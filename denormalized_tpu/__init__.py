@@ -1,0 +1,27 @@
+"""denormalized_tpu — a TPU-native stream-processing framework.
+
+A brand-new engine with the capability contract of the reference
+(probably-nothing-labs/denormalized: Kafka sources/sinks, JSON/Avro decoding,
+event-time watermarks, tumbling/sliding windowed aggregation, stream joins,
+barrier checkpointing, fluent Python API — see SURVEY.md), re-designed
+TPU-first:
+
+- The windowed-aggregate hot path (the reference's ``GroupedWindowAggStream``,
+  crates/core/src/physical_plan/continuous/grouped_window_agg_stream.rs) runs
+  as a single ``jax.jit`` step over *device-resident* window x group state in
+  HBM with donated buffers; only watermark-triggered windows cross back to
+  host.
+- Scale-out (the reference's ``RepartitionExec`` hash exchange + per-partition
+  tokio tasks) maps to ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives over ICI, not channels.
+- The host runtime around the compute path (ingest, decode, state backend) has
+  native C++ components, mirroring the reference's use of librdkafka/SlateDB.
+"""
+
+from denormalized_tpu.api.context import Context
+from denormalized_tpu.api.data_stream import DataStream
+from denormalized_tpu.logical.expr import Expr, col, lit
+
+__version__ = "0.1.0"
+
+__all__ = ["Context", "DataStream", "Expr", "col", "lit", "__version__"]

@@ -1,0 +1,119 @@
+"""Session context — the framework entry point.
+
+Mirror of the reference's ``Context`` (crates/core/src/context.rs:24-89) and
+its Python wrapper (py-denormalized python/denormalized/context.py): builds
+the session with streaming defaults, registers topics/sources as named
+tables, and hands out :class:`DataStream` builders.  Where the reference
+configures DataFusion (batch_size=32, coalesce off, custom planner/optimizer,
+context.rs:27-58), we configure the TPU execution profile: batch bucketing,
+accumulator dtype, state capacities, device mesh, and the checkpoint backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.sources.base import Source
+
+
+@dataclass
+class EngineConfig:
+    """Engine tuning knobs (the reference's SessionConfig + the
+    ``denormalized_config`` extension, config_extensions/denormalized_config.rs:4-13).
+
+    The reference runs 32-row micro-batches with coalescing disabled to keep
+    latency low on CPU; a TPU step amortizes dispatch over much larger
+    buckets, so the default bucket is 8192 rows and sources should aim for
+    ms-scale batches."""
+
+    # checkpoint flag — mirror of denormalized_config.checkpoint
+    checkpoint: bool = False
+    checkpoint_interval_s: float = 10.0  # orchestrator cadence (orchestrator.rs:58)
+    state_backend_path: str | None = None
+
+    # device execution profile
+    accum_dtype: Any = jnp.float32
+    min_batch_bucket: int = 256
+    min_group_capacity: int = 128
+    min_window_slots: int = 16
+    emit_on_close: bool = True
+
+    # sharding (parallel/): number of devices to shard group-state over;
+    # None = single device
+    mesh_devices: int | None = None
+
+    def set(self, key: str, value) -> "EngineConfig":
+        """String-keyed setter for parity with SessionConfig::set
+        (README.md:105 `denormalized_config.checkpoint`)."""
+        k = key.removeprefix("denormalized_config.")
+        if not hasattr(self, k):
+            raise PlanError(f"unknown config key {key!r}")
+        setattr(self, k, value)
+        return self
+
+
+class Context:
+    """Session factory: registers sources, builds streams."""
+
+    def __init__(self, config: EngineConfig | None = None) -> None:
+        self.config = config or EngineConfig()
+        self._tables: dict[str, Source] = {}
+        self._orchestrator = None
+
+    # -- registration (Context::from_topic, context.rs:65-72) -----------
+    def register_source(self, name: str, source: Source) -> None:
+        self._tables[name] = source
+
+    def from_source(self, source: Source, name: str | None = None):
+        from denormalized_tpu.api.data_stream import DataStream
+
+        name = name or source.name
+        self.register_source(name, source)
+        scan = lp.Scan(name, source, source.schema)
+        return DataStream(scan, self)
+
+    def from_topic(
+        self,
+        topic: str,
+        sample_json: str | None = None,
+        bootstrap_servers: str = "localhost:9092",
+        group_id: str = "denormalized-tpu",
+        timestamp_column: str | None = None,
+        encoding: str = "json",
+        schema: Schema | None = None,
+    ):
+        """Kafka source entry point (PyContext::from_topic,
+        py-denormalized/src/context.rs:50-117): schema comes from an explicit
+        Schema or is inferred from ``sample_json``."""
+        from denormalized_tpu.sources.kafka import KafkaSource, KafkaTopicBuilder
+
+        builder = (
+            KafkaTopicBuilder(bootstrap_servers)
+            .with_topic(topic)
+            .with_encoding(encoding)
+            .with_group_id(group_id)
+        )
+        if timestamp_column:
+            builder = builder.with_timestamp_column(timestamp_column)
+        if schema is not None:
+            builder = builder.with_schema(schema)
+        elif sample_json is not None:
+            builder = builder.infer_schema_from_json(sample_json)
+        return self.from_source(builder.build_reader(), name=topic)
+
+    def table(self, name: str) -> Source:
+        if name not in self._tables:
+            raise PlanError(f"unknown table {name!r}")
+        return self._tables[name]
+
+    # -- state backend (Context::with_slatedb_backend, context.rs:77-86) -
+    def with_state_backend(self, path: str) -> "Context":
+        self.config.state_backend_path = path
+        self.config.checkpoint = True
+        return self

@@ -1,0 +1,217 @@
+"""DataStream — the fluent user API.
+
+Method-for-method capability mirror of the reference's ``DataStream``
+(crates/core/src/datastream.rs) and its Python wrapper
+(py-denormalized/python/denormalized/data_stream.py): select / filter /
+with_column / drop_columns / join / window / print_stream / sink.  Plan
+building is lazy; execution happens in the sink methods, wrapped in the
+orchestrator lifecycle when checkpointing is on (with_orchestrator,
+datastream.rs:244-307).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Sequence
+
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.logical.expr import AggregateExpr, Column, Expr, col
+
+
+class DataStream:
+    def __init__(self, plan: lp.LogicalPlan, ctx) -> None:
+        self._plan = plan
+        self._ctx = ctx
+
+    # -- schema (strips internal metadata, datastream.rs:199-210) --------
+    def schema(self) -> Schema:
+        return self._plan.schema.without_internal()
+
+    def logical_plan(self) -> lp.LogicalPlan:
+        return self._plan
+
+    def _wrap(self, plan: lp.LogicalPlan) -> "DataStream":
+        return DataStream(plan, self._ctx)
+
+    # -- transforms ------------------------------------------------------
+    def select(self, *exprs: Expr | str) -> "DataStream":
+        exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+        return self._wrap(lp.Project(self._plan, exprs))
+
+    def select_columns(self, *names: str) -> "DataStream":
+        return self.select(*[col(n) for n in names])
+
+    def filter(self, predicate: Expr) -> "DataStream":
+        return self._wrap(lp.Filter(self._plan, predicate))
+
+    def with_column(self, name: str, expr: Expr) -> "DataStream":
+        """Add or replace a column (datastream.rs:107-114)."""
+        exprs: list[Expr] = []
+        replaced = False
+        for f in self._plan.schema.without_internal():
+            if f.name == name:
+                exprs.append(expr.alias(name))
+                replaced = True
+            else:
+                exprs.append(col(f.name))
+        if not replaced:
+            exprs.append(expr.alias(name))
+        return self.select(*exprs)
+
+    def with_column_renamed(self, old: str, new: str) -> "DataStream":
+        exprs = [
+            col(f.name).alias(new) if f.name == old else col(f.name)
+            for f in self._plan.schema.without_internal()
+        ]
+        return self.select(*exprs)
+
+    def drop_columns(self, *names: str) -> "DataStream":
+        keep = [
+            col(f.name)
+            for f in self._plan.schema.without_internal()
+            if f.name not in set(names)
+        ]
+        return self.select(*keep)
+
+    # -- windows (datastream.rs:178-197) ---------------------------------
+    def window(
+        self,
+        group_exprs: Sequence[Expr | str],
+        aggr_exprs: Sequence[AggregateExpr],
+        window_length_ms: int,
+        slide_ms: int | None = None,
+    ) -> "DataStream":
+        """Windowed aggregation: tumbling when ``slide_ms`` is None,
+        sliding otherwise (mirrors the reference signature where slide=None
+        means tumbling, logical_plan/mod.rs:29-58)."""
+        group_exprs = [col(g) if isinstance(g, str) else g for g in group_exprs]
+        for a in aggr_exprs:
+            if not isinstance(a, AggregateExpr):
+                raise PlanError(f"{a!r} is not an aggregate expression")
+        wt = lp.WindowType.TUMBLING if slide_ms is None else lp.WindowType.SLIDING
+        return self._wrap(
+            lp.StreamingWindow(
+                self._plan,
+                list(group_exprs),
+                list(aggr_exprs),
+                wt,
+                int(window_length_ms),
+                int(slide_ms) if slide_ms is not None else None,
+            )
+        )
+
+    def session_window(
+        self,
+        group_exprs: Sequence[Expr | str],
+        aggr_exprs: Sequence[AggregateExpr],
+        gap_ms: int,
+    ) -> "DataStream":
+        """Session windows — declared in the reference's WindowType but left
+        `todo!()` (streaming_window.rs session arm); implemented here."""
+        group_exprs = [col(g) if isinstance(g, str) else g for g in group_exprs]
+        return self._wrap(
+            lp.StreamingWindow(
+                self._plan,
+                list(group_exprs),
+                list(aggr_exprs),
+                lp.WindowType.SESSION,
+                int(gap_ms),
+                None,
+            )
+        )
+
+    # -- joins (datastream.rs:126-177, Joinable trait :379-395) ----------
+    def join(
+        self,
+        right: "DataStream",
+        join_type: str = "inner",
+        left_cols: Sequence[str] = (),
+        right_cols: Sequence[str] = (),
+        filter: Expr | None = None,
+    ) -> "DataStream":
+        return self._wrap(
+            lp.Join(
+                self._plan,
+                right._plan,
+                lp.JoinKind(join_type.lower()),
+                list(left_cols),
+                list(right_cols),
+                filter,
+            )
+        )
+
+    def join_on(
+        self, right: "DataStream", join_type: str, on_exprs: Sequence[Expr]
+    ) -> "DataStream":
+        """Equi-join via `left_col == right_col` expressions
+        (datastream.rs:126-148)."""
+        from denormalized_tpu.logical.expr import BinaryExpr
+
+        lcols, rcols = [], []
+        for e in on_exprs:
+            if not (
+                isinstance(e, BinaryExpr)
+                and e.op == "=="
+                and isinstance(e.left, Column)
+                and isinstance(e.right, Column)
+            ):
+                raise PlanError("join_on expects col == col expressions")
+            lcols.append(e.left.name)
+            rcols.append(e.right.name)
+        return self.join(right, join_type, lcols, rcols)
+
+    # -- introspection ---------------------------------------------------
+    def print_plan(self) -> "DataStream":
+        print(self._plan.display())
+        return self
+
+    def print_physical_plan(self) -> "DataStream":
+        from denormalized_tpu.planner.planner import Planner
+
+        print(Planner(self._ctx.config).create_physical_plan(self._plan).display())
+        return self
+
+    # -- execution -------------------------------------------------------
+    def _execute(self, sink) -> None:
+        from denormalized_tpu.runtime.executor import execute_plan
+
+        execute_plan(lp.Sink(self._plan, sink), self._ctx)
+
+    def print_stream(self) -> None:
+        """Execute, printing rows as JSON (datastream.rs:311-339)."""
+        from denormalized_tpu.physical.simple_execs import PrintSink
+
+        self._execute(PrintSink())
+
+    def sink(self, fn: Callable[[RecordBatch], None]) -> None:
+        """Execute, calling ``fn`` per emitted batch (the PyO3 sink_python
+        path, py-denormalized/src/datastream.rs:229-270)."""
+        from denormalized_tpu.physical.simple_execs import CallbackSink
+
+        self._execute(CallbackSink(fn))
+
+    def sink_kafka(self, bootstrap_servers: str, topic: str) -> None:
+        """Execute, producing JSON rows to a Kafka topic
+        (datastream.rs:346-374)."""
+        from denormalized_tpu.sources.kafka import KafkaSinkWriter
+
+        self._execute(KafkaSinkWriter(bootstrap_servers, topic))
+
+    def collect(self) -> RecordBatch:
+        """Execute a bounded stream to completion and return all emitted
+        rows — the integration-test seam the reference lacks (SURVEY.md §4)."""
+        from denormalized_tpu.physical.simple_execs import CollectSink
+
+        s = CollectSink()
+        self._execute(s)
+        if not s.batches:
+            return RecordBatch.empty(self._plan.schema)
+        return s.result()
+
+    def stream(self) -> Iterator[RecordBatch]:
+        """Incremental pull-based execution (DataStream::execute_stream)."""
+        from denormalized_tpu.runtime.executor import stream_plan
+
+        yield from stream_plan(self._plan, self._ctx)

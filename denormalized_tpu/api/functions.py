@@ -1,0 +1,74 @@
+"""Aggregate / scalar function constructors.
+
+The public surface mirroring the subset of datafusion-python's
+``functions`` module the reference re-exports
+(py-denormalized/python/denormalized/datafusion/functions.py) and the Rust
+examples use (count/min/max/avg at examples/examples/simple_aggregation.rs:40-46).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from denormalized_tpu.common.schema import DataType
+from denormalized_tpu.logical.expr import (
+    AggregateExpr,
+    Expr,
+    ScalarUDFExpr,
+    col,
+)
+
+
+def count(expr: Expr | str | None = None) -> AggregateExpr:
+    e = col(expr) if isinstance(expr, str) else expr
+    return AggregateExpr("count", e)
+
+
+def sum(expr: Expr | str) -> AggregateExpr:  # noqa: A001 - mirrors SQL name
+    e = col(expr) if isinstance(expr, str) else expr
+    return AggregateExpr("sum", e)
+
+
+def min(expr: Expr | str) -> AggregateExpr:  # noqa: A001
+    e = col(expr) if isinstance(expr, str) else expr
+    return AggregateExpr("min", e)
+
+
+def max(expr: Expr | str) -> AggregateExpr:  # noqa: A001
+    e = col(expr) if isinstance(expr, str) else expr
+    return AggregateExpr("max", e)
+
+
+def avg(expr: Expr | str) -> AggregateExpr:
+    e = col(expr) if isinstance(expr, str) else expr
+    return AggregateExpr("avg", e)
+
+
+def udf(fn: Callable, return_type: DataType, name: str | None = None):
+    """Scalar UDF over vectorized columns (reference udf_example.rs:22-60,
+    py udf.py)."""
+
+    name = name or getattr(fn, "__name__", "udf")
+
+    def make(*args: Expr | str) -> Expr:
+        exprs = tuple(col(a) if isinstance(a, str) else a for a in args)
+        return ScalarUDFExpr(fn, exprs, name, return_type)
+
+    return make
+
+
+def udaf(accumulator_cls, return_type: DataType, name: str | None = None):
+    """User-defined aggregate: ``accumulator_cls`` subclasses
+    :class:`denormalized_tpu.api.udaf.Accumulator` (reference
+    py-denormalized python/denormalized/datafusion/udf.py Accumulator +
+    python/examples/udaf_example.py)."""
+    from denormalized_tpu.api.udaf import UDAF
+
+    name = name or getattr(accumulator_cls, "__name__", "udaf")
+
+    def make(*args: Expr | str) -> AggregateExpr:
+        exprs = [col(a) if isinstance(a, str) else a for a in args]
+        u = UDAF(accumulator_cls, tuple(exprs), return_type, name)
+        return AggregateExpr("udaf", exprs[0] if exprs else None, None, u)
+
+    return make

@@ -1,0 +1,29 @@
+from denormalized_tpu.common.constants import (
+    CANONICAL_TIMESTAMP_COLUMN,
+    INTERNAL_METADATA_COLUMN,
+    WINDOW_END_COLUMN,
+    WINDOW_START_COLUMN,
+)
+from denormalized_tpu.common.errors import (
+    DenormalizedError,
+    PlanError,
+    SchemaError,
+    StateError,
+)
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.common.record_batch import RecordBatch
+
+__all__ = [
+    "CANONICAL_TIMESTAMP_COLUMN",
+    "INTERNAL_METADATA_COLUMN",
+    "WINDOW_END_COLUMN",
+    "WINDOW_START_COLUMN",
+    "DenormalizedError",
+    "PlanError",
+    "SchemaError",
+    "StateError",
+    "DataType",
+    "Field",
+    "Schema",
+    "RecordBatch",
+]

@@ -1,0 +1,4 @@
+from denormalized_tpu.logical.expr import Expr, col, lit
+from denormalized_tpu.logical import plan
+
+__all__ = ["Expr", "col", "lit", "plan"]

@@ -1,0 +1,530 @@
+"""Expression tree: columns, literals, arithmetic/comparison/boolean ops,
+struct-field access, casts, aliases, and aggregate calls.
+
+Capability mirror of the DataFusion ``Expr`` surface the reference exposes
+through its fluent API (datastream.rs select/filter/with_column; nested field
+access used in examples/examples/kafka_rideshare.rs:40-57; aggregates built in
+examples via ``min``/``max``/``avg``/``count``).  Two evaluators exist:
+
+- :meth:`Expr.eval` — host-side vectorized numpy over a ``RecordBatch``
+  (projections, filters, join keys, string work).
+- :meth:`Expr.eval_jax` — the same tree traced over ``jax`` arrays; used for
+  numeric post-aggregation filters and scalar compute fused into the jitted
+  device step, so XLA fuses them into the aggregation kernel.
+"""
+
+from __future__ import annotations
+
+import operator
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from denormalized_tpu.common.errors import PlanError, SchemaError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+
+_BIN_NUMPY: dict[str, Callable] = {
+    "+": operator.add,
+    "-": operator.sub,
+    "*": operator.mul,
+    "/": operator.truediv,
+    "%": operator.mod,
+    "==": operator.eq,
+    "!=": operator.ne,
+    "<": operator.lt,
+    "<=": operator.le,
+    ">": operator.gt,
+    ">=": operator.ge,
+    "and": np.logical_and,
+    "or": np.logical_or,
+}
+
+_CMP = {"==", "!=", "<", "<=", ">", ">="}
+_BOOL = {"and", "or"}
+
+
+class Expr:
+    """Base expression node; builder methods mirror datafusion-python's Expr
+    (reference py-denormalized/python/denormalized/datafusion/expr.py)."""
+
+    # -- builder sugar ---------------------------------------------------
+    def __add__(self, other):
+        return BinaryExpr("+", self, _wrap(other))
+
+    def __radd__(self, other):
+        return BinaryExpr("+", _wrap(other), self)
+
+    def __sub__(self, other):
+        return BinaryExpr("-", self, _wrap(other))
+
+    def __rsub__(self, other):
+        return BinaryExpr("-", _wrap(other), self)
+
+    def __mul__(self, other):
+        return BinaryExpr("*", self, _wrap(other))
+
+    def __rmul__(self, other):
+        return BinaryExpr("*", _wrap(other), self)
+
+    def __truediv__(self, other):
+        return BinaryExpr("/", self, _wrap(other))
+
+    def __rtruediv__(self, other):
+        return BinaryExpr("/", _wrap(other), self)
+
+    def __mod__(self, other):
+        return BinaryExpr("%", self, _wrap(other))
+
+    def __eq__(self, other):  # type: ignore[override]
+        return BinaryExpr("==", self, _wrap(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return BinaryExpr("!=", self, _wrap(other))
+
+    def __lt__(self, other):
+        return BinaryExpr("<", self, _wrap(other))
+
+    def __le__(self, other):
+        return BinaryExpr("<=", self, _wrap(other))
+
+    def __gt__(self, other):
+        return BinaryExpr(">", self, _wrap(other))
+
+    def __ge__(self, other):
+        return BinaryExpr(">=", self, _wrap(other))
+
+    def __and__(self, other):
+        return BinaryExpr("and", self, _wrap(other))
+
+    def __or__(self, other):
+        return BinaryExpr("or", self, _wrap(other))
+
+    def __invert__(self):
+        return NotExpr(self)
+
+    def __hash__(self):
+        return hash(repr(self))
+
+    def alias(self, name: str) -> "Expr":
+        return AliasExpr(self, name)
+
+    def field(self, name: str) -> "Expr":
+        """Struct-field access: ``col('gps').field('speed')`` (reference
+        kafka_rideshare.rs:40)."""
+        return FieldAccessExpr(self, name)
+
+    def cast(self, dtype: DataType) -> "Expr":
+        return CastExpr(self, dtype)
+
+    def is_null(self) -> "Expr":
+        return IsNullExpr(self, negate=False)
+
+    def is_not_null(self) -> "Expr":
+        return IsNullExpr(self, negate=True)
+
+    # -- interface -------------------------------------------------------
+    @property
+    def name(self) -> str:
+        """Output column name."""
+        raise NotImplementedError
+
+    def out_field(self, schema: Schema) -> Field:
+        raise NotImplementedError
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        """Vectorized host evaluation → one array of batch.num_rows."""
+        raise NotImplementedError
+
+    def eval_jax(self, cols: dict[str, Any]):
+        """Trace over a dict of column -> jax array (device evaluation)."""
+        raise NotImplementedError
+
+    def columns_referenced(self) -> set[str]:
+        raise NotImplementedError
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Literal(v)
+
+
+@dataclass(frozen=True, eq=False)
+class Column(Expr):
+    _name: str
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def out_field(self, schema: Schema) -> Field:
+        return schema.field(self._name)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        return batch.column(self._name)
+
+    def eval_jax(self, cols: dict[str, Any]):
+        if self._name not in cols:
+            raise SchemaError(f"column {self._name!r} not on device")
+        return cols[self._name]
+
+    def columns_referenced(self) -> set[str]:
+        return {self._name}
+
+    def __repr__(self):
+        return f"col({self._name!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class Literal(Expr):
+    value: Any
+
+    @property
+    def name(self) -> str:
+        return f"lit({self.value})"
+
+    def out_field(self, schema: Schema) -> Field:
+        return Field(self.name, _literal_dtype(self.value), nullable=False)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        dt = _literal_dtype(self.value).to_numpy()
+        return np.full(batch.num_rows, self.value, dtype=dt)
+
+    def eval_jax(self, cols: dict[str, Any]):
+        return self.value
+
+    def columns_referenced(self) -> set[str]:
+        return set()
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def _literal_dtype(v) -> DataType:
+    if isinstance(v, bool):
+        return DataType.BOOL
+    if isinstance(v, (int, np.integer)):
+        return DataType.INT64
+    if isinstance(v, (float, np.floating)):
+        return DataType.FLOAT64
+    if isinstance(v, str):
+        return DataType.STRING
+    raise PlanError(f"unsupported literal {v!r}")
+
+
+@dataclass(frozen=True, eq=False)
+class BinaryExpr(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+    @property
+    def name(self) -> str:
+        return f"{self.left.name} {self.op} {self.right.name}"
+
+    def out_field(self, schema: Schema) -> Field:
+        if self.op in _CMP or self.op in _BOOL:
+            return Field(self.name, DataType.BOOL)
+        lf = self.left.out_field(schema)
+        rf = self.right.out_field(schema)
+        return Field(self.name, _promote(lf.dtype, rf.dtype, self.op))
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        l = self.left.eval(batch)
+        r = self.right.eval(batch)
+        if self.op in _CMP and (
+            getattr(l, "dtype", None) == object or getattr(r, "dtype", None) == object
+        ):
+            # string comparison: numpy object arrays compare elementwise fine
+            return _BIN_NUMPY[self.op](l, r).astype(bool)
+        return _BIN_NUMPY[self.op](l, r)
+
+    def eval_jax(self, cols: dict[str, Any]):
+        import jax.numpy as jnp
+
+        l = self.left.eval_jax(cols)
+        r = self.right.eval_jax(cols)
+        fn = {
+            "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply,
+            "/": jnp.divide, "%": jnp.mod,
+            "==": jnp.equal, "!=": jnp.not_equal,
+            "<": jnp.less, "<=": jnp.less_equal,
+            ">": jnp.greater, ">=": jnp.greater_equal,
+            "and": jnp.logical_and, "or": jnp.logical_or,
+        }[self.op]
+        return fn(l, r)
+
+    def columns_referenced(self) -> set[str]:
+        return self.left.columns_referenced() | self.right.columns_referenced()
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+def _promote(a: DataType, b: DataType, op: str) -> DataType:
+    if op == "/":
+        return DataType.FLOAT64
+    order = [
+        DataType.BOOL,
+        DataType.INT32,
+        DataType.INT64,
+        DataType.TIMESTAMP_MS,
+        DataType.FLOAT32,
+        DataType.FLOAT64,
+    ]
+    if a in order and b in order:
+        return order[max(order.index(a), order.index(b))]
+    if DataType.STRING in (a, b):
+        return DataType.STRING
+    raise SchemaError(f"cannot promote {a} and {b}")
+
+
+@dataclass(frozen=True, eq=False)
+class NotExpr(Expr):
+    inner: Expr
+
+    @property
+    def name(self) -> str:
+        return f"NOT {self.inner.name}"
+
+    def out_field(self, schema: Schema) -> Field:
+        return Field(self.name, DataType.BOOL)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        return np.logical_not(self.inner.eval(batch))
+
+    def eval_jax(self, cols):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(self.inner.eval_jax(cols))
+
+    def columns_referenced(self) -> set[str]:
+        return self.inner.columns_referenced()
+
+    def __repr__(self):
+        return f"(~{self.inner!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class IsNullExpr(Expr):
+    inner: Expr
+    negate: bool
+
+    @property
+    def name(self) -> str:
+        return f"{self.inner.name} IS {'NOT ' if self.negate else ''}NULL"
+
+    def out_field(self, schema: Schema) -> Field:
+        return Field(self.name, DataType.BOOL)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        if isinstance(self.inner, Column):
+            m = batch.mask(self.inner.name)
+            null = (
+                np.zeros(batch.num_rows, dtype=bool) if m is None else ~m
+            )
+        else:
+            v = self.inner.eval(batch)
+            null = (
+                np.array([x is None for x in v])
+                if v.dtype == object
+                else np.isnan(v) if v.dtype.kind == "f" else np.zeros(len(v), bool)
+            )
+        return ~null if self.negate else null
+
+    def columns_referenced(self) -> set[str]:
+        return self.inner.columns_referenced()
+
+
+@dataclass(frozen=True, eq=False)
+class AliasExpr(Expr):
+    inner: Expr
+    _name: str
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def out_field(self, schema: Schema) -> Field:
+        f = self.inner.out_field(schema)
+        return Field(self._name, f.dtype, f.nullable, f.children)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        return self.inner.eval(batch)
+
+    def eval_jax(self, cols):
+        return self.inner.eval_jax(cols)
+
+    def columns_referenced(self) -> set[str]:
+        return self.inner.columns_referenced()
+
+    def __repr__(self):
+        return f"{self.inner!r}.alias({self._name!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class FieldAccessExpr(Expr):
+    inner: Expr
+    field_name: str
+
+    @property
+    def name(self) -> str:
+        return f"{self.inner.name}.{self.field_name}"
+
+    def out_field(self, schema: Schema) -> Field:
+        f = self.inner.out_field(schema)
+        if f.dtype is not DataType.STRUCT:
+            raise SchemaError(f"{f.name!r} is not a struct")
+        for c in f.children:
+            if c.name == self.field_name:
+                return Field(self.name, c.dtype, c.nullable, c.children)
+        raise SchemaError(f"struct {f.name!r} has no field {self.field_name!r}")
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        structs = self.inner.eval(batch)  # object array of dicts
+        out = np.empty(len(structs), dtype=object)
+        for i, s in enumerate(structs):
+            out[i] = None if s is None else s.get(self.field_name)
+        # densify numerics
+        try:
+            tight = np.asarray(out.tolist())
+            if tight.dtype.kind in "ifb":
+                return tight
+        except (ValueError, TypeError):
+            pass
+        return out
+
+    def columns_referenced(self) -> set[str]:
+        return self.inner.columns_referenced()
+
+    def __repr__(self):
+        return f"{self.inner!r}.field({self.field_name!r})"
+
+
+@dataclass(frozen=True, eq=False)
+class CastExpr(Expr):
+    inner: Expr
+    dtype: DataType
+
+    @property
+    def name(self) -> str:
+        return self.inner.name
+
+    def out_field(self, schema: Schema) -> Field:
+        f = self.inner.out_field(schema)
+        return Field(f.name, self.dtype, f.nullable)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        v = self.inner.eval(batch)
+        if self.dtype is DataType.STRING:
+            return np.array([str(x) for x in v], dtype=object)
+        return np.asarray(v).astype(self.dtype.to_numpy())
+
+    def eval_jax(self, cols):
+        import jax.numpy as jnp
+
+        jdt = {
+            DataType.INT32: jnp.int32,
+            DataType.INT64: jnp.int32,  # device stays 32-bit unless x64 on
+            DataType.FLOAT32: jnp.float32,
+            DataType.FLOAT64: jnp.float32,
+            DataType.BOOL: jnp.bool_,
+        }.get(self.dtype)
+        if jdt is None:
+            raise PlanError(f"cannot cast to {self.dtype} on device")
+        return self.inner.eval_jax(cols).astype(jdt)
+
+    def columns_referenced(self) -> set[str]:
+        return self.inner.columns_referenced()
+
+
+@dataclass(frozen=True, eq=False)
+class ScalarUDFExpr(Expr):
+    """User-defined scalar function over numpy columns (reference:
+    udf_example.rs + py udf.py)."""
+
+    fn: Callable
+    args: tuple[Expr, ...]
+    _name: str
+    dtype: DataType
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def out_field(self, schema: Schema) -> Field:
+        return Field(self._name, self.dtype)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        return np.asarray(self.fn(*[a.eval(batch) for a in self.args]))
+
+    def eval_jax(self, cols):
+        return self.fn(*[a.eval_jax(cols) for a in self.args])
+
+    def columns_referenced(self) -> set[str]:
+        s: set[str] = set()
+        for a in self.args:
+            s |= a.columns_referenced()
+        return s
+
+    def __repr__(self):
+        return f"{self._name}({', '.join(map(repr, self.args))})"
+
+
+# -- aggregates ---------------------------------------------------------
+
+AGG_KINDS = ("count", "sum", "min", "max", "avg")
+
+
+@dataclass(frozen=True, eq=False)
+class AggregateExpr(Expr):
+    """An aggregate call inside window(): count/sum/min/max/avg or a UDAF."""
+
+    kind: str  # one of AGG_KINDS or "udaf"
+    arg: Expr | None  # None for count(*)
+    _alias: str | None = None
+    udaf: Any = None  # api.udaf.UDAF instance when kind == "udaf"
+
+    @property
+    def name(self) -> str:
+        if self._alias:
+            return self._alias
+        argname = self.arg.name if self.arg is not None else "*"
+        return f"{self.kind}({argname})"
+
+    def alias(self, name: str) -> "AggregateExpr":
+        return AggregateExpr(self.kind, self.arg, name, self.udaf)
+
+    def out_field(self, schema: Schema) -> Field:
+        if self.kind == "count":
+            return Field(self.name, DataType.INT64, nullable=False)
+        if self.kind == "avg":
+            return Field(self.name, DataType.FLOAT64)
+        if self.kind == "udaf":
+            return Field(self.name, self.udaf.return_type)
+        f = self.arg.out_field(schema)
+        if self.kind == "sum":
+            if f.dtype in (DataType.INT32, DataType.INT64, DataType.BOOL):
+                return Field(self.name, DataType.INT64)
+            return Field(self.name, DataType.FLOAT64)
+        return Field(self.name, f.dtype)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        raise PlanError("aggregate expression outside window()")
+
+    def columns_referenced(self) -> set[str]:
+        return self.arg.columns_referenced() if self.arg is not None else set()
+
+    def __repr__(self):
+        return self.name
+
+
+# -- public constructors (mirror datafusion-python functions module) -----
+
+
+def col(name: str) -> Expr:
+    return Column(name)
+
+
+def lit(value) -> Expr:
+    return Literal(value)

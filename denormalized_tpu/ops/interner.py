@@ -1,0 +1,119 @@
+"""Host-side group-key interning: values → dense int32 group ids.
+
+The TPU analog of DataFusion's ``GroupValues`` hash-interning table, which the
+reference drives inside ``GroupedAggWindowFrame::group_aggregate_batch``
+(grouped_window_agg_stream.rs:501-537): group keys are interned to dense
+indices so accumulators can be flat vectors.  Here the dense id doubles as the
+row index into the device-resident ``(windows, groups)`` state buffers, so
+interning is the bridge between host strings and HBM tensors.
+
+Vectorized via ``np.unique`` per batch: only first-seen values take the Python
+dict path.  A C++ fast path can replace `_lookup_batch` without changing the
+interface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ColumnInterner:
+    """value -> id for one column (any hashable host values)."""
+
+    def __init__(self) -> None:
+        self._to_id: dict = {}
+        self._values: list = []
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def intern_array(self, arr: np.ndarray) -> np.ndarray:
+        if arr.dtype.kind in "ifb" or arr.dtype.kind == "M":
+            # numeric key column: unique per batch, dict on uniques only
+            uniq, inv = np.unique(arr, return_inverse=True)
+        else:
+            uniq, inv = np.unique(arr.astype(object), return_inverse=True)
+        ids = np.empty(len(uniq), dtype=np.int32)
+        to_id = self._to_id
+        values = self._values
+        for i, v in enumerate(uniq.tolist()):
+            j = to_id.get(v)
+            if j is None:
+                j = len(values)
+                to_id[v] = j
+                values.append(v)
+            ids[i] = j
+        return ids[inv]
+
+    def value_of(self, ids: np.ndarray) -> np.ndarray:
+        out = np.empty(len(ids), dtype=object)
+        for i, j in enumerate(ids.tolist()):
+            out[i] = self._values[j]
+        return out
+
+
+class GroupInterner:
+    """Composite (multi-column) key -> dense group id.
+
+    Per-column ids are packed row-wise and the row-tuples interned, so the
+    reverse map can reconstruct every key column for emission.
+    """
+
+    def __init__(self, num_columns: int) -> None:
+        self.num_columns = num_columns
+        self._col_interners = [ColumnInterner() for _ in range(num_columns)]
+        self._tuple_to_gid: dict = {}
+        # per group id, the tuple of per-column value ids
+        self._gid_rows: list[tuple] = []
+
+    def __len__(self) -> int:
+        return len(self._gid_rows)
+
+    def intern(self, key_columns: list[np.ndarray]) -> np.ndarray:
+        assert len(key_columns) == self.num_columns
+        per_col = [
+            it.intern_array(c) for it, c in zip(self._col_interners, key_columns)
+        ]
+        if self.num_columns == 1:
+            # single-column fast path: column id IS the group id candidate,
+            # but keep the tuple table for a uniform reverse map
+            stacked = per_col[0][:, None]
+        else:
+            stacked = np.stack(per_col, axis=1)
+        uniq_rows, inv = np.unique(stacked, axis=0, return_inverse=True)
+        gids_for_uniq = np.empty(len(uniq_rows), dtype=np.int32)
+        for i, row in enumerate(map(tuple, uniq_rows.tolist())):
+            g = self._tuple_to_gid.get(row)
+            if g is None:
+                g = len(self._gid_rows)
+                self._tuple_to_gid[row] = g
+                self._gid_rows.append(row)
+            gids_for_uniq[i] = g
+        return gids_for_uniq[inv]
+
+    def keys_of(self, gids: np.ndarray) -> list[np.ndarray]:
+        """Reconstruct each key column's values for the given group ids."""
+        rows = np.array([self._gid_rows[g] for g in gids.tolist()], dtype=np.int64)
+        if len(gids) == 0:
+            rows = rows.reshape(0, self.num_columns)
+        return [
+            it.value_of(rows[:, c])
+            for c, it in enumerate(self._col_interners)
+        ]
+
+    # -- checkpoint support ---------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "columns": [it._values for it in self._col_interners],
+            "rows": self._gid_rows,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "GroupInterner":
+        g = cls(len(snap["columns"]))
+        for it, vals in zip(g._col_interners, snap["columns"]):
+            it._values = list(vals)
+            it._to_id = {v: i for i, v in enumerate(it._values)}
+        g._gid_rows = [tuple(r) for r in snap["rows"]]
+        g._tuple_to_gid = {r: i for i, r in enumerate(g._gid_rows)}
+        return g

@@ -1,0 +1,268 @@
+"""Device-resident windowed segment aggregation — THE hot path.
+
+TPU re-design of the reference's ``GroupedWindowAggStream`` /
+``GroupedAggWindowFrame`` (grouped_window_agg_stream.rs:501-605): where the
+reference keeps one ``GroupValues`` table + boxed ``GroupsAccumulator`` per
+open window frame and pushes 32-row batches through them on CPU, we keep ONE
+set of ``(num_window_slots, group_capacity)`` accumulator buffers resident in
+TPU HBM for *all* open windows and update them with a single ``jax.jit``
+step per (large) batch:
+
+- window slots form a ring over the window index (slide index), so sliding
+  windows fan out on-device without duplicating row data (the reference
+  re-filters the batch once per overlapping frame, streaming_window.rs
+  :1063-1075 + :548-605 — O(frames x batch) CPU work);
+- group keys arrive as dense int32 ids from the host interner
+  (:mod:`denormalized_tpu.ops.interner`);
+- nulls are neutralized on-device per aggregate kind (0 for sum, ±inf for
+  min/max) so XLA fuses mask+scatter into one pass over the batch;
+- all state buffers are donated, so the update is allocation-free at
+  steady state;
+- late rows (window < first_open) and padding rows are dropped by scatter
+  ``mode='drop'`` — the device-side mirror of the reference's late-data drop
+  (streaming_window.rs:982-991).
+
+Shapes are static: batches are bucketed to powers of two and state is grown
+by re-compilation when group cardinality or window skew exceeds capacity.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class AggComponent:
+    """One primitive accumulator buffer.  Composite aggregates decompose:
+    avg = sum + count (exactly as DataFusion's AvgGroupsAccumulator does)."""
+
+    kind: str  # 'count' | 'sum' | 'min' | 'max'
+    col: int | None  # value-column index; None = row count (count(*))
+
+    @property
+    def label(self) -> str:
+        return f"{self.kind}_{'star' if self.col is None else self.col}"
+
+
+# presence counter: always first so emission knows which groups are active
+ROW_COUNT = AggComponent("count", None)
+
+
+def components_for(aggs: list[tuple[str, int | None]]) -> list[AggComponent]:
+    """Decompose (kind, value_col) aggregate specs into deduped primitive
+    components.  ``avg`` → sum + count of the same column."""
+    comps: list[AggComponent] = [ROW_COUNT]
+    for kind, col in aggs:
+        if kind == "count":
+            wanted = [AggComponent("count", col)]
+        elif kind == "avg":
+            wanted = [AggComponent("sum", col), AggComponent("count", col)]
+        elif kind in ("sum", "min", "max"):
+            wanted = [AggComponent(kind, col)]
+        else:
+            raise ValueError(f"unknown aggregate kind {kind!r}")
+        for c in wanted:
+            if c not in comps:
+                comps.append(c)
+    return comps
+
+
+@dataclass(frozen=True)
+class WindowKernelSpec:
+    """Static configuration of one compiled window-aggregation kernel.
+
+    Window indexing: windows are identified by their *slide index* ``j``,
+    covering ``[j*slide_ms, j*slide_ms + length_ms)`` in epoch milliseconds
+    (tumbling ⇒ slide == length, epoch-aligned snapping like the reference's
+    ``snap_to_window_start``, streaming_window.rs:1088).  The host rebases
+    indices to ``win_rel = j - first_open`` so the device works in small
+    int32s; ring slots use the *absolute* index mod W via ``base_mod``."""
+
+    components: tuple[AggComponent, ...]
+    num_value_cols: int
+    window_slots: int  # W — ring size over open window indices
+    group_capacity: int  # G — padded group-id capacity (multiple of 128)
+    length_ms: int
+    slide_ms: int
+    accum_dtype: Any = jnp.float32
+
+    @property
+    def length_units(self) -> int:
+        """k = number of windows each row fans out to."""
+        return -(-self.length_ms // self.slide_ms)
+
+    def init_value(self, comp: AggComponent):
+        if comp.kind == "count":
+            return jnp.zeros((), jnp.int32)
+        if comp.kind == "sum":
+            return jnp.zeros((), self.accum_dtype)
+        if comp.kind == "min":
+            return jnp.array(jnp.inf, self.accum_dtype)
+        if comp.kind == "max":
+            return jnp.array(-jnp.inf, self.accum_dtype)
+        raise ValueError(comp.kind)
+
+
+def init_state(spec: WindowKernelSpec) -> dict[str, jax.Array]:
+    """Allocate the HBM-resident accumulator buffers: one (W, G) array per
+    primitive component."""
+    shape = (spec.window_slots, spec.group_capacity)
+    return {
+        c.label: jnp.full(shape, spec.init_value(c))
+        for c in spec.components
+    }
+
+
+def _apply_component(
+    spec: WindowKernelSpec,
+    comp: AggComponent,
+    buf: jax.Array,
+    slot: jax.Array,  # (B,) int32, out-of-range => dropped
+    gid: jax.Array,  # (B,) int32
+    values: jax.Array,  # (B, V) accum_dtype
+    colvalid: jax.Array,  # (B, V) bool
+) -> jax.Array:
+    at = buf.at[slot, gid]
+    if comp.kind == "count":
+        if comp.col is None:
+            inc = jnp.ones(slot.shape, jnp.int32)
+        else:
+            inc = colvalid[:, comp.col].astype(jnp.int32)
+        return at.add(inc, mode="drop")
+    v = values[:, comp.col]
+    ok = colvalid[:, comp.col]
+    if comp.kind == "sum":
+        return at.add(jnp.where(ok, v, 0), mode="drop")
+    if comp.kind == "min":
+        return at.min(jnp.where(ok, v, jnp.inf), mode="drop")
+    if comp.kind == "max":
+        return at.max(jnp.where(ok, v, -jnp.inf), mode="drop")
+    raise ValueError(comp.kind)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def update_state(
+    spec: WindowKernelSpec,
+    state: dict[str, jax.Array],
+    values: jax.Array,  # (B, V)
+    colvalid: jax.Array,  # (B, V) bool
+    win_rel: jax.Array,  # (B,) int32: slide-index of row minus first_open
+    rem_ms: jax.Array,  # (B,) int32: ts - slide_index*slide (in [0, S))
+    gid: jax.Array,  # (B,) int32 dense group ids from the host interner
+    row_valid: jax.Array,  # (B,) bool (padding rows false)
+    base_mod: jax.Array,  # () int32: first_open % W (ring phase)
+) -> dict[str, jax.Array]:
+    """One device step: scatter the batch into every window frame it belongs
+    to.  A row with slide-index ``t`` belongs to windows ``t-k+1 .. t``
+    (k = length_units); the fan-out is a static unrolled loop of k scatters —
+    XLA fuses the mask/neutralize work, and row data crosses host→HBM once
+    regardless of k (tumbling: k=1).  The reference instead re-filters the
+    batch once per overlapping frame on CPU (streaming_window.rs:1063-1075)."""
+    W = spec.window_slots
+    values = values.astype(spec.accum_dtype)
+    for i in range(spec.length_units):
+        wr = win_rel - i  # rebased index of the i-th window this row feeds
+        # membership: window covers the row iff i*S + rem < L (exactly k
+        # windows when L % S == 0); late rows (wr < 0 — window already
+        # emitted; the reference logs-and-drops at streaming_window.rs:982)
+        # and skew overflow (wr >= W, guarded host-side) are masked out.
+        ok = row_valid & (wr >= 0) & (wr < W)
+        if spec.length_ms - i * spec.slide_ms < spec.slide_ms:
+            ok = ok & (rem_ms < spec.length_ms - i * spec.slide_ms)
+        # ring slot of the *absolute* window index; invalid rows pushed out of
+        # range so mode='drop' skips them
+        slot = jnp.where(ok, (wr + base_mod) % W, W).astype(jnp.int32)
+        for comp in spec.components:
+            state[comp.label] = _apply_component(
+                spec, comp, state[comp.label], slot, gid, values, colvalid
+            )
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def reset_slot(
+    spec: WindowKernelSpec, state: dict[str, jax.Array], slot: jax.Array
+) -> dict[str, jax.Array]:
+    """Re-initialize one ring slot after its window was emitted, freeing it
+    for reuse (the reference instead drops the whole frame from its BTreeMap,
+    streaming_window.rs:703-730; our buffers are preallocated)."""
+    for comp in spec.components:
+        buf = state[comp.label]
+        state[comp.label] = buf.at[slot].set(
+            jnp.full((spec.group_capacity,), spec.init_value(comp))
+        )
+    return state
+
+
+def read_slot(
+    spec: WindowKernelSpec, state: dict[str, jax.Array], slot: int
+) -> dict[str, np.ndarray]:
+    """Fetch one window's accumulator rows to host (device→host crossing of
+    G-sized vectors only — results, never raw rows)."""
+    rows = jax.device_get({c.label: state[c.label][slot] for c in spec.components})
+    return rows
+
+
+def export_state(state: dict[str, jax.Array]) -> dict[str, np.ndarray]:
+    """Full device→host snapshot (checkpointing / capacity growth)."""
+    return jax.device_get(state)
+
+
+def import_state(
+    spec: WindowKernelSpec, host_state: dict[str, np.ndarray]
+) -> dict[str, jax.Array]:
+    """Rebuild device state from a host snapshot, padding up to the spec's
+    (possibly larger) capacity — used on restore and on G/W growth."""
+    state = init_state(spec)
+    out = {}
+    for comp in spec.components:
+        # np.array copies: device_get may hand back read-only views
+        buf = np.array(jax.device_get(state[comp.label]))
+        src = host_state.get(comp.label)
+        if src is not None:
+            w = min(src.shape[0], buf.shape[0])
+            g = min(src.shape[1], buf.shape[1])
+            buf[:w, :g] = src[:w, :g]
+        out[comp.label] = jnp.asarray(buf)
+    return out
+
+
+def finalize(
+    agg_specs: list[tuple[str, int | None]],
+    rows: dict[str, np.ndarray],
+    active: np.ndarray,
+) -> list[np.ndarray]:
+    """Host-side final evaluation of one emitted window from its primitive
+    component rows (the mirror of ``Accumulator::evaluate`` /
+    ``GroupsAccumulator::evaluate`` at grouped_window_agg_stream.rs:609-629).
+
+    ``active`` is the boolean mask of live group slots in this window."""
+    outs: list[np.ndarray] = []
+    for kind, col in agg_specs:
+        if kind == "count":
+            label = AggComponent("count", col).label
+            outs.append(rows[label][active].astype(np.int64))
+        elif kind == "sum":
+            outs.append(
+                rows[AggComponent("sum", col).label][active].astype(np.float64)
+            )
+        elif kind == "avg":
+            s = rows[AggComponent("sum", col).label][active].astype(np.float64)
+            c = rows[AggComponent("count", col).label][active].astype(np.float64)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                outs.append(np.where(c > 0, s / np.maximum(c, 1), np.nan))
+        elif kind == "min":
+            v = rows[AggComponent("min", col).label][active].astype(np.float64)
+            outs.append(np.where(np.isposinf(v), np.nan, v))
+        elif kind == "max":
+            v = rows[AggComponent("max", col).label][active].astype(np.float64)
+            outs.append(np.where(np.isneginf(v), np.nan, v))
+        else:
+            raise ValueError(kind)
+    return outs

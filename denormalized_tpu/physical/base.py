@@ -1,0 +1,70 @@
+"""Physical operator protocol.
+
+The reference's physical layer is DataFusion ``ExecutionPlan`` objects
+producing ``RecordBatchStream``s (stream_table.rs, streaming_window.rs).  Ours
+is a pull-based pipeline of Python generators flowing :class:`StreamItem`s:
+
+- ``RecordBatch`` — data;
+- :class:`Marker` — a checkpoint barrier.  Unlike the reference, which
+  delivers barriers out-of-band per stream (orchestrator.rs:55-78, an
+  *approximate* Chandy-Lamport — see SURVEY.md §3.4), markers here flow
+  **in-band and aligned** through the dataflow, so a checkpoint is a
+  consistent cut for free;
+- :class:`EndOfStream` — bounded input exhausted (replay/test sources); the
+  windowed operator flushes open windows on receipt.
+
+Heavy compute happens inside operators (device steps in the window exec);
+the generator plumbing between them moves only batch references.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Union
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+
+
+@dataclass(frozen=True)
+class Marker:
+    """Checkpoint barrier (reference OrchestrationMessage::CheckpointBarrier,
+    orchestrator.rs:12-16)."""
+
+    epoch: int
+
+
+@dataclass(frozen=True)
+class EndOfStream:
+    pass
+
+
+EOS = EndOfStream()
+
+StreamItem = Union[RecordBatch, Marker, EndOfStream]
+
+
+class ExecOperator:
+    """One node of the physical plan."""
+
+    #: output schema
+    schema: Schema
+
+    def run(self) -> Iterator[StreamItem]:
+        raise NotImplementedError
+
+    @property
+    def children(self) -> list["ExecOperator"]:
+        return []
+
+    # -- observability (reference exposes DataFusion MetricsSet via
+    # ExecutionPlan::metrics, streaming_window.rs:491) ------------------
+    def metrics(self) -> dict[str, float]:
+        return {}
+
+    def display(self, indent: int = 0) -> str:
+        line = "  " * indent + self._label()
+        return "\n".join([line] + [c.display(indent + 1) for c in self.children])
+
+    def _label(self) -> str:
+        return type(self).__name__

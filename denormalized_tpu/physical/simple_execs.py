@@ -1,0 +1,276 @@
+"""Source / projection / filter / sink operators (host-side, vectorized).
+
+These mirror the reference's ``DenormalizedStreamingTableExec``
+(stream_table.rs:71-275) and the DataFusion projection/filter/sink nodes its
+plans contain.  They are deliberately thin: all heavy compute lives in the
+windowed operator's device step, and these nodes just move batch references
+and run vectorized numpy expression kernels.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import queue as queue_mod
+from typing import Callable, Iterator
+
+import numpy as np
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.logical.expr import Expr
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+)
+from denormalized_tpu.sources.base import Source
+
+
+class SourceExec(ExecOperator):
+    """Leaf operator: drives every partition of a source and merges their
+    batches into one ordered stream.
+
+    The reference spawns one tokio task per Kafka partition feeding an mpsc
+    channel (kafka_stream_read.rs:87-298); bounded sources here just
+    round-robin in-thread, while unbounded sources get one reader thread per
+    partition feeding a queue (the same shape, sized like the reference's
+    RecordBatchReceiverStreamBuilder).  Checkpoint barriers are injected
+    in-band between batches when an orchestrator is attached.
+    """
+
+    def __init__(self, source: Source, *, queue_size: int = 64) -> None:
+        self.source = source
+        self.schema = source.schema
+        self._queue_size = queue_size
+        self._barrier_poll: Callable[[], int | None] | None = None
+        self._metrics = {"rows_out": 0, "batches_out": 0}
+
+    def set_barrier_source(self, poll: Callable[[], int | None]) -> None:
+        self._barrier_poll = poll
+
+    def metrics(self):
+        return dict(self._metrics)
+
+    def _label(self):
+        return f"SourceExec({self.source.name})"
+
+    def _maybe_barrier(self) -> Iterator[StreamItem]:
+        if self._barrier_poll is not None:
+            epoch = self._barrier_poll()
+            if epoch is not None:
+                yield Marker(epoch)
+
+    def run(self) -> Iterator[StreamItem]:
+        readers = self.source.partitions()
+        if not self.source.unbounded or len(readers) == 1:
+            # deterministic round-robin over bounded partitions
+            live = list(readers)
+            while live:
+                nxt = []
+                for r in live:
+                    b = r.read()
+                    if b is None:
+                        continue
+                    nxt.append(r)
+                    if b.num_rows:
+                        self._metrics["rows_out"] += b.num_rows
+                        self._metrics["batches_out"] += 1
+                        yield b
+                    yield from self._maybe_barrier()
+                live = nxt
+            yield EOS
+            return
+
+        # live multi-partition: reader threads feed a bounded queue
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self._queue_size)
+        done = threading.Event()
+
+        def put_checking_done(item) -> bool:
+            # bounded put that keeps observing the done flag so pump threads
+            # can't block forever when the consumer stops early
+            while not done.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def pump(reader):
+            try:
+                while not done.is_set():
+                    b = reader.read(timeout_s=0.1)
+                    if b is None:
+                        break
+                    if not put_checking_done(b):
+                        return
+            finally:
+                put_checking_done(None)
+
+        threads = [
+            threading.Thread(target=pump, args=(r,), daemon=True) for r in readers
+        ]
+        for t in threads:
+            t.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                item = q.get()
+                if item is None:
+                    finished += 1
+                    continue
+                self._metrics["rows_out"] += item.num_rows
+                self._metrics["batches_out"] += 1
+                yield item
+                yield from self._maybe_barrier()
+        finally:
+            done.set()
+        yield EOS
+
+
+class ProjectExec(ExecOperator):
+    def __init__(self, input_op: ExecOperator, exprs: list[Expr], schema: Schema):
+        self.input_op = input_op
+        self.exprs = exprs
+        self.schema = schema
+
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def _label(self):
+        return f"ProjectExec({', '.join(e.name for e in self.exprs)})"
+
+    def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.logical.expr import AliasExpr, Column
+
+        def passthrough_name(e: Expr) -> str | None:
+            # validity masks survive projections that are pure column
+            # references (possibly aliased); computed exprs get no mask
+            while isinstance(e, AliasExpr):
+                e = e.inner
+            return e.name if isinstance(e, Column) else None
+
+        for item in self.input_op.run():
+            if isinstance(item, RecordBatch):
+                cols = [e.eval(item) for e in self.exprs]
+                masks = [
+                    item.mask(src) if (src := passthrough_name(e)) is not None else None
+                    for e in self.exprs
+                ]
+                yield RecordBatch(self.schema, cols, masks)
+            else:
+                yield item
+
+
+class FilterExec(ExecOperator):
+    def __init__(self, input_op: ExecOperator, predicate: Expr):
+        self.input_op = input_op
+        self.predicate = predicate
+        self.schema = input_op.schema
+
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def _label(self):
+        return f"FilterExec({self.predicate!r})"
+
+    def run(self) -> Iterator[StreamItem]:
+        for item in self.input_op.run():
+            if isinstance(item, RecordBatch):
+                keep = np.asarray(self.predicate.eval(item), dtype=bool)
+                if keep.all():
+                    yield item
+                elif keep.any():
+                    yield item.filter(keep)
+            else:
+                yield item
+
+
+class SinkExec(ExecOperator):
+    """Terminal operator driving a sink callable over the finished stream
+    (print_stream at datastream.rs:311-339 / sink_python at
+    py datastream.rs:229-270)."""
+
+    def __init__(self, input_op: ExecOperator, sink: "Sink") -> None:
+        self.input_op = input_op
+        self.sink = sink
+        self.schema = input_op.schema
+
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def _label(self):
+        return f"SinkExec({type(self.sink).__name__})"
+
+    def run(self) -> Iterator[StreamItem]:
+        for item in self.input_op.run():
+            if isinstance(item, RecordBatch):
+                self.sink.write(item)
+            elif isinstance(item, EndOfStream):
+                self.sink.close()
+            yield item
+
+
+class Sink:
+    def write(self, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class PrintSink(Sink):
+    """stdout sink; strips internal columns like the reference's
+    print_stream (datastream.rs:317-339 prints JSON rows minus metadata)."""
+
+    def __init__(self, file=None) -> None:
+        self._file = file or sys.stdout
+
+    def write(self, batch: RecordBatch) -> None:
+        user = batch.select(batch.schema.without_internal().names)
+        import json
+
+        names = user.schema.names
+        for i in range(user.num_rows):
+            row = {n: _py(user.columns[j][i]) for j, n in enumerate(names)}
+            print(json.dumps(row), file=self._file)
+
+
+def _py(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+class CallbackSink(Sink):
+    """Python-callback sink (the PyO3 ``sink_python`` equivalent): calls
+    ``fn(batch)`` with internal columns stripped."""
+
+    def __init__(self, fn: Callable[[RecordBatch], None]) -> None:
+        self._fn = fn
+
+    def write(self, batch: RecordBatch) -> None:
+        self._fn(batch.select(batch.schema.without_internal().names))
+
+
+class CollectSink(Sink):
+    """Test sink: collects emitted batches."""
+
+    def __init__(self) -> None:
+        self.batches: list[RecordBatch] = []
+
+    def write(self, batch: RecordBatch) -> None:
+        self.batches.append(batch)
+
+    def result(self) -> RecordBatch:
+        return RecordBatch.concat(self.batches)

@@ -1,0 +1,102 @@
+"""Physical planner: logical plan → executable operator tree.
+
+Counterpart of the reference's ``StreamingQueryPlanner`` +
+``StreamingWindowPlanner`` extension (query_planner.rs:11-30,
+planner/streaming_window.rs:71-172).  Where the reference decides
+Partial+Final vs Single aggregation by input partitioning and injects a hash
+``RepartitionExec`` via a physical optimizer rule
+(coalesce_before_streaming_window_aggregate.rs:32-95), the TPU build has no
+cross-thread exchange to plan: partition-parallelism maps to device sharding
+inside the window operator (see :mod:`denormalized_tpu.parallel`), so the
+planner decides *which window operator variant* to instantiate (dense device
+kernel / UDAF host loop / session) and threads sharding config through.
+"""
+
+from __future__ import annotations
+
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.physical.base import ExecOperator
+from denormalized_tpu.physical.simple_execs import (
+    FilterExec,
+    ProjectExec,
+    SinkExec,
+    SourceExec,
+)
+from denormalized_tpu.physical.window_exec import StreamingWindowExec
+
+
+class Planner:
+    def __init__(self, config=None) -> None:
+        # config: api.context.EngineConfig
+        self.config = config
+
+    def create_physical_plan(self, node: lp.LogicalPlan) -> ExecOperator:
+        if isinstance(node, lp.Scan):
+            return SourceExec(node.source)
+        if isinstance(node, lp.Project):
+            child = self.create_physical_plan(node.input)
+            return ProjectExec(child, node.exprs, node.schema)
+        if isinstance(node, lp.Filter):
+            child = self.create_physical_plan(node.input)
+            return FilterExec(child, node.predicate)
+        if isinstance(node, lp.StreamingWindow):
+            child = self.create_physical_plan(node.input)
+            kwargs = {}
+            if self.config is not None:
+                kwargs.update(
+                    accum_dtype=self.config.accum_dtype,
+                    min_group_capacity=self.config.min_group_capacity,
+                    min_window_slots=self.config.min_window_slots,
+                    min_batch_bucket=self.config.min_batch_bucket,
+                    emit_on_close=self.config.emit_on_close,
+                )
+            if any(a.kind == "udaf" for a in node.aggr_exprs):
+                from denormalized_tpu.physical.udaf_exec import UdafWindowExec
+
+                return UdafWindowExec(
+                    child,
+                    node.group_exprs,
+                    node.aggr_exprs,
+                    node.window_type,
+                    node.length_ms,
+                    node.slide_ms,
+                    emit_on_close=kwargs.get("emit_on_close", True),
+                )
+            if node.window_type is lp.WindowType.SESSION:
+                from denormalized_tpu.physical.session_exec import SessionWindowExec
+
+                return SessionWindowExec(
+                    child,
+                    node.group_exprs,
+                    node.aggr_exprs,
+                    gap_ms=node.length_ms,
+                    emit_on_close=kwargs.get("emit_on_close", True),
+                )
+            return StreamingWindowExec(
+                child,
+                node.group_exprs,
+                node.aggr_exprs,
+                node.window_type,
+                node.length_ms,
+                node.slide_ms,
+                **kwargs,
+            )
+        if isinstance(node, lp.Join):
+            from denormalized_tpu.physical.join_exec import StreamingJoinExec
+
+            left = self.create_physical_plan(node.left)
+            right = self.create_physical_plan(node.right)
+            return StreamingJoinExec(
+                left,
+                right,
+                node.kind,
+                node.left_keys,
+                node.right_keys,
+                node.filter,
+                node.schema,
+            )
+        if isinstance(node, lp.Sink):
+            child = self.create_physical_plan(node.input)
+            return SinkExec(child, node.sink)
+        raise PlanError(f"no physical rule for {type(node).__name__}")

@@ -1,0 +1,104 @@
+"""Execution driver: plan → physical tree → drive to completion.
+
+Counterpart of the reference's execution entry (df.execute_stream +
+with_orchestrator lifecycle, datastream.rs:244-343): builds the physical
+plan, wires the checkpoint orchestrator into every source when checkpointing
+is enabled, installs SIGINT/SIGTERM graceful shutdown (the reference's
+start_shutdown_listener, datastream.rs:53-72), and pumps the stream.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterator
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.physical.base import EndOfStream, ExecOperator
+from denormalized_tpu.physical.simple_execs import SourceExec
+from denormalized_tpu.planner.planner import Planner
+
+
+class ShutdownFlag:
+    """Cooperative shutdown shared with sources (tokio::watch analog)."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def set(self) -> None:
+        self._event.set()
+
+    def is_set(self) -> bool:
+        return self._event.is_set()
+
+
+def _install_signal_handlers(flag: ShutdownFlag):
+    """Install SIGINT/SIGTERM → graceful stop; returns a restore fn.  Only
+    possible on the main thread (same constraint tokio::signal has)."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    prev_int = signal.getsignal(signal.SIGINT)
+    prev_term = signal.getsignal(signal.SIGTERM)
+
+    def handler(signum, frame):
+        flag.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    def restore():
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
+
+    return restore
+
+
+def _attach_checkpointing(root: ExecOperator, ctx) -> "object | None":
+    """When checkpoint=true, start the barrier orchestrator and register
+    every source + stateful operator (with_orchestrator,
+    datastream.rs:244-307)."""
+    if not getattr(ctx.config, "checkpoint", False):
+        return None
+    from denormalized_tpu.state.orchestrator import Orchestrator
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+
+    orch = Orchestrator(interval_s=ctx.config.checkpoint_interval_s)
+    wire_checkpointing(root, ctx, orch)
+    orch.start()
+    return orch
+
+
+def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
+    return Planner(ctx.config).create_physical_plan(plan)
+
+
+def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
+    root = build_physical(plan, ctx)
+    orch = _attach_checkpointing(root, ctx)
+    flag = ShutdownFlag()
+    restore = _install_signal_handlers(flag)
+    try:
+        for item in root.run():
+            if flag.is_set():
+                break
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        restore()
+        if orch is not None:
+            orch.stop()
+
+
+def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
+    root = build_physical(plan, ctx)
+    orch = _attach_checkpointing(root, ctx)
+    try:
+        for item in root.run():
+            if isinstance(item, RecordBatch):
+                yield item
+            elif isinstance(item, EndOfStream):
+                break
+    finally:
+        if orch is not None:
+            orch.stop()

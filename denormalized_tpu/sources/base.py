@@ -1,0 +1,82 @@
+"""Source connector abstraction.
+
+Mirror of the reference's source seam: a ``TableProvider`` whose scan yields
+one ``PartitionStream`` per Kafka partition (topic_reader.rs:25-80,
+stream_table.rs:57-65).  A :class:`Source` describes schema + partitioning;
+each :class:`PartitionReader` is an independent cursor that the source exec
+drives (on threads for live connectors).
+
+Every source attaches the canonical event-time column
+(``CANONICAL_TIMESTAMP_COLUMN``) exactly like the reference's
+``KafkaStreamRead`` attaches ``canonical_timestamp`` from either the broker
+timestamp or a designated payload column (kafka_stream_read.rs:222-266).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+
+
+def canonicalize_schema(user_schema: Schema) -> Schema:
+    """User schema + internal event-time column (the reference's
+    ``create_canonical_schema``, kafka_config.rs:186-214)."""
+    if user_schema.has(CANONICAL_TIMESTAMP_COLUMN):
+        return user_schema
+    return user_schema.append(
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False)
+    )
+
+
+def attach_canonical_timestamp(
+    batch: RecordBatch, timestamp_column: str | None, fallback_ms: int
+) -> RecordBatch:
+    """Attach event time: from ``timestamp_column`` when configured, else the
+    ingestion time (the Kafka-broker-timestamp analog)."""
+    if batch.schema.has(CANONICAL_TIMESTAMP_COLUMN):
+        return batch
+    if timestamp_column is not None:
+        ts = np.asarray(batch.column(timestamp_column), dtype=np.int64)
+    else:
+        ts = np.full(batch.num_rows, fallback_ms, dtype=np.int64)
+    return batch.with_column(
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False), ts
+    )
+
+
+class PartitionReader:
+    """Cursor over one source partition."""
+
+    def read(self, timeout_s: float | None = None) -> Optional[RecordBatch]:
+        """Next batch, or None when the partition is exhausted (bounded
+        sources) / the timeout elapsed (live sources return empty batches)."""
+        raise NotImplementedError
+
+    # -- checkpoint hooks (reference BatchReadMetadata offsets,
+    # kafka_stream_read.rs:49-65,275-289) -------------------------------
+    def offset_snapshot(self) -> dict:
+        return {}
+
+    def offset_restore(self, snap: dict) -> None:
+        pass
+
+
+class Source:
+    name: str = "source"
+
+    @property
+    def schema(self) -> Schema:
+        """Canonical schema (includes internal timestamp column)."""
+        raise NotImplementedError
+
+    def partitions(self) -> list[PartitionReader]:
+        raise NotImplementedError
+
+    @property
+    def unbounded(self) -> bool:
+        return True

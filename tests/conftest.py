@@ -1,0 +1,50 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform BEFORE
+jax initializes, so sharding tests run without TPU hardware and unit tests
+are hermetic/fast."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+
+
+@pytest.fixture
+def sensor_schema() -> Schema:
+    """The emit_measurements shape: {occurred_at_ms, sensor_name, reading}
+    (reference examples/examples/emit_measurements.rs:26-47)."""
+    return Schema(
+        [
+            Field("occurred_at_ms", DataType.INT64, nullable=False),
+            Field("sensor_name", DataType.STRING, nullable=False),
+            Field("reading", DataType.FLOAT64),
+        ]
+    )
+
+
+def make_sensor_batch(schema, ts, names, readings) -> RecordBatch:
+    return RecordBatch(
+        schema,
+        [
+            np.asarray(ts, dtype=np.int64),
+            np.asarray(names, dtype=object),
+            np.asarray(readings, dtype=np.float64),
+        ],
+    )
+
+
+@pytest.fixture
+def make_batch(sensor_schema):
+    def _make(ts, names, readings):
+        return make_sensor_batch(sensor_schema, ts, names, readings)
+
+    return _make

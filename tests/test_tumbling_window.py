@@ -1,0 +1,182 @@
+"""Golden-window integration tests: replay source → tumbling windowed
+aggregation → collected results vs a numpy oracle.
+
+This is the integration layer the reference never had (SURVEY.md §4): its
+de-facto test was running examples against live Kafka."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.constants import WINDOW_END_COLUMN, WINDOW_START_COLUMN
+from denormalized_tpu.sources.memory import MemorySource
+
+
+def window_oracle(ts, keys, vals, length_ms):
+    """Reference semantics: tumbling windows epoch-aligned; watermark is the
+    monotonic max of batch min-ts; with in-order batches every window emits."""
+    out = {}
+    for t, k, v in zip(ts, keys, vals):
+        w = (t // length_ms) * length_ms
+        out.setdefault((w, k), []).append(v)
+    return out
+
+
+@pytest.mark.parametrize("num_partitions", [1])
+def test_simple_aggregation_end_to_end(sensor_schema, make_batch, num_partitions):
+    """The simple_aggregation example config: 1s tumbling
+    count/min/max/avg over sensor_name (reference
+    examples/examples/simple_aggregation.rs:15-60)."""
+    rng = np.random.default_rng(0)
+    n_batches, rows = 20, 500
+    batches, all_ts, all_keys, all_vals = [], [], [], []
+    t0 = 1_700_000_000_000
+    for b in range(n_batches):
+        # each batch spans ~250ms, advancing in time (in-order stream)
+        ts = t0 + b * 250 + rng.integers(0, 250, size=rows)
+        ts.sort()
+        names = rng.choice(["sensor_%d" % i for i in range(10)], size=rows)
+        vals = rng.normal(50.0, 10.0, size=rows)
+        batches.append(make_batch(ts, names, vals))
+        all_ts += ts.tolist()
+        all_keys += names.tolist()
+        all_vals += vals.tolist()
+
+    ctx = Context()
+    ds = (
+        ctx.from_source(
+            MemorySource.from_batches(
+                batches, timestamp_column="occurred_at_ms", num_partitions=num_partitions
+            )
+        )
+        .window(
+            [col("sensor_name")],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            1000,
+        )
+    )
+    result = ds.collect()
+
+    oracle = window_oracle(all_ts, all_keys, all_vals, 1000)
+    got = {}
+    for i in range(result.num_rows):
+        key = (
+            int(result.column(WINDOW_START_COLUMN)[i]),
+            result.column("sensor_name")[i],
+        )
+        assert key not in got, f"duplicate window emission for {key}"
+        got[key] = {
+            "count": int(result.column("count")[i]),
+            "min": float(result.column("min")[i]),
+            "max": float(result.column("max")[i]),
+            "avg": float(result.column("average")[i]),
+            "end": int(result.column(WINDOW_END_COLUMN)[i]),
+        }
+
+    assert set(got) == set(oracle)
+    for key, vals in oracle.items():
+        g = got[key]
+        assert g["count"] == len(vals)
+        assert g["end"] == key[0] + 1000
+        np.testing.assert_allclose(g["min"], np.min(vals), rtol=1e-6)
+        np.testing.assert_allclose(g["max"], np.max(vals), rtol=1e-6)
+        np.testing.assert_allclose(g["avg"], np.mean(vals), rtol=1e-4)
+
+
+def test_ungrouped_window(sensor_schema, make_batch):
+    """Ungrouped windows — the reference's WindowAggStream/Partial+Final path
+    (streaming_window.rs:421-482) — degenerate G=1 case here."""
+    t0 = 1_700_000_000_000
+    b1 = make_batch([t0 + 100, t0 + 200, t0 + 900], ["a", "b", "a"], [1.0, 2.0, 3.0])
+    b2 = make_batch([t0 + 1100, t0 + 1500], ["b", "c"], [10.0, 20.0])
+    b3 = make_batch([t0 + 2600], ["c"], [30.0])
+
+    ctx = Context()
+    result = (
+        ctx.from_source(
+            MemorySource.from_batches([b1, b2, b3], timestamp_column="occurred_at_ms")
+        )
+        .window([], [F.count(col("reading")).alias("cnt"), F.sum(col("reading")).alias("total")], 1000)
+        .collect()
+    )
+    rows = {
+        int(result.column(WINDOW_START_COLUMN)[i]): (
+            int(result.column("cnt")[i]),
+            float(result.column("total")[i]),
+        )
+        for i in range(result.num_rows)
+    }
+    assert rows == {
+        t0: (3, 6.0),
+        t0 + 1000: (2, 30.0),
+        t0 + 2000: (1, 30.0),
+    }
+
+
+def test_incremental_emission_before_close(sensor_schema, make_batch):
+    """Windows must emit as the watermark passes them, not only at EOS."""
+    t0 = 1_700_000_000_000
+    batches = [
+        make_batch([t0 + i * 300 + j for j in range(3)], ["x"] * 3, [1.0] * 3)
+        for i in range(12)  # spans ~3.6s
+    ]
+    from denormalized_tpu.sources.memory import GeneratorSource
+
+    fed = []
+
+    def gen():
+        for b in batches:
+            fed.append(1)
+            yield b
+
+    ctx = Context()
+    src = GeneratorSource(
+        sensor_schema,
+        [gen],
+        timestamp_column="occurred_at_ms",
+        unbounded=False,
+    )
+    ds = ctx.from_source(src).window(
+        ["sensor_name"], [F.count(col("reading")).alias("cnt")], 1000
+    )
+    emitted_at = []  # how many source batches had been fed when each window arrived
+    rows = 0
+    for batch in ds.stream():
+        emitted_at.append(len(fed))
+        rows += batch.num_rows
+    assert rows == 4
+    # windows 0..2 close mid-stream as the watermark passes them; only the
+    # last window may rely on the EOS flush
+    assert emitted_at[0] < len(batches), "first window only emitted at EOS"
+    assert sum(1 for e in emitted_at if e < len(batches)) >= 3
+
+
+def test_late_data_dropped(sensor_schema, make_batch):
+    """Late rows (window already emitted) are dropped, mirroring
+    streaming_window.rs:982-991."""
+    t0 = 1_700_000_000_000
+    batches = [
+        make_batch([t0 + 100], ["a"], [1.0]),
+        make_batch([t0 + 2500], ["a"], [2.0]),  # watermark → t0+2500, emits w0,w1
+        make_batch([t0 + 300], ["a"], [99.0]),  # late into w0 — dropped
+        make_batch([t0 + 3600], ["a"], [3.0]),
+    ]
+    ctx = Context()
+    result = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+        )
+        .window(["sensor_name"], [F.count(col("reading")).alias("cnt")], 1000)
+        .collect()
+    )
+    counts = {
+        int(result.column(WINDOW_START_COLUMN)[i]): int(result.column("cnt")[i])
+        for i in range(result.num_rows)
+    }
+    assert counts[t0] == 1  # late row not counted
