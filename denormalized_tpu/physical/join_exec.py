@@ -1,0 +1,340 @@
+"""Symmetric streaming hash join.
+
+The reference gets stream-stream joins "for free" from DataFusion's join over
+two windowed streams (datastream.rs:126-177; examples/examples/stream_join.rs
+joins two windowed aggregates on (sensor, window bounds)).  We implement the
+streaming join ourselves: a symmetric hash join that builds a hash table per
+side and probes the opposite table as batches arrive from either input.
+
+Memory is bounded by watermark-driven eviction: a row can only match rows
+whose event time is within ``retention_ms`` of the join watermark (the min of
+both sides' watermarks), after which it is evicted — and, for outer joins,
+emitted unmatched at eviction/EOS.  Both children are pumped by threads so a
+slow side cannot stall the other (the reference relies on tokio task
+scheduling for the same property).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.logical.expr import Expr
+from denormalized_tpu.logical.plan import JoinKind
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+)
+
+
+class _SideState:
+    """Hash table of buffered rows for one join side."""
+
+    __slots__ = ("batches", "table", "matched", "watermark", "done", "rows")
+
+    def __init__(self) -> None:
+        self.batches: list[RecordBatch] = []  # retained row storage
+        # key tuple -> list of (batch_idx, row_idx)
+        self.table: dict[tuple, list[tuple[int, int]]] = {}
+        # (batch_idx, row_idx) of rows that found ≥1 match (for outer joins)
+        self.matched: set[tuple[int, int]] = set()
+        self.watermark: int | None = None
+        self.done = False
+        self.rows = 0
+
+
+class StreamingJoinExec(ExecOperator):
+    def __init__(
+        self,
+        left: ExecOperator,
+        right: ExecOperator,
+        kind: JoinKind,
+        left_keys: list[str],
+        right_keys: list[str],
+        filter_expr: Expr | None,
+        schema: Schema,
+        *,
+        retention_ms: int = 300_000,
+    ) -> None:
+        if len(left_keys) != len(right_keys) or not left_keys:
+            raise PlanError("join requires equal non-empty key lists")
+        self.left = left
+        self.right = right
+        self.kind = kind
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self.filter_expr = filter_expr
+        self.schema = schema
+        self.retention_ms = retention_ms
+        self._metrics = {"rows_out": 0, "evicted": 0}
+        # output column plan: all left fields, then right fields minus
+        # canonical-ts and shared equi-keys (mirrors lp.Join schema logic)
+        left_names = set(left.schema.names)
+        self._right_out = [
+            f.name
+            for f in right.schema
+            if f.name != CANONICAL_TIMESTAMP_COLUMN and f.name not in left_names
+        ]
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def metrics(self):
+        return dict(self._metrics)
+
+    def _label(self):
+        on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
+        return f"StreamingJoinExec({self.kind.value} on {on})"
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _keys_of(batch: RecordBatch, names: list[str]) -> list[tuple]:
+        cols = [batch.column(n) for n in names]
+        return list(zip(*[c.tolist() for c in cols]))
+
+    def _insert(self, side: _SideState, batch: RecordBatch, keys: list[tuple]):
+        bi = len(side.batches)
+        side.batches.append(batch)
+        side.rows += batch.num_rows
+        for ri, k in enumerate(keys):
+            side.table.setdefault(k, []).append((bi, ri))
+
+    def _probe(
+        self,
+        probe_batch: RecordBatch,
+        probe_keys: list[tuple],
+        build: _SideState,
+        probe_is_left: bool,
+        probe_bi: int,
+        probe_side: _SideState,
+    ) -> RecordBatch | None:
+        """Join a new batch against the opposite side's table.  Rows are
+        marked 'matched' (for outer-join bookkeeping) only AFTER the join
+        filter accepts the pair — an equi-hit rejected by the filter must
+        still surface as unmatched in an outer join."""
+        p_idx: list[int] = []
+        b_pos: list[tuple[int, int]] = []
+        for ri, k in enumerate(probe_keys):
+            hits = build.table.get(k)
+            if not hits:
+                continue
+            for pos in hits:
+                p_idx.append(ri)
+                b_pos.append(pos)
+        if not p_idx:
+            return None
+        p_take = probe_batch.take(np.asarray(p_idx, dtype=np.int64))
+        # gather build rows: per-batch vectorized take, then reassemble in
+        # b_pos order (columns AND validity masks)
+        build_batches = build.batches
+        by_batch_idx: dict[int, list[int]] = {}
+        for i, (bi, ri) in enumerate(b_pos):
+            by_batch_idx.setdefault(bi, []).append(i)
+        gathered: dict[int, RecordBatch] = {}
+        for bi, idxs in by_batch_idx.items():
+            rows = np.asarray([b_pos[i][1] for i in idxs], dtype=np.int64)
+            gathered[bi] = build_batches[bi].take(rows)
+        build_cols: dict[str, np.ndarray] = {}
+        build_masks: dict[str, np.ndarray | None] = {}
+        for name in build_batches[0].schema.names:
+            dtype = gathered[next(iter(gathered))].column(name).dtype
+            col = np.empty(len(b_pos), dtype=dtype)
+            any_mask = any(g.mask(name) is not None for g in gathered.values())
+            mask = np.ones(len(b_pos), dtype=bool) if any_mask else None
+            for bi, idxs in by_batch_idx.items():
+                col[idxs] = gathered[bi].column(name)
+                if mask is not None:
+                    m = gathered[bi].mask(name)
+                    mask[idxs] = m if m is not None else True
+            build_cols[name] = col
+            build_masks[name] = mask
+        probe_cols = {n: p_take.column(n) for n in p_take.schema.names}
+        probe_masks = {n: p_take.mask(n) for n in p_take.schema.names}
+        if probe_is_left:
+            left_cols, left_masks = probe_cols, probe_masks
+            right_cols, right_masks = build_cols, build_masks
+        else:
+            left_cols, left_masks = build_cols, build_masks
+            right_cols, right_masks = probe_cols, probe_masks
+        cols = [left_cols[n] for n in self.left.schema.names]
+        masks = [left_masks.get(n) for n in self.left.schema.names]
+        cols += [right_cols[n] for n in self._right_out]
+        masks += [right_masks.get(n) for n in self._right_out]
+        out = RecordBatch(self.schema, cols, masks)
+        keep = np.ones(out.num_rows, dtype=bool)
+        if self.filter_expr is not None:
+            keep = np.asarray(self.filter_expr.eval(out), dtype=bool)
+            if not keep.all():
+                out = out.filter(keep)
+        # mark matched pairs that survived the filter
+        for i in np.nonzero(keep)[0].tolist():
+            probe_side.matched.add((probe_bi, p_idx[i]))
+            build.matched.add(b_pos[i])
+        return out if out.num_rows else None
+
+    # ------------------------------------------------------------------
+    def _evict(self, side: _SideState, is_left: bool, horizon: int):
+        """Drop rows older than the horizon; emit unmatched for outer joins."""
+        unmatched: list[RecordBatch] = []
+        keep_batches: list[RecordBatch] = []
+        remap: dict[int, int] = {}
+        for bi, b in enumerate(side.batches):
+            ts = np.asarray(b.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
+            if ts.max() < horizon:
+                if self._emits_unmatched(is_left):
+                    rows = [
+                        ri
+                        for ri in range(b.num_rows)
+                        if (bi, ri) not in side.matched
+                    ]
+                    if rows:
+                        unmatched.append(b.take(np.asarray(rows, dtype=np.int64)))
+                self._metrics["evicted"] += b.num_rows
+            else:
+                remap[bi] = len(keep_batches)
+                keep_batches.append(b)
+        if len(keep_batches) != len(side.batches):
+            side.batches = keep_batches
+            new_table: dict[tuple, list[tuple[int, int]]] = {}
+            for k, poss in side.table.items():
+                kept = [(remap[bi], ri) for bi, ri in poss if bi in remap]
+                if kept:
+                    new_table[k] = kept
+            side.table = new_table
+            side.matched = {
+                (remap[bi], ri) for bi, ri in side.matched if bi in remap
+            }
+        return unmatched
+
+    def _emits_unmatched(self, is_left: bool) -> bool:
+        if self.kind is JoinKind.FULL:
+            return True
+        return (self.kind is JoinKind.LEFT) == is_left and self.kind in (
+            JoinKind.LEFT,
+            JoinKind.RIGHT,
+        )
+
+    def _null_padded(self, batch: RecordBatch, is_left: bool) -> RecordBatch:
+        """Pad the missing side with nulls for outer-join unmatched rows."""
+        n = batch.num_rows
+        cols, masks = [], []
+        for f in self.schema:
+            srcs = batch.schema
+            if srcs.has(f.name):
+                cols.append(batch.column(f.name))
+                masks.append(batch.mask(f.name))
+            else:
+                cols.append(np.zeros(n, dtype=f.dtype.to_numpy()))
+                masks.append(np.zeros(n, dtype=bool))
+        return RecordBatch(self.schema, cols, masks)
+
+    # ------------------------------------------------------------------
+    def run(self) -> Iterator[StreamItem]:
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=8)
+        done = threading.Event()
+
+        def put_checking_done(payload) -> bool:
+            while not done.is_set():
+                try:
+                    q.put(payload, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def pump(side_id: int, op: ExecOperator):
+            try:
+                for item in op.run():
+                    if not put_checking_done((side_id, item)):
+                        return
+                    if isinstance(item, EndOfStream):
+                        return
+            except BaseException as e:  # surface upstream failures, don't
+                # let a dead side masquerade as a clean EOS
+                put_checking_done((side_id, e))
+                return
+            finally:
+                put_checking_done((side_id, EOS))
+
+        threads = [
+            threading.Thread(target=pump, args=(0, self.left), daemon=True),
+            threading.Thread(target=pump, args=(1, self.right), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        sides = (_SideState(), _SideState())
+        markers_seen: dict[int, int] = {}
+        try:
+            while not (sides[0].done and sides[1].done):
+                side_id, item = q.get()
+                side, other = sides[side_id], sides[1 - side_id]
+                is_left = side_id == 0
+                if isinstance(item, BaseException):
+                    raise item
+                if isinstance(item, EndOfStream):
+                    if side.done:
+                        continue
+                    side.done = True
+                    continue
+                if isinstance(item, Marker):
+                    # align markers: forward once both live sides delivered
+                    # it; a finished side no longer gates alignment
+                    c = markers_seen.get(item.epoch, 0) + 1
+                    live = sum(1 for s in sides if not s.done)
+                    if c >= live:
+                        markers_seen.pop(item.epoch, None)
+                        yield item
+                    else:
+                        markers_seen[item.epoch] = c
+                    continue
+                batch: RecordBatch = item
+                if batch.num_rows == 0:
+                    continue
+                keys = self._keys_of(
+                    batch, self.left_keys if is_left else self.right_keys
+                )
+                out = self._probe(
+                    batch, keys, other, is_left, len(side.batches), side
+                )
+                self._insert(side, batch, keys)
+                if out is not None:
+                    self._metrics["rows_out"] += out.num_rows
+                    yield out
+                # watermark & eviction
+                ts = np.asarray(
+                    batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
+                )
+                bmin = int(ts.min())
+                if side.watermark is None or bmin > side.watermark:
+                    side.watermark = bmin
+                if sides[0].watermark is not None and sides[1].watermark is not None:
+                    horizon = (
+                        min(sides[0].watermark, sides[1].watermark)
+                        - self.retention_ms
+                    )
+                    for s, l in ((sides[0], True), (sides[1], False)):
+                        for ub in self._evict(s, l, horizon):
+                            padded = self._null_padded(ub, l)
+                            self._metrics["rows_out"] += padded.num_rows
+                            yield padded
+            # EOS: flush unmatched for outer joins
+            for s, l in ((sides[0], True), (sides[1], False)):
+                if self._emits_unmatched(l):
+                    for ub in self._evict(s, l, np.iinfo(np.int64).max):
+                        padded = self._null_padded(ub, l)
+                        self._metrics["rows_out"] += padded.num_rows
+                        yield padded
+            yield EOS
+        finally:
+            done.set()

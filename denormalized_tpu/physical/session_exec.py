@@ -1,0 +1,294 @@
+"""Session windows — per-key gap-separated windows.
+
+The reference *declares* session windows (``StreamingWindowType::Session``,
+logical_plan/streaming_window.rs:69-74) but its operator hits ``todo!()`` at
+runtime (streaming_window.rs window-assignment session arm).  This operator
+implements them: a session for key k is a maximal run of events where
+consecutive timestamps are ≤ ``gap_ms`` apart; the window closes (and emits)
+when the watermark passes ``last_ts + gap_ms``.
+
+Sessions are data-dependent (no static window grid), so state lives host-side
+as per-key running aggregates — the correct tool for this shape: cardinality
+is per-open-session, updates are tiny merges of per-batch partials that numpy
+computes vectorized via sort+reduceat.  The dense fixed-grid hot path stays on
+TPU in StreamingWindowExec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import numpy as np
+
+from denormalized_tpu.common.constants import (
+    CANONICAL_TIMESTAMP_COLUMN,
+    WINDOW_END_COLUMN,
+    WINDOW_START_COLUMN,
+)
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical.expr import AggregateExpr, Expr
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+)
+
+
+@dataclass
+class _Agg:
+    """Mergeable running aggregate for one session (sum/count/min/max)."""
+
+    count: int = 0
+    counts: list[int] = field(default_factory=list)  # per value col
+    sums: list[float] = field(default_factory=list)
+    mins: list[float] = field(default_factory=list)
+    maxs: list[float] = field(default_factory=list)
+
+
+@dataclass
+class _Session:
+    start: int
+    last: int
+    agg: _Agg
+
+
+class SessionWindowExec(ExecOperator):
+    def __init__(
+        self,
+        input_op: ExecOperator,
+        group_exprs: list[Expr],
+        aggr_exprs: list[AggregateExpr],
+        gap_ms: int,
+        *,
+        emit_on_close: bool = True,
+        name: str = "session_window",
+    ) -> None:
+        if not group_exprs:
+            raise PlanError("session windows require at least one group key")
+        self.input_op = input_op
+        self.group_exprs = list(group_exprs)
+        self.aggr_exprs = list(aggr_exprs)
+        self.gap_ms = int(gap_ms)
+        self.emit_on_close = emit_on_close
+        self.name = name
+
+        in_schema = input_op.schema
+        self._value_exprs: list[Expr] = []
+        keys: dict[str, int] = {}
+        self._agg_specs: list[tuple[str, int | None]] = []
+        for a in self.aggr_exprs:
+            if a.arg is None:
+                self._agg_specs.append((a.kind, None))
+                continue
+            k = repr(a.arg)
+            if k not in keys:
+                keys[k] = len(self._value_exprs)
+                self._value_exprs.append(a.arg)
+            self._agg_specs.append((a.kind, keys[k]))
+
+        fields = [g.out_field(in_schema) for g in self.group_exprs]
+        fields += [a.out_field(in_schema) for a in self.aggr_exprs]
+        fields += [
+            Field(WINDOW_START_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+            Field(WINDOW_END_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+            Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+        ]
+        self.schema = Schema(fields)
+
+        self._sessions: dict[tuple, _Session] = {}
+        self._watermark: int | None = None
+        self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
+
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def metrics(self):
+        return dict(self._metrics)
+
+    def _label(self):
+        return (
+            f"SessionWindowExec(gap={self.gap_ms}ms, "
+            f"groups=[{', '.join(g.name for g in self.group_exprs)}])"
+        )
+
+    # ------------------------------------------------------------------
+    def _merge_rows(self, key: tuple, ts_sorted: np.ndarray, partial: _Agg):
+        """Merge one batch's per-key partial into the session table, splitting
+        on gaps *within* the batch handled by the caller."""
+        first, last = int(ts_sorted[0]), int(ts_sorted[-1])
+        sess = self._sessions.get(key)
+        if sess is not None and first - sess.last <= self.gap_ms:
+            sess.start = min(sess.start, first)
+            sess.last = max(sess.last, last)
+            a = sess.agg
+            a.count += partial.count
+            for i in range(len(a.sums)):
+                a.counts[i] += partial.counts[i]
+                a.sums[i] += partial.sums[i]
+                a.mins[i] = min(a.mins[i], partial.mins[i])
+                a.maxs[i] = max(a.maxs[i], partial.maxs[i])
+        else:
+            if sess is not None:
+                # gap exceeded: close the old session immediately
+                self._closed.append((key, sess))
+            self._sessions[key] = _Session(first, last, partial)
+
+    def _process_batch(self, batch: RecordBatch) -> Iterator[RecordBatch]:
+        n = batch.num_rows
+        if n == 0:
+            return
+        self._metrics["rows_in"] += n
+        from denormalized_tpu.logical.expr import Column
+
+        ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
+        key_cols = [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
+        vals = (
+            np.stack(
+                [np.asarray(e.eval(batch), dtype=np.float64) for e in self._value_exprs],
+                axis=1,
+            )
+            if self._value_exprs
+            else np.zeros((n, 0))
+        )
+        valid = np.ones_like(vals, dtype=bool)
+        for ci, e in enumerate(self._value_exprs):
+            if isinstance(e, Column):
+                m = batch.mask(e.name)
+                if m is not None:
+                    valid[:, ci] = m
+        self._closed: list[tuple[tuple, _Session]] = []
+
+        # vectorized per-key segmenting: sort by (key, ts), then reduceat over
+        # key-run + intra-batch gap boundaries
+        composite = np.fromiter(
+            (hash(tuple(kc[i] for kc in key_cols)) for i in range(n)),
+            dtype=np.int64,
+            count=n,
+        )
+        order = np.lexsort((ts, composite))
+        ts_s = ts[order]
+        comp_s = composite[order]
+        vals_s = vals[order]
+        valid_s = valid[order]
+        key_rows = [kc[order] for kc in key_cols]
+        # boundaries: new key run or gap within same key
+        newkey = np.empty(n, dtype=bool)
+        newkey[0] = True
+        newkey[1:] = comp_s[1:] != comp_s[:-1]
+        gap = np.empty(n, dtype=bool)
+        gap[0] = True
+        gap[1:] = (ts_s[1:] - ts_s[:-1]) > self.gap_ms
+        bounds = np.nonzero(newkey | gap)[0]
+        ends = np.append(bounds[1:], n)
+        for b0, b1 in zip(bounds, ends):
+            key = tuple(kr[b0] for kr in key_rows)
+            seg_vals = vals_s[b0:b1]
+            seg_valid = valid_s[b0:b1]
+            # null-neutralize per aggregate kind (same semantics as the
+            # device kernel: nulls excluded from count/sum/min/max)
+            partial = _Agg(
+                count=int(b1 - b0),
+                counts=[int(c) for c in seg_valid.sum(axis=0)],
+                sums=[
+                    float(s)
+                    for s in np.where(seg_valid, seg_vals, 0.0).sum(axis=0)
+                ],
+                mins=[
+                    float(s)
+                    for s in np.where(seg_valid, seg_vals, np.inf).min(axis=0)
+                ],
+                maxs=[
+                    float(s)
+                    for s in np.where(seg_valid, seg_vals, -np.inf).max(axis=0)
+                ],
+            )
+            self._merge_rows(key, ts_s[b0:b1], partial)
+
+        # watermark advance + close expired sessions
+        bmin = int(ts.min())
+        if self._watermark is None or bmin > self._watermark:
+            self._watermark = bmin
+        expired = [
+            (k, s)
+            for k, s in self._sessions.items()
+            if s.last + self.gap_ms <= self._watermark
+        ]
+        for k, s in expired:
+            del self._sessions[k]
+        self._closed.extend(expired)
+        if self._closed:
+            yield self._emit(self._closed)
+
+    def _emit(self, closed: list[tuple[tuple, _Session]]) -> RecordBatch:
+        self._metrics["sessions_emitted"] += len(closed)
+        m = len(closed)
+        cols: list[np.ndarray] = []
+        in_schema = self.input_op.schema
+        for ci, g in enumerate(self.group_exprs):
+            f = g.out_field(in_schema)
+            vals = np.array([k[ci] for k, _ in closed], dtype=object)
+            if f.dtype.is_numeric:
+                vals = vals.astype(f.dtype.to_numpy())
+            cols.append(vals)
+        for kind, col_i in self._agg_specs:
+            if kind == "count":
+                cols.append(
+                    np.array(
+                        [
+                            s.agg.count if col_i is None else s.agg.counts[col_i]
+                            for _, s in closed
+                        ],
+                        dtype=np.int64,
+                    )
+                )
+            elif kind == "sum":
+                cols.append(np.array([s.agg.sums[col_i] for _, s in closed]))
+            elif kind == "avg":
+                cols.append(
+                    np.array(
+                        [
+                            s.agg.sums[col_i] / s.agg.counts[col_i]
+                            if s.agg.counts[col_i]
+                            else np.nan
+                            for _, s in closed
+                        ]
+                    )
+                )
+            elif kind == "min":
+                v = np.array([s.agg.mins[col_i] for _, s in closed])
+                cols.append(np.where(np.isposinf(v), np.nan, v))
+            elif kind == "max":
+                v = np.array([s.agg.maxs[col_i] for _, s in closed])
+                cols.append(np.where(np.isneginf(v), np.nan, v))
+            else:
+                raise PlanError(f"session window does not support {kind}")
+        starts = np.array([s.start for _, s in closed], dtype=np.int64)
+        ends = np.array([s.last + self.gap_ms for _, s in closed], dtype=np.int64)
+        # cast agg outputs to declared dtypes
+        out_cols = []
+        for f, c in zip(self.schema.fields[: len(cols)], cols):
+            out_cols.append(
+                c if c.dtype == object else c.astype(f.dtype.to_numpy())
+            )
+        out_cols += [starts, ends, starts.copy()]
+        return RecordBatch(self.schema, out_cols)
+
+    def run(self) -> Iterator[StreamItem]:
+        for item in self.input_op.run():
+            if isinstance(item, RecordBatch):
+                yield from self._process_batch(item)
+            elif isinstance(item, Marker):
+                yield item
+            elif isinstance(item, EndOfStream):
+                if self.emit_on_close and self._sessions:
+                    closed = list(self._sessions.items())
+                    self._sessions.clear()
+                    yield self._emit(closed)
+                yield EOS
+                return

@@ -107,6 +107,8 @@ class SourceExec(ExecOperator):
                         break
                     if not put_checking_done(b):
                         return
+            except BaseException as e:  # propagate connector failures
+                put_checking_done(e)
             finally:
                 put_checking_done(None)
 
@@ -122,6 +124,8 @@ class SourceExec(ExecOperator):
                 if item is None:
                     finished += 1
                     continue
+                if isinstance(item, BaseException):
+                    raise item
                 self._metrics["rows_out"] += item.num_rows
                 self._metrics["batches_out"] += 1
                 yield item

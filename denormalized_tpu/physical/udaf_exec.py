@@ -1,0 +1,271 @@
+"""Windowed aggregation with user-defined (Python) accumulators.
+
+The reference evaluates Python UDAFs through its vendored datafusion-python
+layer — each group's accumulator is a Python object called under the GIL
+(py-denormalized python/denormalized/datafusion/udf.py).  That shape cannot
+live on the TPU (arbitrary Python state), so this operator keeps the same
+windowing semantics as :class:`StreamingWindowExec` (slide-index windows,
+monotonic min-ts watermark, late-data drop) but maintains per-(window, group)
+``Accumulator`` instances host-side.  Built-in aggregates mixed into the same
+window() call still decompose into device components via the main exec; the
+planner routes a window with ANY udaf here.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from denormalized_tpu.common.constants import (
+    CANONICAL_TIMESTAMP_COLUMN,
+    WINDOW_END_COLUMN,
+    WINDOW_START_COLUMN,
+)
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical.expr import AggregateExpr, Expr
+from denormalized_tpu.logical.plan import WindowType
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+)
+
+
+class _BuiltinAcc:
+    """numpy running aggregate for builtin kinds inside the UDAF exec."""
+
+    __slots__ = ("kind", "count", "sum", "min", "max")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.count = 0
+        self.sum = 0.0
+        self.min = np.inf
+        self.max = -np.inf
+
+    def update(self, v: np.ndarray):
+        self.count += len(v)
+        if self.kind in ("sum", "avg"):
+            self.sum += float(v.sum())
+        elif self.kind == "min" and len(v):
+            self.min = min(self.min, float(v.min()))
+        elif self.kind == "max" and len(v):
+            self.max = max(self.max, float(v.max()))
+
+    def evaluate(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "avg": self.sum / self.count if self.count else np.nan,
+            "min": self.min if np.isfinite(self.min) else np.nan,
+            "max": self.max if np.isfinite(self.max) else np.nan,
+        }[self.kind]
+
+
+class UdafWindowExec(ExecOperator):
+    def __init__(
+        self,
+        input_op: ExecOperator,
+        group_exprs: list[Expr],
+        aggr_exprs: list[AggregateExpr],
+        window_type: WindowType,
+        length_ms: int,
+        slide_ms: int | None,
+        *,
+        emit_on_close: bool = True,
+        name: str = "udaf_window",
+    ) -> None:
+        if window_type is WindowType.SESSION:
+            from denormalized_tpu.common.errors import PlanError
+
+            raise PlanError(
+                "session windows with UDAF aggregates are not supported yet; "
+                "use built-in aggregates with session_window()"
+            )
+        self.input_op = input_op
+        self.group_exprs = list(group_exprs)
+        self.aggr_exprs = list(aggr_exprs)
+        self.window_type = window_type
+        self.length_ms = int(length_ms)
+        self.slide_ms = int(slide_ms) if slide_ms else self.length_ms
+        self.emit_on_close = emit_on_close
+        self.name = name
+        self._k = -(-self.length_ms // self.slide_ms)
+
+        in_schema = input_op.schema
+        fields = [g.out_field(in_schema) for g in self.group_exprs]
+        fields += [a.out_field(in_schema) for a in self.aggr_exprs]
+        fields += [
+            Field(WINDOW_START_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+            Field(WINDOW_END_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+            Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+        ]
+        self.schema = Schema(fields)
+
+        # frames: window index j -> { group key tuple -> [acc per agg] }
+        self._frames: dict[int, dict[tuple, list]] = {}
+        self._first_open: int | None = None
+        self._max_win_seen = -1
+        self._watermark: int | None = None
+        self._metrics = {"rows_in": 0, "windows_emitted": 0, "late_rows": 0}
+
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def metrics(self):
+        return dict(self._metrics)
+
+    def _label(self):
+        return f"UdafWindowExec({self.window_type.value} {self.length_ms}ms)"
+
+    def _make_accs(self) -> list:
+        accs = []
+        for a in self.aggr_exprs:
+            if a.kind == "udaf":
+                accs.append(a.udaf.make())
+            else:
+                accs.append(_BuiltinAcc(a.kind))
+        return accs
+
+    def _process_batch(self, batch: RecordBatch) -> Iterator[RecordBatch]:
+        n = batch.num_rows
+        if n == 0:
+            return
+        self._metrics["rows_in"] += n
+        S = self.slide_ms
+        ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
+        units = ts // S
+        if self._first_open is None:
+            self._first_open = int(units.min()) - self._k + 1
+        self._max_win_seen = max(self._max_win_seen, int(units.max()))
+
+        key_cols = (
+            [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
+            if self.group_exprs
+            else None
+        )
+        from denormalized_tpu.logical.expr import Column
+
+        def mask_of(e) -> np.ndarray | None:
+            return batch.mask(e.name) if isinstance(e, Column) else None
+
+        arg_cols: list[list[np.ndarray]] = []
+        arg_masks: list[np.ndarray | None] = []
+        for a in self.aggr_exprs:
+            if a.kind == "udaf":
+                arg_cols.append([np.asarray(e.eval(batch)) for e in a.udaf.args])
+                arg_masks.append(mask_of(a.udaf.args[0]) if a.udaf.args else None)
+            elif a.arg is not None:
+                arg_cols.append([np.asarray(a.arg.eval(batch), dtype=np.float64)])
+                arg_masks.append(mask_of(a.arg))
+            else:
+                arg_cols.append([np.zeros(n)])
+                arg_masks.append(None)
+
+        # group rows by (window fan-out, key) using argsort for vectorization
+        for i in range(self._k):
+            win = units - i
+            in_window = (win >= self._first_open) & (
+                (ts - win * S) < self.length_ms
+            )
+            late = (win < self._first_open) & ((ts - win * S) < self.length_ms)
+            if i == 0:
+                self._metrics["late_rows"] += int(late.sum())
+            idx = np.nonzero(in_window)[0]
+            if len(idx) == 0:
+                continue
+            wsel = win[idx]
+            if key_cols is not None:
+                keys = list(zip(*[kc[idx].tolist() for kc in key_cols]))
+            else:
+                keys = [()] * len(idx)
+            order = sorted(range(len(idx)), key=lambda r: (int(wsel[r]), keys[r]))
+            run_start = 0
+            for r in range(1, len(order) + 1):
+                if (
+                    r == len(order)
+                    or wsel[order[r]] != wsel[order[run_start]]
+                    or keys[order[r]] != keys[order[run_start]]
+                ):
+                    rows = idx[[order[x] for x in range(run_start, r)]]
+                    j = int(wsel[order[run_start]])
+                    key = keys[order[run_start]]
+                    frame = self._frames.setdefault(j, {})
+                    accs = frame.get(key)
+                    if accs is None:
+                        accs = self._make_accs()
+                        frame[key] = accs
+                    for a, acc, cols, am in zip(
+                        self.aggr_exprs, accs, arg_cols, arg_masks
+                    ):
+                        chunk = [c[rows] for c in cols]
+                        if am is not None:
+                            valid = am[rows]
+                            chunk = [c[valid] for c in chunk]
+                        if a.kind == "udaf":
+                            acc.update(*chunk)
+                        else:
+                            acc.update(chunk[0])
+                    run_start = r
+
+        bmin = int(ts.min())
+        if self._watermark is None or bmin > self._watermark:
+            self._watermark = bmin
+        yield from self._trigger()
+
+    def _trigger(self) -> Iterator[RecordBatch]:
+        if self._watermark is None or self._first_open is None:
+            return
+        while self._first_open * self.slide_ms + self.length_ms <= self._watermark:
+            b = self._emit(self._first_open)
+            self._first_open += 1
+            if b is not None:
+                yield b
+
+    def _emit(self, j: int) -> RecordBatch | None:
+        frame = self._frames.pop(j, None)
+        if not frame:
+            return None
+        self._metrics["windows_emitted"] += 1
+        m = len(frame)
+        items = list(frame.items())
+        cols: list[np.ndarray] = []
+        in_schema = self.input_op.schema
+        for ci, g in enumerate(self.group_exprs):
+            f = g.out_field(in_schema)
+            vals = np.array([k[ci] for k, _ in items], dtype=object)
+            if f.dtype.is_numeric:
+                vals = vals.astype(f.dtype.to_numpy())
+            cols.append(vals)
+        for ai, a in enumerate(self.aggr_exprs):
+            f = a.out_field(in_schema)
+            vals = [accs[ai].evaluate() for _, accs in items]
+            arr = np.array(vals, dtype=object)
+            if f.dtype.is_numeric:
+                arr = arr.astype(f.dtype.to_numpy())
+            cols.append(arr)
+        start = np.full(m, j * self.slide_ms, dtype=np.int64)
+        end = np.full(m, j * self.slide_ms + self.length_ms, dtype=np.int64)
+        cols += [start, end, start.copy()]
+        return RecordBatch(self.schema, cols)
+
+    def run(self) -> Iterator[StreamItem]:
+        for item in self.input_op.run():
+            if isinstance(item, RecordBatch):
+                yield from self._process_batch(item)
+            elif isinstance(item, Marker):
+                yield item
+            elif isinstance(item, EndOfStream):
+                if self.emit_on_close and self._first_open is not None:
+                    for j in range(self._first_open, self._max_win_seen + 1):
+                        b = self._emit(j)
+                        if b is not None:
+                            yield b
+                    self._first_open = self._max_win_seen + 1
+                yield EOS
+                return

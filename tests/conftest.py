@@ -4,12 +4,20 @@ are hermetic/fast."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu — the environment ships a live single-client TPU tunnel
+# (JAX_PLATFORMS=axon, plus a sitecustomize that sets the jax_platforms
+# config at interpreter startup, so the env var alone is NOT enough).
+# Tests must be hermetic and never touch the tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,194 @@
+"""Regression tests for defects found in code review: join-filter vs outer
+matching, null propagation through joins/UDAF/session paths, upstream error
+propagation, marker alignment after one-sided EOS."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.udaf import Accumulator
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.sources.memory import GeneratorSource, MemorySource
+
+KV_SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+
+
+def kv(ts, ks, vs, masks=None):
+    return RecordBatch(
+        KV_SCHEMA,
+        [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        masks=[None, None, masks] if masks is not None else None,
+    )
+
+
+def test_left_join_filter_rejected_rows_are_unmatched():
+    """A LEFT-join row whose only equi-match fails the join filter must
+    appear null-padded, not vanish."""
+    t0 = 1_700_000_000_000
+    ctx = Context()
+    left = ctx.from_source(
+        MemorySource.from_batches([kv([t0], ["a"], [1.0])], timestamp_column="ts"),
+        name="l",
+    )
+    right = (
+        ctx.from_source(
+            MemorySource.from_batches([kv([t0], ["a"], [9.0])], timestamp_column="ts"),
+            name="r",
+        )
+        .with_column_renamed("k", "rk")
+        .with_column_renamed("ts", "rts")
+        .with_column_renamed("v", "rv")
+    )
+    res = left.join(right, "left", ["k"], ["rk"], filter=col("rv") > 100.0).collect()
+    assert res.num_rows == 1
+    m = res.mask("rv")
+    assert m is not None and not m[0]
+
+
+def test_join_propagates_null_masks():
+    """Null values on matched rows keep their validity mask through the
+    join output."""
+    t0 = 1_700_000_000_000
+    ctx = Context()
+    left = ctx.from_source(
+        MemorySource.from_batches(
+            [kv([t0], ["a"], [0.0], masks=np.array([False]))], timestamp_column="ts"
+        ),
+        name="l",
+    )
+    right = (
+        ctx.from_source(
+            MemorySource.from_batches([kv([t0], ["a"], [9.0])], timestamp_column="ts"),
+            name="r",
+        )
+        .with_column_renamed("k", "rk")
+        .with_column_renamed("ts", "rts")
+        .with_column_renamed("v", "rv")
+    )
+    res = left.join(right, "inner", ["k"], ["rk"]).collect()
+    assert res.num_rows == 1
+    m = res.mask("v")
+    assert m is not None and not m[0]
+
+
+def test_source_error_propagates():
+    """A connector failure mid-stream must raise, not truncate silently."""
+
+    def boom():
+        yield kv([1_700_000_000_000], ["a"], [1.0])
+        raise RuntimeError("broker gone")
+
+    def ok():
+        t0 = 1_700_000_000_000
+        for i in range(50):
+            yield kv([t0 + i], ["b"], [1.0])
+
+    ctx = Context()
+    src = GeneratorSource(
+        KV_SCHEMA, [boom, ok], timestamp_column="ts", unbounded=True
+    )
+    with pytest.raises(RuntimeError, match="broker gone"):
+        ctx.from_source(src).collect()
+
+
+def test_udaf_window_respects_null_masks(make_batch, sensor_schema):
+    """Builtins sharing a window() with a UDAF must still exclude nulls."""
+
+    class Noop(Accumulator):
+        def __init__(self):
+            self.n = 0
+
+        def update(self, v):
+            self.n += len(v)
+
+        def merge(self, s):
+            self.n += s[0]
+
+        def state(self):
+            return [self.n]
+
+        def evaluate(self):
+            return self.n
+
+    t0 = 1_700_000_000_000
+    batch = RecordBatch(
+        sensor_schema,
+        [
+            np.array([t0 + 10, t0 + 20, t0 + 30, t0 + 1500], dtype=np.int64),
+            np.array(["a"] * 4, dtype=object),
+            np.array([1.0, 99.0, 3.0, 0.0]),
+        ],
+        masks=[None, None, np.array([True, False, True, True])],
+    )
+    noop = F.udaf(Noop, DataType.INT64, "noop")
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches([batch], timestamp_column="occurred_at_ms")
+        )
+        .window(
+            ["sensor_name"],
+            [
+                noop(col("reading")).alias("u"),
+                F.count(col("reading")).alias("cnt"),
+                F.sum(col("reading")).alias("s"),
+            ],
+            1000,
+        )
+        .collect()
+    )
+    i = list(res.column("window_start_time")).index(t0)
+    assert int(res.column("cnt")[i]) == 2
+    assert float(res.column("s")[i]) == 4.0
+
+
+def test_session_window_respects_null_masks():
+    t0 = 1_700_000_000_000
+    batch = kv(
+        [t0, t0 + 100, t0 + 200],
+        ["a", "a", "a"],
+        [1.0, 99.0, 3.0],
+        masks=np.array([True, False, True]),
+    )
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource.from_batches([batch], timestamp_column="ts"))
+        .session_window(
+            ["k"],
+            [
+                F.count(col("v")).alias("cnt"),
+                F.sum(col("v")).alias("s"),
+                F.max(col("v")).alias("mx"),
+            ],
+            gap_ms=500,
+        )
+        .collect()
+    )
+    assert res.num_rows == 1
+    assert int(res.column("cnt")[0]) == 2
+    assert float(res.column("s")[0]) == 4.0
+    assert float(res.column("mx")[0]) == 3.0
+
+
+def test_session_udaf_rejected():
+    class A(Accumulator):
+        pass
+
+    u = F.udaf(A, DataType.FLOAT64, "u")
+    ctx = Context()
+    ds = ctx.from_source(
+        MemorySource.from_batches(
+            [kv([1_700_000_000_000], ["a"], [1.0])], timestamp_column="ts"
+        )
+    ).session_window(["k"], [u(col("v"))], 1000)
+    with pytest.raises(PlanError, match="session windows with UDAF"):
+        ds.collect()

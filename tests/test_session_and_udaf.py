@@ -1,0 +1,111 @@
+"""Session windows (reference leaves these todo!()) and Python UDAFs
+(reference python/examples/udaf_example.py pattern)."""
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.udaf import Accumulator
+from denormalized_tpu.common.constants import (
+    WINDOW_END_COLUMN,
+    WINDOW_START_COLUMN,
+)
+from denormalized_tpu.common.schema import DataType
+from denormalized_tpu.sources.memory import MemorySource
+
+
+def test_session_window_gap_split(make_batch):
+    t0 = 1_700_000_000_000
+    # key "a": bursts at [0..300] and [2000..2100] (gap 500 splits them)
+    # key "b": single burst [100..900] (within-gap steps)
+    batches = [
+        make_batch(
+            [t0, t0 + 150, t0 + 300, t0 + 100, t0 + 500],
+            ["a", "a", "a", "b", "b"],
+            [1.0, 2.0, 3.0, 10.0, 20.0],
+        ),
+        make_batch(
+            [t0 + 900, t0 + 2000, t0 + 2100, t0 + 9000],
+            ["b", "a", "a", "z"],
+            [30.0, 4.0, 5.0, 0.0],
+        ),
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+        )
+        .session_window(
+            ["sensor_name"],
+            [F.count(col("reading")).alias("cnt"), F.sum(col("reading")).alias("s")],
+            gap_ms=500,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        got[
+            (res.column("sensor_name")[i], int(res.column(WINDOW_START_COLUMN)[i]))
+        ] = (
+            int(res.column("cnt")[i]),
+            float(res.column("s")[i]),
+            int(res.column(WINDOW_END_COLUMN)[i]),
+        )
+    assert got[("a", t0)] == (3, 6.0, t0 + 300 + 500)
+    assert got[("a", t0 + 2000)] == (2, 9.0, t0 + 2100 + 500)
+    assert got[("b", t0 + 100)] == (3, 60.0, t0 + 900 + 500)
+    assert ("z", t0 + 9000) in got
+
+
+class WeightedObservation(Accumulator):
+    """Stateful UDAF: value weighted by recency rank (order-sensitive state,
+    modeled on the reference's udaf_example.py running-sum accumulator)."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.n = 0
+
+    def update(self, values: np.ndarray):
+        self.total += float(values.sum())
+        self.n += len(values)
+
+    def merge(self, states):
+        self.total += states[0]
+        self.n += states[1]
+
+    def state(self):
+        return [self.total, self.n]
+
+    def evaluate(self):
+        return self.total / self.n if self.n else 0.0
+
+
+def test_udaf_window(make_batch):
+    t0 = 1_700_000_000_000
+    batches = [
+        make_batch([t0 + 10, t0 + 20], ["a", "b"], [1.0, 10.0]),
+        make_batch([t0 + 600, t0 + 2500], ["a", "a"], [3.0, 0.0]),
+    ]
+    my_mean = F.udaf(WeightedObservation, DataType.FLOAT64, "my_mean")
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+        )
+        .window(
+            ["sensor_name"],
+            [my_mean(col("reading")).alias("m"), F.count(col("reading")).alias("c")],
+            1000,
+        )
+        .collect()
+    )
+    got = {
+        (res.column("sensor_name")[i], int(res.column(WINDOW_START_COLUMN)[i])): (
+            float(res.column("m")[i]),
+            int(res.column("c")[i]),
+        )
+        for i in range(res.num_rows)
+    }
+    assert got[("a", t0)] == (2.0, 2)  # mean(1, 3)
+    assert got[("b", t0)] == (10.0, 1)
+    assert got[("a", t0 + 2000)] == (0.0, 1)

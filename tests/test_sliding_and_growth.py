@@ -1,0 +1,153 @@
+"""Sliding windows, capacity growth, and null handling."""
+
+import collections
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.sources.memory import MemorySource
+
+
+def test_sliding_window_fanout(sensor_schema, make_batch):
+    """1s window / 200ms slide: every row lands in exactly 5 windows
+    (the reference enumerates overlapping slides at
+    streaming_window.rs:1063-1075; we fan out on device)."""
+    rng = np.random.default_rng(1)
+    t0 = 1_700_000_000_000
+    batches = [
+        make_batch(
+            np.sort(t0 + i * 300 + rng.integers(0, 300, 50)),
+            ["s"] * 50,
+            rng.normal(0, 1, 50),
+        )
+        for i in range(10)
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+        )
+        .window(["sensor_name"], [F.count(col("reading")).alias("cnt")], 1000, 200)
+        .collect()
+    )
+    starts = res.column(WINDOW_START_COLUMN)
+    assert (np.diff(sorted(set(starts.tolist()))) == 200).all()
+    assert sum(int(c) for c in res.column("cnt")) == 500 * 5
+
+
+def test_sliding_window_non_multiple_slide(sensor_schema, make_batch):
+    """Window length not a multiple of slide (1000ms/300ms): membership uses
+    the exact ms bound, k = ceil(L/S) = 4 but some rows hit only 3 windows."""
+    t0 = 1_700_000_000_000
+    ts = t0 + np.arange(0, 3000, 10)
+    batches = [make_batch(ts, ["s"] * len(ts), np.ones(len(ts)))]
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+        )
+        .window(["sensor_name"], [F.count(col("reading")).alias("cnt")], 1000, 300)
+        .collect()
+    )
+    got = {
+        int(res.column(WINDOW_START_COLUMN)[i]): int(res.column("cnt")[i])
+        for i in range(res.num_rows)
+    }
+    oracle = collections.Counter()
+    for t in ts.tolist():
+        j = t // 300
+        while j * 300 + 1000 > t:
+            if j * 300 <= t:
+                oracle[j * 300] += 1
+            j -= 1
+    assert got == dict(oracle)
+
+
+def test_group_capacity_growth_first_batch(sensor_schema, make_batch):
+    """More distinct keys in the first batch than the initial capacity (128):
+    G must grow before any scatter drops data."""
+    rng = np.random.default_rng(2)
+    t0 = 1_700_000_000_000
+    n = 5000
+    ts = np.sort(t0 + rng.integers(0, 2000, n))
+    keys = np.array([f"k{i}" for i in rng.integers(0, 2000, n)], dtype=object)
+    vals = rng.normal(0, 1, n)
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(
+                [make_batch(ts, keys, vals)], timestamp_column="occurred_at_ms"
+            )
+        )
+        .window(["sensor_name"], [F.sum(col("reading")).alias("s")], 1000)
+        .collect()
+    )
+    oracle = collections.defaultdict(float)
+    for t, k, v in zip(ts, keys, vals):
+        oracle[((t // 1000) * 1000, k)] += v
+    got = {
+        (int(res.column(WINDOW_START_COLUMN)[i]), res.column("sensor_name")[i]): float(
+            res.column("s")[i]
+        )
+        for i in range(res.num_rows)
+    }
+    assert set(got) == set(oracle)
+    for k in oracle:
+        np.testing.assert_allclose(got[k], oracle[k], rtol=1e-4, atol=1e-4)
+
+
+def test_window_ring_growth(sensor_schema, make_batch):
+    """A single batch spanning 40 windows grows the ring (initial 16)."""
+    t0 = 1_700_000_000_000
+    ts = t0 + np.arange(0, 40_000, 100)
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches(
+                [make_batch(ts, ["a"] * len(ts), np.ones(len(ts)))],
+                timestamp_column="occurred_at_ms",
+            )
+        )
+        .window(["sensor_name"], [F.count(col("reading")).alias("cnt")], 1000)
+        .collect()
+    )
+    assert res.num_rows == 40
+    assert all(int(c) == 10 for c in res.column("cnt"))
+
+
+def test_null_values_excluded(sensor_schema):
+    """Null readings are excluded from count/sum/avg/min/max
+    (DataFusion null semantics the reference inherits)."""
+    t0 = 1_700_000_000_000
+    batch = RecordBatch(
+        sensor_schema,
+        [
+            np.array([t0 + 10, t0 + 20, t0 + 30, t0 + 1500], dtype=np.int64),
+            np.array(["a", "a", "a", "a"], dtype=object),
+            np.array([1.0, 99.0, 3.0, 0.0]),
+        ],
+        masks=[None, None, np.array([True, False, True, True])],
+    )
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource.from_batches([batch], timestamp_column="occurred_at_ms")
+        )
+        .window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("cnt"),
+                F.sum(col("reading")).alias("s"),
+                F.max(col("reading")).alias("mx"),
+            ],
+            1000,
+        )
+        .collect()
+    )
+    i = list(res.column(WINDOW_START_COLUMN)).index(t0)
+    assert int(res.column("cnt")[i]) == 2
+    assert float(res.column("s")[i]) == 4.0
+    assert float(res.column("mx")[i]) == 3.0
