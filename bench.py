@@ -1,0 +1,183 @@
+"""Benchmark: emit_measurements 1s-tumbling windowed aggregation.
+
+Workload parity with the reference's de-facto benchmark (BASELINE.md): the
+``emit_measurements`` stream shape — JSON events ``{occurred_at_ms,
+sensor_name, reading}`` over 10 sensor keys (reference
+examples/examples/emit_measurements.rs:26-67) — aggregated with a 1s tumbling
+``count/min/max/avg`` by ``sensor_name`` (the driver-defined target config;
+the reference publishes no numbers of its own).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": rows/s through our engine (TPU path),
+     "unit": "rows/s", "vs_baseline": value / cpu_baseline_rows_per_sec}
+
+The CPU baseline is measured in-process: a tight vectorized-numpy columnar
+implementation of the same windowed aggregation (stand-in for CPU DataFusion,
+which is not installed in this image) — same interning, same window math,
+scatter via np.add.at/np.minimum.at.  Diagnostics go to stderr; stdout is
+exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+TOTAL_ROWS = int(os.environ.get("BENCH_ROWS", 8_000_000))
+BATCH_ROWS = int(os.environ.get("BENCH_BATCH", 131_072))
+NUM_KEYS = int(os.environ.get("BENCH_KEYS", 10))
+WINDOW_MS = 1000
+EVENTS_PER_SEC = 1_000_000  # simulated event-time rate (1M events/s target)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def gen_batches():
+    """Pre-generate the host-side decoded batches (decode cost is measured
+    separately by the formats benchmarks; this measures the engine)."""
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    schema = Schema(
+        [
+            Field("occurred_at_ms", DataType.INT64, nullable=False),
+            Field("sensor_name", DataType.STRING, nullable=False),
+            Field("reading", DataType.FLOAT64),
+        ]
+    )
+    rng = np.random.default_rng(0)
+    t0 = 1_700_000_000_000
+    keys = np.array([f"sensor_{i}" for i in range(NUM_KEYS)], dtype=object)
+    batches = []
+    n_batches = TOTAL_ROWS // BATCH_ROWS
+    ms_per_batch = int(BATCH_ROWS / EVENTS_PER_SEC * 1000)
+    for b in range(n_batches):
+        base = t0 + b * ms_per_batch
+        ts = np.sort(base + rng.integers(0, ms_per_batch, BATCH_ROWS))
+        names = keys[rng.integers(0, NUM_KEYS, BATCH_ROWS)]
+        vals = rng.normal(50.0, 10.0, BATCH_ROWS)
+        batches.append(RecordBatch(schema, [ts, names, vals]))
+    return schema, batches
+
+
+def run_engine(batches, label) -> tuple[float, dict]:
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.sources.memory import MemorySource
+
+    cfg = EngineConfig(min_batch_bucket=BATCH_ROWS, min_window_slots=32)
+    ctx = Context(cfg)
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+        name=f"bench_{label}",
+    ).window(
+        ["sensor_name"],
+        [
+            F.count(col("reading")).alias("count"),
+            F.min(col("reading")).alias("min"),
+            F.max(col("reading")).alias("max"),
+            F.avg(col("reading")).alias("average"),
+        ],
+        WINDOW_MS,
+    )
+    rows = sum(b.num_rows for b in batches)
+    t0 = time.perf_counter()
+    out_rows = 0
+    for batch in ds.stream():
+        out_rows += batch.num_rows
+    dt = time.perf_counter() - t0
+    metrics = {}
+    return rows / dt, {"windows_rows": out_rows, "wall_s": dt}
+
+
+def run_cpu_baseline(batches) -> float:
+    """Vectorized-numpy columnar engine for the identical aggregation."""
+    G = 1024
+    W = 64
+    counts = np.zeros((W, G), np.int64)
+    sums = np.zeros((W, G))
+    mins = np.full((W, G), np.inf)
+    maxs = np.full((W, G), -np.inf)
+    interner: dict = {}
+    emitted = 0
+    watermark = None
+    first_open = None
+
+    rows = sum(b.num_rows for b in batches)
+    t0 = time.perf_counter()
+    for b in batches:
+        ts = b.columns[0]
+        names = b.columns[1]
+        vals = b.columns[2]
+        win = ts // WINDOW_MS
+        if first_open is None:
+            first_open = int(win.min())
+        uniq, inv = np.unique(names, return_inverse=True)
+        ids = np.empty(len(uniq), np.int64)
+        for i, k in enumerate(uniq.tolist()):
+            j = interner.get(k)
+            if j is None:
+                j = len(interner)
+                interner[k] = j
+            ids[i] = j
+        gid = ids[inv]
+        slot = (win % W).astype(np.int64)
+        np.add.at(counts, (slot, gid), 1)
+        np.add.at(sums, (slot, gid), vals)
+        np.minimum.at(mins, (slot, gid), vals)
+        np.maximum.at(maxs, (slot, gid), vals)
+        bmin = int(ts.min())
+        if watermark is None or bmin > watermark:
+            watermark = bmin
+        while (first_open + 1) * WINDOW_MS <= watermark:
+            s = first_open % W
+            act = counts[s] > 0
+            emitted += int(act.sum())
+            # finalize: avg, then reset slot
+            _ = sums[s][act] / counts[s][act]
+            counts[s] = 0
+            sums[s] = 0.0
+            mins[s] = np.inf
+            maxs[s] = -np.inf
+            first_open += 1
+    dt = time.perf_counter() - t0
+    log(f"cpu baseline: {rows/dt:,.0f} rows/s ({dt:.2f}s, {emitted} windows)")
+    return rows / dt
+
+
+def main():
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    log(f"generating {TOTAL_ROWS:,} rows in {TOTAL_ROWS//BATCH_ROWS} batches ...")
+    _, batches = gen_batches()
+
+    # warmup (compile cache) on a small prefix
+    run_engine(batches[:4], "warmup")
+    rps, info = run_engine(batches, "main")
+    log(f"engine: {rps:,.0f} rows/s  {info}")
+
+    cpu_rps = run_cpu_baseline(batches)
+
+    print(
+        json.dumps(
+            {
+                "metric": "rows_per_sec_1s_tumbling_count_min_max_avg_by_key",
+                "value": round(rps),
+                "unit": "rows/s",
+                "vs_baseline": round(rps / cpu_rps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,8 @@ class EngineConfig:
     # sharding (parallel/): number of devices to shard group-state over;
     # None = single device
     mesh_devices: int | None = None
+    # 'auto' | 'key_sharded' | 'partial_final' (see parallel/sharded_state.py)
+    shard_strategy: str = "auto"
 
     def set(self, key: str, value) -> "EngineConfig":
         """String-keyed setter for parity with SessionConfig::set

@@ -146,8 +146,7 @@ def _apply_component(
     raise ValueError(comp.kind)
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
-def update_state(
+def update_state_impl(
     spec: WindowKernelSpec,
     state: dict[str, jax.Array],
     values: jax.Array,  # (B, V)
@@ -183,6 +182,13 @@ def update_state(
                 spec, comp, state[comp.label], slot, gid, values, colvalid
             )
     return state
+
+
+# jitted single-device entry; the sharded variants wrap update_state_impl in
+# shard_map (see denormalized_tpu.parallel.sharded_state)
+update_state = functools.partial(jax.jit, static_argnums=0, donate_argnums=1)(
+    update_state_impl
+)
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)

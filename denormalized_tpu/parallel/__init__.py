@@ -1,0 +1,13 @@
+from denormalized_tpu.parallel.mesh import make_mesh
+from denormalized_tpu.parallel.sharded_state import (
+    KeyShardedWindowState,
+    PartialFinalWindowState,
+    make_sharded_state,
+)
+
+__all__ = [
+    "make_mesh",
+    "KeyShardedWindowState",
+    "PartialFinalWindowState",
+    "make_sharded_state",
+]

@@ -1,0 +1,35 @@
+"""Device-mesh construction.
+
+The scale-out axis of the reference is CPU-thread partitioning: one tokio
+task per Kafka partition plus a hash ``RepartitionExec`` exchange
+(SURVEY.md §2.4).  The TPU-native analog is a ``jax.sharding.Mesh``: the
+single mesh axis ``"keys"`` plays the role of the hash-partition axis —
+group-state shards live one-per-device and rows reach the right shard via
+masked scatter (no exchange needed on ICI, the batch rides the broadcast) or
+via per-device partial state merged with ``psum`` (the Partial/Final analog).
+Multi-host extends the same mesh over DCN (jax.distributed), not a separate
+code path.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+KEY_AXIS = "keys"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (axis "keys")."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"({[d.platform for d in devices[:3]]}...)"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (KEY_AXIS,))

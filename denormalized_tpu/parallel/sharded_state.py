@@ -1,0 +1,432 @@
+"""Sharded window-state backends — TPU-native scale-out of the hot path.
+
+The reference scales grouped window aggregation with a hash
+``RepartitionExec`` exchange feeding per-partition streams, and merges
+ungrouped aggregates through a Partial→Final operator pair
+(SURVEY.md §2.4, coalesce_before_streaming_window_aggregate.rs:63-70,
+planner/streaming_window.rs:133-153).  On a TPU mesh both strategies become
+sharding layouts of the SAME device kernel (`update_state_impl`), wrapped in
+``shard_map`` so XLA owns the collectives:
+
+- :class:`KeyShardedWindowState` — the hash-partition analog.  Accumulator
+  buffers are sharded over the group axis (each device owns a contiguous
+  block of group ids); the batch is replicated and every device applies only
+  its own block via masking.  Update needs NO collective (the "exchange"
+  rides the input broadcast over ICI); emission gathers one window row
+  (G-sized) per device.  Right choice for high-cardinality state that must
+  not be duplicated per device.
+
+- :class:`PartialFinalWindowState` — the Partial→Final analog.  Rows are
+  sharded across devices (data parallel); every device keeps a full local
+  copy of the (small) state and emission merges with ``psum`` / ``pmin`` /
+  ``pmax`` at watermark triggers only.  Right choice for low-cardinality
+  aggregation at extreme ingest rates: input transfer is 1/n per device and
+  the merge collective runs once per window, not per batch.
+
+- :class:`SingleDeviceWindowState` — the degenerate 1-device backend used by
+  default (and on the single live chip).
+
+All three present the same interface to the window operator, which stays
+oblivious to the layout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from denormalized_tpu.ops import segment_agg as sa
+from denormalized_tpu.parallel.mesh import KEY_AXIS
+
+
+class WindowStateBackend:
+    """Interface the window operator drives."""
+
+    spec: sa.WindowKernelSpec  # device-local spec
+
+    @property
+    def group_capacity(self) -> int:
+        """Total group-id capacity visible to the host interner."""
+        raise NotImplementedError
+
+    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        raise NotImplementedError
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def reset_slot(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def export(self) -> dict[str, np.ndarray]:
+        """(W, G_total) host snapshot for checkpoint/growth."""
+        raise NotImplementedError
+
+    def import_(self, host_state: dict[str, np.ndarray]) -> None:
+        raise NotImplementedError
+
+
+class SingleDeviceWindowState(WindowStateBackend):
+    def __init__(self, spec: sa.WindowKernelSpec):
+        self.spec = spec
+        self._state = sa.init_state(spec)
+
+    @property
+    def group_capacity(self) -> int:
+        return self.spec.group_capacity
+
+    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        self._state = sa.update_state(
+            self.spec,
+            self._state,
+            jnp.asarray(values),
+            jnp.asarray(colvalid),
+            jnp.asarray(win_rel),
+            jnp.asarray(rem),
+            jnp.asarray(gid),
+            jnp.asarray(row_valid),
+            jnp.asarray(base_mod, dtype=jnp.int32),
+        )
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        return sa.read_slot(self.spec, self._state, slot)
+
+    def reset_slot(self, slot: int) -> None:
+        self._state = sa.reset_slot(
+            self.spec, self._state, jnp.asarray(slot, dtype=jnp.int32)
+        )
+
+    def export(self) -> dict[str, np.ndarray]:
+        return sa.export_state(self._state)
+
+    def import_(self, host_state: dict[str, np.ndarray]) -> None:
+        self._state = sa.import_state(self.spec, host_state)
+
+
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
+def _key_sharded_update(
+    spec: sa.WindowKernelSpec,
+    mesh: Mesh,
+    state,
+    values,
+    colvalid,
+    win_rel,
+    rem,
+    gid,
+    row_valid,
+    base_mod,
+):
+    G_local = spec.group_capacity
+
+    def body(state_l, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        shard = jax.lax.axis_index(KEY_AXIS)
+        local_gid = gid - shard * G_local
+        mine = row_valid & (local_gid >= 0) & (local_gid < G_local)
+        local_gid = jnp.clip(local_gid, 0, G_local - 1)
+        return sa.update_state_impl(
+            spec, state_l, values, colvalid, win_rel, rem, local_gid, mine, base_mod
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            {c.label: P(None, KEY_AXIS) for c in spec.components},
+            P(),
+            P(),
+            P(),
+            P(),
+            P(),
+            P(),
+            P(),
+        ),
+        out_specs={c.label: P(None, KEY_AXIS) for c in spec.components},
+    )(state, values, colvalid, win_rel, rem, gid, row_valid, base_mod)
+
+
+class KeyShardedWindowState(WindowStateBackend):
+    """Group axis sharded over the mesh; batch replicated; no per-batch
+    collectives."""
+
+    def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
+        # spec is the GLOBAL spec; each device holds G_total/n
+        n = mesh.devices.size
+        if spec.group_capacity % n:
+            raise ValueError(
+                f"group capacity {spec.group_capacity} is not divisible by "
+                f"the mesh size {n}"
+            )
+        self.mesh = mesh
+        self.n = n
+        self.spec = sa.WindowKernelSpec(
+            components=spec.components,
+            num_value_cols=spec.num_value_cols,
+            window_slots=spec.window_slots,
+            group_capacity=spec.group_capacity // n,
+            length_ms=spec.length_ms,
+            slide_ms=spec.slide_ms,
+            accum_dtype=spec.accum_dtype,
+        )
+        self._sharding = NamedSharding(mesh, P(None, KEY_AXIS))
+        self._state = {
+            c.label: jax.device_put(
+                jnp.full(
+                    (spec.window_slots, spec.group_capacity),
+                    self.spec.init_value(c),
+                ),
+                self._sharding,
+            )
+            for c in spec.components
+        }
+
+    @property
+    def group_capacity(self) -> int:
+        return self.spec.group_capacity * self.n
+
+    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        self._state = _key_sharded_update(
+            self.spec,
+            self.mesh,
+            self._state,
+            jnp.asarray(values),
+            jnp.asarray(colvalid),
+            jnp.asarray(win_rel),
+            jnp.asarray(rem),
+            jnp.asarray(gid),
+            jnp.asarray(row_valid),
+            jnp.asarray(base_mod, dtype=jnp.int32),
+        )
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        # slicing a G-sharded array gathers one (G_total,) row per component
+        return jax.device_get(
+            {c.label: self._state[c.label][slot] for c in self.spec.components}
+        )
+
+    def reset_slot(self, slot: int) -> None:
+        self._state = _key_sharded_reset_slot(
+            self.spec, self._state, jnp.asarray(slot, dtype=jnp.int32)
+        )
+
+    def export(self) -> dict[str, np.ndarray]:
+        return jax.device_get(self._state)
+
+    def import_(self, host_state: dict[str, np.ndarray]) -> None:
+        W = self.spec.window_slots
+        G_total = self.group_capacity
+        for c in self.spec.components:
+            buf = np.full(
+                (W, G_total), np.asarray(self.spec.init_value(c)),
+                dtype=np.asarray(self.spec.init_value(c)).dtype,
+            )
+            src = host_state.get(c.label)
+            if src is not None:
+                w = min(src.shape[0], W)
+                g = min(src.shape[1], G_total)
+                buf[:w, :g] = src[:w, :g]
+            self._state[c.label] = jax.device_put(
+                jnp.asarray(buf), self._sharding
+            )
+
+
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
+def _partial_update(
+    spec: sa.WindowKernelSpec,
+    mesh: Mesh,
+    state,
+    values,
+    colvalid,
+    win_rel,
+    rem,
+    gid,
+    row_valid,
+    base_mod,
+):
+    def body(state_l, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        st = {k: v[0] for k, v in state_l.items()}
+        st = sa.update_state_impl(
+            spec, st, values, colvalid, win_rel, rem, gid, row_valid, base_mod
+        )
+        return {k: v[None] for k, v in st.items()}
+
+    n = mesh.devices.size
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            {c.label: P(KEY_AXIS) for c in spec.components},
+            P(KEY_AXIS),
+            P(KEY_AXIS),
+            P(KEY_AXIS),
+            P(KEY_AXIS),
+            P(KEY_AXIS),
+            P(KEY_AXIS),
+            P(),
+        ),
+        out_specs={c.label: P(KEY_AXIS) for c in spec.components},
+    )(state, values, colvalid, win_rel, rem, gid, row_valid, base_mod)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _partial_merge_slot(spec: sa.WindowKernelSpec, mesh: Mesh, state, slot):
+    """Final merge of one window row across device partials: psum for
+    count/sum, pmin/pmax for extrema — the reference's Final stage
+    (streaming_window.rs:484-489) as a single collective.  ``slot`` is
+    traced (dynamic slice), so one compilation serves every ring slot."""
+
+    def body(state_l, slot):
+        out = {}
+        for c in spec.components:
+            row = jax.lax.dynamic_index_in_dim(
+                state_l[c.label][0], slot, axis=0, keepdims=False
+            )
+            if c.kind in ("count", "sum"):
+                out[c.label] = jax.lax.psum(row, KEY_AXIS)
+            elif c.kind == "min":
+                out[c.label] = jax.lax.pmin(row, KEY_AXIS)
+            else:
+                out[c.label] = jax.lax.pmax(row, KEY_AXIS)
+        return out
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=({c.label: P(KEY_AXIS) for c in spec.components}, P()),
+        out_specs={c.label: P() for c in spec.components},
+    )(state, slot)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _partial_reset_slot(spec: sa.WindowKernelSpec, state, slot):
+    for c in spec.components:
+        buf = state[c.label]
+        row = jnp.full((buf.shape[0], 1, buf.shape[2]), spec.init_value(c))
+        state[c.label] = jax.lax.dynamic_update_slice(
+            buf, row.astype(buf.dtype), (0, slot, 0)
+        )
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _key_sharded_reset_slot(spec: sa.WindowKernelSpec, state, slot):
+    for c in spec.components:
+        buf = state[c.label]
+        row = jnp.full((buf.shape[1],), spec.init_value(c))
+        state[c.label] = buf.at[slot].set(row.astype(buf.dtype))
+    return state
+
+
+class PartialFinalWindowState(WindowStateBackend):
+    """Rows data-parallel across devices; full state replica per device;
+    collective merge only at emission."""
+
+    def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
+        self.mesh = mesh
+        self.n = mesh.devices.size
+        self.spec = spec
+        self._sharding = NamedSharding(mesh, P(KEY_AXIS))
+        self._state = {
+            c.label: jax.device_put(
+                jnp.full(
+                    (self.n, spec.window_slots, spec.group_capacity),
+                    spec.init_value(c),
+                ),
+                self._sharding,
+            )
+            for c in spec.components
+        }
+
+    @property
+    def group_capacity(self) -> int:
+        return self.spec.group_capacity
+
+    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        # rows must split evenly over the mesh: bucketed batches are powers
+        # of two ≥ mesh size, so this holds by construction
+        self._state = _partial_update(
+            self.spec,
+            self.mesh,
+            self._state,
+            jnp.asarray(values),
+            jnp.asarray(colvalid),
+            jnp.asarray(win_rel),
+            jnp.asarray(rem),
+            jnp.asarray(gid),
+            jnp.asarray(row_valid),
+            jnp.asarray(base_mod, dtype=jnp.int32),
+        )
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        return jax.device_get(
+            _partial_merge_slot(
+                self.spec, self.mesh, self._state, jnp.asarray(slot, jnp.int32)
+            )
+        )
+
+    def reset_slot(self, slot: int) -> None:
+        self._state = _partial_reset_slot(
+            self.spec, self._state, jnp.asarray(slot, dtype=jnp.int32)
+        )
+
+    def export(self) -> dict[str, np.ndarray]:
+        """Merged (W, G) snapshot."""
+        host = jax.device_get(self._state)
+        out = {}
+        for c in self.spec.components:
+            b = host[c.label]
+            if c.kind in ("count", "sum"):
+                out[c.label] = b.sum(axis=0)
+            elif c.kind == "min":
+                out[c.label] = b.min(axis=0)
+            else:
+                out[c.label] = b.max(axis=0)
+        return out
+
+    def import_(self, host_state: dict[str, np.ndarray]) -> None:
+        # load merged snapshot into device 0's partial, init elsewhere
+        for c in self.spec.components:
+            init = np.asarray(jax.device_get(self.spec.init_value(c)))
+            buf = np.full(
+                (self.n, self.spec.window_slots, self.spec.group_capacity),
+                init,
+                dtype=init.dtype,
+            )
+            src = host_state.get(c.label)
+            if src is not None:
+                w = min(src.shape[0], buf.shape[1])
+                g = min(src.shape[1], buf.shape[2])
+                buf[0, :w, :g] = src[:w, :g]
+            self._state[c.label] = jax.device_put(
+                jnp.asarray(buf), self._sharding
+            )
+
+
+def make_sharded_state(
+    spec: sa.WindowKernelSpec,
+    mesh: Mesh | None,
+    strategy: str = "auto",
+) -> WindowStateBackend:
+    """Pick a layout: small state → Partial/Final (duplicate it, shard rows);
+    large state → key-sharded (shard it, broadcast rows)."""
+    if mesh is None or mesh.devices.size == 1:
+        return SingleDeviceWindowState(spec)
+    if strategy == "auto":
+        strategy = (
+            "partial_final" if spec.group_capacity <= 4096 else "key_sharded"
+        )
+    if strategy == "partial_final":
+        return PartialFinalWindowState(spec, mesh)
+    if strategy == "key_sharded":
+        return KeyShardedWindowState(spec, mesh)
+    raise ValueError(f"unknown shard strategy {strategy!r}")

@@ -241,38 +241,18 @@ class StreamingJoinExec(ExecOperator):
 
     # ------------------------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.runtime.pump import spawn_pump
+
         q: queue_mod.Queue = queue_mod.Queue(maxsize=8)
         done = threading.Event()
-
-        def put_checking_done(payload) -> bool:
-            while not done.is_set():
-                try:
-                    q.put(payload, timeout=0.1)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
-
-        def pump(side_id: int, op: ExecOperator):
-            try:
-                for item in op.run():
-                    if not put_checking_done((side_id, item)):
-                        return
-                    if isinstance(item, EndOfStream):
-                        return
-            except BaseException as e:  # surface upstream failures, don't
-                # let a dead side masquerade as a clean EOS
-                put_checking_done((side_id, e))
-                return
-            finally:
-                put_checking_done((side_id, EOS))
-
-        threads = [
-            threading.Thread(target=pump, args=(0, self.left), daemon=True),
-            threading.Thread(target=pump, args=(1, self.right), daemon=True),
-        ]
-        for t in threads:
-            t.start()
+        for side_id, op in ((0, self.left), (1, self.right)):
+            spawn_pump(
+                q,
+                done,
+                op.run,
+                sentinel=(side_id, EOS),
+                wrap=lambda item, s=side_id: (s, item),
+            )
         sides = (_SideState(), _SideState())
         markers_seen: dict[int, int] = {}
         try:
@@ -286,6 +266,14 @@ class StreamingJoinExec(ExecOperator):
                     if side.done:
                         continue
                     side.done = True
+                    # a finished side no longer gates marker alignment:
+                    # flush every pending marker the live side(s) delivered
+                    live = sum(1 for s in sides if not s.done)
+                    for epoch in sorted(
+                        e for e, c in markers_seen.items() if c >= live
+                    ):
+                        markers_seen.pop(epoch, None)
+                        yield Marker(epoch)
                     continue
                 if isinstance(item, Marker):
                     # align markers: forward once both live sides delivered

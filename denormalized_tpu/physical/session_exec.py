@@ -100,7 +100,8 @@ class SessionWindowExec(ExecOperator):
         ]
         self.schema = Schema(fields)
 
-        self._sessions: dict[tuple, _Session] = {}
+        # per key: open sessions sorted by start (usually exactly one)
+        self._sessions: dict[tuple, list[_Session]] = {}
         self._watermark: int | None = None
         self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
 
@@ -118,26 +119,36 @@ class SessionWindowExec(ExecOperator):
         )
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _merge_agg(a: _Agg, p: _Agg) -> None:
+        a.count += p.count
+        for i in range(len(a.sums)):
+            a.counts[i] += p.counts[i]
+            a.sums[i] += p.sums[i]
+            a.mins[i] = min(a.mins[i], p.mins[i])
+            a.maxs[i] = max(a.maxs[i], p.maxs[i])
+
     def _merge_rows(self, key: tuple, ts_sorted: np.ndarray, partial: _Agg):
-        """Merge one batch's per-key partial into the session table, splitting
-        on gaps *within* the batch handled by the caller."""
+        """Merge one batch segment [first, last] into the per-key OPEN
+        session set.  Sessions stay open until the watermark passes
+        ``last + gap`` — closing on gap-at-arrival would mis-split
+        out-of-order data, so a segment may bridge (merge) several open
+        sessions (standard event-time session-merge)."""
         first, last = int(ts_sorted[0]), int(ts_sorted[-1])
-        sess = self._sessions.get(key)
-        if sess is not None and first - sess.last <= self.gap_ms:
-            sess.start = min(sess.start, first)
-            sess.last = max(sess.last, last)
-            a = sess.agg
-            a.count += partial.count
-            for i in range(len(a.sums)):
-                a.counts[i] += partial.counts[i]
-                a.sums[i] += partial.sums[i]
-                a.mins[i] = min(a.mins[i], partial.mins[i])
-                a.maxs[i] = max(a.maxs[i], partial.maxs[i])
-        else:
-            if sess is not None:
-                # gap exceeded: close the old session immediately
-                self._closed.append((key, sess))
-            self._sessions[key] = _Session(first, last, partial)
+        open_list = self._sessions.setdefault(key, [])
+        merged = _Session(first, last, partial)
+        keep: list[_Session] = []
+        for s in open_list:
+            # within-gap overlap in either direction → merge
+            if first - s.last <= self.gap_ms and s.start - last <= self.gap_ms:
+                merged.start = min(merged.start, s.start)
+                merged.last = max(merged.last, s.last)
+                self._merge_agg(merged.agg, s.agg)
+            else:
+                keep.append(s)
+        keep.append(merged)
+        keep.sort(key=lambda s: s.start)
+        self._sessions[key] = keep
 
     def _process_batch(self, batch: RecordBatch) -> Iterator[RecordBatch]:
         n = batch.num_rows
@@ -162,7 +173,21 @@ class SessionWindowExec(ExecOperator):
                 m = batch.mask(e.name)
                 if m is not None:
                     valid[:, ci] = m
-        self._closed: list[tuple[tuple, _Session]] = []
+        # drop late rows: their session (even as a singleton) would already
+        # have closed — mirrors the fixed-window late-drop semantics
+        if self._watermark is not None:
+            late = ts + self.gap_ms <= self._watermark
+            n_late = int(late.sum())
+            if n_late:
+                self._metrics["late_rows"] += n_late
+                keep = ~late
+                ts = ts[keep]
+                key_cols = [kc[keep] for kc in key_cols]
+                vals = vals[keep]
+                valid = valid[keep]
+                n = len(ts)
+                if n == 0:
+                    return
 
         # vectorized per-key segmenting: sort by (key, ts), then reduceat over
         # key-run + intra-batch gap boundaries
@@ -214,16 +239,20 @@ class SessionWindowExec(ExecOperator):
         bmin = int(ts.min())
         if self._watermark is None or bmin > self._watermark:
             self._watermark = bmin
-        expired = [
-            (k, s)
-            for k, s in self._sessions.items()
-            if s.last + self.gap_ms <= self._watermark
-        ]
-        for k, s in expired:
-            del self._sessions[k]
-        self._closed.extend(expired)
-        if self._closed:
-            yield self._emit(self._closed)
+        closed: list[tuple[tuple, _Session]] = []
+        for k in list(self._sessions):
+            still: list[_Session] = []
+            for s in self._sessions[k]:
+                if s.last + self.gap_ms <= self._watermark:
+                    closed.append((k, s))
+                else:
+                    still.append(s)
+            if still:
+                self._sessions[k] = still
+            else:
+                del self._sessions[k]
+        if closed:
+            yield self._emit(closed)
 
     def _emit(self, closed: list[tuple[tuple, _Session]]) -> RecordBatch:
         self._metrics["sessions_emitted"] += len(closed)
@@ -287,7 +316,12 @@ class SessionWindowExec(ExecOperator):
                 yield item
             elif isinstance(item, EndOfStream):
                 if self.emit_on_close and self._sessions:
-                    closed = list(self._sessions.items())
+                    closed = [
+                        (k, s)
+                        for k, lst in self._sessions.items()
+                        for s in lst
+                    ]
+                    closed.sort(key=lambda e: e[1].start)
                     self._sessions.clear()
                     yield self._emit(closed)
                 yield EOS

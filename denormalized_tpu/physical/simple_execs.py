@@ -85,38 +85,23 @@ class SourceExec(ExecOperator):
             return
 
         # live multi-partition: reader threads feed a bounded queue
+        from denormalized_tpu.runtime.pump import spawn_pump
+
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self._queue_size)
         done = threading.Event()
 
-        def put_checking_done(item) -> bool:
-            # bounded put that keeps observing the done flag so pump threads
-            # can't block forever when the consumer stops early
-            while not done.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
-
-        def pump(reader):
-            try:
+        def reader_items(reader):
+            def gen():
                 while not done.is_set():
                     b = reader.read(timeout_s=0.1)
                     if b is None:
-                        break
-                    if not put_checking_done(b):
                         return
-            except BaseException as e:  # propagate connector failures
-                put_checking_done(e)
-            finally:
-                put_checking_done(None)
+                    yield b
 
-        threads = [
-            threading.Thread(target=pump, args=(r,), daemon=True) for r in readers
-        ]
-        for t in threads:
-            t.start()
+            return gen
+
+        for r in readers:
+            spawn_pump(q, done, reader_items(r), sentinel=None)
         finished = 0
         try:
             while finished < len(readers):

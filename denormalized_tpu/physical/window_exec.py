@@ -59,6 +59,13 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1)).bit_length()
 
 
+def _round_capacity(g: int, n_dev: int) -> int:
+    """Round a group capacity up so every device shard is a multiple of 128
+    lanes (and the total divides evenly over the mesh)."""
+    unit = 128 * n_dev
+    return -(-g // unit) * unit
+
+
 class StreamingWindowExec(ExecOperator):
     def __init__(
         self,
@@ -74,6 +81,8 @@ class StreamingWindowExec(ExecOperator):
         min_window_slots: int = 16,
         min_batch_bucket: int = 256,
         emit_on_close: bool = True,
+        mesh=None,
+        shard_strategy: str = "auto",
         name: str = "window",
     ) -> None:
         if window_type is WindowType.SESSION:
@@ -110,16 +119,23 @@ class StreamingWindowExec(ExecOperator):
 
         self._grouped = len(self.group_exprs) > 0
         self._interner = GroupInterner(len(self.group_exprs)) if self._grouped else None
+        self._mesh = mesh
+        self._shard_strategy = shard_strategy
+        n_dev = 1 if mesh is None else mesh.devices.size
         self._spec = sa.WindowKernelSpec(
             components=components,
             num_value_cols=len(self._value_exprs),
             window_slots=min_window_slots,
-            group_capacity=min_group_capacity if self._grouped else 128,
+            group_capacity=_round_capacity(
+                min_group_capacity if self._grouped else 128, n_dev
+            ),
             length_ms=self.length_ms,
             slide_ms=self.slide_ms,
             accum_dtype=accum_dtype,
         )
-        self._state = sa.init_state(self._spec)
+        from denormalized_tpu.parallel.sharded_state import make_sharded_state
+
+        self._backend = make_sharded_state(self._spec, mesh, shard_strategy)
 
         # schema: group cols + agg cols + window bounds (+ canonical ts)
         fields = [g.out_field(in_schema) for g in self.group_exprs]
@@ -164,7 +180,9 @@ class StreamingWindowExec(ExecOperator):
 
     # -- capacity management --------------------------------------------
     def _grow(self, *, window_slots: int | None = None, group_capacity: int | None = None):
-        host = sa.export_state(self._state)
+        from denormalized_tpu.parallel.sharded_state import make_sharded_state
+
+        host = self._backend.export()
         old = self._spec
         self._spec = sa.WindowKernelSpec(
             components=old.components,
@@ -196,13 +214,20 @@ class StreamingWindowExec(ExecOperator):
                     ]
                 remapped[label] = nbuf
             host = remapped
-        self._state = sa.import_state(self._spec, host)
+        self._backend = make_sharded_state(
+            self._spec, self._mesh, self._shard_strategy
+        )
+        self._backend.import_(host)
         self._metrics["grow_events"] += 1
 
     def _ensure_capacity(self, max_win_rel: int):
-        if self._grouped and len(self._interner) > 0.9 * self._spec.group_capacity:
+        cap = self._backend.group_capacity
+        if self._grouped and len(self._interner) > 0.9 * cap:
+            n_dev = 1 if self._mesh is None else self._mesh.devices.size
             self._grow(
-                group_capacity=max(128, _next_pow2(int(len(self._interner) * 2)))
+                group_capacity=_round_capacity(
+                    _next_pow2(int(len(self._interner) * 2)), n_dev
+                )
             )
         if max_win_rel >= self._spec.window_slots:
             self._grow(window_slots=_next_pow2(max_win_rel + 2))
@@ -253,8 +278,10 @@ class StreamingWindowExec(ExecOperator):
             if m is not None:
                 colvalid[:, j] = m
 
-        # pad to bucket
+        # pad to bucket (divisible by the mesh so row-sharding splits evenly)
         Bp = max(self._min_batch_bucket, _next_pow2(n))
+        n_dev = 1 if self._mesh is None else self._mesh.devices.size
+        Bp = -(-Bp // n_dev) * n_dev
         row_valid = np.zeros(Bp, dtype=bool)
         row_valid[:n] = True
 
@@ -266,16 +293,14 @@ class StreamingWindowExec(ExecOperator):
             return out
 
         self._metrics["host_prep_s"] += time.perf_counter() - t0
-        self._state = sa.update_state(
-            self._spec,
-            self._state,
-            jnp.asarray(pad(values)),
-            jnp.asarray(pad(colvalid)),
-            jnp.asarray(pad(win_rel, fill=-1)),
-            jnp.asarray(pad(rem)),
-            jnp.asarray(pad(gid)),
-            jnp.asarray(row_valid),
-            jnp.asarray(first % self._spec.window_slots, dtype=jnp.int32),
+        self._backend.update(
+            pad(values),
+            pad(colvalid),
+            pad(win_rel, fill=-1),
+            pad(rem),
+            pad(gid),
+            row_valid,
+            first % self._spec.window_slots,
         )
         self._metrics["device_steps"] += 1
 
@@ -299,10 +324,8 @@ class StreamingWindowExec(ExecOperator):
 
     def _emit_window(self, j: int) -> RecordBatch | None:
         slot = j % self._spec.window_slots
-        rows = sa.read_slot(self._spec, self._state, slot)
-        self._state = sa.reset_slot(
-            self._spec, self._state, jnp.asarray(slot, dtype=jnp.int32)
-        )
+        rows = self._backend.read_slot(slot)
+        self._backend.reset_slot(slot)
         counts = rows[sa.ROW_COUNT.label]
         ngroups = len(self._interner) if self._grouped else 1
         active = counts > 0

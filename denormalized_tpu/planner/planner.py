@@ -44,12 +44,19 @@ class Planner:
             child = self.create_physical_plan(node.input)
             kwargs = {}
             if self.config is not None:
+                mesh = None
+                if self.config.mesh_devices:
+                    from denormalized_tpu.parallel.mesh import make_mesh
+
+                    mesh = make_mesh(self.config.mesh_devices)
                 kwargs.update(
                     accum_dtype=self.config.accum_dtype,
                     min_group_capacity=self.config.min_group_capacity,
                     min_window_slots=self.config.min_window_slots,
                     min_batch_bucket=self.config.min_batch_bucket,
                     emit_on_close=self.config.emit_on_close,
+                    mesh=mesh,
+                    shard_strategy=self.config.shard_strategy,
                 )
             if any(a.kind == "udaf" for a in node.aggr_exprs):
                 from denormalized_tpu.physical.udaf_exec import UdafWindowExec
