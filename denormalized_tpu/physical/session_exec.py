@@ -103,6 +103,7 @@ class SessionWindowExec(ExecOperator):
         # per key: open sessions sorted by start (usually exactly one)
         self._sessions: dict[tuple, list[_Session]] = {}
         self._watermark: int | None = None
+        self._ckpt: tuple | None = None
         self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
 
     @property
@@ -308,11 +309,58 @@ class SessionWindowExec(ExecOperator):
         out_cols += [starts, ends, starts.copy()]
         return RecordBatch(self.schema, out_cols)
 
+    # -- checkpointing (host dict state → JSON blob) ----------------------
+    def enable_checkpointing(self, node_id: str, coord, orch) -> None:
+        from denormalized_tpu.state.checkpoint import get_json
+
+        self._ckpt = (coord, f"session_{node_id}")
+        snap = get_json(coord, self._ckpt[1])
+        if snap is None:
+            return
+        self._watermark = snap["watermark"]
+        self._sessions = {}
+        for key_list, start, last, agg in snap["sessions"]:
+            s = _Session(
+                start,
+                last,
+                _Agg(
+                    count=agg["count"],
+                    counts=list(agg["counts"]),
+                    sums=list(agg["sums"]),
+                    mins=list(agg["mins"]),
+                    maxs=list(agg["maxs"]),
+                ),
+            )
+            self._sessions.setdefault(tuple(key_list), []).append(s)
+
+    def _snapshot(self, epoch: int) -> None:
+        from denormalized_tpu.state.checkpoint import put_json
+
+        coord, key = self._ckpt
+        sessions = [
+            [list(k), s.start, s.last,
+             {
+                 "count": s.agg.count,
+                 "counts": s.agg.counts,
+                 "sums": s.agg.sums,
+                 "mins": [float(m) for m in s.agg.mins],
+                 "maxs": [float(m) for m in s.agg.maxs],
+             }]
+            for k, lst in self._sessions.items()
+            for s in lst
+        ]
+        put_json(
+            coord, key, epoch,
+            {"epoch": epoch, "watermark": self._watermark, "sessions": sessions},
+        )
+
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
             elif isinstance(item, Marker):
+                if self._ckpt is not None:
+                    self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
                 if self.emit_on_close and self._sessions:

@@ -47,9 +47,65 @@ class SourceExec(ExecOperator):
         self._queue_size = queue_size
         self._barrier_poll: Callable[[], int | None] | None = None
         self._metrics = {"rows_out": 0, "batches_out": 0}
+        self._readers: list | None = None
+        self._yielded_offsets: list | None = None
+        self._ckpt = None  # (CheckpointCoordinator, node_id)
 
     def set_barrier_source(self, poll: Callable[[], int | None]) -> None:
         self._barrier_poll = poll
+
+    # -- checkpointing (offset persistence mirrors BatchReadMetadata,
+    # kafka_stream_read.rs:49-65,275-289; restore :110-140) -------------
+    def enable_checkpointing(self, node_id: str, coord, orch) -> None:
+        from denormalized_tpu.state.checkpoint import make_barrier_poll
+
+        self._ckpt = (coord, node_id)
+        channel = orch.register(f"src_{node_id}")
+        base_poll = make_barrier_poll(channel)
+
+        def poll():
+            epoch = base_poll()
+            if epoch is not None:
+                self._persist_offsets(epoch)
+            return epoch
+
+        self._barrier_poll = poll
+
+    def _persist_offsets(self, epoch: int) -> None:
+        from denormalized_tpu.state.checkpoint import put_json
+
+        if self._ckpt is None or self._yielded_offsets is None:
+            return
+        coord, node_id = self._ckpt
+        # offsets of batches actually YIELDED downstream — in the threaded
+        # path reader positions race ahead (prefetched batches still sit in
+        # the queue), so the barrier must not persist live reader state
+        put_json(
+            coord,
+            f"offsets_{node_id}",
+            epoch,
+            {"epoch": epoch, "partitions": list(self._yielded_offsets)},
+        )
+
+    def _restore_offsets(self, readers) -> None:
+        from denormalized_tpu.common.errors import StateError
+        from denormalized_tpu.state.checkpoint import get_json
+
+        if self._ckpt is None:
+            return
+        coord, node_id = self._ckpt
+        snap = get_json(coord, f"offsets_{node_id}")
+        if snap is None:
+            return
+        parts = snap.get("partitions", [])
+        if len(parts) != len(readers):
+            raise StateError(
+                f"checkpoint has {len(parts)} partitions but source "
+                f"{self.source.name!r} now has {len(readers)} — partition "
+                "layout must match across restarts"
+            )
+        for r, s in zip(readers, parts):
+            r.offset_restore(s)
 
     def metrics(self):
         return dict(self._metrics)
@@ -65,43 +121,49 @@ class SourceExec(ExecOperator):
 
     def run(self) -> Iterator[StreamItem]:
         readers = self.source.partitions()
+        self._readers = readers
+        self._restore_offsets(readers)
+        self._yielded_offsets = [r.offset_snapshot() for r in readers]
         if not self.source.unbounded or len(readers) == 1:
             # deterministic round-robin over bounded partitions
-            live = list(readers)
+            live = list(enumerate(readers))
             while live:
                 nxt = []
-                for r in live:
+                for i, r in live:
                     b = r.read()
                     if b is None:
                         continue
-                    nxt.append(r)
+                    nxt.append((i, r))
                     if b.num_rows:
                         self._metrics["rows_out"] += b.num_rows
                         self._metrics["batches_out"] += 1
                         yield b
+                        self._yielded_offsets[i] = r.offset_snapshot()
                     yield from self._maybe_barrier()
                 live = nxt
             yield EOS
             return
 
-        # live multi-partition: reader threads feed a bounded queue
+        # live multi-partition: reader threads feed a bounded queue.  Each
+        # queue item carries the reader's offset snapshot taken right after
+        # the read, so barrier persistence reflects only yielded batches.
         from denormalized_tpu.runtime.pump import spawn_pump
 
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self._queue_size)
         done = threading.Event()
 
-        def reader_items(reader):
+        def reader_items(idx, reader):
             def gen():
                 while not done.is_set():
                     b = reader.read(timeout_s=0.1)
                     if b is None:
                         return
-                    yield b
+                    yield (idx, reader.offset_snapshot(), b)
 
             return gen
 
-        for r in readers:
-            spawn_pump(q, done, reader_items(r), sentinel=None)
+        for i, r in enumerate(readers):
+            spawn_pump(q, done, reader_items(i, r), sentinel=None)
         finished = 0
         try:
             while finished < len(readers):
@@ -111,9 +173,11 @@ class SourceExec(ExecOperator):
                     continue
                 if isinstance(item, BaseException):
                     raise item
-                self._metrics["rows_out"] += item.num_rows
+                idx, snap, batch = item
+                self._metrics["rows_out"] += batch.num_rows
                 self._metrics["batches_out"] += 1
-                yield item
+                yield batch
+                self._yielded_offsets[idx] = snap
                 yield from self._maybe_barrier()
         finally:
             done.set()

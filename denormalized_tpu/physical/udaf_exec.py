@@ -65,6 +65,15 @@ class _BuiltinAcc:
             "max": self.max if np.isfinite(self.max) else np.nan,
         }[self.kind]
 
+    def state(self):
+        return [self.count, self.sum, float(self.min), float(self.max)]
+
+    def merge(self, s):
+        self.count += s[0]
+        self.sum += s[1]
+        self.min = min(self.min, s[2])
+        self.max = max(self.max, s[3])
+
 
 class UdafWindowExec(ExecOperator):
     def __init__(
@@ -108,6 +117,7 @@ class UdafWindowExec(ExecOperator):
 
         # frames: window index j -> { group key tuple -> [acc per agg] }
         self._frames: dict[int, dict[tuple, list]] = {}
+        self._ckpt: tuple | None = None
         self._first_open: int | None = None
         self._max_win_seen = -1
         self._watermark: int | None = None
@@ -254,11 +264,62 @@ class UdafWindowExec(ExecOperator):
         cols += [start, end, start.copy()]
         return RecordBatch(self.schema, cols)
 
+    # -- checkpointing: accumulator state() lists, the capability the
+    # reference prototypes in SerializableAccumulator
+    # (accumulators/serializable_accumulator.rs:10-68) ------------------
+    def enable_checkpointing(self, node_id: str, coord, orch) -> None:
+        from denormalized_tpu.state.checkpoint import get_json
+
+        self._ckpt = (coord, f"udafwin_{node_id}")
+        snap = get_json(coord, self._ckpt[1])
+        if snap is None:
+            return
+        self._first_open = snap["first_open"]
+        self._max_win_seen = snap["max_win_seen"]
+        self._watermark = snap["watermark"]
+        self._frames = {}
+        for j_str, groups in snap["frames"].items():
+            frame: dict[tuple, list] = {}
+            for key_list, states in groups:
+                accs = self._make_accs()
+                for acc, st in zip(accs, states):
+                    acc.merge(st)
+                frame[tuple(key_list)] = accs
+            self._frames[int(j_str)] = frame
+
+    def _snapshot(self, epoch: int) -> None:
+        # put_json's `jsonable` recursively converts numpy scalars/arrays in
+        # both keys and user accumulator state() payloads
+        from denormalized_tpu.state.checkpoint import put_json
+
+        coord, key = self._ckpt
+        frames = {
+            str(j): [
+                [list(k), [acc.state() for acc in accs]]
+                for k, accs in frame.items()
+            ]
+            for j, frame in self._frames.items()
+        }
+        put_json(
+            coord,
+            key,
+            epoch,
+            {
+                "epoch": epoch,
+                "first_open": self._first_open,
+                "max_win_seen": self._max_win_seen,
+                "watermark": self._watermark,
+                "frames": frames,
+            },
+        )
+
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
             elif isinstance(item, Marker):
+                if self._ckpt is not None:
+                    self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
                 if self.emit_on_close and self._first_open is not None:

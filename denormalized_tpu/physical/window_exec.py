@@ -148,6 +148,7 @@ class StreamingWindowExec(ExecOperator):
         self.schema = Schema(fields)
 
         # streaming state
+        self._ckpt: tuple | None = None
         self._first_open: int | None = None  # lowest non-emitted slide index
         self._max_win_seen: int = -1
         self._watermark_ms: int | None = None
@@ -352,13 +353,69 @@ class StreamingWindowExec(ExecOperator):
         cols += [start, end, start.copy()]
         return RecordBatch(self.schema, cols)
 
+    # -- checkpointing ----------------------------------------------------
+    # Snapshot = device state buffers + interner + watermark scalars, the
+    # analog of CheckpointedGroupedWindowAggStream
+    # (grouped_window_agg_stream.rs:84-102,355-418) — but taken from an
+    # ALIGNED in-band marker, and without the reference's drain-then-reseed
+    # trick (:379-394): export_state reads buffers without mutating them.
+    def enable_checkpointing(self, node_id: str, coord, orch) -> None:
+        self._ckpt = (coord, f"window_{node_id}")
+        self._restore()
+
+    def _snapshot(self, epoch: int) -> None:
+        from denormalized_tpu.state.serialization import pack_snapshot
+
+        coord, key = self._ckpt
+        meta = {
+            "epoch": epoch,
+            "first_open": self._first_open,
+            "max_win_seen": self._max_win_seen,
+            "watermark_ms": self._watermark_ms,
+            "window_slots": self._spec.window_slots,
+            "group_capacity": self._backend.group_capacity,
+            "interner": self._interner.snapshot() if self._grouped else None,
+        }
+        coord.put_snapshot(key, epoch, pack_snapshot(meta, self._backend.export()))
+
+    def _restore(self) -> None:
+        from denormalized_tpu.state.serialization import unpack_snapshot
+        from denormalized_tpu.parallel.sharded_state import make_sharded_state
+
+        coord, key = self._ckpt
+        blob = coord.get_snapshot(key)
+        if blob is None:
+            return
+        meta, arrays = unpack_snapshot(blob)
+        n_dev = 1 if self._mesh is None else self._mesh.devices.size
+        old = self._spec
+        self._spec = sa.WindowKernelSpec(
+            components=old.components,
+            num_value_cols=old.num_value_cols,
+            window_slots=int(meta["window_slots"]),
+            group_capacity=_round_capacity(int(meta["group_capacity"]), n_dev),
+            length_ms=old.length_ms,
+            slide_ms=old.slide_ms,
+            accum_dtype=old.accum_dtype,
+        )
+        self._backend = make_sharded_state(
+            self._spec, self._mesh, self._shard_strategy
+        )
+        self._backend.import_(arrays)
+        self._first_open = meta["first_open"]
+        self._max_win_seen = meta["max_win_seen"]
+        self._watermark_ms = meta["watermark_ms"]
+        if self._grouped and meta["interner"] is not None:
+            self._interner = GroupInterner.restore(meta["interner"])
+
     # -- stream loop -----------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
             elif isinstance(item, Marker):
-                # snapshot hook added by the checkpointing layer
+                if self._ckpt is not None:
+                    self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
                 if self.emit_on_close and self._first_open is not None:

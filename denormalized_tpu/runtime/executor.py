@@ -54,19 +54,19 @@ def _install_signal_handlers(flag: ShutdownFlag):
     return restore
 
 
-def _attach_checkpointing(root: ExecOperator, ctx) -> "object | None":
+def _attach_checkpointing(root: ExecOperator, ctx):
     """When checkpoint=true, start the barrier orchestrator and register
     every source + stateful operator (with_orchestrator,
-    datastream.rs:244-307)."""
+    datastream.rs:244-307).  Returns (orchestrator, coordinator)."""
     if not getattr(ctx.config, "checkpoint", False):
-        return None
+        return None, None
     from denormalized_tpu.state.orchestrator import Orchestrator
     from denormalized_tpu.state.checkpoint import wire_checkpointing
 
     orch = Orchestrator(interval_s=ctx.config.checkpoint_interval_s)
-    wire_checkpointing(root, ctx, orch)
+    coord = wire_checkpointing(root, ctx, orch)
     orch.start()
-    return orch
+    return orch, coord
 
 
 def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
@@ -74,12 +74,18 @@ def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
 
 
 def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
+    from denormalized_tpu.physical.base import Marker
+
     root = build_physical(plan, ctx)
-    orch = _attach_checkpointing(root, ctx)
+    orch, coord = _attach_checkpointing(root, ctx)
     flag = ShutdownFlag()
     restore = _install_signal_handlers(flag)
     try:
         for item in root.run():
+            if isinstance(item, Marker) and coord is not None:
+                # marker drained at the root: every operator snapshotted
+                # this epoch → make it the durable recovery point
+                coord.commit(item.epoch)
             if flag.is_set():
                 break
             if isinstance(item, EndOfStream):
@@ -91,12 +97,16 @@ def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
 
 
 def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
+    from denormalized_tpu.physical.base import Marker
+
     root = build_physical(plan, ctx)
-    orch = _attach_checkpointing(root, ctx)
+    orch, coord = _attach_checkpointing(root, ctx)
     try:
         for item in root.run():
             if isinstance(item, RecordBatch):
                 yield item
+            elif isinstance(item, Marker) and coord is not None:
+                coord.commit(item.epoch)
             elif isinstance(item, EndOfStream):
                 break
     finally:

@@ -1,0 +1,145 @@
+"""Checkpoint wiring: connect the orchestrator, the state backend, and the
+physical plan.
+
+Mirrors the reference's checkpoint topology (SURVEY.md §3.4): sources persist
+their offsets when a barrier passes (kafka_stream_read.rs:275-289) and window
+streams persist watermark + frames (grouped_window_agg_stream.rs:355-418),
+all keyed by ``{node_id}_{partition}`` tags in the state backend; on startup
+operators probe the backend by tag and restore
+(kafka_stream_read.rs:110-140, grouped_window_agg_stream.rs:160-211).  The
+fork's ``node_id`` plumbing (``with_node_id``) becomes a deterministic DFS
+numbering of the physical plan here — stable across runs because the plan is
+rebuilt deterministically from the same query.
+
+Atomicity — an improvement over the reference's fire-and-forget puts
+(slatedb.rs:60-66): snapshots for barrier epoch ``E`` are written under
+epoch-suffixed keys ``{key}@{E}`` as the in-band marker passes each
+operator; when the marker drains at the plan root, the executor calls
+:meth:`CheckpointCoordinator.commit`, which fsyncs the store and only then
+writes the ``committed_epoch`` record (also fsynced).  Restore reads the
+committed epoch and loads exactly that epoch's snapshots — a half-written
+barrier (crash between operator snapshots) is invisible, so recovery never
+mixes epochs.  Older epochs are garbage-collected after commit.
+
+Consistency: barriers flow in-band (see orchestrator.py), so on single-input
+chains the snapshot is an aligned cut and recovery is exactly-once w.r.t.
+engine state; emission to sinks remains at-least-once (windows that closed
+after the last barrier re-emit on recovery), matching the reference.  Join
+operator state is not checkpointed — parity with the reference, which
+checkpoints only sources and window state.
+"""
+
+from __future__ import annotations
+
+import json
+
+from denormalized_tpu.common.errors import StateError
+from denormalized_tpu.physical.base import ExecOperator
+from denormalized_tpu.state.lsm import initialize_global_state_backend
+from denormalized_tpu.state.orchestrator import CheckpointBarrier, Orchestrator
+
+_COMMIT_KEY = "committed_epoch"
+
+
+def walk(op: ExecOperator):
+    yield op
+    for c in op.children:
+        yield from walk(c)
+
+
+def assign_node_ids(root: ExecOperator) -> dict[int, str]:
+    """Deterministic DFS-preorder node ids (the fork's node_id analog)."""
+    ids: dict[int, str] = {}
+    for i, op in enumerate(walk(root)):
+        ids[id(op)] = f"{i}_{type(op).__name__}"
+    return ids
+
+
+class CheckpointCoordinator:
+    """Epoch-aware snapshot IO shared by all operators of one query."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        raw = backend.get(_COMMIT_KEY)
+        self.committed_epoch: int | None = (
+            int(raw.decode()) if raw is not None else None
+        )
+        self._epoch_keys: dict[int, list[str]] = {}
+
+    # -- write side ------------------------------------------------------
+    def put_snapshot(self, key: str, epoch: int, blob: bytes) -> None:
+        self.backend.put(f"{key}@{epoch}", blob)
+        self._epoch_keys.setdefault(epoch, []).append(key)
+
+    def commit(self, epoch: int) -> None:
+        """Marker drained at the root: make epoch E durable, then GC."""
+        self.backend.flush()
+        self.backend.put(_COMMIT_KEY, str(epoch).encode())
+        self.backend.flush()
+        prev = self.committed_epoch
+        self.committed_epoch = epoch
+        if prev is not None and prev != epoch:
+            for key in self._epoch_keys.pop(prev, []):
+                self.backend.delete(f"{key}@{prev}")
+
+    # -- read side -------------------------------------------------------
+    def get_snapshot(self, key: str) -> bytes | None:
+        if self.committed_epoch is None:
+            return None
+        return self.backend.get(f"{key}@{self.committed_epoch}")
+
+
+def wire_checkpointing(
+    root: ExecOperator, ctx, orch: Orchestrator
+) -> CheckpointCoordinator:
+    path = ctx.config.state_backend_path
+    if not path:
+        raise StateError(
+            "checkpoint=True requires state_backend_path "
+            "(Context.with_state_backend)"
+        )
+    backend = initialize_global_state_backend(path)
+    coord = CheckpointCoordinator(backend)
+    ids = assign_node_ids(root)
+    for op in walk(root):
+        node_id = ids[id(op)]
+        hook = getattr(op, "enable_checkpointing", None)
+        if hook is not None:
+            hook(node_id, coord, orch)
+    return coord
+
+
+def make_barrier_poll(channel):
+    """Source-side poll: returns an epoch when a barrier is pending."""
+
+    def poll():
+        msg = channel.poll()
+        if isinstance(msg, CheckpointBarrier):
+            return msg.epoch
+        return None
+
+    return poll
+
+
+def jsonable(v):
+    """Recursively convert numpy scalars/arrays for json.dumps."""
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return [jsonable(x) for x in v.tolist()]
+    if isinstance(v, (list, tuple)):
+        return [jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): jsonable(x) for k, x in v.items()}
+    return v
+
+
+def put_json(coord: CheckpointCoordinator, key: str, epoch: int, obj) -> None:
+    coord.put_snapshot(key, epoch, json.dumps(jsonable(obj)).encode())
+
+
+def get_json(coord: CheckpointCoordinator, key: str):
+    raw = coord.get_snapshot(key)
+    return None if raw is None else json.loads(raw.decode())
