@@ -1,0 +1,49 @@
+"""Stream payload formats.
+
+Mirror of the reference's ``formats`` module: the ``Decoder`` seam
+(crates/core/src/formats/decoders/mod.rs:4-8 — push raw payload bytes,
+flush one RecordBatch), JSON and Avro decoders, and the ``StreamEncoding``
+enum (formats/mod.rs:5-24).
+"""
+
+from __future__ import annotations
+
+import enum
+
+from denormalized_tpu.common.errors import FormatError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+
+
+class StreamEncoding(enum.Enum):
+    JSON = "json"
+    AVRO = "avro"
+
+    @staticmethod
+    def from_str(s: str) -> "StreamEncoding":
+        try:
+            return StreamEncoding(s.lower())
+        except ValueError:
+            raise FormatError(f"unknown encoding {s!r} (expected json|avro)")
+
+
+class Decoder:
+    """Buffer raw payloads; flush to one columnar batch."""
+
+    schema: Schema
+
+    def push(self, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> RecordBatch:
+        raise NotImplementedError
+
+
+def make_decoder(encoding: StreamEncoding, schema: Schema, avro_schema=None):
+    if encoding is StreamEncoding.JSON:
+        from denormalized_tpu.formats.json_codec import JsonDecoder
+
+        return JsonDecoder(schema)
+    from denormalized_tpu.formats.avro_codec import AvroDecoder
+
+    return AvroDecoder(schema, avro_schema)

@@ -1,0 +1,187 @@
+"""JSON payloads ⇄ columnar batches.
+
+Covers three reference components:
+- ``JsonDecoder`` (formats/decoders/json.rs:11-49): buffer payload bytes,
+  flush one batch against a target schema;
+- JSON schema inference (utils/arrow_helpers.rs:283
+  ``infer_arrow_schema_from_json_value`` — nested structs/lists recursed);
+- ``JsonRowEncoder`` (utils/row_encoder.rs:5-44): batch → per-row JSON
+  byte payloads for sinks.
+
+The decode hot path uses the native C++ columnar parser
+(:mod:`denormalized_tpu.formats.native_json`) for flat schemas and falls
+back to Python ``json`` for nested ones.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+from denormalized_tpu.common.errors import FormatError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.formats import Decoder
+
+
+# -- schema inference ----------------------------------------------------
+
+
+def infer_field(name: str, value) -> Field:
+    if isinstance(value, bool):
+        return Field(name, DataType.BOOL)
+    if isinstance(value, int):
+        return Field(name, DataType.INT64)
+    if isinstance(value, float):
+        return Field(name, DataType.FLOAT64)
+    if isinstance(value, str):
+        return Field(name, DataType.STRING)
+    if value is None:
+        return Field(name, DataType.STRING)
+    if isinstance(value, dict):
+        children = tuple(infer_field(k, v) for k, v in value.items())
+        return Field(name, DataType.STRUCT, children=children)
+    if isinstance(value, list):
+        child = (
+            infer_field("item", value[0]) if value else Field("item", DataType.STRING)
+        )
+        return Field(name, DataType.LIST, children=(child,))
+    raise FormatError(f"cannot infer type for {name}={value!r}")
+
+
+def infer_schema_from_json(sample: str | bytes) -> Schema:
+    """Schema from one sample JSON object (the from_topic sample_json path,
+    py-denormalized/src/context.rs:64-83)."""
+    obj = json.loads(sample)
+    if not isinstance(obj, dict):
+        raise FormatError("sample JSON must be an object")
+    return Schema([infer_field(k, v) for k, v in obj.items()])
+
+
+# -- decoding ------------------------------------------------------------
+
+
+class JsonDecoder(Decoder):
+    def __init__(self, schema: Schema, use_native: bool = True):
+        self.schema = schema
+        self._rows: list[bytes] = []
+        self._native = None
+        if use_native and all(
+            f.dtype not in (DataType.STRUCT, DataType.LIST) for f in schema
+        ):
+            try:
+                from denormalized_tpu.formats.native_json import NativeJsonParser
+
+                self._native = NativeJsonParser(schema)
+            except Exception:
+                self._native = None
+
+    def push(self, payload: bytes) -> None:
+        if payload:
+            self._rows.append(payload)
+
+    def flush(self) -> RecordBatch:
+        rows, self._rows = self._rows, []
+        if self._native is not None:
+            return self._native.parse(rows)
+        return decode_json_rows(rows, self.schema)
+
+
+def _null_of(dtype: DataType):
+    # values behind an invalid mask are unspecified; use 0 (same convention
+    # as the native parser) so both decode paths are bit-identical
+    return {
+        DataType.INT32: 0,
+        DataType.INT64: 0,
+        DataType.TIMESTAMP_MS: 0,
+        DataType.FLOAT32: 0.0,
+        DataType.FLOAT64: 0.0,
+        DataType.BOOL: False,
+    }.get(dtype)
+
+
+def decode_json_rows(rows: list[bytes], schema: Schema) -> RecordBatch:
+    """Pure-Python decode path (nested schemas / fallback)."""
+    objs = []
+    for r in rows:
+        try:
+            objs.append(json.loads(r))
+        except json.JSONDecodeError as e:
+            raise FormatError(f"invalid JSON payload: {e}") from None
+    return rows_to_batch(objs, schema)
+
+
+def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
+    for i, o in enumerate(objs):
+        if not isinstance(o, dict):
+            raise FormatError(
+                f"row {i}: expected a JSON object, got {type(o).__name__}"
+            )
+    n = len(objs)
+    cols, masks = [], []
+    for f in schema:
+        if f.dtype in (DataType.STRUCT, DataType.LIST, DataType.STRING):
+            col = np.empty(n, dtype=object)
+            mask = np.ones(n, dtype=bool)
+            for i, o in enumerate(objs):
+                v = o.get(f.name)
+                if v is None:
+                    mask[i] = False
+                col[i] = v
+            cols.append(col)
+            masks.append(None if mask.all() else mask)
+            continue
+        npdt = f.dtype.to_numpy()
+        col = np.zeros(n, dtype=npdt)
+        mask = np.ones(n, dtype=bool)
+        null = _null_of(f.dtype)
+        for i, o in enumerate(objs):
+            v = o.get(f.name)
+            if v is None:
+                mask[i] = False
+                col[i] = null
+            else:
+                try:
+                    col[i] = v
+                except (TypeError, ValueError):
+                    raise FormatError(
+                        f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
+                    ) from None
+        cols.append(col)
+        masks.append(None if mask.all() else mask)
+    return RecordBatch(schema, cols, masks)
+
+
+# -- encoding (sink side) ------------------------------------------------
+
+
+class JsonRowEncoder:
+    """RecordBatch → per-row JSON byte payloads (utils/row_encoder.rs)."""
+
+    def encode(self, batch: RecordBatch) -> list[bytes]:
+        user = batch.select(batch.schema.without_internal().names)
+        names = user.schema.names
+        out = []
+        for i in range(user.num_rows):
+            row = {}
+            for j, name in enumerate(names):
+                m = user.masks[j]
+                if m is not None and not m[i]:
+                    row[name] = None
+                    continue
+                row[name] = _jsonify(user.columns[j][i])
+            out.append(json.dumps(row).encode())
+        return out
+
+
+def _jsonify(v):
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        f = float(v)
+        return None if math.isnan(f) else f
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v

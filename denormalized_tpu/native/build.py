@@ -1,0 +1,36 @@
+"""Build-on-first-use for the native (C++) components: compiles
+``<name>.cpp`` beside this file into ``<name>.so`` with g++ when the source
+is newer, and loads it with ctypes.  Raises on failure — callers decide
+whether a pure-Python fallback exists."""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL] = {}
+
+
+def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = _DIR / f"{name}.cpp"
+        so = _DIR / f"{name}.so"
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                str(src), "-o", str(so),
+            ] + (extra_flags or [])
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build of {name} failed:\n{proc.stderr[-2000:]}"
+                )
+        lib = ctypes.CDLL(str(so))
+        _CACHE[name] = lib
+        return lib

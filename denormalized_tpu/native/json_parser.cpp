@@ -1,0 +1,388 @@
+// json_parser — one-pass JSON-objects → columnar buffers.
+//
+// Native ingest/decode path: the reference decodes Kafka JSON payloads by
+// concatenating them into a JSON array and running arrow-json's reader
+// (crates/core/src/formats/decoders/json.rs:11-49, native Rust/C via Arrow).
+// Ours parses each payload directly into typed columnar buffers in a single
+// pass — no intermediate DOM, no per-row Python objects.  Flat schemas only
+// (the Python fallback handles nested structs/lists).
+//
+// C ABI for ctypes.  Column types: 0=int64, 1=float64, 2=bool, 3=string.
+// Unknown keys are skipped (balanced for nested values); missing keys and
+// JSON nulls set validity 0.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Col {
+  std::string name;
+  int type;
+  std::vector<int64_t> i64;
+  std::vector<double> f64;
+  std::vector<uint8_t> b;
+  std::vector<uint8_t> valid;
+  std::vector<uint8_t> str_bytes;
+  std::vector<uint64_t> str_offsets;  // nrows+1
+};
+
+struct Parser {
+  std::vector<Col> cols;
+  uint64_t nrows = 0;
+  std::string error;
+};
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      p++;
+  }
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == (uint8_t)c) {
+      p++;
+      return true;
+    }
+    fail = true;
+    return false;
+  }
+  bool peek(char c) {
+    ws();
+    return p < end && *p == (uint8_t)c;
+  }
+};
+
+// parse a JSON string (after the opening quote) into out; handles escapes
+bool parse_string(Cursor& c, std::string& out) {
+  out.clear();
+  while (c.p < c.end) {
+    uint8_t ch = *c.p++;
+    if (ch == '"') return true;
+    if (ch != '\\') {
+      out.push_back((char)ch);
+      continue;
+    }
+    if (c.p >= c.end) break;
+    uint8_t esc = *c.p++;
+    switch (esc) {
+      case '"': out.push_back('"'); break;
+      case '\\': out.push_back('\\'); break;
+      case '/': out.push_back('/'); break;
+      case 'b': out.push_back('\b'); break;
+      case 'f': out.push_back('\f'); break;
+      case 'n': out.push_back('\n'); break;
+      case 'r': out.push_back('\r'); break;
+      case 't': out.push_back('\t'); break;
+      case 'u': {
+        auto hex4 = [&](unsigned& cp) -> bool {
+          if (c.end - c.p < 4) return false;
+          cp = 0;
+          for (int i = 0; i < 4; i++) {
+            uint8_t h = *c.p++;
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= h - '0';
+            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            else return false;
+          }
+          return true;
+        };
+        unsigned cp;
+        if (!hex4(cp)) return false;
+        // surrogate pair → combined code point (json.dumps ensure_ascii
+        // emits all non-BMP chars this way)
+        if (cp >= 0xD800 && cp <= 0xDBFF && c.end - c.p >= 6 &&
+            c.p[0] == '\\' && c.p[1] == 'u') {
+          c.p += 2;
+          unsigned lo;
+          if (!hex4(lo)) return false;
+          if (lo >= 0xDC00 && lo <= 0xDFFF) {
+            cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          } else {
+            cp = 0xFFFD;  // lone high surrogate → replacement char
+            // re-emit the second escape as its own char below? simplest:
+            // treat `lo` as an independent BMP code point
+            unsigned cp2 = (lo >= 0xD800 && lo <= 0xDFFF) ? 0xFFFD : lo;
+            // emit cp now, then fall through to emit cp2
+            auto emit = [&](unsigned x) {
+              if (x < 0x80) out.push_back((char)x);
+              else if (x < 0x800) {
+                out.push_back((char)(0xC0 | (x >> 6)));
+                out.push_back((char)(0x80 | (x & 0x3F)));
+              } else if (x < 0x10000) {
+                out.push_back((char)(0xE0 | (x >> 12)));
+                out.push_back((char)(0x80 | ((x >> 6) & 0x3F)));
+                out.push_back((char)(0x80 | (x & 0x3F)));
+              } else {
+                out.push_back((char)(0xF0 | (x >> 18)));
+                out.push_back((char)(0x80 | ((x >> 12) & 0x3F)));
+                out.push_back((char)(0x80 | ((x >> 6) & 0x3F)));
+                out.push_back((char)(0x80 | (x & 0x3F)));
+              }
+            };
+            emit(cp);
+            emit(cp2);
+            break;
+          }
+        } else if (cp >= 0xD800 && cp <= 0xDFFF) {
+          cp = 0xFFFD;  // lone surrogate
+        }
+        if (cp < 0x80) out.push_back((char)cp);
+        else if (cp < 0x800) {
+          out.push_back((char)(0xC0 | (cp >> 6)));
+          out.push_back((char)(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+          out.push_back((char)(0xE0 | (cp >> 12)));
+          out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          out.push_back((char)(0x80 | (cp & 0x3F)));
+        } else {
+          out.push_back((char)(0xF0 | (cp >> 18)));
+          out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+          out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+          out.push_back((char)(0x80 | (cp & 0x3F)));
+        }
+        break;
+      }
+      default: return false;
+    }
+  }
+  return false;
+}
+
+// skip any JSON value (for unknown keys)
+bool skip_value(Cursor& c) {
+  c.ws();
+  if (c.p >= c.end) return false;
+  uint8_t ch = *c.p;
+  if (ch == '"') {
+    c.p++;
+    std::string tmp;
+    return parse_string(c, tmp);
+  }
+  if (ch == '{' || ch == '[') {
+    uint8_t open = ch, close = (ch == '{') ? '}' : ']';
+    int depth = 0;
+    bool in_str = false;
+    while (c.p < c.end) {
+      uint8_t x = *c.p++;
+      if (in_str) {
+        if (x == '\\') { if (c.p < c.end) c.p++; }
+        else if (x == '"') in_str = false;
+      } else if (x == '"') in_str = true;
+      else if (x == open) depth++;
+      else if (x == close) {
+        if (--depth == 0) return true;
+      }
+    }
+    return false;
+  }
+  // number / true / false / null
+  while (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
+         *c.p != ' ' && *c.p != '\n' && *c.p != '\t' && *c.p != '\r')
+    c.p++;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* jp_create(int ncols, const char** names, const int* types) {
+  Parser* p = new Parser();
+  p->cols.resize(ncols);
+  for (int i = 0; i < ncols; i++) {
+    p->cols[i].name = names[i];
+    p->cols[i].type = types[i];
+    p->cols[i].str_offsets.push_back(0);
+  }
+  return p;
+}
+
+void jp_clear(void* h) {
+  Parser* p = static_cast<Parser*>(h);
+  p->nrows = 0;
+  p->error.clear();
+  for (auto& c : p->cols) {
+    c.i64.clear();
+    c.f64.clear();
+    c.b.clear();
+    c.valid.clear();
+    c.str_bytes.clear();
+    c.str_offsets.assign(1, 0);
+  }
+}
+
+// returns 0 on success, -1 on parse error (see jp_error)
+int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
+             uint64_t nrows) {
+  Parser* p = static_cast<Parser*>(h);
+  const int ncols = (int)p->cols.size();
+  std::string key, sval;
+  std::vector<uint8_t> seen(ncols);
+
+  for (uint64_t r = 0; r < nrows; r++) {
+    Cursor c{data + offsets[r], data + offsets[r + 1]};
+    std::fill(seen.begin(), seen.end(), 0);
+    if (!c.eat('{')) {
+      p->error = "expected '{' at row " + std::to_string(r);
+      return -1;
+    }
+    if (!c.peek('}')) {
+      for (;;) {
+        if (!c.eat('"')) break;
+        if (!parse_string(c, key)) { c.fail = true; break; }
+        if (!c.eat(':')) break;
+        // find column
+        int ci = -1;
+        for (int i = 0; i < ncols; i++)
+          if (p->cols[i].name == key) { ci = i; break; }
+        if (ci < 0) {
+          if (!skip_value(c)) { c.fail = true; break; }
+        } else {
+          Col& col = p->cols[ci];
+          if (seen[ci]) {
+            // duplicate key: last-wins (match json.loads dict semantics) —
+            // drop the value stored for the earlier occurrence
+            col.valid.pop_back();
+            switch (col.type) {
+              case 0: col.i64.pop_back(); break;
+              case 1: col.f64.pop_back(); break;
+              case 2: col.b.pop_back(); break;
+              case 3:
+                col.str_offsets.pop_back();
+                col.str_bytes.resize(col.str_offsets.back());
+                break;
+            }
+          }
+          seen[ci] = 1;
+          c.ws();
+          bool is_null = false;
+          if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
+            c.p += 4;
+            is_null = true;
+          }
+          if (is_null) {
+            col.valid.push_back(0);
+            switch (col.type) {
+              case 0: col.i64.push_back(0); break;
+              case 1: col.f64.push_back(0); break;
+              case 2: col.b.push_back(0); break;
+              case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
+            }
+          } else {
+            switch (col.type) {
+              case 0: {
+                char* endp = nullptr;
+                long long v = strtoll((const char*)c.p, &endp, 10);
+                if (endp == (const char*)c.p) { c.fail = true; }
+                c.p = (const uint8_t*)endp;
+                col.i64.push_back(v);
+                col.valid.push_back(1);
+                break;
+              }
+              case 1: {
+                char* endp = nullptr;
+                double v = strtod((const char*)c.p, &endp);
+                if (endp == (const char*)c.p) { c.fail = true; }
+                c.p = (const uint8_t*)endp;
+                col.f64.push_back(v);
+                col.valid.push_back(1);
+                break;
+              }
+              case 2: {
+                c.ws();
+                if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
+                  c.p += 4;
+                  col.b.push_back(1);
+                } else if (c.end - c.p >= 5 && memcmp(c.p, "false", 5) == 0) {
+                  c.p += 5;
+                  col.b.push_back(0);
+                } else {
+                  c.fail = true;
+                  col.b.push_back(0);
+                }
+                col.valid.push_back(1);
+                break;
+              }
+              case 3: {
+                if (!c.eat('"')) { c.fail = true; break; }
+                if (!parse_string(c, sval)) { c.fail = true; break; }
+                col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
+                                     sval.end());
+                col.str_offsets.push_back(col.str_bytes.size());
+                col.valid.push_back(1);
+                break;
+              }
+            }
+          }
+        }
+        if (c.fail) break;
+        c.ws();
+        if (c.peek(',')) { c.p++; continue; }
+        break;
+      }
+      if (!c.fail) c.eat('}');
+    } else {
+      c.p++;  // consume '}'
+    }
+    if (c.fail) {
+      p->error = "malformed JSON at row " + std::to_string(r);
+      return -1;
+    }
+    // missing keys → null
+    for (int i = 0; i < ncols; i++) {
+      if (!seen[i]) {
+        Col& col = p->cols[i];
+        col.valid.push_back(0);
+        switch (col.type) {
+          case 0: col.i64.push_back(0); break;
+          case 1: col.f64.push_back(0); break;
+          case 2: col.b.push_back(0); break;
+          case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
+        }
+      }
+    }
+    p->nrows++;
+  }
+  return 0;
+}
+
+const char* jp_error(void* h) {
+  return static_cast<Parser*>(h)->error.c_str();
+}
+
+uint64_t jp_nrows(void* h) { return static_cast<Parser*>(h)->nrows; }
+
+const int64_t* jp_col_i64(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].i64.data();
+}
+const double* jp_col_f64(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].f64.data();
+}
+const uint8_t* jp_col_bool(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].b.data();
+}
+const uint8_t* jp_col_valid(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].valid.data();
+}
+const uint8_t* jp_col_str_bytes(void* h, int col, uint64_t* nbytes) {
+  Col& c = static_cast<Parser*>(h)->cols[col];
+  *nbytes = c.str_bytes.size();
+  return c.str_bytes.data();
+}
+const uint64_t* jp_col_str_offsets(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].str_offsets.data();
+}
+
+void jp_destroy(void* h) { delete static_cast<Parser*>(h); }
+
+}  // extern "C"

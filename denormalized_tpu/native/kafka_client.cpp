@@ -1,0 +1,575 @@
+// kafka_client — minimal native Kafka wire-protocol client.
+//
+// The reference's Kafka connectivity is librdkafka (native C) behind the
+// rdkafka crate (kafka_config.rs make_consumer/make_producer).  This is our
+// native equivalent, speaking the Kafka binary protocol directly over TCP:
+//
+//   ApiVersions v0 | Metadata v1 | ListOffsets v1 | Produce v3 | Fetch v4
+//
+// with modern magic-2 RecordBatches (varint records, CRC32C).  Scope mirrors
+// what the reference engine actually uses: partition discovery
+// (get_topic_partition_count, kafka_config.rs:325), earliest/latest offset
+// lookup + seek (kafka_stream_read.rs:118-140), per-partition fetch loops
+// (:165-296), and fire-and-forget produce (topic_writer.rs KafkaSink).
+// Consumer-group coordination is intentionally absent — offsets are owned by
+// the engine's checkpoint store, exactly like the reference persists
+// BatchReadMetadata to SlateDB rather than committing to Kafka.
+//
+// C ABI for ctypes; one connection per client object; not thread-safe
+// (callers hold one client per partition reader, mirroring rdkafka's
+// per-consumer model).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <netdb.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---- CRC32C (Castagnoli), table-driven ----------------------------------
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+uint32_t crc32c(const uint8_t* d, size_t n) {
+  static const Crc32cTable tab;
+  uint32_t c = ~0u;
+  for (size_t i = 0; i < n; i++) c = tab.t[(c ^ d[i]) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
+// ---- byte buffer helpers ------------------------------------------------
+struct Writer {
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void i8(int8_t v) { buf.push_back((uint8_t)v); }
+  void i16(int16_t v) {
+    uint16_t x = htons((uint16_t)v);
+    append(&x, 2);
+  }
+  void i32(int32_t v) {
+    uint32_t x = htonl((uint32_t)v);
+    append(&x, 4);
+  }
+  void u32(uint32_t v) {
+    uint32_t x = htonl(v);
+    append(&x, 4);
+  }
+  void i64(int64_t v) {
+    uint32_t hi = htonl((uint32_t)(((uint64_t)v) >> 32));
+    uint32_t lo = htonl((uint32_t)(v & 0xFFFFFFFFu));
+    append(&hi, 4);
+    append(&lo, 4);
+  }
+  void str(const std::string& s) {
+    i16((int16_t)s.size());
+    append(s.data(), s.size());
+  }
+  void nullable_str() { i16(-1); }
+  void bytes(const std::vector<uint8_t>& b) {
+    i32((int32_t)b.size());
+    append(b.data(), b.size());
+  }
+  void varint(int64_t v) {  // zigzag
+    uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    while (z >= 0x80) {
+      buf.push_back((uint8_t)(z | 0x80));
+      z >>= 7;
+    }
+    buf.push_back((uint8_t)z);
+  }
+  void append(const void* p, size_t n) {
+    const uint8_t* b = (const uint8_t*)p;
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+  bool need(size_t n) {
+    if ((size_t)(end - p) < n) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  int8_t i8() {
+    if (!need(1)) return 0;
+    return (int8_t)*p++;
+  }
+  int16_t i16() {
+    if (!need(2)) return 0;
+    uint16_t x;
+    memcpy(&x, p, 2);
+    p += 2;
+    return (int16_t)ntohs(x);
+  }
+  int32_t i32() {
+    if (!need(4)) return 0;
+    uint32_t x;
+    memcpy(&x, p, 4);
+    p += 4;
+    return (int32_t)ntohl(x);
+  }
+  uint32_t u32() { return (uint32_t)i32(); }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    uint32_t hi, lo;
+    memcpy(&hi, p, 4);
+    memcpy(&lo, p + 4, 4);
+    p += 8;
+    return ((int64_t)ntohl(hi) << 32) | (uint32_t)ntohl(lo);
+  }
+  std::string str() {
+    int16_t n = i16();
+    if (n < 0) return "";
+    if (!need((size_t)n)) return "";
+    std::string s((const char*)p, n);
+    p += n;
+    return s;
+  }
+  void skip_bytes() {
+    int32_t n = i32();
+    if (n > 0 && need((size_t)n)) p += n;
+  }
+  int64_t varint() {
+    uint64_t acc = 0;
+    int shift = 0;
+    while (need(1)) {
+      uint8_t b = *p++;
+      acc |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    return (int64_t)((acc >> 1) ^ (~(acc & 1) + 1));
+  }
+  void skip(size_t n) {
+    if (need(n)) p += n;
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::string error;
+  int32_t corr = 0;
+  // fetch results
+  std::vector<uint8_t> rec_bytes;
+  std::vector<uint64_t> rec_offsets;  // n+1
+  std::vector<int64_t> rec_ts;
+  std::vector<int64_t> rec_kafka_offsets;
+  int64_t next_offset = 0;
+  int64_t high_watermark = 0;
+
+  bool send_all(const uint8_t* d, size_t n) {
+    while (n) {
+      ssize_t w = ::send(fd, d, n, MSG_NOSIGNAL);
+      if (w <= 0) {
+        error = std::string("send: ") + strerror(errno);
+        return false;
+      }
+      d += w;
+      n -= (size_t)w;
+    }
+    return true;
+  }
+  bool recv_all(uint8_t* d, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd, d, n, 0);
+      if (r <= 0) {
+        error = std::string("recv: ") + strerror(errno);
+        return false;
+      }
+      d += r;
+      n -= (size_t)r;
+    }
+    return true;
+  }
+
+  // frame + send a request, receive full response body (after corr id)
+  bool rpc(int16_t api_key, int16_t api_version, const Writer& body,
+           std::vector<uint8_t>& resp) {
+    Writer req;
+    req.i16(api_key);
+    req.i16(api_version);
+    req.i32(++corr);
+    req.str("denormalized-tpu");
+    req.append(body.buf.data(), body.buf.size());
+    Writer framed;
+    framed.i32((int32_t)req.buf.size());
+    framed.append(req.buf.data(), req.buf.size());
+    if (!send_all(framed.buf.data(), framed.buf.size())) return false;
+    uint8_t szb[4];
+    if (!recv_all(szb, 4)) return false;
+    uint32_t sz = ntohl(*(uint32_t*)szb);
+    if (sz < 4 || sz > (1u << 28)) {
+      error = "bad response size";
+      return false;
+    }
+    resp.resize(sz);
+    if (!recv_all(resp.data(), sz)) return false;
+    // strip correlation id
+    resp.erase(resp.begin(), resp.begin() + 4);
+    return true;
+  }
+};
+
+// build a magic-2 RecordBatch from payloads
+void build_record_batch(Writer& out, const uint8_t* data,
+                        const uint64_t* offs, int n, int64_t now_ms) {
+  Writer records;
+  for (int i = 0; i < n; i++) {
+    const uint8_t* v = data + offs[i];
+    int64_t vlen = (int64_t)(offs[i + 1] - offs[i]);
+    Writer rec;
+    rec.i8(0);           // attributes
+    rec.varint(0);       // timestampDelta
+    rec.varint(i);       // offsetDelta
+    rec.varint(-1);      // key length (null)
+    rec.varint(vlen);    // value length
+    rec.append(v, (size_t)vlen);
+    rec.varint(0);       // headers
+    records.varint((int64_t)rec.buf.size());
+    records.append(rec.buf.data(), rec.buf.size());
+  }
+  // batch header
+  Writer hdr;  // part covered by CRC starts at attributes
+  hdr.i16(0);                    // attributes
+  hdr.i32(n - 1);                // lastOffsetDelta
+  hdr.i64(now_ms);               // firstTimestamp
+  hdr.i64(now_ms);               // maxTimestamp
+  hdr.i64(-1);                   // producerId
+  hdr.i16(-1);                   // producerEpoch
+  hdr.i32(-1);                   // baseSequence
+  hdr.i32(n);                    // numRecords
+  hdr.append(records.buf.data(), records.buf.size());
+  uint32_t crc = crc32c(hdr.buf.data(), hdr.buf.size());
+
+  Writer batch;
+  batch.i64(0);                              // baseOffset
+  batch.i32((int32_t)(hdr.buf.size() + 9));  // batchLength (from leaderEpoch)
+  batch.i32(-1);                             // partitionLeaderEpoch
+  batch.i8(2);                               // magic
+  batch.u32(crc);
+  batch.append(hdr.buf.data(), hdr.buf.size());
+  out.bytes(batch.buf);
+}
+
+// parse magic-2 record batches out of a Fetch "records" blob
+bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
+                       int64_t fetch_offset) {
+  const uint8_t* blob_end = r.p + total_len;
+  while (r.p + 61 <= blob_end) {  // minimal batch header size
+    int64_t base_offset = r.i64();
+    int32_t batch_len = r.i32();
+    if (r.fail || batch_len <= 0 || r.p + batch_len > blob_end) break;
+    const uint8_t* batch_end = r.p + batch_len;
+    r.i32();              // partitionLeaderEpoch
+    int8_t magic = r.i8();
+    if (magic != 2) {     // old formats unsupported; skip batch, but still
+      // advance past it so the consumer can't stall on this offset forever
+      if (base_offset >= fetch_offset && base_offset + 1 > c->next_offset)
+        c->next_offset = base_offset + 1;
+      r.p = batch_end;
+      continue;
+    }
+    r.u32();              // crc (trusted; transport is TCP)
+    int16_t attrs = r.i16();
+    if (attrs & 0x7) {    // compressed batch — unsupported, skip whole
+      // batch but advance the cursor past every record it covers
+      Reader peek = r;
+      int32_t lod = peek.i32();
+      int64_t past = base_offset + lod + 1;
+      if (past > c->next_offset && base_offset + lod >= fetch_offset)
+        c->next_offset = past;
+      r.p = batch_end;
+      continue;
+    }
+    int32_t last_offset_delta = r.i32();
+    int64_t first_ts = r.i64();
+    r.i64();              // maxTimestamp
+    r.skip(8 + 2 + 4);    // producerId/Epoch/baseSequence
+    int32_t nrec = r.i32();
+    for (int32_t i = 0; i < nrec && !r.fail; i++) {
+      int64_t rec_len = r.varint();
+      const uint8_t* rec_end = r.p + rec_len;
+      r.i8();  // attributes
+      int64_t ts_delta = r.varint();
+      int64_t off_delta = r.varint();
+      int64_t klen = r.varint();
+      if (klen > 0) r.skip((size_t)klen);
+      int64_t vlen = r.varint();
+      int64_t abs_off = base_offset + off_delta;
+      if (abs_off >= fetch_offset && vlen >= 0 && r.need((size_t)vlen)) {
+        c->rec_bytes.insert(c->rec_bytes.end(), r.p, r.p + vlen);
+        c->rec_offsets.push_back(c->rec_bytes.size());
+        c->rec_ts.push_back(first_ts + ts_delta);
+        c->rec_kafka_offsets.push_back(abs_off);
+      }
+      // the cursor advances past EVERY record ≥ fetch_offset — including
+      // tombstones (vlen == -1) and pre-filter duplicates — or the consumer
+      // would refetch the same batch forever
+      if (abs_off >= fetch_offset && abs_off + 1 > c->next_offset)
+        c->next_offset = abs_off + 1;
+      if (vlen > 0) r.skip((size_t)vlen);
+      // headers
+      int64_t nh = r.varint();
+      for (int64_t h = 0; h < nh && !r.fail; h++) {
+        int64_t kl = r.varint();
+        r.skip((size_t)kl);
+        int64_t vl = r.varint();
+        if (vl > 0) r.skip((size_t)vl);
+      }
+      if (r.p > rec_end) r.fail = true;
+      else r.p = rec_end;
+    }
+    // safety net for empty/odd batches: never stall behind a consumed batch
+    int64_t past = base_offset + last_offset_delta + 1;
+    if (past > c->next_offset && past > fetch_offset) c->next_offset = past;
+    r.p = batch_end;
+  }
+  r.p = blob_end;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kc_connect(const char* host, int port, char* errbuf, int errlen) {
+  addrinfo hints{};
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  int rc = getaddrinfo(host, portstr, &hints, &res);
+  if (rc != 0) {
+    snprintf(errbuf, errlen, "resolve %s: %s", host, gai_strerror(rc));
+    return nullptr;
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    snprintf(errbuf, errlen, "connect %s:%d failed", host, port);
+    return nullptr;
+  }
+  Client* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void kc_close(void* h) {
+  Client* c = static_cast<Client*>(h);
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+const char* kc_error(void* h) {
+  return static_cast<Client*>(h)->error.c_str();
+}
+
+// Metadata v1 → partition count for topic (-1 on error)
+int kc_partition_count(void* h, const char* topic) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.i32(1);  // one topic
+  body.str(topic);
+  std::vector<uint8_t> resp;
+  if (!c->rpc(3, 1, body, resp)) return -1;
+  Reader r{resp.data(), resp.data() + resp.size()};
+  int32_t nbrokers = r.i32();
+  for (int32_t i = 0; i < nbrokers; i++) {
+    r.i32();
+    r.str();
+    r.i32();
+    r.str();  // rack (nullable)
+  }
+  r.i32();  // controller id
+  int32_t ntopics = r.i32();
+  for (int32_t t = 0; t < ntopics; t++) {
+    int16_t terr = r.i16();
+    std::string name = r.str();
+    r.i8();  // is_internal
+    int32_t nparts = r.i32();
+    if (name == topic) {
+      if (terr != 0) {
+        c->error = "metadata error code " + std::to_string(terr);
+        return -1;
+      }
+      return nparts;
+    }
+    for (int32_t pi = 0; pi < nparts; pi++) {
+      r.i16();
+      r.i32();
+      r.i32();
+      int32_t nr = r.i32();
+      for (int32_t x = 0; x < nr; x++) r.i32();
+      int32_t ni = r.i32();
+      for (int32_t x = 0; x < ni; x++) r.i32();
+    }
+  }
+  c->error = "topic not in metadata";
+  return -1;
+}
+
+// ListOffsets v1: ts -1=latest, -2=earliest
+int64_t kc_list_offset(void* h, const char* topic, int partition, int64_t ts) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.i32(-1);  // replica
+  body.i32(1);   // topics
+  body.str(topic);
+  body.i32(1);  // partitions
+  body.i32(partition);
+  body.i64(ts);
+  std::vector<uint8_t> resp;
+  if (!c->rpc(2, 1, body, resp)) return -1;
+  Reader r{resp.data(), resp.data() + resp.size()};
+  int32_t ntopics = r.i32();
+  for (int32_t t = 0; t < ntopics; t++) {
+    r.str();
+    int32_t nparts = r.i32();
+    for (int32_t p = 0; p < nparts; p++) {
+      r.i32();  // partition
+      int16_t err = r.i16();
+      r.i64();  // timestamp
+      int64_t off = r.i64();
+      if (err != 0) {
+        c->error = "list_offsets error " + std::to_string(err);
+        return -1;
+      }
+      return off;
+    }
+  }
+  c->error = "empty list_offsets response";
+  return -1;
+}
+
+// Produce v3, acks=1
+int kc_produce(void* h, const char* topic, int partition, const uint8_t* data,
+               const uint64_t* offs, int n, int64_t now_ms) {
+  Client* c = static_cast<Client*>(h);
+  Writer body;
+  body.nullable_str();  // transactional_id
+  body.i16(1);          // acks
+  body.i32(10000);      // timeout
+  body.i32(1);          // topics
+  body.str(topic);
+  body.i32(1);  // partitions
+  body.i32(partition);
+  build_record_batch(body, data, offs, n, now_ms);
+  std::vector<uint8_t> resp;
+  if (!c->rpc(0, 3, body, resp)) return -1;
+  Reader r{resp.data(), resp.data() + resp.size()};
+  int32_t ntopics = r.i32();
+  for (int32_t t = 0; t < ntopics; t++) {
+    r.str();
+    int32_t nparts = r.i32();
+    for (int32_t p = 0; p < nparts; p++) {
+      r.i32();
+      int16_t err = r.i16();
+      r.i64();  // base offset
+      r.i64();  // log append time
+      if (err != 0) {
+        c->error = "produce error " + std::to_string(err);
+        return -1;
+      }
+    }
+  }
+  return 0;
+}
+
+// Fetch v4 from offset; returns record count, -1 error
+int kc_fetch(void* h, const char* topic, int partition, int64_t offset,
+             int max_bytes, int max_wait_ms) {
+  Client* c = static_cast<Client*>(h);
+  c->rec_bytes.clear();
+  c->rec_offsets.assign(1, 0);
+  c->rec_ts.clear();
+  c->rec_kafka_offsets.clear();
+  c->next_offset = offset;
+  Writer body;
+  body.i32(-1);           // replica
+  body.i32(max_wait_ms);  // max wait
+  body.i32(1);            // min bytes
+  body.i32(max_bytes);    // max bytes
+  body.i8(0);             // isolation: read_uncommitted
+  body.i32(1);            // topics
+  body.str(topic);
+  body.i32(1);  // partitions
+  body.i32(partition);
+  body.i64(offset);
+  body.i32(max_bytes);
+  std::vector<uint8_t> resp;
+  if (!c->rpc(1, 4, body, resp)) return -1;
+  Reader r{resp.data(), resp.data() + resp.size()};
+  r.i32();  // throttle
+  int32_t ntopics = r.i32();
+  for (int32_t t = 0; t < ntopics; t++) {
+    r.str();
+    int32_t nparts = r.i32();
+    for (int32_t p = 0; p < nparts; p++) {
+      r.i32();  // partition
+      int16_t err = r.i16();
+      c->high_watermark = r.i64();
+      r.i64();  // last stable offset
+      int32_t naborted = r.i32();
+      for (int32_t a = 0; a < naborted; a++) {
+        r.i64();
+        r.i64();
+      }
+      int32_t blob_len = r.i32();
+      if (err != 0) {
+        c->error = "fetch error " + std::to_string(err);
+        return -1;
+      }
+      if (blob_len > 0) parse_record_sets(c, r, blob_len, offset);
+    }
+  }
+  if (r.fail) {
+    c->error = "malformed fetch response";
+    return -1;
+  }
+  return (int)c->rec_ts.size();
+}
+
+const uint8_t* kc_rec_bytes(void* h, uint64_t* nbytes) {
+  Client* c = static_cast<Client*>(h);
+  *nbytes = c->rec_bytes.size();
+  return c->rec_bytes.data();
+}
+const uint64_t* kc_rec_offsets(void* h) {
+  return static_cast<Client*>(h)->rec_offsets.data();
+}
+const int64_t* kc_rec_timestamps(void* h) {
+  return static_cast<Client*>(h)->rec_ts.data();
+}
+int64_t kc_next_offset(void* h) {
+  return static_cast<Client*>(h)->next_offset;
+}
+int64_t kc_high_watermark(void* h) {
+  return static_cast<Client*>(h)->high_watermark;
+}
+
+}  // extern "C"

@@ -1,0 +1,337 @@
+"""Kafka source/sink connectors over the native wire client.
+
+Mirror of the reference's Kafka layer:
+- ``KafkaTopicBuilder`` (kafka_config.rs:103-339): builder for reader/writer
+  configs; schema from explicit schema, inferred from sample JSON, or from
+  an Avro declaration; queries the broker for the partition count.
+- ``KafkaStreamRead`` (kafka_stream_read.rs:87-298): one reader per
+  partition; fetch → decode → canonical-timestamp attach; offsets persisted
+  through the checkpoint layer and restored by seeking.
+- ``TopicWriter``/``KafkaSink`` (topic_writer.rs): per-row JSON encode →
+  produce.
+
+Transport is :mod:`denormalized_tpu.native.kafka_client` (C++), the
+librdkafka-equivalent.  JSON payload decode goes through the native one-pass
+columnar parser when the schema is flat.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+
+import numpy as np
+
+from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.formats import StreamEncoding, make_decoder
+from denormalized_tpu.formats.json_codec import (
+    JsonRowEncoder,
+    infer_schema_from_json,
+)
+from denormalized_tpu.native.build import load
+from denormalized_tpu.physical.simple_execs import Sink
+from denormalized_tpu.sources.base import (
+    PartitionReader,
+    Source,
+    canonicalize_schema,
+)
+
+
+def _lib():
+    lib = load("kafka_client")
+    if not getattr(lib, "_kc_configured", False):
+        lib.kc_connect.restype = ctypes.c_void_p
+        lib.kc_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.kc_close.argtypes = [ctypes.c_void_p]
+        lib.kc_error.restype = ctypes.c_char_p
+        lib.kc_error.argtypes = [ctypes.c_void_p]
+        lib.kc_partition_count.restype = ctypes.c_int
+        lib.kc_partition_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kc_list_offset.restype = ctypes.c_int64
+        lib.kc_list_offset.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int64,
+        ]
+        lib.kc_produce.restype = ctypes.c_int
+        lib.kc_produce.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int, ctypes.c_int64,
+        ]
+        lib.kc_fetch.restype = ctypes.c_int
+        lib.kc_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kc_rec_bytes.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.kc_rec_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.kc_rec_offsets.restype = ctypes.POINTER(ctypes.c_uint64)
+        lib.kc_rec_offsets.argtypes = [ctypes.c_void_p]
+        lib.kc_rec_timestamps.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.kc_rec_timestamps.argtypes = [ctypes.c_void_p]
+        lib.kc_next_offset.restype = ctypes.c_int64
+        lib.kc_next_offset.argtypes = [ctypes.c_void_p]
+        lib.kc_high_watermark.restype = ctypes.c_int64
+        lib.kc_high_watermark.argtypes = [ctypes.c_void_p]
+        lib._kc_configured = True
+    return lib
+
+
+class KafkaClient:
+    """Thin ctypes handle over the native client (one TCP connection)."""
+
+    def __init__(self, bootstrap_servers: str):
+        host, _, port = bootstrap_servers.partition(":")
+        self._libref = _lib()
+        err = ctypes.create_string_buffer(256)
+        self._h = self._libref.kc_connect(
+            host.encode(), int(port or 9092), err, 256
+        )
+        if not self._h:
+            raise SourceError(f"kafka connect failed: {err.value.decode()}")
+
+    def close(self):
+        if self._h:
+            self._libref.kc_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _err(self) -> str:
+        return self._libref.kc_error(self._h).decode()
+
+    def partition_count(self, topic: str) -> int:
+        n = self._libref.kc_partition_count(self._h, topic.encode())
+        if n < 0:
+            raise SourceError(f"metadata for {topic!r}: {self._err()}")
+        return n
+
+    def list_offset(self, topic: str, partition: int, ts: int) -> int:
+        off = self._libref.kc_list_offset(
+            self._h, topic.encode(), partition, ts
+        )
+        if off < 0:
+            raise SourceError(f"list_offsets: {self._err()}")
+        return off
+
+    def produce(self, topic: str, partition: int, payloads: list[bytes]):
+        if not payloads:
+            return
+        data = b"".join(payloads)
+        offs = np.zeros(len(payloads) + 1, dtype=np.uint64)
+        offs[1:] = np.cumsum([len(p) for p in payloads], dtype=np.uint64)
+        rc = self._libref.kc_produce(
+            self._h,
+            topic.encode(),
+            partition,
+            data,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(payloads),
+            int(time.time() * 1000),
+        )
+        if rc != 0:
+            raise SourceError(f"produce: {self._err()}")
+
+    def fetch(
+        self, topic: str, partition: int, offset: int,
+        max_bytes: int = 4 << 20, max_wait_ms: int = 100,
+    ) -> tuple[list[bytes], np.ndarray, int]:
+        """→ (payloads, timestamps_ms, next_offset)."""
+        lib = self._libref
+        n = lib.kc_fetch(
+            self._h, topic.encode(), partition, offset, max_bytes, max_wait_ms
+        )
+        if n < 0:
+            raise SourceError(f"fetch: {self._err()}")
+        if n == 0:
+            return [], np.empty(0, dtype=np.int64), offset
+        nb = ctypes.c_uint64()
+        bptr = lib.kc_rec_bytes(self._h, ctypes.byref(nb))
+        raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
+        offs = np.ctypeslib.as_array(lib.kc_rec_offsets(self._h), shape=(n + 1,))
+        ts = np.ctypeslib.as_array(
+            lib.kc_rec_timestamps(self._h), shape=(n,)
+        ).copy()
+        payloads = [bytes(raw[offs[i] : offs[i + 1]]) for i in range(n)]
+        return payloads, ts, int(lib.kc_next_offset(self._h))
+
+
+# -- builder (KafkaTopicBuilder, kafka_config.rs:103-339) ----------------
+
+
+class KafkaTopicBuilder:
+    def __init__(self, bootstrap_servers: str):
+        self.bootstrap_servers = bootstrap_servers
+        self.topic: str | None = None
+        self.encoding = StreamEncoding.JSON
+        self.group_id = "denormalized-tpu"
+        self.timestamp_column: str | None = None
+        self.user_schema: Schema | None = None
+        self.avro_schema = None
+        self.opts: dict[str, str] = {}
+
+    def with_topic(self, topic: str) -> "KafkaTopicBuilder":
+        self.topic = topic
+        return self
+
+    def with_encoding(self, encoding: str) -> "KafkaTopicBuilder":
+        self.encoding = StreamEncoding.from_str(encoding)
+        return self
+
+    def with_group_id(self, group_id: str) -> "KafkaTopicBuilder":
+        self.group_id = group_id
+        return self
+
+    def with_timestamp_column(self, col: str) -> "KafkaTopicBuilder":
+        self.timestamp_column = col
+        return self
+
+    def with_schema(self, schema: Schema) -> "KafkaTopicBuilder":
+        self.user_schema = schema
+        return self
+
+    def infer_schema_from_json(self, sample: str) -> "KafkaTopicBuilder":
+        self.user_schema = infer_schema_from_json(sample)
+        return self
+
+    def with_avro_schema(self, decl) -> "KafkaTopicBuilder":
+        from denormalized_tpu.formats.avro_codec import parse_avro_schema
+
+        self.avro_schema = parse_avro_schema(decl)
+        self.encoding = StreamEncoding.AVRO
+        self.user_schema = self.avro_schema.to_engine_schema()
+        return self
+
+    def with_option(self, key: str, value: str) -> "KafkaTopicBuilder":
+        self.opts[key] = value
+        return self
+
+    def build_reader(self) -> "KafkaSource":
+        if not self.topic or self.user_schema is None:
+            raise SourceError("build_reader needs topic and schema")
+        return KafkaSource(self)
+
+    def build_writer(self) -> "KafkaSinkWriter":
+        if not self.topic:
+            raise SourceError("build_writer needs a topic")
+        return KafkaSinkWriter(self.bootstrap_servers, self.topic)
+
+
+class KafkaPartitionReader(PartitionReader):
+    """Per-partition fetch loop (KafkaStreamRead, kafka_stream_read.rs:87)."""
+
+    def __init__(self, src: "KafkaSource", partition: int):
+        self._src = src
+        self._client = KafkaClient(src.builder.bootstrap_servers)
+        self._topic = src.builder.topic
+        self._partition = partition
+        auto_offset = src.builder.opts.get("auto.offset.reset", "earliest")
+        ts = -2 if auto_offset == "earliest" else -1
+        self._offset = self._client.list_offset(self._topic, partition, ts)
+        self._decoder = make_decoder(
+            src.builder.encoding, src.user_schema, src.builder.avro_schema
+        )
+        self._ts_col = src.builder.timestamp_column
+
+    def read(self, timeout_s: float | None = None):
+        payloads, kafka_ts, next_off = self._client.fetch(
+            self._topic,
+            self._partition,
+            self._offset,
+            max_wait_ms=int((timeout_s or 0.1) * 1000),
+        )
+        if not payloads:
+            # live source: no data within the wait — empty batch, stay open
+            self._offset = next_off  # may advance past skipped batches
+            return RecordBatch.empty(self._src.schema)
+        self._offset = next_off
+        # drop zero-length payloads together with their timestamps so rows
+        # and the kafka-timestamp column stay aligned
+        if any(len(p) == 0 for p in payloads):
+            keep = [i for i, p in enumerate(payloads) if len(p)]
+            kafka_ts = kafka_ts[keep]
+            payloads = [payloads[i] for i in keep]
+            if not payloads:
+                return RecordBatch.empty(self._src.schema)
+        for p in payloads:
+            self._decoder.push(p)
+        batch = self._decoder.flush()
+        # canonical timestamp: payload column or the broker record timestamp
+        # (kafka_stream_read.rs:222-266)
+        if self._ts_col is not None:
+            ts = np.asarray(batch.column(self._ts_col), dtype=np.int64)
+        else:
+            ts = kafka_ts
+        return batch.with_column(
+            Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+            ts,
+        )
+
+    def offset_snapshot(self) -> dict:
+        return {"partition": self._partition, "offset": int(self._offset)}
+
+    def offset_restore(self, snap: dict) -> None:
+        self._offset = int(snap.get("offset", self._offset))
+
+
+class KafkaSource(Source):
+    def __init__(self, builder: KafkaTopicBuilder):
+        self.builder = builder
+        self.name = builder.topic
+        self.user_schema = builder.user_schema
+        self._schema = canonicalize_schema(builder.user_schema)
+        client = KafkaClient(builder.bootstrap_servers)
+        try:
+            self._npartitions = client.partition_count(builder.topic)
+        finally:
+            client.close()
+        if self._npartitions <= 0:
+            raise SourceError(f"topic {builder.topic!r} has no partitions")
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def partitions(self) -> list[PartitionReader]:
+        return [
+            KafkaPartitionReader(self, p) for p in range(self._npartitions)
+        ]
+
+    @property
+    def unbounded(self) -> bool:
+        return True
+
+
+class KafkaSinkWriter(Sink):
+    """JSON row producer (KafkaSink::write_all, topic_writer.rs:102-127),
+    round-robin over partitions."""
+
+    def __init__(self, bootstrap_servers: str, topic: str):
+        self._client = KafkaClient(bootstrap_servers)
+        self._topic = topic
+        self._encoder = JsonRowEncoder()
+        try:
+            self._npartitions = max(self._client.partition_count(topic), 1)
+        except SourceError:
+            self._npartitions = 1
+        self._rr = 0
+
+    def write(self, batch: RecordBatch) -> None:
+        payloads = self._encoder.encode(batch)
+        if not payloads:
+            return
+        self._client.produce(self._topic, self._rr, payloads)
+        self._rr = (self._rr + 1) % self._npartitions
+
+    def close(self) -> None:
+        self._client.close()

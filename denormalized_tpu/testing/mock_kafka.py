@@ -1,0 +1,395 @@
+"""In-process mock Kafka broker.
+
+The reference's de-facto integration test is running examples against a
+Kafka docker image (SURVEY.md §4) — no broker, no test.  This embedded
+broker speaks the exact wire subset the native client uses (Metadata v1,
+ListOffsets v1, Produce v3, Fetch v4, magic-2 record batches) over a real
+TCP socket, so Kafka sources/sinks get true end-to-end coverage (framing,
+CRC32C batches, offset semantics) hermetically.
+
+Also usable outside tests as a lightweight local topic bus.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+
+def _zz_enc(n: int) -> bytes:
+    z = ((n << 1) ^ (n >> 63)) & ((1 << 70) - 1)
+    out = bytearray()
+    while z >= 0x80:
+        out.append((z & 0x7F) | 0x80)
+        z >>= 7
+    out.append(z)
+    return bytes(out)
+
+
+def _zz_dec(buf: memoryview, pos: int) -> tuple[int, int]:
+    acc = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+_CRC32C_TABLE = []
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE
+    if not _CRC32C_TABLE:
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            t.append(c)
+        _CRC32C_TABLE = t
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def build_record_batch(
+    base_offset: int, records: list[tuple[int, bytes]]
+) -> bytes:
+    """magic-2 batch from [(timestamp_ms, payload)]."""
+    first_ts = records[0][0] if records else 0
+    recs = bytearray()
+    for i, (ts, payload) in enumerate(records):
+        rec = bytearray()
+        rec += b"\x00"  # attributes
+        rec += _zz_enc(ts - first_ts)
+        rec += _zz_enc(i)
+        rec += _zz_enc(-1)  # null key
+        rec += _zz_enc(len(payload))
+        rec += payload
+        rec += _zz_enc(0)  # headers
+        recs += _zz_enc(len(rec))
+        recs += rec
+    max_ts = max((ts for ts, _ in records), default=0)
+    body = bytearray()
+    body += struct.pack(
+        ">hiqqqhii", 0, len(records) - 1, first_ts, max_ts, -1, -1, -1,
+        len(records),
+    )
+    body += recs
+    crc = _crc32c(bytes(body))
+    out = bytearray()
+    out += struct.pack(">qiib", base_offset, len(body) + 9, -1, 2)
+    out += struct.pack(">I", crc)
+    out += body
+    return bytes(out)
+
+
+def parse_record_batches(blob: bytes) -> list[tuple[int, bytes]]:
+    """magic-2 batches → [(timestamp_ms, payload)]."""
+    out = []
+    mv = memoryview(blob)
+    pos = 0
+    while pos + 61 <= len(blob):
+        base_offset, batch_len, _leader_epoch, magic = struct.unpack_from(
+            ">qiib", mv, pos
+        )
+        batch_end = pos + 12 + batch_len
+        p = pos + 21  # past crc
+        if magic != 2:
+            pos = batch_end
+            continue
+        (_attrs, _lod, first_ts, _max_ts, _pid, _pep, _bseq, nrec) = (
+            struct.unpack_from(">hiqqqhii", mv, p)
+        )
+        p += 40
+        for _ in range(nrec):
+            rec_len, p = _zz_dec(mv, p)
+            rec_end = p + rec_len
+            p += 1  # attributes
+            ts_delta, p = _zz_dec(mv, p)
+            _off_delta, p = _zz_dec(mv, p)
+            klen, p = _zz_dec(mv, p)
+            if klen > 0:
+                p += klen
+            vlen, p = _zz_dec(mv, p)
+            payload = bytes(mv[p : p + vlen]) if vlen > 0 else b""
+            out.append((first_ts + ts_delta, payload))
+            p = rec_end
+        pos = batch_end
+    return out
+
+
+class MockKafkaBroker:
+    """TCP server; topics are created on first produce or via create_topic."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        # (topic, partition) -> list[(offset, ts, payload)]
+        self._logs: dict[tuple[str, int], list] = {}
+        self._npartitions: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.requests_served = 0
+
+    @property
+    def bootstrap(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def create_topic(self, name: str, partitions: int = 1) -> None:
+        with self._lock:
+            self._npartitions[name] = partitions
+            for p in range(partitions):
+                self._logs.setdefault((name, p), [])
+
+    def produce(self, topic: str, partition: int, payloads, ts_ms=None):
+        """Direct (no-wire) produce, handy for tests."""
+        ts = ts_ms if ts_ms is not None else int(time.time() * 1000)
+        with self._lock:
+            self._npartitions.setdefault(topic, max(partition + 1, 1))
+            log = self._logs.setdefault((topic, partition), [])
+            for p in payloads:
+                log.append((len(log), ts, p))
+
+    def log(self, topic: str, partition: int = 0):
+        with self._lock:
+            return list(self._logs.get((topic, partition), []))
+
+    # -- server loop -----------------------------------------------------
+    def start(self) -> "MockKafkaBroker":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                hdr = self._recv_all(conn, 4)
+                if hdr is None:
+                    return
+                (size,) = struct.unpack(">i", hdr)
+                body = self._recv_all(conn, size)
+                if body is None:
+                    return
+                resp = self._handle(body)
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+                self.requests_served += 1
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_all(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # -- request dispatch ------------------------------------------------
+    def _handle(self, body: bytes) -> bytes:
+        api_key, api_version, corr = struct.unpack_from(">hhi", body, 0)
+        pos = 8
+        (client_len,) = struct.unpack_from(">h", body, pos)
+        pos += 2 + max(client_len, 0)
+        payload = body[pos:]
+        out = struct.pack(">i", corr)
+        if api_key == 3:
+            out += self._metadata(payload, api_version)
+        elif api_key == 2:
+            out += self._list_offsets(payload)
+        elif api_key == 0:
+            out += self._produce(payload)
+        elif api_key == 1:
+            out += self._fetch(payload)
+        else:
+            out += struct.pack(">h", 35)  # UNSUPPORTED_VERSION
+        return out
+
+    def _metadata(self, payload: bytes, version: int) -> bytes:
+        (ntopics,) = struct.unpack_from(">i", payload, 0)
+        pos = 4
+        names = []
+        for _ in range(max(ntopics, 0)):
+            (ln,) = struct.unpack_from(">h", payload, pos)
+            pos += 2
+            names.append(payload[pos : pos + ln].decode())
+            pos += ln
+        with self._lock:
+            if ntopics <= 0:
+                names = list(self._npartitions)
+            out = bytearray()
+            # brokers
+            out += struct.pack(">i", 1)
+            out += struct.pack(">i", 0)  # node id
+            host = self.host.encode()
+            out += struct.pack(">h", len(host)) + host
+            out += struct.pack(">i", self.port)
+            out += struct.pack(">h", -1)  # rack null
+            out += struct.pack(">i", 0)  # controller
+            out += struct.pack(">i", len(names))
+            for name in names:
+                nparts = self._npartitions.get(name)
+                err = 0 if nparts else 3  # UNKNOWN_TOPIC_OR_PARTITION
+                out += struct.pack(">h", err)
+                nb = name.encode()
+                out += struct.pack(">h", len(nb)) + nb
+                out += struct.pack(">b", 0)  # is_internal
+                out += struct.pack(">i", nparts or 0)
+                for p in range(nparts or 0):
+                    out += struct.pack(">hiii", 0, p, 0, 1)  # err,idx,leader,nreplicas
+                    out += struct.pack(">i", 0)  # replica 0
+                    out += struct.pack(">i", 1)  # isr count
+                    out += struct.pack(">i", 0)
+            return bytes(out)
+
+    def _list_offsets(self, payload: bytes) -> bytes:
+        pos = 4  # skip replica id
+        (ntopics,) = struct.unpack_from(">i", payload, pos)
+        pos += 4
+        out = bytearray()
+        out += struct.pack(">i", ntopics)
+        for _ in range(ntopics):
+            (ln,) = struct.unpack_from(">h", payload, pos)
+            pos += 2
+            name = payload[pos : pos + ln].decode()
+            pos += ln
+            (nparts,) = struct.unpack_from(">i", payload, pos)
+            pos += 4
+            nb = name.encode()
+            out += struct.pack(">h", len(nb)) + nb
+            out += struct.pack(">i", nparts)
+            for _ in range(nparts):
+                part, ts = struct.unpack_from(">iq", payload, pos)
+                pos += 12
+                with self._lock:
+                    log = self._logs.get((name, part), [])
+                    if ts == -2:  # earliest
+                        off = log[0][0] if log else 0
+                    else:  # latest
+                        off = (log[-1][0] + 1) if log else 0
+                out += struct.pack(">ihqq", part, 0, ts, off)
+        return bytes(out)
+
+    def _produce(self, payload: bytes) -> bytes:
+        pos = 0
+        (tid_len,) = struct.unpack_from(">h", payload, pos)
+        pos += 2 + max(tid_len, 0)
+        pos += 2 + 4  # acks + timeout
+        (ntopics,) = struct.unpack_from(">i", payload, pos)
+        pos += 4
+        out = bytearray()
+        out += struct.pack(">i", ntopics)
+        for _ in range(ntopics):
+            (ln,) = struct.unpack_from(">h", payload, pos)
+            pos += 2
+            name = payload[pos : pos + ln].decode()
+            pos += ln
+            (nparts,) = struct.unpack_from(">i", payload, pos)
+            pos += 4
+            nb = name.encode()
+            out += struct.pack(">h", len(nb)) + nb
+            out += struct.pack(">i", nparts)
+            for _ in range(nparts):
+                (part, blob_len) = struct.unpack_from(">ii", payload, pos)
+                pos += 8
+                blob = payload[pos : pos + blob_len]
+                pos += blob_len
+                records = parse_record_batches(blob)
+                with self._lock:
+                    self._npartitions.setdefault(name, part + 1)
+                    self._npartitions[name] = max(
+                        self._npartitions[name], part + 1
+                    )
+                    log = self._logs.setdefault((name, part), [])
+                    base = log[-1][0] + 1 if log else 0
+                    for i, (ts, pl) in enumerate(records):
+                        log.append((base + i, ts, pl))
+                out += struct.pack(">ihqq", part, 0, base, -1)
+        out += struct.pack(">i", 0)  # throttle
+        return bytes(out)
+
+    def _fetch(self, payload: bytes) -> bytes:
+        pos = 4 + 4 + 4 + 4 + 1  # replica, max_wait, min_bytes, max_bytes, isolation
+        max_wait = struct.unpack_from(">i", payload, 4)[0]
+        (ntopics,) = struct.unpack_from(">i", payload, pos)
+        pos += 4
+        reqs = []
+        for _ in range(ntopics):
+            (ln,) = struct.unpack_from(">h", payload, pos)
+            pos += 2
+            name = payload[pos : pos + ln].decode()
+            pos += ln
+            (nparts,) = struct.unpack_from(">i", payload, pos)
+            pos += 4
+            parts = []
+            for _ in range(nparts):
+                part, off, _maxb = struct.unpack_from(">iqi", payload, pos)
+                pos += 16
+                parts.append((part, off))
+            reqs.append((name, parts))
+
+        # honor max_wait when no data is available
+        deadline = time.time() + max_wait / 1000.0
+        while time.time() < deadline:
+            with self._lock:
+                have_data = any(
+                    any(r[0] >= off for r in self._logs.get((name, part), []))
+                    for name, parts in reqs
+                    for part, off in parts
+                )
+            if have_data:
+                break
+            time.sleep(0.01)
+
+        out = bytearray()
+        out += struct.pack(">i", 0)  # throttle
+        out += struct.pack(">i", len(reqs))
+        for name, parts in reqs:
+            nb = name.encode()
+            out += struct.pack(">h", len(nb)) + nb
+            out += struct.pack(">i", len(parts))
+            for part, off in parts:
+                with self._lock:
+                    log = list(self._logs.get((name, part), []))
+                hw = (log[-1][0] + 1) if log else 0
+                pending = [(ts, pl) for (o, ts, pl) in log if o >= off]
+                blob = (
+                    build_record_batch(off, pending[:5000]) if pending else b""
+                )
+                out += struct.pack(">ihqq", part, 0, hw, hw)
+                out += struct.pack(">i", 0)  # aborted txns: empty array
+                out += struct.pack(">i", len(blob))
+                out += blob
+        return bytes(out)

@@ -1,0 +1,229 @@
+"""Format-layer tests, mirroring the reference's decoder test strategy
+(SURVEY.md §4: synthetic bytes for JSON incl. invalid-JSON error cases,
+real Avro bytes written then decoded, sink encoding roundtrip)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.errors import FormatError
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.formats import StreamEncoding, make_decoder
+from denormalized_tpu.formats.avro_codec import (
+    AvroDecoder,
+    encode_record,
+    parse_avro_schema,
+)
+from denormalized_tpu.formats.json_codec import (
+    JsonDecoder,
+    JsonRowEncoder,
+    infer_schema_from_json,
+)
+
+FLAT = Schema(
+    [
+        Field("occurred_at_ms", DataType.INT64, nullable=False),
+        Field("sensor_name", DataType.STRING, nullable=False),
+        Field("reading", DataType.FLOAT64),
+        Field("flag", DataType.BOOL),
+    ]
+)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_json_decoder_roundtrip(use_native):
+    dec = JsonDecoder(FLAT, use_native=use_native)
+    if use_native:
+        assert dec._native is not None, "native parser failed to build"
+    rows = [
+        b'{"occurred_at_ms": 123, "sensor_name": "a", "reading": 1.5, "flag": true}',
+        b'{"occurred_at_ms": 124, "sensor_name": "b\\u00e9ta", "reading": null, "flag": false}',
+        b'{"sensor_name": "c", "occurred_at_ms": 125, "reading": -2e3, "flag": true, "extra": {"x": 1}}',
+    ]
+    for r in rows:
+        dec.push(r)
+    batch = dec.flush()
+    assert batch.num_rows == 3
+    assert batch.column("occurred_at_ms").tolist() == [123, 124, 125]
+    assert batch.column("sensor_name").tolist() == ["a", "béta", "c"]
+    np.testing.assert_allclose(batch.column("reading")[[0, 2]], [1.5, -2000.0])
+    m = batch.mask("reading")
+    assert m is not None and m.tolist() == [True, False, True]
+    assert batch.column("flag").tolist() == [True, False, True]
+    # second flush is empty
+    assert dec.flush().num_rows == 0
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_json_decoder_invalid(use_native):
+    dec = JsonDecoder(FLAT, use_native=use_native)
+    dec.push(b'{"occurred_at_ms": not-json}')
+    with pytest.raises(FormatError):
+        dec.flush()
+
+
+def test_json_native_matches_python():
+    rows = [
+        json.dumps(
+            {
+                "occurred_at_ms": i,
+                "sensor_name": f"s{i % 7}",
+                "reading": i * 0.5 if i % 3 else None,
+                "flag": bool(i % 2),
+            }
+        ).encode()
+        for i in range(200)
+    ]
+    a = JsonDecoder(FLAT, use_native=True)
+    b = JsonDecoder(FLAT, use_native=False)
+    for r in rows:
+        a.push(r)
+        b.push(r)
+    ba, bb = a.flush(), b.flush()
+    for name in FLAT.names:
+        if ba.column(name).dtype == object:
+            assert ba.column(name).tolist() == bb.column(name).tolist()
+        else:
+            np.testing.assert_array_equal(ba.column(name), bb.column(name))
+        ma, mb = ba.mask(name), bb.mask(name)
+        assert (ma is None) == (mb is None)
+        if ma is not None:
+            np.testing.assert_array_equal(ma, mb)
+
+
+def test_schema_inference_nested():
+    """Nested JSON inference (the rideshare sample shape,
+    utils/arrow_helpers.rs:283)."""
+    sample = json.dumps(
+        {
+            "driver_id": "abc",
+            "occurred_at_ms": 1,
+            "imu_measurement": {
+                "timestamp_ms": 2,
+                "gps": {"latitude": 1.1, "longitude": 2.2, "speed": 3.3},
+            },
+            "tags": ["a", "b"],
+        }
+    )
+    schema = infer_schema_from_json(sample)
+    assert schema.field("driver_id").dtype is DataType.STRING
+    assert schema.field("occurred_at_ms").dtype is DataType.INT64
+    imu = schema.field("imu_measurement")
+    assert imu.dtype is DataType.STRUCT
+    gps = [c for c in imu.children if c.name == "gps"][0]
+    assert gps.dtype is DataType.STRUCT
+    assert {c.name for c in gps.children} == {"latitude", "longitude", "speed"}
+    assert schema.field("tags").dtype is DataType.LIST
+
+
+def test_json_row_encoder():
+    from denormalized_tpu.common.record_batch import RecordBatch
+
+    batch = RecordBatch(
+        FLAT,
+        [
+            np.array([1, 2], dtype=np.int64),
+            np.array(["x", "y"], dtype=object),
+            np.array([0.5, 0.0]),
+            np.array([True, False]),
+        ],
+        masks=[None, None, np.array([True, False]), None],
+    )
+    payloads = JsonRowEncoder().encode(batch)
+    assert json.loads(payloads[0]) == {
+        "occurred_at_ms": 1,
+        "sensor_name": "x",
+        "reading": 0.5,
+        "flag": True,
+    }
+    assert json.loads(payloads[1])["reading"] is None
+
+
+AVRO_DECL = {
+    "type": "record",
+    "name": "Measurement",
+    "fields": [
+        {"name": "occurred_at_ms", "type": {"type": "long", "logicalType": "timestamp-millis"}},
+        {"name": "sensor_name", "type": "string"},
+        {"name": "reading", "type": ["null", "double"]},
+        {"name": "count", "type": "int"},
+        {"name": "ok", "type": "boolean"},
+    ],
+}
+
+
+def test_avro_roundtrip():
+    schema = parse_avro_schema(AVRO_DECL)
+    engine = schema.to_engine_schema()
+    assert engine.field("occurred_at_ms").dtype is DataType.TIMESTAMP_MS
+    assert engine.field("reading").dtype is DataType.FLOAT64
+    records = [
+        {"occurred_at_ms": 1000, "sensor_name": "a", "reading": 1.25, "count": -3, "ok": True},
+        {"occurred_at_ms": 2000, "sensor_name": "日本語", "reading": None, "count": 7, "ok": False},
+    ]
+    dec = AvroDecoder(None, schema)
+    for r in records:
+        dec.push(encode_record(schema, r))
+    batch = dec.flush()
+    assert batch.num_rows == 2
+    assert batch.column("occurred_at_ms").tolist() == [1000, 2000]
+    assert batch.column("sensor_name").tolist() == ["a", "日本語"]
+    assert batch.column("count").tolist() == [-3, 7]
+    assert batch.column("ok").tolist() == [True, False]
+    m = batch.mask("reading")
+    assert m is not None and m.tolist() == [True, False]
+
+
+def test_avro_zigzag_extremes():
+    from denormalized_tpu.formats.avro_codec import _zigzag_decode, _zigzag_encode
+    import io
+
+    for v in (0, 1, -1, 63, -64, 2**40, -(2**40), 2**62, -(2**62)):
+        assert _zigzag_decode(io.BytesIO(_zigzag_encode(v))) == v
+
+
+def test_stream_encoding_parse():
+    assert StreamEncoding.from_str("JSON") is StreamEncoding.JSON
+    assert StreamEncoding.from_str("avro") is StreamEncoding.AVRO
+    with pytest.raises(FormatError):
+        StreamEncoding.from_str("protobuf")
+
+
+def test_native_surrogate_pairs_and_duplicates():
+    """Review regressions: \\u-escaped emoji (surrogate pairs) must decode,
+    and duplicate keys must be last-wins in both decode paths."""
+    schema = Schema([Field("s", DataType.STRING), Field("a", DataType.INT64)])
+    rows = [
+        json.dumps({"s": "hi \U0001F600 there", "a": 1}).encode(),  # 😀
+        b'{"s": "x", "a": 1, "a": 2}',
+    ]
+    for use_native in (True, False):
+        dec = JsonDecoder(schema, use_native=use_native)
+        if use_native:
+            assert dec._native is not None
+        for r in rows:
+            dec.push(r)
+        b = dec.flush()
+        assert b.column("s")[0] == "hi \U0001F600 there", use_native
+        assert int(b.column("a")[1]) == 2, use_native
+
+
+def test_json_non_object_payload():
+    dec = JsonDecoder(FLAT, use_native=False)
+    dec.push(b"[1, 2, 3]")
+    with pytest.raises(FormatError, match="expected a JSON object"):
+        dec.flush()
+
+
+def test_avro_truncated_raises_format_error():
+    from denormalized_tpu.formats.avro_codec import decode_record
+
+    schema = parse_avro_schema(AVRO_DECL)
+    full = encode_record(
+        schema,
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0, "count": 1, "ok": True},
+    )
+    for cut in (1, len(full) // 2, len(full) - 1):
+        with pytest.raises(FormatError):
+            decode_record(schema, full[:cut])
