@@ -324,9 +324,12 @@ class StreamingWindowExec(ExecOperator):
                 yield b
 
     def _emit_window(self, j: int) -> RecordBatch | None:
+        from denormalized_tpu.runtime.tracing import span
+
         slot = j % self._spec.window_slots
-        rows = self._backend.read_slot(slot)
-        self._backend.reset_slot(slot)
+        with span("window.emit", op=self.name, window=j * self.slide_ms):
+            rows = self._backend.read_slot(slot)
+            self._backend.reset_slot(slot)
         counts = rows[sa.ROW_COUNT.label]
         ngroups = len(self._interner) if self._grouped else 1
         active = counts > 0
@@ -410,9 +413,14 @@ class StreamingWindowExec(ExecOperator):
 
     # -- stream loop -----------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.runtime.tracing import span
+
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
-                yield from self._process_batch(item)
+                with span(
+                    "window.process_batch", op=self.name, rows=item.num_rows
+                ):
+                    yield from self._process_batch(item)
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)

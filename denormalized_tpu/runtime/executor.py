@@ -94,6 +94,9 @@ def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
         restore()
         if orch is not None:
             orch.stop()
+        from denormalized_tpu.runtime.tracing import log_metrics
+
+        log_metrics(root)
 
 
 def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:

@@ -1,0 +1,76 @@
+"""Lightweight tracing/metrics.
+
+Observability mirror of the reference: `tracing`/`tracing-subscriber` span
+events wired in the rideshare example (kafka_rideshare.rs:16-22) and the
+per-operator DataFusion `BaselineMetrics` exposed through
+``ExecutionPlan::metrics`` (streaming_window.rs:211,491).  Here:
+
+- every physical operator already keeps a metrics dict (rows_in,
+  device_steps, late_rows, ...) exposed via ``ExecOperator.metrics()``;
+- :func:`collect_metrics` aggregates them over a plan tree;
+- :func:`enable_tracing` turns on span logging: :class:`span` context
+  managers emit enter/close events with wall-time, like tracing-subscriber's
+  span events.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("denormalized_tpu")
+
+_TRACING = False
+
+
+def enable_tracing(level: int = logging.INFO) -> None:
+    global _TRACING
+    _TRACING = True
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+    logger.setLevel(level)
+
+
+def tracing_enabled() -> bool:
+    return _TRACING
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Span with enter/close events (tracing-subscriber
+    `with_span_events(ENTER|CLOSE)` analog)."""
+    if not _TRACING:
+        yield
+        return
+    t0 = time.perf_counter()
+    logger.info("enter %s %s", name, fields or "")
+    try:
+        yield
+    finally:
+        logger.info(
+            "close %s time.busy=%.3fms", name, (time.perf_counter() - t0) * 1e3
+        )
+
+
+def collect_metrics(root) -> dict[str, dict]:
+    """Per-operator metrics over a physical plan tree, keyed by the same
+    DFS ids used for checkpoint node ids."""
+    from denormalized_tpu.state.checkpoint import assign_node_ids, walk
+
+    ids = assign_node_ids(root)
+    out = {}
+    for op in walk(root):
+        m = op.metrics()
+        if m:
+            out[ids[id(op)]] = m
+    return out
+
+
+def log_metrics(root) -> None:
+    if _TRACING:
+        for node, m in collect_metrics(root).items():
+            logger.info("metrics %s %s", node, m)

@@ -1,0 +1,59 @@
+"""Bounded CSV → windowed aggregation → stdout — mirror of the reference's
+csv_streaming example (bounded-mode sanity check)."""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import random
+import tempfile
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.sources.csv import CsvSource
+
+
+def make_sample_csv(path: str, rows: int = 10_000):
+    t0 = 1_700_000_000_000
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["occurred_at_ms", "sensor_name", "reading"])
+        for i in range(rows):
+            w.writerow(
+                [t0 + i, f"sensor_{random.randrange(5)}", f"{random.gauss(50, 10):.4f}"]
+            )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args()
+    path = args.csv
+    cleanup = None
+    if path is None:
+        fd, path = tempfile.mkstemp(suffix=".csv")
+        import os
+
+        os.close(fd)
+        cleanup = path
+        make_sample_csv(path)
+
+    ctx = Context()
+    try:
+        ds = ctx.from_source(
+            CsvSource(path, timestamp_column="occurred_at_ms")
+        ).window(
+            [col("sensor_name")],
+            [F.count(col("reading")).alias("count"), F.avg(col("reading")).alias("avg")],
+            1000,
+        )
+        ds.print_stream()
+    finally:
+        if cleanup:
+            import os
+
+            os.unlink(cleanup)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Data generator — mirror of the reference's emit_measurements
+(examples/examples/emit_measurements.rs:17-84): concurrent producers emit
+JSON events {occurred_at_ms, sensor_name (10 keys), reading} to the
+`temperature` and `humidity` topics.
+
+Run standalone against any broker:
+    python examples/emit_measurements.py --bootstrap-servers localhost:9092
+or import `start_embedded()` to get a mock broker with generators attached.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+
+from denormalized_tpu.sources.kafka import KafkaClient
+
+SENSORS = [f"sensor_{i}" for i in range(10)]
+
+
+def producer_loop(bootstrap: str, topics: list[str], rate_hz: float, stop):
+    client = KafkaClient(bootstrap)
+    part = 0
+    while not stop.is_set():
+        now = int(time.time() * 1000)
+        payloads = [
+            json.dumps(
+                {
+                    "occurred_at_ms": now,
+                    "sensor_name": random.choice(SENSORS),
+                    "reading": random.gauss(50, 10),
+                }
+            ).encode()
+            for _ in range(max(1, int(rate_hz / 100)))
+        ]
+        for t in topics:
+            client.produce(t, part, payloads)
+        time.sleep(0.01)
+
+
+def start_embedded(rate_hz: float = 20000):
+    """Mock broker + generator threads; returns (broker, stop_event)."""
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    broker.create_topic("temperature", 1)
+    broker.create_topic("humidity", 1)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=producer_loop,
+        args=(broker.bootstrap, ["temperature", "humidity"], rate_hz, stop),
+        daemon=True,
+    )
+    t.start()
+    return broker, stop
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    ap.add_argument("--rate", type=float, default=20000)
+    args = ap.parse_args()
+    if args.bootstrap_servers:
+        stop = threading.Event()
+        producer_loop(
+            args.bootstrap_servers, ["temperature", "humidity"], args.rate, stop
+        )
+    else:
+        broker, stop = start_embedded(args.rate)
+        print(f"embedded broker on {broker.bootstrap}; Ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            stop.set()

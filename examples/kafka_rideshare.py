@@ -1,0 +1,94 @@
+"""Rideshare pipeline — mirror of the reference's kafka_rideshare example
+(examples/examples/kafka_rideshare.rs:14-85): nested JSON events, struct
+field accessors (col("imu_measurement").field("gps").field("speed")),
+5s window / 1s slide, sink to an output topic, tracing enabled."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.runtime.tracing import enable_tracing
+
+SAMPLE_EVENT = {
+    "driver_id": "driver-0",
+    "occurred_at_ms": 1,
+    "imu_measurement": {
+        "timestamp_ms": 1,
+        "accelerometer": {"x": 0.0, "y": 0.0, "z": 0.0},
+        "gyroscope": {"x": 0.0, "y": 0.0, "z": 0.0},
+        "gps": {"latitude": 0.0, "longitude": 0.0, "altitude": 0.0, "speed": 0.0},
+    },
+    "meta": {"nonsense": "MORE NONSENSE"},
+}
+
+
+def feed(bootstrap: str, stop):
+    from denormalized_tpu.sources.kafka import KafkaClient
+
+    client = KafkaClient(bootstrap)
+    drivers = [f"driver-{i}" for i in range(8)]
+    while not stop.is_set():
+        now = int(time.time() * 1000)
+        payloads = []
+        for _ in range(50):
+            ev = json.loads(json.dumps(SAMPLE_EVENT))
+            ev["driver_id"] = random.choice(drivers)
+            ev["occurred_at_ms"] = now
+            ev["imu_measurement"]["timestamp_ms"] = now
+            ev["imu_measurement"]["gps"]["speed"] = random.uniform(0, 35)
+            payloads.append(json.dumps(ev).encode())
+        client.produce("driver-imu-data", 0, payloads)
+        time.sleep(0.05)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    args = ap.parse_args()
+    enable_tracing()
+
+    bootstrap = args.bootstrap_servers
+    if bootstrap is None:
+        from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+        broker = MockKafkaBroker().start()
+        broker.create_topic("driver-imu-data", 1)
+        broker.create_topic("aggregated-driver-data", 1)
+        stop = threading.Event()
+        threading.Thread(
+            target=feed, args=(broker.bootstrap, stop), daemon=True
+        ).start()
+        bootstrap = broker.bootstrap
+
+    ctx = Context()
+    ds = (
+        ctx.from_topic(
+            "driver-imu-data",
+            sample_json=json.dumps(SAMPLE_EVENT),
+            bootstrap_servers=bootstrap,
+            timestamp_column="occurred_at_ms",
+        )
+        .with_column("speed", col("imu_measurement").field("gps").field("speed"))
+        .window(
+            [col("driver_id")],
+            [
+                F.count(col("speed")).alias("measurements"),
+                F.avg(col("speed")).alias("avg_speed"),
+                F.max(col("speed")).alias("max_speed"),
+            ],
+            5000,
+            1000,
+        )
+        .filter(col("avg_speed") > 5.0)
+    )
+    ds.sink_kafka(bootstrap, "aggregated-driver-data")
+
+
+if __name__ == "__main__":
+    main()

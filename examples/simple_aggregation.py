@@ -1,0 +1,58 @@
+"""5s-tumbling count/min/max/avg over sensor_name — mirror of the
+reference's simple_aggregation example
+(examples/examples/simple_aggregation.rs:15-60), including the checkpoint
+toggle (`--checkpoint path`)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+
+SAMPLE = json.dumps(
+    {"occurred_at_ms": 100, "sensor_name": "foo", "reading": 0.0}
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    ap.add_argument("--checkpoint", default=None, help="state backend path")
+    args = ap.parse_args()
+
+    bootstrap = args.bootstrap_servers
+    if bootstrap is None:
+        from examples.emit_measurements import start_embedded
+
+        broker, _stop = start_embedded()
+        bootstrap = broker.bootstrap
+
+    config = EngineConfig()
+    if args.checkpoint:
+        config.checkpoint = True
+        config.state_backend_path = args.checkpoint
+
+    ctx = Context(config)
+    ds = ctx.from_topic(
+        "temperature",
+        sample_json=SAMPLE,
+        bootstrap_servers=bootstrap,
+        timestamp_column="occurred_at_ms",
+    ).window(
+        [col("sensor_name")],
+        [
+            F.count(col("reading")).alias("count"),
+            F.min(col("reading")).alias("min"),
+            F.max(col("reading")).alias("max"),
+            F.avg(col("reading")).alias("average"),
+        ],
+        5000,
+    )
+    ds.print_stream()
+
+
+if __name__ == "__main__":
+    main()

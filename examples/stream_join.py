@@ -1,0 +1,64 @@
+"""Windowed stream-stream join — mirror of the reference's stream_join
+(examples/examples/stream_join.rs:15-85): temperature and humidity topics,
+1s-windowed averages, renamed columns, inner join on (sensor, window)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+
+SAMPLE = json.dumps({"occurred_at_ms": 100, "sensor_name": "foo", "reading": 0.0})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    args = ap.parse_args()
+    bootstrap = args.bootstrap_servers
+    if bootstrap is None:
+        from examples.emit_measurements import start_embedded
+
+        broker, _stop = start_embedded()
+        bootstrap = broker.bootstrap
+
+    ctx = Context()
+    temperature = ctx.from_topic(
+        "temperature",
+        sample_json=SAMPLE,
+        bootstrap_servers=bootstrap,
+        timestamp_column="occurred_at_ms",
+    ).window(
+        [col("sensor_name")],
+        [F.avg(col("reading")).alias("average_temperature")],
+        1000,
+    )
+    humidity = (
+        ctx.from_topic(
+            "humidity",
+            sample_json=SAMPLE,
+            bootstrap_servers=bootstrap,
+            timestamp_column="occurred_at_ms",
+        )
+        .window(
+            [col("sensor_name")],
+            [F.avg(col("reading")).alias("average_humidity")],
+            1000,
+        )
+        .with_column_renamed("sensor_name", "humidity_sensor")
+        .with_column_renamed("window_start_time", "humidity_window_start_time")
+        .with_column_renamed("window_end_time", "humidity_window_end_time")
+    )
+    joined = temperature.join(
+        humidity,
+        "inner",
+        ["sensor_name", "window_start_time"],
+        ["humidity_sensor", "humidity_window_start_time"],
+    )
+    joined.print_stream()
+
+
+if __name__ == "__main__":
+    main()

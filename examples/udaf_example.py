@@ -1,0 +1,75 @@
+"""Stateful Python UDAF inside a window — mirror of the reference's
+python/examples/udaf_example.py (a custom Accumulator with mergeable
+state)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.udaf import Accumulator
+from denormalized_tpu.common.schema import DataType
+
+SAMPLE = json.dumps({"occurred_at_ms": 100, "sensor_name": "foo", "reading": 0.0})
+
+
+class ReadingSpread(Accumulator):
+    """Tracks max-min spread of readings per (sensor, window)."""
+
+    def __init__(self):
+        self.lo = float("inf")
+        self.hi = float("-inf")
+
+    def update(self, values: np.ndarray):
+        if len(values):
+            self.lo = min(self.lo, float(values.min()))
+            self.hi = max(self.hi, float(values.max()))
+
+    def merge(self, states):
+        self.lo = min(self.lo, states[0])
+        self.hi = max(self.hi, states[1])
+
+    def state(self):
+        return [self.lo, self.hi]
+
+    def evaluate(self):
+        return self.hi - self.lo if self.hi >= self.lo else 0.0
+
+
+spread = F.udaf(ReadingSpread, DataType.FLOAT64, "reading_spread")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    args = ap.parse_args()
+    bootstrap = args.bootstrap_servers
+    if bootstrap is None:
+        from examples.emit_measurements import start_embedded
+
+        broker, _stop = start_embedded()
+        bootstrap = broker.bootstrap
+
+    ctx = Context()
+    ds = ctx.from_topic(
+        "temperature",
+        sample_json=SAMPLE,
+        bootstrap_servers=bootstrap,
+        timestamp_column="occurred_at_ms",
+    ).window(
+        [col("sensor_name")],
+        [
+            spread(col("reading")).alias("spread"),
+            F.count(col("reading")).alias("count"),
+        ],
+        1000,
+    )
+    ds.print_stream()
+
+
+if __name__ == "__main__":
+    main()

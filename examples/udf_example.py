@@ -1,0 +1,59 @@
+"""Scalar UDF after a window + filter + plan printing — mirror of the
+reference's udf_example (examples/examples/udf_example.rs:22-129)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.schema import DataType
+
+SAMPLE = json.dumps({"occurred_at_ms": 100, "sensor_name": "foo", "reading": 0.0})
+
+# vectorized scalar UDF (the reference's sample_udf adds 1.0)
+sample_udf = F.udf(
+    lambda x: np.asarray(x) + 1.0, DataType.FLOAT64, "sample_udf"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bootstrap-servers", default=None)
+    args = ap.parse_args()
+    bootstrap = args.bootstrap_servers
+    if bootstrap is None:
+        from examples.emit_measurements import start_embedded
+
+        broker, _stop = start_embedded()
+        bootstrap = broker.bootstrap
+
+    ctx = Context()
+    ds = (
+        ctx.from_topic(
+            "temperature",
+            sample_json=SAMPLE,
+            bootstrap_servers=bootstrap,
+            timestamp_column="occurred_at_ms",
+        )
+        .window(
+            [col("sensor_name")],
+            [
+                F.count(col("reading")).alias("count"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            1000,
+        )
+        .with_column("max_plus_one", sample_udf(col("max")))
+        .filter(col("max_plus_one") > 50.0)
+        .print_physical_plan()
+    )
+    ds.print_stream()
+
+
+if __name__ == "__main__":
+    main()

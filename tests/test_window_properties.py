@@ -1,0 +1,142 @@
+"""Property tests for window assignment + watermark semantics — the
+equivalence testing SURVEY.md §7 step 9 calls for (the reference's
+get_windows_for_watermark/snap_to_window_start logic had no tests at all).
+
+The oracle mirrors the engine's documented semantics exactly:
+- window j covers [j*S, j*S + L) in epoch ms (tumbling: S = L);
+- watermark = monotonic max of per-batch min timestamp, advanced AFTER the
+  batch is aggregated;
+- a window emits when its end ≤ watermark; rows for already-emitted windows
+  are dropped (late data), judged against first_open BEFORE the batch;
+- at end-of-stream every remaining open window flushes.
+"""
+
+import collections
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.sources.memory import MemorySource
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+
+T0 = 1_700_000_000_000
+
+
+def oracle(batches, L, S):
+    wm = None
+    first_open = None
+    agg = collections.defaultdict(lambda: [0, 0.0])  # (j, key) -> [cnt, sum]
+    emitted = {}
+    max_win = -(10**9)
+
+    def windows_of(t):
+        j_hi = t // S
+        out = []
+        j = j_hi
+        while j * S + L > t:
+            if j * S <= t:
+                out.append(j)
+            j -= 1
+        return out
+
+    for ts, ks, vs in batches:
+        if first_open is None:
+            first_open = min(t // S for t in ts) - (-(-L // S)) + 1
+        for t, k, v in zip(ts, ks, vs):
+            for j in windows_of(t):
+                if j >= first_open:
+                    a = agg[(j, k)]
+                    a[0] += 1
+                    a[1] += v
+        bmin = min(ts)
+        if wm is None or bmin > wm:
+            wm = bmin
+        while first_open * S + L <= wm:
+            for (j, k), a in list(agg.items()):
+                if j == first_open:
+                    emitted[(j * S, k)] = tuple(a)
+                    del agg[(j, k)]
+            first_open += 1
+        max_win = max(max_win, max(t // S for t in ts))
+    for (j, k), a in agg.items():
+        emitted[(j * S, k)] = tuple(a)
+    return emitted
+
+
+@st.composite
+def stream_case(draw):
+    L = draw(st.sampled_from([100, 250, 400, 1000]))
+    S = draw(st.sampled_from([None, 50, 100, 300]))
+    if S is not None and S > L:
+        S = L
+    n_batches = draw(st.integers(2, 6))
+    batches = []
+    base = 0
+    for _ in range(n_batches):
+        n = draw(st.integers(1, 25))
+        base += draw(st.integers(0, 500))
+        offs = draw(
+            st.lists(st.integers(-300, 600), min_size=n, max_size=n)
+        )
+        ts = sorted(max(0, base + o) + T0 for o in offs)
+        ks = draw(
+            st.lists(st.sampled_from(["a", "b", "c"]), min_size=n, max_size=n)
+        )
+        vs = [float(i % 7) for i in range(n)]
+        batches.append((ts, ks, vs))
+    return L, S, batches
+
+
+@settings(max_examples=40, deadline=None)
+@given(stream_case())
+def test_engine_matches_oracle(case):
+    L, S, raw = case
+    batches = [
+        RecordBatch(
+            SCHEMA,
+            [
+                np.asarray(ts, np.int64),
+                np.asarray(ks, object),
+                np.asarray(vs),
+            ],
+        )
+        for ts, ks, vs in raw
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .window(
+            ["k"],
+            [F.count(col("v")).alias("cnt"), F.sum(col("v")).alias("s")],
+            L,
+            S,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        got[(int(res.column(WINDOW_START_COLUMN)[i]), res.column("k")[i])] = (
+            int(res.column("cnt")[i]),
+            float(res.column("s")[i]),
+        )
+    want = oracle(raw, L, S or L)
+    assert set(got) == set(want), (
+        sorted(set(got) ^ set(want))[:5],
+        L,
+        S,
+    )
+    for key in want:
+        assert got[key][0] == want[key][0], (key, got[key], want[key])
+        np.testing.assert_allclose(got[key][1], want[key][1], rtol=1e-5, atol=1e-5)
