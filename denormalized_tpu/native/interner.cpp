@@ -1,0 +1,147 @@
+// interner — fixed-width-bytes string interning: values → dense int32 ids.
+//
+// Native hot path for group-key interning (the GroupValues-equivalent; see
+// ops/interner.py).  Python converts an object column to a fixed-width
+// numpy 'S' array (vectorized, ~10M rows/s) and hands the raw buffer here;
+// we hash each width-w slot into an open-addressing table that persists
+// across batches, so steady-state interning is one hash+memcmp per row with
+// no Python object traffic at all.
+//
+// The table stores (offset into an append-only arena, id).  C ABI for
+// ctypes.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Interner {
+  std::vector<uint8_t> arena;     // concatenated fixed-width keys (by id)
+  std::vector<uint32_t> arena_w;  // width of each id's key
+  // open addressing table of (id+1), 0 = empty
+  std::vector<uint32_t> table;
+  uint64_t mask = 0;
+  uint64_t count = 0;
+
+  void grow() {
+    size_t ncap = table.empty() ? 1024 : table.size() * 2;
+    std::vector<uint32_t> nt(ncap, 0);
+    uint64_t nmask = ncap - 1;
+    // rehash existing ids
+    uint64_t off = 0;
+    for (uint64_t id = 0; id < count; id++) {
+      uint32_t w = arena_w[id];
+      uint64_t h = hash(arena.data() + off, w);
+      uint64_t slot = h & nmask;
+      while (nt[slot]) slot = (slot + 1) & nmask;
+      nt[slot] = (uint32_t)(id + 1);
+      off += w;
+    }
+    table.swap(nt);
+    mask = nmask;
+  }
+
+  static uint64_t hash(const uint8_t* p, uint32_t w) {
+    // 8-byte-chunk multiply-mix (keys are fixed-width UTF-32 slots, often
+    // 40+ bytes — per-byte FNV costs one multiply per byte; this costs one
+    // per 8 bytes)
+    uint64_t h = 1469598103934665603ull ^ w;
+    while (w >= 8) {
+      uint64_t k;
+      memcpy(&k, p, 8);
+      h = (h ^ k) * 0x9E3779B97F4A7C15ull;
+      h ^= h >> 29;
+      p += 8;
+      w -= 8;
+    }
+    if (w) {
+      uint64_t k = 0;
+      memcpy(&k, p, w);
+      h = (h ^ k) * 0x9E3779B97F4A7C15ull;
+      h ^= h >> 29;
+    }
+    return h;
+  }
+
+};
+
+}  // namespace
+
+extern "C" {
+
+struct CInterner {
+  Interner in;
+  std::vector<uint64_t> offsets;  // arena offset per id
+};
+
+void* intern_create() {
+  CInterner* c = new CInterner();
+  c->in.grow();
+  return c;
+}
+
+void intern_destroy(void* h) { delete static_cast<CInterner*>(h); }
+
+uint64_t intern_count(void* h) { return static_cast<CInterner*>(h)->in.count; }
+
+// Intern n fixed-width keys (width w, buffer n*w bytes) → out_ids[n].
+// Trailing bytes of shorter strings must be zero-padded (numpy 'S' does
+// this).  Keys of DIFFERENT widths across calls are distinct unless their
+// padded bytes match after width normalization — callers keep one interner
+// per column and always pass the column's current max width; previously
+// seen keys are re-looked-up by re-padding, so the arena stores the
+// ORIGINAL width and comparison strips trailing zeros.
+void intern_many(void* h, const uint8_t* data, uint64_t n, uint32_t w,
+                 int32_t* out_ids) {
+  CInterner* c = static_cast<CInterner*>(h);
+  Interner& in = c->in;
+  for (uint64_t i = 0; i < n; i++) {
+    const uint8_t* key = data + i * w;
+    // effective length: strip zero padding so width changes don't split keys
+    uint32_t len = w;
+    while (len > 0 && key[len - 1] == 0) len--;
+    uint64_t hv = Interner::hash(key, len);
+    uint64_t slot = hv & in.mask;
+    for (;;) {
+      uint32_t e = in.table[slot];
+      if (!e) {
+        // new key
+        if ((in.count + 1) * 4 >= in.table.size() * 3) {
+          in.grow();
+          slot = hv & in.mask;
+          while (in.table[slot]) slot = (slot + 1) & in.mask;
+        }
+        uint64_t off = in.arena.size();
+        in.arena.insert(in.arena.end(), key, key + len);
+        in.arena_w.push_back(len);
+        c->offsets.push_back(off);
+        in.table[slot] = (uint32_t)(in.count + 1);
+        out_ids[i] = (int32_t)in.count;
+        in.count++;
+        break;
+      }
+      uint64_t id = e - 1;
+      uint32_t klen = in.arena_w[id];
+      if (klen == len &&
+          memcmp(in.arena.data() + c->offsets[id], key, len) == 0) {
+        out_ids[i] = (int32_t)id;
+        break;
+      }
+      slot = (slot + 1) & in.mask;
+    }
+  }
+}
+
+// copy key bytes for one id (for reverse lookup); returns length
+uint32_t intern_key(void* h, uint64_t id, uint8_t* out, uint32_t cap) {
+  CInterner* c = static_cast<CInterner*>(h);
+  if (id >= c->in.count) return 0;
+  uint32_t w = c->in.arena_w[id];
+  uint32_t n = w < cap ? w : cap;
+  memcpy(out, c->in.arena.data() + c->offsets[id], n);
+  return w;
+}
+
+}  // extern "C"

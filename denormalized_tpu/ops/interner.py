@@ -17,26 +17,101 @@ from __future__ import annotations
 import numpy as np
 
 
+def _load_native():
+    try:
+        import ctypes
+
+        from denormalized_tpu.native.build import load
+
+        lib = load("interner")
+        if not getattr(lib, "_in_configured", False):
+            lib.intern_create.restype = ctypes.c_void_p
+            lib.intern_destroy.argtypes = [ctypes.c_void_p]
+            lib.intern_count.restype = ctypes.c_uint64
+            lib.intern_count.argtypes = [ctypes.c_void_p]
+            lib.intern_many.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.intern_key.restype = ctypes.c_uint32
+            lib.intern_key.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+            ]
+            lib._in_configured = True
+        return lib
+    except Exception:
+        return None
+
+
 class ColumnInterner:
-    """value -> id for one column (any hashable host values)."""
+    """value -> id for one column.
+
+    String columns take the native path: the object column is converted to a
+    fixed-width numpy ``S`` array (one vectorized pass) and the raw buffer is
+    hashed by the C++ open-addressing interner — no per-object Python work at
+    steady state.  Numeric columns and environments without a compiler use
+    the np.unique+dict fallback.
+    """
 
     def __init__(self) -> None:
         self._to_id: dict = {}
         self._values: list = []
+        self._lib = _load_native()
+        self._h = self._lib.intern_create() if self._lib else None
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib:
+            self._lib.intern_destroy(self._h)
+            self._h = None
 
     def __len__(self) -> int:
+        # string columns on the native path keep no Python values; numeric
+        # and fallback columns live in the dict
+        if self._h and not self._values:
+            return int(self._lib.intern_count(self._h))
         return len(self._values)
 
     def intern_array(self, arr: np.ndarray) -> np.ndarray:
-        if arr.dtype.kind in "ifb" or arr.dtype.kind == "M":
+        """Key normalization note: fixed-width numpy string storage cannot
+        represent trailing NUL characters, so keys differing only in
+        trailing ``'\\x00'`` intern to one id — consistently in BOTH the
+        native and fallback paths."""
+        import ctypes
+
+        if arr.dtype.kind in "ifbM":
             # numeric key column: unique per batch, dict on uniques only
             uniq, inv = np.unique(arr, return_inverse=True)
+            uniq = uniq.tolist()
+        elif self._h is not None:
+            # hand the fixed-width UTF-32LE ('U') buffer straight to the
+            # native hash — one vectorized astype, zero per-object encode.
+            # Trailing zero-byte stripping in C++ keeps ids injective for
+            # any key not ending in U+0000 (LE minimal forms are unique).
+            u = np.ascontiguousarray(arr.astype(np.str_))
+            w = u.dtype.itemsize or 1  # 4 bytes per char slot
+            n = len(u)
+            ids = np.empty(n, dtype=np.int32)
+            self._lib.intern_many(
+                self._h,
+                u.ctypes.data_as(ctypes.c_char_p),
+                n,
+                w,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            return ids
         else:
-            uniq, inv = np.unique(arr.astype(object), return_inverse=True)
+            uniq, inv = np.unique(arr.astype(np.str_), return_inverse=True)
+            uniq = list(uniq.tolist())
         ids = np.empty(len(uniq), dtype=np.int32)
         to_id = self._to_id
         values = self._values
-        for i, v in enumerate(uniq.tolist()):
+        for i, v in enumerate(uniq):
             j = to_id.get(v)
             if j is None:
                 j = len(values)
@@ -45,11 +120,49 @@ class ColumnInterner:
             ids[i] = j
         return ids[inv]
 
+    def _native_value(self, j: int):
+        import ctypes
+
+        buf = ctypes.create_string_buffer(1024)
+        n = self._lib.intern_key(self._h, j, buf, 1024)
+        if n > 1024:
+            buf = ctypes.create_string_buffer(n)
+            self._lib.intern_key(self._h, j, buf, n)
+        raw = buf.raw[:n]
+        # keys are stored as zero-stripped UTF-32LE; re-pad to 4-byte units
+        raw += b"\x00" * (-len(raw) % 4)
+        return raw.decode("utf-32-le", errors="replace")
+
     def value_of(self, ids: np.ndarray) -> np.ndarray:
         out = np.empty(len(ids), dtype=object)
+        if self._h is not None and not self._values:
+            for i, j in enumerate(ids.tolist()):
+                out[i] = self._native_value(j)
+            return out
         for i, j in enumerate(ids.tolist()):
             out[i] = self._values[j]
         return out
+
+    # -- snapshot/restore support ---------------------------------------
+    def all_values(self) -> list:
+        if self._h is not None and not self._values:
+            return [self._native_value(j) for j in range(len(self))]
+        return list(self._values)
+
+    def load_values(self, vals: list) -> None:
+        """Re-seed with an ordered value list (ids must match positions)."""
+        if (
+            self._h is not None
+            and vals
+            and all(isinstance(v, str) for v in vals)
+        ):
+            # string column → native table re-seed
+            ids = self.intern_array(np.array(vals, dtype=object))
+            assert ids.tolist() == list(range(len(vals))), "restore order"
+        else:
+            # numeric (or no-native) columns live in the dict
+            self._values = list(vals)
+            self._to_id = {v: i for i, v in enumerate(self._values)}
 
 
 class GroupInterner:
@@ -75,14 +188,29 @@ class GroupInterner:
             it.intern_array(c) for it, c in zip(self._col_interners, key_columns)
         ]
         if self.num_columns == 1:
-            # single-column fast path: column id IS the group id candidate,
-            # but keep the tuple table for a uniform reverse map
-            stacked = per_col[0][:, None]
+            # single-column fast path: the column interner assigns dense ids
+            # in first-seen order, which is exactly the group-id order —
+            # no row-dedup needed at all
+            cids = per_col[0]
+            n_known = len(self._gid_rows)
+            n_now = len(self._col_interners[0])
+            if n_now > n_known:
+                self._gid_rows.extend((i,) for i in range(n_known, n_now))
+            return cids
+        if self.num_columns == 2:
+            # pack both int32 ids into one int64 → 1-D unique (much faster
+            # than np.unique(axis=0)'s void-view row sort)
+            packed = (per_col[0].astype(np.int64) << 32) | per_col[1].astype(
+                np.int64
+            )
+            uniq, inv = np.unique(packed, return_inverse=True)
+            rows = [(int(p >> 32), int(p & 0xFFFFFFFF)) for p in uniq.tolist()]
         else:
             stacked = np.stack(per_col, axis=1)
-        uniq_rows, inv = np.unique(stacked, axis=0, return_inverse=True)
-        gids_for_uniq = np.empty(len(uniq_rows), dtype=np.int32)
-        for i, row in enumerate(map(tuple, uniq_rows.tolist())):
+            uniq_rows, inv = np.unique(stacked, axis=0, return_inverse=True)
+            rows = list(map(tuple, uniq_rows.tolist()))
+        gids_for_uniq = np.empty(len(rows), dtype=np.int32)
+        for i, row in enumerate(rows):
             g = self._tuple_to_gid.get(row)
             if g is None:
                 g = len(self._gid_rows)
@@ -104,7 +232,7 @@ class GroupInterner:
     # -- checkpoint support ---------------------------------------------
     def snapshot(self) -> dict:
         return {
-            "columns": [it._values for it in self._col_interners],
+            "columns": [it.all_values() for it in self._col_interners],
             "rows": self._gid_rows,
         }
 
@@ -112,8 +240,7 @@ class GroupInterner:
     def restore(cls, snap: dict) -> "GroupInterner":
         g = cls(len(snap["columns"]))
         for it, vals in zip(g._col_interners, snap["columns"]):
-            it._values = list(vals)
-            it._to_id = {v: i for i, v in enumerate(it._values)}
+            it.load_values(list(vals))
         g._gid_rows = [tuple(r) for r in snap["rows"]]
         g._tuple_to_gid = {r: i for i, r in enumerate(g._gid_rows)}
         return g
