@@ -1,15 +1,17 @@
-"""Benchmark: emit_measurements 1s-tumbling windowed aggregation.
+"""Benchmarks for the BASELINE.md workload configs.
 
-Workload parity with the reference's de-facto benchmark (BASELINE.md): the
-``emit_measurements`` stream shape — JSON events ``{occurred_at_ms,
-sensor_name, reading}`` over 10 sensor keys (reference
-examples/examples/emit_measurements.rs:26-67) — aggregated with a 1s tumbling
-``count/min/max/avg`` by ``sensor_name`` (the driver-defined target config;
+Default config (what the driver records): the emit_measurements shape —
+JSON events ``{occurred_at_ms, sensor_name, reading}`` over 10 sensor keys
+(reference examples/examples/emit_measurements.rs:26-67) through a 1s
+tumbling ``count/min/max/avg`` by ``sensor_name`` (the driver-defined target;
 the reference publishes no numbers of its own).
 
+Other configs (BENCH_CONFIG env): sliding | highcard | join | checkpoint —
+the remaining BASELINE.md configs 2-5.
+
 Prints ONE JSON line:
-    {"metric": ..., "value": rows/s through our engine (TPU path),
-     "unit": "rows/s", "vs_baseline": value / cpu_baseline_rows_per_sec}
+    {"metric": ..., "value": engine rows/s, "unit": "rows/s",
+     "vs_baseline": value / cpu_baseline, "p99_window_emit_gap_ms": ...}
 
 The CPU baseline is measured in-process: a tight vectorized-numpy columnar
 implementation of the same windowed aggregation (stand-in for CPU DataFusion,
@@ -23,11 +25,12 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
 
-
+CONFIG = os.environ.get("BENCH_CONFIG", "simple")
 TOTAL_ROWS = int(os.environ.get("BENCH_ROWS", 8_000_000))
 BATCH_ROWS = int(os.environ.get("BENCH_BATCH", 131_072))
 NUM_KEYS = int(os.environ.get("BENCH_KEYS", 10))
@@ -39,12 +42,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def gen_batches():
-    """Pre-generate the host-side decoded batches (decode cost is measured
-    separately by the formats benchmarks; this measures the engine)."""
+def gen_batches(num_keys=None, key_prefix="sensor_"):
+    """Pre-generated decoded batches (decode cost is benchmarked separately
+    by the formats tests; this measures the engine)."""
     from denormalized_tpu.common.record_batch import RecordBatch
     from denormalized_tpu.common.schema import DataType, Field, Schema
 
+    num_keys = num_keys or NUM_KEYS
     schema = Schema(
         [
             Field("occurred_at_ms", DataType.INT64, nullable=False),
@@ -54,27 +58,58 @@ def gen_batches():
     )
     rng = np.random.default_rng(0)
     t0 = 1_700_000_000_000
-    keys = np.array([f"sensor_{i}" for i in range(NUM_KEYS)], dtype=object)
+    keys = np.array([f"{key_prefix}{i}" for i in range(num_keys)], dtype=object)
     batches = []
     n_batches = TOTAL_ROWS // BATCH_ROWS
-    ms_per_batch = int(BATCH_ROWS / EVENTS_PER_SEC * 1000)
+    ms_per_batch = max(1, int(BATCH_ROWS / EVENTS_PER_SEC * 1000))
     for b in range(n_batches):
         base = t0 + b * ms_per_batch
         ts = np.sort(base + rng.integers(0, ms_per_batch, BATCH_ROWS))
-        names = keys[rng.integers(0, NUM_KEYS, BATCH_ROWS)]
+        names = keys[rng.integers(0, num_keys, BATCH_ROWS)]
         vals = rng.normal(50.0, 10.0, BATCH_ROWS)
         batches.append(RecordBatch(schema, [ts, names, vals]))
     return schema, batches
 
 
-def run_engine(batches, label) -> tuple[float, dict]:
-    from denormalized_tpu import Context, col
-    from denormalized_tpu.api import functions as F
+def _drive(ds, rows: int) -> tuple[float, float, dict]:
+    """Run a stream to completion; returns (rows/s, p99 emit gap ms, info)."""
+    gaps = []
+    t0 = time.perf_counter()
+    last = t0
+    out_rows = 0
+    for batch in ds.stream():
+        now = time.perf_counter()
+        gaps.append(now - last)
+        last = now
+        out_rows += batch.num_rows
+    dt = time.perf_counter() - t0
+    p99 = float(np.percentile(gaps, 99) * 1000) if gaps else float("nan")
+    return rows / dt, p99, {"windows_rows": out_rows, "wall_s": round(dt, 3)}
+
+
+def _engine_ctx(**over):
+    from denormalized_tpu import Context
     from denormalized_tpu.api.context import EngineConfig
+
+    cfg = EngineConfig(min_batch_bucket=BATCH_ROWS, min_window_slots=32, **over)
+    return Context(cfg)
+
+
+def _F():
+    from denormalized_tpu import col
+    from denormalized_tpu.api import functions as F
+
+    return col, F
+
+
+# -- configs -------------------------------------------------------------
+
+
+def run_simple(batches, label="simple", ctx=None):
+    col, F = _F()
     from denormalized_tpu.sources.memory import MemorySource
 
-    cfg = EngineConfig(min_batch_bucket=BATCH_ROWS, min_window_slots=32)
-    ctx = Context(cfg)
+    ctx = ctx or _engine_ctx()
     ds = ctx.from_source(
         MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
         name=f"bench_{label}",
@@ -88,92 +123,251 @@ def run_engine(batches, label) -> tuple[float, dict]:
         ],
         WINDOW_MS,
     )
-    rows = sum(b.num_rows for b in batches)
-    t0 = time.perf_counter()
-    out_rows = 0
-    for batch in ds.stream():
-        out_rows += batch.num_rows
-    dt = time.perf_counter() - t0
-    metrics = {}
-    return rows / dt, {"windows_rows": out_rows, "wall_s": dt}
+    return _drive(ds, sum(b.num_rows for b in batches))
 
 
-def run_cpu_baseline(batches) -> float:
-    """Vectorized-numpy columnar engine for the identical aggregation."""
-    G = 1024
-    W = 64
-    counts = np.zeros((W, G), np.int64)
-    sums = np.zeros((W, G))
-    mins = np.full((W, G), np.inf)
-    maxs = np.full((W, G), -np.inf)
-    interner: dict = {}
-    emitted = 0
-    watermark = None
-    first_open = None
+def run_sliding(batches, label="sliding"):
+    col, F = _F()
+    from denormalized_tpu.sources.memory import MemorySource
 
-    rows = sum(b.num_rows for b in batches)
-    t0 = time.perf_counter()
-    for b in batches:
-        ts = b.columns[0]
-        names = b.columns[1]
-        vals = b.columns[2]
-        win = ts // WINDOW_MS
-        if first_open is None:
-            first_open = int(win.min())
+    ds = (
+        _engine_ctx()
+        .from_source(
+            MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+            name=f"bench_{label}",
+        )
+        .window(
+            ["sensor_name"],
+            [F.count(col("reading")).alias("cnt"), F.avg(col("reading")).alias("avg")],
+            1000,
+            200,
+        )
+        .filter(col("avg") > 45.0)
+    )
+    return _drive(ds, sum(b.num_rows for b in batches))
+
+
+def run_join(batches, batches2):
+    col, F = _F()
+    from denormalized_tpu.sources.memory import MemorySource
+
+    ctx = _engine_ctx()
+    left = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+        name="bench_t",
+    ).window(["sensor_name"], [F.avg(col("reading")).alias("avg_t")], WINDOW_MS)
+    right = (
+        ctx.from_source(
+            MemorySource.from_batches(batches2, timestamp_column="occurred_at_ms"),
+            name="bench_h",
+        )
+        .window(["sensor_name"], [F.avg(col("reading")).alias("avg_h")], WINDOW_MS)
+        .with_column_renamed("sensor_name", "hs")
+        .with_column_renamed("window_start_time", "hws")
+        .with_column_renamed("window_end_time", "hwe")
+    )
+    ds = left.join(right, "inner", ["sensor_name", "window_start_time"], ["hs", "hws"])
+    rows = sum(b.num_rows for b in batches) + sum(b.num_rows for b in batches2)
+    return _drive(ds, rows)
+
+
+def run_highcard(batches, label="highcard", ctx=None):
+    col, F = _F()
+    from denormalized_tpu.sources.memory import MemorySource
+
+    ctx = ctx or _engine_ctx()
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+        name=f"bench_{label}",
+    ).window(
+        ["sensor_name"],
+        [F.sum(col("reading")).alias("sum"), F.avg(col("reading")).alias("avg")],
+        WINDOW_MS,
+    )
+    return _drive(ds, sum(b.num_rows for b in batches))
+
+
+def run_checkpoint(batches):
+    import shutil
+
+    d = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        ctx = _engine_ctx(
+            checkpoint=True, checkpoint_interval_s=2.0, state_backend_path=d
+        )
+        return run_simple(batches, "ckpt", ctx=ctx)
+    finally:
+        from denormalized_tpu.state.lsm import close_global_state_backend
+
+        close_global_state_backend()
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# -- CPU baseline --------------------------------------------------------
+
+
+class _CpuAgg:
+    """Vectorized-numpy windowed aggregation (shared by all baselines)."""
+
+    def __init__(self, window_ms: int, slide_ms: int | None = None):
+        self.L = window_ms
+        self.S = slide_ms or window_ms
+        self.k = -(-self.L // self.S)
+        G = 1 << max(10, (NUM_KEYS * 2 - 1).bit_length())
+        self.G = G
+        self.W = 64 * self.k
+        self.counts = np.zeros((self.W, G), np.int64)
+        self.sums = np.zeros((self.W, G))
+        self.mins = np.full((self.W, G), np.inf)
+        self.maxs = np.full((self.W, G), -np.inf)
+        self.interner: dict = {}
+        self.watermark = None
+        self.first_open = None
+        self.emitted = 0
+        self.emissions = []  # (win_start, gid array, per-agg arrays)
+
+    def push(self, ts, names, vals):
+        win = ts // self.S
+        if self.first_open is None:
+            self.first_open = int(win.min()) - self.k + 1
         uniq, inv = np.unique(names, return_inverse=True)
         ids = np.empty(len(uniq), np.int64)
-        for i, k in enumerate(uniq.tolist()):
-            j = interner.get(k)
+        for i, key in enumerate(uniq.tolist()):
+            j = self.interner.get(key)
             if j is None:
-                j = len(interner)
-                interner[k] = j
+                j = len(self.interner)
+                self.interner[key] = j
             ids[i] = j
         gid = ids[inv]
-        slot = (win % W).astype(np.int64)
-        np.add.at(counts, (slot, gid), 1)
-        np.add.at(sums, (slot, gid), vals)
-        np.minimum.at(mins, (slot, gid), vals)
-        np.maximum.at(maxs, (slot, gid), vals)
+        for i in range(self.k):
+            w = win - i
+            ok = (w * self.S <= ts) & (ts < w * self.S + self.L) & (
+                w >= self.first_open
+            )
+            slot = (w % self.W).astype(np.int64)[ok]
+            g = gid[ok]
+            v = vals[ok]
+            np.add.at(self.counts, (slot, g), 1)
+            np.add.at(self.sums, (slot, g), v)
+            np.minimum.at(self.mins, (slot, g), v)
+            np.maximum.at(self.maxs, (slot, g), v)
         bmin = int(ts.min())
-        if watermark is None or bmin > watermark:
-            watermark = bmin
-        while (first_open + 1) * WINDOW_MS <= watermark:
-            s = first_open % W
-            act = counts[s] > 0
-            emitted += int(act.sum())
-            # finalize: avg, then reset slot
-            _ = sums[s][act] / counts[s][act]
-            counts[s] = 0
-            sums[s] = 0.0
-            mins[s] = np.inf
-            maxs[s] = -np.inf
-            first_open += 1
+        if self.watermark is None or bmin > self.watermark:
+            self.watermark = bmin
+        out = []
+        while self.first_open * self.S + self.L <= self.watermark:
+            s = self.first_open % self.W
+            act = self.counts[s] > 0
+            self.emitted += int(act.sum())
+            out.append(
+                (
+                    self.first_open * self.S,
+                    np.nonzero(act)[0],
+                    self.counts[s][act].copy(),
+                    self.sums[s][act].copy(),
+                    self.mins[s][act].copy(),
+                    self.maxs[s][act].copy(),
+                )
+            )
+            self.counts[s] = 0
+            self.sums[s] = 0.0
+            self.mins[s] = np.inf
+            self.maxs[s] = -np.inf
+            self.first_open += 1
+        return out
+
+
+def run_cpu_baseline(batches, kind: str, batches2=None) -> float:
+    """CPU baseline implementing the SAME workload as the engine config."""
+    rows = sum(b.num_rows for b in batches)
+    t0 = time.perf_counter()
+    if kind in ("simple", "highcard", "checkpoint"):
+        agg = _CpuAgg(WINDOW_MS)
+        for b in batches:
+            for e in agg.push(b.columns[0], b.columns[1], b.columns[2]):
+                _avg = e[3] / e[2]
+        emitted = agg.emitted
+    elif kind == "sliding":
+        agg = _CpuAgg(1000, 200)
+        for b in batches:
+            for e in agg.push(b.columns[0], b.columns[1], b.columns[2]):
+                avg = e[3] / e[2]
+                _keep = avg > 45.0  # post-agg filter
+        emitted = agg.emitted
+    elif kind == "join":
+        rows += sum(b.num_rows for b in batches2)
+        left = _CpuAgg(WINDOW_MS)
+        right = _CpuAgg(WINDOW_MS)
+        joined = 0
+        table: dict = {}
+        for b, b2 in zip(batches, batches2):
+            for e in left.push(b.columns[0], b.columns[1], b.columns[2]):
+                for g, c, s in zip(e[1].tolist(), e[2], e[3]):
+                    table[(e[0], g, "L")] = s / c
+            for e in right.push(b2.columns[0], b2.columns[1], b2.columns[2]):
+                for g, c, s in zip(e[1].tolist(), e[2], e[3]):
+                    if (e[0], g, "L") in table:
+                        joined += 1
+        emitted = joined
+    else:
+        raise SystemExit(f"no baseline for {kind!r}")
     dt = time.perf_counter() - t0
-    log(f"cpu baseline: {rows/dt:,.0f} rows/s ({dt:.2f}s, {emitted} windows)")
+    log(f"cpu baseline[{kind}]: {rows/dt:,.0f} rows/s ({dt:.2f}s, {emitted} emissions)")
     return rows / dt
 
 
 def main():
     import jax
 
-    log(f"devices: {jax.devices()}")
-    log(f"generating {TOTAL_ROWS:,} rows in {TOTAL_ROWS//BATCH_ROWS} batches ...")
+    if CONFIG not in ("simple", "sliding", "highcard", "join", "checkpoint"):
+        raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
+    log(f"devices: {jax.devices()}  config: {CONFIG}")
+    if CONFIG == "highcard":
+        global NUM_KEYS
+        NUM_KEYS = int(os.environ.get("BENCH_KEYS", 100_000))
+    log(f"generating {TOTAL_ROWS:,} rows ...")
     _, batches = gen_batches()
+    batches2 = None
 
-    # warmup (compile cache) on a small prefix
-    run_engine(batches[:4], "warmup")
-    rps, info = run_engine(batches, "main")
-    log(f"engine: {rps:,.0f} rows/s  {info}")
+    # warmup (compile cache) with THIS config's own pipeline shape
+    warm = batches[:4]
+    if CONFIG == "sliding":
+        run_sliding(warm, "warmup")
+    elif CONFIG == "highcard":
+        run_highcard(warm, "warmup")
+    elif CONFIG == "join":
+        _, batches2 = gen_batches()
+        run_join(warm, batches2[:4])
+    else:
+        run_simple(warm, "warmup")
 
-    cpu_rps = run_cpu_baseline(batches)
+    if CONFIG == "simple":
+        rps, p99, info = run_simple(batches)
+        metric = "rows_per_sec_1s_tumbling_count_min_max_avg_by_key"
+    elif CONFIG == "highcard":
+        rps, p99, info = run_highcard(batches)
+        metric = f"rows_per_sec_1s_tumbling_{NUM_KEYS}key_sum_avg"
+    elif CONFIG == "sliding":
+        rps, p99, info = run_sliding(batches)
+        metric = "rows_per_sec_1s_200ms_sliding_with_filter"
+    elif CONFIG == "join":
+        rps, p99, info = run_join(batches, batches2)
+        metric = "rows_per_sec_windowed_stream_join"
+    else:  # checkpoint
+        rps, p99, info = run_checkpoint(batches)
+        metric = "rows_per_sec_1s_tumbling_with_checkpointing"
+    log(f"engine[{CONFIG}]: {rps:,.0f} rows/s p99 gap {p99:.1f}ms {info}")
+
+    cpu_rps = run_cpu_baseline(batches, CONFIG, batches2)
 
     print(
         json.dumps(
             {
-                "metric": "rows_per_sec_1s_tumbling_count_min_max_avg_by_key",
+                "metric": metric,
                 "value": round(rps),
                 "unit": "rows/s",
                 "vs_baseline": round(rps / cpu_rps, 3),
+                "p99_window_emit_gap_ms": round(p99, 2),
             }
         )
     )

@@ -71,11 +71,18 @@ class ColumnInterner:
             self._h = None
 
     def __len__(self) -> int:
-        # string columns on the native path keep no Python values; numeric
-        # and fallback columns live in the dict
-        if self._h and not self._values:
-            return int(self._lib.intern_count(self._h))
+        # _values mirrors the native table (synced after every intern_many),
+        # so it is authoritative for every column type
         return len(self._values)
+
+    def _sync_native_values(self) -> None:
+        """Extend the Python-side value mirror with newly interned keys —
+        one ctypes reverse lookup per NEW key ever, so emission-time
+        keys_of() is plain list indexing even at 100k+ cardinality."""
+        n_now = int(self._lib.intern_count(self._h))
+        values = self._values
+        while len(values) < n_now:
+            values.append(self._native_value(len(values)))
 
     def intern_array(self, arr: np.ndarray) -> np.ndarray:
         """Key normalization note: fixed-width numpy string storage cannot
@@ -104,6 +111,7 @@ class ColumnInterner:
                 w,
                 ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             )
+            self._sync_native_values()
             return ids
         else:
             uniq, inv = np.unique(arr.astype(np.str_), return_inverse=True)
@@ -134,19 +142,14 @@ class ColumnInterner:
         return raw.decode("utf-32-le", errors="replace")
 
     def value_of(self, ids: np.ndarray) -> np.ndarray:
+        values = self._values
         out = np.empty(len(ids), dtype=object)
-        if self._h is not None and not self._values:
-            for i, j in enumerate(ids.tolist()):
-                out[i] = self._native_value(j)
-            return out
         for i, j in enumerate(ids.tolist()):
-            out[i] = self._values[j]
+            out[i] = values[j]
         return out
 
     # -- snapshot/restore support ---------------------------------------
     def all_values(self) -> list:
-        if self._h is not None and not self._values:
-            return [self._native_value(j) for j in range(len(self))]
         return list(self._values)
 
     def load_values(self, vals: list) -> None:
@@ -156,7 +159,7 @@ class ColumnInterner:
             and vals
             and all(isinstance(v, str) for v in vals)
         ):
-            # string column → native table re-seed
+            # string column → native table re-seed (also re-syncs _values)
             ids = self.intern_array(np.array(vals, dtype=object))
             assert ids.tolist() == list(range(len(vals))), "restore order"
         else:
@@ -221,6 +224,9 @@ class GroupInterner:
 
     def keys_of(self, gids: np.ndarray) -> list[np.ndarray]:
         """Reconstruct each key column's values for the given group ids."""
+        if self.num_columns == 1:
+            # group id == column id (see intern's single-column fast path)
+            return [self._col_interners[0].value_of(gids)]
         rows = np.array([self._gid_rows[g] for g in gids.tolist()], dtype=np.int64)
         if len(gids) == 0:
             rows = rows.reshape(0, self.num_columns)

@@ -1,0 +1,42 @@
+"""Build and run the native C++ test binary under AddressSanitizer +
+UndefinedBehaviorSanitizer — sanitizer coverage the reference lacks
+entirely (SURVEY.md §5)."""
+
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+NATIVE = Path(__file__).resolve().parent.parent / "denormalized_tpu" / "native"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None,
+    reason="no compiler — the pure-Python fallbacks cover this environment",
+)
+
+
+@pytest.mark.parametrize("flags", [
+    ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+    ["-O2"],  # plain optimized build must also pass
+])
+def test_native_components(tmp_path, flags):
+    exe = tmp_path / "native_test"
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-g", *flags,
+         str(NATIVE / "native_test.cpp"), "-o", str(exe)],
+        capture_output=True,
+        text=True,
+        cwd=NATIVE,
+    )
+    assert build.returncode == 0, build.stderr[-2000:]
+    run = subprocess.run(
+        [str(exe), str(tmp_path / "lsm")],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    sys.stderr.write(run.stderr[-1000:])
+    assert run.returncode == 0, (run.stdout[-500:], run.stderr[-2000:])
+    assert "ALL NATIVE TESTS PASSED" in run.stdout
