@@ -175,7 +175,8 @@ def run_highcard(batches, label="highcard", ctx=None):
     col, F = _F()
     from denormalized_tpu.sources.memory import MemorySource
 
-    ctx = ctx or _engine_ctx()
+    # capacity hint: known high-cardinality workload → skip mid-run growth
+    ctx = ctx or _engine_ctx(min_group_capacity=2 * NUM_KEYS)
     ds = ctx.from_source(
         MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
         name=f"bench_{label}",

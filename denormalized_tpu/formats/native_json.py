@@ -34,7 +34,7 @@ def _lib():
         lib.jp_parse.restype = ctypes.c_int
         lib.jp_parse.argtypes = [
             ctypes.c_void_p,
-            ctypes.c_char_p,
+            ctypes.c_void_p,  # bytes or a raw pointer into a native buffer
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_uint64,
         ]
@@ -85,20 +85,23 @@ class NativeJsonParser:
             self._h = None
 
     def parse(self, rows: list[bytes]) -> RecordBatch:
-        lib = self._libref
-        lib.jp_clear(self._h)
         n = len(rows)
         if n == 0:
             return RecordBatch.empty(self.schema)
         data = b"".join(rows)
         offsets = np.zeros(n + 1, dtype=np.uint64)
         offsets[1:] = np.cumsum([len(r) for r in rows], dtype=np.uint64)
-        rc = lib.jp_parse(
-            self._h,
-            data,
-            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            n,
+        return self.parse_ptr(
+            data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n
         )
+
+    def parse_ptr(self, data, offsets_ptr, n: int) -> RecordBatch:
+        """Zero-copy entry: ``data`` may be a bytes object OR a raw ctypes
+        pointer into another native component's buffer (e.g. the Kafka
+        client's fetch arena) — payload bytes never become Python objects."""
+        lib = self._libref
+        lib.jp_clear(self._h)
+        rc = lib.jp_parse(self._h, data, offsets_ptr, n)
         if rc != 0:
             raise FormatError(lib.jp_error(self._h).decode())
         cols, masks = [], []

@@ -134,6 +134,31 @@ void intern_many(void* h, const uint8_t* data, uint64_t n, uint32_t w,
   }
 }
 
+// bulk reverse lookup: copy the arena slice and offsets for ids in
+// [start, end) — one call per batch instead of one per key
+int64_t intern_keys_range(void* h, uint64_t start, uint64_t end,
+                          uint8_t** bytes_out, uint64_t** offsets_out) {
+  CInterner* c = static_cast<CInterner*>(h);
+  if (start > end || end > c->in.count) return -1;
+  uint64_t n = end - start;
+  uint64_t base = c->offsets.empty() || start >= c->offsets.size()
+                      ? c->in.arena.size()
+                      : c->offsets[start];
+  uint64_t total = (end == c->in.count ? c->in.arena.size()
+                                       : c->offsets[end]) -
+                   base;
+  uint8_t* bytes = (uint8_t*)malloc(total ? total : 1);
+  uint64_t* offs = (uint64_t*)malloc((n + 1) * sizeof(uint64_t));
+  memcpy(bytes, c->in.arena.data() + base, total);
+  for (uint64_t i = 0; i < n; i++) offs[i] = c->offsets[start + i] - base;
+  offs[n] = total;
+  *bytes_out = bytes;
+  *offsets_out = offs;
+  return (int64_t)n;
+}
+
+void intern_free(void* p) { free(p); }
+
 // copy key bytes for one id (for reverse lookup); returns length
 uint32_t intern_key(void* h, uint64_t id, uint8_t* out, uint32_t cap) {
   CInterner* c = static_cast<CInterner*>(h);

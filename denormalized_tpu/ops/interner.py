@@ -43,6 +43,15 @@ def _load_native():
                 ctypes.c_char_p,
                 ctypes.c_uint32,
             ]
+            lib.intern_keys_range.restype = ctypes.c_int64
+            lib.intern_keys_range.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_uint64,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ]
+            lib.intern_free.argtypes = [ctypes.c_void_p]
             lib._in_configured = True
         return lib
     except Exception:
@@ -77,12 +86,31 @@ class ColumnInterner:
 
     def _sync_native_values(self) -> None:
         """Extend the Python-side value mirror with newly interned keys —
-        one ctypes reverse lookup per NEW key ever, so emission-time
-        keys_of() is plain list indexing even at 100k+ cardinality."""
+        ONE bulk ctypes call per batch fetching every new key's bytes, so
+        emission-time keys_of() is plain list indexing even at 100k+
+        cardinality."""
+        import ctypes
+
         n_now = int(self._lib.intern_count(self._h))
         values = self._values
-        while len(values) < n_now:
-            values.append(self._native_value(len(values)))
+        start = len(values)
+        if n_now <= start:
+            return
+        bptr = ctypes.POINTER(ctypes.c_uint8)()
+        optr = ctypes.POINTER(ctypes.c_uint64)()
+        n = self._lib.intern_keys_range(
+            self._h, start, n_now, ctypes.byref(bptr), ctypes.byref(optr)
+        )
+        try:
+            offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
+            raw = ctypes.string_at(bptr, int(offs[-1])) if offs[-1] else b""
+            for i in range(n):
+                piece = raw[offs[i] : offs[i + 1]]
+                piece += b"\x00" * (-len(piece) % 4)
+                values.append(piece.decode("utf-32-le", errors="replace"))
+        finally:
+            self._lib.intern_free(bptr)
+            self._lib.intern_free(optr)
 
     def intern_array(self, arr: np.ndarray) -> np.ndarray:
         """Key normalization note: fixed-width numpy string storage cannot
@@ -127,19 +155,6 @@ class ColumnInterner:
                 values.append(v)
             ids[i] = j
         return ids[inv]
-
-    def _native_value(self, j: int):
-        import ctypes
-
-        buf = ctypes.create_string_buffer(1024)
-        n = self._lib.intern_key(self._h, j, buf, 1024)
-        if n > 1024:
-            buf = ctypes.create_string_buffer(n)
-            self._lib.intern_key(self._h, j, buf, n)
-        raw = buf.raw[:n]
-        # keys are stored as zero-stripped UTF-32LE; re-pad to 4-byte units
-        raw += b"\x00" * (-len(raw) % 4)
-        return raw.decode("utf-32-le", errors="replace")
 
     def value_of(self, ids: np.ndarray) -> np.ndarray:
         values = self._values

@@ -148,11 +148,7 @@ class KafkaClient:
     ) -> tuple[list[bytes], np.ndarray, int]:
         """→ (payloads, timestamps_ms, next_offset)."""
         lib = self._libref
-        n = lib.kc_fetch(
-            self._h, topic.encode(), partition, offset, max_bytes, max_wait_ms
-        )
-        if n < 0:
-            raise SourceError(f"fetch: {self._err()}")
+        n = self._fetch_raw(topic, partition, offset, max_bytes, max_wait_ms)
         if n == 0:
             return [], np.empty(0, dtype=np.int64), offset
         nb = ctypes.c_uint64()
@@ -164,6 +160,59 @@ class KafkaClient:
         ).copy()
         payloads = [bytes(raw[offs[i] : offs[i + 1]]) for i in range(n)]
         return payloads, ts, int(lib.kc_next_offset(self._h))
+
+    def _fetch_raw(self, topic, partition, offset, max_bytes, max_wait_ms) -> int:
+        n = self._libref.kc_fetch(
+            self._h, topic.encode(), partition, offset, max_bytes, max_wait_ms
+        )
+        if n < 0:
+            raise SourceError(f"fetch: {self._err()}")
+        return n
+
+    def fetch_ptrs(
+        self, topic: str, partition: int, offset: int,
+        max_bytes: int = 4 << 20, max_wait_ms: int = 100,
+    ):
+        """Raw fetch handles: (n, bytes_ptr, offsets_ptr, timestamps,
+        next_offset).  Pointers reference the client's arena and stay valid
+        until the next fetch on this client."""
+        lib = self._libref
+        n = self._fetch_raw(topic, partition, offset, max_bytes, max_wait_ms)
+        if n == 0:
+            return 0, None, None, np.empty(0, dtype=np.int64), offset
+        nb = ctypes.c_uint64()
+        bptr = lib.kc_rec_bytes(self._h, ctypes.byref(nb))
+        optr = lib.kc_rec_offsets(self._h)
+        ts = np.ctypeslib.as_array(
+            lib.kc_rec_timestamps(self._h), shape=(n,)
+        ).copy()
+        return n, bptr, optr, ts, int(lib.kc_next_offset(self._h))
+
+
+def parse_fetch_arena(parser, n, bptr, optr, ts):
+    """Parse a fetch arena zero-copy; compacts away zero-length payloads
+    (tombstones) keeping the timestamp column aligned.  → (batch|None, ts)."""
+    offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
+    keep = np.diff(offs) > 0
+    if keep.all():
+        return (
+            parser.parse_ptr(ctypes.cast(bptr, ctypes.c_void_p), optr, n),
+            ts,
+        )
+    idx = np.nonzero(keep)[0]
+    if len(idx) == 0:
+        return None, np.empty(0, dtype=np.int64)
+    raw = ctypes.string_at(bptr, int(offs[-1]))
+    pieces = [raw[offs[i] : offs[i + 1]] for i in idx]
+    data = b"".join(pieces)
+    coffs = np.zeros(len(pieces) + 1, dtype=np.uint64)
+    coffs[1:] = np.cumsum([len(p) for p in pieces], dtype=np.uint64)
+    batch = parser.parse_ptr(
+        data,
+        coffs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(pieces),
+    )
+    return batch, ts[idx]
 
 
 # -- builder (KafkaTopicBuilder, kafka_config.rs:103-339) ----------------
@@ -243,18 +292,47 @@ class KafkaPartitionReader(PartitionReader):
         )
         self._ts_col = src.builder.timestamp_column
 
-    def read(self, timeout_s: float | None = None):
-        payloads, kafka_ts, next_off = self._client.fetch(
-            self._topic,
-            self._partition,
-            self._offset,
-            max_wait_ms=int((timeout_s or 0.1) * 1000),
+    def _attach_ts(self, batch, kafka_ts):
+        """Canonical timestamp: payload column or the broker record
+        timestamp (kafka_stream_read.rs:222-266)."""
+        if self._ts_col is not None:
+            ts = np.asarray(batch.column(self._ts_col), dtype=np.int64)
+        else:
+            ts = kafka_ts
+        return batch.with_column(
+            Field(
+                CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False
+            ),
+            ts,
         )
+
+    def read(self, timeout_s: float | None = None):
+        # zero-copy hot path: flat-JSON schemas parse straight from the
+        # fetch arena (no Python payload objects).  The offset is committed
+        # BEFORE decoding, so a poison payload raises once and the next
+        # read continues past it instead of livelocking on the same record.
+        native = getattr(self._decoder, "_native", None)
+        max_wait = int((timeout_s or 0.1) * 1000)
+        if native is not None:
+            n, bptr, optr, kafka_ts, next_off = self._client.fetch_ptrs(
+                self._topic, self._partition, self._offset, max_wait_ms=max_wait
+            )
+            self._offset = next_off
+            if n == 0:
+                return RecordBatch.empty(self._src.schema)
+            batch, kafka_ts = parse_fetch_arena(native, n, bptr, optr, kafka_ts)
+            if batch is None:
+                return RecordBatch.empty(self._src.schema)
+            return self._attach_ts(batch, kafka_ts)
+
+        payloads, kafka_ts, next_off = self._client.fetch(
+            self._topic, self._partition, self._offset, max_wait_ms=max_wait
+        )
+        # commit before decode (see above)
+        self._offset = next_off
         if not payloads:
             # live source: no data within the wait — empty batch, stay open
-            self._offset = next_off  # may advance past skipped batches
             return RecordBatch.empty(self._src.schema)
-        self._offset = next_off
         # drop zero-length payloads together with their timestamps so rows
         # and the kafka-timestamp column stay aligned
         if any(len(p) == 0 for p in payloads):
@@ -266,16 +344,7 @@ class KafkaPartitionReader(PartitionReader):
         for p in payloads:
             self._decoder.push(p)
         batch = self._decoder.flush()
-        # canonical timestamp: payload column or the broker record timestamp
-        # (kafka_stream_read.rs:222-266)
-        if self._ts_col is not None:
-            ts = np.asarray(batch.column(self._ts_col), dtype=np.int64)
-        else:
-            ts = kafka_ts
-        return batch.with_column(
-            Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
-            ts,
-        )
+        return self._attach_ts(batch, kafka_ts)
 
     def offset_snapshot(self) -> dict:
         return {"partition": self._partition, "offset": int(self._offset)}

