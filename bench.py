@@ -87,10 +87,14 @@ def _drive(ds, rows: int) -> tuple[float, float, dict]:
     return rows / dt, p99, {"windows_rows": out_rows, "wall_s": round(dt, 3)}
 
 
+DEVICE_STRATEGY = os.environ.get("BENCH_DEVICE_STRATEGY", "scatter")
+
+
 def _engine_ctx(**over):
     from denormalized_tpu import Context
     from denormalized_tpu.api.context import EngineConfig
 
+    over.setdefault("device_strategy", DEVICE_STRATEGY)
     cfg = EngineConfig(min_batch_bucket=BATCH_ROWS, min_window_slots=32, **over)
     return Context(cfg)
 

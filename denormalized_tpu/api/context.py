@@ -49,6 +49,9 @@ class EngineConfig:
     mesh_devices: int | None = None
     # 'auto' | 'key_sharded' | 'partial_final' (see parallel/sharded_state.py)
     shard_strategy: str = "auto"
+    # single-device kernel: 'scatter' (general) | 'pallas_dense' (MXU/VPU
+    # dense path for low-cardinality aggregation; auto-falls-back)
+    device_strategy: str = "scatter"
 
     def set(self, key: str, value) -> "EngineConfig":
         """String-keyed setter for parity with SessionConfig::set

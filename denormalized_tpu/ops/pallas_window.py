@@ -1,0 +1,250 @@
+"""Pallas TPU kernel for the windowed-aggregation hot op (dense small-G
+path).
+
+The default device step (`segment_agg.update_state`) scatters rows into
+``(W, G)`` HBM buffers — general, but scatter on TPU serializes through
+sort-based lowering.  For LOW-cardinality aggregation (the emit_measurements
+shape: ≤ ~2k groups), this kernel reformulates the scatter as dense
+MXU/VPU work per TILE-row tile (TILE=256):
+
+- count/sum become one-hot matmuls on the MXU
+  (``one_hot(gid).T @ masked_values``);
+- min/max become masked broadcast-reductions on the VPU;
+- the few window slots a batch touches (``k_active``, static) are handled by
+  masking rows per relative slot, so the kernel accumulates a
+  ``(k_active, G)`` VMEM scratch and the caller adds/merges it into the HBM
+  ring at ``[base : base+k_active]`` — one dynamic-slice update instead of a
+  row scatter.
+
+Selected via ``EngineConfig(device_strategy="pallas_dense")``; falls back to
+the scatter path when G or the batch's window span exceeds the dense limits.
+Runs under ``interpret=True`` on CPU so tests validate bit-parity with the
+scatter path without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from denormalized_tpu.ops import segment_agg as sa
+
+# dense-path limits: G beyond this, or batches spanning more ring slots than
+# K_ACTIVE, fall back to the scatter path
+MAX_DENSE_GROUPS = 2048
+K_ACTIVE = 8
+TILE = 256
+
+
+def _kernel(
+    values_ref,  # (TILE, V) f32
+    colvalid_ref,  # (TILE, V) f32 (1.0 valid)
+    rel_ref,  # (TILE, 1) int32 — slot relative to base, -1 = dropped
+    gid_ref,  # (TILE, 1) int32
+    cnt_ref,  # (K, G*V) f32 out — valid-entry count per (slot, col, group)
+    sum_ref,  # (K, G*V) f32 out
+    min_ref,  # (K, G*V) f32 out
+    max_ref,  # (K, G*V) f32 out
+    rowcnt_ref,  # (K, G) f32 out — rows per (slot, group), for count(*)
+    *,
+    G: int,
+    V: int,
+):
+    step = pl.program_id(0)
+    values = values_ref[:]
+    colvalid = colvalid_ref[:]
+    rel = rel_ref[:]  # (TILE, 1)
+    gid = gid_ref[:]
+
+    # one-hot over groups, (TILE, G)
+    groups = jax.lax.broadcasted_iota(jnp.int32, (TILE, G), 1)
+    onehot = (gid == groups).astype(jnp.float32)
+
+    @pl.when(step == 0)
+    def _init():
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        min_ref[:] = jnp.full_like(min_ref, jnp.inf)
+        max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
+        rowcnt_ref[:] = jnp.zeros_like(rowcnt_ref)
+
+    for j in range(K_ACTIVE):
+        in_slot = (rel == j).astype(jnp.float32)  # (TILE, 1)
+        oh = onehot * in_slot  # rows of this slot only
+        # rows per (slot, group): MXU matmul with a ones vector
+        rowcnt_ref[j, :] += jnp.sum(oh, axis=0)
+        for v in range(V):
+            col = values[:, v : v + 1]  # (TILE, 1)
+            ok = colvalid[:, v : v + 1]
+            sel = (oh * ok) > 0
+            # count/sum via where-selection: masked-out lanes may hold NaN
+            # (values behind an invalid mask are unspecified), and 0*NaN
+            # would poison a multiplicative mask
+            cnt_ref[j, v * G : (v + 1) * G] += jnp.sum(oh * ok, axis=0)
+            sum_ref[j, v * G : (v + 1) * G] += jnp.sum(
+                jnp.where(sel, col, 0.0), axis=0
+            )
+            # min/max via masked broadcast reduce on the VPU
+            min_ref[j, v * G : (v + 1) * G] = jnp.minimum(
+                min_ref[j, v * G : (v + 1) * G],
+                jnp.min(jnp.where(sel, col, jnp.inf), axis=0),
+            )
+            max_ref[j, v * G : (v + 1) * G] = jnp.maximum(
+                max_ref[j, v * G : (v + 1) * G],
+                jnp.max(jnp.where(sel, col, -jnp.inf), axis=0),
+            )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("G", "V", "interpret")
+)
+def _dense_partials(
+    values, colvalid, rel, gid, *, G: int, V: int, interpret: bool
+):
+    """→ (rowcnt (K,G), cnt (K,G,V), sum (K,G,V), min (K,G,V), max (K,G,V))"""
+    B = values.shape[0]
+    assert B % TILE == 0
+    grid = (B // TILE,)
+    outs = pl.pallas_call(
+        functools.partial(_kernel, G=G, V=V),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE, V), lambda i: (i, 0)),
+            pl.BlockSpec((TILE, V), lambda i: (i, 0)),
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((K_ACTIVE, G * V), lambda i: (0, 0)),
+            pl.BlockSpec((K_ACTIVE, G * V), lambda i: (0, 0)),
+            pl.BlockSpec((K_ACTIVE, G * V), lambda i: (0, 0)),
+            pl.BlockSpec((K_ACTIVE, G * V), lambda i: (0, 0)),
+            pl.BlockSpec((K_ACTIVE, G), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K_ACTIVE, G * V), jnp.float32),
+            jax.ShapeDtypeStruct((K_ACTIVE, G * V), jnp.float32),
+            jax.ShapeDtypeStruct((K_ACTIVE, G * V), jnp.float32),
+            jax.ShapeDtypeStruct((K_ACTIVE, G * V), jnp.float32),
+            jax.ShapeDtypeStruct((K_ACTIVE, G), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        values.astype(jnp.float32),
+        colvalid.astype(jnp.float32),
+        rel.reshape(-1, 1),
+        gid.reshape(-1, 1),
+    )
+    cnt, ssum, smin, smax, rowcnt = outs
+    shp = (K_ACTIVE, V, G)
+    return (
+        rowcnt,
+        cnt.reshape(shp),
+        ssum.reshape(shp),
+        smin.reshape(shp),
+        smax.reshape(shp),
+    )
+
+
+def dense_supported(spec: sa.WindowKernelSpec) -> bool:
+    # TODO(next round, needs chip measurement): fold the fan-out loop into
+    # the kernel as a (TILE, k) rel matrix so sliding pays one launch.
+    return (
+        spec.group_capacity <= MAX_DENSE_GROUPS
+        and spec.length_units <= 2  # fan-out handled by slot replication
+        # the kernel accumulates in f32; honor an explicit f64 request by
+        # staying on the scatter path
+        and spec.accum_dtype == jnp.float32
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def _merge_partials(spec, state, partials, base_mod):
+    """Fold the (K, ...) dense partials into the HBM ring with ONE
+    dynamic-window update per component (no row scatter)."""
+    rowcnt, cnt, ssum, smin, smax = partials
+    W = spec.window_slots
+    G = spec.group_capacity
+    # ring rows base_mod..base_mod+K (mod W): do it as a K-row scatter-free
+    # update using modular row indices via take/set on a small index vector
+    rows = (base_mod + jnp.arange(K_ACTIVE, dtype=jnp.int32)) % W
+    for comp in spec.components:
+        buf = state[comp.label]
+        if comp.kind == "count":
+            upd = (
+                rowcnt if comp.col is None else cnt[:, comp.col, :]
+            ).astype(buf.dtype)
+            state[comp.label] = buf.at[rows].add(upd)
+        elif comp.kind == "sum":
+            state[comp.label] = buf.at[rows].add(
+                ssum[:, comp.col, :].astype(buf.dtype)
+            )
+        elif comp.kind == "min":
+            state[comp.label] = buf.at[rows].min(
+                smin[:, comp.col, :].astype(buf.dtype)
+            )
+        else:
+            state[comp.label] = buf.at[rows].max(
+                smax[:, comp.col, :].astype(buf.dtype)
+            )
+    return state
+
+
+def dense_update(
+    spec: sa.WindowKernelSpec,
+    state,
+    values,
+    colvalid,
+    win_rel,
+    rem,
+    gid,
+    row_valid,
+    base_mod,
+    *,
+    min_win_rel: int,
+    interpret: bool = False,
+):
+    """Dense-path equivalent of ``update_state``: compute per-slot partials
+    with the pallas kernel, then fold them into the ring.
+
+    ``min_win_rel`` is the smallest window index (relative to first_open) any
+    row of this batch touches; the kernel works in ``rel - min_win_rel``
+    space so K_ACTIVE covers the batch's span.  Caller guarantees the span
+    fits (else it uses the scatter path)."""
+    k = spec.length_units
+    B = values.shape[0]
+    rel_all = []
+    for i in range(k):
+        wr = win_rel - i
+        ok = row_valid & (wr >= 0) & (wr < spec.window_slots)
+        if spec.length_ms - i * spec.slide_ms < spec.slide_ms:
+            ok = ok & (rem < spec.length_ms - i * spec.slide_ms)
+        rel = jnp.where(ok, wr - min_win_rel, -1).astype(jnp.int32)
+        rel_all.append(rel)
+    partials = None
+    for rel in rel_all:
+        p = _dense_partials(
+            values,
+            colvalid,
+            rel,
+            gid,
+            G=spec.group_capacity,
+            V=max(spec.num_value_cols, 1),
+            interpret=interpret,
+        )
+        if partials is None:
+            partials = p
+        else:
+            partials = (
+                partials[0] + p[0],
+                partials[1] + p[1],
+                partials[2] + p[2],
+                jnp.minimum(partials[3], p[3]),
+                jnp.maximum(partials[4], p[4]),
+            )
+    base = (base_mod + jnp.asarray(min_win_rel, jnp.int32)) % spec.window_slots
+    return _merge_partials(spec, state, partials, base)

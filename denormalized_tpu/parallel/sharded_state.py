@@ -54,7 +54,10 @@ class WindowStateBackend:
         """Total group-id capacity visible to the host interner."""
         raise NotImplementedError
 
-    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+    def update(
+        self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
+        min_win_rel: int | None = None, max_win_rel: int | None = None,
+    ):
         raise NotImplementedError
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
@@ -72,15 +75,45 @@ class WindowStateBackend:
 
 
 class SingleDeviceWindowState(WindowStateBackend):
-    def __init__(self, spec: sa.WindowKernelSpec):
+    def __init__(self, spec: sa.WindowKernelSpec, device_strategy: str = "scatter"):
         self.spec = spec
         self._state = sa.init_state(spec)
+        self.device_strategy = device_strategy
+        self._pallas_interpret = jax.default_backend() != "tpu"
 
     @property
     def group_capacity(self) -> int:
         return self.spec.group_capacity
 
-    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+    def update(
+        self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
+        min_win_rel: int | None = None, max_win_rel: int | None = None,
+    ):
+        if self.device_strategy == "pallas_dense" and min_win_rel is not None:
+            from denormalized_tpu.ops import pallas_window as pw
+
+            span_ok = (
+                max_win_rel is not None
+                and max_win_rel - max(min_win_rel - (self.spec.length_units - 1), 0)
+                < pw.K_ACTIVE
+            )
+            tile_ok = np.shape(values)[0] % pw.TILE == 0
+            if pw.dense_supported(self.spec) and span_ok and tile_ok:
+                lo = max(min_win_rel - (self.spec.length_units - 1), 0)
+                self._state = pw.dense_update(
+                    self.spec,
+                    self._state,
+                    jnp.asarray(values),
+                    jnp.asarray(colvalid),
+                    jnp.asarray(win_rel),
+                    jnp.asarray(rem),
+                    jnp.asarray(gid),
+                    jnp.asarray(row_valid),
+                    jnp.asarray(base_mod, dtype=jnp.int32),
+                    min_win_rel=lo,
+                    interpret=self._pallas_interpret,
+                )
+                return
         self._state = sa.update_state(
             self.spec,
             self._state,
@@ -191,7 +224,10 @@ class KeyShardedWindowState(WindowStateBackend):
     def group_capacity(self) -> int:
         return self.spec.group_capacity * self.n
 
-    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+    def update(
+        self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
+        min_win_rel=None, max_win_rel=None,
+    ):
         self._state = _key_sharded_update(
             self.spec,
             self.mesh,
@@ -351,9 +387,12 @@ class PartialFinalWindowState(WindowStateBackend):
     def group_capacity(self) -> int:
         return self.spec.group_capacity
 
-    def update(self, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+    def update(
+        self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
+        min_win_rel=None, max_win_rel=None,
+    ):
         # rows must split evenly over the mesh: bucketed batches are powers
-        # of two ≥ mesh size, so this holds by construction
+        # of two >= mesh size, so this holds by construction
         self._state = _partial_update(
             self.spec,
             self.mesh,
@@ -416,11 +455,12 @@ def make_sharded_state(
     spec: sa.WindowKernelSpec,
     mesh: Mesh | None,
     strategy: str = "auto",
+    device_strategy: str = "scatter",
 ) -> WindowStateBackend:
     """Pick a layout: small state → Partial/Final (duplicate it, shard rows);
     large state → key-sharded (shard it, broadcast rows)."""
     if mesh is None or mesh.devices.size == 1:
-        return SingleDeviceWindowState(spec)
+        return SingleDeviceWindowState(spec, device_strategy)
     if strategy == "auto":
         strategy = (
             "partial_final" if spec.group_capacity <= 4096 else "key_sharded"

@@ -83,6 +83,7 @@ class StreamingWindowExec(ExecOperator):
         emit_on_close: bool = True,
         mesh=None,
         shard_strategy: str = "auto",
+        device_strategy: str = "scatter",
         name: str = "window",
     ) -> None:
         if window_type is WindowType.SESSION:
@@ -121,6 +122,7 @@ class StreamingWindowExec(ExecOperator):
         self._interner = GroupInterner(len(self.group_exprs)) if self._grouped else None
         self._mesh = mesh
         self._shard_strategy = shard_strategy
+        self._device_strategy = device_strategy
         n_dev = 1 if mesh is None else mesh.devices.size
         self._spec = sa.WindowKernelSpec(
             components=components,
@@ -135,7 +137,9 @@ class StreamingWindowExec(ExecOperator):
         )
         from denormalized_tpu.parallel.sharded_state import make_sharded_state
 
-        self._backend = make_sharded_state(self._spec, mesh, shard_strategy)
+        self._backend = make_sharded_state(
+            self._spec, mesh, shard_strategy, device_strategy
+        )
 
         # schema: group cols + agg cols + window bounds (+ canonical ts)
         fields = [g.out_field(in_schema) for g in self.group_exprs]
@@ -216,7 +220,7 @@ class StreamingWindowExec(ExecOperator):
                 remapped[label] = nbuf
             host = remapped
         self._backend = make_sharded_state(
-            self._spec, self._mesh, self._shard_strategy
+            self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
         self._backend.import_(host)
         self._metrics["grow_events"] += 1
@@ -302,6 +306,8 @@ class StreamingWindowExec(ExecOperator):
             pad(gid),
             row_valid,
             first % self._spec.window_slots,
+            min_win_rel=int(max(win_rel64.min(), 0)),
+            max_win_rel=int(win_rel64.max()),
         )
         self._metrics["device_steps"] += 1
 
@@ -402,7 +408,7 @@ class StreamingWindowExec(ExecOperator):
             accum_dtype=old.accum_dtype,
         )
         self._backend = make_sharded_state(
-            self._spec, self._mesh, self._shard_strategy
+            self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
         self._backend.import_(arrays)
         self._first_open = meta["first_open"]

@@ -57,6 +57,7 @@ class Planner:
                     emit_on_close=self.config.emit_on_close,
                     mesh=mesh,
                     shard_strategy=self.config.shard_strategy,
+                    device_strategy=self.config.device_strategy,
                 )
             if any(a.kind == "udaf" for a in node.aggr_exprs):
                 from denormalized_tpu.physical.udaf_exec import UdafWindowExec
