@@ -37,8 +37,13 @@ class EngineConfig:
     checkpoint_interval_s: float = 10.0  # orchestrator cadence (orchestrator.rs:58)
     state_backend_path: str | None = None
 
-    # device execution profile
+    # device execution profile.  accum_dtype=jnp.float64 additionally
+    # requires jax.config.update("jax_enable_x64", True) — without it JAX
+    # silently computes in float32.
     accum_dtype: Any = jnp.float32
+    # streaming joins: rows older than the join watermark by more than this
+    # are evicted (and emitted unmatched for outer joins)
+    join_retention_ms: int = 300_000
     min_batch_bucket: int = 256
     min_group_capacity: int = 128
     min_window_slots: int = 16

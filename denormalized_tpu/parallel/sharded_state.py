@@ -459,6 +459,11 @@ def make_sharded_state(
 ) -> WindowStateBackend:
     """Pick a layout: small state → Partial/Final (duplicate it, shard rows);
     large state → key-sharded (shard it, broadcast rows)."""
+    if device_strategy not in ("scatter", "pallas_dense"):
+        raise ValueError(
+            f"unknown device strategy {device_strategy!r} "
+            "(expected 'scatter' or 'pallas_dense')"
+        )
     if mesh is None or mesh.devices.size == 1:
         return SingleDeviceWindowState(spec, device_strategy)
     if strategy == "auto":

@@ -306,7 +306,11 @@ class StreamingWindowExec(ExecOperator):
             pad(gid),
             row_valid,
             first % self._spec.window_slots,
-            min_win_rel=int(max(win_rel64.min(), 0)),
+            # span of the ON-TIME rows only: late rows (win_rel < 0) are
+            # dropped by both kernels and must not widen the dense-path span
+            min_win_rel=int(
+                win_rel64[win_rel64 >= 0].min() if (win_rel64 >= 0).any() else 0
+            ),
             max_win_rel=int(win_rel64.max()),
         )
         self._metrics["device_steps"] += 1

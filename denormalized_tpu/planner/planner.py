@@ -95,6 +95,9 @@ class Planner:
 
             left = self.create_physical_plan(node.left)
             right = self.create_physical_plan(node.right)
+            jkw = {}
+            if self.config is not None:
+                jkw["retention_ms"] = self.config.join_retention_ms
             return StreamingJoinExec(
                 left,
                 right,
@@ -103,6 +106,7 @@ class Planner:
                 node.right_keys,
                 node.filter,
                 node.schema,
+                **jkw,
             )
         if isinstance(node, lp.Sink):
             child = self.create_physical_plan(node.input)
