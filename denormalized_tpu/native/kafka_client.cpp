@@ -29,6 +29,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 #include <vector>
+#include <zlib.h>
 
 namespace {
 
@@ -269,6 +270,32 @@ void build_record_batch(Writer& out, const uint8_t* data,
   out.bytes(batch.buf);
 }
 
+// inflate a gzip stream (Kafka codec 1) into out
+bool gunzip(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
+  z_stream zs{};
+  if (inflateInit2(&zs, 15 + 16) != Z_OK) return false;  // gzip wrapper
+  out.clear();
+  out.resize(n * 4 + 1024);
+  zs.next_in = const_cast<Bytef*>(src);
+  zs.avail_in = (uInt)n;
+  size_t written = 0;
+  int rc;
+  do {
+    if (written == out.size()) out.resize(out.size() * 2);
+    zs.next_out = out.data() + written;
+    zs.avail_out = (uInt)(out.size() - written);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    written = out.size() - zs.avail_out;
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return false;
+    }
+  } while (rc != Z_STREAM_END && zs.avail_in > 0);
+  inflateEnd(&zs);
+  out.resize(written);
+  return rc == Z_STREAM_END;
+}
+
 // parse magic-2 record batches out of a Fetch "records" blob
 bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
                        int64_t fetch_offset) {
@@ -289,8 +316,11 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
     }
     r.u32();              // crc (trusted; transport is TCP)
     int16_t attrs = r.i16();
-    if (attrs & 0x7) {    // compressed batch — unsupported, skip whole
-      // batch but advance the cursor past every record it covers
+    int codec = attrs & 0x7;
+    std::vector<uint8_t> inflated;  // keeps gunzipped records alive
+    if (codec != 0 && codec != 1) {
+      // snappy/lz4/zstd — unsupported; skip the whole batch but advance
+      // the cursor past every record it covers
       Reader peek = r;
       int32_t lod = peek.i32();
       int64_t past = base_offset + lod + 1;
@@ -304,18 +334,30 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
     r.i64();              // maxTimestamp
     r.skip(8 + 2 + 4);    // producerId/Epoch/baseSequence
     int32_t nrec = r.i32();
-    for (int32_t i = 0; i < nrec && !r.fail; i++) {
-      int64_t rec_len = r.varint();
-      const uint8_t* rec_end = r.p + rec_len;
-      r.i8();  // attributes
-      int64_t ts_delta = r.varint();
-      int64_t off_delta = r.varint();
-      int64_t klen = r.varint();
-      if (klen > 0) r.skip((size_t)klen);
-      int64_t vlen = r.varint();
+    Reader rr = r;  // records section (inline, or inflated for gzip)
+    if (codec == 1) {
+      // gzip: the records section is one gzip stream
+      if (!gunzip(r.p, (size_t)(batch_end - r.p), inflated)) {
+        int64_t past = base_offset + last_offset_delta + 1;
+        if (past > c->next_offset && past > fetch_offset)
+          c->next_offset = past;  // never stall behind a bad batch
+        r.p = batch_end;
+        continue;
+      }
+      rr = Reader{inflated.data(), inflated.data() + inflated.size()};
+    }
+    for (int32_t i = 0; i < nrec && !rr.fail; i++) {
+      int64_t rec_len = rr.varint();
+      const uint8_t* rec_end = rr.p + rec_len;
+      rr.i8();  // attributes
+      int64_t ts_delta = rr.varint();
+      int64_t off_delta = rr.varint();
+      int64_t klen = rr.varint();
+      if (klen > 0) rr.skip((size_t)klen);
+      int64_t vlen = rr.varint();
       int64_t abs_off = base_offset + off_delta;
-      if (abs_off >= fetch_offset && vlen >= 0 && r.need((size_t)vlen)) {
-        c->rec_bytes.insert(c->rec_bytes.end(), r.p, r.p + vlen);
+      if (abs_off >= fetch_offset && vlen >= 0 && rr.need((size_t)vlen)) {
+        c->rec_bytes.insert(c->rec_bytes.end(), rr.p, rr.p + vlen);
         c->rec_offsets.push_back(c->rec_bytes.size());
         c->rec_ts.push_back(first_ts + ts_delta);
         c->rec_kafka_offsets.push_back(abs_off);
@@ -325,17 +367,17 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
       // would refetch the same batch forever
       if (abs_off >= fetch_offset && abs_off + 1 > c->next_offset)
         c->next_offset = abs_off + 1;
-      if (vlen > 0) r.skip((size_t)vlen);
+      if (vlen > 0) rr.skip((size_t)vlen);
       // headers
-      int64_t nh = r.varint();
-      for (int64_t h = 0; h < nh && !r.fail; h++) {
-        int64_t kl = r.varint();
-        r.skip((size_t)kl);
-        int64_t vl = r.varint();
-        if (vl > 0) r.skip((size_t)vl);
+      int64_t nh = rr.varint();
+      for (int64_t h = 0; h < nh && !rr.fail; h++) {
+        int64_t kl = rr.varint();
+        rr.skip((size_t)kl);
+        int64_t vl = rr.varint();
+        if (vl > 0) rr.skip((size_t)vl);
       }
-      if (r.p > rec_end) r.fail = true;
-      else r.p = rec_end;
+      if (rr.p > rec_end) rr.fail = true;
+      else rr.p = rec_end;
     }
     // safety net for empty/odd batches: never stall behind a consumed batch
     int64_t past = base_offset + last_offset_delta + 1;

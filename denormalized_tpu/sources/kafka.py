@@ -41,7 +41,7 @@ from denormalized_tpu.sources.base import (
 
 
 def _lib():
-    lib = load("kafka_client")
+    lib = load("kafka_client", ["-lz"])
     if not getattr(lib, "_kc_configured", False):
         lib.kc_connect.restype = ctypes.c_void_p
         lib.kc_connect.argtypes = [

@@ -61,14 +61,20 @@ def _crc32c(data: bytes) -> int:
 
 
 def build_record_batch(
-    base_offset: int, records: list[tuple[int, bytes]], compute_crc: bool = True
+    base_offset: int,
+    records: list[tuple[int, bytes]],
+    compute_crc: bool = True,
+    gzip_codec: bool = False,
 ) -> bytes:
     """magic-2 batch from [(timestamp_ms, payload)].
 
     ``compute_crc=False`` writes a zero CRC — the embedded broker serves
     high-volume benchmark fetches this way (our native client, like the
     brokers themselves on read, trusts the TCP transport); codec tests use
-    the real CRC32C."""
+    the real CRC32C.  ``gzip_codec=True`` compresses the records section
+    (Kafka compression attribute 1)."""
+    import gzip as _gzip
+
     first_ts = records[0][0] if records else 0
     recs = bytearray()
     for i, (ts, payload) in enumerate(records):
@@ -82,11 +88,13 @@ def build_record_batch(
         rec += _zz_enc(0)  # headers
         recs += _zz_enc(len(rec))
         recs += rec
+    if gzip_codec:
+        recs = bytearray(_gzip.compress(bytes(recs)))
     max_ts = max((ts for ts, _ in records), default=0)
     body = bytearray()
     body += struct.pack(
-        ">hiqqqhii", 0, len(records) - 1, first_ts, max_ts, -1, -1, -1,
-        len(records),
+        ">hiqqqhii", 1 if gzip_codec else 0, len(records) - 1, first_ts,
+        max_ts, -1, -1, -1, len(records),
     )
     body += recs
     crc = _crc32c(bytes(body)) if compute_crc else 0
@@ -159,15 +167,22 @@ class MockKafkaBroker:
             for p in range(partitions):
                 self._logs.setdefault((name, p), [])
 
-    def produce(self, topic: str, partition: int, payloads, ts_ms=None):
-        """Direct (no-wire) produce, handy for tests."""
+    def produce(
+        self, topic: str, partition: int, payloads, ts_ms=None,
+        gzip_codec: bool = False,
+    ):
+        """Direct (no-wire) produce, handy for tests.  ``gzip_codec`` stores
+        gzip-compressed batches (clients must inflate on fetch)."""
         ts = ts_ms if ts_ms is not None else int(time.time() * 1000)
         with self._lock:
             self._npartitions.setdefault(topic, max(partition + 1, 1))
             log = self._logs.setdefault((topic, partition), [])
             for p in payloads:
                 o = len(log)
-                log.append((o, ts, p, self._pre_encode(o, ts, p)))
+                enc = build_record_batch(
+                    o, [(ts, p)], compute_crc=False, gzip_codec=gzip_codec
+                )
+                log.append((o, ts, p, enc))
 
     @staticmethod
     def _pre_encode(offset: int, ts: int, payload: bytes) -> bytes:

@@ -233,3 +233,19 @@ def test_poison_message_does_not_livelock(broker):
         b = reader.read(timeout_s=0.2)
         rows += b.num_rows
     assert rows > 0, "reader never progressed past the poison record"
+
+
+def test_gzip_compressed_batches(broker):
+    """The native client inflates gzip record batches (Kafka codec 1)."""
+    broker.create_topic("gz", partitions=1)
+    payloads = [json.dumps({"i": i, "pad": "x" * 100}).encode() for i in range(50)]
+    broker.produce("gz", 0, payloads, ts_ms=123, gzip_codec=True)
+    c = KafkaClient(broker.bootstrap)
+    got, ts, next_off = c.fetch("gz", 0, 0, max_wait_ms=10)
+    assert got == payloads
+    assert next_off == 50
+    assert list(ts) == [123] * 50
+    # fetch from the middle of compressed batches
+    got2, _, _ = c.fetch("gz", 0, 30, max_wait_ms=10)
+    assert got2 == payloads[30:]
+    c.close()
