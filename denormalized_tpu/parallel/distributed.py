@@ -1,0 +1,72 @@
+"""Multi-host initialization.
+
+The reference is single-process by design (SURVEY.md §2.3: in-process
+channels, "no multi-node anything").  The TPU-native scale-out story keeps
+ONE code path for both: the same ``Mesh`` + ``shard_map`` kernels run over
+however many hosts participate — collectives ride ICI within a slice and DCN
+across slices; nothing in the engine distinguishes the two.
+
+``init_distributed`` wraps ``jax.distributed.initialize`` (coordinator
+address + process count, the JAX-native replacement for the reference's
+would-be NCCL/MPI bootstrap), and ``global_mesh`` builds the key-axis mesh
+over every device in the job.  On a single host both are no-ops/equivalent
+to :func:`make_mesh`.
+
+Operational sketch (multi-host streaming job):
+- every host runs the same query binary with its own Kafka partition subset
+  (source parallelism stays host-local, exactly like the reference's
+  per-partition readers);
+- window state shards over the GLOBAL device set via
+  ``EngineConfig(mesh_devices=len(jax.devices()))``;
+- barriers/checkpoints coordinate per-host (each host owns its sources'
+  offsets; window snapshots are sharded-state exports).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from denormalized_tpu.parallel.mesh import make_mesh
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join a multi-host JAX job.  No-op only when NOTHING multi-host was
+    requested (no coordinator, no process id, ≤1 process); any explicit
+    argument — including a bare ``process_id`` on auto-detecting platforms —
+    goes through to ``jax.distributed.initialize``."""
+    if (
+        coordinator_address is None
+        and process_id is None
+        and num_processes in (None, 1)
+    ):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """Mesh over the ENTIRE job's device set (every host).
+
+    Deliberately takes no device-count argument: slicing the global device
+    list would hand some hosts a mesh containing none of their addressable
+    devices (shard_map would fail or deadlock at the first collective).
+    For single-host sub-meshes use :func:`make_mesh` directly."""
+    devices = jax.devices()
+    local = set(jax.local_devices())
+    if local and not local & set(devices):
+        raise RuntimeError(
+            "global device list excludes this process's devices — was "
+            "init_distributed called on every host?"
+        )
+    return make_mesh(devices=devices)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

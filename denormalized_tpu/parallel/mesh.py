@@ -22,7 +22,10 @@ KEY_AXIS = "keys"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """1-D mesh over the first ``n_devices`` local devices (axis "keys")."""
+    """1-D mesh (axis "keys") over ``devices`` (default: ``jax.devices()``,
+    the job-global list), truncated to the first ``n_devices``.  In
+    multi-process jobs do NOT truncate — use
+    :func:`denormalized_tpu.parallel.distributed.global_mesh`."""
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:

@@ -102,3 +102,18 @@ def test_sharded_growth(make_batch, strategy):
         for i in range(res.num_rows)
     }
     assert set(got) == set(oracle)
+
+
+def test_distributed_helpers_single_process():
+    import jax
+
+    from denormalized_tpu.parallel.distributed import (
+        global_mesh,
+        init_distributed,
+        local_device_count,
+    )
+
+    init_distributed()  # no-op: nothing multi-host requested
+    mesh = global_mesh()  # whole job's devices, never sliced
+    assert mesh.devices.size == len(jax.devices())
+    assert local_device_count() >= 1
