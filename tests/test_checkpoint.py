@@ -96,6 +96,68 @@ def _clean_global_backend():
     close_global_state_backend()
 
 
+def _kill_restore_roundtrip(batches, make_cfg, state_dir):
+    """Shared kill→restore protocol driver: run A crashes right after one
+    committed barrier; run B restores from the same backend path.  Returns
+    (golden, emitted_a, emitted_b)."""
+    from denormalized_tpu.common.record_batch import RecordBatch as RB
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    golden = _collect_windows(_pipeline(Context(make_cfg(None)), batches).collect())
+
+    ctx_a = Context(make_cfg(state_dir))
+    root_a = executor.build_physical(
+        lp.Sink(_pipeline(ctx_a, batches)._plan, CollectSink()), ctx_a
+    )
+    orch_a = Orchestrator(interval_s=9999)
+    coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+    emitted_a = {}
+    items_seen = 0
+    it = root_a.run()
+    for item in it:
+        if isinstance(item, RB):
+            emitted_a.update(_collect_windows(item))
+        # one barrier after the first mid-stream emission, then crash right
+        # after the marker clears the pipeline (root commit = durable epoch)
+        if items_seen == 1:
+            orch_a.trigger_now()
+        if isinstance(item, Marker):
+            coord_a.commit(item.epoch)
+            break
+        items_seen += 1
+    it.close()  # crash
+    close_global_state_backend()
+
+    ctx_b = Context(make_cfg(state_dir))
+    root_b = executor.build_physical(
+        lp.Sink(_pipeline(ctx_b, batches)._plan, CollectSink()), ctx_b
+    )
+    orch_b = Orchestrator(interval_s=9999)
+    coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+    assert coord_b.committed_epoch is not None  # run A's barrier is durable
+    emitted_b = {}
+    for item in root_b.run():
+        if isinstance(item, RB):
+            emitted_b.update(_collect_windows(item))
+    return golden, emitted_a, emitted_b
+
+
+def _assert_kill_restore(golden, emitted_a, emitted_b):
+    combined = dict(emitted_a)
+    combined.update(emitted_b)
+    assert set(combined) == set(golden)
+    for k in golden:
+        assert combined[k] == golden[k], (k, combined[k], golden[k])
+    # the restored run must NOT have reprocessed from scratch (unless the
+    # barrier landed before anything emitted at all)
+    assert len(emitted_b) < len(golden) or len(emitted_a) == 0
+
+
 def test_kill_and_restore(tmp_path, make_batch):
     """Crash mid-stream after a checkpoint; a fresh process-equivalent run
     resumes from the barrier and the union of emissions covers every golden
@@ -110,75 +172,17 @@ def test_kill_and_restore(tmp_path, make_batch):
         keys = np.array([f"s{i}" for i in rng.integers(0, 7, n)], dtype=object)
         batches.append(make_batch(ts, keys, rng.normal(50, 5, n)))
 
-    # golden run, no checkpointing
-    golden = _collect_windows(_pipeline(Context(), batches).collect())
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+        )
 
-    state_dir = str(tmp_path / "state")
-
-    # run A: checkpointing on, crash (abandon) after ~half the stream
-    cfg = EngineConfig(checkpoint=True, checkpoint_interval_s=9999,
-                       state_backend_path=state_dir)
-    ctx_a = Context(cfg)
-    ds_a = _pipeline(ctx_a, batches)
-
-    from denormalized_tpu.logical import plan as lp
-    from denormalized_tpu.physical.simple_execs import CollectSink
-    from denormalized_tpu.runtime import executor
-    from denormalized_tpu.state.orchestrator import Orchestrator
-    from denormalized_tpu.state.checkpoint import wire_checkpointing
-
-    sink_a = CollectSink()
-    root_a = executor.build_physical(lp.Sink(ds_a._plan, sink_a), ctx_a)
-    orch_a = Orchestrator(interval_s=9999)
-    coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
-    emitted_a = {}
-    batches_seen = 0
-    it = root_a.run()
-    for item in it:
-        from denormalized_tpu.common.record_batch import RecordBatch as RB
-        from denormalized_tpu.physical.base import Marker
-
-        if isinstance(item, RB):
-            emitted_a.update(_collect_windows(item))
-        # trigger exactly one barrier partway through (after the first
-        # mid-stream window emission, while the source is still feeding),
-        # then crash right after the marker clears the pipeline (the root
-        # commit makes the epoch durable, as the executor does)
-        if batches_seen == 1:
-            orch_a.trigger_now()
-        if isinstance(item, Marker):
-            coord_a.commit(item.epoch)
-            break
-        batches_seen += 1
-    it.close()  # crash
-    close_global_state_backend()
-
-    # run B: fresh everything, same backend path → restore + finish
-    ctx_b = Context(
-        EngineConfig(checkpoint=True, checkpoint_interval_s=9999,
-                     state_backend_path=state_dir)
+    golden, a, b = _kill_restore_roundtrip(
+        batches, make_cfg, str(tmp_path / "state")
     )
-    ds_b = _pipeline(ctx_b, batches)
-    sink_b = CollectSink()
-    root_b = executor.build_physical(lp.Sink(ds_b._plan, sink_b), ctx_b)
-    orch_b = Orchestrator(interval_s=9999)
-    coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
-    assert coord_b.committed_epoch is not None  # run A's barrier is durable
-    emitted_b = {}
-    from denormalized_tpu.common.record_batch import RecordBatch as RB
-
-    for item in root_b.run():
-        if isinstance(item, RB):
-            emitted_b.update(_collect_windows(item))
-
-    combined = dict(emitted_a)
-    combined.update(emitted_b)
-    assert set(combined) == set(golden)
-    for k in golden:
-        assert combined[k] == golden[k], (k, combined[k], golden[k])
-    # the restored run must NOT have reprocessed from scratch: run A's
-    # pre-barrier windows shouldn't all reappear in run B
-    assert len(emitted_b) < len(golden) or len(emitted_a) == 0
+    _assert_kill_restore(golden, a, b)
 
 
 def test_channel_manager_semantics():
@@ -190,3 +194,35 @@ def test_channel_manager_semantics():
     assert cm.take_receiver("t1") is None  # take-once
     cm.remove_channel("t1")
     assert cm.get_sender("t1") is None
+
+
+@pytest.mark.parametrize("strategy", ["key_sharded", "partial_final"])
+def test_kill_and_restore_sharded(tmp_path, make_batch, strategy):
+    """Checkpoint/restore must also work when window state is sharded over
+    the mesh (export → epoch snapshot → import into the sharded layout)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device platform")
+    rng = np.random.default_rng(31)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(10):
+        n = 256
+        ts = np.sort(t0 + b * 400 + rng.integers(0, 400, n))
+        keys = np.array([f"s{i}" for i in rng.integers(0, 40, n)], dtype=object)
+        batches.append(make_batch(ts, keys, rng.normal(50, 5, n)))
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+            mesh_devices=8,
+            shard_strategy=strategy,
+        )
+
+    golden, a, b = _kill_restore_roundtrip(
+        batches, make_cfg, str(tmp_path / f"state_{strategy}")
+    )
+    _assert_kill_restore(golden, a, b)
