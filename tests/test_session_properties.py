@@ -1,0 +1,121 @@
+"""Property tests for session windows vs an independent oracle: interval
+merging, watermark-driven closing, late-row dropping, EOS flush."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.sources.memory import MemorySource
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+
+
+def session_oracle(batches, gap):
+    """Independent simulation: per key, a set of open (start, last, cnt, sum)
+    sessions; a new row merges every session within `gap` in either
+    direction; sessions close when the watermark passes last+gap; rows with
+    ts+gap <= watermark are dropped."""
+    wm = None
+    open_s: dict[str, list[list]] = {}
+    closed = []
+    for ts, ks, vs in batches:
+        for t, k, v in zip(ts, ks, vs):
+            if wm is not None and t + gap <= wm:
+                continue  # late
+            merged = [t, t, 1, v]
+            keep = []
+            for s in open_s.get(k, []):
+                if t - s[1] <= gap and s[0] - t <= gap:
+                    merged[0] = min(merged[0], s[0])
+                    merged[1] = max(merged[1], s[1])
+                    merged[2] += s[2]
+                    merged[3] += s[3]
+                else:
+                    keep.append(s)
+            keep.append(merged)
+            open_s[k] = keep
+        bmin = min(ts)
+        if wm is None or bmin > wm:
+            wm = bmin
+        for k in list(open_s):
+            still = []
+            for s in open_s[k]:
+                if s[1] + gap <= wm:
+                    closed.append((k, s[0], s[1] + gap, s[2], s[3]))
+                else:
+                    still.append(s)
+            if still:
+                open_s[k] = still
+            else:
+                del open_s[k]
+    for k, lst in open_s.items():
+        for s in lst:
+            closed.append((k, s[0], s[1] + gap, s[2], s[3]))
+    return {
+        (k, start): (end, cnt, round(sm, 4))
+        for k, start, end, cnt, sm in closed
+    }
+
+
+@st.composite
+def session_case(draw):
+    gap = draw(st.sampled_from([100, 300, 700]))
+    n_batches = draw(st.integers(2, 5))
+    batches = []
+    base = 0
+    for _ in range(n_batches):
+        n = draw(st.integers(1, 20))
+        base += draw(st.integers(0, 400))
+        offs = draw(st.lists(st.integers(-200, 500), min_size=n, max_size=n))
+        ts = sorted(max(0, base + o) + T0 for o in offs)
+        ks = draw(st.lists(st.sampled_from(["a", "b"]), min_size=n, max_size=n))
+        vs = [float(i % 5) for i in range(n)]
+        batches.append((ts, ks, vs))
+    return gap, batches
+
+
+@settings(max_examples=30, deadline=None)
+@given(session_case())
+def test_session_engine_matches_oracle(case):
+    gap, raw = case
+    batches = [
+        RecordBatch(
+            SCHEMA,
+            [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        )
+        for ts, ks, vs in raw
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .session_window(
+            ["k"],
+            [F.count(col("v")).alias("cnt"), F.sum(col("v")).alias("s")],
+            gap,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        key = (res.column("k")[i], int(res.column("window_start_time")[i]))
+        assert key not in got, f"duplicate session {key}"
+        got[key] = (
+            int(res.column("window_end_time")[i]),
+            int(res.column("cnt")[i]),
+            round(float(res.column("s")[i]), 4),
+        )
+    want = session_oracle(raw, gap)
+    assert got == want, (
+        sorted(set(got) ^ set(want))[:4],
+        gap,
+    )
