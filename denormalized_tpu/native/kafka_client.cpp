@@ -407,7 +407,15 @@ void* kc_connect(const char* host, int port, char* errbuf, int errlen) {
   for (addrinfo* ai = res; ai; ai = ai->ai_next) {
     fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    // bounded connect/recv: a blackholed peer must not freeze the reader
+    // thread for the kernel's multi-minute SYN retry cycle
+    timeval conn_to{5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &conn_to, sizeof conn_to);
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      timeval io_to{30, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &io_to, sizeof io_to);
+      break;
+    }
     close(fd);
     fd = -1;
   }

@@ -174,6 +174,13 @@ class SessionWindowExec(ExecOperator):
                 m = batch.mask(e.name)
                 if m is not None:
                     valid[:, ci] = m
+        # watermark advances from the RAW batch min (late rows included —
+        # they only keep the min lower, and the reference's
+        # RecordBatchWatermark is computed over the whole batch); computing
+        # it after the late-filter would let a dropped row inflate the
+        # watermark and mis-drop later on-time rows
+        raw_min = int(ts.min())
+
         # drop late rows: their session (even as a singleton) would already
         # have closed — mirrors the fixed-window late-drop semantics
         if self._watermark is not None:
@@ -237,9 +244,8 @@ class SessionWindowExec(ExecOperator):
             self._merge_rows(key, ts_s[b0:b1], partial)
 
         # watermark advance + close expired sessions
-        bmin = int(ts.min())
-        if self._watermark is None or bmin > self._watermark:
-            self._watermark = bmin
+        if self._watermark is None or raw_min > self._watermark:
+            self._watermark = raw_min
         closed: list[tuple[tuple, _Session]] = []
         for k in list(self._sessions):
             still: list[_Session] = []

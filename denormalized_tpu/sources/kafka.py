@@ -33,6 +33,7 @@ from denormalized_tpu.formats.json_codec import (
 )
 from denormalized_tpu.native.build import load
 from denormalized_tpu.physical.simple_execs import Sink
+from denormalized_tpu.runtime.tracing import logger
 from denormalized_tpu.sources.base import (
     PartitionReader,
     Source,
@@ -110,15 +111,20 @@ class KafkaClient:
     def _err(self) -> str:
         return self._libref.kc_error(self._h).decode()
 
+    def _handle(self):
+        if not self._h:
+            raise SourceError("kafka client is closed")
+        return self._h
+
     def partition_count(self, topic: str) -> int:
-        n = self._libref.kc_partition_count(self._h, topic.encode())
+        n = self._libref.kc_partition_count(self._handle(), topic.encode())
         if n < 0:
             raise SourceError(f"metadata for {topic!r}: {self._err()}")
         return n
 
     def list_offset(self, topic: str, partition: int, ts: int) -> int:
         off = self._libref.kc_list_offset(
-            self._h, topic.encode(), partition, ts
+            self._handle(), topic.encode(), partition, ts
         )
         if off < 0:
             raise SourceError(f"list_offsets: {self._err()}")
@@ -131,7 +137,7 @@ class KafkaClient:
         offs = np.zeros(len(payloads) + 1, dtype=np.uint64)
         offs[1:] = np.cumsum([len(p) for p in payloads], dtype=np.uint64)
         rc = self._libref.kc_produce(
-            self._h,
+            self._handle(),
             topic.encode(),
             partition,
             data,
@@ -163,7 +169,7 @@ class KafkaClient:
 
     def _fetch_raw(self, topic, partition, offset, max_bytes, max_wait_ms) -> int:
         n = self._libref.kc_fetch(
-            self._h, topic.encode(), partition, offset, max_bytes, max_wait_ms
+            self._handle(), topic.encode(), partition, offset, max_bytes, max_wait_ms
         )
         if n < 0:
             raise SourceError(f"fetch: {self._err()}")
@@ -291,6 +297,59 @@ class KafkaPartitionReader(PartitionReader):
             src.builder.encoding, src.user_schema, src.builder.avro_schema
         )
         self._ts_col = src.builder.timestamp_column
+        self._consecutive_failures = 0
+
+    # transport failures are transient: log-and-retry with reconnect, like
+    # the reference's recv error handling (kafka_stream_read.rs:210-218) —
+    # only repeated failure surfaces an error (and the counter resets, so
+    # later reads keep retrying if the caller chooses to continue)
+    _MAX_CONSECUTIVE_FAILURES = 20
+    _TRANSPORT_MARKERS = ("send:", "recv:", "connect", "closed", "disconnected")
+
+    @classmethod
+    def _is_transport_error(cls, err: SourceError) -> bool:
+        msg = str(err)
+        return any(m in msg for m in cls._TRANSPORT_MARKERS)
+
+    def _handle_source_error(self, err: SourceError, max_wait: float):
+        # OFFSET_OUT_OF_RANGE (broker error 1): the committed offset fell
+        # off the log (retention / truncated restart) — honor
+        # auto.offset.reset like a real consumer instead of retrying
+        if "fetch error 1" in str(err) and self._client is not None:
+            reset = self._src.builder.opts.get("auto.offset.reset", "earliest")
+            ts = -2 if reset == "earliest" else -1
+            self._offset = self._client.list_offset(
+                self._topic, self._partition, ts
+            )
+            logger.warning(
+                "kafka %s[%d]: offset out of range — reset to %s (%d)",
+                self._topic, self._partition, reset, self._offset,
+            )
+            return RecordBatch.empty(self._src.schema)
+        if not self._is_transport_error(err):
+            raise err  # broker protocol error: not transient, surface now
+        self._consecutive_failures += 1
+        logger.warning(
+            "kafka %s[%d]: %s (attempt %d) — reconnecting",
+            self._topic, self._partition, err, self._consecutive_failures,
+        )
+        if self._consecutive_failures >= self._MAX_CONSECUTIVE_FAILURES:
+            self._consecutive_failures = 0  # future reads retry again
+            raise err
+        old = self._client
+        self._client = None  # never reuse a possibly-freed handle
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        try:
+            self._client = KafkaClient(self._src.builder.bootstrap_servers)
+        except SourceError:
+            pass  # broker still down; next read retries the reconnect
+        # bounded backoff that respects the caller's read timeout contract
+        time.sleep(min(0.05 * self._consecutive_failures, max(max_wait, 0.05)))
+        return RecordBatch.empty(self._src.schema)
 
     def _attach_ts(self, batch, kafka_ts):
         """Canonical timestamp: payload column or the broker record
@@ -313,10 +372,19 @@ class KafkaPartitionReader(PartitionReader):
         # read continues past it instead of livelocking on the same record.
         native = getattr(self._decoder, "_native", None)
         max_wait = int((timeout_s or 0.1) * 1000)
+        try:
+            return self._read_once(native, max_wait)
+        except SourceError as e:
+            return self._handle_source_error(e, timeout_s or 0.1)
+
+    def _read_once(self, native, max_wait):
+        if self._client is None:
+            raise SourceError("kafka client disconnected")
         if native is not None:
             n, bptr, optr, kafka_ts, next_off = self._client.fetch_ptrs(
                 self._topic, self._partition, self._offset, max_wait_ms=max_wait
             )
+            self._consecutive_failures = 0
             self._offset = next_off
             if n == 0:
                 return RecordBatch.empty(self._src.schema)
@@ -328,6 +396,7 @@ class KafkaPartitionReader(PartitionReader):
         payloads, kafka_ts, next_off = self._client.fetch(
             self._topic, self._partition, self._offset, max_wait_ms=max_wait
         )
+        self._consecutive_failures = 0
         # commit before decode (see above)
         self._offset = next_off
         if not payloads:

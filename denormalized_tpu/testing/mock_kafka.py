@@ -155,6 +155,7 @@ class MockKafkaBroker:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
         self.requests_served = 0
 
     @property
@@ -207,10 +208,31 @@ class MockKafkaBroker:
 
     def stop(self) -> None:
         self._stop.set()
+        # shutdown BEFORE close: close() alone does not unblock a thread
+        # parked inside accept(), and the in-flight syscall would keep the
+        # kernel listen socket alive (port stays bound forever)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
+        # also close per-connection sockets: serve threads block in recv and
+        # their ESTABLISHED sockets would keep the local port bound,
+        # preventing a restart on the same port
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -218,6 +240,8 @@ class MockKafkaBroker:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._lock:
+                self._conns.append(conn)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)
