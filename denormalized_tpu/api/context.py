@@ -11,7 +11,7 @@ accumulator dtype, state capacities, device mesh, and the checkpoint backend.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import jax.numpy as jnp
@@ -101,7 +101,7 @@ class Context:
         """Kafka source entry point (PyContext::from_topic,
         py-denormalized/src/context.rs:50-117): schema comes from an explicit
         Schema or is inferred from ``sample_json``."""
-        from denormalized_tpu.sources.kafka import KafkaSource, KafkaTopicBuilder
+        from denormalized_tpu.sources.kafka import KafkaTopicBuilder
 
         builder = (
             KafkaTopicBuilder(bootstrap_servers)

@@ -19,8 +19,6 @@ import io
 import json
 import struct
 
-import numpy as np
-
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema

@@ -12,7 +12,7 @@ construction at context.rs:65 / datastream.rs).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 from denormalized_tpu.common.constants import (

@@ -33,8 +33,6 @@ oblivious to the layout.
 from __future__ import annotations
 
 import functools
-from typing import Any
-
 import jax
 import jax.numpy as jnp
 import numpy as np

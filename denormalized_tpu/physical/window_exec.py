@@ -27,7 +27,6 @@ when the interner or the event-time skew outgrow them (bucketed static shapes
 
 from __future__ import annotations
 
-import math
 import time
 from typing import Iterator
 

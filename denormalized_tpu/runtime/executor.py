@@ -16,7 +16,6 @@ from typing import Iterator
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.logical import plan as lp
 from denormalized_tpu.physical.base import EndOfStream, ExecOperator
-from denormalized_tpu.physical.simple_execs import SourceExec
 from denormalized_tpu.planner.planner import Planner
 
 
