@@ -55,7 +55,8 @@ class EngineConfig:
     # 'auto' | 'key_sharded' | 'partial_final' (see parallel/sharded_state.py)
     shard_strategy: str = "auto"
     # single-device kernel: 'scatter' (general) | 'pallas_dense' (MXU/VPU
-    # dense path for low-cardinality aggregation; auto-falls-back)
+    # dense path for low-cardinality aggregation; auto-falls-back) | 'auto'
+    # (alias: try the dense path, fall back to scatter per batch)
     device_strategy: str = "scatter"
 
     def set(self, key: str, value) -> "EngineConfig":

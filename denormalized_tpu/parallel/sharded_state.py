@@ -87,7 +87,14 @@ class SingleDeviceWindowState(WindowStateBackend):
         self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
         min_win_rel: int | None = None, max_win_rel: int | None = None,
     ):
-        if self.device_strategy == "pallas_dense" and min_win_rel is not None:
+        # 'auto' only engages the dense path on real TPU hardware: in
+        # interpret mode (CPU) the pallas kernel is orders of magnitude
+        # slower than the scatter path, so auto means scatter there.
+        # Explicit 'pallas_dense' still honors interpret for parity tests.
+        try_dense = self.device_strategy == "pallas_dense" or (
+            self.device_strategy == "auto" and not self._pallas_interpret
+        )
+        if try_dense and min_win_rel is not None:
             from denormalized_tpu.ops import pallas_window as pw
 
             span_ok = (
@@ -457,10 +464,10 @@ def make_sharded_state(
 ) -> WindowStateBackend:
     """Pick a layout: small state → Partial/Final (duplicate it, shard rows);
     large state → key-sharded (shard it, broadcast rows)."""
-    if device_strategy not in ("scatter", "pallas_dense"):
+    if device_strategy not in ("scatter", "pallas_dense", "auto"):
         raise ValueError(
             f"unknown device strategy {device_strategy!r} "
-            "(expected 'scatter' or 'pallas_dense')"
+            "(expected 'scatter', 'pallas_dense', or 'auto')"
         )
     if mesh is None or mesh.devices.size == 1:
         return SingleDeviceWindowState(spec, device_strategy)

@@ -247,6 +247,9 @@ class MockKafkaBroker:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket):
+        # OSError (Bad file descriptor / ECONNRESET) is the normal outcome
+        # when stop() shuts the socket down under a blocked recv/sendall —
+        # treat it as end-of-connection, not a thread crash
         try:
             while not self._stop.is_set():
                 hdr = self._recv_all(conn, 4)
@@ -259,8 +262,13 @@ class MockKafkaBroker:
                 resp = self._handle(body)
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
                 self.requests_served += 1
+        except OSError:
+            return
         finally:
-            conn.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     @staticmethod
     def _recv_all(conn, n):
