@@ -1,11 +1,15 @@
 """Build-on-first-use for the native (C++) components: compiles
-``<name>.cpp`` beside this file into ``<name>.so`` with g++ when the source
-is newer, and loads it with ctypes.  Raises on failure — callers decide
-whether a pure-Python fallback exists."""
+``<name>.cpp`` beside this file into ``<name>.so`` with g++ and loads it
+with ctypes.  The rebuild trigger is a content hash of the source recorded
+in a sidecar file — NOT mtimes, which a fresh git checkout resets to the
+same instant for source and any stray binary, silently shipping a stale
+build.  Raises on failure — callers decide whether a pure-Python fallback
+exists."""
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import subprocess
 import threading
 from pathlib import Path
@@ -21,7 +25,10 @@ def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
             return _CACHE[name]
         src = _DIR / f"{name}.cpp"
         so = _DIR / f"{name}.so"
-        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+        stamp = _DIR / f"{name}.so.srchash"
+        want = hashlib.sha256(src.read_bytes()).hexdigest()
+        have = stamp.read_text().strip() if stamp.exists() else ""
+        if not so.exists() or have != want:
             cmd = [
                 "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                 str(src), "-o", str(so),
@@ -31,6 +38,7 @@ def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
                 raise RuntimeError(
                     f"native build of {name} failed:\n{proc.stderr[-2000:]}"
                 )
+            stamp.write_text(want)
         lib = ctypes.CDLL(str(so))
         _CACHE[name] = lib
         return lib

@@ -296,6 +296,198 @@ bool gunzip(const uint8_t* src, size_t n, std::vector<uint8_t>& out) {
   return rc == Z_STREAM_END;
 }
 
+// ---- snappy (Kafka codec 2) --------------------------------------------
+// Raw snappy block format: uvarint uncompressed length, then a stream of
+// literal/copy elements.  Kafka magic-2 batches carry raw snappy; legacy
+// Java producers wrapped it in xerial framing (magic "\x82SNAPPY\x00"),
+// which librdkafka also auto-detects — mirror that.
+
+bool snappy_block(const uint8_t* p, const uint8_t* end,
+                  std::vector<uint8_t>& out) {
+  // uncompressed length: plain LE base-128 varint (not zigzag)
+  uint64_t ulen = 0;
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    ulen |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+    if (shift > 35) return false;
+  }
+  if (ulen > (1u << 30)) return false;  // 1GB sanity cap
+  size_t base = out.size();
+  out.reserve(base + ulen);
+  while (p < end) {
+    uint8_t tag = *p++;
+    uint32_t type = tag & 3;
+    if (type == 0) {  // literal
+      uint32_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        uint32_t nb = len - 60;
+        if (p + nb > end) return false;
+        len = 0;
+        for (uint32_t i = 0; i < nb; i++) len |= (uint32_t)p[i] << (8 * i);
+        p += nb;
+        len += 1;
+      }
+      if (p + len > end) return false;
+      out.insert(out.end(), p, p + len);
+      p += len;
+    } else {  // copy
+      uint32_t len, off;
+      if (type == 1) {
+        if (p >= end) return false;
+        len = ((tag >> 2) & 7) + 4;
+        off = ((uint32_t)(tag >> 5) << 8) | *p++;
+      } else if (type == 2) {
+        if (p + 2 > end) return false;
+        len = (tag >> 2) + 1;
+        off = (uint32_t)p[0] | ((uint32_t)p[1] << 8);
+        p += 2;
+      } else {
+        if (p + 4 > end) return false;
+        len = (tag >> 2) + 1;
+        off = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+              ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+        p += 4;
+      }
+      size_t produced = out.size() - base;
+      if (off == 0 || off > produced) return false;
+      // reject before copying: output past the declared length is invalid,
+      // so a corrupt stream can never make us do unbounded copy work
+      if (produced + len > ulen) return false;
+      // byte-by-byte: copies may overlap their own output (RLE)
+      size_t src = out.size() - off;
+      for (uint32_t i = 0; i < len; i++) out.push_back(out[src + i]);
+    }
+  }
+  return out.size() - base == ulen;
+}
+
+bool snappy_decompress(const uint8_t* src, size_t n,
+                       std::vector<uint8_t>& out) {
+  out.clear();
+  static const uint8_t XERIAL[8] = {0x82, 'S', 'N', 'A', 'P', 'P', 'Y', 0};
+  if (n > 16 && memcmp(src, XERIAL, 8) == 0) {
+    // xerial frame: magic + version(4) + compat(4), then [len BE][block]*
+    const uint8_t* p = src + 16;
+    const uint8_t* end = src + n;
+    while (p + 4 <= end) {
+      uint32_t len = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                     ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+      p += 4;
+      if (p + len > end) return false;
+      if (!snappy_block(p, p + len, out)) return false;
+      p += len;
+    }
+    return p == end;
+  }
+  return snappy_block(src, src + n, out);
+}
+
+// ---- lz4 (Kafka codec 3) -----------------------------------------------
+// LZ4 Frame format (magic 0x184D2204) wrapping LZ4 block compression.
+// Checksums (xxhash) are skipped, not validated — the transport is TCP and
+// the decode itself bounds-checks every copy.
+
+bool lz4_block(const uint8_t* p, const uint8_t* end, std::vector<uint8_t>& out,
+               size_t base) {
+  while (p < end) {
+    uint8_t token = *p++;
+    uint32_t litlen = token >> 4;
+    if (litlen == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return false;
+        b = *p++;
+        litlen += b;
+      } while (b == 255);
+    }
+    if (p + litlen > end) return false;
+    out.insert(out.end(), p, p + litlen);
+    p += litlen;
+    if (p >= end) break;  // last sequence: literals only
+    if (p + 2 > end) return false;
+    uint32_t off = (uint32_t)p[0] | ((uint32_t)p[1] << 8);
+    p += 2;
+    uint32_t mlen = token & 0xF;
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (p >= end) return false;
+        b = *p++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    size_t produced = out.size() - base;
+    if (off == 0 || off > produced) return false;
+    // cap BEFORE the copy: a corrupt matchlength extension (runs of 0xFF)
+    // can encode ~1e9 in a few input bytes — reject it in O(1) instead of
+    // doing a gigabyte of copy work first
+    if (out.size() + mlen > (1u << 30)) return false;
+    size_t src = out.size() - off;
+    for (uint32_t i = 0; i < mlen; i++) out.push_back(out[src + i]);
+  }
+  return true;
+}
+
+bool lz4f_decompress(const uint8_t* src, size_t n,
+                     std::vector<uint8_t>& out) {
+  out.clear();
+  const uint8_t* p = src;
+  const uint8_t* end = src + n;
+  if (n < 7) return false;
+  uint32_t magic = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                   ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+  if (magic != 0x184D2204u) return false;
+  p += 4;
+  uint8_t flg = *p++;
+  p++;  // BD (block max size) — we size dynamically
+  if ((flg >> 6) != 1) return false;     // version
+  bool content_size = flg & 0x08;
+  bool block_checksum = flg & 0x10;
+  bool content_checksum = flg & 0x04;
+  bool dict_id = flg & 0x01;
+  if (content_size) p += 8;
+  if (dict_id) p += 4;
+  p += 1;  // header checksum byte
+  if (p > end) return false;
+  while (p + 4 <= end) {
+    uint32_t bsz = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                   ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+    p += 4;
+    if (bsz == 0) {  // EndMark
+      if (content_checksum) p += 4;
+      return true;
+    }
+    bool stored = bsz & 0x80000000u;
+    bsz &= 0x7FFFFFFFu;
+    if (p + bsz > end) return false;
+    if (stored) {
+      out.insert(out.end(), p, p + bsz);
+    } else {
+      // each frame block decompresses independently against the data
+      // already in `out` (blocks may reference prior blocks' output when
+      // the frame is block-linked; passing base=0 allows both modes)
+      if (!lz4_block(p, p + bsz, out, 0)) return false;
+    }
+    p += bsz;
+    if (block_checksum) p += 4;
+  }
+  return false;  // ran out of input before EndMark
+}
+
+const char* codec_name(int codec) {
+  switch (codec) {
+    case 1: return "gzip";
+    case 2: return "snappy";
+    case 3: return "lz4";
+    case 4: return "zstd";
+    default: return "unknown";
+  }
+}
+
 // parse magic-2 record batches out of a Fetch "records" blob
 bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
                        int64_t fetch_offset) {
@@ -307,42 +499,46 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
     const uint8_t* batch_end = r.p + batch_len;
     r.i32();              // partitionLeaderEpoch
     int8_t magic = r.i8();
-    if (magic != 2) {     // old formats unsupported; skip batch, but still
-      // advance past it so the consumer can't stall on this offset forever
-      if (base_offset >= fetch_offset && base_offset + 1 > c->next_offset)
-        c->next_offset = base_offset + 1;
-      r.p = batch_end;
-      continue;
+    if (magic != 2) {
+      // legacy v0/v1 message sets: error loudly — silently skipping them
+      // would be silent data loss against an old producer
+      c->error = "legacy message format magic=" + std::to_string(magic) +
+                 " at offset " + std::to_string(base_offset) +
+                 " (only magic-2 record batches are supported)";
+      return false;
     }
     r.u32();              // crc (trusted; transport is TCP)
     int16_t attrs = r.i16();
     int codec = attrs & 0x7;
-    std::vector<uint8_t> inflated;  // keeps gunzipped records alive
-    if (codec != 0 && codec != 1) {
-      // snappy/lz4/zstd — unsupported; skip the whole batch but advance
-      // the cursor past every record it covers
-      Reader peek = r;
-      int32_t lod = peek.i32();
-      int64_t past = base_offset + lod + 1;
-      if (past > c->next_offset && base_offset + lod >= fetch_offset)
-        c->next_offset = past;
-      r.p = batch_end;
-      continue;
+    std::vector<uint8_t> inflated;  // keeps decompressed records alive
+    if (codec > 3) {
+      // zstd (or future codec): no silent skip — surface the codec by
+      // name so the operator can reconfigure the producer or the topic
+      // (the reference gets all codecs from librdkafka, Cargo.toml:58)
+      c->error = std::string("unsupported compression codec ") +
+                 codec_name(codec) + " (" + std::to_string(codec) +
+                 ") in batch at offset " + std::to_string(base_offset);
+      return false;
     }
     int32_t last_offset_delta = r.i32();
     int64_t first_ts = r.i64();
     r.i64();              // maxTimestamp
     r.skip(8 + 2 + 4);    // producerId/Epoch/baseSequence
     int32_t nrec = r.i32();
-    Reader rr = r;  // records section (inline, or inflated for gzip)
-    if (codec == 1) {
-      // gzip: the records section is one gzip stream
-      if (!gunzip(r.p, (size_t)(batch_end - r.p), inflated)) {
-        int64_t past = base_offset + last_offset_delta + 1;
-        if (past > c->next_offset && past > fetch_offset)
-          c->next_offset = past;  // never stall behind a bad batch
-        r.p = batch_end;
-        continue;
+    Reader rr = r;  // records section (inline, or decompressed)
+    if (codec != 0) {
+      bool ok = false;
+      size_t comp_len = (size_t)(batch_end - r.p);
+      if (codec == 1) ok = gunzip(r.p, comp_len, inflated);
+      else if (codec == 2) ok = snappy_decompress(r.p, comp_len, inflated);
+      else ok = lz4f_decompress(r.p, comp_len, inflated);
+      if (!ok) {
+        // corrupt compressed section: error (a skip would silently drop
+        // up to last_offset_delta+1 records)
+        c->error = std::string(codec_name(codec)) +
+                   " decompression failed for batch at offset " +
+                   std::to_string(base_offset);
+        return false;
       }
       rr = Reader{inflated.data(), inflated.data() + inflated.size()};
     }
@@ -378,6 +574,17 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
       }
       if (rr.p > rec_end) rr.fail = true;
       else rr.p = rec_end;
+    }
+    if (rr.fail) {
+      // same error-loudly policy as the codec branches: a record stream
+      // that goes bad mid-batch (truncated/garbled after a successful
+      // decompress — nothing validates content checksums) must not
+      // silently drop its remaining records and advance past them.
+      // Truncated *trailing* batches from a maxBytes cut never get here:
+      // the outer loop breaks on r.p + batch_len > blob_end above.
+      c->error = "corrupt record data in batch at offset " +
+                 std::to_string(base_offset);
+      return false;
     }
     // safety net for empty/odd batches: never stall behind a consumed batch
     int64_t past = base_offset + last_offset_delta + 1;
@@ -594,7 +801,8 @@ int kc_fetch(void* h, const char* topic, int partition, int64_t offset,
         c->error = "fetch error " + std::to_string(err);
         return -1;
       }
-      if (blob_len > 0) parse_record_sets(c, r, blob_len, offset);
+      if (blob_len > 0 && !parse_record_sets(c, r, blob_len, offset))
+        return -1;
     }
   }
   if (r.fail) {

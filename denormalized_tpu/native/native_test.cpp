@@ -17,6 +17,7 @@
 // single-TU build: include the component sources directly
 #include "interner.cpp"
 #include "json_parser.cpp"
+#include "kafka_client.cpp"
 #include "lsmkv.cpp"
 
 static void test_lsm(const char* dir) {
@@ -125,11 +126,83 @@ static void test_json() {
   printf("json ok\n");
 }
 
+static void test_codecs() {
+  // valid raw-snappy: "hellohellohello!" via literal + overlapping copy
+  std::string want = "hellohellohello!";
+  std::vector<uint8_t> sn;
+  sn.push_back((uint8_t)want.size());     // uvarint len (16)
+  sn.push_back((5 - 1) << 2);             // literal "hello"
+  sn.insert(sn.end(), want.begin(), want.begin() + 5);
+  sn.push_back(((10 - 4) << 2) | 1);      // type-1 copy off=5 len=10
+  sn.push_back(5);
+  sn.push_back((1 - 1) << 2);             // literal "!"
+  sn.push_back('!');
+  std::vector<uint8_t> out;
+  assert(snappy_decompress(sn.data(), sn.size(), out));
+  assert(std::string(out.begin(), out.end()) == want);
+
+  // valid lz4 frame: one block, literals + match(off=2,len=8) + literals
+  std::string lw = "ababababab-tail";
+  std::vector<uint8_t> blk;
+  blk.push_back((2 << 4) | (8 - 4));      // lit 2, match 8
+  blk.push_back('a');
+  blk.push_back('b');
+  blk.push_back(2);                       // offset LE16 = 2
+  blk.push_back(0);
+  blk.push_back(5 << 4);                  // last sequence: 5 literals
+  const char* tail = "-tail";
+  blk.insert(blk.end(), tail, tail + 5);
+  std::vector<uint8_t> fr;
+  uint32_t magic = 0x184D2204u;
+  for (int i = 0; i < 4; i++) fr.push_back((uint8_t)(magic >> (8 * i)));
+  fr.push_back(0x40);  // FLG v1
+  fr.push_back(0x40);  // BD
+  fr.push_back(0x00);  // header checksum (not validated)
+  uint32_t bsz = (uint32_t)blk.size();
+  for (int i = 0; i < 4; i++) fr.push_back((uint8_t)(bsz >> (8 * i)));
+  fr.insert(fr.end(), blk.begin(), blk.end());
+  for (int i = 0; i < 4; i++) fr.push_back(0);  // EndMark
+  out.clear();
+  assert(lz4f_decompress(fr.data(), fr.size(), out));
+  assert(std::string(out.begin(), out.end()) == lw);
+
+  // sanitizer fuzz: every truncation and every single-byte corruption of
+  // the valid streams must return cleanly (true or false), never read or
+  // write out of bounds — this is untrusted broker data
+  auto hammer = [&](const std::vector<uint8_t>& v,
+                    bool (*fn)(const uint8_t*, size_t,
+                               std::vector<uint8_t>&)) {
+    std::vector<uint8_t> o;
+    for (size_t n = 0; n <= v.size(); n++) fn(v.data(), n, o);
+    std::vector<uint8_t> m;
+    for (size_t i = 0; i < v.size(); i++)
+      for (uint8_t x : {0xFF, 0x80, 0x01, 0x00}) {
+        m = v;
+        m[i] ^= x;
+        fn(m.data(), m.size(), o);
+      }
+  };
+  hammer(sn, snappy_decompress);
+  hammer(fr, lz4f_decompress);
+  // xerial-framed snappy, same hammering
+  std::vector<uint8_t> xr = {0x82, 'S', 'N', 'A', 'P', 'P', 'Y', 0,
+                             0, 0, 0, 1, 0, 0, 0, 1};
+  uint32_t bl = (uint32_t)sn.size();
+  for (int i = 3; i >= 0; i--) xr.push_back((uint8_t)(bl >> (8 * i)));
+  xr.insert(xr.end(), sn.begin(), sn.end());
+  out.clear();
+  assert(snappy_decompress(xr.data(), xr.size(), out));
+  assert(std::string(out.begin(), out.end()) == want);
+  hammer(xr, snappy_decompress);
+  printf("codecs ok\n");
+}
+
 int main(int argc, char** argv) {
   const char* dir = argc > 1 ? argv[1] : "/tmp/native_test_lsm";
   test_lsm(dir);
   test_interner();
   test_json();
+  test_codecs();
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;
 }

@@ -22,7 +22,7 @@ import time
 
 import numpy as np
 
-from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.common.errors import FormatError, SourceError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
@@ -368,14 +368,43 @@ class KafkaPartitionReader(PartitionReader):
     def read(self, timeout_s: float | None = None):
         # zero-copy hot path: flat-JSON schemas parse straight from the
         # fetch arena (no Python payload objects).  The offset is committed
-        # BEFORE decoding, so a poison payload raises once and the next
-        # read continues past it instead of livelocking on the same record.
+        # BEFORE decoding; a poison payload is salvaged per-record (below)
+        # so the stream — and the offsets the checkpoint persists — keep
+        # progressing past it without dropping its co-fetched good records.
         native = getattr(self._decoder, "_native", None)
         max_wait = int((timeout_s or 0.1) * 1000)
         try:
             return self._read_once(native, max_wait)
         except SourceError as e:
             return self._handle_source_error(e, timeout_s or 0.1)
+
+    def _salvage_decode(self, payloads, kafka_ts, err):
+        """A poison payload in the fetch: decode per-record and skip ONLY
+        the undecodable ones.  Raising instead would abort the query with
+        the advanced offset never checkpointed — a crash loop on restart —
+        and dropping the whole fetch would lose up to 4MB of good records
+        alongside one bad byte."""
+        good, keep, first_err = [], [], err
+        for i, p in enumerate(payloads):
+            try:
+                self._decoder.push(p)
+                b = self._decoder.flush()
+            except FormatError as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            if b.num_rows:
+                good.append(b)
+                keep.append(i)
+        logger.warning(
+            "kafka %s[%d]: skipped %d undecodable record(s) at offsets "
+            "<%d: %s",
+            self._topic, self._partition, len(payloads) - len(keep),
+            self._offset, first_err,
+        )
+        if not good:
+            return None, kafka_ts[:0]
+        return RecordBatch.concat(good), kafka_ts[np.asarray(keep)]
 
     def _read_once(self, native, max_wait):
         if self._client is None:
@@ -388,7 +417,17 @@ class KafkaPartitionReader(PartitionReader):
             self._offset = next_off
             if n == 0:
                 return RecordBatch.empty(self._src.schema)
-            batch, kafka_ts = parse_fetch_arena(native, n, bptr, optr, kafka_ts)
+            try:
+                batch, kafka_ts = parse_fetch_arena(
+                    native, n, bptr, optr, kafka_ts
+                )
+            except FormatError as e:
+                offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
+                raw = ctypes.string_at(bptr, int(offs[-1]))
+                payloads = [
+                    raw[offs[i] : offs[i + 1]] for i in range(n)
+                ]
+                batch, kafka_ts = self._salvage_decode(payloads, kafka_ts, e)
             if batch is None:
                 return RecordBatch.empty(self._src.schema)
             return self._attach_ts(batch, kafka_ts)
@@ -410,9 +449,14 @@ class KafkaPartitionReader(PartitionReader):
             payloads = [payloads[i] for i in keep]
             if not payloads:
                 return RecordBatch.empty(self._src.schema)
-        for p in payloads:
-            self._decoder.push(p)
-        batch = self._decoder.flush()
+        try:
+            for p in payloads:
+                self._decoder.push(p)
+            batch = self._decoder.flush()
+        except FormatError as e:
+            batch, kafka_ts = self._salvage_decode(payloads, kafka_ts, e)
+            if batch is None:
+                return RecordBatch.empty(self._src.schema)
         return self._attach_ts(batch, kafka_ts)
 
     def offset_snapshot(self) -> dict:

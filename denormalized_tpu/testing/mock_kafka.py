@@ -60,21 +60,9 @@ def _crc32c(data: bytes) -> int:
     return c ^ 0xFFFFFFFF
 
 
-def build_record_batch(
-    base_offset: int,
-    records: list[tuple[int, bytes]],
-    compute_crc: bool = True,
-    gzip_codec: bool = False,
-) -> bytes:
-    """magic-2 batch from [(timestamp_ms, payload)].
-
-    ``compute_crc=False`` writes a zero CRC — the embedded broker serves
-    high-volume benchmark fetches this way (our native client, like the
-    brokers themselves on read, trusts the TCP transport); codec tests use
-    the real CRC32C.  ``gzip_codec=True`` compresses the records section
-    (Kafka compression attribute 1)."""
-    import gzip as _gzip
-
+def encode_records(records: list[tuple[int, bytes]]) -> bytes:
+    """The uncompressed records section of a magic-2 batch — exposed so
+    codec tests can craft hand-compressed variants of a known section."""
     first_ts = records[0][0] if records else 0
     recs = bytearray()
     for i, (ts, payload) in enumerate(records):
@@ -88,12 +76,115 @@ def build_record_batch(
         rec += _zz_enc(0)  # headers
         recs += _zz_enc(len(rec))
         recs += rec
+    return bytes(recs)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Minimal raw-snappy encoder: uvarint length + literal elements only
+    (valid snappy — real encoders add copy elements, which the decoder
+    tests exercise with hand-crafted streams)."""
+    out = bytearray()
+    n = len(data)
+    while True:  # uvarint uncompressed length
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            break
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos : pos + 60]
+        out.append((len(chunk) - 1) << 2)  # literal, length ≤ 60 inline
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
+
+
+def xerial_snappy_compress(data: bytes) -> bytes:
+    """Legacy Java-producer framing: magic header + [len BE][raw block]*."""
+    block = snappy_compress(data)
+    return (
+        b"\x82SNAPPY\x00"
+        + struct.pack(">ii", 1, 1)
+        + struct.pack(">i", len(block))
+        + block
+    )
+
+
+def lz4_frame_compress(data: bytes) -> bytes:
+    """Minimal LZ4 frame: v1 header, literal-only compressed blocks, EndMark.
+    Valid LZ4 (all-literals sequences), no xxhash checksums."""
+    out = bytearray()
+    out += struct.pack("<I", 0x184D2204)  # magic
+    out += bytes([0x40, 0x40, 0x00])  # FLG(v1), BD(64KB), header checksum*
+    # *our decoder (and this encoder's consumers) skip the HC byte
+    pos = 0
+    while pos < len(data):
+        lit = data[pos : pos + 65536 - 16]
+        pos += len(lit)
+        block = bytearray()
+        llen = len(lit)
+        token_lit = min(llen, 15)
+        block.append(token_lit << 4)
+        if token_lit == 15:
+            rest = llen - 15
+            while rest >= 255:
+                block.append(255)
+                rest -= 255
+            block.append(rest)
+        block += lit
+        out += struct.pack("<I", len(block))
+        out += block
+    out += struct.pack("<I", 0)  # EndMark
+    return bytes(out)
+
+
+# Kafka compression attribute values → encoder
+_CODEC_COMPRESS = {
+    1: lambda d: __import__("gzip").compress(d),
+    2: snappy_compress,
+    3: lz4_frame_compress,
+}
+
+
+def _zstd_compress(data: bytes) -> bytes:
+    import zstandard
+
+    return zstandard.ZstdCompressor().compress(data)
+
+
+_CODEC_COMPRESS[4] = _zstd_compress
+
+
+def build_record_batch(
+    base_offset: int,
+    records: list[tuple[int, bytes]],
+    compute_crc: bool = True,
+    gzip_codec: bool = False,
+    codec: int = 0,
+    compressed_records: bytes | None = None,
+) -> bytes:
+    """magic-2 batch from [(timestamp_ms, payload)].
+
+    ``compute_crc=False`` writes a zero CRC — the embedded broker serves
+    high-volume benchmark fetches this way (our native client, like the
+    brokers themselves on read, trusts the TCP transport); codec tests use
+    the real CRC32C.  ``codec`` is the Kafka compression attribute
+    (0=none 1=gzip 2=snappy 3=lz4 4=zstd); ``gzip_codec=True`` is the
+    legacy alias for codec=1.  ``compressed_records`` overrides the records
+    section verbatim (for hand-crafted compressed streams)."""
     if gzip_codec:
-        recs = bytearray(_gzip.compress(bytes(recs)))
+        codec = 1
+    first_ts = records[0][0] if records else 0
+    recs = bytearray(encode_records(records))
+    if compressed_records is not None:
+        recs = bytearray(compressed_records)
+    elif codec:
+        recs = bytearray(_CODEC_COMPRESS[codec](bytes(recs)))
     max_ts = max((ts for ts, _ in records), default=0)
     body = bytearray()
     body += struct.pack(
-        ">hiqqqhii", 1 if gzip_codec else 0, len(records) - 1, first_ts,
+        ">hiqqqhii", codec, len(records) - 1, first_ts,
         max_ts, -1, -1, -1, len(records),
     )
     body += recs
@@ -170,10 +261,13 @@ class MockKafkaBroker:
 
     def produce(
         self, topic: str, partition: int, payloads, ts_ms=None,
-        gzip_codec: bool = False,
+        gzip_codec: bool = False, codec: int = 0,
+        compressed_records: bytes | None = None,
     ):
-        """Direct (no-wire) produce, handy for tests.  ``gzip_codec`` stores
-        gzip-compressed batches (clients must inflate on fetch)."""
+        """Direct (no-wire) produce, handy for tests.  ``codec`` stores
+        compressed batches (clients must decompress on fetch);
+        ``compressed_records`` plants a verbatim records section (paired
+        with the single payload expected to decode from it)."""
         ts = ts_ms if ts_ms is not None else int(time.time() * 1000)
         with self._lock:
             self._npartitions.setdefault(topic, max(partition + 1, 1))
@@ -181,7 +275,8 @@ class MockKafkaBroker:
             for p in payloads:
                 o = len(log)
                 enc = build_record_batch(
-                    o, [(ts, p)], compute_crc=False, gzip_codec=gzip_codec
+                    o, [(ts, p)], compute_crc=False, gzip_codec=gzip_codec,
+                    codec=codec, compressed_records=compressed_records,
                 )
                 log.append((o, ts, p, enc))
 

@@ -25,7 +25,7 @@ def test_native_components(tmp_path, flags):
     exe = tmp_path / "native_test"
     build = subprocess.run(
         ["g++", "-std=c++17", "-g", *flags,
-         str(NATIVE / "native_test.cpp"), "-o", str(exe)],
+         str(NATIVE / "native_test.cpp"), "-o", str(exe), "-lz"],
         capture_output=True,
         text=True,
         cwd=NATIVE,
