@@ -77,6 +77,16 @@ class Context:
         self._tables: dict[str, Source] = {}
         self._orchestrator = None
 
+    def __repr__(self) -> str:
+        """String representation (reference context.py:16-30)."""
+        return (
+            f"Context(tables=[{', '.join(sorted(self._tables))}], "
+            f"checkpoint={self.config.checkpoint})"
+        )
+
+    def __str__(self) -> str:
+        return self.__repr__()
+
     # -- registration (Context::from_topic, context.rs:65-72) -----------
     def register_source(self, name: str, source: Source) -> None:
         self._tables[name] = source

@@ -29,6 +29,22 @@ class DataStream:
     def schema(self) -> Schema:
         return self._plan.schema.without_internal()
 
+    def __repr__(self) -> str:
+        """String representation (reference data_stream.py:28-34)."""
+        fields = ", ".join(
+            f"{f.name}: {f.dtype.name.lower()}" for f in self.schema()
+        )
+        return f"DataStream[{type(self._plan).__name__}]({fields})"
+
+    def __str__(self) -> str:
+        return self.__repr__()
+
+    def print_schema(self) -> "DataStream":
+        """Print the schema and return self for chaining
+        (reference data_stream.py:187-193)."""
+        print(self.schema())
+        return self
+
     def logical_plan(self) -> lp.LogicalPlan:
         return self._plan
 

@@ -158,6 +158,39 @@ bool parse_string(Cursor& c, std::string& out) {
   return false;
 }
 
+// copy one numeric token into a NUL-terminated buffer, advancing the
+// cursor past it; returns the token length (0 = no token).  Scanning stops
+// at c.end or the first non-number char, so strtoll/strtod never touch the
+// (non-NUL-terminated) arena directly.  Tokens longer than the stack
+// buffer spill into `big` (rare: legal JSON numbers of arbitrary
+// precision) — *out points at whichever buffer holds the token.
+size_t scan_number(Cursor& c, char* buf, size_t bufsize, std::string& big,
+                   const char** out) {
+  size_t n = 0;
+  big.clear();
+  while (c.p < c.end) {
+    uint8_t ch = *c.p;
+    bool numchar = (ch >= '0' && ch <= '9') || ch == '-' || ch == '+' ||
+                   ch == '.' || ch == 'e' || ch == 'E';
+    if (!numchar) break;
+    if (n + 1 < bufsize) {
+      buf[n] = (char)ch;
+    } else {
+      if (big.empty()) big.assign(buf, n);
+      big.push_back((char)ch);
+    }
+    n++;
+    c.p++;
+  }
+  if (!big.empty()) {
+    *out = big.c_str();
+    return n;
+  }
+  buf[n] = '\0';
+  *out = buf;
+  return n;
+}
+
 // skip any JSON value (for unknown keys)
 bool skip_value(Cursor& c) {
   c.ws();
@@ -280,20 +313,30 @@ int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
             }
           } else {
             switch (col.type) {
+              // numeric tokens are copied into a bounded NUL-terminated
+              // local buffer first: strtoll/strtod scan until NUL, and the
+              // fetch arena is NOT NUL-terminated — a payload truncated
+              // mid-number at the arena's end would let them read past it
               case 0: {
+                char numbuf[48];
+                std::string big;
+                const char* tok = nullptr;
+                size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
                 char* endp = nullptr;
-                long long v = strtoll((const char*)c.p, &endp, 10);
-                if (endp == (const char*)c.p) { c.fail = true; }
-                c.p = (const uint8_t*)endp;
+                long long v = tl ? strtoll(tok, &endp, 10) : 0;
+                if (tl == 0 || endp == tok) { c.fail = true; }
                 col.i64.push_back(v);
                 col.valid.push_back(1);
                 break;
               }
               case 1: {
+                char numbuf[48];
+                std::string big;
+                const char* tok = nullptr;
+                size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
                 char* endp = nullptr;
-                double v = strtod((const char*)c.p, &endp);
-                if (endp == (const char*)c.p) { c.fail = true; }
-                c.p = (const uint8_t*)endp;
+                double v = tl ? strtod(tok, &endp) : 0.0;
+                if (tl == 0 || endp == tok) { c.fail = true; }
                 col.f64.push_back(v);
                 col.valid.push_back(1);
                 break;

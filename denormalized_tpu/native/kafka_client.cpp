@@ -19,6 +19,7 @@
 // (callers hold one client per partition reader, mirroring rdkafka's
 // per-consumer model).
 
+#include <algorithm>
 #include <arpa/inet.h>
 #include <cerrno>
 #include <cstdint>
@@ -316,7 +317,11 @@ bool snappy_block(const uint8_t* p, const uint8_t* end,
   }
   if (ulen > (1u << 30)) return false;  // 1GB sanity cap
   size_t base = out.size();
-  out.reserve(base + ulen);
+  // reserve bounded by what the input could plausibly expand to, NOT the
+  // corruption-controlled ulen alone — a crafted 10-byte stream declaring
+  // ulen=1GB must not allocate a gigabyte before validation rejects it
+  size_t n = (size_t)(end - p);
+  out.reserve(base + (size_t)std::min<uint64_t>(ulen, n * 64 + 4096));
   while (p < end) {
     uint8_t tag = *p++;
     uint32_t type = tag & 3;

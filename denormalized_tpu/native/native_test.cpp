@@ -122,6 +122,27 @@ static void test_json() {
   uint64_t boffs[2] = {0, bad.size()};
   assert(jp_parse(p, (const uint8_t*)bad.data(), boffs, 1) == -1);
   assert(strlen(jp_error(p)) > 0);
+  // payload truncated MID-NUMBER at the exact end of the arena: the number
+  // scan must stop at the boundary (ASan redzones on the heap-exact buffer
+  // catch any strtoll/strtod overread) and the row must error cleanly
+  for (const char* t : {"{\"a\": 123", "{\"f\": -1.5e", "{\"a\": "}) {
+    jp_clear(p);
+    std::string tr = t;
+    std::vector<uint8_t> exact(tr.begin(), tr.end());
+    uint64_t toffs[2] = {0, tr.size()};
+    assert(jp_parse(p, exact.data(), toffs, 1) == -1);
+  }
+  // a long-but-legal numeric token (>47 chars) still parses — arbitrary
+  // precision decimals are valid JSON
+  {
+    jp_clear(p);
+    std::string lng =
+        "{\"a\": 7, \"s\": \"x\", \"f\": 1" + std::string(60, '0') + ".5}";
+    std::vector<uint8_t> exact(lng.begin(), lng.end());
+    uint64_t loffs[2] = {0, lng.size()};
+    assert(jp_parse(p, exact.data(), loffs, 1) == 0);
+    assert(jp_col_f64(p, 2)[0] == 1e60);
+  }
   jp_destroy(p);
   printf("json ok\n");
 }

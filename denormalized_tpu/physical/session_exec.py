@@ -181,10 +181,52 @@ class SessionWindowExec(ExecOperator):
         # watermark and mis-drop later on-time rows
         raw_min = int(ts.min())
 
-        # drop late rows: their session (even as a singleton) would already
-        # have closed — mirrors the fixed-window late-drop semantics
+        # late rows: a row with ts+gap <= watermark would close as a
+        # singleton — but if it lies within gap of a STILL-OPEN session for
+        # its key it belongs to that session (Flink event-time session
+        # semantics: the merged session closes later).  So salvage
+        # open-session-mergeable rows and drop only true closed singletons.
         if self._watermark is not None:
             late = ts + self.gap_ms <= self._watermark
+            if late.any():
+                # decide per-row in ARRIVAL order against a live interval
+                # view that also tracks this batch's on-time rows for the
+                # affected keys: an earlier row (late or on-time) can extend
+                # a session into range of a later late row, exactly as
+                # row-at-a-time processing would.  Kept rows then flow
+                # through the normal segment/merge machinery, which
+                # reproduces the same merged aggregates.
+                gap_ms = self.gap_ms
+                late_keys = {
+                    tuple(kc[i] for kc in key_cols)
+                    for i in np.nonzero(late)[0]
+                }
+                views = {
+                    k: [[s.start, s.last] for s in self._sessions.get(k, ())]
+                    for k in late_keys
+                }
+                for i in range(n):
+                    key = tuple(kc[i] for kc in key_cols)
+                    iv_list = views.get(key)
+                    if iv_list is None:
+                        continue
+                    t = int(ts[i])
+                    hit = [
+                        iv
+                        for iv in iv_list
+                        if t - iv[1] <= gap_ms and iv[0] - t <= gap_ms
+                    ]
+                    if late[i]:
+                        if not hit:
+                            continue  # true closed singleton: stays dropped
+                        late[i] = False
+                    merged = [
+                        min([t] + [iv[0] for iv in hit]),
+                        max([t] + [iv[1] for iv in hit]),
+                    ]
+                    views[key] = [
+                        iv for iv in iv_list if iv not in hit
+                    ] + [merged]
             n_late = int(late.sum())
             if n_late:
                 self._metrics["late_rows"] += n_late

@@ -385,11 +385,15 @@ class KafkaPartitionReader(PartitionReader):
         and dropping the whole fetch would lose up to 4MB of good records
         alongside one bad byte."""
         good, keep, first_err = [], [], err
+        n_bad = 0
         for i, p in enumerate(payloads):
+            if not p:
+                continue  # tombstone: no data to lose, not "undecodable"
             try:
                 self._decoder.push(p)
                 b = self._decoder.flush()
             except FormatError as e:
+                n_bad += 1
                 if first_err is None:
                     first_err = e
                 continue
@@ -399,8 +403,7 @@ class KafkaPartitionReader(PartitionReader):
         logger.warning(
             "kafka %s[%d]: skipped %d undecodable record(s) at offsets "
             "<%d: %s",
-            self._topic, self._partition, len(payloads) - len(keep),
-            self._offset, first_err,
+            self._topic, self._partition, n_bad, self._offset, first_err,
         )
         if not good:
             return None, kafka_ts[:0]

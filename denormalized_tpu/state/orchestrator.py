@@ -49,6 +49,20 @@ class Orchestrator:
         self._control_tag = f"{ORCHESTRATOR_TAG}_{Orchestrator._seq}"
         self._control = cm.create_channel(self._control_tag)
         self.epochs_sent = 0
+        self._last_epoch = 0
+        self._epoch_lock = threading.Lock()
+
+    def _next_epoch(self) -> int:
+        """Strictly increasing epoch: wall-clock millis, bumped past the
+        previous value when two barriers land in the same millisecond (or
+        the clock steps back) — identical epochs would collide checkpoint
+        keys ``{key}@{epoch}`` across distinct cuts and double-count in the
+        join's per-epoch marker alignment.  Locked: trigger_now runs on the
+        caller's thread concurrently with the cadence thread."""
+        with self._epoch_lock:
+            e = max(self._last_epoch + 1, int(time.time() * 1000))
+            self._last_epoch = e
+            return e
 
     def register(self, tag: str) -> cm.Channel:
         """Register a stream; returns its barrier channel (sources poll it)."""
@@ -73,7 +87,7 @@ class Orchestrator:
                     self._registered.add(msg.tag)
             if time.monotonic() - last >= self.interval_s:
                 last = time.monotonic()
-                epoch = int(time.time() * 1000)
+                epoch = self._next_epoch()
                 for tag in list(self._registered):
                     ch = cm.get_sender(tag)
                     if ch is not None:
@@ -89,7 +103,7 @@ class Orchestrator:
                 break
             if isinstance(msg, RegisterStream):
                 self._registered.add(msg.tag)
-        epoch = int(time.time() * 1000)
+        epoch = self._next_epoch()
         for tag in list(self._registered):
             ch = cm.get_sender(tag)
             if ch is not None:

@@ -139,21 +139,15 @@ def lz4_frame_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
-# Kafka compression attribute values → encoder
+# Kafka compression attribute values → encoder.  zstd is deliberately
+# absent: the client rejects codec 4 by id before ever decompressing, so
+# tests plant an arbitrary `compressed_records` instead of needing a real
+# zstd encoder (and the image's optional zstandard module).
 _CODEC_COMPRESS = {
     1: lambda d: __import__("gzip").compress(d),
     2: snappy_compress,
     3: lz4_frame_compress,
 }
-
-
-def _zstd_compress(data: bytes) -> bytes:
-    import zstandard
-
-    return zstandard.ZstdCompressor().compress(data)
-
-
-_CODEC_COMPRESS[4] = _zstd_compress
 
 
 def build_record_batch(

@@ -418,7 +418,10 @@ def test_zstd_batch_surfaces_named_error(broker):
     from denormalized_tpu.common.errors import SourceError
 
     broker.create_topic("zs", partitions=1)
-    broker.produce("zs", 0, [b'{"i": 1}'], ts_ms=1, codec=4)
+    # the client rejects codec 4 by id before decompressing, so the records
+    # section can be arbitrary bytes — no zstd encoder needed
+    broker.produce("zs", 0, [b'{"i": 1}'], ts_ms=1, codec=4,
+                   compressed_records=b"\x28\xb5\x2f\xfd")
     c = KafkaClient(broker.bootstrap)
     with pytest.raises(SourceError, match="zstd"):
         c.fetch("zs", 0, 0, max_wait_ms=10)

@@ -99,6 +99,60 @@ def test_session_late_rows_dropped_and_counted():
     assert by_key["a"] == 1.0  # late 99.0 not included
 
 
+def test_session_late_row_merging_open_session_is_kept():
+    """Flink event-time semantics: gap=10s, open session for `a` with
+    last=100s, watermark=105s — a row at ts=90s has ts+gap <= wm but lies
+    within gap of the open session, so it merges (the merged session closes
+    at 110s) instead of being dropped as a closed singleton."""
+    t0 = 1_700_000_000_000
+    batches = [
+        kv([t0 + 100_000], ["a"], [1.0]),  # open session last=100s
+        kv([t0 + 105_000], ["w"], [0.0]),  # wm → 105s (a still open)
+        kv([t0 + 90_000, t0 + 106_000], ["a", "w"], [5.0, 0.0]),  # 90s late
+        kv([t0 + 125_000], ["w"], [0.0]),  # wm → 125s, a closes
+    ]
+    res = run_session(batches, gap_ms=10_000)
+    by_key = {
+        res.column("k")[i]: (
+            int(res.column("cnt")[i]),
+            float(res.column("s")[i]),
+            int(res.column("window_start_time")[i]) - t0,
+            int(res.column("window_end_time")[i]) - t0,
+        )
+        for i in range(res.num_rows)
+        if res.column("k")[i] == "a"
+    }
+    assert by_key["a"] == (2, 6.0, 90_000, 110_000), by_key
+
+
+def test_session_late_chain_to_open_session_is_kept():
+    """A late row that reaches the open session only THROUGH another
+    salvaged late row arriving earlier in the same batch is also kept
+    (matches row-at-a-time processing in arrival order)."""
+    t0 = 1_700_000_000_000
+    batches = [
+        kv([t0 + 100_000], ["a"], [1.0]),
+        kv([t0 + 105_000], ["w"], [0.0]),  # wm → 105s
+        # 82s is NOT within 10s of [100s, 100s], but 91s (arriving first)
+        # is — after 91s merges, the session spans [91s, 100s] and 82s is
+        # within gap of it
+        kv([t0 + 91_000, t0 + 82_000, t0 + 106_000], ["a", "a", "w"],
+           [5.0, 3.0, 0.0]),
+        kv([t0 + 125_000], ["w"], [0.0]),
+    ]
+    res = run_session(batches, gap_ms=10_000)
+    by_key = {
+        res.column("k")[i]: (
+            int(res.column("cnt")[i]),
+            float(res.column("s")[i]),
+            int(res.column("window_start_time")[i]) - t0,
+        )
+        for i in range(res.num_rows)
+        if res.column("k")[i] == "a"
+    }
+    assert by_key["a"] == (3, 9.0, 82_000), by_key
+
+
 def test_partial_final_non_pow2_mesh(make_batch):
     import jax
 

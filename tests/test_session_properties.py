@@ -23,15 +23,21 @@ T0 = 1_700_000_000_000
 def session_oracle(batches, gap):
     """Independent simulation: per key, a set of open (start, last, cnt, sum)
     sessions; a new row merges every session within `gap` in either
-    direction; sessions close when the watermark passes last+gap; rows with
-    ts+gap <= watermark are dropped."""
+    direction; sessions close when the watermark passes last+gap.  A row
+    with ts+gap <= watermark is dropped ONLY if it would be a closed
+    singleton — if it lies within gap of a still-open session it merges
+    into it (Flink event-time session semantics)."""
     wm = None
     open_s: dict[str, list[list]] = {}
     closed = []
     for ts, ks, vs in batches:
         for t, k, v in zip(ts, ks, vs):
             if wm is not None and t + gap <= wm:
-                continue  # late
+                if not any(
+                    t - s[1] <= gap and s[0] - t <= gap
+                    for s in open_s.get(k, [])
+                ):
+                    continue  # late closed singleton: dropped
             merged = [t, t, 1, v]
             keep = []
             for s in open_s.get(k, []):
