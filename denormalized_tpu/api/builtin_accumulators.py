@@ -1,0 +1,166 @@
+"""Built-in non-decomposable aggregates, implemented on the Accumulator
+protocol and routed through :class:`UdafWindowExec`'s host frame path.
+
+These are the aggregates that cannot decompose into the device kernel's
+running components (sum/count/min/max/moments): exact order statistics,
+value collection, and sketches.  The reference gets them from DataFusion
+(`array_agg` with checkpoint serialization is prototyped at
+crates/core/src/accumulators/serializable_accumulator.rs:10-68); ours
+checkpoint through the same ``state()``/``merge()`` contract every user
+UDAF uses, so kill/restore covers them for free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+
+import numpy as np
+
+from denormalized_tpu.api.udaf import Accumulator
+
+
+def _jsonable_scalar(x):
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, (np.str_,)):
+        return str(x)
+    return x
+
+
+class ArrayAggAccumulator(Accumulator):
+    """Collect every value into a list (reference
+    serializable_accumulator.rs:10-68 — the one accumulator it ships
+    checkpoint serialization for)."""
+
+    def __init__(self):
+        self.values: list = []
+
+    def update(self, col: np.ndarray) -> None:
+        self.values.extend(_jsonable_scalar(v) for v in col.tolist())
+
+    def merge(self, state) -> None:
+        self.values.extend(state[0])
+
+    def state(self) -> list:
+        return [list(self.values)]
+
+    def evaluate(self):
+        return list(self.values)
+
+
+class MedianAccumulator(Accumulator):
+    """Exact median (DataFusion `median`); state is the value list."""
+
+    def __init__(self):
+        self.values: list[float] = []
+
+    def update(self, col: np.ndarray) -> None:
+        self.values.extend(float(v) for v in np.asarray(col, np.float64))
+
+    def merge(self, state) -> None:
+        self.values.extend(state[0])
+
+    def state(self) -> list:
+        return [list(self.values)]
+
+    def evaluate(self):
+        return float(np.median(self.values)) if self.values else math.nan
+
+
+class FirstValueAccumulator(Accumulator):
+    """First value in arrival order (DataFusion `first_value` with no
+    explicit ordering: pick-any-deterministic)."""
+
+    def __init__(self):
+        self.value = None
+        self.seen = False
+
+    def update(self, col: np.ndarray) -> None:
+        if not self.seen and len(col):
+            self.value = _jsonable_scalar(col[0])
+            self.seen = True
+
+    def merge(self, state) -> None:
+        if not self.seen and state[1]:
+            self.value, self.seen = state[0], True
+
+    def state(self) -> list:
+        return [self.value, self.seen]
+
+    def evaluate(self):
+        return self.value
+
+
+class LastValueAccumulator(Accumulator):
+    def __init__(self):
+        self.value = None
+        self.seen = False
+
+    def update(self, col: np.ndarray) -> None:
+        if len(col):
+            self.value = _jsonable_scalar(col[-1])
+            self.seen = True
+
+    def merge(self, state) -> None:
+        if state[1]:
+            self.value, self.seen = state[0], True
+
+    def state(self) -> list:
+        return [self.value, self.seen]
+
+    def evaluate(self):
+        return self.value
+
+
+class ApproxDistinctAccumulator(Accumulator):
+    """HyperLogLog distinct-count sketch (DataFusion `approx_distinct`).
+
+    2^11 registers (~1.6% standard error), 64-bit stable hash
+    (blake2b — NOT Python's salted ``hash``, which would break
+    checkpoint/restore across processes).  State is the register list, so
+    merge is an elementwise max — the standard HLL union."""
+
+    P = 11
+    M = 1 << P
+
+    def __init__(self):
+        self.regs = np.zeros(self.M, dtype=np.int8)
+
+    @classmethod
+    def _hash64(cls, v) -> int:
+        b = repr(v).encode() if not isinstance(v, (str, bytes)) else (
+            v.encode() if isinstance(v, str) else v
+        )
+        return int.from_bytes(
+            hashlib.blake2b(b, digest_size=8).digest(), "little"
+        )
+
+    def update(self, col: np.ndarray) -> None:
+        regs = self.regs
+        P, M = self.P, self.M
+        for v in col.tolist():
+            h = self._hash64(v)
+            idx = h & (M - 1)
+            rest = h >> P
+            # rank: position of first set bit in the remaining 64-P bits
+            rank = (64 - P) - rest.bit_length() + 1 if rest else (64 - P) + 1
+            if rank > regs[idx]:
+                regs[idx] = rank
+
+    def merge(self, state) -> None:
+        self.regs = np.maximum(self.regs, np.asarray(state[0], dtype=np.int8))
+
+    def state(self) -> list:
+        return [self.regs.tolist()]
+
+    def evaluate(self) -> int:
+        m = float(self.M)
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / float(np.sum(2.0 ** (-self.regs.astype(np.float64))))
+        zeros = int(np.sum(self.regs == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * math.log(m / zeros)  # linear counting, small range
+        return int(round(est))

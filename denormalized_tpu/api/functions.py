@@ -1,9 +1,18 @@
 """Aggregate / scalar function constructors.
 
-The public surface mirroring the subset of datafusion-python's
-``functions`` module the reference re-exports
-(py-denormalized/python/denormalized/datafusion/functions.py) and the Rust
-examples use (count/min/max/avg at examples/examples/simple_aggregation.rs:40-46).
+The public surface mirroring datafusion-python's ``functions`` module as the
+reference re-exports it
+(py-denormalized/python/denormalized/datafusion/functions.py, 2,659 LoC):
+string/math/date/conditional scalar functions, the full aggregate set
+(count/sum/min/max/avg, the variance family, median, array_agg,
+first/last_value, approx_distinct), CASE expressions, and UDF/UDAF
+factories.
+
+Scalar functions evaluate vectorized on host (numpy); the math subset also
+lowers to jax for device-fused post-aggregation filters.  Non-decomposable
+aggregates (median, array_agg, first/last, approx_distinct) run through the
+host accumulator frame path with checkpoint support; everything else
+decomposes into the device kernel's running components.
 """
 
 from __future__ import annotations
@@ -13,35 +22,200 @@ from typing import Callable
 from denormalized_tpu.common.schema import DataType
 from denormalized_tpu.logical.expr import (
     AggregateExpr,
+    CaseBuilder,
     Expr,
+    ScalarFunctionExpr,
     ScalarUDFExpr,
     col,
+    lit,
 )
+from denormalized_tpu.logical.scalar_functions import REGISTRY, lookup
+
+__all__ = [  # noqa: F822 - scalar names are injected below
+    "count", "sum", "min", "max", "avg",
+    "stddev", "stddev_samp", "stddev_pop", "var", "var_samp", "var_pop",
+    "median", "approx_median", "array_agg", "first_value", "last_value",
+    "approx_distinct",
+    "case", "when", "udf", "udaf", "col", "lit",
+] + sorted(REGISTRY)
+
+
+def _e(expr: Expr | str) -> Expr:
+    return col(expr) if isinstance(expr, str) else expr
+
+
+# -- aggregates ----------------------------------------------------------
 
 
 def count(expr: Expr | str | None = None) -> AggregateExpr:
-    e = col(expr) if isinstance(expr, str) else expr
-    return AggregateExpr("count", e)
+    return AggregateExpr("count", _e(expr) if expr is not None else None)
 
 
 def sum(expr: Expr | str) -> AggregateExpr:  # noqa: A001 - mirrors SQL name
-    e = col(expr) if isinstance(expr, str) else expr
-    return AggregateExpr("sum", e)
+    return AggregateExpr("sum", _e(expr))
 
 
 def min(expr: Expr | str) -> AggregateExpr:  # noqa: A001
-    e = col(expr) if isinstance(expr, str) else expr
-    return AggregateExpr("min", e)
+    return AggregateExpr("min", _e(expr))
 
 
 def max(expr: Expr | str) -> AggregateExpr:  # noqa: A001
-    e = col(expr) if isinstance(expr, str) else expr
-    return AggregateExpr("max", e)
+    return AggregateExpr("max", _e(expr))
 
 
 def avg(expr: Expr | str) -> AggregateExpr:
-    e = col(expr) if isinstance(expr, str) else expr
-    return AggregateExpr("avg", e)
+    return AggregateExpr("avg", _e(expr))
+
+
+def stddev(expr: Expr | str) -> AggregateExpr:
+    """Sample standard deviation (decomposes onto the device kernel)."""
+    return AggregateExpr("stddev", _e(expr))
+
+
+def stddev_samp(expr: Expr | str) -> AggregateExpr:
+    return AggregateExpr("stddev", _e(expr))
+
+
+def stddev_pop(expr: Expr | str) -> AggregateExpr:
+    return AggregateExpr("stddev_pop", _e(expr))
+
+
+def var(expr: Expr | str) -> AggregateExpr:
+    """Sample variance (DataFusion ``var``/``var_samp``)."""
+    return AggregateExpr("var", _e(expr))
+
+
+def var_samp(expr: Expr | str) -> AggregateExpr:
+    return AggregateExpr("var", _e(expr))
+
+
+def var_pop(expr: Expr | str) -> AggregateExpr:
+    return AggregateExpr("var_pop", _e(expr))
+
+
+def _builtin_udaf(acc_cls, return_type: DataType, name: str):
+    from denormalized_tpu.api.udaf import UDAF
+
+    def make(expr: Expr | str) -> AggregateExpr:
+        e = _e(expr)
+        u = UDAF(acc_cls, (e,), return_type, name)
+        return AggregateExpr("udaf", e, None, u)
+
+    make.__name__ = name
+    make.__doc__ = f"{name} aggregate (host accumulator frame path)."
+    return make
+
+
+def _builtin_accs():
+    from denormalized_tpu.api import builtin_accumulators as b
+
+    return b
+
+
+def array_agg(expr: Expr | str) -> AggregateExpr:
+    """Collect values into a list per group-window; checkpoints through
+    accumulator state (reference serializable_accumulator.rs:10-68)."""
+    b = _builtin_accs()
+    return _builtin_udaf(b.ArrayAggAccumulator, DataType.LIST, "array_agg")(expr)
+
+
+def median(expr: Expr | str) -> AggregateExpr:
+    b = _builtin_accs()
+    return _builtin_udaf(b.MedianAccumulator, DataType.FLOAT64, "median")(expr)
+
+
+def approx_median(expr: Expr | str) -> AggregateExpr:
+    """Exact median under the approx_median name (we can afford exact)."""
+    b = _builtin_accs()
+    return _builtin_udaf(b.MedianAccumulator, DataType.FLOAT64, "approx_median")(
+        expr
+    )
+
+
+def first_value(expr: Expr | str) -> AggregateExpr:
+    """First value in arrival order; result type follows the argument."""
+    b = _builtin_accs()
+    return _builtin_udaf(b.FirstValueAccumulator, None, "first_value")(expr)
+
+
+def last_value(expr: Expr | str) -> AggregateExpr:
+    """Last value in arrival order; result type follows the argument."""
+    b = _builtin_accs()
+    return _builtin_udaf(b.LastValueAccumulator, None, "last_value")(expr)
+
+
+def approx_distinct(expr: Expr | str) -> AggregateExpr:
+    """HyperLogLog distinct count (~1.6% error, mergeable sketch state)."""
+    b = _builtin_accs()
+    return _builtin_udaf(
+        b.ApproxDistinctAccumulator, DataType.INT64, "approx_distinct"
+    )(expr)
+
+
+# -- CASE ----------------------------------------------------------------
+
+
+def case(expr: Expr | str) -> CaseBuilder:
+    """Simple CASE: ``case(col('x')).when(1, 'one').otherwise('other')``."""
+    return CaseBuilder(_e(expr))
+
+
+def when(cond, result) -> CaseBuilder:
+    """Searched CASE: ``when(col('x') > 0, 'pos').otherwise('neg')``."""
+    return CaseBuilder(None).when(cond, result)
+
+
+# -- scalar functions (registry-driven) ----------------------------------
+
+
+def _scalar_constructor(fname: str):
+    spec = lookup(fname)
+
+    def make(*args) -> Expr:
+        lo = spec.min_args
+        hi = spec.max_args if spec.max_args is not None else spec.min_args
+        if not (lo <= len(args) <= hi):
+            from denormalized_tpu.common.errors import PlanError
+
+            want = str(lo) if lo == hi else f"{lo}..{hi}"
+            raise PlanError(
+                f"{fname}() takes {want} argument(s), got {len(args)}"
+            )
+        # string-arg convention: the FIRST argument names a column, later
+        # string arguments are literals (`replace("name", "from", "to")`);
+        # unit-taking date functions treat every string as a literal
+        # (`date_trunc("minute", col("ts"))`).  Pass col()/lit() explicitly
+        # to override.
+        exprs = tuple(
+            col(a)
+            if isinstance(a, str) and i == 0 and fname not in _ALL_STR_LITERAL
+            else _wrap_arg(a)
+            for i, a in enumerate(args)
+        )
+        return ScalarFunctionExpr(fname, exprs)
+
+    make.__name__ = fname
+    make.__doc__ = (
+        f"Scalar function ``{fname}`` (datafusion parity).  A bare string "
+        "as the first argument is a column name; later bare strings are "
+        "literals."
+    )
+    return make
+
+
+def _wrap_arg(a) -> Expr:
+    from denormalized_tpu.logical.expr import _wrap
+
+    return _wrap(a)
+
+
+# functions whose FIRST string argument is a literal (unit name), not a
+# column reference
+_ALL_STR_LITERAL = {"date_trunc", "date_part", "extract", "chr"}
+
+for _fname in REGISTRY:
+    globals()[_fname] = _scalar_constructor(_fname)
+del _fname
 
 
 def udf(fn: Callable, return_type: DataType, name: str | None = None):

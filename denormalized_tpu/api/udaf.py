@@ -39,9 +39,13 @@ class Accumulator:
 
 
 class UDAF:
-    """Descriptor binding an Accumulator class to argument expressions."""
+    """Descriptor binding an Accumulator class to argument expressions.
+    ``return_type=None`` means "same type as the first argument" (used by
+    first_value/last_value, which are type-preserving like DataFusion's)."""
 
-    def __init__(self, accumulator_cls, args, return_type: DataType, name: str):
+    def __init__(
+        self, accumulator_cls, args, return_type: DataType | None, name: str
+    ):
         self.accumulator_cls = accumulator_cls
         self.args = args  # tuple[Expr, ...]
         self.return_type = return_type

@@ -439,6 +439,169 @@ class CastExpr(Expr):
 
 
 @dataclass(frozen=True, eq=False)
+class ScalarFunctionExpr(Expr):
+    """Built-in scalar function call (registry:
+    :mod:`denormalized_tpu.logical.scalar_functions` — the equivalent of the
+    datafusion function library the reference re-exports,
+    py-denormalized/python/denormalized/datafusion/functions.py)."""
+
+    fname: str
+    args: tuple[Expr, ...]
+
+    def _fn(self):
+        from denormalized_tpu.logical import scalar_functions as sf
+
+        return sf.lookup(self.fname)
+
+    @property
+    def name(self) -> str:
+        return f"{self.fname}({', '.join(a.name for a in self.args)})"
+
+    def out_field(self, schema: Schema) -> Field:
+        ot = self._fn().out_type
+        if ot == "same":
+            if not self.args:
+                raise PlanError(f"{self.fname} needs arguments")
+            f0 = self.args[0].out_field(schema)
+            return Field(self.name, f0.dtype)
+        return Field(self.name, ot)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        # domain errors (sqrt(-x), log(0)) follow SQL NaN/NULL semantics —
+        # no warnings
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = self._fn().np_fn(*[a.eval(batch) for a in self.args])
+        out = np.asarray(out)
+        if out.ndim == 0:  # zero-arg / scalar result → broadcast
+            out = np.full(batch.num_rows, out.item())
+        return out
+
+    def eval_jax(self, cols: dict[str, Any]):
+        fn = self._fn()
+        if fn.jax_fn is None:
+            raise PlanError(f"{self.fname} is host-only (no device lowering)")
+        return fn.jax_fn(*[a.eval_jax(cols) for a in self.args])
+
+    def columns_referenced(self) -> set[str]:
+        s: set[str] = set()
+        for a in self.args:
+            s |= a.columns_referenced()
+        return s
+
+    def __repr__(self):
+        return f"{self.fname}({', '.join(map(repr, self.args))})"
+
+
+@dataclass(frozen=True, eq=False)
+class CaseExpr(Expr):
+    """SQL CASE.  ``base`` None → searched form (WHEN <bool-cond> THEN r);
+    otherwise the simple form (WHEN base == value THEN r)."""
+
+    base: Expr | None
+    branches: tuple[tuple[Expr, Expr], ...]
+    otherwise: Expr | None
+
+    @property
+    def name(self) -> str:
+        return "case(" + ", ".join(
+            f"{c.name}->{r.name}" for c, r in self.branches
+        ) + ")"
+
+    def out_field(self, schema: Schema) -> Field:
+        dt = self.branches[0][1].out_field(schema).dtype
+        for _, r in self.branches[1:]:
+            dt = _promote(dt, r.out_field(schema).dtype, "case")
+        if self.otherwise is not None:
+            dt = _promote(dt, self.otherwise.out_field(schema).dtype, "case")
+        return Field(self.name, dt)
+
+    def _conds(self, batch):
+        for c, _ in self.branches:
+            if self.base is not None:
+                yield BinaryExpr("==", self.base, c).eval(batch)
+            else:
+                yield np.asarray(c.eval(batch), dtype=bool)
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        conds = list(self._conds(batch))
+        results = [np.asarray(r.eval(batch)) for _, r in self.branches]
+        is_obj = any(r.dtype == object for r in results)
+        if self.otherwise is not None:
+            default = np.asarray(self.otherwise.eval(batch))
+            is_obj = is_obj or default.dtype == object
+        else:
+            default = None
+        n = batch.num_rows
+        if is_obj:
+            out = np.empty(n, dtype=object)
+            out[:] = None
+            taken = np.zeros(n, dtype=bool)
+            for cond, res in zip(conds, results):
+                pick = cond & ~taken
+                out[pick] = res[pick] if res.ndim else res.item()
+                taken |= cond
+            if default is not None:
+                rest = ~taken
+                out[rest] = (
+                    default[rest] if default.ndim else default.item()
+                )
+            return out
+        if default is None:
+            default = np.full(n, np.nan)
+        return np.select(conds, results, default)
+
+    def eval_jax(self, cols: dict[str, Any]):
+        import jax.numpy as jnp
+
+        if self.otherwise is not None:
+            out = self.otherwise.eval_jax(cols)
+        else:
+            out = jnp.nan
+        for c, r in reversed(self.branches):
+            if self.base is not None:
+                cond = BinaryExpr("==", self.base, c).eval_jax(cols)
+            else:
+                cond = c.eval_jax(cols)
+            out = jnp.where(cond, r.eval_jax(cols), out)
+        return out
+
+    def columns_referenced(self) -> set[str]:
+        s: set[str] = set()
+        if self.base is not None:
+            s |= self.base.columns_referenced()
+        for c, r in self.branches:
+            s |= c.columns_referenced() | r.columns_referenced()
+        if self.otherwise is not None:
+            s |= self.otherwise.columns_referenced()
+        return s
+
+    def __repr__(self):
+        return self.name
+
+
+class CaseBuilder:
+    """Fluent CASE builder (datafusion-python `case(...)`/`when(...)`)."""
+
+    def __init__(self, base: Expr | None = None):
+        self._base = base
+        self._branches: list[tuple[Expr, Expr]] = []
+
+    def when(self, cond, result) -> "CaseBuilder":
+        self._branches.append((_wrap(cond), _wrap(result)))
+        return self
+
+    def otherwise(self, value) -> CaseExpr:
+        if not self._branches:
+            raise PlanError("CASE needs at least one WHEN branch")
+        return CaseExpr(self._base, tuple(self._branches), _wrap(value))
+
+    def end(self) -> CaseExpr:
+        if not self._branches:
+            raise PlanError("CASE needs at least one WHEN branch")
+        return CaseExpr(self._base, tuple(self._branches), None)
+
+
+@dataclass(frozen=True, eq=False)
 class ScalarUDFExpr(Expr):
     """User-defined scalar function over numpy columns (reference:
     udf_example.rs + py udf.py)."""
@@ -473,7 +636,14 @@ class ScalarUDFExpr(Expr):
 
 # -- aggregates ---------------------------------------------------------
 
-AGG_KINDS = ("count", "sum", "min", "max", "avg")
+AGG_KINDS = (
+    "count", "sum", "min", "max", "avg",
+    # variance family: decomposes into sum/count/sum-of-squares components
+    # on device (DataFusion exposes these through the reference's vendored
+    # functions module)
+    "stddev", "stddev_pop", "var", "var_pop",
+)
+VAR_KINDS = ("stddev", "stddev_pop", "var", "var_pop")
 
 
 @dataclass(frozen=True, eq=False)
@@ -498,9 +668,11 @@ class AggregateExpr(Expr):
     def out_field(self, schema: Schema) -> Field:
         if self.kind == "count":
             return Field(self.name, DataType.INT64, nullable=False)
-        if self.kind == "avg":
+        if self.kind == "avg" or self.kind in VAR_KINDS:
             return Field(self.name, DataType.FLOAT64)
         if self.kind == "udaf":
+            if self.udaf.return_type is None:  # same type as the argument
+                return Field(self.name, self.arg.out_field(schema).dtype)
             return Field(self.name, self.udaf.return_type)
         f = self.arg.out_field(schema)
         if self.kind == "sum":
@@ -517,6 +689,19 @@ class AggregateExpr(Expr):
 
     def __repr__(self):
         return self.name
+
+
+def column_validity(e: Expr, batch: RecordBatch) -> np.ndarray | None:
+    """Row validity of an expression's output: the AND of the null masks of
+    every column it reads (derived columns — e.g. variance's shifted
+    moments — inherit their source columns' nulls).  None = all valid."""
+    m = None
+    refs = (e.name,) if isinstance(e, Column) else e.columns_referenced()
+    for ref in refs:
+        rm = batch.mask(ref) if batch.schema.has(ref) else None
+        if rm is not None:
+            m = rm if m is None else (m & rm)
+    return m
 
 
 # -- public constructors (mirror datafusion-python functions module) -----

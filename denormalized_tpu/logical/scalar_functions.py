@@ -1,0 +1,470 @@
+"""Built-in scalar function registry.
+
+The reference exposes datafusion's scalar function library to Python users
+(py-denormalized/python/denormalized/datafusion/functions.py — string, math,
+date and conditional functions re-exported wholesale).  This module is the
+TPU build's equivalent: every function has a vectorized numpy implementation
+(host projections/filters) and, where it makes sense on device, a jax
+implementation so post-aggregation filters fuse into the jitted step.
+
+Numeric null semantics follow NaN propagation; string functions map
+``None`` → ``None`` elementwise (object arrays are the host string
+representation, mirroring arrow's null slots).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.schema import DataType
+
+# out_type codes: a DataType, or "same" (argument 0's type)
+_F64 = DataType.FLOAT64
+_I64 = DataType.INT64
+_STR = DataType.STRING
+_BOOL = DataType.BOOL
+_TS = DataType.TIMESTAMP_MS
+
+
+@dataclass(frozen=True)
+class ScalarFn:
+    np_fn: Callable  # (*numpy arrays/scalars) -> numpy array
+    out_type: object  # DataType | "same"
+    jax_fn: Callable | None = None  # (*jax arrays) -> jax array
+    min_args: int = 1
+    max_args: int | None = None  # None = same as min
+
+
+def _map1(fn):
+    """Elementwise over an object array, None-preserving."""
+
+    def run(a):
+        a = np.asarray(a, dtype=object)
+        out = np.empty(len(a), dtype=object)
+        for i, x in enumerate(a):
+            out[i] = None if x is None else fn(x)
+        return out
+
+    return run
+
+
+def _map_n(fn):
+    """Elementwise over N object arrays; None in any arg → None (SQL-ish)."""
+
+    def run(*arrays):
+        n = max(len(np.atleast_1d(a)) for a in arrays)
+        cols = [np.asarray(a, dtype=object) for a in arrays]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            vals = [c[i] if len(c) > 1 else c[0] for c in cols]
+            out[i] = None if any(v is None for v in vals) else fn(*vals)
+        return out
+
+    return run
+
+
+def _str_of(x):
+    return x if isinstance(x, str) else str(x)
+
+
+# -- string functions ----------------------------------------------------
+
+
+def _substr(s, start, length=None):
+    start = int(start)
+    # SQL 1-based; start<1 extends the window leftward like datafusion
+    begin = max(start - 1, 0)
+    if length is None:
+        return s[begin:]
+    end = start - 1 + int(length)
+    return s[begin:max(end, begin)]
+
+
+def _split_part(s, delim, idx):
+    parts = s.split(delim)
+    i = int(idx)
+    return parts[i - 1] if 1 <= i <= len(parts) else ""
+
+
+def _strpos(s, sub):
+    return s.find(sub) + 1
+
+
+def _initcap(s):
+    return "".join(
+        c.upper() if (i == 0 or not s[i - 1].isalnum()) else c.lower()
+        for i, c in enumerate(s)
+    )
+
+
+_STRING_FNS = {
+    "upper": ScalarFn(_map1(lambda s: _str_of(s).upper()), _STR),
+    "lower": ScalarFn(_map1(lambda s: _str_of(s).lower()), _STR),
+    "length": ScalarFn(_map1(len), _I64),
+    "char_length": ScalarFn(_map1(len), _I64),
+    "character_length": ScalarFn(_map1(len), _I64),
+    "octet_length": ScalarFn(_map1(lambda s: len(s.encode())), _I64),
+    "reverse": ScalarFn(_map1(lambda s: s[::-1]), _STR),
+    "initcap": ScalarFn(_map1(_initcap), _STR),
+    "ascii": ScalarFn(_map1(lambda s: ord(s[0]) if s else 0), _I64),
+    "chr": ScalarFn(_map1(lambda n: chr(int(n))), _STR),
+    "md5": ScalarFn(
+        _map1(
+            lambda s: __import__("hashlib").md5(
+                _str_of(s).encode()
+            ).hexdigest()
+        ),
+        _STR,
+    ),
+    "concat": ScalarFn(
+        # datafusion concat skips nulls rather than nulling out
+        lambda *a: _concat_skip_nulls(*a),
+        _STR,
+        min_args=1,
+        max_args=64,
+    ),
+    "concat_ws": ScalarFn(
+        lambda sep, *a: _concat_ws(sep, *a), _STR, min_args=2, max_args=64
+    ),
+    "trim": ScalarFn(
+        _map_n(lambda s, chars=None: s.strip(chars)), _STR, min_args=1,
+        max_args=2,
+    ),
+    "btrim": ScalarFn(
+        _map_n(lambda s, chars=None: s.strip(chars)), _STR, min_args=1,
+        max_args=2,
+    ),
+    "ltrim": ScalarFn(
+        _map_n(lambda s, chars=None: s.lstrip(chars)), _STR, min_args=1,
+        max_args=2,
+    ),
+    "rtrim": ScalarFn(
+        _map_n(lambda s, chars=None: s.rstrip(chars)), _STR, min_args=1,
+        max_args=2,
+    ),
+    "substr": ScalarFn(_map_n(_substr), _STR, min_args=2, max_args=3),
+    "substring": ScalarFn(_map_n(_substr), _STR, min_args=2, max_args=3),
+    "replace": ScalarFn(
+        _map_n(lambda s, f, t: s.replace(f, t)), _STR, min_args=3
+    ),
+    "translate": ScalarFn(
+        _map_n(lambda s, f, t: s.translate(str.maketrans(f, t[: len(f)]))),
+        _STR,
+        min_args=3,
+    ),
+    "starts_with": ScalarFn(
+        _map_n(lambda s, p: s.startswith(p)), _BOOL, min_args=2
+    ),
+    "ends_with": ScalarFn(
+        _map_n(lambda s, p: s.endswith(p)), _BOOL, min_args=2
+    ),
+    "contains": ScalarFn(_map_n(lambda s, p: p in s), _BOOL, min_args=2),
+    "strpos": ScalarFn(_map_n(_strpos), _I64, min_args=2),
+    "instr": ScalarFn(_map_n(_strpos), _I64, min_args=2),
+    "left": ScalarFn(_map_n(lambda s, n: s[: int(n)]), _STR, min_args=2),
+    "right": ScalarFn(
+        _map_n(lambda s, n: s[-int(n):] if int(n) else ""), _STR, min_args=2
+    ),
+    "lpad": ScalarFn(
+        _map_n(lambda s, n, p=" ": s.rjust(int(n), p[:1])[: int(n)]),
+        _STR,
+        min_args=2,
+        max_args=3,
+    ),
+    "rpad": ScalarFn(
+        _map_n(lambda s, n, p=" ": s.ljust(int(n), p[:1])[: int(n)]),
+        _STR,
+        min_args=2,
+        max_args=3,
+    ),
+    "repeat": ScalarFn(_map_n(lambda s, n: s * int(n)), _STR, min_args=2),
+    "split_part": ScalarFn(_map_n(_split_part), _STR, min_args=3),
+    "to_hex": ScalarFn(_map1(lambda n: format(int(n), "x")), _STR),
+}
+
+
+def _concat_skip_nulls(*arrays):
+    n = max(len(np.atleast_1d(a)) for a in arrays)
+    cols = [np.asarray(a, dtype=object) for a in arrays]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = "".join(
+            _str_of(c[i] if len(c) > 1 else c[0])
+            for c in cols
+            if (c[i] if len(c) > 1 else c[0]) is not None
+        )
+    return out
+
+
+def _concat_ws(sep, *arrays):
+    n = max(len(np.atleast_1d(a)) for a in ((sep,) + arrays))
+    sep_arr = np.asarray(sep, dtype=object)
+    cols = [np.asarray(a, dtype=object) for a in arrays]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        s = sep_arr[i] if sep_arr.ndim and len(sep_arr) > 1 else sep_arr.item() if sep_arr.ndim == 0 else sep_arr[0]
+        if s is None:
+            out[i] = None
+            continue
+        vals = [
+            _str_of(c[i] if len(c) > 1 else c[0])
+            for c in cols
+            if (c[i] if len(c) > 1 else c[0]) is not None
+        ]
+        out[i] = s.join(vals)
+    return out
+
+
+# -- math functions ------------------------------------------------------
+
+
+def _np_round(x, d=0):
+    # SQL/DataFusion semantics: half away from zero (numpy rounds half to
+    # even — round(-2.5) must be -3, not -2)
+    x = np.asarray(x, dtype=np.float64)
+    scale = 10.0 ** int(np.atleast_1d(d)[0])
+    return np.copysign(np.floor(np.abs(x) * scale + 0.5) / scale, x)
+
+
+def _jax(fname):
+    import jax.numpy as jnp
+
+    return getattr(jnp, fname)
+
+
+def _jax_fn(name):
+    def run(*a):
+        import jax.numpy as jnp
+
+        return getattr(jnp, name)(*a)
+
+    return run
+
+
+def _jax_round(x, d=0):
+    import jax.numpy as jnp
+
+    scale = 10.0 ** int(d) if not hasattr(d, "shape") else 10.0 ** d
+    return jnp.copysign(jnp.floor(jnp.abs(x) * scale + 0.5) / scale, x)
+
+
+_MATH_FNS = {
+    "abs": ScalarFn(np.abs, "same", _jax_fn("abs")),
+    # device lowering must match the host's half-away-from-zero, NOT
+    # jnp.round's half-to-even — the same expression fused into a device
+    # filter has to agree with the host evaluator
+    "round": ScalarFn(_np_round, _F64, lambda x, d=0: _jax_round(x, d), 1, 2),
+    "floor": ScalarFn(np.floor, _F64, _jax_fn("floor")),
+    "ceil": ScalarFn(np.ceil, _F64, _jax_fn("ceil")),
+    "trunc": ScalarFn(np.trunc, _F64, _jax_fn("trunc")),
+    "sqrt": ScalarFn(np.sqrt, _F64, _jax_fn("sqrt")),
+    "cbrt": ScalarFn(np.cbrt, _F64, _jax_fn("cbrt")),
+    "exp": ScalarFn(np.exp, _F64, _jax_fn("exp")),
+    "ln": ScalarFn(np.log, _F64, _jax_fn("log")),
+    "log10": ScalarFn(np.log10, _F64, _jax_fn("log10")),
+    "log2": ScalarFn(np.log2, _F64, _jax_fn("log2")),
+    "power": ScalarFn(np.power, _F64, _jax_fn("power"), 2),
+    "pow": ScalarFn(np.power, _F64, _jax_fn("power"), 2),
+    "signum": ScalarFn(np.sign, _F64, _jax_fn("sign")),
+    "sin": ScalarFn(np.sin, _F64, _jax_fn("sin")),
+    "cos": ScalarFn(np.cos, _F64, _jax_fn("cos")),
+    "tan": ScalarFn(np.tan, _F64, _jax_fn("tan")),
+    "asin": ScalarFn(np.arcsin, _F64, _jax_fn("arcsin")),
+    "acos": ScalarFn(np.arccos, _F64, _jax_fn("arccos")),
+    "atan": ScalarFn(np.arctan, _F64, _jax_fn("arctan")),
+    "atan2": ScalarFn(np.arctan2, _F64, _jax_fn("arctan2"), 2),
+    "sinh": ScalarFn(np.sinh, _F64, _jax_fn("sinh")),
+    "cosh": ScalarFn(np.cosh, _F64, _jax_fn("cosh")),
+    "tanh": ScalarFn(np.tanh, _F64, _jax_fn("tanh")),
+    "degrees": ScalarFn(np.degrees, _F64, _jax_fn("degrees")),
+    "radians": ScalarFn(np.radians, _F64, _jax_fn("radians")),
+    "isnan": ScalarFn(
+        lambda x: np.isnan(np.asarray(x, dtype=np.float64)),
+        _BOOL,
+        _jax_fn("isnan"),
+    ),
+    "nanvl": ScalarFn(
+        lambda x, y: np.where(np.isnan(np.asarray(x, np.float64)), y, x),
+        _F64,
+        lambda x, y: __import__("jax.numpy", fromlist=["where"]).where(
+            __import__("jax.numpy", fromlist=["isnan"]).isnan(x), y, x
+        ),
+        2,
+    ),
+    "pi": ScalarFn(lambda: np.float64(math.pi), _F64, None, 0, 0),
+    "log": ScalarFn(  # log(x) = base 10 (datafusion); log(base, x) two-arg
+        lambda *a: (
+            np.log10(a[0])
+            if len(a) == 1
+            else np.log(np.asarray(a[1], np.float64))
+            / np.log(np.asarray(a[0], np.float64))
+        ),
+        _F64,
+        None,
+        1,
+        2,
+    ),
+}
+
+# -- date/time functions (int64 epoch-millis timestamps) -----------------
+
+_TRUNC_UNITS = ("second", "minute", "hour", "day", "week", "month", "year")
+
+
+def _date_trunc(unit, ts):
+    unit = str(np.atleast_1d(unit)[0]).lower()
+    t = np.asarray(ts, dtype=np.int64)
+    if unit == "second":
+        return (t // 1000) * 1000
+    if unit == "minute":
+        return (t // 60_000) * 60_000
+    if unit == "hour":
+        return (t // 3_600_000) * 3_600_000
+    if unit == "day":
+        return (t // 86_400_000) * 86_400_000
+    if unit == "week":
+        # epoch day 0 = Thursday; ISO weeks start Monday (epoch day 4)
+        days = t // 86_400_000
+        return ((days - 4) // 7 * 7 + 4) * 86_400_000
+    d = t.astype("datetime64[ms]")
+    if unit == "month":
+        return d.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+    if unit == "year":
+        return d.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+    raise PlanError(f"date_trunc: unknown unit {unit!r}")
+
+
+def _date_part(unit, ts):
+    unit = str(np.atleast_1d(unit)[0]).lower()
+    t = np.asarray(ts, dtype=np.int64)
+    if unit in ("epoch",):
+        return t.astype(np.float64) / 1000.0
+    if unit in ("millisecond", "milliseconds"):
+        return (t % 1000).astype(np.int64)
+    d = t.astype("datetime64[ms]")
+    if unit == "second":
+        return (t // 1000 % 60).astype(np.int64)
+    if unit == "minute":
+        return (t // 60_000 % 60).astype(np.int64)
+    if unit == "hour":
+        return (t // 3_600_000 % 24).astype(np.int64)
+    if unit in ("day", "dom"):
+        return (d - d.astype("datetime64[M]")).astype(
+            "timedelta64[D]"
+        ).astype(np.int64) + 1
+    if unit in ("dow",):  # 0 = Sunday, postgres-style
+        return ((t // 86_400_000 + 4) % 7).astype(np.int64)
+    if unit in ("doy",):
+        return (d - d.astype("datetime64[Y]")).astype(
+            "timedelta64[D]"
+        ).astype(np.int64) + 1
+    if unit == "week":
+        iso = d.astype("datetime64[D]").astype(object)
+        return np.array([x.isocalendar()[1] for x in iso], dtype=np.int64)
+    if unit == "month":
+        return (
+            d.astype("datetime64[M]").astype(np.int64) % 12 + 1
+        ).astype(np.int64)
+    if unit == "year":
+        return (
+            d.astype("datetime64[Y]").astype(np.int64) + 1970
+        ).astype(np.int64)
+    raise PlanError(f"date_part: unknown unit {unit!r}")
+
+
+def _to_timestamp_millis(v):
+    a = np.asarray(v)
+    if a.dtype == object:
+        return np.array(
+            [
+                np.datetime64(x, "ms").astype(np.int64) if x is not None else 0
+                for x in a
+            ],
+            dtype=np.int64,
+        )
+    return a.astype(np.int64)
+
+
+def _date_bin(stride_ms, ts, origin_ms=0):
+    t = np.asarray(ts, dtype=np.int64)
+    s = int(np.atleast_1d(stride_ms)[0])
+    o = int(np.atleast_1d(origin_ms)[0])
+    return (t - o) // s * s + o
+
+
+_DATE_FNS = {
+    "date_trunc": ScalarFn(_date_trunc, _TS, None, 2),
+    "date_part": ScalarFn(_date_part, _F64, None, 2),
+    "extract": ScalarFn(_date_part, _F64, None, 2),
+    "to_timestamp_millis": ScalarFn(_to_timestamp_millis, _TS),
+    "date_bin": ScalarFn(_date_bin, _TS, None, 2, 3),
+    "now": ScalarFn(
+        lambda: np.int64(__import__("time").time() * 1000), _TS, None, 0, 0
+    ),
+}
+
+# -- conditional ---------------------------------------------------------
+
+
+def _coalesce(*arrays):
+    cols = [np.asarray(a) for a in arrays]
+    n = max(len(np.atleast_1d(c)) for c in cols)
+    if any(c.dtype == object for c in cols):
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = None
+            for c in cols:
+                v = c[i] if c.ndim and len(c) > 1 else c.item() if c.ndim == 0 else c[0]
+                if v is not None and not (
+                    isinstance(v, float) and math.isnan(v)
+                ):
+                    out[i] = v
+                    break
+        return out
+    out = np.broadcast_to(cols[0].astype(np.float64), (n,)).copy()
+    for c in cols[1:]:
+        out = np.where(np.isnan(out), c, out)
+    return out
+
+
+def _nullif(a, b):
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.dtype == object or b.dtype == object:
+        return _map_n(lambda x, y: None if x == y else x)(a, b)
+    return np.where(a == b, np.nan, a.astype(np.float64))
+
+
+def _ifnull(a, b):
+    return _coalesce(a, b)
+
+
+_COND_FNS = {
+    "coalesce": ScalarFn(_coalesce, "same", None, 1, 64),
+    "nullif": ScalarFn(_nullif, "same", None, 2),
+    "ifnull": ScalarFn(_ifnull, "same", None, 2),
+    "nvl": ScalarFn(_ifnull, "same", None, 2),
+}
+
+
+REGISTRY: dict[str, ScalarFn] = {
+    **_STRING_FNS,
+    **_MATH_FNS,
+    **_DATE_FNS,
+    **_COND_FNS,
+}
+
+
+def lookup(fname: str) -> ScalarFn:
+    fn = REGISTRY.get(fname)
+    if fn is None:
+        raise PlanError(
+            f"unknown scalar function {fname!r} "
+            f"(available: {', '.join(sorted(REGISTRY))})"
+        )
+    return fn

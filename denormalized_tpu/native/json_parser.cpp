@@ -324,7 +324,9 @@ int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
                 size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
                 char* endp = nullptr;
                 long long v = tl ? strtoll(tok, &endp, 10) : 0;
-                if (tl == 0 || endp == tok) { c.fail = true; }
+                // partial consumption (e.g. "1e5" on an int column) must
+                // fail the row, not silently truncate to 1
+                if (tl == 0 || endp != tok + tl) { c.fail = true; }
                 col.i64.push_back(v);
                 col.valid.push_back(1);
                 break;
@@ -336,7 +338,7 @@ int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
                 size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
                 char* endp = nullptr;
                 double v = tl ? strtod(tok, &endp) : 0.0;
-                if (tl == 0 || endp == tok) { c.fail = true; }
+                if (tl == 0 || endp != tok + tl) { c.fail = true; }
                 col.f64.push_back(v);
                 col.valid.push_back(1);
                 break;

@@ -132,6 +132,15 @@ static void test_json() {
     uint64_t toffs[2] = {0, tr.size()};
     assert(jp_parse(p, exact.data(), toffs, 1) == -1);
   }
+  // partial-consumption tokens must fail the row, not silently truncate
+  // ("1e5" on an int column would otherwise store 1)
+  for (const char* t : {"{\"a\": 1e5}", "{\"a\": 12.5}", "{\"f\": 1.2.3}"}) {
+    jp_clear(p);
+    std::string tr = t;
+    std::vector<uint8_t> exact(tr.begin(), tr.end());
+    uint64_t toffs[2] = {0, tr.size()};
+    assert(jp_parse(p, exact.data(), toffs, 1) == -1);
+  }
   // a long-but-legal numeric token (>47 chars) still parses — arbitrary
   // precision decimals are valid JSON
   {

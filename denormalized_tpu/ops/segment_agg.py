@@ -54,15 +54,78 @@ class AggComponent:
 ROW_COUNT = AggComponent("count", None)
 
 
-def components_for(aggs: list[tuple[str, int | None]]) -> list[AggComponent]:
-    """Decompose (kind, value_col) aggregate specs into deduped primitive
-    components.  ``avg`` → sum + count of the same column."""
+from denormalized_tpu.logical.expr import VAR_KINDS  # noqa: E402
+
+
+def variance_result(
+    kind: str, c: np.ndarray, s: np.ndarray, s2: np.ndarray
+) -> np.ndarray:
+    """Shared variance finalize: ``s``/``s2`` are Σ(x−K) and Σ(x−K)² for any
+    constant shift K (callers pick K near the data's magnitude so the
+    ``s2 − s²/c`` subtraction doesn't catastrophically cancel — with K=0 and
+    epoch-scale values the two terms agree to ~24 digits and f32/f64 both
+    return garbage).  The shift cancels exactly in the algebra."""
+    c = np.asarray(c, np.float64)
+    s = np.asarray(s, np.float64)
+    s2 = np.asarray(s2, np.float64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        m2 = np.maximum(s2 - s * s / np.maximum(c, 1), 0.0)
+        if kind.endswith("_pop"):
+            v = np.where(c > 0, m2 / np.maximum(c, 1), np.nan)
+        else:  # sample: NULL (NaN) below 2 observations
+            v = np.where(c > 1, m2 / np.maximum(c - 1, 1), np.nan)
+    return np.sqrt(v) if kind.startswith("stddev") else v
+
+
+def variance_from_m2(kind: str, c, m2):
+    """Variance finalize from Welford/Chan moments (count, M2) — the host
+    accumulators' representation."""
+    c = np.asarray(c, np.float64)
+    m2 = np.asarray(m2, np.float64)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        if kind.endswith("_pop"):
+            v = np.where(c > 0, m2 / np.maximum(c, 1), np.nan)
+        else:
+            v = np.where(c > 1, m2 / np.maximum(c - 1, 1), np.nan)
+    return np.sqrt(v) if kind.startswith("stddev") else v
+
+
+def chan_merge(n1, mean1, m21, n2, mean2, m22):
+    """Chan et al. parallel combine of (count, mean, M2) moment pairs —
+    numerically stable for any magnitude, exact merge algebra."""
+    n = n1 + n2
+    if n == 0:
+        return 0.0, 0.0, 0.0
+    delta = mean2 - mean1
+    mean = mean1 + delta * n2 / n
+    m2 = m21 + m22 + delta * delta * n1 * n2 / n
+    return n, mean, m2
+
+
+def components_for(aggs: list[tuple]) -> list[AggComponent]:
+    """Decompose aggregate specs into deduped primitive components.
+
+    Spec entries are ``(kind, value_col)`` — or, for the variance family,
+    ``(kind, shifted_col, shifted_sq_col)``: the caller registers two
+    DEDICATED value columns holding (x−K) and (x−K)² for a pivot K it picks
+    from the first data it sees (see ``variance_result``).  ``avg`` → sum +
+    count; variance → sum + count + sum of squares over the shifted
+    columns (the running-moments decomposition DataFusion's
+    VarianceGroupsAccumulator keeps, made cancellation-safe)."""
     comps: list[AggComponent] = [ROW_COUNT]
-    for kind, col in aggs:
+    for spec in aggs:
+        kind, col = spec[0], spec[1]
         if kind == "count":
             wanted = [AggComponent("count", col)]
         elif kind == "avg":
             wanted = [AggComponent("sum", col), AggComponent("count", col)]
+        elif kind in VAR_KINDS:
+            sq = spec[2]
+            wanted = [
+                AggComponent("sum", col),
+                AggComponent("count", col),
+                AggComponent("sum", sq),
+            ]
         elif kind in ("sum", "min", "max"):
             wanted = [AggComponent(kind, col)]
         else:
@@ -240,7 +303,7 @@ def import_state(
 
 
 def finalize(
-    agg_specs: list[tuple[str, int | None]],
+    agg_specs: list[tuple],
     rows: dict[str, np.ndarray],
     active: np.ndarray,
 ) -> list[np.ndarray]:
@@ -250,7 +313,19 @@ def finalize(
 
     ``active`` is the boolean mask of live group slots in this window."""
     outs: list[np.ndarray] = []
-    for kind, col in agg_specs:
+    for spec in agg_specs:
+        kind, col = spec[0], spec[1]
+        if kind in VAR_KINDS:
+            sq = spec[2]
+            outs.append(
+                variance_result(
+                    kind,
+                    rows[AggComponent("count", col).label][active],
+                    rows[AggComponent("sum", col).label][active],
+                    rows[AggComponent("sum", sq).label][active],
+                )
+            )
+            continue
         if kind == "count":
             label = AggComponent("count", col).label
             outs.append(rows[label][active].astype(np.int64))

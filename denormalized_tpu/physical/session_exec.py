@@ -41,13 +41,17 @@ from denormalized_tpu.physical.base import (
 
 @dataclass
 class _Agg:
-    """Mergeable running aggregate for one session (sum/count/min/max)."""
+    """Mergeable running aggregate for one session.  Variance uses
+    Welford/Chan moments (means/m2s) — numerically stable at any value
+    magnitude, merged exactly by ``segment_agg.chan_merge``."""
 
     count: int = 0
     counts: list[int] = field(default_factory=list)  # per value col
     sums: list[float] = field(default_factory=list)
     mins: list[float] = field(default_factory=list)
     maxs: list[float] = field(default_factory=list)
+    means: list[float] = field(default_factory=list)
+    m2s: list[float] = field(default_factory=list)
 
 
 @dataclass
@@ -80,16 +84,20 @@ class SessionWindowExec(ExecOperator):
         in_schema = input_op.schema
         self._value_exprs: list[Expr] = []
         keys: dict[str, int] = {}
-        self._agg_specs: list[tuple[str, int | None]] = []
+
+        def value_idx(e: Expr) -> int:
+            k = repr(e)
+            if k not in keys:
+                keys[k] = len(self._value_exprs)
+                self._value_exprs.append(e)
+            return keys[k]
+
+        self._agg_specs: list[tuple] = []
         for a in self.aggr_exprs:
             if a.arg is None:
                 self._agg_specs.append((a.kind, None))
                 continue
-            k = repr(a.arg)
-            if k not in keys:
-                keys[k] = len(self._value_exprs)
-                self._value_exprs.append(a.arg)
-            self._agg_specs.append((a.kind, keys[k]))
+            self._agg_specs.append((a.kind, value_idx(a.arg)))
 
         fields = [g.out_field(in_schema) for g in self.group_exprs]
         fields += [a.out_field(in_schema) for a in self.aggr_exprs]
@@ -122,8 +130,14 @@ class SessionWindowExec(ExecOperator):
     # ------------------------------------------------------------------
     @staticmethod
     def _merge_agg(a: _Agg, p: _Agg) -> None:
+        from denormalized_tpu.ops.segment_agg import chan_merge
+
         a.count += p.count
         for i in range(len(a.sums)):
+            _, a.means[i], a.m2s[i] = chan_merge(
+                a.counts[i], a.means[i], a.m2s[i],
+                p.counts[i], p.means[i], p.m2s[i],
+            )
             a.counts[i] += p.counts[i]
             a.sums[i] += p.sums[i]
             a.mins[i] = min(a.mins[i], p.mins[i])
@@ -170,10 +184,15 @@ class SessionWindowExec(ExecOperator):
         )
         valid = np.ones_like(vals, dtype=bool)
         for ci, e in enumerate(self._value_exprs):
-            if isinstance(e, Column):
-                m = batch.mask(e.name)
-                if m is not None:
-                    valid[:, ci] = m
+            m = None
+            for ref in (
+                (e.name,) if isinstance(e, Column) else e.columns_referenced()
+            ):
+                rm = batch.mask(ref) if batch.schema.has(ref) else None
+                if rm is not None:
+                    m = rm if m is None else (m & rm)
+            if m is not None:
+                valid[:, ci] = m
         # watermark advances from the RAW batch min (late rows included —
         # they only keep the min lower, and the reference's
         # RecordBatchWatermark is computed over the whole batch); computing
@@ -267,13 +286,19 @@ class SessionWindowExec(ExecOperator):
             seg_valid = valid_s[b0:b1]
             # null-neutralize per aggregate kind (same semantics as the
             # device kernel: nulls excluded from count/sum/min/max)
+            seg_counts = seg_valid.sum(axis=0)
+            seg_sums = np.where(seg_valid, seg_vals, 0.0).sum(axis=0)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                seg_means = np.where(
+                    seg_counts > 0, seg_sums / np.maximum(seg_counts, 1), 0.0
+                )
+                seg_m2s = np.where(
+                    seg_valid, (seg_vals - seg_means) ** 2, 0.0
+                ).sum(axis=0)
             partial = _Agg(
                 count=int(b1 - b0),
-                counts=[int(c) for c in seg_valid.sum(axis=0)],
-                sums=[
-                    float(s)
-                    for s in np.where(seg_valid, seg_vals, 0.0).sum(axis=0)
-                ],
+                counts=[int(c) for c in seg_counts],
+                sums=[float(s) for s in seg_sums],
                 mins=[
                     float(s)
                     for s in np.where(seg_valid, seg_vals, np.inf).min(axis=0)
@@ -282,6 +307,8 @@ class SessionWindowExec(ExecOperator):
                     float(s)
                     for s in np.where(seg_valid, seg_vals, -np.inf).max(axis=0)
                 ],
+                means=[float(m) for m in seg_means],
+                m2s=[float(m) for m in seg_m2s],
             )
             self._merge_rows(key, ts_s[b0:b1], partial)
 
@@ -314,8 +341,19 @@ class SessionWindowExec(ExecOperator):
             if f.dtype.is_numeric:
                 vals = vals.astype(f.dtype.to_numpy())
             cols.append(vals)
-        for kind, col_i in self._agg_specs:
-            if kind == "count":
+        from denormalized_tpu.ops.segment_agg import VAR_KINDS, variance_from_m2
+
+        for spec in self._agg_specs:
+            kind, col_i = spec[0], spec[1]
+            if kind in VAR_KINDS:
+                cols.append(
+                    variance_from_m2(
+                        kind,
+                        np.array([s.agg.counts[col_i] for _, s in closed]),
+                        np.array([s.agg.m2s[col_i] for _, s in closed]),
+                    )
+                )
+            elif kind == "count":
                 cols.append(
                     np.array(
                         [
@@ -377,6 +415,8 @@ class SessionWindowExec(ExecOperator):
                     sums=list(agg["sums"]),
                     mins=list(agg["mins"]),
                     maxs=list(agg["maxs"]),
+                    means=list(agg.get("means", [0.0] * len(agg["sums"]))),
+                    m2s=list(agg.get("m2s", [0.0] * len(agg["sums"]))),
                 ),
             )
             self._sessions.setdefault(tuple(key_list), []).append(s)
@@ -393,6 +433,8 @@ class SessionWindowExec(ExecOperator):
                  "sums": s.agg.sums,
                  "mins": [float(m) for m in s.agg.mins],
                  "maxs": [float(m) for m in s.agg.maxs],
+                 "means": [float(m) for m in s.agg.means],
+                 "m2s": [float(m) for m in s.agg.m2s],
              }]
             for k, lst in self._sessions.items()
             for s in lst

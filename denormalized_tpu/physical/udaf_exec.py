@@ -36,27 +36,45 @@ from denormalized_tpu.physical.base import (
 
 
 class _BuiltinAcc:
-    """numpy running aggregate for builtin kinds inside the UDAF exec."""
+    """numpy running aggregate for builtin kinds inside the UDAF exec.
+    Variance keeps Welford/Chan moments (mean, M2) — stable at any value
+    magnitude — merged via ``segment_agg.chan_merge``."""
 
-    __slots__ = ("kind", "count", "sum", "min", "max")
+    __slots__ = ("kind", "count", "sum", "mean", "m2", "min", "max")
 
     def __init__(self, kind: str):
         self.kind = kind
         self.count = 0
         self.sum = 0.0
+        self.mean = 0.0
+        self.m2 = 0.0
         self.min = np.inf
         self.max = -np.inf
 
     def update(self, v: np.ndarray):
+        from denormalized_tpu.ops.segment_agg import VAR_KINDS, chan_merge
+
         self.count += len(v)
-        if self.kind in ("sum", "avg"):
+        if self.kind in ("sum", "avg") or self.kind in VAR_KINDS:
             self.sum += float(v.sum())
+            if self.kind in VAR_KINDS and len(v):
+                x = v.astype(np.float64)
+                cm = float(x.mean())
+                cm2 = float(((x - cm) ** 2).sum())
+                n_prev = self.count - len(v)
+                _, self.mean, self.m2 = chan_merge(
+                    n_prev, self.mean, self.m2, len(v), cm, cm2
+                )
         elif self.kind == "min" and len(v):
             self.min = min(self.min, float(v.min()))
         elif self.kind == "max" and len(v):
             self.max = max(self.max, float(v.max()))
 
     def evaluate(self):
+        from denormalized_tpu.ops.segment_agg import VAR_KINDS, variance_from_m2
+
+        if self.kind in VAR_KINDS:
+            return float(variance_from_m2(self.kind, self.count, self.m2))
         return {
             "count": self.count,
             "sum": self.sum,
@@ -66,9 +84,18 @@ class _BuiltinAcc:
         }[self.kind]
 
     def state(self):
-        return [self.count, self.sum, float(self.min), float(self.max)]
+        return [
+            self.count, self.sum, float(self.min), float(self.max),
+            self.mean, self.m2,
+        ]
 
     def merge(self, s):
+        from denormalized_tpu.ops.segment_agg import chan_merge
+
+        _, self.mean, self.m2 = chan_merge(
+            self.count, self.mean, self.m2,
+            s[0], s[4] if len(s) > 4 else 0.0, s[5] if len(s) > 5 else 0.0,
+        )
         self.count += s[0]
         self.sum += s[1]
         self.min = min(self.min, s[2])
@@ -159,10 +186,10 @@ class UdafWindowExec(ExecOperator):
             if self.group_exprs
             else None
         )
-        from denormalized_tpu.logical.expr import Column
+        from denormalized_tpu.logical.expr import column_validity
 
         def mask_of(e) -> np.ndarray | None:
-            return batch.mask(e.name) if isinstance(e, Column) else None
+            return column_validity(e, batch)
 
         arg_cols: list[list[np.ndarray]] = []
         arg_masks: list[np.ndarray | None] = []
@@ -255,7 +282,11 @@ class UdafWindowExec(ExecOperator):
         for ai, a in enumerate(self.aggr_exprs):
             f = a.out_field(in_schema)
             vals = [accs[ai].evaluate() for _, accs in items]
-            arr = np.array(vals, dtype=object)
+            # element-wise fill: np.array(list_of_lists, dtype=object) would
+            # build a 2-D array when every list has the same length
+            arr = np.empty(len(vals), dtype=object)
+            for vi, v in enumerate(vals):
+                arr[vi] = v
             if f.dtype.is_numeric:
                 arr = arr.astype(f.dtype.to_numpy())
             cols.append(arr)

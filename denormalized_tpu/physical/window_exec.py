@@ -41,7 +41,7 @@ from denormalized_tpu.common.constants import (
 from denormalized_tpu.common.errors import PlanError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
-from denormalized_tpu.logical.expr import AggregateExpr, Column, Expr
+from denormalized_tpu.logical.expr import AggregateExpr, Expr
 from denormalized_tpu.logical.plan import WindowType
 from denormalized_tpu.ops import segment_agg as sa
 from denormalized_tpu.ops.interner import GroupInterner
@@ -103,18 +103,47 @@ class StreamingWindowExec(ExecOperator):
         # deduped value columns: one device column per distinct agg argument
         self._value_exprs: list[Expr] = []
         keys = {}
-        self._agg_specs: list[tuple[str, int | None]] = []
+
+        def value_idx(e: Expr) -> int:
+            k = repr(e)
+            if k not in keys:
+                keys[k] = len(self._value_exprs)
+                self._value_exprs.append(e)
+                self._value_transforms.append(None)
+            return keys[k]
+
+        # variance columns are SHIFTED on host by a pivot K picked from the
+        # first data (see segment_agg.variance_result): transforms[j] is
+        # None | "shift" | "shift_sq", and _var_shift maps the source
+        # expression's repr to its pivot (checkpointed with the operator)
+        self._value_transforms: list[str | None] = []
+        self._var_shift: dict[str, float] = {}
+
+        def shifted_idx(e: Expr, transform: str) -> int:
+            k = (transform, repr(e))
+            if k not in keys:
+                keys[k] = len(self._value_exprs)
+                self._value_exprs.append(e)
+                self._value_transforms.append(transform)
+            return keys[k]
+
+        self._agg_specs: list[tuple] = []
         for a in self.aggr_exprs:
             if a.kind == "udaf":
                 raise PlanError("UDAF aggregates run in UdafWindowExec")
             if a.arg is None:
                 self._agg_specs.append((a.kind, None))
                 continue
-            k = repr(a.arg)
-            if k not in keys:
-                keys[k] = len(self._value_exprs)
-                self._value_exprs.append(a.arg)
-            self._agg_specs.append((a.kind, keys[k]))
+            if a.kind in sa.VAR_KINDS:
+                self._agg_specs.append(
+                    (
+                        a.kind,
+                        shifted_idx(a.arg, "shift"),
+                        shifted_idx(a.arg, "shift_sq"),
+                    )
+                )
+            else:
+                self._agg_specs.append((a.kind, value_idx(a.arg)))
         components = tuple(sa.components_for(self._agg_specs))
 
         self._grouped = len(self.group_exprs) > 0
@@ -273,14 +302,30 @@ class StreamingWindowExec(ExecOperator):
         V = self._spec.num_value_cols
         values = np.zeros((n, max(V, 1)), dtype=np.float32)
         colvalid = np.ones((n, max(V, 1)), dtype=bool)
+        from denormalized_tpu.logical.expr import column_validity
+
         for j, e in enumerate(self._value_exprs):
-            v = e.eval(batch)
-            values[:, j] = np.asarray(v, dtype=np.float64)
-            m = None
-            if isinstance(e, Column):
-                m = batch.mask(e.name)
+            raw = np.asarray(e.eval(batch), dtype=np.float64)
+            m = column_validity(e, batch)
             if m is not None:
                 colvalid[:, j] = m
+            tr = self._value_transforms[j]
+            if tr is not None:
+                # variance moment columns: shift by a pivot K taken from the
+                # first valid value ever seen for this expression, so the
+                # s2 − s²/c finalize never catastrophically cancels (exact
+                # for any constant K)
+                key = repr(e)
+                K = self._var_shift.get(key)
+                if K is None:
+                    valid_vals = raw[colvalid[:, j]] if m is not None else raw
+                    finite = valid_vals[np.isfinite(valid_vals)]
+                    K = float(finite[0]) if len(finite) else 0.0
+                    self._var_shift[key] = K
+                raw = raw - K
+                if tr == "shift_sq":
+                    raw = raw * raw
+            values[:, j] = raw
 
         # pad to bucket (divisible by the mesh so row-sharding splits evenly)
         Bp = max(self._min_batch_bucket, _next_pow2(n))
@@ -387,6 +432,9 @@ class StreamingWindowExec(ExecOperator):
             "window_slots": self._spec.window_slots,
             "group_capacity": self._backend.group_capacity,
             "interner": self._interner.snapshot() if self._grouped else None,
+            # variance pivots: shifted sums are only comparable under the
+            # same K, so K must survive restart with the state it shifted
+            "var_shift": dict(self._var_shift),
         }
         coord.put_snapshot(key, epoch, pack_snapshot(meta, self._backend.export()))
 
@@ -417,6 +465,7 @@ class StreamingWindowExec(ExecOperator):
         self._first_open = meta["first_open"]
         self._max_win_seen = meta["max_win_seen"]
         self._watermark_ms = meta["watermark_ms"]
+        self._var_shift = dict(meta.get("var_shift") or {})
         if self._grouped and meta["interner"] is not None:
             self._interner = GroupInterner.restore(meta["interner"])
 
