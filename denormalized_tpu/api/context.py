@@ -38,9 +38,14 @@ class EngineConfig:
     state_backend_path: str | None = None
 
     # device execution profile.  accum_dtype=jnp.float64 additionally
-    # requires jax.config.update("jax_enable_x64", True) — without it JAX
-    # silently computes in float32.
+    # requires jax.config.update("jax_enable_x64", True) — the engine
+    # REFUSES to run f64 without it (JAX would silently compute in f32).
     accum_dtype: Any = jnp.float32
+    # compensated (Kahan-style) summation: sum components keep a (hi, lo)
+    # buffer pair and each batch folds in via exact TwoSum.  Error bound vs
+    # an f64 oracle: ~1e-6 relative at 1M f32 values per group (see
+    # segment_agg.WindowKernelSpec.compensated); plain f32 drifts ~1e-4.
+    compensated_sums: bool = False
     # streaming joins: rows older than the join watermark by more than this
     # are evicted (and emitted unmatched for outer joins)
     join_retention_ms: int = 300_000

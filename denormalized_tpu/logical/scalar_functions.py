@@ -85,6 +85,16 @@ def _substr(s, start, length=None):
     return s[begin:max(end, begin)]
 
 
+def _pad(s, n, p, left):
+    """Postgres lpad/rpad: the pad string CYCLES; result truncated to n."""
+    if len(s) >= n:
+        return s[:n]
+    fill = (p * (n - len(s)))[: n - len(s)] if p else ""
+    if not fill:
+        return s
+    return fill + s if left else s + fill
+
+
 def _split_part(s, delim, idx):
     parts = s.split(delim)
     i = int(idx)
@@ -153,7 +163,12 @@ _STRING_FNS = {
         _map_n(lambda s, f, t: s.replace(f, t)), _STR, min_args=3
     ),
     "translate": ScalarFn(
-        _map_n(lambda s, f, t: s.translate(str.maketrans(f, t[: len(f)]))),
+        # postgres semantics: chars beyond the 'to' string are DELETED
+        _map_n(
+            lambda s, f, t: s.translate(
+                str.maketrans(f[: len(t)], t[: len(f)], f[len(t):])
+            )
+        ),
         _STR,
         min_args=3,
     ),
@@ -171,13 +186,13 @@ _STRING_FNS = {
         _map_n(lambda s, n: s[-int(n):] if int(n) else ""), _STR, min_args=2
     ),
     "lpad": ScalarFn(
-        _map_n(lambda s, n, p=" ": s.rjust(int(n), p[:1])[: int(n)]),
+        _map_n(lambda s, n, p=" ": _pad(s, int(n), p, left=True)),
         _STR,
         min_args=2,
         max_args=3,
     ),
     "rpad": ScalarFn(
-        _map_n(lambda s, n, p=" ": s.ljust(int(n), p[:1])[: int(n)]),
+        _map_n(lambda s, n, p=" ": _pad(s, int(n), p, left=False)),
         _STR,
         min_args=2,
         max_args=3,
@@ -380,13 +395,18 @@ def _date_part(unit, ts):
 def _to_timestamp_millis(v):
     a = np.asarray(v)
     if a.dtype == object:
-        return np.array(
-            [
-                np.datetime64(x, "ms").astype(np.int64) if x is not None else 0
-                for x in a
-            ],
-            dtype=np.int64,
-        )
+        out = np.empty(len(a), dtype=object)
+        for i, x in enumerate(a):
+            # null propagates as None (an epoch-0 stand-in would silently
+            # inject 1970 events into windows)
+            out[i] = (
+                None
+                if x is None
+                else int(np.datetime64(x, "ms").astype(np.int64))
+            )
+        if all(x is not None for x in out):
+            return out.astype(np.int64)
+        return out
     return a.astype(np.int64)
 
 

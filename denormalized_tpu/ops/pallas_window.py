@@ -42,7 +42,9 @@ TILE = 256
 def _kernel(
     values_ref,  # (TILE, V) f32
     colvalid_ref,  # (TILE, V) f32 (1.0 valid)
-    rel_ref,  # (TILE, 1) int32 — slot relative to base, -1 = dropped
+    rel_ref,  # (TILE, KREL) int32 — slots relative to base, -1 = dropped.
+    # One column per window the row fans out to (sliding: KREL =
+    # length_units), so the whole fan-out costs ONE kernel launch.
     gid_ref,  # (TILE, 1) int32
     cnt_ref,  # (K, G*V) f32 out — valid-entry count per (slot, col, group)
     sum_ref,  # (K, G*V) f32 out
@@ -56,7 +58,7 @@ def _kernel(
     step = pl.program_id(0)
     values = values_ref[:]
     colvalid = colvalid_ref[:]
-    rel = rel_ref[:]  # (TILE, 1)
+    rel = rel_ref[:]  # (TILE, KREL)
     gid = gid_ref[:]
 
     # one-hot over groups, (TILE, G)
@@ -72,7 +74,11 @@ def _kernel(
         rowcnt_ref[:] = jnp.zeros_like(rowcnt_ref)
 
     for j in range(K_ACTIVE):
-        in_slot = (rel == j).astype(jnp.float32)  # (TILE, 1)
+        # a row feeds slot j through at most one of its KREL fan-out
+        # columns (windows are distinct), so the sum is 0/1
+        in_slot = jnp.sum(
+            (rel == j).astype(jnp.float32), axis=1, keepdims=True
+        )  # (TILE, 1)
         oh = onehot * in_slot  # rows of this slot only
         # rows per (slot, group): MXU matmul with a ones vector
         rowcnt_ref[j, :] += jnp.sum(oh, axis=0)
@@ -99,12 +105,14 @@ def _kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("G", "V", "interpret")
+    jax.jit, static_argnames=("G", "V", "KREL", "interpret")
 )
 def _dense_partials(
-    values, colvalid, rel, gid, *, G: int, V: int, interpret: bool
+    values, colvalid, rel, gid, *, G: int, V: int, KREL: int, interpret: bool
 ):
-    """→ (rowcnt (K,G), cnt (K,G,V), sum (K,G,V), min (K,G,V), max (K,G,V))"""
+    """→ (rowcnt (K,G), cnt (K,G,V), sum (K,G,V), min (K,G,V), max (K,G,V))
+
+    ``rel`` is (B, KREL): each row's target slots (rebased), -1 = dropped."""
     B = values.shape[0]
     assert B % TILE == 0
     grid = (B // TILE,)
@@ -114,7 +122,7 @@ def _dense_partials(
         in_specs=[
             pl.BlockSpec((TILE, V), lambda i: (i, 0)),
             pl.BlockSpec((TILE, V), lambda i: (i, 0)),
-            pl.BlockSpec((TILE, 1), lambda i: (i, 0)),
+            pl.BlockSpec((TILE, KREL), lambda i: (i, 0)),
             pl.BlockSpec((TILE, 1), lambda i: (i, 0)),
         ],
         out_specs=[
@@ -135,7 +143,7 @@ def _dense_partials(
     )(
         values.astype(jnp.float32),
         colvalid.astype(jnp.float32),
-        rel.reshape(-1, 1),
+        rel.reshape(-1, KREL),
         gid.reshape(-1, 1),
     )
     cnt, ssum, smin, smax, rowcnt = outs
@@ -150,14 +158,17 @@ def _dense_partials(
 
 
 def dense_supported(spec: sa.WindowKernelSpec) -> bool:
-    # TODO(next round, needs chip measurement): fold the fan-out loop into
-    # the kernel as a (TILE, k) rel matrix so sliding pays one launch.
     return (
         spec.group_capacity <= MAX_DENSE_GROUPS
-        and spec.length_units <= 2  # fan-out handled by slot replication
+        # sliding fan-out rides the (TILE, k) rel matrix in ONE launch; the
+        # batch's slot span must still fit the K_ACTIVE scratch rows (the
+        # caller additionally checks the actual span per batch)
+        and spec.length_units <= K_ACTIVE
         # the kernel accumulates in f32; honor an explicit f64 request by
         # staying on the scatter path
         and spec.accum_dtype == jnp.float32
+        # compensated (hi, lo) sums need the scatter path's TwoSum fold
+        and not spec.compensated
     )
 
 
@@ -213,37 +224,26 @@ def dense_update(
     ``min_win_rel`` is the smallest window index (relative to first_open) any
     row of this batch touches; the kernel works in ``rel - min_win_rel``
     space so K_ACTIVE covers the batch's span.  Caller guarantees the span
-    fits (else it uses the scatter path)."""
+    fits (else it uses the scatter path).  The k-way sliding fan-out is one
+    (B, k) rel matrix → ONE kernel launch regardless of k."""
     k = spec.length_units
-    B = values.shape[0]
-    rel_all = []
+    rel_cols = []
     for i in range(k):
         wr = win_rel - i
         ok = row_valid & (wr >= 0) & (wr < spec.window_slots)
         if spec.length_ms - i * spec.slide_ms < spec.slide_ms:
             ok = ok & (rem < spec.length_ms - i * spec.slide_ms)
-        rel = jnp.where(ok, wr - min_win_rel, -1).astype(jnp.int32)
-        rel_all.append(rel)
-    partials = None
-    for rel in rel_all:
-        p = _dense_partials(
-            values,
-            colvalid,
-            rel,
-            gid,
-            G=spec.group_capacity,
-            V=max(spec.num_value_cols, 1),
-            interpret=interpret,
-        )
-        if partials is None:
-            partials = p
-        else:
-            partials = (
-                partials[0] + p[0],
-                partials[1] + p[1],
-                partials[2] + p[2],
-                jnp.minimum(partials[3], p[3]),
-                jnp.maximum(partials[4], p[4]),
-            )
+        rel_cols.append(jnp.where(ok, wr - min_win_rel, -1).astype(jnp.int32))
+    rel = jnp.stack(rel_cols, axis=1)  # (B, k)
+    partials = _dense_partials(
+        values,
+        colvalid,
+        rel,
+        gid,
+        G=spec.group_capacity,
+        V=max(spec.num_value_cols, 1),
+        KREL=k,
+        interpret=interpret,
+    )
     base = (base_mod + jnp.asarray(min_win_rel, jnp.int32)) % spec.window_slots
     return _merge_partials(spec, state, partials, base)

@@ -212,6 +212,7 @@ class KeyShardedWindowState(WindowStateBackend):
             length_ms=spec.length_ms,
             slide_ms=spec.slide_ms,
             accum_dtype=spec.accum_dtype,
+            compensated=spec.compensated,
         )
         self._sharding = NamedSharding(mesh, P(None, KEY_AXIS))
         self._state = {
@@ -332,7 +333,7 @@ def _partial_merge_slot(spec: sa.WindowKernelSpec, mesh: Mesh, state, slot):
             row = jax.lax.dynamic_index_in_dim(
                 state_l[c.label][0], slot, axis=0, keepdims=False
             )
-            if c.kind in ("count", "sum"):
+            if c.kind in ("count", "sum", "sumc"):
                 out[c.label] = jax.lax.psum(row, KEY_AXIS)
             elif c.kind == "min":
                 out[c.label] = jax.lax.pmin(row, KEY_AXIS)
@@ -429,7 +430,7 @@ class PartialFinalWindowState(WindowStateBackend):
         out = {}
         for c in self.spec.components:
             b = host[c.label]
-            if c.kind in ("count", "sum"):
+            if c.kind in ("count", "sum", "sumc"):
                 out[c.label] = b.sum(axis=0)
             elif c.kind == "min":
                 out[c.label] = b.min(axis=0)

@@ -170,8 +170,6 @@ class SessionWindowExec(ExecOperator):
         if n == 0:
             return
         self._metrics["rows_in"] += n
-        from denormalized_tpu.logical.expr import Column
-
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         key_cols = [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
         vals = (
@@ -182,15 +180,11 @@ class SessionWindowExec(ExecOperator):
             if self._value_exprs
             else np.zeros((n, 0))
         )
+        from denormalized_tpu.logical.expr import column_validity
+
         valid = np.ones_like(vals, dtype=bool)
         for ci, e in enumerate(self._value_exprs):
-            m = None
-            for ref in (
-                (e.name,) if isinstance(e, Column) else e.columns_referenced()
-            ):
-                rm = batch.mask(ref) if batch.schema.has(ref) else None
-                if rm is not None:
-                    m = rm if m is None else (m & rm)
+            m = column_validity(e, batch)
             if m is not None:
                 valid[:, ci] = m
         # watermark advances from the RAW batch min (late rows included —

@@ -76,6 +76,7 @@ class StreamingWindowExec(ExecOperator):
         slide_ms: int | None,
         *,
         accum_dtype=jnp.float32,
+        compensated_sums: bool = False,
         min_group_capacity: int = 128,
         min_window_slots: int = 16,
         min_batch_bucket: int = 256,
@@ -144,7 +145,20 @@ class StreamingWindowExec(ExecOperator):
                 )
             else:
                 self._agg_specs.append((a.kind, value_idx(a.arg)))
-        components = tuple(sa.components_for(self._agg_specs))
+        import jax
+
+        if accum_dtype == jnp.float64 and not jax.config.jax_enable_x64:
+            raise PlanError(
+                "accum_dtype=float64 requires jax.config.update("
+                "'jax_enable_x64', True) — without it JAX silently "
+                "accumulates in float32; either enable x64 or use "
+                "compensated_sums=True for near-f64 sums in f32 storage"
+            )
+        comps = sa.components_for(self._agg_specs)
+        if compensated_sums:
+            comps = sa.with_compensation(comps)
+        components = tuple(comps)
+        self._compensated = compensated_sums
 
         self._grouped = len(self.group_exprs) > 0
         self._interner = GroupInterner(len(self.group_exprs)) if self._grouped else None
@@ -162,6 +176,7 @@ class StreamingWindowExec(ExecOperator):
             length_ms=self.length_ms,
             slide_ms=self.slide_ms,
             accum_dtype=accum_dtype,
+            compensated=compensated_sums,
         )
         from denormalized_tpu.parallel.sharded_state import make_sharded_state
 
@@ -225,6 +240,7 @@ class StreamingWindowExec(ExecOperator):
             length_ms=old.length_ms,
             slide_ms=old.slide_ms,
             accum_dtype=old.accum_dtype,
+            compensated=old.compensated,
         )
         if window_slots and self._first_open is not None:
             # ring phase changes with W: re-lay out slots by absolute window
@@ -320,8 +336,15 @@ class StreamingWindowExec(ExecOperator):
                 if K is None:
                     valid_vals = raw[colvalid[:, j]] if m is not None else raw
                     finite = valid_vals[np.isfinite(valid_vals)]
-                    K = float(finite[0]) if len(finite) else 0.0
-                    self._var_shift[key] = K
+                    if len(finite):
+                        K = float(finite[0])
+                        self._var_shift[key] = K
+                    else:
+                        # no finite value yet (all-null warm-up batch): use 0
+                        # transiently but do NOT cache it — a later batch
+                        # with real data must still set a magnitude-matched
+                        # pivot, or the cancellation guard is lost
+                        K = 0.0
                 raw = raw - K
                 if tr == "shift_sq":
                     raw = raw * raw
@@ -457,6 +480,7 @@ class StreamingWindowExec(ExecOperator):
             length_ms=old.length_ms,
             slide_ms=old.slide_ms,
             accum_dtype=old.accum_dtype,
+            compensated=old.compensated,
         )
         self._backend = make_sharded_state(
             self._spec, self._mesh, self._shard_strategy, self._device_strategy

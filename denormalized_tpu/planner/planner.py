@@ -51,6 +51,7 @@ class Planner:
                     mesh = make_mesh(self.config.mesh_devices)
                 kwargs.update(
                     accum_dtype=self.config.accum_dtype,
+                    compensated_sums=self.config.compensated_sums,
                     min_group_capacity=self.config.min_group_capacity,
                     min_window_slots=self.config.min_window_slots,
                     min_batch_bucket=self.config.min_batch_bucket,

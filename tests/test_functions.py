@@ -71,6 +71,9 @@ BATCH = rb(
             F.concat_ws(lit("/"), col("k"), lit("z")),
             ["Hello World/z", "abc-def-ghi/z", "z"],
         ),
+        (F.translate(lit("abcba"), lit("abc"), lit("x")), ["xx", "xx", "xx"]),
+        (F.lpad(lit("hi"), lit(6), lit("xy")), ["xyxyhi", "xyxyhi", "xyxyhi"]),
+        (F.rpad(lit("hi"), lit(5), lit("xy")), ["hixyx", "hixyx", "hixyx"]),
         (F.ascii(lit("A")), [65, 65, 65]),
         (F.chr(lit(66)), ["B", "B", "B"]),
         (F.octet_length(lit("日本")), [6, 6, 6]),
@@ -154,6 +157,11 @@ def test_date_functions():
     assert all(int(x) % 100_000 == 0 for x in bin100)
     iso = F.to_timestamp_millis(lit("2023-11-14T22:13:20")).eval(BATCH)
     assert int(iso[0]) == 1_700_000_000_000
+    # null strings propagate as None, never as epoch-0 events
+    nulls = F.to_timestamp_millis(col("k")).eval(
+        rb([1, 2], ["2023-11-14T22:13:20", None], [0.0, 0.0])
+    )
+    assert int(nulls[0]) == 1_700_000_000_000 and nulls[1] is None
 
 
 # -- scalar: conditional + CASE -----------------------------------------

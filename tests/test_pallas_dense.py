@@ -65,8 +65,10 @@ def _run_inner(strategy, batches, slide, calls, expect_dense):
     }
 
 
-@pytest.mark.parametrize("slide", [None, 500])
+@pytest.mark.parametrize("slide", [None, 500, 200])
 def test_pallas_dense_matches_scatter(make_batch, slide):
+    # slide=200 is the BASELINE.md sliding config's shape (k=5): the k-way
+    # fan-out rides the (TILE, k) rel matrix in a single kernel launch
     rng = np.random.default_rng(7)
     t0 = 1_700_000_000_000
     batches = []
