@@ -113,10 +113,13 @@ class Context:
         timestamp_column: str | None = None,
         encoding: str = "json",
         schema: Schema | None = None,
+        avro_schema=None,
     ):
         """Kafka source entry point (PyContext::from_topic,
         py-denormalized/src/context.rs:50-117): schema comes from an explicit
-        Schema or is inferred from ``sample_json``."""
+        Schema, is inferred from ``sample_json``, or — for
+        ``encoding="avro"`` — derives from ``avro_schema`` (an Avro record
+        declaration as JSON string or dict)."""
         from denormalized_tpu.sources.kafka import KafkaTopicBuilder
 
         builder = (
@@ -127,7 +130,19 @@ class Context:
         )
         if timestamp_column:
             builder = builder.with_timestamp_column(timestamp_column)
-        if schema is not None:
+        if avro_schema is not None:
+            # conflicting arguments are errors, not silent overrides
+            if schema is not None:
+                raise PlanError(
+                    "pass either schema= or avro_schema=, not both (the "
+                    "Avro declaration defines the schema)"
+                )
+            if encoding.lower() != "avro":
+                raise PlanError(
+                    f"avro_schema= conflicts with encoding={encoding!r}"
+                )
+            builder = builder.with_avro_schema(avro_schema)
+        elif schema is not None:
             builder = builder.with_schema(schema)
         elif sample_json is not None:
             builder = builder.infer_schema_from_json(sample_json)

@@ -1,0 +1,131 @@
+"""Shared ctypes plumbing for the native columnar parsers (JSON, Avro).
+
+Both C++ parsers expose the same column-oriented ABI behind a prefix
+(``jp_`` / ``ap_``): create/destroy/clear/parse/error/nrows plus per-column
+getters.  This module owns the signature setup and the parse/extract loop so
+the two wrappers can't drift (e.g. null-mask materialization or the
+``errors='replace'`` string decode — invalid bytes become U+FFFD so a weird
+payload can never crash the reader — live in exactly one place)."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from denormalized_tpu.common.errors import FormatError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+
+
+def configure_lib(lib, prefix: str, create_argtypes: list) -> None:
+    """Set ctypes signatures for one parser library (idempotent)."""
+    flag = f"_{prefix}_configured"
+    if getattr(lib, flag, False):
+        return
+    g = lambda name: getattr(lib, f"{prefix}_{name}")  # noqa: E731
+    g("create").restype = ctypes.c_void_p
+    g("create").argtypes = create_argtypes
+    g("parse").restype = ctypes.c_int
+    g("parse").argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,  # bytes or a raw pointer into a native buffer
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+    ]
+    g("error").restype = ctypes.c_char_p
+    g("error").argtypes = [ctypes.c_void_p]
+    g("nrows").restype = ctypes.c_uint64
+    g("nrows").argtypes = [ctypes.c_void_p]
+    for fn, restype in (
+        ("col_i64", ctypes.POINTER(ctypes.c_int64)),
+        ("col_f64", ctypes.POINTER(ctypes.c_double)),
+        ("col_bool", ctypes.POINTER(ctypes.c_uint8)),
+        ("col_valid", ctypes.POINTER(ctypes.c_uint8)),
+        ("col_str_offsets", ctypes.POINTER(ctypes.c_uint64)),
+    ):
+        g(fn).restype = restype
+        g(fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+    g("col_str_bytes").restype = ctypes.POINTER(ctypes.c_uint8)
+    g("col_str_bytes").argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    g("clear").argtypes = [ctypes.c_void_p]
+    g("destroy").argtypes = [ctypes.c_void_p]
+    setattr(lib, flag, True)
+
+
+class ColumnarNativeParser:
+    """Base wrapper: subclasses set ``_libref``, ``_h``, ``_prefix``,
+    ``schema`` and ``_kinds`` ('i64'|'f64'|'bool'|'str' per column)."""
+
+    schema: Schema
+    _kinds: list[str]
+    _prefix: str
+
+    def _fn(self, name: str):
+        return getattr(self._libref, f"{self._prefix}_{name}")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._fn("destroy")(h)
+            self._h = None
+
+    def parse(self, rows: list[bytes]) -> RecordBatch:
+        n = len(rows)
+        if n == 0:
+            return RecordBatch.empty(self.schema)
+        data = b"".join(rows)
+        offsets = np.zeros(n + 1, dtype=np.uint64)
+        offsets[1:] = np.cumsum([len(r) for r in rows], dtype=np.uint64)
+        return self.parse_ptr(
+            data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n
+        )
+
+    def parse_ptr(self, data, offsets_ptr, n: int) -> RecordBatch:
+        """Zero-copy entry: ``data`` may be a bytes object OR a raw ctypes
+        pointer into another native component's buffer (e.g. the Kafka
+        client's fetch arena) — payload bytes never become Python
+        objects."""
+        self._fn("clear")(self._h)
+        rc = self._fn("parse")(self._h, data, offsets_ptr, n)
+        if rc != 0:
+            raise FormatError(self._fn("error")(self._h).decode())
+        cols, masks = [], []
+        for ci, f in enumerate(self.schema):
+            valid = np.ctypeslib.as_array(
+                self._fn("col_valid")(self._h, ci), shape=(n,)
+            ).astype(bool)
+            kind = self._kinds[ci]
+            if kind == "i64":
+                arr = np.ctypeslib.as_array(
+                    self._fn("col_i64")(self._h, ci), shape=(n,)
+                ).astype(f.dtype.to_numpy(), copy=True)
+            elif kind == "f64":
+                arr = np.ctypeslib.as_array(
+                    self._fn("col_f64")(self._h, ci), shape=(n,)
+                ).astype(f.dtype.to_numpy(), copy=True)
+            elif kind == "bool":
+                arr = np.ctypeslib.as_array(
+                    self._fn("col_bool")(self._h, ci), shape=(n,)
+                ).astype(bool)
+            else:
+                nb = ctypes.c_uint64()
+                bptr = self._fn("col_str_bytes")(
+                    self._h, ci, ctypes.byref(nb)
+                )
+                raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
+                offs = np.ctypeslib.as_array(
+                    self._fn("col_str_offsets")(self._h, ci), shape=(n + 1,)
+                )
+                arr = np.empty(n, dtype=object)
+                for i in range(n):
+                    arr[i] = raw[offs[i] : offs[i + 1]].decode(
+                        errors="replace"
+                    )
+            cols.append(arr)
+            masks.append(None if valid.all() else valid)
+        return RecordBatch(self.schema, cols, masks)

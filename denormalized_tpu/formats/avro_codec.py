@@ -52,12 +52,13 @@ class AvroSchema:
             t = f["type"]
             nullable = False
             if isinstance(t, list):  # union
-                branches = [b for b in t if b != "null"]
-                if len(branches) != 1 or len(t) > 2:
+                # null must come FIRST: the decoder maps union branch 0 to
+                # null, so ['T', 'null'] would silently misread every value
+                if len(t) != 2 or t[0] != "null":
                     raise FormatError(
                         f"only ['null', T] unions supported, got {t!r}"
                     )
-                t = branches[0]
+                t = t[1]
                 nullable = True
             self.fields.append((f["name"], t, nullable))
 
@@ -141,6 +142,10 @@ def decode_value(t, nullable: bool, buf: io.BytesIO):
         branch = _zigzag_decode(buf)
         if branch == 0:
             return None
+        if branch != 1:
+            raise FormatError(
+                f"invalid union branch {branch} (only ['null', T])"
+            )
     base = t.get("type") if isinstance(t, dict) else t
     if base == "boolean":
         raw = buf.read(1)
@@ -161,10 +166,15 @@ def decode_value(t, nullable: bool, buf: io.BytesIO):
         return struct.unpack("<d", raw)[0]
     if base in ("string", "bytes"):
         n = _zigzag_decode(buf)
+        if n < 0:
+            raise FormatError("negative Avro string length")
         raw = buf.read(n)
         if len(raw) != n:
             raise FormatError("truncated Avro string")
-        return raw.decode() if base == "string" else raw
+        # errors='replace' matches the native parser: invalid UTF-8 becomes
+        # U+FFFD rather than an exception class the reader's per-record
+        # salvage doesn't catch
+        return raw.decode(errors="replace") if base == "string" else raw
     raise FormatError(f"unsupported Avro type {t!r}")
 
 
@@ -177,16 +187,26 @@ def encode_record(schema: AvroSchema, record: dict) -> bytes:
 
 def decode_record(schema: AvroSchema, payload: bytes) -> dict:
     buf = io.BytesIO(payload)
-    return {
+    out = {
         name: decode_value(t, nullable, buf)
         for name, t, nullable in schema.fields
     }
+    if buf.read(1):
+        # same contract as the native parser: trailing bytes after the last
+        # field mean a corrupt record or a mismatched schema
+        raise FormatError("trailing bytes after Avro record")
+    return out
 
 
 class AvroDecoder(Decoder):
-    """Buffer Avro-encoded records; flush one batch."""
+    """Buffer Avro-encoded records; flush one batch.
 
-    def __init__(self, schema: Schema | None, avro_schema):
+    Decode is native (C++ one-pass columnar, avro_parser.cpp — mirroring
+    the reference's Rust-native path) whenever the schema is flat; the
+    pure-Python record decoder remains as the no-compiler fallback and the
+    differential-test oracle."""
+
+    def __init__(self, schema: Schema | None, avro_schema, use_native=True):
         if avro_schema is None:
             raise FormatError("Avro decoding requires an Avro schema")
         if not isinstance(avro_schema, AvroSchema):
@@ -194,6 +214,16 @@ class AvroDecoder(Decoder):
         self.avro_schema = avro_schema
         self.schema = schema or avro_schema.to_engine_schema()
         self._rows: list[bytes] = []
+        self._native = None
+        if use_native:
+            try:
+                from denormalized_tpu.formats.native_avro import (
+                    NativeAvroParser,
+                )
+
+                self._native = NativeAvroParser(avro_schema, self.schema)
+            except Exception:
+                self._native = None
 
     def push(self, payload: bytes) -> None:
         if payload:
@@ -201,5 +231,7 @@ class AvroDecoder(Decoder):
 
     def flush(self) -> RecordBatch:
         rows, self._rows = self._rows, []
+        if self._native is not None:
+            return self._native.parse(rows)
         objs = [decode_record(self.avro_schema, r) for r in rows]
         return rows_to_batch(objs, self.schema)

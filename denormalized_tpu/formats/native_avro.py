@@ -1,0 +1,87 @@
+"""ctypes wrapper over the native one-pass Avro-binary → columnar parser.
+
+Same shape as :mod:`denormalized_tpu.formats.native_json` (shared plumbing
+in :mod:`denormalized_tpu.formats._native_parser_base`): ``parse_ptr``
+accepts either bytes or a raw pointer into another native component's
+buffer (the Kafka fetch arena), so payload bytes never become Python
+objects on the hot path.  Reference capability: the Rust-native Avro
+decode at crates/core/src/formats/decoders/avro.rs:11-54.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from denormalized_tpu.common.errors import FormatError
+from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.formats._native_parser_base import (
+    ColumnarNativeParser,
+    configure_lib,
+)
+from denormalized_tpu.native.build import load
+
+# native type codes (see avro_parser.cpp): base Avro type → code
+_AVRO_CODE = {
+    "int": 0,
+    "long": 0,
+    "boolean": 2,
+    "float": 4,
+    "double": 1,
+    "string": 3,
+    "bytes": 3,
+}
+_OUT_KIND = {0: "i64", 1: "f64", 4: "f64", 2: "bool", 3: "str"}
+
+
+def _lib():
+    lib = load("avro_parser")
+    configure_lib(
+        lib,
+        "ap",
+        [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ],
+    )
+    return lib
+
+
+def _base_type(t) -> str:
+    if isinstance(t, dict):
+        return str(t.get("type"))
+    return str(t)
+
+
+class NativeAvroParser(ColumnarNativeParser):
+    """One parser per AvroSchema; positional fields, flat records only."""
+
+    _prefix = "ap"
+
+    def __init__(self, avro_schema, schema: Schema):
+        # Avro fields are positional: the engine schema MUST align
+        # one-to-one with the Avro declaration, or columns would be
+        # silently mislabeled (a reordered/subset user schema falls back to
+        # the by-name pure-Python decoder instead)
+        if len(schema) != len(avro_schema.fields) or any(
+            f.name != name
+            for f, (name, _, _) in zip(schema, avro_schema.fields)
+        ):
+            raise FormatError(
+                "engine schema does not align positionally with the Avro "
+                "declaration"
+            )
+        self.schema = schema
+        codes = []
+        nullables = []
+        for name, t, nullable in avro_schema.fields:
+            base = _base_type(t)
+            if base not in _AVRO_CODE:
+                raise FormatError(f"native Avro parser cannot handle {t!r}")
+            codes.append(_AVRO_CODE[base])
+            nullables.append(1 if nullable else 0)
+        self._kinds = [_OUT_KIND[c] for c in codes]
+        self._libref = _lib()
+        ctypes_codes = (ctypes.c_int * len(codes))(*codes)
+        ctypes_nulls = (ctypes.c_int * len(codes))(*nullables)
+        self._h = self._libref.ap_create(len(codes), ctypes_codes, ctypes_nulls)

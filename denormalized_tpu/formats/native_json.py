@@ -1,14 +1,16 @@
-"""ctypes wrapper over the native one-pass JSON → columnar parser."""
+"""ctypes wrapper over the native one-pass JSON → columnar parser (shared
+plumbing in :mod:`denormalized_tpu.formats._native_parser_base`)."""
 
 from __future__ import annotations
 
 import ctypes
 
-import numpy as np
-
 from denormalized_tpu.common.errors import FormatError
-from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Schema
+from denormalized_tpu.formats._native_parser_base import (
+    ColumnarNativeParser,
+    configure_lib,
+)
 from denormalized_tpu.native.build import load
 
 _TYPE_CODE = {
@@ -20,55 +22,32 @@ _TYPE_CODE = {
     DataType.BOOL: 2,
     DataType.STRING: 3,
 }
+_OUT_KIND = {0: "i64", 1: "f64", 2: "bool", 3: "str"}
 
 
 def _lib():
     lib = load("json_parser")
-    if not getattr(lib, "_jp_configured", False):
-        lib.jp_create.restype = ctypes.c_void_p
-        lib.jp_create.argtypes = [
+    configure_lib(
+        lib,
+        "jp",
+        [
             ctypes.c_int,
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.jp_parse.restype = ctypes.c_int
-        lib.jp_parse.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_void_p,  # bytes or a raw pointer into a native buffer
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64,
-        ]
-        lib.jp_error.restype = ctypes.c_char_p
-        lib.jp_error.argtypes = [ctypes.c_void_p]
-        lib.jp_nrows.restype = ctypes.c_uint64
-        lib.jp_nrows.argtypes = [ctypes.c_void_p]
-        for fn, restype in (
-            ("jp_col_i64", ctypes.POINTER(ctypes.c_int64)),
-            ("jp_col_f64", ctypes.POINTER(ctypes.c_double)),
-            ("jp_col_bool", ctypes.POINTER(ctypes.c_uint8)),
-            ("jp_col_valid", ctypes.POINTER(ctypes.c_uint8)),
-            ("jp_col_str_offsets", ctypes.POINTER(ctypes.c_uint64)),
-        ):
-            getattr(lib, fn).restype = restype
-            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.jp_col_str_bytes.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.jp_col_str_bytes.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.jp_clear.argtypes = [ctypes.c_void_p]
-        lib.jp_destroy.argtypes = [ctypes.c_void_p]
-        lib._jp_configured = True
+        ],
+    )
     return lib
 
 
-class NativeJsonParser:
+class NativeJsonParser(ColumnarNativeParser):
+    _prefix = "jp"
+
     def __init__(self, schema: Schema):
         for f in schema:
             if f.dtype not in _TYPE_CODE:
                 raise FormatError(f"native parser cannot handle {f.dtype}")
         self.schema = schema
+        self._kinds = [_OUT_KIND[_TYPE_CODE[f.dtype]] for f in schema]
         self._libref = _lib()
         names = (ctypes.c_char_p * len(schema))(
             *[f.name.encode() for f in schema]
@@ -77,65 +56,3 @@ class NativeJsonParser:
             *[_TYPE_CODE[f.dtype] for f in schema]
         )
         self._h = self._libref.jp_create(len(schema), names, types)
-
-    def __del__(self):
-        h = getattr(self, "_h", None)
-        if h:
-            self._libref.jp_destroy(h)
-            self._h = None
-
-    def parse(self, rows: list[bytes]) -> RecordBatch:
-        n = len(rows)
-        if n == 0:
-            return RecordBatch.empty(self.schema)
-        data = b"".join(rows)
-        offsets = np.zeros(n + 1, dtype=np.uint64)
-        offsets[1:] = np.cumsum([len(r) for r in rows], dtype=np.uint64)
-        return self.parse_ptr(
-            data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), n
-        )
-
-    def parse_ptr(self, data, offsets_ptr, n: int) -> RecordBatch:
-        """Zero-copy entry: ``data`` may be a bytes object OR a raw ctypes
-        pointer into another native component's buffer (e.g. the Kafka
-        client's fetch arena) — payload bytes never become Python objects."""
-        lib = self._libref
-        lib.jp_clear(self._h)
-        rc = lib.jp_parse(self._h, data, offsets_ptr, n)
-        if rc != 0:
-            raise FormatError(lib.jp_error(self._h).decode())
-        cols, masks = [], []
-        for ci, f in enumerate(self.schema):
-            valid = np.ctypeslib.as_array(
-                lib.jp_col_valid(self._h, ci), shape=(n,)
-            ).astype(bool)
-            code = _TYPE_CODE[f.dtype]
-            if code == 0:
-                arr = np.ctypeslib.as_array(
-                    lib.jp_col_i64(self._h, ci), shape=(n,)
-                ).astype(f.dtype.to_numpy(), copy=True)
-            elif code == 1:
-                arr = np.ctypeslib.as_array(
-                    lib.jp_col_f64(self._h, ci), shape=(n,)
-                ).astype(f.dtype.to_numpy(), copy=True)
-            elif code == 2:
-                arr = np.ctypeslib.as_array(
-                    lib.jp_col_bool(self._h, ci), shape=(n,)
-                ).astype(bool)
-            else:
-                nb = ctypes.c_uint64()
-                bptr = lib.jp_col_str_bytes(self._h, ci, ctypes.byref(nb))
-                raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
-                offs = np.ctypeslib.as_array(
-                    lib.jp_col_str_offsets(self._h, ci), shape=(n + 1,)
-                )
-                arr = np.empty(n, dtype=object)
-                for i in range(n):
-                    # errors='replace': never crash the reader on weird
-                    # escape sequences; lone surrogates become U+FFFD
-                    arr[i] = raw[offs[i] : offs[i + 1]].decode(
-                        errors="replace"
-                    )
-            cols.append(arr)
-            masks.append(None if valid.all() else valid)
-        return RecordBatch(self.schema, cols, masks)

@@ -1,0 +1,234 @@
+// avro_parser — one-pass Avro-binary → columnar decoder.
+//
+// The reference's Avro decode is native (Rust apache-avro through
+// DataFusion's avro_to_arrow, crates/core/src/formats/decoders/avro.rs:11-54);
+// this is our native equivalent, built like json_parser.cpp: the caller
+// hands an arena of concatenated record payloads + offsets (typically the
+// Kafka fetch arena, zero-copy) and reads back columnar buffers.
+//
+// Avro records are positional — no key matching, just the schema's field
+// order: [nullable-union branch varint] then the value per the base type.
+// Supported base types (codes): 0 = int/long/timestamp-millis (zigzag
+// varint → i64), 1 = float/double (IEEE LE → f64), 2 = boolean (1 byte),
+// 3 = string/bytes (length varint + raw).  Nullable fields are the
+// ["null", T] union (branch 0 = null, branch 1 = value) — the only union
+// shape the engine schema layer admits.
+//
+// C ABI for ctypes; one parser object per schema; not thread-safe.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct AvroCol {
+  int type;  // 0 i64, 1 f64, 2 bool, 3 string
+  int nullable;
+  std::vector<int64_t> i64;
+  std::vector<double> f64;
+  std::vector<uint8_t> b;
+  std::vector<uint8_t> valid;
+  std::vector<uint8_t> str_bytes;
+  std::vector<uint64_t> str_offsets;  // n+1
+  void clear() {
+    i64.clear();
+    f64.clear();
+    b.clear();
+    valid.clear();
+    str_bytes.clear();
+    str_offsets.assign(1, 0);
+  }
+  void push_null() {
+    valid.push_back(0);
+    switch (type) {
+      case 0: i64.push_back(0); break;
+      case 1:
+      case 4: f64.push_back(0); break;  // float shares the f64 store
+      case 2: b.push_back(0); break;
+      case 3: str_offsets.push_back(str_bytes.size()); break;
+    }
+  }
+};
+
+struct AvroParser {
+  std::vector<AvroCol> cols;
+  std::string error;
+  uint64_t nrows = 0;
+};
+
+struct Cur {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+};
+
+// Avro long: zigzag base-128 varint (spec "binary encoding")
+int64_t read_varint(Cur& c) {
+  uint64_t acc = 0;
+  int shift = 0;
+  while (c.p < c.end) {
+    uint8_t b = *c.p++;
+    acc |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80))
+      return (int64_t)((acc >> 1) ^ (~(acc & 1) + 1));
+    shift += 7;
+    if (shift > 63) break;
+  }
+  c.fail = true;
+  return 0;
+}
+
+bool parse_record(AvroParser* p, Cur& c) {
+  for (auto& col : p->cols) {
+    if (col.nullable) {
+      int64_t branch = read_varint(c);
+      if (c.fail) return false;
+      if (branch == 0) {
+        col.push_null();
+        continue;
+      }
+      if (branch != 1) return false;  // only ["null", T]
+    }
+    switch (col.type) {
+      case 0: {
+        int64_t v = read_varint(c);
+        if (c.fail) return false;
+        col.i64.push_back(v);
+        col.valid.push_back(1);
+        break;
+      }
+      case 1: {  // double: 8-byte IEEE LE
+        if (c.p + 8 > c.end) return false;
+        double v;
+        memcpy(&v, c.p, 8);
+        c.p += 8;
+        col.f64.push_back(v);
+        col.valid.push_back(1);
+        break;
+      }
+      case 4: {  // float: 4-byte IEEE LE, widened to f64 storage
+        if (c.p + 4 > c.end) return false;
+        float v;
+        memcpy(&v, c.p, 4);
+        c.p += 4;
+        col.f64.push_back((double)v);
+        col.valid.push_back(1);
+        break;
+      }
+      case 2: {
+        if (c.p >= c.end) return false;
+        col.b.push_back(*c.p++ ? 1 : 0);
+        col.valid.push_back(1);
+        break;
+      }
+      case 3: {
+        int64_t n = read_varint(c);
+        if (c.fail || n < 0 || c.p + n > c.end) return false;
+        col.str_bytes.insert(col.str_bytes.end(), c.p, c.p + n);
+        c.p += n;
+        col.str_offsets.push_back(col.str_bytes.size());
+        col.valid.push_back(1);
+        break;
+      }
+      default:
+        return false;
+    }
+  }
+  // trailing bytes after the last field = corrupt/mismatched schema
+  return c.p == c.end;
+}
+
+void rollback_row(AvroParser* p, size_t row) {
+  // drop any partial values parse_record pushed for the failed row
+  for (auto& col : p->cols) {
+    if (col.valid.size() > row) {
+      col.valid.resize(row);
+      if (col.i64.size() > row) col.i64.resize(row);
+      if (col.f64.size() > row) col.f64.resize(row);
+      if (col.b.size() > row) col.b.resize(row);
+      if (col.str_offsets.size() > row + 1) {
+        col.str_offsets.resize(row + 1);
+        col.str_bytes.resize(col.str_offsets.back());
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// types[i]: 0 i64(varint) | 1 f64(8B) | 4 f32(4B stored as f64) | 2 bool |
+// 3 string/bytes; nullables[i]: 1 = ["null", T] union-prefixed
+void* ap_create(int ncols, const int* types, const int* nullables) {
+  AvroParser* p = new AvroParser();
+  p->cols.resize(ncols);
+  for (int i = 0; i < ncols; i++) {
+    p->cols[i].type = types[i];
+    p->cols[i].nullable = nullables[i];
+    p->cols[i].str_offsets.assign(1, 0);
+  }
+  return p;
+}
+
+void ap_destroy(void* h) { delete static_cast<AvroParser*>(h); }
+
+void ap_clear(void* h) {
+  AvroParser* p = static_cast<AvroParser*>(h);
+  p->nrows = 0;
+  p->error.clear();
+  for (auto& c : p->cols) c.clear();
+}
+
+const char* ap_error(void* h) {
+  return static_cast<AvroParser*>(h)->error.c_str();
+}
+
+uint64_t ap_nrows(void* h) { return static_cast<AvroParser*>(h)->nrows; }
+
+// parse n records from the arena; offsets has n+1 entries
+int ap_parse(void* h, const void* data, const uint64_t* offsets, uint64_t n) {
+  AvroParser* p = static_cast<AvroParser*>(h);
+  const uint8_t* base = (const uint8_t*)data;
+  for (uint64_t i = 0; i < n; i++) {
+    Cur c{base + offsets[i], base + offsets[i + 1]};
+    size_t row = (size_t)p->nrows;
+    if (!parse_record(p, c)) {
+      rollback_row(p, row);
+      char msg[96];
+      snprintf(msg, sizeof msg,
+               "malformed Avro record at index %llu (offset %llu)",
+               (unsigned long long)i, (unsigned long long)offsets[i]);
+      p->error = msg;
+      return -1;
+    }
+    p->nrows++;
+  }
+  return 0;
+}
+
+const int64_t* ap_col_i64(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].i64.data();
+}
+const double* ap_col_f64(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].f64.data();
+}
+const uint8_t* ap_col_bool(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].b.data();
+}
+const uint8_t* ap_col_valid(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].valid.data();
+}
+const uint64_t* ap_col_str_offsets(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].str_offsets.data();
+}
+const uint8_t* ap_col_str_bytes(void* h, int ci, uint64_t* nbytes) {
+  AvroCol& c = static_cast<AvroParser*>(h)->cols[ci];
+  *nbytes = c.str_bytes.size();
+  return c.str_bytes.data();
+}
+
+}  // extern "C"

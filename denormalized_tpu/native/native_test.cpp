@@ -15,6 +15,7 @@
 #include <vector>
 
 // single-TU build: include the component sources directly
+#include "avro_parser.cpp"
 #include "interner.cpp"
 #include "json_parser.cpp"
 #include "kafka_client.cpp"
@@ -156,6 +157,72 @@ static void test_json() {
   printf("json ok\n");
 }
 
+static void zz(std::vector<uint8_t>& out, int64_t v) {
+  uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+  while (z >= 0x80) {
+    out.push_back((uint8_t)(z | 0x80));
+    z >>= 7;
+  }
+  out.push_back((uint8_t)z);
+}
+
+static void test_avro() {
+  // schema: long ts, nullable double v, string name, bool ok
+  int types[4] = {0, 1, 3, 2};
+  int nulls[4] = {0, 1, 0, 0};
+  void* p = ap_create(4, types, nulls);
+  std::vector<uint8_t> arena;
+  std::vector<uint64_t> offs{0};
+  auto rec = [&](int64_t ts, bool has_v, double v, const char* s, bool ok) {
+    zz(arena, ts);
+    zz(arena, has_v ? 1 : 0);
+    if (has_v) {
+      const uint8_t* b = (const uint8_t*)&v;
+      arena.insert(arena.end(), b, b + 8);
+    }
+    zz(arena, (int64_t)strlen(s));
+    arena.insert(arena.end(), (const uint8_t*)s, (const uint8_t*)s + strlen(s));
+    arena.push_back(ok ? 1 : 0);
+    offs.push_back(arena.size());
+  };
+  rec(1700000000000LL, true, 2.5, "alpha", true);
+  rec(-42, false, 0, "", false);
+  rec(7, true, -1.25, "日本", true);
+  assert(ap_parse(p, arena.data(), offs.data(), 3) == 0);
+  assert(ap_nrows(p) == 3);
+  const int64_t* ts = ap_col_i64(p, 0);
+  assert(ts[0] == 1700000000000LL && ts[1] == -42 && ts[2] == 7);
+  const uint8_t* valid = ap_col_valid(p, 1);
+  assert(valid[0] == 1 && valid[1] == 0 && valid[2] == 1);
+  const double* v = ap_col_f64(p, 1);
+  assert(v[0] == 2.5 && v[2] == -1.25);
+  const uint8_t* okc = ap_col_bool(p, 3);
+  assert(okc[0] == 1 && okc[1] == 0 && okc[2] == 1);
+  // trailing garbage after the last field must fail the parse
+  ap_clear(p);
+  std::vector<uint8_t> bad(arena.begin(), arena.begin() + (long)offs[1]);
+  bad.push_back(0xAB);
+  uint64_t boffs[2] = {0, bad.size()};
+  assert(ap_parse(p, bad.data(), boffs, 1) == -1);
+  // sanitizer fuzz: truncations + single-byte corruptions of a valid arena
+  for (uint64_t n = 0; n <= offs[1]; n++) {
+    ap_clear(p);
+    uint64_t toffs[2] = {0, n};
+    std::vector<uint8_t> exact(arena.begin(), arena.begin() + (long)n);
+    ap_parse(p, exact.data(), toffs, 1);
+  }
+  for (size_t i = 0; i < offs[1]; i++)
+    for (uint8_t x : {0xFF, 0x80, 0x01}) {
+      ap_clear(p);
+      std::vector<uint8_t> m(arena.begin(), arena.begin() + (long)offs[1]);
+      m[i] ^= x;
+      uint64_t moffs[2] = {0, m.size()};
+      ap_parse(p, m.data(), moffs, 1);
+    }
+  ap_destroy(p);
+  printf("avro ok\n");
+}
+
 static void test_codecs() {
   // valid raw-snappy: "hellohellohello!" via literal + overlapping copy
   std::string want = "hellohellohello!";
@@ -232,6 +299,7 @@ int main(int argc, char** argv) {
   test_lsm(dir);
   test_interner();
   test_json();
+  test_avro();
   test_codecs();
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;

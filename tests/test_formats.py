@@ -175,6 +175,93 @@ def test_avro_roundtrip():
     assert m is not None and m.tolist() == [True, False]
 
 
+def test_avro_native_matches_python_decoder():
+    """Differential: the C++ columnar Avro parser must agree with the
+    pure-Python record decoder on randomized flat records (nulls, unicode,
+    zigzag extremes, float32 widening)."""
+    decl = {
+        "type": "record",
+        "name": "R",
+        "fields": [
+            {"name": "ts", "type": {"type": "long", "logicalType": "timestamp-millis"}},
+            {"name": "s", "type": "string"},
+            {"name": "d", "type": ["null", "double"]},
+            {"name": "f", "type": "float"},
+            {"name": "nf", "type": ["null", "float"]},
+            {"name": "i", "type": ["null", "int"]},
+            {"name": "b", "type": "boolean"},
+        ],
+    }
+    schema = parse_avro_schema(decl)
+    rng = np.random.default_rng(0)
+    records = []
+    for i in range(300):
+        records.append(
+            {
+                "ts": int(rng.integers(-(2**62), 2**62)),
+                "s": ["", "héllo", "日本", "x" * int(rng.integers(0, 50))][i % 4],
+                "d": None if i % 5 == 0 else float(rng.normal(0, 1e9)),
+                "f": float(np.float32(rng.normal(0, 10))),
+                # nullable float: null must still push an f64 placeholder so
+                # later rows stay aligned (review-found OOB)
+                "nf": None if i % 3 == 0 else float(np.float32(i)),
+                "i": None if i % 7 == 0 else int(rng.integers(-(2**31), 2**31)),
+                "b": bool(i % 2),
+            }
+        )
+    payloads = [encode_record(schema, r) for r in records]
+
+    native = AvroDecoder(None, schema, use_native=True)
+    assert native._native is not None, "native Avro parser did not engage"
+    python = AvroDecoder(None, schema, use_native=False)
+    for p in payloads:
+        native.push(p)
+        python.push(p)
+    bn, bp = native.flush(), python.flush()
+    assert bn.num_rows == bp.num_rows == 300
+    for f in bn.schema:
+        a, b = bn.column(f.name), bp.column(f.name)
+        if a.dtype == object:
+            assert list(a) == list(b), f.name
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=f.name)
+        ma, mb = bn.mask(f.name), bp.mask(f.name)
+        np.testing.assert_array_equal(
+            ma if ma is not None else np.ones(300, bool),
+            mb if mb is not None else np.ones(300, bool),
+            err_msg=f"mask {f.name}",
+        )
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_avro_rejects_corrupt_records(use_native):
+    """BOTH decode paths reject truncation/trailing garbage identically —
+    data acceptance must not depend on whether g++ was available."""
+    schema = parse_avro_schema(AVRO_DECL)
+    good = encode_record(
+        schema,
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0,
+         "count": 1, "ok": True},
+    )
+    dec = AvroDecoder(None, schema, use_native=use_native)
+    assert (dec._native is not None) == use_native
+    for bad in (good[:-1], good + b"\x00", good[1:]):
+        dec.push(bad)
+        with pytest.raises(FormatError):
+            dec.flush()
+
+
+def test_avro_union_null_must_come_first():
+    with pytest.raises(FormatError, match="null"):
+        parse_avro_schema(
+            {
+                "type": "record",
+                "name": "R",
+                "fields": [{"name": "x", "type": ["long", "null"]}],
+            }
+        )
+
+
 def test_avro_zigzag_extremes():
     from denormalized_tpu.formats.avro_codec import _zigzag_decode, _zigzag_encode
     import io

@@ -1,0 +1,128 @@
+"""2-process ``jax.distributed`` smoke test (VERDICT round-1 item: prove
+``init_distributed`` + ``global_mesh`` are more than documentation).
+
+Spawns two real OS processes that join one JAX job over a local
+coordinator, build the global key-axis mesh spanning both processes'
+devices (4 virtual CPU devices each → 8 global), and run one key-sharded
+window-kernel update through ``shard_map``.  Each process validates the
+accumulator shards it can address against a host oracle."""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_CHILD = r"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+coordinator, pid = sys.argv[1], int(sys.argv[2])
+
+from denormalized_tpu.parallel.distributed import (
+    global_mesh,
+    init_distributed,
+    local_device_count,
+)
+
+init_distributed(
+    coordinator_address=coordinator, num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert local_device_count() == 4, local_device_count()
+assert len(jax.devices()) == 8, jax.devices()
+
+mesh = global_mesh()
+assert mesh.devices.size == 8
+
+from denormalized_tpu.ops import segment_agg as sa
+from denormalized_tpu.parallel.sharded_state import KeyShardedWindowState
+
+spec = sa.WindowKernelSpec(
+    components=tuple(sa.components_for([("count", 0), ("sum", 0)])),
+    num_value_cols=1,
+    window_slots=8,
+    group_capacity=256,  # 32 per device
+    length_ms=1000,
+    slide_ms=1000,
+)
+state = KeyShardedWindowState(spec, mesh)
+
+# deterministic batch, identical on both processes (inputs are replicated)
+rng = np.random.default_rng(0)
+B = 512
+gid = rng.integers(0, 256, B).astype(np.int32)
+vals = rng.normal(10.0, 1.0, (B, 1)).astype(np.float32)
+win_rel = rng.integers(0, 4, B).astype(np.int32)
+state.update(
+    vals,
+    np.ones((B, 1), dtype=bool),
+    win_rel,
+    np.zeros(B, dtype=np.int32),
+    gid,
+    np.ones(B, dtype=bool),
+    np.int32(0),
+)
+
+# oracle over the full (W, G) space
+expect = np.zeros((8, 256), np.int64)
+np.add.at(expect, (win_rel, gid), 1)
+
+# validate every shard THIS process can address
+buf = state._state["count_0"]
+checked = 0
+for shard in buf.addressable_shards:
+    got = np.asarray(shard.data)
+    w_sl, g_sl = shard.index
+    np.testing.assert_array_equal(got, expect[w_sl, g_sl])
+    checked += 1
+assert checked > 0
+print(f"DISTRIBUTED-OK pid={pid} shards={checked}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_distributed_window_step(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{port.getsockname()[1]}"
+    port.close()
+
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = (
+        str(Path(__file__).resolve().parent.parent)
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), addr, str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+        assert f"DISTRIBUTED-OK pid={i}" in out, out[-2000:]
