@@ -20,7 +20,11 @@ from denormalized_tpu.formats._native_parser_base import (
 )
 from denormalized_tpu.native.build import load
 
-# native type codes (see avro_parser.cpp): base Avro type → code
+# native type codes (see avro_parser.cpp): base Avro type → code.
+# 'bytes' is deliberately absent: the native path would decode it as UTF-8
+# text (destroying binary payloads) while the Python fallback returns raw
+# bytes — schemas with bytes fields fall back to the Python decoder so the
+# column content never depends on whether a compiler was available.
 _AVRO_CODE = {
     "int": 0,
     "long": 0,
@@ -28,7 +32,6 @@ _AVRO_CODE = {
     "float": 4,
     "double": 1,
     "string": 3,
-    "bytes": 3,
 }
 _OUT_KIND = {0: "i64", 1: "f64", 4: "f64", 2: "bool", 3: "str"}
 

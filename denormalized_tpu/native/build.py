@@ -26,7 +26,9 @@ def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
         src = _DIR / f"{name}.cpp"
         so = _DIR / f"{name}.so"
         stamp = _DIR / f"{name}.so.srchash"
-        want = hashlib.sha256(src.read_bytes()).hexdigest()
+        want = hashlib.sha256(
+            src.read_bytes() + repr(sorted(extra_flags or [])).encode()
+        ).hexdigest()
         have = stamp.read_text().strip() if stamp.exists() else ""
         if not so.exists() or have != want:
             cmd = [

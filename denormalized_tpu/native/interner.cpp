@@ -10,6 +10,11 @@
 // The table stores (offset into an append-only arena, id).  C ABI for
 // ctypes.
 
+#ifdef INTERN_HAVE_PYTHON
+// must precede the standard headers per CPython's include rules
+#include <Python.h>
+#endif
+
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -74,6 +79,17 @@ extern "C" {
 struct CInterner {
   Interner in;
   std::vector<uint64_t> offsets;  // arena offset per id
+#ifdef INTERN_HAVE_PYTHON
+  // pointer-identity lookaside: PyObject* → id.  Group keys repeat the
+  // SAME string objects heavily (dictionary-style sources, reused pools),
+  // and str is immutable — so a pointer hit skips the UTF-8 fetch, content
+  // hash, and arena memcmp entirely.  Cached objects are INCREF-pinned so
+  // the pointer can never be reused for a different string.
+  std::vector<uint64_t> pkeys;  // ptr, 0 = empty
+  std::vector<uint32_t> pids;   // id + 1
+  uint64_t pmask = 0;
+  uint64_t pcount = 0;
+#endif
 };
 
 void* intern_create() {
@@ -86,6 +102,42 @@ void intern_destroy(void* h) { delete static_cast<CInterner*>(h); }
 
 uint64_t intern_count(void* h) { return static_cast<CInterner*>(h)->in.count; }
 
+namespace {
+
+// intern one key (len already padding-stripped) → dense id
+inline int32_t intern_one(CInterner* c, const uint8_t* key, uint32_t len) {
+  Interner& in = c->in;
+  uint64_t hv = Interner::hash(key, len);
+  uint64_t slot = hv & in.mask;
+  for (;;) {
+    uint32_t e = in.table[slot];
+    if (!e) {
+      // new key
+      if ((in.count + 1) * 4 >= in.table.size() * 3) {
+        in.grow();
+        slot = hv & in.mask;
+        while (in.table[slot]) slot = (slot + 1) & in.mask;
+      }
+      uint64_t off = in.arena.size();
+      in.arena.insert(in.arena.end(), key, key + len);
+      in.arena_w.push_back(len);
+      c->offsets.push_back(off);
+      in.table[slot] = (uint32_t)(in.count + 1);
+      int32_t id = (int32_t)in.count;
+      in.count++;
+      return id;
+    }
+    uint64_t id = e - 1;
+    uint32_t klen = in.arena_w[id];
+    if (klen == len &&
+        memcmp(in.arena.data() + c->offsets[id], key, len) == 0)
+      return (int32_t)id;
+    slot = (slot + 1) & in.mask;
+  }
+}
+
+}  // namespace
+
 // Intern n fixed-width keys (width w, buffer n*w bytes) → out_ids[n].
 // Trailing bytes of shorter strings must be zero-padded (numpy 'S' does
 // this).  Keys of DIFFERENT widths across calls are distinct unless their
@@ -96,43 +148,125 @@ uint64_t intern_count(void* h) { return static_cast<CInterner*>(h)->in.count; }
 void intern_many(void* h, const uint8_t* data, uint64_t n, uint32_t w,
                  int32_t* out_ids) {
   CInterner* c = static_cast<CInterner*>(h);
-  Interner& in = c->in;
   for (uint64_t i = 0; i < n; i++) {
     const uint8_t* key = data + i * w;
     // effective length: strip zero padding so width changes don't split keys
     uint32_t len = w;
     while (len > 0 && key[len - 1] == 0) len--;
-    uint64_t hv = Interner::hash(key, len);
-    uint64_t slot = hv & in.mask;
-    for (;;) {
-      uint32_t e = in.table[slot];
-      if (!e) {
-        // new key
-        if ((in.count + 1) * 4 >= in.table.size() * 3) {
-          in.grow();
-          slot = hv & in.mask;
-          while (in.table[slot]) slot = (slot + 1) & in.mask;
-        }
-        uint64_t off = in.arena.size();
-        in.arena.insert(in.arena.end(), key, key + len);
-        in.arena_w.push_back(len);
-        c->offsets.push_back(off);
-        in.table[slot] = (uint32_t)(in.count + 1);
-        out_ids[i] = (int32_t)in.count;
-        in.count++;
-        break;
-      }
-      uint64_t id = e - 1;
-      uint32_t klen = in.arena_w[id];
-      if (klen == len &&
-          memcmp(in.arena.data() + c->offsets[id], key, len) == 0) {
-        out_ids[i] = (int32_t)id;
-        break;
-      }
-      slot = (slot + 1) & in.mask;
-    }
+    out_ids[i] = intern_one(c, key, len);
   }
 }
+
+#ifdef INTERN_HAVE_PYTHON
+// Direct PyObject path: hash each numpy-object-array slot's string content
+// (CPython-cached UTF-8) with NO fixed-width conversion and NO new Python
+// objects — the hot path for high-cardinality group keys.  Must be called
+// through ctypes.PyDLL (the GIL stays held).  Keys stored as UTF-8, so a
+// column interner must use EITHER this path or intern_many, never both.
+namespace {
+
+constexpr uint64_t kPtrCacheCap = 1u << 20;  // bound pinned objects
+
+inline void pcache_grow(CInterner* c) {
+  size_t ncap = c->pkeys.empty() ? 4096 : c->pkeys.size() * 2;
+  std::vector<uint64_t> nk(ncap, 0);
+  std::vector<uint32_t> ni(ncap, 0);
+  uint64_t nmask = ncap - 1;
+  for (size_t i = 0; i < c->pkeys.size(); i++) {
+    if (!c->pkeys[i]) continue;
+    uint64_t slot = (c->pkeys[i] * 0x9E3779B97F4A7C15ull >> 17) & nmask;
+    while (nk[slot]) slot = (slot + 1) & nmask;
+    nk[slot] = c->pkeys[i];
+    ni[slot] = c->pids[i];
+  }
+  c->pkeys.swap(nk);
+  c->pids.swap(ni);
+  c->pmask = nmask;
+}
+
+}  // namespace
+
+int intern_pyobjects(void* h, PyObject** objs, uint64_t n, int32_t* out_ids) {
+  CInterner* c = static_cast<CInterner*>(h);
+  if (c->pkeys.empty()) pcache_grow(c);
+  for (uint64_t i = 0; i < n; i++) {
+    PyObject* o = objs[i];
+    // pointer lookaside first
+    uint64_t ptr = (uint64_t)(uintptr_t)o;
+    uint64_t slot = (ptr * 0x9E3779B97F4A7C15ull >> 17) & c->pmask;
+    bool hit = false;
+    while (c->pkeys[slot]) {
+      if (c->pkeys[slot] == ptr) {
+        out_ids[i] = (int32_t)(c->pids[slot] - 1);
+        hit = true;
+        break;
+      }
+      slot = (slot + 1) & c->pmask;
+    }
+    if (hit) continue;
+    Py_ssize_t len = 0;
+    const char* s = nullptr;
+    PyObject* tmp = nullptr;
+    if (PyUnicode_Check(o)) {
+      s = PyUnicode_AsUTF8AndSize(o, &len);
+      if (s == nullptr) {
+        // lone surrogates etc.: match the engine-wide errors='replace'
+        // policy instead of aborting the stream
+        PyErr_Clear();
+        tmp = PyUnicode_AsEncodedString(o, "utf-8", "replace");
+        if (tmp) {
+          char* bs = nullptr;
+          if (PyBytes_AsStringAndSize(tmp, &bs, &len) == 0) s = bs;
+        }
+      }
+    } else {
+      // non-string key (None, numbers in an object column): match the
+      // fallback path's str() normalization
+      PyObject* as_str = PyObject_Str(o);
+      if (as_str) {
+        s = PyUnicode_AsUTF8AndSize(as_str, &len);
+        tmp = as_str;
+      }
+    }
+    if (s == nullptr) {
+      Py_XDECREF(tmp);
+      return -1;  // propagate: caller raises the pending Python error
+    }
+    uint32_t l = (uint32_t)len;
+    while (l > 0 && s[l - 1] == 0) l--;  // same padding-strip semantics
+    int32_t id = intern_one(c, (const uint8_t*)s, l);
+    out_ids[i] = id;
+    Py_XDECREF(tmp);
+    // Cache only plain strs that show evidence of POOLING: a per-row str
+    // freshly minted by a decoder is held by nothing but the batch array
+    // (refcount 1 + the borrowed array slot), so pinning it would retain
+    // dead objects forever for zero hits.  Reused/pooled keys (the case
+    // the cache exists for) carry extra references.
+    if (tmp == nullptr && Py_REFCNT(o) >= 2 && c->pcount < kPtrCacheCap) {
+      if ((c->pcount + 1) * 4 >= c->pkeys.size() * 3) pcache_grow(c);
+      uint64_t s2 = (ptr * 0x9E3779B97F4A7C15ull >> 17) & c->pmask;
+      while (c->pkeys[s2]) s2 = (s2 + 1) & c->pmask;
+      c->pkeys[s2] = ptr;
+      c->pids[s2] = (uint32_t)(id + 1);
+      c->pcount++;
+      Py_INCREF(o);
+    }
+  }
+  return 0;
+}
+
+// release the pointer cache's pins — MUST be called through ctypes.PyDLL
+// (needs the GIL) before intern_destroy
+void intern_py_release(void* h) {
+  CInterner* c = static_cast<CInterner*>(h);
+  for (size_t i = 0; i < c->pkeys.size(); i++)
+    if (c->pkeys[i]) Py_DECREF((PyObject*)(uintptr_t)c->pkeys[i]);
+  c->pkeys.clear();
+  c->pids.clear();
+  c->pmask = 0;
+  c->pcount = 0;
+}
+#endif  // INTERN_HAVE_PYTHON
 
 // bulk reverse lookup: copy the arena slice and offsets for ids in
 // [start, end) — one call per batch instead of one per key

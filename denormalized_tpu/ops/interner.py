@@ -18,12 +18,48 @@ import numpy as np
 
 
 def _load_native():
+    lib = _load_native_lib()
+    if lib is None:
+        return None, None
     try:
         import ctypes
 
+        from denormalized_tpu.native.build import _DIR
+
+        if getattr(lib, "_intern_pyobjects", None) is None:
+            # the PyObject fast path keeps the GIL → must go through PyDLL
+            # (same .so, second handle)
+            pylib = ctypes.PyDLL(str(_DIR / "interner.so"))
+            pylib.intern_pyobjects.restype = ctypes.c_int
+            pylib.intern_pyobjects.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_void_p,  # PyObject** (the object array's data)
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            pylib.intern_py_release.argtypes = [ctypes.c_void_p]
+            lib._intern_pyobjects = pylib.intern_pyobjects
+            lib._intern_py_release = pylib.intern_py_release
+        return lib, lib._intern_pyobjects
+    except Exception:
+        return lib, None
+
+
+def _load_native_lib():
+    try:
+        import ctypes
+        import sysconfig
+
         from denormalized_tpu.native.build import load
 
-        lib = load("interner")
+        try:
+            inc = sysconfig.get_paths()["include"]
+            lib = load(
+                "interner", [f"-I{inc}", "-DINTERN_HAVE_PYTHON"]
+            )
+        except Exception:
+            # no Python headers: plain build without the PyObject path
+            lib = load("interner")
         if not getattr(lib, "_in_configured", False):
             lib.intern_create.restype = ctypes.c_void_p
             lib.intern_destroy.argtypes = [ctypes.c_void_p]
@@ -71,17 +107,29 @@ class ColumnInterner:
     def __init__(self) -> None:
         self._to_id: dict = {}
         self._values: list = []
-        self._lib = _load_native()
+        self._lib, self._py_intern = _load_native()
         self._h = self._lib.intern_create() if self._lib else None
+        # which byte encoding the native table stores (decided by the first
+        # string batch's path) — the PyObject path stores UTF-8, the
+        # fixed-width path UTF-32LE; a column never mixes the two
+        self._encoding: str | None = None
+        self._native_active = False
+        self._values_arr: np.ndarray | None = None  # object-array mirror
 
     def __del__(self):
         if getattr(self, "_h", None) and self._lib:
+            rel = getattr(self._lib, "_intern_py_release", None)
+            if rel is not None:
+                rel(self._h)  # drop the pointer cache's INCREF pins
             self._lib.intern_destroy(self._h)
             self._h = None
 
     def __len__(self) -> int:
-        # _values mirrors the native table (synced after every intern_many),
-        # so it is authoritative for every column type
+        if self._native_active:
+            # authoritative count straight from the native table — the
+            # Python value mirror is synced LAZILY (only when emission or a
+            # checkpoint needs the actual strings)
+            return int(self._lib.intern_count(self._h))
         return len(self._values)
 
     def _sync_native_values(self) -> None:
@@ -104,10 +152,18 @@ class ColumnInterner:
         try:
             offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
             raw = ctypes.string_at(bptr, int(offs[-1])) if offs[-1] else b""
-            for i in range(n):
-                piece = raw[offs[i] : offs[i + 1]]
-                piece += b"\x00" * (-len(piece) % 4)
-                values.append(piece.decode("utf-32-le", errors="replace"))
+            if self._encoding == "utf-8":
+                for i in range(n):
+                    values.append(
+                        raw[offs[i] : offs[i + 1]].decode(
+                            "utf-8", errors="replace"
+                        )
+                    )
+            else:
+                for i in range(n):
+                    piece = raw[offs[i] : offs[i + 1]]
+                    piece += b"\x00" * (-len(piece) % 4)
+                    values.append(piece.decode("utf-32-le", errors="replace"))
         finally:
             self._lib.intern_free(bptr)
             self._lib.intern_free(optr)
@@ -123,11 +179,30 @@ class ColumnInterner:
             # numeric key column: unique per batch, dict on uniques only
             uniq, inv = np.unique(arr, return_inverse=True)
             uniq = uniq.tolist()
+        elif self._h is not None and self._py_intern is not None:
+            # PyObject fast path: the C side reads each slot's CPython-cached
+            # UTF-8 bytes directly — no fixed-width conversion, no new
+            # Python objects, no per-batch value sync (lazy, at emission)
+            obj = arr if arr.dtype == object else arr.astype(object)
+            obj = np.ascontiguousarray(obj)
+            n = len(obj)
+            ids = np.empty(n, dtype=np.int32)
+            rc = self._py_intern(
+                self._h,
+                obj.ctypes.data,
+                n,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            if rc != 0:  # pragma: no cover - PyDLL re-raises pending errors
+                raise RuntimeError("native interning failed")
+            self._encoding = self._encoding or "utf-8"
+            self._native_active = True
+            return ids
         elif self._h is not None:
-            # hand the fixed-width UTF-32LE ('U') buffer straight to the
-            # native hash — one vectorized astype, zero per-object encode.
-            # Trailing zero-byte stripping in C++ keeps ids injective for
-            # any key not ending in U+0000 (LE minimal forms are unique).
+            # no Python headers at build time: hand the fixed-width UTF-32LE
+            # ('U') buffer to the native hash — one vectorized astype, zero
+            # per-object encode.  Trailing zero-byte stripping in C++ keeps
+            # ids injective for any key not ending in U+0000.
             u = np.ascontiguousarray(arr.astype(np.str_))
             w = u.dtype.itemsize or 1  # 4 bytes per char slot
             n = len(u)
@@ -139,7 +214,8 @@ class ColumnInterner:
                 w,
                 ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             )
-            self._sync_native_values()
+            self._encoding = self._encoding or "utf-32-le"
+            self._native_active = True
             return ids
         else:
             uniq, inv = np.unique(arr.astype(np.str_), return_inverse=True)
@@ -157,6 +233,16 @@ class ColumnInterner:
         return ids[inv]
 
     def value_of(self, ids: np.ndarray) -> np.ndarray:
+        if self._native_active:
+            self._sync_native_values()
+            # fancy-index the object-array mirror: C-speed gather even for
+            # 100k-group emissions
+            if self._values_arr is None or len(self._values_arr) != len(
+                self._values
+            ):
+                self._values_arr = np.empty(len(self._values), dtype=object)
+                self._values_arr[:] = self._values
+            return self._values_arr[np.asarray(ids)]
         values = self._values
         out = np.empty(len(ids), dtype=object)
         for i, j in enumerate(ids.tolist()):
@@ -165,6 +251,8 @@ class ColumnInterner:
 
     # -- snapshot/restore support ---------------------------------------
     def all_values(self) -> list:
+        if self._native_active:
+            self._sync_native_values()
         return list(self._values)
 
     def load_values(self, vals: list) -> None:

@@ -251,6 +251,35 @@ def test_avro_rejects_corrupt_records(use_native):
             dec.flush()
 
 
+def test_avro_bytes_schema_uses_python_fallback():
+    """'bytes' fields must return raw bytes — the native parser would decode
+    them as UTF-8 text, so such schemas never engage it."""
+    decl = {
+        "type": "record",
+        "name": "B",
+        "fields": [{"name": "p", "type": "bytes"}, {"name": "n", "type": "long"}],
+    }
+    s = parse_avro_schema(decl)
+    dec = AvroDecoder(None, s)
+    assert dec._native is None
+    dec.push(encode_record(s, {"p": b"\x80\x81", "n": 5}))
+    b = dec.flush()
+    assert b.column("p")[0] == b"\x80\x81"
+    assert int(b.column("n")[0]) == 5
+
+
+def test_interner_survives_lone_surrogates():
+    """Group keys containing lone surrogates (producible by JSON \\u escapes)
+    must intern — errors='replace' policy, never a mid-stream crash."""
+    from denormalized_tpu.ops.interner import ColumnInterner
+
+    ci = ColumnInterner()
+    a = np.array(["ok", "\ud800bad", "ok", "\ud800bad"], dtype=object)
+    ids = ci.intern_array(a)
+    assert ids.tolist() == [0, 1, 0, 1]
+    assert "bad" in ci.value_of(np.array([1]))[0]
+
+
 def test_avro_union_null_must_come_first():
     with pytest.raises(FormatError, match="null"):
         parse_avro_schema(
