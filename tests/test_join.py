@@ -122,3 +122,179 @@ def test_left_join_emits_unmatched():
     assert float(res.column("rv")[ia]) == 9.0
     rv_mask = res.mask("rv")
     assert rv_mask is not None and not rv_mask[ib]
+
+
+def _raw_sources(L_rows, R_rows):
+    """Two raw (unwindowed) sources from (ts, key, value) row tuples."""
+    SL = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.STRING, nullable=False),
+            Field("v", DataType.FLOAT64),
+        ]
+    )
+    SR = Schema(
+        [
+            Field("ts2", DataType.INT64, nullable=False),
+            Field("k2", DataType.STRING, nullable=False),
+            Field("w", DataType.FLOAT64),
+        ]
+    )
+
+    def rb(schema, names, rows):
+        cols = list(zip(*rows))
+        return RecordBatch(
+            schema,
+            [
+                np.asarray(cols[0], np.int64),
+                np.asarray(cols[1], object),
+                np.asarray(cols[2], np.float64),
+            ],
+        )
+
+    L = [rb(SL, None, batch) for batch in L_rows]
+    R = [rb(SR, None, batch) for batch in R_rows]
+    ctx = Context()
+    left = ctx.from_source(
+        MemorySource.from_batches(L, timestamp_column="ts"), name="jl"
+    )
+    right = ctx.from_source(
+        MemorySource.from_batches(R, timestamp_column="ts2"), name="jr"
+    )
+    return left, right
+
+
+def test_raw_join_duplicate_key_chains():
+    """Duplicate keys within AND across batches: the chained-array probe
+    must produce the full cross product per key, matching a brute-force
+    oracle."""
+    t0 = 1_700_000_000_000
+    L_rows = [
+        [(t0 + 1, "a", 1.0), (t0 + 2, "a", 2.0), (t0 + 3, "b", 3.0)],
+        [(t0 + 10, "a", 4.0), (t0 + 11, "c", 5.0)],
+    ]
+    R_rows = [
+        [(t0 + 1, "a", 10.0), (t0 + 2, "b", 20.0)],
+        [(t0 + 12, "a", 30.0), (t0 + 13, "a", 40.0), (t0 + 14, "z", 50.0)],
+    ]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "inner", ["k"], ["k2"]).collect()
+    got = sorted(
+        (res.column("k")[i], float(res.column("v")[i]), float(res.column("w")[i]))
+        for i in range(res.num_rows)
+    )
+    lflat = [r for b in L_rows for r in b]
+    rflat = [r for b in R_rows for r in b]
+    want = sorted(
+        (lk, lv, rw)
+        for (_, lk, lv) in lflat
+        for (_, rk, rw) in rflat
+        if lk == rk
+    )
+    assert got == want, (got, want)
+
+
+def test_raw_join_eviction_rebuild_keeps_matching():
+    """After watermark eviction drops old batches, the rebuilt chain arrays
+    must still match retained rows correctly (and never resurrect evicted
+    ones)."""
+    t0 = 1_700_000_000_000
+    gap = 400_000  # > default 300s retention → forces eviction
+    L_rows = [
+        [(t0 + 1, "old", 1.0)],
+        [(t0 + gap, "new", 2.0), (t0 + gap + 1, "new", 3.0)],
+        [(t0 + gap + 1000, "new", 4.0)],
+    ]
+    R_rows = [
+        [(t0 + 2, "none", 0.0)],
+        [(t0 + gap + 5, "new", 10.0)],
+        # 'old' arrives after eviction: must NOT match the evicted left row
+        [(t0 + gap + 1001, "old", 20.0), (t0 + gap + 1002, "new", 30.0)],
+    ]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "inner", ["k"], ["k2"]).collect()
+    got = sorted(
+        (res.column("k")[i], float(res.column("v")[i]), float(res.column("w")[i]))
+        for i in range(res.num_rows)
+    )
+    want = sorted(
+        [("new", 2.0, 10.0), ("new", 3.0, 10.0), ("new", 4.0, 10.0),
+         ("new", 2.0, 30.0), ("new", 3.0, 30.0), ("new", 4.0, 30.0)]
+    )
+    # the evicted left 'old' row must never match the late right 'old' probe
+    assert got == want, (got, want)
+
+
+def test_raw_join_key_dtype_mismatch_rejected():
+    import pytest
+
+    from denormalized_tpu.common.errors import PlanError
+
+    t0 = 1_700_000_000_000
+    left, right = _raw_sources(
+        [[(t0, "a", 1.0)]], [[(t0, "a", 2.0)]]
+    )
+    with pytest.raises(PlanError, match="dtype mismatch"):
+        # string key joined against a numeric column
+        left.join(right, "inner", ["k"], ["ts2"]).collect()
+
+
+def test_raw_join_reinterning_bounds_key_state():
+    """UUID-style keys: every row a new key.  After eviction, the join must
+    re-key so interner state is bounded by retention, not stream lifetime —
+    and results must stay correct across the rebuild."""
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+
+    t0 = 1_700_000_000_000
+    step = 100_000
+    L_rows, R_rows = [], []
+    uid = 0
+    for b in range(40):
+        lb, rb_ = [], []
+        for i in range(50):
+            lb.append((t0 + b * step + i, f"u{uid}", float(uid)))
+            rb_.append((t0 + b * step + i, f"u{uid}", float(uid) * 10))
+            uid += 1
+        L_rows.append(lb)
+        R_rows.append(rb_)
+    left, right = _raw_sources(L_rows, R_rows)
+    ds = left.join(right, "inner", ["k"], ["k2"])
+    ctx = ds._ctx
+    root = executor.build_physical(lp.Sink(ds._plan, CollectSink()), ctx)
+    # find the join exec and force aggressive re-keying
+    from denormalized_tpu.physical.join_exec import StreamingJoinExec
+
+    def find(op):
+        if isinstance(op, StreamingJoinExec):
+            return op
+        for c in op.children:
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    j = find(root)
+    j._reintern_min = 64
+    rows = []
+    from denormalized_tpu.physical.base import EndOfStream
+
+    sink = root.sink if hasattr(root, "sink") else None
+    got = {}
+    for item in root.run():
+        if isinstance(item, EndOfStream):
+            break
+        if isinstance(item, RecordBatch):
+            for i in range(item.num_rows):
+                got[item.column("k")[i]] = (
+                    float(item.column("v")[i]),
+                    float(item.column("w")[i]),
+                )
+    assert len(got) == 2000, len(got)
+    for k, (v, w) in got.items():
+        assert w == v * 10, (k, v, w)
+    # the interner was actually re-keyed down: without re-keying it would
+    # hold all 2000 distinct keys; retention (~300s = 4 batches of 50 keys)
+    # keeps it far smaller
+    assert len(j._interner) < 1000, len(j._interner)
