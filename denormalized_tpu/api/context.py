@@ -32,6 +32,10 @@ class EngineConfig:
     buckets, so the default bucket is 8192 rows and sources should aim for
     ms-scale batches."""
 
+    # logical optimizer (projection pruning / project merge / filter
+    # pushdown — the reference's curated rule list analog,
+    # utils/default_optimizer_rules.rs:29-65)
+    optimizer: bool = True
     # checkpoint flag — mirror of denormalized_config.checkpoint
     checkpoint: bool = False
     checkpoint_interval_s: float = 10.0  # orchestrator cadence (orchestrator.rs:58)

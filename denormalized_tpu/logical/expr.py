@@ -691,6 +691,62 @@ class AggregateExpr(Expr):
         return self.name
 
 
+def substitute_columns(e: Expr, mapping: dict[str, Expr]) -> Expr:
+    """Rewrite ``e`` with every Column reference replaced by its mapped
+    expression (used by the optimizer to merge stacked projections and push
+    filters beneath them).  Nodes are immutable, so untouched subtrees are
+    reused as-is."""
+    if isinstance(e, Column):
+        return mapping.get(e.name, e)
+    if isinstance(e, Literal):
+        return e
+    if isinstance(e, BinaryExpr):
+        return BinaryExpr(
+            e.op,
+            substitute_columns(e.left, mapping),
+            substitute_columns(e.right, mapping),
+        )
+    if isinstance(e, NotExpr):
+        return NotExpr(substitute_columns(e.inner, mapping))
+    if isinstance(e, IsNullExpr):
+        return IsNullExpr(substitute_columns(e.inner, mapping), e.negate)
+    if isinstance(e, AliasExpr):
+        return AliasExpr(substitute_columns(e.inner, mapping), e._name)
+    if isinstance(e, FieldAccessExpr):
+        return FieldAccessExpr(
+            substitute_columns(e.inner, mapping), e.field_name
+        )
+    if isinstance(e, CastExpr):
+        return CastExpr(substitute_columns(e.inner, mapping), e.dtype)
+    if isinstance(e, ScalarFunctionExpr):
+        return ScalarFunctionExpr(
+            e.fname,
+            tuple(substitute_columns(a, mapping) for a in e.args),
+        )
+    if isinstance(e, ScalarUDFExpr):
+        return ScalarUDFExpr(
+            e.fn,
+            tuple(substitute_columns(a, mapping) for a in e.args),
+            e._name,
+            e.dtype,
+        )
+    if isinstance(e, CaseExpr):
+        return CaseExpr(
+            substitute_columns(e.base, mapping) if e.base is not None else None,
+            tuple(
+                (
+                    substitute_columns(c, mapping),
+                    substitute_columns(r, mapping),
+                )
+                for c, r in e.branches
+            ),
+            substitute_columns(e.otherwise, mapping)
+            if e.otherwise is not None
+            else None,
+        )
+    raise PlanError(f"cannot substitute through {type(e).__name__}")
+
+
 def column_validity(e: Expr, batch: RecordBatch) -> np.ndarray | None:
     """Row validity of an expression's output: the AND of the null masks of
     every column it reads (derived columns — e.g. variance's shifted

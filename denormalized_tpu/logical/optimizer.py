@@ -1,0 +1,307 @@
+"""Logical plan optimizer.
+
+The reference runs a CURATED subset of DataFusion's rewrite rules — it
+deliberately omits the ones that break unbounded plans
+(crates/core/src/utils/default_optimizer_rules.rs:29-65).  Our plan algebra
+is purpose-built, so the rule set is small and streaming-safe by
+construction:
+
+- :class:`ProjectionPruning` — insert a narrow Project above each Scan so
+  unused source columns are dropped before every downstream operator
+  (interning, window state, joins).  (Decode itself still materializes the
+  source's columns — pushing the column set into the readers is a further
+  step this rule does not take.)
+- :class:`MergeProjects` — collapse stacked projections (each
+  ``with_column`` call adds one) into a single evaluation pass.  A merge is
+  only taken when it cannot DUPLICATE work: an inner expression that is
+  non-trivial is inlined only if the outer projection references it at most
+  once, and user UDFs are never inlined (they may be expensive or
+  non-deterministic).
+- :class:`FilterPushdown` — evaluate filters before the projections above
+  them, and fuse adjacent filters into one conjunction.  A predicate is
+  only pushed when the rewrite is semantics-preserving: no IsNull nodes
+  (null-mask checks on projected columns would silently become value/NaN
+  checks) and no UDFs in the substituted form (duplicate / re-evaluated
+  calls).
+
+Rules run to a fixpoint (bounded); ``EngineConfig(optimizer=False)``
+disables the pass wholesale.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.logical.expr import (
+    AliasExpr,
+    BinaryExpr,
+    CaseExpr,
+    CastExpr,
+    Column,
+    Expr,
+    FieldAccessExpr,
+    IsNullExpr,
+    NotExpr,
+    ScalarFunctionExpr,
+    ScalarUDFExpr,
+    substitute_columns,
+)
+
+
+def map_children(
+    node: lp.LogicalPlan, fn: Callable[[lp.LogicalPlan], lp.LogicalPlan]
+) -> lp.LogicalPlan:
+    """Rebuild ``node`` with ``fn`` applied to each child — the ONE place
+    that knows how to reconstruct every plan node (all rules traverse
+    through it, so a new node type only needs adding here)."""
+    if isinstance(node, lp.Sink):
+        return lp.Sink(fn(node.input), node.sink)
+    if isinstance(node, lp.Project):
+        return lp.Project(fn(node.input), node.exprs)
+    if isinstance(node, lp.Filter):
+        return lp.Filter(fn(node.input), node.predicate)
+    if isinstance(node, lp.StreamingWindow):
+        return lp.StreamingWindow(
+            fn(node.input),
+            node.group_exprs,
+            node.aggr_exprs,
+            node.window_type,
+            node.length_ms,
+            node.slide_ms,
+        )
+    if isinstance(node, lp.Join):
+        return lp.Join(
+            fn(node.left),
+            fn(node.right),
+            node.kind,
+            node.left_keys,
+            node.right_keys,
+            node.filter,
+        )
+    return node
+
+
+def _expr_nodes(e: Expr):
+    """Yield every node of an expression tree."""
+    yield e
+    if isinstance(e, BinaryExpr):
+        yield from _expr_nodes(e.left)
+        yield from _expr_nodes(e.right)
+    elif isinstance(e, (NotExpr, IsNullExpr, AliasExpr, CastExpr)):
+        yield from _expr_nodes(e.inner)
+    elif isinstance(e, FieldAccessExpr):
+        yield from _expr_nodes(e.inner)
+    elif isinstance(e, (ScalarFunctionExpr, ScalarUDFExpr)):
+        for a in e.args:
+            yield from _expr_nodes(a)
+    elif isinstance(e, CaseExpr):
+        if e.base is not None:
+            yield from _expr_nodes(e.base)
+        for c, r in e.branches:
+            yield from _expr_nodes(c)
+            yield from _expr_nodes(r)
+        if e.otherwise is not None:
+            yield from _expr_nodes(e.otherwise)
+
+
+def _contains(e: Expr, cls) -> bool:
+    return any(isinstance(n, cls) for n in _expr_nodes(e))
+
+
+def _is_trivial(e: Expr) -> bool:
+    """Inlining this duplicates no meaningful work."""
+    while isinstance(e, AliasExpr):
+        e = e.inner
+    from denormalized_tpu.logical.expr import Literal
+
+    return isinstance(e, (Column, Literal))
+
+
+class ProjectionPruning:
+    """Insert a narrow Project directly above each Scan covering only the
+    columns the plan actually reads."""
+
+    def rewrite(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        return self._walk(plan, None)
+
+    def _walk(
+        self, node: lp.LogicalPlan, required: set[str] | None
+    ) -> lp.LogicalPlan:
+        # required=None means "every column" (top of plan / sinks)
+        if isinstance(node, lp.Sink):
+            return lp.Sink(self._walk(node.input, None), node.sink)
+        if isinstance(node, lp.Project):
+            need: set[str] = set()
+            for e in node.exprs:
+                need |= e.columns_referenced()
+            return lp.Project(self._walk(node.input, need), node.exprs)
+        if isinstance(node, lp.Filter):
+            need = set(node.predicate.columns_referenced())
+            if required is None:
+                return lp.Filter(self._walk(node.input, None), node.predicate)
+            return lp.Filter(
+                self._walk(node.input, need | required), node.predicate
+            )
+        if isinstance(node, lp.StreamingWindow):
+            need = set()
+            for g in node.group_exprs:
+                need |= g.columns_referenced()
+            for a in node.aggr_exprs:
+                if a.kind == "udaf" and a.udaf is not None:
+                    for arg in a.udaf.args:
+                        need |= arg.columns_referenced()
+                elif a.arg is not None:
+                    need |= a.arg.columns_referenced()
+            return lp.StreamingWindow(
+                self._walk(node.input, need),
+                node.group_exprs,
+                node.aggr_exprs,
+                node.window_type,
+                node.length_ms,
+                node.slide_ms,
+            )
+        if isinstance(node, lp.Join):
+            lnames = set(node.left.schema.names)
+            rnames = set(node.right.schema.names)
+            if required is None:
+                lneed = rneed = None
+            else:
+                base = set(required)
+                base |= set(node.left_keys) | set(node.right_keys)
+                lneed = {n for n in base if n in lnames}
+                rneed = {n for n in base if n in rnames}
+                if node.filter is not None:
+                    for n in node.filter.columns_referenced():
+                        (lneed if n in lnames else rneed).add(n)
+            return lp.Join(
+                self._walk(node.left, lneed),
+                self._walk(node.right, rneed),
+                node.kind,
+                node.left_keys,
+                node.right_keys,
+                node.filter,
+            )
+        if isinstance(node, lp.Scan):
+            if required is None:
+                return node
+            keep = [
+                f.name
+                for f in node.schema
+                if f.name in required or f.name == CANONICAL_TIMESTAMP_COLUMN
+            ]
+            if len(keep) == len(node.schema):
+                return node  # nothing to prune
+            return lp.Project(node, [Column(n) for n in keep])
+        return map_children(node, lambda c: self._walk(c, None))
+
+
+class MergeProjects:
+    """Project(Project(x)) → Project(x), gated so no work is duplicated."""
+
+    # a merged projection may be at most this factor larger (in expression
+    # nodes) than the two it replaces — cheap recomputation is a win over an
+    # extra column-materialization pass, exponential reference chains are not
+    _GROWTH_BOUND = 2.0
+
+    def rewrite(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        node = map_children(plan, self.rewrite)
+        if isinstance(node, lp.Project) and isinstance(node.input, lp.Project):
+            inner = node.input
+            mapping = self._mapping(inner)
+            if self._udf_inlined(node, mapping):
+                return node  # UDFs may be expensive or non-deterministic
+            merged = [
+                self._realias(substitute_columns(e, mapping), e)
+                for e in node.exprs
+            ]
+            before = self._size(node.exprs) + self._size(inner.exprs)
+            if self._size(merged) > self._GROWTH_BOUND * before:
+                return node
+            return self.rewrite(lp.Project(inner.input, merged))
+        return node
+
+    @staticmethod
+    def _mapping(p: lp.Project) -> dict[str, Expr]:
+        return {f.name: e for f, e in zip(p.schema, p.exprs)}
+
+    @staticmethod
+    def _size(exprs) -> int:
+        return sum(sum(1 for _ in _expr_nodes(e)) for e in exprs)
+
+    @staticmethod
+    def _udf_inlined(outer: lp.Project, mapping: dict[str, Expr]) -> bool:
+        for e in outer.exprs:
+            for n in _expr_nodes(e):
+                if isinstance(n, Column):
+                    inner_e = mapping.get(n.name)
+                    if inner_e is not None and not _is_trivial(inner_e) and (
+                        _contains(inner_e, ScalarUDFExpr)
+                    ):
+                        return True
+        return False
+
+    @staticmethod
+    def _realias(sub: Expr, original: Expr) -> Expr:
+        # keep the outer projection's output names stable
+        want = original.name
+        return sub if sub.name == want else AliasExpr(sub, want)
+
+
+class FilterPushdown:
+    """Filter(Project(x)) → Project(Filter'(x)); Filter(Filter(x)) → one
+    conjunctive Filter.  Pushes only semantics-preserving rewrites."""
+
+    def rewrite(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
+        node = map_children(plan, self.rewrite)
+        if isinstance(node, lp.Filter):
+            child = node.input
+            if isinstance(child, lp.Filter):
+                return self.rewrite(
+                    lp.Filter(
+                        child.input,
+                        BinaryExpr("and", child.predicate, node.predicate),
+                    )
+                )
+            if isinstance(child, lp.Project):
+                mapping = MergeProjects._mapping(child)
+                refs = node.predicate.columns_referenced()
+                if not all(
+                    n in mapping or child.input.schema.has(n) for n in refs
+                ):
+                    return node
+                # IsNull on a projected column checks the VALIDITY MASK
+                # when the expression stays a bare Column; substituting a
+                # computed expression would silently turn it into a
+                # value/NaN check — don't push those
+                if _contains(node.predicate, IsNullExpr):
+                    return node
+                pred = substitute_columns(node.predicate, mapping)
+                # never duplicate UDF evaluation into the filter
+                if _contains(pred, ScalarUDFExpr):
+                    return node
+                return self.rewrite(
+                    lp.Project(lp.Filter(child.input, pred), child.exprs)
+                )
+        return node
+
+
+DEFAULT_RULES = (MergeProjects(), FilterPushdown(), ProjectionPruning())
+_MAX_PASSES = 5
+
+
+def optimize(plan: lp.LogicalPlan, enabled: bool = True) -> lp.LogicalPlan:
+    """Run the curated rule list to a (bounded) fixpoint — FilterPushdown
+    can re-stack projections that MergeProjects then collapses."""
+    if not enabled:
+        return plan
+    prev = None
+    for _ in range(_MAX_PASSES):
+        for rule in DEFAULT_RULES:
+            plan = rule.rewrite(plan)
+        shape = plan.display()
+        if shape == prev:
+            break
+        prev = shape
+    return plan

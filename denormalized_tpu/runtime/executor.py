@@ -69,6 +69,9 @@ def _attach_checkpointing(root: ExecOperator, ctx):
 
 
 def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
+    from denormalized_tpu.logical.optimizer import optimize
+
+    plan = optimize(plan, getattr(ctx.config, "optimizer", True))
     return Planner(ctx.config).create_physical_plan(plan)
 
 
