@@ -6,11 +6,13 @@ deliberately omits the ones that break unbounded plans
 is purpose-built, so the rule set is small and streaming-safe by
 construction:
 
-- :class:`ProjectionPruning` — insert a narrow Project above each Scan so
-  unused source columns are dropped before every downstream operator
-  (interning, window state, joins).  (Decode itself still materializes the
-  source's columns — pushing the column set into the readers is a further
-  step this rule does not take.)
+- :class:`ProjectionPruning` — compute the transitively-required column set
+  top-down, NARROW every intermediate Project to the outputs actually read
+  above it, and insert a narrow Project above each Scan so unused source
+  columns are dropped before every downstream operator (interning, window
+  state, joins).  (Decode itself still materializes the source's columns —
+  pushing the column set into the readers is a further step this rule does
+  not take.)
 - :class:`MergeProjects` — collapse stacked projections (each
   ``with_column`` call adds one) into a single evaluation pass.  A merge is
   only taken when it cannot DUPLICATE work: an inner expression that is
@@ -120,8 +122,9 @@ def _is_trivial(e: Expr) -> bool:
 
 
 class ProjectionPruning:
-    """Insert a narrow Project directly above each Scan covering only the
-    columns the plan actually reads."""
+    """Narrow every projection to the columns the plan actually reads:
+    intermediate Projects lose outputs nobody above consumes, and each Scan
+    gets a narrow Project directly above it."""
 
     def rewrite(self, plan: lp.LogicalPlan) -> lp.LogicalPlan:
         return self._walk(plan, None)
@@ -133,10 +136,23 @@ class ProjectionPruning:
         if isinstance(node, lp.Sink):
             return lp.Sink(self._walk(node.input, None), node.sink)
         if isinstance(node, lp.Project):
+            exprs = node.exprs
+            if required is not None:
+                # narrow the projection itself: outputs nobody above reads
+                # are dropped (with_column chains otherwise carry every
+                # passthrough column to the top)
+                kept = [
+                    e
+                    for e in exprs
+                    if e.name in required
+                    or e.name == CANONICAL_TIMESTAMP_COLUMN
+                ]
+                if kept:
+                    exprs = kept
             need: set[str] = set()
-            for e in node.exprs:
+            for e in exprs:
                 need |= e.columns_referenced()
-            return lp.Project(self._walk(node.input, need), node.exprs)
+            return lp.Project(self._walk(node.input, need), exprs)
         if isinstance(node, lp.Filter):
             need = set(node.predicate.columns_referenced())
             if required is None:

@@ -118,6 +118,41 @@ def test_filter_pushdown_below_projection():
     assert len(_find(opt2, lp.Filter)) == 1, opt2.display()
 
 
+def test_projection_narrowing_through_with_column_chain():
+    """Columns nobody above reads are dropped from intermediate
+    projections, not carried to the top of the plan."""
+    ctx = Context()
+    ds = (
+        _ds(ctx)
+        .with_column("x", col("a") * 2.0)
+        .window(["k"], [F.avg(col("x")).alias("m")], 1000)
+    )
+    opt = optimize(ds._plan)
+    win = _find(opt, lp.StreamingWindow)[0]
+    names = set(win.input.schema.names)
+    assert "unused1" not in names and "unused2" not in names, opt.display()
+    assert "b" not in names and "c" not in names, opt.display()
+    # results unchanged
+    res_on = ds.collect()
+    ctx_off = Context(EngineConfig(optimizer=False))
+    ds_off = (
+        _ds(ctx_off)
+        .with_column("x", col("a") * 2.0)
+        .window(["k"], [F.avg(col("x")).alias("m")], 1000)
+    )
+    res_off = ds_off.collect()
+
+    def key(r):
+        return {
+            (r.column("k")[i], int(r.column("window_start_time")[i])): round(
+                float(r.column("m")[i]), 6
+            )
+            for i in range(r.num_rows)
+        }
+
+    assert key(res_on) == key(res_off) and res_on.num_rows > 0
+
+
 def test_is_null_filter_not_pushed_through_projection():
     """IsNull on a projected column checks the validity MASK; pushing the
     substituted predicate would turn it into a value/NaN check (review
