@@ -10,9 +10,9 @@ construction:
   top-down, NARROW every intermediate Project to the outputs actually read
   above it, and insert a narrow Project above each Scan so unused source
   columns are dropped before every downstream operator (interning, window
-  state, joins).  (Decode itself still materializes the source's columns —
-  pushing the column set into the readers is a further step this rule does
-  not take.)
+  state, joins).  Sources that support it (JSON readers) take the pushdown
+  all the way into DECODE via ``Source.with_projection`` — the parser never
+  materializes pruned columns; others get the Project fallback.
 - :class:`MergeProjects` — collapse stacked projections (each
   ``with_column`` call adds one) into a single evaluation pass.  A merge is
   only taken when it cannot DUPLICATE work: an inner expression that is
@@ -209,6 +209,25 @@ class ProjectionPruning:
             ]
             if len(keep) == len(node.schema):
                 return node  # nothing to prune
+            # best case: the reader itself declines to DECODE the pruned
+            # columns (JSON sources); otherwise project them away above it.
+            # A pushed source may still carry extra columns (its timestamp
+            # column) — narrow those with a Project HERE rather than relying
+            # on a later fixpoint pass.
+            pushed = node.source.with_projection(set(keep))
+            if pushed is not None:
+                scan = lp.Scan(node.table_name, pushed, pushed.schema)
+                extra = set(pushed.schema.names) - set(keep)
+                if extra - {CANONICAL_TIMESTAMP_COLUMN}:
+                    return lp.Project(
+                        scan,
+                        [
+                            Column(n)
+                            for n in pushed.schema.names
+                            if n in keep
+                        ],
+                    )
+                return scan
             return lp.Project(node, [Column(n) for n in keep])
         return map_children(node, lambda c: self._walk(c, None))
 

@@ -80,3 +80,11 @@ class Source:
     @property
     def unbounded(self) -> bool:
         return True
+
+    def with_projection(self, names: set[str]) -> "Source | None":
+        """Reader-level projection pushdown: return a copy of this source
+        that only DECODES the named columns, or None when unsupported (the
+        optimizer then falls back to a Project above the Scan).  The
+        canonical timestamp machinery must keep working — implementations
+        retain their timestamp column regardless of ``names``."""
+        return None

@@ -496,6 +496,28 @@ class KafkaSource(Source):
     def unbounded(self) -> bool:
         return True
 
+    def with_projection(self, names: set[str]):
+        """JSON decode is key-matched, so a narrowed schema skips unneeded
+        fields inside the native parser — decode work drops with the column
+        count.  Avro decode is POSITIONAL (every field must be walked), so
+        pushdown is declined there."""
+        import copy
+
+        if self.builder.encoding is not StreamEncoding.JSON:
+            return None
+        keep = set(names)
+        if self.builder.timestamp_column:
+            keep.add(self.builder.timestamp_column)
+        fields = [f for f in self.user_schema if f.name in keep]
+        if len(fields) == len(self.user_schema) or not fields:
+            return None  # nothing to prune (or nothing left: fall back)
+        src = copy.copy(self)
+        src.builder = copy.copy(self.builder)
+        src.builder.user_schema = Schema(fields)
+        src.user_schema = src.builder.user_schema
+        src._schema = canonicalize_schema(src.user_schema)
+        return src
+
 
 class KafkaSinkWriter(Sink):
     """JSON row producer (KafkaSink::write_all, topic_writer.rs:102-127),

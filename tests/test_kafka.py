@@ -442,6 +442,87 @@ def test_corrupt_compressed_batch_errors(broker):
     c.close()
 
 
+def test_projection_pushdown_into_json_reader(broker):
+    """Reader-level pushdown: a wide JSON topic feeding a 2-column window
+    only DECODES the needed columns (the decoder's schema narrows), and
+    results are unchanged."""
+    broker.create_topic("wide", partitions=1)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        # progressive: separate fetches so the watermark (monotonic max of
+        # batch MIN timestamps) actually advances and closes windows
+        for chunk in range(4):
+            msgs = [
+                json.dumps(
+                    {
+                        "occurred_at_ms": t0 + i * 20,
+                        "sensor_name": f"s{i % 3}",
+                        "reading": float(i),
+                        **{f"extra{j}": j * 1.5 for j in range(10)},
+                    }
+                ).encode()
+                for i in range(chunk * 50, (chunk + 1) * 50)
+            ]
+            broker.produce("wide", 0, msgs, ts_ms=t0)
+            time.sleep(0.25)
+        broker.produce(
+            "wide", 0,
+            [json.dumps({"occurred_at_ms": t0 + 10_000, "sensor_name": "s0",
+                         "reading": 0.0,
+                         **{f"extra{j}": 0.0 for j in range(10)}}).encode()],
+            ts_ms=t0,
+        )
+
+    threading.Thread(target=feed, daemon=True).start()
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0,
+         **{f"extra{j}": 1.0 for j in range(10)}}
+    )
+    ctx = Context()
+    ds = ctx.from_topic(
+        "wide",
+        sample_json=sample,
+        bootstrap_servers=broker.bootstrap,
+        timestamp_column="occurred_at_ms",
+    ).window(
+        ["sensor_name"], [F.sum(col("reading")).alias("s")], 1000
+    )
+
+    # the OPTIMIZED plan's scan decodes only 3 columns (+ canonical ts)
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.logical.optimizer import optimize
+
+    opt = optimize(lp.Sink(ds._plan, None))
+
+    def find_scan(n):
+        if isinstance(n, lp.Scan):
+            return n
+        for c in n.children:
+            r = find_scan(c)
+            if r is not None:
+                return r
+        return None
+
+    scan = find_scan(opt)
+    names = set(scan.source.schema.names)
+    assert "extra0" not in names and "extra9" not in names, names
+    assert {"sensor_name", "reading", "occurred_at_ms"} <= names
+
+    total = 0.0
+    expected = sum(float(i) for i in range(200))
+    it = ds.stream()
+    deadline = time.time() + 20
+    for b in it:
+        for i in range(b.num_rows):
+            total += float(b.column("s")[i])
+        # rows 0..199 land in closed windows once the t0+10s row arrives
+        if abs(total - expected) < 1e-6 or time.time() > deadline:
+            it.close()
+            break
+    assert abs(total - expected) < 1e-6, total
+
+
 def test_avro_from_topic_pipeline(broker):
     """Broker-backed Avro source: from_topic(encoding='avro') decodes
     through the native C++ parser straight off the fetch arena and feeds
