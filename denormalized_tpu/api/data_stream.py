@@ -183,10 +183,33 @@ class DataStream:
         print(self._plan.display())
         return self
 
-    def print_physical_plan(self) -> "DataStream":
+    def optimized_plan(self) -> lp.LogicalPlan:
+        """The logical plan after the optimizer pass (what will execute)."""
+        from denormalized_tpu.logical.optimizer import optimize
+
+        return optimize(
+            self._plan, getattr(self._ctx.config, "optimizer", True)
+        )
+
+    def _physical_display(self, plan: lp.LogicalPlan) -> str:
         from denormalized_tpu.planner.planner import Planner
 
-        print(Planner(self._ctx.config).create_physical_plan(self._plan).display())
+        return Planner(self._ctx.config).create_physical_plan(plan).display()
+
+    def print_physical_plan(self) -> "DataStream":
+        print(self._physical_display(self.optimized_plan()))
+        return self
+
+    def explain(self) -> "DataStream":
+        """Print logical plan, optimized plan, and physical plan — the
+        datafusion ``explain`` analog."""
+        opt = self.optimized_plan()
+        print("== logical plan ==")
+        print(self._plan.display())
+        print("== optimized plan ==")
+        print(opt.display())
+        print("== physical plan ==")
+        print(self._physical_display(opt))
         return self
 
     # -- execution -------------------------------------------------------

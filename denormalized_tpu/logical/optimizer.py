@@ -322,7 +322,11 @@ class FilterPushdown:
         return node
 
 
-DEFAULT_RULES = (MergeProjects(), FilterPushdown(), ProjectionPruning())
+# order matters: MergeProjects runs LAST so each pass ENDS with stacked
+# projections collapsed — ProjectionPruning re-wraps scans every pass (its
+# walk is stateless), and ending a pass on the wrap would let the fixpoint
+# terminate on a shape with redundant Project(Project(Scan)) stacks
+DEFAULT_RULES = (ProjectionPruning(), FilterPushdown(), MergeProjects())
 _MAX_PASSES = 5
 
 

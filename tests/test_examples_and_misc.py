@@ -52,6 +52,13 @@ def test_simple_aggregation_example_smoke():
     assert {"sensor_name", "count", "min", "max", "average"} <= set(lines[0])
 
 
+def test_functions_tour_example():
+    out = _run_example("functions_tour.py", 60)
+    assert "window rows emitted" in out, out[-800:]
+    assert "== optimized plan ==" in out
+    assert "sd=" in out and "med=" in out and "distinct=" in out
+
+
 def test_csv_source_inference(tmp_path):
     p = tmp_path / "x.csv"
     p.write_text("ts,name,v,ok\n1,a,1.5,true\n2,b,,false\n")
