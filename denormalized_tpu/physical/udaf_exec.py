@@ -142,8 +142,17 @@ class UdafWindowExec(ExecOperator):
         ]
         self.schema = Schema(fields)
 
-        # frames: window index j -> { group key tuple -> [acc per agg] }
-        self._frames: dict[int, dict[tuple, list]] = {}
+        # frames: window index j -> { dense group id -> [acc per agg] }.
+        # Keys intern through a GroupInterner (the same machinery the device
+        # window uses) so per-batch grouping is one lexsort over int arrays
+        # instead of per-row Python tuple comparisons; checkpoints store the
+        # actual key VALUES (stable across restarts), re-interned on restore.
+        from denormalized_tpu.ops.interner import GroupInterner
+
+        self._interner = (
+            GroupInterner(len(self.group_exprs)) if self.group_exprs else None
+        )
+        self._frames: dict[int, dict[int, list]] = {}
         self._ckpt: tuple | None = None
         self._first_open: int | None = None
         self._max_win_seen = -1
@@ -181,11 +190,16 @@ class UdafWindowExec(ExecOperator):
             self._first_open = int(units.min()) - self._k + 1
         self._max_win_seen = max(self._max_win_seen, int(units.max()))
 
-        key_cols = (
-            [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
-            if self.group_exprs
-            else None
-        )
+        if self._interner is not None:
+            # raw dtypes (same calling convention as the device window):
+            # numeric/bool keys take the interner's exact-value path —
+            # forcing object would str()-normalize them (False → 'True'
+            # on emission re-cast)
+            gids = self._interner.intern(
+                [np.asarray(g.eval(batch)) for g in self.group_exprs]
+            ).astype(np.int64)
+        else:
+            gids = np.zeros(n, dtype=np.int64)
         from denormalized_tpu.logical.expr import column_validity
 
         def mask_of(e) -> np.ndarray | None:
@@ -204,7 +218,8 @@ class UdafWindowExec(ExecOperator):
                 arg_cols.append([np.zeros(n)])
                 arg_masks.append(None)
 
-        # group rows by (window fan-out, key) using argsort for vectorization
+        # group rows by (window fan-out, dense gid): one lexsort per
+        # fan-out step, runs found by boundary diff — no per-row Python
         for i in range(self._k):
             win = units - i
             in_window = (win >= self._first_open) & (
@@ -217,38 +232,37 @@ class UdafWindowExec(ExecOperator):
             if len(idx) == 0:
                 continue
             wsel = win[idx]
-            if key_cols is not None:
-                keys = list(zip(*[kc[idx].tolist() for kc in key_cols]))
-            else:
-                keys = [()] * len(idx)
-            order = sorted(range(len(idx)), key=lambda r: (int(wsel[r]), keys[r]))
-            run_start = 0
-            for r in range(1, len(order) + 1):
-                if (
-                    r == len(order)
-                    or wsel[order[r]] != wsel[order[run_start]]
-                    or keys[order[r]] != keys[order[run_start]]
+            gsel = gids[idx]
+            order = np.lexsort((gsel, wsel))
+            ws = wsel[order]
+            gs = gsel[order]
+            m = len(order)
+            bounds = np.nonzero(
+                np.concatenate(
+                    ([True], (ws[1:] != ws[:-1]) | (gs[1:] != gs[:-1]))
+                )
+            )[0]
+            ends = np.append(bounds[1:], m)
+            for b0, b1 in zip(bounds, ends):
+                rows = idx[order[b0:b1]]
+                j = int(ws[b0])
+                gid = int(gs[b0])
+                frame = self._frames.setdefault(j, {})
+                accs = frame.get(gid)
+                if accs is None:
+                    accs = self._make_accs()
+                    frame[gid] = accs
+                for a, acc, cols, am in zip(
+                    self.aggr_exprs, accs, arg_cols, arg_masks
                 ):
-                    rows = idx[[order[x] for x in range(run_start, r)]]
-                    j = int(wsel[order[run_start]])
-                    key = keys[order[run_start]]
-                    frame = self._frames.setdefault(j, {})
-                    accs = frame.get(key)
-                    if accs is None:
-                        accs = self._make_accs()
-                        frame[key] = accs
-                    for a, acc, cols, am in zip(
-                        self.aggr_exprs, accs, arg_cols, arg_masks
-                    ):
-                        chunk = [c[rows] for c in cols]
-                        if am is not None:
-                            valid = am[rows]
-                            chunk = [c[valid] for c in chunk]
-                        if a.kind == "udaf":
-                            acc.update(*chunk)
-                        else:
-                            acc.update(chunk[0])
-                    run_start = r
+                    chunk = [c[rows] for c in cols]
+                    if am is not None:
+                        valid = am[rows]
+                        chunk = [c[valid] for c in chunk]
+                    if a.kind == "udaf":
+                        acc.update(*chunk)
+                    else:
+                        acc.update(chunk[0])
 
         bmin = int(ts.min())
         if self._watermark is None or bmin > self._watermark:
@@ -263,6 +277,48 @@ class UdafWindowExec(ExecOperator):
             self._first_open += 1
             if b is not None:
                 yield b
+        self._maybe_reintern()
+
+    # re-keying threshold (tests lower it to force the path)
+    _reintern_min = 262_144
+
+    def _maybe_reintern(self) -> None:
+        """Frames free their accumulators when windows emit, but the
+        interner only ever grows — re-key from the LIVE groups when
+        distinct-keys-ever-seen dwarfs them, so host memory follows open
+        windows, not stream lifetime (same policy as the join)."""
+        if self._interner is None:
+            return
+        live: set[int] = set()
+        for frame in self._frames.values():
+            live.update(frame.keys())
+        if len(self._interner) <= max(self._reintern_min, 4 * max(len(live), 1)):
+            return
+        from denormalized_tpu.ops.interner import GroupInterner
+
+        old = self._interner
+        new = GroupInterner(len(self.group_exprs))
+        gids_sorted = sorted(live)
+        if gids_sorted:
+            key_arrays = old.keys_of(np.asarray(gids_sorted, dtype=np.int64))
+            in_schema = self.input_op.schema
+            cols = []
+            for g, arr in zip(self.group_exprs, key_arrays):
+                f = g.out_field(in_schema)
+                # keys_of yields object arrays; restore the column's real
+                # dtype so numeric keys re-enter the exact-value path
+                cols.append(
+                    np.asarray(arr.tolist(), dtype=f.dtype.to_numpy())
+                    if f.dtype.is_numeric
+                    else arr
+                )
+            new_gids = new.intern(cols)
+            remap = dict(zip(gids_sorted, (int(x) for x in new_gids)))
+            self._frames = {
+                j: {remap[g]: accs for g, accs in fr.items()}
+                for j, fr in self._frames.items()
+            }
+        self._interner = new
 
     def _emit(self, j: int) -> RecordBatch | None:
         frame = self._frames.pop(j, None)
@@ -273,12 +329,15 @@ class UdafWindowExec(ExecOperator):
         items = list(frame.items())
         cols: list[np.ndarray] = []
         in_schema = self.input_op.schema
-        for ci, g in enumerate(self.group_exprs):
-            f = g.out_field(in_schema)
-            vals = np.array([k[ci] for k, _ in items], dtype=object)
-            if f.dtype.is_numeric:
-                vals = vals.astype(f.dtype.to_numpy())
-            cols.append(vals)
+        if self.group_exprs:
+            key_arrays = self._interner.keys_of(
+                np.asarray([g for g, _ in items], dtype=np.int64)
+            )
+            for g, vals in zip(self.group_exprs, key_arrays):
+                f = g.out_field(in_schema)
+                if f.dtype.is_numeric:
+                    vals = np.asarray(vals.tolist(), dtype=f.dtype.to_numpy())
+                cols.append(vals)
         for ai, a in enumerate(self.aggr_exprs):
             f = a.out_field(in_schema)
             vals = [accs[ai].evaluate() for _, accs in items]
@@ -310,12 +369,20 @@ class UdafWindowExec(ExecOperator):
         self._watermark = snap["watermark"]
         self._frames = {}
         for j_str, groups in snap["frames"].items():
-            frame: dict[tuple, list] = {}
+            frame: dict[int, list] = {}
             for key_list, states in groups:
                 accs = self._make_accs()
                 for acc, st in zip(accs, states):
                     acc.merge(st)
-                frame[tuple(key_list)] = accs
+                if self._interner is not None:
+                    gid = int(
+                        self._interner.intern(
+                            [np.asarray([v]) for v in key_list]
+                        )[0]
+                    )
+                else:
+                    gid = 0
+                frame[gid] = accs
             self._frames[int(j_str)] = frame
 
     def _snapshot(self, epoch: int) -> None:
@@ -324,13 +391,26 @@ class UdafWindowExec(ExecOperator):
         from denormalized_tpu.state.checkpoint import put_json
 
         coord, key = self._ckpt
-        frames = {
-            str(j): [
-                [list(k), [acc.state() for acc in accs]]
-                for k, accs in frame.items()
+
+        # frames persist key VALUES (stable across restarts), not gids —
+        # a restored process re-interns them.  Reverse lookups are batched
+        # per frame (one keys_of call), not per group.
+        frames = {}
+        for j, frame in self._frames.items():
+            gids = list(frame.keys())
+            if self._interner is not None and gids:
+                key_arrays = self._interner.keys_of(
+                    np.asarray(gids, dtype=np.int64)
+                )
+                keys_per_gid = [
+                    [col[i] for col in key_arrays] for i in range(len(gids))
+                ]
+            else:
+                keys_per_gid = [[] for _ in gids]
+            frames[str(j)] = [
+                [kv, [acc.state() for acc in frame[g]]]
+                for g, kv in zip(gids, keys_per_gid)
             ]
-            for j, frame in self._frames.items()
-        }
         put_json(
             coord,
             key,

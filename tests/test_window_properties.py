@@ -140,3 +140,96 @@ def test_engine_matches_oracle(case):
     for key in want:
         assert got[key][0] == want[key][0], (key, got[key], want[key])
         np.testing.assert_allclose(got[key][1], want[key][1], rtol=1e-5, atol=1e-5)
+
+
+def oracle_values(batches, L, S):
+    """Like oracle() but retains each (window, key)'s raw value list so the
+    test can check ANY aggregate against f64 numpy."""
+    wm = None
+    first_open = None
+    agg = collections.defaultdict(list)
+    emitted = {}
+
+    def windows_of(t):
+        j = t // S
+        out = []
+        while j * S + L > t:
+            if j * S <= t:
+                out.append(j)
+            j -= 1
+        return out
+
+    for ts, ks, vs in batches:
+        if first_open is None:
+            first_open = min(t // S for t in ts) - (-(-L // S)) + 1
+        for t, k, v in zip(ts, ks, vs):
+            for j in windows_of(t):
+                if j >= first_open:
+                    agg[(j, k)].append(v)
+        bmin = min(ts)
+        if wm is None or bmin > wm:
+            wm = bmin
+        while first_open * S + L <= wm:
+            for (j, k), vals in list(agg.items()):
+                if j == first_open:
+                    emitted[(j * S, k)] = vals
+                    del agg[(j, k)]
+            first_open += 1
+    for (j, k), vals in agg.items():
+        emitted[(j * S, k)] = vals
+    return emitted
+
+
+@settings(max_examples=30, deadline=None)
+@given(stream_case(), st.booleans())
+def test_variance_and_compensated_match_oracle(case, compensated):
+    """The shifted-moments variance decomposition and the compensated-sum
+    (hi, lo TwoSum) path must both match a retained-values f64 oracle under
+    arbitrary window shapes, late data, and out-of-order arrival."""
+    from denormalized_tpu.api.context import EngineConfig
+
+    L, S, raw = case
+    batches = [
+        RecordBatch(
+            SCHEMA,
+            [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        )
+        for ts, ks, vs in raw
+    ]
+    ctx = Context(EngineConfig(compensated_sums=compensated))
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .window(
+            ["k"],
+            [
+                F.sum(col("v")).alias("s"),
+                F.stddev(col("v")).alias("sd"),
+                F.var_pop(col("v")).alias("vp"),
+            ],
+            L,
+            S,
+        )
+        .collect()
+    )
+    want = oracle_values(raw, L, S or L)
+    got_keys = {
+        (int(res.column(WINDOW_START_COLUMN)[i]), res.column("k")[i])
+        for i in range(res.num_rows)
+    }
+    assert got_keys == set(want)
+    for i in range(res.num_rows):
+        key = (int(res.column(WINDOW_START_COLUMN)[i]), res.column("k")[i])
+        vals = np.asarray(want[key], dtype=np.float64)
+        np.testing.assert_allclose(
+            float(res.column("s")[i]), vals.sum(), rtol=1e-5, atol=1e-5
+        )
+        sd = float(res.column("sd")[i])
+        if len(vals) < 2:
+            assert np.isnan(sd), (key, sd)
+        else:
+            np.testing.assert_allclose(
+                sd, vals.std(ddof=1), rtol=1e-3, atol=1e-4
+            )
+        np.testing.assert_allclose(
+            float(res.column("vp")[i]), vals.var(), rtol=1e-3, atol=1e-4
+        )
