@@ -323,6 +323,14 @@ class IsNullExpr(Expr):
             null = (
                 np.zeros(batch.num_rows, dtype=bool) if m is None else ~m
             )
+            v = batch.column(self.inner.name)
+            if v.dtype == object:
+                # string/derived columns carry nulls as None VALUES (scalar
+                # functions propagate None without materializing a mask) —
+                # both representations are null
+                null = null | np.fromiter(
+                    (x is None for x in v), dtype=bool, count=len(v)
+                )
         else:
             v = self.inner.eval(batch)
             null = (

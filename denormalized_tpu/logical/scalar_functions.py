@@ -200,7 +200,116 @@ _STRING_FNS = {
     "repeat": ScalarFn(_map_n(lambda s, n: s * int(n)), _STR, min_args=2),
     "split_part": ScalarFn(_map_n(_split_part), _STR, min_args=3),
     "to_hex": ScalarFn(_map1(lambda n: format(int(n), "x")), _STR),
+    # regex family (postgres/datafusion semantics; patterns compile once
+    # per distinct (pattern, flags) via _regex)
+    "regexp_like": ScalarFn(
+        _map_n(lambda s, p, f="": bool(_regex(p, f).search(s))),
+        _BOOL,
+        min_args=2,
+        max_args=3,
+    ),
+    "regexp_replace": ScalarFn(
+        _map_n(
+            lambda s, p, r, f="": _regex(p, f).sub(
+                _pg_replacement(r), s, count=0 if "g" in f else 1
+            )
+        ),
+        _STR,
+        min_args=3,
+        max_args=4,
+    ),
+    "regexp_count": ScalarFn(
+        _map_n(lambda s, p, f="": len(_regex(p, f).findall(s))),
+        _I64,
+        min_args=2,
+        max_args=3,
+    ),
+    "like": ScalarFn(
+        _map_n(lambda s, p: bool(_like_regex(p, False).fullmatch(s))),
+        _BOOL,
+        min_args=2,
+    ),
+    "ilike": ScalarFn(
+        _map_n(lambda s, p: bool(_like_regex(p, True).fullmatch(s))),
+        _BOOL,
+        min_args=2,
+    ),
 }
+
+
+# compiled-pattern caches are lru-BOUNDED: patterns can come from a data
+# column, and an unbounded dict would grow for the stream's lifetime
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=4096)
+def _regex(pattern: str, flags: str = ""):
+    import re
+
+    f = 0
+    if "i" in flags:
+        f |= re.IGNORECASE
+    if "s" in flags:
+        f |= re.DOTALL
+    if "m" in flags:
+        f |= re.MULTILINE
+    return re.compile(pattern, f)
+
+
+@_functools.lru_cache(maxsize=4096)
+def _pg_replacement(r: str) -> str:
+    """Postgres replacement escapes → python re escapes: ``\\&`` is the
+    whole match (python ``\\g<0>``); ``\\1``..``\\9`` pass through; an
+    escaped backslash stays literal; ANY other escaped character is that
+    literal character (python re.sub would raise 'bad escape' on it)."""
+    out = []
+    i = 0
+    while i < len(r):
+        c = r[i]
+        if c == "\\":
+            if i + 1 >= len(r):
+                out.append("\\\\")  # trailing lone backslash: literal
+                i += 1
+                continue
+            nxt = r[i + 1]
+            if nxt == "&":
+                out.append("\\g<0>")
+            elif nxt == "\\":
+                out.append("\\\\")
+            elif nxt.isdigit():
+                out.append("\\" + nxt)
+            else:
+                out.append(nxt if nxt not in "\\" else "\\\\")
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+@_functools.lru_cache(maxsize=4096)
+def _like_regex(pattern: str, case_insensitive: bool):
+    import re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            # escaped wildcard (\% or \_) or backslash: literal character
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    # DOTALL: SQL LIKE wildcards match newlines too
+    flags = re.DOTALL | (re.IGNORECASE if case_insensitive else 0)
+    return re.compile("".join(out), flags)
 
 
 def _concat_skip_nulls(*arrays):

@@ -207,6 +207,14 @@ int intern_pyobjects(void* h, PyObject** objs, uint64_t n, int32_t* out_ids) {
     Py_ssize_t len = 0;
     const char* s = nullptr;
     PyObject* tmp = nullptr;
+    if (o == Py_None) {
+      // NULL keys get a dedicated 1-byte key (0xFF — impossible in valid
+      // UTF-8), so null groups never collide with the string 'None' and
+      // the reverse lookup can reconstruct real None
+      static const char kNullKey[1] = {(char)0xFF};
+      out_ids[i] = intern_one(c, (const uint8_t*)kNullKey, 1);
+      continue;
+    }
     if (PyUnicode_Check(o)) {
       s = PyUnicode_AsUTF8AndSize(o, &len);
       if (s == nullptr) {

@@ -152,18 +152,14 @@ class ColumnInterner:
         try:
             offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
             raw = ctypes.string_at(bptr, int(offs[-1])) if offs[-1] else b""
-            if self._encoding == "utf-8":
-                for i in range(n):
-                    values.append(
-                        raw[offs[i] : offs[i + 1]].decode(
-                            "utf-8", errors="replace"
-                        )
-                    )
-            else:
-                for i in range(n):
-                    piece = raw[offs[i] : offs[i + 1]]
-                    piece += b"\x00" * (-len(piece) % 4)
-                    values.append(piece.decode("utf-32-le", errors="replace"))
+            for i in range(n):
+                piece = raw[offs[i] : offs[i + 1]]
+                # 0xFF is the dedicated NULL-key byte (see interner.cpp)
+                values.append(
+                    None
+                    if piece == b"\xff"
+                    else piece.decode("utf-8", errors="replace")
+                )
         finally:
             self._lib.intern_free(bptr)
             self._lib.intern_free(optr)
@@ -198,28 +194,30 @@ class ColumnInterner:
             self._encoding = self._encoding or "utf-8"
             self._native_active = True
             return ids
-        elif self._h is not None:
-            # no Python headers at build time: hand the fixed-width UTF-32LE
-            # ('U') buffer to the native hash — one vectorized astype, zero
-            # per-object encode.  Trailing zero-byte stripping in C++ keeps
-            # ids injective for any key not ending in U+0000.
-            u = np.ascontiguousarray(arr.astype(np.str_))
-            w = u.dtype.itemsize or 1  # 4 bytes per char slot
-            n = len(u)
-            ids = np.empty(n, dtype=np.int32)
-            self._lib.intern_many(
-                self._h,
-                u.ctypes.data_as(ctypes.c_char_p),
-                n,
-                w,
-                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            )
-            self._encoding = self._encoding or "utf-32-le"
-            self._native_active = True
-            return ids
         else:
-            uniq, inv = np.unique(arr.astype(np.str_), return_inverse=True)
-            uniq = list(uniq.tolist())
+            # fallback dict interning with the SAME value identity rules as
+            # the native PyObject path, so results never depend on build
+            # flavor: None is its own key, non-string objects normalize via
+            # str(), trailing NULs strip like the native arena padding.
+            # (There is deliberately NO third fixed-width-buffer path: a
+            # str()-based one merged None with 'None'.)
+            ids = np.empty(len(arr), dtype=np.int32)
+            to_id = self._to_id
+            values = self._values
+            for i, v in enumerate(arr.tolist()):
+                if v is None:
+                    pass
+                elif isinstance(v, str):
+                    v = v.rstrip("\x00")
+                else:
+                    v = str(v)
+                j = to_id.get(v)
+                if j is None:
+                    j = len(values)
+                    to_id[v] = j
+                    values.append(v)
+                ids[i] = j
+            return ids
         ids = np.empty(len(uniq), dtype=np.int32)
         to_id = self._to_id
         values = self._values
@@ -260,7 +258,7 @@ class ColumnInterner:
         if (
             self._h is not None
             and vals
-            and all(isinstance(v, str) for v in vals)
+            and all(isinstance(v, str) or v is None for v in vals)
         ):
             # string column → native table re-seed (also re-syncs _values)
             ids = self.intern_array(np.array(vals, dtype=object))

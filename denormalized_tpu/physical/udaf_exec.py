@@ -289,10 +289,14 @@ class UdafWindowExec(ExecOperator):
         windows, not stream lifetime (same policy as the join)."""
         if self._interner is None:
             return
+        # cheap threshold first: don't build the live set (O(open groups))
+        # on every trigger just to no-op
+        if len(self._interner) <= self._reintern_min:
+            return
         live: set[int] = set()
         for frame in self._frames.values():
             live.update(frame.keys())
-        if len(self._interner) <= max(self._reintern_min, 4 * max(len(live), 1)):
+        if len(self._interner) <= 4 * max(len(live), 1):
             return
         from denormalized_tpu.ops.interner import GroupInterner
 

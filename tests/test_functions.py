@@ -77,6 +77,26 @@ BATCH = rb(
         (F.ascii(lit("A")), [65, 65, 65]),
         (F.chr(lit(66)), ["B", "B", "B"]),
         (F.octet_length(lit("日本")), [6, 6, 6]),
+        (F.regexp_like("k", lit(r"^[A-Z]\w+ ")), [True, False, None]),
+        (
+            F.regexp_replace("k", lit(r"[aeiou]"), lit("*"), lit("g")),
+            ["H*ll* W*rld", "*bc-d*f-gh*", None],
+        ),
+        (F.regexp_replace("k", lit(r"l"), lit("L")), ["HeLlo World", "abc-def-ghi", None]),
+        (F.regexp_count("k", lit(r"[aeiou]")), [3, 3, None]),
+        (F.like("k", lit("Hello%")), [True, False, None]),
+        (F.like("k", lit("%def%")), [False, True, None]),
+        (F.ilike("k", lit("hello world")), [True, False, None]),
+        (F.like("k", lit("Hello_World")), [True, False, None]),
+        # SQL LIKE wildcards span newlines and \% escapes a literal percent
+        (F.like(lit("a\nb"), lit("a%b")), [True, True, True]),
+        (F.like(lit("100%"), lit("100\\%")), [True, True, True]),
+        (F.like(lit("100x"), lit("100\\%")), [False, False, False]),
+        # \& is the whole-match backreference (postgres semantics)
+        (
+            F.regexp_replace(lit("ab"), lit(r"\w+"), lit(r"<\&>")),
+            ["<ab>", "<ab>", "<ab>"],
+        ),
         (F.to_hex(lit(255)), ["ff", "ff", "ff"]),
     ],
 )
@@ -478,6 +498,91 @@ def test_udaf_path_bool_and_numeric_group_keys():
         (True, 8): 3.0,
         (False, 8): 4.0,
     }, got
+
+
+def test_regexp_replace_literal_escapes_do_not_crash():
+    """Unknown backslash escapes in the replacement are literal characters
+    (postgres semantics) — python re.sub would raise 'bad escape'."""
+    got = F.regexp_replace(lit("abc"), lit("b"), lit(r"\q")).eval(BATCH)
+    assert list(got) == ["aqc"] * 3
+    got2 = F.regexp_replace(lit("abc"), lit("b"), lit("x\\")).eval(BATCH)
+    assert list(got2) == ["ax\\c"] * 3
+
+
+def test_interner_value_identity_consistent_across_paths():
+    """Native and fallback interners must agree: None is its own key,
+    non-string objects normalize via str() (so int 5 merges with '5'),
+    and checkpoint value lists containing None round-trip."""
+    from denormalized_tpu.ops.interner import ColumnInterner
+
+    mixed = np.array([None, "None", 5, "5", None], dtype=object)
+    native = ColumnInterner()
+    fallback = ColumnInterner()
+    fallback._h = None  # force the dict path
+    ids_n = native.intern_array(mixed)
+    ids_f = fallback.intern_array(mixed)
+    assert ids_n.tolist() == ids_f.tolist() == [0, 1, 2, 2, 0]
+    assert list(native.value_of(np.array([0, 1, 2]))) == [None, "None", "5"]
+    assert list(fallback.value_of(np.array([0, 1, 2]))) == [None, "None", "5"]
+    # checkpoint round-trip with a None value in the list
+    snap = native.all_values()
+    restored = ColumnInterner()
+    restored.load_values(snap)
+    assert restored.intern_array(mixed).tolist() == [0, 1, 2, 2, 0]
+
+
+def test_is_null_sees_none_values_in_object_columns():
+    """Null can be a mask OR a None value (scalar functions propagate None
+    without materializing masks); is_null must see both."""
+    b = rb([1, 2, 3], ["/api/x", None, "/static"], [1.0, 2.0, 3.0])
+    assert list(col("k").is_null().eval(b)) == [False, True, False]
+    assert list(col("k").is_not_null().eval(b)) == [True, False, True]
+    # through an OR with a null-propagating predicate (the real-world shape)
+    pred = F.like("k", lit("/api/%")) | col("k").is_null()
+    assert list(np.asarray(pred.eval(b), dtype=bool)) == [True, True, False]
+
+
+def test_null_group_keys_stay_null():
+    """A NULL group key is its own group and emits as None — it must never
+    collide with the literal string 'None' (review-found: the interner's
+    str() normalization merged them)."""
+    t0 = 1_700_000_000_000
+    batches = [
+        rb(
+            [t0, t0 + 1, t0 + 2, t0 + 5000],
+            [None, "None", None, "w"],
+            [1.0, 10.0, 2.0, 0.0],
+        )
+    ]
+    ctx = Context()
+    # device window path
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .window(["k"], [F.sum(col("v")).alias("s")], 1000)
+        .collect()
+    )
+    got = {
+        res.column("k")[i]: float(res.column("s")[i])
+        for i in range(res.num_rows)
+        if int(res.column("window_start_time")[i]) == t0
+    }
+    assert got.get(None) == 3.0, got
+    assert got.get("None") == 10.0, got
+    # UDAF frame path
+    res2 = (
+        ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"), name="m2"
+        )
+        .window(["k"], [F.median(col("v")).alias("m")], 1000)
+        .collect()
+    )
+    got2 = {
+        res2.column("k")[i]: float(res2.column("m")[i])
+        for i in range(res2.num_rows)
+        if int(res2.column("window_start_time")[i]) == t0
+    }
+    assert got2.get(None) == 1.5, got2
+    assert got2.get("None") == 10.0, got2
 
 
 def test_udaf_path_reinterning_bounds_key_state():
