@@ -276,10 +276,13 @@ def _pg_replacement(r: str) -> str:
                 out.append("\\g<0>")
             elif nxt == "\\":
                 out.append("\\\\")
-            elif nxt.isdigit():
-                out.append("\\" + nxt)
+            elif nxt.isdigit() and nxt != "0":
+                # \g<N> form: a following literal digit must not extend
+                # the group number (\10 means group 1 then literal '0')
+                out.append(f"\\g<{nxt}>")
             else:
-                out.append(nxt if nxt not in "\\" else "\\\\")
+                # any other escaped char (incl. \0) is that literal char
+                out.append(nxt)
             i += 2
             continue
         out.append(c)

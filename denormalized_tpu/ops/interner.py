@@ -65,13 +65,6 @@ def _load_native_lib():
             lib.intern_destroy.argtypes = [ctypes.c_void_p]
             lib.intern_count.restype = ctypes.c_uint64
             lib.intern_count.argtypes = [ctypes.c_void_p]
-            lib.intern_many.argtypes = [
-                ctypes.c_void_p,
-                ctypes.c_char_p,
-                ctypes.c_uint64,
-                ctypes.c_uint32,
-                ctypes.POINTER(ctypes.c_int32),
-            ]
             lib.intern_key.restype = ctypes.c_uint32
             lib.intern_key.argtypes = [
                 ctypes.c_void_p,
@@ -109,10 +102,6 @@ class ColumnInterner:
         self._values: list = []
         self._lib, self._py_intern = _load_native()
         self._h = self._lib.intern_create() if self._lib else None
-        # which byte encoding the native table stores (decided by the first
-        # string batch's path) — the PyObject path stores UTF-8, the
-        # fixed-width path UTF-32LE; a column never mixes the two
-        self._encoding: str | None = None
         self._native_active = False
         self._values_arr: np.ndarray | None = None  # object-array mirror
 
@@ -191,7 +180,6 @@ class ColumnInterner:
             )
             if rc != 0:  # pragma: no cover - PyDLL re-raises pending errors
                 raise RuntimeError("native interning failed")
-            self._encoding = self._encoding or "utf-8"
             self._native_active = True
             return ids
         else:
