@@ -115,6 +115,51 @@ class LastValueAccumulator(Accumulator):
         return self.value
 
 
+class CountDistinctAccumulator(Accumulator):
+    """Exact distinct count (DataFusion ``count(distinct x)``); state is
+    the value set (jsonable list)."""
+
+    def __init__(self):
+        self.seen: set = set()
+
+    def update(self, col: np.ndarray) -> None:
+        self.seen.update(_jsonable_scalar(v) for v in col.tolist())
+
+    def merge(self, state) -> None:
+        self.seen.update(state[0])
+
+    def state(self) -> list:
+        return [list(self.seen)]
+
+    def evaluate(self) -> int:
+        return len(self.seen)
+
+
+class PercentileContAccumulator(Accumulator):
+    """Exact continuous percentile (DataFusion ``approx_percentile_cont``'s
+    exact cousin): linear interpolation over the sorted values."""
+
+    def __init__(self, q: float):
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"percentile must be in [0, 1], got {q}")
+        self.q = q
+        self.values: list[float] = []
+
+    def update(self, col: np.ndarray) -> None:
+        self.values.extend(float(v) for v in np.asarray(col, np.float64))
+
+    def merge(self, state) -> None:
+        self.values.extend(state[0])
+
+    def state(self) -> list:
+        return [list(self.values)]
+
+    def evaluate(self):
+        if not self.values:
+            return math.nan
+        return float(np.quantile(self.values, self.q))
+
+
 class ApproxDistinctAccumulator(Accumulator):
     """HyperLogLog distinct-count sketch (DataFusion `approx_distinct`).
 

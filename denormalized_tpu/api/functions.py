@@ -35,7 +35,8 @@ __all__ = [  # noqa: F822 - scalar names are injected below
     "count", "sum", "min", "max", "avg",
     "stddev", "stddev_samp", "stddev_pop", "var", "var_samp", "var_pop",
     "median", "approx_median", "array_agg", "first_value", "last_value",
-    "approx_distinct",
+    "approx_distinct", "count_distinct", "percentile_cont",
+    "approx_percentile_cont",
     "case", "when", "udf", "udaf", "col", "lit",
 ] + sorted(REGISTRY)
 
@@ -150,6 +151,34 @@ def approx_distinct(expr: Expr | str) -> AggregateExpr:
     return _builtin_udaf(
         b.ApproxDistinctAccumulator, DataType.INT64, "approx_distinct"
     )(expr)
+
+
+def count_distinct(expr: Expr | str) -> AggregateExpr:
+    """Exact distinct count (DataFusion ``count(distinct x)``)."""
+    b = _builtin_accs()
+    return _builtin_udaf(
+        b.CountDistinctAccumulator, DataType.INT64, "count_distinct"
+    )(expr)
+
+
+def percentile_cont(expr: Expr | str, q: float) -> AggregateExpr:
+    """Exact continuous percentile with linear interpolation (covers
+    DataFusion's approx_percentile_cont use cases exactly)."""
+    b = _builtin_accs()
+
+    class _Bound(b.PercentileContAccumulator):
+        def __init__(self):
+            super().__init__(q)
+
+    _Bound.__name__ = f"PercentileCont[{q}]"
+    return _builtin_udaf(
+        _Bound, DataType.FLOAT64, f"percentile_cont_{q}"
+    )(expr)
+
+
+def approx_percentile_cont(expr: Expr | str, q: float) -> AggregateExpr:
+    """Alias of :func:`percentile_cont` (we can afford exact)."""
+    return percentile_cont(expr, q)
 
 
 # -- CASE ----------------------------------------------------------------

@@ -59,6 +59,8 @@ class _Session:
     start: int
     last: int
     agg: _Agg
+    # one Accumulator per UDAF/collection aggregate (None when none exist)
+    accs: list | None = None
 
 
 class SessionWindowExec(ExecOperator):
@@ -92,8 +94,16 @@ class SessionWindowExec(ExecOperator):
                 self._value_exprs.append(e)
             return keys[k]
 
+        # accumulator (UDAF/collection) aggregates ride their own per-
+        # session Accumulator instances; their args never enter the float
+        # value matrix (they may be strings)
+        self._udafs = []  # list of AggregateExpr with kind == "udaf"
         self._agg_specs: list[tuple] = []
         for a in self.aggr_exprs:
+            if a.kind == "udaf":
+                self._agg_specs.append(("udaf", len(self._udafs)))
+                self._udafs.append(a)
+                continue
             if a.arg is None:
                 self._agg_specs.append((a.kind, None))
                 continue
@@ -128,6 +138,12 @@ class SessionWindowExec(ExecOperator):
         )
 
     # ------------------------------------------------------------------
+    def _make_accs(self) -> list | None:
+        if not self._udafs:
+            return None
+        return [a.udaf.make() for a in self._udafs]
+
+    # ------------------------------------------------------------------
     @staticmethod
     def _merge_agg(a: _Agg, p: _Agg) -> None:
         from denormalized_tpu.ops.segment_agg import chan_merge
@@ -143,7 +159,13 @@ class SessionWindowExec(ExecOperator):
             a.mins[i] = min(a.mins[i], p.mins[i])
             a.maxs[i] = max(a.maxs[i], p.maxs[i])
 
-    def _merge_rows(self, key: tuple, ts_sorted: np.ndarray, partial: _Agg):
+    def _merge_rows(
+        self,
+        key: tuple,
+        ts_sorted: np.ndarray,
+        partial: _Agg,
+        partial_accs: list | None = None,
+    ):
         """Merge one batch segment [first, last] into the per-key OPEN
         session set.  Sessions stay open until the watermark passes
         ``last + gap`` — closing on gap-at-arrival would mis-split
@@ -151,17 +173,36 @@ class SessionWindowExec(ExecOperator):
         sessions (standard event-time session-merge)."""
         first, last = int(ts_sorted[0]), int(ts_sorted[-1])
         open_list = self._sessions.setdefault(key, [])
-        merged = _Session(first, last, partial)
         keep: list[_Session] = []
+        hits: list[_Session] = []
         for s in open_list:
             # within-gap overlap in either direction → merge
             if first - s.last <= self.gap_ms and s.start - last <= self.gap_ms:
-                merged.start = min(merged.start, s.start)
-                merged.last = max(merged.last, s.last)
-                self._merge_agg(merged.agg, s.agg)
+                hits.append(s)
             else:
                 keep.append(s)
-        keep.append(merged)
+        if not hits:
+            keep.append(_Session(first, last, partial, partial_accs))
+        else:
+            # the OLDEST session is the merge base and the new partial folds
+            # in LAST: order-sensitive accumulators (first/last_value,
+            # array_agg) keep arrival order, and the per-batch merge copies
+            # only the new partial's state — not the session's accumulated
+            # state — so long sessions stay O(rows), not quadratic
+            hits.sort(key=lambda s: s.start)
+            base = hits[0]
+            for s in hits[1:]:
+                self._merge_agg(base.agg, s.agg)
+                if base.accs is not None:
+                    for acc, other in zip(base.accs, s.accs):
+                        acc.merge(other.state())
+            self._merge_agg(base.agg, partial)
+            if base.accs is not None and partial_accs is not None:
+                for acc, p in zip(base.accs, partial_accs):
+                    acc.merge(p.state())
+            base.start = min(base.start, first)
+            base.last = max(base.last, last, *(s.last for s in hits[1:]))
+            keep.append(base)
         keep.sort(key=lambda s: s.start)
         self._sessions[key] = keep
 
@@ -187,6 +228,15 @@ class SessionWindowExec(ExecOperator):
             m = column_validity(e, batch)
             if m is not None:
                 valid[:, ci] = m
+
+        # accumulator-aggregate argument columns (raw dtypes) + masks
+        udaf_cols: list[list[np.ndarray]] = []
+        udaf_masks: list[np.ndarray | None] = []
+        for a in self._udafs:
+            udaf_cols.append([np.asarray(e.eval(batch)) for e in a.udaf.args])
+            udaf_masks.append(
+                column_validity(a.udaf.args[0], batch) if a.udaf.args else None
+            )
         # watermark advances from the RAW batch min (late rows included —
         # they only keep the min lower, and the reference's
         # RecordBatchWatermark is computed over the whole batch); computing
@@ -248,6 +298,10 @@ class SessionWindowExec(ExecOperator):
                 key_cols = [kc[keep] for kc in key_cols]
                 vals = vals[keep]
                 valid = valid[keep]
+                udaf_cols = [[c[keep] for c in cols] for cols in udaf_cols]
+                udaf_masks = [
+                    m[keep] if m is not None else None for m in udaf_masks
+                ]
                 n = len(ts)
                 if n == 0:
                     return
@@ -304,7 +358,16 @@ class SessionWindowExec(ExecOperator):
                 means=[float(m) for m in seg_means],
                 m2s=[float(m) for m in seg_m2s],
             )
-            self._merge_rows(key, ts_s[b0:b1], partial)
+            partial_accs = self._make_accs()
+            if partial_accs is not None:
+                seg_rows = order[b0:b1]
+                for acc, cols, am in zip(partial_accs, udaf_cols, udaf_masks):
+                    chunk = [c[seg_rows] for c in cols]
+                    if am is not None:
+                        ok = am[seg_rows]
+                        chunk = [c[ok] for c in chunk]
+                    acc.update(*chunk)
+            self._merge_rows(key, ts_s[b0:b1], partial, partial_accs)
 
         # watermark advance + close expired sessions
         if self._watermark is None or raw_min > self._watermark:
@@ -337,9 +400,18 @@ class SessionWindowExec(ExecOperator):
             cols.append(vals)
         from denormalized_tpu.ops.segment_agg import VAR_KINDS, variance_from_m2
 
-        for spec in self._agg_specs:
+        for ai, spec in enumerate(self._agg_specs):
             kind, col_i = spec[0], spec[1]
-            if kind in VAR_KINDS:
+            if kind == "udaf":
+                vals_out = [s.accs[col_i].evaluate() for _, s in closed]
+                arr = np.empty(len(vals_out), dtype=object)
+                for vi, v in enumerate(vals_out):
+                    arr[vi] = v
+                f = self.aggr_exprs[ai].out_field(self.input_op.schema)
+                if f.dtype.is_numeric:
+                    arr = arr.astype(f.dtype.to_numpy())
+                cols.append(arr)
+            elif kind in VAR_KINDS:
                 cols.append(
                     variance_from_m2(
                         kind,
@@ -399,7 +471,13 @@ class SessionWindowExec(ExecOperator):
             return
         self._watermark = snap["watermark"]
         self._sessions = {}
-        for key_list, start, last, agg in snap["sessions"]:
+        for entry in snap["sessions"]:
+            key_list, start, last, agg = entry[:4]
+            acc_states = entry[4] if len(entry) > 4 else None
+            accs = self._make_accs()
+            if accs is not None and acc_states is not None:
+                for acc, st in zip(accs, acc_states):
+                    acc.merge(st)
             s = _Session(
                 start,
                 last,
@@ -412,6 +490,7 @@ class SessionWindowExec(ExecOperator):
                     means=list(agg.get("means", [0.0] * len(agg["sums"]))),
                     m2s=list(agg.get("m2s", [0.0] * len(agg["sums"]))),
                 ),
+                accs,
             )
             self._sessions.setdefault(tuple(key_list), []).append(s)
 
@@ -429,7 +508,8 @@ class SessionWindowExec(ExecOperator):
                  "maxs": [float(m) for m in s.agg.maxs],
                  "means": [float(m) for m in s.agg.means],
                  "m2s": [float(m) for m in s.agg.m2s],
-             }]
+             },
+             [acc.state() for acc in s.accs] if s.accs is not None else None]
             for k, lst in self._sessions.items()
             for s in lst
         ]

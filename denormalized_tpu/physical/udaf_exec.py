@@ -119,8 +119,8 @@ class UdafWindowExec(ExecOperator):
             from denormalized_tpu.common.errors import PlanError
 
             raise PlanError(
-                "session windows with UDAF aggregates are not supported yet; "
-                "use built-in aggregates with session_window()"
+                "session windows route to SessionWindowExec (which handles "
+                "accumulator aggregates directly)"
             )
         self.input_op = input_op
         self.group_exprs = list(group_exprs)

@@ -60,6 +60,18 @@ class Planner:
                     shard_strategy=self.config.shard_strategy,
                     device_strategy=self.config.device_strategy,
                 )
+            if node.window_type is lp.WindowType.SESSION:
+                # sessions handle builtin AND accumulator (UDAF/collection)
+                # aggregates in one operator
+                from denormalized_tpu.physical.session_exec import SessionWindowExec
+
+                return SessionWindowExec(
+                    child,
+                    node.group_exprs,
+                    node.aggr_exprs,
+                    gap_ms=node.length_ms,
+                    emit_on_close=kwargs.get("emit_on_close", True),
+                )
             if any(a.kind == "udaf" for a in node.aggr_exprs):
                 from denormalized_tpu.physical.udaf_exec import UdafWindowExec
 
@@ -70,16 +82,6 @@ class Planner:
                     node.window_type,
                     node.length_ms,
                     node.slide_ms,
-                    emit_on_close=kwargs.get("emit_on_close", True),
-                )
-            if node.window_type is lp.WindowType.SESSION:
-                from denormalized_tpu.physical.session_exec import SessionWindowExec
-
-                return SessionWindowExec(
-                    child,
-                    node.group_exprs,
-                    node.aggr_exprs,
-                    gap_ms=node.length_ms,
                     emit_on_close=kwargs.get("emit_on_close", True),
                 )
             return StreamingWindowExec(

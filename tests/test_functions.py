@@ -433,6 +433,30 @@ def test_median_array_agg_first_last_distinct():
     np.testing.assert_allclose(res.column("mean")[i], 5.0)
 
 
+def test_count_distinct_and_percentile_cont():
+    t0 = 1_700_000_000_000
+    vals = [1.0, 2.0, 2.0, 3.0, 3.0, 3.0, 4.0, 10.0]
+    batches = [
+        rb([t0 + i for i in range(len(vals))], ["a"] * len(vals), vals),
+        rb([t0 + 5000], ["w"], [0.0]),
+    ]
+    res = _window_aggs(
+        batches,
+        [
+            F.count_distinct(col("v")).alias("nd"),
+            F.percentile_cont(col("v"), 0.5).alias("p50"),
+            F.percentile_cont(col("v"), 0.9).alias("p90"),
+            F.approx_percentile_cont(col("v"), 0.25).alias("p25"),
+        ],
+    )
+    row = {res.column("k")[i]: i for i in range(res.num_rows)}
+    i = row["a"]
+    assert int(res.column("nd")[i]) == 5
+    np.testing.assert_allclose(res.column("p50")[i], np.quantile(vals, 0.5))
+    np.testing.assert_allclose(res.column("p90")[i], np.quantile(vals, 0.9))
+    np.testing.assert_allclose(res.column("p25")[i], np.quantile(vals, 0.25))
+
+
 def test_approx_distinct_accuracy():
     from denormalized_tpu.api.builtin_accumulators import (
         ApproxDistinctAccumulator,

@@ -8,7 +8,7 @@ import pytest
 from denormalized_tpu import Context, col
 from denormalized_tpu.api import functions as F
 from denormalized_tpu.api.udaf import Accumulator
-from denormalized_tpu.common.errors import PlanError
+
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.sources.memory import GeneratorSource, MemorySource
@@ -179,16 +179,33 @@ def test_session_window_respects_null_masks():
     assert float(res.column("mx")[0]) == 3.0
 
 
-def test_session_udaf_rejected():
-    class A(Accumulator):
-        pass
+def test_session_udaf_supported():
+    """Sessions carry user UDAFs (formerly a PlanError)."""
 
-    u = F.udaf(A, DataType.FLOAT64, "u")
+    class Total(Accumulator):
+        def __init__(self):
+            self.t = 0.0
+
+        def update(self, col):
+            self.t += float(col.sum())
+
+        def merge(self, state):
+            self.t += state[0]
+
+        def state(self):
+            return [self.t]
+
+        def evaluate(self):
+            return self.t
+
+    u = F.udaf(Total, DataType.FLOAT64, "total")
+    t0 = 1_700_000_000_000
     ctx = Context()
-    ds = ctx.from_source(
+    res = ctx.from_source(
         MemorySource.from_batches(
-            [kv([1_700_000_000_000], ["a"], [1.0])], timestamp_column="ts"
+            [kv([t0, t0 + 10, t0 + 9000], ["a", "a", "w"], [1.5, 2.5, 0.0])],
+            timestamp_column="ts",
         )
-    ).session_window(["k"], [u(col("v"))], 1000)
-    with pytest.raises(PlanError, match="session windows with UDAF"):
-        ds.collect()
+    ).session_window(["k"], [u(col("v")).alias("t")], 1000).collect()
+    rows = {res.column("k")[i]: float(res.column("t")[i]) for i in range(res.num_rows)}
+    assert rows["a"] == 4.0

@@ -109,3 +109,223 @@ def test_udaf_window(make_batch):
     assert got[("a", t0)] == (2.0, 2)  # mean(1, 3)
     assert got[("b", t0)] == (10.0, 1)
     assert got[("a", t0 + 2000)] == (0.0, 1)
+
+
+def test_session_window_with_collection_aggregates():
+    """Sessions now carry accumulator aggregates (median/array_agg/user
+    UDAFs) alongside the builtin kinds — merging across segments and
+    out-of-order bridges included."""
+    import numpy as np
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.sources.memory import MemorySource
+
+    S = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.STRING, nullable=False),
+            Field("v", DataType.FLOAT64),
+        ]
+    )
+    t0 = 1_700_000_000_000
+
+    def kv(ts, ks, vs):
+        return RecordBatch(
+            S,
+            [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        )
+
+    batches = [
+        kv([t0 + 0, t0 + 100], ["a", "a"], [5.0, 1.0]),
+        # out-of-order bridge: arrives later, merges the session downward
+        kv([t0 + 50, t0 + 20_000], ["a", "w"], [3.0, 0.0]),
+        kv([t0 + 40_000], ["w"], [0.0]),
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .session_window(
+            ["k"],
+            [
+                F.median(col("v")).alias("med"),
+                F.array_agg(col("v")).alias("arr"),
+                F.count(col("v")).alias("c"),
+            ],
+            5_000,
+        )
+        .collect()
+    )
+    rows = {res.column("k")[i]: i for i in range(res.num_rows)}
+    i = rows["a"]
+    assert int(res.column("c")[i]) == 3
+    assert float(res.column("med")[i]) == 3.0
+    assert sorted(res.column("arr")[i]) == [1.0, 3.0, 5.0]
+
+
+def test_session_collection_aggregates_survive_kill_restore(tmp_path):
+    """Session accumulator state (array_agg) checkpoints and restores."""
+    import numpy as np
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import EndOfStream, Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.sources.memory import MemorySource
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.lsm import close_global_state_backend
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    S = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.STRING, nullable=False),
+            Field("v", DataType.FLOAT64),
+        ]
+    )
+    t0 = 1_700_000_000_000
+
+    def kv(ts, ks, vs):
+        return RecordBatch(
+            S,
+            [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        )
+
+    # bursts every 800ms spanning 200ms, gap 300 → sessions close per burst
+    rng = np.random.default_rng(9)
+    batches = []
+    for b in range(10):
+        n = 20
+        ts = np.sort(t0 + b * 800 + rng.integers(0, 200, n))
+        ks = np.asarray([f"s{i % 3}" for i in range(n)], dtype=object)
+        batches.append(kv(ts, ks, rng.integers(0, 50, n).astype(np.float64)))
+
+    def pipeline(ctx):
+        return ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"),
+            name="sacc",
+        ).session_window(
+            ["k"], [F.array_agg(col("v")).alias("arr")], 300
+        )
+
+    def windows(result):
+        return {
+            (result.column("k")[i], int(result.column("window_start_time")[i])):
+            sorted(result.column("arr")[i])
+            for i in range(result.num_rows)
+        }
+
+    golden = windows(pipeline(Context()).collect())
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+        )
+
+    state_dir = str(tmp_path / "state")
+    try:
+        ctx_a = Context(make_cfg(state_dir))
+        root_a = executor.build_physical(
+            lp.Sink(pipeline(ctx_a)._plan, CollectSink()), ctx_a
+        )
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emitted_a = {}
+        items_seen = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, RecordBatch):
+                emitted_a.update(windows(item))
+            if items_seen == 1:
+                orch_a.trigger_now()
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                break
+            items_seen += 1
+        it.close()
+        close_global_state_backend()
+
+        ctx_b = Context(make_cfg(state_dir))
+        root_b = executor.build_physical(
+            lp.Sink(pipeline(ctx_b)._plan, CollectSink()), ctx_b
+        )
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        emitted_b = {}
+        for item in root_b.run():
+            if isinstance(item, RecordBatch):
+                emitted_b.update(windows(item))
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        close_global_state_backend()
+
+    combined = dict(emitted_a)
+    combined.update(emitted_b)
+    assert set(combined) == set(golden)
+    for k in golden:
+        assert combined[k] == golden[k], (k, combined[k], golden[k])
+
+
+def test_session_order_sensitive_accumulators_keep_arrival_order():
+    """first_value/last_value through session merges must reflect arrival
+    order (review repro: the new batch partial was the merge base, flipping
+    first and last)."""
+    import numpy as np
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.sources.memory import MemorySource
+
+    S = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.STRING, nullable=False),
+            Field("v", DataType.FLOAT64),
+        ]
+    )
+    t0 = 1_700_000_000_000
+
+    def kv(ts, ks, vs):
+        return RecordBatch(
+            S,
+            [np.asarray(ts, np.int64), np.asarray(ks, object), np.asarray(vs)],
+        )
+
+    batches = [
+        kv([t0], ["a"], [1.0]),
+        kv([t0 + 100], ["a"], [2.0]),
+        kv([t0 + 200], ["a"], [3.0]),
+        kv([t0 + 20_000], ["w"], [0.0]),
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource.from_batches(batches, timestamp_column="ts"))
+        .session_window(
+            ["k"],
+            [
+                F.first_value(col("v")).alias("fv"),
+                F.last_value(col("v")).alias("lv"),
+                F.array_agg(col("v")).alias("arr"),
+            ],
+            5_000,
+        )
+        .collect()
+    )
+    rows = {res.column("k")[i]: i for i in range(res.num_rows)}
+    i = rows["a"]
+    assert float(res.column("fv")[i]) == 1.0
+    assert float(res.column("lv")[i]) == 3.0
+    assert list(res.column("arr")[i]) == [1.0, 2.0, 3.0]
