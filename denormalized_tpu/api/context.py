@@ -67,6 +67,11 @@ class EngineConfig:
     # dense path for low-cardinality aggregation; auto-falls-back) | 'auto'
     # (alias: try the dense path, fall back to scatter per batch)
     device_strategy: str = "scatter"
+    # device-side emission compaction: permute active groups to the front on
+    # device and transfer only a pow2 bucket covering them, instead of all G
+    # rows per component.  Wins when emitted windows are sparse vs the
+    # padded capacity; default off pending real-chip A/B.
+    emission_compaction: bool = False
 
     def set(self, key: str, value) -> "EngineConfig":
         """String-keyed setter for parity with SessionConfig::set

@@ -61,6 +61,12 @@ class WindowStateBackend:
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
+    def read_slot_compact(self, slot: int):
+        """(active gids, aligned component rows) — or None when this layout
+        doesn't implement device-side compaction (caller falls back to the
+        full read_slot)."""
+        return None
+
     def reset_slot(self, slot: int) -> None:
         raise NotImplementedError
 
@@ -133,6 +139,9 @@ class SingleDeviceWindowState(WindowStateBackend):
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
         return sa.read_slot(self.spec, self._state, slot)
+
+    def read_slot_compact(self, slot: int):
+        return sa.read_slot_compact(self.spec, self._state, slot)
 
     def reset_slot(self, slot: int) -> None:
         self._state = sa.reset_slot(

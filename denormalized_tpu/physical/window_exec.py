@@ -77,6 +77,7 @@ class StreamingWindowExec(ExecOperator):
         *,
         accum_dtype=jnp.float32,
         compensated_sums: bool = False,
+        emission_compaction: bool = False,
         min_group_capacity: int = 128,
         min_window_slots: int = 16,
         min_batch_bucket: int = 256,
@@ -159,6 +160,7 @@ class StreamingWindowExec(ExecOperator):
             comps = sa.with_compensation(comps)
         components = tuple(comps)
         self._compensated = compensated_sums
+        self._emission_compaction = emission_compaction
 
         self._grouped = len(self.group_exprs) > 0
         self._interner = GroupInterner(len(self.group_exprs)) if self._grouped else None
@@ -404,9 +406,31 @@ class StreamingWindowExec(ExecOperator):
         from denormalized_tpu.runtime.tracing import span
 
         slot = j % self._spec.window_slots
+        compacted = None
         with span("window.emit", op=self.name, window=j * self.slide_ms):
-            rows = self._backend.read_slot(slot)
-            self._backend.reset_slot(slot)
+            if self._emission_compaction:
+                compacted = self._backend.read_slot_compact(slot)
+            if compacted is not None:
+                gids32, rows = compacted
+                self._backend.reset_slot(slot)
+            else:
+                rows = self._backend.read_slot(slot)
+                self._backend.reset_slot(slot)
+        if compacted is not None:
+            # rows hold ONLY the active groups, already in ascending gid
+            # order (read_slot_compact's contract).  Apply the same
+            # interner-bound guard the full path applies before keys_of.
+            ngroups = len(self._interner) if self._grouped else 1
+            in_bounds = gids32 < ngroups
+            if not in_bounds.all():
+                gids32 = gids32[in_bounds]
+                rows = {label: arr[in_bounds] for label, arr in rows.items()}
+            if len(gids32) == 0:
+                return None
+            gids = gids32.astype(np.int32)
+            active = np.ones(len(gids), dtype=bool)
+            self._metrics["windows_emitted"] += 1
+            return self._build_emission(j, gids, rows, active)
         counts = rows[sa.ROW_COUNT.label]
         ngroups = len(self._interner) if self._grouped else 1
         active = counts > 0
@@ -415,6 +439,11 @@ class StreamingWindowExec(ExecOperator):
             return None
         self._metrics["windows_emitted"] += 1
         gids = np.nonzero(active)[0].astype(np.int32)
+        return self._build_emission(j, gids, rows, active)
+
+    def _build_emission(
+        self, j: int, gids: np.ndarray, rows: dict, active: np.ndarray
+    ) -> RecordBatch:
         cols: list[np.ndarray] = []
         if self._grouped:
             key_vals = self._interner.keys_of(gids)
