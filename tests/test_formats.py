@@ -9,7 +9,7 @@ import pytest
 
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.schema import DataType, Field, Schema
-from denormalized_tpu.formats import StreamEncoding, make_decoder
+from denormalized_tpu.formats import StreamEncoding
 from denormalized_tpu.formats.avro_codec import (
     AvroDecoder,
     encode_record,

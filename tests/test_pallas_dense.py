@@ -1,7 +1,6 @@
 """Pallas dense-path (MXU/VPU) window kernel vs the scatter path: identical
 results on tumbling and sliding workloads (interpret mode on CPU)."""
 
-import collections
 
 import numpy as np
 import pytest

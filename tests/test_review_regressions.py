@@ -8,7 +8,6 @@ import pytest
 from denormalized_tpu import Context, col
 from denormalized_tpu.api import functions as F
 from denormalized_tpu.api.udaf import Accumulator
-
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.sources.memory import GeneratorSource, MemorySource
