@@ -149,6 +149,7 @@ def gen_batches(
 
 
 DEVICE_STRATEGY = os.environ.get("BENCH_DEVICE_STRATEGY", "auto")
+EMISSION_COMPACTION = os.environ.get("BENCH_EMISSION_COMPACTION", "0") == "1"
 
 
 def _engine_ctx(batch_bucket=None, **over):
@@ -156,6 +157,7 @@ def _engine_ctx(batch_bucket=None, **over):
     from denormalized_tpu.api.context import EngineConfig
 
     over.setdefault("device_strategy", DEVICE_STRATEGY)
+    over.setdefault("emission_compaction", EMISSION_COMPACTION)
     cfg = EngineConfig(
         min_batch_bucket=batch_bucket or BATCH_ROWS, min_window_slots=32, **over
     )

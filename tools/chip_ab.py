@@ -1,0 +1,125 @@
+"""Real-chip A/B harness: the full strategy matrix over the BASELINE.md
+configs, for the moment the axon tunnel is reachable.
+
+Runs bench.py in subprocesses (so each config gets a fresh backend and a
+wedged tunnel can never hang this process) across:
+
+    config    × {simple, sliding, highcard, join, checkpoint}
+    strategy  × {scatter, pallas_dense}
+    emission  × {full, compacted}
+
+and writes one JSON report with rows/s, vs_baseline, and p50/p99 window
+latency per cell — the VERDICT round-1 ask ("A/B scatter vs pallas_dense on
+the chip for all five configs") in one command:
+
+    python tools/chip_ab.py [--rows 8000000] [--out AB_REPORT.json]
+
+The TPU probe follows the tunnel rules (subprocess, abandoned not killed on
+timeout); if the backend is down every cell falls back to CPU and the
+report says so — still useful as a host-side regression matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+CONFIGS = ["simple", "sliding", "highcard", "join", "checkpoint"]
+STRATEGIES = ["scatter", "pallas_dense"]
+COMPACTION = [False, True]
+
+
+def run_cell(config, strategy, compaction, rows, lat_rows):
+    env = dict(os.environ)
+    env.update(
+        BENCH_CONFIG=config,
+        BENCH_DEVICE_STRATEGY=strategy,
+        BENCH_ROWS=str(rows),
+        BENCH_LAT_ROWS=str(lat_rows),
+        BENCH_EMISSION_COMPACTION="1" if compaction else "0",
+    )
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=3600,
+    )
+    cell = {
+        "config": config,
+        "strategy": strategy,
+        "emission_compaction": compaction,
+        "rc": proc.returncode,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            try:
+                cell.update(json.loads(line))
+                break
+            except json.JSONDecodeError:
+                pass
+    if proc.returncode != 0:
+        cell["stderr_tail"] = proc.stderr[-800:]
+    return cell
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8_000_000)
+    ap.add_argument("--lat-rows", type=int, default=10_000_000)
+    ap.add_argument("--out", default=str(REPO / "AB_REPORT.json"))
+    ap.add_argument(
+        "--configs", default=",".join(CONFIGS),
+        help="comma-separated subset",
+    )
+    args = ap.parse_args()
+
+    # probe ONCE and pin the result for every cell: per-cell probes would
+    # stack abandoned probe processes against the single-client tunnel
+    sys.path.insert(0, str(REPO))
+    import bench as bench_mod
+
+    device = os.environ.get("BENCH_DEVICE") or bench_mod.pick_device()
+    os.environ["BENCH_DEVICE"] = device
+    print(f"device: {device}", flush=True)
+
+    cells = []
+    for config in args.configs.split(","):
+        for strategy in STRATEGIES:
+            for compaction in COMPACTION:
+                print(
+                    f"== {config} / {strategy} / "
+                    f"compaction={'on' if compaction else 'off'} ==",
+                    flush=True,
+                )
+                cell = run_cell(
+                    config, strategy, compaction, args.rows, args.lat_rows
+                )
+                print(
+                    f"   rc={cell['rc']} device={cell.get('device')} "
+                    f"{cell.get('value', 0):,} rows/s "
+                    f"p99={cell.get('p99_window_latency_ms')}ms",
+                    flush=True,
+                )
+                cells.append(cell)
+    report = {
+        "generated_at_unix": int(time.time()),
+        "rows": args.rows,
+        "device": device,
+        "cells": cells,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=1))
+    print(f"wrote {args.out} ({len(cells)} cells)")
+
+
+if __name__ == "__main__":
+    main()
