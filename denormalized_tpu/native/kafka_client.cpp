@@ -176,6 +176,21 @@ struct Client {
   std::vector<int64_t> rec_kafka_offsets;
   int64_t next_offset = 0;
   int64_t high_watermark = 0;
+  // externally-decompressed codecs (e.g. zstd via the caller's Python
+  // zstandard module): batches whose codec bit is set here are stashed in
+  // `pending` for the caller to decompress and re-ingest, instead of
+  // erroring.  Bit n = Kafka codec id n.
+  uint32_t ext_codec_mask = 0;
+  struct Pending {
+    int64_t base_offset;
+    int64_t first_ts;
+    int64_t fetch_offset;
+    int32_t nrec;
+    int32_t last_offset_delta;
+    int32_t codec;
+    std::vector<uint8_t> data;  // compressed records section
+  };
+  std::vector<Pending> pending;
 
   bool send_all(const uint8_t* d, size_t n) {
     while (n) {
@@ -493,6 +508,60 @@ const char* codec_name(int codec) {
   }
 }
 
+// parse one records stream (inline or decompressed) into the client's
+// arenas; returns false (with c->error set) on corrupt record data
+bool parse_records_stream(Client* c, Reader rr, int32_t nrec,
+                          int64_t base_offset, int64_t first_ts,
+                          int64_t fetch_offset) {
+  for (int32_t i = 0; i < nrec && !rr.fail; i++) {
+    int64_t rec_len = rr.varint();
+    const uint8_t* rec_end = rr.p + rec_len;
+    rr.i8();  // attributes
+    int64_t ts_delta = rr.varint();
+    int64_t off_delta = rr.varint();
+    int64_t klen = rr.varint();
+    if (klen > 0) rr.skip((size_t)klen);
+    int64_t vlen = rr.varint();
+    int64_t abs_off = base_offset + off_delta;
+    if (abs_off >= fetch_offset && vlen >= 0 && rr.need((size_t)vlen)) {
+      c->rec_bytes.insert(c->rec_bytes.end(), rr.p, rr.p + vlen);
+      c->rec_offsets.push_back(c->rec_bytes.size());
+      c->rec_ts.push_back(first_ts + ts_delta);
+      c->rec_kafka_offsets.push_back(abs_off);
+    }
+    // the cursor advances past EVERY record >= fetch_offset — including
+    // tombstones (vlen == -1) and pre-filter duplicates — or the consumer
+    // would refetch the same batch forever
+    if (abs_off >= fetch_offset && abs_off + 1 > c->next_offset)
+      c->next_offset = abs_off + 1;
+    if (vlen > 0) rr.skip((size_t)vlen);
+    // headers
+    int64_t nh = rr.varint();
+    for (int64_t h = 0; h < nh && !rr.fail; h++) {
+      int64_t kl = rr.varint();
+      rr.skip((size_t)kl);
+      int64_t vl = rr.varint();
+      if (vl > 0) rr.skip((size_t)vl);
+    }
+    // rec_end comes from an untrusted rec_len (possibly decompressed from
+    // an external codec): never let the cursor move past the buffer, or
+    // Reader::need's (end - p) would underflow and every later bounds
+    // check would pass on out-of-bounds memory
+    if (rr.p > rec_end || rec_end > rr.end) rr.fail = true;
+    else rr.p = rec_end;
+  }
+  if (rr.fail) {
+    // same error-loudly policy as the codec branches: a record stream
+    // that goes bad mid-batch (truncated/garbled after a successful
+    // decompress — nothing validates content checksums) must not
+    // silently drop its remaining records and advance past them.
+    c->error = "corrupt record data in batch at offset " +
+               std::to_string(base_offset);
+    return false;
+  }
+  return true;
+}
+
 // parse magic-2 record batches out of a Fetch "records" blob
 bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
                        int64_t fetch_offset) {
@@ -516,10 +585,11 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
     int16_t attrs = r.i16();
     int codec = attrs & 0x7;
     std::vector<uint8_t> inflated;  // keeps decompressed records alive
-    if (codec > 3) {
-      // zstd (or future codec): no silent skip — surface the codec by
-      // name so the operator can reconfigure the producer or the topic
-      // (the reference gets all codecs from librdkafka, Cargo.toml:58)
+    if (codec > 3 && !((c->ext_codec_mask >> codec) & 1)) {
+      // zstd (or future codec) with no external decompressor registered:
+      // no silent skip — surface the codec by name so the operator can
+      // reconfigure the producer or the topic (the reference gets all
+      // codecs from librdkafka, Cargo.toml:58)
       c->error = std::string("unsupported compression codec ") +
                  codec_name(codec) + " (" + std::to_string(codec) +
                  ") in batch at offset " + std::to_string(base_offset);
@@ -530,6 +600,31 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
     r.i64();              // maxTimestamp
     r.skip(8 + 2 + 4);    // producerId/Epoch/baseSequence
     int32_t nrec = r.i32();
+    if (codec > 3) {
+      // externally-decompressed codec: stash the compressed records
+      // section; the caller decompresses (e.g. Python zstandard) and
+      // re-ingests through kc_ingest_decompressed BEFORE reading the
+      // fetch arena
+      Client::Pending pend;
+      pend.base_offset = base_offset;
+      pend.first_ts = first_ts;
+      pend.fetch_offset = fetch_offset;
+      pend.nrec = nrec;
+      pend.last_offset_delta = last_offset_delta;
+      pend.codec = codec;
+      pend.data.assign(r.p, batch_end);
+      c->pending.push_back(std::move(pend));
+      r.p = batch_end;
+      continue;
+    }
+    if (!c->pending.empty()) {
+      // an inline batch AFTER a stashed one would be parsed into the arena
+      // BEFORE the stashed batch's records are ingested, scrambling
+      // partition-offset order.  Stop the fetch here; these batches
+      // refetch next round (next_offset has not advanced past them).
+      r.p = blob_end;
+      return true;
+    }
     Reader rr = r;  // records section (inline, or decompressed)
     if (codec != 0) {
       bool ok = false;
@@ -547,50 +642,9 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
       }
       rr = Reader{inflated.data(), inflated.data() + inflated.size()};
     }
-    for (int32_t i = 0; i < nrec && !rr.fail; i++) {
-      int64_t rec_len = rr.varint();
-      const uint8_t* rec_end = rr.p + rec_len;
-      rr.i8();  // attributes
-      int64_t ts_delta = rr.varint();
-      int64_t off_delta = rr.varint();
-      int64_t klen = rr.varint();
-      if (klen > 0) rr.skip((size_t)klen);
-      int64_t vlen = rr.varint();
-      int64_t abs_off = base_offset + off_delta;
-      if (abs_off >= fetch_offset && vlen >= 0 && rr.need((size_t)vlen)) {
-        c->rec_bytes.insert(c->rec_bytes.end(), rr.p, rr.p + vlen);
-        c->rec_offsets.push_back(c->rec_bytes.size());
-        c->rec_ts.push_back(first_ts + ts_delta);
-        c->rec_kafka_offsets.push_back(abs_off);
-      }
-      // the cursor advances past EVERY record ≥ fetch_offset — including
-      // tombstones (vlen == -1) and pre-filter duplicates — or the consumer
-      // would refetch the same batch forever
-      if (abs_off >= fetch_offset && abs_off + 1 > c->next_offset)
-        c->next_offset = abs_off + 1;
-      if (vlen > 0) rr.skip((size_t)vlen);
-      // headers
-      int64_t nh = rr.varint();
-      for (int64_t h = 0; h < nh && !rr.fail; h++) {
-        int64_t kl = rr.varint();
-        rr.skip((size_t)kl);
-        int64_t vl = rr.varint();
-        if (vl > 0) rr.skip((size_t)vl);
-      }
-      if (rr.p > rec_end) rr.fail = true;
-      else rr.p = rec_end;
-    }
-    if (rr.fail) {
-      // same error-loudly policy as the codec branches: a record stream
-      // that goes bad mid-batch (truncated/garbled after a successful
-      // decompress — nothing validates content checksums) must not
-      // silently drop its remaining records and advance past them.
-      // Truncated *trailing* batches from a maxBytes cut never get here:
-      // the outer loop breaks on r.p + batch_len > blob_end above.
-      c->error = "corrupt record data in batch at offset " +
-                 std::to_string(base_offset);
+    if (!parse_records_stream(c, rr, nrec, base_offset, first_ts,
+                              fetch_offset))
       return false;
-    }
     // safety net for empty/odd batches: never stall behind a consumed batch
     int64_t past = base_offset + last_offset_delta + 1;
     if (past > c->next_offset && past > fetch_offset) c->next_offset = past;
@@ -599,6 +653,7 @@ bool parse_record_sets(Client* c, Reader& r, int32_t total_len,
   r.p = blob_end;
   return true;
 }
+
 
 }  // namespace
 
@@ -770,6 +825,7 @@ int kc_fetch(void* h, const char* topic, int partition, int64_t offset,
   c->rec_offsets.assign(1, 0);
   c->rec_ts.clear();
   c->rec_kafka_offsets.clear();
+  c->pending.clear();
   c->next_offset = offset;
   Writer body;
   body.i32(-1);           // replica
@@ -814,6 +870,40 @@ int kc_fetch(void* h, const char* topic, int partition, int64_t offset,
     c->error = "malformed fetch response";
     return -1;
   }
+  return (int)c->rec_ts.size();
+}
+
+// register codecs the CALLER can decompress (bit n = Kafka codec id n)
+void kc_set_external_codecs(void* h, uint32_t mask) {
+  static_cast<Client*>(h)->ext_codec_mask = mask;
+}
+
+int kc_pending_count(void* h) {
+  return (int)static_cast<Client*>(h)->pending.size();
+}
+
+int kc_pending_codec(void* h, int i) {
+  return static_cast<Client*>(h)->pending[i].codec;
+}
+
+const uint8_t* kc_pending_data(void* h, int i, uint64_t* len) {
+  Client::Pending& p = static_cast<Client*>(h)->pending[i];
+  *len = p.data.size();
+  return p.data.data();
+}
+
+// ingest a decompressed records section for pending batch i; returns the
+// new total record count, or -1 (error set) on corrupt data
+int kc_ingest_decompressed(void* h, int i, const uint8_t* data,
+                           uint64_t len) {
+  Client* c = static_cast<Client*>(h);
+  Client::Pending& p = c->pending[i];
+  Reader rr{data, data + len};
+  if (!parse_records_stream(c, rr, p.nrec, p.base_offset, p.first_ts,
+                            p.fetch_offset))
+    return -1;
+  int64_t past = p.base_offset + p.last_offset_delta + 1;
+  if (past > c->next_offset && past > p.fetch_offset) c->next_offset = past;
   return (int)c->rec_ts.size();
 }
 

@@ -139,14 +139,18 @@ def lz4_frame_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
-# Kafka compression attribute values → encoder.  zstd is deliberately
-# absent: the client rejects codec 4 by id before ever decompressing, so
-# tests plant an arbitrary `compressed_records` instead of needing a real
-# zstd encoder (and the image's optional zstandard module).
+def _zstd_compress(data: bytes) -> bytes:
+    import zstandard  # optional: only needed when a test produces codec=4
+
+    return zstandard.ZstdCompressor().compress(data)
+
+
+# Kafka compression attribute values → encoder
 _CODEC_COMPRESS = {
     1: lambda d: __import__("gzip").compress(d),
     2: snappy_compress,
     3: lz4_frame_compress,
+    4: _zstd_compress,
 }
 
 

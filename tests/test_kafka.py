@@ -411,20 +411,50 @@ def test_lz4_match_sequences(broker):
     c.close()
 
 
-def test_zstd_batch_surfaces_named_error(broker):
-    """zstd (codec 4) is not implemented: the fetch must ERROR naming the
-    codec — never silently skip the batch (that would be silent data
+def test_zstd_round_trip(broker):
+    """zstd batches decode via the hybrid path: C++ stashes the compressed
+    section, Python zstandard decompresses, the C++ record parser
+    re-ingests — full codec parity with librdkafka."""
+    pytest.importorskip("zstandard")
+    broker.create_topic("zs", partitions=1)
+    payloads = [json.dumps({"i": i, "pad": "z" * 70}).encode() for i in range(25)]
+    broker.produce("zs", 0, payloads, ts_ms=55, codec=4)
+    c = KafkaClient(broker.bootstrap)
+    got, ts, next_off = c.fetch("zs", 0, 0, max_wait_ms=10)
+    assert got == payloads
+    assert next_off == 25
+    assert list(ts) == [55] * 25
+    got2, _, _ = c.fetch("zs", 0, 10, max_wait_ms=10)
+    assert got2 == payloads[10:]
+    c.close()
+
+
+def test_zstd_without_decompressor_surfaces_named_error(broker):
+    """Without an external decompressor registered, zstd batches keep the
+    error-loudly behavior — never a silent skip (that would be silent data
     loss; the reference supports all codecs via librdkafka)."""
     from denormalized_tpu.common.errors import SourceError
 
-    broker.create_topic("zs", partitions=1)
-    # the client rejects codec 4 by id before decompressing, so the records
-    # section can be arbitrary bytes — no zstd encoder needed
-    broker.produce("zs", 0, [b'{"i": 1}'], ts_ms=1, codec=4,
+    broker.create_topic("zs2", partitions=1)
+    broker.produce("zs2", 0, [b'{"i": 1}'], ts_ms=1, codec=4,
                    compressed_records=b"\x28\xb5\x2f\xfd")
-    c = KafkaClient(broker.bootstrap)
+    c = KafkaClient(broker.bootstrap, external_codecs=False)
     with pytest.raises(SourceError, match="zstd"):
-        c.fetch("zs", 0, 0, max_wait_ms=10)
+        c.fetch("zs2", 0, 0, max_wait_ms=10)
+    c.close()
+
+
+def test_zstd_corrupt_payload_errors(broker):
+    """A zstd batch whose payload fails decompression raises loudly."""
+    pytest.importorskip("zstandard")
+    from denormalized_tpu.common.errors import SourceError
+
+    broker.create_topic("zs3", partitions=1)
+    broker.produce("zs3", 0, [b'{"i": 1}'], ts_ms=1, codec=4,
+                   compressed_records=b"\x28\xb5\x2f\xfd\xff\xff\xff")
+    c = KafkaClient(broker.bootstrap)
+    with pytest.raises(SourceError, match="zstd decompression failed"):
+        c.fetch("zs3", 0, 0, max_wait_ms=10)
     c.close()
 
 
@@ -648,3 +678,26 @@ def test_broker_outage_recovery():
         got += batch.num_rows
     assert got >= 1
     b2.stop()
+
+
+def test_mixed_codec_fetch_preserves_offset_order(broker):
+    """A fetch spanning a zstd batch followed by a plain batch must deliver
+    records in partition-offset order: the client stops the fetch at the
+    boundary and the trailing batches arrive on the NEXT fetch."""
+    pytest.importorskip("zstandard")
+    broker.create_topic("mix", partitions=1)
+    broker.produce("mix", 0, [b'{"i": 0}', b'{"i": 1}'], ts_ms=1, codec=4)
+    broker.produce("mix", 0, [b'{"i": 2}', b'{"i": 3}'], ts_ms=2)  # plain
+    broker.produce("mix", 0, [b'{"i": 4}'], ts_ms=3, codec=4)
+    c = KafkaClient(broker.bootstrap)
+    seen = []
+    off = 0
+    for _ in range(6):
+        got, _, off = c.fetch("mix", 0, off, max_wait_ms=10)
+        seen.extend(got)
+        if len(seen) >= 5:
+            break
+    assert seen == [b'{"i": 0}', b'{"i": 1}', b'{"i": 2}', b'{"i": 3}',
+                    b'{"i": 4}'], seen
+    assert off == 5
+    c.close()

@@ -46,21 +46,30 @@ def run_cell(config, strategy, compaction, rows, lat_rows):
         BENCH_EMISSION_COMPACTION="1" if compaction else "0",
     )
     t0 = time.time()
-    proc = subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, str(REPO / "bench.py")],
-        capture_output=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         text=True,
         env=env,
-        timeout=3600,
+        start_new_session=True,
     )
     cell = {
         "config": config,
         "strategy": strategy,
         "emission_compaction": compaction,
-        "rc": proc.returncode,
-        "wall_s": round(time.time() - t0, 1),
     }
-    for line in proc.stdout.splitlines():
+    try:
+        out, errout = proc.communicate(timeout=3600)
+        cell["rc"] = proc.returncode
+    except subprocess.TimeoutExpired:
+        # ABANDON, never kill: SIGKILLing a process mid-TPU-handshake is
+        # what wedges the single-client tunnel for every later user
+        cell["rc"] = "timeout-abandoned"
+        cell["wall_s"] = round(time.time() - t0, 1)
+        return cell
+    cell["wall_s"] = round(time.time() - t0, 1)
+    for line in out.splitlines():
         if line.startswith("{"):
             try:
                 cell.update(json.loads(line))
@@ -68,7 +77,7 @@ def run_cell(config, strategy, compaction, rows, lat_rows):
             except json.JSONDecodeError:
                 pass
     if proc.returncode != 0:
-        cell["stderr_tail"] = proc.stderr[-800:]
+        cell["stderr_tail"] = errout[-800:]
     return cell
 
 
@@ -111,6 +120,14 @@ def main():
                     flush=True,
                 )
                 cells.append(cell)
+                # incremental write: a wedged later cell must not lose
+                # hours of completed cells
+                Path(args.out).write_text(
+                    json.dumps(
+                        {"partial": True, "device": device, "cells": cells},
+                        indent=1,
+                    )
+                )
     report = {
         "generated_at_unix": int(time.time()),
         "rows": args.rows,
