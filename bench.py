@@ -62,6 +62,14 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _warm_batches(batch_rows: int, floor: int, available: int) -> int:
+    """Number of warmup batches spanning ~3 windows of event time — enough
+    that the emission path (slot gather / reset / compaction) compiles
+    during warmup, not in the measured run."""
+    ms_per_batch = max(1, int(batch_rows / EVENTS_PER_SEC * 1000))
+    return min(available, max(floor, int(3 * WINDOW_MS / ms_per_batch)))
+
+
 # -- device selection ----------------------------------------------------
 
 
@@ -347,11 +355,14 @@ def run_latency(config, ckpt_dir=None) -> dict:
         )
     # shape warmup: run a short unpaced stream with the SAME engine config
     # (same batch bucket → same compiled shapes) so jit compile time does
-    # not pollute the first windows' latency samples
+    # not pollute the first windows' latency samples.  The warmup must span
+    # enough EVENT TIME to close windows: emission (slot gather / reset /
+    # compaction) has its own compiled programs, and on a remote-compile
+    # backend an unwarmed emission path costs seconds on the first window.
     warm_ctx = _ctx_for(
         config, batch_bucket=LAT_BATCH, ckpt_dir=ckpt_dir, emit_on_close=False
     )
-    warm_n = min(len(batches), 160)
+    warm_n = _warm_batches(LAT_BATCH, 160, len(batches))
     for _ in build_pipeline(
         config,
         warm_ctx,
@@ -632,8 +643,12 @@ def main():
     try:
         if CONFIG == "checkpoint":
             ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
-        # warmup (compile cache) with this config's own pipeline shape
-        run_throughput(CONFIG, batches[:4], batches2[:4] if batches2 else None,
+        # warmup (compile cache) with this config's own pipeline shape —
+        # spanning enough event time to CLOSE windows, so the emission
+        # path's compiled programs are warm before the measured run
+        warm_n = _warm_batches(BATCH_ROWS, 4, len(batches))
+        run_throughput(CONFIG, batches[:warm_n],
+                       batches2[:warm_n] if batches2 else None,
                        ckpt_dir=ckpt_dir)
         _reset_ckpt(ckpt_dir)
         rps, info = run_throughput(CONFIG, batches, batches2, ckpt_dir=ckpt_dir)

@@ -323,13 +323,27 @@ def reset_slot(
     return state
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def _gather_slot(spec: WindowKernelSpec, state, slot):
+    # slot is TRACED: one compiled program serves every ring slot.  Indexing
+    # with a Python int instead would compile a fresh gather per distinct
+    # slot — ruinous on a remote-compile TPU backend (seconds per window).
+    return {
+        c.label: jax.lax.dynamic_index_in_dim(
+            state[c.label], slot, axis=0, keepdims=False
+        )
+        for c in spec.components
+    }
+
+
 def read_slot(
     spec: WindowKernelSpec, state: dict[str, jax.Array], slot: int
 ) -> dict[str, np.ndarray]:
     """Fetch one window's accumulator rows to host (device→host crossing of
     G-sized vectors only — results, never raw rows)."""
-    rows = jax.device_get({c.label: state[c.label][slot] for c in spec.components})
-    return rows
+    return jax.device_get(
+        _gather_slot(spec, state, jnp.asarray(slot, jnp.int32))
+    )
 
 
 @functools.partial(jax.jit, static_argnums=0)

@@ -257,10 +257,9 @@ class KeyShardedWindowState(WindowStateBackend):
         )
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
-        # slicing a G-sharded array gathers one (G_total,) row per component
-        return jax.device_get(
-            {c.label: self._state[c.label][slot] for c in self.spec.components}
-        )
+        # jitted traced-slot gather; slicing a G-sharded array gathers one
+        # (G_total,) row per component
+        return sa.read_slot(self.spec, self._state, slot)
 
     def reset_slot(self, slot: int) -> None:
         self._state = _key_sharded_reset_slot(
