@@ -622,8 +622,12 @@ def main():
         force_cpu()
     log(f"device: {device}  config: {CONFIG}  strategy: {DEVICE_STRATEGY}")
     if CONFIG == "highcard":
-        global NUM_KEYS
+        global NUM_KEYS, BATCH_ROWS
         NUM_KEYS = int(os.environ.get("BENCH_KEYS", 100_000))
+        if "BENCH_BATCH" not in os.environ:
+            # bigger arrival batches amortize per-batch host overheads,
+            # which dominate at 100K-key cardinality
+            BATCH_ROWS = 524_288
     log(f"generating {TOTAL_ROWS:,} rows ...")
     _, batches = gen_batches()
     batches2 = None

@@ -63,10 +63,25 @@ class EngineConfig:
     mesh_devices: int | None = None
     # 'auto' | 'key_sharded' | 'partial_final' (see parallel/sharded_state.py)
     shard_strategy: str = "auto"
-    # single-device kernel: 'scatter' (general) | 'pallas_dense' (MXU/VPU
-    # dense path for low-cardinality aggregation; auto-falls-back) | 'auto'
-    # (alias: try the dense path, fall back to scatter per batch)
-    device_strategy: str = "scatter"
+    # single-device kernel strategy:
+    #   'scatter'       — ship rows, device scatters them into the window
+    #                     ring (general; right when host↔device bandwidth
+    #                     is plentiful, e.g. CPU JAX or co-located TPU)
+    #   'pallas_dense'  — ship rows, dense MXU/VPU pallas kernel for
+    #                     low-cardinality aggregation (auto-falls-back)
+    #   'partial_merge' — reduce each batch on host (native C++ single
+    #                     pass) and ship per-(slide-unit, group) partials;
+    #                     the device merges them into the ring.  Traffic
+    #                     scales with cardinality, not rows — the right
+    #                     choice behind a narrow host↔device link
+    #   'auto'          — partial_merge on a TPU backend, scatter on CPU
+    device_strategy: str = "auto"
+    # partial_merge pacing: merge the host stripe after this many rows even
+    # if no window closed, and defer emission up to emit_lag_ms after a
+    # window becomes closable so replay-speed runs batch several windows
+    # per device round-trip (real-time feeds always exceed the lag)
+    partial_merge_rows: int = 4_000_000
+    emit_lag_ms: int = 200
     # device-side emission compaction: permute active groups to the front on
     # device and transfer only a pow2 bucket covering them, instead of all G
     # rows per component.  Wins when emitted windows are sparse vs the

@@ -308,6 +308,116 @@ update_state = functools.partial(jax.jit, static_argnums=0, donate_argnums=1)(
 )
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=3)
+def merge_partials(
+    spec: WindowKernelSpec,
+    SUB: int,
+    a_pad: int,
+    state: dict[str, jax.Array],
+    packed: jax.Array,  # (P+1, a_pad+2) int32, HostPartialStripe.take_packed
+) -> dict[str, jax.Array]:
+    """Fold host-side partial aggregates into the window ring — the device
+    half of the ``partial_merge`` strategy (host edge-reduction +
+    accelerator merge; see ops/host_partial.py).
+
+    ``packed`` is an **int32 carrier** (immune to x64-off canonicalization):
+    row 0 holds flat cell indices ``((u*SUB)+s)*G + g`` (−1 = padding) plus
+    ``u_base_rel`` (stripe unit 0 relative to first_open) and ``base_mod``
+    (first_open % W) in its tail slots; value planes are f32 (or f64-pair)
+    bitcasts — sums arrive as (hi, lo) so the host's f64 accumulation
+    survives transit.  The k-way sliding fan-out happens HERE: unit u's
+    partial feeds windows u-k+1..u, with sub-bucket 1 (rows past the
+    L-(k-1)S edge) excluded from the oldest window.  Compensated mode
+    routes lo into the 'sumc' buffer — one rounding per merge per cell
+    instead of one per row."""
+    W = spec.window_slots
+    G = spec.group_capacity
+    idx = packed[0, :a_pad]
+    u_base_rel = packed[0, a_pad]
+    base_mod = packed[0, a_pad + 1]
+    valid = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    g = safe % G
+    us = safe // G
+    s = us % SUB
+    u = us // SUB
+
+    def f32_plane(pi):
+        return jax.lax.bitcast_convert_type(packed[pi, :a_pad], jnp.float32)
+
+    for i in range(spec.length_units):
+        ok = valid
+        if SUB == 2 and i == spec.length_units - 1:
+            ok = ok & (s == 0)
+        w_rel = u_base_rel + u - i
+        ok = ok & (w_rel >= 0) & (w_rel < W)
+        slot = jnp.where(ok, (base_mod + w_rel) % W, W).astype(jnp.int32)
+        pi = 1
+        for comp in spec.components:
+            if comp.kind == "sumc":
+                continue
+            buf = state[comp.label]
+            at = buf.at[slot, g]
+            if comp.kind == "sum":
+                hi = f32_plane(pi).astype(buf.dtype)
+                lo = f32_plane(pi + 1).astype(buf.dtype)
+                if spec.compensated:
+                    state[comp.label] = at.add(hi, mode="drop")
+                    lo_label = AggComponent("sumc", comp.col).label
+                    state[lo_label] = state[lo_label].at[slot, g].add(
+                        lo, mode="drop"
+                    )
+                else:
+                    # two adds keep most of the host f64 precision even in
+                    # a plain f32 buffer
+                    state[comp.label] = at.add(hi, mode="drop").at[
+                        slot, g
+                    ].add(lo, mode="drop")
+                pi += 2
+                continue
+            pv = f32_plane(pi)
+            pi += 1
+            if comp.kind == "count":
+                state[comp.label] = at.add(pv.astype(buf.dtype), mode="drop")
+            elif comp.kind == "min":
+                state[comp.label] = at.min(pv.astype(buf.dtype), mode="drop")
+            else:
+                state[comp.label] = at.max(pv.astype(buf.dtype), mode="drop")
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=3)
+def _gather_and_reset(
+    spec: WindowKernelSpec,
+    n: int,
+    g_bucket: int,
+    state: dict[str, jax.Array],
+    first_slot,
+):
+    """Read ``n`` consecutive ring slots AND reset them in one program —
+    one device round-trip per emission cycle instead of two per window.
+
+    ``g_bucket`` bounds the transferred group prefix: interner ids are
+    dense, so groups ≥ the live count hold only init values — fetching
+    ``[:, :g_bucket]`` instead of all G cuts the device→host volume when
+    capacity is padded well beyond the live cardinality."""
+    W = spec.window_slots
+    slots = (first_slot + jnp.arange(n, dtype=jnp.int32)) % W
+    out = {
+        c.label: state[c.label][slots, :g_bucket] for c in spec.components
+    }
+    for c in spec.components:
+        # only the transferred prefix needs resetting: cells beyond the
+        # live-group prefix were never written
+        init = jnp.full((n, g_bucket), spec.init_value(c))
+        state[c.label] = state[c.label].at[slots, :g_bucket].set(
+            init.astype(state[c.label].dtype)
+        )
+    return state, out
+
+
+
+
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
 def reset_slot(
     spec: WindowKernelSpec, state: dict[str, jax.Array], slot: jax.Array

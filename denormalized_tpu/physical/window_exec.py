@@ -85,6 +85,8 @@ class StreamingWindowExec(ExecOperator):
         mesh=None,
         shard_strategy: str = "auto",
         device_strategy: str = "scatter",
+        partial_merge_rows: int = 4_000_000,
+        emit_lag_ms: int = 200,
         name: str = "window",
     ) -> None:
         if window_type is WindowType.SESSION:
@@ -201,12 +203,22 @@ class StreamingWindowExec(ExecOperator):
         self._first_open: int | None = None  # lowest non-emitted slide index
         self._max_win_seen: int = -1
         self._watermark_ms: int | None = None
+        # partial_merge flush/emission pacing: emission is deferred up to
+        # emit_lag_s after a window becomes closable so replay-speed runs
+        # batch several windows per device round-trip; paced (real-time)
+        # feeds always exceed the lag and emit promptly
+        self._emit_lag_s = emit_lag_ms / 1000.0
+        self._merge_rows = partial_merge_rows
+        self._stripe_wall: float | None = None
+        # dispatched-but-unmaterialized emission blocks: (j0, n, handle)
+        self._pending_emit: list[tuple] = []
         self._metrics = {
             "rows_in": 0,
             "batches_in": 0,
             "late_rows": 0,
             "windows_emitted": 0,
             "device_steps": 0,
+            "partial_merges": 0,
             "grow_events": 0,
             "host_prep_s": 0.0,
         }
@@ -217,7 +229,14 @@ class StreamingWindowExec(ExecOperator):
         return [self.input_op]
 
     def metrics(self):
-        return dict(self._metrics)
+        m = dict(self._metrics)
+        if self._backend.accumulates_host:
+            # reconcile from the backend counter: flushes can also happen
+            # inside accumulate() (stripe-span overflow), which per-call
+            # deltas in _flush would miss
+            m["partial_merges"] = self._backend.merges
+            m["device_steps"] = self._backend.merges
+        return m
 
     def _label(self):
         w = f"{self.window_type.value} {self.length_ms}ms"
@@ -232,6 +251,9 @@ class StreamingWindowExec(ExecOperator):
     def _grow(self, *, window_slots: int | None = None, group_capacity: int | None = None):
         from denormalized_tpu.parallel.sharded_state import make_sharded_state
 
+        # host-accumulated partials are bound to the old G/W layout —
+        # merge them into device state before exporting it
+        self._backend.flush_pending()
         host = self._backend.export()
         old = self._spec
         self._spec = sa.WindowKernelSpec(
@@ -314,12 +336,13 @@ class StreamingWindowExec(ExecOperator):
         else:
             gid = np.zeros(n, dtype=np.int32)
         self._ensure_capacity(int(win_rel64.max()))
-        win_rel = np.clip(win_rel64, -1, self._spec.window_slots).astype(np.int32)
 
-        # value matrix + per-column validity
+        # value matrix + per-column validity (f64 until the device cast —
+        # the partial_merge path accumulates in f64 on host)
         V = self._spec.num_value_cols
-        values = np.zeros((n, max(V, 1)), dtype=np.float32)
+        values64 = np.zeros((n, max(V, 1)), dtype=np.float64)
         colvalid = np.ones((n, max(V, 1)), dtype=bool)
+        any_invalid = False
         from denormalized_tpu.logical.expr import column_validity
 
         for j, e in enumerate(self._value_exprs):
@@ -327,6 +350,7 @@ class StreamingWindowExec(ExecOperator):
             m = column_validity(e, batch)
             if m is not None:
                 colvalid[:, j] = m
+                any_invalid = any_invalid or not colvalid[:, j].all()
             tr = self._value_transforms[j]
             if tr is not None:
                 # variance moment columns: shift by a pivot K taken from the
@@ -350,39 +374,78 @@ class StreamingWindowExec(ExecOperator):
                 raw = raw - K
                 if tr == "shift_sq":
                     raw = raw * raw
-            values[:, j] = raw
+            values64[:, j] = raw
 
-        # pad to bucket (divisible by the mesh so row-sharding splits evenly)
-        Bp = max(self._min_batch_bucket, _next_pow2(n))
-        n_dev = 1 if self._mesh is None else self._mesh.devices.size
-        Bp = -(-Bp // n_dev) * n_dev
-        row_valid = np.zeros(Bp, dtype=bool)
-        row_valid[:n] = True
+        if self._backend.accumulates_host:
+            # partial_merge: reduce the batch on host; the device sees a
+            # merged stripe later (flush on trigger/growth/snapshot).
+            # Late-drop against the WATERMARK (windows already closable),
+            # not first_open: emission deferral must not make drop
+            # semantics wall-clock-dependent — this is exactly where the
+            # scatter path's first_open would sit, since it emits every
+            # closable window immediately.
+            closable_pre = self._closable()
+            if late or closable_pre:
+                keep = win_rel64 >= closable_pre
+                n_drop = int((~keep).sum())
+                if n_drop:
+                    self._metrics["late_rows"] += n_drop - late
+                else:
+                    keep = None
+            else:
+                keep = None
+            if self._backend.pending_rows == 0:
+                self._stripe_wall = time.perf_counter()
+            self._backend.accumulate(
+                win_rel64,
+                rem,
+                gid,
+                values64,
+                colvalid if any_invalid else None,
+                keep,
+                first % self._spec.window_slots,
+            )
+            self._metrics["host_prep_s"] += time.perf_counter() - t0
+        else:
+            values = values64.astype(np.float32)
+            win_rel = np.clip(
+                win_rel64, -1, self._spec.window_slots
+            ).astype(np.int32)
+            # pad to bucket (divisible by the mesh so row-sharding splits
+            # evenly)
+            Bp = max(self._min_batch_bucket, _next_pow2(n))
+            n_dev = 1 if self._mesh is None else self._mesh.devices.size
+            Bp = -(-Bp // n_dev) * n_dev
+            row_valid = np.zeros(Bp, dtype=bool)
+            row_valid[:n] = True
 
-        def pad(a, fill=0):
-            if a.shape[0] == Bp:
-                return a
-            out = np.full((Bp,) + a.shape[1:], fill, dtype=a.dtype)
-            out[:n] = a
-            return out
+            def pad(a, fill=0):
+                if a.shape[0] == Bp:
+                    return a
+                out = np.full((Bp,) + a.shape[1:], fill, dtype=a.dtype)
+                out[:n] = a
+                return out
 
-        self._metrics["host_prep_s"] += time.perf_counter() - t0
-        self._backend.update(
-            pad(values),
-            pad(colvalid),
-            pad(win_rel, fill=-1),
-            pad(rem),
-            pad(gid),
-            row_valid,
-            first % self._spec.window_slots,
-            # span of the ON-TIME rows only: late rows (win_rel < 0) are
-            # dropped by both kernels and must not widen the dense-path span
-            min_win_rel=int(
-                win_rel64[win_rel64 >= 0].min() if (win_rel64 >= 0).any() else 0
-            ),
-            max_win_rel=int(win_rel64.max()),
-        )
-        self._metrics["device_steps"] += 1
+            self._metrics["host_prep_s"] += time.perf_counter() - t0
+            self._backend.update(
+                pad(values),
+                pad(colvalid),
+                pad(win_rel, fill=-1),
+                pad(rem),
+                pad(gid),
+                row_valid,
+                first % self._spec.window_slots,
+                # span of the ON-TIME rows only: late rows (win_rel < 0)
+                # are dropped by both kernels and must not widen the
+                # dense-path span
+                min_win_rel=int(
+                    win_rel64[win_rel64 >= 0].min()
+                    if (win_rel64 >= 0).any()
+                    else 0
+                ),
+                max_win_rel=int(win_rel64.max()),
+            )
+            self._metrics["device_steps"] += 1
 
         # watermark: monotonic max of batch min-ts (reference semantics)
         bmin = int(ts.min())
@@ -391,16 +454,98 @@ class StreamingWindowExec(ExecOperator):
         yield from self._trigger()
 
     # -- emission --------------------------------------------------------
+    def _closable(self) -> int:
+        if self._watermark_ms is None or self._first_open is None:
+            return 0
+        wm_win = (self._watermark_ms - self.length_ms) // self.slide_ms + 1
+        return max(0, int(wm_win) - self._first_open)
+
+    def _drain_pending(self) -> Iterator[RecordBatch]:
+        """Materialize previously dispatched emission blocks (their
+        device→host transfers have been running in the background)."""
+        if not self._pending_emit:
+            return
+        pending, self._pending_emit = self._pending_emit, []
+        ngroups = len(self._interner) if self._grouped else 1
+        for j0, n, handle in pending:
+            block = self._backend.read_reset_block_finish(handle)
+            for i in range(n):
+                rows = {label: arr[i] for label, arr in block.items()}
+                counts = rows[sa.ROW_COUNT.label]
+                active = counts > 0
+                active[ngroups:] = False
+                if not active.any():
+                    continue
+                self._metrics["windows_emitted"] += 1
+                gids = np.nonzero(active)[0].astype(np.int32)
+                yield self._build_emission(j0 + i, gids, rows, active)
+
     def _trigger(self) -> Iterator[RecordBatch]:
         """Emit every window whose end ≤ watermark (trigger_windows,
-        grouped_window_agg_stream.rs:220-253)."""
-        if self._watermark_ms is None or self._first_open is None:
+        grouped_window_agg_stream.rs:220-253).
+
+        With a host-accumulating backend, emission is deferred up to
+        ``_emit_lag_s`` after the first window becomes closable: a
+        replay-speed feed then closes several windows per device
+        round-trip (merge + block gather amortized), while a real-time
+        feed — whose stripe is necessarily older than the lag when its
+        window closes — emits immediately."""
+        yield from self._drain_pending()
+        n_close = self._closable()
+        if n_close == 0:
+            if (
+                self._backend.accumulates_host
+                and self._backend.pending_rows >= self._merge_rows
+            ):
+                self._flush()
             return
-        while self._first_open * self.slide_ms + self.length_ms <= self._watermark_ms:
-            b = self._emit_window(self._first_open)
-            self._first_open += 1
-            if b is not None:
-                yield b
+        if self._backend.accumulates_host:
+            age = time.perf_counter() - (self._stripe_wall or 0.0)
+            if (
+                age < self._emit_lag_s
+                and self._backend.pending_rows < self._merge_rows
+                and self._stripe_fits_more()
+            ):
+                return
+            self._flush()
+        if self._emission_compaction:
+            while self._first_open * self.slide_ms + self.length_ms <= self._watermark_ms:
+                b = self._emit_window(self._first_open)
+                self._first_open += 1
+                if b is not None:
+                    yield b
+            return
+        while n_close > 0:
+            # pow2 block sizes bound the compiled gather variants
+            n = 1 << min(3, (n_close).bit_length() - 1)
+            n = min(n, self._spec.window_slots)
+            handle = self._backend.read_reset_block_start(
+                self._first_open % self._spec.window_slots,
+                n,
+                len(self._interner) if self._grouped else 1,
+            )
+            self._pending_emit.append((self._first_open, n, handle))
+            self._first_open += n
+            n_close -= n
+        if not self._backend.accumulates_host:
+            # row-shipping backends emit synchronously (prompt, in the
+            # same trigger); the async pipeline — drain on the NEXT
+            # trigger so the device→host transfer overlaps ingest — is
+            # reserved for the partial_merge path where round-trips
+            # dominate
+            yield from self._drain_pending()
+
+    def _stripe_fits_more(self) -> bool:
+        """Can the stripe still absorb the next slide unit without
+        overflowing its span? (else defer no further — flush and emit)"""
+        from denormalized_tpu.ops.host_partial import HostPartialStripe
+
+        span_now = self._max_win_seen - self._first_open + 1
+        return span_now + 1 < HostPartialStripe.U_MAX
+
+    def _flush(self) -> None:
+        # counters reconcile from backend.merges in metrics()
+        self._backend.flush_pending()
 
     def _emit_window(self, j: int) -> RecordBatch | None:
         from denormalized_tpu.runtime.tracing import span
@@ -475,6 +620,9 @@ class StreamingWindowExec(ExecOperator):
     def _snapshot(self, epoch: int) -> None:
         from denormalized_tpu.state.serialization import pack_snapshot
 
+        # device state must include everything the stripe holds — the
+        # snapshot is the recovery point
+        self._flush()
         coord, key = self._ckpt
         meta = {
             "epoch": epoch,
@@ -533,11 +681,16 @@ class StreamingWindowExec(ExecOperator):
                 ):
                     yield from self._process_batch(item)
             elif isinstance(item, Marker):
+                yield from self._drain_pending()
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
+                # pending blocks are watermark-CLOSED windows: they emit
+                # even when the unclosed-window flush is disabled
+                yield from self._drain_pending()
                 if self.emit_on_close and self._first_open is not None:
+                    self._flush()
                     for j in range(self._first_open, self._max_win_seen + 1):
                         b = self._emit_window(j)
                         if b is not None:

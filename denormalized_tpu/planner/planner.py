@@ -60,6 +60,8 @@ class Planner:
                     mesh=mesh,
                     shard_strategy=self.config.shard_strategy,
                     device_strategy=self.config.device_strategy,
+                    partial_merge_rows=self.config.partial_merge_rows,
+                    emit_lag_ms=self.config.emit_lag_ms,
                 )
             if node.window_type is lp.WindowType.SESSION:
                 # sessions handle builtin AND accumulator (UDAF/collection)

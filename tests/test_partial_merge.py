@@ -1,0 +1,294 @@
+"""Parity suite for the ``partial_merge`` device strategy: host edge
+reduction (native C++ / numpy fallback) + device merge must produce the
+same results as the per-row ``scatter`` path across window shapes, nulls,
+variance aggregates, late data, capacity growth, and checkpoint export."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+from denormalized_tpu.sources.memory import MemorySource
+
+
+def _run(batches, aggs, length_ms, slide_ms=None, *, strategy, groups=None,
+         cfg_extra=None):
+    cfg = EngineConfig(device_strategy=strategy, **(cfg_extra or {}))
+    ctx = Context(cfg)
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+    ).window(
+        [col(g) for g in (groups if groups is not None else ["sensor_name"])],
+        aggs(),
+        length_ms,
+        slide_ms,
+    )
+    result = ds.collect()
+    keyed = {}
+    group_cols = groups if groups is not None else ["sensor_name"]
+    for i in range(result.num_rows):
+        key = (int(result.column(WINDOW_START_COLUMN)[i]),) + tuple(
+            result.column(g)[i] for g in group_cols
+        )
+        assert key not in keyed, f"duplicate emission {key}"
+        keyed[key] = {
+            n: result.column(n)[i]
+            for n in result.schema.names
+            if n not in group_cols
+        }
+    return keyed
+
+
+def _assert_parity(a, b, rtol=1e-6):
+    assert set(a) == set(b), (
+        f"window/key sets differ: only-scatter={set(a) - set(b)} "
+        f"only-partial={set(b) - set(a)}"
+    )
+    for k in a:
+        for name, va in a[k].items():
+            vb = b[k][name]
+            if isinstance(va, (float, np.floating)):
+                if np.isnan(va) and np.isnan(vb):
+                    continue
+                assert vb == pytest.approx(va, rel=rtol, abs=1e-9), (
+                    k, name, va, vb
+                )
+            else:
+                assert va == vb, (k, name, va, vb)
+
+
+def _sensor_batches(make_batch, n_batches=24, rows=400, keys=10, span=250,
+                    seed=0, nulls=False):
+    from denormalized_tpu.common.record_batch import RecordBatch
+
+    rng = np.random.default_rng(seed)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(n_batches):
+        ts = np.sort(t0 + b * span + rng.integers(0, span, rows))
+        names = rng.choice([f"s{i}" for i in range(keys)], size=rows)
+        vals = rng.normal(50.0, 10.0, rows)
+        batch = make_batch(ts, names, vals)
+        if nulls:
+            mask = rng.random(rows) > 0.15
+            batch = RecordBatch(
+                batch.schema, batch.columns, [None, None, mask]
+            )
+        batches.append(batch)
+    return batches
+
+
+def _std_aggs():
+    return [
+        F.count(col("reading")).alias("cnt"),
+        F.min(col("reading")).alias("mn"),
+        F.max(col("reading")).alias("mx"),
+        F.avg(col("reading")).alias("av"),
+        F.sum(col("reading")).alias("sm"),
+    ]
+
+
+@pytest.mark.parametrize(
+    "length,slide",
+    [(1000, None), (1000, 250), (500, 200)],  # tumbling; k=4; k=3 with sub
+    ids=["tumbling", "sliding_divisible", "sliding_ragged"],
+)
+def test_partial_matches_scatter(make_batch, length, slide):
+    batches = _sensor_batches(make_batch)
+    a = _run(batches, _std_aggs, length, slide, strategy="scatter")
+    b = _run(batches, _std_aggs, length, slide, strategy="partial_merge")
+    assert len(a) > 10
+    _assert_parity(a, b)
+
+
+def test_partial_with_nulls(make_batch):
+    batches = _sensor_batches(make_batch, nulls=True)
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    _assert_parity(a, b)
+
+
+def test_partial_ungrouped(make_batch):
+    batches = _sensor_batches(make_batch)
+    a = _run(batches, _std_aggs, 1000, strategy="scatter", groups=[])
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge", groups=[])
+    assert len(a) > 3
+    _assert_parity(a, b)
+
+
+def test_partial_variance_family(make_batch):
+    batches = _sensor_batches(make_batch)
+
+    def aggs():
+        return [
+            F.stddev(col("reading")).alias("sd"),
+            F.var(col("reading")).alias("vr"),
+            F.avg(col("reading")).alias("av"),
+        ]
+
+    a = _run(batches, aggs, 1000, strategy="scatter")
+    b = _run(batches, aggs, 1000, strategy="partial_merge")
+    _assert_parity(a, b, rtol=1e-5)
+
+
+def test_partial_late_rows_dropped(make_batch):
+    """A batch far behind the watermark must be dropped identically."""
+    batches = _sensor_batches(make_batch, n_batches=12)
+    # splice in a late batch (timestamps from 3 windows earlier)
+    rng = np.random.default_rng(9)
+    t0 = 1_700_000_000_000
+    late = make_batch(
+        np.sort(t0 + rng.integers(0, 200, 100)),
+        rng.choice(["s0", "s1"], 100),
+        rng.normal(0, 1, 100),
+    )
+    seq = batches[:8] + [late] + batches[8:]
+    a = _run(seq, _std_aggs, 1000, strategy="scatter")
+    b = _run(seq, _std_aggs, 1000, strategy="partial_merge")
+    _assert_parity(a, b)
+
+
+def test_partial_growth(make_batch):
+    """Group capacity and window-slot growth mid-stream (stripe must be
+    flushed across the recompilation boundary)."""
+    rng = np.random.default_rng(3)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(30):
+        rows = 300
+        ts = np.sort(t0 + b * 200 + rng.integers(0, 200, rows))
+        # cardinality ramps past the 128 default capacity
+        hi = 20 + b * 12
+        names = rng.choice([f"k{i}" for i in range(hi)], size=rows)
+        vals = rng.normal(10.0, 3.0, rows)
+        batches.append(make_batch(ts, names, vals))
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    assert len({k[1] for k in a}) > 128
+    _assert_parity(a, b)
+
+
+def test_partial_compensated(make_batch):
+    batches = _sensor_batches(make_batch)
+    a = _run(
+        batches, _std_aggs, 1000, strategy="scatter",
+        cfg_extra={"compensated_sums": True},
+    )
+    b = _run(
+        batches, _std_aggs, 1000, strategy="partial_merge",
+        cfg_extra={"compensated_sums": True},
+    )
+    _assert_parity(a, b)
+
+
+def test_partial_giant_span_batch(make_batch):
+    """One catch-up batch spanning far more slide units than a stripe can
+    hold (> U_MAX=16) must be chunk-folded, not silently truncated."""
+    rng = np.random.default_rng(13)
+    t0 = 1_700_000_000_000
+    n = 40_000
+    ts = np.sort(t0 + rng.integers(0, 40_000, n))  # 40 one-second units
+    names = rng.choice([f"s{i}" for i in range(6)], size=n)
+    vals = rng.normal(1.0, 0.1, n)
+    batches = [make_batch(ts, names, vals)]
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    assert len({k[0] for k in a}) >= 39  # windows across the whole span
+    _assert_parity(a, b)
+
+
+def test_partial_f32_overflow_parity(make_batch):
+    """Sums overflowing f32 range: both strategies end at ±inf (the f32
+    accumulator's honest answer), never NaN."""
+    t0 = 1_700_000_000_000
+    n = 64
+    ts = np.arange(t0, t0 + n, dtype=np.int64)
+    names = np.array(["a"] * n, dtype=object)
+    vals = np.full(n, 1e38)
+    tail = make_batch(
+        np.arange(t0 + 2000, t0 + 2064, dtype=np.int64),
+        np.array(["a"] * 64, dtype=object),
+        np.ones(64),
+    )
+    batches = [make_batch(ts, names, vals), tail]
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    key = (t0 // 1000 * 1000, "a")
+    assert np.isinf(a[key]["sm"]) and a[key]["sm"] > 0
+    assert np.isinf(b[key]["sm"]) and b[key]["sm"] > 0
+
+
+def test_partial_nan_values_propagate(make_batch):
+    """NaN VALUES (valid, not null) must poison min/max identically on
+    every strategy — a plain `x < mn` in the native reducer would skip
+    them."""
+    t0 = 1_700_000_000_000
+    ts = np.arange(t0, t0 + 400, dtype=np.int64)
+    names = np.array(["a", "b"] * 200, dtype=object)
+    vals = np.ones(400)
+    vals[7] = np.nan  # lands in key 'b'
+    tail = make_batch(
+        np.arange(t0 + 2000, t0 + 2100, dtype=np.int64),
+        np.array(["a"] * 100, dtype=object),
+        np.ones(100),
+    )
+    batches = [make_batch(ts, names, vals), tail]
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    key = (t0 // 1000 * 1000, "b")
+    assert np.isnan(a[key]["mn"]) and np.isnan(a[key]["mx"])
+    assert np.isnan(b[key]["mn"]) and np.isnan(b[key]["mx"])
+
+
+def test_partial_numpy_fallback_matches_native(make_batch, monkeypatch):
+    from denormalized_tpu.ops import host_partial
+
+    batches = _sensor_batches(make_batch, nulls=True)
+    a = _run(batches, _std_aggs, 500, 200, strategy="partial_merge")
+    monkeypatch.setattr(host_partial, "_LIB", None)
+    monkeypatch.setattr(host_partial, "_LIB_TRIED", True)
+    b = _run(batches, _std_aggs, 500, 200, strategy="partial_merge")
+    _assert_parity(a, b, rtol=1e-12)
+
+
+def test_partial_checkpoint_kill_restore(make_batch, tmp_path):
+    """Kill/restore through the shared protocol driver with the
+    partial_merge backend: the barrier snapshot must include host-striped
+    rows (flush-before-snapshot), and run B resumes to golden."""
+    from test_checkpoint import _kill_restore_roundtrip
+
+    rng = np.random.default_rng(77)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(12):
+        n = 200
+        ts = np.sort(t0 + b * 400 + rng.integers(0, 400, n))
+        keys = np.array([f"s{i}" for i in rng.integers(0, 7, n)], dtype=object)
+        batches.append(make_batch(ts, keys, rng.normal(50, 5, n)))
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+            device_strategy="partial_merge",
+            emit_lag_ms=0,  # prompt emission: the driver commits a barrier
+            # between mid-stream emissions
+        )
+
+    golden, a, b = _kill_restore_roundtrip(
+        batches, make_cfg, str(tmp_path / "state_pm")
+    )
+    combined = dict(a)
+    combined.update(b)
+    assert set(combined) == set(golden)
+    # stripe boundaries differ across the restore, so f32 merge order (and
+    # the last rounded digit of sums) may differ — counts stay exact
+    for k, (cnt, sm, av) in golden.items():
+        gc, gs, ga = combined[k]
+        assert gc == cnt, (k, gc, cnt)
+        assert gs == pytest.approx(sm, rel=1e-5)
+        assert ga == pytest.approx(av, rel=1e-5)
+    assert len(b) < len(golden) or len(a) == 0
