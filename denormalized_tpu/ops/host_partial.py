@@ -280,15 +280,20 @@ class HostPartialStripe:
                 # f32 accumulator is right for f32 state, but an f64
                 # accumulator would have held the value — refuse loudly
                 # rather than corrupt it
-                over = ~np.isfinite(hi) & np.isfinite(src)
-                if over.any():
-                    if self.spec.accum_dtype == sa.jnp.float64:
+                nonfin = ~np.isfinite(hi)
+                if nonfin.any():
+                    over = nonfin & np.isfinite(src)
+                    if over.any() and self.spec.accum_dtype == sa.jnp.float64:
                         raise OverflowError(
                             "partial_merge cannot transport f64 sums "
                             "beyond float32 range (~3.4e38); use "
                             "device_strategy='scatter' for this workload"
                         )
-                    lo[over] = 0.0
+                    # overflow (finite src) and genuine ±inf/NaN sums both
+                    # leave lo meaningless (inf - inf = NaN): zero it so
+                    # the device fold yields ±inf/NaN parity with the
+                    # scatter path instead of poisoning cells with NaN
+                    lo[nonfin] = 0.0
                 rows.append(hi.view(np.int32))
                 rows.append(lo.view(np.int32))
             else:

@@ -330,17 +330,39 @@ def merge_partials(
     L-(k-1)S edge) excluded from the oldest window.  Compensated mode
     routes lo into the 'sumc' buffer — one rounding per merge per cell
     instead of one per row."""
+    return merge_partials_body(
+        spec, SUB, a_pad, state, packed, spec.group_capacity,
+        jnp.asarray(0, jnp.int32),
+    )
+
+
+def merge_partials_body(
+    spec: WindowKernelSpec,
+    SUB: int,
+    a_pad: int,
+    state: dict[str, jax.Array],
+    packed: jax.Array,
+    G_total: int,
+    g_shift,
+) -> dict[str, jax.Array]:
+    """Shared fold: ``state`` holds the contiguous group slice
+    ``[g_shift, g_shift + cap)`` of a ``G_total``-wide group space (single
+    device: the whole space, shift 0; key-sharded mesh: one shard per
+    device, shift = axis_index * G_local)."""
     W = spec.window_slots
-    G = spec.group_capacity
     idx = packed[0, :a_pad]
     u_base_rel = packed[0, a_pad]
     base_mod = packed[0, a_pad + 1]
     valid = idx >= 0
     safe = jnp.maximum(idx, 0)
-    g = safe % G
-    us = safe // G
+    g_glob = safe % G_total
+    us = safe // G_total
     s = us % SUB
     u = us // SUB
+    cap = next(iter(state.values())).shape[1]
+    g = g_glob - g_shift
+    valid = valid & (g >= 0) & (g < cap)
+    g = jnp.clip(g, 0, cap - 1)
 
     def f32_plane(pi):
         return jax.lax.bitcast_convert_type(packed[pi, :a_pad], jnp.float32)
@@ -397,10 +419,9 @@ def _gather_and_reset(
     """Read ``n`` consecutive ring slots AND reset them in one program —
     one device round-trip per emission cycle instead of two per window.
 
-    ``g_bucket`` bounds the transferred group prefix: interner ids are
-    dense, so groups ≥ the live count hold only init values — fetching
-    ``[:, :g_bucket]`` instead of all G cuts the device→host volume when
-    capacity is padded well beyond the live cardinality."""
+    ``g_bucket`` is the transferred group width — the GLOBAL capacity for
+    sharded layouts (whose static spec carries only the per-device
+    shard), the spec capacity on a single device."""
     W = spec.window_slots
     slots = (first_slot + jnp.arange(n, dtype=jnp.int32)) % W
     out = {

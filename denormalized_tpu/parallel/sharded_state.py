@@ -84,11 +84,7 @@ class WindowStateBackend:
     # returns a handle; finish materializes it on host.  The default is
     # synchronous (start does the work); device backends override start to
     # return in-flight device arrays so the transfer overlaps ingest.
-    # ``n_groups`` (live interner size) lets device backends bound the
-    # transferred group prefix.
-    def read_reset_block_start(
-        self, first_slot: int, n: int, n_groups: int | None = None
-    ):
+    def read_reset_block_start(self, first_slot: int, n: int):
         return self.read_reset_block(first_slot, n)
 
     def read_reset_block_finish(self, handle) -> dict[str, "np.ndarray"]:
@@ -201,9 +197,7 @@ class SingleDeviceWindowState(WindowStateBackend):
             self.read_reset_block_start(first_slot, n)
         )
 
-    def read_reset_block_start(
-        self, first_slot: int, n: int, n_groups: int | None = None
-    ):
+    def read_reset_block_start(self, first_slot: int, n: int):
         """Dispatch the fused gather+reset and return the in-flight device
         arrays WITHOUT blocking — the device→host transfer overlaps
         whatever the host does next (typically accumulating the next
@@ -214,7 +208,7 @@ class SingleDeviceWindowState(WindowStateBackend):
         backend — determinism wins."""
         assert n <= self.spec.window_slots  # slots must be distinct
         self._state, out = sa._gather_and_reset(
-            self.spec, n, self.spec.group_capacity, self._state,
+            self.spec, n, self.group_capacity, self._state,
             jnp.asarray(first_slot, jnp.int32),
         )
         for arr in out.values():
@@ -231,45 +225,33 @@ class SingleDeviceWindowState(WindowStateBackend):
         self._state = sa.import_state(self.spec, host_state)
 
 
-class PartialMergeWindowState(SingleDeviceWindowState):
-    """Host edge-reduction + device merge (the ``partial_merge`` strategy).
-
-    Rows are reduced on the host into per-(slide-unit, sub, group) partials
-    (native C++ single-pass, ops/host_partial.py) and the device folds each
-    stripe into the HBM window ring with ONE transfer + ONE program — the
-    reference's Partial/Final operator split (planner/streaming_window.rs
-    :133-153) applied across the host↔accelerator boundary.  This is the
-    right layout whenever the host→device link is narrow relative to the
-    ingest rate: traffic scales with group cardinality × window span, not
-    row count.  Device state, emission, growth, and checkpointing are
-    identical to the scatter path."""
+class _HostPartialMixin:
+    """Shared host-stripe machinery for partial_merge backends: batch
+    chunk-folding, flush orchestration, and merge-program prewarming.
+    Concrete classes provide ``_merge(packed, a_pad)``."""
 
     accumulates_host = True
 
-    def __init__(self, spec: sa.WindowKernelSpec):
-        super().__init__(spec, "scatter")
+    def _init_host_partial(self, stripe_group_capacity: int) -> None:
         from denormalized_tpu.ops.host_partial import HostPartialStripe
 
-        self._stripe = HostPartialStripe(spec, spec.group_capacity)
+        self._stripe = HostPartialStripe(self.spec, stripe_group_capacity)
         self._pending_base_mod = 0
         self.merges = 0
-        if not self._pallas_interpret:
+        if jax.default_backend() == "tpu":
             # pre-compile every merge bucket with a no-op (all-padding)
             # stripe: which bucket a flush lands in depends on runtime
             # pacing, and an unseen size mid-stream is a multi-second
             # compile on a remote-compile backend
             n_planes = sum(
                 2 if c.kind == "sum" else 1
-                for c in spec.components
+                for c in self.spec.components
                 if c.kind != "sumc"
             )
             for a_pad in self._stripe.transfer_buckets():
                 noop = np.zeros((n_planes + 1, a_pad + 2), np.int32)
                 noop[0, :a_pad] = -1
-                self._state = sa.merge_partials(
-                    spec, self._stripe.SUB, a_pad, self._state,
-                    jnp.asarray(noop),
-                )
+                self._merge(noop, a_pad)
 
     @property
     def pending_rows(self) -> int:
@@ -289,11 +271,9 @@ class PartialMergeWindowState(SingleDeviceWindowState):
         stripe can hold (catch-up reads, giant arrival batches) is folded
         in unit-range chunks with a merge between them — the partial-path
         equivalent of the scatter path's W growth."""
-        import numpy as _np
-
-        units_rel = _np.asarray(units_rel, _np.int64)
+        units_rel = np.asarray(units_rel, np.int64)
         remaining = (
-            _np.ones(len(units_rel), bool) if keep is None else keep.copy()
+            np.ones(len(units_rel), bool) if keep is None else keep.copy()
         )
         stripe = self._stripe
         # units a stripe may span: both the U_MAX ring and the transfer
@@ -337,10 +317,32 @@ class PartialMergeWindowState(SingleDeviceWindowState):
         if taken is None:
             return
         packed, a_pad, _u_base = taken
-        self._state = sa.merge_partials(
-            self.spec, self._stripe.SUB, a_pad, self._state, jnp.asarray(packed)
-        )
+        self._merge(packed, a_pad)
         self.merges += 1
+
+
+class PartialMergeWindowState(_HostPartialMixin, SingleDeviceWindowState):
+    """Host edge-reduction + device merge (the ``partial_merge`` strategy).
+
+    Rows are reduced on the host into per-(slide-unit, sub, group) partials
+    (native C++ single-pass, ops/host_partial.py) and the device folds each
+    stripe into the HBM window ring with ONE transfer + ONE program — the
+    reference's Partial/Final operator split (planner/streaming_window.rs
+    :133-153) applied across the host↔accelerator boundary.  This is the
+    right layout whenever the host→device link is narrow relative to the
+    ingest rate: traffic scales with group cardinality × window span, not
+    row count.  Device state, emission, growth, and checkpointing are
+    identical to the scatter path."""
+
+    def __init__(self, spec: sa.WindowKernelSpec):
+        super().__init__(spec, "scatter")
+        self._init_host_partial(spec.group_capacity)
+
+    def _merge(self, packed: np.ndarray, a_pad: int) -> None:
+        self._state = sa.merge_partials(
+            self.spec, self._stripe.SUB, a_pad, self._state,
+            jnp.asarray(packed),
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -473,6 +475,60 @@ class KeyShardedWindowState(WindowStateBackend):
             self._state[c.label] = jax.device_put(
                 jnp.asarray(buf), self._sharding
             )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+def _key_sharded_merge_partials(
+    spec: sa.WindowKernelSpec,  # LOCAL spec (G_local per device)
+    mesh: Mesh,
+    SUB: int,
+    a_pad: int,
+    state,
+    packed,
+):
+    """Sharded fold of one host-partial stripe: the packed matrix is
+    replicated over ICI and every device folds only the cells whose group
+    id lands in its block — the hash-exchange analog for partials (no
+    collective needed; the "exchange" rides the input broadcast)."""
+    G_local = spec.group_capacity
+    n = mesh.devices.size
+
+    def body(state_l, packed_l):
+        shift = jax.lax.axis_index(KEY_AXIS) * G_local
+        return sa.merge_partials_body(
+            spec, SUB, a_pad, state_l, packed_l, G_local * n, shift
+        )
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=({c.label: P(None, KEY_AXIS) for c in spec.components}, P()),
+        out_specs={c.label: P(None, KEY_AXIS) for c in spec.components},
+    )(state, packed)
+
+
+class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState):
+    """partial_merge over a device mesh: host stripes cover the GLOBAL
+    group space; each device merges its own group block from the
+    replicated packed stripe.  Emission gathers/reset via a fused global
+    program (GSPMD partitions it over the same sharding)."""
+
+    def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
+        super().__init__(spec, mesh)
+        # stripe spans the GLOBAL group space
+        self._init_host_partial(self.group_capacity)
+
+    def _merge(self, packed: np.ndarray, a_pad: int) -> None:
+        self._state = _key_sharded_merge_partials(
+            self.spec, self.mesh, self._stripe.SUB, a_pad, self._state,
+            jnp.asarray(packed),
+        )
+
+    # fused async gather+reset: identical machinery to the single-device
+    # backend (self.group_capacity is the global width here)
+    read_reset_block = SingleDeviceWindowState.read_reset_block
+    read_reset_block_start = SingleDeviceWindowState.read_reset_block_start
+    read_reset_block_finish = SingleDeviceWindowState.read_reset_block_finish
 
 
 # ---------------------------------------------------------------------------
@@ -681,11 +737,10 @@ def make_sharded_state(
             return PartialMergeWindowState(spec)
         return SingleDeviceWindowState(spec, device_strategy)
     if device_strategy == "partial_merge":
-        raise ValueError(
-            "device_strategy='partial_merge' is single-device for now; on a "
-            "mesh use shard_strategy key_sharded/partial_final (row "
-            "shipping) or run without a mesh"
-        )
+        # host partials imply the Partial/Final split already happened on
+        # the host, so the mesh's job is holding the (large) group space:
+        # the key-sharded layout is the only one that makes sense here
+        return KeyShardedPartialMergeWindowState(spec, mesh)
     if strategy == "auto":
         strategy = (
             "partial_final" if spec.group_capacity <= 4096 else "key_sharded"

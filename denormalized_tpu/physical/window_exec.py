@@ -337,10 +337,16 @@ class StreamingWindowExec(ExecOperator):
             gid = np.zeros(n, dtype=np.int32)
         self._ensure_capacity(int(win_rel64.max()))
 
-        # value matrix + per-column validity (f64 until the device cast —
-        # the partial_merge path accumulates in f64 on host)
+        # value matrix + per-column validity: f64 only when the backend
+        # accumulates on host (partial_merge keeps f64 precision); the
+        # row-shipping paths fill f32 directly — no second full-matrix copy
         V = self._spec.num_value_cols
-        values64 = np.zeros((n, max(V, 1)), dtype=np.float64)
+        values64 = np.zeros(
+            (n, max(V, 1)),
+            dtype=np.float64
+            if self._backend.accumulates_host
+            else np.float32,
+        )
         colvalid = np.ones((n, max(V, 1)), dtype=bool)
         any_invalid = False
         from denormalized_tpu.logical.expr import column_validity
@@ -407,7 +413,7 @@ class StreamingWindowExec(ExecOperator):
             )
             self._metrics["host_prep_s"] += time.perf_counter() - t0
         else:
-            values = values64.astype(np.float32)
+            values = values64  # already f32 (see allocation above)
             win_rel = np.clip(
                 win_rel64, -1, self._spec.window_slots
             ).astype(np.int32)
@@ -520,9 +526,7 @@ class StreamingWindowExec(ExecOperator):
             n = 1 << min(3, (n_close).bit_length() - 1)
             n = min(n, self._spec.window_slots)
             handle = self._backend.read_reset_block_start(
-                self._first_open % self._spec.window_slots,
-                n,
-                len(self._interner) if self._grouped else 1,
+                self._first_open % self._spec.window_slots, n
             )
             self._pending_emit.append((self._first_open, n, handle))
             self._first_open += n

@@ -220,6 +220,28 @@ def test_partial_f32_overflow_parity(make_batch):
     assert np.isinf(b[key]["sm"]) and b[key]["sm"] > 0
 
 
+def test_partial_inf_values_propagate(make_batch):
+    """Genuine ±inf inputs: sum must stay ±inf (as scatter yields), not
+    NaN from the (hi, lo) split's inf - inf residual."""
+    t0 = 1_700_000_000_000
+    n = 32
+    ts = np.arange(t0, t0 + n, dtype=np.int64)
+    names = np.array(["a"] * n, dtype=object)
+    vals = np.ones(n)
+    vals[3] = np.inf
+    tail = make_batch(
+        np.arange(t0 + 2000, t0 + 2032, dtype=np.int64),
+        np.array(["a"] * 32, dtype=object),
+        np.ones(32),
+    )
+    batches = [make_batch(ts, names, vals), tail]
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    key = (t0 // 1000 * 1000, "a")
+    assert np.isinf(a[key]["sm"]) and a[key]["sm"] > 0
+    assert np.isinf(b[key]["sm"]) and b[key]["sm"] > 0
+
+
 def test_partial_nan_values_propagate(make_batch):
     """NaN VALUES (valid, not null) must poison min/max identically on
     every strategy — a plain `x < mn` in the native reducer would skip
@@ -251,6 +273,50 @@ def test_partial_numpy_fallback_matches_native(make_batch, monkeypatch):
     monkeypatch.setattr(host_partial, "_LIB_TRIED", True)
     b = _run(batches, _std_aggs, 500, 200, strategy="partial_merge")
     _assert_parity(a, b, rtol=1e-12)
+
+
+def test_partial_merge_key_sharded_mesh(make_batch):
+    """partial_merge over an 8-device mesh (G-sharded merge under
+    shard_map) must match the single-device scatter path exactly in
+    shape and near-exactly in values."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device platform")
+    rng = np.random.default_rng(23)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(20):
+        n = 768
+        ts = np.sort(t0 + b * 300 + rng.integers(0, 300, n))
+        # cardinality ramps past the 8-device initial capacity (1024) so
+        # growth re-lays the sharded state mid-stream
+        hi = 100 + b * 80
+        keys = np.array(
+            [f"s{i}" for i in rng.integers(0, hi, n)], dtype=object
+        )
+        batches.append(make_batch(ts, keys, rng.normal(50, 5, n)))
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(
+        batches, _std_aggs, 1000, strategy="partial_merge",
+        cfg_extra={"mesh_devices": 8},
+    )
+    assert len({k[1] for k in a}) > 1024  # grew past the initial capacity
+    _assert_parity(a, b)
+
+
+def test_partial_merge_key_sharded_sliding(make_batch):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device platform")
+    batches = _sensor_batches(make_batch, n_batches=20)
+    a = _run(batches, _std_aggs, 500, 200, strategy="scatter")
+    b = _run(
+        batches, _std_aggs, 500, 200, strategy="partial_merge",
+        cfg_extra={"mesh_devices": 8},
+    )
+    _assert_parity(a, b)
 
 
 def test_partial_checkpoint_kill_restore(make_batch, tmp_path):

@@ -5,8 +5,8 @@ Runs bench.py in subprocesses (so each config gets a fresh backend and a
 wedged tunnel can never hang this process) across:
 
     config    × {simple, sliding, highcard, join, checkpoint}
-    strategy  × {scatter, pallas_dense}
-    emission  × {full, compacted}
+    strategy  × {scatter, pallas_dense, partial_merge}
+    emission  × {full} (+ compacted via --compaction)
 
 and writes one JSON report with rows/s, vs_baseline, and p50/p99 window
 latency per cell — the VERDICT round-1 ask ("A/B scatter vs pallas_dense on
@@ -32,8 +32,8 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 CONFIGS = ["simple", "sliding", "highcard", "join", "checkpoint"]
-STRATEGIES = ["scatter", "pallas_dense"]
-COMPACTION = [False, True]
+STRATEGIES = ["scatter", "pallas_dense", "partial_merge"]
+COMPACTION = [False]  # emission compaction: add True via --compaction
 
 
 def run_cell(config, strategy, compaction, rows, lat_rows):
@@ -90,7 +90,17 @@ def main():
         "--configs", default=",".join(CONFIGS),
         help="comma-separated subset",
     )
+    ap.add_argument(
+        "--strategies", default=",".join(STRATEGIES),
+        help="comma-separated subset",
+    )
+    ap.add_argument(
+        "--compaction", action="store_true",
+        help="also run emission-compaction=on cells",
+    )
     args = ap.parse_args()
+    strategies = args.strategies.split(",")
+    compaction = [False, True] if args.compaction else [False]
 
     # probe ONCE and pin the result for every cell: per-cell probes would
     # stack abandoned probe processes against the single-client tunnel
@@ -103,15 +113,15 @@ def main():
 
     cells = []
     for config in args.configs.split(","):
-        for strategy in STRATEGIES:
-            for compaction in COMPACTION:
+        for strategy in strategies:
+            for compact in compaction:
                 print(
                     f"== {config} / {strategy} / "
-                    f"compaction={'on' if compaction else 'off'} ==",
+                    f"compaction={'on' if compact else 'off'} ==",
                     flush=True,
                 )
                 cell = run_cell(
-                    config, strategy, compaction, args.rows, args.lat_rows
+                    config, strategy, compact, args.rows, args.lat_rows
                 )
                 print(
                     f"   rc={cell['rc']} device={cell.get('device')} "
