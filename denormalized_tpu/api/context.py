@@ -87,6 +87,12 @@ class EngineConfig:
     # rows per component.  Wins when emitted windows are sparse vs the
     # padded capacity; default off pending real-chip A/B.
     emission_compaction: bool = False
+    # persistent XLA compilation cache (jax_compilation_cache_dir): the
+    # engine prewarms its program ladders at stream start, which on a
+    # remote-compile TPU backend costs seconds per program on FIRST run;
+    # with the cache every later process start loads compiled binaries
+    # from disk instead.  None disables; default under ~/.cache.
+    compilation_cache_dir: str | None = "~/.cache/denormalized_tpu/xla"
 
     def set(self, key: str, value) -> "EngineConfig":
         """String-keyed setter for parity with SessionConfig::set
@@ -98,6 +104,38 @@ class EngineConfig:
         return self
 
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache(path: str | None) -> None:
+    """Point JAX's persistent compilation cache at ``path`` (once per
+    process).  A user-set ``JAX_COMPILATION_CACHE_DIR`` or an earlier
+    explicit configuration wins; failures are non-fatal (a read-only HOME
+    must not kill the stream — it just recompiles)."""
+    global _cache_enabled
+    if path is None or _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            return
+        full = os.path.expanduser(path)
+        os.makedirs(full, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", full)
+        # cache even fast compiles: the ladder programs are individually
+        # cheap to compile locally but each costs a round-trip on a
+        # remote-compile backend
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
 class Context:
     """Session factory: registers sources, builds streams."""
 
@@ -105,6 +143,7 @@ class Context:
         self.config = config or EngineConfig()
         self._tables: dict[str, Source] = {}
         self._orchestrator = None
+        _enable_compilation_cache(self.config.compilation_cache_dir)
 
     def __repr__(self) -> str:
         """String representation (reference context.py:16-30)."""

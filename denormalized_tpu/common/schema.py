@@ -94,6 +94,20 @@ class Field:
     # for STRUCT fields: child fields
     children: tuple["Field", ...] = ()
 
+    def __post_init__(self):
+        # accept the enum's string value ("int64", "string", …) — failing
+        # here with the valid names beats an AttributeError deep in an
+        # operator long after schema construction
+        if isinstance(self.dtype, str):
+            try:
+                object.__setattr__(self, "dtype", DataType(self.dtype))
+            except ValueError:
+                raise ValueError(
+                    f"unknown dtype {self.dtype!r} for field "
+                    f"{self.name!r}; expected one of "
+                    f"{[d.value for d in DataType]}"
+                ) from None
+
     def __repr__(self) -> str:
         if self.dtype is DataType.STRUCT:
             return f"Field({self.name}: struct<{', '.join(map(repr, self.children))}>)"

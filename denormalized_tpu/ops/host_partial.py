@@ -89,6 +89,11 @@ class HostPartialStripe:
         self.u_base: int | None = None
         self.u_hi = 0  # highest stripe-relative unit written (span - 1)
         self.rows = 0
+        # True once ANY value column in this stripe had a null: decides
+        # between the lean packed layout (per-column count planes aliased
+        # to the row-count plane — valid because no-null means they are
+        # equal) and the full layout
+        self.nulls_seen = False
         self._alloc()
 
     def _alloc(self):
@@ -122,6 +127,8 @@ class HostPartialStripe:
             n = len(units)
             if n == 0:
                 return
+        if colvalid is not None and not self.nulls_seen and not colvalid.all():
+            self.nulls_seen = True
         if self.u_base is None:
             self.u_base = int(units.min())
         rel = (units - self.u_base).astype(np.int64)
@@ -234,11 +241,15 @@ class HostPartialStripe:
         out = sorted({1024, max(1024, bound // 4), max(1024, bound // 2), bound})
         return out
 
-    def take_packed(self, base_mod: int) -> tuple[np.ndarray, int, int] | None:
+    def take_packed(
+        self, base_mod: int
+    ) -> tuple[np.ndarray, int, int, bool] | None:
         """Compact the stripe into the single int32 matrix the device
         merge op consumes, then reset.
 
-        Returns ``(packed, a_pad, u_base)`` or None when empty.  ``packed``
+        Returns ``(packed, a_pad, u_base, lean)`` or None when empty —
+        ``lean`` says per-column count planes were omitted (null-free
+        stripe; the device merge aliases them to the row-count plane).  ``packed``
         is ``(P + 1, a_pad + 2)`` **int32** — an int32 carrier is immune to
         jnp's x64-off canonicalization, which would silently round an f64
         matrix to f32 and corrupt cell indices beyond 2^24.  Row 0 holds
@@ -255,6 +266,10 @@ class HostPartialStripe:
         used = self.u_hi + 1
         active = np.flatnonzero(self.row_cnt[:used].reshape(-1) > 0)
         A = len(active)
+        # lean layout: a null-free stripe's per-column counts equal the
+        # row count cell-for-cell, so their planes need not cross the
+        # link — the device merge aliases them to plane 1 (row count)
+        lean = not self.nulls_seen and sa.lean_possible(self.spec)
         # smallest member of the FIXED bucket set that covers A (see
         # transfer_buckets — all merge programs precompiled); the backend's
         # chunking keeps A within the largest bucket, but never crash the
@@ -266,6 +281,8 @@ class HostPartialStripe:
         rows: list[np.ndarray] = []
         for c in self.spec.components:
             if c.kind == "sumc":
+                continue
+            if lean and sa.lean_skippable(c):
                 continue
             src = self._component_plane(c)[:used].reshape(-1)[active]
             if c.kind == "sum":
@@ -313,5 +330,14 @@ class HostPartialStripe:
         self.u_base = None
         self.u_hi = 0
         self.rows = 0
-        self._alloc()
-        return packed, a_pad, u_base
+        # reset in place, touching only the unit rows this stripe used:
+        # re-zeroing the full (V, U_MAX, SUB, G) planes costs ~100ms per
+        # flush at 100K-key cardinality, while a stripe typically spans
+        # 1-2 slide units
+        self.row_cnt[:used] = 0
+        self.cnt[:, :used] = 0
+        self.sum[:, :used] = 0.0
+        self.mn[:, :used] = np.inf
+        self.mx[:, :used] = -np.inf
+        self.nulls_seen = False
+        return packed, a_pad, u_base, lean

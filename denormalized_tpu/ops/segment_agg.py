@@ -308,11 +308,12 @@ update_state = functools.partial(jax.jit, static_argnums=0, donate_argnums=1)(
 )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=3)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
 def merge_partials(
     spec: WindowKernelSpec,
     SUB: int,
     a_pad: int,
+    lean: bool,
     state: dict[str, jax.Array],
     packed: jax.Array,  # (P+1, a_pad+2) int32, HostPartialStripe.take_packed
 ) -> dict[str, jax.Array]:
@@ -332,8 +333,22 @@ def merge_partials(
     instead of one per row."""
     return merge_partials_body(
         spec, SUB, a_pad, state, packed, spec.group_capacity,
-        jnp.asarray(0, jnp.int32),
+        jnp.asarray(0, jnp.int32), lean,
     )
+
+
+def lean_skippable(c: AggComponent) -> bool:
+    """Whether ``c``'s plane is omitted from the LEAN packed/gather layouts
+    and aliased to plane 1 (row count).  Single source of truth: the host
+    packing (host_partial.take_packed), the device merge unpack, the
+    emission gather, and the prewarm plane count must all agree on this
+    predicate or plane indices silently shift."""
+    return c.kind == "count" and c.col is not None
+
+
+def lean_possible(spec: WindowKernelSpec) -> bool:
+    """Whether the lean layout differs from the full one for this spec."""
+    return any(lean_skippable(c) for c in spec.components)
 
 
 def merge_partials_body(
@@ -344,11 +359,17 @@ def merge_partials_body(
     packed: jax.Array,
     G_total: int,
     g_shift,
+    lean: bool = False,
 ) -> dict[str, jax.Array]:
     """Shared fold: ``state`` holds the contiguous group slice
     ``[g_shift, g_shift + cap)`` of a ``G_total``-wide group space (single
     device: the whole space, shift 0; key-sharded mesh: one shard per
-    device, shift = axis_index * G_local)."""
+    device, shift = axis_index * G_local).
+
+    ``lean`` selects the null-free packed layout: per-column count planes
+    are omitted from ``packed`` and aliased to plane 1 (row count) — a
+    null-free stripe's per-column counts equal its row counts
+    cell-for-cell (host_partial.take_packed)."""
     W = spec.window_slots
     idx = packed[0, :a_pad]
     u_base_rel = packed[0, a_pad]
@@ -397,8 +418,11 @@ def merge_partials_body(
                     ].add(lo, mode="drop")
                 pi += 2
                 continue
-            pv = f32_plane(pi)
-            pi += 1
+            if lean and lean_skippable(comp):
+                pv = f32_plane(1)  # alias the row-count plane
+            else:
+                pv = f32_plane(pi)
+                pi += 1
             if comp.kind == "count":
                 state[comp.label] = at.add(pv.astype(buf.dtype), mode="drop")
             elif comp.kind == "min":
@@ -408,24 +432,30 @@ def merge_partials_body(
     return state
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=3)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 5), donate_argnums=3)
 def _gather_and_reset(
     spec: WindowKernelSpec,
     n: int,
     g_bucket: int,
     state: dict[str, jax.Array],
     first_slot,
+    lean: bool = False,
 ):
     """Read ``n`` consecutive ring slots AND reset them in one program —
     one device round-trip per emission cycle instead of two per window.
 
     ``g_bucket`` is the transferred group width — the GLOBAL capacity for
     sharded layouts (whose static spec carries only the per-device
-    shard), the spec capacity on a single device."""
+    shard), the spec capacity on a single device.  ``lean`` omits
+    per-column count planes from the transfer (they equal the row-count
+    plane when the stream has never carried a null; the host aliases
+    them back)."""
     W = spec.window_slots
     slots = (first_slot + jnp.arange(n, dtype=jnp.int32)) % W
     out = {
-        c.label: state[c.label][slots, :g_bucket] for c in spec.components
+        c.label: state[c.label][slots, :g_bucket]
+        for c in spec.components
+        if not (lean and lean_skippable(c))
     }
     for c in spec.components:
         # only the transferred prefix needs resetting: cells beyond the

@@ -84,7 +84,9 @@ class WindowStateBackend:
     # returns a handle; finish materializes it on host.  The default is
     # synchronous (start does the work); device backends override start to
     # return in-flight device arrays so the transfer overlaps ingest.
-    def read_reset_block_start(self, first_slot: int, n: int):
+    def read_reset_block_start(
+        self, first_slot: int, n: int, live_groups=None, lean=False
+    ):
         return self.read_reset_block(first_slot, n)
 
     def read_reset_block_finish(self, handle) -> dict[str, "np.ndarray"]:
@@ -117,17 +119,27 @@ class SingleDeviceWindowState(WindowStateBackend):
         self.device_strategy = device_strategy
         self._pallas_interpret = jax.default_backend() != "tpu"
         if not self._pallas_interpret:
-            # pre-compile every emission block-size bucket: which n the
-            # trigger uses depends on runtime pacing, and an unseen bucket
-            # compiling mid-stream costs seconds on a remote-compile TPU
-            # backend.  Running them on the freshly-initialized state is a
-            # no-op (slots are already at init values).
+            # pre-compile emission gather programs for the block sizes and
+            # group buckets the trigger will actually request: an unseen
+            # (n, g_bucket, lean) tuple compiling mid-stream costs seconds
+            # on a remote-compile TPU backend.  Running them on the
+            # freshly-initialized state is a no-op (slots are already at
+            # init values).  The runtime bucket is pow2(live groups),
+            # floor 1024, cap G — warm the two endpoints; a pow2 crossing
+            # in between pays a one-off compile (and hits the persistent
+            # XLA cache on any later run).  Both layout variants are
+            # warmed when they differ: a stream flips lean→full on its
+            # first null, and a restored stream starts full.
+            variants = {False, sa.lean_possible(spec)}
             for n in (1, 2, 4, 8):
                 if n <= spec.window_slots:
-                    self._state, _ = sa._gather_and_reset(
-                        spec, n, spec.group_capacity, self._state,
-                        jnp.asarray(0, jnp.int32),
-                    )
+                    for g_bucket in {min(1024, spec.group_capacity),
+                                     spec.group_capacity}:
+                        for lean in variants:
+                            self._state, _ = sa._gather_and_reset(
+                                spec, n, g_bucket, self._state,
+                                jnp.asarray(0, jnp.int32), lean,
+                            )
 
     @property
     def group_capacity(self) -> int:
@@ -197,19 +209,34 @@ class SingleDeviceWindowState(WindowStateBackend):
             self.read_reset_block_start(first_slot, n)
         )
 
-    def read_reset_block_start(self, first_slot: int, n: int):
+    def read_reset_block_start(
+        self, first_slot: int, n: int, live_groups=None, lean=False
+    ):
         """Dispatch the fused gather+reset and return the in-flight device
         arrays WITHOUT blocking — the device→host transfer overlaps
         whatever the host does next (typically accumulating the next
-        stripe).  Always full-G rows: a live-group-count bucket would save
-        transfer when capacity is padded far beyond cardinality, but every
-        (n, bucket) pair is its own compiled program and an unseen pair
-        mid-stream stalls the stream for seconds on a remote-compile
-        backend — determinism wins."""
+        stripe).
+
+        ``live_groups`` (the interner's current size) bounds the
+        transferred group width: gids are interner-dense, so every cell
+        at index ≥ live_groups is still at its init value and need not
+        cross the link.  The width is bucketed to a pow2 (floor 1024) so
+        the (n, bucket) program ladder stays ≤ log2(G/1024) entries per
+        block size — the bucket only grows when the interner crosses a
+        pow2 boundary, a one-off compile, while the transfer shrinks by
+        the full capacity/cardinality ratio (e.g. 2.6× at 100K keys in a
+        262K-capacity ring, and ~all of it when capacity is
+        over-provisioned)."""
         assert n <= self.spec.window_slots  # slots must be distinct
+        g_bucket = self.group_capacity
+        if live_groups is not None:
+            g_bucket = min(
+                g_bucket,
+                max(1024, 1 << max(0, int(live_groups) - 1).bit_length()),
+            )
         self._state, out = sa._gather_and_reset(
-            self.spec, n, self.group_capacity, self._state,
-            jnp.asarray(first_slot, jnp.int32),
+            self.spec, n, g_bucket, self._state,
+            jnp.asarray(first_slot, jnp.int32), lean,
         )
         for arr in out.values():
             arr.copy_to_host_async()
@@ -242,16 +269,23 @@ class _HostPartialMixin:
             # pre-compile every merge bucket with a no-op (all-padding)
             # stripe: which bucket a flush lands in depends on runtime
             # pacing, and an unseen size mid-stream is a multi-second
-            # compile on a remote-compile backend
-            n_planes = sum(
-                2 if c.kind == "sum" else 1
-                for c in self.spec.components
-                if c.kind != "sumc"
-            )
-            for a_pad in self._stripe.transfer_buckets():
-                noop = np.zeros((n_planes + 1, a_pad + 2), np.int32)
-                noop[0, :a_pad] = -1
-                self._merge(noop, a_pad)
+            # compile on a remote-compile backend.  Both packed layouts
+            # are warmed when the spec has per-column counts: lean (the
+            # null-free steady state) and full (the moment a null shows
+            # up).
+            variants = [False]
+            if sa.lean_possible(self.spec):
+                variants.append(True)
+            for lean in variants:
+                n_planes = sum(
+                    2 if c.kind == "sum" else 1
+                    for c in self.spec.components
+                    if c.kind != "sumc" and not (lean and sa.lean_skippable(c))
+                )
+                for a_pad in self._stripe.transfer_buckets():
+                    noop = np.zeros((n_planes + 1, a_pad + 2), np.int32)
+                    noop[0, :a_pad] = -1
+                    self._merge(noop, a_pad, lean)
 
     @property
     def pending_rows(self) -> int:
@@ -316,8 +350,8 @@ class _HostPartialMixin:
         taken = self._stripe.take_packed(self._pending_base_mod)
         if taken is None:
             return
-        packed, a_pad, _u_base = taken
-        self._merge(packed, a_pad)
+        packed, a_pad, _u_base, lean = taken
+        self._merge(packed, a_pad, lean)
         self.merges += 1
 
 
@@ -338,9 +372,9 @@ class PartialMergeWindowState(_HostPartialMixin, SingleDeviceWindowState):
         super().__init__(spec, "scatter")
         self._init_host_partial(spec.group_capacity)
 
-    def _merge(self, packed: np.ndarray, a_pad: int) -> None:
+    def _merge(self, packed: np.ndarray, a_pad: int, lean: bool = False) -> None:
         self._state = sa.merge_partials(
-            self.spec, self._stripe.SUB, a_pad, self._state,
+            self.spec, self._stripe.SUB, a_pad, lean, self._state,
             jnp.asarray(packed),
         )
 
@@ -477,12 +511,13 @@ class KeyShardedWindowState(WindowStateBackend):
             )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=5)
 def _key_sharded_merge_partials(
     spec: sa.WindowKernelSpec,  # LOCAL spec (G_local per device)
     mesh: Mesh,
     SUB: int,
     a_pad: int,
+    lean: bool,
     state,
     packed,
 ):
@@ -496,7 +531,7 @@ def _key_sharded_merge_partials(
     def body(state_l, packed_l):
         shift = jax.lax.axis_index(KEY_AXIS) * G_local
         return sa.merge_partials_body(
-            spec, SUB, a_pad, state_l, packed_l, G_local * n, shift
+            spec, SUB, a_pad, state_l, packed_l, G_local * n, shift, lean
         )
 
     return jax.shard_map(
@@ -518,9 +553,9 @@ class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState
         # stripe spans the GLOBAL group space
         self._init_host_partial(self.group_capacity)
 
-    def _merge(self, packed: np.ndarray, a_pad: int) -> None:
+    def _merge(self, packed: np.ndarray, a_pad: int, lean: bool = False) -> None:
         self._state = _key_sharded_merge_partials(
-            self.spec, self.mesh, self._stripe.SUB, a_pad, self._state,
+            self.spec, self.mesh, self._stripe.SUB, a_pad, lean, self._state,
             jnp.asarray(packed),
         )
 

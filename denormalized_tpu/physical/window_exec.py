@@ -203,6 +203,10 @@ class StreamingWindowExec(ExecOperator):
         self._first_open: int | None = None  # lowest non-emitted slide index
         self._max_win_seen: int = -1
         self._watermark_ms: int | None = None
+        # monotone: True once any value column carried a null.  While
+        # False, emission gathers skip per-column count planes (they equal
+        # the row-count plane) — see _gather_and_reset(lean=True)
+        self._any_nulls_seen = False
         # partial_merge flush/emission pacing: emission is deferred up to
         # emit_lag_s after a window becomes closable so replay-speed runs
         # batch several windows per device round-trip; paced (real-time)
@@ -382,6 +386,9 @@ class StreamingWindowExec(ExecOperator):
                     raw = raw * raw
             values64[:, j] = raw
 
+        if any_invalid:
+            self._any_nulls_seen = True
+
         if self._backend.accumulates_host:
             # partial_merge: reduce the batch on host; the device sees a
             # merged stripe later (flush on trigger/growth/snapshot).
@@ -475,6 +482,11 @@ class StreamingWindowExec(ExecOperator):
         ngroups = len(self._interner) if self._grouped else 1
         for j0, n, handle in pending:
             block = self._backend.read_reset_block_finish(handle)
+            # lean gathers omit per-column count planes (null-free stream:
+            # they equal the row-count plane) — alias them back
+            for c in self._spec.components:
+                if c.kind == "count" and c.label not in block:
+                    block[c.label] = block[sa.ROW_COUNT.label]
             for i in range(n):
                 rows = {label: arr[i] for label, arr in block.items()}
                 counts = rows[sa.ROW_COUNT.label]
@@ -526,7 +538,12 @@ class StreamingWindowExec(ExecOperator):
             n = 1 << min(3, (n_close).bit_length() - 1)
             n = min(n, self._spec.window_slots)
             handle = self._backend.read_reset_block_start(
-                self._first_open % self._spec.window_slots, n
+                self._first_open % self._spec.window_slots, n,
+                live_groups=len(self._interner) if self._grouped else 1,
+                # only when the lean layout actually differs — else the
+                # lean=True program would be a duplicate compilation of
+                # the full one
+                lean=not self._any_nulls_seen and sa.lean_possible(self._spec),
             )
             self._pending_emit.append((self._first_open, n, handle))
             self._first_open += n
@@ -639,6 +656,7 @@ class StreamingWindowExec(ExecOperator):
             # variance pivots: shifted sums are only comparable under the
             # same K, so K must survive restart with the state it shifted
             "var_shift": dict(self._var_shift),
+            "any_nulls_seen": self._any_nulls_seen,
         }
         coord.put_snapshot(key, epoch, pack_snapshot(meta, self._backend.export()))
 
@@ -670,6 +688,9 @@ class StreamingWindowExec(ExecOperator):
         self._first_open = meta["first_open"]
         self._max_win_seen = meta["max_win_seen"]
         self._watermark_ms = meta["watermark_ms"]
+        # restored state may hold counts < row counts (nulls before the
+        # kill); unless the snapshot says otherwise, stay on full gathers
+        self._any_nulls_seen = bool(meta.get("any_nulls_seen", True))
         self._var_shift = dict(meta.get("var_shift") or {})
         if self._grouped and meta["interner"] is not None:
             self._interner = GroupInterner.restore(meta["interner"])

@@ -110,6 +110,44 @@ def test_partial_with_nulls(make_batch):
     _assert_parity(a, b)
 
 
+def test_partial_lean_to_full_transition(make_batch):
+    """Null-free stripes ship the lean packed layout (per-column count
+    planes aliased to the row-count plane); the first null switches the
+    stripe to the full layout.  A stream whose nulls start mid-way must
+    exercise both layouts and still match scatter exactly — counts in the
+    null windows must reflect only valid rows."""
+    from denormalized_tpu.common.record_batch import RecordBatch
+
+    rng = np.random.default_rng(3)
+    clean = _sensor_batches(make_batch, n_batches=12, seed=3)
+    dirty = []
+    for b in _sensor_batches(make_batch, n_batches=12, seed=4):
+        # shift dirty batches after the clean ones in event time
+        ts = np.asarray(b.column("occurred_at_ms")) + 12 * 250
+        mask = rng.random(b.num_rows) > 0.2
+        dirty.append(
+            RecordBatch(b.schema, [ts, b.columns[1], b.columns[2]],
+                        [None, None, mask])
+        )
+    batches = clean + dirty
+    # oracle row counts per (window_start, key) INCLUDING null readings:
+    # proves the dirty half really carried nulls (cnt < rows somewhere)
+    rows_per_window: dict = {}
+    for bt in batches:
+        ts = np.asarray(bt.column("occurred_at_ms"))
+        names = np.asarray(bt.column("sensor_name"))
+        for t, nm in zip(ts, names):
+            rows_per_window[(int(t) // 1000 * 1000, nm)] = (
+                rows_per_window.get((int(t) // 1000 * 1000, nm), 0) + 1
+            )
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    _assert_parity(a, b)
+    assert any(
+        v["cnt"] < rows_per_window[k[0], k[1]] for k, v in a.items()
+    ), "no window lost rows to nulls — the full layout was never exercised"
+
+
 def test_partial_ungrouped(make_batch):
     batches = _sensor_batches(make_batch)
     a = _run(batches, _std_aggs, 1000, strategy="scatter", groups=[])
