@@ -158,6 +158,7 @@ def gen_batches(
 
 DEVICE_STRATEGY = os.environ.get("BENCH_DEVICE_STRATEGY", "auto")
 EMISSION_COMPACTION = os.environ.get("BENCH_EMISSION_COMPACTION", "0") == "1"
+HOST_PIPELINE = os.environ.get("BENCH_HOST_PIPELINE", "0") == "1"
 
 
 def _engine_ctx(batch_bucket=None, **over):
@@ -166,6 +167,7 @@ def _engine_ctx(batch_bucket=None, **over):
 
     over.setdefault("device_strategy", DEVICE_STRATEGY)
     over.setdefault("emission_compaction", EMISSION_COMPACTION)
+    over.setdefault("host_pipeline", HOST_PIPELINE)
     cfg = EngineConfig(
         min_batch_bucket=batch_bucket or BATCH_ROWS, min_window_slots=32, **over
     )

@@ -82,6 +82,12 @@ class EngineConfig:
     # per device round-trip (real-time feeds always exceed the lag)
     partial_merge_rows: int = 4_000_000
     emit_lag_ms: int = 200
+    # run backend.accumulate (native stripe reduction, GIL-releasing) on a
+    # worker thread so batch N's reduction overlaps batch N+1's
+    # decode/eval/intern.  Default OFF: on CPU JAX the worker contends
+    # with device programs for the same cores (measured 13-21% SLOWER);
+    # worth A/B-ing on a real chip where device work leaves the host idle
+    host_pipeline: bool = False
     # device-side emission compaction: permute active groups to the front on
     # device and transfer only a pow2 bucket covering them, instead of all G
     # rows per component.  Wins when emitted windows are sparse vs the

@@ -87,6 +87,7 @@ class StreamingWindowExec(ExecOperator):
         device_strategy: str = "scatter",
         partial_merge_rows: int = 4_000_000,
         emit_lag_ms: int = 200,
+        host_pipeline: bool = False,
         name: str = "window",
     ) -> None:
         if window_type is WindowType.SESSION:
@@ -207,6 +208,16 @@ class StreamingWindowExec(ExecOperator):
         # False, emission gathers skip per-column count planes (they equal
         # the row-count plane) — see _gather_and_reset(lean=True)
         self._any_nulls_seen = False
+        # host pipelining for accumulating backends: backend.accumulate
+        # (the native C++ stripe reduction — it releases the GIL) runs on
+        # a single worker thread so batch N's reduction overlaps batch
+        # N+1's eval/intern on the main thread.  The single worker keeps
+        # stripe mutation serialized; _join_acc() fences before any other
+        # backend access (flush/emission/export/growth)
+        self._host_pipeline = host_pipeline
+        self._acc_exec = None
+        self._acc_future = None
+        self._acc_error: BaseException | None = None
         # partial_merge flush/emission pacing: emission is deferred up to
         # emit_lag_s after a window becomes closable so replay-speed runs
         # batch several windows per device round-trip; paced (real-time)
@@ -257,6 +268,7 @@ class StreamingWindowExec(ExecOperator):
 
         # host-accumulated partials are bound to the old G/W layout —
         # merge them into device state before exporting it
+        self._join_acc()
         self._backend.flush_pending()
         host = self._backend.export()
         old = self._spec
@@ -407,9 +419,11 @@ class StreamingWindowExec(ExecOperator):
                     keep = None
             else:
                 keep = None
-            if self._backend.pending_rows == 0:
+            if (
+                self._acc_future is None or self._acc_future.done()
+            ) and self._backend.pending_rows == 0:
                 self._stripe_wall = time.perf_counter()
-            self._backend.accumulate(
+            acc_args = (
                 win_rel64,
                 rem,
                 gid,
@@ -418,6 +432,10 @@ class StreamingWindowExec(ExecOperator):
                 keep,
                 first % self._spec.window_slots,
             )
+            if self._host_pipeline:
+                self._submit_acc(*acc_args)
+            else:
+                self._backend.accumulate(*acc_args)
             self._metrics["host_prep_s"] += time.perf_counter() - t0
         else:
             values = values64  # already f32 (see allocation above)
@@ -465,6 +483,54 @@ class StreamingWindowExec(ExecOperator):
         if self._watermark_ms is None or bmin > self._watermark_ms:
             self._watermark_ms = bmin
         yield from self._trigger()
+
+    # -- host pipeline fence --------------------------------------------
+    def _join_acc(self) -> None:
+        """Wait for any in-flight host accumulation.  Every backend access
+        other than pacing reads (pending_rows) must fence through here —
+        the stripe and the device merge stream are only consistent between
+        worker tasks."""
+        f, self._acc_future = self._acc_future, None
+        err = None
+        if f is not None:
+            try:
+                f.result()  # re-raises a worker failure on this thread
+            finally:
+                # read the flag only AFTER the wait: an EARLIER task
+                # (future superseded by a later submission) may set it
+                # while we block on the latest one.  Clearing it here also
+                # prevents f's own failure from being raised a second time
+                # by a later, unrelated fence.
+                err, self._acc_error = self._acc_error, None
+        else:
+            err, self._acc_error = self._acc_error, None
+        if err is not None:
+            # a superseded task failed even though the latest one
+            # succeeded; the stream must not keep running on a
+            # half-updated stripe
+            raise err
+
+    def _submit_acc(self, *args) -> None:
+        if self._acc_error is not None:
+            err, self._acc_error = self._acc_error, None
+            raise err
+        if self._acc_exec is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._acc_exec = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"{self.name}-acc"
+            )
+
+        backend = self._backend
+
+        def run():
+            try:
+                backend.accumulate(*args)
+            except BaseException as e:  # surfaced via _join_acc/_submit_acc
+                self._acc_error = e
+                raise
+
+        self._acc_future = self._acc_exec.submit(run)
 
     # -- emission --------------------------------------------------------
     def _closable(self) -> int:
@@ -566,6 +632,7 @@ class StreamingWindowExec(ExecOperator):
 
     def _flush(self) -> None:
         # counters reconcile from backend.merges in metrics()
+        self._join_acc()
         self._backend.flush_pending()
 
     def _emit_window(self, j: int) -> RecordBatch | None:
@@ -697,6 +764,24 @@ class StreamingWindowExec(ExecOperator):
 
     # -- stream loop -----------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
+        try:
+            yield from self._run_inner()
+        finally:
+            self._shutdown_acc()
+
+    def _shutdown_acc(self) -> None:
+        """Stop the host-pipeline worker (if any).  Joins the in-flight
+        task so a failure in the stream's final batches still surfaces,
+        and releases the thread — one leaked worker per finished stream
+        otherwise."""
+        ex, self._acc_exec = self._acc_exec, None
+        if ex is not None:
+            try:
+                self._join_acc()
+            finally:
+                ex.shutdown(wait=True)
+
+    def _run_inner(self) -> Iterator[StreamItem]:
         from denormalized_tpu.runtime.tracing import span
 
         for item in self.input_op.run():
@@ -721,5 +806,9 @@ class StreamingWindowExec(ExecOperator):
                         if b is not None:
                             yield b
                     self._first_open = self._max_win_seen + 1
+                else:
+                    # no final flush ran — still fence the worker so an
+                    # async accumulate failure cannot be swallowed
+                    self._join_acc()
                 yield EOS
                 return

@@ -62,6 +62,7 @@ class Planner:
                     device_strategy=self.config.device_strategy,
                     partial_merge_rows=self.config.partial_merge_rows,
                     emit_lag_ms=self.config.emit_lag_ms,
+                    host_pipeline=self.config.host_pipeline,
                 )
             if node.window_type is lp.WindowType.SESSION:
                 # sessions handle builtin AND accumulator (UDAF/collection)

@@ -148,6 +148,41 @@ def test_partial_lean_to_full_transition(make_batch):
     ), "no window lost rows to nulls — the full layout was never exercised"
 
 
+def test_partial_host_pipeline_parity(make_batch):
+    """host_pipeline=True moves backend.accumulate onto a worker thread;
+    results must be identical to the synchronous path (same stream, same
+    windows), including across growth and null batches."""
+    batches = _sensor_batches(make_batch, keys=200, nulls=True)
+    a = _run(batches, _std_aggs, 1000, 250, strategy="partial_merge")
+    b = _run(batches, _std_aggs, 1000, 250, strategy="partial_merge",
+             cfg_extra={"host_pipeline": True})
+    _assert_parity(a, b)
+
+
+def test_partial_host_pipeline_error_propagates(make_batch):
+    """A failure inside the worker-threaded accumulate must surface on the
+    stream thread (not vanish into the pool)."""
+    from denormalized_tpu.parallel import sharded_state as ss
+
+    batches = _sensor_batches(make_batch, n_batches=8)
+    orig = ss._HostPartialMixin.accumulate
+    calls = {"n": 0}
+
+    def boom(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("injected stripe failure")
+        return orig(self, *a, **k)
+
+    ss._HostPartialMixin.accumulate = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected stripe failure"):
+            _run(batches, _std_aggs, 1000, strategy="partial_merge",
+                 cfg_extra={"host_pipeline": True})
+    finally:
+        ss._HostPartialMixin.accumulate = orig
+
+
 def test_partial_ungrouped(make_batch):
     batches = _sensor_batches(make_batch)
     a = _run(batches, _std_aggs, 1000, strategy="scatter", groups=[])
