@@ -259,6 +259,296 @@ def _ctx_for(config, batch_bucket=None, ckpt_dir=None, emit_on_close=True):
     return _engine_ctx(batch_bucket, emit_on_close=emit_on_close)
 
 
+# -- kafka end-to-end (broker → fetch → decode → intern → window) --------
+
+
+def _json_payloads(batches) -> list[bytes]:
+    """Vectorized emit_measurements JSON encode (np.char at C speed)."""
+    out: list[bytes] = []
+    for b in batches:
+        ts = np.asarray(b.column("occurred_at_ms")).astype("S20")
+        names = np.asarray(b.column("sensor_name"), dtype=object).astype("S64")
+        vals = np.round(np.asarray(b.column("reading")), 6).astype("S32")
+        s = np.char.add(b'{"occurred_at_ms":', ts)
+        s = np.char.add(s, b',"sensor_name":"')
+        s = np.char.add(s, names)
+        s = np.char.add(s, b'","reading":')
+        s = np.char.add(s, vals)
+        s = np.char.add(s, b"}")
+        out.extend(s.tolist())
+    return out
+
+
+def _e2e_schema():
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    return Schema(
+        [
+            Field("occurred_at_ms", DataType.INT64, nullable=False),
+            Field("sensor_name", DataType.STRING, nullable=False),
+            Field("reading", DataType.FLOAT64),
+        ]
+    )
+
+
+def _e2e_source(broker, ctx, topic="bench_temperature"):
+    sch = _e2e_schema()
+    return ctx.from_topic(
+        topic,
+        schema=sch,
+        bootstrap_servers=broker.bootstrap,
+        timestamp_column="occurred_at_ms",
+    )
+
+
+def run_kafka_e2e(batches) -> tuple[float, dict, dict]:
+    """The full reference-shaped pipeline: an embedded Kafka broker serving
+    multi-record JSON batches → native wire client → native JSON decode →
+    intern → window → emission.  Unlike the other configs (pre-decoded
+    MemorySource; engine-only cost), this measures ingest end to end.
+
+    Returns (rows_per_sec, info, latency_dict).  Throughput counts ALL
+    produced rows over the wall time to the last CLOSABLE window's
+    emission (the final partial window's rows are fetched and aggregated
+    but never emitted — bounded replay into an unbounded source)."""
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    col, F = _F()
+    parts = int(os.environ.get("BENCH_E2E_PARTITIONS", 4))
+    payloads = _json_payloads(batches)
+    total = len(payloads)
+    last_close_ws = (
+        (EVENT_T0 + int(total / EVENTS_PER_SEC * 1000)) // WINDOW_MS - 1
+    ) * WINDOW_MS
+
+    def consume(ds, deadline_s=240.0):
+        seen_ws = -1
+        out_rows = 0
+        it = ds.stream()
+        deadline = time.time() + deadline_s
+        for batch in it:
+            out_rows += batch.num_rows
+            if batch.schema.has("window_start_time"):
+                seen_ws = max(
+                    seen_ws, int(np.max(batch.column("window_start_time")))
+                )
+            if seen_ws >= last_close_ws or time.time() > deadline:
+                it.close()
+                break
+        return out_rows
+
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("bench_temperature", partitions=parts)
+        for p in range(parts):
+            # interleaved assignment keeps every partition's event-time
+            # range aligned (slab assignment would make one partition's
+            # data arrive "late" behind the global watermark)
+            broker.produce_batched("bench_temperature", p, payloads[p::parts])
+
+        def pipeline(ctx):
+            return _e2e_source(broker, ctx).window(
+                ["sensor_name"],
+                [
+                    F.count(col("reading")).alias("count"),
+                    F.min(col("reading")).alias("min"),
+                    F.max(col("reading")).alias("max"),
+                    F.avg(col("reading")).alias("average"),
+                ],
+                WINDOW_MS,
+            )
+
+        # warmup on a throwaway consumer group (fresh offsets), enough
+        # event time to close windows and compile the emission path
+        consume(pipeline(_engine_ctx()), deadline_s=60.0)
+
+        t0 = time.perf_counter()
+        out_rows = consume(pipeline(_engine_ctx()))
+        dt = time.perf_counter() - t0
+        cpu_rps = _kafka_e2e_baseline(broker, total)
+        lat = _kafka_e2e_latency(parts, sustainable=total / dt)
+        return (
+            total / dt,
+            {"windows_rows": out_rows, "wall_s": round(dt, 3)},
+            lat,
+            cpu_rps,
+        )
+    finally:
+        broker.stop()
+
+
+def _kafka_e2e_baseline(broker, total) -> float:
+    """CPU baseline sharing the SAME ingest path (native fetch + decode —
+    a pure-Python json.loads consumer would be a strawman): raw partition
+    readers feeding the vectorized-numpy aggregation.  Isolates the
+    engine's aggregation/emission value over identical input costs."""
+    from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+
+    src = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic("bench_temperature")
+        .with_encoding("json")
+        .with_group_id("bench-e2e-baseline")
+        .with_timestamp_column("occurred_at_ms")
+        .with_schema(_e2e_schema())
+        .build_reader()
+    )
+    agg = _CpuAgg(WINDOW_MS)
+    readers = src.partitions()
+    rows = 0
+    t0 = time.perf_counter()
+    idle_since = None
+    while rows < total:
+        progressed = False
+        for r in readers:
+            b = r.read(timeout_s=0.05)
+            if b is not None and b.num_rows:
+                rows += b.num_rows
+                agg.push(
+                    np.asarray(b.column("occurred_at_ms"), dtype=np.int64),
+                    np.asarray(b.column("sensor_name"), dtype=object),
+                    np.asarray(b.column("reading"), dtype=np.float64),
+                )
+                progressed = True
+        if progressed:
+            idle_since = None
+        else:
+            idle_since = idle_since or time.perf_counter()
+            if time.perf_counter() - idle_since > 30:
+                log(f"e2e baseline stalled at {rows}/{total} rows")
+                break
+    dt = time.perf_counter() - t0
+    rps = rows / dt
+    log(f"cpu baseline[kafka e2e numpy]: {rps:,.0f} rows/s ({dt:.2f}s)")
+    return rps
+
+
+def _kafka_e2e_latency(parts, sustainable: float) -> dict:
+    """Paced producer thread into a fresh topic; latency = emit wall −
+    wall(window close), sampled per emitted window close.  The pace is
+    min(1M ev/s, 60% of measured e2e throughput): pacing an ingest-bound
+    pipeline beyond what it sustains would only measure backlog drain,
+    not latency.  The pace used is reported alongside the percentiles."""
+    import threading
+
+    from denormalized_tpu.common.constants import WINDOW_END_COLUMN
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    col, F = _F()
+    lat_rows = int(os.environ.get("BENCH_E2E_LAT_ROWS", 6_000_000))
+    if lat_rows < 2 * EVENTS_PER_SEC * WINDOW_MS // 1000:
+        # fewer than two windows of event time can never produce a closed
+        # window, and an emission-less stream has nothing to sample
+        return {"p50_window_latency_ms": None, "p99_window_latency_ms": None}
+    pace = float(
+        os.environ.get("BENCH_E2E_PACE", 0)
+    ) or min(EVENTS_PER_SEC, 0.6 * sustainable)
+    _, batches = gen_batches(total_rows=lat_rows, batch_rows=8192, seed=7)
+    payloads = _json_payloads(batches)
+    clock = _FeedClock(pace)
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("bench_lat", partitions=parts)
+        chunk = 8192
+        # pre-encode every record batch NOW: the paced feed loop must only
+        # append slices, or Python encode costs throttle the producer below
+        # the pace and the samples measure producer lag instead of latency
+        per_part = chunk // parts
+        staged = []  # per partition: list of per-chunk entry lists
+        for p in range(parts):
+            rows = payloads[p::parts]
+            ents = []
+            for i in range(0, len(rows), per_part):
+                ents.append(
+                    MockKafkaBroker.stage_batched(
+                        rows[i : i + per_part], ts_ms=EVENT_T0,
+                        records_per_batch=per_part, base_offset=i,
+                    )
+                )
+            staged.append(ents)
+        n_chunks = max(len(e) for e in staged)
+
+        def feed():
+            clock.start()
+            for ci in range(n_chunks):
+                due = clock.wall_of(
+                    EVENT_T0 + (ci + 1) * chunk * 1000.0 / EVENTS_PER_SEC
+                )
+                delay = due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                for p in range(parts):
+                    if ci < len(staged[p]):
+                        broker.append_staged("bench_lat", p, staged[p][ci])
+
+        # shape warmup: consume a short unpaced topic with the same batch
+        # bucket so jit compiles (update/merge/gather ladders) are out of
+        # the way before the first paced window's latency is sampled
+        warm_rows = 3 * EVENTS_PER_SEC * WINDOW_MS // 1000
+        broker.create_topic("bench_lat_warm", partitions=parts)
+        for p in range(parts):
+            broker.produce_batched(
+                "bench_lat_warm", p, payloads[: warm_rows][p::parts]
+            )
+        warm_ds = _e2e_source(
+            broker, _engine_ctx(batch_bucket=8192), topic="bench_lat_warm"
+        ).window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            WINDOW_MS,
+        )
+        wit = warm_ds.stream()
+        warm_deadline = time.time() + 120
+        for _ in wit:
+            break
+        wit.close()
+        if time.time() > warm_deadline:
+            log("e2e latency warmup overran")
+
+        feeder = threading.Thread(target=feed, daemon=True)
+        ctx = _engine_ctx(batch_bucket=8192)
+        ds = _e2e_source(broker, ctx, topic="bench_lat").window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            WINDOW_MS,
+        )
+        n_windows = int(lat_rows / EVENTS_PER_SEC * 1000) // WINDOW_MS - 1
+        lats: list[float] = []
+        seen = set()
+        it = ds.stream()
+        feeder.start()
+        deadline = time.time() + lat_rows / pace + 120
+        for batch in it:
+            now = time.perf_counter()
+            if not batch.schema.has(WINDOW_END_COLUMN) or clock.t0 is None:
+                continue
+            ends = np.asarray(batch.column(WINDOW_END_COLUMN), dtype=np.float64)
+            for e in np.unique(ends):
+                if e not in seen:
+                    seen.add(e)
+                    lats.append((now - clock.wall_of(e)) * 1000.0)
+            if len(seen) >= n_windows or time.time() > deadline:
+                it.close()
+                break
+    finally:
+        broker.stop()
+    if not lats:
+        return {"p50_window_latency_ms": None, "p99_window_latency_ms": None}
+    a = np.asarray(lats)
+    return {
+        "p50_window_latency_ms": round(float(np.percentile(a, 50)), 2),
+        "p99_window_latency_ms": round(float(np.percentile(a, 99)), 2),
+        "latency_samples": int(a.size),
+        "latency_pace_events_per_sec": round(pace),
+    }
+
+
 # -- throughput phase ----------------------------------------------------
 
 
@@ -282,10 +572,13 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
 
 
 class _FeedClock:
-    """Shared wall↔event-time mapping: wall(E) = t0 + (E - EVENT_T0)/1000."""
+    """Shared wall↔event-time mapping: wall(E) = t0 + (E - EVENT_T0)/1000
+    scaled by the feed pace (events/s; generation density is 1M rows per
+    event-second, so pace < 1M stretches event time onto the wall)."""
 
-    def __init__(self):
+    def __init__(self, pace_events_per_sec: float = None):
         self.t0 = None
+        self.scale = EVENTS_PER_SEC / float(pace_events_per_sec or EVENTS_PER_SEC)
 
     def start(self):
         if self.t0 is None:
@@ -293,7 +586,7 @@ class _FeedClock:
         return self.t0
 
     def wall_of(self, event_ms: float) -> float:
-        return self.t0 + (event_ms - EVENT_T0) / 1000.0
+        return self.t0 + (event_ms - EVENT_T0) / 1000.0 * self.scale
 
 
 def _paced_source(batches, clock):
@@ -617,12 +910,34 @@ def run_cpu_baseline(batches, kind: str, batches2=None) -> float:
 
 
 def main():
-    if CONFIG not in ("simple", "sliding", "highcard", "join", "checkpoint"):
+    if CONFIG not in (
+        "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e"
+    ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     device = pick_device()
     if device == "cpu":
         force_cpu()
     log(f"device: {device}  config: {CONFIG}  strategy: {DEVICE_STRATEGY}")
+    if CONFIG == "kafka_e2e":
+        global TOTAL_ROWS
+        if "BENCH_ROWS" not in os.environ:
+            TOTAL_ROWS = 4_000_000  # bounded by broker memory + encode time
+        # fewer than ~3 windows of event time never closes a window and
+        # the consume loop would wait forever for an emission
+        TOTAL_ROWS = max(TOTAL_ROWS, 3 * EVENTS_PER_SEC * WINDOW_MS // 1000)
+        log(f"generating {TOTAL_ROWS:,} rows ...")
+        _, batches = gen_batches()
+        rps, info, lat, cpu_rps = run_kafka_e2e(batches)
+        log(f"engine[kafka_e2e]: {rps:,.0f} rows/s {info}")
+        print(json.dumps({
+            "metric": "rows_per_sec_kafka_e2e_fetch_decode_1s_tumbling",
+            "value": round(rps),
+            "unit": "rows/s",
+            "vs_baseline": round(rps / cpu_rps, 3),
+            "device": device,
+            **lat,
+        }))
+        return
     if CONFIG == "highcard":
         global NUM_KEYS, BATCH_ROWS
         NUM_KEYS = int(os.environ.get("BENCH_KEYS", 100_000))

@@ -131,6 +131,13 @@ def _enable_compilation_cache(path: str | None) -> None:
 
         if jax.config.jax_compilation_cache_dir:
             return
+        # only worthwhile for remote-compile accelerator backends; local
+        # CPU compiles are fast, and caching them risks loading AOT
+        # artifacts whose target machine features don't match the host
+        # (XLA warns of possible SIGILL)
+        plat = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+        if "cpu" in (plat or "cpu"):
+            return
         full = os.path.expanduser(path)
         os.makedirs(full, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", full)
