@@ -111,14 +111,39 @@ class EngineConfig:
 
 
 _cache_enabled = False
+_pending_cache_path: str | None = None
+
+
+def _activate_compilation_cache(path: str) -> None:
+    import os
+
+    import jax
+
+    full = os.path.expanduser(path)
+    os.makedirs(full, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", full)
+    # cache even fast compiles: the ladder programs are individually
+    # cheap to compile locally but each costs a round-trip on a
+    # remote-compile backend
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 def _enable_compilation_cache(path: str | None) -> None:
     """Point JAX's persistent compilation cache at ``path`` (once per
     process).  A user-set ``JAX_COMPILATION_CACHE_DIR`` or an earlier
     explicit configuration wins; failures are non-fatal (a read-only HOME
-    must not kill the stream — it just recompiles)."""
-    global _cache_enabled
+    must not kill the stream — it just recompiles).
+
+    Only worthwhile for remote-compile accelerator backends; local CPU
+    compiles are fast, and caching them risks loading AOT artifacts whose
+    target machine features don't match the host (XLA warns of possible
+    SIGILL).  When the platform is explicitly configured we decide here;
+    when it is auto-detected (no JAX_PLATFORMS — the common TPU
+    deployment) the decision is DEFERRED to
+    :func:`ensure_compilation_cache_for_backend`, called from the device
+    chokepoint once a real backend exists, so auto-detected TPUs still
+    get the cache (round-2 ADVICE item)."""
+    global _cache_enabled, _pending_cache_path
     if path is None or _cache_enabled:
         return
     _cache_enabled = True
@@ -131,20 +156,32 @@ def _enable_compilation_cache(path: str | None) -> None:
 
         if jax.config.jax_compilation_cache_dir:
             return
-        # only worthwhile for remote-compile accelerator backends; local
-        # CPU compiles are fast, and caching them risks loading AOT
-        # artifacts whose target machine features don't match the host
-        # (XLA warns of possible SIGILL)
         plat = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
-        if "cpu" in (plat or "cpu"):
+        if not plat:
+            # platform unknown until backend init — don't guess "cpu";
+            # remember the path and let the first device touch decide
+            _pending_cache_path = path
             return
-        full = os.path.expanduser(path)
-        os.makedirs(full, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", full)
-        # cache even fast compiles: the ladder programs are individually
-        # cheap to compile locally but each costs a round-trip on a
-        # remote-compile backend
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        if "cpu" in plat:
+            return
+        _activate_compilation_cache(path)
+    except Exception:
+        pass
+
+
+def ensure_compilation_cache_for_backend() -> None:
+    """Finish a deferred cache decision now that a backend is initialized
+    (called from the window-state factory, the first point that touches
+    the device).  No-op unless Context deferred with a pending path."""
+    global _pending_cache_path
+    if _pending_cache_path is None:
+        return
+    path, _pending_cache_path = _pending_cache_path, None
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            _activate_compilation_cache(path)
     except Exception:
         pass
 

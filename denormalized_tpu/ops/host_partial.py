@@ -290,8 +290,12 @@ class HostPartialStripe:
                 # accumulators, ~1e-14 relative for f64 ones (the axon
                 # runtime decomposes f64, so raw-bit transport of f64 is
                 # not portable)
-                hi = src.astype(np.float32)
-                lo = (src - hi.astype(np.float64)).astype(np.float32)
+                # overflow-to-inf in the cast and inf - inf below are
+                # deliberate (handled by the nonfin branch); suppress the
+                # spurious RuntimeWarnings
+                with np.errstate(invalid="ignore", over="ignore"):
+                    hi = src.astype(np.float32)
+                    lo = (src - hi.astype(np.float64)).astype(np.float32)
                 # a finite f64 sum beyond f32 range becomes (±inf, ∓inf)
                 # and would fold to NaN; ±inf parity with an overflowed
                 # f32 accumulator is right for f32 state, but an f64

@@ -759,6 +759,11 @@ def make_sharded_state(
             f"unknown device strategy {device_strategy!r} (expected "
             "'scatter', 'pallas_dense', 'partial_merge', or 'auto')"
         )
+    # first point that touches the device: complete a deferred
+    # compilation-cache decision for auto-detected accelerator backends
+    from denormalized_tpu.api.context import ensure_compilation_cache_for_backend
+
+    ensure_compilation_cache_for_backend()
     if mesh is None or mesh.devices.size == 1:
         # 'auto' on a real TPU backend chooses host edge-reduction: the
         # chip sits behind a host↔device link whose cost scales with

@@ -1,22 +1,20 @@
 """Real-chip A/B harness: the full strategy matrix over the BASELINE.md
 configs, for the moment the axon tunnel is reachable.
 
-Runs bench.py in subprocesses (so each config gets a fresh backend and a
-wedged tunnel can never hang this process) across:
-
-    config    × {simple, sliding, highcard, join, checkpoint}
-    strategy  × {scatter, pallas_dense, partial_merge}
-    emission  × {full} (+ compacted via --compaction)
-
-and writes one JSON report with rows/s, vs_baseline, and p50/p99 window
-latency per cell — the VERDICT round-1 ask ("A/B scatter vs pallas_dense on
-the chip for all five configs") in one command:
+Round-3 rework: cells run IN THIS PROCESS via ``bench.set_knobs`` +
+``bench.run_config``.  The round-2 harness ran each cell as a subprocess
+with its own device probe; on a single-client tunnel those probes stacked
+abandoned children against the claim and each cell re-paid a multi-minute
+backend acquisition.  One process = one init, one shared jit cache
+(cells reuse compiled programs across strategies), zero orphans.
 
     python tools/chip_ab.py [--rows 8000000] [--out AB_REPORT.json]
+        [--configs simple,sliding,...] [--strategies scatter,...]
+        [--compaction] [--host-pipeline]
 
-The TPU probe follows the tunnel rules (subprocess, abandoned not killed on
-timeout); if the backend is down every cell falls back to CPU and the
-report says so — still useful as a host-side regression matrix.
+Writes one JSON report with rows/s, vs_baseline, p50/p99 window latency
+and sample counts per cell; the report is rewritten after every cell so a
+wedged later cell cannot lose completed ones.
 """
 
 from __future__ import annotations
@@ -24,71 +22,27 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
+import traceback
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
 CONFIGS = ["simple", "sliding", "highcard", "join", "checkpoint"]
 STRATEGIES = ["scatter", "pallas_dense", "partial_merge"]
-COMPACTION = [False]  # emission compaction: add True via --compaction
-
-
-def run_cell(config, strategy, compaction, rows, lat_rows):
-    env = dict(os.environ)
-    env.update(
-        BENCH_CONFIG=config,
-        BENCH_DEVICE_STRATEGY=strategy,
-        BENCH_ROWS=str(rows),
-        BENCH_LAT_ROWS=str(lat_rows),
-        BENCH_EMISSION_COMPACTION="1" if compaction else "0",
-    )
-    t0 = time.time()
-    proc = subprocess.Popen(
-        [sys.executable, str(REPO / "bench.py")],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        env=env,
-        start_new_session=True,
-    )
-    cell = {
-        "config": config,
-        "strategy": strategy,
-        "emission_compaction": compaction,
-    }
-    try:
-        out, errout = proc.communicate(timeout=3600)
-        cell["rc"] = proc.returncode
-    except subprocess.TimeoutExpired:
-        # ABANDON, never kill: SIGKILLing a process mid-TPU-handshake is
-        # what wedges the single-client tunnel for every later user
-        cell["rc"] = "timeout-abandoned"
-        cell["wall_s"] = round(time.time() - t0, 1)
-        return cell
-    cell["wall_s"] = round(time.time() - t0, 1)
-    for line in out.splitlines():
-        if line.startswith("{"):
-            try:
-                cell.update(json.loads(line))
-                break
-            except json.JSONDecodeError:
-                pass
-    if proc.returncode != 0:
-        cell["stderr_tail"] = errout[-800:]
-    return cell
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=8_000_000)
-    ap.add_argument("--lat-rows", type=int, default=10_000_000)
+    ap.add_argument(
+        "--lat-rows", type=int, default=60_000_000,
+        help="paced latency-phase rows (60M -> ~59 samples/cell)",
+    )
     ap.add_argument("--out", default=str(REPO / "AB_REPORT.json"))
     ap.add_argument(
-        "--configs", default=",".join(CONFIGS),
-        help="comma-separated subset",
+        "--configs", default=",".join(CONFIGS), help="comma-separated subset"
     )
     ap.add_argument(
         "--strategies", default=",".join(STRATEGIES),
@@ -98,49 +52,139 @@ def main():
         "--compaction", action="store_true",
         help="also run emission-compaction=on cells",
     )
+    ap.add_argument(
+        "--host-pipeline", action="store_true",
+        help="also run host_pipeline=on cells (partial_merge only)",
+    )
+    ap.add_argument(
+        "--cell-timeout", type=float, default=5400.0,
+        help="per-cell wall bound: on expiry the partial report is "
+        "written with the cell marked hung and the process exits 3 "
+        "(a wedged device op cannot be cancelled in-process; rerun "
+        "with --resume to continue from completed cells)",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="skip cells already present with rc==0 in --out",
+    )
     args = ap.parse_args()
     strategies = args.strategies.split(",")
     compaction = [False, True] if args.compaction else [False]
 
-    # probe ONCE and pin the result for every cell: per-cell probes would
-    # stack abandoned probe processes against the single-client tunnel
     sys.path.insert(0, str(REPO))
-    import bench as bench_mod
+    import bench
 
-    device = os.environ.get("BENCH_DEVICE") or bench_mod.pick_device()
-    os.environ["BENCH_DEVICE"] = device
+    device = bench.init_backend()
     print(f"device: {device}", flush=True)
 
-    cells = []
+    done_keys = set()
+    prior_cells = []
+    if args.resume and Path(args.out).exists():
+        try:
+            prior = json.loads(Path(args.out).read_text())
+            for c in prior.get("cells", []):
+                if c.get("rc") == 0:
+                    prior_cells.append(c)
+                    done_keys.add((
+                        c["config"], c["strategy"],
+                        c.get("emission_compaction", False),
+                        c.get("host_pipeline", False),
+                    ))
+        except Exception as e:
+            print(f"resume: could not read {args.out}: {e!r}", flush=True)
+
+    def run_cell(config, strategy, compact, pipeline):
+        cell = {
+            "config": config,
+            "strategy": strategy,
+            "emission_compaction": compact,
+            "host_pipeline": pipeline,
+        }
+        t0 = time.time()
+        # a wedged device op cannot be cancelled from inside the process:
+        # on expiry, persist what we have and exit nonzero so an outer
+        # loop can rerun with --resume
+        import threading
+
+        cell_done = threading.Event()
+
+        def _hang_watch():
+            if not cell_done.wait(args.cell_timeout):
+                cell["rc"] = "hung"
+                cell["wall_s"] = round(time.time() - t0, 1)
+                cells.append(cell)
+                Path(args.out).write_text(json.dumps(
+                    {"partial": True, "device": device, "cells": cells},
+                    indent=1,
+                ))
+                print(f"cell hung >{args.cell_timeout:.0f}s; exiting 3 "
+                      f"(rerun with --resume)", flush=True)
+                os._exit(3)
+
+        threading.Thread(target=_hang_watch, daemon=True).start()
+        bench.set_knobs(
+            config=config,
+            strategy=strategy,
+            compaction=compact,
+            host_pipeline=pipeline,
+            rows=args.rows,
+            lat_rows=args.lat_rows,
+            # run_config re-derives highcard keys/batch from env; reset
+            # the generic defaults for every other cell
+            keys=int(os.environ.get("BENCH_KEYS", 10)),
+            batch=int(os.environ.get("BENCH_BATCH", 131_072)),
+        )
+        try:
+            cell.update(bench.run_config(device))
+            cell["rc"] = 0
+        except Exception:
+            cell["rc"] = 1
+            cell["error"] = traceback.format_exc()[-800:]
+        finally:
+            cell_done.set()
+        cell["wall_s"] = round(time.time() - t0, 1)
+        return cell
+
+    cells = list(prior_cells)
+
+    def emit(cell):
+        print(
+            f"   rc={cell['rc']} device={cell.get('device')} "
+            f"{cell.get('value', 0):,} rows/s "
+            f"p99={cell.get('p99_window_latency_ms')}ms "
+            f"n={cell.get('latency_samples')}",
+            flush=True,
+        )
+        cells.append(cell)
+        # incremental write: a wedged later cell must not lose hours of
+        # completed cells
+        Path(args.out).write_text(
+            json.dumps(
+                {"partial": True, "device": device, "cells": cells}, indent=1
+            )
+        )
+
     for config in args.configs.split(","):
         for strategy in strategies:
-            for compact in compaction:
+            variants = [(c, False) for c in compaction]
+            if args.host_pipeline and strategy == "partial_merge":
+                variants.append((False, True))
+            for compact, pipeline in variants:
+                if (config, strategy, compact, pipeline) in done_keys:
+                    print(f"== {config} / {strategy} skipped (resume) ==",
+                          flush=True)
+                    continue
                 print(
                     f"== {config} / {strategy} / "
-                    f"compaction={'on' if compact else 'off'} ==",
+                    f"compaction={'on' if compact else 'off'}"
+                    f"{' / host_pipeline=on' if pipeline else ''} ==",
                     flush=True,
                 )
-                cell = run_cell(
-                    config, strategy, compact, args.rows, args.lat_rows
-                )
-                print(
-                    f"   rc={cell['rc']} device={cell.get('device')} "
-                    f"{cell.get('value', 0):,} rows/s "
-                    f"p99={cell.get('p99_window_latency_ms')}ms",
-                    flush=True,
-                )
-                cells.append(cell)
-                # incremental write: a wedged later cell must not lose
-                # hours of completed cells
-                Path(args.out).write_text(
-                    json.dumps(
-                        {"partial": True, "device": device, "cells": cells},
-                        indent=1,
-                    )
-                )
+                emit(run_cell(config, strategy, compact, pipeline))
     report = {
         "generated_at_unix": int(time.time()),
         "rows": args.rows,
+        "lat_rows": args.lat_rows,
         "device": device,
         "cells": cells,
     }
