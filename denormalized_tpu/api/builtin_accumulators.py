@@ -160,6 +160,274 @@ class PercentileContAccumulator(Accumulator):
         return float(np.quantile(self.values, self.q))
 
 
+class BitAndAccumulator(Accumulator):
+    """Bitwise AND over int64 values (DataFusion ``bit_and``)."""
+
+    _init = -1  # all bits set
+    _op = staticmethod(lambda a, b: a & b)
+    _ufunc = np.bitwise_and
+
+    def __init__(self):
+        self.acc = self._init
+        self.seen = False
+
+    def update(self, col: np.ndarray) -> None:
+        vals = np.asarray(col, np.int64)
+        if len(vals):
+            self.seen = True
+            self.acc = self._op(
+                self.acc, int(type(self)._ufunc.reduce(vals))
+            )
+
+    def merge(self, state) -> None:
+        if state[1]:
+            self.acc = self._op(self.acc, int(state[0]))
+            self.seen = True
+
+    def state(self) -> list:
+        return [self.acc, self.seen]
+
+    def evaluate(self):
+        return self.acc if self.seen else None
+
+
+class BitOrAccumulator(BitAndAccumulator):
+    _init = 0
+    _op = staticmethod(lambda a, b: a | b)
+    _ufunc = np.bitwise_or
+
+
+class BitXorAccumulator(BitAndAccumulator):
+    _init = 0
+    _op = staticmethod(lambda a, b: a ^ b)
+    _ufunc = np.bitwise_xor
+
+
+class BoolAndAccumulator(Accumulator):
+    """TRUE iff every value is true (DataFusion ``bool_and``)."""
+
+    _all = True
+
+    def __init__(self):
+        self.acc = self._all
+        self.seen = False
+
+    def update(self, col: np.ndarray) -> None:
+        vals = np.asarray(col, np.bool_)
+        if len(vals):
+            self.seen = True
+            agg = bool(vals.all()) if self._all else bool(vals.any())
+            self.acc = (self.acc and agg) if self._all else (self.acc or agg)
+
+    def merge(self, state) -> None:
+        if state[1]:
+            self.seen = True
+            self.acc = (
+                (self.acc and state[0]) if self._all else (self.acc or state[0])
+            )
+
+    def state(self) -> list:
+        return [bool(self.acc), self.seen]
+
+    def evaluate(self):
+        return bool(self.acc) if self.seen else None
+
+
+class BoolOrAccumulator(BoolAndAccumulator):
+    _all = False
+
+
+class StringAggAccumulator(Accumulator):
+    """Concatenate values with a delimiter in arrival order (DataFusion
+    ``string_agg``)."""
+
+    def __init__(self, delimiter: str = ","):
+        self.delimiter = delimiter
+        self.values: list[str] = []
+
+    def update(self, col: np.ndarray) -> None:
+        self.values.extend(
+            str(v) for v in col.tolist() if v is not None
+        )
+
+    def merge(self, state) -> None:
+        self.values.extend(state[0])
+
+    def state(self) -> list:
+        return [list(self.values)]
+
+    def evaluate(self):
+        return self.delimiter.join(self.values) if self.values else None
+
+
+class NthValueAccumulator(Accumulator):
+    """N-th value in arrival order, 1-based (DataFusion ``nth_value``);
+    keeps only the first N values, not the whole stream."""
+
+    def __init__(self, n: int = 1):
+        if n < 1:
+            raise ValueError(f"nth_value position must be >= 1, got {n}")
+        self.n = n
+        self.values: list = []
+
+    def update(self, col: np.ndarray) -> None:
+        need = self.n - len(self.values)
+        if need > 0:
+            self.values.extend(
+                _jsonable_scalar(v) for v in col.tolist()[:need]
+            )
+
+    def merge(self, state) -> None:
+        need = self.n - len(self.values)
+        if need > 0:
+            self.values.extend(state[0][:need])
+
+    def state(self) -> list:
+        return [list(self.values)]
+
+    def evaluate(self):
+        return self.values[self.n - 1] if len(self.values) >= self.n else None
+
+
+class TwoColStatsAccumulator(Accumulator):
+    """Shared sufficient statistics for every bivariate aggregate —
+    corr / covar_samp / covar_pop / the regr_* family (reference
+    functions.py:1658-2066).  State is (n, Σx, Σy, Σxx, Σyy, Σxy) over
+    pairwise-non-null pairs; each public aggregate is a finalizer over
+    these six numbers.  Column convention follows DataFusion:
+    ``(value_y, value_x)``."""
+
+    stat = "corr"
+
+    def __init__(self):
+        self.n = 0
+        self.sx = self.sy = self.sxx = self.syy = self.sxy = 0.0
+
+    def update(self, ycol: np.ndarray, xcol: np.ndarray = None) -> None:
+        if xcol is None:
+            raise ValueError(f"{self.stat} takes two argument columns")
+        y = np.asarray(ycol, np.float64)
+        x = np.asarray(xcol, np.float64)
+        ok = ~(np.isnan(x) | np.isnan(y))
+        x, y = x[ok], y[ok]
+        self.n += int(len(x))
+        self.sx += float(x.sum())
+        self.sy += float(y.sum())
+        self.sxx += float((x * x).sum())
+        self.syy += float((y * y).sum())
+        self.sxy += float((x * y).sum())
+
+    def merge(self, state) -> None:
+        n, sx, sy, sxx, syy, sxy = state
+        self.n += n
+        self.sx += sx
+        self.sy += sy
+        self.sxx += sxx
+        self.syy += syy
+        self.sxy += sxy
+
+    def state(self) -> list:
+        return [self.n, self.sx, self.sy, self.sxx, self.syy, self.sxy]
+
+    # centered moments (numerically fine for window-scale data; the
+    # device kernel's compensated path is for the billion-row axis)
+    def _mxx(self):
+        return self.sxx - self.sx * self.sx / self.n
+
+    def _myy(self):
+        return self.syy - self.sy * self.sy / self.n
+
+    def _mxy(self):
+        return self.sxy - self.sx * self.sy / self.n
+
+    def evaluate(self):
+        import math as _m
+
+        n = self.n
+        if n == 0:
+            # regr_count is 0 over an empty pair set (postgres/DataFusion);
+            # every other bivariate stat is undefined -> NULL
+            return 0 if self.stat == "regr_count" else None
+        s = self.stat
+        if s == "regr_count":
+            return n
+        if s == "regr_avgx":
+            return self.sx / n
+        if s == "regr_avgy":
+            return self.sy / n
+        if s == "regr_sxx":
+            return self._mxx()
+        if s == "regr_syy":
+            return self._myy()
+        if s == "regr_sxy":
+            return self._mxy()
+        if s == "covar_pop":
+            return self._mxy() / n
+        if s in ("covar", "covar_samp"):
+            return self._mxy() / (n - 1) if n > 1 else None
+        if s == "corr":
+            d = _m.sqrt(self._mxx() * self._myy())
+            return self._mxy() / d if d > 0 else None
+        if s == "regr_slope":
+            return self._mxy() / self._mxx() if self._mxx() != 0 else None
+        if s == "regr_intercept":
+            if self._mxx() == 0:
+                return None
+            slope = self._mxy() / self._mxx()
+            return (self.sy - slope * self.sx) / n
+        if s == "regr_r2":
+            if self._mxx() == 0 or self._myy() == 0:
+                return None
+            r = self._mxy() / _m.sqrt(self._mxx() * self._myy())
+            return r * r
+        raise ValueError(f"unknown bivariate stat {s!r}")
+
+
+class WeightedPercentileAccumulator(Accumulator):
+    """Exact weighted continuous percentile (DataFusion
+    ``approx_percentile_cont_with_weight``'s exact cousin)."""
+
+    def __init__(self, q: float):
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"percentile must be in [0, 1], got {q}")
+        self.q = q
+        self.values: list[float] = []
+        self.weights: list[float] = []
+
+    def update(self, col: np.ndarray, wcol: np.ndarray = None) -> None:
+        v = np.asarray(col, np.float64)
+        w = (
+            np.ones_like(v)
+            if wcol is None
+            else np.asarray(wcol, np.float64)
+        )
+        self.values.extend(v.tolist())
+        self.weights.extend(w.tolist())
+
+    def merge(self, state) -> None:
+        self.values.extend(state[0])
+        self.weights.extend(state[1])
+
+    def state(self) -> list:
+        return [list(self.values), list(self.weights)]
+
+    def evaluate(self):
+        if not self.values:
+            return math.nan
+        v = np.asarray(self.values)
+        w = np.asarray(self.weights)
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        cw = np.cumsum(w)
+        total = cw[-1]
+        if total <= 0:
+            return math.nan
+        # weighted quantile with linear interpolation on the cumulative
+        # weight midpoints (the standard Hazen-type definition)
+        mid = (cw - 0.5 * w) / total
+        return float(np.interp(self.q, mid, v))
+
+
 class ApproxDistinctAccumulator(Accumulator):
     """HyperLogLog distinct-count sketch (DataFusion `approx_distinct`).
 

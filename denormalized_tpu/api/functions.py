@@ -32,12 +32,21 @@ from denormalized_tpu.logical.expr import (
 from denormalized_tpu.logical.scalar_functions import REGISTRY, lookup
 
 __all__ = [  # noqa: F822 - scalar names are injected below
-    "count", "sum", "min", "max", "avg",
-    "stddev", "stddev_samp", "stddev_pop", "var", "var_samp", "var_pop",
+    "count", "count_star", "sum", "min", "max", "avg", "mean",
+    "stddev", "stddev_samp", "stddev_pop", "var", "var_samp", "var_sample",
+    "var_pop",
     "median", "approx_median", "array_agg", "first_value", "last_value",
+    "nth_value", "string_agg",
     "approx_distinct", "count_distinct", "percentile_cont",
-    "approx_percentile_cont",
+    "approx_percentile_cont", "approx_percentile_cont_with_weight",
+    "bit_and", "bit_or", "bit_xor", "bool_and", "bool_or",
+    "corr", "covar", "covar_pop", "covar_samp",
+    "regr_avgx", "regr_avgy", "regr_count", "regr_intercept", "regr_r2",
+    "regr_slope", "regr_sxx", "regr_sxy", "regr_syy",
     "case", "when", "udf", "udaf", "col", "lit",
+    "alias", "order_by", "in_list",
+    "window", "lead", "lag", "row_number", "rank", "dense_rank",
+    "percent_rank", "cume_dist", "ntile",
 ] + sorted(REGISTRY)
 
 
@@ -181,6 +190,123 @@ def approx_percentile_cont(expr: Expr | str, q: float) -> AggregateExpr:
     return percentile_cont(expr, q)
 
 
+def approx_percentile_cont_with_weight(
+    expr: Expr | str, weight: Expr | str, q: float
+) -> AggregateExpr:
+    """Weighted continuous percentile (reference functions.py
+    approx_percentile_cont_with_weight; exact here)."""
+    b = _builtin_accs()
+
+    class _Bound(b.WeightedPercentileAccumulator):
+        def __init__(self):
+            super().__init__(q)
+
+    _Bound.__name__ = f"WeightedPercentile[{q}]"
+    from denormalized_tpu.api.udaf import UDAF
+
+    e, w = _e(expr), _e(weight)
+    u = UDAF(_Bound, (e, w), DataType.FLOAT64, f"percentile_weight_{q}")
+    return AggregateExpr("udaf", e, None, u)
+
+
+def count_star() -> AggregateExpr:
+    """COUNT(*) (reference functions.py:371)."""
+    return count(None)
+
+
+def mean(expr: Expr | str) -> AggregateExpr:
+    """Alias of :func:`avg` (reference functions.py:1760)."""
+    return avg(expr)
+
+
+def var_sample(expr: Expr | str) -> AggregateExpr:
+    """Alias of :func:`var` (reference functions.py:1893)."""
+    return var(expr)
+
+
+def string_agg(expr: Expr | str, delimiter: str = ",") -> AggregateExpr:
+    """Concatenate values with a delimiter (reference ``string_agg``)."""
+    b = _builtin_accs()
+
+    class _Bound(b.StringAggAccumulator):
+        def __init__(self):
+            super().__init__(delimiter)
+
+    _Bound.__name__ = f"StringAgg[{delimiter!r}]"
+    return _builtin_udaf(_Bound, DataType.STRING, "string_agg")(expr)
+
+
+def nth_value(expr: Expr | str, n: int) -> AggregateExpr:
+    """N-th value in arrival order, 1-based (reference ``nth_value``)."""
+    b = _builtin_accs()
+
+    class _Bound(b.NthValueAccumulator):
+        def __init__(self):
+            super().__init__(n)
+
+    _Bound.__name__ = f"NthValue[{n}]"
+    return _builtin_udaf(_Bound, None, f"nth_value_{n}")(expr)
+
+
+def _bool_bit_agg(acc_attr: str, name: str, rt: DataType):
+    def make(expr: Expr | str) -> AggregateExpr:
+        b = _builtin_accs()
+        return _builtin_udaf(getattr(b, acc_attr), rt, name)(expr)
+
+    make.__name__ = name
+    make.__doc__ = f"{name} aggregate (reference functions.py exports it)."
+    return make
+
+
+bit_and = _bool_bit_agg("BitAndAccumulator", "bit_and", DataType.INT64)
+bit_or = _bool_bit_agg("BitOrAccumulator", "bit_or", DataType.INT64)
+bit_xor = _bool_bit_agg("BitXorAccumulator", "bit_xor", DataType.INT64)
+bool_and = _bool_bit_agg("BoolAndAccumulator", "bool_and", DataType.BOOL)
+bool_or = _bool_bit_agg("BoolOrAccumulator", "bool_or", DataType.BOOL)
+
+
+def _bivariate(stat: str, rt: DataType = DataType.FLOAT64):
+    """Two-column aggregate over shared sufficient statistics (reference
+    functions.py:1658-2066 corr/covar/regr_* — DataFusion's argument
+    order ``(value_y, value_x)``)."""
+
+    def make(value_y: Expr | str, value_x: Expr | str) -> AggregateExpr:
+        b = _builtin_accs()
+
+        class _Bound(b.TwoColStatsAccumulator):
+            pass
+
+        _Bound.stat = stat
+        _Bound.__name__ = f"TwoColStats[{stat}]"
+        from denormalized_tpu.api.udaf import UDAF
+
+        ey, ex = _e(value_y), _e(value_x)
+        u = UDAF(_Bound, (ey, ex), rt, stat)
+        return AggregateExpr("udaf", ey, None, u)
+
+    make.__name__ = stat
+    make.__doc__ = (
+        f"{stat}(value_y, value_x) bivariate aggregate "
+        "(sufficient-statistics decomposition, mergeable for checkpoints)."
+    )
+    return make
+
+
+corr = _bivariate("corr")
+covar = _bivariate("covar")
+covar_pop = _bivariate("covar_pop")
+covar_samp = _bivariate("covar_samp")
+regr_avgx = _bivariate("regr_avgx")
+regr_avgy = _bivariate("regr_avgy")
+regr_count = _bivariate("regr_count", DataType.INT64)
+regr_intercept = _bivariate("regr_intercept")
+regr_r2 = _bivariate("regr_r2")
+regr_slope = _bivariate("regr_slope")
+regr_sxx = _bivariate("regr_sxx")
+regr_sxy = _bivariate("regr_sxy")
+regr_syy = _bivariate("regr_syy")
+
+
 # -- CASE ----------------------------------------------------------------
 
 
@@ -240,11 +366,165 @@ def _wrap_arg(a) -> Expr:
 
 # functions whose FIRST string argument is a literal (unit name), not a
 # column reference
-_ALL_STR_LITERAL = {"date_trunc", "date_part", "extract", "chr"}
+_ALL_STR_LITERAL = {
+    "date_trunc", "date_part", "datetrunc", "datepart", "extract", "chr",
+    "named_struct",
+}
 
 for _fname in REGISTRY:
     globals()[_fname] = _scalar_constructor(_fname)
 del _fname
+
+# -- explicit overrides of registry-generated constructors ---------------
+# (defined AFTER the injection loop so these richer signatures win)
+
+_registry_in_list = globals()["in_list"]
+_registry_array_sort = globals()["array_sort"]
+_registry_named_struct = globals()["named_struct"]
+
+
+def in_list(arg: Expr | str, values: list, negated: bool = False) -> Expr:
+    """Membership test (reference functions.py:323): ``values`` is a
+    python list of expressions/literals; ``negated=True`` gives NOT IN."""
+    e = _registry_in_list(arg, *[_wrap_arg(v) for v in values])
+    return ~e if negated else e
+
+
+def array_sort(
+    array: Expr | str, descending: bool = False, null_first: bool = False
+) -> Expr:
+    """Sort list elements (reference functions.py:1401 — python bool
+    flags, converted to literals for the row-wise kernel)."""
+    return _registry_array_sort(array, lit(bool(descending)), lit(bool(null_first)))
+
+
+list_sort = array_sort
+
+
+def named_struct(*args) -> Expr:
+    """STRUCT with named fields.  Accepts the reference's list-of-pairs
+    form ``named_struct([("a", e1), ("b", e2)])`` (functions.py:1059) or
+    flat ``named_struct("a", e1, "b", e2)``."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        flat: list = []
+        for name, value in args[0]:
+            flat.extend([name, value])
+        args = tuple(flat)
+    return _registry_named_struct(*args)
+
+
+def alias(expr: Expr | str, name: str) -> Expr:
+    """Function form of ``expr.alias(name)`` (reference functions.py:361)."""
+    return _e(expr).alias(name)
+
+
+def order_by(
+    expr: Expr | str, ascending: bool = True, nulls_first: bool = True
+):
+    """Sort specification (reference functions.py:356) — consumed by
+    order-aware aggregate options and ``DataStream.sort`` on bounded
+    collects."""
+    from denormalized_tpu.logical.expr import SortExpr
+
+    return SortExpr(_e(expr), ascending, nulls_first)
+
+
+# -- ranking / offset window functions -----------------------------------
+
+
+def _win(wname, args=(), partition_by=None, order_by=None, params=()):
+    from denormalized_tpu.logical.expr import SortExpr, WindowFunctionExpr
+
+    def _sort(x):
+        if isinstance(x, SortExpr):
+            return x
+        return SortExpr(_e(x))
+
+    return WindowFunctionExpr(
+        wname,
+        tuple(_e(a) for a in args),
+        tuple(_e(p) for p in (partition_by or ())),
+        tuple(_sort(s) for s in (order_by or ())),
+        params,
+    )
+
+
+def window(name, args, partition_by=None, order_by=None, window_frame=None):
+    """Window function by name (reference functions.py:405).  Custom
+    window frames are not supported — the ranking/offset family ignores
+    frames in DataFusion too."""
+    if window_frame is not None:
+        from denormalized_tpu.common.errors import PlanError
+
+        raise PlanError(
+            "custom window frames are not supported; the ranking/offset "
+            "window functions operate over the whole partition"
+        )
+    name = name.lower()
+    if name in ("lead", "lag"):
+        a = list(args)
+        shift = a[1] if len(a) > 1 else 1
+        default = a[2] if len(a) > 2 else None
+        return _win(name, a[:1], partition_by, order_by,
+                    (int(getattr(shift, "value", shift)),
+                     getattr(default, "value", default)))
+    if name == "ntile":
+        n = args[0] if args else 1
+        return _win(name, (), partition_by, order_by,
+                    (int(getattr(n, "value", n)),))
+    if name in ("row_number", "rank", "dense_rank", "percent_rank",
+                "cume_dist"):
+        return _win(name, (), partition_by, order_by)
+    from denormalized_tpu.common.errors import PlanError
+
+    raise PlanError(f"unknown window function {name!r}")
+
+
+def lead(arg, shift_offset: int = 1, default_value=None,
+         partition_by=None, order_by=None):
+    """Value from the row ``shift_offset`` AFTER the current one in the
+    partition (reference functions.py:2292)."""
+    return _win("lead", (arg,), partition_by, order_by,
+                (shift_offset, default_value))
+
+
+def lag(arg, shift_offset: int = 1, default_value=None,
+        partition_by=None, order_by=None):
+    """Value from the row ``shift_offset`` BEFORE the current one in the
+    partition (reference functions.py:2347)."""
+    return _win("lag", (arg,), partition_by, order_by,
+                (shift_offset, default_value))
+
+
+def row_number(partition_by=None, order_by=None):
+    """1-based row number within the partition (reference :2399)."""
+    return _win("row_number", (), partition_by, order_by)
+
+
+def rank(partition_by=None, order_by=None):
+    """Olympic-medal rank with gaps after ties (reference :2435)."""
+    return _win("rank", (), partition_by, order_by)
+
+
+def dense_rank(partition_by=None, order_by=None):
+    """Rank without gaps after ties (reference :2476)."""
+    return _win("dense_rank", (), partition_by, order_by)
+
+
+def percent_rank(partition_by=None, order_by=None):
+    """(rank - 1) / (rows - 1) (reference :2500)."""
+    return _win("percent_rank", (), partition_by, order_by)
+
+
+def cume_dist(partition_by=None, order_by=None):
+    """Cumulative distribution: rows with key <= current / rows."""
+    return _win("cume_dist", (), partition_by, order_by)
+
+
+def ntile(arg, partition_by=None, order_by=None):
+    """Bucket number 1..N over the partition (reference :2560)."""
+    n = int(getattr(arg, "value", arg))
+    return _win("ntile", (), partition_by, order_by, (n,))
 
 
 def udf(fn: Callable, return_type: DataType, name: str | None = None):

@@ -370,6 +370,209 @@ class AliasExpr(Expr):
         return f"{self.inner!r}.alias({self._name!r})"
 
 
+@dataclass(frozen=True)
+class SortExpr:
+    """Sort specification (the reference's ``order_by`` export,
+    py-denormalized functions.py:356 → datafusion SortExpr): not itself a
+    value expression — consumed by order-aware options (e.g. sorting a
+    bounded ``collect``)."""
+
+    expr: "Expr"
+    ascending: bool = True
+    nulls_first: bool = True
+
+    def __repr__(self):
+        d = "asc" if self.ascending else "desc"
+        nf = "nulls_first" if self.nulls_first else "nulls_last"
+        return f"{self.expr!r}.sort({d}, {nf})"
+
+
+@dataclass(frozen=True, eq=False)
+class WindowFunctionExpr(Expr):
+    """Ranking / offset window function (the reference exports
+    datafusion's lead/lag/row_number/rank/dense_rank/percent_rank/
+    cume_dist/ntile, functions.py:2292-2560).
+
+    Evaluation scope is the RecordBatch being projected: exact SQL
+    semantics on bounded ``collect()`` results (which coalesce to one
+    batch); on an unbounded stream the frame is each arrival batch —
+    windowed aggregation is the streaming-native tool there."""
+
+    wname: str
+    args: tuple[Expr, ...] = ()
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple["SortExpr", ...] = ()
+    params: tuple = ()
+
+    @property
+    def name(self) -> str:
+        inner = ", ".join(a.name for a in self.args)
+        return f"{self.wname}({inner})"
+
+    def out_field(self, schema: Schema) -> Field:
+        if self.wname in ("lead", "lag"):
+            f0 = self.args[0].out_field(schema)
+            return Field(self.name, f0.dtype, True, f0.children)
+        if self.wname in ("row_number", "rank", "dense_rank", "ntile"):
+            return Field(self.name, DataType.INT64)
+        if self.wname in ("percent_rank", "cume_dist"):
+            return Field(self.name, DataType.FLOAT64)
+        raise PlanError(f"unknown window function {self.wname!r}")
+
+    def columns_referenced(self) -> set[str]:
+        s: set[str] = set()
+        for e in self.args + self.partition_by:
+            s |= e.columns_referenced()
+        for sx in self.order_by:
+            s |= sx.expr.columns_referenced()
+        return s
+
+    def _order_index(self, batch: RecordBatch) -> tuple[np.ndarray, list]:
+        """Row order within the batch under order_by (stable; repeated
+        sorts from the least-significant key honor per-key direction and
+        null placement), plus the composite order-key tuples for tie
+        detection."""
+        n = batch.num_rows
+        idx = list(range(n))
+        keycols = []
+        for sx in self.order_by:
+            vals = np.atleast_1d(sx.expr.eval(batch)).tolist()
+            keycols.append(vals)
+        for sx, vals in reversed(list(zip(self.order_by, keycols))):
+            def k(i, vals=vals, sx=sx):
+                v = vals[i]
+                isnull = v is None or (isinstance(v, float) and v != v)
+                # nulls get an extreme bucket; direction-aware so that
+                # reverse=True keeps nulls where nulls_first asks
+                null_rank = 0 if (sx.nulls_first != (not sx.ascending)) else 2
+                return (null_rank if isnull else 1, _SortKey(v, isnull))
+
+            idx.sort(key=k, reverse=not sx.ascending)
+        keys = [
+            tuple(vals[i] for vals in keycols) for i in range(n)
+        ]
+        return np.asarray(idx, np.int64), keys
+
+    def eval(self, batch: RecordBatch) -> np.ndarray:
+        n = batch.num_rows
+        # partition ids
+        if self.partition_by:
+            pcols = [
+                np.atleast_1d(e.eval(batch)).tolist()
+                for e in self.partition_by
+            ]
+            pkeys = [tuple(c[i] for c in pcols) for i in range(n)]
+        else:
+            pkeys = [()] * n
+        order_idx, okeys = (
+            self._order_index(batch)
+            if self.order_by
+            else (np.arange(n, dtype=np.int64), [()] * n)
+        )
+        # group ordered rows by partition
+        parts: dict = {}
+        for pos in order_idx.tolist():
+            parts.setdefault(pkeys[pos], []).append(pos)
+        out = np.empty(n, dtype=object)
+        for rows in parts.values():
+            self._eval_partition(rows, okeys, batch, out)
+        # densify numeric results
+        try:
+            tight = np.asarray(out.tolist())
+            if tight.dtype.kind in "ifb":
+                return tight
+        except (ValueError, TypeError):
+            pass
+        return out
+
+    def _eval_partition(self, rows, okeys, batch, out) -> None:
+        k = len(rows)
+        w = self.wname
+        if w == "row_number":
+            for j, r in enumerate(rows):
+                out[r] = j + 1
+            return
+        if w in ("rank", "dense_rank", "percent_rank", "cume_dist"):
+            rank = 0
+            dense = 0
+            ranks = np.empty(k, np.int64)
+            for j, r in enumerate(rows):
+                if j == 0 or okeys[r] != okeys[rows[j - 1]]:
+                    rank = j + 1
+                    dense += 1
+                ranks[j] = dense if w == "dense_rank" else rank
+            if w in ("rank", "dense_rank"):
+                for j, r in enumerate(rows):
+                    out[r] = int(ranks[j])
+                return
+            if w == "percent_rank":
+                for j, r in enumerate(rows):
+                    out[r] = 0.0 if k <= 1 else (ranks[j] - 1) / (k - 1)
+                return
+            # cume_dist: fraction of rows with key <= current
+            last_of_key = {}
+            for j, r in enumerate(rows):
+                last_of_key[okeys[r]] = j
+            for j, r in enumerate(rows):
+                out[r] = (last_of_key[okeys[r]] + 1) / k
+            return
+        if w == "ntile":
+            # SQL NTILE: the first (k mod n) buckets hold ceil(k/n) rows,
+            # the rest floor(k/n) — consecutive bucket ids even when
+            # rows < buckets
+            n_buckets = int(self.params[0])
+            big = k // n_buckets + 1
+            r_big = k % n_buckets
+            for j, r in enumerate(rows):
+                if j < r_big * big:
+                    out[r] = j // big + 1
+                else:
+                    out[r] = r_big + (j - r_big * big) // (k // n_buckets) + 1
+            return
+        if w in ("lead", "lag"):
+            offset, default = self.params
+            vals = np.atleast_1d(self.args[0].eval(batch))
+            shift = offset if w == "lead" else -offset
+            for j, r in enumerate(rows):
+                src = j + shift
+                out[r] = (
+                    _scalarize(vals[rows[src]])
+                    if 0 <= src < k
+                    else default
+                )
+            return
+        raise PlanError(f"unknown window function {w!r}")
+
+    def __repr__(self):
+        return self.name
+
+
+class _SortKey:
+    """Total-order wrapper: mixed / non-comparable values fall back to
+    string comparison instead of raising mid-projection."""
+
+    __slots__ = ("v", "isnull")
+
+    def __init__(self, v, isnull):
+        self.v = v
+        self.isnull = isnull
+
+    def __lt__(self, other):
+        if self.isnull or other.isnull:
+            return False  # null bucket already separated by the tuple
+        try:
+            return self.v < other.v
+        except TypeError:
+            return str(self.v) < str(other.v)
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _scalarize(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
 @dataclass(frozen=True, eq=False)
 class FieldAccessExpr(Expr):
     inner: Expr
@@ -472,14 +675,26 @@ class ScalarFunctionExpr(Expr):
                 raise PlanError(f"{self.fname} needs arguments")
             f0 = self.args[0].out_field(schema)
             return Field(self.name, f0.dtype)
+        if callable(ot) and not isinstance(ot, DataType):
+            # computed output type: LIST/STRUCT functions derive element /
+            # child fields from their argument fields
+            f = ot(tuple(a.out_field(schema) for a in self.args))
+            return Field(self.name, f.dtype, f.nullable, f.children)
         return Field(self.name, ot)
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
+        fn = self._fn()
         # domain errors (sqrt(-x), log(0)) follow SQL NaN/NULL semantics —
         # no warnings
         with np.errstate(invalid="ignore", divide="ignore"):
-            out = self._fn().np_fn(*[a.eval(batch) for a in self.args])
-        out = np.asarray(out)
+            if fn.rowwise_nullary:
+                # per-row zero-arg functions (random, uuid) need the row
+                # count — a broadcast scalar would repeat one draw
+                out = fn.np_fn(batch.num_rows)
+            else:
+                out = fn.np_fn(*[a.eval(batch) for a in self.args])
+        if not isinstance(out, np.ndarray):
+            out = np.asarray(out)
         if out.ndim == 0:  # zero-arg / scalar result → broadcast
             out = np.full(batch.num_rows, out.item())
         return out

@@ -34,10 +34,15 @@ _TS = DataType.TIMESTAMP_MS
 @dataclass(frozen=True)
 class ScalarFn:
     np_fn: Callable  # (*numpy arrays/scalars) -> numpy array
-    out_type: object  # DataType | "same"
+    # DataType | "same" (argument 0's type) | callable(arg_fields)->Field
+    # (computed — LIST/STRUCT functions derive element types from args)
+    out_type: object
     jax_fn: Callable | None = None  # (*jax arrays) -> jax array
     min_args: int = 1
     max_args: int | None = None  # None = same as min
+    # zero-arg functions that draw PER ROW (random, uuid): np_fn receives
+    # the batch row count instead of being broadcast from one scalar
+    rowwise_nullary: bool = False
 
 
 def _map1(fn):
@@ -347,6 +352,180 @@ def _concat_ws(sep, *arrays):
     return out
 
 
+# -- string additions: edit distance, hashes, encodings ------------------
+
+
+def _levenshtein(a: str, b: str) -> int:
+    """Classic two-row DP (the sizes here are projection cells, not bulk
+    data — a C implementation would be noise next to the object-array
+    iteration around it)."""
+    if a == b:
+        return 0
+    if not a:
+        return len(b)
+    if not b:
+        return len(a)
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(
+                prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb)
+            ))
+        prev = cur
+    return prev[-1]
+
+
+def _find_in_set(s: str, str_list: str) -> int:
+    """MySQL find_in_set: 1-based index of s in a comma-separated list;
+    0 when absent."""
+    parts = str_list.split(",")
+    try:
+        return parts.index(s) + 1
+    except ValueError:
+        return 0
+
+
+def _overlay(s: str, repl: str, pos, length=None) -> str:
+    """Postgres overlay(string PLACING repl FROM pos [FOR length])."""
+    p = int(pos)
+    ln = len(repl) if length is None else int(length)
+    return s[: p - 1] + repl + s[p - 1 + ln :]
+
+
+def _substr_index(s: str, delim: str, count) -> str:
+    """MySQL substring_index: everything before (count>0) / after
+    (count<0) the count-th delimiter occurrence."""
+    n = int(count)
+    if n == 0 or not delim:
+        return ""
+    parts = s.split(delim)
+    if n > 0:
+        return delim.join(parts[:n])
+    return delim.join(parts[n:])
+
+
+def _hash_fn(algo: str):
+    import hashlib
+
+    def one(s):
+        h = hashlib.new(algo)
+        h.update(s.encode() if isinstance(s, str) else bytes(s))
+        return h.hexdigest()
+
+    return _map1(one)
+
+
+def _encode(s, enc):
+    import base64
+
+    data = s.encode() if isinstance(s, str) else bytes(s)
+    enc = str(enc).lower()
+    if enc == "hex":
+        return data.hex()
+    if enc == "base64":
+        # datafusion uses unpadded url-safe-less base64? standard with
+        # padding stripped matches arrow's base64 for round-trips here
+        return base64.b64encode(data).decode().rstrip("=")
+    raise PlanError(f"encode: unknown encoding {enc!r} (hex|base64)")
+
+
+def _decode(s, enc):
+    import base64
+
+    enc = str(enc).lower()
+    if enc == "hex":
+        return bytes.fromhex(s).decode(errors="replace")
+    if enc == "base64":
+        pad = "=" * (-len(s) % 4)
+        return base64.b64decode(s + pad).decode(errors="replace")
+    raise PlanError(f"decode: unknown encoding {enc!r} (hex|base64)")
+
+
+def _digest(s, method):
+    import hashlib
+
+    h = hashlib.new(str(method).lower())
+    h.update(s.encode() if isinstance(s, str) else bytes(s))
+    return h.hexdigest()
+
+
+def _arrow_typeof(x):
+    a = np.asarray(x)
+    if a.dtype == object:
+        probe = next((v for v in a.tolist() if v is not None), None)
+        if isinstance(probe, str) or probe is None:
+            name = "Utf8"
+        elif isinstance(probe, dict):
+            name = "Struct"
+        elif isinstance(probe, (list, tuple)):
+            name = "List"
+        else:
+            name = type(probe).__name__
+    else:
+        name = {
+            "int32": "Int32", "int64": "Int64", "float32": "Float32",
+            "float64": "Float64", "bool": "Boolean",
+        }.get(a.dtype.name, a.dtype.name)
+    out = np.empty(max(a.size, 1), dtype=object)
+    out[:] = name
+    return out
+
+
+def _in_list(v, *candidates):
+    """Membership against a candidate tuple (the ``in_list`` function,
+    reference functions.py:323); NULL value → NULL."""
+    vals = np.atleast_1d(np.asarray(v, dtype=object))
+    cands = [
+        (np.atleast_1d(np.asarray(c, dtype=object))) for c in candidates
+    ]
+    out = np.empty(len(vals), dtype=object)
+    for i, x in enumerate(vals):
+        if x is None:
+            out[i] = None
+            continue
+        out[i] = any(
+            _eq_scalar(x, (c[i] if len(c) > 1 else c[0])) for c in cands
+        )
+    return out
+
+
+def _eq_scalar(a, b):
+    if b is None:
+        return False
+    try:
+        return bool(a == b)
+    except Exception:
+        return False
+
+
+_STRING_FNS2 = {
+    "levenshtein": ScalarFn(_map_n(_levenshtein), _I64, None, 2),
+    "find_in_set": ScalarFn(_map_n(_find_in_set), _I64, None, 2),
+    "overlay": ScalarFn(_map_n(_overlay), _STR, None, 3, 4),
+    "substr_index": ScalarFn(_map_n(_substr_index), _STR, None, 3),
+    "bit_length": ScalarFn(
+        _map1(lambda s: len(s.encode()) * 8 if isinstance(s, str) else 64),
+        _I64,
+    ),
+    "sha224": ScalarFn(_hash_fn("sha224"), _STR),
+    "sha256": ScalarFn(_hash_fn("sha256"), _STR),
+    "sha384": ScalarFn(_hash_fn("sha384"), _STR),
+    "sha512": ScalarFn(_hash_fn("sha512"), _STR),
+    "encode": ScalarFn(_map_n(_encode), _STR, None, 2),
+    "decode": ScalarFn(_map_n(_decode), _STR, None, 2),
+    "digest": ScalarFn(_map_n(_digest), _STR, None, 2),
+    "uuid": ScalarFn(
+        lambda n: np.array(
+            [str(__import__("uuid").uuid4()) for _ in range(n)], object
+        ),
+        _STR, None, 0, 0, rowwise_nullary=True,
+    ),
+    "arrow_typeof": ScalarFn(_arrow_typeof, _STR),
+    "in_list": ScalarFn(_in_list, _BOOL, None, 2, 64),
+}
+
+
 # -- math functions ------------------------------------------------------
 
 
@@ -435,6 +614,38 @@ _MATH_FNS = {
         None,
         1,
         2,
+    ),
+    "asinh": ScalarFn(np.arcsinh, _F64, _jax_fn("arcsinh")),
+    "acosh": ScalarFn(np.arccosh, _F64, _jax_fn("arccosh")),
+    "atanh": ScalarFn(np.arctanh, _F64, _jax_fn("arctanh")),
+    "cot": ScalarFn(
+        lambda x: 1.0 / np.tan(np.asarray(x, np.float64)),
+        _F64,
+        lambda x: 1.0 / _jax("tan")(x),
+    ),
+    "factorial": ScalarFn(
+        _map1(lambda n: math.factorial(int(n))), _I64
+    ),
+    "gcd": ScalarFn(
+        lambda a, b: np.gcd(
+            np.asarray(a, np.int64), np.asarray(b, np.int64)
+        ),
+        _I64, _jax_fn("gcd"), 2,
+    ),
+    "lcm": ScalarFn(
+        lambda a, b: np.lcm(
+            np.asarray(a, np.int64), np.asarray(b, np.int64)
+        ),
+        _I64, _jax_fn("lcm"), 2,
+    ),
+    "iszero": ScalarFn(
+        lambda x: np.asarray(x, np.float64) == 0.0,
+        _BOOL,
+        lambda x: x == 0.0,
+    ),
+    "random": ScalarFn(
+        lambda n: np.random.default_rng().random(n), _F64, None, 0, 0,
+        rowwise_nullary=True,
     ),
 }
 
@@ -529,11 +740,119 @@ def _date_bin(stride_ms, ts, origin_ms=0):
     return (t - o) // s * s + o
 
 
+def _parse_ts_cell(x, formatters, unit_scale_ms: float):
+    """One cell → epoch ms.  Strings go through the formatters (chrono-%
+    style, strptime-compatible) or ISO parse; numerics scale by the
+    function's unit (to_timestamp_seconds → ×1000, micros → ÷1000)."""
+    if x is None:
+        return None
+    if isinstance(x, str):
+        if formatters:
+            import datetime as _dt
+
+            for f in formatters:
+                try:
+                    d = _dt.datetime.strptime(x, str(f))
+                    if d.tzinfo is None:
+                        d = d.replace(tzinfo=_dt.timezone.utc)
+                    return int(d.timestamp() * 1000)
+                except ValueError:
+                    continue
+            raise PlanError(
+                f"to_timestamp: {x!r} matches none of {formatters}"
+            )
+        return int(np.datetime64(x, "ms").astype(np.int64))
+    return int(round(float(x) * unit_scale_ms))
+
+
+def _to_timestamp_family(unit_scale_ms: float):
+    def run(v, *formatters):
+        fmts = [
+            str(np.atleast_1d(f)[0]) for f in formatters
+        ] if formatters else []
+        a = np.atleast_1d(np.asarray(v))
+        if a.dtype != object and a.dtype.kind in "iuf":
+            return np.round(
+                a.astype(np.float64) * unit_scale_ms
+            ).astype(np.int64)
+        out = np.empty(len(a), dtype=object)
+        for i, x in enumerate(a.tolist()):
+            out[i] = _parse_ts_cell(x, fmts, unit_scale_ms)
+        if all(x is not None for x in out):
+            return out.astype(np.int64)
+        return out
+
+    return run
+
+
+def _to_unixtime(v, *formatters):
+    ms = _to_timestamp_family(1.0)(v, *formatters)
+    if ms.dtype == object:
+        return np.array(
+            [None if x is None else x // 1000 for x in ms], object
+        )
+    return ms // 1000
+
+
+def _from_unixtime(secs):
+    return np.asarray(secs, np.int64) * 1000
+
+
+def _make_date(y, m, d):
+    ys = np.atleast_1d(np.asarray(y, np.int64))
+    ms_ = np.atleast_1d(np.asarray(m, np.int64))
+    ds = np.atleast_1d(np.asarray(d, np.int64))
+    n = max(len(ys), len(ms_), len(ds))
+
+    def pick(a, i):
+        return int(a[i] if len(a) > 1 else a[0])
+
+    import datetime as _dt
+
+    out = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        out[i] = int(
+            _dt.datetime(
+                pick(ys, i), pick(ms_, i), pick(ds, i),
+                tzinfo=_dt.timezone.utc,
+            ).timestamp() * 1000
+        )
+    return out
+
+
 _DATE_FNS = {
     "date_trunc": ScalarFn(_date_trunc, _TS, None, 2),
+    "datetrunc": ScalarFn(_date_trunc, _TS, None, 2),
     "date_part": ScalarFn(_date_part, _F64, None, 2),
+    "datepart": ScalarFn(_date_part, _F64, None, 2),
     "extract": ScalarFn(_date_part, _F64, None, 2),
     "to_timestamp_millis": ScalarFn(_to_timestamp_millis, _TS),
+    # the engine's timestamp storage is epoch-millis; every to_timestamp_*
+    # variant converts its input unit to ms (reference functions.py:909-955
+    # — arrow precisions there; one storage precision here)
+    "to_timestamp": ScalarFn(_to_timestamp_family(1000.0), _TS, None, 1, 5),
+    "to_timestamp_seconds": ScalarFn(
+        _to_timestamp_family(1000.0), _TS, None, 1, 5
+    ),
+    "to_timestamp_micros": ScalarFn(
+        _to_timestamp_family(1e-3), _TS, None, 1, 5
+    ),
+    "to_timestamp_nanos": ScalarFn(
+        _to_timestamp_family(1e-6), _TS, None, 1, 5
+    ),
+    "to_unixtime": ScalarFn(_to_unixtime, _I64, None, 1, 5),
+    "from_unixtime": ScalarFn(_from_unixtime, _TS),
+    "make_date": ScalarFn(_make_date, _TS, None, 3),
+    "current_date": ScalarFn(
+        lambda: np.int64(
+            __import__("time").time() * 1000 // 86_400_000 * 86_400_000
+        ),
+        _TS, None, 0, 0,
+    ),
+    "current_time": ScalarFn(
+        lambda: np.int64(__import__("time").time() * 1000 % 86_400_000),
+        _I64, None, 0, 0,
+    ),
     "date_bin": ScalarFn(_date_bin, _TS, None, 2, 3),
     "now": ScalarFn(
         lambda: np.int64(__import__("time").time() * 1000), _TS, None, 0, 0
@@ -584,11 +903,19 @@ _COND_FNS = {
 }
 
 
+def _array_fns():
+    from denormalized_tpu.logical.array_functions import ARRAY_FNS
+
+    return ARRAY_FNS
+
+
 REGISTRY: dict[str, ScalarFn] = {
     **_STRING_FNS,
+    **_STRING_FNS2,
     **_MATH_FNS,
     **_DATE_FNS,
     **_COND_FNS,
+    **_array_fns(),
 }
 
 

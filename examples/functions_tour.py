@@ -103,6 +103,72 @@ def main():
     broker.stop()
     print(f"\n{emitted} window rows emitted")
     assert emitted > 0
+    array_tour()
+
+
+def array_tour():
+    """The LIST function family over a windowed array_agg — a dozen of
+    the reference's array_* exports (functions.py:1029-1502) applied to
+    first-class LIST columns."""
+    import numpy as np
+
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.sources.memory import MemorySource
+
+    sch = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.STRING, nullable=False),
+            Field("v", DataType.FLOAT64),
+        ]
+    )
+    rng = np.random.default_rng(1)
+    ts = 1_700_000_000_000 + np.sort(rng.integers(0, 3000, 120))
+    ks = np.array(["alpha", "beta"], object)[rng.integers(0, 2, 120)]
+    vs = rng.integers(0, 6, 120).astype(np.float64)
+    ctx = Context()
+    ds = (
+        ctx.from_source(
+            MemorySource.from_batches(
+                [RecordBatch(sch, [ts, ks, vs])], timestamp_column="ts"
+            )
+        )
+        .window(["k"], [F.array_agg(col("v")).alias("vals")], 1000)
+        # 1-2: size and distinct
+        .with_column("n", F.array_length(col("vals")))
+        .with_column("uniq", F.array_sort(F.array_distinct(col("vals"))))
+        # 3-6: element access, search, slicing
+        .with_column("first", F.array_element(col("vals"), lit(1)))
+        .with_column("has3", F.array_has(col("vals"), lit(3.0)))
+        .with_column("pos3", F.array_position(col("vals"), lit(3.0)))
+        .with_column("head", F.array_slice(col("vals"), lit(1), lit(3)))
+        # 7-10: mutation
+        .with_column("plus9", F.array_append(col("uniq"), lit(9.0)))
+        .with_column("no0", F.array_remove_all(col("uniq"), lit(0.0)))
+        .with_column("capped", F.array_resize(col("uniq"), lit(3), lit(0.0)))
+        .with_column("both", F.array_concat(col("head"), col("head")))
+        # 11-13: set ops and rendering
+        .with_column(
+            "evens", F.array_intersect(col("uniq"), F.make_array(
+                lit(0.0), lit(2.0), lit(4.0)
+            ))
+        )
+        .with_column("txt", F.array_to_string(col("uniq"), lit(",")))
+        .with_column("n_uniq", F.array_length(col("uniq")))
+        .filter(col("n") > 0)
+    )
+    out = ds.collect()
+    print("\narray function tour (13 array_* functions over array_agg):")
+    for i in range(min(out.num_rows, 4)):
+        print(
+            f"  k={out.column('k')[i]} n={int(out.column('n')[i])} "
+            f"uniq={out.column('uniq')[i]} has3={out.column('has3')[i]} "
+            f"head={out.column('head')[i]} evens={out.column('evens')[i]} "
+            f"txt={out.column('txt')[i]!r}"
+        )
+    assert out.num_rows > 0
+    assert out.schema.field("uniq").dtype is DataType.LIST
 
 
 if __name__ == "__main__":
