@@ -251,6 +251,8 @@ class StreamingWindowExec(ExecOperator):
             # deltas in _flush would miss
             m["partial_merges"] = self._backend.merges
             m["device_steps"] = self._backend.merges
+        m["bytes_h2d"] = self._backend.bytes_h2d
+        m["bytes_d2h"] = self._backend.bytes_d2h
         return m
 
     def _label(self):

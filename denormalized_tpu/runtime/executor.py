@@ -79,6 +79,7 @@ def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
     from denormalized_tpu.physical.base import Marker
 
     root = build_physical(plan, ctx)
+    ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
     orch, coord = _attach_checkpointing(root, ctx)
     flag = ShutdownFlag()
     restore = _install_signal_handlers(flag)
@@ -105,6 +106,7 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     from denormalized_tpu.physical.base import Marker
 
     root = build_physical(plan, ctx)
+    ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
     orch, coord = _attach_checkpointing(root, ctx)
     try:
         for item in root.run():
