@@ -280,15 +280,225 @@ def test_interner_survives_lone_surrogates():
     assert "bad" in ci.value_of(np.array([1]))[0]
 
 
-def test_avro_union_null_must_come_first():
-    with pytest.raises(FormatError, match="null"):
-        parse_avro_schema(
-            {
-                "type": "record",
-                "name": "R",
-                "fields": [{"name": "x", "type": ["long", "null"]}],
-            }
-        )
+def test_avro_union_null_second_branch_order_preserved():
+    """['T', 'null'] unions are valid Avro — branch 0 must stay T on the
+    wire (a decoder that assumed branch 0 = null would silently misread
+    every value; round-4 lifted the old null-first restriction)."""
+    from denormalized_tpu.formats.avro_codec import decode_record, encode_record
+
+    sch = parse_avro_schema(
+        {
+            "type": "record",
+            "name": "R",
+            "fields": [{"name": "x", "type": ["long", "null"]}],
+        }
+    )
+    name, t, nullable = sch.fields[0]
+    assert nullable
+    assert decode_record(sch, encode_record(sch, {"x": 7}))["x"] == 7
+    assert decode_record(sch, encode_record(sch, {"x": None}))["x"] is None
+    # wire check: value branch is index 0 → first varint is zigzag(0)=0x00
+    assert encode_record(sch, {"x": 7})[0] == 0x00
+    assert encode_record(sch, {"x": None})[0] == 0x02  # zigzag(1)
+
+
+NESTED_AVRO_DECL = {
+    "type": "record",
+    "name": "rides.Trip",
+    "fields": [
+        {"name": "occurred_at_ms",
+         "type": {"type": "long", "logicalType": "timestamp-millis"}},
+        {"name": "driver", "type": {
+            "type": "record", "name": "Driver",
+            "fields": [
+                {"name": "id", "type": "string"},
+                {"name": "location", "type": {
+                    "type": "record", "name": "GeoPoint",
+                    "fields": [
+                        {"name": "lat", "type": "double"},
+                        {"name": "lng", "type": "double"},
+                    ]}},
+            ]}},
+        # named reference: GeoPoint defined above, reused by (short) name
+        {"name": "destination", "type": ["null", "GeoPoint"]},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "fares", "type": {"type": "map", "values": "double"}},
+        {"name": "status", "type": {
+            "type": "enum", "name": "Status",
+            "symbols": ["REQUESTED", "ACTIVE", "DONE"]}},
+        {"name": "token", "type": {"type": "fixed", "name": "Tok", "size": 4}},
+        {"name": "fare_or_note", "type": ["null", "double", "float"]},
+    ],
+}
+
+
+def test_avro_nested_roundtrip():
+    """Recursive Avro: nested records, named refs, arrays (incl. empty),
+    maps, enums, fixed, and a 3-branch union — full encode→decode→batch
+    round trip (reference: DataFusion's recursive avro_to_arrow reader,
+    formats/decoders/utils.rs:14)."""
+    schema = parse_avro_schema(NESTED_AVRO_DECL)
+    engine = schema.to_engine_schema()
+    assert engine.field("driver").dtype is DataType.STRUCT
+    drv = engine.field("driver")
+    assert [c.name for c in drv.children] == ["id", "location"]
+    loc = drv.children[1]
+    assert loc.dtype is DataType.STRUCT
+    assert [c.name for c in loc.children] == ["lat", "lng"]
+    assert engine.field("destination").dtype is DataType.STRUCT
+    assert engine.field("destination").nullable
+    assert engine.field("tags").dtype is DataType.LIST
+    assert engine.field("fares").dtype is DataType.STRUCT  # dynamic-key map
+    assert engine.field("status").dtype is DataType.STRING
+    assert engine.field("fare_or_note").dtype is DataType.FLOAT64
+
+    records = [
+        {
+            "occurred_at_ms": 1000,
+            "driver": {"id": "d1", "location": {"lat": 37.77, "lng": -122.4}},
+            "destination": {"lat": 40.7, "lng": -74.0},
+            "tags": ["airport", "pool"],
+            "fares": {"base": 5.0, "tip": 1.5},
+            "status": "ACTIVE",
+            "token": b"\x01\x02\x03\x04",
+            "fare_or_note": 12.5,
+        },
+        {
+            "occurred_at_ms": 2000,
+            "driver": {"id": "d2", "location": {"lat": 0.0, "lng": 0.0}},
+            "destination": None,
+            "tags": [],
+            "fares": {},
+            "status": "DONE",
+            "token": b"\xff\xff\xff\xff",
+            "fare_or_note": None,
+        },
+    ]
+    from denormalized_tpu.formats.avro_codec import decode_record
+
+    for r in records:
+        got = decode_record(schema, encode_record(schema, r))
+        assert got == r, got
+
+    dec = AvroDecoder(None, schema)
+    assert dec._native is None, "nested schema must use the Python decoder"
+    for r in records:
+        dec.push(encode_record(schema, r))
+    batch = dec.flush()
+    assert batch.num_rows == 2
+    assert batch.column("driver")[0]["location"]["lat"] == 37.77
+    assert batch.column("tags")[0] == ["airport", "pool"]
+    assert batch.column("fares")[0]["tip"] == 1.5
+    assert batch.column("status").tolist() == ["ACTIVE", "DONE"]
+    m = batch.mask("destination")
+    assert m is not None and m.tolist() == [True, False]
+
+
+def test_avro_array_negative_block_count():
+    """Writers may emit blocks with negative count + byte size (Avro spec
+    §blocks); the decoder must honor both forms."""
+    from denormalized_tpu.formats.avro_codec import (
+        _zigzag_encode,
+        decode_record,
+    )
+
+    decl = {
+        "type": "record",
+        "name": "R",
+        "fields": [{"name": "xs", "type": {"type": "array", "items": "long"}}],
+    }
+    schema = parse_avro_schema(decl)
+    # hand-build: block of -2 items (byte size 2), items 7, 9, terminator
+    payload = bytearray()
+    payload += _zigzag_encode(-2)
+    payload += _zigzag_encode(2)  # byte size of the block
+    payload += _zigzag_encode(7)
+    payload += _zigzag_encode(9)
+    payload += _zigzag_encode(0)
+    assert decode_record(schema, bytes(payload))["xs"] == [7, 9]
+
+
+def test_avro_recursive_named_type():
+    """Self-referential records (linked-list shape) resolve, decode, AND
+    convert: the back-reference becomes a childless STRUCT (host dict
+    column) instead of recursing forever."""
+    decl = {
+        "type": "record",
+        "name": "Node",
+        "fields": [
+            {"name": "v", "type": "long"},
+            {"name": "next", "type": ["null", "Node"]},
+        ],
+    }
+    schema = parse_avro_schema(decl)
+    from denormalized_tpu.formats.avro_codec import decode_record
+
+    rec = {"v": 1, "next": {"v": 2, "next": {"v": 3, "next": None}}}
+    assert decode_record(schema, encode_record(schema, rec)) == rec
+    engine = schema.to_engine_schema()  # must not RecursionError
+    nxt = engine.field("next")
+    assert nxt.dtype is DataType.STRUCT
+    # one level expands (v + next), then the back-reference degrades to a
+    # childless STRUCT (dict column) instead of recursing forever
+    assert [c.name for c in nxt.children] == ["v", "next"]
+    assert nxt.children[1].children == ()
+    dec = AvroDecoder(None, schema)
+    dec.push(encode_record(schema, rec))
+    batch = dec.flush()
+    assert batch.column("next")[0] == {"v": 2, "next": {"v": 3, "next": None}}
+
+
+def test_avro_union_of_distinct_records_rejected():
+    """Two record branches both map to STRUCT but with different children —
+    no single column schema exists; conversion must fail, not silently
+    adopt the first branch's fields."""
+    decl = {
+        "type": "record",
+        "name": "R",
+        "fields": [{"name": "x", "type": [
+            {"type": "record", "name": "A",
+             "fields": [{"name": "a", "type": "long"}]},
+            {"type": "record", "name": "B",
+             "fields": [{"name": "b", "type": "string"}]},
+        ]}],
+    }
+    schema = parse_avro_schema(decl)
+    with pytest.raises(FormatError, match="mixed"):
+        schema.to_engine_schema()
+
+
+def test_avro_block_count_bomb_rejected():
+    """A tiny payload declaring a huge block of zero-byte items (array of
+    nulls) must be rejected, not allocated: decompression-bomb guard on
+    the Kafka ingest path."""
+    from denormalized_tpu.formats.avro_codec import (
+        _zigzag_encode,
+        decode_record,
+    )
+
+    decl = {
+        "type": "record",
+        "name": "R",
+        "fields": [{"name": "xs", "type": {"type": "array", "items": "null"}}],
+    }
+    schema = parse_avro_schema(decl)
+    payload = _zigzag_encode(1 << 25) + _zigzag_encode(0)
+    with pytest.raises(FormatError, match="capacity"):
+        decode_record(schema, payload)
+
+
+def test_avro_mixed_union_dtype_rejected():
+    """A union whose branches map to incompatible engine dtypes has no
+    column type — schema conversion must fail loudly, not guess.  Numeric
+    branches widen instead (covered by NESTED_AVRO_DECL's fare_or_note)."""
+    decl = {
+        "type": "record",
+        "name": "R",
+        "fields": [{"name": "x", "type": ["null", "string", "long"]}],
+    }
+    schema = parse_avro_schema(decl)
+    with pytest.raises(FormatError, match="mixed"):
+        schema.to_engine_schema()
 
 
 def test_avro_zigzag_extremes():

@@ -634,6 +634,109 @@ def test_avro_from_topic_pipeline(broker):
     assert sum(counts.values()) >= 108, counts
 
 
+def test_nested_avro_from_topic_pipeline(broker):
+    """Rideshare-shape NESTED Avro payload (record-in-record + array +
+    enum) through from_topic(encoding='avro'), struct field accessors, and
+    a windowed aggregation (VERDICT round-3 item 7; reference decodes
+    arbitrary Avro via DataFusion's recursive reader,
+    formats/decoders/utils.rs:14, decoders/avro.rs:11-54)."""
+    from denormalized_tpu.formats.avro_codec import (
+        AvroDecoder,
+        encode_record,
+        parse_avro_schema,
+    )
+
+    decl = {
+        "type": "record",
+        "name": "Trip",
+        "fields": [
+            {"name": "occurred_at_ms",
+             "type": {"type": "long", "logicalType": "timestamp-millis"}},
+            {"name": "driver", "type": {
+                "type": "record", "name": "Driver",
+                "fields": [
+                    {"name": "id", "type": "string"},
+                    {"name": "gps", "type": {
+                        "type": "record", "name": "Gps",
+                        "fields": [
+                            {"name": "speed", "type": "double"},
+                            {"name": "lat", "type": "double"},
+                        ]}},
+                ]}},
+            {"name": "tags", "type": {"type": "array", "items": "string"}},
+            {"name": "status", "type": {
+                "type": "enum", "name": "Status",
+                "symbols": ["REQUESTED", "ACTIVE", "DONE"]}},
+        ],
+    }
+    schema = parse_avro_schema(decl)
+    broker.create_topic("trips_avro", partitions=1)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        for chunk in range(5):
+            msgs = []
+            for i in range(chunk * 40, (chunk + 1) * 40):
+                msgs.append(
+                    encode_record(
+                        schema,
+                        {
+                            "occurred_at_ms": t0 + i * 25,
+                            "driver": {
+                                "id": f"d{i % 3}",
+                                "gps": {"speed": float(i % 7), "lat": 37.0},
+                            },
+                            "tags": ["x"] * (i % 3),
+                            "status": "ACTIVE" if i % 2 else "DONE",
+                        },
+                    )
+                )
+            broker.produce("trips_avro", 0, msgs, ts_ms=t0 + chunk)
+            time.sleep(0.15)
+
+    threading.Thread(target=feed, daemon=True).start()
+    ctx = Context()
+    src = ctx.from_topic(
+        "trips_avro",
+        bootstrap_servers=broker.bootstrap,
+        timestamp_column="occurred_at_ms",
+        encoding="avro",
+        avro_schema=decl,
+    )
+    probe = ctx.table("trips_avro").partitions()[0]
+    assert isinstance(probe._decoder, AvroDecoder)
+    assert probe._decoder._native is None, (
+        "nested Avro must route to the recursive Python decoder"
+    )
+
+    ds = (
+        src.with_column("speed", col("driver").field("gps").field("speed"))
+        .with_column("driver_id", col("driver").field("id"))
+        .window(
+            ["driver_id"],
+            [
+                F.count(col("speed")).alias("cnt"),
+                F.max(col("speed")).alias("top_speed"),
+            ],
+            1000,
+        )
+    )
+    counts: dict = {}
+    top: dict = {}
+    deadline = time.time() + 20
+    for batch in ds.stream():
+        for i in range(batch.num_rows):
+            key = batch.column("driver_id")[i]
+            counts[key] = counts.get(key, 0) + int(batch.column("cnt")[i])
+            top[key] = max(top.get(key, 0.0), float(batch.column("top_speed")[i]))
+        if sum(counts.values()) >= 120 or time.time() > deadline:
+            break
+    # 3 windows close (rows 0..119), keys d0/d1/d2 each get 40 rows
+    assert sum(counts.values()) >= 120, counts
+    assert set(counts) == {"d0", "d1", "d2"}
+    assert max(top.values()) == 6.0, top
+
+
 def test_broker_outage_recovery():
     """A broker outage yields empty batches with reconnect attempts (the
     reference's log-and-retry on recv errors, kafka_stream_read.rs:210-218);
