@@ -84,14 +84,19 @@ def _warm_batches(batch_rows: int, floor: int, available: int) -> int:
 
 def _sweep_stale_holders():
     """SIGKILL leftover python processes that could be holding the
-    single-client axon tunnel: legacy subprocess probes (older bench
-    versions abandoned them on timeout) or interactive ``jax.devices()``
-    one-liners.  A process qualifies only if it is axon-capable by
-    ORIGINAL environment (``JAX_PLATFORMS=axon``), is python, and is
-    neither this process nor one of its ancestors — inside this container
-    that set is exactly the stale holders.  pytest / chip_ab command lines
-    are exempt (a concurrent test run or A/B harness is legitimate), and
-    ``BENCH_SWEEP=0`` disables the sweep entirely."""
+    single-client axon tunnel.  A process qualifies if it is axon-capable
+    by ORIGINAL environment (``JAX_PLATFORMS=axon``), is python, and is
+    neither this process nor one of its ancestors.
+
+    Round-4 hardening: NO command-line exemptions.  Round 3 exempted
+    pytest/chip_ab as "legitimate concurrent work" — but on a
+    single-client tunnel a leftover exempted A/B harness is precisely the
+    process that wedges the driver's end-of-round bench (BENCH_r03:
+    "backend init exceeded 600s").  The bench owns the tunnel while it
+    runs; anything else axon-capable is reaped.  The A/B harness persists
+    its report incrementally, so being reaped costs it nothing.
+    ``BENCH_SWEEP=0`` disables the sweep entirely (and is set by the
+    harness's own in-process bench calls)."""
     import signal
 
     if os.environ.get("BENCH_SWEEP", "1") == "0":
@@ -123,16 +128,32 @@ def _sweep_stale_holders():
             continue
         if "python" not in cmd:
             continue
-        if "pytest" in cmd or "chip_ab" in cmd or "bench.py" in cmd:
-            continue
-        if "BENCH_SWEEP_EXEMPT=1" in penv:
-            continue
         if "JAX_PLATFORMS=axon" in penv and "PALLAS_AXON" in penv:
             log(f"sweeping stale axon-capable process {pid}: {cmd[:120].strip()}")
             try:
                 os.kill(pid, signal.SIGKILL)
             except Exception:
                 pass
+
+
+# the loopback relay (tunnel ingress) listens on these when the TPU path
+# is alive at all; when every probe port is closed the axon client's
+# /v1/claim dials fail instantly and it retries forever — there is no
+# point burning the init budget, and no point falling back early either:
+# poll until the relay appears or the budget expires
+_RELAY_PROBE_PORTS = (8082, 8083, 8087, 8092, 8093, 8097)
+
+
+def _relay_open() -> bool:
+    import socket
+
+    for port in _RELAY_PROBE_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return True
+        except OSError:
+            continue
+    return False
 
 
 def _exec_cpu_fallback(reason: str):
@@ -153,8 +174,34 @@ def _exec_cpu_fallback(reason: str):
 DEVICE_FALLBACK = os.environ.get("BENCH_CPU_FALLBACK_REASON")
 
 
+def _tpu_init_fail(reason: str):
+    """On init failure: exec a CPU rerun (default), or exit(4) when
+    ``BENCH_TPU_INIT_REQUIRED=1`` — the A/B harness sets it so a dead
+    tunnel produces a retryable failure instead of a useless CPU report."""
+    if os.environ.get("BENCH_TPU_INIT_REQUIRED") == "1":
+        log(f"TPU init required but failed: {reason}")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(4)
+    _exec_cpu_fallback(reason)
+
+
 def init_backend() -> str:
     """Initialize the JAX backend in THIS process; return 'tpu' or 'cpu'.
+
+    Round-4 phased acquisition (the r1-r3 benches never produced a TPU
+    number; diagnosis: when the loopback relay is down, the axon client's
+    claim dials fail instantly and it retries forever, so a blind 600s
+    watchdog burns its whole budget inside jax.devices()):
+
+      1. sweep stale axon-capable processes (single-client tunnel);
+      2. wait for the relay ingress port to open — cheap socket probes,
+         budget ``BENCH_TPU_RELAY_WAIT`` (default 240s).  Relay closed
+         for the whole budget => CPU fallback immediately, with the
+         relay state in the fallback reason;
+      3. only then run ``jax.devices()`` under the
+         ``BENCH_TPU_INIT_TIMEOUT`` watchdog (default 600s) — now the
+         budget is spent on a claim that can actually succeed.
 
     If init exceeds the deadline or raises, the watchdog execs a CPU-only
     rerun (see module docstring) — so this function either returns with a
@@ -168,6 +215,23 @@ def init_backend() -> str:
         force_cpu()
         return "cpu"
     _sweep_stale_holders()
+
+    relay_wait = float(os.environ.get("BENCH_TPU_RELAY_WAIT", 240))
+    t0 = time.monotonic()
+    relay = _relay_open()
+    while not relay and time.monotonic() - t0 < relay_wait:
+        dt = time.monotonic() - t0
+        log(f"tunnel relay closed; waiting... {dt:.0f}s/{relay_wait:.0f}s")
+        time.sleep(min(10.0, relay_wait - dt))
+        relay = _relay_open()
+    if not relay:
+        _tpu_init_fail(
+            f"tunnel relay ports {_RELAY_PROBE_PORTS} closed for "
+            f"{relay_wait:.0f}s — TPU path is down"
+        )
+        return "cpu"  # unreachable (exec/exit above); keeps control flow clear
+    log(f"tunnel relay open after {time.monotonic() - t0:.1f}s")
+
     timeout = float(os.environ.get("BENCH_TPU_INIT_TIMEOUT", 600))
     done = threading.Event()
 
@@ -177,7 +241,7 @@ def init_backend() -> str:
             dt = time.monotonic() - t0
             log(f"backend init in progress... {dt:.0f}s")
             if dt >= timeout:
-                _exec_cpu_fallback(f"backend init exceeded {timeout:.0f}s")
+                _tpu_init_fail(f"backend init exceeded {timeout:.0f}s")
 
     threading.Thread(target=_watchdog, daemon=True).start()
     t0 = time.monotonic()
@@ -188,8 +252,8 @@ def init_backend() -> str:
         plat = devs[0].platform
     except Exception as e:
         done.set()
-        _exec_cpu_fallback(f"backend init failed: {type(e).__name__}: {e}")
-        raise  # unreachable; exec does not return
+        _tpu_init_fail(f"backend init failed: {type(e).__name__}: {e}")
+        raise  # unreachable; exec/exit does not return
     done.set()
     log(f"backend up in {time.monotonic() - t0:.1f}s: {plat} x{len(devs)}")
     return "tpu" if plat not in ("cpu", "host") else "cpu"
@@ -877,6 +941,204 @@ def run_latency(config, ckpt_dir=None) -> dict:
     }
 
 
+# -- checkpoint kill/recovery phase (BASELINE.json config 5) --------------
+#
+# "stateful tumbling agg with mid-run kill/recovery": a CHILD process runs
+# the checkpointed pipeline over a paced deterministic feed; the parent
+# SIGKILLs it mid-stream (a real kill — no finally blocks, no generator
+# close), then starts a recovery child on the same state path.  Reported:
+# recovery_s (recovery-child spawn → its first post-restore emission),
+# windows_lost (golden windows missing or wrong in the union — must be 0).
+# The children force CPU: the parent may hold the single-client TPU
+# tunnel, and recovery correctness is engine-level (the state/offset
+# restore path is identical; labeled via recovery_device).
+# Reference path being exercised: offset restore-by-seek
+# (kafka_stream_read.rs:110-140) + state snapshot/restore
+# (grouped_window_agg_stream.rs:355-418, :160-211).
+
+
+def _ckpt_child_main() -> None:
+    """Entry for BENCH_CKPT_CHILD=1: run the 'simple' pipeline (checkpointed
+    unless BENCH_CKPT_GOLDEN=1), appending one JSON line per emitted window
+    row (flushed immediately so the parent can watch progress and a SIGKILL
+    loses at most one line).  The golden variant exists because the PARENT
+    must never touch the engine here — its backend may be the live TPU
+    tunnel (or a down one that hangs init); recovery correctness is
+    engine-level, so every pipeline run happens in a forced-CPU child."""
+    force_cpu()
+    ckpt_dir = os.environ["BENCH_CKPT_DIR"]
+    out_path = os.environ["BENCH_CKPT_OUT"]
+    rows = int(os.environ.get("BENCH_CKPT_ROWS", 12_000_000))
+    pace = float(os.environ.get("BENCH_CKPT_PACE", 0))
+    interval = float(os.environ.get("BENCH_CKPT_INTERVAL", 2.0))
+    golden = os.environ.get("BENCH_CKPT_GOLDEN") == "1"
+
+    _, batches = gen_batches(total_rows=rows, batch_rows=LAT_BATCH, seed=3)
+    from denormalized_tpu import Context
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.common.constants import (
+        WINDOW_END_COLUMN,
+        WINDOW_START_COLUMN,
+    )
+
+    cfg = EngineConfig(
+        min_batch_bucket=LAT_BATCH,
+        min_window_slots=32,
+        checkpoint=not golden,
+        checkpoint_interval_s=interval,
+        state_backend_path=None if golden else ckpt_dir,
+        emit_on_close=True,
+    )
+    ctx = Context(cfg)
+    source = (
+        _paced_source(batches, _FeedClock(pace)) if pace > 0
+        else _mem_source(batches)
+    )
+    ds = build_pipeline("simple", ctx, source)
+    with open(out_path, "a", buffering=1) as out:
+        out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
+        for batch in ds.stream():
+            if not batch.schema.has(WINDOW_START_COLUMN):
+                continue
+            now = time.time()
+            ws = batch.column(WINDOW_START_COLUMN)
+            names = batch.column("sensor_name")
+            for i in range(batch.num_rows):
+                out.write(json.dumps({
+                    "t": now,
+                    "ws": int(ws[i]),
+                    "key": str(names[i]),
+                    "count": int(batch.column("count")[i]),
+                    "min": round(float(batch.column("min")[i]), 4),
+                    "max": round(float(batch.column("max")[i]), 4),
+                    "avg": round(float(batch.column("average")[i]), 4),
+                }) + "\n")
+        out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
+
+
+def _read_ckpt_lines(path) -> tuple[dict, bool]:
+    """(windows {(ws,key): (count,min,max,avg)}, done_seen) from a child's
+    output file; a torn final line (SIGKILL mid-write) is ignored."""
+    wins: dict = {}
+    done = False
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail
+                if o.get("event") == "done":
+                    done = True
+                elif "ws" in o:
+                    wins[(o["ws"], o["key"])] = (
+                        o["count"], o["min"], o["max"], o["avg"],
+                    )
+    except FileNotFoundError:
+        pass
+    return wins, done
+
+
+def run_kill_recovery() -> dict:
+    """SIGKILL a checkpointed child mid-stream; restart; verify no window
+    is lost and measure recovery time.  See section comment above."""
+    import signal
+    import subprocess
+
+    rows = int(os.environ.get("BENCH_CKPT_ROWS", 12_000_000))
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_killckpt_")
+    out_g = os.path.join(ckpt_dir, "emit_golden.jsonl")
+    out1 = os.path.join(ckpt_dir, "emit_a.jsonl")
+    out2 = os.path.join(ckpt_dir, "emit_b.jsonl")
+    child_env = dict(os.environ)
+    child_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_CKPT_CHILD": "1",
+        "BENCH_CKPT_DIR": ckpt_dir,
+        "BENCH_CKPT_ROWS": str(rows),
+    })
+
+    def _spawn(out_path, pace, golden=False):
+        env = dict(child_env)
+        env["BENCH_CKPT_OUT"] = out_path
+        env["BENCH_CKPT_PACE"] = str(pace)
+        if golden:
+            env["BENCH_CKPT_GOLDEN"] = "1"
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=sys.stderr, stderr=sys.stderr,
+        )
+
+    try:
+        # golden: same deterministic feed, no checkpointing, forced-CPU
+        # child (the parent's backend may be the TPU tunnel — never init
+        # a second engine around it)
+        pg = _spawn(out_g, 0, golden=True)
+        rc_g = pg.wait(600)
+        golden, done_g = _read_ckpt_lines(out_g)
+        if rc_g != 0 or not done_g or not golden:
+            return {"kill_recovery": "golden child failed",
+                    "golden_rc": rc_g, "golden_windows": len(golden)}
+        # run A: paced at 1M ev/s so windows close on the wall clock and
+        # the 2s checkpoint interval commits epochs mid-stream
+        p1 = _spawn(out1, EVENTS_PER_SEC)
+        kill_after = max(40, len(golden) // 3)  # ~4+ closed windows
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            wins1, _ = _read_ckpt_lines(out1)
+            if len(wins1) >= kill_after:
+                break
+            if p1.poll() is not None:
+                break  # finished early — still restorable, just not mid-run
+            time.sleep(0.1)
+        mid_run_kill = p1.poll() is None
+        if mid_run_kill:
+            os.kill(p1.pid, signal.SIGKILL)
+        p1.wait(10)
+        wins1, _ = _read_ckpt_lines(out1)
+        log(f"kill_recovery: SIGKILL after {len(wins1)} window rows "
+            f"(mid_run={mid_run_kill})")
+
+        # run B: recovery — unpaced replay of the remainder
+        t_spawn = time.time()
+        p2 = _spawn(out2, 0)
+        rc = p2.wait(300)
+        wins2, done2 = _read_ckpt_lines(out2)
+        if rc != 0 or not done2:
+            return {"kill_recovery": "recovery child failed",
+                    "recovery_rc": rc}
+        first_emit_t = None
+        with open(out2) as f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "ws" in o:
+                    first_emit_t = o["t"]
+                    break
+        union = dict(wins1)
+        union.update(wins2)
+        lost = [k for k in golden
+                if k not in union or union[k] != golden[k]]
+        return {
+            "recovery_s": (
+                round(first_emit_t - t_spawn, 2) if first_emit_t else None
+            ),
+            "windows_lost": len(lost),
+            "killed_after_window_rows": len(wins1),
+            "recovered_window_rows": len(wins2),
+            "full_reprocess": len(wins2) >= len(golden) and len(wins1) > 0,
+            "recovery_device": "cpu",
+            "mid_run_kill": mid_run_kill,
+        }
+    finally:
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
 # -- CPU baselines (two independent implementations) ---------------------
 
 
@@ -1187,6 +1449,10 @@ def run_config(device: str) -> dict:
         _reset_ckpt(ckpt_dir)
         lat = run_latency(config, ckpt_dir=ckpt_dir)
         log(f"latency[{config}]: {lat}")
+        kill_rec = {}
+        if config == "checkpoint":
+            kill_rec = run_kill_recovery()
+            log(f"kill_recovery[{config}]: {kill_rec}")
         cpu_rps = run_cpu_baseline(batches, config, batches2)
         result = {
             "metric": metric,
@@ -1197,6 +1463,7 @@ def run_config(device: str) -> dict:
             "windows_rows": info.get("windows_rows"),
             "throughput_wall_s": info.get("wall_s"),
             **lat,
+            **kill_rec,
         }
         if DEVICE_FALLBACK:
             result["device_fallback"] = DEVICE_FALLBACK
@@ -1206,6 +1473,9 @@ def run_config(device: str) -> dict:
 
 
 def main():
+    if os.environ.get("BENCH_CKPT_CHILD") == "1":
+        _ckpt_child_main()
+        return
     if CONFIG not in (
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e"
     ):
