@@ -57,10 +57,10 @@ CONFIG = os.environ.get("BENCH_CONFIG", "simple")
 TOTAL_ROWS = int(os.environ.get("BENCH_ROWS", 8_000_000))
 BATCH_ROWS = int(os.environ.get("BENCH_BATCH", 131_072))
 NUM_KEYS = int(os.environ.get("BENCH_KEYS", 10))
-# 60M rows at the 1M ev/s event density = 60 windows of event time →
-# ~59 closed-window latency samples per run (the round-2 VERDICT flagged
-# p99-of-5; the bar is >= 50 samples per cell)
-LAT_ROWS = int(os.environ.get("BENCH_LAT_ROWS", 60_000_000))
+# 110M rows at the 1M ev/s event density = 110 windows of event time →
+# ~109 closed-window latency samples per run (the round-3 VERDICT bar:
+# >= 100 samples per cell, plus a stall counter)
+LAT_ROWS = int(os.environ.get("BENCH_LAT_ROWS", 110_000_000))
 LAT_BATCH = int(os.environ.get("BENCH_LAT_BATCH", 8_192))
 WINDOW_MS = 1000
 EVENTS_PER_SEC = 1_000_000  # event-time generation rate AND latency-phase pace
@@ -922,23 +922,91 @@ def run_latency(config, ckpt_dir=None) -> dict:
         _paced_source(batches, clock),
         _paced_source(batches2, clock) if batches2 else None,
     )
+    # Tail-attribution rig (r03 shipped an unexplained 1374ms p99 against
+    # an 8.9ms p50; this box has ONE core, so any concurrent work — or a
+    # gen-2 cyclic GC over the feed's tens of millions of interned-string
+    # refs, or a mid-stream XLA compile — lands directly in the paced
+    # loop).  Three causes are each neutralized or counted:
+    #   * GC: collect then freeze() the pre-generated feed so the cyclic
+    #     collector never scans it mid-phase; gc pauses are timed anyway.
+    #   * XLA compiles: jax_log_compiles routed to a counting handler —
+    #     `paced_compiles` in the JSON (should be 0 after warmup).
+    #   * anything else (scheduler preemption by a co-resident process):
+    #     shows up as `stalls`/`stall_max_ms` with no matching compile or
+    #     gc pause, which is itself the diagnosis.
+    import gc
+    import logging
+
+    gc_pauses: list[float] = []
+
+    def _gc_cb(phase, info, _t=[0.0]):
+        if phase == "start":
+            _t[0] = time.perf_counter()
+        else:
+            gc_pauses.append((time.perf_counter() - _t[0]) * 1000.0)
+
+    class _CompileCounter(logging.Handler):
+        # one record per REAL compile: each XLA compilation emits exactly
+        # one "Finished XLA compilation ..." on jax._src.interpreters.pxla
+        # (trace-cache misses served from the compilation cache emit only
+        # tracing records, which must not count)
+        count = 0
+
+        def emit(self, record):
+            if record.getMessage().startswith("Finished XLA compilation"):
+                _CompileCounter.count += 1
+
+    import jax
+
+    compile_handler = _CompileCounter()
+    for logger_name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+        logging.getLogger(logger_name).addHandler(compile_handler)
+    prior_log_compiles = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    gc.collect()
+    gc.freeze()
+    gc.callbacks.append(_gc_cb)
     lats = []
-    for batch in ds.stream():
-        now = time.perf_counter()
-        if not batch.schema.has(WINDOW_END_COLUMN) or clock.t0 is None:
-            continue
-        ends = np.asarray(batch.column(WINDOW_END_COLUMN), dtype=np.float64)
-        # one latency sample per distinct window close in the batch
-        for e in np.unique(ends):
-            lats.append((now - clock.wall_of(e)) * 1000.0)
+    try:
+        for batch in ds.stream():
+            now = time.perf_counter()
+            if not batch.schema.has(WINDOW_END_COLUMN) or clock.t0 is None:
+                continue
+            ends = np.asarray(
+                batch.column(WINDOW_END_COLUMN), dtype=np.float64
+            )
+            # one latency sample per distinct window close in the batch
+            for e in np.unique(ends):
+                lat_ms = (now - clock.wall_of(e)) * 1000.0
+                lats.append(lat_ms)
+                if lat_ms > 200:
+                    log(f"latency[{config}]: slow sample #{len(lats)}: "
+                        f"{lat_ms:.1f}ms (window_end={e:.0f}, "
+                        f"compiles_so_far={_CompileCounter.count})")
+    finally:
+        gc.callbacks.remove(_gc_cb)
+        gc.unfreeze()
+        jax.config.update("jax_log_compiles", prior_log_compiles)
+        for logger_name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+            logging.getLogger(logger_name).removeHandler(compile_handler)
     if not lats:
         return {"p50_window_latency_ms": None, "p99_window_latency_ms": None}
     a = np.asarray(lats)
-    return {
-        "p50_window_latency_ms": round(float(np.percentile(a, 50)), 2),
+    p50 = float(np.percentile(a, 50))
+    stall_floor = max(10 * p50, 200.0)
+    stalls = a[a > stall_floor]
+    out = {
+        "p50_window_latency_ms": round(p50, 2),
+        "p95_window_latency_ms": round(float(np.percentile(a, 95)), 2),
         "p99_window_latency_ms": round(float(np.percentile(a, 99)), 2),
         "latency_samples": int(a.size),
+        "latency_stalls": int(stalls.size),
+        "paced_compiles": int(_CompileCounter.count),
     }
+    if stalls.size:
+        out["stall_max_ms"] = round(float(stalls.max()), 1)
+        out["gc_pause_max_ms"] = round(max(gc_pauses, default=0.0), 1)
+    return out
 
 
 # -- checkpoint kill/recovery phase (BASELINE.json config 5) --------------
