@@ -808,7 +808,28 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
     for batch in ds.stream():
         out_rows += batch.num_rows
     dt = time.perf_counter() - t0
-    return rows / dt, {"windows_rows": out_rows, "wall_s": round(dt, 3)}
+    info = {"windows_rows": out_rows, "wall_s": round(dt, 3)}
+    # link-traffic accounting (round-3 VERDICT weak-5: "transport-bound"
+    # must be proven, not asserted): numpy-payload bytes the engine moved
+    # over the host↔device link, summed across operators, plus the
+    # utilization those bytes imply against the probed link bandwidth
+    try:
+        from denormalized_tpu.runtime.tracing import collect_metrics
+
+        h2d = d2h = merges = 0
+        for m in collect_metrics(ctx._last_physical).values():
+            h2d += m.get("bytes_h2d", 0)
+            d2h += m.get("bytes_d2h", 0)
+            merges += m.get("partial_merges", 0)
+        info.update(
+            bytes_h2d=h2d,
+            bytes_d2h=d2h,
+            partial_merges=merges,
+            link_MBps_used=round((h2d + d2h) / 1e6 / dt, 1),
+        )
+    except Exception as e:  # metrics must never sink the bench
+        log(f"metrics collection failed: {e}")
+    return rows / dt, info
 
 
 # -- latency phase (paced feed) ------------------------------------------
@@ -1207,6 +1228,46 @@ def run_kill_recovery() -> dict:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# -- link probe -----------------------------------------------------------
+
+
+def link_probe() -> dict:
+    """Raw host↔device link characteristics, measured in-process: one-way
+    bandwidth each direction over an 8MB f32 buffer and the small-program
+    dispatch round-trip.  Together with ``bytes_h2d``/``bytes_d2h`` from
+    the engine's own accounting this proves (or refutes) that a config is
+    transport-bound on the tunnel: engine MB/s ≈ probe MB/s ⇒ the link is
+    the ceiling; engine MB/s ≪ probe MB/s ⇒ the ceiling is elsewhere."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    buf = np.zeros(8 * 1024 * 1024 // 4, np.float32)
+    x = jax.device_put(buf, dev)
+    x.block_until_ready()
+    np.asarray(jax.device_get(x))  # warm both directions
+    t0 = time.perf_counter()
+    x = jax.device_put(buf, dev)
+    x.block_until_ready()
+    h2d_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.device_get(x)
+    d2h_s = time.perf_counter() - t0
+    one = jnp.zeros((8, 8), jnp.float32)
+    f = jax.jit(lambda a: a + 1)
+    f(one).block_until_ready()  # compile outside the timing
+    t0 = time.perf_counter()
+    for _ in range(5):
+        f(one).block_until_ready()
+    rtt_s = (time.perf_counter() - t0) / 5
+    mb = buf.nbytes / 1e6
+    return {
+        "link_h2d_MBps": round(mb / h2d_s, 1),
+        "link_d2h_MBps": round(mb / d2h_s, 1),
+        "dispatch_rtt_ms": round(rtt_s * 1e3, 2),
+    }
+
+
 # -- CPU baselines (two independent implementations) ---------------------
 
 
@@ -1522,6 +1583,13 @@ def run_config(device: str) -> dict:
             kill_rec = run_kill_recovery()
             log(f"kill_recovery[{config}]: {kill_rec}")
         cpu_rps = run_cpu_baseline(batches, config, batches2)
+        probe = {}
+        if device == "tpu":
+            try:
+                probe = link_probe()
+                log(f"link probe: {probe}")
+            except Exception as e:
+                log(f"link probe failed: {e}")
         result = {
             "metric": metric,
             "value": round(rps),
@@ -1530,6 +1598,11 @@ def run_config(device: str) -> dict:
             "device": device,
             "windows_rows": info.get("windows_rows"),
             "throughput_wall_s": info.get("wall_s"),
+            "bytes_h2d": info.get("bytes_h2d"),
+            "bytes_d2h": info.get("bytes_d2h"),
+            "partial_merges": info.get("partial_merges"),
+            "link_MBps_used": info.get("link_MBps_used"),
+            **probe,
             **lat,
             **kill_rec,
         }

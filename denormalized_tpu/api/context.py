@@ -93,6 +93,13 @@ class EngineConfig:
     # rows per component.  Wins when emitted windows are sparse vs the
     # padded capacity; default off pending real-chip A/B.
     emission_compaction: bool = False
+    # on-device finalization: emission ships the FINAL output columns
+    # (count/sum/min/max/avg, computed on device in accum dtype) plus an
+    # active-group bitmask, instead of the raw component planes — fewer
+    # bytes per emitted window on a narrow link, and no host finalize.
+    # Falls back per-operator when an aggregate isn't finalizable on
+    # device (variance family) or the state layout doesn't support it.
+    device_finalize: bool = True
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a
     # remote-compile TPU backend costs seconds per program on FIRST run;

@@ -68,6 +68,15 @@ def _ptr(a: np.ndarray | None):
     return None if a is None else a.ctypes.data_as(ctypes.c_void_p)
 
 
+# fold-neutral int32 bit patterns for min/max planes in the DENSE packed
+# layout (which has no validity mask): shared by the real pack and the
+# prewarm no-op so the two can never diverge
+NEUTRAL_BITS = {
+    "min": np.float32(np.inf).view(np.int32),
+    "max": np.float32(-np.inf).view(np.int32),
+}
+
+
 class HostPartialStripe:
     """Accumulates per-(slide-unit, sub, group) partials between device
     merges.
@@ -243,24 +252,35 @@ class HostPartialStripe:
 
     def take_packed(
         self, base_mod: int
-    ) -> tuple[np.ndarray, int, int, bool] | None:
+    ) -> tuple[np.ndarray, int, int, bool, bool] | None:
         """Compact the stripe into the single int32 matrix the device
         merge op consumes, then reset.
 
-        Returns ``(packed, a_pad, u_base, lean)`` or None when empty —
-        ``lean`` says per-column count planes were omitted (null-free
-        stripe; the device merge aliases them to the row-count plane).  ``packed``
-        is ``(P + 1, a_pad + 2)`` **int32** — an int32 carrier is immune to
+        Returns ``(packed, a_pad, u_base, lean, dense)`` or None when
+        empty — ``lean`` says per-column count planes were omitted
+        (null-free stripe; the device merge aliases them to the row-count
+        plane).  ``packed`` is **int32** — an int32 carrier is immune to
         jnp's x64-off canonicalization, which would silently round an f64
-        matrix to f32 and corrupt cell indices beyond 2^24.  Row 0 holds
-        the active flat cell indices (pad = -1) with ``u_base`` and
-        ``base_mod`` in the two tail slots.  Value planes are f32 bitcast
-        to int32: one plane per count/min/max component (counts are exact
-        in f32 under the MAX_STRIPE_ROWS cap) and TWO planes per sum —
-        the f64 host sum split into (hi, lo) f32 so no precision is lost
-        in transit.  With ``accum_dtype=float64`` (x64 enabled) sums ship
-        as two f64-bitcast int32-pair planes instead.  One matrix → ONE
-        host→device transfer per merge."""
+        matrix to f32 and corrupt cell indices beyond 2^24.  Value planes
+        are f32 bitcast to int32: one plane per count/min/max component
+        (counts are exact in f32 under the MAX_STRIPE_ROWS cap) and TWO
+        planes per sum — the f64 host sum split into (hi, lo) f32 so no
+        precision is lost in transit.  ``u_base`` and ``base_mod`` ride in
+        the two tail slots of row 0.  One matrix → ONE host→device
+        transfer per merge.
+
+        Two layouts, chosen per stripe by exact transferred-byte count:
+
+        * **compact** (``dense=False``): ``(P + 1, a_pad + 2)`` — row 0
+          holds the active flat cell indices ``((u*SUB)+s)*G + g``
+          (pad = −1), value planes follow.  Wins when active cells are
+          sparse in the stripe's span.
+        * **dense** (``dense=True``): ``(P, a_pad + 2)`` — NO index row;
+          cell i is flat index i over the first ``used`` units, pad cells
+          carry fold-neutral values (count 0, sum 0, min +inf, max −inf).
+          Wins at high density (e.g. 100K live keys in a 131072-wide
+          ring: 4 planes × active vs 3 planes × span), and skips the
+          host-side gather entirely."""
         if self.rows == 0:
             return None
         used = self.u_hi + 1
@@ -268,16 +288,26 @@ class HostPartialStripe:
         A = len(active)
         # lean layout: a null-free stripe's per-column counts equal the
         # row count cell-for-cell, so their planes need not cross the
-        # link — the device merge aliases them to plane 1 (row count)
+        # link — the device merge aliases them to the row-count plane
         lean = not self.nulls_seen and sa.lean_possible(self.spec)
+        n_planes = self.n_planes(lean)
         # smallest member of the FIXED bucket set that covers A (see
         # transfer_buckets — all merge programs precompiled); the backend's
         # chunking keeps A within the largest bucket, but never crash the
         # stream if an invariant slips — pay a one-off compile instead
+        buckets = self.transfer_buckets()
         a_pad = next(
-            (b for b in self.transfer_buckets() if b >= A),
+            (b for b in buckets if b >= A),
             1 << (A - 1).bit_length(),
         )
+        cells_d = used * self.SUB * self.G
+        a_pad_d = next((b for b in buckets if b >= cells_d), None)
+        # dense only when a precompiled bucket covers the span AND it
+        # moves fewer bytes than compact (index row included)
+        if a_pad_d is not None and n_planes * a_pad_d < (n_planes + 1) * a_pad:
+            return self._take_packed_dense(
+                base_mod, used, a_pad_d, lean, n_planes
+            )
         rows: list[np.ndarray] = []
         for c in self.spec.components:
             if c.kind == "sumc":
@@ -286,37 +316,9 @@ class HostPartialStripe:
                 continue
             src = self._component_plane(c)[:used].reshape(-1)[active]
             if c.kind == "sum":
-                # (hi, lo) f32 split of the host f64 sum: exact for f32
-                # accumulators, ~1e-14 relative for f64 ones (the axon
-                # runtime decomposes f64, so raw-bit transport of f64 is
-                # not portable)
-                # overflow-to-inf in the cast and inf - inf below are
-                # deliberate (handled by the nonfin branch); suppress the
-                # spurious RuntimeWarnings
-                with np.errstate(invalid="ignore", over="ignore"):
-                    hi = src.astype(np.float32)
-                    lo = (src - hi.astype(np.float64)).astype(np.float32)
-                # a finite f64 sum beyond f32 range becomes (±inf, ∓inf)
-                # and would fold to NaN; ±inf parity with an overflowed
-                # f32 accumulator is right for f32 state, but an f64
-                # accumulator would have held the value — refuse loudly
-                # rather than corrupt it
-                nonfin = ~np.isfinite(hi)
-                if nonfin.any():
-                    over = nonfin & np.isfinite(src)
-                    if over.any() and self.spec.accum_dtype == sa.jnp.float64:
-                        raise OverflowError(
-                            "partial_merge cannot transport f64 sums "
-                            "beyond float32 range (~3.4e38); use "
-                            "device_strategy='scatter' for this workload"
-                        )
-                    # overflow (finite src) and genuine ±inf/NaN sums both
-                    # leave lo meaningless (inf - inf = NaN): zero it so
-                    # the device fold yields ±inf/NaN parity with the
-                    # scatter path instead of poisoning cells with NaN
-                    lo[nonfin] = 0.0
-                rows.append(hi.view(np.int32))
-                rows.append(lo.view(np.int32))
+                hi, lo = self._split_sum(src)
+                rows.append(hi)
+                rows.append(lo)
             else:
                 rows.append(
                     np.ascontiguousarray(src, np.float64)
@@ -330,6 +332,105 @@ class HostPartialStripe:
         packed[0, a_pad + 1] = base_mod
         for i, r in enumerate(rows):
             packed[i + 1, :A] = r
+        u_base = self._reset_after_take(used)
+        return packed, a_pad, u_base, lean, False
+
+    def n_planes(self, lean: bool) -> int:
+        """Value planes in a packed stripe of this spec: two per sum
+        (hi/lo split), one per other component; lean omits per-column
+        count planes (aliased to row count device-side)."""
+        return sum(
+            2 if c.kind == "sum" else 1
+            for c in self.spec.components
+            if c.kind != "sumc" and not (lean and sa.lean_skippable(c))
+        )
+
+    def dense_noop(self, a_pad: int, lean: bool) -> np.ndarray:
+        """An all-padding DENSE packed matrix (for merge-program prewarm):
+        every cell fold-neutral — count/sum planes zero, min/max planes
+        +inf/−inf bit patterns.  Must stay in lockstep with
+        ``_take_packed_dense``'s plane order (it is derived from the same
+        component walk)."""
+        packed = np.zeros((self.n_planes(lean), a_pad + 2), np.int32)
+        pi = 0
+        for c in self.spec.components:
+            if c.kind == "sumc" or (lean and sa.lean_skippable(c)):
+                continue
+            if c.kind == "sum":
+                pi += 2
+                continue
+            if c.kind in NEUTRAL_BITS:
+                packed[pi, :a_pad] = NEUTRAL_BITS[c.kind]
+            pi += 1
+        return packed
+
+    def _split_sum(self, src: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(hi, lo) f32 split of a host f64 sum plane, int32-bitcast —
+        exact for f32 accumulators, ~1e-14 relative for f64 ones (the
+        remote runtime decomposes f64, so raw-bit f64 transport is not
+        portable)."""
+        # overflow-to-inf in the cast and inf - inf below are deliberate
+        # (handled by the nonfin branch); suppress the spurious
+        # RuntimeWarnings
+        with np.errstate(invalid="ignore", over="ignore"):
+            hi = src.astype(np.float32)
+            lo = (src - hi.astype(np.float64)).astype(np.float32)
+        # a finite f64 sum beyond f32 range becomes (±inf, ∓inf) and would
+        # fold to NaN; ±inf parity with an overflowed f32 accumulator is
+        # right for f32 state, but an f64 accumulator would have held the
+        # value — refuse loudly rather than corrupt it
+        nonfin = ~np.isfinite(hi)
+        if nonfin.any():
+            over = nonfin & np.isfinite(src)
+            if over.any() and self.spec.accum_dtype == sa.jnp.float64:
+                raise OverflowError(
+                    "partial_merge cannot transport f64 sums "
+                    "beyond float32 range (~3.4e38); use "
+                    "device_strategy='scatter' for this workload"
+                )
+            # overflow (finite src) and genuine ±inf/NaN sums both leave
+            # lo meaningless (inf - inf = NaN): zero it so the device fold
+            # yields ±inf/NaN parity with the scatter path instead of
+            # poisoning cells with NaN
+            lo[nonfin] = 0.0
+        return hi.view(np.int32), lo.view(np.int32)
+
+    def _take_packed_dense(
+        self, base_mod: int, used: int, a_pad: int, lean: bool, n_planes: int
+    ) -> tuple[np.ndarray, int, int, bool, bool]:
+        """Dense (index-free) pack: plane p at row p, cell i = flat index
+        i over the first ``used`` units, pad cells fold-neutral.  No host
+        gather — straight reshape + dtype conversion."""
+        cells = used * self.SUB * self.G
+        packed = np.zeros((n_planes, a_pad + 2), np.int32)
+        pi = 0
+        for c in self.spec.components:
+            if c.kind == "sumc":
+                continue
+            if lean and sa.lean_skippable(c):
+                continue
+            src = self._component_plane(c)[:used].reshape(-1)
+            if c.kind == "sum":
+                hi, lo = self._split_sum(src)
+                packed[pi, :cells] = hi
+                packed[pi + 1, :cells] = lo
+                pi += 2
+                continue
+            packed[pi, :cells] = (
+                np.ascontiguousarray(src, np.float64)
+                .astype(np.float32)
+                .view(np.int32)
+            )
+            if c.kind in NEUTRAL_BITS and cells < a_pad:
+                packed[pi, cells:a_pad] = NEUTRAL_BITS[c.kind]
+            pi += 1
+        packed[0, a_pad] = self.u_base
+        packed[0, a_pad + 1] = base_mod
+        u_base = self._reset_after_take(used)
+        return packed, a_pad, u_base, lean, True
+
+    def _reset_after_take(self, used: int) -> int:
+        """Shared post-pack stripe reset; returns the taken u_base."""
         u_base = self.u_base
         self.u_base = None
         self.u_hi = 0
@@ -344,4 +445,4 @@ class HostPartialStripe:
         self.mn[:, :used] = np.inf
         self.mx[:, :used] = -np.inf
         self.nulls_seen = False
-        return packed, a_pad, u_base, lean
+        return u_base

@@ -308,14 +308,15 @@ update_state = functools.partial(jax.jit, static_argnums=0, donate_argnums=1)(
 )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=5)
 def merge_partials(
     spec: WindowKernelSpec,
     SUB: int,
     a_pad: int,
     lean: bool,
+    dense: bool,
     state: dict[str, jax.Array],
-    packed: jax.Array,  # (P+1, a_pad+2) int32, HostPartialStripe.take_packed
+    packed: jax.Array,  # int32, (P+1, a_pad+2) compact / (P, a_pad+2) dense
 ) -> dict[str, jax.Array]:
     """Fold host-side partial aggregates into the window ring — the device
     half of the ``partial_merge`` strategy (host edge-reduction +
@@ -330,10 +331,16 @@ def merge_partials(
     partial feeds windows u-k+1..u, with sub-bucket 1 (rows past the
     L-(k-1)S edge) excluded from the oldest window.  Compensated mode
     routes lo into the 'sumc' buffer — one rounding per merge per cell
-    instead of one per row."""
+    instead of one per row.
+
+    ``dense`` selects the index-free layout (host_partial.take_packed
+    dense branch): cell i IS flat index i, the index plane is omitted
+    (plane p sits at row p, header ints still in row 0's tail slots), and
+    padding carries fold-neutral values — the high-density win (≥~75%
+    of cells active, e.g. 100K live keys in a 131K ring)."""
     return merge_partials_body(
         spec, SUB, a_pad, state, packed, spec.group_capacity,
-        jnp.asarray(0, jnp.int32), lean,
+        jnp.asarray(0, jnp.int32), lean, dense,
     )
 
 
@@ -360,6 +367,7 @@ def merge_partials_body(
     G_total: int,
     g_shift,
     lean: bool = False,
+    dense: bool = False,
 ) -> dict[str, jax.Array]:
     """Shared fold: ``state`` holds the contiguous group slice
     ``[g_shift, g_shift + cap)`` of a ``G_total``-wide group space (single
@@ -367,15 +375,24 @@ def merge_partials_body(
     device, shift = axis_index * G_local).
 
     ``lean`` selects the null-free packed layout: per-column count planes
-    are omitted from ``packed`` and aliased to plane 1 (row count) — a
+    are omitted from ``packed`` and aliased to the row-count plane — a
     null-free stripe's per-column counts equal its row counts
-    cell-for-cell (host_partial.take_packed)."""
+    cell-for-cell (host_partial.take_packed).
+
+    ``dense`` selects the index-free layout: no index plane (value plane p
+    is row p, header stays in row 0's tail slots), cell i is flat index i,
+    and pad cells beyond the stripe's span hold fold-neutral values (count
+    0, sum 0, min +inf, max −inf) so no validity mask is needed for them."""
     W = spec.window_slots
-    idx = packed[0, :a_pad]
     u_base_rel = packed[0, a_pad]
     base_mod = packed[0, a_pad + 1]
-    valid = idx >= 0
-    safe = jnp.maximum(idx, 0)
+    if dense:
+        safe = jnp.arange(a_pad, dtype=jnp.int32)
+        valid = jnp.ones((a_pad,), bool)
+    else:
+        idx = packed[0, :a_pad]
+        valid = idx >= 0
+        safe = jnp.maximum(idx, 0)
     g_glob = safe % G_total
     us = safe // G_total
     s = us % SUB
@@ -384,9 +401,12 @@ def merge_partials_body(
     g = g_glob - g_shift
     valid = valid & (g >= 0) & (g < cap)
     g = jnp.clip(g, 0, cap - 1)
+    plane0 = 0 if dense else 1
 
     def f32_plane(pi):
-        return jax.lax.bitcast_convert_type(packed[pi, :a_pad], jnp.float32)
+        return jax.lax.bitcast_convert_type(
+            packed[plane0 + pi, :a_pad], jnp.float32
+        )
 
     for i in range(spec.length_units):
         ok = valid
@@ -395,7 +415,7 @@ def merge_partials_body(
         w_rel = u_base_rel + u - i
         ok = ok & (w_rel >= 0) & (w_rel < W)
         slot = jnp.where(ok, (base_mod + w_rel) % W, W).astype(jnp.int32)
-        pi = 1
+        pi = 0
         for comp in spec.components:
             if comp.kind == "sumc":
                 continue
@@ -419,7 +439,7 @@ def merge_partials_body(
                 pi += 2
                 continue
             if lean and lean_skippable(comp):
-                pv = f32_plane(1)  # alias the row-count plane
+                pv = f32_plane(0)  # alias the row-count plane
             else:
                 pv = f32_plane(pi)
                 pi += 1
@@ -450,12 +470,29 @@ def _gather_and_reset(
     per-column count planes from the transfer (they equal the row-count
     plane when the stream has never carried a null; the host aliases
     them back)."""
-    W = spec.window_slots
-    slots = (first_slot + jnp.arange(n, dtype=jnp.int32)) % W
+    state, comp = _read_and_reset_slots(spec, n, g_bucket, state, first_slot)
     out = {
-        c.label: state[c.label][slots, :g_bucket]
+        c.label: comp[c.label]
         for c in spec.components
         if not (lean and lean_skippable(c))
+    }
+    return state, out
+
+
+
+
+def _read_and_reset_slots(
+    spec: WindowKernelSpec, n: int, g_bucket: int, state, first_slot
+):
+    """Traced slice of ``n`` consecutive ring slots (``:g_bucket`` group
+    prefix) of EVERY component, and re-initialization of those slots in
+    the (donated) state — the shared read+reset core of both emission
+    paths (_gather_and_reset and _finals_and_reset), so the ':g_bucket
+    prefix only' reset invariant cannot diverge between them."""
+    W = spec.window_slots
+    slots = (first_slot + jnp.arange(n, dtype=jnp.int32)) % W
+    comp = {
+        c.label: state[c.label][slots, :g_bucket] for c in spec.components
     }
     for c in spec.components:
         # only the transferred prefix needs resetting: cells beyond the
@@ -464,9 +501,80 @@ def _gather_and_reset(
         state[c.label] = state[c.label].at[slots, :g_bucket].set(
             init.astype(state[c.label].dtype)
         )
+    return state, comp
+
+
+# aggregate kinds whose final value is cheap elementwise math over the
+# component planes — eligible for on-device finalization at emission
+BASIC_FINAL_KINDS = ("count", "sum", "min", "max", "avg")
+
+# key of the packed active-group bitmask in a finals emission block
+ACTIVE_BITS = "__active_bits__"
+
+
+def finals_possible(agg_specs: tuple) -> bool:
+    """True when every output aggregate can be finalized on device (the
+    variance family needs the host's pivot-shifted f64 algebra)."""
+    return all(s[0] in BASIC_FINAL_KINDS for s in agg_specs)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+def _finals_and_reset(
+    spec: WindowKernelSpec,
+    agg_specs: tuple,
+    n: int,
+    g_bucket: int,
+    state: dict[str, jax.Array],
+    first_slot,
+):
+    """Emission with on-device finalization: read ``n`` ring slots, compute
+    the FINAL output columns (count/sum/min/max/avg) and an active-group
+    bitmask on device, reset the slots, and return only the finals.
+
+    Versus the component gather this ships one ``accum_dtype`` plane per
+    OUTPUT aggregate plus ``g_bucket/8`` mask bytes — instead of one plane
+    per primitive component (row count, per-column counts, Kahan hi+lo sum
+    pairs).  On a narrow host↔device link emission traffic drops by the
+    component/output ratio (e.g. 12→8.5 bytes per group for sum+avg,
+    12→4.5 for a single avg).  Precision: a compensated sum is emitted as
+    fl(hi+lo) — the correctly-rounded ``accum_dtype`` value of the
+    maintained sum (≤1 ulp), vs the host's f64 hi+lo add; checkpoints and
+    state export still carry full components, so this rounding affects
+    emitted values only.  Mirrors ``GroupsAccumulator::evaluate``
+    (grouped_window_agg_stream.rs:609-629) run device-side."""
+    state, comp = _read_and_reset_slots(spec, n, g_bucket, state, first_slot)
+    rc = comp[ROW_COUNT.label]
+    out = {ACTIVE_BITS: jnp.packbits(rc > 0, axis=1)}
+
+    def cnt_of(col):
+        lbl = AggComponent("count", col).label
+        return comp[lbl] if lbl in comp else rc
+
+    def sum_of(col):
+        hi = comp[AggComponent("sum", col).label]
+        lo = comp.get(AggComponent("sumc", col).label)
+        return hi if lo is None else hi + lo
+
+    nan = jnp.asarray(jnp.nan, spec.accum_dtype)
+    for i, s in enumerate(agg_specs):
+        kind, col = s[0], s[1]
+        if kind == "count":
+            f = cnt_of(col)
+        elif kind == "sum":
+            f = sum_of(col)
+        elif kind == "avg":
+            c = cnt_of(col)
+            f = jnp.where(c > 0, sum_of(col) / jnp.maximum(c, 1), nan)
+        elif kind == "min":
+            v = comp[AggComponent("min", col).label]
+            f = jnp.where(jnp.isposinf(v), nan, v)
+        elif kind == "max":
+            v = comp[AggComponent("max", col).label]
+            f = jnp.where(jnp.isneginf(v), nan, v)
+        else:  # pragma: no cover — guarded by finals_possible
+            raise ValueError(kind)
+        out[f"__final_{i}__"] = f
     return state, out
-
-
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
@@ -531,13 +639,16 @@ def _compact_slot(spec: WindowKernelSpec, state, slot):
 
 
 def read_slot_compact(
-    spec: WindowKernelSpec, state: dict[str, jax.Array], slot
+    spec: WindowKernelSpec, state: dict[str, jax.Array], slot,
+    capacity: int | None = None,
 ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
     """→ (active gids ascending, component rows aligned to them).
 
     Two-phase transfer: the scalar active count crosses first, then a
     pow2-bucketed prefix of the compacted buffers — one compiled program
-    per bucket size, ≤ log2(G) programs total."""
+    per bucket size, ≤ log2(G) programs total.  ``capacity`` overrides the
+    spec's group width for sharded layouts whose state is globally shaped
+    while the spec carries the per-device shard."""
     compacted = _compact_slot(spec, state, jnp.asarray(slot, jnp.int32))
     k = int(jax.device_get(compacted["__count__"]))
     if k == 0:
@@ -547,7 +658,9 @@ def read_slot_compact(
             )
             for c in spec.components
         }
-    bucket = min(1 << (k - 1).bit_length(), spec.group_capacity)
+    bucket = min(
+        1 << (k - 1).bit_length(), capacity or spec.group_capacity
+    )
     host = jax.device_get(
         {
             name: jax.lax.slice_in_dim(arr, 0, bucket)
@@ -565,6 +678,16 @@ def read_slot_compact(
 def export_state(state: dict[str, jax.Array]) -> dict[str, np.ndarray]:
     """Full device→host snapshot (checkpointing / capacity growth)."""
     return jax.device_get(state)
+
+
+@jax.jit
+def clone_state(state: dict[str, jax.Array]) -> dict[str, jax.Array]:
+    """On-device copy of the window ring — an immutable snapshot source
+    that later (donated) update programs cannot touch, so its
+    device→host transfer can run asynchronously under ingest (the
+    drain-free analog of the reference's state()-then-reseed trick,
+    grouped_window_agg_stream.rs:379-394)."""
+    return {k: jnp.copy(v) for k, v in state.items()}
 
 
 def import_state(

@@ -98,6 +98,21 @@ class WindowStateBackend:
     def read_reset_block_finish(self, handle) -> dict[str, "np.ndarray"]:
         return handle
 
+    # -- on-device finalization (optional) -----------------------------
+    def prepare_finals(self, agg_specs: tuple) -> None:
+        """Announce the output aggregate specs so the backend can
+        pre-compile finals-emission programs.  No-op for backends that
+        don't finalize on device."""
+
+    def read_reset_block_finals_start(
+        self, first_slot: int, n: int, live_groups=None
+    ):
+        """Dispatch a finals emission (final output planes + active
+        bitmask, see segment_agg._finals_and_reset) for n ring slots —
+        or return None when this layout doesn't support it (caller falls
+        back to the component-plane path)."""
+        return None
+
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
         raise NotImplementedError
 
@@ -113,6 +128,15 @@ class WindowStateBackend:
     def export(self) -> dict[str, np.ndarray]:
         """(W, G_total) host snapshot for checkpoint/growth."""
         raise NotImplementedError
+
+    # -- async export (checkpointing): start dispatches an on-device clone
+    # plus its host copy and returns a handle; finish materializes it.
+    # Default is synchronous.
+    def export_start(self):
+        return self.export()
+
+    def export_finish(self, handle) -> dict[str, "np.ndarray"]:
+        return handle
 
     def import_(self, host_state: dict[str, np.ndarray]) -> None:
         raise NotImplementedError
@@ -239,12 +263,7 @@ class SingleDeviceWindowState(WindowStateBackend):
         262K-capacity ring, and ~all of it when capacity is
         over-provisioned)."""
         assert n <= self.spec.window_slots  # slots must be distinct
-        g_bucket = self.group_capacity
-        if live_groups is not None:
-            g_bucket = min(
-                g_bucket,
-                max(1024, 1 << max(0, int(live_groups) - 1).bit_length()),
-            )
+        g_bucket = self._live_bucket(live_groups)
         self._state, out = sa._gather_and_reset(
             self.spec, n, g_bucket, self._state,
             jnp.asarray(first_slot, jnp.int32), lean,
@@ -258,8 +277,64 @@ class SingleDeviceWindowState(WindowStateBackend):
         self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
         return out
 
+    def prepare_finals(self, agg_specs: tuple) -> None:
+        self._finals_specs = tuple(agg_specs)
+        if not getattr(self, "_pallas_interpret", True):
+            # pre-compile the finals ladder like the component-gather one
+            # in __init__: an unseen (n, bucket) pair compiling mid-stream
+            # costs seconds on a remote-compile backend.  group_capacity
+            # is the property — the GLOBAL width on sharded layouts.
+            for n in (1, 2, 4, 8):
+                if n <= self.spec.window_slots:
+                    for g_bucket in {min(1024, self.group_capacity),
+                                     self.group_capacity}:
+                        self._state, _ = sa._finals_and_reset(
+                            self.spec, self._finals_specs, n, g_bucket,
+                            self._state, jnp.asarray(0, jnp.int32),
+                        )
+
+    def _live_bucket(self, live_groups) -> int:
+        """Transferred group width: pow2 of the interner's live size
+        (floor 1024), capped at capacity — the single bucketing policy
+        for every emission ladder (component gather AND finals), so both
+        prewarm sets stay aligned with runtime requests."""
+        g_bucket = self.group_capacity
+        if live_groups is not None:
+            g_bucket = min(
+                g_bucket,
+                max(1024, 1 << max(0, int(live_groups) - 1).bit_length()),
+            )
+        return g_bucket
+
+    def read_reset_block_finals_start(
+        self, first_slot: int, n: int, live_groups=None
+    ):
+        specs = getattr(self, "_finals_specs", None)
+        if specs is None:
+            return None
+        assert n <= self.spec.window_slots
+        g_bucket = self._live_bucket(live_groups)
+        self._state, out = sa._finals_and_reset(
+            self.spec, specs, n, g_bucket, self._state,
+            jnp.asarray(first_slot, jnp.int32),
+        )
+        for arr in out.values():
+            arr.copy_to_host_async()
+        return out
+
     def export(self) -> dict[str, np.ndarray]:
         return sa.export_state(self._state)
+
+    def export_start(self):
+        snap = sa.clone_state(self._state)
+        for arr in snap.values():
+            arr.copy_to_host_async()
+        return snap
+
+    def export_finish(self, handle) -> dict[str, np.ndarray]:
+        out = jax.device_get(handle)
+        self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
+        return out
 
     def import_(self, host_state: dict[str, np.ndarray]) -> None:
         self._state = sa.import_state(self.spec, host_state)
@@ -289,16 +364,22 @@ class _HostPartialMixin:
             variants = [False]
             if sa.lean_possible(self.spec):
                 variants.append(True)
+            stripe = self._stripe
+            dense_floor = stripe.G * stripe.SUB  # smallest dense span
             for lean in variants:
-                n_planes = sum(
-                    2 if c.kind == "sum" else 1
-                    for c in self.spec.components
-                    if c.kind != "sumc" and not (lean and sa.lean_skippable(c))
-                )
-                for a_pad in self._stripe.transfer_buckets():
+                n_planes = stripe.n_planes(lean)
+                for a_pad in stripe.transfer_buckets():
                     noop = np.zeros((n_planes + 1, a_pad + 2), np.int32)
                     noop[0, :a_pad] = -1
                     self._merge(noop, a_pad, lean)
+                    if a_pad >= dense_floor:
+                        # dense no-op: fold-neutral planes (a zeroed min
+                        # plane would clobber state with 0.0 — dense has
+                        # no validity mask); layout owned by the stripe
+                        self._merge(
+                            stripe.dense_noop(a_pad, lean), a_pad, lean,
+                            dense=True,
+                        )
 
     @property
     def pending_rows(self) -> int:
@@ -363,9 +444,9 @@ class _HostPartialMixin:
         taken = self._stripe.take_packed(self._pending_base_mod)
         if taken is None:
             return
-        packed, a_pad, _u_base, lean = taken
+        packed, a_pad, _u_base, lean, dense = taken
         self.bytes_h2d += int(packed.nbytes)
-        self._merge(packed, a_pad, lean)
+        self._merge(packed, a_pad, lean, dense)
         self.merges += 1
 
 
@@ -386,9 +467,12 @@ class PartialMergeWindowState(_HostPartialMixin, SingleDeviceWindowState):
         super().__init__(spec, "scatter")
         self._init_host_partial(spec.group_capacity)
 
-    def _merge(self, packed: np.ndarray, a_pad: int, lean: bool = False) -> None:
+    def _merge(
+        self, packed: np.ndarray, a_pad: int, lean: bool = False,
+        dense: bool = False,
+    ) -> None:
         self._state = sa.merge_partials(
-            self.spec, self._stripe.SUB, a_pad, lean, self._state,
+            self.spec, self._stripe.SUB, a_pad, lean, dense, self._state,
             jnp.asarray(packed),
         )
 
@@ -525,13 +609,16 @@ class KeyShardedWindowState(WindowStateBackend):
             )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=5)
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4, 5), donate_argnums=6
+)
 def _key_sharded_merge_partials(
     spec: sa.WindowKernelSpec,  # LOCAL spec (G_local per device)
     mesh: Mesh,
     SUB: int,
     a_pad: int,
     lean: bool,
+    dense: bool,
     state,
     packed,
 ):
@@ -545,7 +632,8 @@ def _key_sharded_merge_partials(
     def body(state_l, packed_l):
         shift = jax.lax.axis_index(KEY_AXIS) * G_local
         return sa.merge_partials_body(
-            spec, SUB, a_pad, state_l, packed_l, G_local * n, shift, lean
+            spec, SUB, a_pad, state_l, packed_l, G_local * n, shift, lean,
+            dense,
         )
 
     return jax.shard_map(
@@ -564,20 +652,40 @@ class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState
 
     def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
         super().__init__(spec, mesh)
+        self._pallas_interpret = jax.default_backend() != "tpu"
         # stripe spans the GLOBAL group space
         self._init_host_partial(self.group_capacity)
 
-    def _merge(self, packed: np.ndarray, a_pad: int, lean: bool = False) -> None:
+    def _merge(
+        self, packed: np.ndarray, a_pad: int, lean: bool = False,
+        dense: bool = False,
+    ) -> None:
         self._state = _key_sharded_merge_partials(
-            self.spec, self.mesh, self._stripe.SUB, a_pad, lean, self._state,
-            jnp.asarray(packed),
+            self.spec, self.mesh, self._stripe.SUB, a_pad, lean, dense,
+            self._state, jnp.asarray(packed),
         )
 
-    # fused async gather+reset: identical machinery to the single-device
-    # backend (self.group_capacity is the global width here)
+    # fused async gather+reset + on-device finalization + emission
+    # compaction: identical machinery to the single-device backend
+    # (self.group_capacity is the global width here; GSPMD partitions the
+    # programs over the key sharding)
     read_reset_block = SingleDeviceWindowState.read_reset_block
     read_reset_block_start = SingleDeviceWindowState.read_reset_block_start
     read_reset_block_finish = SingleDeviceWindowState.read_reset_block_finish
+    _live_bucket = SingleDeviceWindowState._live_bucket
+    prepare_finals = SingleDeviceWindowState.prepare_finals
+    read_reset_block_finals_start = (
+        SingleDeviceWindowState.read_reset_block_finals_start
+    )
+    export_start = SingleDeviceWindowState.export_start
+    export_finish = SingleDeviceWindowState.export_finish
+
+    def read_slot_compact(self, slot: int):
+        # state is globally shaped; the spec carries the per-device shard,
+        # so the bucket cap must come from the global width
+        return sa.read_slot_compact(
+            self.spec, self._state, slot, capacity=self.group_capacity
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -78,6 +78,7 @@ class StreamingWindowExec(ExecOperator):
         accum_dtype=jnp.float32,
         compensated_sums: bool = False,
         emission_compaction: bool = False,
+        device_finalize: bool = True,
         min_group_capacity: int = 128,
         min_window_slots: int = 16,
         min_batch_bucket: int = 256,
@@ -188,6 +189,22 @@ class StreamingWindowExec(ExecOperator):
         self._backend = make_sharded_state(
             self._spec, mesh, shard_strategy, device_strategy
         )
+        # on-device finalization: emission ships final output planes + an
+        # active bitmask instead of raw component planes (see
+        # segment_agg._finals_and_reset).  Only when every aggregate is
+        # finalizable and the backend layout supports it (it returns None
+        # from read_reset_block_finals_start otherwise).  Compaction takes
+        # a different trigger branch entirely — preparing finals under it
+        # would compile programs that never run.
+        self._finals_specs = (
+            tuple(self._agg_specs)
+            if device_finalize
+            and not emission_compaction
+            and sa.finals_possible(tuple(self._agg_specs))
+            else None
+        )
+        if self._finals_specs is not None:
+            self._backend.prepare_finals(self._finals_specs)
 
         # schema: group cols + agg cols + window bounds (+ canonical ts)
         fields = [g.out_field(in_schema) for g in self.group_exprs]
@@ -225,8 +242,13 @@ class StreamingWindowExec(ExecOperator):
         self._emit_lag_s = emit_lag_ms / 1000.0
         self._merge_rows = partial_merge_rows
         self._stripe_wall: float | None = None
-        # dispatched-but-unmaterialized emission blocks: (j0, n, handle)
+        # dispatched-but-unmaterialized emission blocks:
+        # (j0, n, handle, is_finals)
         self._pending_emit: list[tuple] = []
+        # async checkpoint in flight: (epoch, meta, backend, handle), plus
+        # the barrier marker held until the snapshot is durable
+        self._pending_snapshot: tuple | None = None
+        self._held_marker = None
         self._metrics = {
             "rows_in": 0,
             "batches_in": 0,
@@ -308,6 +330,8 @@ class StreamingWindowExec(ExecOperator):
         self._backend = make_sharded_state(
             self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
+        if self._finals_specs is not None:
+            self._backend.prepare_finals(self._finals_specs)
         self._backend.import_(host)
         self._metrics["grow_events"] += 1
 
@@ -548,8 +572,25 @@ class StreamingWindowExec(ExecOperator):
             return
         pending, self._pending_emit = self._pending_emit, []
         ngroups = len(self._interner) if self._grouped else 1
-        for j0, n, handle in pending:
+        for j0, n, handle, is_finals in pending:
             block = self._backend.read_reset_block_finish(handle)
+            if is_finals:
+                # finals block: one plane per output aggregate + packed
+                # active bitmask; no host-side finalize needed
+                bits = np.unpackbits(block[sa.ACTIVE_BITS], axis=1)
+                for i in range(n):
+                    active = bits[i].astype(bool)
+                    active[ngroups:] = False
+                    if not active.any():
+                        continue
+                    gids = np.nonzero(active)[0].astype(np.int32)
+                    finals = [
+                        block[f"__final_{k}__"][i][gids]
+                        for k in range(len(self.aggr_exprs))
+                    ]
+                    self._metrics["windows_emitted"] += 1
+                    yield self._build_emission_finals(j0 + i, gids, finals)
+                continue
             # lean gathers omit per-column count planes (null-free stream:
             # they equal the row-count plane) — alias them back
             for c in self._spec.components:
@@ -605,15 +646,28 @@ class StreamingWindowExec(ExecOperator):
             # pow2 block sizes bound the compiled gather variants
             n = 1 << min(3, (n_close).bit_length() - 1)
             n = min(n, self._spec.window_slots)
-            handle = self._backend.read_reset_block_start(
-                self._first_open % self._spec.window_slots, n,
-                live_groups=len(self._interner) if self._grouped else 1,
-                # only when the lean layout actually differs — else the
-                # lean=True program would be a duplicate compilation of
-                # the full one
-                lean=not self._any_nulls_seen and sa.lean_possible(self._spec),
-            )
-            self._pending_emit.append((self._first_open, n, handle))
+            live = len(self._interner) if self._grouped else 1
+            handle = None
+            if self._finals_specs is not None:
+                handle = self._backend.read_reset_block_finals_start(
+                    self._first_open % self._spec.window_slots, n,
+                    live_groups=live,
+                )
+            if handle is not None:
+                self._pending_emit.append((self._first_open, n, handle, True))
+            else:
+                handle = self._backend.read_reset_block_start(
+                    self._first_open % self._spec.window_slots, n,
+                    live_groups=live,
+                    # only when the lean layout actually differs — else the
+                    # lean=True program would be a duplicate compilation of
+                    # the full one
+                    lean=(
+                        not self._any_nulls_seen
+                        and sa.lean_possible(self._spec)
+                    ),
+                )
+                self._pending_emit.append((self._first_open, n, handle, False))
             self._first_open += n
             n_close -= n
         if not self._backend.accumulates_host:
@@ -676,9 +730,12 @@ class StreamingWindowExec(ExecOperator):
         gids = np.nonzero(active)[0].astype(np.int32)
         return self._build_emission(j, gids, rows, active)
 
-    def _build_emission(
-        self, j: int, gids: np.ndarray, rows: dict, active: np.ndarray
+    def _assemble_emission(
+        self, j: int, gids: np.ndarray, finals: list
     ) -> RecordBatch:
+        """Shared emission assembly: group-key columns from the interner,
+        finalized aggregate columns (cast to output dtypes), window
+        bounds + canonical timestamp."""
         cols: list[np.ndarray] = []
         if self._grouped:
             key_vals = self._interner.keys_of(gids)
@@ -687,15 +744,28 @@ class StreamingWindowExec(ExecOperator):
                 if f.dtype.is_numeric:
                     kv = np.asarray(kv.tolist(), dtype=f.dtype.to_numpy())
                 cols.append(kv)
-        finals = sa.finalize(self._agg_specs, rows, active)
         for a, arr in zip(self.aggr_exprs, finals):
             f = a.out_field(self.input_op.schema)
-            cols.append(arr.astype(f.dtype.to_numpy()))
+            cols.append(np.asarray(arr).astype(f.dtype.to_numpy()))
         m = len(gids)
         start = np.full(m, j * self.slide_ms, dtype=np.int64)
         end = np.full(m, j * self.slide_ms + self.length_ms, dtype=np.int64)
         cols += [start, end, start.copy()]
         return RecordBatch(self.schema, cols)
+
+    def _build_emission_finals(
+        self, j: int, gids: np.ndarray, finals: list
+    ) -> RecordBatch:
+        """Emission from device-finalized output planes (already masked to
+        the active gids, in aggr_exprs order)."""
+        return self._assemble_emission(j, gids, finals)
+
+    def _build_emission(
+        self, j: int, gids: np.ndarray, rows: dict, active: np.ndarray
+    ) -> RecordBatch:
+        return self._assemble_emission(
+            j, gids, sa.finalize(self._agg_specs, rows, active)
+        )
 
     # -- checkpointing ----------------------------------------------------
     # Snapshot = device state buffers + interner + watermark scalars, the
@@ -708,12 +778,17 @@ class StreamingWindowExec(ExecOperator):
         self._restore()
 
     def _snapshot(self, epoch: int) -> None:
-        from denormalized_tpu.state.serialization import pack_snapshot
-
+        """Dispatch an epoch snapshot WITHOUT blocking on the device→host
+        transfer: flush host partials, clone the ring on device, start its
+        async host copy, and capture the host-side meta NOW (it mutates
+        with the very next batch).  ``_release_snapshot`` materializes and
+        persists it — and only then releases the held barrier marker, so
+        the commit protocol (snapshot durable before the marker reaches
+        the root) is preserved while the transfer overlaps downstream
+        work and the next source read."""
         # device state must include everything the stripe holds — the
         # snapshot is the recovery point
         self._flush()
-        coord, key = self._ckpt
         meta = {
             "epoch": epoch,
             "first_open": self._first_open,
@@ -727,7 +802,28 @@ class StreamingWindowExec(ExecOperator):
             "var_shift": dict(self._var_shift),
             "any_nulls_seen": self._any_nulls_seen,
         }
-        coord.put_snapshot(key, epoch, pack_snapshot(meta, self._backend.export()))
+        self._pending_snapshot = (
+            epoch, meta, self._backend, self._backend.export_start()
+        )
+
+    def _release_snapshot(self) -> Iterator:
+        """Persist a pending snapshot and release its held marker.  MUST
+        run before any output derived from post-marker input leaves this
+        operator — a downstream operator that saw post-marker emissions
+        before the marker would snapshot state AHEAD of ours, and a
+        restore would double-apply those windows."""
+        if self._pending_snapshot is not None:
+            from denormalized_tpu.state.serialization import pack_snapshot
+
+            epoch, meta, backend, handle = self._pending_snapshot
+            self._pending_snapshot = None
+            coord, key = self._ckpt
+            coord.put_snapshot(
+                key, epoch, pack_snapshot(meta, backend.export_finish(handle))
+            )
+        if self._held_marker is not None:
+            marker, self._held_marker = self._held_marker, None
+            yield marker
 
     def _restore(self) -> None:
         from denormalized_tpu.state.serialization import unpack_snapshot
@@ -753,6 +849,8 @@ class StreamingWindowExec(ExecOperator):
         self._backend = make_sharded_state(
             self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
+        if self._finals_specs is not None:
+            self._backend.prepare_finals(self._finals_specs)
         self._backend.import_(arrays)
         self._first_open = meta["first_open"]
         self._max_win_seen = meta["max_win_seen"]
@@ -788,19 +886,27 @@ class StreamingWindowExec(ExecOperator):
 
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
+                # materialize any in-flight snapshot and release its
+                # marker BEFORE producing output from post-marker input
+                # (alignment invariant, see _release_snapshot)
+                yield from self._release_snapshot()
                 with span(
                     "window.process_batch", op=self.name, rows=item.num_rows
                 ):
                     yield from self._process_batch(item)
             elif isinstance(item, Marker):
                 yield from self._drain_pending()
+                yield from self._release_snapshot()  # an earlier epoch
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)
-                yield item
+                    self._held_marker = item
+                else:
+                    yield item
             elif isinstance(item, EndOfStream):
                 # pending blocks are watermark-CLOSED windows: they emit
                 # even when the unclosed-window flush is disabled
                 yield from self._drain_pending()
+                yield from self._release_snapshot()
                 if self.emit_on_close and self._first_open is not None:
                     self._flush()
                     for j in range(self._first_open, self._max_win_seen + 1):

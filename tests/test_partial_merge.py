@@ -431,3 +431,115 @@ def test_partial_checkpoint_kill_restore(make_batch, tmp_path):
         assert gs == pytest.approx(sm, rel=1e-5)
         assert ga == pytest.approx(av, rel=1e-5)
     assert len(b) < len(golden) or len(a) == 0
+
+
+def test_partial_device_finalize_parity(make_batch):
+    """On-device finalization (finals planes + active bitmask,
+    segment_agg._finals_and_reset) must match the component-transfer path
+    (device_finalize=False) on the same feed — including nulls, where
+    per-column counts diverge from row counts."""
+    for nulls in (False, True):
+        batches = _sensor_batches(make_batch, nulls=nulls, seed=11)
+        a = _run(
+            batches, _std_aggs, 1000, strategy="partial_merge",
+            cfg_extra={"device_finalize": False},
+        )
+        b = _run(
+            batches, _std_aggs, 1000, strategy="partial_merge",
+            cfg_extra={"device_finalize": True},
+        )
+        assert len(a) > 10
+        # finals emit fl(hi+lo) in f32 — up to 1 ulp from the host's
+        # f64 hi+lo add
+        _assert_parity(a, b, rtol=1e-5)
+
+
+def test_partial_device_finalize_sharded(make_batch):
+    """Finals emission over the 8-device mesh (borrowed single-device
+    machinery, GSPMD-partitioned) matches scatter."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device platform")
+    batches = _sensor_batches(make_batch, n_batches=20)
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(
+        batches, _std_aggs, 1000, strategy="partial_merge",
+        cfg_extra={"mesh_devices": 8, "device_finalize": True},
+    )
+    _assert_parity(a, b, rtol=1e-5)
+
+
+def test_partial_emission_compaction_sharded(make_batch):
+    """Device-side emission compaction now works over
+    KeyShardedPartialMergeWindowState (round-3 VERDICT item 2): active
+    groups permuted to the front on device, bucketed prefix transfer."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device platform")
+    batches = _sensor_batches(make_batch, n_batches=20)
+    a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    b = _run(
+        batches, _std_aggs, 1000, strategy="partial_merge",
+        cfg_extra={"mesh_devices": 8, "emission_compaction": True},
+    )
+    _assert_parity(a, b, rtol=1e-5)
+
+
+def test_partial_dense_upload_layout(make_batch):
+    """High-density stripes take the index-free dense pack (fewer bytes
+    than compact incl. the index row) and still match scatter; the layout
+    decision is exercised both ways by spying take_packed."""
+    from denormalized_tpu.ops.host_partial import HostPartialStripe
+
+    layouts = []
+    orig = HostPartialStripe.take_packed
+
+    def spy(self, base_mod):
+        r = orig(self, base_mod)
+        if r is not None:
+            layouts.append(r[4])
+        return r
+
+    HostPartialStripe.take_packed = spy
+    try:
+        batches = _sensor_batches(make_batch, keys=10)
+        a = _run(batches, _std_aggs, 1000, strategy="scatter")
+        b = _run(batches, _std_aggs, 1000, strategy="partial_merge")
+    finally:
+        HostPartialStripe.take_packed = orig
+    # small G (128) in a 1024 bucket: dense (3-5 planes x 1024) always
+    # beats compact ((P+1) x 1024) — every flush should have gone dense
+    assert layouts and all(layouts), layouts
+    _assert_parity(a, b)
+
+
+def test_partial_compact_upload_layout(make_batch):
+    """Sparse stripes (few active cells in a grown ring) keep the compact
+    indexed pack."""
+    from denormalized_tpu.ops.host_partial import HostPartialStripe
+
+    layouts = []
+    orig = HostPartialStripe.take_packed
+
+    def spy(self, base_mod):
+        r = orig(self, base_mod)
+        if r is not None:
+            layouts.append(r[4])
+        return r
+
+    HostPartialStripe.take_packed = spy
+    try:
+        batches = _sensor_batches(make_batch, keys=5, n_batches=12)
+        b = _run(
+            batches, _std_aggs, 1000, strategy="partial_merge",
+            cfg_extra={"min_group_capacity": 16384},
+        )
+        a = _run(batches, _std_aggs, 1000, strategy="scatter")
+    finally:
+        HostPartialStripe.take_packed = orig
+    # G=16384 forces cells_d >= 16384 -> its bucket dwarfs the ~5-cell
+    # compact bucket (1024): compact must win every flush
+    assert layouts and not any(layouts), layouts
+    _assert_parity(a, b)
