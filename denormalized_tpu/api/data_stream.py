@@ -161,22 +161,79 @@ class DataStream:
     def join_on(
         self, right: "DataStream", join_type: str, on_exprs: Sequence[Expr]
     ) -> "DataStream":
-        """Equi-join via `left_col == right_col` expressions
-        (datastream.rs:126-148)."""
+        """Join on arbitrary binary expressions (datastream.rs:126-148).
+
+        ``expr_l == expr_r`` conjuncts where each side references exactly
+        one input become equi-keys: non-column sides are computed into
+        hidden key columns on their input, the hash join runs on those,
+        and the hidden columns are dropped from the output.  Any other
+        conjunct (non-equi op, or an equality whose sides mix both
+        inputs) becomes a residual filter evaluated on matched pairs —
+        the same lowering DataFusion applies to the reference's
+        ``join_on``."""
         from denormalized_tpu.logical.expr import BinaryExpr
 
-        lcols, rcols = [], []
-        for e in on_exprs:
-            if not (
-                isinstance(e, BinaryExpr)
-                and e.op == "=="
-                and isinstance(e.left, Column)
-                and isinstance(e.right, Column)
-            ):
-                raise PlanError("join_on expects col == col expressions")
-            lcols.append(e.left.name)
-            rcols.append(e.right.name)
-        return self.join(right, join_type, lcols, rcols)
+        left_names = set(self.schema().names)
+        right_names = set(right.schema().names)
+
+        def side_of(e: Expr) -> str | None:
+            refs = e.columns_referenced()
+            if not refs:
+                return None  # literal: computable on either side
+            if refs <= left_names and not (refs & right_names):
+                return "l"
+            if refs <= right_names and not (refs & left_names):
+                return "r"
+            return None  # ambiguous or mixed — not a separable equi side
+
+        lds, rds = self, right
+        lcols: list[str] = []
+        rcols: list[str] = []
+        hidden: list[str] = []
+        residual: Expr | None = None
+        for i, e in enumerate(on_exprs):
+            sides = None
+            if isinstance(e, BinaryExpr) and e.op == "==":
+                if isinstance(e.left, Column) and isinstance(e.right, Column):
+                    # plain column == column: key names verbatim (including
+                    # the shared-name form col('k') == col('k'), which Join
+                    # resolves as a once-appearing shared equi-key)
+                    lcols.append(e.left.name)
+                    rcols.append(e.right.name)
+                    continue
+                sl, sr = side_of(e.left), side_of(e.right)
+                if {sl, sr} == {"l", "r"}:
+                    sides = (e.left, e.right) if sl == "l" else (e.right, e.left)
+                elif sl == "l" and sr is None and not e.right.columns_referenced():
+                    sides = (e.left, e.right)
+                elif sl == "r" and sr is None and not e.left.columns_referenced():
+                    sides = (e.right, e.left)
+            if sides is None:
+                residual = e if residual is None else (residual & e)
+                continue
+            le, re_ = sides
+            if isinstance(le, Column):
+                lcols.append(le.name)
+            else:
+                name = f"__join_lk_{i}__"
+                lds = lds.with_column(name, le)
+                lcols.append(name)
+                hidden.append(name)
+            if isinstance(re_, Column):
+                rcols.append(re_.name)
+            else:
+                name = f"__join_rk_{i}__"
+                rds = rds.with_column(name, re_)
+                rcols.append(name)
+                hidden.append(name)
+        if not lcols:
+            raise PlanError(
+                "join_on needs at least one separable equi conjunct "
+                "(expr_over_left == expr_over_right) — a pure theta join "
+                "over unbounded streams has no hash key to bound state"
+            )
+        out = lds.join(rds, join_type, lcols, rcols, filter=residual)
+        return out.drop_columns(*hidden) if hidden else out
 
     # -- introspection ---------------------------------------------------
     def print_plan(self) -> "DataStream":

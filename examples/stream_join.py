@@ -1,6 +1,12 @@
 """Windowed stream-stream join — mirror of the reference's stream_join
 (examples/examples/stream_join.rs:15-85): temperature and humidity topics,
-1s-windowed averages, renamed columns, inner join on (sensor, window)."""
+1s-windowed averages, renamed columns, inner join on (sensor, window).
+
+``--expressions`` switches to the generalized ``join_on`` form
+(datastream.rs:126-177): an equi conjunct over EXPRESSIONS
+(``upper(sensor_name) == upper(humidity_sensor)`` — lowered to hidden
+hash-key columns) plus a non-equi residual (``average_humidity >
+average_temperature - 100``) evaluated on matched pairs."""
 
 from __future__ import annotations
 
@@ -16,6 +22,10 @@ SAMPLE = json.dumps({"occurred_at_ms": 100, "sensor_name": "foo", "reading": 0.0
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--bootstrap-servers", default=None)
+    ap.add_argument(
+        "--expressions", action="store_true",
+        help="join_on with expression equi-keys + a non-equi residual",
+    )
     args = ap.parse_args()
     bootstrap = args.bootstrap_servers
     if bootstrap is None:
@@ -51,12 +61,23 @@ def main():
         .with_column_renamed("window_start_time", "humidity_window_start_time")
         .with_column_renamed("window_end_time", "humidity_window_end_time")
     )
-    joined = temperature.join(
-        humidity,
-        "inner",
-        ["sensor_name", "window_start_time"],
-        ["humidity_sensor", "humidity_window_start_time"],
-    )
+    if args.expressions:
+        joined = temperature.join_on(
+            humidity,
+            "inner",
+            [
+                F.upper(col("sensor_name")) == F.upper(col("humidity_sensor")),
+                col("window_start_time") == col("humidity_window_start_time"),
+                col("average_humidity") > col("average_temperature") - F.lit(100.0),
+            ],
+        )
+    else:
+        joined = temperature.join(
+            humidity,
+            "inner",
+            ["sensor_name", "window_start_time"],
+            ["humidity_sensor", "humidity_window_start_time"],
+        )
     joined.print_stream()
 
 

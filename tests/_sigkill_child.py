@@ -1,0 +1,70 @@
+"""Child entry point for the true process-level SIGKILL kill/restore test
+(tests/test_checkpoint.py::test_sigkill_process_kill_and_restore).
+
+Runs a CHECKPOINTED Kafka pipeline (from_topic → 500ms tumbling count/sum
+by key) against the parent's mock broker and appends one flushed JSON line
+per emitted window row — so a SIGKILL loses at most one torn line.  The
+parent kills this process mid-stream with a real ``os.kill(pid, SIGKILL)``
+(no ``finally`` blocks, no generator close — unlike the in-process
+variants above it in the test file), then starts a second instance on the
+same state path to exercise the restore path the reference implements at
+kafka_stream_read.rs:110-140 (offset restore-by-seek) and
+grouped_window_agg_stream.rs:160-211 (frame restore).
+
+Config via env: KR_BROKER, KR_TOPIC, KR_STATE, KR_OUT, KR_INTERVAL.
+"""
+
+import json
+import os
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+
+    cfg = EngineConfig(
+        checkpoint=True,
+        checkpoint_interval_s=float(os.environ["KR_INTERVAL"]),
+        state_backend_path=os.environ["KR_STATE"],
+        min_batch_bucket=1024,
+        emit_on_close=False,
+    )
+    ctx = Context(cfg)
+    ds = ctx.from_topic(
+        os.environ["KR_TOPIC"],
+        sample_json='{"ts": 1, "k": "a", "v": 1.0}',
+        bootstrap_servers=os.environ["KR_BROKER"],
+        timestamp_column="ts",
+    ).window(
+        ["k"],
+        [F.count(col("v")).alias("c"), F.sum(col("v")).alias("s")],
+        500,
+    )
+    with open(os.environ["KR_OUT"], "a", buffering=1) as out:
+        out.write(json.dumps({"event": "ready"}) + "\n")
+        for b in ds.stream():
+            if not b.schema.has(WINDOW_START_COLUMN):
+                continue
+            ws = b.column(WINDOW_START_COLUMN)
+            for i in range(b.num_rows):
+                out.write(
+                    json.dumps(
+                        {
+                            "ws": int(ws[i]),
+                            "k": str(b.column("k")[i]),
+                            "c": int(b.column("c")[i]),
+                            "s": float(b.column("s")[i]),
+                        }
+                    )
+                    + "\n"
+                )
+
+
+if __name__ == "__main__":
+    main()

@@ -329,3 +329,211 @@ def test_session_window_kill_and_restore(tmp_path, make_batch):
     assert set(combined) == set(golden)
     for k in golden:
         assert combined[k] == golden[k], (k, combined[k], golden[k])
+
+
+def test_sigkill_process_kill_and_restore(tmp_path, make_batch):
+    """TRUE process-level kill/restore (round-3 VERDICT item 6): a child
+    process runs a checkpointed Kafka pipeline against the mock broker;
+    the parent SIGKILLs it mid-stream after at least one committed epoch
+    — a real ``os.kill`` that skips every ``finally`` block an in-process
+    ``it.close()`` would run — restarts it on the same state path, and
+    asserts golden-window equality plus no full reprocess.  This is what
+    makes PARITY.md's "SIGKILL-tested" claim literal.
+
+    Reference paths exercised: offset restore-by-seek
+    (kafka_stream_read.rs:110-140), frame restore
+    (grouped_window_agg_stream.rs:160-211)."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    t0 = 1_700_000_000_000
+    keys = [f"k{i}" for i in range(5)]
+    golden: dict = {}
+
+    def produce_span(ms_lo, ms_hi, rows_per_ms=4):
+        """Rows over [ms_lo, ms_hi) event time, round-robin over both
+        partitions; updates the golden (count, sum) oracle."""
+        payloads = [[], []]
+        for ms in range(ms_lo, ms_hi):
+            for r in range(rows_per_ms):
+                ts = t0 + ms
+                k = keys[(ms + r) % len(keys)]
+                v = float((ms + r) % 97) / 7.0
+                payloads[(ms + r) % 2].append(
+                    _json.dumps({"ts": ts, "k": k, "v": v}).encode()
+                )
+                w = (ts // 500) * 500
+                c, s = golden.get((w, k), (0, 0.0))
+                golden[(w, k)] = (c + 1, s + v)
+        for p in (0, 1):
+            broker.produce("kr", p, payloads[p], ts_ms=t0 + ms_lo)
+
+    def read_out(path):
+        wins = {}
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        o = _json.loads(line)
+                    except _json.JSONDecodeError:
+                        continue  # torn tail from the SIGKILL
+                    if "ws" in o:
+                        wins[(o["ws"], o["k"])] = (o["c"], o["s"])
+        except FileNotFoundError:
+            pass
+        return wins
+
+    out_a = str(tmp_path / "emit_a.jsonl")
+    out_b = str(tmp_path / "emit_b.jsonl")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        # prepend the repo root but keep the rest (e.g. the TPU plugin's
+        # site dir) — overwriting PYTHONPATH breaks other environments
+        PYTHONPATH=os.pathsep.join(
+            [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        ),
+        KR_BROKER=broker.bootstrap,
+        KR_TOPIC="kr",
+        KR_STATE=str(tmp_path / "state"),
+        KR_INTERVAL="0.3",
+    )
+
+    def spawn(out_path):
+        e = dict(env)
+        e["KR_OUT"] = out_path
+        return subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "_sigkill_child.py")],
+            env=e, stderr=open(out_path + ".err", "w"),
+        )
+
+    def child_err(out_path, n=800):
+        try:
+            return open(out_path + ".err").read()[-n:]
+        except OSError:
+            return "<no stderr>"
+
+    stop_closers = threading.Event()
+
+    def trickle(ms_lo, ms_hi, step=150, delay=0.25):
+        """Continuous small-chunk production: the watermark is the batch's
+        MIN timestamp (reference parity, RecordBatchWatermark), so a
+        pre-produced topic fetched as one giant batch would never close a
+        window — real streams arrive incrementally."""
+        for lo in range(ms_lo, ms_hi, step):
+            produce_span(lo, min(lo + step, ms_hi))
+            time.sleep(delay)
+
+    def wait_ready(out_path, proc, timeout=60):
+        """Block until the child wrote its 'ready' line — producing before
+        the consumer is up would land everything in its first fetch."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if open(out_path).readline():
+                    return
+            except FileNotFoundError:
+                pass
+            assert proc.poll() is None, (
+                "child exited before ready: " + child_err(out_path)
+            )
+            time.sleep(0.05)
+        raise AssertionError("child never became ready")
+
+    def closer_trickle():
+        """Far-future rows, repeated: once a consumer drains the backlog,
+        its next fetch holds only these (batch min ts = 5000+) and the
+        watermark jumps past every real window."""
+        ms = 5000
+        while not stop_closers.is_set():
+            produce_span(ms, ms + 1, rows_per_ms=1)
+            ms += 1
+            time.sleep(0.1)
+
+    try:
+        broker.create_topic("kr", partitions=2)
+        p_a = spawn(out_a)
+        wait_ready(out_a, p_a)
+        feeder = threading.Thread(target=trickle, args=(0, 3600), daemon=True)
+        feeder.start()
+        try:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if len(read_out(out_a)) >= 10:  # >= 2 windows emitted
+                    break
+                assert p_a.poll() is None, (
+                        "child A exited early: " + child_err(out_a)
+                    )
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    "child A never emitted 2 windows; stderr: "
+                    + child_err(out_a)
+                )
+            # >= 3 checkpoint intervals after the emissions: at least one
+            # epoch that covers them is committed by now
+            time.sleep(1.0)
+            assert p_a.poll() is None
+        finally:
+            if p_a.poll() is None:
+                os.kill(p_a.pid, signal.SIGKILL)  # REAL mid-stream kill
+            p_a.wait(10)
+        wins_a = read_out(out_a)
+        assert len(wins_a) >= 10
+        feeder.join()  # the full feed is produced either way → golden fixed
+
+        # freeze 'needed' BEFORE the closer thread starts mutating golden
+        needed = {k for k in golden if k[0] + 500 <= t0 + 3600}
+        closers = threading.Thread(target=closer_trickle, daemon=True)
+        closers.start()
+        p_b = spawn(out_b)
+        try:
+            deadline = time.time() + 150
+            while time.time() < deadline:
+                union = dict(wins_a)
+                union.update(read_out(out_b))
+                if needed <= set(union):
+                    break
+                assert p_b.poll() is None, (
+                        "child B exited early: " + child_err(out_b)
+                    )
+                time.sleep(0.1)
+            else:
+                missing = needed - set(union)
+                raise AssertionError(
+                    f"recovery never covered {missing}; stderr: "
+                    + child_err(out_b)
+                )
+        finally:
+            stop_closers.set()
+            if p_b.poll() is None:
+                os.kill(p_b.pid, signal.SIGKILL)
+            p_b.wait(10)
+        wins_b = read_out(out_b)
+
+        union = dict(wins_a)
+        union.update(wins_b)
+        lost = []
+        for k in needed:
+            c, s = golden[k]
+            gc, gs = union.get(k, (None, None))
+            if gc != c or gs is None or abs(gs - s) > 1e-4 * max(1.0, abs(s)):
+                lost.append((k, (gc, gs), (c, s)))
+        assert not lost, f"windows lost/corrupt after SIGKILL: {lost[:5]}"
+        # no full reprocess: at least one window child A emitted was
+        # restored-past (not re-emitted) by child B
+        assert set(wins_a) - set(wins_b), (
+            "recovery child re-emitted every window — full reprocess"
+        )
+    finally:
+        broker.stop()
