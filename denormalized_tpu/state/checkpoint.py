@@ -25,8 +25,11 @@ Consistency: barriers flow in-band (see orchestrator.py), so on single-input
 chains the snapshot is an aligned cut and recovery is exactly-once w.r.t.
 engine state; emission to sinks remains at-least-once (windows that closed
 after the last barrier re-emit on recovery), matching the reference.  Join
-operator state is not checkpointed — parity with the reference, which
-checkpoints only sources and window state.
+operators checkpoint too (both sides' retained build rows + matched flags +
+watermarks, physical/join_exec.py enable_checkpointing) — BEYOND the
+reference, which checkpoints only sources and window state; at a join the
+early side's post-marker items are buffered until the other side's marker
+arrives, so the two-input cut is aligned as well.
 """
 
 from __future__ import annotations

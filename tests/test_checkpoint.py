@@ -537,3 +537,134 @@ def test_sigkill_process_kill_and_restore(tmp_path, make_batch):
         )
     finally:
         broker.stop()
+
+
+def _join_pipeline(ctx, t_batches, h_batches):
+    left = ctx.from_source(
+        MemorySource.from_batches(t_batches, timestamp_column="occurred_at_ms"),
+        name="jk_t",
+    ).window(["sensor_name"], [F.avg(col("reading")).alias("avg_t")], 1000)
+    right = (
+        ctx.from_source(
+            MemorySource.from_batches(h_batches, timestamp_column="occurred_at_ms"),
+            name="jk_h",
+        )
+        .window(["sensor_name"], [F.avg(col("reading")).alias("avg_h")], 1000)
+        .with_column_renamed("sensor_name", "hs")
+        .with_column_renamed("window_start_time", "hws")
+        .with_column_renamed("window_end_time", "hwe")
+    )
+    return left.join(
+        right, "inner", ["sensor_name", "window_start_time"], ["hs", "hws"]
+    )
+
+
+def _join_windows(result_or_batch):
+    out = {}
+    r = result_or_batch
+    for i in range(r.num_rows):
+        k = (int(r.column(WINDOW_START_COLUMN)[i]), r.column("sensor_name")[i])
+        out[k] = (
+            round(float(r.column("avg_t")[i]), 4),
+            round(float(r.column("avg_h")[i]), 4),
+        )
+    return out
+
+
+@pytest.mark.parametrize("mesh", [None, 8], ids=["single", "sharded"])
+def test_join_kill_and_restore(tmp_path, make_batch, mesh):
+    """Join-state checkpointing (round-3 VERDICT item 9): kill after a
+    committed aligned barrier, restore, and the union of join emissions
+    covers every golden pair without a full reprocess.  The join snapshot
+    carries both sides' retained build rows + matched flags + watermarks;
+    barrier alignment BUFFERS the early side's post-marker items so the
+    snapshot can never contain rows the source replay would re-insert."""
+    import jax
+
+    from denormalized_tpu.common.record_batch import RecordBatch as RB
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    if mesh and len(jax.devices()) < mesh:
+        pytest.skip("needs the virtual 8-device platform")
+    rng = np.random.default_rng(41)
+    t0 = 1_700_000_000_000
+
+    def batches(shift):
+        out = []
+        for b in range(14):
+            n = 160
+            ts = np.sort(t0 + b * 400 + rng.integers(0, 400, n))
+            keys = np.array(
+                [f"s{i}" for i in rng.integers(0, 6, n)], dtype=object
+            )
+            out.append(make_batch(ts, keys, rng.normal(50, 5, n) + shift))
+        return out
+
+    tb, hb = batches(0), batches(100)
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+            mesh_devices=mesh,
+            emit_lag_ms=0,
+        )
+
+    golden = _join_windows(
+        _join_pipeline(Context(make_cfg(None)), tb, hb).collect()
+    )
+    assert len(golden) > 8
+
+    state_dir = str(tmp_path / f"state_join_{mesh}")
+    ctx_a = Context(make_cfg(state_dir))
+    root_a = executor.build_physical(
+        lp.Sink(_join_pipeline(ctx_a, tb, hb)._plan, CollectSink()), ctx_a
+    )
+    orch_a = Orchestrator(interval_s=9999)
+    coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+    emitted_a = {}
+    items_seen = 0
+    it = root_a.run()
+    for item in it:
+        if isinstance(item, RB):
+            emitted_a.update(_join_windows(item))
+        if items_seen == 1:
+            orch_a.trigger_now()
+        if isinstance(item, Marker):
+            coord_a.commit(item.epoch)
+            break
+        items_seen += 1
+    it.close()  # crash
+    close_global_state_backend()
+
+    ctx_b = Context(make_cfg(state_dir))
+    root_b = executor.build_physical(
+        lp.Sink(_join_pipeline(ctx_b, tb, hb)._plan, CollectSink()), ctx_b
+    )
+    orch_b = Orchestrator(interval_s=9999)
+    coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+    assert coord_b.committed_epoch is not None
+    emitted_b = {}
+    for item in root_b.run():
+        if isinstance(item, RB):
+            emitted_b.update(_join_windows(item))
+
+    combined = dict(emitted_a)
+    combined.update(emitted_b)
+    assert set(combined) == set(golden), (
+        set(golden) ^ set(combined)
+    )
+    for k in golden:
+        gt, gh = golden[k]
+        ct, ch = combined[k]
+        assert ct == pytest.approx(gt, rel=1e-5), (k, ct, gt)
+        assert ch == pytest.approx(gh, rel=1e-5), (k, ch, gh)
+    # restored run resumed (upstream windows + join state restored), it
+    # did not reprocess the whole stream
+    assert len(emitted_b) < len(golden) or len(emitted_a) == 0
