@@ -41,17 +41,23 @@ def producer_loop(bootstrap: str, topics: list[str], rate_hz: float, stop):
         time.sleep(0.01)
 
 
-def start_embedded(rate_hz: float = 20000):
-    """Mock broker + generator threads; returns (broker, stop_event)."""
+def start_embedded(rate_hz: float = 20000, port: int = 0, host: str = "127.0.0.1"):
+    """Mock broker + generator threads; returns (broker, stop_event).
+    ``port=0`` picks an ephemeral port; the container entrypoint passes a
+    fixed one (Dockerfile) so external engines can connect — the role the
+    reference's baked Kafka image plays (Dockerfile:1-100)."""
     from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
 
-    broker = MockKafkaBroker().start()
+    broker = MockKafkaBroker(host=host, port=port).start()
     broker.create_topic("temperature", 1)
     broker.create_topic("humidity", 1)
     stop = threading.Event()
+    # a 0.0.0.0 bind (container) is not a connectable address — the
+    # in-process producer dials loopback
+    connect = broker.bootstrap.replace("0.0.0.0", "127.0.0.1")
     t = threading.Thread(
         target=producer_loop,
-        args=(broker.bootstrap, ["temperature", "humidity"], rate_hz, stop),
+        args=(connect, ["temperature", "humidity"], rate_hz, stop),
         daemon=True,
     )
     t.start()
@@ -62,6 +68,16 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--bootstrap-servers", default=None)
     ap.add_argument("--rate", type=float, default=20000)
+    ap.add_argument(
+        "--port", type=int, default=0,
+        help="fixed port for the embedded broker (0 = ephemeral); the "
+        "container entrypoint uses 9092",
+    )
+    ap.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind interface for the embedded broker; the container "
+        "entrypoint passes 0.0.0.0 (exposing all interfaces is opt-in)",
+    )
     args = ap.parse_args()
     if args.bootstrap_servers:
         stop = threading.Event()
@@ -69,8 +85,9 @@ if __name__ == "__main__":
             args.bootstrap_servers, ["temperature", "humidity"], args.rate, stop
         )
     else:
-        broker, stop = start_embedded(args.rate)
-        print(f"embedded broker on {broker.bootstrap}; Ctrl-C to stop")
+        broker, stop = start_embedded(args.rate, port=args.port, host=args.host)
+        addr = broker.bootstrap.replace("0.0.0.0", "127.0.0.1")
+        print(f"embedded broker on {addr}; Ctrl-C to stop")
         try:
             while True:
                 time.sleep(1)
