@@ -303,6 +303,7 @@ def gen_batches(
 DEVICE_STRATEGY = os.environ.get("BENCH_DEVICE_STRATEGY", "auto")
 EMISSION_COMPACTION = os.environ.get("BENCH_EMISSION_COMPACTION", "0") == "1"
 HOST_PIPELINE = os.environ.get("BENCH_HOST_PIPELINE", "0") == "1"
+DEVICE_FINALIZE = os.environ.get("BENCH_DEVICE_FINALIZE", "1") == "1"
 # True once set_knobs(rows=...) was called (harness mode): run_config's
 # kafka_e2e default-rows override must not clobber an explicit knob
 _ROWS_EXPLICIT = "BENCH_ROWS" in os.environ
@@ -315,6 +316,7 @@ def _engine_ctx(batch_bucket=None, **over):
     over.setdefault("device_strategy", DEVICE_STRATEGY)
     over.setdefault("emission_compaction", EMISSION_COMPACTION)
     over.setdefault("host_pipeline", HOST_PIPELINE)
+    over.setdefault("device_finalize", DEVICE_FINALIZE)
     cfg = EngineConfig(
         min_batch_bucket=batch_bucket or BATCH_ROWS, min_window_slots=32, **over
     )
@@ -1488,6 +1490,7 @@ def set_knobs(
     lat_rows=None,
     keys=None,
     batch=None,
+    device_finalize=None,
 ):
     """Set the module-level knobs main() normally reads from env.  Lets a
     harness (tools/chip_ab.py) run many configs IN ONE PROCESS — one
@@ -1495,6 +1498,7 @@ def set_knobs(
     each paying a multi-minute tunnel acquisition."""
     global CONFIG, DEVICE_STRATEGY, EMISSION_COMPACTION, HOST_PIPELINE
     global TOTAL_ROWS, LAT_ROWS, NUM_KEYS, BATCH_ROWS, _ROWS_EXPLICIT
+    global DEVICE_FINALIZE
     if config is not None:
         CONFIG = config
     if strategy is not None:
@@ -1503,6 +1507,8 @@ def set_knobs(
         EMISSION_COMPACTION = compaction
     if host_pipeline is not None:
         HOST_PIPELINE = host_pipeline
+    if device_finalize is not None:
+        DEVICE_FINALIZE = device_finalize
     if rows is not None:
         TOTAL_ROWS = rows
         _ROWS_EXPLICIT = True

@@ -229,10 +229,28 @@ class SingleDeviceWindowState(WindowStateBackend):
         )
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
-        return sa.read_slot(self.spec, self._state, slot)
+        out = sa.read_slot(self.spec, self._state, slot)
+        self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
+        return out
 
     def read_slot_compact(self, slot: int):
-        return sa.read_slot_compact(self.spec, self._state, slot)
+        gids, rows = sa.read_slot_compact(self.spec, self._state, slot)
+        self._count_compact_d2h(gids, rows, self.spec.group_capacity)
+        return gids, rows
+
+    def _count_compact_d2h(self, gids, rows, capacity) -> None:
+        """Wire accounting for a compact read: the transfer is the pow2
+        BUCKET covering the k active groups (read_slot_compact truncates
+        to k on host AFTER the device_get), so counting the returned
+        arrays would undercount by up to ~2x."""
+        k = len(gids)
+        if k == 0:
+            return
+        bucket = min(1 << (k - 1).bit_length(), capacity)
+        per_elem = gids.dtype.itemsize + sum(
+            a.dtype.itemsize for a in rows.values()
+        )
+        self.bytes_d2h += bucket * per_elem
 
     def reset_slot(self, slot: int) -> None:
         self._state = sa.reset_slot(
@@ -581,7 +599,9 @@ class KeyShardedWindowState(WindowStateBackend):
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
         # jitted traced-slot gather; slicing a G-sharded array gathers one
         # (G_total,) row per component
-        return sa.read_slot(self.spec, self._state, slot)
+        out = sa.read_slot(self.spec, self._state, slot)
+        self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
+        return out
 
     def reset_slot(self, slot: int) -> None:
         self._state = _key_sharded_reset_slot(
@@ -673,6 +693,7 @@ class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState
     read_reset_block_start = SingleDeviceWindowState.read_reset_block_start
     read_reset_block_finish = SingleDeviceWindowState.read_reset_block_finish
     _live_bucket = SingleDeviceWindowState._live_bucket
+    _count_compact_d2h = SingleDeviceWindowState._count_compact_d2h
     prepare_finals = SingleDeviceWindowState.prepare_finals
     read_reset_block_finals_start = (
         SingleDeviceWindowState.read_reset_block_finals_start
@@ -683,9 +704,11 @@ class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState
     def read_slot_compact(self, slot: int):
         # state is globally shaped; the spec carries the per-device shard,
         # so the bucket cap must come from the global width
-        return sa.read_slot_compact(
+        gids, rows = sa.read_slot_compact(
             self.spec, self._state, slot, capacity=self.group_capacity
         )
+        self._count_compact_d2h(gids, rows, self.group_capacity)
+        return gids, rows
 
 
 # ---------------------------------------------------------------------------
@@ -822,11 +845,13 @@ class PartialFinalWindowState(WindowStateBackend):
         )
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
-        return jax.device_get(
+        out = jax.device_get(
             _partial_merge_slot(
                 self.spec, self.mesh, self._state, jnp.asarray(slot, jnp.int32)
             )
         )
+        self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
+        return out
 
     def reset_slot(self, slot: int) -> None:
         self._state = _partial_reset_slot(

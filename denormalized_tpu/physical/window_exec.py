@@ -327,13 +327,25 @@ class StreamingWindowExec(ExecOperator):
                     ]
                 remapped[label] = nbuf
             host = remapped
+        old_backend = self._backend
         self._backend = make_sharded_state(
             self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
+        self._carry_counters(old_backend)
         if self._finals_specs is not None:
             self._backend.prepare_finals(self._finals_specs)
         self._backend.import_(host)
         self._metrics["grow_events"] += 1
+
+    def _carry_counters(self, old_backend) -> None:
+        """Link-traffic and merge counters live on the backend instance;
+        a grow/restore replacement must carry them or the bench's
+        bytes_h2d/bytes_d2h reflect only the post-last-growth tail —
+        exactly wrong for high-cardinality runs that grow repeatedly."""
+        self._backend.bytes_h2d += old_backend.bytes_h2d
+        self._backend.bytes_d2h += old_backend.bytes_d2h
+        if hasattr(self._backend, "merges") and hasattr(old_backend, "merges"):
+            self._backend.merges += old_backend.merges
 
     def _ensure_capacity(self, max_win_rel: int):
         cap = self._backend.group_capacity
@@ -846,9 +858,11 @@ class StreamingWindowExec(ExecOperator):
             accum_dtype=old.accum_dtype,
             compensated=old.compensated,
         )
+        old_backend = self._backend
         self._backend = make_sharded_state(
             self._spec, self._mesh, self._shard_strategy, self._device_strategy
         )
+        self._carry_counters(old_backend)
         if self._finals_specs is not None:
             self._backend.prepare_finals(self._finals_specs)
         self._backend.import_(arrays)

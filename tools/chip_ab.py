@@ -37,8 +37,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=8_000_000)
     ap.add_argument(
-        "--lat-rows", type=int, default=60_000_000,
-        help="paced latency-phase rows (60M -> ~59 samples/cell)",
+        "--lat-rows", type=int, default=110_000_000,
+        help="paced latency-phase rows (110M -> ~109 samples/cell; the "
+        "round-3 bar is >=100)",
     )
     ap.add_argument("--out", default=str(REPO / "AB_REPORT.json"))
     ap.add_argument(
@@ -67,15 +68,37 @@ def main():
         "--resume", action="store_true",
         help="skip cells already present with rc==0 in --out",
     )
+    ap.add_argument(
+        "--finals-ab", action="store_true",
+        help="also run device_finalize=off cells (partial_merge only) — "
+        "isolates the on-device finalization win",
+    )
+    ap.add_argument(
+        "--allow-cpu", action="store_true",
+        help="proceed on CPU fallback instead of failing fast (a CPU "
+        "A/B report is useless as chip evidence, so the default is to "
+        "exit 4 when the tunnel is down and let an outer loop retry)",
+    )
     args = ap.parse_args()
     strategies = args.strategies.split(",")
     compaction = [False, True] if args.compaction else [False]
 
     sys.path.insert(0, str(REPO))
+    if not args.allow_cpu:
+        # a dead tunnel must produce a retryable failure (exit 4), not a
+        # silent CPU report (bench._tpu_init_fail honors this)
+        os.environ["BENCH_TPU_INIT_REQUIRED"] = "1"
     import bench
 
     device = bench.init_backend()
     print(f"device: {device}", flush=True)
+    probe = {}
+    if device == "tpu":
+        try:
+            probe = bench.link_probe()
+            print(f"link probe: {probe}", flush=True)
+        except Exception as e:
+            print(f"link probe failed: {e!r}", flush=True)
 
     done_keys = set()
     prior_cells = []
@@ -89,16 +112,18 @@ def main():
                         c["config"], c["strategy"],
                         c.get("emission_compaction", False),
                         c.get("host_pipeline", False),
+                        c.get("device_finalize", True),
                     ))
         except Exception as e:
             print(f"resume: could not read {args.out}: {e!r}", flush=True)
 
-    def run_cell(config, strategy, compact, pipeline):
+    def run_cell(config, strategy, compact, pipeline, finals=True):
         cell = {
             "config": config,
             "strategy": strategy,
             "emission_compaction": compact,
             "host_pipeline": pipeline,
+            "device_finalize": finals,
         }
         t0 = time.time()
         # a wedged device op cannot be cancelled from inside the process:
@@ -127,6 +152,7 @@ def main():
             strategy=strategy,
             compaction=compact,
             host_pipeline=pipeline,
+            device_finalize=finals,
             rows=args.rows,
             lat_rows=args.lat_rows,
             # run_config re-derives highcard keys/batch from env; reset
@@ -166,26 +192,31 @@ def main():
 
     for config in args.configs.split(","):
         for strategy in strategies:
-            variants = [(c, False) for c in compaction]
-            if args.host_pipeline and strategy == "partial_merge":
-                variants.append((False, True))
-            for compact, pipeline in variants:
-                if (config, strategy, compact, pipeline) in done_keys:
+            variants = [(c, False, True) for c in compaction]
+            if strategy == "partial_merge":
+                if args.host_pipeline:
+                    variants.append((False, True, True))
+                if args.finals_ab:
+                    variants.append((False, False, False))
+            for compact, pipeline, finals in variants:
+                if (config, strategy, compact, pipeline, finals) in done_keys:
                     print(f"== {config} / {strategy} skipped (resume) ==",
                           flush=True)
                     continue
                 print(
                     f"== {config} / {strategy} / "
                     f"compaction={'on' if compact else 'off'}"
-                    f"{' / host_pipeline=on' if pipeline else ''} ==",
+                    f"{' / host_pipeline=on' if pipeline else ''}"
+                    f"{' / device_finalize=off' if not finals else ''} ==",
                     flush=True,
                 )
-                emit(run_cell(config, strategy, compact, pipeline))
+                emit(run_cell(config, strategy, compact, pipeline, finals))
     report = {
         "generated_at_unix": int(time.time()),
         "rows": args.rows,
         "lat_rows": args.lat_rows,
         "device": device,
+        "link_probe": probe,
         "cells": cells,
     }
     Path(args.out).write_text(json.dumps(report, indent=1))
