@@ -220,7 +220,12 @@ def main():
         "cells": cells,
     }
     Path(args.out).write_text(json.dumps(report, indent=1))
-    print(f"wrote {args.out} ({len(cells)} cells)")
+    failed = sum(1 for c in cells if c.get("rc") != 0)
+    print(f"wrote {args.out} ({len(cells)} cells, {failed} failed)")
+    if failed:
+        # failed cells are resumable (--resume skips only rc==0): exit
+        # nonzero so an outer retry loop reruns them
+        sys.exit(5)
 
 
 if __name__ == "__main__":

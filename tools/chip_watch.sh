@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-4 chip-evidence runner: wait for the axon tunnel relay to open,
+# then run the A/B harness over the BASELINE configs, retrying through
+# tunnel drops (chip_ab exits 4 on a dead tunnel, 3 on a hung cell; both
+# are resumable — the report is rewritten after every cell).
+#
+#   setsid nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
+#
+# The driver-bench's stale-holder sweep may SIGKILL this process at
+# end-of-round; AB_REPORT_r4.json keeps every completed cell either way.
+cd "$(dirname "$0")/.." || exit 1
+
+relay_open() {
+    for p in 8082 8083 8087 8092 8093 8097; do
+        if (exec 3<>"/dev/tcp/127.0.0.1/$p") 2>/dev/null; then
+            exec 3>&- 2>/dev/null
+            return 0
+        fi
+    done
+    return 1
+}
+
+echo "$(date -u +%H:%M:%S) chip_watch: waiting for relay"
+until relay_open; do sleep 15; done
+echo "$(date -u +%H:%M:%S) chip_watch: relay OPEN"
+
+# attempts are consumed only by runs that got past backend init (rc=4 =
+# init-time tunnel drop: ran zero cells, costs seconds — re-wait instead,
+# so a flapping relay cannot exhaust the budget before any work happens)
+attempt=0
+while [ "$attempt" -lt 6 ]; do
+    echo "$(date -u +%H:%M:%S) chip_watch: run (attempt $attempt/6)"
+    python tools/chip_ab.py \
+        --out AB_REPORT_r4.json --resume --finals-ab \
+        --strategies scatter,partial_merge \
+        --cell-timeout 1800
+    rc=$?
+    echo "$(date -u +%H:%M:%S) chip_watch: chip_ab rc=$rc"
+    if [ "$rc" -eq 0 ]; then
+        echo "$(date -u +%H:%M:%S) chip_watch: DONE"
+        exit 0
+    fi
+    if [ "$rc" -eq 4 ]; then
+        echo "$(date -u +%H:%M:%S) chip_watch: tunnel dead at init; re-waiting"
+        sleep 30
+        until relay_open; do sleep 15; done
+    else
+        # rc=3 (hung cell) / rc=5 (failed cells): resumable — retry
+        attempt=$((attempt + 1))
+        sleep 10
+    fi
+done
+echo "$(date -u +%H:%M:%S) chip_watch: attempts exhausted"
+exit 1
