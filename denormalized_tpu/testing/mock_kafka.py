@@ -12,6 +12,7 @@ Also usable outside tests as a lightweight local topic bus.
 
 from __future__ import annotations
 
+import bisect
 import socket
 import struct
 import threading
@@ -240,6 +241,10 @@ class MockKafkaBroker:
         self.host, self.port = self._sock.getsockname()
         # (topic, partition) -> list[(offset, ts, payload)]
         self._logs: dict[tuple[str, int], list] = {}
+        # batch-head blob index per partition: (head_offset, enc) for every
+        # non-empty pre-encoded record batch, so _fetch slices by bisect
+        # instead of walking the log (O(log n) vs O(n) per fetch)
+        self._blobs: dict[tuple[str, int], list] = {}
         self._npartitions: dict[str, int] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -270,6 +275,7 @@ class MockKafkaBroker:
         with self._lock:
             self._npartitions.setdefault(topic, max(partition + 1, 1))
             log = self._logs.setdefault((topic, partition), [])
+            blobs = self._blobs.setdefault((topic, partition), [])
             for p in payloads:
                 o = len(log)
                 enc = build_record_batch(
@@ -277,6 +283,7 @@ class MockKafkaBroker:
                     codec=codec, compressed_records=compressed_records,
                 )
                 log.append((o, ts, p, enc))
+                blobs.append((o, enc))
 
     def produce_batched(
         self, topic: str, partition: int, payloads, ts_ms=None,
@@ -295,6 +302,7 @@ class MockKafkaBroker:
         with self._lock:
             self._npartitions.setdefault(topic, max(partition + 1, 1))
             log = self._logs.setdefault((topic, partition), [])
+            blobs = self._blobs.setdefault((topic, partition), [])
             i = 0
             n = len(payloads)
             while i < n:
@@ -304,6 +312,7 @@ class MockKafkaBroker:
                     o, [(ts, p) for p in chunk], compute_crc=False
                 )
                 log.append((o, ts, chunk[0], enc))
+                blobs.append((o, enc))
                 for j in range(1, len(chunk)):
                     log.append((o + j, ts, chunk[j], b""))
                 i += len(chunk)
@@ -344,6 +353,8 @@ class MockKafkaBroker:
                     f"log is at {expect}"
                 )
             log.extend(entries)
+            blobs = self._blobs.setdefault((topic, partition), [])
+            blobs.extend((o, enc) for o, _ts, _pl, enc in entries if enc)
 
     @staticmethod
     def _pre_encode(offset: int, ts: int, payload: bytes) -> bytes:
@@ -556,10 +567,13 @@ class MockKafkaBroker:
                         self._npartitions[name], part + 1
                     )
                     log = self._logs.setdefault((name, part), [])
+                    blobs = self._blobs.setdefault((name, part), [])
                     base = log[-1][0] + 1 if log else 0
                     for i, (ts, pl) in enumerate(records):
                         o = base + i
-                        log.append((o, ts, pl, self._pre_encode(o, ts, pl)))
+                        enc = self._pre_encode(o, ts, pl)
+                        log.append((o, ts, pl, enc))
+                        blobs.append((o, enc))
                 out += struct.pack(">ihqq", part, 0, base, -1)
         out += struct.pack(">i", 0)  # throttle
         return bytes(out)
@@ -579,19 +593,23 @@ class MockKafkaBroker:
             pos += 4
             parts = []
             for _ in range(nparts):
-                part, off, _maxb = struct.unpack_from(">iqi", payload, pos)
+                part, off, maxb = struct.unpack_from(">iqi", payload, pos)
                 pos += 16
-                parts.append((part, off))
+                parts.append((part, off, maxb))
             reqs.append((name, parts))
 
         # honor max_wait when no data is available
         deadline = time.time() + max_wait / 1000.0
         while time.time() < deadline:
             with self._lock:
+                # offsets are dense from 0: data available iff the high
+                # watermark passed the requested offset — O(1) per
+                # partition (the old per-record any() walked the whole
+                # log prefix on every fetch poll)
                 have_data = any(
-                    any(r[0] >= off for r in self._logs.get((name, part), []))
+                    len(self._logs.get((name, part), ())) > off
                     for name, parts in reqs
-                    for part, off in parts
+                    for part, off, _maxb in parts
                 )
             if have_data:
                 break
@@ -604,25 +622,34 @@ class MockKafkaBroker:
             nb = name.encode()
             out += struct.pack(">h", len(nb)) + nb
             out += struct.pack(">i", len(parts))
-            for part, off in parts:
+            for part, off, maxb in parts:
                 with self._lock:
                     log = self._logs.get((name, part), [])
                     hw = (log[-1][0] + 1) if log else 0
-                    # offsets are dense from log[0]: slice instead of scan;
-                    # serve pre-encoded batches verbatim
-                    base = log[0][0] if log else 0
-                    lo = max(0, int(off) - base)
-                    # a batched entry's followers carry no bytes: back up
-                    # to the batch head so a mid-batch offset is still
-                    # served (clients skip records below the requested
-                    # offset, per protocol)
-                    while 0 < lo < len(log) and log[lo][3] == b"":
-                        lo -= 1
-                    # ~50K offsets ≈ 3-4MB of typical JSON payloads per
-                    # fetch: few round trips, still under client max-bytes
-                    blob = b"".join(
-                        e[3] for e in log[lo : lo + 50000]
-                    )
+                    # batch-head blob index: bisect to the batch covering
+                    # ``off`` (a mid-batch offset serves its head — clients
+                    # skip records below the requested offset, per
+                    # protocol), then take whole batches up to the
+                    # request's max_bytes.  O(log n + batches served) vs
+                    # the old O(n) log walk.  A caught-up consumer
+                    # (off >= hw) gets an EMPTY record set, not a replay
+                    # of the final batch.
+                    if int(off) >= hw:
+                        blob = b""
+                    else:
+                        blobs = self._blobs.get((name, part), [])
+                        bi = bisect.bisect_right(
+                            blobs, (int(off), b"\xff")
+                        ) - 1
+                        bi = max(0, bi)
+                        picked = []
+                        size = 0
+                        for o, enc in blobs[bi : bi + 50_000]:
+                            picked.append(enc)
+                            size += len(enc)
+                            if size >= max(maxb, 1):
+                                break
+                        blob = b"".join(picked)
                 out += struct.pack(">ihqq", part, 0, hw, hw)
                 out += struct.pack(">i", 0)  # aborted txns: empty array
                 out += struct.pack(">i", len(blob))
