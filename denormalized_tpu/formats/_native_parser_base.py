@@ -52,6 +52,25 @@ def configure_lib(lib, prefix: str, create_argtypes: list) -> None:
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint64),
     ]
+    # dictionary-encoded string export (tolerate a stale .so without the
+    # symbols — the wrapper falls back to the per-row decode loop); the
+    # capability is probed ONCE here, not per batch in the parse loop
+    setattr(
+        lib, f"_{prefix}_has_str_dict", hasattr(lib, f"{prefix}_col_str_dict")
+    )
+    if getattr(lib, f"_{prefix}_has_str_dict"):
+        g("col_str_dict").restype = ctypes.c_int64
+        g("col_str_dict").argtypes = [ctypes.c_void_p, ctypes.c_int]
+        g("col_str_dict_codes").restype = ctypes.POINTER(ctypes.c_int32)
+        g("col_str_dict_codes").argtypes = [ctypes.c_void_p, ctypes.c_int]
+        g("col_str_dict_bytes").restype = ctypes.POINTER(ctypes.c_uint8)
+        g("col_str_dict_bytes").argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        g("col_str_dict_offsets").restype = ctypes.POINTER(ctypes.c_uint64)
+        g("col_str_dict_offsets").argtypes = [ctypes.c_void_p, ctypes.c_int]
     g("clear").argtypes = [ctypes.c_void_p]
     g("destroy").argtypes = [ctypes.c_void_p]
     setattr(lib, flag, True)
@@ -112,6 +131,36 @@ class ColumnarNativeParser:
                 arr = np.ctypeslib.as_array(
                     self._fn("col_bool")(self._h, ci), shape=(n,)
                 ).astype(bool)
+            elif (
+                getattr(self._libref, f"_{self._prefix}_has_str_dict", False)
+                and (
+                    n_uniq := int(self._fn("col_str_dict")(self._h, ci))
+                ) >= 0
+            ):
+                # dictionary path (native dedupe, str_dict.hpp): decode
+                # each DISTINCT value once, fan out with one vectorized
+                # take — the per-row slice+decode loop below was the
+                # dominant host cost of the Kafka ingest path.  n_uniq < 0
+                # = high-cardinality bail-out (dict would cost more than
+                # the direct loop).
+                codes = np.ctypeslib.as_array(
+                    self._fn("col_str_dict_codes")(self._h, ci), shape=(n,)
+                )
+                nb = ctypes.c_uint64()
+                bptr = self._fn("col_str_dict_bytes")(
+                    self._h, ci, ctypes.byref(nb)
+                )
+                raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
+                offs = np.ctypeslib.as_array(
+                    self._fn("col_str_dict_offsets")(self._h, ci),
+                    shape=(n_uniq + 1,),
+                )
+                uniq = np.empty(n_uniq, dtype=object)
+                for i in range(n_uniq):
+                    uniq[i] = raw[offs[i] : offs[i + 1]].decode(
+                        errors="replace"
+                    )
+                arr = uniq[codes]
             else:
                 nb = ctypes.c_uint64()
                 bptr = self._fn("col_str_bytes")(

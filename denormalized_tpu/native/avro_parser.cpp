@@ -20,6 +20,8 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+
+#include "str_dict.hpp"
 #include <vector>
 
 namespace {
@@ -33,6 +35,7 @@ struct AvroCol {
   std::vector<uint8_t> valid;
   std::vector<uint8_t> str_bytes;
   std::vector<uint64_t> str_offsets;  // n+1
+  StrDict dict;
   void clear() {
     i64.clear();
     f64.clear();
@@ -229,6 +232,22 @@ const uint8_t* ap_col_str_bytes(void* h, int ci, uint64_t* nbytes) {
   AvroCol& c = static_cast<AvroParser*>(h)->cols[ci];
   *nbytes = c.str_bytes.size();
   return c.str_bytes.data();
+}
+int64_t ap_col_str_dict(void* h, int ci) {
+  AvroParser* p = static_cast<AvroParser*>(h);
+  AvroCol& c = p->cols[ci];
+  return build_str_dict(c.str_bytes, c.str_offsets, p->nrows, c.dict);
+}
+const int32_t* ap_col_str_dict_codes(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].dict.codes.data();
+}
+const uint8_t* ap_col_str_dict_bytes(void* h, int ci, uint64_t* nbytes) {
+  StrDict& d = static_cast<AvroParser*>(h)->cols[ci].dict;
+  *nbytes = d.bytes.size();
+  return d.bytes.data();
+}
+const uint64_t* ap_col_str_dict_offsets(void* h, int ci) {
+  return static_cast<AvroParser*>(h)->cols[ci].dict.offsets.data();
 }
 
 }  // extern "C"

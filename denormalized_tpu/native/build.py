@@ -26,8 +26,26 @@ def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
         src = _DIR / f"{name}.cpp"
         so = _DIR / f"{name}.so"
         stamp = _DIR / f"{name}.so.srchash"
+        # local quoted includes participate in the rebuild hash — a header
+        # edit must rebuild every .so that inlines it; the scan follows
+        # the quoted-include closure recursively
+        def hash_with_includes(path: Path, seen: set) -> bytes:
+            if path in seen or not path.exists():
+                return b""
+            seen.add(path)
+            data = path.read_bytes()
+            out = data
+            for line in data.splitlines():
+                line = line.strip().replace(b'#include"', b'#include "')
+                if line.startswith(b'#include "'):
+                    out += hash_with_includes(
+                        _DIR / line.split(b'"')[1].decode(), seen
+                    )
+            return out
+
         want = hashlib.sha256(
-            src.read_bytes() + repr(sorted(extra_flags or [])).encode()
+            hash_with_includes(src, set())
+            + repr(sorted(extra_flags or [])).encode()
         ).hexdigest()
         have = stamp.read_text().strip() if stamp.exists() else ""
         if not so.exists() or have != want:

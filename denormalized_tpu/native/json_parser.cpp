@@ -18,6 +18,8 @@
 #include <string>
 #include <vector>
 
+#include "str_dict.hpp"
+
 namespace {
 
 struct Col {
@@ -29,6 +31,7 @@ struct Col {
   std::vector<uint8_t> valid;
   std::vector<uint8_t> str_bytes;
   std::vector<uint64_t> str_offsets;  // nrows+1
+  StrDict dict;
 };
 
 struct Parser {
@@ -426,6 +429,22 @@ const uint8_t* jp_col_str_bytes(void* h, int col, uint64_t* nbytes) {
 }
 const uint64_t* jp_col_str_offsets(void* h, int col) {
   return static_cast<Parser*>(h)->cols[col].str_offsets.data();
+}
+int64_t jp_col_str_dict(void* h, int col) {
+  Parser* p = static_cast<Parser*>(h);
+  Col& c = p->cols[col];
+  return build_str_dict(c.str_bytes, c.str_offsets, p->nrows, c.dict);
+}
+const int32_t* jp_col_str_dict_codes(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].dict.codes.data();
+}
+const uint8_t* jp_col_str_dict_bytes(void* h, int col, uint64_t* nbytes) {
+  StrDict& d = static_cast<Parser*>(h)->cols[col].dict;
+  *nbytes = d.bytes.size();
+  return d.bytes.data();
+}
+const uint64_t* jp_col_str_dict_offsets(void* h, int col) {
+  return static_cast<Parser*>(h)->cols[col].dict.offsets.data();
 }
 
 void jp_destroy(void* h) { delete static_cast<Parser*>(h); }

@@ -553,3 +553,45 @@ def test_avro_truncated_raises_format_error():
     for cut in (1, len(full) // 2, len(full) - 1):
         with pytest.raises(FormatError):
             decode_record(schema, full[:cut])
+
+
+def test_native_string_dict_high_cardinality_bailout():
+    """The native parsers dictionary-encode string columns (decode each
+    distinct once, vectorized fanout); an effectively-unique column must
+    take the bail-out (>n/2 distincts -> -1) and still decode correctly
+    via the direct path."""
+    import json as _json
+
+    from denormalized_tpu.formats.json_codec import JsonDecoder
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    schema = Schema([
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ])
+    # all-unique keys (UUID-style): bail-out regime
+    rows = [
+        _json.dumps({"k": f"id-{i:06d}", "v": float(i)}).encode()
+        for i in range(5000)
+    ]
+    dec = JsonDecoder(schema)
+    for r in rows:
+        dec.push(r)
+    batch = dec.flush()
+    assert batch.num_rows == 5000
+    assert [str(x) for x in batch.column("k")[:3]] == [
+        "id-000000", "id-000001", "id-000002",
+    ]
+    assert str(batch.column("k")[4999]) == "id-004999"
+    # low-cardinality: dict path, values identical
+    rows2 = [
+        _json.dumps({"k": f"s{i % 7}", "v": float(i)}).encode()
+        for i in range(5000)
+    ]
+    dec2 = JsonDecoder(schema)
+    for r in rows2:
+        dec2.push(r)
+    batch2 = dec2.flush()
+    assert [str(x) for x in batch2.column("k")[:8]] == [
+        f"s{i % 7}" for i in range(8)
+    ]
