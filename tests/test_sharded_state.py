@@ -117,3 +117,4 @@ def test_distributed_helpers_single_process():
     mesh = global_mesh()  # whole job's devices, never sliced
     assert mesh.devices.size == len(jax.devices())
     assert local_device_count() >= 1
+
