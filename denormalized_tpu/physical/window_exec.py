@@ -454,6 +454,24 @@ class StreamingWindowExec(ExecOperator):
             closable_pre = self._closable()
             if late or closable_pre:
                 keep = win_rel64 >= closable_pre
+                if closable_pre and self._spec.length_units > 1:
+                    # A kept row's unit partial feeds EVERY window
+                    # containing that unit — including closable windows
+                    # whose emission is merely deferred.  The stripe is
+                    # per-unit, so that stale contribution cannot be
+                    # subtracted per-window later; the only sound order is
+                    # freeze-then-accumulate: emit every closable window
+                    # now, then rebase against the advanced first_open.
+                    # Only rows strictly BEHIND the watermark can straddle
+                    # (a row at ts ≥ wm has no closable window), so a
+                    # sorted feed never takes this path.
+                    lows = win_rel64 - (self._spec.length_units - 1)
+                    if bool((keep & (lows < closable_pre)).any()):
+                        yield from self._trigger(force=True)
+                        first = self._first_open
+                        win_rel64 = units - first
+                        closable_pre = self._closable()  # 0 post-emission
+                        keep = win_rel64 >= closable_pre
                 n_drop = int((~keep).sum())
                 if n_drop:
                     self._metrics["late_rows"] += n_drop - late
@@ -623,7 +641,7 @@ class StreamingWindowExec(ExecOperator):
                 gids = np.nonzero(active)[0].astype(np.int32)
                 yield self._build_emission(j0 + i, gids, rows, active)
 
-    def _trigger(self) -> Iterator[RecordBatch]:
+    def _trigger(self, force: bool = False) -> Iterator[RecordBatch]:
         """Emit every window whose end ≤ watermark (trigger_windows,
         grouped_window_agg_stream.rs:220-253).
 
@@ -632,7 +650,9 @@ class StreamingWindowExec(ExecOperator):
         replay-speed feed then closes several windows per device
         round-trip (merge + block gather amortized), while a real-time
         feed — whose stripe is necessarily older than the lag when its
-        window closes — emits immediately."""
+        window closes — emits immediately.  ``force`` bypasses the
+        deferral: ingest uses it to freeze closable windows before a
+        batch whose rows would otherwise leak late units into them."""
         yield from self._drain_pending()
         n_close = self._closable()
         if n_close == 0:
@@ -645,7 +665,8 @@ class StreamingWindowExec(ExecOperator):
         if self._backend.accumulates_host:
             age = time.perf_counter() - (self._stripe_wall or 0.0)
             if (
-                age < self._emit_lag_s
+                not force
+                and age < self._emit_lag_s
                 and self._backend.pending_rows < self._merge_rows
                 and self._stripe_fits_more()
             ):

@@ -30,9 +30,12 @@ echo "$(date -u +%H:%M:%S) chip_watch: relay OPEN"
 attempt=0
 while [ "$attempt" -lt 6 ]; do
     echo "$(date -u +%H:%M:%S) chip_watch: run (attempt $attempt/6)"
+    # partial_merge first: it is the headline (auto-selected) strategy —
+    # if the tunnel flaps mid-matrix the report still has the cells that
+    # matter most
     python tools/chip_ab.py \
         --out AB_REPORT_r4.json --resume --finals-ab \
-        --strategies scatter,partial_merge \
+        --strategies partial_merge,scatter \
         --cell-timeout 1800
     rc=$?
     echo "$(date -u +%H:%M:%S) chip_watch: chip_ab rc=$rc"
