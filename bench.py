@@ -963,6 +963,30 @@ def run_latency(config, ckpt_dir=None) -> dict:
     #     gc pause, which is itself the diagnosis.
     import gc
     import logging
+    import threading
+
+    # heartbeat sentinel: a daemon thread sleeping 5ms and timing its
+    # oversleep.  A slow latency sample WITH a matching heartbeat gap is a
+    # process-wide freeze (GIL-held host work or a kernel-level stall); a
+    # slow sample WITHOUT one is queueing in the engine's async pipeline.
+    # jit execution releases the GIL, so the sentinel ticks through device
+    # work.
+    hb_stop = threading.Event()
+    hb_gaps: list[tuple[float, float]] = []  # (gap_ms, wall)
+
+    def _heartbeat():
+        last = time.perf_counter()
+        while not hb_stop.is_set():
+            time.sleep(0.005)
+            now = time.perf_counter()
+            gap = (now - last) * 1000.0 - 5.0
+            if gap > 20:
+                hb_gaps.append((gap, now))
+            last = now
+
+    hb_thread = threading.Thread(
+        target=_heartbeat, daemon=True, name="lat-heartbeat"
+    )
 
     gc_pauses: list[float] = []
 
@@ -993,6 +1017,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
     gc.collect()
     gc.freeze()
     gc.callbacks.append(_gc_cb)
+    hb_thread.start()
     lats = []
     try:
         for batch in ds.stream():
@@ -1006,11 +1031,24 @@ def run_latency(config, ckpt_dir=None) -> dict:
             for e in np.unique(ends):
                 lat_ms = (now - clock.wall_of(e)) * 1000.0
                 lats.append(lat_ms)
-                if lat_ms > 200:
+                if lat_ms > 50:
+                    # grace sleep: after a GIL-held freeze the main thread
+                    # resumes first — give the sentinel a beat to wake and
+                    # record the gap before reading it, or the freeze gets
+                    # misclassified as engine queueing
+                    time.sleep(0.015)
+                    recent_hb = max(
+                        (g for g, w in hb_gaps if now - w < 2.0), default=0.0
+                    )
                     log(f"latency[{config}]: slow sample #{len(lats)}: "
                         f"{lat_ms:.1f}ms (window_end={e:.0f}, "
-                        f"compiles_so_far={_CompileCounter.count})")
+                        f"compiles_so_far={_CompileCounter.count}, "
+                        f"gc_pauses={len(gc_pauses)}, "
+                        f"hb_gap_recent={recent_hb:.1f}ms)")
     finally:
+        hb_stop.set()
+        # join so a gap ending at stream end still lands in the summary
+        hb_thread.join(timeout=0.1)
         gc.callbacks.remove(_gc_cb)
         gc.unfreeze()
         jax.config.update("jax_log_compiles", prior_log_compiles)
@@ -1027,12 +1065,16 @@ def run_latency(config, ckpt_dir=None) -> dict:
         "p95_window_latency_ms": round(float(np.percentile(a, 95)), 2),
         "p99_window_latency_ms": round(float(np.percentile(a, 99)), 2),
         "latency_samples": int(a.size),
+        "max_window_latency_ms": round(float(a.max()), 2),
         "latency_stalls": int(stalls.size),
         "paced_compiles": int(_CompileCounter.count),
     }
     if stalls.size:
         out["stall_max_ms"] = round(float(stalls.max()), 1)
         out["gc_pause_max_ms"] = round(max(gc_pauses, default=0.0), 1)
+    if hb_gaps:
+        out["hb_gap_max_ms"] = round(max(g for g, _ in hb_gaps), 1)
+        out["hb_gap_count"] = len(hb_gaps)
     return out
 
 
