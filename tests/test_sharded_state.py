@@ -19,7 +19,17 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _run(config, batches):
+def _default_aggs():
+    return [
+        F.count(col("reading")).alias("cnt"),
+        F.sum(col("reading")).alias("s"),
+        F.min(col("reading")).alias("mn"),
+        F.max(col("reading")).alias("mx"),
+        F.avg(col("reading")).alias("a"),
+    ]
+
+
+def _run(config, batches, aggs=None, slide_ms=None):
     ctx = Context(config)
     return (
         ctx.from_source(
@@ -27,26 +37,19 @@ def _run(config, batches):
         )
         .window(
             ["sensor_name"],
-            [
-                F.count(col("reading")).alias("cnt"),
-                F.sum(col("reading")).alias("s"),
-                F.min(col("reading")).alias("mn"),
-                F.max(col("reading")).alias("mx"),
-                F.avg(col("reading")).alias("a"),
-            ],
+            aggs if aggs is not None else _default_aggs(),
             1000,
+            slide_ms,
         )
         .collect()
     )
 
 
-def _to_dict(res):
+def _to_dict(res, fields=("cnt", "s", "mn", "mx")):
     return {
-        (int(res.column(WINDOW_START_COLUMN)[i]), res.column("sensor_name")[i]): (
-            int(res.column("cnt")[i]),
-            float(res.column("s")[i]),
-            float(res.column("mn")[i]),
-            float(res.column("mx")[i]),
+        (int(res.column(WINDOW_START_COLUMN)[i]), res.column("sensor_name")[i]): tuple(
+            int(res.column(f)[i]) if f == "cnt" else float(res.column(f)[i])
+            for f in fields
         )
         for i in range(res.num_rows)
     }
@@ -102,6 +105,60 @@ def test_sharded_growth(make_batch, strategy):
         for i in range(res.num_rows)
     }
     assert set(got) == set(oracle)
+
+
+def test_sharded_partial_merge_late_data_sliding(make_batch):
+    """Sharded partial_merge (KeyShardedPartialMergeWindowState) must apply
+    the same freeze-then-accumulate late-data semantics as the
+    single-device paths: a row behind the watermark whose newest window is
+    still open may NOT leak its unit partial into a closable-but-deferred
+    window (oracle: rows for emitted/closable windows drop per-window).
+    Compared against the default single-device run, which is
+    property-tested against the f64 oracle in test_window_properties."""
+    rng = np.random.default_rng(21)
+    t0 = 1_700_000_000_000
+    batches = []
+    # sorted feed for 4 batches, then one disordered batch reaching ~1.2s
+    # behind the watermark (straddles closable windows at L=1000/S=250).
+    # The watermark is the monotonic max of per-batch MIN timestamps, so
+    # after batch 3 (spanning t0+1800..2399) it sits at ~t0+1800.
+    for b in range(4):
+        n = 256
+        ts = np.sort(t0 + b * 600 + rng.integers(0, 600, n))
+        keys = np.array(
+            [f"k{i}" for i in rng.integers(0, 40, n)], dtype=object
+        )
+        batches.append(make_batch(ts, keys, rng.normal(0, 1, n)))
+    n = 256
+    late_ts = np.sort(t0 + rng.integers(600, 2400, n))  # behind wm≈t0+1800
+    keys = np.array([f"k{i}" for i in rng.integers(0, 40, n)], dtype=object)
+    batches.append(make_batch(late_ts, keys, rng.normal(0, 1, n)))
+
+    aggs = lambda: [
+        F.count(col("reading")).alias("cnt"),
+        F.sum(col("reading")).alias("s"),
+    ]
+    single = _to_dict(
+        _run(EngineConfig(), batches, aggs=aggs(), slide_ms=250),
+        fields=("cnt", "s"),
+    )
+    sharded = _to_dict(
+        _run(
+            EngineConfig(mesh_devices=8, device_strategy="partial_merge"),
+            batches,
+            aggs=aggs(),
+            slide_ms=250,
+        ),
+        fields=("cnt", "s"),
+    )
+    assert set(single) == set(sharded), sorted(
+        set(single) ^ set(sharded)
+    )[:5]
+    for k in single:
+        assert sharded[k][0] == single[k][0], (k, sharded[k], single[k])
+        np.testing.assert_allclose(
+            sharded[k][1], single[k][1], rtol=1e-4, atol=1e-5
+        )
 
 
 def test_distributed_helpers_single_process():

@@ -34,7 +34,7 @@ while [ "$attempt" -lt 6 ]; do
     # if the tunnel flaps mid-matrix the report still has the cells that
     # matter most
     python tools/chip_ab.py \
-        --out AB_REPORT_r4.json --resume --finals-ab \
+        --out AB_REPORT_r4.json --resume --finals-ab --host-pipeline \
         --strategies partial_merge,scatter \
         --cell-timeout 1800
     rc=$?
