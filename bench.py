@@ -157,11 +157,25 @@ def _relay_open() -> bool:
 
 
 def _relay_conn_established() -> bool:
-    """Passive relay liveness: is ANY socket in our netns ESTABLISHED to a
-    relay probe port?  While a claim is in flight the single-client relay
-    may refuse new connects, so an active ``_relay_open()`` probe can read
-    "closed" against a healthy tunnel — but the in-flight claim connection
-    itself then shows up here, proving the relay is alive."""
+    """Passive relay liveness: does THIS process own a socket ESTABLISHED
+    to a relay probe port?  While a claim is in flight the single-client
+    relay may refuse new connects, so an active ``_relay_open()`` probe
+    can read "closed" against a healthy tunnel — but our own in-flight
+    claim connection shows up here, proving the relay is alive.  Only our
+    own sockets count: a STALE holder's established connection means the
+    relay can never be claimed by us, which must read as dead so the
+    early abort fires instead of burning the full watchdog budget."""
+    own_inodes = set()
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                tgt = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                continue
+            if tgt.startswith("socket:["):
+                own_inodes.add(tgt[8:-1])
+    except OSError:
+        return False
     for path in ("/proc/self/net/tcp", "/proc/self/net/tcp6"):
         try:
             with open(path) as f:
@@ -170,7 +184,9 @@ def _relay_conn_established() -> bool:
             continue
         for ln in lines:
             parts = ln.split()
-            if len(parts) < 4 or parts[3] != "01":  # 01 = ESTABLISHED
+            if len(parts) < 10 or parts[3] != "01":  # 01 = ESTABLISHED
+                continue
+            if parts[9] not in own_inodes:
                 continue
             try:
                 rem_addr, rem_port_hex = parts[2].rsplit(":", 1)
