@@ -12,6 +12,7 @@
 // JSON nulls set validity 0.
 
 #include <algorithm>
+#include <charconv>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -34,10 +35,39 @@ struct Col {
   StrDict dict;
 };
 
+// Adaptive row layout: streaming producers emit a fixed record shape, so
+// after one general-path row parse we capture the exact inter-value byte
+// runs — `{"key":`, `,"key2":`, …, the trailing `}` — including whatever
+// fixed whitespace style the producer uses (serde_json compact,
+// json.dumps `", "`/`": "`, …).  Subsequent rows then reduce to a few
+// memcmps plus direct value parses: no per-key string materialization, no
+// column-name lookup, no whitespace scanning.  Any mismatch rolls the row
+// back and reparses it on the general path (which re-learns the layout),
+// so this is purely a fast path — semantics are identical.
+struct Layout {
+  bool valid = false;
+  std::vector<std::string> tok;  // tok[i]: bytes preceding value i
+  std::vector<int> col;          // column index of value i (-1: skip)
+  std::vector<int> missing;      // schema columns absent from the row
+  std::string tail;              // bytes after the last value
+  int fail_streak = 0;
+};
+
 struct Parser {
   std::vector<Col> cols;
   uint64_t nrows = 0;
   std::string error;
+  Layout layout;
+  int adopt_cooldown = 0;  // >0: layout adoption suppressed (see jp_parse)
+  // per-row discovery scratch (value spans, matched columns), filled by
+  // the general path so a successful row can become the new layout
+  std::vector<size_t> d_vs, d_ve;
+  std::vector<int> d_col;
+  bool d_ok = false;
+  // general-path per-row scratch, hoisted here so rows that stay on the
+  // general path don't pay per-row heap allocations
+  std::string g_key, g_sval;
+  std::vector<uint8_t> g_seen;
 };
 
 struct Cursor {
@@ -161,37 +191,70 @@ bool parse_string(Cursor& c, std::string& out) {
   return false;
 }
 
-// copy one numeric token into a NUL-terminated buffer, advancing the
-// cursor past it; returns the token length (0 = no token).  Scanning stops
-// at c.end or the first non-number char, so strtoll/strtod never touch the
-// (non-NUL-terminated) arena directly.  Tokens longer than the stack
-// buffer spill into `big` (rare: legal JSON numbers of arbitrary
-// precision) — *out points at whichever buffer holds the token.
-size_t scan_number(Cursor& c, char* buf, size_t bufsize, std::string& big,
-                   const char** out) {
-  size_t n = 0;
-  big.clear();
-  while (c.p < c.end) {
-    uint8_t ch = *c.p;
-    bool numchar = (ch >= '0' && ch <= '9') || ch == '-' || ch == '+' ||
-                   ch == '.' || ch == 'e' || ch == 'E';
-    if (!numchar) break;
-    if (n + 1 < bufsize) {
-      buf[n] = (char)ch;
-    } else {
-      if (big.empty()) big.assign(buf, n);
-      big.push_back((char)ch);
-    }
-    n++;
-    c.p++;
+// End of the numeric token starting at p (same charset the old
+// strtol-based scanner used); std::from_chars then converts straight from
+// the arena — no copy, no NUL termination needed, exactly-rounded doubles.
+// The full token must be consumed or the row fails (so "1e5" on an int
+// column cannot silently truncate to 1, and "inf"/"nan" — which
+// from_chars would accept but JSON forbids — yield an empty token).
+inline const uint8_t* num_token_end(const uint8_t* p, const uint8_t* e) {
+  while (p < e) {
+    uint8_t ch = *p;
+    if ((ch >= '0' && ch <= '9') || ch == '-' || ch == '+' || ch == '.' ||
+        ch == 'e' || ch == 'E')
+      p++;
+    else
+      break;
   }
-  if (!big.empty()) {
-    *out = big.c_str();
-    return n;
+  return p;
+}
+
+// out-of-range tokens keep the historical strtoll/strtod semantics
+// (clamp to LLONG_MIN/MAX; overflow to ±inf, underflow to ±0) instead of
+// failing the batch — json.loads accepts 1e999 and 20-digit ints, so the
+// parser must too.  Cold path: copies the token for NUL termination.
+bool num_range_fallback_i64(const uint8_t* q, const uint8_t* te, int64_t& v) {
+  std::string tok((const char*)q, (const char*)te);
+  char* endp = nullptr;
+  long long r = strtoll(tok.c_str(), &endp, 10);
+  if (endp != tok.c_str() + tok.size()) return false;
+  v = r;
+  return true;
+}
+
+bool num_range_fallback_f64(const uint8_t* q, const uint8_t* te, double& v) {
+  std::string tok((const char*)q, (const char*)te);
+  char* endp = nullptr;
+  double r = strtod(tok.c_str(), &endp);
+  if (endp != tok.c_str() + tok.size()) return false;
+  v = r;
+  return true;
+}
+
+inline bool parse_i64_at(const uint8_t*& q, const uint8_t* e, int64_t& v) {
+  const uint8_t* te = num_token_end(q, e);
+  if (te == q) return false;
+  auto r = std::from_chars((const char*)q, (const char*)te, v, 10);
+  if (r.ec == std::errc::result_out_of_range) {
+    if (!num_range_fallback_i64(q, te, v)) return false;
+  } else if (r.ec != std::errc() || r.ptr != (const char*)te) {
+    return false;
   }
-  buf[n] = '\0';
-  *out = buf;
-  return n;
+  q = te;
+  return true;
+}
+
+inline bool parse_f64_at(const uint8_t*& q, const uint8_t* e, double& v) {
+  const uint8_t* te = num_token_end(q, e);
+  if (te == q) return false;
+  auto r = std::from_chars((const char*)q, (const char*)te, v);
+  if (r.ec == std::errc::result_out_of_range) {
+    if (!num_range_fallback_f64(q, te, v)) return false;
+  } else if (r.ec != std::errc() || r.ptr != (const char*)te) {
+    return false;
+  }
+  q = te;
+  return true;
 }
 
 // skip any JSON value (for unknown keys)
@@ -228,6 +291,264 @@ bool skip_value(Cursor& c) {
   return true;
 }
 
+// drop every per-row append made by a partially parsed row, restoring all
+// column vectors to exactly `nr` committed rows (cheap: size bookkeeping
+// only, no reallocation)
+void rollback_row(Parser* p, uint64_t nr) {
+  for (auto& col : p->cols) {
+    col.valid.resize(nr);
+    switch (col.type) {
+      case 0: col.i64.resize(nr); break;
+      case 1: col.f64.resize(nr); break;
+      case 2: col.b.resize(nr); break;
+      case 3:
+        col.str_offsets.resize(nr + 1);
+        col.str_bytes.resize(col.str_offsets.back());
+        break;
+    }
+  }
+}
+
+void push_null(Col& col) {
+  col.valid.push_back(0);
+  switch (col.type) {
+    case 0: col.i64.push_back(0); break;
+    case 1: col.f64.push_back(0.0); break;
+    case 2: col.b.push_back(0); break;
+    case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
+  }
+}
+
+// layout-driven row parse; returns false on ANY deviation (caller rolls
+// back and reparses on the general path).  Appends exactly one entry per
+// schema column on success.
+bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
+  Layout& L = p->layout;
+  const uint8_t* q = b;
+  const size_t n = L.tok.size();
+  for (size_t i = 0; i < n; i++) {
+    const std::string& t = L.tok[i];
+    if ((size_t)(e - q) < t.size() || memcmp(q, t.data(), t.size()) != 0)
+      return false;
+    q += t.size();
+    const int ci = L.col[i];
+    if (ci < 0) {
+      Cursor c{q, e};
+      if (!skip_value(c) || c.fail) return false;
+      q = c.p;
+      continue;
+    }
+    Col& col = p->cols[ci];
+    if ((size_t)(e - q) >= 4 && memcmp(q, "null", 4) == 0) {
+      q += 4;
+      push_null(col);
+      continue;
+    }
+    switch (col.type) {
+      case 0: {
+        int64_t v;
+        if (!parse_i64_at(q, e, v)) return false;
+        col.i64.push_back(v);
+        break;
+      }
+      case 1: {
+        double v;
+        if (!parse_f64_at(q, e, v)) return false;
+        col.f64.push_back(v);
+        break;
+      }
+      case 2: {
+        if ((size_t)(e - q) >= 4 && memcmp(q, "true", 4) == 0) {
+          q += 4;
+          col.b.push_back(1);
+        } else if ((size_t)(e - q) >= 5 && memcmp(q, "false", 5) == 0) {
+          q += 5;
+          col.b.push_back(0);
+        } else {
+          return false;
+        }
+        break;
+      }
+      case 3: {
+        if (q >= e || *q != '"') return false;
+        const uint8_t* s = q + 1;
+        const uint8_t* close = (const uint8_t*)memchr(s, '"', e - s);
+        if (!close) return false;
+        if (memchr(s, '\\', close - s) != nullptr) {
+          // escape present: the first '"' may itself be escaped — use the
+          // full unescaping parser for this value
+          Cursor c{s, e};
+          std::string sval;
+          if (!parse_string(c, sval)) return false;
+          col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
+                               sval.end());
+          q = c.p;
+        } else {
+          col.str_bytes.insert(col.str_bytes.end(), s, close);
+          q = close + 1;
+        }
+        col.str_offsets.push_back(col.str_bytes.size());
+        break;
+      }
+    }
+    col.valid.push_back(1);
+  }
+  if ((size_t)(e - q) != L.tail.size() ||
+      memcmp(q, L.tail.data(), L.tail.size()) != 0)
+    return false;
+  for (int ci : L.missing) push_null(p->cols[ci]);
+  return true;
+}
+
+// capture the layout of a row the general path just parsed successfully
+void adopt_layout(Parser* p, const uint8_t* b, const uint8_t* e) {
+  Layout& L = p->layout;
+  L.valid = false;
+  if (!p->d_ok || p->d_vs.empty()) return;  // dup keys / empty object
+  const size_t n = p->d_vs.size();
+  L.tok.resize(n);
+  L.tok[0].assign((const char*)b, p->d_vs[0]);
+  for (size_t i = 1; i < n; i++)
+    L.tok[i].assign((const char*)b + p->d_ve[i - 1],
+                    p->d_vs[i] - p->d_ve[i - 1]);
+  L.tail.assign((const char*)b + p->d_ve[n - 1],
+                (size_t)(e - b) - p->d_ve[n - 1]);
+  L.col = p->d_col;
+  L.missing.clear();
+  std::vector<uint8_t> present(p->cols.size(), 0);
+  for (int c : L.col)
+    if (c >= 0) present[c] = 1;
+  for (int i = 0; i < (int)p->cols.size(); i++)
+    if (!present[i]) L.missing.push_back(i);
+  L.valid = true;
+  // NOTE: fail_streak is deliberately NOT reset here — it resets only on
+  // a fast-row success.  Re-adopting after every general-path row would
+  // otherwise zero the streak each time and the mixed-shape kill-switch
+  // in jp_parse could never fire.
+}
+
+// the general (any-shape) row parse; fills discovery scratch for
+// adopt_layout.  Returns false with p->error set on malformed input.
+bool parse_row_general(Parser* p, const uint8_t* b, const uint8_t* e,
+                       uint64_t r) {
+  const int ncols = (int)p->cols.size();
+  std::string& key = p->g_key;
+  std::string& sval = p->g_sval;
+  std::vector<uint8_t>& seen = p->g_seen;
+  seen.assign(ncols, 0);
+  p->d_vs.clear();
+  p->d_ve.clear();
+  p->d_col.clear();
+  p->d_ok = true;
+
+  Cursor c{b, e};
+  if (!c.eat('{')) {
+    p->error = "expected '{' at row " + std::to_string(r);
+    return false;
+  }
+  if (!c.peek('}')) {
+    for (;;) {
+      if (!c.eat('"')) break;
+      if (!parse_string(c, key)) { c.fail = true; break; }
+      if (!c.eat(':')) break;
+      // find column
+      int ci = -1;
+      for (int i = 0; i < ncols; i++)
+        if (p->cols[i].name == key) { ci = i; break; }
+      c.ws();
+      p->d_vs.push_back((size_t)(c.p - b));
+      p->d_col.push_back(ci);
+      if (ci < 0) {
+        if (!skip_value(c)) { c.fail = true; break; }
+      } else {
+        Col& col = p->cols[ci];
+        if (seen[ci]) {
+          // duplicate key: last-wins (match json.loads dict semantics) —
+          // drop the value stored for the earlier occurrence
+          p->d_ok = false;  // fast path can't reproduce dup handling
+          col.valid.pop_back();
+          switch (col.type) {
+            case 0: col.i64.pop_back(); break;
+            case 1: col.f64.pop_back(); break;
+            case 2: col.b.pop_back(); break;
+            case 3:
+              col.str_offsets.pop_back();
+              col.str_bytes.resize(col.str_offsets.back());
+              break;
+          }
+        }
+        seen[ci] = 1;
+        bool is_null = false;
+        if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
+          c.p += 4;
+          is_null = true;
+        }
+        if (is_null) {
+          push_null(col);
+        } else {
+          switch (col.type) {
+            case 0: {
+              int64_t v;
+              if (!parse_i64_at(c.p, c.end, v)) { c.fail = true; }
+              col.i64.push_back(c.fail ? 0 : v);
+              col.valid.push_back(1);
+              break;
+            }
+            case 1: {
+              double v;
+              if (!parse_f64_at(c.p, c.end, v)) { c.fail = true; }
+              col.f64.push_back(c.fail ? 0.0 : v);
+              col.valid.push_back(1);
+              break;
+            }
+            case 2: {
+              c.ws();
+              if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
+                c.p += 4;
+                col.b.push_back(1);
+              } else if (c.end - c.p >= 5 &&
+                         memcmp(c.p, "false", 5) == 0) {
+                c.p += 5;
+                col.b.push_back(0);
+              } else {
+                c.fail = true;
+                col.b.push_back(0);
+              }
+              col.valid.push_back(1);
+              break;
+            }
+            case 3: {
+              if (!c.eat('"')) { c.fail = true; break; }
+              if (!parse_string(c, sval)) { c.fail = true; break; }
+              col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
+                                   sval.end());
+              col.str_offsets.push_back(col.str_bytes.size());
+              col.valid.push_back(1);
+              break;
+            }
+          }
+        }
+      }
+      if (c.fail) break;
+      p->d_ve.push_back((size_t)(c.p - b));
+      c.ws();
+      if (c.peek(',')) { c.p++; continue; }
+      break;
+    }
+    if (!c.fail) c.eat('}');
+  } else {
+    c.p++;  // consume '}'
+  }
+  if (c.fail) {
+    p->error = "malformed JSON at row " + std::to_string(r);
+    return false;
+  }
+  // missing keys → null
+  for (int i = 0; i < ncols; i++)
+    if (!seen[i]) push_null(p->cols[i]);
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -261,144 +582,42 @@ void jp_clear(void* h) {
 int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
              uint64_t nrows) {
   Parser* p = static_cast<Parser*>(h);
-  const int ncols = (int)p->cols.size();
-  std::string key, sval;
-  std::vector<uint8_t> seen(ncols);
-
-  for (uint64_t r = 0; r < nrows; r++) {
-    Cursor c{data + offsets[r], data + offsets[r + 1]};
-    std::fill(seen.begin(), seen.end(), 0);
-    if (!c.eat('{')) {
-      p->error = "expected '{' at row " + std::to_string(r);
-      return -1;
-    }
-    if (!c.peek('}')) {
-      for (;;) {
-        if (!c.eat('"')) break;
-        if (!parse_string(c, key)) { c.fail = true; break; }
-        if (!c.eat(':')) break;
-        // find column
-        int ci = -1;
-        for (int i = 0; i < ncols; i++)
-          if (p->cols[i].name == key) { ci = i; break; }
-        if (ci < 0) {
-          if (!skip_value(c)) { c.fail = true; break; }
-        } else {
-          Col& col = p->cols[ci];
-          if (seen[ci]) {
-            // duplicate key: last-wins (match json.loads dict semantics) —
-            // drop the value stored for the earlier occurrence
-            col.valid.pop_back();
-            switch (col.type) {
-              case 0: col.i64.pop_back(); break;
-              case 1: col.f64.pop_back(); break;
-              case 2: col.b.pop_back(); break;
-              case 3:
-                col.str_offsets.pop_back();
-                col.str_bytes.resize(col.str_offsets.back());
-                break;
-            }
-          }
-          seen[ci] = 1;
-          c.ws();
-          bool is_null = false;
-          if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
-            c.p += 4;
-            is_null = true;
-          }
-          if (is_null) {
-            col.valid.push_back(0);
-            switch (col.type) {
-              case 0: col.i64.push_back(0); break;
-              case 1: col.f64.push_back(0); break;
-              case 2: col.b.push_back(0); break;
-              case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
-            }
-          } else {
-            switch (col.type) {
-              // numeric tokens are copied into a bounded NUL-terminated
-              // local buffer first: strtoll/strtod scan until NUL, and the
-              // fetch arena is NOT NUL-terminated — a payload truncated
-              // mid-number at the arena's end would let them read past it
-              case 0: {
-                char numbuf[48];
-                std::string big;
-                const char* tok = nullptr;
-                size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
-                char* endp = nullptr;
-                long long v = tl ? strtoll(tok, &endp, 10) : 0;
-                // partial consumption (e.g. "1e5" on an int column) must
-                // fail the row, not silently truncate to 1
-                if (tl == 0 || endp != tok + tl) { c.fail = true; }
-                col.i64.push_back(v);
-                col.valid.push_back(1);
-                break;
-              }
-              case 1: {
-                char numbuf[48];
-                std::string big;
-                const char* tok = nullptr;
-                size_t tl = scan_number(c, numbuf, sizeof numbuf, big, &tok);
-                char* endp = nullptr;
-                double v = tl ? strtod(tok, &endp) : 0.0;
-                if (tl == 0 || endp != tok + tl) { c.fail = true; }
-                col.f64.push_back(v);
-                col.valid.push_back(1);
-                break;
-              }
-              case 2: {
-                c.ws();
-                if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
-                  c.p += 4;
-                  col.b.push_back(1);
-                } else if (c.end - c.p >= 5 && memcmp(c.p, "false", 5) == 0) {
-                  c.p += 5;
-                  col.b.push_back(0);
-                } else {
-                  c.fail = true;
-                  col.b.push_back(0);
-                }
-                col.valid.push_back(1);
-                break;
-              }
-              case 3: {
-                if (!c.eat('"')) { c.fail = true; break; }
-                if (!parse_string(c, sval)) { c.fail = true; break; }
-                col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
-                                     sval.end());
-                col.str_offsets.push_back(col.str_bytes.size());
-                col.valid.push_back(1);
-                break;
-              }
-            }
-          }
-        }
-        if (c.fail) break;
-        c.ws();
-        if (c.peek(',')) { c.p++; continue; }
+  for (auto& col : p->cols) {
+    col.valid.reserve(col.valid.size() + nrows);
+    switch (col.type) {
+      case 0: col.i64.reserve(col.i64.size() + nrows); break;
+      case 1: col.f64.reserve(col.f64.size() + nrows); break;
+      case 2: col.b.reserve(col.b.size() + nrows); break;
+      case 3:
+        col.str_offsets.reserve(col.str_offsets.size() + nrows);
         break;
+    }
+  }
+  for (uint64_t r = 0; r < nrows; r++) {
+    const uint8_t* b = data + offsets[r];
+    const uint8_t* e = data + offsets[r + 1];
+    if (p->layout.valid) {
+      if (fast_row(p, b, e)) {
+        p->layout.fail_streak = 0;
+        p->nrows++;
+        continue;
       }
-      if (!c.fail) c.eat('}');
-    } else {
-      c.p++;  // consume '}'
-    }
-    if (c.fail) {
-      p->error = "malformed JSON at row " + std::to_string(r);
-      return -1;
-    }
-    // missing keys → null
-    for (int i = 0; i < ncols; i++) {
-      if (!seen[i]) {
-        Col& col = p->cols[i];
-        col.valid.push_back(0);
-        switch (col.type) {
-          case 0: col.i64.push_back(0); break;
-          case 1: col.f64.push_back(0); break;
-          case 2: col.b.push_back(0); break;
-          case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
-        }
+      rollback_row(p, p->nrows);
+      // a producer whose shape keeps missing the layout (mixed styles,
+      // varying key sets) must not pay fast-attempt + rollback + layout
+      // re-adoption per row forever: after 8 straight misses, disable
+      // the fast path and suppress re-adoption for a stretch of rows
+      if (++p->layout.fail_streak >= 8) {
+        p->layout.valid = false;
+        p->layout.fail_streak = 0;
+        p->adopt_cooldown = 256;
       }
     }
+    if (!parse_row_general(p, b, e, r)) return -1;
+    if (p->adopt_cooldown > 0)
+      p->adopt_cooldown--;
+    else
+      adopt_layout(p, b, e);
     p->nrows++;
   }
   return 0;

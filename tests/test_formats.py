@@ -595,3 +595,106 @@ def test_native_string_dict_high_cardinality_bailout():
     assert [str(x) for x in batch2.column("k")[:8]] == [
         f"s{i % 7}" for i in range(8)
     ]
+
+
+def test_json_native_adaptive_layout_mixed_shapes():
+    """The native parser learns a producer's fixed row layout and fast-
+    paths subsequent rows (memcmp key tokens, direct value parses); any
+    deviation must transparently fall back.  Differential vs the Python
+    decoder across: key reorder mid-stream, json.dumps-spaced vs compact
+    styles, escaped strings, nulls, missing keys, unknown extra keys,
+    and layout reuse across flushes."""
+    rows = []
+    for i in range(64):  # stable compact shape: layout adopted + reused
+        rows.append(
+            (
+                '{"occurred_at_ms":%d,"sensor_name":"s%d","reading":%.3f,'
+                '"flag":%s}' % (i, i % 5, i * 0.5, "true" if i % 2 else "false")
+            ).encode()
+        )
+    # json.dumps style (", " / ": " separators) — different fixed layout
+    for i in range(64, 96):
+        rows.append(
+            json.dumps(
+                {
+                    "occurred_at_ms": i,
+                    "sensor_name": f"s{i % 5}",
+                    "reading": None if i % 7 == 0 else i * 0.5,
+                    "flag": bool(i % 2),
+                }
+            ).encode()
+        )
+    # key order changed mid-stream
+    for i in range(96, 128):
+        rows.append(
+            json.dumps(
+                {
+                    "flag": bool(i % 2),
+                    "reading": i * 0.5,
+                    "occurred_at_ms": i,
+                    "sensor_name": f"s{i % 5}",
+                }
+            ).encode()
+        )
+    # escapes in string values; unknown extra key; missing 'flag'
+    for i in range(128, 160):
+        rows.append(
+            json.dumps(
+                {
+                    "occurred_at_ms": i,
+                    "sensor_name": f's"quoted"\\{i % 5}☃',
+                    "reading": i * 0.5,
+                    "extra": {"nested": [1, 2, {"deep": None}]},
+                }
+            ).encode()
+        )
+    a = JsonDecoder(FLAT, use_native=True)
+    b = JsonDecoder(FLAT, use_native=False)
+    # two flushes: the adopted layout persists across jp_clear and must
+    # keep decoding correctly on the second batch
+    for cut in (0, 80):
+        for r in rows[cut : cut + 80]:
+            a.push(r)
+            b.push(r)
+        ba, bb = a.flush(), b.flush()
+        assert ba.num_rows == bb.num_rows
+        for name in FLAT.names:
+            if ba.column(name).dtype == object:
+                assert ba.column(name).tolist() == bb.column(name).tolist()
+            else:
+                np.testing.assert_array_equal(
+                    ba.column(name), bb.column(name)
+                )
+            ma, mb = ba.mask(name), bb.mask(name)
+            assert (ma is None) == (mb is None), name
+            if ma is not None:
+                np.testing.assert_array_equal(ma, mb)
+
+
+def test_json_native_numeric_range_extremes():
+    """Out-of-range numerics keep json.loads-compatible semantics instead
+    of failing the batch: huge ints clamp (strtoll semantics), 1e999
+    overflows to inf, 1e-999 underflows to 0."""
+    schema = Schema(
+        [Field("i", DataType.INT64), Field("f", DataType.FLOAT64)]
+    )
+    rows = [
+        b'{"i":99999999999999999999999,"f":1e999}',
+        b'{"i":-99999999999999999999999,"f":-1e999}',
+        b'{"i":7,"f":1e-999}',
+        # same shape repeated so the FAST path (layout adopted from row 1)
+        # also sees the extremes
+        b'{"i":99999999999999999999999,"f":1e999}',
+        b'{"i":7,"f":-1e-999}',
+    ]
+    dec = JsonDecoder(schema, use_native=True)
+    for r in rows:
+        dec.push(r)
+    batch = dec.flush()
+    ivals = batch.column("i")
+    fvals = batch.column("f")
+    assert ivals[0] == np.iinfo(np.int64).max
+    assert ivals[1] == np.iinfo(np.int64).min
+    assert ivals[2] == 7 and ivals[3] == np.iinfo(np.int64).max
+    assert np.isposinf(fvals[0]) and np.isneginf(fvals[1])
+    assert fvals[2] == 0.0 and np.isposinf(fvals[3]) and fvals[4] == 0.0
