@@ -281,10 +281,19 @@ class DataStream:
 
         self._execute(PrintSink())
 
-    def sink(self, fn: Callable[[RecordBatch], None]) -> None:
+    def sink(
+        self, fn: Callable[[RecordBatch], None], *, as_pyarrow: bool = False
+    ) -> None:
         """Execute, calling ``fn`` per emitted batch (the PyO3 sink_python
-        path, py-denormalized/src/datastream.rs:229-270)."""
+        path, py-denormalized/src/datastream.rs:229-270).  With
+        ``as_pyarrow=True`` the callback receives ``pyarrow.RecordBatch``
+        objects — the exact shape the reference hands its Python callbacks
+        (datastream.rs:244-252 converts via to_pyarrow under the GIL)."""
         from denormalized_tpu.physical.simple_execs import CallbackSink
+
+        if as_pyarrow:
+            user_fn = fn
+            fn = lambda b: user_fn(b.to_pyarrow())  # noqa: E731
 
         self._execute(CallbackSink(fn))
 

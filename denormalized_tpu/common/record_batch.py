@@ -89,6 +89,80 @@ class RecordBatch:
             f.name: c.tolist() for f, c in zip(self.schema, self.columns)
         }
 
+    # -- Arrow interop ---------------------------------------------------
+    # The reference's Python callback path hands pyarrow batches to user
+    # code (py-denormalized/src/datastream.rs:244-252), and its vendored
+    # layer leans on pyarrow throughout — a user switching over gets the
+    # same shapes via these converters.  pyarrow is an optional
+    # convenience (lazy import), never an engine dependency.
+
+    def to_pyarrow(self):
+        """Convert to a ``pyarrow.RecordBatch`` (nulls preserved)."""
+        import pyarrow as pa
+
+        arrays, fields = [], []
+        for f, col, mask in zip(self.schema, self.columns, self.masks):
+            nulls = None if mask is None else ~np.asarray(mask, dtype=bool)
+            pa_type = _pa_type_of_field(pa, f)
+            if pa_type is not None and col.dtype != object and not (
+                pa.types.is_struct(pa_type) or pa.types.is_list(pa_type)
+            ):
+                arr = pa.array(np.ascontiguousarray(col), type=pa_type,
+                               mask=nulls)
+            else:
+                # STRING object arrays and host-only STRUCT/LIST columns go
+                # through python values; nulls become None.  The declared
+                # type (when derivable from Field children) keeps the
+                # arrow schema identical between empty and non-empty
+                # batches — inference on [] would yield a null-typed field.
+                vals = col.tolist()
+                if nulls is not None:
+                    vals = [None if d else v for v, d in zip(vals, nulls)]
+                arr = (pa.array(vals, type=pa_type)
+                       if pa_type is not None else pa.array(vals))
+            arrays.append(arr)
+            fields.append(pa.field(f.name, arr.type, nullable=f.nullable))
+        return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+    def to_pandas(self):
+        """Convert to a ``pandas.DataFrame`` (via pyarrow)."""
+        return self.to_pyarrow().to_pandas()
+
+    @staticmethod
+    def from_pyarrow(rb) -> "RecordBatch":
+        """Build from a ``pyarrow.RecordBatch`` / ``pyarrow.Table`` slice."""
+        import pyarrow as pa
+
+        fields, cols, masks = [], [], []
+        for pf in rb.schema:
+            col = rb.column(pf.name)
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            dtype = _dtype_from_arrow(pa, pf.type)
+            valid = None
+            if col.null_count:
+                valid = np.asarray(pa.compute.is_valid(col).to_numpy(
+                    zero_copy_only=False), dtype=bool)
+            if dtype in (DataType.STRING, DataType.STRUCT, DataType.LIST):
+                arr = np.empty(len(col), dtype=object)
+                arr[:] = col.to_pylist()
+            else:
+                if pa.types.is_timestamp(pf.type):
+                    # normalize us/ns (e.g. pandas-origin) to millisecond
+                    # values BEFORE the integer reinterpretation
+                    col = col.cast(pa.timestamp("ms")).cast(pa.int64())
+                if col.null_count:
+                    fill = False if pa.types.is_boolean(col.type) else 0
+                    col = col.fill_null(fill)
+                arr = np.asarray(
+                    col.to_numpy(zero_copy_only=False),
+                    dtype=dtype.to_numpy(),
+                )
+            fields.append(Field(pf.name, dtype, nullable=pf.nullable))
+            cols.append(arr)
+            masks.append(valid)
+        return RecordBatch(Schema(fields), cols, masks)
+
     # -- transforms ------------------------------------------------------
     def select(self, names: Sequence[str]) -> "RecordBatch":
         idx = [self.schema.index_of(n) for n in names]
@@ -170,6 +244,66 @@ class RecordBatch:
 
     def __repr__(self) -> str:
         return f"RecordBatch({self.num_rows} rows, {self.schema!r})"
+
+
+# engine dtype → pyarrow type factory (callables taking the pa module, so
+# pyarrow stays a lazy import); STRUCT/LIST fall through to inference
+_PA_OF = {
+    DataType.INT32: lambda pa: pa.int32(),
+    DataType.INT64: lambda pa: pa.int64(),
+    DataType.FLOAT32: lambda pa: pa.float32(),
+    DataType.FLOAT64: lambda pa: pa.float64(),
+    DataType.BOOL: lambda pa: pa.bool_(),
+    DataType.STRING: lambda pa: pa.string(),
+    DataType.TIMESTAMP_MS: lambda pa: pa.timestamp("ms"),
+}
+
+
+def _pa_type_of_field(pa, f):
+    """Arrow type for an engine Field, or None when not derivable (a LIST
+    with no declared child falls back to value inference)."""
+    base = _PA_OF.get(f.dtype)
+    if base is not None:
+        return base(pa)
+    if f.dtype is DataType.STRUCT:
+        return pa.struct(
+            [
+                pa.field(c.name, _pa_type_of_field(pa, c) or pa.null(),
+                         nullable=c.nullable)
+                for c in f.children
+            ]
+        )
+    if f.dtype is DataType.LIST and len(f.children) == 1:
+        child = _pa_type_of_field(pa, f.children[0])
+        if child is not None:
+            return pa.list_(child)
+    return None
+
+
+def _dtype_from_arrow(pa, t) -> DataType:
+    if pa.types.is_timestamp(t):
+        return DataType.TIMESTAMP_MS
+    if pa.types.is_int32(t):
+        return DataType.INT32
+    if pa.types.is_uint64(t):
+        # values above 2**63-1 would wrap negative in the int64 engine
+        # representation — refuse loudly rather than corrupt silently
+        raise SchemaError("uint64 arrow columns are not representable")
+    if pa.types.is_integer(t):
+        return DataType.INT64
+    if pa.types.is_float32(t):
+        return DataType.FLOAT32
+    if pa.types.is_floating(t):
+        return DataType.FLOAT64
+    if pa.types.is_boolean(t):
+        return DataType.BOOL
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return DataType.STRING
+    if pa.types.is_struct(t):
+        return DataType.STRUCT
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return DataType.LIST
+    raise SchemaError(f"unsupported arrow type {t!r}")
 
 
 def _coerce_column(vals: Sequence) -> np.ndarray:

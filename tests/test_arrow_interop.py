@@ -1,0 +1,224 @@
+"""pyarrow interop: the reference's Python surface hands user callbacks
+pyarrow RecordBatches (py-denormalized/src/datastream.rs:244-252) and its
+vendored layer is pyarrow-based throughout — these tests pin the
+conversion bridge a migrating user relies on."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+
+
+def _flat_batch():
+    schema = Schema(
+        [
+            Field("ts", DataType.TIMESTAMP_MS, nullable=False),
+            Field("name", DataType.STRING, nullable=False),
+            Field("reading", DataType.FLOAT64),
+            Field("n", DataType.INT64),
+            Field("ok", DataType.BOOL),
+        ]
+    )
+    return RecordBatch(
+        schema,
+        [
+            np.array([1000, 2000, 3000], dtype=np.int64),
+            np.array(["a", "béta", "c"], dtype=object),
+            np.array([0.5, 0.0, -2.5]),
+            np.array([7, 0, 9], dtype=np.int64),
+            np.array([True, False, True]),
+        ],
+        masks=[
+            None,
+            None,
+            np.array([True, False, True]),
+            np.array([True, False, True]),
+            None,
+        ],
+    )
+
+
+def test_to_pyarrow_types_and_nulls():
+    rb = _flat_batch().to_pyarrow()
+    assert rb.num_rows == 3
+    assert rb.schema.field("ts").type == pa.timestamp("ms")
+    assert rb.schema.field("name").type == pa.string()
+    assert rb.schema.field("reading").type == pa.float64()
+    assert rb.schema.field("n").type == pa.int64()
+    assert rb.schema.field("ok").type == pa.bool_()
+    assert rb.column("reading").null_count == 1
+    assert rb.column("reading").to_pylist() == [0.5, None, -2.5]
+    assert rb.column("n").to_pylist() == [7, None, 9]
+    assert rb.column("name").to_pylist() == ["a", "béta", "c"]
+
+
+def test_pyarrow_roundtrip():
+    b = _flat_batch()
+    back = RecordBatch.from_pyarrow(b.to_pyarrow())
+    assert [f.dtype for f in back.schema] == [f.dtype for f in b.schema]
+    for name in b.schema.names:
+        ma, mb = b.mask(name), back.mask(name)
+        assert (ma is None) == (mb is None), name
+        if ma is not None:
+            np.testing.assert_array_equal(ma, mb)
+        va, vb = b.column(name), back.column(name)
+        if va.dtype == object:
+            assert va.tolist() == vb.tolist()
+        else:
+            keep = np.ones(len(va), bool) if ma is None else ma
+            np.testing.assert_array_equal(va[keep], vb[keep])
+
+
+def test_from_pyarrow_external_batch():
+    """A batch built by pyarrow directly (a migrating user's data)."""
+    rb = pa.RecordBatch.from_pydict(
+        {
+            "k": pa.array(["x", None, "z"]),
+            "v": pa.array([1.5, 2.5, None]),
+            "t": pa.array([1, 2, 3], type=pa.timestamp("ms")),
+        }
+    )
+    b = RecordBatch.from_pyarrow(rb)
+    assert b.schema.field("k").dtype is DataType.STRING
+    assert b.schema.field("v").dtype is DataType.FLOAT64
+    assert b.schema.field("t").dtype is DataType.TIMESTAMP_MS
+    assert b.column("k").tolist() == ["x", None, "z"]
+    assert b.mask("v").tolist() == [True, True, False]
+    assert b.column("t").tolist() == [1, 2, 3]
+
+
+def test_nested_struct_list_to_pyarrow():
+    schema = Schema(
+        [
+            Field("id", DataType.INT64, nullable=False),
+            Field(
+                "gps",
+                DataType.STRUCT,
+                children=(
+                    Field("lat", DataType.FLOAT64),
+                    Field("lon", DataType.FLOAT64),
+                ),
+            ),
+            Field("tags", DataType.LIST),
+        ]
+    )
+    gps = np.empty(2, dtype=object)
+    gps[:] = [{"lat": 1.0, "lon": 2.0}, {"lat": 3.0, "lon": 4.0}]
+    tags = np.empty(2, dtype=object)
+    tags[:] = [["a", "b"], []]
+    b = RecordBatch(
+        schema, [np.array([1, 2], dtype=np.int64), gps, tags]
+    )
+    rb = b.to_pyarrow()
+    assert pa.types.is_struct(rb.schema.field("gps").type)
+    assert pa.types.is_list(rb.schema.field("tags").type)
+    assert rb.column("gps").to_pylist()[1] == {"lat": 3.0, "lon": 4.0}
+    back = RecordBatch.from_pyarrow(rb)
+    assert back.column("tags").tolist() == [["a", "b"], []]
+
+
+def test_sink_as_pyarrow_end_to_end():
+    """ds.sink(fn, as_pyarrow=True): the callback sees pyarrow batches
+    with internal columns stripped, through a real windowed pipeline."""
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import Context
+    from denormalized_tpu.api.functions import col
+    from denormalized_tpu.sources.memory import MemorySource
+
+    schema = Schema(
+        [
+            Field("occurred_at_ms", DataType.INT64, nullable=False),
+            Field("sensor_name", DataType.STRING, nullable=False),
+            Field("reading", DataType.FLOAT64),
+        ]
+    )
+    t0 = 1_700_000_000_000
+    rng = np.random.default_rng(3)
+    batches = []
+    for i in range(8):
+        ts = np.sort(t0 + i * 500 + rng.integers(0, 500, 256))
+        names = np.array(
+            [f"s{k}" for k in rng.integers(0, 4, 256)], dtype=object
+        )
+        batches.append(
+            RecordBatch(schema, [ts, names, rng.normal(10, 2, 256)])
+        )
+    got = []
+    ctx = Context()
+    (
+        ctx.from_source(
+            MemorySource.from_batches(
+                batches, timestamp_column="occurred_at_ms"
+            )
+        )
+        .window(
+            [col("sensor_name")],
+            [F.count(col("reading")).alias("count")],
+            1000,
+        )
+        .sink(got.append, as_pyarrow=True)
+    )
+    assert got, "no batches delivered"
+    for rb in got:
+        assert isinstance(rb, pa.RecordBatch)
+        names = rb.schema.names
+        assert "window_start_time" in names and "count" in names
+        assert not any(n.startswith("_") for n in names)
+
+
+def test_from_pyarrow_normalizes_us_ns_timestamps():
+    """us/ns timestamps (pandas default is ns) must land as millisecond
+    values, not raw unit counts mislabeled TIMESTAMP_MS."""
+    rb = pa.RecordBatch.from_pydict(
+        {
+            "us": pa.array([1_700_000_000_000_000], type=pa.timestamp("us")),
+            "ns": pa.array(
+                [1_700_000_000_000_000_000], type=pa.timestamp("ns")
+            ),
+        }
+    )
+    b = RecordBatch.from_pyarrow(rb)
+    assert b.column("us").tolist() == [1_700_000_000_000]
+    assert b.column("ns").tolist() == [1_700_000_000_000]
+
+
+def test_empty_struct_list_batches_keep_schema():
+    """A zero-row batch must produce the SAME arrow schema as a populated
+    one (a windowed stream interleaves empty emissions; consumers concat
+    by schema)."""
+    schema = Schema(
+        [
+            Field(
+                "gps",
+                DataType.STRUCT,
+                children=(
+                    Field("lat", DataType.FLOAT64),
+                    Field("lon", DataType.FLOAT64),
+                ),
+            ),
+            Field("tags", DataType.LIST, children=(Field("", DataType.STRING),)),
+        ]
+    )
+    empty = RecordBatch.empty(schema).to_pyarrow()
+    gps = np.empty(1, dtype=object)
+    gps[:] = [{"lat": 1.0, "lon": 2.0}]
+    tags = np.empty(1, dtype=object)
+    tags[:] = [["a"]]
+    full = RecordBatch(schema, [gps, tags]).to_pyarrow()
+    assert empty.schema.field("gps").type == full.schema.field("gps").type
+    assert empty.schema.field("tags").type == full.schema.field("tags").type
+    back = RecordBatch.from_pyarrow(empty)  # must not raise
+    assert back.num_rows == 0
+
+
+def test_from_pyarrow_rejects_uint64():
+    from denormalized_tpu.common.errors import SchemaError
+
+    rb = pa.RecordBatch.from_pydict(
+        {"u": pa.array([2**63 + 5], type=pa.uint64())}
+    )
+    with pytest.raises(SchemaError):
+        RecordBatch.from_pyarrow(rb)
