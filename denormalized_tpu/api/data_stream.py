@@ -257,16 +257,39 @@ class DataStream:
         print(self._physical_display(self.optimized_plan()))
         return self
 
-    def explain(self) -> "DataStream":
+    def explain(self, analyze: bool = False) -> "DataStream":
         """Print logical plan, optimized plan, and physical plan — the
-        datafusion ``explain`` analog."""
+        datafusion ``explain`` analog.  With ``analyze=True``, execute the
+        stream to completion against a discard sink and print the physical
+        plan annotated with each operator's runtime metrics (rows, batches,
+        compute time) — the EXPLAIN ANALYZE analog of the reference's
+        engine substrate (DataFusion; per-operator MetricsSet exposure at
+        streaming_window.rs:491).  Like ``collect``, analyze requires a
+        bounded source."""
         opt = self.optimized_plan()
         print("== logical plan ==")
         print(self._plan.display())
         print("== optimized plan ==")
         print(opt.display())
-        print("== physical plan ==")
-        print(self._physical_display(opt))
+        if not analyze:
+            print("== physical plan ==")
+            print(self._physical_display(opt))
+            return self
+        from denormalized_tpu.physical.simple_execs import CallbackSink
+
+        # introspection must not mutate durable recovery state: with
+        # checkpointing live, this run would commit epochs (and source
+        # offsets) under the SAME node-id keys the real pipeline uses —
+        # the next real run would restore at explain's cut
+        cfg = self._ctx.config
+        saved_checkpoint = getattr(cfg, "checkpoint", False)
+        cfg.checkpoint = False
+        try:
+            self._execute(CallbackSink(lambda _b: None))
+        finally:
+            cfg.checkpoint = saved_checkpoint
+        print("== physical plan (analyzed) ==")
+        print(self._ctx._last_physical.display(with_metrics=True))
         return self
 
     # -- execution -------------------------------------------------------

@@ -62,9 +62,19 @@ class ExecOperator:
     def metrics(self) -> dict[str, float]:
         return {}
 
-    def display(self, indent: int = 0) -> str:
+    def display(self, indent: int = 0, with_metrics: bool = False) -> str:
         line = "  " * indent + self._label()
-        return "\n".join([line] + [c.display(indent + 1) for c in self.children])
+        if with_metrics:
+            m = self.metrics()
+            if m:
+                parts = ", ".join(
+                    f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in m.items()
+                )
+                line += f"  [{parts}]"
+        return "\n".join(
+            [line] + [c.display(indent + 1, with_metrics) for c in self.children]
+        )
 
     def _label(self) -> str:
         return type(self).__name__

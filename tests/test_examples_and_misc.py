@@ -136,3 +136,69 @@ def test_collect_metrics(make_batch):
     assert window_key and metrics[window_key[0]]["rows_in"] == 2
     src_key = [k for k in metrics if "Source" in k]
     assert src_key and metrics[src_key[0]]["rows_out"] == 2
+
+
+def test_explain_analyze(make_batch, capsys):
+    """explain(analyze=True) executes against a discard sink and prints
+    the physical plan annotated with runtime metrics (the EXPLAIN ANALYZE
+    analog of the reference's engine substrate)."""
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.sources.memory import MemorySource
+
+    t0 = 1_700_000_000_000
+    ctx = Context()
+    ds = ctx.from_source(
+        MemorySource.from_batches(
+            [make_batch([t0, t0 + 700, t0 + 1500], ["a", "b", "a"],
+                        [1.0, 2.0, 3.0])],
+            timestamp_column="occurred_at_ms",
+        )
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+    out = ds.explain(analyze=True)
+    assert out is ds  # chainable
+    text = capsys.readouterr().out
+    assert "== physical plan (analyzed) ==" in text
+    analyzed = text.split("== physical plan (analyzed) ==", 1)[1]
+    assert "rows_in=3" in analyzed or "rows_out=3" in analyzed
+    assert "[" in analyzed  # at least one operator annotated
+
+
+def test_explain_analyze_does_not_commit_checkpoints(make_batch, tmp_path, capsys):
+    """explain(analyze=True) is introspection: with checkpointing
+    configured it must NOT commit epochs/offsets — a later real run of
+    the same pipeline would otherwise restore at explain's cut."""
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.sources.memory import MemorySource
+    from denormalized_tpu.state.lsm import close_global_state_backend
+
+    t0 = 1_700_000_000_000
+    cfg = EngineConfig(
+        checkpoint=True,
+        checkpoint_interval_s=9999,
+        state_backend_path=str(tmp_path / "state"),
+    )
+
+    def make_ds(ctx):
+        return ctx.from_source(
+            MemorySource.from_batches(
+                [make_batch([t0 + i, t0 + 1500 + i], ["a", "b"], [1.0, 2.0])
+                 for i in range(4)],
+                timestamp_column="occurred_at_ms",
+            )
+        ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+
+    ctx = Context(cfg)
+    make_ds(ctx).explain(analyze=True)
+    assert cfg.checkpoint is True  # restored
+    capsys.readouterr()
+    close_global_state_backend()
+
+    # a real run after explain must process the FULL stream (no restored
+    # offsets from explain's execution)
+    ctx2 = Context(cfg)
+    out = make_ds(ctx2).collect()
+    assert int(np.sum(out.column("c"))) == 8  # windows [t0,1000): all 8 rows
+    close_global_state_backend()
