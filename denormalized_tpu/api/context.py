@@ -61,7 +61,12 @@ class EngineConfig:
     # sharding (parallel/): number of devices to shard group-state over;
     # None = single device
     mesh_devices: int | None = None
-    # 'auto' | 'key_sharded' | 'partial_final' (see parallel/sharded_state.py)
+    # 2-D layout: split mesh_devices into this many row-parallel slices
+    # (keys sharded within each slice, cross-slice merge at emission only
+    # — the dp x tp analog; see parallel/sharded_state.TwoLevelWindowState)
+    mesh_slices: int | None = None
+    # 'auto' | 'key_sharded' | 'partial_final' | 'two_level'
+    # (see parallel/sharded_state.py)
     shard_strategy: str = "auto"
     # single-device kernel strategy:
     #   'scatter'       — ship rows, device scatters them into the window

@@ -1,13 +1,16 @@
-from denormalized_tpu.parallel.mesh import make_mesh
+from denormalized_tpu.parallel.mesh import make_mesh, make_mesh_2d
 from denormalized_tpu.parallel.sharded_state import (
     KeyShardedWindowState,
     PartialFinalWindowState,
+    TwoLevelWindowState,
     make_sharded_state,
 )
 
 __all__ = [
     "make_mesh",
+    "make_mesh_2d",
     "KeyShardedWindowState",
     "PartialFinalWindowState",
+    "TwoLevelWindowState",
     "make_sharded_state",
 ]

@@ -19,6 +19,38 @@ from jax.sharding import Mesh
 
 
 KEY_AXIS = "keys"
+# second mesh axis for the 2-D layout: data-parallel row slices (each
+# slice ingests its own source partitions; ICI-local key blocks within a
+# slice, cross-slice merge only at emission — the axis that rides DCN in
+# a multi-slice job)
+SLICE_AXIS = "slices"
+
+
+def make_mesh_2d(
+    n_slices: int, n_key_shards: int | None = None, devices=None
+) -> Mesh:
+    """2-D mesh ``(slices, keys)``: rows are data-parallel across the
+    slice axis, group-state is sharded across the key axis.  Lay the key
+    axis innermost so its per-batch traffic (state updates, emission
+    gathers) stays on the fastest links (ICI within a slice); the slice
+    axis carries traffic only at emission/export (psum of window rows) —
+    the cross-slice/DCN-tolerant direction."""
+    if devices is None:
+        devices = jax.devices()
+    if n_key_shards is None:
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {n_slices} slices"
+            )
+        n_key_shards = len(devices) // n_slices
+    need = n_slices * n_key_shards
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices ({n_slices}x{n_key_shards}), have "
+            f"{len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(n_slices, n_key_shards)
+    return Mesh(arr, (SLICE_AXIS, KEY_AXIS))
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:

@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from denormalized_tpu.ops import segment_agg as sa
-from denormalized_tpu.parallel.mesh import KEY_AXIS
+from denormalized_tpu.parallel.mesh import KEY_AXIS, SLICE_AXIS
 
 
 class WindowStateBackend:
@@ -785,12 +785,21 @@ def _partial_update(
     )(state, values, colvalid, win_rel, rem, gid, row_valid, base_mod)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _partial_merge_slot(spec: sa.WindowKernelSpec, mesh: Mesh, state, slot):
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _merge_slot_over(
+    spec: sa.WindowKernelSpec, mesh: Mesh, reduce_axis: str, state, slot
+):
     """Final merge of one window row across device partials: psum for
     count/sum, pmin/pmax for extrema — the reference's Final stage
-    (streaming_window.rs:484-489) as a single collective.  ``slot`` is
-    traced (dynamic slice), so one compilation serves every ring slot."""
+    (streaming_window.rs:484-489) as a single collective over
+    ``reduce_axis``.  Serves both partial layouts (the per-kind fold must
+    exist ONCE): partial_final reduces over the 1-D key axis; two_level
+    reduces over the slice axis of the 2-D mesh, and its key axis
+    assembles via the out-spec with no collective.  ``slot`` is traced
+    (dynamic slice), so one compilation serves every ring slot."""
+    two_d = reduce_axis == SLICE_AXIS
+    state_spec = P(SLICE_AXIS, None, KEY_AXIS) if two_d else P(KEY_AXIS)
+    out_spec = P(KEY_AXIS) if two_d else P()
 
     def body(state_l, slot):
         out = {}
@@ -799,19 +808,60 @@ def _partial_merge_slot(spec: sa.WindowKernelSpec, mesh: Mesh, state, slot):
                 state_l[c.label][0], slot, axis=0, keepdims=False
             )
             if c.kind in ("count", "sum", "sumc"):
-                out[c.label] = jax.lax.psum(row, KEY_AXIS)
+                out[c.label] = jax.lax.psum(row, reduce_axis)
             elif c.kind == "min":
-                out[c.label] = jax.lax.pmin(row, KEY_AXIS)
+                out[c.label] = jax.lax.pmin(row, reduce_axis)
             else:
-                out[c.label] = jax.lax.pmax(row, KEY_AXIS)
+                out[c.label] = jax.lax.pmax(row, reduce_axis)
         return out
 
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=({c.label: P(KEY_AXIS) for c in spec.components}, P()),
-        out_specs={c.label: P() for c in spec.components},
+        in_specs=({c.label: state_spec for c in spec.components}, P()),
+        out_specs={c.label: out_spec for c in spec.components},
     )(state, slot)
+
+
+def _fold_partials_host(
+    spec: sa.WindowKernelSpec, host: dict, axis: int = 0
+) -> dict:
+    """Host-side fold of per-device partial planes along ``axis`` (the
+    export path's counterpart of _merge_slot_over)."""
+    out = {}
+    for c in spec.components:
+        b = host[c.label]
+        if c.kind in ("count", "sum", "sumc"):
+            out[c.label] = b.sum(axis=axis)
+        elif c.kind == "min":
+            out[c.label] = b.min(axis=axis)
+        else:
+            out[c.label] = b.max(axis=axis)
+    return out
+
+
+def _import_merged_into_lead(
+    spec: sa.WindowKernelSpec,
+    host_state: dict,
+    n_lead: int,
+    W: int,
+    G_total: int,
+    sharding,
+) -> dict:
+    """Load a merged (W, G) snapshot into partial 0 of an (n, W, G)
+    layout, init elsewhere — restore-time equivalence: the per-kind merge
+    reproduces the snapshot exactly."""
+    out = {}
+    for c in spec.components:
+        init = np.asarray(jax.device_get(spec.init_value(c)))
+        buf = np.full((n_lead, W, G_total), init, dtype=init.dtype)
+        src = host_state.get(c.label)
+        if src is not None:
+            w = min(src.shape[0], W)
+            g = min(src.shape[1], G_total)
+            buf[0, :w, :g] = src[:w, :g]
+        out[c.label] = jax.device_put(jnp.asarray(buf), sharding)
+    return out
 
 
 @functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
@@ -881,8 +931,9 @@ class PartialFinalWindowState(WindowStateBackend):
 
     def read_slot(self, slot: int) -> dict[str, np.ndarray]:
         out = jax.device_get(
-            _partial_merge_slot(
-                self.spec, self.mesh, self._state, jnp.asarray(slot, jnp.int32)
+            _merge_slot_over(
+                self.spec, self.mesh, KEY_AXIS, self._state,
+                jnp.asarray(slot, jnp.int32),
             )
         )
         self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
@@ -895,35 +946,165 @@ class PartialFinalWindowState(WindowStateBackend):
 
     def export(self) -> dict[str, np.ndarray]:
         """Merged (W, G) snapshot."""
-        host = jax.device_get(self._state)
-        out = {}
-        for c in self.spec.components:
-            b = host[c.label]
-            if c.kind in ("count", "sum", "sumc"):
-                out[c.label] = b.sum(axis=0)
-            elif c.kind == "min":
-                out[c.label] = b.min(axis=0)
-            else:
-                out[c.label] = b.max(axis=0)
-        return out
+        return _fold_partials_host(self.spec, jax.device_get(self._state))
 
     def import_(self, host_state: dict[str, np.ndarray]) -> None:
         # load merged snapshot into device 0's partial, init elsewhere
-        for c in self.spec.components:
-            init = np.asarray(jax.device_get(self.spec.init_value(c)))
-            buf = np.full(
-                (self.n, self.spec.window_slots, self.spec.group_capacity),
-                init,
-                dtype=init.dtype,
+        self._state = _import_merged_into_lead(
+            self.spec, host_state, self.n, self.spec.window_slots,
+            self.spec.group_capacity, self._sharding,
+        )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
+def _two_level_update(
+    spec: sa.WindowKernelSpec,  # LOCAL spec (G_local per key shard)
+    mesh: Mesh,
+    state,
+    values,
+    colvalid,
+    win_rel,
+    rem,
+    gid,
+    row_valid,
+    base_mod,
+):
+    """2-D update: rows split across the slice axis (each slice applies
+    only its shard of the batch — in a multi-host job each host feeds its
+    own slice), group blocks split across the key axis (each device masks
+    to its gid block, exactly like the 1-D key-sharded layout).  NO
+    collective: the key exchange rides the within-slice input broadcast
+    and slices don't talk until emission."""
+    G_local = spec.group_capacity
+
+    def body(state_l, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
+        shard = jax.lax.axis_index(KEY_AXIS)
+        local_gid = gid - shard * G_local
+        mine = row_valid & (local_gid >= 0) & (local_gid < G_local)
+        local_gid = jnp.clip(local_gid, 0, G_local - 1)
+        st = {k: v[0] for k, v in state_l.items()}
+        st = sa.update_state_impl(
+            spec, st, values, colvalid, win_rel, rem, local_gid, mine, base_mod
+        )
+        return {k: v[None] for k, v in st.items()}
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            {c.label: P(SLICE_AXIS, None, KEY_AXIS) for c in spec.components},
+            P(SLICE_AXIS),
+            P(SLICE_AXIS),
+            P(SLICE_AXIS),
+            P(SLICE_AXIS),
+            P(SLICE_AXIS),
+            P(SLICE_AXIS),
+            P(),
+        ),
+        out_specs={
+            c.label: P(SLICE_AXIS, None, KEY_AXIS) for c in spec.components
+        },
+    )(state, values, colvalid, win_rel, rem, gid, row_valid, base_mod)
+
+
+class TwoLevelWindowState(WindowStateBackend):
+    """2-D ``(slices, keys)`` layout composing the two 1-D strategies:
+    rows data-parallel across slices (the Partial/Final axis — cross-
+    slice collectives fire only at emission, so this axis tolerates DCN
+    in a multi-slice job), state key-sharded within each slice (the
+    hash-partition axis — per-batch traffic stays on ICI).  The dp x tp
+    analog for streaming window state."""
+
+    strategy_name = "two_level"
+
+    def __init__(self, spec: sa.WindowKernelSpec, mesh: Mesh):
+        if SLICE_AXIS not in mesh.axis_names or KEY_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"two_level needs a ({SLICE_AXIS}, {KEY_AXIS}) mesh; got "
+                f"{mesh.axis_names}"
             )
-            src = host_state.get(c.label)
-            if src is not None:
-                w = min(src.shape[0], buf.shape[1])
-                g = min(src.shape[1], buf.shape[2])
-                buf[0, :w, :g] = src[:w, :g]
-            self._state[c.label] = jax.device_put(
-                jnp.asarray(buf), self._sharding
+        self.mesh = mesh
+        self.n_slices = mesh.shape[SLICE_AXIS]
+        self.n_keys = mesh.shape[KEY_AXIS]
+        if spec.group_capacity % self.n_keys:
+            raise ValueError(
+                f"group capacity {spec.group_capacity} not divisible by "
+                f"{self.n_keys} key shards"
             )
+        self.spec = sa.WindowKernelSpec(
+            components=spec.components,
+            num_value_cols=spec.num_value_cols,
+            window_slots=spec.window_slots,
+            group_capacity=spec.group_capacity // self.n_keys,
+            length_ms=spec.length_ms,
+            slide_ms=spec.slide_ms,
+            accum_dtype=spec.accum_dtype,
+            compensated=spec.compensated,
+        )
+        self._sharding = NamedSharding(mesh, P(SLICE_AXIS, None, KEY_AXIS))
+        self._state = {
+            c.label: jax.device_put(
+                jnp.full(
+                    (self.n_slices, spec.window_slots, spec.group_capacity),
+                    self.spec.init_value(c),
+                ),
+                self._sharding,
+            )
+            for c in spec.components
+        }
+
+    @property
+    def group_capacity(self) -> int:
+        return self.spec.group_capacity * self.n_keys
+
+    def update(
+        self, values, colvalid, win_rel, rem, gid, row_valid, base_mod,
+        min_win_rel=None, max_win_rel=None,
+    ):
+        # rows split S ways (bucketed pow2 batches >= mesh rows by
+        # construction, same invariant as PartialFinalWindowState)
+        self._state = _two_level_update(
+            self.spec,
+            self.mesh,
+            self._state,
+            jnp.asarray(values),
+            jnp.asarray(colvalid),
+            jnp.asarray(win_rel),
+            jnp.asarray(rem),
+            jnp.asarray(gid),
+            jnp.asarray(row_valid),
+            jnp.asarray(base_mod, dtype=jnp.int32),
+        )
+
+    def read_slot(self, slot: int) -> dict[str, np.ndarray]:
+        # cross-slice merge (the layout's only collective) + key-axis
+        # assembly via the out-spec — see _merge_slot_over
+        out = jax.device_get(
+            _merge_slot_over(
+                self.spec, self.mesh, SLICE_AXIS, self._state,
+                jnp.asarray(slot, jnp.int32),
+            )
+        )
+        self.bytes_d2h += sum(int(a.nbytes) for a in out.values())
+        return out
+
+    def reset_slot(self, slot: int) -> None:
+        # global-shape program; GSPMD partitions it over self._sharding
+        self._state = _partial_reset_slot(
+            self.spec, self._state, jnp.asarray(slot, dtype=jnp.int32)
+        )
+
+    def export(self) -> dict[str, np.ndarray]:
+        """Merged (W, G_total) snapshot (cross-slice fold on host)."""
+        return _fold_partials_host(self.spec, jax.device_get(self._state))
+
+    def import_(self, host_state: dict[str, np.ndarray]) -> None:
+        # merged snapshot into slice 0, init elsewhere (restore-time
+        # equivalence: sums re-merge identically across slices)
+        self._state = _import_merged_into_lead(
+            self.spec, host_state, self.n_slices, self.spec.window_slots,
+            self.group_capacity, self._sharding,
+        )
 
 
 def make_sharded_state(
@@ -958,6 +1139,25 @@ def make_sharded_state(
         ):
             return PartialMergeWindowState(spec)
         return SingleDeviceWindowState(spec, device_strategy)
+    if SLICE_AXIS in mesh.axis_names:
+        # 2-D (slices, keys) mesh: the two_level layout is the only one
+        # shaped for it
+        if strategy not in ("auto", "two_level"):
+            raise ValueError(
+                f"strategy {strategy!r} does not fit a 2-D "
+                f"({SLICE_AXIS}, {KEY_AXIS}) mesh — use 'two_level'/'auto'"
+            )
+        if device_strategy == "partial_merge":
+            raise ValueError(
+                "partial_merge composes with the 1-D key-sharded mesh "
+                "(host partials already ARE the slice axis); use "
+                "mesh_devices without mesh_slices"
+            )
+        return TwoLevelWindowState(spec, mesh)
+    if strategy == "two_level":
+        raise ValueError(
+            "two_level needs a 2-D mesh — set EngineConfig.mesh_slices"
+        )
     if device_strategy == "partial_merge":
         # host partials imply the Partial/Final split already happened on
         # the host, so the mesh's job is holding the (large) group space:

@@ -46,9 +46,29 @@ class Planner:
             if self.config is not None:
                 mesh = None
                 if self.config.mesh_devices:
-                    from denormalized_tpu.parallel.mesh import make_mesh
+                    from denormalized_tpu.parallel.mesh import (
+                        make_mesh,
+                        make_mesh_2d,
+                    )
 
-                    mesh = make_mesh(self.config.mesh_devices)
+                    if getattr(self.config, "mesh_slices", None):
+                        import jax as _jax
+
+                        n_dev = self.config.mesh_devices
+                        n_sl = self.config.mesh_slices
+                        if n_sl > n_dev or n_dev % n_sl:
+                            raise ValueError(
+                                f"mesh_devices={n_dev} must be a multiple "
+                                f"of mesh_slices={n_sl} (each slice gets "
+                                f"mesh_devices/mesh_slices key shards)"
+                            )
+                        mesh = make_mesh_2d(
+                            n_sl,
+                            n_dev // n_sl,
+                            devices=_jax.devices()[:n_dev],
+                        )
+                    else:
+                        mesh = make_mesh(self.config.mesh_devices)
                 kwargs.update(
                     accum_dtype=self.config.accum_dtype,
                     compensated_sums=self.config.compensated_sums,

@@ -80,11 +80,14 @@ def _pipeline(ctx, batches):
 
 
 def _collect_windows(result):
+    # values stay UNROUNDED: kill/restore comparisons are tolerance-based
+    # (f32 merge order differs between a restored and an uninterrupted
+    # run); rounding first would re-introduce boundary coin flips
     return {
         (int(result.column(WINDOW_START_COLUMN)[i]), result.column("sensor_name")[i]): (
             int(result.column("cnt")[i]),
-            round(float(result.column("s")[i]), 3),
-            round(float(result.column("mn")[i]), 4),
+            float(result.column("s")[i]),
+            float(result.column("mn")[i]),
         )
         for i in range(result.num_rows)
     }
@@ -152,7 +155,14 @@ def _assert_kill_restore(golden, emitted_a, emitted_b):
     combined.update(emitted_b)
     assert set(combined) == set(golden)
     for k in golden:
-        assert combined[k] == golden[k], (k, combined[k], golden[k])
+        got, want = combined[k], golden[k]
+        assert got[0] == want[0], (k, got, want)  # counts: exact
+        # f32 sums: a restored run merges the snapshot in a different
+        # order than the uninterrupted run accumulated, so rounded-equal
+        # is a coin flip at the rounding boundary — compare by tolerance
+        np.testing.assert_allclose(
+            got[1:], want[1:], rtol=1e-4, atol=1e-6, err_msg=str(k)
+        )
     # the restored run must NOT have reprocessed from scratch (unless the
     # barrier landed before anything emitted at all)
     assert len(emitted_b) < len(golden) or len(emitted_a) == 0
@@ -196,7 +206,9 @@ def test_channel_manager_semantics():
     assert cm.get_sender("t1") is None
 
 
-@pytest.mark.parametrize("strategy", ["key_sharded", "partial_final"])
+@pytest.mark.parametrize(
+    "strategy", ["key_sharded", "partial_final", "two_level"]
+)
 def test_kill_and_restore_sharded(tmp_path, make_batch, strategy):
     """Checkpoint/restore must also work when window state is sharded over
     the mesh (export → epoch snapshot → import into the sharded layout)."""
@@ -220,6 +232,7 @@ def test_kill_and_restore_sharded(tmp_path, make_batch, strategy):
             state_backend_path=path,
             mesh_devices=8,
             shard_strategy=strategy,
+            mesh_slices=2 if strategy == "two_level" else None,
         )
 
     golden, a, b = _kill_restore_roundtrip(
@@ -761,4 +774,8 @@ def test_repeated_kill_restore_cycles(tmp_path, make_batch, seed):
     assert not crashed, "stream never ran to completion within 5 cycles"
     assert set(combined) == set(golden)
     for k in golden:
-        assert combined[k] == golden[k], (k, combined[k], golden[k])
+        got, want = combined[k], golden[k]
+        assert got[0] == want[0], (k, got, want)
+        np.testing.assert_allclose(
+            got[1:], want[1:], rtol=1e-4, atol=1e-6, err_msg=str(k)
+        )

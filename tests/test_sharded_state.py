@@ -19,6 +19,17 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def _shard_cfg(strategy, **kw):
+    """EngineConfig for a named shard strategy; two_level runs on the 2-D
+    (2 slices x 4 key shards) mesh."""
+    return EngineConfig(
+        mesh_devices=8,
+        shard_strategy=strategy,
+        mesh_slices=2 if strategy == "two_level" else None,
+        **kw,
+    )
+
+
 def _default_aggs():
     return [
         F.count(col("reading")).alias("cnt"),
@@ -55,7 +66,9 @@ def _to_dict(res, fields=("cnt", "s", "mn", "mx")):
     }
 
 
-@pytest.mark.parametrize("strategy", ["key_sharded", "partial_final"])
+@pytest.mark.parametrize(
+    "strategy", ["key_sharded", "partial_final", "two_level"]
+)
 def test_sharded_matches_single_device(make_batch, strategy):
     rng = np.random.default_rng(11)
     t0 = 1_700_000_000_000
@@ -68,14 +81,16 @@ def test_sharded_matches_single_device(make_batch, strategy):
 
     single = _to_dict(_run(EngineConfig(), batches))
     sharded = _to_dict(
-        _run(EngineConfig(mesh_devices=8, shard_strategy=strategy), batches)
+        _run(_shard_cfg(strategy), batches)
     )
     assert set(single) == set(sharded)
     for k in single:
         np.testing.assert_allclose(sharded[k], single[k], rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("strategy", ["key_sharded", "partial_final"])
+@pytest.mark.parametrize(
+    "strategy", ["key_sharded", "partial_final", "two_level"]
+)
 def test_sharded_growth(make_batch, strategy):
     """Capacity growth must also work under sharding (export→regrid→import)."""
     rng = np.random.default_rng(12)
@@ -89,7 +104,7 @@ def test_sharded_growth(make_batch, strategy):
             [f"k{i}" for i in rng.integers(0, 5000, n)], dtype=object
         )
         batches.append(make_batch(ts, keys, rng.normal(0, 1, n)))
-    res = _run(EngineConfig(mesh_devices=8, shard_strategy=strategy), batches)
+    res = _run(_shard_cfg(strategy), batches)
     oracle = collections.defaultdict(float)
     ts_all, k_all, v_all = [], [], []
     for b in batches:
