@@ -234,8 +234,8 @@ class Context:
         topic: str,
         sample_json: str | None = None,
         bootstrap_servers: str = "localhost:9092",
-        group_id: str = "denormalized-tpu",
         timestamp_column: str | None = None,
+        group_id: str = "denormalized-tpu",
         encoding: str = "json",
         schema: Schema | None = None,
         avro_schema=None,
@@ -244,7 +244,15 @@ class Context:
         py-denormalized/src/context.rs:50-117): schema comes from an explicit
         Schema, is inferred from ``sample_json``, or — for
         ``encoding="avro"`` — derives from ``avro_schema`` (an Avro record
-        declaration as JSON string or dict)."""
+        declaration as JSON string or dict).
+
+        Parameter ORDER matches the reference wrapper exactly
+        (py-denormalized/python/denormalized/context.py:32-39:
+        topic, sample_json, bootstrap_servers, timestamp_column,
+        group_id) — a migrating user's positional call
+        ``from_topic("t", sample, server, "occurred_at_ms")`` must bind
+        the timestamp column, not the consumer group id; getting this
+        wrong silently demotes event-time to broker arrival time."""
         from denormalized_tpu.sources.kafka import KafkaTopicBuilder
 
         builder = (

@@ -527,6 +527,18 @@ class PartialMergeWindowState(_HostPartialMixin, SingleDeviceWindowState):
 # ---------------------------------------------------------------------------
 
 
+def _mask_to_key_shard(spec: sa.WindowKernelSpec, gid, row_valid):
+    """Inside a shard_map body: rebase global group ids onto THIS key
+    shard's block and mask out everyone else's rows — the one place the
+    key-sharded 'exchange rides the broadcast' trick is implemented (both
+    the 1-D and 2-D layouts use it)."""
+    G_local = spec.group_capacity
+    shard = jax.lax.axis_index(KEY_AXIS)
+    local_gid = gid - shard * G_local
+    mine = row_valid & (local_gid >= 0) & (local_gid < G_local)
+    return jnp.clip(local_gid, 0, G_local - 1), mine
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=2)
 def _key_sharded_update(
     spec: sa.WindowKernelSpec,
@@ -540,13 +552,8 @@ def _key_sharded_update(
     row_valid,
     base_mod,
 ):
-    G_local = spec.group_capacity
-
     def body(state_l, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
-        shard = jax.lax.axis_index(KEY_AXIS)
-        local_gid = gid - shard * G_local
-        mine = row_valid & (local_gid >= 0) & (local_gid < G_local)
-        local_gid = jnp.clip(local_gid, 0, G_local - 1)
+        local_gid, mine = _mask_to_key_shard(spec, gid, row_valid)
         return sa.update_state_impl(
             spec, state_l, values, colvalid, win_rel, rem, local_gid, mine, base_mod
         )
@@ -975,13 +982,9 @@ def _two_level_update(
     to its gid block, exactly like the 1-D key-sharded layout).  NO
     collective: the key exchange rides the within-slice input broadcast
     and slices don't talk until emission."""
-    G_local = spec.group_capacity
 
     def body(state_l, values, colvalid, win_rel, rem, gid, row_valid, base_mod):
-        shard = jax.lax.axis_index(KEY_AXIS)
-        local_gid = gid - shard * G_local
-        mine = row_valid & (local_gid >= 0) & (local_gid < G_local)
-        local_gid = jnp.clip(local_gid, 0, G_local - 1)
+        local_gid, mine = _mask_to_key_shard(spec, gid, row_valid)
         st = {k: v[0] for k, v in state_l.items()}
         st = sa.update_state_impl(
             spec, st, values, colvalid, win_rel, rem, local_gid, mine, base_mod

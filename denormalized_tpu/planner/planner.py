@@ -45,6 +45,14 @@ class Planner:
             kwargs = {}
             if self.config is not None:
                 mesh = None
+                if getattr(self.config, "mesh_slices", None) and not (
+                    self.config.mesh_devices
+                ):
+                    raise ValueError(
+                        "mesh_slices requires mesh_devices (the 2-D "
+                        "layout needs the total device count) — the job "
+                        "would otherwise silently run single-device"
+                    )
                 if self.config.mesh_devices:
                     from denormalized_tpu.parallel.mesh import (
                         make_mesh,
@@ -61,6 +69,16 @@ class Planner:
                                 f"mesh_devices={n_dev} must be a multiple "
                                 f"of mesh_slices={n_sl} (each slice gets "
                                 f"mesh_devices/mesh_slices key shards)"
+                            )
+                        if n_sl & (n_sl - 1):
+                            # batches bucket to powers of two and rows
+                            # shard P(slices): a non-pow2 slice count
+                            # would die on the first batch mid-stream
+                            # with a cryptic divisibility error
+                            raise ValueError(
+                                f"mesh_slices={n_sl} must be a power of "
+                                f"two (batches are pow2-bucketed and rows "
+                                f"split across slices)"
                             )
                         mesh = make_mesh_2d(
                             n_sl,

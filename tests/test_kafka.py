@@ -804,3 +804,58 @@ def test_mixed_codec_fetch_preserves_offset_order(broker):
                     b'{"i": 4}'], seen
     assert off == 5
     c.close()
+
+
+def test_from_topic_positional_order_matches_reference(broker):
+    """The reference wrapper's positional order is (topic, sample_json,
+    bootstrap_servers, timestamp_column, group_id)
+    (py-denormalized/python/denormalized/context.py:32-39).  A migrating
+    user's positional call must bind the timestamp column — binding
+    group_id there instead would silently demote event-time processing
+    to broker arrival time."""
+    broker.create_topic("postest", partitions=1)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        # progressive production: the watermark is the monotonic max of
+        # batch min-timestamps, so windows only close as newer fetches
+        # arrive — all-at-once production would pin it at t0 forever
+        for chunk in range(5):
+            msgs = [
+                json.dumps(
+                    {
+                        "occurred_at_ms": t0 + chunk * 500 + i,
+                        "sensor_name": "a",
+                        "reading": 1.0,
+                    }
+                ).encode()
+                for i in range(500)
+            ]
+            # no ts_ms: broker stamps wall clock, so if the regression
+            # under test reappears (timestamp column not bound), windows
+            # anchor at wall time and close — the assert fails cleanly
+            # instead of the stream hanging with a frozen watermark
+            broker.produce("postest", 0, msgs)
+            time.sleep(0.25)
+
+    threading.Thread(target=feed, daemon=True).start()
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context()
+    # POSITIONAL call in the reference's order
+    ds = ctx.from_topic(
+        "postest", sample, broker.bootstrap, "occurred_at_ms"
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+    starts = []
+    it = ds.stream()
+    deadline = time.time() + 20
+    for batch in it:
+        for i in range(batch.num_rows):
+            starts.append(int(batch.column("window_start_time")[i]))
+        if starts or time.time() > deadline:
+            it.close()
+            break
+    # event-time windows anchor at t0 — broker arrival time (wall clock)
+    # would put the first window decades later
+    assert t0 in starts, starts
