@@ -53,6 +53,11 @@ class DataStream:
 
     # -- transforms ------------------------------------------------------
     def select(self, *exprs: Expr | str) -> "DataStream":
+        # the reference wrapper takes a LIST (`select(expr_list)`,
+        # py-denormalized data_stream.py:52) — accept both spellings so a
+        # migrating user's call works unchanged
+        if len(exprs) == 1 and isinstance(exprs[0], (list, tuple)):
+            exprs = tuple(exprs[0])
         exprs = [col(e) if isinstance(e, str) else e for e in exprs]
         return self._wrap(lp.Project(self._plan, exprs))
 
@@ -84,6 +89,10 @@ class DataStream:
         return self.select(*exprs)
 
     def drop_columns(self, *names: str) -> "DataStream":
+        # reference spelling is a list (`drop_columns(columns)`,
+        # py-denormalized data_stream.py:95) — accept both
+        if len(names) == 1 and isinstance(names[0], (list, tuple)):
+            names = tuple(names[0])
         keep = [
             col(f.name)
             for f in self._plan.schema.without_internal()

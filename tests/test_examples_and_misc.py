@@ -202,3 +202,27 @@ def test_explain_analyze_does_not_commit_checkpoints(make_batch, tmp_path, capsy
     out = make_ds(ctx2).collect()
     assert int(np.sum(out.column("c"))) == 8  # windows [t0,1000): all 8 rows
     close_global_state_backend()
+
+
+def test_reference_list_style_calls(make_batch):
+    """The reference wrapper passes LISTS to select/drop_columns
+    (py-denormalized data_stream.py:52,95); both spellings must work so
+    migrating code runs unchanged."""
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.sources.memory import MemorySource
+
+    t0 = 1_700_000_000_000
+    ds = Context().from_source(
+        MemorySource.from_batches(
+            [make_batch([t0, t0 + 1], ["a", "b"], [1.0, 2.0])],
+            timestamp_column="occurred_at_ms",
+        )
+    )
+    # list style (reference) and varargs style (ours) are equivalent
+    lst = ds.select([col("sensor_name"), col("reading")])
+    var = ds.select(col("sensor_name"), col("reading"))
+    assert [f.name for f in lst.schema()] == [f.name for f in var.schema()]
+    lst = ds.drop_columns(["reading"])
+    var = ds.drop_columns("reading")
+    assert [f.name for f in lst.schema()] == [f.name for f in var.schema()]
+    assert "reading" not in [f.name for f in lst.schema()]
