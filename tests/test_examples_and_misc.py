@@ -226,3 +226,43 @@ def test_reference_list_style_calls(make_batch):
     var = ds.drop_columns("reading")
     assert [f.name for f in lst.schema()] == [f.name for f in var.schema()]
     assert "reading" not in [f.name for f in lst.schema()]
+
+
+def test_datafusion_import_shim(make_batch):
+    """Reference imports work with only the package renamed:
+    `from denormalized.datafusion import ...` ->
+    `from denormalized_tpu.datafusion import ...`
+    (reference datafusion/__init__.py:29-56 surface; examples use
+    Accumulator/col/lit/udf/udaf/functions)."""
+    from denormalized_tpu.datafusion import (  # noqa: F401
+        Accumulator,
+        Expr,
+        col,
+        functions as f,
+        lit,
+        udaf,
+        udf,
+    )
+    from denormalized_tpu import Context
+    from denormalized_tpu.sources.memory import MemorySource
+
+    t0 = 1_700_000_000_000
+    out = (
+        Context()
+        .from_source(
+            MemorySource.from_batches(
+                [make_batch([t0, t0 + 1, t0 + 1500], ["a", "b", "a"],
+                            [1.0, 120.0, 3.0])],
+                timestamp_column="occurred_at_ms",
+            )
+        )
+        .window(
+            [col("sensor_name")],
+            [f.count(col("reading")).alias("count"),
+             f.max(col("reading")).alias("max")],
+            1000,
+        )
+        .filter(col("max") > lit(100))
+        .collect()
+    )
+    assert out.num_rows == 1 and str(out.column("sensor_name")[0]) == "b"
