@@ -58,6 +58,13 @@ class EngineConfig:
     min_window_slots: int = 16
     emit_on_close: bool = True
 
+    # idle sources: when EVERY partition of a live source has produced no
+    # rows for this long, emit a WatermarkHint advancing event time to the
+    # max timestamp seen, so windows over a quiet topic still close.
+    # None (default) = reference behavior: the last windows of a quiet
+    # stream wait for more data forever.
+    source_idle_timeout_ms: int | None = None
+
     # sharding (parallel/): number of devices to shard group-state over;
     # None = single device
     mesh_devices: int | None = None

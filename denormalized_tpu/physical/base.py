@@ -27,6 +27,19 @@ from denormalized_tpu.common.schema import Schema
 
 
 @dataclass(frozen=True)
+class WatermarkHint:
+    """Advisory event-time advance from an idle source: no further rows at
+    or before ``ts_ms`` are expected, so stateful operators may close
+    windows/sessions up to it.  Emitted by SourceExec when every partition
+    has been idle for ``EngineConfig.source_idle_timeout_ms`` (the
+    reference — like Kafka consumers generally — simply never closes the
+    last windows of a quiet topic; this is the Flink-style idleness
+    escape hatch, default off).  Stateless operators pass it through."""
+
+    ts_ms: int
+
+
+@dataclass(frozen=True)
 class Marker:
     """Checkpoint barrier (reference OrchestrationMessage::CheckpointBarrier,
     orchestrator.rs:12-16)."""
@@ -41,7 +54,7 @@ class EndOfStream:
 
 EOS = EndOfStream()
 
-StreamItem = Union[RecordBatch, Marker, EndOfStream]
+StreamItem = Union[RecordBatch, Marker, WatermarkHint, EndOfStream]
 
 
 class ExecOperator:

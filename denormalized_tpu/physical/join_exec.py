@@ -47,6 +47,7 @@ from denormalized_tpu.physical.base import (
     ExecOperator,
     Marker,
     StreamItem,
+    WatermarkHint,
 )
 
 
@@ -382,6 +383,27 @@ class StreamingJoinExec(ExecOperator):
         )
         return unmatched
 
+    def _evict_horizon(self, sides) -> "Iterator[RecordBatch]":
+        """Evict both sides against the joint watermark horizon (emitting
+        null-padded unmatched rows for outer joins) — shared by the
+        per-batch path and idle-source WatermarkHint handling."""
+        if sides[0].watermark is None or sides[1].watermark is None:
+            return
+        horizon = (
+            min(sides[0].watermark, sides[1].watermark) - self.retention_ms
+        )
+        for s, l in ((sides[0], True), (sides[1], False)):
+            for ub in self._evict(s, l, horizon):
+                padded = self._null_padded(ub, l)
+                self._metrics["rows_out"] += padded.num_rows
+                yield padded
+        # interner growth is keyed by DISTINCT keys ever seen; once it
+        # dwarfs the retained rows (UUID-style keys), re-key from scratch
+        # so memory stays bounded by retention, not stream lifetime
+        retained = sides[0].count + sides[1].count
+        if len(self._interner) > max(self._reintern_min, 4 * retained):
+            self._reintern(sides)
+
     def _reintern(self, sides) -> None:
         """Re-key the join when the interner has accumulated far more
         distinct keys than rows remain retained (high-cardinality streams:
@@ -600,6 +622,25 @@ class StreamingJoinExec(ExecOperator):
                 is_left = side_id == 0
                 if isinstance(item, BaseException):
                     raise item
+                if isinstance(item, WatermarkHint):
+                    # idle source on this side: advance its watermark so
+                    # the joint horizon (min of both) can move and retained
+                    # rows evict.  Downstream must see the JOINT low
+                    # watermark — forwarding this side's ts verbatim would
+                    # advance downstream event time past the still-active
+                    # other side, and its later joined rows (carrying the
+                    # other side's timestamps) would drop as late.
+                    if side.watermark is None or item.ts_ms > side.watermark:
+                        side.watermark = item.ts_ms
+                    yield from self._evict_horizon(sides)
+                    if (
+                        sides[0].watermark is not None
+                        and sides[1].watermark is not None
+                    ):
+                        yield WatermarkHint(
+                            min(sides[0].watermark, sides[1].watermark)
+                        )
+                    continue
                 if isinstance(item, EndOfStream):
                     if side.done:
                         continue
@@ -670,23 +711,7 @@ class StreamingJoinExec(ExecOperator):
                 bmin = int(ts.min())
                 if side.watermark is None or bmin > side.watermark:
                     side.watermark = bmin
-                if sides[0].watermark is not None and sides[1].watermark is not None:
-                    horizon = (
-                        min(sides[0].watermark, sides[1].watermark)
-                        - self.retention_ms
-                    )
-                    for s, l in ((sides[0], True), (sides[1], False)):
-                        for ub in self._evict(s, l, horizon):
-                            padded = self._null_padded(ub, l)
-                            self._metrics["rows_out"] += padded.num_rows
-                            yield padded
-                    # interner growth is keyed by DISTINCT keys ever seen;
-                    # once it dwarfs the retained rows (UUID-style keys),
-                    # re-key from scratch so memory stays bounded by
-                    # retention, not stream lifetime
-                    retained = sides[0].count + sides[1].count
-                    if len(self._interner) > max(self._reintern_min, 4 * retained):
-                        self._reintern(sides)
+                yield from self._evict_horizon(sides)
             # EOS: flush unmatched for outer joins
             for s, l in ((sides[0], True), (sides[1], False)):
                 if self._emits_unmatched(l):

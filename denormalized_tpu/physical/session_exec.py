@@ -370,8 +370,14 @@ class SessionWindowExec(ExecOperator):
             self._merge_rows(key, ts_s[b0:b1], partial, partial_accs)
 
         # watermark advance + close expired sessions
-        if self._watermark is None or raw_min > self._watermark:
-            self._watermark = raw_min
+        yield from self._advance_and_close(raw_min)
+
+    def _advance_and_close(self, candidate_wm: int) -> Iterator[RecordBatch]:
+        """Monotonic watermark advance, then emit every session whose gap
+        has expired — shared by the per-batch path and idle-source
+        WatermarkHint handling."""
+        if self._watermark is None or candidate_wm > self._watermark:
+            self._watermark = candidate_wm
         closed: list[tuple[tuple, _Session]] = []
         for k in list(self._sessions):
             still: list[_Session] = []
@@ -519,9 +525,25 @@ class SessionWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.physical.base import WatermarkHint
+
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
+            elif isinstance(item, WatermarkHint):
+                yield from self._advance_and_close(item.ts_ms)
+                # emissions stamp canonical ts with the session START:
+                # forward clamped below every still-open session's start
+                # (a future row > ts can only extend open sessions or
+                # begin past ts, so with none open the hint passes as-is)
+                open_starts = [
+                    s.start
+                    for lst in self._sessions.values()
+                    for s in lst
+                ]
+                yield WatermarkHint(
+                    min([item.ts_ms] + [st - 1 for st in open_starts])
+                )
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)

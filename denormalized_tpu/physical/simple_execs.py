@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 import queue as queue_mod
 from typing import Callable, Iterator
 
@@ -25,8 +26,50 @@ from denormalized_tpu.physical.base import (
     ExecOperator,
     Marker,
     StreamItem,
+    WatermarkHint,
 )
 from denormalized_tpu.sources.base import Source
+
+
+class _IdleTracker:
+    """Idle-source detection shared by both SourceExec drive loops: rows
+    re-arm it; after ``timeout_ms`` without rows it yields ONE
+    WatermarkHint at the max canonical timestamp seen."""
+
+    def __init__(self, timeout_ms: int) -> None:
+        self.timeout_ms = timeout_ms
+        self._last_rows_wall = time.monotonic()
+        self._max_ts: int | None = None
+        self._sent = False
+
+    def observe_rows(self, batch: RecordBatch) -> None:
+        from denormalized_tpu.common.constants import (
+            CANONICAL_TIMESTAMP_COLUMN,
+        )
+
+        self._last_rows_wall = time.monotonic()
+        self._sent = False
+        bmax = int(
+            np.max(
+                np.asarray(
+                    batch.column(CANONICAL_TIMESTAMP_COLUMN),
+                    dtype=np.int64,
+                )
+            )
+        )
+        if self._max_ts is None or bmax > self._max_ts:
+            self._max_ts = bmax
+
+    def maybe_hint(self) -> WatermarkHint | None:
+        if (
+            self._sent
+            or self._max_ts is None
+            or (time.monotonic() - self._last_rows_wall) * 1000
+            < self.timeout_ms
+        ):
+            return None
+        self._sent = True
+        return WatermarkHint(self._max_ts)
 
 
 class SourceExec(ExecOperator):
@@ -41,10 +84,17 @@ class SourceExec(ExecOperator):
     in-band between batches when an orchestrator is attached.
     """
 
-    def __init__(self, source: Source, *, queue_size: int = 64) -> None:
+    def __init__(
+        self,
+        source: Source,
+        *,
+        queue_size: int = 64,
+        idle_timeout_ms: int | None = None,
+    ) -> None:
         self.source = source
         self.schema = source.schema
         self._queue_size = queue_size
+        self._idle_timeout_ms = idle_timeout_ms
         self._barrier_poll: Callable[[], int | None] | None = None
         self._metrics = {"rows_out": 0, "batches_out": 0}
         self._readers: list | None = None
@@ -125,7 +175,15 @@ class SourceExec(ExecOperator):
         self._restore_offsets(readers)
         self._yielded_offsets = [r.offset_snapshot() for r in readers]
         if not self.source.unbounded or len(readers) == 1:
-            # deterministic round-robin over bounded partitions
+            # deterministic round-robin over bounded partitions (also the
+            # single-reader unbounded path, which needs idle hints like
+            # the threaded path below — bounded sources get the EOS flush
+            # instead)
+            idle = (
+                _IdleTracker(self._idle_timeout_ms)
+                if self.source.unbounded and self._idle_timeout_ms is not None
+                else None
+            )
             live = list(enumerate(readers))
             while live:
                 nxt = []
@@ -137,8 +195,12 @@ class SourceExec(ExecOperator):
                     if b.num_rows:
                         self._metrics["rows_out"] += b.num_rows
                         self._metrics["batches_out"] += 1
+                        if idle is not None:
+                            idle.observe_rows(b)
                         yield b
                         self._yielded_offsets[i] = r.offset_snapshot()
+                    elif idle is not None and (h := idle.maybe_hint()):
+                        yield h
                     yield from self._maybe_barrier()
                 live = nxt
             yield EOS
@@ -165,6 +227,15 @@ class SourceExec(ExecOperator):
         for i, r in enumerate(readers):
             spawn_pump(q, done, reader_items(i, r), sentinel=None)
         finished = 0
+        # idle-source watermark hints: live readers deliver EMPTY batches
+        # on read timeouts even when the topic is quiet, so idleness is
+        # measured from the last ROWFUL batch (wall clock), not from queue
+        # starvation.  One hint per idle period; rows re-arm it.
+        idle = (
+            _IdleTracker(self._idle_timeout_ms)
+            if self._idle_timeout_ms is not None
+            else None
+        )
         try:
             while finished < len(readers):
                 item = q.get()
@@ -176,6 +247,11 @@ class SourceExec(ExecOperator):
                 idx, snap, batch = item
                 self._metrics["rows_out"] += batch.num_rows
                 self._metrics["batches_out"] += 1
+                if idle is not None:
+                    if batch.num_rows:
+                        idle.observe_rows(batch)
+                    elif h := idle.maybe_hint():
+                        yield h
                 yield batch
                 self._yielded_offsets[idx] = snap
                 yield from self._maybe_barrier()

@@ -429,9 +429,27 @@ class UdafWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.physical.base import WatermarkHint
+
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
+            elif isinstance(item, WatermarkHint):
+                if self._watermark is None or item.ts_ms > self._watermark:
+                    self._watermark = item.ts_ms
+                    yield from self._trigger()
+                # emissions stamp canonical ts with the window START:
+                # forward clamped below the lowest still-emittable start
+                # (open frames, or the earliest window a future row could
+                # land in) so downstream never late-drops our output
+                if self._first_open is not None:
+                    low = self._first_open * self.slide_ms - 1
+                else:
+                    low = (
+                        (item.ts_ms + 1 - self.length_ms) // self.slide_ms
+                        + 1
+                    ) * self.slide_ms - 1
+                yield WatermarkHint(min(item.ts_ms, low))
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)

@@ -51,6 +51,7 @@ from denormalized_tpu.physical.base import (
     ExecOperator,
     Marker,
     StreamItem,
+    WatermarkHint,
 )
 
 
@@ -592,6 +593,19 @@ class StreamingWindowExec(ExecOperator):
 
         self._acc_future = self._acc_exec.submit(run)
 
+    def _output_low_watermark(self, hint_ts: int) -> int:
+        """Strict lower bound (minus one) on the start of any window this
+        operator can still emit, given no further input rows at or before
+        ``hint_ts``.  With open windows that is the first open slot's
+        start; with none, the earliest window a future row (> hint_ts)
+        could land in."""
+        if self._first_open is not None:
+            return self._first_open * self.slide_ms - 1
+        min_future_start = (
+            (hint_ts + 1 - self.length_ms) // self.slide_ms + 1
+        ) * self.slide_ms
+        return min_future_start - 1
+
     # -- emission --------------------------------------------------------
     def _closable(self) -> int:
         if self._watermark_ms is None or self._first_open is None:
@@ -933,6 +947,21 @@ class StreamingWindowExec(ExecOperator):
                     "window.process_batch", op=self.name, rows=item.num_rows
                 ):
                     yield from self._process_batch(item)
+            elif isinstance(item, WatermarkHint):
+                # idle source: advance event time and close what's ready,
+                # then forward the hint for downstream stateful operators —
+                # CLAMPED below this operator's lowest possible future
+                # emission timestamp (emissions are stamped with the
+                # window START, so an unclamped forward would make a
+                # downstream operator drop our later closed windows as
+                # late)
+                yield from self._release_snapshot()
+                if self._watermark_ms is None or item.ts_ms > self._watermark_ms:
+                    self._watermark_ms = item.ts_ms
+                    yield from self._trigger()
+                yield WatermarkHint(
+                    min(item.ts_ms, self._output_low_watermark(item.ts_ms))
+                )
             elif isinstance(item, Marker):
                 yield from self._drain_pending()
                 yield from self._release_snapshot()  # an earlier epoch

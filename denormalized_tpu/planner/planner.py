@@ -33,7 +33,14 @@ class Planner:
 
     def create_physical_plan(self, node: lp.LogicalPlan) -> ExecOperator:
         if isinstance(node, lp.Scan):
-            return SourceExec(node.source)
+            return SourceExec(
+                node.source,
+                idle_timeout_ms=getattr(
+                    self.config, "source_idle_timeout_ms", None
+                )
+                if self.config is not None
+                else None,
+            )
         if isinstance(node, lp.Project):
             child = self.create_physical_plan(node.input)
             return ProjectExec(child, node.exprs, node.schema)

@@ -1,0 +1,323 @@
+"""Idle-source watermark hints: windows/sessions over a quiet topic close
+after ``source_idle_timeout_ms`` instead of waiting for more data forever
+(the reference never closes them — this is the Flink-style idleness
+escape hatch, default OFF)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+
+@pytest.fixture
+def broker():
+    b = MockKafkaBroker().start()
+    yield b
+    b.stop()
+
+
+def _produce_then_quiet(broker, topic, parts, t0, rows_per_part=600):
+    """Rows spanning ~2.4s of event time, produced progressively, then
+    silence."""
+
+    def feed():
+        for chunk in range(4):
+            for p in range(parts):
+                msgs = [
+                    json.dumps(
+                        {
+                            "occurred_at_ms": t0
+                            + chunk * 600
+                            + i * (600 // (rows_per_part // 4)),
+                            "sensor_name": f"s{i % 3}",
+                            "reading": 1.0,
+                        }
+                    ).encode()
+                    for i in range(rows_per_part // 4)
+                ]
+                broker.produce(topic, p, msgs)
+            time.sleep(0.15)
+
+    th = threading.Thread(target=feed, daemon=True)
+    th.start()
+    return th
+
+
+@pytest.mark.parametrize("parts", [1, 2])
+def test_idle_timeout_closes_final_windows(broker, parts):
+    """Without the timeout the windows covering the tail of a quiet topic
+    never emit; with it they close at the max timestamp seen.  parts=1
+    exercises the round-robin source path, parts=2 the threaded one."""
+    topic = f"quiet{parts}"
+    broker.create_topic(topic, partitions=parts)
+    t0 = 1_700_000_000_000
+    _produce_then_quiet(broker, topic, parts, t0)
+
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+    ds = ctx.from_topic(
+        topic, sample, broker.bootstrap, "occurred_at_ms"
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+
+    got = {}
+    it = ds.stream()
+    deadline = time.time() + 25
+    for batch in it:
+        for i in range(batch.num_rows):
+            got[
+                (
+                    int(batch.column("window_start_time")[i]),
+                    str(batch.column("sensor_name")[i]),
+                )
+            ] = int(batch.column("c")[i])
+        # the LAST fully-covered window starts at t0+1000 (event time tops
+        # out just under t0+2400, so [1000,2000) is complete; [2000,3000)
+        # is partial and must stay open)
+        if any(ws == t0 + 1000 for ws, _ in got) or time.time() > deadline:
+            it.close()
+            break
+    starts = {ws for ws, _ in got}
+    assert t0 in starts, starts
+    assert t0 + 1000 in starts, (
+        "idle hint did not close the final complete window"
+    )
+    assert t0 + 2000 not in starts, (
+        "window beyond the max seen timestamp must NOT close"
+    )
+
+
+def test_idle_timeout_closes_sessions(broker):
+    """Session windows: the gap can only expire via new data — or via the
+    idle hint."""
+    topic = "quiet_sess"
+    broker.create_topic(topic, partitions=2)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        for chunk in range(3):
+            for p in range(2):
+                msgs = [
+                    json.dumps(
+                        {
+                            "occurred_at_ms": t0 + chunk * 300 + i * 2,
+                            "sensor_name": "a",
+                            "reading": 1.0,
+                        }
+                    ).encode()
+                    for i in range(100)
+                ]
+                broker.produce(topic, p, msgs)
+            time.sleep(0.15)
+
+    threading.Thread(target=feed, daemon=True).start()
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+    ds = ctx.from_topic(
+        topic, sample, broker.bootstrap, "occurred_at_ms"
+    ).session_window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 5_000
+    )
+
+    # all 600 rows form ONE session (gaps are tiny); the hint advances
+    # the watermark only to the max SEEN timestamp, which is inside the
+    # session's gap horizon — so nothing may close.  Pull items at the
+    # operator level: the hint reaching the sink is the deterministic
+    # "idleness fired" sync point, making the no-emission assert bounded.
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+
+    root = executor.build_physical(
+        lp.Sink(ds._plan, CollectSink()), ds._ctx
+    )
+    gen = root.run()
+    saw_hint = False
+    emitted = 0
+    for item in gen:
+        if isinstance(item, RecordBatch):
+            emitted += item.num_rows
+        if isinstance(item, WatermarkHint):
+            saw_hint = True
+            break
+    gen.close()
+    assert saw_hint, "idle hint never reached the sink"
+    assert emitted == 0, (
+        "session closed although its gap extends beyond the max seen "
+        "timestamp"
+    )
+
+
+def test_idle_timeout_session_gap_expired(broker):
+    """A session whose gap HAS expired relative to the max seen timestamp
+    closes on the idle hint."""
+    topic = "quiet_sess2"
+    broker.create_topic(topic, partitions=2)
+    t0 = 1_700_000_000_000
+
+    def feed():
+        # burst 1 at t0, burst 2 at t0+10_000 (gap 5s long expired)
+        for burst_t in (t0, t0 + 10_000):
+            for p in range(2):
+                msgs = [
+                    json.dumps(
+                        {
+                            "occurred_at_ms": burst_t + i,
+                            "sensor_name": "a",
+                            "reading": 1.0,
+                        }
+                    ).encode()
+                    for i in range(50)
+                ]
+                broker.produce(topic, p, msgs)
+            time.sleep(0.15)
+
+    threading.Thread(target=feed, daemon=True).start()
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+    ds = ctx.from_topic(
+        topic, sample, broker.bootstrap, "occurred_at_ms"
+    ).session_window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 5_000
+    )
+    counts = []
+    it = ds.stream()
+    deadline = time.time() + 25
+    for batch in it:
+        counts += [int(v) for v in batch.column("c")]
+        if counts or time.time() > deadline:
+            it.close()
+            break
+    # the FIRST burst's session (100 rows across 2 partitions) closes via
+    # the hint: max_ts ~= t0+10_049 > t0+49+5000
+    assert counts and counts[0] == 100, counts
+
+
+def test_idle_timeout_evicts_join_state(broker):
+    """A left-outer join's unmatched rows can only evict (and emit
+    null-padded) once BOTH sides' watermarks pass them; a quiet side's
+    watermark advances via the idle hint."""
+    t0 = 1_700_000_000_000
+    broker.create_topic("jl", partitions=2)
+    broker.create_topic("jr", partitions=2)
+
+    def feed(topic, key):
+        def run():
+            for chunk in range(3):
+                for p in range(2):
+                    msgs = [
+                        json.dumps(
+                            {
+                                "occurred_at_ms": t0 + chunk * 400 + i * 4,
+                                "sensor_name": f"{key}{i % 4}",
+                                "reading": 1.0,
+                            }
+                        ).encode()
+                        for i in range(100)
+                    ]
+                    broker.produce(topic, p, msgs)
+                time.sleep(0.12)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        return th
+
+    feed("jl", "L")  # keys L0..L3 never match R0..R3: all left rows unmatched
+    feed("jr", "R")
+
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(
+        EngineConfig(source_idle_timeout_ms=400, join_retention_ms=500)
+    )
+    left = ctx.from_topic("jl", sample, broker.bootstrap, "occurred_at_ms")
+    right = (
+        ctx.from_topic("jr", sample, broker.bootstrap, "occurred_at_ms")
+        .with_column_renamed("occurred_at_ms", "r_at_ms")
+        .with_column_renamed("sensor_name", "rname")
+        .with_column_renamed("reading", "rreading")
+    )
+    ds = left.join(right, "left", ["sensor_name"], ["rname"])
+
+    unmatched = 0
+    it = ds.stream()
+    deadline = time.time() + 25
+    for batch in it:
+        m = batch.mask("rname")
+        if m is not None:
+            unmatched += int((~m).sum())
+        elif batch.num_rows and batch.column("rname")[0] is None:
+            unmatched += batch.num_rows
+        if unmatched > 0 or time.time() > deadline:
+            # only the rows older than the hint-driven horizon evict
+            # (~200 of 600); one emitted eviction proves the path
+            it.close()
+            break
+    # both sides go quiet after ~1.2s; hints advance both watermarks to
+    # their max seen (~t0+1196), horizon = that - 500 > t0+696... at least
+    # the early unmatched left rows MUST have evicted and emitted
+    assert unmatched > 0, "no unmatched rows evicted via idle hints"
+
+
+def test_forwarded_hint_clamped_below_open_windows(broker):
+    """Operators forward hints clamped below their lowest possible future
+    emission (emissions stamp canonical ts = window start) — a downstream
+    stateful operator must NOT late-drop a later-closing window."""
+    topic = "quiet_clamp"
+    broker.create_topic(topic, partitions=2)
+    t0 = 1_700_000_000_000
+    _produce_then_quiet(broker, topic, 2, t0)
+
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+    ds = ctx.from_topic(
+        topic, sample, broker.bootstrap, "occurred_at_ms"
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+    root = executor.build_physical(
+        lp.Sink(ds._plan, CollectSink()), ds._ctx
+    )
+    gen = root.run()
+    hint_ts = None
+    max_emitted_start = None
+    deadline = time.time() + 20
+    for item in gen:
+        if isinstance(item, RecordBatch) and item.num_rows:
+            s = int(np.max(item.column("window_start_time")))
+            if max_emitted_start is None or s > max_emitted_start:
+                max_emitted_start = s
+        if isinstance(item, WatermarkHint):
+            hint_ts = item.ts_ms
+            break
+        if time.time() > deadline:
+            break
+    gen.close()
+    assert hint_ts is not None, "no forwarded hint observed"
+    # event time tops out just under t0+2400: window [2000,3000) stays
+    # OPEN, so the forwarded hint must be clamped below its start
+    assert hint_ts < t0 + 2000, (hint_ts - t0, "hint not clamped")
+    # and everything emitted so far is at or below the forwarded hint
+    if max_emitted_start is not None:
+        assert max_emitted_start <= hint_ts
