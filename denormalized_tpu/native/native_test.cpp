@@ -157,6 +157,71 @@ static void test_json() {
   printf("json ok\n");
 }
 
+static void test_json_fast_layout() {
+  // the adaptive-layout fast path: identical-shape rows adopt a layout
+  // after the first general-path parse; deviating rows roll back and
+  // reparse.  Heap-exact buffers put ASan redzones right at every row
+  // boundary, so any fast-path overread (memcmp/memchr/num scan) traps.
+  const char* names[3] = {"a", "s", "f"};
+  int types[3] = {0, 3, 1};
+  void* p = jp_create(3, names, types);
+  std::string rows;
+  std::vector<uint64_t> offs{0};
+  auto add = [&](const std::string& r) {
+    rows += r;
+    offs.push_back(rows.size());
+  };
+  // 32 identical-shape rows (fast path from row 1 on)
+  for (int i = 0; i < 32; i++)
+    add("{\"a\":" + std::to_string(i) + ",\"s\":\"k" + std::to_string(i) +
+        "\",\"f\":" + std::to_string(i) + ".5}");
+  // deviations mid-stream: reorder, escape in string, null value,
+  // missing key, unknown key, json.dumps spacing — each must fall back
+  // (rollback) and reparse correctly, then re-adopt
+  add("{\"s\":\"re\",\"a\":900,\"f\":1.0}");
+  add("{\"a\":901,\"s\":\"q\\\"x\\\\y\",\"f\":2.0}");
+  add("{\"a\":null,\"s\":\"n\",\"f\":3.0}");
+  add("{\"a\":903,\"f\":4.0}");
+  add("{\"a\":904,\"s\":\"u\",\"zz\":[1,{\"q\":2}],\"f\":5.0}");
+  add("{\"a\": 905, \"s\": \"sp\", \"f\": 6.0}");
+  // back to the fast shape
+  for (int i = 0; i < 8; i++)
+    add("{\"a\":" + std::to_string(1000 + i) + ",\"s\":\"t\",\"f\":0.25}");
+  {
+    std::vector<uint8_t> exact(rows.begin(), rows.end());
+    int rc = jp_parse(p, exact.data(), offs.data(), offs.size() - 1);
+    assert(rc == 0);
+    assert(jp_nrows(p) == 32 + 6 + 8);
+    const int64_t* av = jp_col_i64(p, 0);
+    const uint8_t* valid = jp_col_valid(p, 0);
+    for (int i = 0; i < 32; i++) assert(av[i] == i);
+    assert(av[32] == 900 && av[33] == 901);
+    assert(valid[34] == 0);            // null a
+    assert(av[35] == 903 && av[36] == 904 && av[37] == 905);
+    for (int i = 0; i < 8; i++) assert(av[38 + i] == 1000 + i);
+    const uint8_t* svalid = jp_col_valid(p, 1);
+    assert(svalid[35] == 0);           // missing s
+    const double* fv = jp_col_f64(p, 2);
+    assert(fv[33] == 2.0 && fv[45] == 0.25);
+  }
+  // truncated rows WITH an armed layout: fast path must stop at the row
+  // boundary, roll back, and the general path reports the error
+  for (const char* t :
+       {"{\"a\":7,\"s\":\"x\",\"f\":1.", "{\"a\":7,\"s\":\"x", "{\"a\":7,"}) {
+    jp_clear(p);
+    // re-arm the layout on the fast shape first
+    std::string warm = "{\"a\":1,\"s\":\"w\",\"f\":2.0}";
+    std::string tr = t;
+    std::string both = warm + tr;
+    std::vector<uint8_t> exact(both.begin(), both.end());
+    uint64_t toffs[3] = {0, warm.size(), both.size()};
+    assert(jp_parse(p, exact.data(), toffs, 2) == -1);
+    assert(strlen(jp_error(p)) > 0);
+  }
+  jp_destroy(p);
+  printf("json fast layout ok\n");
+}
+
 static void zz(std::vector<uint8_t>& out, int64_t v) {
   uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
   while (z >= 0x80) {
@@ -299,6 +364,7 @@ int main(int argc, char** argv) {
   test_lsm(dir);
   test_interner();
   test_json();
+  test_json_fast_layout();
   test_avro();
   test_codecs();
   printf("ALL NATIVE TESTS PASSED\n");

@@ -637,8 +637,14 @@ class StreamingJoinExec(ExecOperator):
                         sides[0].watermark is not None
                         and sides[1].watermark is not None
                     ):
+                        # clamp by retention too: rows ABOVE the eviction
+                        # horizon stay retained and can still match a
+                        # resuming side, producing output with their
+                        # (older) timestamps — forwarding min_wm verbatim
+                        # would let downstream late-drop those matches
                         yield WatermarkHint(
                             min(sides[0].watermark, sides[1].watermark)
+                            - self.retention_ms
                         )
                     continue
                 if isinstance(item, EndOfStream):

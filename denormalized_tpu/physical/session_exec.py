@@ -36,6 +36,7 @@ from denormalized_tpu.physical.base import (
     ExecOperator,
     Marker,
     StreamItem,
+    WatermarkHint,
 )
 
 
@@ -525,8 +526,6 @@ class SessionWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        from denormalized_tpu.physical.base import WatermarkHint
-
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
@@ -534,15 +533,25 @@ class SessionWindowExec(ExecOperator):
                 yield from self._advance_and_close(item.ts_ms)
                 # emissions stamp canonical ts with the session START:
                 # forward clamped below every still-open session's start
-                # (a future row > ts can only extend open sessions or
-                # begin past ts, so with none open the hint passes as-is)
+                # AND below watermark - gap — the lateness rule accepts
+                # out-of-order rows down to watermark - gap + 1, and such
+                # a row can START (or merge a session down to) exactly
+                # there, so that is the true output low bound
                 open_starts = [
                     s.start
                     for lst in self._sessions.values()
                     for s in lst
                 ]
+                floor = (
+                    self._watermark - self.gap_ms
+                    if self._watermark is not None
+                    else item.ts_ms
+                )
                 yield WatermarkHint(
-                    min([item.ts_ms] + [st - 1 for st in open_starts])
+                    min(
+                        [item.ts_ms, floor]
+                        + [st - 1 for st in open_starts]
+                    )
                 )
             elif isinstance(item, Marker):
                 if self._ckpt is not None:

@@ -32,6 +32,7 @@ from denormalized_tpu.physical.base import (
     ExecOperator,
     Marker,
     StreamItem,
+    WatermarkHint,
 )
 
 
@@ -429,8 +430,6 @@ class UdafWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        from denormalized_tpu.physical.base import WatermarkHint
-
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
@@ -442,13 +441,14 @@ class UdafWindowExec(ExecOperator):
                 # forward clamped below the lowest still-emittable start
                 # (open frames, or the earliest window a future row could
                 # land in) so downstream never late-drops our output
-                if self._first_open is not None:
-                    low = self._first_open * self.slide_ms - 1
-                else:
-                    low = (
-                        (item.ts_ms + 1 - self.length_ms) // self.slide_ms
-                        + 1
-                    ) * self.slide_ms - 1
+                from denormalized_tpu.physical.window_exec import (
+                    window_output_low_watermark,
+                )
+
+                low = window_output_low_watermark(
+                    self._first_open, self.slide_ms, self.length_ms,
+                    item.ts_ms,
+                )
                 yield WatermarkHint(min(item.ts_ms, low))
             elif isinstance(item, Marker):
                 if self._ckpt is not None:

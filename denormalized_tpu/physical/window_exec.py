@@ -66,6 +66,22 @@ def _round_capacity(g: int, n_dev: int) -> int:
     return -(-g // unit) * unit
 
 
+def window_output_low_watermark(
+    first_open: int | None, slide_ms: int, length_ms: int, hint_ts: int
+) -> int:
+    """Strict lower bound (minus one) on the start of any window a
+    slide/length windowed operator can still emit, given no further input
+    rows at or before ``hint_ts``.  With open windows that is the first
+    open slot's start; with none, the earliest window a future row
+    (> hint_ts) could land in.  Shared by StreamingWindowExec and
+    UdafWindowExec — the forwarded WatermarkHint clamp must stay
+    identical in both."""
+    if first_open is not None:
+        return first_open * slide_ms - 1
+    min_future_start = ((hint_ts + 1 - length_ms) // slide_ms + 1) * slide_ms
+    return min_future_start - 1
+
+
 class StreamingWindowExec(ExecOperator):
     def __init__(
         self,
@@ -594,17 +610,9 @@ class StreamingWindowExec(ExecOperator):
         self._acc_future = self._acc_exec.submit(run)
 
     def _output_low_watermark(self, hint_ts: int) -> int:
-        """Strict lower bound (minus one) on the start of any window this
-        operator can still emit, given no further input rows at or before
-        ``hint_ts``.  With open windows that is the first open slot's
-        start; with none, the earliest window a future row (> hint_ts)
-        could land in."""
-        if self._first_open is not None:
-            return self._first_open * self.slide_ms - 1
-        min_future_start = (
-            (hint_ts + 1 - self.length_ms) // self.slide_ms + 1
-        ) * self.slide_ms
-        return min_future_start - 1
+        return window_output_low_watermark(
+            self._first_open, self.slide_ms, self.length_ms, hint_ts
+        )
 
     # -- emission --------------------------------------------------------
     def _closable(self) -> int:
@@ -958,7 +966,16 @@ class StreamingWindowExec(ExecOperator):
                 yield from self._release_snapshot()
                 if self._watermark_ms is None or item.ts_ms > self._watermark_ms:
                     self._watermark_ms = item.ts_ms
-                    yield from self._trigger()
+                    # force: the emit-lag deferral assumes another batch
+                    # (or hint) will follow, but an idle period delivers
+                    # exactly ONE hint — a deferred emission would never
+                    # run and the final windows would sit closed-but-
+                    # unemitted, defeating the feature.  Likewise drain
+                    # the async emission pipeline NOW: blocks dispatched
+                    # by this trigger normally materialize on the next
+                    # item, and there is no next item.
+                    yield from self._trigger(force=True)
+                    yield from self._drain_pending()
                 yield WatermarkHint(
                     min(item.ts_ms, self._output_low_watermark(item.ts_ms))
                 )

@@ -321,3 +321,53 @@ def test_forwarded_hint_clamped_below_open_windows(broker):
     # and everything emitted so far is at or below the forwarded hint
     if max_emitted_start is not None:
         assert max_emitted_start <= hint_ts
+
+
+def test_idle_hint_forces_deferred_emission(broker):
+    """The partial_merge strategy defers emission up to emit_lag_ms
+    expecting another item to follow; the single idle hint must FORCE the
+    emission and drain the async pipeline — otherwise closable windows
+    sit unemitted forever."""
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+
+    topic = "quiet_defer"
+    broker.create_topic(topic, partitions=2)
+    t0 = 1_700_000_000_000
+    _produce_then_quiet(broker, topic, 2, t0)
+    sample = json.dumps(
+        {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
+    )
+    ctx = Context(
+        EngineConfig(
+            source_idle_timeout_ms=400,
+            device_strategy="partial_merge",
+            emit_lag_ms=10_000,  # far beyond the test horizon
+        )
+    )
+    ds = ctx.from_topic(
+        topic, sample, broker.bootstrap, "occurred_at_ms"
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+    root = executor.build_physical(
+        lp.Sink(ds._plan, CollectSink()), ds._ctx
+    )
+    gen = root.run()
+    starts = set()
+    hint_ts = None
+    deadline = time.time() + 20
+    for item in gen:
+        if isinstance(item, RecordBatch) and item.num_rows:
+            starts |= {
+                int(v) - t0 for v in item.column("window_start_time")
+            }
+        if isinstance(item, WatermarkHint):
+            hint_ts = item.ts_ms
+            break
+        if time.time() > deadline:
+            break
+    gen.close()
+    assert 0 in starts and 1000 in starts, starts
+    assert hint_ts is not None and hint_ts < t0 + 2000
