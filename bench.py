@@ -477,12 +477,14 @@ def _ctx_for(
     ckpt_dir=None,
     emit_on_close=True,
     ckpt_interval_s=2.0,
+    **over,
 ):
     if config == "highcard":
         return _engine_ctx(
             batch_bucket,
             min_group_capacity=2 * NUM_KEYS,
             emit_on_close=emit_on_close,
+            **over,
         )
     if config == "checkpoint":
         return _engine_ctx(
@@ -491,8 +493,9 @@ def _ctx_for(
             checkpoint_interval_s=ckpt_interval_s,
             state_backend_path=ckpt_dir,
             emit_on_close=emit_on_close,
+            **over,
         )
-    return _engine_ctx(batch_bucket, emit_on_close=emit_on_close)
+    return _engine_ctx(batch_bucket, emit_on_close=emit_on_close, **over)
 
 
 # -- kafka end-to-end (broker → fetch → decode → intern → window) --------
@@ -1015,9 +1018,15 @@ def run_latency(config, ckpt_dir=None) -> dict:
     # finishes in well under the 2s barrier cadence, so without it the
     # snapshot/export programs compile on the first barrier INSIDE the
     # paced phase (observed as paced_compiles=1 on the checkpoint config)
+    # emit_lag_ms=0 for the WARM context only: at replay speed the
+    # deferral batches several closable windows into one n>=2 emission
+    # block, but the paced phase closes windows ONE at a time (n=1) — the
+    # n-static emission program then compiles mid-paced-phase (observed
+    # as paced_compiles=1 / a ~300ms first-window sample on partial_merge
+    # + device_finalize).  Zero lag makes the warmup emit n=1 blocks too.
     warm_ctx = _ctx_for(
         config, batch_bucket=LAT_BATCH, ckpt_dir=ckpt_dir,
-        emit_on_close=False, ckpt_interval_s=0.05,
+        emit_on_close=False, ckpt_interval_s=0.05, emit_lag_ms=0,
     )
     warm_n = _warm_batches(LAT_BATCH, 160, len(batches))
     for _ in build_pipeline(

@@ -86,14 +86,25 @@ class EngineConfig:
     #                     the device merges them into the ring.  Traffic
     #                     scales with cardinality, not rows — the right
     #                     choice behind a narrow host↔device link
-    #   'auto'          — partial_merge on a TPU backend, scatter on CPU
+    #   'auto'          — partial_merge on single-device TPU (host
+    #                     edge-reduction wins on the narrow link) and CPU
+    #                     (it beats XLA scatter adds there too), except
+    #                     f64 accumulators on CPU, which keep scatter:
+    #                     the partial stripe's f32 hi/lo transport cannot
+    #                     carry finite f64 sums beyond f32 range.  On
+    #                     backends neither measurement covers (e.g. a
+    #                     co-located GPU) 'auto' keeps row shipping
     device_strategy: str = "auto"
     # partial_merge pacing: merge the host stripe after this many rows even
     # if no window closed, and defer emission up to emit_lag_ms after a
     # window becomes closable so replay-speed runs batch several windows
-    # per device round-trip (real-time feeds always exceed the lag)
+    # per device round-trip.  None = backend default: 0 on CPU (merges
+    # are memcpy-cheap, and deferral would hold a paused live stream's
+    # final windows until the next rowful batch), 200ms on every
+    # accelerator backend (TPU, GPU, ...) where the remote merge
+    # round-trip is worth amortizing
     partial_merge_rows: int = 4_000_000
-    emit_lag_ms: int = 200
+    emit_lag_ms: int | None = None
     # run backend.accumulate (native stripe reduction, GIL-releasing) on a
     # worker thread so batch N's reduction overlaps batch N+1's
     # decode/eval/intern.  Default OFF: on CPU JAX the worker contends

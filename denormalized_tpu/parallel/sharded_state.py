@@ -1131,14 +1131,34 @@ def make_sharded_state(
 
     ensure_compilation_cache_for_backend()
     if mesh is None or mesh.devices.size == 1:
-        # 'auto' on a real TPU backend chooses host edge-reduction: the
-        # chip sits behind a host↔device link whose cost scales with
-        # shipped bytes, and partials are orders of magnitude smaller
-        # than rows (measured on the axon tunnel: ~20 MB/s uplink vs a
-        # >20 MB/s decoded-row stream at 1M ev/s).  On CPU JAX the link
-        # is memcpy, so per-row scatter stays the default.
+        # 'auto' chooses host edge-reduction on EVERY single-device
+        # backend.  On a real TPU the chip sits behind a host↔device
+        # link whose cost scales with shipped bytes, and partials are
+        # orders of magnitude smaller than rows (measured on the axon
+        # tunnel: ~20 MB/s uplink vs a >20 MB/s decoded-row stream at
+        # 1M ev/s).  On CPU JAX the link is memcpy, but the native
+        # single-pass reducer (native/partial_agg.cpp, 43-88M rows/s)
+        # beats shipping rows through XLA's scatter adds there too:
+        # measured ~30M vs ~20M rows/s on the simple config, with
+        # equivalent paced latency once emission shapes are warm.
+        # Row shipping remains available explicitly ('scatter' /
+        # 'pallas_dense') for co-located accelerators — and stays the
+        # 'auto' pick on backends neither measurement covers (e.g. a
+        # co-located GPU, where host reduction would forfeit device-side
+        # scatter for no demonstrated win).
+        # ... except f64 accumulators on CPU: the partial_merge stripe
+        # transports f64 as an f32 hi/lo split and refuses finite sums
+        # beyond f32 range (ops/host_partial.py), while CPU XLA scatter
+        # keeps f64 end-to-end — don't let 'auto' turn a working f64
+        # workload into a runtime OverflowError.
+        if device_strategy == "auto" and (
+            spec.accum_dtype == jnp.float64
+            and jax.default_backend() == "cpu"
+        ):
+            return SingleDeviceWindowState(spec, "scatter")
         if device_strategy == "partial_merge" or (
-            device_strategy == "auto" and jax.default_backend() == "tpu"
+            device_strategy == "auto"
+            and jax.default_backend() in ("tpu", "cpu")
         ):
             return PartialMergeWindowState(spec)
         return SingleDeviceWindowState(spec, device_strategy)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import time
 from typing import Iterator
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -104,7 +105,7 @@ class StreamingWindowExec(ExecOperator):
         shard_strategy: str = "auto",
         device_strategy: str = "scatter",
         partial_merge_rows: int = 4_000_000,
-        emit_lag_ms: int = 200,
+        emit_lag_ms: int | None = None,
         host_pipeline: bool = False,
         name: str = "window",
     ) -> None:
@@ -167,8 +168,6 @@ class StreamingWindowExec(ExecOperator):
                 )
             else:
                 self._agg_specs.append((a.kind, value_idx(a.arg)))
-        import jax
-
         if accum_dtype == jnp.float64 and not jax.config.jax_enable_x64:
             raise PlanError(
                 "accum_dtype=float64 requires jax.config.update("
@@ -255,7 +254,14 @@ class StreamingWindowExec(ExecOperator):
         # partial_merge flush/emission pacing: emission is deferred up to
         # emit_lag_s after a window becomes closable so replay-speed runs
         # batch several windows per device round-trip; paced (real-time)
-        # feeds always exceed the lag and emit promptly
+        # feeds always exceed the lag and emit promptly.  Backend-default
+        # (None): 0 on CPU — merges are memcpy-cheap, and the deferral
+        # only re-checks on rowful batches, so it would hold a paused
+        # live stream's final windows until the next batch arrives; 200ms
+        # on every accelerator backend (TPU, GPU, ...) — a remote merge
+        # round-trip over the host↔device link is worth amortizing.
+        if emit_lag_ms is None:
+            emit_lag_ms = 0 if jax.default_backend() == "cpu" else 200
         self._emit_lag_s = emit_lag_ms / 1000.0
         self._merge_rows = partial_merge_rows
         self._stripe_wall: float | None = None
@@ -729,12 +735,15 @@ class StreamingWindowExec(ExecOperator):
                 self._pending_emit.append((self._first_open, n, handle, False))
             self._first_open += n
             n_close -= n
-        if not self._backend.accumulates_host:
+        if not self._backend.accumulates_host or self._emit_lag_s == 0:
             # row-shipping backends emit synchronously (prompt, in the
             # same trigger); the async pipeline — drain on the NEXT
             # trigger so the device→host transfer overlaps ingest — is
-            # reserved for the partial_merge path where round-trips
-            # dominate
+            # reserved for the deferred partial_merge path where remote
+            # round-trips dominate.  With a zero emit lag (the CPU
+            # default) there is nothing to overlap, and deferring the
+            # drain would hold a paused live stream's output until the
+            # next rowful batch arrives.
             yield from self._drain_pending()
 
     def _stripe_fits_more(self) -> bool:

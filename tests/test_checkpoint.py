@@ -187,6 +187,11 @@ def test_kill_and_restore(tmp_path, make_batch):
             checkpoint=path is not None,
             checkpoint_interval_s=9999,
             state_backend_path=path,
+            # prompt emission: the trigger in these tests is keyed to
+            # consumer-visible items, and the partial_merge deferral
+            # (the 'auto' default) would otherwise let the bounded
+            # source drain before the barrier has an injection point
+            emit_lag_ms=0,
         )
 
     golden, a, b = _kill_restore_roundtrip(
@@ -297,6 +302,8 @@ def test_session_window_kill_and_restore(tmp_path, make_batch):
     golden = windows(pipeline(Context()).collect())
 
     def make_cfg(path):
+        # no emit_lag_ms here: session windows run in SessionWindowExec,
+        # which has no partial_merge emission deferral
         return EngineConfig(
             checkpoint=path is not None,
             checkpoint_interval_s=9999,
@@ -715,6 +722,11 @@ def test_repeated_kill_restore_cycles(tmp_path, make_batch, seed):
             checkpoint=path is not None,
             checkpoint_interval_s=9999,
             state_backend_path=path,
+            # prompt emission: the trigger in these tests is keyed to
+            # consumer-visible items, and the partial_merge deferral
+            # (the 'auto' default) would otherwise let the bounded
+            # source drain before the barrier has an injection point
+            emit_lag_ms=0,
         )
 
     golden = _collect_windows(

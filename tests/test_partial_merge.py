@@ -569,3 +569,83 @@ def test_auto_strategy_never_row_ships_on_tpu(monkeypatch):
     backend = ss.make_sharded_state(spec, None, "auto", "auto")
     assert isinstance(backend, ss.PartialMergeWindowState)
     assert backend.strategy_name == "partial_merge"
+
+
+def test_auto_strategy_on_cpu_partial_merge_except_f64(monkeypatch):
+    """'auto' on CPU picks host edge-reduction too (the native reducer
+    beats XLA scatter adds), EXCEPT for f64 accumulators: the stripe's
+    f32 hi/lo transport refuses finite f64 sums beyond f32 range
+    (ops/host_partial.py), while CPU XLA scatter keeps f64 end-to-end —
+    routing must not turn a working default-config f64 workload into a
+    runtime OverflowError."""
+    import jax.numpy as jnp
+
+    import denormalized_tpu.parallel.sharded_state as ss
+    from denormalized_tpu.ops import segment_agg as sa
+
+    def spec_for(dtype):
+        return sa.WindowKernelSpec(
+            components=tuple(sa.components_for([("sum", 0)])),
+            num_value_cols=1,
+            window_slots=4,
+            group_capacity=128,
+            length_ms=1000,
+            slide_ms=1000,
+            accum_dtype=dtype,
+        )
+
+    monkeypatch.setattr(ss.jax, "default_backend", lambda: "cpu")
+    assert isinstance(
+        ss.make_sharded_state(spec_for(jnp.float32), None, "auto", "auto"),
+        ss.PartialMergeWindowState,
+    )
+    f64 = ss.make_sharded_state(spec_for(jnp.float64), None, "auto", "auto")
+    assert isinstance(f64, ss.SingleDeviceWindowState)
+    assert "scatter" in f64.strategy_name
+    # explicit partial_merge is still honored (the transport raises its
+    # own actionable OverflowError only if an out-of-range sum occurs)
+    assert isinstance(
+        ss.make_sharded_state(spec_for(jnp.float64), None, "auto",
+                              "partial_merge"),
+        ss.PartialMergeWindowState,
+    )
+
+
+@pytest.mark.parametrize(
+    "backend,expected_lag_s",
+    [("cpu", 0.0), ("tpu", 0.2), ("gpu", 0.2)],
+)
+def test_emit_lag_backend_default(monkeypatch, make_batch, backend,
+                                  expected_lag_s):
+    """emit_lag_ms=None resolves per backend: 0 only on CPU (merges are
+    memcpy-cheap and deferral would hold a paused stream's output); every
+    accelerator — including GPU, which the routing measurements don't
+    cover — keeps the 200ms round-trip amortization."""
+    import denormalized_tpu.physical.window_exec as we
+
+    monkeypatch.setattr(we.jax, "default_backend", lambda: backend)
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime.executor import build_physical
+    from denormalized_tpu.sources.memory import MemorySource
+
+    t0 = 1_700_000_000_000
+    ctx = Context()
+    ds = ctx.from_source(
+        MemorySource.from_batches(
+            [make_batch([t0], ["a"], [1.0])],
+            timestamp_column="occurred_at_ms",
+        )
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+    root = build_physical(lp.Sink(ds._plan, CollectSink()), ctx)
+    op, found = root, None
+    while op is not None:
+        if isinstance(op, we.StreamingWindowExec):
+            found = op
+            break
+        op = getattr(op, "input_op", None)
+    assert found is not None
+    assert found._emit_lag_s == expected_lag_s
