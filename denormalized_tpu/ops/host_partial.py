@@ -140,7 +140,9 @@ class HostPartialStripe:
             self.nulls_seen = True
         if self.u_base is None:
             self.u_base = int(units.min())
-        rel = (units - self.u_base).astype(np.int64)
+        # units is int64 (accumulate() normalizes), so the subtraction
+        # already yields a fresh contiguous int64 array — no astype copy
+        rel = units - self.u_base
         self.u_hi = max(self.u_hi, int(rel.max()))
         sub = None
         if self.SUB == 2:

@@ -445,9 +445,6 @@ class _HostPartialMixin:
         in unit-range chunks with a merge between them — the partial-path
         equivalent of the scatter path's W growth."""
         units_rel = np.asarray(units_rel, np.int64)
-        remaining = (
-            np.ones(len(units_rel), bool) if keep is None else keep.copy()
-        )
         stripe = self._stripe
         # units a stripe may span: both the U_MAX ring and the transfer
         # cell cap (at least one unit — transfer_buckets covers G*SUB)
@@ -457,6 +454,32 @@ class _HostPartialMixin:
                 stripe.U_MAX,
                 stripe.MAX_STRIPE_CELLS // max(1, stripe.G * stripe.SUB),
             ),
+        )
+        if keep is None and len(units_rel):
+            # fast path for the steady state: no late/keep mask and the
+            # whole batch fits the CURRENT stripe as-is — fold it in one
+            # call with no boolean scans or masked copies.  Anything that
+            # would need a flush (span overflow, row cap, units behind
+            # u_base) falls through to the chunk loop below, which keeps
+            # the one and only copy of the flush/admission logic.
+            u_min = int(units_rel.min())
+            u_max = int(units_rel.max())
+            base = stripe.u_base if not stripe.is_empty() else u_min
+            if (
+                u_min >= base
+                and u_max <= base + span_u - 1
+                and (
+                    stripe.is_empty()
+                    or stripe.rows + len(units_rel)
+                    <= stripe.MAX_STRIPE_ROWS
+                )
+            ):
+                if stripe.is_empty():
+                    self._pending_base_mod = int(base_mod)
+                stripe.add_batch(units_rel, rem, gid, values64, colvalid, None)
+                return
+        remaining = (
+            np.ones(len(units_rel), bool) if keep is None else keep.copy()
         )
         while remaining.any():
             u0 = int(units_rel[remaining].min())

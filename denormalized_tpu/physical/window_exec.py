@@ -396,8 +396,8 @@ class StreamingWindowExec(ExecOperator):
         self._metrics["batches_in"] += 1
         S = self.slide_ms
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
-        units = ts // S
-        rem = (ts - units * S).astype(np.int32)
+        units, rem64 = np.divmod(ts, S)  # one pass for quotient+remainder
+        rem = rem64.astype(np.int32)
 
         if self._first_open is None:
             # windows overlapping the first data: back to units.min() - k + 1
@@ -422,46 +422,67 @@ class StreamingWindowExec(ExecOperator):
         # accumulates on host (partial_merge keeps f64 precision); the
         # row-shipping paths fill f32 directly — no second full-matrix copy
         V = self._spec.num_value_cols
-        values64 = np.zeros(
-            (n, max(V, 1)),
-            dtype=np.float64
-            if self._backend.accumulates_host
-            else np.float32,
-        )
-        colvalid = np.ones((n, max(V, 1)), dtype=bool)
-        any_invalid = False
         from denormalized_tpu.logical.expr import column_validity
 
-        for j, e in enumerate(self._value_exprs):
-            raw = np.asarray(e.eval(batch), dtype=np.float64)
+        host_dtype = (
+            np.float64 if self._backend.accumulates_host else np.float32
+        )
+        single_untransformed = (
+            V == 1 and self._value_transforms[0] is None
+        )
+        if single_untransformed:
+            # single untransformed value column (the common case): the
+            # evaluated column IS the value matrix — skip the zeros
+            # allocation and the per-column copy.  The host reducer and
+            # the device paths only read it, so aliasing the batch
+            # column (host path, already f64) is safe.
+            e = self._value_exprs[0]
+            values64 = np.asarray(e.eval(batch), dtype=host_dtype).reshape(
+                n, 1
+            )
+            colvalid = np.ones((n, 1), dtype=bool)
             m = column_validity(e, batch)
+            any_invalid = False
             if m is not None:
-                colvalid[:, j] = m
-                any_invalid = any_invalid or not colvalid[:, j].all()
-            tr = self._value_transforms[j]
-            if tr is not None:
-                # variance moment columns: shift by a pivot K taken from the
-                # first valid value ever seen for this expression, so the
-                # s2 − s²/c finalize never catastrophically cancels (exact
-                # for any constant K)
-                key = repr(e)
-                K = self._var_shift.get(key)
-                if K is None:
-                    valid_vals = raw[colvalid[:, j]] if m is not None else raw
-                    finite = valid_vals[np.isfinite(valid_vals)]
-                    if len(finite):
-                        K = float(finite[0])
-                        self._var_shift[key] = K
-                    else:
-                        # no finite value yet (all-null warm-up batch): use 0
-                        # transiently but do NOT cache it — a later batch
-                        # with real data must still set a magnitude-matched
-                        # pivot, or the cancellation guard is lost
-                        K = 0.0
-                raw = raw - K
-                if tr == "shift_sq":
-                    raw = raw * raw
-            values64[:, j] = raw
+                colvalid[:, 0] = m
+                any_invalid = not m.all()
+        else:
+            values64 = np.zeros((n, max(V, 1)), dtype=host_dtype)
+            colvalid = np.ones((n, max(V, 1)), dtype=bool)
+            any_invalid = False
+            for j, e in enumerate(self._value_exprs):
+                raw = np.asarray(e.eval(batch), dtype=np.float64)
+                m = column_validity(e, batch)
+                if m is not None:
+                    colvalid[:, j] = m
+                    any_invalid = any_invalid or not colvalid[:, j].all()
+                tr = self._value_transforms[j]
+                if tr is not None:
+                    # variance moment columns: shift by a pivot K taken
+                    # from the first valid value ever seen for this
+                    # expression, so the s2 − s²/c finalize never
+                    # catastrophically cancels (exact for any constant K)
+                    key = repr(e)
+                    K = self._var_shift.get(key)
+                    if K is None:
+                        valid_vals = (
+                            raw[colvalid[:, j]] if m is not None else raw
+                        )
+                        finite = valid_vals[np.isfinite(valid_vals)]
+                        if len(finite):
+                            K = float(finite[0])
+                            self._var_shift[key] = K
+                        else:
+                            # no finite value yet (all-null warm-up
+                            # batch): use 0 transiently but do NOT cache
+                            # it — a later batch with real data must
+                            # still set a magnitude-matched pivot, or
+                            # the cancellation guard is lost
+                            K = 0.0
+                    raw = raw - K
+                    if tr == "shift_sq":
+                        raw = raw * raw
+                values64[:, j] = raw
 
         if any_invalid:
             self._any_nulls_seen = True
