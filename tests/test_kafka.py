@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from denormalized_tpu import Context, col
+from denormalized_tpu.api.context import EngineConfig
 from denormalized_tpu.api import functions as F
 from denormalized_tpu.sources.kafka import KafkaClient, KafkaTopicBuilder
 from denormalized_tpu.testing.mock_kafka import (
@@ -88,7 +89,13 @@ def test_kafka_source_to_window_pipeline(broker):
 
     threading.Thread(target=feed, daemon=True).start()
 
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     sample = json.dumps(
         {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0}
     )
@@ -147,7 +154,13 @@ def test_sink_kafka_roundtrip(broker):
 
     threading.Thread(target=feed, daemon=True).start()
 
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     sample = json.dumps({"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0})
     ds = ctx.from_topic(
         "in",
@@ -509,7 +522,13 @@ def test_projection_pushdown_into_json_reader(broker):
         {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0,
          **{f"extra{j}": 1.0 for j in range(10)}}
     )
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     ds = ctx.from_topic(
         "wide",
         sample_json=sample,
@@ -597,7 +616,13 @@ def test_avro_from_topic_pipeline(broker):
             time.sleep(0.15)
 
     threading.Thread(target=feed, daemon=True).start()
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     src = ctx.from_topic(
         "avro_t",
         bootstrap_servers=broker.bootstrap,
@@ -695,7 +720,13 @@ def test_nested_avro_from_topic_pipeline(broker):
             time.sleep(0.15)
 
     threading.Thread(target=feed, daemon=True).start()
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     src = ctx.from_topic(
         "trips_avro",
         bootstrap_servers=broker.bootstrap,
@@ -842,7 +873,13 @@ def test_from_topic_positional_order_matches_reference(broker):
     sample = json.dumps(
         {"occurred_at_ms": 1, "sensor_name": "a", "reading": 0.5}
     )
-    ctx = Context()
+    ctx = Context(
+        # the feed goes quiet once produced; without an idle hint the
+        # tail windows close only if the LAST fetch happens to carry a
+        # high min-ts batch (watermark = max of batch min-ts), so the
+        # consume loop can starve on fetch-coalescing timing
+        EngineConfig(source_idle_timeout_ms=400)
+    )
     # POSITIONAL call in the reference's order
     ds = ctx.from_topic(
         "postest", sample, broker.bootstrap, "occurred_at_ms"
