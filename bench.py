@@ -761,6 +761,8 @@ def _kafka_e2e_latency(parts, sustainable: float) -> dict:
     _, batches = gen_batches(total_rows=lat_rows, batch_rows=8192, seed=7)
     payloads = _json_payloads(batches)
     clock = _FeedClock(pace)
+    gc_pauses: list[float] = []
+    gc_fence = _GcFence(gc_pauses)
     broker = MockKafkaBroker().start()
     try:
         broker.create_topic("bench_lat", partitions=parts)
@@ -836,6 +838,12 @@ def _kafka_e2e_latency(parts, sustainable: float) -> dict:
         finally:
             wbroker.stop()
 
+        # GC fence: the staged payload lists hold tens of millions of
+        # PERMANENT byte objects; without freeze, gen2 collections rescan
+        # them mid-sampling and multi-hundred-ms pauses are charged to
+        # the engine
+        gc_fence.install()
+
         feeder = threading.Thread(target=feed, daemon=True)
         ctx = _engine_ctx(batch_bucket=8192)
         ds = _e2e_source(broker, ctx, topic="bench_lat").window(
@@ -846,7 +854,11 @@ def _kafka_e2e_latency(parts, sustainable: float) -> dict:
             ],
             WINDOW_MS,
         )
-        n_windows = int(lat_rows / EVENTS_PER_SEC * 1000) // WINDOW_MS - 1
+        # -2: the final window's close depends on fetch-boundary luck (a
+        # tail batch whose MIN-ts clears the boundary may never arrive on
+        # a finished feed), and waiting for it burned the full sampling
+        # deadline (~2 min) for one sample
+        n_windows = int(lat_rows / EVENTS_PER_SEC * 1000) // WINDOW_MS - 2
         lats: list[float] = []
         seen = set()
         it = ds.stream()
@@ -873,15 +885,37 @@ def _kafka_e2e_latency(parts, sustainable: float) -> dict:
         _consume_bounded(_sample, deadline_s, "e2e latency sampling")
     finally:
         broker.stop()
+        gc_fence.remove()
     if not lats:
         return {"p50_window_latency_ms": None, "p99_window_latency_ms": None}
     a = np.asarray(lats)
-    return {
+    out = {
         "p50_window_latency_ms": round(float(np.percentile(a, 50)), 2),
         "p99_window_latency_ms": round(float(np.percentile(a, 99)), 2),
         "latency_samples": int(a.size),
         "latency_pace_events_per_sec": round(pace),
     }
+    if a.size >= 8:
+        # backlog drift: latency growing linearly across windows means
+        # the paced pipeline runs slightly over capacity and the
+        # percentiles measure ACCUMULATION, not steady-state latency —
+        # report the slope so the distinction is visible in the JSON
+        # (observed: single-core CPU host runs the whole stack — feeder,
+        # broker, engine — and drifts ~12 ms per fed second at 1M ev/s,
+        # turning a ~22ms steady-state latency into a 662ms "p50" over a
+        # 52s feed)
+        slope = float(np.polyfit(np.arange(a.size), a, 1)[0])
+        out["latency_drift_ms_per_window"] = round(slope, 2)
+        if slope > 1.0:
+            # steady-state estimate with the accumulation removed: what
+            # the per-window latency would be if the feed were at (not
+            # above) capacity
+            detr = a - slope * np.arange(a.size)
+            out["p50_detrended_ms"] = round(float(np.percentile(detr, 50)), 2)
+    if gc_pauses:
+        out["gc_pauses"] = len(gc_pauses)
+        out["gc_pause_max_ms"] = round(max(gc_pauses), 1)
+    return out
 
 
 # -- throughput phase ----------------------------------------------------
@@ -929,6 +963,45 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
 
 
 # -- latency phase (paced feed) ------------------------------------------
+
+
+class _GcFence:
+    """Move the harness's permanent objects (staged payloads, generated
+    batches) out of the collector's scan set and record the duration of
+    any collections that still run, so GC cost is visible in the JSON
+    instead of silently charged to the engine's latency samples.
+    ``install()``/``remove()`` pair; ``remove()`` is idempotent."""
+
+    def __init__(self, pauses: list):
+        self._pauses = pauses
+        self._t0 = 0.0
+        self._installed = False
+
+    def _cb(self, phase, info):
+        if phase == "start":
+            self._t0 = time.perf_counter()
+        else:
+            self._pauses.append((time.perf_counter() - self._t0) * 1000.0)
+
+    def install(self):
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        gc.callbacks.append(self._cb)
+        self._installed = True
+
+    def remove(self):
+        import gc
+
+        if not self._installed:
+            return
+        self._installed = False
+        try:
+            gc.callbacks.remove(self._cb)
+        except ValueError:
+            pass
+        gc.unfreeze()
 
 
 class _FeedClock:
@@ -1062,7 +1135,6 @@ def run_latency(config, ckpt_dir=None) -> dict:
     #   * anything else (scheduler preemption by a co-resident process):
     #     shows up as `stalls`/`stall_max_ms` with no matching compile or
     #     gc pause, which is itself the diagnosis.
-    import gc
     import logging
     import threading
 
@@ -1090,12 +1162,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
     )
 
     gc_pauses: list[float] = []
-
-    def _gc_cb(phase, info, _t=[0.0]):
-        if phase == "start":
-            _t[0] = time.perf_counter()
-        else:
-            gc_pauses.append((time.perf_counter() - _t[0]) * 1000.0)
+    gc_fence = _GcFence(gc_pauses)
 
     class _CompileCounter(logging.Handler):
         # one record per REAL compile: each XLA compilation emits exactly
@@ -1115,9 +1182,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
         logging.getLogger(logger_name).addHandler(compile_handler)
     prior_log_compiles = jax.config.jax_log_compiles
     jax.config.update("jax_log_compiles", True)
-    gc.collect()
-    gc.freeze()
-    gc.callbacks.append(_gc_cb)
+    gc_fence.install()
     hb_thread.start()
     lats = []
     try:
@@ -1150,8 +1215,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
         hb_stop.set()
         # join so a gap ending at stream end still lands in the summary
         hb_thread.join(timeout=0.1)
-        gc.callbacks.remove(_gc_cb)
-        gc.unfreeze()
+        gc_fence.remove()
         jax.config.update("jax_log_compiles", prior_log_compiles)
         for logger_name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
             logging.getLogger(logger_name).removeHandler(compile_handler)

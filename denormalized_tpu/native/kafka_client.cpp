@@ -918,6 +918,12 @@ const uint64_t* kc_rec_offsets(void* h) {
 const int64_t* kc_rec_timestamps(void* h) {
   return static_cast<Client*>(h)->rec_ts.data();
 }
+// absolute Kafka offset of each fetched record — exact slice-boundary
+// offsets for readers that split a large fetch into bounded batches
+// (gaps from compaction/control records make base+index arithmetic wrong)
+const int64_t* kc_rec_kafka_offsets(void* h) {
+  return static_cast<Client*>(h)->rec_kafka_offsets.data();
+}
 int64_t kc_next_offset(void* h) {
   return static_cast<Client*>(h)->next_offset;
 }

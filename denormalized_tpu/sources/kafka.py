@@ -93,6 +93,12 @@ def _lib():
         ]
         lib.kc_high_watermark.restype = ctypes.c_int64
         lib.kc_high_watermark.argtypes = [ctypes.c_void_p]
+        # per-record absolute Kafka offsets (tolerate a stale .so without
+        # the symbol — readers then skip fetch splitting)
+        lib._kc_has_rec_kafka_offsets = hasattr(lib, "kc_rec_kafka_offsets")
+        if lib._kc_has_rec_kafka_offsets:
+            lib.kc_rec_kafka_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+            lib.kc_rec_kafka_offsets.argtypes = [ctypes.c_void_p]
         lib._kc_configured = True
     return lib
 
@@ -248,6 +254,15 @@ class KafkaClient:
         ).copy()
         return n, bptr, optr, ts, int(lib.kc_next_offset(self._h))
 
+    def rec_kafka_offsets(self, n: int) -> np.ndarray | None:
+        """Absolute Kafka offset of each record in the LAST fetch (copy),
+        or None on a stale native build without the export."""
+        if not getattr(self._libref, "_kc_has_rec_kafka_offsets", False):
+            return None
+        return np.ctypeslib.as_array(
+            self._libref.kc_rec_kafka_offsets(self._h), shape=(n,)
+        ).copy()
+
 
 def parse_fetch_arena(parser, n, bptr, optr, ts):
     """Parse a fetch arena zero-copy; compacts away zero-length payloads
@@ -352,6 +367,28 @@ class KafkaPartitionReader(PartitionReader):
         )
         self._ts_col = src.builder.timestamp_column
         self._consecutive_failures = 0
+        # fetch splitting: a 4MB fetch can span hundreds of ms of event
+        # time, and the watermark only advances on batch MIN-ts — so one
+        # oversized batch delays every window close behind it by the whole
+        # fetch span.  Bounded batches keep watermark granularity (and the
+        # compiled batch-bucket shape) tight.  Splitting uses the EXACT
+        # per-record offsets the native client records for every fetch
+        # (both decode paths): approximating slice-boundary offsets by
+        # arithmetic would break checkpoint exactly-once on logs with
+        # gaps (compaction, control records).
+        raw_max = src.builder.opts.get("max.batch.rows", 32768)
+        try:
+            self._max_batch_rows = int(raw_max)
+        except (TypeError, ValueError):
+            raise SourceError(
+                f"max.batch.rows must be an integer, got {raw_max!r}"
+            ) from None
+        if self._max_batch_rows < 1:
+            raise SourceError(
+                f"max.batch.rows must be >= 1, got {self._max_batch_rows}"
+            )
+        self._pending_slices: list = []
+        self._snap_offset = self._offset
 
     # transport failures are transient: log-and-retry with reconnect, like
     # the reference's recv error handling (kafka_stream_read.rs:210-218) —
@@ -425,12 +462,20 @@ class KafkaPartitionReader(PartitionReader):
         # BEFORE decoding; a poison payload is salvaged per-record (below)
         # so the stream — and the offsets the checkpoint persists — keep
         # progressing past it without dropping its co-fetched good records.
+        if self._pending_slices:
+            batch, snap = self._pending_slices.pop(0)
+            self._snap_offset = snap
+            return batch
         native = getattr(self._decoder, "_native", None)
         max_wait = int((timeout_s or 0.1) * 1000)
         try:
-            return self._read_once(native, max_wait)
+            batch = self._read_once(native, max_wait)
         except SourceError as e:
-            return self._handle_source_error(e, timeout_s or 0.1)
+            batch = self._handle_source_error(e, timeout_s or 0.1)
+        if not self._pending_slices:
+            # whole-fetch yield (no split): snapshot == fetch cursor
+            self._snap_offset = self._offset
+        return batch
 
     def _salvage_decode(self, payloads, kafka_ts, err):
         """A poison payload in the fetch: decode per-record and skip ONLY
@@ -487,7 +532,9 @@ class KafkaPartitionReader(PartitionReader):
                 batch, kafka_ts = self._salvage_decode(payloads, kafka_ts, e)
             if batch is None:
                 return RecordBatch.empty(self._src.schema)
-            return self._attach_ts(batch, kafka_ts)
+            return self._maybe_split(
+                self._attach_ts(batch, kafka_ts), n, next_off
+            )
 
         payloads, kafka_ts, next_off = self._client.fetch(
             self._topic, self._partition, self._offset, max_wait_ms=max_wait
@@ -495,6 +542,7 @@ class KafkaPartitionReader(PartitionReader):
         self._consecutive_failures = 0
         # commit before decode (see above)
         self._offset = next_off
+        n_fetch = len(payloads)
         if not payloads:
             # live source: no data within the wait — empty batch, stay open
             return RecordBatch.empty(self._src.schema)
@@ -514,13 +562,44 @@ class KafkaPartitionReader(PartitionReader):
             batch, kafka_ts = self._salvage_decode(payloads, kafka_ts, e)
             if batch is None:
                 return RecordBatch.empty(self._src.schema)
-        return self._attach_ts(batch, kafka_ts)
+        return self._maybe_split(
+            self._attach_ts(batch, kafka_ts), n_fetch, next_off
+        )
 
     def offset_snapshot(self) -> dict:
-        return {"partition": self._partition, "offset": int(self._offset)}
+        # _snap_offset trails _offset while a split fetch drains: it
+        # covers exactly the YIELDED slices, so a barrier between slices
+        # checkpoints neither lost nor duplicated rows
+        return {"partition": self._partition, "offset": int(self._snap_offset)}
 
     def offset_restore(self, snap: dict) -> None:
         self._offset = int(snap.get("offset", self._offset))
+        self._snap_offset = self._offset
+        self._pending_slices.clear()
+
+    def _maybe_split(self, batch, n_fetch, next_off):
+        """Split an oversized CLEANLY-decoded batch.  Rows must align 1:1
+        with the fetch's records for the per-record offsets to apply —
+        tombstone-dropped or salvaged fetches skip splitting."""
+        if batch.num_rows > self._max_batch_rows and batch.num_rows == n_fetch:
+            return self._split_oversized(
+                batch, self._client.rec_kafka_offsets(n_fetch), next_off
+            )
+        return batch
+
+    def _split_oversized(self, batch, rec_offs, next_off):
+        """Return the first ≤max.batch.rows slice; stash the rest with the
+        EXACT kafka offset each slice's yield advances the snapshot to."""
+        n = batch.num_rows
+        if n <= self._max_batch_rows or rec_offs is None:
+            self._snap_offset = next_off
+            return batch
+        for a in range(0, n, self._max_batch_rows):
+            b = min(a + self._max_batch_rows, n)
+            snap = next_off if b == n else int(rec_offs[b])
+            self._pending_slices.append((batch.slice(a, b - a), snap))
+        batch, self._snap_offset = self._pending_slices.pop(0)
+        return batch
 
 
 class KafkaSource(Source):

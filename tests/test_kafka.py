@@ -837,6 +837,91 @@ def test_mixed_codec_fetch_preserves_offset_order(broker):
     c.close()
 
 
+def test_fetch_splitting_bounded_batches_exact_offsets(broker):
+    """A fetch larger than max.batch.rows yields bounded batches whose
+    offset snapshots land EXACTLY on slice boundaries: a checkpoint taken
+    between slices must neither lose nor duplicate rows on restore.  The
+    split also keeps watermark granularity tight — one oversized batch
+    would otherwise hold every window close behind it for the whole
+    fetch's event-time span (watermark = batch min-ts)."""
+    broker.create_topic("split", partitions=1)
+    total = 1000
+    msgs = [
+        b'{"occurred_at_ms": %d, "sensor_name": "s", "reading": %d}'
+        % (1_700_000_000_000 + i, i)
+        for i in range(total)
+    ]
+    broker.produce_batched("split", 0, msgs)
+    sample = json.dumps({"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0})
+    src = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic("split")
+        .infer_schema_from_json(sample)
+        .with_timestamp_column("occurred_at_ms")
+        .with_option("max.batch.rows", "256")
+        .build_reader()
+    )
+    reader = src.partitions()[0]
+    sizes, snaps, readings = [], [], []
+    deadline = time.time() + 15
+    while sum(sizes) < total and time.time() < deadline:
+        b = reader.read(timeout_s=0.1)
+        if b is None or b.num_rows == 0:
+            continue
+        sizes.append(b.num_rows)
+        snaps.append(reader.offset_snapshot()["offset"])
+        readings.extend(int(v) for v in b.column("reading"))
+    assert sum(sizes) == total
+    assert max(sizes) <= 256, sizes
+    # snapshots advance by exactly the yielded rows (cumulative row count)
+    assert snaps == list(np.cumsum(sizes)), (snaps, sizes)
+    assert readings == list(range(total))
+    # restore onto a mid-fetch snapshot: replay starts at the NEXT row
+    reader2 = src.partitions()[0]
+    reader2.offset_restore({"offset": snaps[1]})
+    b = reader2.read(timeout_s=0.5)
+    while b is not None and b.num_rows == 0:
+        b = reader2.read(timeout_s=0.5)
+    assert int(b.column("reading")[0]) == sum(sizes[:2])
+
+
+def test_fetch_splitting_non_native_decode_path(broker):
+    """Nested-JSON schemas decode through the Python decoder (no native
+    parser), but the fetch still runs through the native client — so
+    max.batch.rows splitting and its exact slice-boundary offsets apply
+    on this path too."""
+    broker.create_topic("splitnest", partitions=1)
+    total = 600
+    msgs = [
+        b'{"occurred_at_ms": %d, "gps": {"speed": %d}}'
+        % (1_700_000_000_000 + i, i)
+        for i in range(total)
+    ]
+    broker.produce_batched("splitnest", 0, msgs)
+    sample = json.dumps({"occurred_at_ms": 1, "gps": {"speed": 2}})
+    src = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic("splitnest")
+        .infer_schema_from_json(sample)
+        .with_timestamp_column("occurred_at_ms")
+        .with_option("max.batch.rows", "128")
+        .build_reader()
+    )
+    reader = src.partitions()[0]
+    assert getattr(reader._decoder, "_native", None) is None
+    sizes, snaps = [], []
+    deadline = time.time() + 15
+    while sum(sizes) < total and time.time() < deadline:
+        b = reader.read(timeout_s=0.1)
+        if b is None or b.num_rows == 0:
+            continue
+        sizes.append(b.num_rows)
+        snaps.append(reader.offset_snapshot()["offset"])
+    assert sum(sizes) == total
+    assert max(sizes) <= 128, sizes
+    assert snaps == list(np.cumsum(sizes)), (snaps, sizes)
+
+
 def test_from_topic_positional_order_matches_reference(broker):
     """The reference wrapper's positional order is (topic, sample_json,
     bootstrap_servers, timestamp_column, group_id)
