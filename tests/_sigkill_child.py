@@ -11,7 +11,11 @@ same state path to exercise the restore path the reference implements at
 kafka_stream_read.rs:110-140 (offset restore-by-seek) and
 grouped_window_agg_stream.rs:160-211 (frame restore).
 
-Config via env: KR_BROKER, KR_TOPIC, KR_STATE, KR_OUT, KR_INTERVAL.
+Config via env: KR_BROKER, KR_TOPIC, KR_STATE, KR_OUT, KR_INTERVAL, and
+optionally KR_MAX_BATCH_ROWS — when set, the source is built through
+``KafkaTopicBuilder.with_option("max.batch.rows", …)`` instead of
+``from_topic``, so oversized fetches are sliced and checkpoint barriers
+can land between slices (the mid-split kill/restore test).
 """
 
 import json
@@ -36,12 +40,28 @@ def main() -> None:
         emit_on_close=False,
     )
     ctx = Context(cfg)
-    ds = ctx.from_topic(
-        os.environ["KR_TOPIC"],
-        sample_json='{"ts": 1, "k": "a", "v": 1.0}',
-        bootstrap_servers=os.environ["KR_BROKER"],
-        timestamp_column="ts",
-    ).window(
+    mbr = os.environ.get("KR_MAX_BATCH_ROWS")
+    if mbr:
+        # builder path: the mid-split variant bounds fetch slices so
+        # checkpoint barriers land BETWEEN slices of one fetch
+        from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+
+        stream = ctx.from_source(
+            KafkaTopicBuilder(os.environ["KR_BROKER"])
+            .with_topic(os.environ["KR_TOPIC"])
+            .infer_schema_from_json('{"ts": 1, "k": "a", "v": 1.0}')
+            .with_timestamp_column("ts")
+            .with_option("max.batch.rows", mbr)
+            .build_reader()
+        )
+    else:
+        stream = ctx.from_topic(
+            os.environ["KR_TOPIC"],
+            sample_json='{"ts": 1, "k": "a", "v": 1.0}',
+            bootstrap_servers=os.environ["KR_BROKER"],
+            timestamp_column="ts",
+        )
+    ds = stream.window(
         ["k"],
         [F.count(col("v")).alias("c"), F.sum(col("v")).alias("s")],
         500,

@@ -351,6 +351,71 @@ def test_session_window_kill_and_restore(tmp_path, make_batch):
         assert combined[k] == golden[k], (k, combined[k], golden[k])
 
 
+# -- shared scaffolding for the process-level SIGKILL tests ---------------
+
+
+def _sigkill_read_out(path):
+    """Parse the child's JSONL emissions → {(ws, k): (count, sum)}."""
+    import json as _json
+
+    wins = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    o = _json.loads(line)
+                except _json.JSONDecodeError:
+                    continue  # torn tail from the SIGKILL
+                if "ws" in o:
+                    wins[(o["ws"], o["k"])] = (o["c"], o["s"])
+    except FileNotFoundError:
+        pass
+    return wins
+
+
+def _sigkill_child_err(out_path, n=800):
+    try:
+        return open(out_path + ".err").read()[-n:]
+    except OSError:
+        return "<no stderr>"
+
+
+def _sigkill_env(broker, topic, state_path, interval, **extra):
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        # prepend the repo root but keep the rest (e.g. the TPU plugin's
+        # site dir) — overwriting PYTHONPATH breaks other environments
+        PYTHONPATH=os.pathsep.join(
+            [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        ),
+        KR_BROKER=broker.bootstrap,
+        KR_TOPIC=topic,
+        KR_STATE=state_path,
+        KR_INTERVAL=interval,
+        **extra,
+    )
+    return env
+
+
+def _sigkill_spawn(env, out_path):
+    import os
+    import subprocess
+    import sys
+
+    e = dict(env)
+    e["KR_OUT"] = out_path
+    with open(out_path + ".err", "w") as errf:
+        return subprocess.Popen(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "_sigkill_child.py")],
+            env=e, stderr=errf,
+        )
+
+
 def test_sigkill_process_kill_and_restore(tmp_path, make_batch):
     """TRUE process-level kill/restore (round-3 VERDICT item 6): a child
     process runs a checkpointed Kafka pipeline against the mock broker;
@@ -396,52 +461,14 @@ def test_sigkill_process_kill_and_restore(tmp_path, make_batch):
         for p in (0, 1):
             broker.produce("kr", p, payloads[p], ts_ms=t0 + ms_lo)
 
-    def read_out(path):
-        wins = {}
-        try:
-            with open(path) as f:
-                for line in f:
-                    try:
-                        o = _json.loads(line)
-                    except _json.JSONDecodeError:
-                        continue  # torn tail from the SIGKILL
-                    if "ws" in o:
-                        wins[(o["ws"], o["k"])] = (o["c"], o["s"])
-        except FileNotFoundError:
-            pass
-        return wins
-
+    read_out = _sigkill_read_out
+    child_err = _sigkill_child_err
     out_a = str(tmp_path / "emit_a.jsonl")
     out_b = str(tmp_path / "emit_b.jsonl")
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env.update(
-        JAX_PLATFORMS="cpu",
-        # prepend the repo root but keep the rest (e.g. the TPU plugin's
-        # site dir) — overwriting PYTHONPATH breaks other environments
-        PYTHONPATH=os.pathsep.join(
-            [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-        ),
-        KR_BROKER=broker.bootstrap,
-        KR_TOPIC="kr",
-        KR_STATE=str(tmp_path / "state"),
-        KR_INTERVAL="0.3",
-    )
+    env = _sigkill_env(broker, "kr", str(tmp_path / "state"), "0.3")
 
     def spawn(out_path):
-        e = dict(env)
-        e["KR_OUT"] = out_path
-        return subprocess.Popen(
-            [sys.executable, os.path.join(os.path.dirname(__file__),
-                                          "_sigkill_child.py")],
-            env=e, stderr=open(out_path + ".err", "w"),
-        )
-
-    def child_err(out_path, n=800):
-        try:
-            return open(out_path + ".err").read()[-n:]
-        except OSError:
-            return "<no stderr>"
+        return _sigkill_spawn(env, out_path)
 
     stop_closers = threading.Event()
 
@@ -553,6 +580,143 @@ def test_sigkill_process_kill_and_restore(tmp_path, make_batch):
         # no full reprocess: at least one window child A emitted was
         # restored-past (not re-emitted) by child B
         assert set(wins_a) - set(wins_b), (
+            "recovery child re-emitted every window — full reprocess"
+        )
+    finally:
+        broker.stop()
+
+
+def test_sigkill_mid_split_fetch_restore(tmp_path):
+    """SIGKILL while a SPLIT fetch drains: the topic is pre-filled so the
+    child's fetches arrive oversized and get sliced by max.batch.rows;
+    with a 50ms barrier cadence, committed epochs land BETWEEN slices of
+    one fetch, so the persisted offsets are the exact per-record slice
+    boundaries (kc_rec_kafka_offsets).  A real mid-drain kill + restore
+    must reproduce the golden windows exactly — a replayed slice would
+    double counts, a skipped one would lose rows."""
+    import json as _json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    t0 = 1_700_000_000_000
+    keys = [f"k{i}" for i in range(5)]
+    span_ms, rows_per_ms = 1500, 400  # 600K rows pre-filled
+    golden: dict = {}
+    payloads = []
+    for ms in range(span_ms):
+        for r in range(rows_per_ms):
+            ts = t0 + ms
+            k = keys[(ms + r) % len(keys)]
+            v = float((ms * 7 + r) % 97) / 7.0
+            payloads.append(
+                _json.dumps({"ts": ts, "k": k, "v": v}).encode()
+            )
+            w = (ts // 500) * 500
+            c, s = golden.get((w, k), (0, 0.0))
+            golden[(w, k)] = (c + 1, s + v)
+
+    read_out = _sigkill_read_out
+    child_err = _sigkill_child_err
+    out_a = str(tmp_path / "split_a.jsonl")
+    out_b = str(tmp_path / "split_b.jsonl")
+    env = _sigkill_env(
+        broker, "krs", str(tmp_path / "state"), "0.05",
+        KR_MAX_BATCH_ROWS="2048",
+    )
+
+    def spawn(out_path):
+        return _sigkill_spawn(env, out_path)
+
+    stop_closers = threading.Event()
+
+    def closer_trickle():
+        ms = 5000
+        while not stop_closers.is_set():
+            broker.produce(
+                "krs", 0,
+                [_json.dumps({"ts": t0 + ms, "k": "k0", "v": 0.0}).encode()],
+                ts_ms=t0 + ms,
+            )
+            ms += 1
+            time.sleep(0.1)
+
+    try:
+        broker.create_topic("krs", partitions=1)
+        broker.produce_batched("krs", 0, payloads)  # pre-filled: big fetches
+        p_a = spawn(out_a)
+        try:
+            # kill as soon as the first window emits + a couple more
+            # barrier intervals — mid-drain, with committed epochs whose
+            # offsets sit inside a split fetch
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                if len(read_out(out_a)) >= 5:
+                    break
+                assert p_a.poll() is None, (
+                    "child A exited early: " + child_err(out_a)
+                )
+                time.sleep(0.02)
+            else:
+                raise AssertionError(
+                    "child A never emitted; stderr: " + child_err(out_a)
+                )
+            time.sleep(0.2)  # ~4 barrier intervals
+        finally:
+            if p_a.poll() is None:
+                os.kill(p_a.pid, signal.SIGKILL)
+            p_a.wait(10)
+        wins_a = read_out(out_a)
+        assert wins_a, "no emission before the kill"
+
+        needed = {k for k in golden if k[0] + 500 <= t0 + span_ms}
+        closers = threading.Thread(target=closer_trickle, daemon=True)
+        closers.start()
+        p_b = spawn(out_b)
+        try:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                union = dict(wins_a)
+                union.update(read_out(out_b))
+                if needed <= set(union):
+                    break
+                assert p_b.poll() is None, (
+                    "child B exited early: " + child_err(out_b)
+                )
+                time.sleep(0.1)
+            else:
+                missing = needed - set(union)
+                raise AssertionError(
+                    f"recovery never covered {missing}; stderr: "
+                    + child_err(out_b)
+                )
+        finally:
+            stop_closers.set()
+            if p_b.poll() is None:
+                os.kill(p_b.pid, signal.SIGKILL)
+            p_b.wait(10)
+
+        union = dict(wins_a)
+        union.update(read_out(out_b))
+        bad = []
+        for k in needed:
+            c, s = golden[k]
+            gc_, gs = union.get(k, (None, None))
+            if gc_ != c or gs is None or abs(gs - s) > 1e-4 * max(1.0, abs(s)):
+                bad.append((k, (gc_, gs), (c, s)))
+        assert not bad, (
+            f"windows lost/duplicated across a mid-split kill: {bad[:5]}"
+        )
+        # emission is barrier-aligned (emit_on_close=False), so everything
+        # child A emitted was committed — the recovery child must restore
+        # PAST at least one of A's windows, not reprocess from offset 0
+        assert set(wins_a) - set(read_out(out_b)), (
             "recovery child re-emitted every window — full reprocess"
         )
     finally:
