@@ -942,18 +942,20 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
     try:
         from denormalized_tpu.runtime.tracing import collect_metrics
 
-        h2d = d2h = merges = 0
+        h2d = d2h = merges = late = 0
         resolved = set()
         for m in collect_metrics(ctx._last_physical).values():
             h2d += m.get("bytes_h2d", 0)
             d2h += m.get("bytes_d2h", 0)
             merges += m.get("partial_merges", 0)
+            late += m.get("late_rows", 0)
             if "strategy_resolved" in m:
                 resolved.add(m["strategy_resolved"])
         info.update(
             bytes_h2d=h2d,
             bytes_d2h=d2h,
             partial_merges=merges,
+            late_rows=late,
             link_MBps_used=round((h2d + d2h) / 1e6 / dt, 1),
             strategy_resolved=",".join(sorted(resolved)) or None,
         )

@@ -64,6 +64,16 @@ class EngineConfig:
     # None (default) = reference behavior: the last windows of a quiet
     # stream wait for more data forever.
     source_idle_timeout_ms: int | None = None
+    # per-partition watermarks: the source-level watermark is the MIN over
+    # each partition's own max-of-batch-min-ts, so one fast-draining
+    # partition cannot race the watermark ahead and drop the slower
+    # partitions' backlog as late (replay/catch-up skew — the reference's
+    # global max-of-min rule shares this flaw).  'auto' (default) enables
+    # it for multi-partition sources whose liveness is guaranteed: bounded
+    # sources, or unbounded ones with source_idle_timeout_ms set (quiet
+    # partitions then leave the min instead of stalling it).  True forces
+    # it on, False keeps reference semantics everywhere.
+    partition_watermarks: bool | str = "auto"
 
     # sharding (parallel/): number of devices to shard group-state over;
     # None = single device

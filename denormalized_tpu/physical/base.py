@@ -28,15 +28,43 @@ from denormalized_tpu.common.schema import Schema
 
 @dataclass(frozen=True)
 class WatermarkHint:
-    """Advisory event-time advance from an idle source: no further rows at
-    or before ``ts_ms`` are expected, so stateful operators may close
-    windows/sessions up to it.  Emitted by SourceExec when every partition
-    has been idle for ``EngineConfig.source_idle_timeout_ms`` (the
-    reference — like Kafka consumers generally — simply never closes the
-    last windows of a quiet topic; this is the Flink-style idleness
-    escape hatch, default off).  Stateless operators pass it through."""
+    """Event-time advance from the source.  Two kinds:
+
+    - ``"idle"`` — advisory one-shot from a quiet source: no further rows
+      at or before ``ts_ms`` are expected, so stateful operators may
+      close windows/sessions up to it.  Emitted by SourceExec when every
+      partition has been idle for ``EngineConfig.source_idle_timeout_ms``
+      (the reference — like Kafka consumers generally — simply never
+      closes the last windows of a quiet topic; this is the Flink-style
+      idleness escape hatch, default off).
+    - ``"partition"`` — AUTHORITATIVE per-partition watermark: the min
+      over each partition's own max-of-batch-min-ts (idle partitions
+      excluded).  Operators that see one stop advancing their watermark
+      from raw batch min-ts: the merged stream's global max-of-min races
+      ahead on whichever partition drains fastest and drops the slower
+      partitions' backlog as late (replay/catch-up skew — the reference
+      shares this flaw).  A hint with ``ts_ms <= WM_ANNOUNCE`` is a pure
+      mode announcement carrying no timestamp.
+
+    Stateless operators pass both kinds through."""
 
     ts_ms: int
+    kind: str = "idle"
+
+    @property
+    def is_announcement(self) -> bool:
+        """Pure mode announcement: switches operators to hint-driven
+        watermarks without advancing anything.  Every stateful operator
+        must use THIS check (not its own sentinel comparison) so the
+        rule cannot drift between call sites."""
+        return self.ts_ms <= WM_ANNOUNCE
+
+
+#: mode-announcement sentinel: a kind="partition" hint at or below this
+#: value switches operators to hint-driven watermarks without advancing
+#: anything (emitted before the first batch, closing the startup window
+#: where batch-driven advance could already race ahead)
+WM_ANNOUNCE = -(2**62)
 
 
 @dataclass(frozen=True)

@@ -65,6 +65,7 @@ class _SideState:
         "matched",
         "count",
         "watermark",
+        "src_watermarks",
         "done",
     )
 
@@ -79,6 +80,9 @@ class _SideState:
         self.matched = np.zeros(1024, dtype=bool)
         self.count = 0
         self.watermark: int | None = None
+        # True once this side's source sent a kind="partition" hint:
+        # batch min-ts no longer advances this side's watermark
+        self.src_watermarks = False
         self.done = False
 
     def _ensure_rows(self, n: int) -> None:
@@ -623,7 +627,13 @@ class StreamingJoinExec(ExecOperator):
                 if isinstance(item, BaseException):
                     raise item
                 if isinstance(item, WatermarkHint):
-                    # idle source on this side: advance its watermark so
+                    if item.kind == "partition":
+                        side.src_watermarks = True
+                        if item.is_announcement:
+                            yield item  # pure mode announcement
+                            continue
+                    # watermark advance on this side (idle hint, or the
+                    # side's authoritative per-partition watermark) so
                     # the joint horizon (min of both) can move and retained
                     # rows evict.  Downstream must see the JOINT low
                     # watermark — forwarding this side's ts verbatim would
@@ -644,7 +654,8 @@ class StreamingJoinExec(ExecOperator):
                         # would let downstream late-drop those matches
                         yield WatermarkHint(
                             min(sides[0].watermark, sides[1].watermark)
-                            - self.retention_ms
+                            - self.retention_ms,
+                            kind=item.kind,
                         )
                     continue
                 if isinstance(item, EndOfStream):
@@ -714,9 +725,10 @@ class StreamingJoinExec(ExecOperator):
                 ts = np.asarray(
                     batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
                 )
-                bmin = int(ts.min())
-                if side.watermark is None or bmin > side.watermark:
-                    side.watermark = bmin
+                if not side.src_watermarks:
+                    bmin = int(ts.min())
+                    if side.watermark is None or bmin > side.watermark:
+                        side.watermark = bmin
                 yield from self._evict_horizon(sides)
             # EOS: flush unmatched for outer joins
             for s, l in ((sides[0], True), (sides[1], False)):

@@ -122,6 +122,9 @@ class SessionWindowExec(ExecOperator):
         # per key: open sessions sorted by start (usually exactly one)
         self._sessions: dict[tuple, list[_Session]] = {}
         self._watermark: int | None = None
+        # True once a kind="partition" hint arrived: batch min-ts no
+        # longer advances the watermark (replay-skew safety)
+        self._src_watermarks = False
         self._ckpt: tuple | None = None
         self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
 
@@ -370,8 +373,11 @@ class SessionWindowExec(ExecOperator):
                     acc.update(*chunk)
             self._merge_rows(key, ts_s[b0:b1], partial, partial_accs)
 
-        # watermark advance + close expired sessions
-        yield from self._advance_and_close(raw_min)
+        # watermark advance + close expired sessions — skipped under
+        # per-partition watermarks: the authoritative advance arrives as
+        # a kind="partition" hint right after this batch
+        if not self._src_watermarks:
+            yield from self._advance_and_close(raw_min)
 
     def _advance_and_close(self, candidate_wm: int) -> Iterator[RecordBatch]:
         """Monotonic watermark advance, then emit every session whose gap
@@ -530,6 +536,11 @@ class SessionWindowExec(ExecOperator):
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
             elif isinstance(item, WatermarkHint):
+                if item.kind == "partition":
+                    self._src_watermarks = True
+                    if item.is_announcement:
+                        yield item  # pure mode announcement
+                        continue
                 yield from self._advance_and_close(item.ts_ms)
                 # emissions stamp canonical ts with the session START:
                 # forward clamped below every still-open session's start
@@ -551,7 +562,8 @@ class SessionWindowExec(ExecOperator):
                     min(
                         [item.ts_ms, floor]
                         + [st - 1 for st in open_starts]
-                    )
+                    ),
+                    kind=item.kind,
                 )
             elif isinstance(item, Marker):
                 if self._ckpt is not None:

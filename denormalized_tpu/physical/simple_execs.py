@@ -22,6 +22,7 @@ from denormalized_tpu.common.schema import Schema
 from denormalized_tpu.logical.expr import Expr
 from denormalized_tpu.physical.base import (
     EOS,
+    WM_ANNOUNCE,
     EndOfStream,
     ExecOperator,
     Marker,
@@ -72,6 +73,80 @@ class _IdleTracker:
         return WatermarkHint(self._max_ts)
 
 
+class _PartitionWatermarks:
+    """Per-partition watermark aggregation: the source-level watermark is
+    the MIN over each partition's own max-of-batch-min-ts.  The merged
+    stream's legacy rule (operator watermark = global max of batch
+    min-ts) races ahead on whichever partition drains fastest — during
+    replay/catch-up that drops the slower partitions' entire backlog as
+    late.  Exclusions from the min:
+
+    - finished partitions (bounded EOS or a dead unbounded reader): their
+      constraint lifts permanently;
+    - partitions idle past ``timeout_ms`` (Flink-style idleness) — they
+      re-enter on new rows, and the monotonic emission guard means a
+      resumed partition's OLD rows may drop late, exactly as if idleness
+      had been declared by the idle-hint machinery.
+
+    ``observe``/``advance`` return a kind="partition" WatermarkHint only
+    when the min strictly advances."""
+
+    def __init__(self, n: int, timeout_ms: int | None) -> None:
+        self._wm: list[int | None] = [None] * n
+        self._last_rows = [time.monotonic()] * n
+        self._finished = [False] * n
+        self._timeout_s = (
+            timeout_ms / 1000.0 if timeout_ms is not None else None
+        )
+        self._emitted: int | None = None
+
+    def observe(self, idx: int, batch: RecordBatch) -> WatermarkHint | None:
+        from denormalized_tpu.common.constants import (
+            CANONICAL_TIMESTAMP_COLUMN,
+        )
+
+        bmin = int(
+            np.min(
+                np.asarray(
+                    batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
+                )
+            )
+        )
+        if self._wm[idx] is None or bmin > self._wm[idx]:
+            self._wm[idx] = bmin
+        self._last_rows[idx] = time.monotonic()
+        return self.advance()
+
+    def finish(self, idx: int) -> WatermarkHint | None:
+        self._finished[idx] = True
+        return self.advance()
+
+    def advance(self) -> WatermarkHint | None:
+        now = time.monotonic()
+        vals = []
+        for w, lr, fin in zip(self._wm, self._last_rows, self._finished):
+            if fin:
+                continue
+            idle = (
+                self._timeout_s is not None
+                and now - lr >= self._timeout_s
+            )
+            if w is None:
+                if idle:
+                    continue  # never-produced idle partition: excluded
+                return None  # a live partition hasn't spoken yet
+            if idle:
+                continue
+            vals.append(w)
+        if not vals:
+            return None
+        m = min(vals)
+        if self._emitted is None or m > self._emitted:
+            self._emitted = m
+            return WatermarkHint(m, kind="partition")
+        return None
+
+
 class SourceExec(ExecOperator):
     """Leaf operator: drives every partition of a source and merges their
     batches into one ordered stream.
@@ -90,11 +165,13 @@ class SourceExec(ExecOperator):
         *,
         queue_size: int = 64,
         idle_timeout_ms: int | None = None,
+        partition_watermarks: bool | str = "auto",
     ) -> None:
         self.source = source
         self.schema = source.schema
         self._queue_size = queue_size
         self._idle_timeout_ms = idle_timeout_ms
+        self._partition_watermarks = partition_watermarks
         self._barrier_poll: Callable[[], int | None] | None = None
         self._metrics = {"rows_out": 0, "batches_out": 0}
         self._readers: list | None = None
@@ -169,6 +246,25 @@ class SourceExec(ExecOperator):
             if epoch is not None:
                 yield Marker(epoch)
 
+    def _partition_wm_tracker(self, n_readers: int):
+        """Resolve partition-watermark mode: 'auto' enables it for any
+        multi-partition source whose liveness is guaranteed — bounded
+        (finished partitions leave the min) or unbounded WITH an idle
+        timeout (quiet partitions leave the min).  An unbounded source
+        with no idleness policy keeps legacy max-of-min semantics: a
+        silent partition would otherwise stall the watermark forever."""
+        on = self._partition_watermarks is True or (
+            self._partition_watermarks == "auto"
+            and n_readers > 1
+            and (
+                not self.source.unbounded
+                or self._idle_timeout_ms is not None
+            )
+        )
+        if not on:
+            return None
+        return _PartitionWatermarks(n_readers, self._idle_timeout_ms)
+
     def run(self) -> Iterator[StreamItem]:
         readers = self.source.partitions()
         self._readers = readers
@@ -184,12 +280,17 @@ class SourceExec(ExecOperator):
                 if self.source.unbounded and self._idle_timeout_ms is not None
                 else None
             )
+            pwm = self._partition_wm_tracker(len(readers))
+            if pwm is not None:
+                yield WatermarkHint(WM_ANNOUNCE, kind="partition")
             live = list(enumerate(readers))
             while live:
                 nxt = []
                 for i, r in live:
                     b = r.read()
                     if b is None:
+                        if pwm is not None and (h := pwm.finish(i)):
+                            yield h
                         continue
                     nxt.append((i, r))
                     if b.num_rows:
@@ -199,8 +300,13 @@ class SourceExec(ExecOperator):
                             idle.observe_rows(b)
                         yield b
                         self._yielded_offsets[i] = r.offset_snapshot()
-                    elif idle is not None and (h := idle.maybe_hint()):
-                        yield h
+                        if pwm is not None and (h := pwm.observe(i, b)):
+                            yield h
+                    else:
+                        if idle is not None and (h := idle.maybe_hint()):
+                            yield h
+                        if pwm is not None and (h := pwm.advance()):
+                            yield h
                     yield from self._maybe_barrier()
                 live = nxt
             yield EOS
@@ -219,6 +325,10 @@ class SourceExec(ExecOperator):
                 while not done.is_set():
                     b = reader.read(timeout_s=0.1)
                     if b is None:
+                        # explicit per-reader EOS marker (the pump's
+                        # sentinel doesn't say WHICH reader ended, and
+                        # the partition-watermark min must drop it)
+                        yield (idx, None, None)
                         return
                     yield (idx, reader.offset_snapshot(), b)
 
@@ -236,6 +346,9 @@ class SourceExec(ExecOperator):
             if self._idle_timeout_ms is not None
             else None
         )
+        pwm = self._partition_wm_tracker(len(readers))
+        if pwm is not None:
+            yield WatermarkHint(WM_ANNOUNCE, kind="partition")
         try:
             while finished < len(readers):
                 item = q.get()
@@ -245,6 +358,11 @@ class SourceExec(ExecOperator):
                 if isinstance(item, BaseException):
                     raise item
                 idx, snap, batch = item
+                if batch is None:
+                    # per-reader EOS (dead unbounded reader)
+                    if pwm is not None and (h := pwm.finish(idx)):
+                        yield h
+                    continue
                 self._metrics["rows_out"] += batch.num_rows
                 self._metrics["batches_out"] += 1
                 if idle is not None:
@@ -254,6 +372,14 @@ class SourceExec(ExecOperator):
                         yield h
                 yield batch
                 self._yielded_offsets[idx] = snap
+                if pwm is not None:
+                    h = (
+                        pwm.observe(idx, batch)
+                        if batch.num_rows
+                        else pwm.advance()
+                    )
+                    if h:
+                        yield h
                 yield from self._maybe_barrier()
         finally:
             done.set()

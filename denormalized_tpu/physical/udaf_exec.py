@@ -158,6 +158,9 @@ class UdafWindowExec(ExecOperator):
         self._first_open: int | None = None
         self._max_win_seen = -1
         self._watermark: int | None = None
+        # True once a kind="partition" hint arrived: batch min-ts no
+        # longer advances the watermark (replay-skew safety)
+        self._src_watermarks = False
         self._metrics = {"rows_in": 0, "windows_emitted": 0, "late_rows": 0}
 
     @property
@@ -265,9 +268,10 @@ class UdafWindowExec(ExecOperator):
                     else:
                         acc.update(chunk[0])
 
-        bmin = int(ts.min())
-        if self._watermark is None or bmin > self._watermark:
-            self._watermark = bmin
+        if not self._src_watermarks:
+            bmin = int(ts.min())
+            if self._watermark is None or bmin > self._watermark:
+                self._watermark = bmin
         yield from self._trigger()
 
     def _trigger(self) -> Iterator[RecordBatch]:
@@ -434,6 +438,11 @@ class UdafWindowExec(ExecOperator):
             if isinstance(item, RecordBatch):
                 yield from self._process_batch(item)
             elif isinstance(item, WatermarkHint):
+                if item.kind == "partition":
+                    self._src_watermarks = True
+                    if item.is_announcement:
+                        yield item  # pure mode announcement
+                        continue
                 if self._watermark is None or item.ts_ms > self._watermark:
                     self._watermark = item.ts_ms
                     yield from self._trigger()
@@ -449,7 +458,7 @@ class UdafWindowExec(ExecOperator):
                     self._first_open, self.slide_ms, self.length_ms,
                     item.ts_ms,
                 )
-                yield WatermarkHint(min(item.ts_ms, low))
+                yield WatermarkHint(min(item.ts_ms, low), kind=item.kind)
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)

@@ -48,6 +48,7 @@ from denormalized_tpu.ops import segment_agg as sa
 from denormalized_tpu.ops.interner import GroupInterner
 from denormalized_tpu.physical.base import (
     EOS,
+    WM_ANNOUNCE,
     EndOfStream,
     ExecOperator,
     Marker,
@@ -237,6 +238,10 @@ class StreamingWindowExec(ExecOperator):
         self._first_open: int | None = None  # lowest non-emitted slide index
         self._max_win_seen: int = -1
         self._watermark_ms: int | None = None
+        # True once a kind="partition" hint arrived: the source computes
+        # per-partition watermarks, so raw batch min-ts must NOT advance
+        # the operator watermark (it races ahead on replay skew)
+        self._src_watermarks = False
         # monotone: True once any value column carried a null.  While
         # False, emission gathers skip per-column count planes (they equal
         # the row-count plane) — see _gather_and_reset(lean=True)
@@ -582,10 +587,13 @@ class StreamingWindowExec(ExecOperator):
             )
             self._metrics["device_steps"] += 1
 
-        # watermark: monotonic max of batch min-ts (reference semantics)
-        bmin = int(ts.min())
-        if self._watermark_ms is None or bmin > self._watermark_ms:
-            self._watermark_ms = bmin
+        # watermark: monotonic max of batch min-ts (reference semantics) —
+        # unless the source supplies per-partition watermarks, which
+        # arrive as kind="partition" hints right after their batch
+        if not self._src_watermarks:
+            bmin = int(ts.min())
+            if self._watermark_ms is None or bmin > self._watermark_ms:
+                self._watermark_ms = bmin
         yield from self._trigger()
 
     # -- host pipeline fence --------------------------------------------
@@ -986,6 +994,31 @@ class StreamingWindowExec(ExecOperator):
                 ):
                     yield from self._process_batch(item)
             elif isinstance(item, WatermarkHint):
+                if item.kind == "partition":
+                    # authoritative per-partition watermark: from now on
+                    # batch min-ts must not advance the watermark
+                    self._src_watermarks = True
+                    if item.is_announcement:
+                        yield item  # pure mode announcement
+                        continue
+                    # barrier alignment: a held marker must reach
+                    # downstream before any trigger output this hint
+                    # produces (same invariant as the batch path)
+                    yield from self._release_snapshot()
+                    if (
+                        self._watermark_ms is None
+                        or item.ts_ms > self._watermark_ms
+                    ):
+                        self._watermark_ms = item.ts_ms
+                        # normal trigger: these hints arrive continuously
+                        # (one per advancing batch), so the emit-lag
+                        # deferral keeps working — no force, no drain
+                        yield from self._trigger()
+                    yield WatermarkHint(
+                        min(item.ts_ms, self._output_low_watermark(item.ts_ms)),
+                        kind="partition",
+                    )
+                    continue
                 # idle source: advance event time and close what's ready,
                 # then forward the hint for downstream stateful operators —
                 # CLAMPED below this operator's lowest possible future

@@ -40,6 +40,11 @@ class Planner:
                 )
                 if self.config is not None
                 else None,
+                partition_watermarks=getattr(
+                    self.config, "partition_watermarks", "auto"
+                )
+                if self.config is not None
+                else "auto",
             )
         if isinstance(node, lp.Project):
             child = self.create_physical_plan(node.input)

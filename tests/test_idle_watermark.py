@@ -136,7 +136,7 @@ def test_idle_timeout_closes_sessions(broker):
     # "idleness fired" sync point, making the no-emission assert bounded.
     from denormalized_tpu.common.record_batch import RecordBatch
     from denormalized_tpu.logical import plan as lp
-    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.base import WM_ANNOUNCE, WatermarkHint
     from denormalized_tpu.physical.simple_execs import CollectSink
     from denormalized_tpu.runtime import executor
 
@@ -285,7 +285,7 @@ def test_forwarded_hint_clamped_below_open_windows(broker):
 
     from denormalized_tpu.common.record_batch import RecordBatch
     from denormalized_tpu.logical import plan as lp
-    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.base import WM_ANNOUNCE, WatermarkHint
     from denormalized_tpu.physical.simple_execs import CollectSink
     from denormalized_tpu.runtime import executor
 
@@ -308,7 +308,9 @@ def test_forwarded_hint_clamped_below_open_windows(broker):
             s = int(np.max(item.column("window_start_time")))
             if max_emitted_start is None or s > max_emitted_start:
                 max_emitted_start = s
-        if isinstance(item, WatermarkHint):
+        if isinstance(item, WatermarkHint) and item.ts_ms > WM_ANNOUNCE:
+            # skip the partition-mode announcement: the clamp property
+            # applies to every REAL forwarded hint, idle or partition
             hint_ts = item.ts_ms
             break
         if time.time() > deadline:
@@ -330,7 +332,7 @@ def test_idle_hint_forces_deferred_emission(broker):
     sit unemitted forever."""
     from denormalized_tpu.common.record_batch import RecordBatch
     from denormalized_tpu.logical import plan as lp
-    from denormalized_tpu.physical.base import WatermarkHint
+    from denormalized_tpu.physical.base import WM_ANNOUNCE, WatermarkHint
     from denormalized_tpu.physical.simple_execs import CollectSink
     from denormalized_tpu.runtime import executor
 
@@ -363,7 +365,10 @@ def test_idle_hint_forces_deferred_emission(broker):
             starts |= {
                 int(v) - t0 for v in item.column("window_start_time")
             }
-        if isinstance(item, WatermarkHint):
+        if isinstance(item, WatermarkHint) and item.kind == "idle":
+            # partition-watermark hints flow continuously (and do NOT
+            # force); the IDLE hint is the one that must force the
+            # deferred emission
             hint_ts = item.ts_ms
             break
         if time.time() > deadline:

@@ -1,0 +1,263 @@
+"""Per-partition watermarks (EngineConfig.partition_watermarks).
+
+The legacy rule — operator watermark = monotonic max of each merged
+batch's MIN timestamp (reference RecordBatchWatermark semantics) — races
+ahead on whichever partition drains fastest: during replay/catch-up the
+slower partitions' entire backlog then drops as late.  With per-partition
+watermarks the source emits kind="partition" hints carrying the MIN over
+each partition's own max-of-batch-min-ts, and stateful operators advance
+only on those."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.physical.base import WM_ANNOUNCE, WatermarkHint
+from denormalized_tpu.physical.simple_execs import CollectSink
+from denormalized_tpu.runtime import executor
+from denormalized_tpu.runtime.tracing import collect_metrics
+from denormalized_tpu.sources.memory import MemorySource
+
+T0 = 1_700_000_000_000
+
+_SCHEMA = Schema([
+    Field("occurred_at_ms", DataType.INT64, nullable=False),
+    Field("sensor_name", DataType.STRING, nullable=False),
+    Field("reading", DataType.FLOAT64),
+])
+
+
+def _batch(ts, names, vals):
+    return RecordBatch(
+        _SCHEMA,
+        [np.asarray(ts, np.int64),
+         np.asarray(names, object),
+         np.asarray(vals, np.float64)],
+    )
+
+
+def _span_batch(ms_lo, ms_hi, key, step=1):
+    ts = np.arange(T0 + ms_lo, T0 + ms_hi, step, dtype=np.int64)
+    return _batch(ts, [key] * len(ts), np.ones(len(ts)))
+
+
+def _counts(ds):
+    got = {}
+    for b in ds.stream():
+        if not b.schema.has("window_start_time"):
+            continue
+        for i in range(b.num_rows):
+            k = (int(b.column("window_start_time")[i]) - T0,
+                 str(b.column("sensor_name")[i]))
+            got[k] = got.get(k, 0) + int(b.column("c")[i])
+    return got
+
+
+def _window_metrics(ctx):
+    mets = collect_metrics(ctx._last_physical)
+    return next(m for k, m in mets.items() if "Window" in k)
+
+
+def _skewed_source():
+    """Both partitions cover [0,4000)ms, but partition 0 advances event
+    time at 1000ms per batch while partition 1 advances at 500ms per
+    batch.  Round-robin reads one batch per partition per cycle, so
+    after partition 0 exhausts, the legacy max-of-min watermark sits at
+    3000 while partition 1 still owes [2000,4000) — its [2000,3000) rows
+    are then behind a closable window and drop as late."""
+    p0 = [_span_batch(lo, lo + 1000, "a") for lo in range(0, 4000, 1000)]
+    p1 = [_span_batch(lo, lo + 500, "b") for lo in range(0, 4000, 500)]
+    return MemorySource([p0, p1], timestamp_column="occurred_at_ms")
+
+
+def test_bounded_skew_exact_with_partition_watermarks():
+    ctx = Context(EngineConfig())  # 'auto': ON for bounded multi-partition
+    ds = ctx.from_source(_skewed_source()).window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 1000
+    )
+    got = _counts(ds)
+    for w in range(0, 4000, 1000):
+        assert got.get((w, "a")) == 1000, (w, got.get((w, "a")))
+        assert got.get((w, "b")) == 1000, (w, got.get((w, "b")))
+    assert _window_metrics(ctx).get("late_rows", 0) == 0
+
+
+def test_bounded_skew_drops_under_legacy_semantics():
+    """The flaw the feature fixes must be demonstrable: with
+    partition_watermarks=False the same skewed source late-drops most of
+    partition 1's rows."""
+    ctx = Context(EngineConfig(partition_watermarks=False))
+    ds = ctx.from_source(_skewed_source()).window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 1000
+    )
+    got = _counts(ds)
+    # partition 0 is complete either way
+    for w in range(0, 4000, 1000):
+        assert got.get((w, "a")) == 1000
+    assert _window_metrics(ctx)["late_rows"] > 0
+    assert sum(v for (w, k), v in got.items() if k == "b") < 4000
+
+
+def test_kafka_catchup_skew_no_drops(broker_factory=None):
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("skew", partitions=2)
+        mk = lambda lo, hi: [
+            json.dumps({"occurred_at_ms": T0 + ms, "sensor_name": "x",
+                        "reading": 1.0}).encode()
+            for ms in range(lo, hi)
+        ]
+        # partition 0: full backlog available immediately
+        broker.produce_batched("skew", 0, mk(0, 4000))
+
+        def slow_feed():
+            # partition 1 stays ACTIVE (never idle-excluded) but trails
+            # far behind in event time — the catch-up shape: p0 drains
+            # instantly while p1's backlog arrives over ~1.2s.  Under
+            # legacy max-of-min, p0's drain would put the watermark at
+            # ~3500 and everything p1 later delivers below that would
+            # drop as late.
+            for lo in range(0, 4000, 500):
+                broker.produce_batched("skew", 1, mk(lo, lo + 500))
+                time.sleep(0.15)
+
+        threading.Thread(target=slow_feed, daemon=True).start()
+        ctx = Context(EngineConfig(source_idle_timeout_ms=500))
+        sample = json.dumps(
+            {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0}
+        )
+        ds = ctx.from_topic(
+            "skew", sample, broker.bootstrap, "occurred_at_ms"
+        ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+        got = {}
+        deadline = time.time() + 25
+        it = ds.stream()
+        for b in it:
+            for i in range(b.num_rows):
+                k = int(b.column("window_start_time")[i]) - T0
+                got[k] = got.get(k, 0) + int(b.column("c")[i])
+            # both partitions contribute 1000 rows per window; the
+            # final window [3000,4000) can never close (max ts 3999),
+            # so only the first three are required
+            if all(got.get(w) == 2000 for w in range(0, 3000, 1000)):
+                it.close()
+                break
+            if time.time() > deadline:
+                it.close()
+                break
+        assert all(
+            got.get(w) == 2000 for w in range(0, 3000, 1000)
+        ), got
+        assert _window_metrics(ctx).get("late_rows", 0) == 0
+    finally:
+        broker.stop()
+
+
+def test_empty_partition_does_not_stall(broker_factory=None):
+    """A partition that never produces is excluded from the min after the
+    idle timeout — windows over the active partition still close."""
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("halfquiet", partitions=2)
+
+        def feed():
+            for chunk in range(4):
+                msgs = [
+                    json.dumps({"occurred_at_ms": T0 + chunk * 800 + i,
+                                "sensor_name": "k", "reading": 1.0}).encode()
+                    for i in range(0, 800, 2)
+                ]
+                broker.produce("halfquiet", 0, msgs, ts_ms=T0)
+                time.sleep(0.1)
+
+        threading.Thread(target=feed, daemon=True).start()
+        ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+        sample = json.dumps(
+            {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0}
+        )
+        ds = ctx.from_topic(
+            "halfquiet", sample, broker.bootstrap, "occurred_at_ms"
+        ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+        got = {}
+        deadline = time.time() + 20
+        it = ds.stream()
+        for b in it:
+            for i in range(b.num_rows):
+                got[int(b.column("window_start_time")[i]) - T0] = int(
+                    b.column("c")[i]
+                )
+            if {0, 1000, 2000} <= set(got) or time.time() > deadline:
+                it.close()
+                break
+        assert {0, 1000, 2000} <= set(got), got
+    finally:
+        broker.stop()
+
+
+def test_unbounded_without_idle_keeps_legacy_semantics():
+    """'auto' must NOT enable partition watermarks for an unbounded
+    source with no idleness policy: a silent partition would stall the
+    watermark forever.  No kind="partition" hint may appear."""
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("nohints", partitions=2)
+
+        def feed():
+            # trickled rising chunks: each fetch's min-ts climbs, so the
+            # legacy max-of-min watermark advances and window 0 closes
+            for chunk in range(4):
+                for p in (0, 1):
+                    broker.produce(
+                        "nohints", p,
+                        [json.dumps({"occurred_at_ms": T0 + chunk * 800 + i,
+                                     "sensor_name": "k",
+                                     "reading": 1.0}).encode()
+                         for i in range(800)],
+                        ts_ms=T0,
+                    )
+                time.sleep(0.15)
+
+        threading.Thread(target=feed, daemon=True).start()
+        ctx = Context(EngineConfig())  # no idle timeout
+        sample = json.dumps(
+            {"occurred_at_ms": 1, "sensor_name": "a", "reading": 1.0}
+        )
+        ds = ctx.from_topic(
+            "nohints", sample, broker.bootstrap, "occurred_at_ms"
+        ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+        root = executor.build_physical(
+            lp.Sink(ds._plan, CollectSink()), ds._ctx
+        )
+        gen = root.run()
+        saw_partition_hint = False
+        emitted = False
+        deadline = time.time() + 10
+        for item in gen:
+            if isinstance(item, WatermarkHint) and item.kind == "partition":
+                saw_partition_hint = True
+                break
+            if isinstance(item, RecordBatch) and item.num_rows:
+                emitted = True
+                break
+            if time.time() > deadline:
+                break
+        gen.close()
+        assert not saw_partition_hint
+        assert emitted  # legacy max-of-min closed window 0
+    finally:
+        broker.stop()
