@@ -190,8 +190,26 @@ class UdafWindowExec(ExecOperator):
         S = self.slide_ms
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         units = ts // S
+        anchor = int(units.min()) - self._k + 1
         if self._first_open is None:
-            self._first_open = int(units.min()) - self._k + 1
+            self._first_open = anchor
+        elif self._src_watermarks and anchor < self._first_open:
+            # per-partition watermarks: a slower partition's earlier
+            # windows stay legitimate until the min-driven watermark
+            # closes them (frames are host dicts keyed by absolute
+            # window index, so lowering the cursor just re-admits them);
+            # triggers advance first_open exactly to the wm floor, so
+            # anything below it was genuinely closed and stays late
+            from denormalized_tpu.physical.window_exec import (
+                watermark_floor,
+            )
+
+            wm_floor = (
+                watermark_floor(self._watermark, self.length_ms, self.slide_ms)
+                if self._watermark is not None
+                else anchor
+            )
+            self._first_open = max(anchor, int(wm_floor))
         self._max_win_seen = max(self._max_win_seen, int(units.max()))
 
         if self._interner is not None:
@@ -457,6 +475,7 @@ class UdafWindowExec(ExecOperator):
                 low = window_output_low_watermark(
                     self._first_open, self.slide_ms, self.length_ms,
                     item.ts_ms,
+                    wm_ms=self._watermark if self._src_watermarks else None,
                 )
                 yield WatermarkHint(min(item.ts_ms, low), kind=item.kind)
             elif isinstance(item, Marker):

@@ -68,8 +68,18 @@ def _round_capacity(g: int, n_dev: int) -> int:
     return -(-g // unit) * unit
 
 
+def watermark_floor(wm_ms: int, length_ms: int, slide_ms: int) -> int:
+    """First slide index NOT closed by watermark ``wm_ms`` — the exact
+    point triggers advance ``first_open`` to, the floor the per-partition
+    rebase may lower it back to, and the basis of ``_closable``.  One
+    definition for all three so the trigger/rebase parity invariant is
+    enforced by code, not comments."""
+    return (wm_ms - length_ms) // slide_ms + 1
+
+
 def window_output_low_watermark(
-    first_open: int | None, slide_ms: int, length_ms: int, hint_ts: int
+    first_open: int | None, slide_ms: int, length_ms: int, hint_ts: int,
+    wm_ms: int | None = None,
 ) -> int:
     """Strict lower bound (minus one) on the start of any window a
     slide/length windowed operator can still emit, given no further input
@@ -77,9 +87,19 @@ def window_output_low_watermark(
     open slot's start; with none, the earliest window a future row
     (> hint_ts) could land in.  Shared by StreamingWindowExec and
     UdafWindowExec — the forwarded WatermarkHint clamp must stay
-    identical in both."""
+    identical in both.
+
+    Under per-partition watermarks ``first_open`` is NOT monotone: a
+    slower partition's earlier windows may rebase it down to the
+    watermark floor later, so the promise must already account for that
+    — pass ``wm_ms`` and the bound uses min(first_open, floor)."""
     if first_open is not None:
-        return first_open * slide_ms - 1
+        low_first = first_open
+        if wm_ms is not None:
+            low_first = min(
+                low_first, watermark_floor(wm_ms, length_ms, slide_ms)
+            )
+        return low_first * slide_ms - 1
     min_future_start = ((hint_ts + 1 - length_ms) // slide_ms + 1) * slide_ms
     return min_future_start - 1
 
@@ -404,9 +424,40 @@ class StreamingWindowExec(ExecOperator):
         units, rem64 = np.divmod(ts, S)  # one pass for quotient+remainder
         rem = rem64.astype(np.int32)
 
+        anchor = int(units.min()) - self._spec.length_units + 1
         if self._first_open is None:
             # windows overlapping the first data: back to units.min() - k + 1
-            self._first_open = int(units.min()) - self._spec.length_units + 1
+            self._first_open = anchor
+        elif self._src_watermarks and anchor < self._first_open:
+            # per-partition watermarks: the first batch anchored first_open
+            # to ITS partition's windows, but a slower partition's earlier
+            # windows are still legitimate until the (min-driven) watermark
+            # closes them.  Rebase down to the watermark floor — the ring
+            # addresses slots by absolute window index, so this only
+            # widens the logical span (capacity grows below).  Triggers
+            # advance first_open exactly to the wm floor, so anything
+            # below it was genuinely closed and stays late.
+            wm_floor = (
+                watermark_floor(
+                    self._watermark_ms, self.length_ms, self.slide_ms
+                )
+                if self._watermark_ms is not None
+                else anchor
+            )
+            new_first = max(anchor, int(wm_floor))
+            if new_first < self._first_open:
+                if self._backend.accumulates_host:
+                    # the pending stripe's units are relative to the OLD
+                    # first_open (via its captured base_mod) — fold it
+                    # into the device ring before the base moves
+                    self._flush()
+                self._first_open = new_first
+                # the live span now runs new_first.._max_win_seen; the
+                # per-batch capacity check below only sees THIS batch's
+                # relative max, so grow here or a re-admitted low window
+                # and a live high window collide on the same ring slot
+                if self._max_win_seen >= 0:
+                    self._ensure_capacity(self._max_win_seen - new_first)
         first = self._first_open
         win_rel64 = units - first
         self._max_win_seen = max(self._max_win_seen, int(units.max()))
@@ -646,14 +697,17 @@ class StreamingWindowExec(ExecOperator):
 
     def _output_low_watermark(self, hint_ts: int) -> int:
         return window_output_low_watermark(
-            self._first_open, self.slide_ms, self.length_ms, hint_ts
+            self._first_open, self.slide_ms, self.length_ms, hint_ts,
+            wm_ms=self._watermark_ms if self._src_watermarks else None,
         )
 
     # -- emission --------------------------------------------------------
     def _closable(self) -> int:
         if self._watermark_ms is None or self._first_open is None:
             return 0
-        wm_win = (self._watermark_ms - self.length_ms) // self.slide_ms + 1
+        wm_win = watermark_floor(
+            self._watermark_ms, self.length_ms, self.slide_ms
+        )
         return max(0, int(wm_win) - self._first_open)
 
     def _drain_pending(self) -> Iterator[RecordBatch]:

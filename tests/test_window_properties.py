@@ -295,3 +295,101 @@ def test_partial_merge_finals_matches_oracle(case, finals):
         np.testing.assert_allclose(mx, max(vals), rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(av, np.mean(vals), rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(s, np.sum(vals), rtol=1e-5, atol=1e-5)
+
+
+# -- per-partition watermarks: lossless ordered-partition replay ----------
+
+
+@st.composite
+def partitioned_case(draw):
+    """2-3 partitions, each a time-ORDERED batch stream (batch spans
+    never overlap within a partition) with arbitrary cross-partition
+    skew in how fast event time advances."""
+    L = draw(st.sampled_from([100, 250, 1000]))
+    S = draw(st.sampled_from([None, 100, 300]))
+    if S is not None and S > L:
+        S = L
+    n_parts = draw(st.integers(2, 3))
+    parts = []
+    for _ in range(n_parts):
+        n_batches = draw(st.integers(1, 5))
+        pos = draw(st.integers(0, 400))
+        batches = []
+        for _ in range(n_batches):
+            span = draw(st.integers(1, 900))
+            n = draw(st.integers(1, 20))
+            offs = draw(
+                st.lists(st.integers(0, span - 1), min_size=n, max_size=n)
+            )
+            ts = sorted(T0 + pos + o for o in offs)
+            ks = draw(
+                st.lists(
+                    st.sampled_from(["a", "b", "c"]), min_size=n, max_size=n
+                )
+            )
+            vs = [float(i % 5) for i in range(n)]
+            batches.append((ts, ks, vs))
+            pos += span + draw(st.integers(0, 200))
+        parts.append(batches)
+    return L, S, parts
+
+
+@settings(max_examples=40, deadline=None)
+@given(partitioned_case())
+def test_partitioned_replay_is_lossless(case):
+    """With per-partition watermarks (auto-on for bounded multi-partition
+    sources), NO row of a time-ordered partition can ever drop late —
+    regardless of cross-partition skew — so the result must equal the
+    full groupby over all rows.  Under legacy max-of-min semantics the
+    same cases drop rows whenever one partition's event time runs ahead
+    (test_partition_watermarks.py demonstrates that with a fixed case)."""
+    L, S, parts = case
+    Sx = S or L
+    part_batches = [
+        [
+            RecordBatch(
+                SCHEMA,
+                [
+                    np.asarray(ts, np.int64),
+                    np.asarray(ks, object),
+                    np.asarray(vs),
+                ],
+            )
+            for ts, ks, vs in p
+        ]
+        for p in parts
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(
+            MemorySource(part_batches, timestamp_column="ts")
+        )
+        .window(
+            ["k"],
+            [F.count(col("v")).alias("cnt"), F.sum(col("v")).alias("s")],
+            L,
+            S,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        key = (int(res.column(WINDOW_START_COLUMN)[i]), res.column("k")[i])
+        c, s_ = got.get(key, (0, 0.0))
+        got[key] = (c + int(res.column("cnt")[i]),
+                    s_ + float(res.column("s")[i]))
+    want = collections.defaultdict(lambda: [0, 0.0])
+    for p in parts:
+        for ts, ks, vs in p:
+            for t, k, v in zip(ts, ks, vs):
+                j = t // Sx
+                while j * Sx + L > t:
+                    if j * Sx <= t:
+                        want[(j * Sx, k)][0] += 1
+                        want[(j * Sx, k)][1] += v
+                    j -= 1
+    assert set(got) == set(want), sorted(set(got) ^ set(want))[:5]
+    for key, (c, s_) in want.items():
+        gc_, gs = got[key]
+        assert gc_ == c, (key, gc_, c)
+        np.testing.assert_allclose(gs, s_, rtol=1e-6, atol=1e-6)
