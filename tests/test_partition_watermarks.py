@@ -261,3 +261,78 @@ def test_unbounded_without_idle_keeps_legacy_semantics():
         assert emitted  # legacy max-of-min closed window 0
     finally:
         broker.stop()
+
+
+def test_session_windows_survive_partition_skew():
+    """SessionWindowExec's batch-driven advance is suppressed under
+    partition hints: a fast partition must not close (and late-drop) a
+    slow partition's still-active sessions.  Partition 0 covers
+    [0,4000)ms quickly; partition 1 delivers a session at [100,400]ms in
+    batches that arrive AFTER p0's event time has raced far past the
+    session gap — under legacy max-of-min those rows would close as
+    dropped-late singletons."""
+    p0 = [
+        _span_batch(lo, lo + 1000, "fast", step=50)
+        for lo in range(0, 4000, 1000)
+    ]
+    # slow partition: one session's rows split across 4 batches (ordered)
+    p1 = [
+        _batch([T0 + t], ["slow"], [1.0])
+        for t in (100, 200, 300, 400)
+    ]
+    ctx = Context(EngineConfig())
+    ds = ctx.from_source(
+        MemorySource([p0, p1], timestamp_column="occurred_at_ms")
+    ).session_window(
+        ["sensor_name"],
+        [F.count(col("reading")).alias("c")],
+        gap_ms=200,
+    )
+    got = _counts(ds)
+    # the slow partition's 4 rows form ONE session [100,400] (gap 200) —
+    # not four dropped/singleton fragments (legacy max-of-min measured
+    # exactly that: {('slow', 100): 1})
+    assert got.get((100, "slow")) == 4, got
+
+
+def test_join_sides_survive_partition_skew():
+    """Each join side latches src_watermarks independently: a multi-partition
+    skewed build side must not evict rows the slow partition still
+    owes matches for."""
+    # left side: 2 partitions, skewed exactly like the window test
+    left_src = _skewed_source()
+    # right side: single partition covering the same range
+    right = [_span_batch(0, 4000, "a", step=100)]
+    right_src = MemorySource([right], timestamp_column="occurred_at_ms")
+    ctx = Context(EngineConfig())
+    lds = ctx.from_source(left_src, name="pl").window(
+        ["sensor_name"], [F.count(col("reading")).alias("lc")], 1000
+    )
+    rds = (
+        ctx.from_source(right_src, name="pr")
+        .window(["sensor_name"], [F.count(col("reading")).alias("rc")], 1000)
+        .with_column_renamed("sensor_name", "rs")
+        .with_column_renamed("window_start_time", "rws")
+        .with_column_renamed("window_end_time", "rwe")
+    )
+    res = lds.join(
+        rds, "inner", ["sensor_name", "window_start_time"], ["rs", "rws"]
+    ).collect()
+    got = {}
+    for i in range(res.num_rows):
+        got[(str(res.column("sensor_name")[i]),
+             int(res.column("window_start_time")[i]) - T0)] = (
+            int(res.column("lc")[i]), int(res.column("rc")[i]),
+        )
+    # the left side's slow partition 'b' keeps every window (1000 rows
+    # each); key 'a' joins with the right side's 10 rows per window
+    for w in range(0, 4000, 1000):
+        assert got.get(("a", w)) == (1000, 10), (w, got.get(("a", w)))
+    # teeth for the slow partition (the inner join filters key 'b' out of
+    # the OUTPUT, so assert at the operator level): nothing anywhere in
+    # the plan late-dropped, i.e. partition 'b''s windows were all
+    # legitimate when they reached the join's left window operator
+    mets = collect_metrics(ctx._last_physical)
+    assert sum(m.get("late_rows", 0) for m in mets.values()) == 0, {
+        k: m.get("late_rows") for k, m in mets.items() if m.get("late_rows")
+    }
