@@ -451,13 +451,21 @@ class StreamingWindowExec(ExecOperator):
                     # first_open (via its captured base_mod) — fold it
                     # into the device ring before the base moves
                     self._flush()
+                # the widened span (new_first.._max_win_seen) needs ring
+                # capacity, and the grow must run BEFORE the base moves:
+                # _grow attributes old ring slots to windows
+                # first_open..first_open+old_W-1, so lowering first would
+                # alias a re-admitted low window with a live high one and
+                # the remap would credit the high window's accumulators
+                # to the low one (found by hypothesis: L=1000/S=100,
+                # span 17 over a 16-slot ring lost window 7's content).
+                # No sentinel guard: reaching this branch means a batch
+                # was seen, and _max_win_seen's -1 floor (negative
+                # event-time streams pin it there) only OVERestimates
+                # the span — a larger-than-needed grow is safe, a
+                # skipped one aliases slots.
+                self._ensure_capacity(self._max_win_seen - new_first)
                 self._first_open = new_first
-                # the live span now runs new_first.._max_win_seen; the
-                # per-batch capacity check below only sees THIS batch's
-                # relative max, so grow here or a re-admitted low window
-                # and a live high window collide on the same ring slot
-                if self._max_win_seen >= 0:
-                    self._ensure_capacity(self._max_win_seen - new_first)
         first = self._first_open
         win_rel64 = units - first
         self._max_win_seen = max(self._max_win_seen, int(units.max()))

@@ -334,7 +334,7 @@ def partitioned_case(draw):
     return L, S, parts
 
 
-@settings(max_examples=40, deadline=None)
+@settings(max_examples=80, deadline=None)
 @given(partitioned_case())
 def test_partitioned_replay_is_lossless(case):
     """With per-partition watermarks (auto-on for bounded multi-partition
