@@ -125,3 +125,105 @@ def test_session_engine_matches_oracle(case):
         sorted(set(got) ^ set(want))[:4],
         gap,
     )
+
+
+# -- per-partition watermarks: lossless partitioned session replay --------
+
+
+@st.composite
+def partitioned_session_case(draw):
+    """2-3 time-ordered partitions with arbitrary skew.  All timestamps
+    are EVEN and gaps ODD: the engine's close-at-``last+gap <= wm``
+    boundary vs the merge-at-``t-last <= gap`` rule makes behavior at
+    exact equality arrival-order dependent, and the union oracle below
+    is order-free — the even/odd split keeps the property exact."""
+    gap = draw(st.sampled_from([101, 301, 701]))
+    n_parts = draw(st.integers(2, 3))
+    parts = []
+    for _ in range(n_parts):
+        n_batches = draw(st.integers(1, 4))
+        pos = draw(st.integers(0, 300))
+        batches = []
+        for _ in range(n_batches):
+            span = draw(st.integers(1, 400))
+            n = draw(st.integers(1, 12))
+            offs = draw(
+                st.lists(st.integers(0, span), min_size=n, max_size=n)
+            )
+            ts = sorted(T0 + 2 * (pos + o) for o in offs)
+            ks = draw(
+                st.lists(st.sampled_from(["a", "b"]), min_size=n, max_size=n)
+            )
+            vs = [float(i % 5) for i in range(n)]
+            batches.append((ts, ks, vs))
+            pos += span + draw(st.integers(1, 150))
+        parts.append(batches)
+    return gap, parts
+
+
+@settings(max_examples=40, deadline=None)
+@given(partitioned_session_case())
+def test_partitioned_session_replay_is_lossless(case):
+    """With per-partition watermarks (auto-on for bounded multi-partition
+    sources) no row of a time-ordered partition can drop late, so the
+    emitted sessions must equal classic interval merging over the UNION
+    of all partitions' rows — regardless of cross-partition skew."""
+    gap, parts = case
+    part_batches = [
+        [
+            RecordBatch(
+                SCHEMA,
+                [
+                    np.asarray(ts, np.int64),
+                    np.asarray(ks, object),
+                    np.asarray(vs),
+                ],
+            )
+            for ts, ks, vs in p
+        ]
+        for p in parts
+    ]
+    ctx = Context()
+    res = (
+        ctx.from_source(MemorySource(part_batches, timestamp_column="ts"))
+        .session_window(
+            ["k"],
+            [F.count(col("v")).alias("cnt"), F.sum(col("v")).alias("s")],
+            gap_ms=gap,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        got[(res.column("k")[i], int(res.column("window_start_time")[i]))] = (
+            int(res.column("window_end_time")[i]),
+            int(res.column("cnt")[i]),
+            round(float(res.column("s")[i]), 4),
+        )
+    # union oracle: interval merging per key over ALL rows
+    rows_by_key: dict[str, list] = {}
+    for p in parts:
+        for ts, ks, vs in p:
+            for t, k, v in zip(ts, ks, vs):
+                rows_by_key.setdefault(k, []).append((t, v))
+    want = {}
+    for k, rows in rows_by_key.items():
+        rows.sort()
+        seg = [rows[0]]
+        for t, v in rows[1:]:
+            if t - seg[-1][0] <= gap:
+                seg.append((t, v))
+            else:
+                want[(k, seg[0][0])] = (
+                    seg[-1][0] + gap, len(seg),
+                    round(sum(x[1] for x in seg), 4),
+                )
+                seg = [(t, v)]
+        want[(k, seg[0][0])] = (
+            seg[-1][0] + gap, len(seg),
+            round(sum(x[1] for x in seg), 4),
+        )
+    assert got == want, {
+        "extra": {k: v for k, v in got.items() if want.get(k) != v},
+        "missing": {k: v for k, v in want.items() if got.get(k) != v},
+    }
