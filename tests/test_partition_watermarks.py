@@ -407,3 +407,48 @@ def test_partitioned_join_replay_is_lossless(seed):
         "missing": {k: v for k, v in expect.items() if got.get(k) != v},
         "extra": {k: v for k, v in got.items() if expect.get(k) != v},
     }
+
+
+def test_udaf_window_survives_partition_skew():
+    """The UDAF window exec has the same first_open rebase path as the
+    device window — a slower partition's earlier windows must re-admit
+    into its host frames instead of dropping late."""
+    from denormalized_tpu.api.udaf import Accumulator
+    from denormalized_tpu.common.schema import DataType
+
+    class CountAcc(Accumulator):
+        def __init__(self):
+            self.n = 0
+
+        def update(self, values):
+            self.n += len(values)
+
+        def merge(self, states):
+            self.n += states[0]
+
+        def state(self):
+            return [self.n]
+
+        def evaluate(self):
+            return float(self.n)
+
+    my_count = F.udaf(CountAcc, DataType.FLOAT64, "my_count")
+    ctx = Context(EngineConfig())
+    res = (
+        ctx.from_source(_skewed_source())
+        .window(
+            ["sensor_name"],
+            [my_count(col("reading")).alias("c")],
+            1000,
+        )
+        .collect()
+    )
+    got = {}
+    for i in range(res.num_rows):
+        got[(int(res.column("window_start_time")[i]) - T0,
+             str(res.column("sensor_name")[i]))] = int(
+            float(res.column("c")[i])
+        )
+    for w in range(0, 4000, 1000):
+        assert got.get((w, "a")) == 1000, (w, got.get((w, "a")))
+        assert got.get((w, "b")) == 1000, (w, got.get((w, "b")))
