@@ -452,3 +452,21 @@ def test_udaf_window_survives_partition_skew():
     for w in range(0, 4000, 1000):
         assert got.get((w, "a")) == 1000, (w, got.get((w, "a")))
         assert got.get((w, "b")) == 1000, (w, got.get((w, "b")))
+
+
+@pytest.mark.parametrize("strategy", ["key_sharded", "partial_final"])
+def test_sharded_state_survives_partition_skew(strategy):
+    """Partition watermarks compose with device-sharded window state:
+    hints drive the watermark while the 8-device mesh shards the ring —
+    the skewed replay must stay lossless on every layout."""
+    ctx = Context(
+        EngineConfig(mesh_devices=8, shard_strategy=strategy)
+    )
+    ds = ctx.from_source(_skewed_source()).window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 1000
+    )
+    got = _counts(ds)
+    for w in range(0, 4000, 1000):
+        assert got.get((w, "a")) == 1000, (strategy, w, got.get((w, "a")))
+        assert got.get((w, "b")) == 1000, (strategy, w, got.get((w, "b")))
+    assert _window_metrics(ctx).get("late_rows", 0) == 0
