@@ -266,3 +266,17 @@ def test_datafusion_import_shim(make_batch):
         .collect()
     )
     assert out.num_rows == 1 and str(out.column("sensor_name")[0]) == "b"
+
+
+def test_catchup_replay_example():
+    out = _run_example("catchup_replay.py", 120)
+    assert "late-dropped rows: 0" in out, out[-500:]
+    assert "slow= 25000" in out, out[-500:]
+
+
+def test_catchup_replay_example_legacy_mode_drops():
+    out = _run_example("catchup_replay.py", 120, "--legacy")
+    assert "legacy max-of-min" in out, out[-500:]
+    # the demo's point: the reference-semantics replay silently loses
+    # the slow partition's rows
+    assert "late-dropped rows: 0" not in out, out[-500:]
