@@ -79,6 +79,21 @@ def main():
         "A/B report is useless as chip evidence, so the default is to "
         "exit 4 when the tunnel is down and let an outer loop retry)",
     )
+    ap.add_argument(
+        "--quick-rows", type=int, default=300_000,
+        help="row count for the quick evidence tier: before the full "
+        "matrix, one reduced-rows partial_merge cell per config runs "
+        "with no latency phase and no kill-recovery, so the first "
+        "banked device=tpu cell costs seconds past compile rather than "
+        "minutes (round-4's one relay window died with zero cells)",
+    )
+    ap.add_argument(
+        "--no-quick", action="store_true", help="skip the quick tier",
+    )
+    ap.add_argument(
+        "--quick-only", action="store_true",
+        help="run ONLY the quick tier (smoke / first-evidence mode)",
+    )
     args = ap.parse_args()
     strategies = args.strategies.split(",")
     compaction = [False, True] if args.compaction else [False]
@@ -113,17 +128,24 @@ def main():
                         c.get("emission_compaction", False),
                         c.get("host_pipeline", False),
                         c.get("device_finalize", True),
+                        c.get("quick", False),
                     ))
         except Exception as e:
             print(f"resume: could not read {args.out}: {e!r}", flush=True)
 
-    def run_cell(config, strategy, compact, pipeline, finals=True):
+    def run_cell(config, strategy, compact, pipeline, finals=True,
+                 quick=False):
         cell = {
             "config": config,
             "strategy": strategy,
             "emission_compaction": compact,
             "host_pipeline": pipeline,
             "device_finalize": finals,
+            "quick": quick,
+            # per-cell scale: the top-level rows/lat_rows describe only
+            # full cells, so each cell records what it actually ran
+            "rows": args.quick_rows if quick else args.rows,
+            "lat_rows_run": 0 if quick else args.lat_rows,
         }
         t0 = time.time()
         # a wedged device op cannot be cancelled from inside the process:
@@ -153,8 +175,11 @@ def main():
             compaction=compact,
             host_pipeline=pipeline,
             device_finalize=finals,
-            rows=args.rows,
-            lat_rows=args.lat_rows,
+            rows=args.quick_rows if quick else args.rows,
+            # quick tier: lat_rows=0 skips the latency phase entirely (a
+            # second compiled shape); kill_recovery off for the same reason
+            lat_rows=0 if quick else args.lat_rows,
+            kill_recovery=not quick,
             # run_config re-derives highcard keys/batch from env; reset
             # the generic defaults for every other cell
             keys=int(os.environ.get("BENCH_KEYS", 10)),
@@ -191,26 +216,38 @@ def main():
         )
 
     specs = []
-    for config in args.configs.split(","):
-        for strategy in strategies:
-            variants = [(c, False, True) for c in compaction]
-            if strategy == "partial_merge":
-                if args.host_pipeline:
-                    variants.append((False, True, True))
-                if args.finals_ab:
-                    variants.append((False, False, False))
-            for compact, pipeline, finals in variants:
-                specs.append((config, strategy, compact, pipeline, finals))
+    if not args.no_quick:
+        # quick evidence tier: one tiny partial_merge cell per config, run
+        # before everything else — the first banked device=tpu cell must
+        # cost seconds, not minutes, on a tunnel that flaps in ~60s windows
+        for config in args.configs.split(","):
+            specs.append((config, "partial_merge", False, False, True, True))
+    if not args.quick_only:
+        for config in args.configs.split(","):
+            for strategy in strategies:
+                variants = [(c, False, True) for c in compaction]
+                if strategy == "partial_merge":
+                    if args.host_pipeline:
+                        variants.append((False, True, True))
+                    if args.finals_ab:
+                        variants.append((False, False, False))
+                for compact, pipeline, finals in variants:
+                    specs.append(
+                        (config, strategy, compact, pipeline, finals, False)
+                    )
 
     def _prio(spec):
-        """Coverage-first ordering for a flapping tunnel: the judge bar is
-        an artifact covering ALL FIVE configs, so the five partial_merge
-        base cells (the auto-selected headline strategy) run before any
-        second strategy, which runs before the pipeline/finals variants.
-        Within a tier, keep the BASELINE config order."""
-        config, strategy, compact, pipeline, finals = spec
+        """Coverage-first ordering for a flapping tunnel: the quick
+        evidence tier runs first (tiny cells, all five configs), then the
+        five full-size partial_merge base cells (the auto-selected
+        headline strategy) before any second strategy, which runs before
+        the pipeline/finals variants.  Within a tier, keep the BASELINE
+        config order."""
+        config, strategy, compact, pipeline, finals, quick = spec
         variant = compact or pipeline or not finals
-        if strategy == "partial_merge" and not variant:
+        if quick:
+            tier = -1
+        elif strategy == "partial_merge" and not variant:
             tier = 0
         elif not variant:
             tier = 1
@@ -223,19 +260,26 @@ def main():
         )
         return (tier, cfg_rank, strat_rank)
 
-    for config, strategy, compact, pipeline, finals in sorted(specs, key=_prio):
-        if (config, strategy, compact, pipeline, finals) in done_keys:
-            print(f"== {config} / {strategy} skipped (resume) ==",
+    for spec in sorted(specs, key=_prio):
+        config, strategy, compact, pipeline, finals, quick = spec
+        if spec in done_keys or (
+            # a completed full-size cell supersedes its quick twin
+            quick and (config, strategy, compact, pipeline, finals)
+            in {k[:5] for k in done_keys if not k[5]}
+        ):
+            print(f"== {config} / {strategy}"
+                  f"{' / quick' if quick else ''} skipped (resume) ==",
                   flush=True)
             continue
         print(
             f"== {config} / {strategy} / "
             f"compaction={'on' if compact else 'off'}"
             f"{' / host_pipeline=on' if pipeline else ''}"
-            f"{' / device_finalize=off' if not finals else ''} ==",
+            f"{' / device_finalize=off' if not finals else ''}"
+            f"{' / QUICK' if quick else ''} ==",
             flush=True,
         )
-        emit(run_cell(config, strategy, compact, pipeline, finals))
+        emit(run_cell(config, strategy, compact, pipeline, finals, quick))
     report = {
         "generated_at_unix": int(time.time()),
         "rows": args.rows,

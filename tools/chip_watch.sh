@@ -1,14 +1,26 @@
 #!/bin/bash
-# Round-4 chip-evidence runner: wait for the axon tunnel relay to open,
+# Round-5 chip-evidence runner: wait for the axon tunnel relay to open,
 # then run the A/B harness over the BASELINE configs, retrying through
 # tunnel drops (chip_ab exits 4 on a dead tunnel, 3 on a hung cell; both
 # are resumable — the report is rewritten after every cell).
 #
 #   setsid nohup tools/chip_watch.sh > /tmp/chip_watch.log 2>&1 &
 #
+# Round-5 hardening (r4's one ~60s relay window died in backend init with
+# zero cells banked):
+#   - chip_ab now runs a QUICK tier first: 300K-row partial_merge cells
+#     for all five configs, no latency phase — first evidence in seconds
+#     past compile;
+#   - bench.init_backend banks CHIP_CLAIM.jsonl the instant the claim
+#     succeeds, before any compile, and enables the persistent XLA
+#     compilation cache (.jax_cache/) so a retry after a flap skips
+#     recompilation entirely.
+#
 # The driver-bench's stale-holder sweep may SIGKILL this process at
-# end-of-round; AB_REPORT_r4.json keeps every completed cell either way.
+# end-of-round; AB_REPORT_r5.json keeps every completed cell either way.
 cd "$(dirname "$0")/.." || exit 1
+
+OUT=AB_REPORT_r5.json
 
 relay_open() {
     for p in 8082 8083 8087 8092 8093 8097; do
@@ -24,22 +36,46 @@ echo "$(date -u +%H:%M:%S) chip_watch: waiting for relay"
 until relay_open; do sleep 15; done
 echo "$(date -u +%H:%M:%S) chip_watch: relay OPEN"
 
-# attempts are consumed only by runs that got past backend init (rc=4 =
+# Two phases, both driven through the same retry loop so a tunnel flap
+# during either is resumed, not dropped:
+#   main   — quick tier (automatic), then partial_merge full cells, then
+#            scatter, then host_pipeline/finals variants;
+#   pallas — pallas_dense decision cells (VERDICT r4 #8): its plausible
+#            win regime is emission-heavy sliding windows at low
+#            cardinality — one A/B on the chip decides keep-vs-demote.
+# Attempts are consumed only by runs that got past backend init (rc=4 =
 # init-time tunnel drop: ran zero cells, costs seconds — re-wait instead,
-# so a flapping relay cannot exhaust the budget before any work happens)
+# so a flapping relay cannot exhaust the budget before any work happens).
+run_phase() {
+    case "$1" in
+    main)
+        python tools/chip_ab.py \
+            --out "$OUT" --resume --finals-ab --host-pipeline \
+            --strategies partial_merge,scatter \
+            --cell-timeout 1800
+        ;;
+    pallas)
+        python tools/chip_ab.py \
+            --out "$OUT" --resume --no-quick \
+            --configs sliding,simple --strategies pallas_dense \
+            --cell-timeout 1800
+        ;;
+    esac
+}
+
+phase=main
 attempt=0
 while [ "$attempt" -lt 6 ]; do
-    echo "$(date -u +%H:%M:%S) chip_watch: run (attempt $attempt/6)"
-    # partial_merge first: it is the headline (auto-selected) strategy —
-    # if the tunnel flaps mid-matrix the report still has the cells that
-    # matter most
-    python tools/chip_ab.py \
-        --out AB_REPORT_r4.json --resume --finals-ab --host-pipeline \
-        --strategies partial_merge,scatter \
-        --cell-timeout 1800
+    echo "$(date -u +%H:%M:%S) chip_watch: run $phase (attempt $attempt/6)"
+    run_phase "$phase"
     rc=$?
-    echo "$(date -u +%H:%M:%S) chip_watch: chip_ab rc=$rc"
+    echo "$(date -u +%H:%M:%S) chip_watch: chip_ab[$phase] rc=$rc"
     if [ "$rc" -eq 0 ]; then
+        if [ "$phase" = main ]; then
+            echo "$(date -u +%H:%M:%S) chip_watch: main matrix DONE"
+            phase=pallas
+            continue
+        fi
         echo "$(date -u +%H:%M:%S) chip_watch: DONE"
         exit 0
     fi

@@ -39,6 +39,11 @@ def build_table(report: dict) -> str:
     for c in report.get("cells", []):
         if c.get("rc") != 0 or c.get("device") != "tpu":
             continue
+        # quick-tier smoke cells (reduced rows, no latency phase) are
+        # chip EVIDENCE, not headline numbers — never let one into the
+        # README table, even when no full-size cell completed
+        if c.get("quick"):
+            continue
         k = c["config"]
         if k not in best or c["value"] > best[k]["value"]:
             best[k] = c
