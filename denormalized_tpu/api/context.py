@@ -267,6 +267,7 @@ class Context:
         encoding: str = "json",
         schema: Schema | None = None,
         avro_schema=None,
+        timestamp_unit: str | None = None,
     ):
         """Kafka source entry point (PyContext::from_topic,
         py-denormalized/src/context.rs:50-117): schema comes from an explicit
@@ -291,6 +292,8 @@ class Context:
         )
         if timestamp_column:
             builder = builder.with_timestamp_column(timestamp_column)
+        if timestamp_unit:
+            builder = builder.with_timestamp_unit(timestamp_unit)
         if avro_schema is not None:
             # conflicting arguments are errors, not silent overrides
             if schema is not None:

@@ -289,23 +289,20 @@ class DataStream:
         # introspection must not mutate durable recovery state: with
         # checkpointing live, this run would commit epochs (and source
         # offsets) under the SAME node-id keys the real pipeline uses —
-        # the next real run would restore at explain's cut
-        cfg = self._ctx.config
-        saved_checkpoint = getattr(cfg, "checkpoint", False)
-        cfg.checkpoint = False
-        try:
-            self._execute(CallbackSink(lambda _b: None))
-        finally:
-            cfg.checkpoint = saved_checkpoint
+        # the next real run would restore at explain's cut.  The override
+        # is per-execution (threaded through execute_plan), not a flip of
+        # the Context's shared EngineConfig, which concurrent streams on
+        # the same Context read mid-run.
+        self._execute(CallbackSink(lambda _b: None), checkpoint=False)
         print("== physical plan (analyzed) ==")
         print(self._ctx._last_physical.display(with_metrics=True))
         return self
 
     # -- execution -------------------------------------------------------
-    def _execute(self, sink) -> None:
+    def _execute(self, sink, checkpoint=None) -> None:
         from denormalized_tpu.runtime.executor import execute_plan
 
-        execute_plan(lp.Sink(self._plan, sink), self._ctx)
+        execute_plan(lp.Sink(self._plan, sink), self._ctx, checkpoint)
 
     def print_stream(self) -> None:
         """Execute, printing rows as JSON (datastream.rs:311-339)."""

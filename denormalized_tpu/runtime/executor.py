@@ -53,11 +53,20 @@ def _install_signal_handlers(flag: ShutdownFlag):
     return restore
 
 
-def _attach_checkpointing(root: ExecOperator, ctx):
+def _attach_checkpointing(root: ExecOperator, ctx, checkpoint=None):
     """When checkpoint=true, start the barrier orchestrator and register
     every source + stateful operator (with_orchestrator,
-    datastream.rs:244-307).  Returns (orchestrator, coordinator)."""
-    if not getattr(ctx.config, "checkpoint", False):
+    datastream.rs:244-307).  Returns (orchestrator, coordinator).
+
+    ``checkpoint`` is a per-execution override: explain(analyze=True)
+    passes False so an introspection run never commits epochs under the
+    real pipeline's node-id keys — without mutating the Context's shared
+    EngineConfig, which a concurrent stream on the same Context reads."""
+    enabled = (
+        checkpoint if checkpoint is not None
+        else getattr(ctx.config, "checkpoint", False)
+    )
+    if not enabled:
         return None, None
     from denormalized_tpu.state.orchestrator import Orchestrator
     from denormalized_tpu.state.checkpoint import wire_checkpointing
@@ -75,12 +84,12 @@ def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
     return Planner(ctx.config).create_physical_plan(plan)
 
 
-def execute_plan(plan: lp.LogicalPlan, ctx) -> None:
+def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
     from denormalized_tpu.physical.base import Marker
 
     root = build_physical(plan, ctx)
     ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
-    orch, coord = _attach_checkpointing(root, ctx)
+    orch, coord = _attach_checkpointing(root, ctx, checkpoint)
     flag = ShutdownFlag()
     restore = _install_signal_handlers(flag)
     try:

@@ -54,6 +54,7 @@ class CsvSource(MemorySource):
         schema: Schema | None = None,
         timestamp_column: str | None = None,
         batch_rows: int = 8192,
+        timestamp_unit: str = "ms",
     ):
         schema = schema or infer_csv_schema(path)
         batches = []
@@ -104,4 +105,7 @@ class CsvSource(MemorySource):
             batches.append(RecordBatch(schema, cols, masks))
         if not batches:
             batches = [RecordBatch.empty(schema)]
-        super().__init__([batches], timestamp_column, name=path)
+        super().__init__(
+            [batches], timestamp_column, name=path,
+            timestamp_unit=timestamp_unit,
+        )

@@ -300,6 +300,7 @@ class KafkaTopicBuilder:
         self.encoding = StreamEncoding.JSON
         self.group_id = "denormalized-tpu"
         self.timestamp_column: str | None = None
+        self.timestamp_unit: str = "ms"
         self.user_schema: Schema | None = None
         self.avro_schema = None
         self.opts: dict[str, str] = {}
@@ -320,6 +321,16 @@ class KafkaTopicBuilder:
         self.timestamp_column = col
         return self
 
+    def with_timestamp_unit(self, unit: str) -> "KafkaTopicBuilder":
+        """Unit of the designated event-time column (kafka_config.rs:42);
+        normalized to canonical epoch-ms at ingest.  The broker record
+        timestamp is always ms, so this only matters with
+        ``with_timestamp_column``."""
+        from denormalized_tpu.sources.base import validate_ts_unit
+
+        self.timestamp_unit = validate_ts_unit(unit)
+        return self
+
     def with_schema(self, schema: Schema) -> "KafkaTopicBuilder":
         self.user_schema = schema
         return self
@@ -337,6 +348,10 @@ class KafkaTopicBuilder:
         return self
 
     def with_option(self, key: str, value: str) -> "KafkaTopicBuilder":
+        # option-string spelling of the typed builder knobs (the reference
+        # accepts either; ConnectionOpts passthrough, kafka_config.rs:48-58)
+        if key == "timestamp_unit":
+            return self.with_timestamp_unit(value)
         self.opts[key] = value
         return self
 
@@ -366,6 +381,7 @@ class KafkaPartitionReader(PartitionReader):
             src.builder.encoding, src.user_schema, src.builder.avro_schema
         )
         self._ts_col = src.builder.timestamp_column
+        self._ts_unit = src.builder.timestamp_unit
         self._consecutive_failures = 0
         # fetch splitting: a 4MB fetch can span hundreds of ms of event
         # time, and the watermark only advances on batch MIN-ts — so one
@@ -443,10 +459,14 @@ class KafkaPartitionReader(PartitionReader):
         return RecordBatch.empty(self._src.schema)
 
     def _attach_ts(self, batch, kafka_ts):
-        """Canonical timestamp: payload column or the broker record
-        timestamp (kafka_stream_read.rs:222-266)."""
+        """Canonical timestamp: payload column (normalized from the
+        configured timestamp_unit to epoch-ms) or the broker record
+        timestamp, which the wire protocol defines as ms
+        (kafka_stream_read.rs:222-266)."""
         if self._ts_col is not None:
-            ts = np.asarray(batch.column(self._ts_col), dtype=np.int64)
+            from denormalized_tpu.sources.base import normalize_ts_to_ms
+
+            ts = normalize_ts_to_ms(batch.column(self._ts_col), self._ts_unit)
         else:
             ts = kafka_ts
         return batch.with_column(

@@ -20,23 +20,29 @@ from denormalized_tpu.sources.base import (
     Source,
     attach_canonical_timestamp,
     canonicalize_schema,
+    validate_ts_unit,
 )
 
 
 class _MemoryPartition(PartitionReader):
     def __init__(
-        self, batches: Sequence[RecordBatch], timestamp_column: str | None
+        self,
+        batches: Sequence[RecordBatch],
+        timestamp_column: str | None,
+        timestamp_unit: str = "ms",
     ) -> None:
         self._batches = list(batches)
         self._pos = 0
         self._ts_col = timestamp_column
+        self._ts_unit = timestamp_unit
 
     def read(self, timeout_s: float | None = None):
         while self._pos < len(self._batches):
             b = self._batches[self._pos]
             self._pos += 1
             b = attach_canonical_timestamp(
-                b, self._ts_col, fallback_ms=int(time.time() * 1000)
+                b, self._ts_col, fallback_ms=int(time.time() * 1000),
+                timestamp_unit=self._ts_unit,
             )
             return b
         return None
@@ -56,11 +62,13 @@ class MemorySource(Source):
         partition_batches: Sequence[Sequence[RecordBatch]],
         timestamp_column: str | None = None,
         name: str = "memory",
+        timestamp_unit: str = "ms",
     ) -> None:
         if not partition_batches or not any(len(p) for p in partition_batches):
             raise ValueError("MemorySource needs at least one batch")
         self._parts = [list(p) for p in partition_batches]
         self._ts_col = timestamp_column
+        self._ts_unit = validate_ts_unit(timestamp_unit)
         self.name = name
         first = next(b for p in self._parts for b in p)
         user_schema = first.schema
@@ -72,18 +80,22 @@ class MemorySource(Source):
         timestamp_column: str | None = None,
         num_partitions: int = 1,
         name: str = "memory",
+        timestamp_unit: str = "ms",
     ) -> "MemorySource":
         parts: list[list[RecordBatch]] = [[] for _ in range(num_partitions)]
         for i, b in enumerate(batches):
             parts[i % num_partitions].append(b)
-        return MemorySource(parts, timestamp_column, name)
+        return MemorySource(parts, timestamp_column, name, timestamp_unit)
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
     def partitions(self) -> list[PartitionReader]:
-        return [_MemoryPartition(p, self._ts_col) for p in self._parts]
+        return [
+            _MemoryPartition(p, self._ts_col, self._ts_unit)
+            for p in self._parts
+        ]
 
     @property
     def unbounded(self) -> bool:
@@ -95,9 +107,11 @@ class _GeneratorPartition(PartitionReader):
         self,
         gen: Iterable[RecordBatch],
         timestamp_column: str | None,
+        timestamp_unit: str = "ms",
     ) -> None:
         self._it = iter(gen)
         self._ts_col = timestamp_column
+        self._ts_unit = timestamp_unit
         self._count = 0
 
     def read(self, timeout_s: float | None = None):
@@ -107,7 +121,8 @@ class _GeneratorPartition(PartitionReader):
             return None
         self._count += 1
         return attach_canonical_timestamp(
-            b, self._ts_col, fallback_ms=int(time.time() * 1000)
+            b, self._ts_col, fallback_ms=int(time.time() * 1000),
+            timestamp_unit=self._ts_unit,
         )
 
     def offset_snapshot(self) -> dict:
@@ -124,10 +139,12 @@ class GeneratorSource(Source):
         timestamp_column: str | None = None,
         unbounded: bool = True,
         name: str = "generator",
+        timestamp_unit: str = "ms",
     ) -> None:
         self._schema = canonicalize_schema(user_schema)
         self._factories = list(partition_factories)
         self._ts_col = timestamp_column
+        self._ts_unit = validate_ts_unit(timestamp_unit)
         self._unbounded = unbounded
         self.name = name
 
@@ -137,7 +154,8 @@ class GeneratorSource(Source):
 
     def partitions(self) -> list[PartitionReader]:
         return [
-            _GeneratorPartition(f(), self._ts_col) for f in self._factories
+            _GeneratorPartition(f(), self._ts_col, self._ts_unit)
+            for f in self._factories
         ]
 
     @property

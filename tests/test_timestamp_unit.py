@@ -1,0 +1,176 @@
+"""timestamp_unit on sources (VERDICT-r4 missing #3).
+
+The reference's source config declares the event-time column's unit
+(kafka_config.rs:42); without it a seconds- or microseconds-resolution
+topic silently mis-windows by 1000x.  All sources normalize to the
+canonical epoch-ms column at ingest.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.sources.base import normalize_ts_to_ms, validate_ts_unit
+from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+
+# -- unit conversion ------------------------------------------------------
+
+
+def test_normalize_units():
+    ts = np.array([1_700_000_000, 1_700_000_001], np.int64)
+    np.testing.assert_array_equal(
+        normalize_ts_to_ms(ts, "s"), ts * 1000)
+    np.testing.assert_array_equal(
+        normalize_ts_to_ms(ts * 1000, "ms"), ts * 1000)
+    np.testing.assert_array_equal(
+        normalize_ts_to_ms(ts * 1_000_000, "us"), ts * 1000)
+    np.testing.assert_array_equal(
+        normalize_ts_to_ms(ts * 1_000_000_000, "ns"), ts * 1000)
+    # spelling variants
+    assert validate_ts_unit("Seconds") == "s"
+    assert validate_ts_unit("microseconds") == "us"
+    assert validate_ts_unit(None) == "ms"
+
+
+def test_float_seconds_keep_subsecond_part():
+    # a float-seconds column (time.time() style) must not truncate to
+    # whole seconds before scaling
+    ts = np.array([1_700_000_000.25, 1_700_000_000.75])
+    np.testing.assert_array_equal(
+        normalize_ts_to_ms(ts, "s"),
+        np.array([1_700_000_000_250, 1_700_000_000_750], np.int64),
+    )
+
+
+def test_unknown_unit_raises_at_build_time():
+    with pytest.raises(SourceError, match="timestamp_unit"):
+        validate_ts_unit("fortnights")
+    with pytest.raises(SourceError, match="timestamp_unit"):
+        MemorySource.from_batches(
+            [_batch_s([1.0], ["a"], [1.0])],
+            timestamp_column="ts",
+            timestamp_unit="fortnights",
+        )
+    with pytest.raises(SourceError, match="timestamp_unit"):
+        KafkaTopicBuilder("localhost:9092").with_option(
+            "timestamp_unit", "fortnights")
+
+
+# -- windowing on a seconds-unit source ----------------------------------
+
+SCHEMA_S = Schema([
+    Field("ts", DataType.FLOAT64, nullable=False),
+    Field("k", DataType.STRING, nullable=False),
+    Field("v", DataType.FLOAT64),
+])
+T0_S = 1_700_000_000  # epoch seconds
+
+
+def _batch_s(ts, ks, vs):
+    return RecordBatch(
+        SCHEMA_S,
+        [np.asarray(ts, np.float64), np.asarray(ks, object),
+         np.asarray(vs, np.float64)],
+    )
+
+
+def test_memory_source_seconds_unit_windows():
+    """1s tumbling windows over a seconds-resolution source: each whole
+    second's events land in exactly one window keyed at second*1000 ms."""
+    batches = [
+        _batch_s([T0_S + 0.1, T0_S + 0.6, T0_S + 1.2], ["a", "a", "a"],
+                 [1.0, 2.0, 3.0]),
+        _batch_s([T0_S + 2.4, T0_S + 3.5], ["a", "a"], [4.0, 5.0]),
+        _batch_s([T0_S + 6.0], ["a"], [6.0]),
+    ]
+    out = (
+        Context()
+        .from_source(MemorySource.from_batches(
+            batches, timestamp_column="ts", timestamp_unit="s"))
+        .window(["k"], [F.count(col("v")).alias("n"),
+                        F.sum(col("v")).alias("s")], 1000)
+        .collect()
+    )
+    got = {}
+    for i in range(out.num_rows):
+        got[int(out.column("window_start_time")[i])] = (
+            int(out.column("n")[i]), float(out.column("s")[i]))
+    base = T0_S * 1000
+    assert got[base] == (2, 3.0)          # +0.1s, +0.6s
+    assert got[base + 1000] == (1, 3.0)   # +1.2s
+    assert got[base + 2000] == (1, 4.0)
+    assert got[base + 3000] == (1, 5.0)
+    assert got[base + 6000] == (1, 6.0)
+    # WITHOUT the unit the same feed mis-windows: seconds read as ms all
+    # collapse near epoch-0 — guard that the fix is actually load-bearing
+    out2 = (
+        Context()
+        .from_source(MemorySource.from_batches(
+            batches, timestamp_column="ts"))
+        .window(["k"], [F.count(col("v")).alias("n")], 1000)
+        .collect()
+    )
+    starts = {int(out2.column("window_start_time")[i])
+              for i in range(out2.num_rows)}
+    assert not (starts & set(got)), (starts, set(got))
+
+
+def test_kafka_topic_seconds_unit_windows():
+    """End-to-end: a topic whose payload carries float epoch-SECONDS event
+    time windows correctly under with_option('timestamp_unit', 's')
+    (the reference inherits this via config passthrough)."""
+    b = MockKafkaBroker().start()
+    try:
+        b.create_topic("secs", partitions=1)
+
+        def feed():
+            for chunk in range(5):
+                msgs = [
+                    json.dumps({
+                        "occurred_at": T0_S + chunk + i / 50.0,
+                        "sensor": "s0",
+                        "reading": 1.0,
+                    }).encode()
+                    for i in range(50)
+                ]
+                b.produce("secs", 0, msgs, ts_ms=T0_S * 1000)
+                time.sleep(0.15)
+
+        threading.Thread(target=feed, daemon=True).start()
+        ctx = Context(EngineConfig(source_idle_timeout_ms=400))
+        sample = json.dumps(
+            {"occurred_at": 1.5, "sensor": "a", "reading": 1.0})
+        ds = ctx.from_topic(
+            "secs",
+            sample_json=sample,
+            bootstrap_servers=b.bootstrap,
+            timestamp_column="occurred_at",
+            timestamp_unit="s",
+        ).window(["sensor"], [F.count(col("reading")).alias("n")], 1000)
+        got = {}
+        stop_at = time.time() + 20
+        for batch in ds.stream():
+            for i in range(batch.num_rows):
+                got[int(batch.column("window_start_time")[i])] = int(
+                    batch.column("n")[i])
+            if len(got) >= 3 or time.time() > stop_at:
+                break
+        base = T0_S * 1000
+        assert len(got) >= 3
+        for w, n in got.items():
+            assert (w - base) % 1000 == 0 and 0 <= (w - base) < 5000, w
+            assert n == 50, (w, n)  # each second carries exactly 50 events
+    finally:
+        b.stop()
