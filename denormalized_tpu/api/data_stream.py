@@ -148,6 +148,18 @@ class DataStream:
         )
 
     # -- joins (datastream.rs:126-177, Joinable trait :379-395) ----------
+    # reference JoinType spellings (datastream.rs:129 exposes DataFusion's
+    # enum) → our JoinKind; right-side existence joins normalize to the
+    # left-side kind with swapped inputs, so the exec implements only two
+    _JOIN_TYPE_ALIASES = {
+        "semi": "left_semi", "leftsemi": "left_semi",
+        "left_semi": "left_semi",
+        "anti": "left_anti", "leftanti": "left_anti",
+        "left_anti": "left_anti",
+        "rightsemi": "right_semi", "right_semi": "right_semi",
+        "rightanti": "right_anti", "right_anti": "right_anti",
+    }
+
     def join(
         self,
         right: "DataStream",
@@ -156,11 +168,23 @@ class DataStream:
         right_cols: Sequence[str] = (),
         filter: Expr | None = None,
     ) -> "DataStream":
+        jt = self._JOIN_TYPE_ALIASES.get(
+            join_type.lower().replace(" ", ""), join_type.lower()
+        )
+        if jt in ("right_semi", "right_anti"):
+            # RightSemi(a,b) == LeftSemi(b,a): swap inputs and key lists
+            return right.join(
+                self,
+                jt.replace("right", "left"),
+                list(right_cols),
+                list(left_cols),
+                filter,
+            )
         return self._wrap(
             lp.Join(
                 self._plan,
                 right._plan,
-                lp.JoinKind(join_type.lower()),
+                lp.JoinKind(jt),
                 list(left_cols),
                 list(right_cols),
                 filter,

@@ -162,6 +162,14 @@ class JoinKind(enum.Enum):
     LEFT = "left"
     RIGHT = "right"
     FULL = "full"
+    # existence joins (DataFusion JoinType::LeftSemi/LeftAnti, exposed by
+    # the reference's DataStream::join surface, datastream.rs:129): output
+    # is LEFT rows only — semi emits each left row at most once when a
+    # right match exists; anti emits left rows proven matchless (at
+    # eviction horizon or EOS).  Right-side variants normalize to these by
+    # swapping inputs at the API layer (DataStream.join).
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
 
 
 @dataclass
@@ -179,6 +187,29 @@ class Join(LogicalPlan):
     schema: Schema = None  # type: ignore[assignment]
 
     def __post_init__(self):
+        if self.kind in (JoinKind.LEFT_SEMI, JoinKind.LEFT_ANTI):
+            # existence joins surface no right columns, so same-named
+            # columns across sides are fine in the OUTPUT — but a join
+            # filter still evaluates over matched pairs, and a name both
+            # sides carry would silently bind to the left column there
+            if self.filter is not None:
+                shared_keys = {
+                    l for l, r in zip(self.left_keys, self.right_keys)
+                    if l == r
+                }  # equal by construction on a matched pair: unambiguous
+                both = (
+                    {f.name for f in self.left.schema}
+                    & {f.name for f in self.right.schema}
+                ) - shared_keys - {CANONICAL_TIMESTAMP_COLUMN}
+                amb = self.filter.columns_referenced() & both
+                if amb:
+                    raise PlanError(
+                        f"ambiguous column(s) {sorted(amb)} in "
+                        f"{self.kind.value} join filter: present on both "
+                        "sides; rename one side before joining"
+                    )
+            self.schema = self.left.schema
+            return
         fields = list(self.left.schema.fields)
         names = {f.name for f in fields}
         for f in self.right.schema:

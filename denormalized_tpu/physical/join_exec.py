@@ -283,6 +283,18 @@ class StreamingJoinExec(ExecOperator):
             for f in right.schema
             if f.name != CANONICAL_TIMESTAMP_COLUMN and f.name not in left_names
         ]
+        # existence joins (LeftSemi/LeftAnti, datastream.rs:129) output
+        # LEFT rows only — self.schema is the left schema — but the join
+        # FILTER still evaluates over matched pairs, so pair assembly uses
+        # this schema (== self.schema for every other kind)
+        self._existence = kind in (JoinKind.LEFT_SEMI, JoinKind.LEFT_ANTI)
+        if self._existence:
+            self._pair_schema = Schema(
+                list(left.schema.fields)
+                + [right.schema.field(n) for n in self._right_out]
+            )
+        else:
+            self._pair_schema = schema
 
     @property
     def children(self):
@@ -317,6 +329,14 @@ class StreamingJoinExec(ExecOperator):
         p_idx, b_rows = build.probe(probe_gids)
         if len(p_idx) == 0:
             return None
+        if self._existence and self.filter_expr is None:
+            # no pair materializes downstream and no filter reads one:
+            # the index arrays alone decide existence
+            return self._existence_probe(
+                probe_batch, p_idx, b_rows,
+                np.ones(len(p_idx), dtype=bool), probe_is_left,
+                probe_base, probe_side, build,
+            )
         p_take = probe_batch.take(p_idx)
         b_take = build.gather(b_rows)
         probe_cols = {n: p_take.column(n) for n in p_take.schema.names}
@@ -333,16 +353,50 @@ class StreamingJoinExec(ExecOperator):
         masks = [left_masks.get(n) for n in self.left.schema.names]
         cols += [right_cols[n] for n in self._right_out]
         masks += [right_masks.get(n) for n in self._right_out]
-        out = RecordBatch(self.schema, cols, masks)
+        out = RecordBatch(self._pair_schema, cols, masks)
         keep = np.ones(out.num_rows, dtype=bool)
         if self.filter_expr is not None:
             keep = np.asarray(self.filter_expr.eval(out), dtype=bool)
-            if not keep.all():
-                out = out.filter(keep)
+        if self._existence:
+            return self._existence_probe(
+                probe_batch, p_idx, b_rows, keep, probe_is_left,
+                probe_base, probe_side, build,
+            )
+        if not keep.all():
+            out = out.filter(keep)
         # mark matched pairs that survived the filter (vectorized)
         probe_side.matched[probe_base + p_idx[keep]] = True
         build.matched[b_rows[keep]] = True
         return out if out.num_rows else None
+
+    def _existence_probe(
+        self, probe_batch, p_idx, b_rows, keep, probe_is_left,
+        probe_base, probe_side, build,
+    ) -> RecordBatch | None:
+        """Semi/anti probe: no pair materializes downstream — only the
+        LEFT side's matched flags matter.  Semi emits each left row at
+        most once: on arrival when it matches retained right rows, or on
+        the matched-flag's False→True transition when a later right batch
+        probes it.  Anti emits nothing here (unmatched left rows surface
+        at eviction/EOS via _emits_unmatched)."""
+        pk = p_idx[keep]
+        bk = b_rows[keep]
+        if probe_is_left:
+            # this batch's left rows are new: any filtered match emits now
+            probe_side.matched[probe_base + pk] = True
+            build.matched[bk] = True
+            if self.kind is JoinKind.LEFT_SEMI and len(pk):
+                return probe_batch.take(np.unique(pk))
+            return None
+        # probe is the right side: matching LEFT rows live in `build`
+        pre = build.matched[bk].copy()
+        build.matched[bk] = True
+        probe_side.matched[probe_base + pk] = True
+        if self.kind is JoinKind.LEFT_SEMI:
+            newly = np.unique(bk[~pre])
+            if len(newly):
+                return build.gather(newly)
+        return None
 
     # ------------------------------------------------------------------
     def _evict(self, side: _SideState, is_left: bool, horizon: int):
@@ -440,6 +494,13 @@ class StreamingJoinExec(ExecOperator):
     def _emits_unmatched(self, is_left: bool) -> bool:
         if self.kind is JoinKind.FULL:
             return True
+        if self.kind is JoinKind.LEFT_ANTI:
+            # anti = left rows proven matchless: emitted when the eviction
+            # horizon passes them still unmatched (or at EOS).  Output is
+            # left-schema rows, so _null_padded is a pass-through.
+            return is_left
+        if self.kind is JoinKind.LEFT_SEMI:
+            return False
         return (self.kind is JoinKind.LEFT) == is_left and self.kind in (
             JoinKind.LEFT,
             JoinKind.RIGHT,

@@ -955,3 +955,114 @@ def test_repeated_kill_restore_cycles(tmp_path, make_batch, seed):
         np.testing.assert_allclose(
             got[1:], want[1:], rtol=1e-4, atol=1e-6, err_msg=str(k)
         )
+
+
+def test_semi_join_kill_and_restore_exactly_once(tmp_path, make_batch):
+    """Checkpoint/restore of a SEMI join (VERDICT-r4 #5): the matched
+    flags ARE the 'already emitted' record, so after a crash at a
+    committed aligned barrier the restored run must emit exactly the
+    not-yet-emitted matching left rows — union == golden, intersection
+    empty, no row twice."""
+    from collections import Counter
+
+    from denormalized_tpu.common.record_batch import RecordBatch as RB
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    rng = np.random.default_rng(23)
+    t0 = 1_700_000_000_000
+
+    def batches(seed, keyspace):
+        # enough batches that the triggered barrier lands mid-stream:
+        # the join's pump queues (maxsize 8) backpressure the sources, so
+        # with ~2 items consumed at trigger time the sources are still
+        # mid-replay and the marker aligns well before EOS
+        r = np.random.default_rng(seed)
+        out = []
+        for b in range(48):
+            n = 60
+            ts = np.sort(t0 + b * 400 + r.integers(0, 400, n))
+            keys = np.array(
+                [f"k{i}" for i in r.integers(0, keyspace, n)], dtype=object
+            )
+            out.append(make_batch(ts, keys, r.normal(0, 1, n)))
+        return out
+
+    lb = batches(1, 40)   # left keys k0..k39
+    rb_ = batches(2, 20)  # right keys k0..k19: half the left rows match
+
+    def pipeline(ctx):
+        left = ctx.from_source(
+            MemorySource.from_batches(lb, timestamp_column="occurred_at_ms"),
+            name="sj_l",
+        )
+        right = ctx.from_source(
+            MemorySource.from_batches(rb_, timestamp_column="occurred_at_ms"),
+            name="sj_r",
+        )
+        return left.join(right, "semi", ["sensor_name"], ["sensor_name"])
+
+    def rows_of(batch):
+        return [
+            (int(batch.column("occurred_at_ms")[i]),
+             batch.column("sensor_name")[i],
+             round(float(batch.column("reading")[i]), 6))
+            for i in range(batch.num_rows)
+        ]
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+        )
+
+    golden = Counter(rows_of(pipeline(Context(make_cfg(None))).collect()))
+    assert golden and max(golden.values()) == 1
+    close_global_state_backend()
+
+    state_dir = str(tmp_path / "state_semi")
+    ctx_a = Context(make_cfg(state_dir))
+    root_a = executor.build_physical(
+        lp.Sink(pipeline(ctx_a)._plan, CollectSink()), ctx_a
+    )
+    orch_a = Orchestrator(interval_s=9999)
+    coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+    emitted_a: Counter = Counter()
+    items_seen = 0
+    it = root_a.run()
+    for item in it:
+        if isinstance(item, RB):
+            emitted_a.update(rows_of(item))
+        if items_seen == 1:
+            orch_a.trigger_now()
+        if isinstance(item, Marker):
+            coord_a.commit(item.epoch)
+            break
+        items_seen += 1
+    it.close()  # crash
+    close_global_state_backend()
+
+    ctx_b = Context(make_cfg(state_dir))
+    root_b = executor.build_physical(
+        lp.Sink(pipeline(ctx_b)._plan, CollectSink()), ctx_b
+    )
+    orch_b = Orchestrator(interval_s=9999)
+    coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+    assert coord_b.committed_epoch is not None
+    emitted_b: Counter = Counter()
+    for item in root_b.run():
+        if isinstance(item, RB):
+            emitted_b.update(rows_of(item))
+    close_global_state_backend()
+
+    combined = emitted_a + emitted_b
+    assert set(combined) == set(golden), (
+        sorted(set(golden) ^ set(combined))[:5]
+    )
+    dupes = {k: c for k, c in combined.items() if c != 1}
+    assert not dupes, f"semi rows emitted more than once: {list(dupes)[:5]}"

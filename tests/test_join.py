@@ -536,3 +536,158 @@ def test_windowed_join_matches_oracle(case):
     for k, (al, ar) in want.items():
         np.testing.assert_allclose(got[k][0], al, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(got[k][1], ar, rtol=1e-5, atol=1e-5)
+
+
+# -- existence joins (LeftSemi / LeftAnti, datastream.rs:129) ------------
+
+
+def _rows(res):
+    """Materialize a left-schema result as a set-with-counts of row tuples."""
+    from collections import Counter
+
+    return Counter(
+        (int(res.column("ts")[i]), res.column("k")[i],
+         float(res.column("v")[i]))
+        for i in range(res.num_rows)
+    )
+
+
+def test_left_semi_join_emits_matching_left_rows_once():
+    """Semi: every left row with >=1 right key match emits exactly once,
+    with the LEFT schema only — regardless of how many right rows match
+    or which side arrives first."""
+    t0 = 1_700_000_000_000
+    L_rows = [
+        [(t0 + 1, "a", 1.0), (t0 + 2, "b", 2.0)],
+        [(t0 + 500, "a", 3.0), (t0 + 501, "c", 4.0)],
+        [(t0 + 1000, "d", 5.0)],
+    ]
+    R_rows = [
+        [(t0 + 3, "a", 10.0), (t0 + 4, "a", 11.0)],  # dup matches: still 1 emit
+        [(t0 + 600, "c", 12.0)],
+        [(t0 + 1100, "zz", 13.0)],
+    ]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "semi", ["k"], ["k2"]).collect()
+    # left-only schema: no right columns surface
+    assert "w" not in res.schema.names and "k2" not in res.schema.names
+    got = _rows(res)
+    want = {(t0 + 1, "a", 1.0): 1, (t0 + 500, "a", 3.0): 1,
+            (t0 + 501, "c", 4.0): 1}
+    assert dict(got) == want, (dict(got), want)
+
+
+def test_left_anti_join_emits_matchless_left_rows():
+    """Anti: left rows with NO right key match emit (at EOS for a bounded
+    stream), each exactly once, left schema only."""
+    t0 = 1_700_000_000_000
+    L_rows = [
+        [(t0 + 1, "a", 1.0), (t0 + 2, "b", 2.0)],
+        [(t0 + 500, "c", 3.0), (t0 + 501, "b", 4.0)],
+    ]
+    R_rows = [
+        [(t0 + 3, "a", 10.0)],
+        [(t0 + 600, "c", 12.0), (t0 + 601, "c", 13.0)],
+    ]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "anti", ["k"], ["k2"]).collect()
+    assert "w" not in res.schema.names
+    got = _rows(res)
+    want = {(t0 + 2, "b", 2.0): 1, (t0 + 501, "b", 4.0): 1}
+    assert dict(got) == want, (dict(got), want)
+
+
+def test_semi_join_filter_gates_existence():
+    """The join filter participates in the existence check: a key-equal
+    pair rejected by the filter does not count as a match (for semi OR
+    anti), exactly like DataFusion's filtered semi join."""
+    t0 = 1_700_000_000_000
+    L_rows = [[(t0 + 1, "a", 1.0), (t0 + 2, "b", 50.0)]]
+    R_rows = [[(t0 + 3, "a", 10.0), (t0 + 4, "b", 10.0)]]
+    left, right = _raw_sources(L_rows, R_rows)
+    # match requires w > v: a (10 > 1) passes, b (10 > 50) fails
+    res = left.join(right, "semi", ["k"], ["k2"],
+                    filter=col("w") > col("v")).collect()
+    assert dict(_rows(res)) == {(t0 + 1, "a", 1.0): 1}
+    left2, right2 = _raw_sources(L_rows, R_rows)
+    res2 = left2.join(right2, "anti", ["k"], ["k2"],
+                      filter=col("w") > col("v")).collect()
+    assert dict(_rows(res2)) == {(t0 + 2, "b", 50.0): 1}
+
+
+def test_right_semi_anti_normalize_by_swapping():
+    """RightSemi(a,b) == LeftSemi(b,a): the API normalizes, the output is
+    RIGHT-side rows."""
+    t0 = 1_700_000_000_000
+    L_rows = [[(t0 + 1, "a", 1.0), (t0 + 2, "b", 2.0)]]
+    R_rows = [[(t0 + 3, "a", 10.0), (t0 + 4, "x", 11.0)]]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "right_semi", ["k"], ["k2"]).collect()
+    assert "v" not in res.schema.names  # left columns don't surface
+    assert [(int(res.column("ts2")[i]), res.column("k2")[i])
+            for i in range(res.num_rows)] == [(t0 + 3, "a")]
+    left2, right2 = _raw_sources(L_rows, R_rows)
+    res2 = left2.join(right2, "RightAnti", ["k"], ["k2"]).collect()
+    assert [(int(res2.column("ts2")[i]), res2.column("k2")[i])
+            for i in range(res2.num_rows)] == [(t0 + 4, "x")]
+
+
+def test_anti_join_watermark_eviction_is_final():
+    """Watermark-eviction interaction: a left row that ages past the
+    retention horizon unmatched emits as anti THEN — a matching right row
+    arriving later must neither retract the anti emission nor match the
+    evicted row (same finality contract as the inner join's eviction)."""
+    t0 = 1_700_000_000_000
+    gap = 400_000  # > default 300s retention → forces eviction
+    L_rows = [
+        [(t0 + 1, "old", 1.0)],
+        [(t0 + gap, "new", 2.0)],
+        [(t0 + gap + 1000, "new", 3.0)],
+    ]
+    R_rows = [
+        [(t0 + 2, "none", 0.0)],
+        [(t0 + gap + 5, "new", 10.0)],
+        # 'old' arrives only after the left 'old' row evicted
+        [(t0 + gap + 1001, "old", 20.0)],
+    ]
+    left, right = _raw_sources(L_rows, R_rows)
+    res = left.join(right, "anti", ["k"], ["k2"]).collect()
+    got = dict(_rows(res))
+    # 'old' evicted unmatched → anti; 'new' rows matched → absent
+    assert got == {(t0 + 1, "old", 1.0): 1}, got
+
+
+def test_semi_join_filter_ambiguous_shared_name_rejected():
+    """A semi/anti join FILTER referencing a column both sides carry must
+    raise (it would silently bind left); shared equi-keys and untouched
+    shared names stay fine."""
+    import pytest
+
+    from denormalized_tpu.common.errors import PlanError
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    t0 = 1_700_000_000_000
+    S = Schema([Field("ts", DataType.INT64, nullable=False),
+                Field("k", DataType.STRING, nullable=False),
+                Field("v", DataType.FLOAT64)])
+
+    def src(name):
+        rb = RecordBatch(S, [np.asarray([t0], np.int64),
+                             np.asarray(["a"], object),
+                             np.asarray([1.0])])
+        return Context().from_source(
+            MemorySource.from_batches([rb], timestamp_column="ts"),
+            name=name)
+
+    ctx = Context()
+    l_, r_ = src("l"), src("r")
+    with pytest.raises(PlanError, match="ambiguous"):
+        l_.join(r_, "semi", ["k"], ["k"], filter=col("v") > 0.5)
+    # same-named columns WITHOUT a filter referencing them are fine
+    res = l_.join(r_, "semi", ["k"], ["k"]).collect()
+    assert res.num_rows == 1
+    # and the shared equi-key itself is referenceable (equal on a pair)
+    res2 = src("l2").join(src("r2"), "semi", ["k"], ["k"],
+                          filter=col("k") == "a").collect()
+    assert res2.num_rows == 1
