@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <dlfcn.h>
 #include <netdb.h>
 #include <string>
 #include <sys/socket.h>
@@ -51,6 +52,102 @@ uint32_t crc32c(const uint8_t* d, size_t n) {
   uint32_t c = ~0u;
   for (size_t i = 0; i < n; i++) c = tab.t[(c ^ d[i]) & 0xFF] ^ (c >> 8);
   return ~c;
+}
+
+// ---- TLS via dlopen'd OpenSSL -------------------------------------------
+// The image ships the OpenSSL 3 RUNTIME (libssl.so.3 / libcrypto.so.3) but
+// not the dev headers, so the needed surface is declared here and resolved
+// with dlopen/dlsym at first use.  This matches the capability the
+// reference inherits from librdkafka's ssl support (kafka_config.rs:48-58
+// passes security.protocol etc. straight through to rdkafka).  All OpenSSL
+// object types are opaque pointers at this ABI level.
+struct TlsApi {
+  void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(void*);
+  void (*SSL_CTX_free)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  int (*SSL_set1_host)(void*, const char*);
+  void* (*SSL_get0_param)(void*);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+  bool ok = false;
+};
+
+TlsApi* tls_api() {
+  static TlsApi api;
+  static bool tried = false;
+  if (!tried) {
+    tried = true;
+    // libssl declares libcrypto as a dependency, but ERR_* symbols live in
+    // libcrypto — resolve each from its own handle
+    void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (!ssl) ssl = dlopen("libssl.so.1.1", RTLD_NOW | RTLD_LOCAL);
+    if (!ssl) ssl = dlopen("libssl.so", RTLD_NOW | RTLD_LOCAL);
+    void* cry = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_LOCAL);
+    if (!cry) cry = dlopen("libcrypto.so.1.1", RTLD_NOW | RTLD_LOCAL);
+    if (!cry) cry = dlopen("libcrypto.so", RTLD_NOW | RTLD_LOCAL);
+    if (ssl && cry) {
+      bool all = true;
+      auto S = [&](const char* n) {
+        void* p = dlsym(ssl, n);
+        if (!p) all = false;
+        return p;
+      };
+      auto C = [&](const char* n) {
+        void* p = dlsym(cry, n);
+        if (!p) all = false;
+        return p;
+      };
+      api.TLS_client_method = (void* (*)())S("TLS_client_method");
+      api.SSL_CTX_new = (void* (*)(void*))S("SSL_CTX_new");
+      api.SSL_CTX_free = (void (*)(void*))S("SSL_CTX_free");
+      api.SSL_CTX_load_verify_locations =
+          (int (*)(void*, const char*, const char*))S(
+              "SSL_CTX_load_verify_locations");
+      api.SSL_CTX_set_default_verify_paths =
+          (int (*)(void*))S("SSL_CTX_set_default_verify_paths");
+      api.SSL_CTX_set_verify =
+          (void (*)(void*, int, void*))S("SSL_CTX_set_verify");
+      api.SSL_new = (void* (*)(void*))S("SSL_new");
+      api.SSL_free = (void (*)(void*))S("SSL_free");
+      api.SSL_set_fd = (int (*)(void*, int))S("SSL_set_fd");
+      api.SSL_connect = (int (*)(void*))S("SSL_connect");
+      api.SSL_read = (int (*)(void*, void*, int))S("SSL_read");
+      api.SSL_write = (int (*)(void*, const void*, int))S("SSL_write");
+      api.SSL_shutdown = (int (*)(void*))S("SSL_shutdown");
+      api.SSL_ctrl = (long (*)(void*, int, long, void*))S("SSL_ctrl");
+      api.SSL_set1_host = (int (*)(void*, const char*))S("SSL_set1_host");
+      api.SSL_get0_param = (void* (*)(void*))S("SSL_get0_param");
+      api.X509_VERIFY_PARAM_set1_ip_asc =
+          (int (*)(void*, const char*))C("X509_VERIFY_PARAM_set1_ip_asc");
+      api.ERR_get_error = (unsigned long (*)())C("ERR_get_error");
+      api.ERR_error_string_n =
+          (void (*)(unsigned long, char*, size_t))C("ERR_error_string_n");
+      api.ok = all;
+    }
+  }
+  return api.ok ? &api : nullptr;
+}
+
+std::string tls_err(TlsApi* api, const char* what) {
+  char buf[256] = {0};
+  unsigned long e = api->ERR_get_error();
+  if (e)
+    api->ERR_error_string_n(e, buf, sizeof buf);
+  else
+    snprintf(buf, sizeof buf, "%s", strerror(errno));
+  return std::string(what) + ": " + buf;
 }
 
 // ---- byte buffer helpers ------------------------------------------------
@@ -192,12 +289,26 @@ struct Client {
   };
   std::vector<Pending> pending;
 
+  // TLS state (null = plaintext).  All framing above this layer is
+  // identical either way — rpc() and the record paths never know.
+  void* ssl = nullptr;
+  void* ssl_ctx = nullptr;
+
   bool send_all(const uint8_t* d, size_t n) {
     while (n) {
-      ssize_t w = ::send(fd, d, n, MSG_NOSIGNAL);
-      if (w <= 0) {
-        error = std::string("send: ") + strerror(errno);
-        return false;
+      ssize_t w;
+      if (ssl) {
+        w = tls_api()->SSL_write(ssl, d, (int)std::min(n, (size_t)1 << 30));
+        if (w <= 0) {
+          error = tls_err(tls_api(), "tls send");
+          return false;
+        }
+      } else {
+        w = ::send(fd, d, n, MSG_NOSIGNAL);
+        if (w <= 0) {
+          error = std::string("send: ") + strerror(errno);
+          return false;
+        }
       }
       d += w;
       n -= (size_t)w;
@@ -206,10 +317,19 @@ struct Client {
   }
   bool recv_all(uint8_t* d, size_t n) {
     while (n) {
-      ssize_t r = ::recv(fd, d, n, 0);
-      if (r <= 0) {
-        error = std::string("recv: ") + strerror(errno);
-        return false;
+      ssize_t r;
+      if (ssl) {
+        r = tls_api()->SSL_read(ssl, d, (int)std::min(n, (size_t)1 << 30));
+        if (r <= 0) {
+          error = tls_err(tls_api(), "tls recv");
+          return false;
+        }
+      } else {
+        r = ::recv(fd, d, n, 0);
+        if (r <= 0) {
+          error = std::string("recv: ") + strerror(errno);
+          return false;
+        }
       }
       d += r;
       n -= (size_t)r;
@@ -698,8 +818,150 @@ void* kc_connect(const char* host, int port, char* errbuf, int errlen) {
 
 void kc_close(void* h) {
   Client* c = static_cast<Client*>(h);
+  TlsApi* api = c->ssl ? tls_api() : nullptr;
+  if (api) {
+    api->SSL_shutdown(c->ssl);  // best-effort close_notify
+    api->SSL_free(c->ssl);
+    if (c->ssl_ctx) api->SSL_CTX_free(c->ssl_ctx);
+  }
   if (c->fd >= 0) close(c->fd);
   delete c;
+}
+
+// Upgrade the connected socket to TLS (librdkafka security.protocol=SSL
+// analog).  ca_path: PEM bundle (null → system default paths); verify:
+// nonzero enforces certificate chain + host identity (host_for_verify
+// handles both DNS names and IP-literal SANs); SNI is sent for DNS names.
+// Returns 0 on success; on failure the connection is unusable.
+int kc_tls_init(void* h, const char* ca_path, int verify,
+                const char* host_for_verify, char* errbuf, int errlen) {
+  Client* c = static_cast<Client*>(h);
+  TlsApi* api = tls_api();
+  if (!api) {
+    snprintf(errbuf, errlen,
+             "TLS unavailable: libssl/libcrypto not loadable in this "
+             "environment");
+    return -1;
+  }
+  void* ctx = api->SSL_CTX_new(api->TLS_client_method());
+  if (!ctx) {
+    snprintf(errbuf, errlen, "%s", tls_err(api, "SSL_CTX_new").c_str());
+    return -1;
+  }
+  if (ca_path && *ca_path) {
+    if (api->SSL_CTX_load_verify_locations(ctx, ca_path, nullptr) != 1) {
+      snprintf(errbuf, errlen, "%s",
+               tls_err(api, "load ssl.ca.location").c_str());
+      api->SSL_CTX_free(ctx);
+      return -1;
+    }
+  } else {
+    api->SSL_CTX_set_default_verify_paths(ctx);
+  }
+  if (verify) api->SSL_CTX_set_verify(ctx, 1 /*SSL_VERIFY_PEER*/, nullptr);
+  void* ssl = api->SSL_new(ctx);
+  if (!ssl) {
+    snprintf(errbuf, errlen, "%s", tls_err(api, "SSL_new").c_str());
+    api->SSL_CTX_free(ctx);
+    return -1;
+  }
+  api->SSL_set_fd(ssl, c->fd);
+  bool is_ip = false;
+  if (host_for_verify && *host_for_verify) {
+    unsigned char tmp[16];
+    is_ip = inet_pton(AF_INET, host_for_verify, tmp) == 1 ||
+            inet_pton(AF_INET6, host_for_verify, tmp) == 1;
+    if (!is_ip) {
+      // SNI (RFC 6066 forbids IP literals in the extension)
+      api->SSL_ctrl(ssl, 55 /*SSL_CTRL_SET_TLSEXT_HOSTNAME*/,
+                    0 /*TLSEXT_NAMETYPE_host_name*/,
+                    (void*)host_for_verify);
+    }
+    if (verify) {
+      int hv;
+      if (is_ip)
+        hv = api->X509_VERIFY_PARAM_set1_ip_asc(api->SSL_get0_param(ssl),
+                                                host_for_verify);
+      else
+        hv = api->SSL_set1_host(ssl, host_for_verify);
+      if (hv != 1) {
+        snprintf(errbuf, errlen, "%s",
+                 tls_err(api, "set verify host").c_str());
+        api->SSL_free(ssl);
+        api->SSL_CTX_free(ctx);
+        return -1;
+      }
+    }
+  }
+  if (api->SSL_connect(ssl) != 1) {
+    snprintf(errbuf, errlen, "%s", tls_err(api, "tls handshake").c_str());
+    api->SSL_free(ssl);
+    api->SSL_CTX_free(ctx);
+    return -1;
+  }
+  c->ssl = ssl;
+  c->ssl_ctx = ctx;
+  return 0;
+}
+
+// SASL/PLAIN (RFC 4616) over the Kafka SaslHandshake v1 + SaslAuthenticate
+// v0 exchange — the librdkafka sasl.mechanism=PLAIN analog.  Runs over
+// whatever transport is active (call after kc_tls_init for SASL_SSL).
+int kc_sasl_plain(void* h, const char* user, const char* pass, char* errbuf,
+                  int errlen) {
+  Client* c = static_cast<Client*>(h);
+  {
+    Writer body;
+    body.str("PLAIN");
+    std::vector<uint8_t> resp;
+    if (!c->rpc(17 /*SaslHandshake*/, 1, body, resp)) {
+      snprintf(errbuf, errlen, "sasl handshake: %s", c->error.c_str());
+      return -1;
+    }
+    Reader r{resp.data(), resp.data() + resp.size()};
+    int16_t err = r.i16();
+    if (err != 0) {
+      // collect the broker's advertised mechanisms for the error
+      std::string mechs;
+      int32_t n = r.i32();
+      for (int32_t i = 0; i < n && !r.fail; i++) {
+        if (i) mechs += ",";
+        mechs += r.str();
+      }
+      snprintf(errbuf, errlen,
+               "broker rejected SASL mechanism PLAIN (error %d; broker "
+               "supports: %s)",
+               (int)err, mechs.c_str());
+      return -1;
+    }
+  }
+  {
+    std::vector<uint8_t> token;
+    token.push_back(0);  // authzid (empty)
+    token.insert(token.end(), user, user + strlen(user));
+    token.push_back(0);
+    token.insert(token.end(), pass, pass + strlen(pass));
+    Writer body;
+    body.bytes(token);
+    std::vector<uint8_t> resp;
+    if (!c->rpc(36 /*SaslAuthenticate*/, 0, body, resp)) {
+      snprintf(errbuf, errlen, "sasl authenticate: %s", c->error.c_str());
+      return -1;
+    }
+    Reader r{resp.data(), resp.data() + resp.size()};
+    int16_t err = r.i16();
+    if (err != 0) {
+      int16_t mlen = r.i16();
+      std::string msg;
+      if (mlen > 0 && r.need((size_t)mlen)) {
+        msg.assign((const char*)r.p, (size_t)mlen);
+      }
+      snprintf(errbuf, errlen, "sasl authentication failed (error %d%s%s)",
+               (int)err, msg.empty() ? "" : ": ", msg.c_str());
+      return -1;
+    }
+  }
+  return 0;
 }
 
 const char* kc_error(void* h) {

@@ -93,6 +93,16 @@ def _lib():
         ]
         lib.kc_high_watermark.restype = ctypes.c_int64
         lib.kc_high_watermark.argtypes = [ctypes.c_void_p]
+        lib.kc_tls_init.restype = ctypes.c_int
+        lib.kc_tls_init.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.kc_sasl_plain.restype = ctypes.c_int
+        lib.kc_sasl_plain.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
         # per-record absolute Kafka offsets (tolerate a stale .so without
         # the symbol — readers then skip fetch splitting)
         lib._kc_has_rec_kafka_offsets = hasattr(lib, "kc_rec_kafka_offsets")
@@ -112,8 +122,21 @@ class KafkaClient:
     parser re-ingests the result — full codec parity with librdkafka.
     Without the module, zstd batches keep the error-loudly behavior."""
 
-    def __init__(self, bootstrap_servers: str, external_codecs: bool = True):
+    #: security.protocol values the native transport implements; anything
+    #: else fails LOUDLY at connect (the reference inherits the full
+    #: librdkafka surface via passthrough — kafka_config.rs:48-58 — so an
+    #: unsupported value here must never silently fall back to plaintext)
+    SUPPORTED_PROTOCOLS = ("PLAINTEXT", "SSL", "SASL_PLAINTEXT", "SASL_SSL")
+    SUPPORTED_SASL_MECHANISMS = ("PLAIN",)
+
+    def __init__(
+        self,
+        bootstrap_servers: str,
+        external_codecs: bool = True,
+        security: dict | None = None,
+    ):
         host, _, port = bootstrap_servers.partition(":")
+        proto = self._validate_security(security)
         self._libref = _lib()
         err = ctypes.create_string_buffer(256)
         self._h = self._libref.kc_connect(
@@ -121,6 +144,12 @@ class KafkaClient:
         )
         if not self._h:
             raise SourceError(f"kafka connect failed: {err.value.decode()}")
+        if proto != "PLAINTEXT":
+            try:
+                self._setup_security(proto, security or {}, host)
+            except Exception:
+                self.close()
+                raise
         self._zstd = None
         if external_codecs:
             try:
@@ -130,6 +159,60 @@ class KafkaClient:
                 self._libref.kc_set_external_codecs(self._h, 1 << 4)
             except ImportError:
                 pass
+
+    @classmethod
+    def _validate_security(cls, security: dict | None) -> str:
+        """Canonical security.protocol, validated BEFORE any socket opens
+        — unsupported transport must be a loud error, never a silent
+        plaintext fallback."""
+        proto = (security or {}).get("security.protocol", "PLAINTEXT")
+        proto = proto.strip().upper()
+        if proto not in cls.SUPPORTED_PROTOCOLS:
+            raise SourceError(
+                f"unsupported security.protocol {proto!r}; this client "
+                f"implements {'/'.join(cls.SUPPORTED_PROTOCOLS)}"
+            )
+        if proto.startswith("SASL"):
+            mech = (security or {}).get("sasl.mechanism", "PLAIN")
+            if mech.strip().upper() not in cls.SUPPORTED_SASL_MECHANISMS:
+                raise SourceError(
+                    f"unsupported sasl.mechanism {mech!r}; this client "
+                    "implements "
+                    f"{'/'.join(cls.SUPPORTED_SASL_MECHANISMS)} "
+                    "(the reference reaches SCRAM/OAUTHBEARER through "
+                    "librdkafka; not implemented here)"
+                )
+            if not (security or {}).get("sasl.username"):
+                raise SourceError(
+                    f"{proto} requires sasl.username and sasl.password"
+                )
+        return proto
+
+    def _setup_security(self, proto: str, security: dict, host: str) -> None:
+        err = ctypes.create_string_buffer(512)
+        if proto in ("SSL", "SASL_SSL"):
+            ca = security.get("ssl.ca.location")
+            verify = str(
+                security.get("enable.ssl.certificate.verification", "true")
+            ).strip().lower() not in ("false", "0", "no")
+            rc = self._libref.kc_tls_init(
+                self._h,
+                ca.encode() if ca else None,
+                1 if verify else 0,
+                host.encode(),
+                err,
+                512,
+            )
+            if rc != 0:
+                raise SourceError(f"TLS to {host}: {err.value.decode()}")
+        if proto in ("SASL_PLAINTEXT", "SASL_SSL"):
+            user = security.get("sasl.username", "")
+            password = security.get("sasl.password", "")
+            rc = self._libref.kc_sasl_plain(
+                self._h, user.encode(), password.encode(), err, 512
+            )
+            if rc != 0:
+                raise SourceError(err.value.decode())
 
     def close(self):
         if self._h:
@@ -363,7 +446,9 @@ class KafkaTopicBuilder:
     def build_writer(self) -> "KafkaSinkWriter":
         if not self.topic:
             raise SourceError("build_writer needs a topic")
-        return KafkaSinkWriter(self.bootstrap_servers, self.topic)
+        return KafkaSinkWriter(
+            self.bootstrap_servers, self.topic, security=self.opts
+        )
 
 
 class KafkaPartitionReader(PartitionReader):
@@ -371,7 +456,9 @@ class KafkaPartitionReader(PartitionReader):
 
     def __init__(self, src: "KafkaSource", partition: int):
         self._src = src
-        self._client = KafkaClient(src.builder.bootstrap_servers)
+        self._client = KafkaClient(
+            src.builder.bootstrap_servers, security=src.builder.opts
+        )
         self._topic = src.builder.topic
         self._partition = partition
         auto_offset = src.builder.opts.get("auto.offset.reset", "earliest")
@@ -451,7 +538,10 @@ class KafkaPartitionReader(PartitionReader):
             except Exception:
                 pass
         try:
-            self._client = KafkaClient(self._src.builder.bootstrap_servers)
+            self._client = KafkaClient(
+                self._src.builder.bootstrap_servers,
+                security=self._src.builder.opts,
+            )
         except SourceError:
             pass  # broker still down; next read retries the reconnect
         # bounded backoff that respects the caller's read timeout contract
@@ -628,7 +718,8 @@ class KafkaSource(Source):
         self.name = builder.topic
         self.user_schema = builder.user_schema
         self._schema = canonicalize_schema(builder.user_schema)
-        client = KafkaClient(builder.bootstrap_servers)
+        client = KafkaClient(builder.bootstrap_servers,
+                             security=builder.opts)
         try:
             self._npartitions = client.partition_count(builder.topic)
         finally:
@@ -676,8 +767,9 @@ class KafkaSinkWriter(Sink):
     """JSON row producer (KafkaSink::write_all, topic_writer.rs:102-127),
     round-robin over partitions."""
 
-    def __init__(self, bootstrap_servers: str, topic: str):
-        self._client = KafkaClient(bootstrap_servers)
+    def __init__(self, bootstrap_servers: str, topic: str,
+                 security: dict | None = None):
+        self._client = KafkaClient(bootstrap_servers, security=security)
         self._topic = topic
         self._encoder = JsonRowEncoder()
         try:

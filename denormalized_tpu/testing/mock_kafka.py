@@ -231,13 +231,28 @@ def parse_record_batches(blob: bytes) -> list[tuple[int, bytes]]:
 
 
 class MockKafkaBroker:
-    """TCP server; topics are created on first produce or via create_topic."""
+    """TCP server; topics are created on first produce or via create_topic.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``tls_context`` (a server-side ``ssl.SSLContext``) wraps every accepted
+    connection — the listener side of security.protocol=SSL/SASL_SSL.
+    ``sasl_plain`` ({username: password}) makes the broker REQUIRE a
+    SaslHandshake v1 + SaslAuthenticate PLAIN exchange before serving any
+    data API; unauthenticated requests drop the connection, like a real
+    broker's sasl listener."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls_context=None,
+        sasl_plain: dict | None = None,
+    ):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(16)
+        self._tls_context = tls_context
+        self._sasl_plain = sasl_plain
         self.host, self.port = self._sock.getsockname()
         # (topic, partition) -> list[(offset, ts, payload)]
         self._logs: dict[tuple[str, int], list] = {}
@@ -422,6 +437,13 @@ class MockKafkaBroker:
         # when stop() shuts the socket down under a blocked recv/sendall —
         # treat it as end-of-connection, not a thread crash
         try:
+            if self._tls_context is not None:
+                # a plaintext client against the TLS listener fails the
+                # handshake here — connection drops, like a real broker
+                conn = self._tls_context.wrap_socket(conn, server_side=True)
+            # per-connection auth state (real brokers authenticate each
+            # connection independently)
+            authed = self._sasl_plain is None
             while not self._stop.is_set():
                 hdr = self._recv_all(conn, 4)
                 if hdr is None:
@@ -430,10 +452,15 @@ class MockKafkaBroker:
                 body = self._recv_all(conn, size)
                 if body is None:
                     return
-                resp = self._handle(body)
+                resp, authed = self._handle(body, authed)
+                if resp is None:
+                    return  # protocol violation (e.g. unauthed data API)
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
                 self.requests_served += 1
         except OSError:
+            return
+        except Exception:
+            # ssl.SSLError on a failed handshake ends the connection too
             return
         finally:
             try:
@@ -452,13 +479,23 @@ class MockKafkaBroker:
         return buf
 
     # -- request dispatch ------------------------------------------------
-    def _handle(self, body: bytes) -> bytes:
+    def _handle(self, body: bytes, authed: bool) -> tuple[bytes | None, bool]:
         api_key, api_version, corr = struct.unpack_from(">hhi", body, 0)
         pos = 8
         (client_len,) = struct.unpack_from(">h", body, pos)
         pos += 2 + max(client_len, 0)
         payload = body[pos:]
         out = struct.pack(">i", corr)
+        if api_key == 17:  # SaslHandshake v1
+            resp, authed = self._sasl_handshake(payload)
+            return out + resp, authed
+        if api_key == 36:  # SaslAuthenticate v0
+            resp, authed = self._sasl_authenticate(payload)
+            return out + resp, authed
+        if not authed:
+            # data API before authentication: drop the connection (real
+            # sasl listeners treat this as an illegal state)
+            return None, authed
         if api_key == 3:
             out += self._metadata(payload, api_version)
         elif api_key == 2:
@@ -469,7 +506,41 @@ class MockKafkaBroker:
             out += self._fetch(payload)
         else:
             out += struct.pack(">h", 35)  # UNSUPPORTED_VERSION
-        return out
+        return out, authed
+
+    def _sasl_handshake(self, payload: bytes) -> tuple[bytes, bool]:
+        (ln,) = struct.unpack_from(">h", payload, 0)
+        mech = payload[2 : 2 + ln].decode()
+        if self._sasl_plain is None or mech != "PLAIN":
+            # 33 = UNSUPPORTED_SASL_MECHANISM, advertise what we speak
+            out = struct.pack(">h", 33) + struct.pack(">i", 1)
+            m = b"PLAIN"
+            out += struct.pack(">h", len(m)) + m
+            return out, False
+        return struct.pack(">hi", 0, 1) + struct.pack(">h", 5) + b"PLAIN", (
+            False  # handshake ok, but authentication is the next step
+        )
+
+    def _sasl_authenticate(self, payload: bytes) -> tuple[bytes, bool]:
+        (blen,) = struct.unpack_from(">i", payload, 0)
+        token = payload[4 : 4 + max(blen, 0)]
+        parts = token.split(b"\x00")
+        ok = False
+        if self._sasl_plain is not None and len(parts) == 3:
+            user = parts[1].decode()
+            ok = self._sasl_plain.get(user) == parts[2].decode()
+        if not ok:
+            msg = b"Authentication failed: Invalid username or password"
+            # 58 = SASL_AUTHENTICATION_FAILED
+            return (
+                struct.pack(">h", 58)
+                + struct.pack(">h", len(msg)) + msg
+                + struct.pack(">i", 0),
+                False,
+            )
+        return struct.pack(">h", 0) + struct.pack(">h", -1) + struct.pack(
+            ">i", 0
+        ), True
 
     def _metadata(self, payload: bytes, version: int) -> bytes:
         (ntopics,) = struct.unpack_from(">i", payload, 0)
