@@ -5,17 +5,27 @@ Both C++ parsers expose the same column-oriented ABI behind a prefix
 getters.  This module owns the signature setup and the parse/extract loop so
 the two wrappers can't drift (e.g. null-mask materialization or the
 ``errors='replace'`` string decode — invalid bytes become U+FFFD so a weird
-payload can never crash the reader — live in exactly one place)."""
+payload can never crash the reader — live in exactly one place).
+
+Nested schemas (the reference's arrow-json/avro readers handle nested
+structs/lists natively — decoders/json.rs:11-49, decoders/avro.rs:11-54)
+ride the SHREDDED node-tree ABI: the C++ side parses nested values into
+typed leaf columns plus struct-presence bytes and Arrow-style list
+(offsets, values, elem-validity) triples; :class:`NodeDesc` mirrors that
+tree here, and ``_extract_tree`` reassembles the engine's host
+representation (object arrays of dicts/lists) from the leaves — no
+per-row ``json.loads``, no DOM."""
 
 from __future__ import annotations
 
 import ctypes
+from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.record_batch import RecordBatch
-from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.common.schema import Field, Schema
 
 
 def configure_lib(lib, prefix: str, create_argtypes: list) -> None:
@@ -71,9 +81,46 @@ def configure_lib(lib, prefix: str, create_argtypes: list) -> None:
         ]
         g("col_str_dict_offsets").restype = ctypes.POINTER(ctypes.c_uint64)
         g("col_str_dict_offsets").argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # node-tree (nested) accessors — present on parsers that support the
+    # shredded ABI; probed once like the dict symbols above
+    setattr(
+        lib, f"_{prefix}_has_tree", hasattr(lib, f"{prefix}_col_list_offsets")
+    )
+    if getattr(lib, f"_{prefix}_has_tree"):
+        g("col_list_offsets").restype = ctypes.POINTER(ctypes.c_uint64)
+        g("col_list_offsets").argtypes = [ctypes.c_void_p, ctypes.c_int]
+        g("col_list_evalid").restype = ctypes.POINTER(ctypes.c_uint8)
+        g("col_list_evalid").argtypes = [ctypes.c_void_p, ctypes.c_int]
+        g("col_list_nelems").restype = ctypes.c_uint64
+        g("col_list_nelems").argtypes = [ctypes.c_void_p, ctypes.c_int]
     g("clear").argtypes = [ctypes.c_void_p]
     g("destroy").argtypes = [ctypes.c_void_p]
     setattr(lib, flag, True)
+
+
+# natural (widest) numpy dtype per parser kind — nested python values are
+# materialized at this width regardless of the declared leaf dtype
+_NATURAL_DTYPE = {
+    "i64": np.int64,
+    "f64": np.float64,
+    "bool": bool,
+    "str": object,
+}
+
+
+@dataclass
+class NodeDesc:
+    """One node of the shredded schema tree, mirroring the C++ side.
+
+    ``kind``: 'i64' | 'f64' | 'bool' | 'str' | 'struct' | 'list'.
+    For lists, ``elem_kind`` is the scalar element kind and ``field``'s
+    single child declares the element dtype."""
+
+    idx: int
+    field: Field
+    kind: str
+    children: list = dc_field(default_factory=list)
+    elem_kind: str | None = None
 
 
 class ColumnarNativeParser:
@@ -113,68 +160,147 @@ class ColumnarNativeParser:
         rc = self._fn("parse")(self._h, data, offsets_ptr, n)
         if rc != 0:
             raise FormatError(self._fn("error")(self._h).decode())
+        tree = getattr(self, "_tree", None)
+        if tree is not None:
+            return self._extract_tree(tree, n)
         cols, masks = [], []
         for ci, f in enumerate(self.schema):
-            valid = np.ctypeslib.as_array(
-                self._fn("col_valid")(self._h, ci), shape=(n,)
-            ).astype(bool)
-            kind = self._kinds[ci]
-            if kind == "i64":
-                arr = np.ctypeslib.as_array(
-                    self._fn("col_i64")(self._h, ci), shape=(n,)
-                ).astype(f.dtype.to_numpy(), copy=True)
-            elif kind == "f64":
-                arr = np.ctypeslib.as_array(
-                    self._fn("col_f64")(self._h, ci), shape=(n,)
-                ).astype(f.dtype.to_numpy(), copy=True)
-            elif kind == "bool":
-                arr = np.ctypeslib.as_array(
-                    self._fn("col_bool")(self._h, ci), shape=(n,)
-                ).astype(bool)
-            elif (
-                getattr(self._libref, f"_{self._prefix}_has_str_dict", False)
-                and (
-                    n_uniq := int(self._fn("col_str_dict")(self._h, ci))
-                ) >= 0
-            ):
-                # dictionary path (native dedupe, str_dict.hpp): decode
-                # each DISTINCT value once, fan out with one vectorized
-                # take — the per-row slice+decode loop below was the
-                # dominant host cost of the Kafka ingest path.  n_uniq < 0
-                # = high-cardinality bail-out (dict would cost more than
-                # the direct loop).
-                codes = np.ctypeslib.as_array(
-                    self._fn("col_str_dict_codes")(self._h, ci), shape=(n,)
-                )
-                nb = ctypes.c_uint64()
-                bptr = self._fn("col_str_dict_bytes")(
-                    self._h, ci, ctypes.byref(nb)
-                )
-                raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
-                offs = np.ctypeslib.as_array(
-                    self._fn("col_str_dict_offsets")(self._h, ci),
-                    shape=(n_uniq + 1,),
-                )
-                uniq = np.empty(n_uniq, dtype=object)
-                for i in range(n_uniq):
-                    uniq[i] = raw[offs[i] : offs[i + 1]].decode(
-                        errors="replace"
-                    )
-                arr = uniq[codes]
-            else:
-                nb = ctypes.c_uint64()
-                bptr = self._fn("col_str_bytes")(
-                    self._h, ci, ctypes.byref(nb)
-                )
-                raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
-                offs = np.ctypeslib.as_array(
-                    self._fn("col_str_offsets")(self._h, ci), shape=(n + 1,)
-                )
-                arr = np.empty(n, dtype=object)
-                for i in range(n):
-                    arr[i] = raw[offs[i] : offs[i + 1]].decode(
-                        errors="replace"
-                    )
+            arr, valid = self._scalar_arrays(
+                ci, self._kinds[ci], n, f.dtype.to_numpy()
+            )
             cols.append(arr)
             masks.append(None if valid.all() else valid)
         return RecordBatch(self.schema, cols, masks)
+
+    def _scalar_arrays(self, ci: int, kind: str, count: int, np_dtype):
+        """(values, validity) for one scalar node: ``ci`` is the C-side
+        node index, ``count`` the entry count (nrows for row-level nodes,
+        nelems for list elements)."""
+        valid = np.ctypeslib.as_array(
+            self._fn("col_valid")(self._h, ci), shape=(count,)
+        ).astype(bool) if count else np.ones(0, dtype=bool)
+        return self._scalar_values(ci, kind, count, np_dtype), valid
+
+    def _scalar_values(self, ci: int, kind: str, count: int, np_dtype):
+        if count == 0:
+            return np.empty(0, dtype=np_dtype if kind != "str" else object)
+        if kind == "i64":
+            return np.ctypeslib.as_array(
+                self._fn("col_i64")(self._h, ci), shape=(count,)
+            ).astype(np_dtype, copy=True)
+        if kind == "f64":
+            return np.ctypeslib.as_array(
+                self._fn("col_f64")(self._h, ci), shape=(count,)
+            ).astype(np_dtype, copy=True)
+        if kind == "bool":
+            return np.ctypeslib.as_array(
+                self._fn("col_bool")(self._h, ci), shape=(count,)
+            ).astype(bool)
+        # strings
+        if (
+            getattr(self._libref, f"_{self._prefix}_has_str_dict", False)
+            and (n_uniq := int(self._fn("col_str_dict")(self._h, ci))) >= 0
+        ):
+            # dictionary path (native dedupe, str_dict.hpp): decode each
+            # DISTINCT value once, fan out with one vectorized take — the
+            # per-row slice+decode loop below was the dominant host cost
+            # of the Kafka ingest path.  n_uniq < 0 = high-cardinality
+            # bail-out (dict would cost more than the direct loop).
+            codes = np.ctypeslib.as_array(
+                self._fn("col_str_dict_codes")(self._h, ci), shape=(count,)
+            )
+            nb = ctypes.c_uint64()
+            bptr = self._fn("col_str_dict_bytes")(
+                self._h, ci, ctypes.byref(nb)
+            )
+            raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
+            offs = np.ctypeslib.as_array(
+                self._fn("col_str_dict_offsets")(self._h, ci),
+                shape=(n_uniq + 1,),
+            )
+            uniq = np.empty(n_uniq, dtype=object)
+            for i in range(n_uniq):
+                uniq[i] = raw[offs[i] : offs[i + 1]].decode(errors="replace")
+            return uniq[codes]
+        nb = ctypes.c_uint64()
+        bptr = self._fn("col_str_bytes")(self._h, ci, ctypes.byref(nb))
+        raw = ctypes.string_at(bptr, nb.value) if nb.value else b""
+        offs = np.ctypeslib.as_array(
+            self._fn("col_str_offsets")(self._h, ci), shape=(count + 1,)
+        )
+        arr = np.empty(count, dtype=object)
+        for i in range(count):
+            arr[i] = raw[offs[i] : offs[i + 1]].decode(errors="replace")
+        return arr
+
+    # -- nested (shredded) extraction ------------------------------------
+
+    def _extract_tree(self, tree: list, n: int) -> RecordBatch:
+        cols, masks = [], []
+        for nd in tree:
+            if nd.kind in ("struct", "list"):
+                vals, valid = self._node_pyvalues(nd, n)
+                arr = np.empty(n, dtype=object)
+                arr[:] = vals
+                cols.append(arr)
+                masks.append(None if valid.all() else valid)
+            else:
+                arr, valid = self._scalar_arrays(
+                    nd.idx, nd.kind, n, nd.field.dtype.to_numpy()
+                )
+                cols.append(arr)
+                masks.append(None if valid.all() else valid)
+        return RecordBatch(self.schema, cols, masks)
+
+    def _node_pyvalues(self, nd: "NodeDesc", n: int):
+        """Python value list (dicts / lists / scalars, None for null) plus
+        row-validity for one node — the reassembly of the shredded leaves.
+        Scalar leaves decode once per COLUMN (vectorized ``tolist``), so
+        a nested batch costs a few list comprehensions rather than a
+        ``json.loads`` per row."""
+        if nd.kind == "struct":
+            pres = np.ctypeslib.as_array(
+                self._fn("col_valid")(self._h, nd.idx), shape=(n,)
+            ).astype(bool) if n else np.ones(0, dtype=bool)
+            names = [c.field.name for c in nd.children]
+            kid_vals = [self._node_pyvalues(c, n)[0] for c in nd.children]
+            vals = [
+                dict(zip(names, t)) if p else None
+                for p, t in zip(pres.tolist(), zip(*kid_vals))
+            ] if nd.children else [dict() if p else None for p in pres]
+            return vals, pres
+        if nd.kind == "list":
+            valid = np.ctypeslib.as_array(
+                self._fn("col_valid")(self._h, nd.idx), shape=(n,)
+            ).astype(bool) if n else np.ones(0, dtype=bool)
+            offs = np.ctypeslib.as_array(
+                self._fn("col_list_offsets")(self._h, nd.idx), shape=(n + 1,)
+            ).tolist()
+            ne = int(self._fn("col_list_nelems")(self._h, nd.idx))
+            elems = self._scalar_values(
+                nd.idx, nd.elem_kind, ne, _NATURAL_DTYPE[nd.elem_kind]
+            ).tolist()
+            if ne:
+                evalid = np.ctypeslib.as_array(
+                    self._fn("col_list_evalid")(self._h, nd.idx), shape=(ne,)
+                )
+                if not evalid.all():
+                    for i in np.flatnonzero(evalid == 0):
+                        elems[i] = None
+            vals = [
+                elems[offs[i] : offs[i + 1]] if v else None
+                for i, v in enumerate(valid.tolist())
+            ]
+            return vals, valid
+        # python values inside dicts keep the parser's NATURAL width
+        # (int64/float64) rather than the declared leaf dtype — json.loads
+        # (the fallback) never narrows, and silently wrapping an
+        # out-of-range int through int32 would corrupt data
+        arr, valid = self._scalar_arrays(
+            nd.idx, nd.kind, n, _NATURAL_DTYPE[nd.kind]
+        )
+        vals = arr.tolist()
+        if not valid.all():
+            for i in np.flatnonzero(~valid):
+                vals[i] = None
+        return vals, valid

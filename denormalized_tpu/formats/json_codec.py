@@ -9,8 +9,15 @@ Covers three reference components:
   byte payloads for sinks.
 
 The decode hot path uses the native C++ columnar parser
-(:mod:`denormalized_tpu.formats.native_json`) for flat schemas and falls
-back to Python ``json`` for nested ones.
+(:mod:`denormalized_tpu.formats.native_json`) — flat schemas AND nested
+ones (structs to any depth, lists of scalars) via the shredded node-tree
+ABI.  Python ``json`` remains only for shapes the native side declines
+(lists of structs/lists, dynamic-map structs with no declared children).
+
+Both paths normalize nested struct values to the DECLARED schema shape
+(missing children become None, undeclared keys are dropped) — the same
+semantics the reference gets from arrow-json's schema-driven reader, and
+a precondition for the two decode paths staying bit-identical.
 """
 
 from __future__ import annotations
@@ -68,9 +75,7 @@ class JsonDecoder(Decoder):
         self.schema = schema
         self._rows: list[bytes] = []
         self._native = None
-        if use_native and all(
-            f.dtype not in (DataType.STRUCT, DataType.LIST) for f in schema
-        ):
+        if use_native:
             try:
                 from denormalized_tpu.formats.native_json import NativeJsonParser
 
@@ -87,6 +92,72 @@ class JsonDecoder(Decoder):
         if self._native is not None:
             return self._native.parse(rows)
         return decode_json_rows(rows, self.schema)
+
+
+_LEAF_PYTYPES = {
+    DataType.INT32: (int,),
+    DataType.INT64: (int,),
+    DataType.TIMESTAMP_MS: (int,),
+    DataType.FLOAT32: (int, float),
+    DataType.FLOAT64: (int, float),
+    DataType.BOOL: (bool,),
+    # bytes: the avro decoder represents avro "bytes" values as python
+    # bytes in STRING columns and shares rows_to_batch; json.loads can
+    # never produce bytes, so this does not loosen the JSON path
+    DataType.STRING: (str, bytes),
+}
+
+
+def _normalize_nested(v, f: Field):
+    """Reshape a decoded nested value to the DECLARED field shape: struct
+    values keep exactly the schema's children (missing → None, undeclared
+    keys dropped), recursively; type-mismatched values (an int where a
+    struct is declared, a bool on an int leaf) raise FormatError.  Structs
+    with no declared children (dynamic maps) and lists with no declared
+    element pass through as-is.  This is exactly what the native shredded
+    parser produces — schema-strict like the reference's arrow-json
+    reader (decoders/json.rs:11-49) — so downstream code (field access,
+    sinks, checkpoints) sees one shape and one failure mode regardless of
+    which decode path ran."""
+    if v is None:
+        return None
+    if f.dtype is DataType.STRUCT and f.children:
+        if not isinstance(v, dict):
+            raise FormatError(
+                f"field {f.name!r}: expected an object, got {v!r}"
+            )
+        return {
+            c.name: _normalize_nested(v.get(c.name), c) for c in f.children
+        }
+    if f.dtype is DataType.LIST and len(f.children) == 1:
+        if not isinstance(v, list):
+            raise FormatError(
+                f"field {f.name!r}: expected an array, got {v!r}"
+            )
+        c = f.children[0]
+        return [_normalize_nested(x, c) for x in v]
+    want = _LEAF_PYTYPES.get(f.dtype)
+    if want is not None and (
+        not isinstance(v, want)
+        or (bool not in want and isinstance(v, bool))
+    ):
+        raise FormatError(
+            f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
+        )
+    if f.dtype in (DataType.FLOAT32, DataType.FLOAT64):
+        # int-typed JSON on a float leaf: the native parser always
+        # materializes float — match it, or sink/checkpoint bytes would
+        # differ by decode path ('3' vs '3.0')
+        return float(v)
+    if f.dtype in (DataType.INT32, DataType.INT64, DataType.TIMESTAMP_MS):
+        # out-of-int64-range: the native parser keeps strtoll's saturate
+        # semantics (json.loads accepts 20-digit ints, so refusing would
+        # fail the batch); clamp identically here
+        if v > 0x7FFFFFFFFFFFFFFF:
+            return 0x7FFFFFFFFFFFFFFF
+        if v < -0x8000000000000000:
+            return -0x8000000000000000
+    return v
 
 
 def _null_of(dtype: DataType):
@@ -129,7 +200,7 @@ def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
                 v = o.get(f.name)
                 if v is None:
                     mask[i] = False
-                col[i] = v
+                col[i] = _normalize_nested(v, f)
             cols.append(col)
             masks.append(None if mask.all() else mask)
             continue

@@ -1,14 +1,21 @@
 """ctypes wrapper over the native one-pass JSON → columnar parser (shared
-plumbing in :mod:`denormalized_tpu.formats._native_parser_base`)."""
+plumbing in :mod:`denormalized_tpu.formats._native_parser_base`).
+
+Flat schemas use the historical column ABI; nested schemas (structs to any
+depth, lists of scalars — the reference's arrow-json reader handles these
+natively, decoders/json.rs:11-49) use the shredded node-tree ABI
+(``jp_create_tree``).  Lists of structs / lists of lists raise
+:class:`FormatError`, which routes the decoder to the Python fallback."""
 
 from __future__ import annotations
 
 import ctypes
 
 from denormalized_tpu.common.errors import FormatError
-from denormalized_tpu.common.schema import DataType, Schema
+from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.formats._native_parser_base import (
     ColumnarNativeParser,
+    NodeDesc,
     configure_lib,
 )
 from denormalized_tpu.native.build import load
@@ -36,23 +43,93 @@ def _lib():
             ctypes.POINTER(ctypes.c_int),
         ],
     )
+    if not getattr(lib, "_jp_tree_configured", False):
+        lib.jp_create_tree.restype = ctypes.c_void_p
+        lib.jp_create_tree.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib._jp_tree_configured = True
     return lib
+
+
+def build_node_tree(schema: Schema):
+    """Flatten a (possibly nested) schema into the parallel arrays the
+    ``jp_create_tree`` ABI takes, plus the :class:`NodeDesc` tree used for
+    extraction.  Raises :class:`FormatError` for shapes the native parser
+    does not shred (lists of non-scalars, childless structs — dynamic
+    maps stay on the Python fallback)."""
+    names: list[bytes] = []
+    types: list[int] = []
+    etypes: list[int] = []
+    parents: list[int] = []
+
+    def add(f: Field, parent: int) -> NodeDesc:
+        idx = len(names)
+        names.append(f.name.encode())
+        parents.append(parent)
+        if f.dtype in _TYPE_CODE:
+            code = _TYPE_CODE[f.dtype]
+            types.append(code)
+            etypes.append(-1)
+            return NodeDesc(idx, f, _OUT_KIND[code])
+        if f.dtype is DataType.STRUCT:
+            if not f.children:
+                raise FormatError(
+                    f"native parser cannot shred dynamic-map struct "
+                    f"{f.name!r} (no declared children)"
+                )
+            types.append(4)
+            etypes.append(-1)
+            nd = NodeDesc(idx, f, "struct")
+            for c in f.children:
+                nd.children.append(add(c, idx))
+            return nd
+        if f.dtype is DataType.LIST:
+            if len(f.children) != 1 or f.children[0].dtype not in _TYPE_CODE:
+                raise FormatError(
+                    f"native parser cannot shred list {f.name!r} "
+                    f"(element must be a declared scalar)"
+                )
+            ecode = _TYPE_CODE[f.children[0].dtype]
+            types.append(5)
+            etypes.append(ecode)
+            return NodeDesc(idx, f, "list", elem_kind=_OUT_KIND[ecode])
+        raise FormatError(f"native parser cannot handle {f.dtype}")
+
+    tree = [add(f, -1) for f in schema]
+    return names, types, etypes, parents, tree
 
 
 class NativeJsonParser(ColumnarNativeParser):
     _prefix = "jp"
 
     def __init__(self, schema: Schema):
-        for f in schema:
-            if f.dtype not in _TYPE_CODE:
-                raise FormatError(f"native parser cannot handle {f.dtype}")
         self.schema = schema
-        self._kinds = [_OUT_KIND[_TYPE_CODE[f.dtype]] for f in schema]
         self._libref = _lib()
-        names = (ctypes.c_char_p * len(schema))(
-            *[f.name.encode() for f in schema]
+        if all(f.dtype in _TYPE_CODE for f in schema):
+            # flat schema: historical column ABI (node i = column i)
+            self._tree = None
+            self._kinds = [_OUT_KIND[_TYPE_CODE[f.dtype]] for f in schema]
+            names = (ctypes.c_char_p * len(schema))(
+                *[f.name.encode() for f in schema]
+            )
+            types = (ctypes.c_int * len(schema))(
+                *[_TYPE_CODE[f.dtype] for f in schema]
+            )
+            self._h = self._libref.jp_create(len(schema), names, types)
+            return
+        names, types, etypes, parents, tree = build_node_tree(schema)
+        n = len(names)
+        self._tree = tree
+        self._kinds = []  # unused on the tree path
+        self._h = self._libref.jp_create_tree(
+            n,
+            (ctypes.c_char_p * n)(*names),
+            (ctypes.c_int * n)(*types),
+            (ctypes.c_int * n)(*etypes),
+            (ctypes.c_int * n)(*parents),
         )
-        types = (ctypes.c_int * len(schema))(
-            *[_TYPE_CODE[f.dtype] for f in schema]
-        )
-        self._h = self._libref.jp_create(len(schema), names, types)

@@ -2,14 +2,23 @@
 //
 // Native ingest/decode path: the reference decodes Kafka JSON payloads by
 // concatenating them into a JSON array and running arrow-json's reader
-// (crates/core/src/formats/decoders/json.rs:11-49, native Rust/C via Arrow).
-// Ours parses each payload directly into typed columnar buffers in a single
-// pass — no intermediate DOM, no per-row Python objects.  Flat schemas only
-// (the Python fallback handles nested structs/lists).
+// (crates/core/src/formats/decoders/json.rs:11-49, native Rust/C via Arrow),
+// which handles nested structs/lists natively.  Ours parses each payload
+// directly into typed columnar buffers in a single pass — no intermediate
+// DOM, no per-row Python objects — and SHREDS nested values the way a
+// columnar format does:
+//   - struct fields (any depth) become their leaf columns plus a per-row
+//     presence byte per struct node;
+//   - lists of scalars become Arrow-style (offsets, values, elem-validity)
+//     triples;
+//   - lists of structs / lists of lists are not shredded natively (the
+//     Python fallback handles them).
 //
-// C ABI for ctypes.  Column types: 0=int64, 1=float64, 2=bool, 3=string.
-// Unknown keys are skipped (balanced for nested values); missing keys and
-// JSON nulls set validity 0.
+// C ABI for ctypes.  Node types: 0=int64, 1=float64, 2=bool, 3=string,
+// 4=struct, 5=list-of-scalar.  ``jp_create`` keeps the historical flat
+// ABI (top-level scalar columns only); ``jp_create_tree`` takes the full
+// schema tree.  Unknown keys are skipped (balanced for nested values);
+// missing keys and JSON nulls set validity 0 (recursively for structs).
 
 #include <algorithm>
 #include <charconv>
@@ -23,15 +32,24 @@
 
 namespace {
 
-struct Col {
+// One schema-tree node.  Scalars store one value per row; struct nodes
+// store a presence byte per row in `valid` (1 = object present, 0 =
+// null/missing) and their children hold the data; list nodes store
+// per-row `list_offsets` (nrows+1) with the elements packed into the
+// node's own value vectors (`evalid` parallel to elements).
+struct Node {
   std::string name;
-  int type;
+  int type;            // 0 i64 | 1 f64 | 2 bool | 3 str | 4 struct | 5 list
+  int elem_type = -1;  // list: scalar element type 0..3
+  std::vector<int> kids;  // struct children (node indices)
   std::vector<int64_t> i64;
   std::vector<double> f64;
   std::vector<uint8_t> b;
-  std::vector<uint8_t> valid;
   std::vector<uint8_t> str_bytes;
-  std::vector<uint64_t> str_offsets;  // nrows+1
+  std::vector<uint64_t> str_offsets;  // scalar: nrows+1; list str: nelems+1
+  std::vector<uint8_t> valid;         // per row (leaf/struct/list)
+  std::vector<uint64_t> list_offsets;  // list: nrows+1
+  std::vector<uint8_t> evalid;         // list: per element
   StrDict dict;
 };
 
@@ -39,35 +57,40 @@ struct Col {
 // after one general-path row parse we capture the exact inter-value byte
 // runs — `{"key":`, `,"key2":`, …, the trailing `}` — including whatever
 // fixed whitespace style the producer uses (serde_json compact,
-// json.dumps `", "`/`": "`, …).  Subsequent rows then reduce to a few
-// memcmps plus direct value parses: no per-key string materialization, no
-// column-name lookup, no whitespace scanning.  Any mismatch rolls the row
-// back and reparses it on the general path (which re-learns the layout),
-// so this is purely a fast path — semantics are identical.
+// json.dumps `", "`/`": "`, …).  With nesting, the "values" are the
+// LAYOUT UNITS: scalar leaves at any struct depth plus entire lists; the
+// bytes of the nested structure itself (`{"gps":{"lat":`) land inside the
+// inter-unit token runs, so a nested fixed-shape producer gets the same
+// few-memcmp fast path as a flat one.  Any mismatch rolls the row back
+// and reparses it on the general path (which re-learns the layout), so
+// this is purely a fast path — semantics are identical.
 struct Layout {
   bool valid = false;
-  std::vector<std::string> tok;  // tok[i]: bytes preceding value i
-  std::vector<int> col;          // column index of value i (-1: skip)
-  std::vector<int> missing;      // schema columns absent from the row
-  std::string tail;              // bytes after the last value
+  std::vector<std::string> tok;  // tok[i]: bytes preceding unit i
+  std::vector<int> col;          // node index of unit i (-1: skip)
+  std::vector<int> present;      // struct nodes present in this shape
+  std::vector<int> missing;      // nodes nulled in this shape (subtree tops)
+  std::string tail;              // bytes after the last unit
   int fail_streak = 0;
 };
 
 struct Parser {
-  std::vector<Col> cols;
+  std::vector<Node> nodes;
+  std::vector<int> top;  // top-level node indices, schema order
   uint64_t nrows = 0;
   std::string error;
   Layout layout;
   int adopt_cooldown = 0;  // >0: layout adoption suppressed (see jp_parse)
-  // per-row discovery scratch (value spans, matched columns), filled by
-  // the general path so a successful row can become the new layout
+  // per-row discovery scratch (unit spans, node ids, shape sets), filled
+  // by the general path so a successful row can become the new layout
   std::vector<size_t> d_vs, d_ve;
   std::vector<int> d_col;
+  std::vector<int> d_present, d_missing;
   bool d_ok = false;
   // general-path per-row scratch, hoisted here so rows that stay on the
   // general path don't pay per-row heap allocations
   std::string g_key, g_sval;
-  std::vector<uint8_t> g_seen;
+  std::vector<uint8_t> g_seen;  // per NODE, cleared per row
 };
 
 struct Cursor {
@@ -142,10 +165,7 @@ bool parse_string(Cursor& c, std::string& out) {
             cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
           } else {
             cp = 0xFFFD;  // lone high surrogate → replacement char
-            // re-emit the second escape as its own char below? simplest:
-            // treat `lo` as an independent BMP code point
             unsigned cp2 = (lo >= 0xD800 && lo <= 0xDFFF) ? 0xFFFD : lo;
-            // emit cp now, then fall through to emit cp2
             auto emit = [&](unsigned x) {
               if (x < 0x80) out.push_back((char)x);
               else if (x < 0x800) {
@@ -291,37 +311,177 @@ bool skip_value(Cursor& c) {
   return true;
 }
 
+inline uint64_t list_elems(const Node& nd) {
+  return nd.list_offsets.empty() ? 0 : nd.list_offsets.back();
+}
+
 // drop every per-row append made by a partially parsed row, restoring all
-// column vectors to exactly `nr` committed rows (cheap: size bookkeeping
+// node vectors to exactly `nr` committed rows (cheap: size bookkeeping
 // only, no reallocation)
 void rollback_row(Parser* p, uint64_t nr) {
-  for (auto& col : p->cols) {
-    col.valid.resize(nr);
-    switch (col.type) {
-      case 0: col.i64.resize(nr); break;
-      case 1: col.f64.resize(nr); break;
-      case 2: col.b.resize(nr); break;
+  for (auto& nd : p->nodes) {
+    nd.valid.resize(nr);
+    switch (nd.type) {
+      case 0: nd.i64.resize(nr); break;
+      case 1: nd.f64.resize(nr); break;
+      case 2: nd.b.resize(nr); break;
       case 3:
-        col.str_offsets.resize(nr + 1);
-        col.str_bytes.resize(col.str_offsets.back());
+        nd.str_offsets.resize(nr + 1);
+        nd.str_bytes.resize(nd.str_offsets.back());
         break;
+      case 4: break;  // presence only
+      case 5: {
+        nd.list_offsets.resize(nr + 1);
+        uint64_t ne = nd.list_offsets.back();
+        nd.evalid.resize(ne);
+        switch (nd.elem_type) {
+          case 0: nd.i64.resize(ne); break;
+          case 1: nd.f64.resize(ne); break;
+          case 2: nd.b.resize(ne); break;
+          case 3:
+            nd.str_offsets.resize(ne + 1);
+            nd.str_bytes.resize(nd.str_offsets.back());
+            break;
+        }
+        break;
+      }
     }
   }
 }
 
-void push_null(Col& col) {
-  col.valid.push_back(0);
-  switch (col.type) {
-    case 0: col.i64.push_back(0); break;
-    case 1: col.f64.push_back(0.0); break;
-    case 2: col.b.push_back(0); break;
-    case 3: col.str_offsets.push_back(col.str_bytes.size()); break;
+void push_null_scalar(Node& nd) {
+  nd.valid.push_back(0);
+  switch (nd.type) {
+    case 0: nd.i64.push_back(0); break;
+    case 1: nd.f64.push_back(0.0); break;
+    case 2: nd.b.push_back(0); break;
+    case 3: nd.str_offsets.push_back(nd.str_bytes.size()); break;
   }
+}
+
+// append one null row entry to node ni and (for structs) every descendant
+void push_null_recursive(Parser* p, int ni) {
+  Node& nd = p->nodes[ni];
+  switch (nd.type) {
+    case 4:
+      nd.valid.push_back(0);
+      for (int k : nd.kids) push_null_recursive(p, k);
+      break;
+    case 5:
+      nd.valid.push_back(0);
+      nd.list_offsets.push_back(list_elems(nd));
+      break;
+    default:
+      push_null_scalar(nd);
+  }
+}
+
+// remove the last row entry from node ni and every descendant (duplicate
+// keys: json.loads is last-wins, so the earlier subtree's appends must
+// go).  Also clears the per-row `seen` marks for the subtree so the
+// replacement occurrence re-parses descendants as first sightings (the
+// caller re-marks the subtree top itself).
+void pop_row_subtree(Parser* p, int ni) {
+  Node& nd = p->nodes[ni];
+  p->g_seen[ni] = 0;
+  nd.valid.pop_back();
+  switch (nd.type) {
+    case 0: nd.i64.pop_back(); break;
+    case 1: nd.f64.pop_back(); break;
+    case 2: nd.b.pop_back(); break;
+    case 3:
+      nd.str_offsets.pop_back();
+      nd.str_bytes.resize(nd.str_offsets.back());
+      break;
+    case 4:
+      for (int k : nd.kids) pop_row_subtree(p, k);
+      break;
+    case 5: {
+      nd.list_offsets.pop_back();
+      uint64_t ne = nd.list_offsets.back();
+      nd.evalid.resize(ne);
+      switch (nd.elem_type) {
+        case 0: nd.i64.resize(ne); break;
+        case 1: nd.f64.resize(ne); break;
+        case 2: nd.b.resize(ne); break;
+        case 3:
+          nd.str_offsets.resize(ne + 1);
+          nd.str_bytes.resize(nd.str_offsets.back());
+          break;
+      }
+      break;
+    }
+  }
+}
+
+// parse one list value (cursor at '['); appends elements + one
+// list_offsets/valid row entry.  Shared by the general and fast paths —
+// a list is a single layout unit, reparsed generically every row (its
+// element count varies, so its bytes can't be layout tokens).
+bool parse_list_value(Parser* p, Node& nd, Cursor& c, std::string& sval) {
+  if (!c.eat('[')) return false;
+  if (!c.peek(']')) {
+    for (;;) {
+      c.ws();
+      if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
+        c.p += 4;
+        nd.evalid.push_back(0);
+        switch (nd.elem_type) {
+          case 0: nd.i64.push_back(0); break;
+          case 1: nd.f64.push_back(0.0); break;
+          case 2: nd.b.push_back(0); break;
+          case 3: nd.str_offsets.push_back(nd.str_bytes.size()); break;
+        }
+      } else {
+        switch (nd.elem_type) {
+          case 0: {
+            int64_t v;
+            if (!parse_i64_at(c.p, c.end, v)) return false;
+            nd.i64.push_back(v);
+            break;
+          }
+          case 1: {
+            double v;
+            if (!parse_f64_at(c.p, c.end, v)) return false;
+            nd.f64.push_back(v);
+            break;
+          }
+          case 2: {
+            if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
+              c.p += 4;
+              nd.b.push_back(1);
+            } else if (c.end - c.p >= 5 && memcmp(c.p, "false", 5) == 0) {
+              c.p += 5;
+              nd.b.push_back(0);
+            } else {
+              return false;
+            }
+            break;
+          }
+          case 3: {
+            if (!c.eat('"')) return false;
+            if (!parse_string(c, sval)) return false;
+            nd.str_bytes.insert(nd.str_bytes.end(), sval.begin(),
+                                sval.end());
+            nd.str_offsets.push_back(nd.str_bytes.size());
+            break;
+          }
+        }
+        nd.evalid.push_back(1);
+      }
+      if (c.peek(',')) { c.p++; continue; }
+      break;
+    }
+  }
+  if (!c.eat(']')) return false;
+  nd.list_offsets.push_back(nd.evalid.size());
+  nd.valid.push_back(1);
+  return true;
 }
 
 // layout-driven row parse; returns false on ANY deviation (caller rolls
 // back and reparses on the general path).  Appends exactly one entry per
-// schema column on success.
+// schema node on success.
 bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
   Layout& L = p->layout;
   const uint8_t* q = b;
@@ -338,32 +498,32 @@ bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
       q = c.p;
       continue;
     }
-    Col& col = p->cols[ci];
+    Node& nd = p->nodes[ci];
     if ((size_t)(e - q) >= 4 && memcmp(q, "null", 4) == 0) {
       q += 4;
-      push_null(col);
+      push_null_recursive(p, ci);
       continue;
     }
-    switch (col.type) {
+    switch (nd.type) {
       case 0: {
         int64_t v;
         if (!parse_i64_at(q, e, v)) return false;
-        col.i64.push_back(v);
+        nd.i64.push_back(v);
         break;
       }
       case 1: {
         double v;
         if (!parse_f64_at(q, e, v)) return false;
-        col.f64.push_back(v);
+        nd.f64.push_back(v);
         break;
       }
       case 2: {
         if ((size_t)(e - q) >= 4 && memcmp(q, "true", 4) == 0) {
           q += 4;
-          col.b.push_back(1);
+          nd.b.push_back(1);
         } else if ((size_t)(e - q) >= 5 && memcmp(q, "false", 5) == 0) {
           q += 5;
-          col.b.push_back(0);
+          nd.b.push_back(0);
         } else {
           return false;
         }
@@ -380,23 +540,32 @@ bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
           Cursor c{s, e};
           std::string sval;
           if (!parse_string(c, sval)) return false;
-          col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
-                               sval.end());
+          nd.str_bytes.insert(nd.str_bytes.end(), sval.begin(),
+                              sval.end());
           q = c.p;
         } else {
-          col.str_bytes.insert(col.str_bytes.end(), s, close);
+          nd.str_bytes.insert(nd.str_bytes.end(), s, close);
           q = close + 1;
         }
-        col.str_offsets.push_back(col.str_bytes.size());
+        nd.str_offsets.push_back(nd.str_bytes.size());
         break;
       }
+      case 5: {
+        Cursor c{q, e};
+        if (!parse_list_value(p, nd, c, p->g_sval) || c.fail) return false;
+        q = c.p;
+        continue;  // parse_list_value pushed valid itself
+      }
+      default:
+        return false;  // struct nodes are never layout units
     }
-    col.valid.push_back(1);
+    nd.valid.push_back(1);
   }
   if ((size_t)(e - q) != L.tail.size() ||
       memcmp(q, L.tail.data(), L.tail.size()) != 0)
     return false;
-  for (int ci : L.missing) push_null(p->cols[ci]);
+  for (int ni : L.present) p->nodes[ni].valid.push_back(1);
+  for (int ni : L.missing) push_null_recursive(p, ni);
   return true;
 }
 
@@ -404,7 +573,7 @@ bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
 void adopt_layout(Parser* p, const uint8_t* b, const uint8_t* e) {
   Layout& L = p->layout;
   L.valid = false;
-  if (!p->d_ok || p->d_vs.empty()) return;  // dup keys / empty object
+  if (!p->d_ok || p->d_vs.empty()) return;  // dup keys / no units
   const size_t n = p->d_vs.size();
   L.tok.resize(n);
   L.tok[0].assign((const char*)b, p->d_vs[0]);
@@ -414,12 +583,8 @@ void adopt_layout(Parser* p, const uint8_t* b, const uint8_t* e) {
   L.tail.assign((const char*)b + p->d_ve[n - 1],
                 (size_t)(e - b) - p->d_ve[n - 1]);
   L.col = p->d_col;
-  L.missing.clear();
-  std::vector<uint8_t> present(p->cols.size(), 0);
-  for (int c : L.col)
-    if (c >= 0) present[c] = 1;
-  for (int i = 0; i < (int)p->cols.size(); i++)
-    if (!present[i]) L.missing.push_back(i);
+  L.present = p->d_present;
+  L.missing = p->d_missing;
   L.valid = true;
   // NOTE: fail_streak is deliberately NOT reset here — it resets only on
   // a fast-row success.  Re-adopting after every general-path row would
@@ -427,125 +592,149 @@ void adopt_layout(Parser* p, const uint8_t* b, const uint8_t* e) {
   // in jp_parse could never fire.
 }
 
-// the general (any-shape) row parse; fills discovery scratch for
-// adopt_layout.  Returns false with p->error set on malformed input.
-bool parse_row_general(Parser* p, const uint8_t* b, const uint8_t* e,
-                       uint64_t r) {
-  const int ncols = (int)p->cols.size();
+// general-path parse of one struct BODY (cursor at '{'); ni = -1 for the
+// row root (children = p->top).  Fills discovery scratch for adopt_layout:
+// unit spans for scalar leaves + whole lists, present/missing node sets.
+bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
+  const std::vector<int>& kids = ni < 0 ? p->top : p->nodes[ni].kids;
   std::string& key = p->g_key;
-  std::string& sval = p->g_sval;
-  std::vector<uint8_t>& seen = p->g_seen;
-  seen.assign(ncols, 0);
-  p->d_vs.clear();
-  p->d_ve.clear();
-  p->d_col.clear();
-  p->d_ok = true;
-
-  Cursor c{b, e};
-  if (!c.eat('{')) {
-    p->error = "expected '{' at row " + std::to_string(r);
-    return false;
+  if (!c.eat('{')) return false;
+  if (ni >= 0) {
+    p->nodes[ni].valid.push_back(1);
+    p->d_present.push_back(ni);
   }
   if (!c.peek('}')) {
     for (;;) {
-      if (!c.eat('"')) break;
-      if (!parse_string(c, key)) { c.fail = true; break; }
-      if (!c.eat(':')) break;
-      // find column
+      if (!c.eat('"')) return false;
+      if (!parse_string(c, key)) { c.fail = true; return false; }
+      if (!c.eat(':')) return false;
       int ci = -1;
-      for (int i = 0; i < ncols; i++)
-        if (p->cols[i].name == key) { ci = i; break; }
+      for (int k : kids)
+        if (p->nodes[k].name == key) { ci = k; break; }
       c.ws();
-      p->d_vs.push_back((size_t)(c.p - b));
-      p->d_col.push_back(ci);
       if (ci < 0) {
-        if (!skip_value(c)) { c.fail = true; break; }
+        // unknown key: skip — and record it as a col=-1 layout unit so a
+        // producer whose undeclared field VARIES byte-to-byte (uuid,
+        // trace id) still gets the fast path (fast_row re-skips the
+        // value generically at that position instead of memcmp-failing)
+        p->d_vs.push_back((size_t)(c.p - b));
+        p->d_col.push_back(-1);
+        if (!skip_value(c)) { c.fail = true; return false; }
+        p->d_ve.push_back((size_t)(c.p - b));
       } else {
-        Col& col = p->cols[ci];
-        if (seen[ci]) {
+        Node& nd = p->nodes[ci];
+        if (p->g_seen[ci]) {
           // duplicate key: last-wins (match json.loads dict semantics) —
-          // drop the value stored for the earlier occurrence
+          // drop the whole subtree stored for the earlier occurrence.
+          // (Stale d_present/d_missing entries from it don't matter:
+          // d_ok=false suppresses layout adoption for this row.)
           p->d_ok = false;  // fast path can't reproduce dup handling
-          col.valid.pop_back();
-          switch (col.type) {
-            case 0: col.i64.pop_back(); break;
-            case 1: col.f64.pop_back(); break;
-            case 2: col.b.pop_back(); break;
-            case 3:
-              col.str_offsets.pop_back();
-              col.str_bytes.resize(col.str_offsets.back());
-              break;
-          }
+          pop_row_subtree(p, ci);
         }
-        seen[ci] = 1;
+        p->g_seen[ci] = 1;
         bool is_null = false;
         if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
           c.p += 4;
           is_null = true;
         }
         if (is_null) {
-          push_null(col);
+          push_null_recursive(p, ci);
+          p->d_missing.push_back(ci);
+        } else if (nd.type == 4) {
+          if (!parse_struct_body(p, ci, c, b)) {
+            c.fail = true;
+            return false;
+          }
+        } else if (nd.type == 5) {
+          p->d_vs.push_back((size_t)(c.p - b));
+          p->d_col.push_back(ci);
+          if (!parse_list_value(p, nd, c, p->g_sval) || c.fail) {
+            c.fail = true;
+            return false;
+          }
+          p->d_ve.push_back((size_t)(c.p - b));
         } else {
-          switch (col.type) {
+          p->d_vs.push_back((size_t)(c.p - b));
+          p->d_col.push_back(ci);
+          switch (nd.type) {
             case 0: {
               int64_t v;
-              if (!parse_i64_at(c.p, c.end, v)) { c.fail = true; }
-              col.i64.push_back(c.fail ? 0 : v);
-              col.valid.push_back(1);
+              if (!parse_i64_at(c.p, c.end, v)) { c.fail = true; return false; }
+              nd.i64.push_back(v);
               break;
             }
             case 1: {
               double v;
-              if (!parse_f64_at(c.p, c.end, v)) { c.fail = true; }
-              col.f64.push_back(c.fail ? 0.0 : v);
-              col.valid.push_back(1);
+              if (!parse_f64_at(c.p, c.end, v)) { c.fail = true; return false; }
+              nd.f64.push_back(v);
               break;
             }
             case 2: {
-              c.ws();
               if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
                 c.p += 4;
-                col.b.push_back(1);
+                nd.b.push_back(1);
               } else if (c.end - c.p >= 5 &&
                          memcmp(c.p, "false", 5) == 0) {
                 c.p += 5;
-                col.b.push_back(0);
+                nd.b.push_back(0);
               } else {
                 c.fail = true;
-                col.b.push_back(0);
+                return false;
               }
-              col.valid.push_back(1);
               break;
             }
             case 3: {
-              if (!c.eat('"')) { c.fail = true; break; }
-              if (!parse_string(c, sval)) { c.fail = true; break; }
-              col.str_bytes.insert(col.str_bytes.end(), sval.begin(),
-                                   sval.end());
-              col.str_offsets.push_back(col.str_bytes.size());
-              col.valid.push_back(1);
+              if (!c.eat('"')) { c.fail = true; return false; }
+              if (!parse_string(c, p->g_sval)) { c.fail = true; return false; }
+              nd.str_bytes.insert(nd.str_bytes.end(), p->g_sval.begin(),
+                                  p->g_sval.end());
+              nd.str_offsets.push_back(nd.str_bytes.size());
               break;
             }
           }
+          nd.valid.push_back(1);
+          p->d_ve.push_back((size_t)(c.p - b));
         }
       }
-      if (c.fail) break;
-      p->d_ve.push_back((size_t)(c.p - b));
       c.ws();
       if (c.peek(',')) { c.p++; continue; }
       break;
     }
-    if (!c.fail) c.eat('}');
+    if (!c.eat('}')) return false;
   } else {
     c.p++;  // consume '}'
   }
-  if (c.fail) {
-    p->error = "malformed JSON at row " + std::to_string(r);
+  // missing children → null (recursively)
+  for (int k : kids)
+    if (!p->g_seen[k]) {
+      push_null_recursive(p, k);
+      p->d_missing.push_back(k);
+    }
+  return true;
+}
+
+// the general (any-shape) row parse
+bool parse_row_general(Parser* p, const uint8_t* b, const uint8_t* e,
+                       uint64_t r) {
+  p->g_seen.assign(p->nodes.size(), 0);
+  p->d_vs.clear();
+  p->d_ve.clear();
+  p->d_col.clear();
+  p->d_present.clear();
+  p->d_missing.clear();
+  p->d_ok = true;
+
+  Cursor probe{b, e};
+  probe.ws();
+  const bool is_object = probe.p < probe.end && *probe.p == '{';
+  Cursor c{b, e};
+  if (!parse_struct_body(p, -1, c, b)) {
+    rollback_row(p, p->nrows);
+    p->error = (is_object ? "malformed JSON at row "
+                          : "expected '{' at row ") +
+               std::to_string(r);
     return false;
   }
-  // missing keys → null
-  for (int i = 0; i < ncols; i++)
-    if (!seen[i]) push_null(p->cols[i]);
   return true;
 }
 
@@ -553,13 +742,38 @@ bool parse_row_general(Parser* p, const uint8_t* b, const uint8_t* e,
 
 extern "C" {
 
+// flat ABI (top-level scalar columns only) — kept for the historical
+// callers; a flat schema is just a tree whose nodes are all top-level
 void* jp_create(int ncols, const char** names, const int* types) {
   Parser* p = new Parser();
-  p->cols.resize(ncols);
+  p->nodes.resize(ncols);
   for (int i = 0; i < ncols; i++) {
-    p->cols[i].name = names[i];
-    p->cols[i].type = types[i];
-    p->cols[i].str_offsets.push_back(0);
+    p->nodes[i].name = names[i];
+    p->nodes[i].type = types[i];
+    p->nodes[i].str_offsets.push_back(0);
+    p->top.push_back(i);
+  }
+  return p;
+}
+
+// full schema tree.  nodes come in any order with parent[i] either -1
+// (top-level field, order significant) or the index of a struct node.
+// types: 0..3 scalar, 4 struct, 5 list-of-scalar with elem_types[i] 0..3.
+void* jp_create_tree(int nnodes, const char** names, const int* types,
+                     const int* elem_types, const int* parents) {
+  Parser* p = new Parser();
+  p->nodes.resize(nnodes);
+  for (int i = 0; i < nnodes; i++) {
+    Node& nd = p->nodes[i];
+    nd.name = names[i];
+    nd.type = types[i];
+    nd.elem_type = elem_types[i];
+    nd.str_offsets.push_back(0);
+    nd.list_offsets.assign(nd.type == 5 ? 1 : 0, 0);
+    if (parents[i] < 0)
+      p->top.push_back(i);
+    else
+      p->nodes[parents[i]].kids.push_back(i);
   }
   return p;
 }
@@ -568,13 +782,15 @@ void jp_clear(void* h) {
   Parser* p = static_cast<Parser*>(h);
   p->nrows = 0;
   p->error.clear();
-  for (auto& c : p->cols) {
-    c.i64.clear();
-    c.f64.clear();
-    c.b.clear();
-    c.valid.clear();
-    c.str_bytes.clear();
-    c.str_offsets.assign(1, 0);
+  for (auto& nd : p->nodes) {
+    nd.i64.clear();
+    nd.f64.clear();
+    nd.b.clear();
+    nd.valid.clear();
+    nd.str_bytes.clear();
+    nd.str_offsets.assign(1, 0);
+    nd.evalid.clear();
+    if (nd.type == 5) nd.list_offsets.assign(1, 0);
   }
 }
 
@@ -582,14 +798,17 @@ void jp_clear(void* h) {
 int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
              uint64_t nrows) {
   Parser* p = static_cast<Parser*>(h);
-  for (auto& col : p->cols) {
-    col.valid.reserve(col.valid.size() + nrows);
-    switch (col.type) {
-      case 0: col.i64.reserve(col.i64.size() + nrows); break;
-      case 1: col.f64.reserve(col.f64.size() + nrows); break;
-      case 2: col.b.reserve(col.b.size() + nrows); break;
+  for (auto& nd : p->nodes) {
+    nd.valid.reserve(nd.valid.size() + nrows);
+    switch (nd.type) {
+      case 0: nd.i64.reserve(nd.i64.size() + nrows); break;
+      case 1: nd.f64.reserve(nd.f64.size() + nrows); break;
+      case 2: nd.b.reserve(nd.b.size() + nrows); break;
       case 3:
-        col.str_offsets.reserve(col.str_offsets.size() + nrows);
+        nd.str_offsets.reserve(nd.str_offsets.size() + nrows);
+        break;
+      case 5:
+        nd.list_offsets.reserve(nd.list_offsets.size() + nrows);
         break;
     }
   }
@@ -630,40 +849,53 @@ const char* jp_error(void* h) {
 uint64_t jp_nrows(void* h) { return static_cast<Parser*>(h)->nrows; }
 
 const int64_t* jp_col_i64(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].i64.data();
+  return static_cast<Parser*>(h)->nodes[col].i64.data();
 }
 const double* jp_col_f64(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].f64.data();
+  return static_cast<Parser*>(h)->nodes[col].f64.data();
 }
 const uint8_t* jp_col_bool(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].b.data();
+  return static_cast<Parser*>(h)->nodes[col].b.data();
 }
 const uint8_t* jp_col_valid(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].valid.data();
+  return static_cast<Parser*>(h)->nodes[col].valid.data();
 }
 const uint8_t* jp_col_str_bytes(void* h, int col, uint64_t* nbytes) {
-  Col& c = static_cast<Parser*>(h)->cols[col];
+  Node& c = static_cast<Parser*>(h)->nodes[col];
   *nbytes = c.str_bytes.size();
   return c.str_bytes.data();
 }
 const uint64_t* jp_col_str_offsets(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].str_offsets.data();
+  return static_cast<Parser*>(h)->nodes[col].str_offsets.data();
+}
+// list node accessors: per-row offsets (nrows+1), element validity, and
+// element count; element VALUES come through the scalar getters above
+// (a list node stores its elements in its own value vectors)
+const uint64_t* jp_col_list_offsets(void* h, int col) {
+  return static_cast<Parser*>(h)->nodes[col].list_offsets.data();
+}
+const uint8_t* jp_col_list_evalid(void* h, int col) {
+  return static_cast<Parser*>(h)->nodes[col].evalid.data();
+}
+uint64_t jp_col_list_nelems(void* h, int col) {
+  return list_elems(static_cast<Parser*>(h)->nodes[col]);
 }
 int64_t jp_col_str_dict(void* h, int col) {
   Parser* p = static_cast<Parser*>(h);
-  Col& c = p->cols[col];
-  return build_str_dict(c.str_bytes, c.str_offsets, p->nrows, c.dict);
+  Node& c = p->nodes[col];
+  uint64_t n = c.type == 5 ? list_elems(c) : p->nrows;
+  return build_str_dict(c.str_bytes, c.str_offsets, n, c.dict);
 }
 const int32_t* jp_col_str_dict_codes(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].dict.codes.data();
+  return static_cast<Parser*>(h)->nodes[col].dict.codes.data();
 }
 const uint8_t* jp_col_str_dict_bytes(void* h, int col, uint64_t* nbytes) {
-  StrDict& d = static_cast<Parser*>(h)->cols[col].dict;
+  StrDict& d = static_cast<Parser*>(h)->nodes[col].dict;
   *nbytes = d.bytes.size();
   return d.bytes.data();
 }
 const uint64_t* jp_col_str_dict_offsets(void* h, int col) {
-  return static_cast<Parser*>(h)->cols[col].dict.offsets.data();
+  return static_cast<Parser*>(h)->nodes[col].dict.offsets.data();
 }
 
 void jp_destroy(void* h) { delete static_cast<Parser*>(h); }

@@ -222,6 +222,81 @@ static void test_json_fast_layout() {
   printf("json fast layout ok\n");
 }
 
+static void test_json_tree() {
+  // the shredded node-tree ABI: nested structs to depth 2, a list of
+  // strings, null/missing/duplicate/unknown-key handling, and the
+  // adaptive layout over nested shapes.  Heap-exact buffers put ASan
+  // redzones at every row boundary.
+  //   0 id(str)  1 imu(struct)  2 ts(i64, p=1)  3 gps(struct, p=1)
+  //   4 lat(f64, p=3)  5 spd(f64, p=3)  6 tags(list<str>)
+  const char* names[7] = {"id", "imu", "ts", "gps", "lat", "spd", "tags"};
+  int types[7] = {3, 4, 0, 4, 1, 1, 5};
+  int etypes[7] = {-1, -1, -1, -1, -1, -1, 3};
+  int parents[7] = {-1, -1, 1, 1, 3, 3, -1};
+  void* p = jp_create_tree(7, names, types, etypes, parents);
+  std::string rows;
+  std::vector<uint64_t> offs{0};
+  auto add = [&](const std::string& r) {
+    rows += r;
+    offs.push_back(rows.size());
+  };
+  // fixed nested shape — layout adoption must cover leaves inside structs
+  for (int i = 0; i < 16; i++)
+    add("{\"id\":\"d" + std::to_string(i) + "\",\"imu\":{\"ts\":" +
+        std::to_string(i) + ",\"gps\":{\"lat\":1.5,\"spd\":2.5}},\"tags\":"
+        "[\"a\",\"b\"]}");
+  add("{\"id\":\"x\",\"imu\":null,\"tags\":[]}");               // null struct
+  add("{\"id\":\"y\",\"imu\":{\"gps\":null},\"tags\":null}");   // inner null
+  add("{\"id\":\"z\",\"imu\":{\"ts\":7,\"gps\":{\"lat\":9.5,\"spd\":8.5},"
+      "\"junk\":{\"a\":[1]}},\"tags\":[\"q\",null]}");          // unknown key
+  add("{\"imu\":{\"ts\":1,\"gps\":{\"lat\":0.0,\"spd\":0.0}},"
+      "\"imu\":{\"ts\":99,\"gps\":{\"lat\":7.5,\"spd\":6.5}},"
+      "\"id\":\"dup\",\"tags\":[\"w\"]}");                      // dup struct
+  {
+    std::vector<uint8_t> exact(rows.begin(), rows.end());
+    assert(jp_parse(p, exact.data(), offs.data(), offs.size() - 1) == 0);
+    uint64_t n = jp_nrows(p);
+    assert(n == 20);
+    const int64_t* ts = jp_col_i64(p, 2);
+    const uint8_t* tsv = jp_col_valid(p, 2);
+    for (int i = 0; i < 16; i++) assert(ts[i] == i && tsv[i] == 1);
+    assert(tsv[16] == 0 && tsv[17] == 0);  // null imu / missing ts
+    const uint8_t* imup = jp_col_valid(p, 1);
+    const uint8_t* gpsp = jp_col_valid(p, 3);
+    assert(imup[16] == 0 && gpsp[16] == 0);
+    assert(imup[17] == 1 && gpsp[17] == 0);
+    assert(ts[18] == 7 && ts[19] == 99);  // dup: last wins
+    const double* lat = jp_col_f64(p, 4);
+    assert(lat[18] == 9.5 && lat[19] == 7.5);
+    const uint64_t* lo = jp_col_list_offsets(p, 6);
+    assert(lo[16] - lo[0] == 32);          // 16 rows x 2 elems
+    assert(lo[17] == lo[16]);              // []
+    assert(lo[18] == lo[17]);              // null list
+    assert(lo[19] - lo[18] == 2);          // ["q", null]
+    const uint8_t* ev = jp_col_list_evalid(p, 6);
+    assert(ev[lo[18]] == 1 && ev[lo[18] + 1] == 0);
+    const uint8_t* lv = jp_col_valid(p, 6);
+    assert(lv[16] == 1 && lv[17] == 0);
+    assert(jp_col_list_nelems(p, 6) == lo[20]);
+  }
+  // truncation inside a nested value with an armed layout
+  for (const char* t :
+       {"{\"id\":\"t\",\"imu\":{\"ts\":1,\"gps\":{\"lat\":1.5,",
+        "{\"id\":\"t\",\"imu\":{\"ts\":1", "{\"id\":\"t\",\"tags\":[\"a\""}) {
+    jp_clear(p);
+    std::string warm =
+        "{\"id\":\"w\",\"imu\":{\"ts\":0,\"gps\":{\"lat\":1.5,\"spd\":2.5}},"
+        "\"tags\":[\"a\",\"b\"]}";
+    std::string both = warm + t;
+    std::vector<uint8_t> exact(both.begin(), both.end());
+    uint64_t toffs[3] = {0, warm.size(), both.size()};
+    assert(jp_parse(p, exact.data(), toffs, 2) == -1);
+    assert(strlen(jp_error(p)) > 0);
+  }
+  jp_destroy(p);
+  printf("json tree ok\n");
+}
+
 static void zz(std::vector<uint8_t>& out, int64_t v) {
   uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
   while (z >= 0x80) {
@@ -365,6 +440,7 @@ int main(int argc, char** argv) {
   test_interner();
   test_json();
   test_json_fast_layout();
+  test_json_tree();
   test_avro();
   test_codecs();
   printf("ALL NATIVE TESTS PASSED\n");

@@ -886,19 +886,21 @@ def test_fetch_splitting_bounded_batches_exact_offsets(broker):
 
 
 def test_fetch_splitting_non_native_decode_path(broker):
-    """Nested-JSON schemas decode through the Python decoder (no native
-    parser), but the fetch still runs through the native client — so
-    max.batch.rows splitting and its exact slice-boundary offsets apply
-    on this path too."""
+    """Schemas the native parser declines to shred (here: a list of
+    structs) decode through the Python decoder, but the fetch still runs
+    through the native client — so max.batch.rows splitting and its exact
+    slice-boundary offsets apply on this path too.  (Plain nested structs
+    now decode natively via the shredded tree ABI, so they no longer
+    exercise this path.)"""
     broker.create_topic("splitnest", partitions=1)
     total = 600
     msgs = [
-        b'{"occurred_at_ms": %d, "gps": {"speed": %d}}'
+        b'{"occurred_at_ms": %d, "evts": [{"speed": %d}]}'
         % (1_700_000_000_000 + i, i)
         for i in range(total)
     ]
     broker.produce_batched("splitnest", 0, msgs)
-    sample = json.dumps({"occurred_at_ms": 1, "gps": {"speed": 2}})
+    sample = json.dumps({"occurred_at_ms": 1, "evts": [{"speed": 2}]})
     src = (
         KafkaTopicBuilder(broker.bootstrap)
         .with_topic("splitnest")
