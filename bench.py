@@ -816,6 +816,126 @@ def _kafka_e2e_baseline(broker, total) -> float:
     return rps
 
 
+def run_ingest_scale(batches) -> dict:
+    """Max-sustainable-ingest measurement (round-4 weak item: the kafka_e2e
+    numbers are per-core; where does the Python-side pump top out?): the raw
+    multi-partition pump — native wire fetch → native JSON decode →
+    RecordBatch intern — one reader thread per partition, NO windowing.
+    Reports aggregate rows/s at 1/2/4/8 partitions plus per-point thread-
+    scaling efficiency (rps[N] / (N * rps[1])).
+
+    Scaling works at all only because the ctypes foreign calls (fetch,
+    parse) drop the GIL for the C++ portion; the efficiency number is the
+    honest measure of how much Python-side per-fetch work remains.  The
+    embedded broker runs in-process, so its service cost (blob slicing +
+    socket sends under the GIL) is INCLUDED — against a remote broker the
+    pump has strictly more headroom, i.e. the reported ceiling is
+    conservative."""
+    import threading
+
+    from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    payloads = _json_payloads(batches)
+    total = len(payloads)
+    points: dict[int, float] = {}
+    point_failures: dict[int, list[str]] = {}
+    for parts in (1, 2, 4, 8):
+        broker = MockKafkaBroker().start()
+        try:
+            broker.create_topic("bench_ingest", partitions=parts)
+            for p in range(parts):
+                broker.produce_batched("bench_ingest", p, payloads[p::parts])
+            src = (
+                KafkaTopicBuilder(broker.bootstrap)
+                .with_topic("bench_ingest")
+                .with_encoding("json")
+                .with_group_id("bench-ingest-scale")
+                .with_timestamp_column("occurred_at_ms")
+                .with_schema(_e2e_schema())
+                .build_reader()
+            )
+            readers = src.partitions()
+            targets = [len(payloads[p::parts]) for p in range(parts)]
+            counts = [0] * parts
+            fails: list[str] = []
+
+            def drain(i, r):
+                try:
+                    deadline = time.monotonic() + 180.0
+                    while counts[i] < targets[i]:
+                        b = r.read(timeout_s=0.25)
+                        if b is not None and b.num_rows:
+                            counts[i] += b.num_rows
+                        elif time.monotonic() > deadline:
+                            fails.append(f"partition {i} stalled at "
+                                         f"{counts[i]}/{targets[i]}")
+                            return
+                except Exception as e:  # surfaced in the point's log line
+                    fails.append(f"partition {i}: {e!r}")
+
+            threads = [
+                threading.Thread(target=drain, args=(i, r), daemon=True)
+                for i, r in enumerate(readers)
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            got = sum(counts)
+            log(f"ingest_scale[{parts}p]: {got / dt:,.0f} rows/s "
+                f"({got:,}/{total:,} rows, {dt:.2f}s)"
+                + (f" FAILURES {fails}" if fails else ""))
+            # a stalled/failed partition skews got/dt arbitrarily (dt
+            # absorbs the stall) — a failed point must be visibly failed
+            # in the artifact, never a silently-wrong number
+            if fails or got < total:
+                point_failures[parts] = fails or [
+                    f"short read: {got}/{total} rows"
+                ]
+            else:
+                points[parts] = got / dt
+        finally:
+            broker.stop()
+    if not points:
+        return {
+            "metric": "rows_per_sec_max_sustainable_ingest_fetch_decode",
+            "value": 0,
+            "unit": "rows/s",
+            "vs_baseline": None,
+            "device": "host",
+            "point_failures": {
+                str(k): v for k, v in point_failures.items()
+            },
+            "host_cores": os.cpu_count(),
+            "host_load_1m": round(os.getloadavg()[0], 2),
+        }
+    base = points.get(1)
+    best = max(points, key=points.get)
+    return {
+        "metric": "rows_per_sec_max_sustainable_ingest_fetch_decode",
+        "value": round(points[best]),
+        "unit": "rows/s",
+        # for this config the ratio is pump scaling (best aggregate over
+        # single-partition), not engine-vs-cpu — there is no engine here
+        "vs_baseline": round(points[best] / base, 3) if base else None,
+        "device": "host",
+        "best_partitions": best,
+        "points_rows_per_s": {str(k): round(v) for k, v in points.items()},
+        "scaling_efficiency": {
+            str(k): round(v / (k * base), 3) for k, v in points.items()
+        } if base else None,
+        "point_failures": {str(k): v for k, v in point_failures.items()},
+        # a 1-core host can only show partition-multiplex OVERHEAD (perfect
+        # flat = 1/N efficiency); true thread scaling needs cores — record
+        # the context so the numbers aren't misread as a GIL ceiling
+        "host_cores": os.cpu_count(),
+        "host_load_1m": round(os.getloadavg()[0], 2),
+    }
+
+
 def _kafka_e2e_latency(parts, sustainable: float) -> dict:
     """Paced producer thread into a fresh topic; latency = emit wall −
     wall(window close), sampled per emitted window close.  The pace is
@@ -1860,6 +1980,17 @@ def run_config(device: str) -> dict:
     latency + CPU baseline) and return the one-line JSON dict."""
     global NUM_KEYS, BATCH_ROWS, TOTAL_ROWS
     config = CONFIG
+    if config == "ingest_scale":
+        if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
+            TOTAL_ROWS = 4_000_000  # bounded by broker memory + encode time
+        log(f"generating {TOTAL_ROWS:,} rows ...")
+        _, batches = gen_batches()
+        out = run_ingest_scale(batches)
+        # all-points-failed dicts omit best_partitions/points — .get, so
+        # the failure artifact still gets emitted instead of a KeyError
+        log(f"engine[ingest_scale]: {out['value']:,} rows/s "
+            f"@ {out.get('best_partitions')}p {out.get('points_rows_per_s')}")
+        return out
     if config == "kafka_e2e":
         if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
             TOTAL_ROWS = 4_000_000  # bounded by broker memory + encode time
@@ -1971,7 +2102,8 @@ def main():
         _ckpt_child_main()
         return
     if CONFIG not in (
-        "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e"
+        "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
+        "ingest_scale",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     device = init_backend()

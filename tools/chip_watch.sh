@@ -47,18 +47,21 @@ echo "$(date -u +%H:%M:%S) chip_watch: relay OPEN"
 # init-time tunnel drop: ran zero cells, costs seconds — re-wait instead,
 # so a flapping relay cannot exhaust the budget before any work happens).
 run_phase() {
+    # 2>&1: bench's log() writes diagnostics (relay waits, backend-init
+    # progress, watchdog state) to stderr — r5's first launch had fd2 on
+    # /dev/null and the wait loop was invisible.  Keep it in the log.
     case "$1" in
     main)
         python tools/chip_ab.py \
             --out "$OUT" --resume --finals-ab --host-pipeline \
             --strategies partial_merge,scatter \
-            --cell-timeout 1800
+            --cell-timeout 1800 2>&1
         ;;
     pallas)
         python tools/chip_ab.py \
             --out "$OUT" --resume --no-quick \
             --configs sliding,simple --strategies pallas_dense \
-            --cell-timeout 1800
+            --cell-timeout 1800 2>&1
         ;;
     esac
 }
