@@ -215,8 +215,19 @@ def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
                 col[i] = null
             else:
                 try:
+                    # out-of-int64-range ints saturate like the native
+                    # parser's strtoll semantics (json.loads accepts
+                    # 20-digit ints; refusing would fail the batch) —
+                    # same clamp _normalize_nested applies on nested leaves
+                    if (
+                        npdt.kind == "i"
+                        and isinstance(v, int)
+                        and not isinstance(v, bool)
+                    ):
+                        v = min(max(v, -0x8000000000000000), 0x7FFFFFFFFFFFFFFF)
                     col[i] = v
-                except (TypeError, ValueError):
+                except (TypeError, ValueError, OverflowError):
+                    # OverflowError: float('inf') into an int column
                     raise FormatError(
                         f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
                     ) from None

@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <vector>
 
@@ -217,6 +218,9 @@ bool parse_string(Cursor& c, std::string& out) {
 // The full token must be consumed or the row fails (so "1e5" on an int
 // column cannot silently truncate to 1, and "inf"/"nan" — which
 // from_chars would accept but JSON forbids — yield an empty token).
+// The three literals json.loads DOES accept (NaN/Infinity/-Infinity;
+// our own JsonRowEncoder emits Infinity for inf) are matched by spelling
+// in parse_f64_at, keeping the native and Python decode paths identical.
 inline const uint8_t* num_token_end(const uint8_t* p, const uint8_t* e) {
   while (p < e) {
     uint8_t ch = *p;
@@ -265,6 +269,23 @@ inline bool parse_i64_at(const uint8_t*& q, const uint8_t* e, int64_t& v) {
 }
 
 inline bool parse_f64_at(const uint8_t*& q, const uint8_t* e, double& v) {
+  // the exact (case-sensitive) non-finite literals json.loads accepts;
+  // int columns stay strict — the Python path also rejects them there
+  if (e - q >= 3 && memcmp(q, "NaN", 3) == 0) {
+    v = std::numeric_limits<double>::quiet_NaN();
+    q += 3;
+    return true;
+  }
+  if (e - q >= 8 && memcmp(q, "Infinity", 8) == 0) {
+    v = std::numeric_limits<double>::infinity();
+    q += 8;
+    return true;
+  }
+  if (e - q >= 9 && memcmp(q, "-Infinity", 9) == 0) {
+    v = -std::numeric_limits<double>::infinity();
+    q += 9;
+    return true;
+  }
   const uint8_t* te = num_token_end(q, e);
   if (te == q) return false;
   auto r = std::from_chars((const char*)q, (const char*)te, v);

@@ -988,3 +988,65 @@ def test_json_nested_leaf_value_width_parity():
     assert isinstance(vals[0][0]["f"], float) and isinstance(vals[1][0]["f"], float)
     assert vals[0][0]["i"] == 2**63 - 1
     assert vals[0][1]["i"] == -(2**63)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_json_nonfinite_literals_both_paths(use_native):
+    """json.loads accepts exactly NaN / Infinity / -Infinity, and our own
+    JsonRowEncoder emits Infinity for inf — so a sink->source round trip
+    must decode on BOTH paths (review-found divergence: the native parser
+    hard-failed these, breaking re-ingest of engine-emitted bytes).  Int
+    leaves stay strict on both paths."""
+    schema = Schema(
+        [
+            Field("reading", DataType.FLOAT64),
+            Field(
+                "imu",
+                DataType.STRUCT,
+                children=(Field("lat", DataType.FLOAT64),),
+            ),
+        ]
+    )
+    dec = JsonDecoder(schema, use_native=use_native)
+    if use_native:
+        assert dec._native is not None, "native parser failed to build"
+    rows = [
+        b'{"reading": Infinity, "imu": {"lat": -Infinity}}',
+        b'{"reading": NaN, "imu": {"lat": NaN}}',
+        b'{"reading": 1.5, "imu": {"lat": 2.5}}',
+        # repeat the literal shape so the native FAST path (layout adopted
+        # from an earlier row) takes it too, not just the general path
+        b'{"reading": Infinity, "imu": {"lat": -Infinity}}',
+    ]
+    for r in rows:
+        dec.push(r)
+    b = dec.flush()
+    reading = b.column("reading")
+    assert np.isposinf(reading[[0, 3]]).all() and np.isnan(reading[1])
+    lats = [v["lat"] for v in b.column("imu").tolist()]
+    assert np.isneginf(lats[0]) and np.isnan(lats[1]) and lats[2] == 2.5
+    assert np.isneginf(lats[3])
+    # -NaN / +Infinity are NOT json.loads spellings: both paths reject
+    dec2 = JsonDecoder(schema, use_native=use_native)
+    dec2.push(b'{"reading": +Infinity, "imu": null}')
+    with pytest.raises(FormatError):
+        dec2.flush()
+    # int leaves: non-finite literals are a type error on both paths
+    int_schema = Schema([Field("n", DataType.INT64)])
+    dec3 = JsonDecoder(int_schema, use_native=use_native)
+    dec3.push(b'{"n": Infinity}')
+    with pytest.raises(FormatError):
+        dec3.flush()
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_json_flat_int64_saturation_both_paths(use_native):
+    """Top-level (flat-schema) out-of-int64-range ints saturate like the
+    nested leaves do (review-found divergence: native saturated, the
+    Python fallback raised — the same producer stream must not fail only
+    on hosts without the native lib)."""
+    sch = Schema([Field("n", DataType.INT64)])
+    dec = JsonDecoder(sch, use_native=use_native)
+    dec.push(b'{"n": 99999999999999999999}')
+    dec.push(b'{"n": -99999999999999999999}')
+    assert dec.flush().column("n").tolist() == [2**63 - 1, -(2**63)]
