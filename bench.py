@@ -410,6 +410,11 @@ def gen_batches(
     num_keys = num_keys or NUM_KEYS
     total_rows = total_rows or TOTAL_ROWS
     batch_rows = batch_rows or BATCH_ROWS
+    # rows below one batch bucket must still produce a batch — a reduced-
+    # rows quick cell (chip_ab first-evidence tier) with the default 131K
+    # bucket otherwise generates ZERO batches and every cell dies in
+    # MemorySource ("needs at least one batch")
+    batch_rows = min(batch_rows, total_rows)
     schema = Schema(
         [
             Field("occurred_at_ms", DataType.INT64, nullable=False),

@@ -30,7 +30,13 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 CONFIGS = ["simple", "sliding", "highcard", "join", "checkpoint"]
-STRATEGIES = ["scatter", "pallas_dense", "partial_merge"]
+# pallas_dense is out of the default matrix (VERDICT r4 #8 decision): in
+# the only chip evidence (AB_REPORT_r2.json) it lost every config to
+# partial_merge (1.24-2.76x vs 3.19-9.56x) — behind a ~20-35 MB/s tunnel
+# a row-shipping kernel cannot beat edge reduction.  It stays runnable
+# via --strategies pallas_dense (chip_watch's phase 2 runs exactly that
+# in its plausible-win regime: emission-heavy sliding, low cardinality).
+STRATEGIES = ["scatter", "partial_merge"]
 
 
 def main():
