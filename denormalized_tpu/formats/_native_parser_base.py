@@ -185,9 +185,15 @@ class ColumnarNativeParser:
         if count == 0:
             return np.empty(0, dtype=np_dtype if kind != "str" else object)
         if kind == "i64":
-            return np.ctypeslib.as_array(
+            vals = np.ctypeslib.as_array(
                 self._fn("col_i64")(self._h, ci), shape=(count,)
-            ).astype(np_dtype, copy=True)
+            )
+            if np.dtype(np_dtype).itemsize < 8:
+                # narrowing (INT32 columns): saturate like the i64 parse
+                # itself does — astype alone would WRAP out-of-range values
+                info = np.iinfo(np_dtype)
+                vals = np.clip(vals, info.min, info.max)
+            return vals.astype(np_dtype, copy=True)
         if kind == "f64":
             return np.ctypeslib.as_array(
                 self._fn("col_f64")(self._h, ci), shape=(count,)

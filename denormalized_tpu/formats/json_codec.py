@@ -152,12 +152,22 @@ def _normalize_nested(v, f: Field):
     if f.dtype in (DataType.INT32, DataType.INT64, DataType.TIMESTAMP_MS):
         # out-of-int64-range: the native parser keeps strtoll's saturate
         # semantics (json.loads accepts 20-digit ints, so refusing would
-        # fail the batch); clamp identically here
-        if v > 0x7FFFFFFFFFFFFFFF:
-            return 0x7FFFFFFFFFFFFFFF
-        if v < -0x8000000000000000:
-            return -0x8000000000000000
+        # fail the batch); clamp identically here.  (Nested leaves live in
+        # object columns on both paths — no numpy narrowing — so INT32
+        # nested leaves saturate at i64 bounds exactly like native.)
+        return _saturate_int(v, _I64_MIN, _I64_MAX)
     return v
+
+
+_I64_MIN, _I64_MAX = -0x8000000000000000, 0x7FFFFFFFFFFFFFFF
+
+
+def _saturate_int(v: int, lo: int, hi: int) -> int:
+    """strtoll-style saturation shared by both decode paths (the native
+    parser clamps at parse for i64 and at extraction for narrower
+    columns; the Python path must clamp identically or the same producer
+    stream fails on one host and succeeds on another)."""
+    return hi if v > hi else lo if v < lo else v
 
 
 def _null_of(dtype: DataType):
@@ -208,29 +218,39 @@ def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
         col = np.zeros(n, dtype=npdt)
         mask = np.ones(n, dtype=bool)
         null = _null_of(f.dtype)
+        want = _LEAF_PYTYPES.get(f.dtype)
+        # integer columns saturate wide JSON ints at the DECLARED width,
+        # matching the native path (strtoll i64 saturation at parse, clip
+        # at narrowing extraction) — numpy assignment alone would raise
+        # (int64) or wrap (int32)
+        info = np.iinfo(npdt) if npdt.kind == "i" else None
         for i, o in enumerate(objs):
             v = o.get(f.name)
             if v is None:
                 mask[i] = False
                 col[i] = null
-            else:
-                try:
-                    # out-of-int64-range ints saturate like the native
-                    # parser's strtoll semantics (json.loads accepts
-                    # 20-digit ints; refusing would fail the batch) —
-                    # same clamp _normalize_nested applies on nested leaves
-                    if (
-                        npdt.kind == "i"
-                        and isinstance(v, int)
-                        and not isinstance(v, bool)
-                    ):
-                        v = min(max(v, -0x8000000000000000), 0x7FFFFFFFFFFFFFFF)
-                    col[i] = v
-                except (TypeError, ValueError, OverflowError):
-                    # OverflowError: float('inf') into an int column
-                    raise FormatError(
-                        f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
-                    ) from None
+                continue
+            # same leaf strictness as the native parser and the nested
+            # normalizer: a float or bool on an int column (or non-bool on
+            # a bool column) fails the batch on BOTH paths — numpy's
+            # unsafe-cast assignment would otherwise truncate 1.5 -> 1
+            # only on hosts without the native lib
+            if want is not None and (
+                not isinstance(v, want)
+                or (bool not in want and isinstance(v, bool))
+            ):
+                raise FormatError(
+                    f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
+                )
+            if info is not None:
+                v = _saturate_int(v, int(info.min), int(info.max))
+            try:
+                col[i] = v
+            except (TypeError, ValueError, OverflowError):
+                # e.g. 1e200 into f32 is fine (inf) but exotic objects are not
+                raise FormatError(
+                    f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
+                ) from None
         cols.append(col)
         masks.append(None if mask.all() else mask)
     return RecordBatch(schema, cols, masks)

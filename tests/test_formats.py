@@ -1050,3 +1050,30 @@ def test_json_flat_int64_saturation_both_paths(use_native):
     dec.push(b'{"n": 99999999999999999999}')
     dec.push(b'{"n": -99999999999999999999}')
     assert dec.flush().column("n").tolist() == [2**63 - 1, -(2**63)]
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_json_int32_saturation_and_strict_leaves_both_paths(use_native):
+    """INT32 columns saturate at the declared width on both paths (native
+    previously WRAPPED via astype; Python raised), and non-int leaf values
+    on int columns fail the batch on both paths (numpy's unsafe-cast
+    assignment silently truncated 1.5 -> 1 / true -> 1 on the Python
+    fallback only — review-found divergences)."""
+    sch32 = Schema([Field("n", DataType.INT32)])
+    dec = JsonDecoder(sch32, use_native=use_native)
+    dec.push(b'{"n": 4294967296}')   # 2**32: wraps to 0 under astype
+    dec.push(b'{"n": -4294967296}')
+    dec.push(b'{"n": 7}')
+    assert dec.flush().column("n").tolist() == [2**31 - 1, -(2**31), 7]
+    for bad in (b'{"n": 1.5}', b'{"n": true}'):
+        d = JsonDecoder(Schema([Field("n", DataType.INT64)]),
+                        use_native=use_native)
+        d.push(bad)
+        with pytest.raises(FormatError):
+            d.flush()
+    # bool columns: only true/false — an int is not a bool on either path
+    d = JsonDecoder(Schema([Field("b", DataType.BOOL)]),
+                    use_native=use_native)
+    d.push(b'{"b": 1}')
+    with pytest.raises(FormatError):
+        d.flush()
