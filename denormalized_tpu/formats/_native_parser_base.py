@@ -179,7 +179,13 @@ class ColumnarNativeParser:
         valid = np.ctypeslib.as_array(
             self._fn("col_valid")(self._h, ci), shape=(count,)
         ).astype(bool) if count else np.ones(0, dtype=bool)
-        return self._scalar_values(ci, kind, count, np_dtype), valid
+        vals = self._scalar_values(ci, kind, count, np_dtype)
+        if kind == "str" and not valid.all():
+            # masked-out strings materialize as None, matching the Python
+            # fallback and the nested reassembly (numeric columns use 0 on
+            # both paths; '' here would differ from the fallback's None)
+            vals[~valid] = None
+        return vals, valid
 
     def _scalar_values(self, ci: int, kind: str, count: int, np_dtype):
         if count == 0:
@@ -195,9 +201,13 @@ class ColumnarNativeParser:
                 vals = np.clip(vals, info.min, info.max)
             return vals.astype(np_dtype, copy=True)
         if kind == "f64":
-            return np.ctypeslib.as_array(
-                self._fn("col_f64")(self._h, ci), shape=(count,)
-            ).astype(np_dtype, copy=True)
+            # narrowing to f32 overflows out-of-range values to +-inf —
+            # the same result the Python fallback's element assignment
+            # produces; the RuntimeWarning is expected, not actionable
+            with np.errstate(over="ignore"):
+                return np.ctypeslib.as_array(
+                    self._fn("col_f64")(self._h, ci), shape=(count,)
+                ).astype(np_dtype, copy=True)
         if kind == "bool":
             return np.ctypeslib.as_array(
                 self._fn("col_bool")(self._h, ci), shape=(count,)

@@ -148,7 +148,7 @@ def _normalize_nested(v, f: Field):
         # int-typed JSON on a float leaf: the native parser always
         # materializes float — match it, or sink/checkpoint bytes would
         # differ by decode path ('3' vs '3.0')
-        return float(v)
+        return _to_float(v)
     if f.dtype in (DataType.INT32, DataType.INT64, DataType.TIMESTAMP_MS):
         # out-of-int64-range: the native parser keeps strtoll's saturate
         # semantics (json.loads accepts 20-digit ints, so refusing would
@@ -168,6 +168,16 @@ def _saturate_int(v: int, lo: int, hi: int) -> int:
     columns; the Python path must clamp identically or the same producer
     stream fails on one host and succeeds on another)."""
     return hi if v > hi else lo if v < lo else v
+
+
+def _to_float(v) -> float:
+    """int/float → float with strtod's overflow semantics: a JSON int too
+    large for a double becomes ±inf (the native path's result), never an
+    OverflowError escaping the codec's error contract."""
+    try:
+        return float(v)
+    except OverflowError:
+        return float("inf") if v > 0 else float("-inf")
 
 
 def _null_of(dtype: DataType):
@@ -224,33 +234,43 @@ def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
         # at narrowing extraction) — numpy assignment alone would raise
         # (int64) or wrap (int32)
         info = np.iinfo(npdt) if npdt.kind == "i" else None
-        for i, o in enumerate(objs):
-            v = o.get(f.name)
-            if v is None:
-                mask[i] = False
-                col[i] = null
-                continue
-            # same leaf strictness as the native parser and the nested
-            # normalizer: a float or bool on an int column (or non-bool on
-            # a bool column) fails the batch on BOTH paths — numpy's
-            # unsafe-cast assignment would otherwise truncate 1.5 -> 1
-            # only on hosts without the native lib
-            if want is not None and (
-                not isinstance(v, want)
-                or (bool not in want and isinstance(v, bool))
-            ):
-                raise FormatError(
-                    f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
-                )
-            if info is not None:
-                v = _saturate_int(v, int(info.min), int(info.max))
-            try:
-                col[i] = v
-            except (TypeError, ValueError, OverflowError):
-                # e.g. 1e200 into f32 is fine (inf) but exotic objects are not
-                raise FormatError(
-                    f"field {f.name!r}: cannot coerce {v!r} to {f.dtype.value}"
-                ) from None
+        # f32 columns: out-of-range doubles overflow to +-inf on
+        # assignment — same result as the native path's narrowing cast;
+        # the RuntimeWarning is expected, not actionable
+        with np.errstate(over="ignore"):
+            for i, o in enumerate(objs):
+                v = o.get(f.name)
+                if v is None:
+                    mask[i] = False
+                    col[i] = null
+                    continue
+                # same leaf strictness as the native parser and the nested
+                # normalizer: a float or bool on an int column (or non-bool
+                # on a bool column) fails the batch on BOTH paths — numpy's
+                # unsafe-cast assignment would otherwise truncate 1.5 -> 1
+                # only on hosts without the native lib
+                if want is not None and (
+                    not isinstance(v, want)
+                    or (bool not in want and isinstance(v, bool))
+                ):
+                    raise FormatError(
+                        f"field {f.name!r}: cannot coerce {v!r} to "
+                        f"{f.dtype.value}"
+                    )
+                if info is not None:
+                    v = _saturate_int(v, int(info.min), int(info.max))
+                elif npdt.kind == "f" and isinstance(v, int):
+                    # ints beyond double range saturate to +-inf like the
+                    # native path's strtod overflow
+                    v = _to_float(v)
+                try:
+                    col[i] = v
+                except (TypeError, ValueError, OverflowError):
+                    # 1e200 into f32 is fine (inf); exotic objects are not
+                    raise FormatError(
+                        f"field {f.name!r}: cannot coerce {v!r} to "
+                        f"{f.dtype.value}"
+                    ) from None
         cols.append(col)
         masks.append(None if mask.all() else mask)
     return RecordBatch(schema, cols, masks)
