@@ -1,0 +1,41 @@
+"""Fast end-to-end run of the soak harness (tools/soak.py).
+
+The real soak is minutes long (committed artifact SOAK.json); this keeps
+the harness itself CI-validated: a ~20s run with one mid-stream SIGKILL
+must lose zero windows, match the golden, and see EOS.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_soak_smoke(tmp_path):
+    out = tmp_path / "soak.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "soak.py"),
+            "--minutes", "0.35", "--kill-every", "8",
+            "--pace", "150000", "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    r = json.loads(out.read_text())
+    if r.get("aborted") and "relay active" in r["aborted"]:
+        import pytest
+
+        pytest.skip("soak yielded to an open TPU relay window")
+    assert r["aborted"] is None, r
+    assert r["eos_done_seen"], r
+    assert r["kills"] >= 1, r
+    assert r["windows_lost"] == 0, r
+    assert r["windows_spurious"] == 0, r
+    assert r["windows_mismatched"] == 0, r
+    assert r["emitted_windows"] == r["golden_windows"] > 0, r
+    # recovery after SIGKILL banks its first emission promptly
+    for t in r["recovery_first_emit_s"]:
+        assert t < 30, r

@@ -1,0 +1,514 @@
+"""Long-running stability soak: one checkpointed streaming job, paced for
+minutes, SIGKILLed and restored repeatedly, leak- and loss-checked.
+
+The unit/property tests prove single kill/restore cycles; this proves the
+ENGINE PROCESS is stable over wall-clock time: no unbounded RSS growth in
+a long-lived child (state rings, LSM checkpoints, emission buffers), no
+window lost or corrupted across many restores, recovery time bounded.
+The reference has no analog (its de-facto soak is "run the docker example
+and watch", SURVEY §4); a framework claiming checkpoint/restore parity
+should demonstrate it surviving repetition.
+
+    python tools/soak.py [--minutes 12] [--pace 200000] [--kill-every 90]
+                         [--out SOAK.json]
+
+Design:
+- The child process runs the simple windowed pipeline (1s tumbling
+  count/min/max/avg by key) over a DETERMINISTIC paced source whose
+  batches are a pure function of the batch index (seeded RNG per batch),
+  with checkpointing every 2s to a shared LSM dir.  The source implements
+  ``offset_snapshot``/``offset_restore`` (fast-forward to batch i), so a
+  restored child resumes exactly where the checkpoint cut — the same
+  contract KafkaPartitionReader honors, exercised here through the public
+  Source extension API.
+- The parent samples child RSS from /proc, kills it with SIGKILL every
+  --kill-every seconds (the LAST segment runs to EOS), respawns it, and
+  finally compares the union of all segments' emitted windows against an
+  incrementally-computed numpy golden.  Duplicated emissions across a
+  restore are counted, not failed (at-least-once output, exactly-once
+  state — the reference's contract too).
+- Relay-aware: if the TPU tunnel relay opens mid-soak, the soak aborts
+  gracefully (partial JSON, exit 0) so it never steals the single core
+  from a chip-evidence run.
+
+The parent never imports jax; the child pins jax to CPU before first use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+T0 = 1_700_000_000_000
+N_KEYS = 10
+WINDOW_MS = 1000
+
+
+def relay_active() -> bool:
+    """Relay open for claims OR already held by a chip run.  The active
+    connect probe alone is not enough: while a claim is in flight the
+    single-client relay REFUSES new connects (bench.py
+    ``_relay_conn_established`` rationale), so a busy tunnel would read
+    "closed" and the soak would keep saturating the core under a live
+    chip run.  Scan /proc/net/tcp for ANY established loopback
+    connection to a relay port (chip_ab's claim shows up there) as the
+    busy signal.  Probe logic and the port list come from bench — one
+    source of truth."""
+    import bench  # env reads only at import; no jax
+
+    if bench._relay_open():
+        return True
+    # both tables: a dual-stack client's v4-mapped connection lands in
+    # tcp6 (endswith covers ::ffff:127.0.0.1), same as bench's own
+    # passive check
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    if len(parts) < 4 or parts[3] != "01":  # ESTABLISHED
+                        continue
+                    ip, _, port = parts[2].partition(":")
+                    if (
+                        ip.endswith("0100007F")
+                        and int(port, 16) in bench._RELAY_PROBE_PORTS
+                    ):
+                        return True
+        except (OSError, ValueError):
+            continue
+    return False
+
+
+# -- deterministic feed: batch i is a pure function of (seed, i) ---------
+
+
+def batch_arrays(i: int, batch_rows: int, pace: float, seed: int = 11):
+    """(ts, key_ids, vals) for batch i.  Event time advances at exactly
+    ``pace`` rows per event-second, so event time == wall time when the
+    feed keeps up."""
+    rng = np.random.default_rng(seed * 1_000_003 + i)
+    span_ms = batch_rows * 1000.0 / pace
+    base = T0 + int(i * span_ms)
+    ts = base + np.sort(rng.integers(0, max(1, int(span_ms)), batch_rows))
+    keys = rng.integers(0, N_KEYS, batch_rows)
+    vals = np.round(rng.normal(50.0, 10.0, batch_rows), 6)
+    return ts.astype(np.int64), keys, vals
+
+
+def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i into the golden {(ws, key): [cnt, min, max, sum]},
+    vectorized: the Python loop runs per GROUP (~2 windows x N_KEYS per
+    batch), not per row — the parent must not steal the single core from
+    the engine child it is measuring."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace)
+    ws = (ts // WINDOW_MS) * WINDOW_MS
+    comp = ws * N_KEYS + keys  # composite (window, key) id
+    order = np.argsort(comp, kind="stable")
+    v = vals[order]
+    uniq, starts = np.unique(comp[order], return_index=True)
+    cnts = np.diff(np.append(starts, len(v)))
+    mins = np.minimum.reduceat(v, starts)
+    maxs = np.maximum.reduceat(v, starts)
+    sums = np.add.reduceat(v, starts)
+    for u, c, mn, mx, sm in zip(
+        uniq.tolist(), cnts.tolist(), mins.tolist(), maxs.tolist(),
+        sums.tolist(),
+    ):
+        w, k = divmod(u, N_KEYS)
+        a = agg.setdefault(
+            (w, f"sensor_{k}"), [0, float("inf"), float("-inf"), 0.0]
+        )
+        a[0] += c
+        if mn < a[1]:
+            a[1] = mn
+        if mx > a[2]:
+            a[2] = mx
+        a[3] += sm
+
+
+# -- child ---------------------------------------------------------------
+
+
+def child_main() -> None:
+    sys.path.insert(0, str(REPO))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from denormalized_tpu import Context, col
+    from denormalized_tpu.api import functions as F
+    from denormalized_tpu.api.context import EngineConfig
+    from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.sources.base import (
+        PartitionReader,
+        Source,
+        attach_canonical_timestamp,
+        canonicalize_schema,
+    )
+
+    batch_rows = int(os.environ["SOAK_BATCH_ROWS"])
+    pace = float(os.environ["SOAK_PACE"])
+    total_batches = int(os.environ["SOAK_TOTAL_BATCHES"])
+    ckpt_dir = os.environ["SOAK_CKPT_DIR"]
+    out_path = os.environ["SOAK_OUT"]
+
+    schema = Schema([
+        Field("occurred_at_ms", DataType.INT64, nullable=False),
+        Field("sensor_name", DataType.STRING, nullable=False),
+        Field("reading", DataType.FLOAT64),
+    ])
+    key_names = np.array(
+        [f"sensor_{k}" for k in range(N_KEYS)], dtype=object
+    )
+
+    class SoakPartition(PartitionReader):
+        """Deterministic paced feed with Kafka-grade restore semantics:
+        batch i regenerates from the index, so offset_restore is a pure
+        fast-forward.  Pacing re-anchors at the restored index — the
+        source IS the producer here, so a restored child continues at the
+        paced rate from the checkpoint cut (event time simply lags wall
+        clock by the downtime; window contents are index-deterministic
+        either way)."""
+
+        def __init__(self):
+            self._i = 0
+            self._anchor_wall = None
+            self._anchor_i = 0
+
+        def read(self, timeout_s=None):
+            if self._i >= total_batches:
+                return None
+            now = time.monotonic()
+            if self._anchor_wall is None:
+                self._anchor_wall = now
+                self._anchor_i = self._i
+            due = self._anchor_wall + (
+                (self._i - self._anchor_i) * batch_rows / pace
+            )
+            if now < due:
+                time.sleep(min(due - now, timeout_s or (due - now)))
+                if time.monotonic() < due:
+                    # not due yet: an empty heartbeat batch (canonical ts
+                    # column attached — downstream requires it on every
+                    # batch, rowful or not)
+                    return attach_canonical_timestamp(
+                        RecordBatch.empty(schema), "occurred_at_ms",
+                        fallback_ms=int(time.time() * 1000),
+                    )
+            ts, keys, vals = batch_arrays(self._i, batch_rows, pace)
+            self._i += 1
+            b = RecordBatch(schema, [ts, key_names[keys], vals])
+            return attach_canonical_timestamp(
+                b, "occurred_at_ms", fallback_ms=int(time.time() * 1000)
+            )
+
+        def offset_snapshot(self):
+            return {"i": self._i}
+
+        def offset_restore(self, snap):
+            self._i = int(snap["i"])
+            self._anchor_wall = None  # re-anchor pacing at the restored i
+
+    canon = canonicalize_schema(schema)
+
+    class SoakSource(Source):
+        name = "soak"
+
+        @property
+        def schema(self):
+            return canon
+
+        def partitions(self):
+            return [SoakPartition()]
+
+        @property
+        def unbounded(self):
+            return False
+
+    cfg = EngineConfig(
+        min_batch_bucket=batch_rows,
+        min_window_slots=32,
+        checkpoint=True,
+        checkpoint_interval_s=2.0,
+        state_backend_path=ckpt_dir,
+        emit_on_close=True,
+    )
+    ctx = Context(cfg)
+    ds = ctx.from_source(SoakSource(), name="soak").window(
+        ["sensor_name"],
+        [
+            F.count(col("reading")).alias("count"),
+            F.min(col("reading")).alias("min"),
+            F.max(col("reading")).alias("max"),
+            F.avg(col("reading")).alias("average"),
+        ],
+        WINDOW_MS,
+    )
+    with open(out_path, "a", buffering=1) as out:
+        out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
+        for batch in ds.stream():
+            if not batch.schema.has(WINDOW_START_COLUMN):
+                continue
+            now = time.time()
+            ws = batch.column(WINDOW_START_COLUMN)
+            names = batch.column("sensor_name")
+            for i in range(batch.num_rows):
+                out.write(json.dumps({
+                    "t": round(now, 3),
+                    "ws": int(ws[i]),
+                    "key": str(names[i]),
+                    "count": int(batch.column("count")[i]),
+                    "min": round(float(batch.column("min")[i]), 4),
+                    "max": round(float(batch.column("max")[i]), 4),
+                    "avg": round(float(batch.column("average")[i]), 4),
+                }) + "\n")
+        out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
+
+
+# -- parent --------------------------------------------------------------
+
+
+def read_emissions(paths) -> tuple[dict, int, bool]:
+    """ALL emitted window rows across segment files → ({(ws,key):
+    [tuple, ...]}, duplicate_emissions, done_seen) — every occurrence is
+    kept, so a wrong first emission can't hide behind a correct
+    re-emission after restore.  A torn tail line (SIGKILL mid-write) is
+    skipped."""
+    wins: dict = {}
+    dupes = 0
+    done = False
+    for path in paths:
+        try:
+            f = open(path)
+        except FileNotFoundError:
+            continue
+        with f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if o.get("event") == "done":
+                    done = True
+                elif "ws" in o:
+                    k = (o["ws"], o["key"])
+                    occ = wins.setdefault(k, [])
+                    if occ:
+                        dupes += 1
+                    occ.append((o["count"], o["min"], o["max"], o["avg"]))
+    return wins, dupes, done
+
+
+def rss_kb(pid: int) -> int | None:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        return None
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--minutes", type=float, default=12.0)
+    ap.add_argument("--pace", type=float, default=200_000.0)
+    ap.add_argument("--batch-rows", type=int, default=4096)
+    ap.add_argument("--kill-every", type=float, default=90.0)
+    ap.add_argument("--out", default=str(REPO / "SOAK.json"))
+    args = ap.parse_args()
+    if args.child:
+        child_main()
+        return
+
+    import shutil
+    import tempfile
+
+    total_batches = int(args.minutes * 60 * args.pace / args.batch_rows)
+    work = tempfile.mkdtemp(prefix="soak_")
+    ckpt_dir = os.path.join(work, "ckpt")
+    os.makedirs(ckpt_dir)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SOAK_BATCH_ROWS": str(args.batch_rows),
+        "SOAK_PACE": str(args.pace),
+        "SOAK_TOTAL_BATCHES": str(total_batches),
+        "SOAK_CKPT_DIR": ckpt_dir,
+    })
+
+    report = {
+        "minutes": args.minutes,
+        "pace_rows_per_s": args.pace,
+        "total_rows": total_batches * args.batch_rows,
+        "kill_every_s": args.kill_every,
+        "segments": [],
+    }
+
+    def write(extra=None):
+        report.update(extra or {})
+        Path(args.out).write_text(json.dumps(report, indent=1))
+
+    golden: dict = {}
+    golden_i = 0
+    seg_paths = []
+    seg = 0
+    kills_issued = 0
+    t_start = time.monotonic()
+    aborted = None
+    recovery_times = []
+    done = False
+    proc = None
+    try:
+        while not done:
+            seg += 1
+            out_path = os.path.join(work, f"emit_{seg}.jsonl")
+            seg_paths.append(out_path)
+            seg_env = dict(env)
+            seg_env["SOAK_OUT"] = out_path
+            t_spawn = time.monotonic()
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                env=seg_env, stdout=sys.stderr, stderr=sys.stderr,
+            )
+            # first-emission latency after spawn = recovery time (seg > 1)
+            first_emit = None
+            seg_rss = []  # sampled only AFTER first emission: a pre-exec
+            # or mid-import sample (~4KB) says nothing about the engine
+            kill_at = t_spawn + args.kill_every
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc != 0:
+                        aborted = f"segment {seg} child rc={rc}"
+                    done = True
+                    break
+                now = time.monotonic()
+                if first_emit is not None and (r := rss_kb(proc.pid)):
+                    seg_rss.append(r)
+                if first_emit is None:
+                    wins, _, _ = read_emissions([out_path])
+                    if wins:
+                        first_emit = now - t_spawn
+                        if seg > 1:
+                            recovery_times.append(round(first_emit, 2))
+                # fold golden forward while the child streams (parent is
+                # otherwise idle); stay ahead of the feed
+                target_i = min(
+                    total_batches,
+                    int((now - t_start) * args.pace / args.batch_rows)
+                    + 200,
+                )
+                while golden_i < target_i:
+                    golden_update(
+                        golden, golden_i, args.batch_rows, args.pace
+                    )
+                    golden_i += 1
+                if relay_active():
+                    aborted = "relay active (yielding core to chip run)"
+                    proc.kill()
+                    proc.wait(10)
+                    done = True
+                    break
+                if now >= kill_at:
+                    # never kill the final drain: once the feed's event
+                    # time is exhausted, let the segment run to EOS
+                    if golden_i >= total_batches:
+                        kill_at = float("inf")
+                        time.sleep(0.5)
+                        continue
+                    os.kill(proc.pid, signal.SIGKILL)
+                    kills_issued += 1
+                    proc.wait(10)
+                    break
+                time.sleep(0.5)
+            report["segments"].append({
+                "segment": seg,
+                "wall_s": round(time.monotonic() - t_spawn, 1),
+                "rss_kb_start": seg_rss[0] if seg_rss else None,
+                "rss_kb_max": max(seg_rss) if seg_rss else None,
+                "rss_kb_end": seg_rss[-1] if seg_rss else None,
+                "first_emit_s": (
+                    round(first_emit, 2) if first_emit else None
+                ),
+            })
+            write()
+            if aborted:
+                break
+        # finish golden
+        while golden_i < total_batches and not aborted:
+            golden_update(golden, golden_i, args.batch_rows, args.pace)
+            golden_i += 1
+        wins, dupes, done_seen = read_emissions(seg_paths)
+        lost = []
+        spurious = []
+        mismatched = []
+        if not aborted:
+            for k, (cnt, mn, mx, sm) in golden.items():
+                occs = wins.get(k)
+                if not occs:
+                    lost.append(k)
+                    continue
+                want = (cnt, round(mn, 4), round(mx, 4), round(sm / cnt, 4))
+                for got in occs:  # EVERY occurrence must match, dupes too
+                    if (
+                        got[0] != want[0]
+                        or abs(got[1] - want[1]) > 1e-3
+                        or abs(got[2] - want[2]) > 1e-3
+                        or abs(got[3] - want[3]) > 1e-3
+                    ):
+                        mismatched.append((k, got, want))
+            # spurious: emitted keys the golden never produced (corrupted
+            # ws/key after a restore would land here)
+            spurious = [k for k in wins if k not in golden]
+        write({
+            "aborted": aborted,
+            "eos_done_seen": done_seen,
+            "kills": kills_issued,
+            "recovery_first_emit_s": recovery_times,
+            "golden_windows": len(golden),
+            "emitted_windows": len(wins),
+            "duplicate_emissions": dupes,
+            "windows_lost": len(lost),
+            "windows_spurious": len(spurious),
+            "windows_mismatched": len(mismatched),
+            "mismatch_sample": mismatched[:3],
+            "spurious_sample": spurious[:3],
+            "ok": (
+                not aborted and done_seen and not lost and not spurious
+                and not mismatched and len(wins) == len(golden) > 0
+            ),
+        })
+        print(json.dumps({
+            "ok": report.get("ok"),
+            "kills": report.get("kills"),
+            "windows": len(wins),
+            "lost": len(lost),
+            "dupes": dupes,
+            "aborted": aborted,
+        }))
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
