@@ -1,8 +1,11 @@
-"""Fast end-to-end run of the soak harness (tools/soak.py).
+"""Fast end-to-end runs of the soak harness (tools/soak.py).
 
-The real soak is minutes long (committed artifact SOAK.json); this keeps
-the harness itself CI-validated: a ~20s run with one mid-stream SIGKILL
-must lose zero windows, match the golden, and see EOS.
+The real soaks are minutes long (committed artifacts SOAK.json /
+SOAK_JOIN.json / SOAK_SESSION.json); this keeps the harness itself
+CI-validated: a ~20s run with one mid-stream SIGKILL must lose zero
+windows, match the golden, and see EOS — for the simple windowed
+pipeline, the stream-join pipeline (join state is the hardest
+checkpoint-restore path), and session windows (exact bounds checked).
 """
 
 import json
@@ -10,14 +13,18 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
-def test_soak_smoke(tmp_path):
+@pytest.mark.parametrize("pipeline", ["simple", "join", "session"])
+def test_soak_smoke(tmp_path, pipeline):
     out = tmp_path / "soak.json"
     proc = subprocess.run(
         [
             sys.executable, str(REPO / "tools" / "soak.py"),
+            "--pipeline", pipeline,
             "--minutes", "0.35", "--kill-every", "8",
             "--pace", "150000", "--out", str(out),
         ],
@@ -26,9 +33,7 @@ def test_soak_smoke(tmp_path):
     assert proc.returncode == 0, proc.stderr[-800:]
     r = json.loads(out.read_text())
     if r.get("aborted") and "relay active" in r["aborted"]:
-        import pytest
-
-        pytest.skip("soak yielded to an open TPU relay window")
+        pytest.skip("soak yielded to an active TPU relay")
     assert r["aborted"] is None, r
     assert r["eos_done_seen"], r
     assert r["kills"] >= 1, r

@@ -9,12 +9,16 @@ The reference has no analog (its de-facto soak is "run the docker example
 and watch", SURVEY §4); a framework claiming checkpoint/restore parity
 should demonstrate it surviving repetition.
 
-    python tools/soak.py [--minutes 12] [--pace 200000] [--kill-every 90]
-                         [--out SOAK.json]
+    python tools/soak.py [--pipeline simple|join|session] [--minutes 12]
+                         [--pace 200000] [--kill-every 90] [--out SOAK.json]
 
 Design:
-- The child process runs the simple windowed pipeline (1s tumbling
-  count/min/max/avg by key) over a DETERMINISTIC paced source whose
+- The child process runs the chosen pipeline — ``simple`` (1s tumbling
+  count/min/max/avg by key), ``join`` (two independent streams windowed
+  then inner-joined on (key, window): join state rides the same
+  checkpoint barriers), or ``session`` (300ms-gap session windows over
+  a bursty feed: exact session bounds verified — the operator the
+  reference left ``todo!()``) — over a DETERMINISTIC paced source whose
   batches are a pure function of the batch index (seeded RNG per batch),
   with checkpointing every 2s to a shared LSM dir.  The source implements
   ``offset_snapshot``/``offset_restore`` (fast-forward to batch i), so a
@@ -138,6 +142,83 @@ def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
         a[3] += sm
 
 
+SEED_LEFT = 11
+SEED_RIGHT = 23
+
+
+def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i of BOTH streams into {(ws, key): [cnt_l, sum_l,
+    cnt_r, sum_r]} — the join emits (avg_t, avg_h) per (window, key)
+    present on both sides (at this pace every key is in every window).
+    Vectorized per group like golden_update."""
+    for off, seed in ((0, SEED_LEFT), (2, SEED_RIGHT)):
+        ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=seed)
+        ws = (ts // WINDOW_MS) * WINDOW_MS
+        comp = ws * N_KEYS + keys
+        order = np.argsort(comp, kind="stable")
+        v = vals[order]
+        uniq, starts = np.unique(comp[order], return_index=True)
+        cnts = np.diff(np.append(starts, len(v)))
+        sums = np.add.reduceat(v, starts)
+        for u, c, sm in zip(uniq.tolist(), cnts.tolist(), sums.tolist()):
+            w, k = divmod(u, N_KEYS)
+            a = agg.setdefault((w, f"sensor_{k}"), [0, 0.0, 0, 0.0])
+            a[off] += c
+            a[off + 1] += sm
+
+
+SESSION_GAP_MS = 300
+
+
+def burst_ts(ts: "np.ndarray") -> "np.ndarray":
+    """Squeeze each second's events into its first 600ms: the 400ms
+    event-time silence every second (> SESSION_GAP_MS) closes one session
+    per key per second; the mapping is monotonic, so batch-min watermarks
+    are preserved."""
+    sec = (ts // 1000) * 1000
+    frac = ts - sec
+    return sec + (frac * 3) // 5
+
+
+def golden_update_session(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i into {(key, sec): [cnt, min_v, max_v, sum_v,
+    min_ts, max_ts]} — one session per key per second under burst_ts;
+    emitted start = min_ts, end = max_ts + SESSION_GAP_MS."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace)
+    bts = burst_ts(ts)
+    sec = (bts // 1000) * 1000
+    comp = sec * N_KEYS + keys
+    order = np.argsort(comp, kind="stable")
+    v = vals[order]
+    t = bts[order]
+    uniq, starts = np.unique(comp[order], return_index=True)
+    cnts = np.diff(np.append(starts, len(v)))
+    vmins = np.minimum.reduceat(v, starts)
+    vmaxs = np.maximum.reduceat(v, starts)
+    vsums = np.add.reduceat(v, starts)
+    tmins = np.minimum.reduceat(t, starts)
+    tmaxs = np.maximum.reduceat(t, starts)
+    for u, c, mn, mx, sm, t0, t1 in zip(
+        uniq.tolist(), cnts.tolist(), vmins.tolist(), vmaxs.tolist(),
+        vsums.tolist(), tmins.tolist(), tmaxs.tolist(),
+    ):
+        w, k = divmod(u, N_KEYS)
+        a = agg.setdefault(
+            (w, f"sensor_{k}"),
+            [0, float("inf"), float("-inf"), 0.0, float("inf"), 0],
+        )
+        a[0] += c
+        if mn < a[1]:
+            a[1] = mn
+        if mx > a[2]:
+            a[2] = mx
+        a[3] += sm
+        if t0 < a[4]:
+            a[4] = t0
+        if t1 > a[5]:
+            a[5] = t1
+
+
 # -- child ---------------------------------------------------------------
 
 
@@ -151,7 +232,10 @@ def child_main() -> None:
     from denormalized_tpu import Context, col
     from denormalized_tpu.api import functions as F
     from denormalized_tpu.api.context import EngineConfig
-    from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+    from denormalized_tpu.common.constants import (
+        WINDOW_END_COLUMN,
+        WINDOW_START_COLUMN,
+    )
     from denormalized_tpu.common.record_batch import RecordBatch
     from denormalized_tpu.common.schema import DataType, Field, Schema
     from denormalized_tpu.sources.base import (
@@ -161,6 +245,7 @@ def child_main() -> None:
         canonicalize_schema,
     )
 
+    pipeline = os.environ.get("SOAK_PIPELINE", "simple")
     batch_rows = int(os.environ["SOAK_BATCH_ROWS"])
     pace = float(os.environ["SOAK_PACE"])
     total_batches = int(os.environ["SOAK_TOTAL_BATCHES"])
@@ -185,7 +270,8 @@ def child_main() -> None:
         clock by the downtime; window contents are index-deterministic
         either way)."""
 
-        def __init__(self):
+        def __init__(self, seed):
+            self._seed = seed
             self._i = 0
             self._anchor_wall = None
             self._anchor_i = 0
@@ -210,7 +296,11 @@ def child_main() -> None:
                         RecordBatch.empty(schema), "occurred_at_ms",
                         fallback_ms=int(time.time() * 1000),
                     )
-            ts, keys, vals = batch_arrays(self._i, batch_rows, pace)
+            ts, keys, vals = batch_arrays(
+                self._i, batch_rows, pace, seed=self._seed
+            )
+            if pipeline == "session":
+                ts = burst_ts(ts)
             self._i += 1
             b = RecordBatch(schema, [ts, key_names[keys], vals])
             return attach_canonical_timestamp(
@@ -227,14 +317,16 @@ def child_main() -> None:
     canon = canonicalize_schema(schema)
 
     class SoakSource(Source):
-        name = "soak"
+        def __init__(self, seed, name):
+            self._seed = seed
+            self.name = name
 
         @property
         def schema(self):
             return canon
 
         def partitions(self):
-            return [SoakPartition()]
+            return [SoakPartition(self._seed)]
 
         @property
         def unbounded(self):
@@ -249,16 +341,55 @@ def child_main() -> None:
         emit_on_close=True,
     )
     ctx = Context(cfg)
-    ds = ctx.from_source(SoakSource(), name="soak").window(
-        ["sensor_name"],
-        [
-            F.count(col("reading")).alias("count"),
-            F.min(col("reading")).alias("min"),
-            F.max(col("reading")).alias("max"),
-            F.avg(col("reading")).alias("average"),
-        ],
-        WINDOW_MS,
-    )
+    if pipeline == "session":
+        ds = ctx.from_source(
+            SoakSource(SEED_LEFT, "soak_s"), name="soak_s"
+        ).session_window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            SESSION_GAP_MS,
+        )
+    elif pipeline == "join":
+        # the bench 'join' shape: two independent streams, windowed avg
+        # each, inner-joined on (key, window) — the join operator's state
+        # (both sides' retained windows + matched flags) rides the same
+        # checkpoint barriers the window state does
+        left = ctx.from_source(
+            SoakSource(SEED_LEFT, "soak_t"), name="soak_t"
+        ).window(
+            ["sensor_name"], [F.avg(col("reading")).alias("avg_t")],
+            WINDOW_MS,
+        )
+        right = (
+            ctx.from_source(SoakSource(SEED_RIGHT, "soak_h"), name="soak_h")
+            .window(
+                ["sensor_name"], [F.avg(col("reading")).alias("avg_h")],
+                WINDOW_MS,
+            )
+            .with_column_renamed("sensor_name", "hs")
+            .with_column_renamed("window_start_time", "hws")
+            .with_column_renamed("window_end_time", "hwe")
+        )
+        ds = left.join(
+            right, "inner",
+            ["sensor_name", "window_start_time"], ["hs", "hws"],
+        )
+    else:
+        ds = ctx.from_source(SoakSource(SEED_LEFT, "soak"), name="soak").window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            WINDOW_MS,
+        )
     with open(out_path, "a", buffering=1) as out:
         out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
         for batch in ds.stream():
@@ -268,15 +399,36 @@ def child_main() -> None:
             ws = batch.column(WINDOW_START_COLUMN)
             names = batch.column("sensor_name")
             for i in range(batch.num_rows):
-                out.write(json.dumps({
-                    "t": round(now, 3),
-                    "ws": int(ws[i]),
-                    "key": str(names[i]),
-                    "count": int(batch.column("count")[i]),
-                    "min": round(float(batch.column("min")[i]), 4),
-                    "max": round(float(batch.column("max")[i]), 4),
-                    "avg": round(float(batch.column("average")[i]), 4),
-                }) + "\n")
+                if pipeline == "session":
+                    rec = {
+                        "t": round(now, 3),
+                        "ws": int(ws[i]),
+                        "key": str(names[i]),
+                        "we": int(batch.column(WINDOW_END_COLUMN)[i]),
+                        "count": int(batch.column("count")[i]),
+                        "min": round(float(batch.column("min")[i]), 4),
+                        "max": round(float(batch.column("max")[i]), 4),
+                        "avg": round(float(batch.column("average")[i]), 4),
+                    }
+                elif pipeline == "join":
+                    rec = {
+                        "t": round(now, 3),
+                        "ws": int(ws[i]),
+                        "key": str(names[i]),
+                        "avg_t": round(float(batch.column("avg_t")[i]), 4),
+                        "avg_h": round(float(batch.column("avg_h")[i]), 4),
+                    }
+                else:
+                    rec = {
+                        "t": round(now, 3),
+                        "ws": int(ws[i]),
+                        "key": str(names[i]),
+                        "count": int(batch.column("count")[i]),
+                        "min": round(float(batch.column("min")[i]), 4),
+                        "max": round(float(batch.column("max")[i]), 4),
+                        "avg": round(float(batch.column("average")[i]), 4),
+                    }
+                out.write(json.dumps(rec) + "\n")
         out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
 
 
@@ -310,7 +462,15 @@ def read_emissions(paths) -> tuple[dict, int, bool]:
                     occ = wins.setdefault(k, [])
                     if occ:
                         dupes += 1
-                    occ.append((o["count"], o["min"], o["max"], o["avg"]))
+                    if "avg_t" in o:  # join pipeline record
+                        occ.append((o["avg_t"], o["avg_h"]))
+                    elif "we" in o:  # session record: bounds + aggregates
+                        occ.append((o["count"], o["min"], o["max"],
+                                    o["avg"], o["ws"], o["we"]))
+                    else:
+                        occ.append(
+                            (o["count"], o["min"], o["max"], o["avg"])
+                        )
     return wins, dupes, done
 
 
@@ -332,8 +492,18 @@ def main():
     ap.add_argument("--pace", type=float, default=200_000.0)
     ap.add_argument("--batch-rows", type=int, default=4096)
     ap.add_argument("--kill-every", type=float, default=90.0)
-    ap.add_argument("--out", default=str(REPO / "SOAK.json"))
+    ap.add_argument("--pipeline", choices=("simple", "join", "session"),
+                    default="simple")
+    ap.add_argument("--out", default=None, help="default derives from "
+                    "--pipeline: SOAK.json / SOAK_JOIN.json / "
+                    "SOAK_SESSION.json (never cross-clobbers artifacts)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = str(REPO / {
+            "simple": "SOAK.json",
+            "join": "SOAK_JOIN.json",
+            "session": "SOAK_SESSION.json",
+        }[args.pipeline])
     if args.child:
         child_main()
         return
@@ -352,9 +522,11 @@ def main():
         "SOAK_PACE": str(args.pace),
         "SOAK_TOTAL_BATCHES": str(total_batches),
         "SOAK_CKPT_DIR": ckpt_dir,
+        "SOAK_PIPELINE": args.pipeline,
     })
 
     report = {
+        "pipeline": args.pipeline,
         "minutes": args.minutes,
         "pace_rows_per_s": args.pace,
         "total_rows": total_batches * args.batch_rows,
@@ -367,6 +539,10 @@ def main():
         Path(args.out).write_text(json.dumps(report, indent=1))
 
     golden: dict = {}
+    _fold = {
+        "join": golden_update_join,
+        "session": golden_update_session,
+    }.get(args.pipeline, golden_update)
     golden_i = 0
     seg_paths = []
     seg = 0
@@ -417,9 +593,7 @@ def main():
                     + 200,
                 )
                 while golden_i < target_i:
-                    golden_update(
-                        golden, golden_i, args.batch_rows, args.pace
-                    )
+                    _fold(golden, golden_i, args.batch_rows, args.pace)
                     golden_i += 1
                 if relay_active():
                     aborted = "relay active (yielding core to chip run)"
@@ -454,25 +628,43 @@ def main():
                 break
         # finish golden
         while golden_i < total_batches and not aborted:
-            golden_update(golden, golden_i, args.batch_rows, args.pace)
+            _fold(golden, golden_i, args.batch_rows, args.pace)
             golden_i += 1
         wins, dupes, done_seen = read_emissions(seg_paths)
+        if args.pipeline == "join" and not aborted:
+            # an inner join correctly emits nothing for a (window, key)
+            # present on only one stream — drop one-sided golden entries
+            # (also prevents a zero-division on the absent side's count)
+            golden = {k: g for k, g in golden.items() if g[0] and g[2]}
+        if args.pipeline == "session" and not aborted:
+            # golden keys are (burst second, key); emissions key on the
+            # session START (min ts in the burst) — remap for comparison
+            golden = {
+                (int(g[4]), k[1]): g for k, g in golden.items()
+            }
         lost = []
         spurious = []
         mismatched = []
         if not aborted:
-            for k, (cnt, mn, mx, sm) in golden.items():
+            for k, g in golden.items():
                 occs = wins.get(k)
                 if not occs:
                     lost.append(k)
                     continue
-                want = (cnt, round(mn, 4), round(mx, 4), round(sm / cnt, 4))
+                if args.pipeline == "join":
+                    cl, sl, cr, sr = g
+                    want = (round(sl / cl, 4), round(sr / cr, 4))
+                elif args.pipeline == "session":
+                    cnt, mn, mx, sm, t0, t1 = g
+                    want = (cnt, round(mn, 4), round(mx, 4),
+                            round(sm / cnt, 4), t0, t1 + SESSION_GAP_MS)
+                else:
+                    cnt, mn, mx, sm = g
+                    want = (cnt, round(mn, 4), round(mx, 4),
+                            round(sm / cnt, 4))
                 for got in occs:  # EVERY occurrence must match, dupes too
-                    if (
-                        got[0] != want[0]
-                        or abs(got[1] - want[1]) > 1e-3
-                        or abs(got[2] - want[2]) > 1e-3
-                        or abs(got[3] - want[3]) > 1e-3
+                    if len(got) != len(want) or any(
+                        abs(a - b) > 1e-3 for a, b in zip(got, want)
                     ):
                         mismatched.append((k, got, want))
             # spurious: emitted keys the golden never produced (corrupted
