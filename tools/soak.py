@@ -111,21 +111,30 @@ def batch_arrays(i: int, batch_rows: int, pace: float, seed: int = 11):
     return ts.astype(np.int64), keys, vals
 
 
+SEED_LEFT = 11
+SEED_RIGHT = 23
+
+
+def _group_reduce(comp, vals, *ops):
+    """Composite-key group reduction shared by the golden folds:
+    (uniq_keys, counts, [op.reduceat(vals_sorted) for op in ops])."""
+    order = np.argsort(comp, kind="stable")
+    v = vals[order]
+    uniq, starts = np.unique(comp[order], return_index=True)
+    cnts = np.diff(np.append(starts, len(v)))
+    return uniq, cnts, [op.reduceat(v, starts) for op in ops]
+
+
 def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
     """Fold batch i into the golden {(ws, key): [cnt, min, max, sum]},
     vectorized: the Python loop runs per GROUP (~2 windows x N_KEYS per
     batch), not per row — the parent must not steal the single core from
     the engine child it is measuring."""
-    ts, keys, vals = batch_arrays(i, batch_rows, pace)
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
     ws = (ts // WINDOW_MS) * WINDOW_MS
-    comp = ws * N_KEYS + keys  # composite (window, key) id
-    order = np.argsort(comp, kind="stable")
-    v = vals[order]
-    uniq, starts = np.unique(comp[order], return_index=True)
-    cnts = np.diff(np.append(starts, len(v)))
-    mins = np.minimum.reduceat(v, starts)
-    maxs = np.maximum.reduceat(v, starts)
-    sums = np.add.reduceat(v, starts)
+    uniq, cnts, (mins, maxs, sums) = _group_reduce(
+        ws * N_KEYS + keys, vals, np.minimum, np.maximum, np.add
+    )
     for u, c, mn, mx, sm in zip(
         uniq.tolist(), cnts.tolist(), mins.tolist(), maxs.tolist(),
         sums.tolist(),
@@ -142,10 +151,6 @@ def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
         a[3] += sm
 
 
-SEED_LEFT = 11
-SEED_RIGHT = 23
-
-
 def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
     """Fold batch i of BOTH streams into {(ws, key): [cnt_l, sum_l,
     cnt_r, sum_r]} — the join emits (avg_t, avg_h) per (window, key)
@@ -154,12 +159,7 @@ def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
     for off, seed in ((0, SEED_LEFT), (2, SEED_RIGHT)):
         ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=seed)
         ws = (ts // WINDOW_MS) * WINDOW_MS
-        comp = ws * N_KEYS + keys
-        order = np.argsort(comp, kind="stable")
-        v = vals[order]
-        uniq, starts = np.unique(comp[order], return_index=True)
-        cnts = np.diff(np.append(starts, len(v)))
-        sums = np.add.reduceat(v, starts)
+        uniq, cnts, (sums,) = _group_reduce(ws * N_KEYS + keys, vals, np.add)
         for u, c, sm in zip(uniq.tolist(), cnts.tolist(), sums.tolist()):
             w, k = divmod(u, N_KEYS)
             a = agg.setdefault((w, f"sensor_{k}"), [0, 0.0, 0, 0.0])
@@ -184,20 +184,14 @@ def golden_update_session(agg: dict, i: int, batch_rows: int, pace: float):
     """Fold batch i into {(key, sec): [cnt, min_v, max_v, sum_v,
     min_ts, max_ts]} — one session per key per second under burst_ts;
     emitted start = min_ts, end = max_ts + SESSION_GAP_MS."""
-    ts, keys, vals = batch_arrays(i, batch_rows, pace)
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
     bts = burst_ts(ts)
     sec = (bts // 1000) * 1000
     comp = sec * N_KEYS + keys
-    order = np.argsort(comp, kind="stable")
-    v = vals[order]
-    t = bts[order]
-    uniq, starts = np.unique(comp[order], return_index=True)
-    cnts = np.diff(np.append(starts, len(v)))
-    vmins = np.minimum.reduceat(v, starts)
-    vmaxs = np.maximum.reduceat(v, starts)
-    vsums = np.add.reduceat(v, starts)
-    tmins = np.minimum.reduceat(t, starts)
-    tmaxs = np.maximum.reduceat(t, starts)
+    uniq, cnts, (vmins, vmaxs, vsums) = _group_reduce(
+        comp, vals, np.minimum, np.maximum, np.add
+    )
+    _, _, (tmins, tmaxs) = _group_reduce(comp, bts, np.minimum, np.maximum)
     for u, c, mn, mx, sm, t0, t1 in zip(
         uniq.tolist(), cnts.tolist(), vmins.tolist(), vmaxs.tolist(),
         vsums.tolist(), tmins.tolist(), tmaxs.tolist(),
