@@ -925,10 +925,12 @@ def run_ingest_scale(batches) -> dict:
                 point_failures[parts] = rep_fails
         else:
             point_failures[parts] = rep_fails or ["no reps succeeded"]
-        log(f"ingest_scale[{parts}p]: best "
-            f"{points.get(parts, 0):,.0f} rows/s of "
-            f"{[f'{r / 1e6:.2f}M' for r in reps]}"
-            + (f" FAILURES {rep_fails}" if rep_fails else ""))
+        if reps:
+            log(f"ingest_scale[{parts}p]: best {points[parts]:,.0f} "
+                f"rows/s of {[f'{r / 1e6:.2f}M' for r in reps]}"
+                + (f" FAILURES {rep_fails}" if rep_fails else ""))
+        else:
+            log(f"ingest_scale[{parts}p]: POINT FAILED — {rep_fails}")
     if not points:
         return {
             "metric": "rows_per_sec_max_sustainable_ingest_fetch_decode",

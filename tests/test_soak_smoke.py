@@ -18,7 +18,9 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
-@pytest.mark.parametrize("pipeline", ["simple", "join", "session"])
+@pytest.mark.parametrize(
+    "pipeline", ["simple", "sliding", "join", "session", "udaf"]
+)
 def test_soak_smoke(tmp_path, pipeline):
     out = tmp_path / "soak.json"
     proc = subprocess.run(

@@ -9,16 +9,20 @@ The reference has no analog (its de-facto soak is "run the docker example
 and watch", SURVEY §4); a framework claiming checkpoint/restore parity
 should demonstrate it surviving repetition.
 
-    python tools/soak.py [--pipeline simple|join|session] [--minutes 12]
-                         [--pace 200000] [--kill-every 90] [--out SOAK.json]
+    python tools/soak.py [--pipeline simple|sliding|join|session|udaf]
+                         [--minutes 12] [--pace 200000] [--kill-every 90]
+                         [--out SOAK.json]
 
 Design:
 - The child process runs the chosen pipeline — ``simple`` (1s tumbling
-  count/min/max/avg by key), ``join`` (two independent streams windowed
-  then inner-joined on (key, window): join state rides the same
-  checkpoint barriers), or ``session`` (300ms-gap session windows over
-  a bursty feed: exact session bounds verified — the operator the
-  reference left ``todo!()``) — over a DETERMINISTIC paced source whose
+  count/min/max/avg by key), ``sliding`` (1s/250ms, 4-way emission
+  fan-out), ``join`` (two independent streams windowed then
+  inner-joined on (key, window): join state rides the same checkpoint
+  barriers), ``session`` (300ms-gap session windows over a bursty
+  feed: exact session bounds verified — the operator the reference
+  left ``todo!()``), or ``udaf`` (stateful Python accumulator on the
+  host-frame path: state()/merge() snapshots) — over a DETERMINISTIC
+  paced source whose
   batches are a pure function of the batch index (seeded RNG per batch),
   with checkpointing every 2s to a shared LSM dir.  The source implements
   ``offset_snapshot``/``offset_restore`` (fast-forward to batch i), so a
@@ -115,26 +119,24 @@ SEED_LEFT = 11
 SEED_RIGHT = 23
 
 
-def _group_reduce(comp, vals, *ops):
-    """Composite-key group reduction shared by the golden folds:
-    (uniq_keys, counts, [op.reduceat(vals_sorted) for op in ops])."""
+def _group_reduce(comp, arrays):
+    """Composite-key group reduction shared by the golden folds — ONE
+    argsort/unique reused across every value array: ``arrays`` is a list
+    of (vals, [ufuncs]); returns (uniq_keys, counts, [[reduceat results
+    per ufunc] per entry])."""
     order = np.argsort(comp, kind="stable")
-    v = vals[order]
     uniq, starts = np.unique(comp[order], return_index=True)
-    cnts = np.diff(np.append(starts, len(v)))
-    return uniq, cnts, [op.reduceat(v, starts) for op in ops]
+    cnts = np.diff(np.append(starts, len(comp)))
+    outs = []
+    for vals, ops in arrays:
+        v = vals[order]
+        outs.append([op.reduceat(v, starts) for op in ops])
+    return uniq, cnts, outs
 
 
-def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
-    """Fold batch i into the golden {(ws, key): [cnt, min, max, sum]},
-    vectorized: the Python loop runs per GROUP (~2 windows x N_KEYS per
-    batch), not per row — the parent must not steal the single core from
-    the engine child it is measuring."""
-    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
-    ws = (ts // WINDOW_MS) * WINDOW_MS
-    uniq, cnts, (mins, maxs, sums) = _group_reduce(
-        ws * N_KEYS + keys, vals, np.minimum, np.maximum, np.add
-    )
+def _merge_tumbling(agg, uniq, cnts, mins, maxs, sums):
+    """Accumulate one batch's per-(window,key) partials into the golden —
+    shared by the tumbling and sliding folds."""
     for u, c, mn, mx, sm in zip(
         uniq.tolist(), cnts.tolist(), mins.tolist(), maxs.tolist(),
         sums.tolist(),
@@ -151,6 +153,19 @@ def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
         a[3] += sm
 
 
+def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i into the golden {(ws, key): [cnt, min, max, sum]},
+    vectorized: the Python loop runs per GROUP (~2 windows x N_KEYS per
+    batch), not per row — the parent must not steal the single core from
+    the engine child it is measuring."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+    ws = (ts // WINDOW_MS) * WINDOW_MS
+    uniq, cnts, [[mins, maxs, sums]] = _group_reduce(
+        ws * N_KEYS + keys, [(vals, [np.minimum, np.maximum, np.add])]
+    )
+    _merge_tumbling(agg, uniq, cnts, mins, maxs, sums)
+
+
 def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
     """Fold batch i of BOTH streams into {(ws, key): [cnt_l, sum_l,
     cnt_r, sum_r]} — the join emits (avg_t, avg_h) per (window, key)
@@ -159,12 +174,31 @@ def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
     for off, seed in ((0, SEED_LEFT), (2, SEED_RIGHT)):
         ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=seed)
         ws = (ts // WINDOW_MS) * WINDOW_MS
-        uniq, cnts, (sums,) = _group_reduce(ws * N_KEYS + keys, vals, np.add)
+        uniq, cnts, [[sums]] = _group_reduce(
+            ws * N_KEYS + keys, [(vals, [np.add])]
+        )
         for u, c, sm in zip(uniq.tolist(), cnts.tolist(), sums.tolist()):
             w, k = divmod(u, N_KEYS)
             a = agg.setdefault((w, f"sensor_{k}"), [0, 0.0, 0, 0.0])
             a[off] += c
             a[off + 1] += sm
+
+
+SLIDE_MS = 250  # 1000ms window / 250ms slide = 4-way emission fan-out
+
+
+def golden_update_sliding(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i into sliding-window golden {(ws, key): [cnt, min,
+    max, sum]}: every row belongs to WINDOW_MS/SLIDE_MS consecutive
+    windows (epoch-aligned slide indices, like the engine's on-device
+    fan-out)."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+    for j in range(WINDOW_MS // SLIDE_MS):
+        ws = (ts // SLIDE_MS - j) * SLIDE_MS
+        uniq, cnts, [[mins, maxs, sums]] = _group_reduce(
+            ws * N_KEYS + keys, [(vals, [np.minimum, np.maximum, np.add])]
+        )
+        _merge_tumbling(agg, uniq, cnts, mins, maxs, sums)
 
 
 SESSION_GAP_MS = 300
@@ -188,10 +222,12 @@ def golden_update_session(agg: dict, i: int, batch_rows: int, pace: float):
     bts = burst_ts(ts)
     sec = (bts // 1000) * 1000
     comp = sec * N_KEYS + keys
-    uniq, cnts, (vmins, vmaxs, vsums) = _group_reduce(
-        comp, vals, np.minimum, np.maximum, np.add
+    uniq, cnts, [[vmins, vmaxs, vsums], [tmins, tmaxs]] = _group_reduce(
+        comp, [
+            (vals, [np.minimum, np.maximum, np.add]),
+            (bts, [np.minimum, np.maximum]),
+        ]
     )
-    _, _, (tmins, tmaxs) = _group_reduce(comp, bts, np.minimum, np.maximum)
     for u, c, mn, mx, sm, t0, t1 in zip(
         uniq.tolist(), cnts.tolist(), vmins.tolist(), vmaxs.tolist(),
         vsums.tolist(), tmins.tolist(), tmaxs.tolist(),
@@ -335,7 +371,44 @@ def child_main() -> None:
         emit_on_close=True,
     )
     ctx = Context(cfg)
-    if pipeline == "session":
+    if pipeline == "udaf":
+        # stateful Python accumulator (host-frame path, udaf_exec):
+        # Accumulator.state()/merge() snapshots ride the checkpoint —
+        # the SerializableAccumulator contract through repeated kills
+        from denormalized_tpu.api.udaf import Accumulator
+
+        class Spread(Accumulator):
+            def __init__(self):
+                self.lo = float("inf")
+                self.hi = float("-inf")
+
+            def update(self, values):
+                if len(values):
+                    self.lo = min(self.lo, float(values.min()))
+                    self.hi = max(self.hi, float(values.max()))
+
+            def merge(self, states):
+                self.lo = min(self.lo, states[0])
+                self.hi = max(self.hi, states[1])
+
+            def state(self):
+                return [self.lo, self.hi]
+
+            def evaluate(self):
+                return self.hi - self.lo if self.hi >= self.lo else 0.0
+
+        spread = F.udaf(Spread, DataType.FLOAT64, "spread")
+        ds = ctx.from_source(
+            SoakSource(SEED_LEFT, "soak_u"), name="soak_u"
+        ).window(
+            ["sensor_name"],
+            [
+                spread(col("reading")).alias("spread"),
+                F.count(col("reading")).alias("count"),
+            ],
+            WINDOW_MS,
+        )
+    elif pipeline == "session":
         ds = ctx.from_source(
             SoakSource(SEED_LEFT, "soak_s"), name="soak_s"
         ).session_window(
@@ -383,6 +456,7 @@ def child_main() -> None:
                 F.avg(col("reading")).alias("average"),
             ],
             WINDOW_MS,
+            SLIDE_MS if pipeline == "sliding" else None,
         )
     with open(out_path, "a", buffering=1) as out:
         out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
@@ -393,7 +467,15 @@ def child_main() -> None:
             ws = batch.column(WINDOW_START_COLUMN)
             names = batch.column("sensor_name")
             for i in range(batch.num_rows):
-                if pipeline == "session":
+                if pipeline == "udaf":
+                    rec = {
+                        "t": round(now, 3),
+                        "ws": int(ws[i]),
+                        "key": str(names[i]),
+                        "count": int(batch.column("count")[i]),
+                        "spread": round(float(batch.column("spread")[i]), 4),
+                    }
+                elif pipeline == "session":
                     rec = {
                         "t": round(now, 3),
                         "ws": int(ws[i]),
@@ -461,6 +543,8 @@ def read_emissions(paths) -> tuple[dict, int, bool]:
                     elif "we" in o:  # session record: bounds + aggregates
                         occ.append((o["count"], o["min"], o["max"],
                                     o["avg"], o["ws"], o["we"]))
+                    elif "spread" in o:  # udaf record
+                        occ.append((o["count"], o["spread"]))
                     else:
                         occ.append(
                             (o["count"], o["min"], o["max"], o["avg"])
@@ -486,17 +570,21 @@ def main():
     ap.add_argument("--pace", type=float, default=200_000.0)
     ap.add_argument("--batch-rows", type=int, default=4096)
     ap.add_argument("--kill-every", type=float, default=90.0)
-    ap.add_argument("--pipeline", choices=("simple", "join", "session"),
+    ap.add_argument("--pipeline",
+                    choices=("simple", "sliding", "join", "session", "udaf"),
                     default="simple")
     ap.add_argument("--out", default=None, help="default derives from "
-                    "--pipeline: SOAK.json / SOAK_JOIN.json / "
-                    "SOAK_SESSION.json (never cross-clobbers artifacts)")
+                    "--pipeline: SOAK.json / SOAK_SLIDING.json / "
+                    "SOAK_JOIN.json / SOAK_SESSION.json / SOAK_UDAF.json "
+                    "(never cross-clobbers artifacts)")
     args = ap.parse_args()
     if args.out is None:
         args.out = str(REPO / {
             "simple": "SOAK.json",
             "join": "SOAK_JOIN.json",
             "session": "SOAK_SESSION.json",
+            "udaf": "SOAK_UDAF.json",
+            "sliding": "SOAK_SLIDING.json",
         }[args.pipeline])
     if args.child:
         child_main()
@@ -536,7 +624,8 @@ def main():
     _fold = {
         "join": golden_update_join,
         "session": golden_update_session,
-    }.get(args.pipeline, golden_update)
+        "sliding": golden_update_sliding,
+    }.get(args.pipeline, golden_update)  # udaf golden == tumbling fold
     golden_i = 0
     seg_paths = []
     seg = 0
@@ -652,6 +741,9 @@ def main():
                     cnt, mn, mx, sm, t0, t1 = g
                     want = (cnt, round(mn, 4), round(mx, 4),
                             round(sm / cnt, 4), t0, t1 + SESSION_GAP_MS)
+                elif args.pipeline == "udaf":
+                    cnt, mn, mx, _sm = g
+                    want = (cnt, round(mx - mn, 4))
                 else:
                     cnt, mn, mx, sm = g
                     want = (cnt, round(mn, 4), round(mx, 4),
