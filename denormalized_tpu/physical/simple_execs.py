@@ -91,7 +91,7 @@ class _PartitionWatermarks:
     ``observe``/``advance`` return a kind="partition" WatermarkHint only
     when the min strictly advances."""
 
-    def __init__(self, n: int, timeout_ms: int | None) -> None:
+    def __init__(self, n: int, timeout_ms: int | None, activity=None) -> None:
         self._wm: list[int | None] = [None] * n
         self._last_rows = [time.monotonic()] * n
         self._finished = [False] * n
@@ -99,6 +99,23 @@ class _PartitionWatermarks:
             timeout_ms / 1000.0 if timeout_ms is not None else None
         )
         self._emitted: int | None = None
+        # activity(idx) -> (has_pending, last_rowful_produce_wall,
+        # first_read_done): on the threaded path idleness must be judged
+        # by what the READER produced, not by when the consumer got
+        # around to processing it — a burst of one partition's catch-up
+        # batches ahead in the SHARED queue otherwise makes the other
+        # partition look idle while its backlog is already enqueued,
+        # excludes it from the min, and late-drops that backlog
+        # (soak-found: a contiguous slice of the first window after a
+        # kill/restore vanished whenever the consumer spent >idle_timeout
+        # on one partition's run of queued batches).  first_read_done
+        # separates "quiet topic" from "still starting": a reader that
+        # has not yet RETURNED from its first read (connect/seek/fetch in
+        # flight, possibly starved by a compiling consumer on a shared
+        # core) holds the min — its initial backlog is unknown, not
+        # absent (soak-found at stream start: window 0 short by the
+        # slower-connecting partition's share under first-batch compile)
+        self._activity = activity
 
     def observe(self, idx: int, batch: RecordBatch) -> WatermarkHint | None:
         from denormalized_tpu.common.constants import (
@@ -124,9 +141,18 @@ class _PartitionWatermarks:
     def advance(self) -> WatermarkHint | None:
         now = time.monotonic()
         vals = []
-        for w, lr, fin in zip(self._wm, self._last_rows, self._finished):
+        for i, (w, lr, fin) in enumerate(
+            zip(self._wm, self._last_rows, self._finished)
+        ):
             if fin:
                 continue
+            if self._activity is not None:
+                pending, produced, first_read_done = self._activity(i)
+                if not first_read_done:
+                    return None  # still starting: backlog unknown, hold
+                lr = max(lr, produced)
+                if pending:
+                    lr = now  # enqueued-but-unprocessed rows: never idle
             idle = (
                 self._timeout_s is not None
                 and now - lr >= self._timeout_s
@@ -246,7 +272,7 @@ class SourceExec(ExecOperator):
             if epoch is not None:
                 yield Marker(epoch)
 
-    def _partition_wm_tracker(self, n_readers: int):
+    def _partition_wm_tracker(self, n_readers: int, activity=None):
         """Resolve partition-watermark mode: 'auto' enables it for any
         multi-partition source whose liveness is guaranteed — bounded
         (finished partitions leave the min) or unbounded WITH an idle
@@ -263,7 +289,9 @@ class SourceExec(ExecOperator):
         )
         if not on:
             return None
-        return _PartitionWatermarks(n_readers, self._idle_timeout_ms)
+        return _PartitionWatermarks(
+            n_readers, self._idle_timeout_ms, activity=activity
+        )
 
     def run(self) -> Iterator[StreamItem]:
         readers = self.source.partitions()
@@ -319,20 +347,43 @@ class SourceExec(ExecOperator):
 
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self._queue_size)
         done = threading.Event()
+        # per-partition reader-side activity, single-writer per slot (the
+        # reader thread writes enq_*, the consumer writes deq_) — consumed
+        # by the partition-watermark tracker's idleness judgment so a
+        # partition with rows enqueued (or blocked mid-put) is never
+        # idle-excluded just because the consumer is busy elsewhere
+        enq_rowful = [0] * len(readers)
+        deq_rowful = [0] * len(readers)
+        enq_wall = [time.monotonic()] * len(readers)
+        first_read_done = [False] * len(readers)
 
         def reader_items(idx, reader):
             def gen():
                 while not done.is_set():
                     b = reader.read(timeout_s=0.1)
+                    first_read_done[idx] = True
                     if b is None:
                         # explicit per-reader EOS marker (the pump's
                         # sentinel doesn't say WHICH reader ended, and
                         # the partition-watermark min must drop it)
                         yield (idx, None, None)
                         return
+                    if b.num_rows:
+                        # stamp BEFORE the (possibly blocking) queue put:
+                        # while blocked on a full queue the partition has
+                        # pending work and must read as active
+                        enq_wall[idx] = time.monotonic()
+                        enq_rowful[idx] += 1
                     yield (idx, reader.offset_snapshot(), b)
 
             return gen
+
+        def _activity(i):
+            return (
+                enq_rowful[i] > deq_rowful[i],
+                enq_wall[i],
+                first_read_done[i],
+            )
 
         for i, r in enumerate(readers):
             spawn_pump(q, done, reader_items(i, r), sentinel=None)
@@ -346,7 +397,7 @@ class SourceExec(ExecOperator):
             if self._idle_timeout_ms is not None
             else None
         )
-        pwm = self._partition_wm_tracker(len(readers))
+        pwm = self._partition_wm_tracker(len(readers), activity=_activity)
         if pwm is not None:
             yield WatermarkHint(WM_ANNOUNCE, kind="partition")
         try:
@@ -372,6 +423,8 @@ class SourceExec(ExecOperator):
                         yield h
                 yield batch
                 self._yielded_offsets[idx] = snap
+                if batch.num_rows:
+                    deq_rowful[idx] += 1
                 if pwm is not None:
                     h = (
                         pwm.observe(idx, batch)

@@ -1,0 +1,166 @@
+"""Regression: a partition whose backlog is ENQUEUED must never be
+idle-excluded from the partition-watermark min (soak-found bug).
+
+On the threaded multi-partition path both readers feed one shared queue.
+Idleness used to be judged by when the CONSUMER last processed a
+partition's rowful batch — so a burst of partition A's catch-up batches
+ahead in the queue made partition B look idle while B's (older) backlog
+was already sitting behind them.  B was excluded from the min, the
+watermark jumped to A's level, and B's backlog was dropped as late: a
+contiguous slice of the first window after a kill/restore vanished
+(SOAK_KAFKA caught it; windows short by exactly one partition's share).
+
+The fix judges idleness by reader-side activity: a partition with rows
+enqueued-but-unprocessed (or blocked mid-put) is never idle.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.physical.base import WatermarkHint
+from denormalized_tpu.physical.simple_execs import SourceExec
+from denormalized_tpu.sources.base import (
+    PartitionReader,
+    Source,
+    attach_canonical_timestamp,
+    canonicalize_schema,
+)
+
+T0 = 1_700_000_000_000
+SCH = Schema([
+    Field("occurred_at_ms", DataType.INT64, nullable=False),
+    Field("v", DataType.FLOAT64),
+])
+
+
+def _batch(ts0, n=64, step=1):
+    ts = np.arange(ts0, ts0 + n * step, step, dtype=np.int64)
+    return attach_canonical_timestamp(
+        RecordBatch(SCH, [ts, np.zeros(n)]), "occurred_at_ms",
+        fallback_ms=ts0,
+    )
+
+
+class _ScriptedReader(PartitionReader):
+    """Yields a scripted list of batches (after an optional initial
+    delay), then permanently times out (empty batches) like a quiet live
+    partition."""
+
+    def __init__(self, batches, initial_delay_s=0.0):
+        self._batches = list(batches)
+        self._delay = initial_delay_s
+        self._started = time.monotonic()
+
+    def read(self, timeout_s=None):
+        if self._delay and time.monotonic() - self._started < self._delay:
+            time.sleep(min(timeout_s or 0.05, 0.05))
+            return RecordBatch.empty(SCH)
+        if self._batches:
+            return self._batches.pop(0)
+        time.sleep(timeout_s or 0.05)
+        return attach_canonical_timestamp(
+            RecordBatch.empty(SCH), "occurred_at_ms", fallback_ms=T0
+        )
+
+
+class _TwoPartSource(Source):
+    name = "race"
+
+    def __init__(self, readers_factory):
+        self._factory = readers_factory
+        self._schema = canonicalize_schema(SCH)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def partitions(self):
+        return self._factory()
+
+    @property
+    def unbounded(self):
+        return True
+
+
+def _drive(strip_activity: bool):
+    """Slow-consumer drive; returns (violations, saw_b_rows).
+
+    Partition A bursts 20 batches spanning ~20s of event time (all
+    enqueued nearly instantly); partition B enqueues 5 batches of OLDER
+    event time ~80ms later (catch-up backlog shape).  The consumer takes
+    ~40ms per item, so it spends >idle_timeout on A's run before
+    reaching B's queued rows.  A violation is a rowful batch whose
+    min-ts is below an already-announced partition watermark — exactly
+    the condition under which downstream drops those rows as late."""
+    a_batches = [_batch(T0 + 10_000 + i * 1000) for i in range(20)]
+    b_batches = [_batch(T0 + i * 50) for i in range(5)]
+
+    def factory():
+        return [
+            _ScriptedReader(a_batches),
+            _ScriptedReader(b_batches, initial_delay_s=0.08),
+        ]
+
+    exec_ = SourceExec(
+        _TwoPartSource(factory),
+        idle_timeout_ms=300,
+        partition_watermarks=True,
+    )
+    if strip_activity:
+        orig = exec_._partition_wm_tracker
+
+        def no_activity(n_readers, activity=None):
+            return orig(n_readers, activity=None)
+
+        exec_._partition_wm_tracker = no_activity
+
+    max_hint = None
+    violations = []
+    saw_b_rows = 0
+    deadline = time.monotonic() + 10
+    for item in exec_.run():
+        if time.monotonic() > deadline:
+            break
+        if isinstance(item, WatermarkHint):
+            if item.kind == "partition" and not item.is_announcement:
+                max_hint = max(max_hint or 0, item.ts_ms)
+            continue
+        if isinstance(item, RecordBatch) and item.num_rows:
+            ts = np.asarray(
+                item.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
+            )
+            bmin = int(ts.min())
+            if bmin < T0 + 9_000:
+                saw_b_rows += item.num_rows
+            if max_hint is not None and bmin < max_hint:
+                violations.append((bmin, max_hint))
+            time.sleep(0.04)  # slow consumer: the race window
+            if saw_b_rows >= 5 * 64:
+                break
+        else:
+            continue
+    return violations, saw_b_rows
+
+
+def test_enqueued_backlog_never_idle_excluded():
+    violations, saw_b = _drive(strip_activity=False)
+    assert saw_b == 5 * 64, "B's backlog must be yielded"
+    assert not violations, (
+        f"partition hints ran ahead of enqueued backlog: {violations[:3]}"
+    )
+
+
+def test_detector_catches_consumer_side_idleness():
+    """The inverse run proves the scenario actually triggers the race
+    when idleness is judged consumer-side (activity stripped) — i.e. the
+    test above is load-bearing, not vacuously green."""
+    violations, _ = _drive(strip_activity=True)
+    assert violations, (
+        "expected the stripped-activity tracker to idle-exclude the "
+        "queued partition; the race scenario no longer triggers"
+    )

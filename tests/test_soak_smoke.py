@@ -19,7 +19,7 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.mark.parametrize(
-    "pipeline", ["simple", "sliding", "join", "session", "udaf"]
+    "pipeline", ["simple", "sliding", "join", "session", "udaf", "kafka"]
 )
 def test_soak_smoke(tmp_path, pipeline):
     out = tmp_path / "soak.json"

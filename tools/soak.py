@@ -201,6 +201,75 @@ def golden_update_sliding(agg: dict, i: int, batch_rows: int, pace: float):
         _merge_tumbling(agg, uniq, cnts, mins, maxs, sums)
 
 
+KAFKA_PARTS = int(os.environ.get("SOAK_KAFKA_PARTS", 2))
+
+
+def encode_json_rows(ts, keys, vals):
+    """Vectorized emit_measurements-shaped JSON encode (np.char at C
+    speed) for the kafka pipeline's staged feed."""
+    s = np.char.add(b'{"occurred_at_ms":', ts.astype("S20"))
+    s = np.char.add(s, b',"sensor_name":"sensor_')
+    s = np.char.add(s, keys.astype("S4"))
+    s = np.char.add(s, b'","reading":')
+    s = np.char.add(s, vals.astype("S32"))
+    s = np.char.add(s, b"}")
+    return s.tolist()
+
+
+def kafka_prep_and_feed(args, total_batches, log):
+    """Start the parent-owned broker (the durable log that SURVIVES child
+    kills — the restored child seeks back to its checkpointed offsets),
+    pre-encode every chunk (the paced feed loop must only append staged
+    slices), and return (broker, feed_thread, last_close_ws).  Rows
+    interleave across KAFKA_PARTS partitions per batch so both
+    partitions' event-time ranges stay aligned (per-partition watermarks
+    advance together)."""
+    import threading
+
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    span_ms = int(total_batches * args.batch_rows * 1000.0 / args.pace)
+    # two full windows of slack before the stream end: the child exits on
+    # seeing this window, closed by the NATURAL watermark (events beyond
+    # its end), no idle-hint dependence at the boundary
+    last_close_ws = ((T0 + span_ms) // WINDOW_MS - 2) * WINDOW_MS
+    broker = MockKafkaBroker().start()
+    broker.create_topic("soak", partitions=KAFKA_PARTS)
+    staged = [[] for _ in range(KAFKA_PARTS)]
+    base = [0] * KAFKA_PARTS
+    t_prep = time.monotonic()
+    for i in range(total_batches):
+        ts, keys, vals = batch_arrays(i, args.batch_rows, args.pace,
+                                      seed=SEED_LEFT)
+        rows = encode_json_rows(ts, keys, vals)
+        for p in range(KAFKA_PARTS):
+            rp = rows[p::KAFKA_PARTS]
+            staged[p].append(MockKafkaBroker.stage_batched(
+                rp, ts_ms=int(ts[0]), records_per_batch=len(rp),
+                base_offset=base[p],
+            ))
+            base[p] += len(rp)
+        if i and i % max(1, total_batches // 10) == 0:
+            log(f"kafka soak: staged {i}/{total_batches} chunks "
+                f"({time.monotonic() - t_prep:.0f}s)")
+    log(f"kafka soak: staged all {total_batches} chunks in "
+        f"{time.monotonic() - t_prep:.0f}s; feed starts now")
+
+    def feed():
+        t0 = time.monotonic()
+        for i in range(total_batches):
+            due = t0 + (i + 1) * args.batch_rows / args.pace
+            delay = due - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            for p in range(KAFKA_PARTS):
+                broker.append_staged("soak", p, staged[p][i])
+
+    th = threading.Thread(target=feed, daemon=True)
+    th.start()
+    return broker, th, last_close_ws
+
+
 SESSION_GAP_MS = 300
 
 
@@ -366,12 +435,40 @@ def child_main() -> None:
         min_batch_bucket=batch_rows,
         min_window_slots=32,
         checkpoint=True,
-        checkpoint_interval_s=2.0,
+        checkpoint_interval_s=float(os.environ.get("SOAK_CKPT_S", 2.0)),
         state_backend_path=ckpt_dir,
         emit_on_close=True,
+        source_idle_timeout_ms=int(
+            os.environ.get("SOAK_IDLE_MS", 1000)
+        ) or None,
     )
     ctx = Context(cfg)
-    if pipeline == "udaf":
+    last_close_ws = (
+        int(os.environ["SOAK_LAST_CLOSE_WS"])
+        if pipeline == "kafka" else None
+    )
+    if pipeline == "kafka":
+        # the reference-shaped path end to end: broker -> native wire
+        # client -> native JSON decode -> window, checkpointed offsets
+        # restored by seek.  The feed keeps running across kills (the
+        # broker is the durable log), so recovery includes backlog
+        # catch-up — exactly a real deployment's restart
+        ds = ctx.from_topic(
+            "soak",
+            schema=schema,
+            bootstrap_servers=os.environ["SOAK_BOOTSTRAP"],
+            timestamp_column="occurred_at_ms",
+        ).window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            WINDOW_MS,
+        )
+    elif pipeline == "udaf":
         # stateful Python accumulator (host-frame path, udaf_exec):
         # Accumulator.state()/merge() snapshots ride the checkpoint —
         # the SerializableAccumulator contract through repeated kills
@@ -458,9 +555,11 @@ def child_main() -> None:
             WINDOW_MS,
             SLIDE_MS if pipeline == "sliding" else None,
         )
+    it = ds.stream()
+    stop = False
     with open(out_path, "a", buffering=1) as out:
         out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
-        for batch in ds.stream():
+        for batch in it:
             if not batch.schema.has(WINDOW_START_COLUMN):
                 continue
             now = time.time()
@@ -505,13 +604,34 @@ def child_main() -> None:
                         "avg": round(float(batch.column("average")[i]), 4),
                     }
                 out.write(json.dumps(rec) + "\n")
+                if last_close_ws is not None and rec["ws"] >= last_close_ws:
+                    stop = True  # unbounded source: close at the target
+            if stop:
+                it.close()
+                break
+        try:
+            from denormalized_tpu.runtime.tracing import collect_metrics
+
+            sums: dict = {}
+            for m in collect_metrics(ctx._last_physical).values():
+                for k, v in m.items():
+                    if isinstance(v, (int, float)):
+                        sums[k] = sums.get(k, 0) + v
+            out.write(json.dumps({
+                "event": "metrics",
+                **{k: sums[k] for k in (
+                    "late_rows", "rows_out", "rows_in", "batches_out",
+                ) if k in sums},
+            }) + "\n")
+        except Exception:
+            pass
         out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
 
 
 # -- parent --------------------------------------------------------------
 
 
-def read_emissions(paths) -> tuple[dict, int, bool]:
+def read_emissions(paths):
     """ALL emitted window rows across segment files → ({(ws,key):
     [tuple, ...]}, duplicate_emissions, done_seen) — every occurrence is
     kept, so a wrong first emission can't hide behind a correct
@@ -520,7 +640,8 @@ def read_emissions(paths) -> tuple[dict, int, bool]:
     wins: dict = {}
     dupes = 0
     done = False
-    for path in paths:
+    metrics: list = []
+    for seg_idx, path in enumerate(paths, 1):
         try:
             f = open(path)
         except FileNotFoundError:
@@ -533,23 +654,27 @@ def read_emissions(paths) -> tuple[dict, int, bool]:
                     continue
                 if o.get("event") == "done":
                     done = True
+                elif o.get("event") == "metrics":
+                    metrics.append({k: v for k, v in o.items()
+                                    if k != "event"})
                 elif "ws" in o:
                     k = (o["ws"], o["key"])
                     occ = wins.setdefault(k, [])
                     if occ:
                         dupes += 1
                     if "avg_t" in o:  # join pipeline record
-                        occ.append((o["avg_t"], o["avg_h"]))
+                        vals = (o["avg_t"], o["avg_h"])
                     elif "we" in o:  # session record: bounds + aggregates
-                        occ.append((o["count"], o["min"], o["max"],
-                                    o["avg"], o["ws"], o["we"]))
+                        vals = (o["count"], o["min"], o["max"],
+                                o["avg"], o["ws"], o["we"])
                     elif "spread" in o:  # udaf record
-                        occ.append((o["count"], o["spread"]))
+                        vals = (o["count"], o["spread"])
                     else:
-                        occ.append(
-                            (o["count"], o["min"], o["max"], o["avg"])
-                        )
-    return wins, dupes, done
+                        vals = (o["count"], o["min"], o["max"], o["avg"])
+                    # segment attribution rides along for diagnosis but
+                    # stays OUT of the compared tuple
+                    occ.append((vals, seg_idx))
+    return wins, dupes, done, metrics
 
 
 def rss_kb(pid: int) -> int | None:
@@ -571,7 +696,8 @@ def main():
     ap.add_argument("--batch-rows", type=int, default=4096)
     ap.add_argument("--kill-every", type=float, default=90.0)
     ap.add_argument("--pipeline",
-                    choices=("simple", "sliding", "join", "session", "udaf"),
+                    choices=("simple", "sliding", "join", "session",
+                             "udaf", "kafka"),
                     default="simple")
     ap.add_argument("--out", default=None, help="default derives from "
                     "--pipeline: SOAK.json / SOAK_SLIDING.json / "
@@ -585,6 +711,7 @@ def main():
             "session": "SOAK_SESSION.json",
             "udaf": "SOAK_UDAF.json",
             "sliding": "SOAK_SLIDING.json",
+            "kafka": "SOAK_KAFKA.json",
         }[args.pipeline])
     if args.child:
         child_main()
@@ -597,6 +724,8 @@ def main():
     work = tempfile.mkdtemp(prefix="soak_")
     ckpt_dir = os.path.join(work, "ckpt")
     os.makedirs(ckpt_dir)
+    kafka_broker = None
+    kafka_last_close_ws = None
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -606,6 +735,12 @@ def main():
         "SOAK_CKPT_DIR": ckpt_dir,
         "SOAK_PIPELINE": args.pipeline,
     })
+    if args.pipeline == "kafka":
+        kafka_broker, _feed_th, kafka_last_close_ws = kafka_prep_and_feed(
+            args, total_batches, lambda m: print(m, file=sys.stderr)
+        )
+        env["SOAK_BOOTSTRAP"] = kafka_broker.bootstrap
+        env["SOAK_LAST_CLOSE_WS"] = str(kafka_last_close_ws)
 
     report = {
         "pipeline": args.pipeline,
@@ -663,7 +798,7 @@ def main():
                 if first_emit is not None and (r := rss_kb(proc.pid)):
                     seg_rss.append(r)
                 if first_emit is None:
-                    wins, _, _ = read_emissions([out_path])
+                    wins, _, _, _ = read_emissions([out_path])
                     if wins:
                         first_emit = now - t_spawn
                         if seg > 1:
@@ -713,7 +848,19 @@ def main():
         while golden_i < total_batches and not aborted:
             _fold(golden, golden_i, args.batch_rows, args.pace)
             golden_i += 1
-        wins, dupes, done_seen = read_emissions(seg_paths)
+        wins, dupes, done_seen, child_metrics = read_emissions(seg_paths)
+        if args.pipeline == "kafka" and not aborted:
+            # the unbounded source ends at last_close_ws by design: windows
+            # past it may or may not close (idle-hint timing) before the
+            # child exits — clip BOTH sides to the deterministic range
+            golden = {
+                k: g for k, g in golden.items()
+                if k[0] <= kafka_last_close_ws
+            }
+            wins = {
+                k: v for k, v in wins.items()
+                if k[0] <= kafka_last_close_ws
+            }
         if args.pipeline == "join" and not aborted:
             # an inner join correctly emits nothing for a (window, key)
             # present on only one stream — drop one-sided golden entries
@@ -748,11 +895,12 @@ def main():
                     cnt, mn, mx, sm = g
                     want = (cnt, round(mn, 4), round(mx, 4),
                             round(sm / cnt, 4))
-                for got in occs:  # EVERY occurrence must match, dupes too
+                for got, seg_idx in occs:  # EVERY occurrence, dupes too
                     if len(got) != len(want) or any(
                         abs(a - b) > 1e-3 for a, b in zip(got, want)
                     ):
-                        mismatched.append((k, got, want))
+                        mismatched.append((k, got, want,
+                                           {"segment": seg_idx}))
             # spurious: emitted keys the golden never produced (corrupted
             # ws/key after a restore would land here)
             spurious = [k for k in wins if k not in golden]
@@ -764,6 +912,7 @@ def main():
             "golden_windows": len(golden),
             "emitted_windows": len(wins),
             "duplicate_emissions": dupes,
+            "child_metrics": child_metrics,
             "windows_lost": len(lost),
             "windows_spurious": len(spurious),
             "windows_mismatched": len(mismatched),
@@ -785,6 +934,8 @@ def main():
     finally:
         if proc is not None and proc.poll() is None:
             proc.kill()
+        if kafka_broker is not None:
+            kafka_broker.stop()
         shutil.rmtree(work, ignore_errors=True)
 
 
