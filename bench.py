@@ -836,8 +836,6 @@ def run_ingest_scale(batches) -> dict:
     socket sends under the GIL) is INCLUDED — against a remote broker the
     pump has strictly more headroom, i.e. the reported ceiling is
     conservative."""
-    import threading
-
     from denormalized_tpu.sources.kafka import KafkaTopicBuilder
     from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
 
@@ -849,6 +847,8 @@ def run_ingest_scale(batches) -> dict:
     point_failures: dict[int, list[str]] = {}
 
     def one_rep(parts: int) -> tuple[float | None, list[str]]:
+        from denormalized_tpu.runtime.prefetch import PrefetchPump
+
         broker = MockKafkaBroker().start()
         try:
             broker.create_topic("bench_ingest", partitions=parts)
@@ -864,38 +864,31 @@ def run_ingest_scale(batches) -> dict:
                 .build_reader()
             )
             readers = src.partitions()
-            targets = [len(payloads[p::parts]) for p in range(parts)]
-            counts = [0] * parts
+            # the PRODUCTION ingest path: per-partition prefetch workers
+            # (fetch → native decode → assembly off-thread) merged into
+            # the consumer through the bounded per-partition buffers —
+            # exactly what SourceExec drains, minus windowing
+            pump = PrefetchPump(readers, queue_budget=64)
             fails: list[str] = []
-
-            def drain(i, r):
-                try:
-                    deadline = time.monotonic() + 180.0
-                    while counts[i] < targets[i]:
-                        b = r.read(timeout_s=0.25)
-                        if b is not None and b.num_rows:
-                            counts[i] += b.num_rows
-                        elif time.monotonic() > deadline:
-                            fails.append(f"partition {i} stalled at "
-                                         f"{counts[i]}/{targets[i]}")
-                            return
-                except Exception as e:  # surfaced in the point's log line
-                    fails.append(f"partition {i}: {e!r}")
-
-            threads = [
-                threading.Thread(target=drain, args=(i, r), daemon=True)
-                for i, r in enumerate(readers)
-            ]
+            got = 0
             t0 = time.perf_counter()
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
+            pump.start()
+            try:
+                # deadline enforced INSIDE drain (empty heartbeats and
+                # outright wedges included) — a stalled rep must fail
+                # visibly, never hang the benchmark
+                for _idx, _snap, batch in pump.drain(
+                    total_rows=total, deadline=time.monotonic() + 180.0
+                ):
+                    got += batch.num_rows
+            except Exception as e:  # surfaced in the point's log line
+                fails.append(repr(e))
+            finally:
+                pump.stop()
             dt = time.perf_counter() - t0
-            got = sum(counts)
-            # a stalled/failed partition skews got/dt arbitrarily (dt
-            # absorbs the stall) — a failed rep must be visibly failed
-            # in the artifact, never a silently-wrong number
+            # a stalled/failed rep skews got/dt arbitrarily (dt absorbs
+            # the stall) — a failed rep must be visibly failed in the
+            # artifact, never a silently-wrong number
             if fails or got < total:
                 return None, fails or [f"short read: {got}/{total} rows"]
             return got / dt, []

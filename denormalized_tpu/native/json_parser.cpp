@@ -26,6 +26,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <limits>
+#include <locale.h>
 #include <string>
 #include <vector>
 
@@ -247,11 +248,77 @@ bool num_range_fallback_i64(const uint8_t* q, const uint8_t* te, int64_t& v) {
 }
 
 bool num_range_fallback_f64(const uint8_t* q, const uint8_t* te, double& v) {
+  // strtod_l against a cached C locale: plain strtod honors LC_NUMERIC,
+  // so an embedding process that set a comma-decimal locale would reject
+  // every '.'-pointed token this fallback exists to parse (from_chars is
+  // locale-independent — the two branches must not diverge by locale)
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
   std::string tok((const char*)q, (const char*)te);
   char* endp = nullptr;
-  double r = strtod(tok.c_str(), &endp);
+  double r = c_loc ? strtod_l(tok.c_str(), &endp, c_loc)
+                   : strtod(tok.c_str(), &endp);
   if (endp != tok.c_str() + tok.size()) return false;
   v = r;
+  return true;
+}
+
+// Clinger fast path: a token with <= 15 significant digits and a net
+// decimal exponent within ±22 is EXACTLY m * 10^q with m < 2^53 and
+// 10^|q| exactly representable — one multiply/divide, one rounding,
+// bit-identical to a correctly-rounded strtod/from_chars.  Returns
+// false (caller falls back to strtod) on long mantissas, big exponents,
+// or malformed tails.  This is the hot conversion on toolchains whose
+// libstdc++ lacks floating-point from_chars (gcc 10, this image): the
+// sensor-style payloads the engine ingests are short decimals, so the
+// slow path is essentially never taken.
+inline bool fast_f64(const uint8_t* p, const uint8_t* e, double& v) {
+  static const double P10[] = {1.0,   1e1,  1e2,  1e3,  1e4,  1e5,
+                               1e6,   1e7,  1e8,  1e9,  1e10, 1e11,
+                               1e12,  1e13, 1e14, 1e15, 1e16, 1e17,
+                               1e18,  1e19, 1e20, 1e21, 1e22};
+  bool neg = false;
+  if (p < e && *p == '-') {
+    neg = true;
+    p++;
+  }
+  uint64_t m = 0;
+  int ndig = 0, frac = 0;
+  bool seen_dot = false, any = false;
+  for (; p < e; p++) {
+    uint8_t ch = *p;
+    if (ch >= '0' && ch <= '9') {
+      any = true;
+      if (ndig < 19) m = m * 10 + (ch - '0');
+      ndig++;
+      if (seen_dot) frac++;
+    } else if (ch == '.' && !seen_dot) {
+      seen_dot = true;
+    } else {
+      break;
+    }
+  }
+  if (!any) return false;
+  int exp10 = 0;
+  if (p < e && (*p == 'e' || *p == 'E')) {
+    p++;
+    bool eneg = false;
+    if (p < e && (*p == '+' || *p == '-')) {
+      eneg = (*p == '-');
+      p++;
+    }
+    if (p >= e || *p < '0' || *p > '9') return false;
+    int ev = 0;
+    for (; p < e && *p >= '0' && *p <= '9'; p++)
+      if (ev < 100000) ev = ev * 10 + (*p - '0');
+    exp10 = eneg ? -ev : ev;
+  }
+  if (p != e) return false;
+  if (ndig > 15) return false;  // double rounding possible: strtod decides
+  int q10 = exp10 - frac;
+  if (q10 < -22 || q10 > 22) return false;
+  double dv = (double)m;
+  dv = q10 >= 0 ? dv * P10[q10] : dv / P10[-q10];
+  v = neg ? -dv : dv;
   return true;
 }
 
@@ -288,12 +355,24 @@ inline bool parse_f64_at(const uint8_t*& q, const uint8_t* e, double& v) {
   }
   const uint8_t* te = num_token_end(q, e);
   if (te == q) return false;
+#if defined(__cpp_lib_to_chars)
   auto r = std::from_chars((const char*)q, (const char*)te, v);
   if (r.ec == std::errc::result_out_of_range) {
     if (!num_range_fallback_f64(q, te, v)) return false;
   } else if (r.ec != std::errc() || r.ptr != (const char*)te) {
     return false;
   }
+#else
+  // libstdc++ < 11 ships integer from_chars only.  Clinger fast path
+  // first (correctly rounded for short decimals — the hot shape), then
+  // strtod on a bounded copy (the range-fallback conversion), keeping
+  // the same full-token consumption rule; '+'-led tokens are rejected
+  // explicitly to keep from_chars strictness (JSON forbids a leading
+  // plus, strtod does not).
+  if (*q == '+') return false;
+  if (!fast_f64(q, te, v) && !num_range_fallback_f64(q, te, v))
+    return false;
+#endif
   q = te;
   return true;
 }

@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from denormalized_tpu.ops import segment_agg as sa
-from denormalized_tpu.parallel.mesh import KEY_AXIS, SLICE_AXIS
+from denormalized_tpu.parallel.mesh import KEY_AXIS, SLICE_AXIS, shard_map
 
 
 class WindowStateBackend:
@@ -581,7 +581,7 @@ def _key_sharded_update(
             spec, state_l, values, colvalid, win_rel, rem, local_gid, mine, base_mod
         )
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -717,7 +717,7 @@ def _key_sharded_merge_partials(
             dense,
         )
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=({c.label: P(None, KEY_AXIS) for c in spec.components}, P()),
@@ -798,7 +798,7 @@ def _partial_update(
         return {k: v[None] for k, v in st.items()}
 
     n = mesh.devices.size
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -845,7 +845,7 @@ def _merge_slot_over(
                 out[c.label] = jax.lax.pmax(row, reduce_axis)
         return out
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=({c.label: state_spec for c in spec.components}, P()),
@@ -1014,7 +1014,7 @@ def _two_level_update(
         )
         return {k: v[None] for k, v in st.items()}
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(

@@ -10,9 +10,7 @@ and run vectorized numpy expression kernels.
 from __future__ import annotations
 
 import sys
-import threading
 import time
-import queue as queue_mod
 from typing import Callable, Iterator
 
 import numpy as np
@@ -35,13 +33,24 @@ from denormalized_tpu.sources.base import Source
 class _IdleTracker:
     """Idle-source detection shared by both SourceExec drive loops: rows
     re-arm it; after ``timeout_ms`` without rows it yields ONE
-    WatermarkHint at the max canonical timestamp seen."""
+    WatermarkHint at the max canonical timestamp seen.
 
-    def __init__(self, timeout_ms: int) -> None:
+    ``quiet`` (optional) is a reader-side gate: the hint carries the
+    GLOBAL max timestamp, so on the multi-partition prefetch path it
+    must never fire while any partition still has rows enqueued or
+    known backlog at the broker — the consumer-side clock alone reads
+    "idle" after any long consumer stall (first-batch compile, GC) even
+    though the stalled period's batches are sitting in the queue, and
+    the resulting hint would close windows the slower partition still
+    owes rows to (the same soak-found failure family as the
+    partition-watermark activity guard, see ``_PartitionWatermarks``)."""
+
+    def __init__(self, timeout_ms: int, quiet: Callable[[], bool] | None = None) -> None:
         self.timeout_ms = timeout_ms
         self._last_rows_wall = time.monotonic()
         self._max_ts: int | None = None
         self._sent = False
+        self._quiet = quiet
 
     def observe_rows(self, batch: RecordBatch) -> None:
         from denormalized_tpu.common.constants import (
@@ -69,6 +78,8 @@ class _IdleTracker:
             < self.timeout_ms
         ):
             return None
+        if self._quiet is not None and not self._quiet():
+            return None
         self._sent = True
         return WatermarkHint(self._max_ts)
 
@@ -91,6 +102,15 @@ class _PartitionWatermarks:
     ``observe``/``advance`` return a kind="partition" WatermarkHint only
     when the min strictly advances."""
 
+    #: first-read hold bound, as a multiple of the idle timeout: a reader
+    #: that still hasn't RETURNED from its first read after this long
+    #: stops holding the watermark and falls back to idle exclusion —
+    #: a reader wedged in connect/seek must not stall the stream forever.
+    #: The residual hazard (a reader legitimately IN its first read that
+    #: long whose eventual rows then drop late) is documented in
+    #: docs/watermarks.md.
+    FIRST_READ_GRACE_MULT = 4
+
     def __init__(self, n: int, timeout_ms: int | None, activity=None) -> None:
         self._wm: list[int | None] = [None] * n
         self._last_rows = [time.monotonic()] * n
@@ -99,22 +119,29 @@ class _PartitionWatermarks:
             timeout_ms / 1000.0 if timeout_ms is not None else None
         )
         self._emitted: int | None = None
+        self._born = time.monotonic()
         # activity(idx) -> (has_pending, last_rowful_produce_wall,
-        # first_read_done): on the threaded path idleness must be judged
-        # by what the READER produced, not by when the consumer got
-        # around to processing it — a burst of one partition's catch-up
-        # batches ahead in the SHARED queue otherwise makes the other
-        # partition look idle while its backlog is already enqueued,
-        # excludes it from the min, and late-drops that backlog
-        # (soak-found: a contiguous slice of the first window after a
-        # kill/restore vanished whenever the consumer spent >idle_timeout
-        # on one partition's run of queued batches).  first_read_done
-        # separates "quiet topic" from "still starting": a reader that
-        # has not yet RETURNED from its first read (connect/seek/fetch in
-        # flight, possibly starved by a compiling consumer on a shared
-        # core) holds the min — its initial backlog is unknown, not
-        # absent (soak-found at stream start: window 0 short by the
-        # slower-connecting partition's share under first-batch compile)
+        # first_read_done[, may_judge_idle]): on the threaded path
+        # idleness must be judged by what the READER produced, not by
+        # when the consumer got around to processing it — a burst of one
+        # partition's catch-up batches ahead in the SHARED queue
+        # otherwise makes the other partition look idle while its
+        # backlog is already enqueued, excludes it from the min, and
+        # late-drops that backlog (soak-found: a contiguous slice of the
+        # first window after a kill/restore vanished whenever the
+        # consumer spent >idle_timeout on one partition's run of queued
+        # batches).  first_read_done separates "quiet topic" from "still
+        # starting": a reader that has not yet RETURNED from its first
+        # read (connect/seek/fetch in flight, possibly starved by a
+        # compiling consumer on a shared core) holds the min — its
+        # initial backlog is unknown, not absent (soak-found at stream
+        # start: window 0 short by the slower-connecting partition's
+        # share under first-batch compile).  may_judge_idle extends the
+        # same reasoning to a reader that KNOWS it has broker-side
+        # backlog (PartitionReader.caught_up() is False): a partition
+        # mid-way through a large catch-up fetch/decode has nothing
+        # enqueued and a stale produce stamp, yet idle-excluding it
+        # late-drops the very rows that fetch is carrying.
         self._activity = activity
 
     def observe(self, idx: int, batch: RecordBatch) -> WatermarkHint | None:
@@ -147,12 +174,24 @@ class _PartitionWatermarks:
             if fin:
                 continue
             if self._activity is not None:
-                pending, produced, first_read_done = self._activity(i)
+                act = self._activity(i)
+                pending, produced, first_read_done = act[0], act[1], act[2]
+                may_judge_idle = act[3] if len(act) > 3 else True
                 if not first_read_done:
-                    return None  # still starting: backlog unknown, hold
+                    # still starting: backlog unknown, hold — but only up
+                    # to a bounded multiple of the idle timeout; past it
+                    # the stuck reader is excluded like an idle one
+                    if self._timeout_s is None or (
+                        now - self._born
+                        < self.FIRST_READ_GRACE_MULT * self._timeout_s
+                    ):
+                        return None
+                    continue
                 lr = max(lr, produced)
-                if pending:
-                    lr = now  # enqueued-but-unprocessed rows: never idle
+                if pending or not may_judge_idle:
+                    # enqueued-but-unprocessed rows, or reader-reported
+                    # broker backlog (catch-up fetch in flight): never idle
+                    lr = now
             idle = (
                 self._timeout_s is not None
                 and now - lr >= self._timeout_s
@@ -304,7 +343,16 @@ class SourceExec(ExecOperator):
             # the threaded path below — bounded sources get the EOS flush
             # instead)
             idle = (
-                _IdleTracker(self._idle_timeout_ms)
+                _IdleTracker(
+                    self._idle_timeout_ms,
+                    # same reader-side gate as the prefetch path: a
+                    # reader that KNOWS it has backlog (caught_up False)
+                    # blocks the idle hint; None (no backlog knowledge)
+                    # keeps the wall-clock judgment
+                    quiet=lambda: all(
+                        r.caught_up() is not False for r in readers
+                    ),
+                )
                 if self.source.unbounded and self._idle_timeout_ms is not None
                 else None
             )
@@ -340,77 +388,42 @@ class SourceExec(ExecOperator):
             yield EOS
             return
 
-        # live multi-partition: reader threads feed a bounded queue.  Each
-        # queue item carries the reader's offset snapshot taken right after
-        # the read, so barrier persistence reflects only yielded batches.
-        from denormalized_tpu.runtime.pump import spawn_pump
+        # live multi-partition: one prefetch worker per partition runs the
+        # full fetch → decode → assembly loop off this thread (the ctypes
+        # foreign calls release the GIL for their native portion, so
+        # workers overlap across cores).  Each ready item carries the
+        # reader's offset snapshot taken right after the read, so barrier
+        # persistence reflects only yielded batches; backpressure is the
+        # per-partition bounded buffer inside the pump, released only
+        # after downstream fully processed the batch.
+        from denormalized_tpu.runtime.prefetch import PrefetchPump
 
-        q: queue_mod.Queue = queue_mod.Queue(maxsize=self._queue_size)
-        done = threading.Event()
-        # per-partition reader-side activity, single-writer per slot (the
-        # reader thread writes enq_*, the consumer writes deq_) — consumed
-        # by the partition-watermark tracker's idleness judgment so a
-        # partition with rows enqueued (or blocked mid-put) is never
-        # idle-excluded just because the consumer is busy elsewhere
-        enq_rowful = [0] * len(readers)
-        deq_rowful = [0] * len(readers)
-        enq_wall = [time.monotonic()] * len(readers)
-        first_read_done = [False] * len(readers)
-
-        def reader_items(idx, reader):
-            def gen():
-                while not done.is_set():
-                    b = reader.read(timeout_s=0.1)
-                    first_read_done[idx] = True
-                    if b is None:
-                        # explicit per-reader EOS marker (the pump's
-                        # sentinel doesn't say WHICH reader ended, and
-                        # the partition-watermark min must drop it)
-                        yield (idx, None, None)
-                        return
-                    if b.num_rows:
-                        # stamp BEFORE the (possibly blocking) queue put:
-                        # while blocked on a full queue the partition has
-                        # pending work and must read as active
-                        enq_wall[idx] = time.monotonic()
-                        enq_rowful[idx] += 1
-                    yield (idx, reader.offset_snapshot(), b)
-
-            return gen
-
-        def _activity(i):
-            return (
-                enq_rowful[i] > deq_rowful[i],
-                enq_wall[i],
-                first_read_done[i],
-            )
-
-        for i, r in enumerate(readers):
-            spawn_pump(q, done, reader_items(i, r), sentinel=None)
+        pump = PrefetchPump(readers, queue_budget=self._queue_size)
         finished = 0
         # idle-source watermark hints: live readers deliver EMPTY batches
         # on read timeouts even when the topic is quiet, so idleness is
         # measured from the last ROWFUL batch (wall clock), not from queue
-        # starvation.  One hint per idle period; rows re-arm it.
+        # starvation — gated on reader-side quiescence so a consumer
+        # stall can never declare idleness over data already in flight.
+        # One hint per idle period; rows re-arm it.
         idle = (
-            _IdleTracker(self._idle_timeout_ms)
+            _IdleTracker(self._idle_timeout_ms, quiet=pump.quiet)
             if self._idle_timeout_ms is not None
             else None
         )
-        pwm = self._partition_wm_tracker(len(readers), activity=_activity)
+        pwm = self._partition_wm_tracker(len(readers), activity=pump.activity)
         if pwm is not None:
             yield WatermarkHint(WM_ANNOUNCE, kind="partition")
+        pump.start()
         try:
             while finished < len(readers):
-                item = q.get()
-                if item is None:
-                    finished += 1
-                    continue
+                item = pump.get()
                 if isinstance(item, BaseException):
                     raise item
                 idx, snap, batch = item
                 if batch is None:
                     # per-reader EOS (dead unbounded reader)
+                    finished += 1
                     if pwm is not None and (h := pwm.finish(idx)):
                         yield h
                     continue
@@ -423,8 +436,7 @@ class SourceExec(ExecOperator):
                         yield h
                 yield batch
                 self._yielded_offsets[idx] = snap
-                if batch.num_rows:
-                    deq_rowful[idx] += 1
+                pump.consumed(idx, bool(batch.num_rows))
                 if pwm is not None:
                     h = (
                         pwm.observe(idx, batch)
@@ -435,7 +447,7 @@ class SourceExec(ExecOperator):
                         yield h
                 yield from self._maybe_barrier()
         finally:
-            done.set()
+            pump.stop()
         yield EOS
 
 

@@ -90,6 +90,7 @@ def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
     root = build_physical(plan, ctx)
     ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
     orch, coord = _attach_checkpointing(root, ctx, checkpoint)
+    ctx._last_coord = coord  # transactional sinks read committed_epoch
     flag = ShutdownFlag()
     restore = _install_signal_handlers(flag)
     try:
@@ -117,6 +118,10 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     root = build_physical(plan, ctx)
     ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
     orch, coord = _attach_checkpointing(root, ctx)
+    # exactly-once sinks tag output with the in-flight epoch and a
+    # recovery reader discards the uncommitted suffix (the transactional
+    # truncate-on-restore protocol); committed_epoch is their boundary
+    ctx._last_coord = coord
     try:
         for item in root.run():
             if isinstance(item, RecordBatch):

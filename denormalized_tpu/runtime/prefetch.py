@@ -1,0 +1,257 @@
+"""Pipelined multi-core ingest: one prefetch worker per partition.
+
+Each :class:`PrefetchWorker` thread owns one :class:`PartitionReader`
+(and therefore that reader's own native client connection — the native
+Kafka client is single-threaded per object, so per-worker ownership is
+what makes the fetch loops independent) and runs the full
+fetch → native decode → ``RecordBatch`` assembly loop off the consumer
+thread.  The ctypes foreign calls (``kc_fetch``, the native JSON/Avro
+parse) drop the GIL for their native portion, so N workers overlap
+network wait and decode across cores; ``tests/test_prefetch_pipeline.py``
+pins that property with a concurrency test.
+
+Completed batches land in one shared ready queue that the consumer
+(:class:`~denormalized_tpu.physical.simple_execs.SourceExec`) drains —
+each item already carries the reader's offset snapshot (taken right
+after the read, so barrier persistence reflects only yielded batches)
+and its canonical timestamps.  The queue itself is unbounded; the bound
+is a per-worker ``Semaphore(depth)`` released only after the consumer
+has fully processed the item downstream.  That makes backpressure the
+bounded per-partition buffer (a double buffer at ``depth=2``: one batch
+being consumed, one being assembled) rather than the reader's poll
+cadence, and it means one partition's catch-up burst can never occupy
+another partition's budget the way a single shared bounded queue could.
+
+Reader-side activity is tracked on the worker (single-writer slots) so
+watermark idleness judgments never depend on when the consumer got
+around to processing a partition's batches:
+
+- ``pending``         — enqueued-but-unconsumed rowful batches exist;
+- ``enq_wall``        — wall clock of the last rowful enqueue;
+- ``first_read_done`` — the first ``read()`` has RETURNED (before that,
+  the partition's backlog is unknown, not absent);
+- ``caught_up``       — the reader's own backlog report
+  (``PartitionReader.caught_up()``): ``False`` means the source KNOWS
+  more data is already at the broker, so the partition must never be
+  idle-excluded even while a fetch/decode is in flight (the soak-found
+  hole behind SOAK_KAFKA's short first window: a partition mid-way
+  through a large catch-up fetch looked idle to every consumer-side
+  clock).  ``None`` (reader has no backlog knowledge) falls back to the
+  wall-clock judgment.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import Callable, Iterator
+
+
+class PrefetchWorker:
+    """One partition's fetch+decode loop on its own thread."""
+
+    def __init__(
+        self,
+        idx: int,
+        reader,
+        out_q: queue_mod.Queue,
+        done: threading.Event,
+        *,
+        depth: int = 2,
+        read_timeout_s: float = 0.1,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.idx = idx
+        self.reader = reader
+        self._q = out_q
+        self._done = done
+        self._slots = threading.Semaphore(depth)
+        self._read_timeout_s = read_timeout_s
+        # single-writer activity slots (worker writes enq_*, consumer
+        # writes deq_) — see module docstring
+        self.enq_rowful = 0
+        self.deq_rowful = 0
+        self.enq_wall = time.monotonic()
+        self.first_read_done = False
+        self.caught_up: bool | None = None
+        self.finished = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name=f"prefetch-{self.idx}",
+        )
+        self._thread.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- consumer side ----------------------------------------------------
+    def consumed(self, rowful: bool) -> None:
+        """Release the item's buffer slot AFTER downstream processed it —
+        the slot is the backpressure unit, so it must cover the full
+        consume, not just the dequeue."""
+        if rowful:
+            self.deq_rowful += 1
+        self._slots.release()
+
+    def activity(self) -> tuple[bool, float, bool, bool]:
+        """(pending, last_rowful_enqueue_wall, first_read_done,
+        may_judge_idle) for the partition-watermark tracker."""
+        return (
+            self.enq_rowful > self.deq_rowful,
+            self.enq_wall,
+            self.first_read_done,
+            self.caught_up is not False,
+        )
+
+    def reader_quiet(self) -> bool:
+        """True when the READER side shows no sign of data in flight:
+        first read returned, nothing enqueued-but-unconsumed, and the
+        reader does not report known backlog.  A finished partition is
+        quiet permanently."""
+        if self.finished:
+            return True
+        return (
+            self.first_read_done
+            and self.enq_rowful <= self.deq_rowful
+            and self.caught_up is not False
+        )
+
+    # -- worker side ------------------------------------------------------
+    def _acquire_slot(self) -> bool:
+        while not self._done.is_set():
+            if self._slots.acquire(timeout=0.1):
+                return True
+        return False
+
+    def _run(self) -> None:
+        reader = self.reader
+        probe = getattr(reader, "caught_up", None)
+        if not callable(probe):
+            probe = None
+        try:
+            while not self._done.is_set():
+                b = reader.read(timeout_s=self._read_timeout_s)
+                self.first_read_done = True
+                if b is None:
+                    break  # partition exhausted (or reader died cleanly)
+                if probe is not None:
+                    self.caught_up = probe()
+                if b.num_rows:
+                    # stamp BEFORE the (possibly blocking) slot acquire:
+                    # while waiting for the consumer the partition has
+                    # pending work and must read as active
+                    self.enq_wall = time.monotonic()
+                    self.enq_rowful += 1
+                snap = reader.offset_snapshot()
+                if not self._acquire_slot():
+                    return  # shutdown won
+                self._q.put((self.idx, snap, b))
+        except BaseException as e:  # surfaced by the consumer
+            self._q.put(e)
+        finally:
+            self.finished = True
+            self._q.put((self.idx, None, None))
+
+
+class PrefetchPump:
+    """N prefetch workers merged into one ready queue."""
+
+    def __init__(
+        self,
+        readers,
+        *,
+        queue_budget: int = 64,
+        depth: int | None = None,
+        read_timeout_s: float = 0.1,
+    ) -> None:
+        if depth is None:
+            # split the aggregate budget across partitions; never below a
+            # double buffer, never absurdly deep (in-flight batches widen
+            # the watermark skew the consumer must reconcile)
+            depth = max(2, min(16, queue_budget // max(1, len(readers))))
+        self._q: queue_mod.Queue = queue_mod.Queue()
+        self._done = threading.Event()
+        self.workers = [
+            PrefetchWorker(
+                i, r, self._q, self._done,
+                depth=depth, read_timeout_s=read_timeout_s,
+            )
+            for i, r in enumerate(readers)
+        ]
+        self.depth = depth
+
+    def start(self) -> "PrefetchPump":
+        for w in self.workers:
+            w.start()
+        return self
+
+    def stop(self) -> None:
+        self._done.set()
+
+    def get(self):
+        return self._q.get()
+
+    def consumed(self, idx: int, rowful: bool) -> None:
+        self.workers[idx].consumed(rowful)
+
+    def activity(self, idx: int) -> tuple[bool, float, bool, bool]:
+        return self.workers[idx].activity()
+
+    def quiet(self) -> bool:
+        """True when EVERY partition is reader-side quiet — the gate for
+        the source-level idle hint, so a consumer stall (compile, GC)
+        followed by an empty heartbeat can never declare idleness over
+        rows that are already fetched or known to be at the broker."""
+        return all(w.reader_quiet() for w in self.workers)
+
+    def drain(
+        self,
+        total_rows: int | None = None,
+        deadline: float | None = None,
+    ) -> Iterator:
+        """Utility consumer loop (bench / tests): yield (idx, snap,
+        batch) for every rowful batch, releasing slots as it goes, until
+        ``total_rows`` rows were seen or every worker finished.  Raises
+        the first worker exception; raises TimeoutError once
+        ``time.monotonic()`` passes ``deadline`` — checked on every
+        dequeued item (empty heartbeats included) AND while waiting, so
+        a wedged stream fails visibly instead of blocking forever."""
+        finished = 0
+        seen = 0
+        n = len(self.workers)
+        while finished < n:
+            if deadline is None:
+                item = self.get()
+            else:
+                while True:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"prefetch drain stalled at {seen} rows"
+                        )
+                    try:
+                        item = self._q.get(timeout=1.0)
+                        break
+                    except queue_mod.Empty:
+                        continue
+            if isinstance(item, BaseException):
+                raise item
+            idx, snap, batch = item
+            if batch is None:
+                finished += 1
+                continue
+            rowful = bool(batch.num_rows)
+            try:
+                if rowful:
+                    seen += batch.num_rows
+                    yield idx, snap, batch
+            finally:
+                self.consumed(idx, rowful)
+            if total_rows is not None and seen >= total_rows:
+                return

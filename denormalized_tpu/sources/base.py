@@ -110,6 +110,16 @@ class PartitionReader:
     def offset_restore(self, snap: dict) -> None:
         pass
 
+    # -- optional backlog report ----------------------------------------
+    def caught_up(self) -> bool | None:
+        """Does this reader KNOW whether more data is already waiting at
+        the source?  ``False`` = yes, backlog exists (the prefetch
+        engine then never judges the partition idle, even mid-fetch);
+        ``True`` = the cursor is at the source's frontier; ``None``
+        (default) = no backlog knowledge — idleness falls back to the
+        wall-clock-since-last-rows judgment."""
+        return None
+
 
 class Source:
     name: str = "source"

@@ -346,21 +346,51 @@ class KafkaClient:
             self._libref.kc_rec_kafka_offsets(self._h), shape=(n,)
         ).copy()
 
+    def high_watermark(self) -> int:
+        """The partition high watermark reported by the LAST fetch
+        response on this client — next_offset < high_watermark means the
+        broker already holds more records (catch-up backlog)."""
+        return int(self._libref.kc_high_watermark(self._handle()))
+
+
+def _fetch_offsets(optr, n):
+    """Offsets view for live arena pointers or coalesced ndarrays."""
+    if isinstance(optr, np.ndarray):
+        return optr
+    return np.ctypeslib.as_array(optr, shape=(n + 1,))
+
+
+def _fetch_raw_bytes(bptr, offs):
+    """Materialize the record bytes of either buffer representation —
+    the ONE place the bytes/pointer duality is resolved, so the salvage
+    path can never diverge from the parse path."""
+    if isinstance(bptr, (bytes, bytearray)):
+        return bytes(bptr)
+    return ctypes.string_at(bptr, int(offs[-1]))
+
 
 def parse_fetch_arena(parser, n, bptr, optr, ts):
     """Parse a fetch arena zero-copy; compacts away zero-length payloads
-    (tombstones) keeping the timestamp column aligned.  → (batch|None, ts)."""
-    offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
+    (tombstones) keeping the timestamp column aligned.  → (batch|None, ts).
+
+    ``bptr``/``optr`` are either live arena pointers (valid until the next
+    fetch on that client) or materialized buffers — ``bytes`` plus a
+    uint64 offsets ndarray — from a coalesced multi-fetch decode unit."""
+    offs = _fetch_offsets(optr, n)
+    if isinstance(optr, np.ndarray):
+        optr = offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+    data = (
+        bptr
+        if isinstance(bptr, (bytes, bytearray))
+        else ctypes.cast(bptr, ctypes.c_void_p)
+    )
     keep = np.diff(offs) > 0
     if keep.all():
-        return (
-            parser.parse_ptr(ctypes.cast(bptr, ctypes.c_void_p), optr, n),
-            ts,
-        )
+        return parser.parse_ptr(data, optr, n), ts
     idx = np.nonzero(keep)[0]
     if len(idx) == 0:
         return None, np.empty(0, dtype=np.int64)
-    raw = ctypes.string_at(bptr, int(offs[-1]))
+    raw = _fetch_raw_bytes(bptr, offs)
     pieces = [raw[offs[i] : offs[i + 1]] for i in idx]
     data = b"".join(pieces)
     coffs = np.zeros(len(pieces) + 1, dtype=np.uint64)
@@ -490,8 +520,32 @@ class KafkaPartitionReader(PartitionReader):
             raise SourceError(
                 f"max.batch.rows must be >= 1, got {self._max_batch_rows}"
             )
+        # fetch coalescing: a trickle of small fetches (live tail, or a
+        # broker serving few batches per response) pays the per-parse
+        # Python overhead once per tiny arena.  When a fetch comes back
+        # under this row count AND the response's high watermark shows
+        # backlog already at the broker, keep fetching with ZERO extra
+        # wait and decode the copied arenas as ONE unit — larger decode
+        # units, identical records, no added latency.  0 disables.
+        raw_coal = src.builder.opts.get("fetch.coalesce.rows", 4096)
+        try:
+            self._coalesce_rows = int(raw_coal)
+        except (TypeError, ValueError):
+            raise SourceError(
+                f"fetch.coalesce.rows must be an integer, got {raw_coal!r}"
+            ) from None
+        if self._coalesce_rows < 0:
+            raise SourceError(
+                "fetch.coalesce.rows must be >= 0, got "
+                f"{self._coalesce_rows}"
+            )
         self._pending_slices: list = []
         self._snap_offset = self._offset
+        # backlog report from the last fetch response (None = unknown):
+        # consumed by the prefetch engine's idleness judgment — a reader
+        # that KNOWS the broker holds more records must never be judged
+        # idle, even while its next fetch/decode is in flight
+        self._caught_up: bool | None = None
 
     # transport failures are transient: log-and-retry with reconnect, like
     # the reference's recv error handling (kafka_stream_read.rs:210-218) —
@@ -530,6 +584,7 @@ class KafkaPartitionReader(PartitionReader):
         if self._consecutive_failures >= self._MAX_CONSECUTIVE_FAILURES:
             self._consecutive_failures = 0  # future reads retry again
             raise err
+        self._caught_up = None  # broker unreachable: backlog unknown
         old = self._client
         self._client = None  # never reuse a possibly-freed handle
         if old is not None:
@@ -618,6 +673,71 @@ class KafkaPartitionReader(PartitionReader):
             return None, kafka_ts[:0]
         return RecordBatch.concat(good), kafka_ts[np.asarray(keep)]
 
+    #: bound on fetches combined into one coalesced decode unit
+    _MAX_COALESCED_FETCHES = 16
+
+    def _coalesce_fetches(self, n, bptr, optr, kafka_ts, next_off):
+        """Combine a small fetch with immediately-available backlog into
+        one decode unit.  Arenas are copied (each fetch invalidates the
+        previous fetch's pointers on this client); per-record absolute
+        Kafka offsets are captured per fetch so oversize splitting keeps
+        its exact checkpoint semantics.  Extra fetches use max_wait=0 —
+        only records ALREADY at the broker coalesce, never added wait.
+        → (n, data_bytes, offsets_ndarray, ts, next_off, rec_offs|None)."""
+        offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
+        chunks = [(
+            ctypes.string_at(bptr, int(offs[-1])),
+            offs.copy(),
+            kafka_ts,
+            self._client.rec_kafka_offsets(n),
+        )]
+        total = n
+        while (
+            total < self._coalesce_rows
+            and self._caught_up is False
+            and len(chunks) < self._MAX_COALESCED_FETCHES
+        ):
+            try:
+                n2, bptr2, optr2, ts2, off2 = self._client.fetch_ptrs(
+                    self._topic, self._partition, self._offset, max_wait_ms=0
+                )
+            except SourceError:
+                # records already collected must still decode — the
+                # cursor has advanced past them; surface the transport
+                # problem on the NEXT read instead of dropping data
+                self._caught_up = None
+                break
+            self._offset = off2
+            self._caught_up = off2 >= self._client.high_watermark()
+            if n2 == 0:
+                break
+            next_off = off2
+            offs2 = np.ctypeslib.as_array(optr2, shape=(n2 + 1,))
+            chunks.append((
+                ctypes.string_at(bptr2, int(offs2[-1])),
+                offs2.copy(),
+                ts2,
+                self._client.rec_kafka_offsets(n2),
+            ))
+            total += n2
+        if len(chunks) == 1:
+            raw, offs0, ts, ro = chunks[0]
+            return n, raw, offs0, ts, next_off, ro
+        data = b"".join(c[0] for c in chunks)
+        comb = np.zeros(total + 1, dtype=np.uint64)
+        pos = 0
+        shift = np.uint64(0)
+        for raw, offs_c, _ts, _ro in chunks:
+            k = len(offs_c) - 1
+            comb[pos + 1 : pos + k + 1] = offs_c[1:] + shift
+            pos += k
+            shift += offs_c[-1]
+        ts_all = np.concatenate([c[2] for c in chunks])
+        rec_offs = None
+        if all(c[3] is not None for c in chunks):
+            rec_offs = np.concatenate([c[3] for c in chunks])
+        return total, data, comb, ts_all, next_off, rec_offs
+
     def _read_once(self, native, max_wait):
         if self._client is None:
             raise SourceError("kafka client disconnected")
@@ -627,15 +747,25 @@ class KafkaPartitionReader(PartitionReader):
             )
             self._consecutive_failures = 0
             self._offset = next_off
+            self._caught_up = next_off >= self._client.high_watermark()
             if n == 0:
                 return RecordBatch.empty(self._src.schema)
+            rec_offs = None
+            if (
+                self._coalesce_rows
+                and n < self._coalesce_rows
+                and self._caught_up is False
+            ):
+                n, bptr, optr, kafka_ts, next_off, rec_offs = (
+                    self._coalesce_fetches(n, bptr, optr, kafka_ts, next_off)
+                )
             try:
                 batch, kafka_ts = parse_fetch_arena(
                     native, n, bptr, optr, kafka_ts
                 )
             except FormatError as e:
-                offs = np.ctypeslib.as_array(optr, shape=(n + 1,))
-                raw = ctypes.string_at(bptr, int(offs[-1]))
+                offs = _fetch_offsets(optr, n)
+                raw = _fetch_raw_bytes(bptr, offs)
                 payloads = [
                     raw[offs[i] : offs[i + 1]] for i in range(n)
                 ]
@@ -643,7 +773,7 @@ class KafkaPartitionReader(PartitionReader):
             if batch is None:
                 return RecordBatch.empty(self._src.schema)
             return self._maybe_split(
-                self._attach_ts(batch, kafka_ts), n, next_off
+                self._attach_ts(batch, kafka_ts), n, next_off, rec_offs
             )
 
         payloads, kafka_ts, next_off = self._client.fetch(
@@ -652,6 +782,7 @@ class KafkaPartitionReader(PartitionReader):
         self._consecutive_failures = 0
         # commit before decode (see above)
         self._offset = next_off
+        self._caught_up = next_off >= self._client.high_watermark()
         n_fetch = len(payloads)
         if not payloads:
             # live source: no data within the wait — empty batch, stay open
@@ -676,6 +807,14 @@ class KafkaPartitionReader(PartitionReader):
             self._attach_ts(batch, kafka_ts), n_fetch, next_off
         )
 
+    def caught_up(self) -> bool | None:
+        """Backlog report for the prefetch engine: ``False`` = the last
+        fetch response showed records beyond this reader's cursor (a
+        catch-up is in flight — never judge this partition idle),
+        ``True`` = cursor at the high watermark, ``None`` = unknown (no
+        fetch yet, or reconnecting)."""
+        return self._caught_up
+
     def offset_snapshot(self) -> dict:
         # _snap_offset trails _offset while a split fetch drains: it
         # covers exactly the YIELDED slices, so a barrier between slices
@@ -683,18 +822,25 @@ class KafkaPartitionReader(PartitionReader):
         return {"partition": self._partition, "offset": int(self._snap_offset)}
 
     def offset_restore(self, snap: dict) -> None:
+        # in-flight work past the restored offset — undrained split
+        # slices here, plus anything a prefetch worker buffered upstream
+        # (discarded by the restore happening BEFORE workers spawn) —
+        # must be dropped, not replayed on top of the seek-back
         self._offset = int(snap.get("offset", self._offset))
         self._snap_offset = self._offset
         self._pending_slices.clear()
+        self._caught_up = None
 
-    def _maybe_split(self, batch, n_fetch, next_off):
+    def _maybe_split(self, batch, n_fetch, next_off, rec_offs=None):
         """Split an oversized CLEANLY-decoded batch.  Rows must align 1:1
         with the fetch's records for the per-record offsets to apply —
-        tombstone-dropped or salvaged fetches skip splitting."""
+        tombstone-dropped or salvaged fetches skip splitting.  A
+        coalesced decode unit passes its per-fetch-captured ``rec_offs``
+        (the client only retains the LAST fetch's)."""
         if batch.num_rows > self._max_batch_rows and batch.num_rows == n_fetch:
-            return self._split_oversized(
-                batch, self._client.rec_kafka_offsets(n_fetch), next_off
-            )
+            if rec_offs is None:
+                rec_offs = self._client.rec_kafka_offsets(n_fetch)
+            return self._split_oversized(batch, rec_offs, next_off)
         return batch
 
     def _split_oversized(self, batch, rec_offs, next_off):

@@ -67,6 +67,13 @@ class CheckpointCoordinator:
         self.committed_epoch: int | None = (
             int(raw.decode()) if raw is not None else None
         )
+        #: the epoch this run RECOVERED from, frozen at construction —
+        #: committed_epoch moves with every new commit, but transactional
+        #: sinks need the recovery point itself: output the previous
+        #: incarnation wrote with an in-flight epoch beyond this value is
+        #: exactly the uncommitted suffix a restore regenerates, and a
+        #: recovery reader must discard it (truncate-on-restore)
+        self.restored_epoch: int | None = self.committed_epoch
         self._epoch_keys: dict[int, list[str]] = {}
 
     # -- write side ------------------------------------------------------

@@ -261,6 +261,17 @@ class MockKafkaBroker:
         # instead of walking the log (O(log n) vs O(n) per fetch)
         self._blobs: dict[tuple[str, int], list] = {}
         self._npartitions: dict[str, int] = {}
+        # per-(topic, partition) artificial fetch latency (seconds),
+        # applied before serving a Fetch that covers the partition — lets
+        # tests stagger partition service times deterministically (each
+        # client connection has its own serve thread, so delaying one
+        # partition's consumer never slows the others)
+        self.fetch_delay_s: dict[tuple[str, int], float] = {}
+        # test knob: serve at most this many bytes per fetch regardless
+        # of the client's max_bytes — small fetches on demand (the shape
+        # a slow link or a tiny-batch producer creates), for exercising
+        # fetch coalescing deterministically
+        self.fetch_max_bytes_clamp: int | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -669,6 +680,18 @@ class MockKafkaBroker:
                 parts.append((part, off, maxb))
             reqs.append((name, parts))
 
+        if self.fetch_delay_s:
+            delay = max(
+                (
+                    self.fetch_delay_s.get((name, part), 0.0)
+                    for name, parts in reqs
+                    for part, _off, _maxb in parts
+                ),
+                default=0.0,
+            )
+            if delay:
+                time.sleep(delay)
+
         # honor max_wait when no data is available
         deadline = time.time() + max_wait / 1000.0
         while time.time() < deadline:
@@ -715,10 +738,13 @@ class MockKafkaBroker:
                         bi = max(0, bi)
                         picked = []
                         size = 0
+                        budget = max(maxb, 1)
+                        if self.fetch_max_bytes_clamp is not None:
+                            budget = min(budget, self.fetch_max_bytes_clamp)
                         for o, enc in blobs[bi : bi + 50_000]:
                             picked.append(enc)
                             size += len(enc)
-                            if size >= max(maxb, 1):
+                            if size >= budget:
                                 break
                         blob = b"".join(picked)
                 out += struct.pack(">ihqq", part, 0, hw, hw)

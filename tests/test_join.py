@@ -423,119 +423,136 @@ def test_join_on_shared_name_columns():
 
 # -- property test: windowed join vs brute-force oracle ------------------
 
-from hypothesis import given, settings, strategies as st
+try:
+    from hypothesis import given, settings, strategies as st
 
-# pytest inserts tests/ itself on sys.path (no __init__.py here), so the
-# sibling module imports under its own name — the same module object the
-# suite already created, not a 'tests.' package double-import
-from test_window_properties import oracle_values
+    HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:  # image without hypothesis: keep the
+    # concrete join tests collectable, skip only the property test
+    HAVE_HYPOTHESIS = False
+
+if HAVE_HYPOTHESIS:
 
 
-@st.composite
-def _join_case(draw):
-    """Two random streams with disorder and late rows; tumbling 1s join."""
-    t0 = 1_700_000_000_000
-    streams = []
-    for side in range(2):
-        n_batches = draw(st.integers(2, 5))
-        batches = []
-        base = 0
-        for _ in range(n_batches):
-            n = draw(st.integers(1, 20))
-            base += draw(st.integers(0, 800))
-            offs = draw(
-                st.lists(st.integers(-500, 900), min_size=n, max_size=n)
-            )
-            ts = sorted(max(0, base + o) + t0 for o in offs)
-            ks = draw(
-                st.lists(
-                    st.sampled_from(["a", "b", "c"]), min_size=n, max_size=n
+    # pytest inserts tests/ itself on sys.path (no __init__.py here), so the
+    # sibling module imports under its own name — the same module object the
+    # suite already created, not a 'tests.' package double-import
+    from test_window_properties import oracle_values
+
+
+    @st.composite
+    def _join_case(draw):
+        """Two random streams with disorder and late rows; tumbling 1s join."""
+        t0 = 1_700_000_000_000
+        streams = []
+        for side in range(2):
+            n_batches = draw(st.integers(2, 5))
+            batches = []
+            base = 0
+            for _ in range(n_batches):
+                n = draw(st.integers(1, 20))
+                base += draw(st.integers(0, 800))
+                offs = draw(
+                    st.lists(st.integers(-500, 900), min_size=n, max_size=n)
                 )
-            )
-            vs = [float((i * 7 + side) % 11) for i in range(n)]
-            batches.append((ts, ks, vs))
-        streams.append(batches)
-    return streams
+                ts = sorted(max(0, base + o) + t0 for o in offs)
+                ks = draw(
+                    st.lists(
+                        st.sampled_from(["a", "b", "c"]), min_size=n, max_size=n
+                    )
+                )
+                vs = [float((i * 7 + side) % 11) for i in range(n)]
+                batches.append((ts, ks, vs))
+            streams.append(batches)
+        return streams
 
 
-@settings(max_examples=25, deadline=None)
-@given(_join_case())
-def test_windowed_join_matches_oracle(case):
-    """The inner windowed stream join must equal the brute-force join of
-    the two per-stream window oracles (each with its own watermark and
-    late-row drops) on (window_start, key) — the stream_join example
-    semantics (reference examples/examples/stream_join.rs:61-80) under
-    random disorder."""
-    L = 1000
-    raw_l, raw_r = case
-    schema = Schema(
-        [
-            Field("occurred_at_ms", DataType.INT64, nullable=False),
-            Field("sensor_name", DataType.STRING, nullable=False),
-            Field("reading", DataType.FLOAT64),
-        ]
-    )
+    @settings(max_examples=25, deadline=None)
+    @given(_join_case())
+    def test_windowed_join_matches_oracle(case):
+        """The inner windowed stream join must equal the brute-force join of
+        the two per-stream window oracles (each with its own watermark and
+        late-row drops) on (window_start, key) — the stream_join example
+        semantics (reference examples/examples/stream_join.rs:61-80) under
+        random disorder."""
+        L = 1000
+        raw_l, raw_r = case
+        schema = Schema(
+            [
+                Field("occurred_at_ms", DataType.INT64, nullable=False),
+                Field("sensor_name", DataType.STRING, nullable=False),
+                Field("reading", DataType.FLOAT64),
+            ]
+        )
 
-    def to_batches(raw):
-        return [
-            RecordBatch(
-                schema,
-                [
-                    np.asarray(ts, np.int64),
-                    np.asarray(ks, object),
-                    np.asarray(vs),
-                ],
-            )
-            for ts, ks, vs in raw
-        ]
+        def to_batches(raw):
+            return [
+                RecordBatch(
+                    schema,
+                    [
+                        np.asarray(ts, np.int64),
+                        np.asarray(ks, object),
+                        np.asarray(vs),
+                    ],
+                )
+                for ts, ks, vs in raw
+            ]
 
-    ctx = Context()
-    left = ctx.from_source(
-        MemorySource.from_batches(
-            to_batches(raw_l), timestamp_column="occurred_at_ms"
-        ),
-        name="pj_l",
-    ).window(["sensor_name"], [F.avg(col("reading")).alias("avg_l")], L)
-    right = (
-        ctx.from_source(
+        ctx = Context()
+        left = ctx.from_source(
             MemorySource.from_batches(
-                to_batches(raw_r), timestamp_column="occurred_at_ms"
+                to_batches(raw_l), timestamp_column="occurred_at_ms"
             ),
-            name="pj_r",
+            name="pj_l",
+        ).window(["sensor_name"], [F.avg(col("reading")).alias("avg_l")], L)
+        right = (
+            ctx.from_source(
+                MemorySource.from_batches(
+                    to_batches(raw_r), timestamp_column="occurred_at_ms"
+                ),
+                name="pj_r",
+            )
+            .window(["sensor_name"], [F.avg(col("reading")).alias("avg_r")], L)
+            .with_column_renamed("sensor_name", "rs")
+            .with_column_renamed("window_start_time", "rws")
+            .with_column_renamed("window_end_time", "rwe")
         )
-        .window(["sensor_name"], [F.avg(col("reading")).alias("avg_r")], L)
-        .with_column_renamed("sensor_name", "rs")
-        .with_column_renamed("window_start_time", "rws")
-        .with_column_renamed("window_end_time", "rwe")
-    )
-    res = left.join(
-        right,
-        "inner",
-        ["sensor_name", "window_start_time"],
-        ["rs", "rws"],
-    ).collect()
+        res = left.join(
+            right,
+            "inner",
+            ["sensor_name", "window_start_time"],
+            ["rs", "rws"],
+        ).collect()
 
-    want_l = oracle_values(raw_l, L, L)
-    want_r = oracle_values(raw_r, L, L)
-    want = {
-        k: (np.mean(want_l[k]), np.mean(want_r[k]))
-        for k in set(want_l) & set(want_r)
-    }
-    got = {}
-    for i in range(res.num_rows):
-        key = (
-            int(res.column(WINDOW_START_COLUMN)[i]),
-            res.column("sensor_name")[i],
-        )
-        assert key not in got, f"duplicate joined row {key}"
-        got[key] = (
-            float(res.column("avg_l")[i]),
-            float(res.column("avg_r")[i]),
-        )
-    assert set(got) == set(want), sorted(set(got) ^ set(want))[:5]
-    for k, (al, ar) in want.items():
-        np.testing.assert_allclose(got[k][0], al, rtol=1e-5, atol=1e-5)
-        np.testing.assert_allclose(got[k][1], ar, rtol=1e-5, atol=1e-5)
+        want_l = oracle_values(raw_l, L, L)
+        want_r = oracle_values(raw_r, L, L)
+        want = {
+            k: (np.mean(want_l[k]), np.mean(want_r[k]))
+            for k in set(want_l) & set(want_r)
+        }
+        got = {}
+        for i in range(res.num_rows):
+            key = (
+                int(res.column(WINDOW_START_COLUMN)[i]),
+                res.column("sensor_name")[i],
+            )
+            assert key not in got, f"duplicate joined row {key}"
+            got[key] = (
+                float(res.column("avg_l")[i]),
+                float(res.column("avg_r")[i]),
+            )
+        assert set(got) == set(want), sorted(set(got) ^ set(want))[:5]
+        for k, (al, ar) in want.items():
+            np.testing.assert_allclose(got[k][0], al, rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(got[k][1], ar, rtol=1e-5, atol=1e-5)
+
+
+else:
+    import pytest
+
+    @pytest.mark.skip(reason="hypothesis not installed in this image")
+    def test_windowed_join_matches_oracle():
+        pass
 
 
 # -- existence joins (LeftSemi / LeftAnti, datastream.rs:129) ------------

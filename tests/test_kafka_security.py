@@ -27,6 +27,10 @@ from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
 def tls_material(tmp_path_factory):
     """Self-signed server cert for 127.0.0.1 (IP SAN) + a SECOND CA that
     never signed it, for negative verification tests."""
+    pytest.importorskip(
+        "cryptography",
+        reason="cryptography not installed — cannot mint test certs",
+    )
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

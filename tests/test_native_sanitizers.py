@@ -24,8 +24,11 @@ pytestmark = pytest.mark.skipif(
 def test_native_components(tmp_path, flags):
     exe = tmp_path / "native_test"
     build = subprocess.run(
+        # -ldl: the kafka client dlopens OpenSSL; glibc < 2.34 keeps
+        # dlopen/dlsym in libdl (newer glibc folded them into libc, where
+        # the flag is a harmless no-op)
         ["g++", "-std=c++17", "-g", *flags,
-         str(NATIVE / "native_test.cpp"), "-o", str(exe), "-lz"],
+         str(NATIVE / "native_test.cpp"), "-o", str(exe), "-lz", "-ldl"],
         capture_output=True,
         text=True,
         cwd=NATIVE,

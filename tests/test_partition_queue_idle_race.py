@@ -164,3 +164,106 @@ def test_detector_catches_consumer_side_idleness():
         "expected the stripped-activity tracker to idle-exclude the "
         "queued partition; the race scenario no longer triggers"
     )
+
+
+# -- reader-reported backlog (caught_up) and the first-read hold bound ------
+#
+# The shared-queue activity guard above closes the ENQUEUED-backlog hole.
+# Two more holes in the same family (both soak-found on the kafka
+# pipeline, SOAK_KAFKA round 5: first window short by one partition's
+# share):
+#   1. a partition mid-way through a large catch-up fetch has nothing
+#      enqueued and a stale produce stamp — it must not be idle-excluded
+#      while its reader KNOWS broker-side backlog exists
+#      (PartitionReader.caught_up() is False);
+#   2. the first-read hold ("backlog unknown, not absent") must be
+#      BOUNDED, or a reader wedged in connect stalls the watermark
+#      forever.
+
+
+def _mk_tracker(activity, timeout_ms=100):
+    from denormalized_tpu.physical.simple_execs import _PartitionWatermarks
+
+    return _PartitionWatermarks(2, timeout_ms, activity=activity)
+
+
+def test_known_backlog_never_idle_excluded_mid_fetch():
+    """caught_up=False holds the min even with nothing enqueued and a
+    stale produce stamp (the in-flight catch-up fetch window)."""
+    long_ago = time.monotonic() - 60.0
+    act = {
+        0: (False, time.monotonic(), True, True),
+        1: (False, long_ago, True, False),  # backlog known, fetch in flight
+    }
+    pwm = _mk_tracker(lambda i: act[i])
+    h = pwm.observe(0, _batch(T0 + 10_000))
+    # partition 1 has never produced AND reports backlog: min must hold
+    assert h is None, f"watermark advanced over known backlog: {h}"
+    time.sleep(0.25)  # well past the idle timeout
+    assert pwm.advance() is None
+    # backlog drains: partition 1 produces its (older) rows, then catches
+    # up — only then does the min advance, and it starts at B's frontier
+    act[1] = (False, time.monotonic(), True, True)
+    h = pwm.observe(1, _batch(T0))
+    assert h is not None and h.ts_ms == T0
+
+
+def test_without_backlog_report_idleness_is_time_based():
+    """The inverse proves the guard is load-bearing: an unknown-backlog
+    reader (may_judge_idle True, the pre-fix judgment) IS idle-excluded
+    after the timeout, so the tracker advances on partition 0 alone."""
+    long_ago = time.monotonic() - 60.0
+    act = {
+        1: (False, long_ago, True, True),
+    }
+
+    def activity(i):
+        # partition 0 is live (fresh produce stamp on every judgment)
+        return (False, time.monotonic(), True, True) if i == 0 else act[1]
+
+    pwm = _mk_tracker(activity)
+    assert pwm.observe(0, _batch(T0 + 10_000)) is None  # p1 not yet idle
+    time.sleep(0.15)  # past the 100ms idle timeout
+    h = pwm.advance()
+    assert h is not None and h.ts_ms == T0 + 10_000
+
+
+def test_first_read_hold_is_bounded():
+    """A reader stuck in its FIRST read holds the watermark — but only
+    for FIRST_READ_GRACE_MULT x idle_timeout; past that it falls back to
+    idle exclusion instead of stalling the stream forever."""
+    from denormalized_tpu.physical.simple_execs import _PartitionWatermarks
+
+    def activity(i):
+        if i == 0:
+            return (False, time.monotonic(), True, True)  # live
+        return (False, time.monotonic(), False, True)  # first read in flight
+
+    pwm = _mk_tracker(activity, timeout_ms=50)
+    assert pwm.observe(0, _batch(T0 + 10_000)) is None  # held
+    deadline = time.monotonic() + 2.0
+    grace = _PartitionWatermarks.FIRST_READ_GRACE_MULT * 0.05
+    while time.monotonic() - pwm._born < grace + 0.05:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    h = pwm.advance()  # stuck reader now excluded, partition 0 advances
+    assert h is not None and h.ts_ms == T0 + 10_000
+
+
+def test_idle_hint_gated_on_reader_quiet():
+    """The source-level idle hint carries the GLOBAL max timestamp, so it
+    must never fire while any partition still has enqueued rows or known
+    broker backlog — a consumer stall (compile, GC) followed by an empty
+    heartbeat used to fire it over the stalled period's queued batches."""
+    from denormalized_tpu.physical.simple_execs import _IdleTracker
+
+    quiet = {"v": False}
+    idle = _IdleTracker(50, quiet=lambda: quiet["v"])
+    idle.observe_rows(_batch(T0 + 10_000))
+    time.sleep(0.12)  # consumer stall well past the timeout
+    assert idle.maybe_hint() is None, (
+        "idle hint fired while a partition had data in flight"
+    )
+    quiet["v"] = True  # every partition reader-side quiet
+    h = idle.maybe_hint()
+    assert h is not None and h.ts_ms == T0 + 10_000 + 63  # batch max ts

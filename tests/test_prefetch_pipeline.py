@@ -1,0 +1,386 @@
+"""Pipelined multi-core ingest: prefetch-path correctness and the
+GIL-release property it depends on.
+
+The prefetch engine (``denormalized_tpu/runtime/prefetch.py``) gives
+every partition a worker thread that owns its own ``KafkaClient`` and
+runs fetch → native decode → assembly off the consumer thread.  That
+only scales because the ctypes foreign calls drop the GIL for their
+native portion — pinned here — and it is only CORRECT if batches,
+offsets, and watermarks come out equivalent to a serial drive of the
+same readers, and if a restore discards in-flight prefetched batches
+instead of replaying them.
+"""
+
+import ctypes
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.physical.base import Marker, WatermarkHint
+from denormalized_tpu.physical.simple_execs import SourceExec
+from denormalized_tpu.sources.kafka import KafkaClient, KafkaTopicBuilder
+from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+T0 = 1_700_000_000_000
+SAMPLE = '{"ts": 1, "p": 1, "i": 1, "v": 1.0}'
+
+
+@pytest.fixture
+def broker():
+    b = MockKafkaBroker().start()
+    try:
+        yield b
+    finally:
+        b.stop()
+
+
+def _produce_chunk(broker, topic, part, chunk_idx, rows, n_parts):
+    payloads = []
+    for r in range(rows):
+        i = chunk_idx * rows + r
+        ts = T0 + (chunk_idx * rows + r) * 7
+        payloads.append(
+            json.dumps(
+                {"ts": ts, "p": part, "i": i, "v": float(i % 13)}
+            ).encode()
+        )
+    broker.produce_batched(topic, part, payloads, ts_ms=T0)
+
+
+def _source(broker, topic, **opts):
+    b = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic(topic)
+        .infer_schema_from_json(SAMPLE)
+        .with_timestamp_column("ts")
+    )
+    for k, v in opts.items():
+        b = b.with_option(k, v)
+    return b.build_reader()
+
+
+# -- GIL audit --------------------------------------------------------------
+
+
+def test_native_libs_loaded_gil_releasing():
+    """The whole pipelining premise: every native library is loaded via
+    ``ctypes.CDLL`` (releases the GIL around each foreign call), never
+    ``ctypes.PyDLL`` (holds it).  A regression here would silently
+    serialize every worker again."""
+    from denormalized_tpu.native.build import load
+
+    lib = load("kafka_client", ["-lz"])
+    assert isinstance(lib, ctypes.CDLL)
+    assert not isinstance(lib, ctypes.PyDLL)
+    for name in ("json_parser", "interner"):
+        lib = load(name)
+        assert isinstance(lib, ctypes.CDLL) and not isinstance(
+            lib, ctypes.PyDLL
+        ), name
+
+
+def test_blocking_fetch_releases_gil(broker):
+    """Two clients long-poll an EMPTY topic concurrently.  The broker
+    honors max_wait before answering an empty fetch, so each call blocks
+    ~0.5s inside the native client; if ctypes held the GIL the two calls
+    would serialize to ~1.0s+.  Concurrent wall time must stay well
+    under the serial sum — even on one core, because the block is a
+    socket wait, not CPU."""
+    broker.create_topic("gil", partitions=2)
+    clients = [KafkaClient(broker.bootstrap) for _ in range(2)]
+    try:
+        # warm up connections/metadata outside the timed section
+        for p, c in enumerate(clients):
+            c.fetch("gil", p, 0, max_wait_ms=1)
+
+        def one(p):
+            clients[p].fetch("gil", p, 0, max_wait_ms=500)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=one, args=(p,)) for p in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert wall < 0.85, (
+            f"two concurrent 0.5s blocking fetches took {wall:.2f}s — "
+            "the native fetch is not releasing the GIL"
+        )
+    finally:
+        for c in clients:
+            c.close()
+
+
+# -- equivalence with the serial path ---------------------------------------
+
+
+N_PARTS = 3
+CHUNK_ROWS = 200
+N_CHUNKS = 12
+TOTAL = N_PARTS * CHUNK_ROWS * N_CHUNKS
+
+
+def _feed(broker, topic, delay_s=0.015):
+    for j in range(N_CHUNKS):
+        for p in range(N_PARTS):
+            _produce_chunk(broker, topic, p, j, CHUNK_ROWS, N_PARTS)
+        time.sleep(delay_s)
+
+
+def _drain_serial(src):
+    """Ground truth: drive fresh readers one at a time on this thread."""
+    per_part = {p: [] for p in range(N_PARTS)}
+    readers = src.partitions()
+    for r in readers:
+        while sum(len(v) for v in per_part.values()) < TOTAL:
+            b = r.read(timeout_s=0.05)
+            if b is None or not b.num_rows:
+                if b is not None and not b.num_rows and r.caught_up():
+                    break
+                continue
+            p = int(np.asarray(b.column("p"))[0])
+            per_part[p].extend(np.asarray(b.column("i")).tolist())
+    snaps = [r.offset_snapshot() for r in readers]
+    return per_part, snaps
+
+
+def test_staggered_prefetch_matches_serial(broker):
+    """N partitions with staggered per-fetch broker latency through the
+    full prefetch path: rows, per-partition order, final offsets, and
+    partition-watermark monotonicity must match a serial drive of the
+    same topic."""
+    topic = "stag"
+    broker.create_topic(topic, partitions=N_PARTS)
+    for p in range(N_PARTS):
+        # stagger service times so partitions genuinely interleave
+        broker.fetch_delay_s[(topic, p)] = 0.005 * (p + 1)
+    feeder = threading.Thread(
+        target=_feed, args=(broker, topic), daemon=True
+    )
+    feeder.start()
+
+    src = _source(broker, topic)
+    exec_ = SourceExec(src, idle_timeout_ms=400, partition_watermarks=True)
+    per_part = {p: [] for p in range(N_PARTS)}
+    hint_max = None
+    violations = []
+    gen = exec_.run()
+    deadline = time.monotonic() + 60
+    for item in gen:
+        if time.monotonic() > deadline:
+            pytest.fail(
+                f"prefetch drain stalled: "
+                f"{sum(len(v) for v in per_part.values())}/{TOTAL} rows"
+            )
+        if isinstance(item, WatermarkHint):
+            if item.kind == "partition" and not item.is_announcement:
+                hint_max = max(hint_max or 0, item.ts_ms)
+            continue
+        if isinstance(item, RecordBatch) and item.num_rows:
+            ts = np.asarray(
+                item.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
+            )
+            if hint_max is not None and int(ts.min()) < hint_max:
+                violations.append((int(ts.min()), hint_max))
+            p = int(np.asarray(item.column("p"))[0])
+            per_part[p].extend(np.asarray(item.column("i")).tolist())
+            if sum(len(v) for v in per_part.values()) >= TOTAL:
+                # one more step so the generator runs the post-yield
+                # bookkeeping (offset snapshot) for the final batch
+                next(gen)
+                break
+    yielded = [dict(s) for s in exec_._yielded_offsets]
+    gen.close()
+    feeder.join()
+
+    serial_parts, serial_snaps = _drain_serial(_source(broker, topic))
+    n_rows = CHUNK_ROWS * N_CHUNKS
+    for p in range(N_PARTS):
+        assert per_part[p] == list(range(n_rows)), (
+            f"partition {p}: prefetch rows diverge "
+            f"(got {len(per_part[p])}, dupes="
+            f"{len(per_part[p]) - len(set(per_part[p]))})"
+        )
+        assert serial_parts[p] == per_part[p]
+    # offsets the barrier would persist == the serial cursors
+    assert sorted(yielded, key=lambda s: s["partition"]) == sorted(
+        serial_snaps, key=lambda s: s["partition"]
+    )
+    # a partition hint must never run ahead of rows still being yielded
+    assert not violations, f"watermark ran ahead of data: {violations[:3]}"
+
+
+# -- restore vs in-flight prefetch ------------------------------------------
+
+
+def test_restore_mid_prefetch_replays_no_row_twice(broker):
+    """Kill/restore semantics at the exact hazard the prefetch engine
+    introduces: batches fetched and buffered PAST the last barrier's
+    offsets are in flight when the stream dies.  A restore from that
+    barrier must yield exactly the complement of what was consumed
+    before it — nothing lost, nothing twice — because restore happens
+    before workers spawn and the restored reader discards pending
+    slices."""
+    topic = "restore"
+    broker.create_topic(topic, partitions=2)
+    n_rows = 4000
+    for p in range(2):
+        _produce_chunk(broker, topic, p, 0, n_rows, 2)
+        broker.fetch_delay_s[(topic, p)] = 0.002 * (p + 1)
+
+    # small decode units force many in-flight batches around the barrier
+    src = _source(broker, topic, **{"max.batch.rows": "256",
+                                    "fetch.coalesce.rows": "0"})
+    exec_ = SourceExec(src, idle_timeout_ms=None,
+                       partition_watermarks=False)
+    marker_every = [0]
+
+    def barrier_poll():
+        marker_every[0] += 1
+        if marker_every[0] % 5 == 0:
+            return marker_every[0] // 5
+        return None
+
+    exec_.set_barrier_source(barrier_poll)
+    seen_pre = {0: [], 1: []}
+    snap_at_marker = None
+    seen_at_marker = {0: 0, 1: 0}
+    gen = exec_.run()
+    deadline = time.monotonic() + 60
+    for item in gen:
+        assert time.monotonic() < deadline, "pre-restore drive stalled"
+        if isinstance(item, Marker):
+            snap_at_marker = [dict(s) for s in exec_._yielded_offsets]
+            seen_at_marker = {p: len(v) for p, v in seen_pre.items()}
+            continue
+        if isinstance(item, RecordBatch) and item.num_rows:
+            p = int(np.asarray(item.column("p"))[0])
+            seen_pre[p].extend(np.asarray(item.column("i")).tolist())
+            total = sum(len(v) for v in seen_pre.values())
+            if snap_at_marker is not None and total >= 5000:
+                break  # die mid-stream, prefetch buffers non-empty
+    gen.close()
+    assert snap_at_marker is not None, "no barrier landed before the kill"
+    # roll the consumed-set back to the barrier cut: everything after the
+    # marker is "lost output" the restore must regenerate
+    pre_marker = {
+        p: seen_pre[p][: seen_at_marker[p]] for p in (0, 1)
+    }
+
+    # restored process: fresh readers, seek to the barrier's offsets —
+    # this is what SourceExec._restore_offsets does before spawning
+    # prefetch workers
+    readers = src.partitions()
+    by_part = {r._partition: r for r in readers}
+    for s in snap_at_marker:
+        by_part[s["partition"]].offset_restore(s)
+    post = {0: [], 1: []}
+    for p, r in by_part.items():
+        deadline = time.monotonic() + 30
+        while len(post[p]) < n_rows - len(pre_marker[p]):
+            assert time.monotonic() < deadline, "post-restore read stalled"
+            b = r.read(timeout_s=0.05)
+            if b is not None and b.num_rows:
+                post[p].extend(np.asarray(b.column("i")).tolist())
+
+    for p in (0, 1):
+        got = pre_marker[p] + post[p]
+        assert got == list(range(n_rows)), (
+            f"partition {p}: restore replayed or lost rows "
+            f"(pre={len(pre_marker[p])}, post={len(post[p])}, "
+            f"dupes={len(got) - len(set(got))})"
+        )
+
+
+# -- coalescing -------------------------------------------------------------
+
+
+def _drain_counting(reader, n):
+    rows = []
+    batches = 0
+    deadline = time.monotonic() + 30
+    while len(rows) < n:
+        assert time.monotonic() < deadline, "read stalled"
+        b = reader.read(timeout_s=0.05)
+        if b is not None and b.num_rows:
+            rows.extend(np.asarray(b.column("i")).tolist())
+            batches += 1
+    return rows, batches
+
+
+def test_fetch_coalescing_combines_small_fetches(broker):
+    """Small fetches (clamped broker serve size) with backlog at the
+    broker must coalesce into larger decode units — identical rows, same
+    final offset, several-fold fewer rowful batches than the uncoalesced
+    read of the same topic."""
+    topic = "coal"
+    broker.create_topic(topic, partitions=1)
+    n = 600
+    payloads = [
+        json.dumps({"ts": T0 + i, "p": 0, "i": i, "v": 1.0}).encode()
+        for i in range(n)
+    ]
+    broker.produce_batched(topic, 0, payloads, ts_ms=T0,
+                           records_per_batch=4)
+    # ~4 records per fetch: the small-arena shape of a slow link or a
+    # tiny-batch producer
+    broker.fetch_max_bytes_clamp = 256
+
+    plain = _source(broker, topic, **{"fetch.coalesce.rows": "0"})
+    (reader0,) = plain.partitions()
+    rows0, batches0 = _drain_counting(reader0, n)
+    assert rows0 == list(range(n))
+
+    src = _source(broker, topic, **{"fetch.coalesce.rows": "512"})
+    (reader,) = src.partitions()
+    rows, batches = _drain_counting(reader, n)
+    assert rows == list(range(n))
+    assert reader.offset_snapshot()["offset"] == n
+    assert reader.caught_up() is True
+    assert batches * 3 <= batches0, (
+        f"coalescing produced {batches} decode units vs {batches0} "
+        "uncoalesced — expected a several-fold reduction"
+    )
+
+
+def test_coalescing_preserves_split_offsets(broker):
+    """Coalesced decode units still split at max.batch.rows with EXACT
+    per-record kafka offsets: a barrier between slices checkpoints a
+    cursor that a restore can seek to without loss or replay."""
+    topic = "coalsplit"
+    broker.create_topic(topic, partitions=1)
+    n = 900
+    payloads = [
+        json.dumps({"ts": T0 + i, "p": 0, "i": i, "v": 1.0}).encode()
+        for i in range(n)
+    ]
+    broker.produce_batched(topic, 0, payloads, ts_ms=T0,
+                           records_per_batch=64)
+    # ~64 records per fetch, so the 900-row decode unit is stitched from
+    # many fetches — the combined per-record offsets must stay exact
+    broker.fetch_max_bytes_clamp = 3000
+    src = _source(broker, topic, **{
+        "fetch.coalesce.rows": "4096", "max.batch.rows": "128",
+    })
+    (reader,) = src.partitions()
+    rows = []
+    deadline = time.monotonic() + 30
+    while len(rows) < n:
+        assert time.monotonic() < deadline, "split read stalled"
+        b = reader.read(timeout_s=0.05)
+        if b is None or not b.num_rows:
+            continue
+        assert b.num_rows <= 128
+        rows.extend(np.asarray(b.column("i")).tolist())
+        # the snapshot after each slice must equal the count of rows
+        # yielded so far — the exact offset a restore would seek to
+        assert reader.offset_snapshot()["offset"] == len(rows)
+    assert rows == list(range(n))

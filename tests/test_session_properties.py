@@ -2,6 +2,8 @@
 merging, watermark-driven closing, late-row dropping, EOS flush."""
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis", reason="hypothesis not installed in this image")
 from hypothesis import given, settings, strategies as st
 
 from denormalized_tpu import Context, col

@@ -14,6 +14,8 @@ The oracle mirrors the engine's documented semantics exactly:
 import collections
 
 import numpy as np
+import pytest
+pytest.importorskip("hypothesis", reason="hypothesis not installed in this image")
 from hypothesis import given, settings, strategies as st
 
 from denormalized_tpu import Context, col

@@ -32,9 +32,13 @@ Design:
 - The parent samples child RSS from /proc, kills it with SIGKILL every
   --kill-every seconds (the LAST segment runs to EOS), respawns it, and
   finally compares the union of all segments' emitted windows against an
-  incrementally-computed numpy golden.  Duplicated emissions across a
-  restore are counted, not failed (at-least-once output, exactly-once
-  state — the reference's contract too).
+  incrementally-computed numpy golden.  Output is exactly-once under a
+  transactional file-sink protocol: every emitted line carries its
+  in-flight epoch, each restored child announces its recovery epoch,
+  and the parent discards a killed segment's uncommitted suffix (the
+  lines its successor's replay regenerates) — truncate-on-restore,
+  applied where the union is read.  Duplicate emissions that survive
+  the clip are therefore REAL duplicates and count against the run.
 - Relay-aware: if the TPU tunnel relay opens mid-soak, the soak aborts
   gracefully (partial JSON, exit 0) so it never steals the single core
   from a chip-evidence run.
@@ -557,9 +561,28 @@ def child_main() -> None:
         )
     it = ds.stream()
     stop = False
+    coord = None
+    announced = False
     with open(out_path, "a", buffering=1) as out:
         out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
         for batch in it:
+            if not announced:
+                # exactly-once output protocol: announce the recovery
+                # point (frozen at coordinator construction) BEFORE any
+                # window line.  The parent clips the PREVIOUS segment's
+                # lines tagged beyond this epoch — they are the
+                # uncommitted suffix this incarnation's replay
+                # regenerates (a transactional sink's
+                # truncate-on-restore, done reader-side).
+                coord = getattr(ctx, "_last_coord", None)
+                out.write(json.dumps({
+                    "event": "restored",
+                    "epoch": (
+                        (coord.restored_epoch or 0)
+                        if coord is not None else None
+                    ),
+                }) + "\n")
+                announced = True
             if not batch.schema.has(WINDOW_START_COLUMN):
                 continue
             now = time.time()
@@ -603,6 +626,13 @@ def child_main() -> None:
                         "max": round(float(batch.column("max")[i]), 4),
                         "avg": round(float(batch.column("average")[i]), 4),
                     }
+                if coord is not None:
+                    # in-flight epoch tag: this line is committed once
+                    # epoch `ep` commits — emissions between barrier N
+                    # and N+1 belong to (uncommitted) epoch N+1, and
+                    # stream order guarantees the commit never precedes
+                    # the write
+                    rec["ep"] = (coord.committed_epoch or 0) + 1
                 out.write(json.dumps(rec) + "\n")
                 if last_close_ws is not None and rec["ws"] >= last_close_ws:
                     stop = True  # unbounded source: close at the target
@@ -632,19 +662,37 @@ def child_main() -> None:
 
 
 def read_emissions(paths):
-    """ALL emitted window rows across segment files → ({(ws,key):
-    [tuple, ...]}, duplicate_emissions, done_seen) — every occurrence is
+    """ALL COMMITTED emitted window rows across segment files →
+    ({(ws,key): [tuple, ...]}, duplicate_emissions, done_seen,
+    child_metrics, uncommitted_clipped) — every committed occurrence is
     kept, so a wrong first emission can't hide behind a correct
-    re-emission after restore.  A torn tail line (SIGKILL mid-write) is
-    skipped."""
-    wins: dict = {}
-    dupes = 0
+    re-emission after restore.  ``child_metrics`` is one dict per
+    'metrics' event found (only children that reached EOS write one —
+    SIGKILLed segments leave none).  A torn tail line (SIGKILL
+    mid-write) is skipped.
+
+    Exactly-once output: each line carries ``ep``, the in-flight epoch
+    at write time, and each restored child announces the epoch it
+    recovered from.  A killed segment's lines tagged BEYOND the epoch
+    the successor restored from are the uncommitted suffix that
+    successor's replay regenerates — the recovery reader discards them
+    (the transactional sink's truncate-on-restore, applied where the
+    union is read).  The clip boundary for segment i is the restore
+    epoch of the next segment that emitted windows: an intermediate
+    windowless segment may have advanced commits without re-emitting
+    anything, and clipping by ITS restore point would drop lines nobody
+    regenerates.  Lines without ``ep`` (no checkpointing) are always
+    kept — at-least-once counting, as before."""
     done = False
     metrics: list = []
+    segments: list = []  # (seg_idx, restored_epoch|None, [line dicts])
     for seg_idx, path in enumerate(paths, 1):
+        restored = None
+        lines: list = []
         try:
             f = open(path)
         except FileNotFoundError:
+            segments.append((seg_idx, restored, lines))
             continue
         with f:
             for line in f:
@@ -654,27 +702,54 @@ def read_emissions(paths):
                     continue
                 if o.get("event") == "done":
                     done = True
+                elif o.get("event") == "restored":
+                    restored = o.get("epoch")
                 elif o.get("event") == "metrics":
                     metrics.append({k: v for k, v in o.items()
                                     if k != "event"})
                 elif "ws" in o:
-                    k = (o["ws"], o["key"])
-                    occ = wins.setdefault(k, [])
-                    if occ:
-                        dupes += 1
-                    if "avg_t" in o:  # join pipeline record
-                        vals = (o["avg_t"], o["avg_h"])
-                    elif "we" in o:  # session record: bounds + aggregates
-                        vals = (o["count"], o["min"], o["max"],
-                                o["avg"], o["ws"], o["we"])
-                    elif "spread" in o:  # udaf record
-                        vals = (o["count"], o["spread"])
-                    else:
-                        vals = (o["count"], o["min"], o["max"], o["avg"])
-                    # segment attribution rides along for diagnosis but
-                    # stays OUT of the compared tuple
-                    occ.append((vals, seg_idx))
-    return wins, dupes, done, metrics
+                    lines.append(o)
+        segments.append((seg_idx, restored, lines))
+
+    clipped = 0
+    kept: list = []  # (seg_idx, line)
+    for i, (seg_idx, _restored, lines) in enumerate(segments):
+        boundary = None  # None = final (or no emitting successor): keep all
+        for j in range(i + 1, len(segments)):
+            if segments[j][2]:  # next segment that emitted windows
+                boundary = segments[j][1]
+                break
+        for o in lines:
+            ep = o.get("ep")
+            if (
+                boundary is not None
+                and ep is not None
+                and ep > (boundary or 0)
+            ):
+                clipped += 1
+                continue
+            kept.append((seg_idx, o))
+
+    wins: dict = {}
+    dupes = 0
+    for seg_idx, o in kept:
+        k = (o["ws"], o["key"])
+        occ = wins.setdefault(k, [])
+        if occ:
+            dupes += 1
+        if "avg_t" in o:  # join pipeline record
+            vals = (o["avg_t"], o["avg_h"])
+        elif "we" in o:  # session record: bounds + aggregates
+            vals = (o["count"], o["min"], o["max"],
+                    o["avg"], o["ws"], o["we"])
+        elif "spread" in o:  # udaf record
+            vals = (o["count"], o["spread"])
+        else:
+            vals = (o["count"], o["min"], o["max"], o["avg"])
+        # segment attribution rides along for diagnosis but stays OUT
+        # of the compared tuple
+        occ.append((vals, seg_idx))
+    return wins, dupes, done, metrics, clipped
 
 
 def rss_kb(pid: int) -> int | None:
@@ -798,7 +873,7 @@ def main():
                 if first_emit is not None and (r := rss_kb(proc.pid)):
                     seg_rss.append(r)
                 if first_emit is None:
-                    wins, _, _, _ = read_emissions([out_path])
+                    wins, _, _, _, _ = read_emissions([out_path])
                     if wins:
                         first_emit = now - t_spawn
                         if seg > 1:
@@ -848,7 +923,9 @@ def main():
         while golden_i < total_batches and not aborted:
             _fold(golden, golden_i, args.batch_rows, args.pace)
             golden_i += 1
-        wins, dupes, done_seen, child_metrics = read_emissions(seg_paths)
+        wins, dupes, done_seen, child_metrics, clipped = read_emissions(
+            seg_paths
+        )
         if args.pipeline == "kafka" and not aborted:
             # the unbounded source ends at last_close_ws by design: windows
             # past it may or may not close (idle-hint timing) before the
@@ -912,6 +989,7 @@ def main():
             "golden_windows": len(golden),
             "emitted_windows": len(wins),
             "duplicate_emissions": dupes,
+            "uncommitted_clipped": clipped,
             "child_metrics": child_metrics,
             "windows_lost": len(lost),
             "windows_spurious": len(spurious),
