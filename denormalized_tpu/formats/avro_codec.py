@@ -24,9 +24,12 @@ Supported (round-4: full recursive coverage):
     multi-branch unions decode by branch index, and convert to an engine
     dtype only when all non-null branches share one engine dtype.
 
-The native one-pass parser (avro_parser.cpp) only accepts flat records of
-primitives; :class:`AvroDecoder` detects anything beyond that and routes
-to this recursive pure-Python decoder — defined fallback, not an error.
+The native one-pass parser (avro_parser.cpp) decodes flat records AND
+nested records/arrays (of primitives, records, or arrays, to any depth)
+via its schema-tree ABI; :class:`AvroDecoder` routes the remaining shapes
+(maps, enums, fixed, bytes fields, general unions, recursive named types)
+to this recursive pure-Python decoder — defined fallback, not an error,
+and counted in ``decode_fallback_rows`` so it is observable.
 """
 
 from __future__ import annotations
@@ -409,7 +412,11 @@ def _decode_blocks(buf: io.BytesIO, read_item, what: str):
     any item of >=1 wire byte makes count <= remaining for valid data, and
     zero-byte items (null / empty-record elements) are allowed a bounded
     slack — without the cap a 5-byte payload declaring 2^30 null items
-    would allocate gigabytes off one malicious Kafka message."""
+    would allocate gigabytes off one malicious Kafka message.  The
+    per-block cap alone is bypassable by REPEATED blocks of zero-byte
+    items, so the record-level cumulative budget (``elem_budget`` on the
+    buffer, set by :func:`decode_record`; same formula as the native
+    parser) bounds total decoded elements per record too."""
     out = []
     while True:
         count = _zigzag_decode(buf)
@@ -424,6 +431,15 @@ def _decode_blocks(buf: io.BytesIO, read_item, what: str):
                 f"Avro {what} block of {count} items exceeds payload "
                 f"capacity ({remaining} bytes remain)"
             )
+        budget = getattr(buf, "elem_budget", None)
+        if budget is not None:
+            budget -= count
+            if budget < 0:
+                raise FormatError(
+                    f"Avro {what} blocks exceed the record's cumulative "
+                    f"element budget (zero-byte-item bomb)"
+                )
+            buf.elem_budget = budget
         for _ in range(count):
             out.append(read_item())
 
@@ -508,8 +524,20 @@ def encode_record(schema: AvroSchema, record: dict) -> bytes:
     return bytes(out)
 
 
+class _RecordBuf(io.BytesIO):
+    """BytesIO + the record-level cumulative element budget slot (builtin
+    BytesIO rejects attribute assignment)."""
+
+    elem_budget: int = 0
+
+
 def decode_record(schema: AvroSchema, payload: bytes) -> dict:
-    buf = io.BytesIO(payload)
+    buf = _RecordBuf(payload)
+    # same cumulative bound as the native parser (avro_parser.cpp
+    # ap_parse): decoded array elements per record <= max(64Ki, 4x wire
+    # bytes) — callers that build a plain BytesIO (tests, direct
+    # decode_value use) simply skip the cumulative check
+    buf.elem_budget = max(65536, 4 * len(payload))
     out = {
         name: decode_value(t, nullable, buf)
         for name, t, nullable in schema.fields
@@ -521,24 +549,18 @@ def decode_record(schema: AvroSchema, payload: bytes) -> dict:
     return out
 
 
-def _is_flat(schema: AvroSchema) -> bool:
-    """True when every top-level field is a plain primitive (the only shape
-    the native one-pass parser handles)."""
-    for _, t, _ in schema.fields:
-        base = t.get("type") if isinstance(t, dict) else t
-        if isinstance(base, (dict, list)) or base not in _PRIMITIVE:
-            return False
-    return True
-
-
 class AvroDecoder(Decoder):
     """Buffer Avro-encoded records; flush one batch.
 
     Decode is native (C++ one-pass columnar, avro_parser.cpp — mirroring
-    the reference's Rust-native path) whenever the schema is flat; nested
-    schemas (records/arrays/maps/enums/unions) route to the recursive
-    pure-Python decoder, which is also the no-compiler fallback and the
-    differential-test oracle."""
+    the reference's Rust-native path) for flat records AND nested
+    records/arrays via the schema-tree ABI; the shapes the native walker
+    declines (maps, enums, fixed, bytes fields, general unions, recursive
+    named types) route to the recursive pure-Python decoder, which is
+    also the no-compiler fallback and the differential-test oracle.
+    ``decode_fallback_rows`` counts the rows that actually decoded on the
+    Python path, so a schema silently routed there is observable in
+    source metrics."""
 
     def __init__(self, schema: Schema | None, avro_schema, use_native=True):
         if avro_schema is None:
@@ -549,7 +571,8 @@ class AvroDecoder(Decoder):
         self.schema = schema or avro_schema.to_engine_schema()
         self._rows: list[bytes] = []
         self._native = None
-        if use_native and _is_flat(avro_schema):
+        self.decode_fallback_rows = 0
+        if use_native:
             try:
                 from denormalized_tpu.formats.native_avro import (
                     NativeAvroParser,
@@ -567,5 +590,6 @@ class AvroDecoder(Decoder):
         rows, self._rows = self._rows, []
         if self._native is not None:
             return self._native.parse(rows)
+        self.decode_fallback_rows += len(rows)
         objs = [decode_record(self.avro_schema, r) for r in rows]
         return rows_to_batch(objs, self.schema)

@@ -10,9 +10,10 @@ Covers three reference components:
 
 The decode hot path uses the native C++ columnar parser
 (:mod:`denormalized_tpu.formats.native_json`) — flat schemas AND nested
-ones (structs to any depth, lists of scalars) via the shredded node-tree
-ABI.  Python ``json`` remains only for shapes the native side declines
-(lists of structs/lists, dynamic-map structs with no declared children).
+ones (structs to any depth, lists of scalars, lists of structs, lists of
+lists) via the shredded node-tree ABI.  Python ``json`` remains only for
+dynamic-map structs (no declared children), the one shape with no static
+shredding.
 
 Both paths normalize nested struct values to the DECLARED schema shape
 (missing children become None, undeclared keys are dropped) — the same
@@ -71,10 +72,16 @@ def infer_schema_from_json(sample: str | bytes) -> Schema:
 
 
 class JsonDecoder(Decoder):
+    """``decode_fallback_rows`` counts rows that decoded on the Python
+    path (native parser unavailable or schema declined) — surfaced
+    through source ``metrics()`` so a schema that silently routes to the
+    ~30x-slower fallback is observable, never a quiet perf cliff."""
+
     def __init__(self, schema: Schema, use_native: bool = True):
         self.schema = schema
         self._rows: list[bytes] = []
         self._native = None
+        self.decode_fallback_rows = 0
         if use_native:
             try:
                 from denormalized_tpu.formats.native_json import NativeJsonParser
@@ -91,6 +98,7 @@ class JsonDecoder(Decoder):
         rows, self._rows = self._rows, []
         if self._native is not None:
             return self._native.parse(rows)
+        self.decode_fallback_rows += len(rows)
         return decode_json_rows(rows, self.schema)
 
 
@@ -149,17 +157,22 @@ def _normalize_nested(v, f: Field):
         # materializes float — match it, or sink/checkpoint bytes would
         # differ by decode path ('3' vs '3.0')
         return _to_float(v)
-    if f.dtype in (DataType.INT32, DataType.INT64, DataType.TIMESTAMP_MS):
+    if f.dtype is DataType.INT32:
+        # nested leaves live in object columns (no numpy narrowing), so
+        # the declared i32 width is enforced here — the same clamp the
+        # native extraction applies (_native_parser_base._clamp_nested_ints),
+        # and the same bounds flat INT32 columns saturate at
+        return _saturate_int(v, _I32_MIN, _I32_MAX)
+    if f.dtype in (DataType.INT64, DataType.TIMESTAMP_MS):
         # out-of-int64-range: the native parser keeps strtoll's saturate
         # semantics (json.loads accepts 20-digit ints, so refusing would
-        # fail the batch); clamp identically here.  (Nested leaves live in
-        # object columns on both paths — no numpy narrowing — so INT32
-        # nested leaves saturate at i64 bounds exactly like native.)
+        # fail the batch); clamp identically here
         return _saturate_int(v, _I64_MIN, _I64_MAX)
     return v
 
 
 _I64_MIN, _I64_MAX = -0x8000000000000000, 0x7FFFFFFFFFFFFFFF
+_I32_MIN, _I32_MAX = -0x80000000, 0x7FFFFFFF
 
 
 def _saturate_int(v: int, lo: int, hi: int) -> int:

@@ -1,11 +1,13 @@
 """ctypes wrapper over the native one-pass JSON → columnar parser (shared
 plumbing in :mod:`denormalized_tpu.formats._native_parser_base`).
 
-Flat schemas use the historical column ABI; nested schemas (structs to any
-depth, lists of scalars — the reference's arrow-json reader handles these
-natively, decoders/json.rs:11-49) use the shredded node-tree ABI
-(``jp_create_tree``).  Lists of structs / lists of lists raise
-:class:`FormatError`, which routes the decoder to the Python fallback."""
+Flat schemas use the historical column ABI; nested schemas (structs to
+any depth, lists of scalars, lists of structs, lists of lists — the full
+shape set the reference's arrow-json reader handles natively,
+decoders/json.rs:11-49) use the shredded node-tree ABI
+(``jp_create_tree``).  Only dynamic-map structs (no declared children)
+raise :class:`FormatError`, which routes the decoder to the Python
+fallback."""
 
 from __future__ import annotations
 
@@ -59,9 +61,11 @@ def _lib():
 def build_node_tree(schema: Schema):
     """Flatten a (possibly nested) schema into the parallel arrays the
     ``jp_create_tree`` ABI takes, plus the :class:`NodeDesc` tree used for
-    extraction.  Raises :class:`FormatError` for shapes the native parser
-    does not shred (lists of non-scalars, childless structs — dynamic
-    maps stay on the Python fallback)."""
+    extraction.  Scalar-element lists use the packed type-5 layout
+    (elements in the list node's own vectors); lists of structs / lists
+    of lists become type-6 generic lists whose single child node is the
+    element subtree.  Raises :class:`FormatError` only for childless
+    structs — dynamic maps stay on the Python fallback."""
     names: list[bytes] = []
     types: list[int] = []
     etypes: list[int] = []
@@ -89,15 +93,24 @@ def build_node_tree(schema: Schema):
                 nd.children.append(add(c, idx))
             return nd
         if f.dtype is DataType.LIST:
-            if len(f.children) != 1 or f.children[0].dtype not in _TYPE_CODE:
+            if len(f.children) != 1:
                 raise FormatError(
                     f"native parser cannot shred list {f.name!r} "
-                    f"(element must be a declared scalar)"
+                    f"(exactly one declared element required)"
                 )
-            ecode = _TYPE_CODE[f.children[0].dtype]
-            types.append(5)
-            etypes.append(ecode)
-            return NodeDesc(idx, f, "list", elem_kind=_OUT_KIND[ecode])
+            elem = f.children[0]
+            if elem.dtype in _TYPE_CODE:
+                ecode = _TYPE_CODE[elem.dtype]
+                types.append(5)
+                etypes.append(ecode)
+                return NodeDesc(idx, f, "list", elem_kind=_OUT_KIND[ecode])
+            # list of structs / list of lists: generic list node, element
+            # subtree as the single child
+            types.append(6)
+            etypes.append(-1)
+            nd = NodeDesc(idx, f, "list")
+            nd.children.append(add(elem, idx))
+            return nd
         raise FormatError(f"native parser cannot handle {f.dtype}")
 
     tree = [add(f, -1) for f in schema]

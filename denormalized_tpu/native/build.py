@@ -19,10 +19,19 @@ _LOCK = threading.Lock()
 _CACHE: dict[str, ctypes.CDLL] = {}
 
 
-def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
+def load(
+    name: str, extra_flags: list[str] | None = None, *, pydll: bool = False
+) -> ctypes.CDLL:
+    """``pydll=True`` loads through :class:`ctypes.PyDLL` (calls keep the
+    GIL) — REQUIRED for libraries that touch the CPython API
+    (pyassemble.cpp): a plain-CDLL handle to such a library would release
+    the GIL around calls that manipulate PyObjects and crash the
+    interpreter.  The cache keys on the loader kind so a PyDLL library
+    can never be served a previously-cached CDLL handle or vice versa."""
+    key = f"{name}|pydll" if pydll else name
     with _LOCK:
-        if name in _CACHE:
-            return _CACHE[name]
+        if key in _CACHE:
+            return _CACHE[key]
         src = _DIR / f"{name}.cpp"
         so = _DIR / f"{name}.so"
         stamp = _DIR / f"{name}.so.srchash"
@@ -59,6 +68,6 @@ def load(name: str, extra_flags: list[str] | None = None) -> ctypes.CDLL:
                     f"native build of {name} failed:\n{proc.stderr[-2000:]}"
                 )
             stamp.write_text(want)
-        lib = ctypes.CDLL(str(so))
-        _CACHE[name] = lib
+        lib = (ctypes.PyDLL if pydll else ctypes.CDLL)(str(so))
+        _CACHE[key] = lib
         return lib

@@ -11,14 +11,18 @@
 //     presence byte per struct node;
 //   - lists of scalars become Arrow-style (offsets, values, elem-validity)
 //     triples;
-//   - lists of structs / lists of lists are not shredded natively (the
-//     Python fallback handles them).
+//   - lists of structs / lists of lists are GENERIC list nodes: the list
+//     stores per-row offsets and the single child node stores one entry
+//     per ELEMENT (struct presence + descendant leaves, or another
+//     (offsets, …) level for lists-of-lists) — recursion to any depth,
+//     the same shredding arrow-json performs.
 //
 // C ABI for ctypes.  Node types: 0=int64, 1=float64, 2=bool, 3=string,
-// 4=struct, 5=list-of-scalar.  ``jp_create`` keeps the historical flat
-// ABI (top-level scalar columns only); ``jp_create_tree`` takes the full
-// schema tree.  Unknown keys are skipped (balanced for nested values);
-// missing keys and JSON nulls set validity 0 (recursively for structs).
+// 4=struct, 5=list-of-scalar, 6=list-of-node (child subtree per element).
+// ``jp_create`` keeps the historical flat ABI (top-level scalar columns
+// only); ``jp_create_tree`` takes the full schema tree.  Unknown keys are
+// skipped (balanced for nested values); missing keys and JSON nulls set
+// validity 0 (recursively for structs).
 
 #include <algorithm>
 #include <charconv>
@@ -34,24 +38,29 @@
 
 namespace {
 
-// One schema-tree node.  Scalars store one value per row; struct nodes
-// store a presence byte per row in `valid` (1 = object present, 0 =
-// null/missing) and their children hold the data; list nodes store
-// per-row `list_offsets` (nrows+1) with the elements packed into the
-// node's own value vectors (`evalid` parallel to elements).
+// One schema-tree node.  Scalars store one value per ENTRY; struct nodes
+// store a presence byte per entry in `valid` (1 = object present, 0 =
+// null/missing) and their children hold the data; scalar-list nodes
+// (type 5) store per-entry `list_offsets` with the elements packed into
+// the node's own value vectors (`evalid` parallel to elements); generic
+// list nodes (type 6) store per-entry `list_offsets` and their single
+// child node holds one entry per element.  An "entry" is a row for
+// top-level nodes and struct descendants, and an element for nodes under
+// a generic list — every node appends exactly one `valid` byte per
+// entry, so `valid.size()` is always a node's entry count.
 struct Node {
   std::string name;
-  int type;            // 0 i64 | 1 f64 | 2 bool | 3 str | 4 struct | 5 list
-  int elem_type = -1;  // list: scalar element type 0..3
-  std::vector<int> kids;  // struct children (node indices)
+  int type;  // 0 i64 | 1 f64 | 2 bool | 3 str | 4 struct | 5 list | 6 list-of-node
+  int elem_type = -1;  // type-5 list: scalar element type 0..3
+  std::vector<int> kids;  // struct children / generic-list element node
   std::vector<int64_t> i64;
   std::vector<double> f64;
   std::vector<uint8_t> b;
   std::vector<uint8_t> str_bytes;
   std::vector<uint64_t> str_offsets;  // scalar: nrows+1; list str: nelems+1
   std::vector<uint8_t> valid;         // per row (leaf/struct/list)
-  std::vector<uint64_t> list_offsets;  // list: nrows+1
-  std::vector<uint8_t> evalid;         // list: per element
+  std::vector<uint64_t> list_offsets;  // list: nentries+1
+  std::vector<uint8_t> evalid;         // type-5 list: per element
   StrDict dict;
 };
 
@@ -415,38 +424,51 @@ inline uint64_t list_elems(const Node& nd) {
   return nd.list_offsets.empty() ? 0 : nd.list_offsets.back();
 }
 
-// drop every per-row append made by a partially parsed row, restoring all
-// node vectors to exactly `nr` committed rows (cheap: size bookkeeping
-// only, no reallocation)
-void rollback_row(Parser* p, uint64_t nr) {
-  for (auto& nd : p->nodes) {
-    nd.valid.resize(nr);
-    switch (nd.type) {
-      case 0: nd.i64.resize(nr); break;
-      case 1: nd.f64.resize(nr); break;
-      case 2: nd.b.resize(nr); break;
-      case 3:
-        nd.str_offsets.resize(nr + 1);
-        nd.str_bytes.resize(nd.str_offsets.back());
-        break;
-      case 4: break;  // presence only
-      case 5: {
-        nd.list_offsets.resize(nr + 1);
-        uint64_t ne = nd.list_offsets.back();
-        nd.evalid.resize(ne);
-        switch (nd.elem_type) {
-          case 0: nd.i64.resize(ne); break;
-          case 1: nd.f64.resize(ne); break;
-          case 2: nd.b.resize(ne); break;
-          case 3:
-            nd.str_offsets.resize(ne + 1);
-            nd.str_bytes.resize(nd.str_offsets.back());
-            break;
-        }
-        break;
+// resize node ni and its whole subtree down to exactly `count` entries —
+// cheap size bookkeeping, no reallocation.  Used by row rollback (count =
+// committed rows for top-level nodes) and by duplicate-key subtree
+// removal, where a generic-list child's entry count is whatever the
+// trimmed parent's offsets say.
+void trim_node(Parser* p, int ni, uint64_t count) {
+  Node& nd = p->nodes[ni];
+  nd.valid.resize(count);
+  switch (nd.type) {
+    case 0: nd.i64.resize(count); break;
+    case 1: nd.f64.resize(count); break;
+    case 2: nd.b.resize(count); break;
+    case 3:
+      nd.str_offsets.resize(count + 1);
+      nd.str_bytes.resize(nd.str_offsets.back());
+      break;
+    case 4:
+      for (int k : nd.kids) trim_node(p, k, count);
+      break;
+    case 5: {
+      nd.list_offsets.resize(count + 1);
+      uint64_t ne = nd.list_offsets.back();
+      nd.evalid.resize(ne);
+      switch (nd.elem_type) {
+        case 0: nd.i64.resize(ne); break;
+        case 1: nd.f64.resize(ne); break;
+        case 2: nd.b.resize(ne); break;
+        case 3:
+          nd.str_offsets.resize(ne + 1);
+          nd.str_bytes.resize(nd.str_offsets.back());
+          break;
       }
+      break;
     }
+    case 6:
+      nd.list_offsets.resize(count + 1);
+      trim_node(p, nd.kids[0], nd.list_offsets.back());
+      break;
   }
+}
+
+// drop every per-row append made by a partially parsed row, restoring all
+// node vectors to exactly `nr` committed rows
+void rollback_row(Parser* p, uint64_t nr) {
+  for (int ni : p->top) trim_node(p, ni, nr);
 }
 
 void push_null_scalar(Node& nd) {
@@ -459,7 +481,8 @@ void push_null_scalar(Node& nd) {
   }
 }
 
-// append one null row entry to node ni and (for structs) every descendant
+// append one null entry to node ni and (for structs) every descendant
+// (a null list leaves its child untouched — zero elements)
 void push_null_recursive(Parser* p, int ni) {
   Node& nd = p->nodes[ni];
   switch (nd.type) {
@@ -468,6 +491,7 @@ void push_null_recursive(Parser* p, int ni) {
       for (int k : nd.kids) push_null_recursive(p, k);
       break;
     case 5:
+    case 6:
       nd.valid.push_back(0);
       nd.list_offsets.push_back(list_elems(nd));
       break;
@@ -476,7 +500,13 @@ void push_null_recursive(Parser* p, int ni) {
   }
 }
 
-// remove the last row entry from node ni and every descendant (duplicate
+// zero the per-row duplicate-key marks for a whole subtree
+void clear_seen(Parser* p, int ni) {
+  p->g_seen[ni] = 0;
+  for (int k : p->nodes[ni].kids) clear_seen(p, k);
+}
+
+// remove the last entry from node ni and every descendant (duplicate
 // keys: json.loads is last-wins, so the earlier subtree's appends must
 // go).  Also clears the per-row `seen` marks for the subtree so the
 // replacement occurrence re-parses descendants as first sightings (the
@@ -511,10 +541,60 @@ void pop_row_subtree(Parser* p, int ni) {
       }
       break;
     }
+    case 6:
+      nd.list_offsets.pop_back();
+      trim_node(p, nd.kids[0], nd.list_offsets.back());
+      clear_seen(p, nd.kids[0]);
+      break;
   }
 }
 
-// parse one list value (cursor at '['); appends elements + one
+// parse one scalar JSON value into nd (appends value + valid=1); the
+// cursor sits at the first value byte (caller already handled "null")
+bool parse_scalar_value(Parser* p, Node& nd, Cursor& c) {
+  switch (nd.type) {
+    case 0: {
+      int64_t v;
+      if (!parse_i64_at(c.p, c.end, v)) { c.fail = true; return false; }
+      nd.i64.push_back(v);
+      break;
+    }
+    case 1: {
+      double v;
+      if (!parse_f64_at(c.p, c.end, v)) { c.fail = true; return false; }
+      nd.f64.push_back(v);
+      break;
+    }
+    case 2: {
+      if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
+        c.p += 4;
+        nd.b.push_back(1);
+      } else if (c.end - c.p >= 5 && memcmp(c.p, "false", 5) == 0) {
+        c.p += 5;
+        nd.b.push_back(0);
+      } else {
+        c.fail = true;
+        return false;
+      }
+      break;
+    }
+    case 3: {
+      if (!c.eat('"')) { c.fail = true; return false; }
+      if (!parse_string(c, p->g_sval)) { c.fail = true; return false; }
+      nd.str_bytes.insert(nd.str_bytes.end(), p->g_sval.begin(),
+                          p->g_sval.end());
+      nd.str_offsets.push_back(nd.str_bytes.size());
+      break;
+    }
+    default:
+      c.fail = true;
+      return false;
+  }
+  nd.valid.push_back(1);
+  return true;
+}
+
+// parse one scalar-list value (cursor at '['); appends elements + one
 // list_offsets/valid row entry.  Shared by the general and fast paths —
 // a list is a single layout unit, reparsed generically every row (its
 // element count varies, so its bytes can't be layout tokens).
@@ -577,6 +657,59 @@ bool parse_list_value(Parser* p, Node& nd, Cursor& c, std::string& sval) {
   nd.list_offsets.push_back(nd.evalid.size());
   nd.valid.push_back(1);
   return true;
+}
+
+bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b,
+                       bool discover);
+bool parse_value_node(Parser* p, int ni, Cursor& c);
+
+// parse one generic-list value (type 6, cursor at '['): each element
+// appends ONE entry to the child subtree — a struct element pushes its
+// presence byte + descendant leaves, a list element pushes another
+// offsets level, a null element pushes a recursive null — so the child's
+// entry count IS the element count and the parent only records offsets.
+bool parse_list_node(Parser* p, int ni, Cursor& c) {
+  Node& nd = p->nodes[ni];
+  const int kid = nd.kids[0];
+  if (!c.eat('[')) return false;
+  if (!c.peek(']')) {
+    for (;;) {
+      if (!parse_value_node(p, kid, c)) return false;
+      if (c.peek(',')) { c.p++; continue; }
+      break;
+    }
+  }
+  if (!c.eat(']')) return false;
+  nd.list_offsets.push_back(p->nodes[kid].valid.size());
+  nd.valid.push_back(1);
+  return true;
+}
+
+// parse any JSON value into node ni — the element parser for generic
+// lists (no layout discovery: the enclosing list is already one opaque
+// layout unit, reparsed generically every row)
+bool parse_value_node(Parser* p, int ni, Cursor& c) {
+  c.ws();
+  if (c.end - c.p >= 4 && memcmp(c.p, "null", 4) == 0) {
+    c.p += 4;
+    push_null_recursive(p, ni);
+    return true;
+  }
+  Node& nd = p->nodes[ni];
+  switch (nd.type) {
+    case 4:
+      if (!parse_struct_body(p, ni, c, nullptr, false)) {
+        c.fail = true;
+        return false;
+      }
+      return true;
+    case 5:
+      return parse_list_value(p, nd, c, p->g_sval) && !c.fail;
+    case 6:
+      return parse_list_node(p, ni, c);
+    default:
+      return parse_scalar_value(p, nd, c);
+  }
 }
 
 // layout-driven row parse; returns false on ANY deviation (caller rolls
@@ -656,6 +789,12 @@ bool fast_row(Parser* p, const uint8_t* b, const uint8_t* e) {
         q = c.p;
         continue;  // parse_list_value pushed valid itself
       }
+      case 6: {
+        Cursor c{q, e};
+        if (!parse_list_node(p, ci, c) || c.fail) return false;
+        q = c.p;
+        continue;  // parse_list_node pushed valid itself
+      }
       default:
         return false;  // struct nodes are never layout units
     }
@@ -693,15 +832,22 @@ void adopt_layout(Parser* p, const uint8_t* b, const uint8_t* e) {
 }
 
 // general-path parse of one struct BODY (cursor at '{'); ni = -1 for the
-// row root (children = p->top).  Fills discovery scratch for adopt_layout:
-// unit spans for scalar leaves + whole lists, present/missing node sets.
-bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
+// row root (children = p->top).  With ``discover`` set (row-scope
+// structs) it fills the discovery scratch for adopt_layout: unit spans
+// for scalar leaves + whole lists, present/missing node sets.  Struct
+// values inside generic-list elements parse with discover=false — the
+// enclosing list is already one opaque layout unit — and clear their
+// direct kids' seen marks on entry, because the same schema node is
+// instantiated once per ELEMENT within a single row.
+bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b,
+                       bool discover) {
   const std::vector<int>& kids = ni < 0 ? p->top : p->nodes[ni].kids;
   std::string& key = p->g_key;
   if (!c.eat('{')) return false;
+  for (int k : kids) p->g_seen[k] = 0;
   if (ni >= 0) {
     p->nodes[ni].valid.push_back(1);
-    p->d_present.push_back(ni);
+    if (discover) p->d_present.push_back(ni);
   }
   if (!c.peek('}')) {
     for (;;) {
@@ -717,10 +863,12 @@ bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
         // producer whose undeclared field VARIES byte-to-byte (uuid,
         // trace id) still gets the fast path (fast_row re-skips the
         // value generically at that position instead of memcmp-failing)
-        p->d_vs.push_back((size_t)(c.p - b));
-        p->d_col.push_back(-1);
+        if (discover) {
+          p->d_vs.push_back((size_t)(c.p - b));
+          p->d_col.push_back(-1);
+        }
         if (!skip_value(c)) { c.fail = true; return false; }
-        p->d_ve.push_back((size_t)(c.p - b));
+        if (discover) p->d_ve.push_back((size_t)(c.p - b));
       } else {
         Node& nd = p->nodes[ci];
         if (p->g_seen[ci]) {
@@ -728,7 +876,7 @@ bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
           // drop the whole subtree stored for the earlier occurrence.
           // (Stale d_present/d_missing entries from it don't matter:
           // d_ok=false suppresses layout adoption for this row.)
-          p->d_ok = false;  // fast path can't reproduce dup handling
+          if (discover) p->d_ok = false;  // fast path can't reproduce dups
           pop_row_subtree(p, ci);
         }
         p->g_seen[ci] = 1;
@@ -739,61 +887,32 @@ bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
         }
         if (is_null) {
           push_null_recursive(p, ci);
-          p->d_missing.push_back(ci);
+          if (discover) p->d_missing.push_back(ci);
         } else if (nd.type == 4) {
-          if (!parse_struct_body(p, ci, c, b)) {
+          if (!parse_struct_body(p, ci, c, b, discover)) {
             c.fail = true;
             return false;
           }
-        } else if (nd.type == 5) {
-          p->d_vs.push_back((size_t)(c.p - b));
-          p->d_col.push_back(ci);
-          if (!parse_list_value(p, nd, c, p->g_sval) || c.fail) {
+        } else if (nd.type == 5 || nd.type == 6) {
+          if (discover) {
+            p->d_vs.push_back((size_t)(c.p - b));
+            p->d_col.push_back(ci);
+          }
+          bool ok = nd.type == 5
+                        ? parse_list_value(p, nd, c, p->g_sval) && !c.fail
+                        : parse_list_node(p, ci, c);
+          if (!ok) {
             c.fail = true;
             return false;
           }
-          p->d_ve.push_back((size_t)(c.p - b));
+          if (discover) p->d_ve.push_back((size_t)(c.p - b));
         } else {
-          p->d_vs.push_back((size_t)(c.p - b));
-          p->d_col.push_back(ci);
-          switch (nd.type) {
-            case 0: {
-              int64_t v;
-              if (!parse_i64_at(c.p, c.end, v)) { c.fail = true; return false; }
-              nd.i64.push_back(v);
-              break;
-            }
-            case 1: {
-              double v;
-              if (!parse_f64_at(c.p, c.end, v)) { c.fail = true; return false; }
-              nd.f64.push_back(v);
-              break;
-            }
-            case 2: {
-              if (c.end - c.p >= 4 && memcmp(c.p, "true", 4) == 0) {
-                c.p += 4;
-                nd.b.push_back(1);
-              } else if (c.end - c.p >= 5 &&
-                         memcmp(c.p, "false", 5) == 0) {
-                c.p += 5;
-                nd.b.push_back(0);
-              } else {
-                c.fail = true;
-                return false;
-              }
-              break;
-            }
-            case 3: {
-              if (!c.eat('"')) { c.fail = true; return false; }
-              if (!parse_string(c, p->g_sval)) { c.fail = true; return false; }
-              nd.str_bytes.insert(nd.str_bytes.end(), p->g_sval.begin(),
-                                  p->g_sval.end());
-              nd.str_offsets.push_back(nd.str_bytes.size());
-              break;
-            }
+          if (discover) {
+            p->d_vs.push_back((size_t)(c.p - b));
+            p->d_col.push_back(ci);
           }
-          nd.valid.push_back(1);
-          p->d_ve.push_back((size_t)(c.p - b));
+          if (!parse_scalar_value(p, nd, c)) return false;
+          if (discover) p->d_ve.push_back((size_t)(c.p - b));
         }
       }
       c.ws();
@@ -808,7 +927,7 @@ bool parse_struct_body(Parser* p, int ni, Cursor& c, const uint8_t* b) {
   for (int k : kids)
     if (!p->g_seen[k]) {
       push_null_recursive(p, k);
-      p->d_missing.push_back(k);
+      if (discover) p->d_missing.push_back(k);
     }
   return true;
 }
@@ -828,7 +947,7 @@ bool parse_row_general(Parser* p, const uint8_t* b, const uint8_t* e,
   probe.ws();
   const bool is_object = probe.p < probe.end && *probe.p == '{';
   Cursor c{b, e};
-  if (!parse_struct_body(p, -1, c, b)) {
+  if (!parse_struct_body(p, -1, c, b, true)) {
     rollback_row(p, p->nrows);
     p->error = (is_object ? "malformed JSON at row "
                           : "expected '{' at row ") +
@@ -857,8 +976,10 @@ void* jp_create(int ncols, const char** names, const int* types) {
 }
 
 // full schema tree.  nodes come in any order with parent[i] either -1
-// (top-level field, order significant) or the index of a struct node.
-// types: 0..3 scalar, 4 struct, 5 list-of-scalar with elem_types[i] 0..3.
+// (top-level field, order significant) or the index of a struct node /
+// a type-6 list node (whose single child is its element subtree).
+// types: 0..3 scalar, 4 struct, 5 list-of-scalar with elem_types[i]
+// 0..3, 6 generic list.
 void* jp_create_tree(int nnodes, const char** names, const int* types,
                      const int* elem_types, const int* parents) {
   Parser* p = new Parser();
@@ -869,7 +990,7 @@ void* jp_create_tree(int nnodes, const char** names, const int* types,
     nd.type = types[i];
     nd.elem_type = elem_types[i];
     nd.str_offsets.push_back(0);
-    nd.list_offsets.assign(nd.type == 5 ? 1 : 0, 0);
+    nd.list_offsets.assign((nd.type == 5 || nd.type == 6) ? 1 : 0, 0);
     if (parents[i] < 0)
       p->top.push_back(i);
     else
@@ -890,7 +1011,7 @@ void jp_clear(void* h) {
     nd.str_bytes.clear();
     nd.str_offsets.assign(1, 0);
     nd.evalid.clear();
-    if (nd.type == 5) nd.list_offsets.assign(1, 0);
+    if (nd.type == 5 || nd.type == 6) nd.list_offsets.assign(1, 0);
   }
 }
 
@@ -908,6 +1029,7 @@ int jp_parse(void* h, const uint8_t* data, const uint64_t* offsets,
         nd.str_offsets.reserve(nd.str_offsets.size() + nrows);
         break;
       case 5:
+      case 6:
         nd.list_offsets.reserve(nd.list_offsets.size() + nrows);
         break;
     }
@@ -983,7 +1105,10 @@ uint64_t jp_col_list_nelems(void* h, int col) {
 int64_t jp_col_str_dict(void* h, int col) {
   Parser* p = static_cast<Parser*>(h);
   Node& c = p->nodes[col];
-  uint64_t n = c.type == 5 ? list_elems(c) : p->nrows;
+  // entry count: packed scalar-list elements live in the list node's own
+  // vectors; every other node (including string nodes under a generic
+  // list) pushes one valid byte per entry
+  uint64_t n = c.type == 5 ? list_elems(c) : c.valid.size();
   return build_str_dict(c.str_bytes, c.str_offsets, n, c.dict);
 }
 const int32_t* jp_col_str_dict_codes(void* h, int col) {

@@ -297,6 +297,82 @@ static void test_json_tree() {
   printf("json tree ok\n");
 }
 
+static void test_json_generic_lists() {
+  // type-6 generic lists (PR 2): list-of-struct and list-of-list with
+  // null elements, missing/duplicate keys inside elements, layout
+  // adoption over the opaque list units, and mid-list truncation
+  // rollback.  Heap-exact buffers put ASan redzones at the row ends.
+  //   0 id(i64)  1 evts(list<struct>)  2 item(struct,p=1)  3 k(i64,p=2)
+  //   4 s(str,p=2)  5 m(list<list<i64>>)  6 inner(list<i64>,p=5)
+  const char* names[7] = {"id", "evts", "item", "k", "s", "m", "inner"};
+  int types[7] = {0, 6, 4, 0, 3, 6, 5};
+  int etypes[7] = {-1, -1, -1, -1, -1, -1, 0};
+  int parents[7] = {-1, -1, 1, 2, 2, -1, 5};
+  void* p = jp_create_tree(7, names, types, etypes, parents);
+  std::string rows;
+  std::vector<uint64_t> offs{0};
+  auto add = [&](const std::string& r) {
+    rows += r;
+    offs.push_back(rows.size());
+  };
+  for (int i = 0; i < 12; i++)  // fixed shape: layout adoption
+    add("{\"id\":" + std::to_string(i) +
+        ",\"evts\":[{\"k\":1,\"s\":\"a\"},{\"k\":2,\"s\":\"b\"}],"
+        "\"m\":[[1,2],[3]]}");
+  add("{\"id\":100,\"evts\":[],\"m\":[]}");
+  add("{\"id\":101,\"evts\":null,\"m\":null}");
+  add("{\"id\":102,\"evts\":[null,{\"s\":\"y\",\"zz\":7}],"
+      "\"m\":[null,[4,null]]}");  // null elem, missing k, unknown key
+  add("{\"id\":103,\"evts\":[{\"k\":5,\"k\":6}],\"m\":[[]]}");  // dup in elem
+  {
+    std::vector<uint8_t> exact(rows.begin(), rows.end());
+    assert(jp_parse(p, exact.data(), offs.data(), offs.size() - 1) == 0);
+    assert(jp_nrows(p) == 16);
+    const uint64_t* eo = jp_col_list_offsets(p, 1);
+    assert(eo[12] == 24 && eo[13] == 24);   // 12 x 2 elems, then []
+    assert(eo[14] == 24);                   // null list: no elems
+    assert(eo[15] - eo[14] == 2 && eo[16] - eo[15] == 1);
+    const uint8_t* ep = jp_col_valid(p, 2);  // element struct presence
+    assert(ep[24] == 0 && ep[25] == 1);      // [null, {...}]
+    const int64_t* kv = jp_col_i64(p, 3);
+    const uint8_t* kvv = jp_col_valid(p, 3);
+    assert(kvv[25] == 0);                    // missing k -> null leaf
+    assert(kv[26] == 6 && kvv[26] == 1);     // dup key: last wins
+    const uint8_t* lv = jp_col_valid(p, 1);
+    assert(lv[12] == 1 && lv[13] == 0 && lv[14] == 1);
+    // list-of-list: outer offsets index INNER list entries
+    const uint64_t* mo = jp_col_list_offsets(p, 5);
+    const uint64_t* io = jp_col_list_offsets(p, 6);
+    const uint8_t* iv = jp_col_valid(p, 6);
+    assert(mo[12] == 24);                    // 12 x 2 inner lists
+    assert(mo[15] - mo[14] == 2);            // [null, [4, null]]
+    assert(iv[mo[14]] == 0 && iv[mo[14] + 1] == 1);
+    uint64_t in0 = mo[14] + 1;               // the [4, null] inner entry
+    assert(io[in0 + 1] - io[in0] == 2);
+    const uint8_t* iev = jp_col_list_evalid(p, 6);
+    assert(iev[io[in0]] == 1 && iev[io[in0] + 1] == 0);
+    assert(jp_col_i64(p, 6)[io[in0]] == 4);
+  }
+  // truncation mid-element with an armed layout: rollback must trim the
+  // whole nested subtree (trim_node through offsets), caught by ASan if
+  // any vector is left inconsistent
+  for (const char* t :
+       {"{\"id\":1,\"evts\":[{\"k\":1,\"s\":\"a\"},{\"k\":",
+        "{\"id\":1,\"m\":[[1,", "{\"id\":1,\"evts\":[null,"}) {
+    jp_clear(p);
+    std::string warm =
+        "{\"id\":0,\"evts\":[{\"k\":1,\"s\":\"a\"},{\"k\":2,\"s\":\"b\"}],"
+        "\"m\":[[1,2],[3]]}";
+    std::string both = warm + t;
+    std::vector<uint8_t> exact(both.begin(), both.end());
+    uint64_t toffs[3] = {0, warm.size(), both.size()};
+    assert(jp_parse(p, exact.data(), toffs, 2) == -1);
+    assert(jp_nrows(p) == 1);  // the warm row survived the rollback
+  }
+  jp_destroy(p);
+  printf("json generic lists ok\n");
+}
+
 static void zz(std::vector<uint8_t>& out, int64_t v) {
   uint64_t z = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
   while (z >= 0x80) {
@@ -361,6 +437,128 @@ static void test_avro() {
     }
   ap_destroy(p);
   printf("avro ok\n");
+}
+
+static void test_avro_tree() {
+  // the schema-tree ABI (PR 2): nested records, arrays of records,
+  // arrays of arrays, nullable at every level; block-encoded arrays
+  // with negative counts; truncation rollback; count-bomb rejection.
+  //   0 id(i64)  1 imu(rec,nullable)  2 ts(i64,p=1)  3 gps(rec,p=1,nul)
+  //   4 lat(f64,p=3)  5 readings(list,p=-1)  6 elem(rec,p=5)
+  //   7 k(i64,p=6)  8 m(list)  9 inner(list,p=8)  10 x(i64,p=9)
+  int types[11] = {0, 5, 0, 5, 1, 6, 5, 0, 6, 6, 0};
+  int nulls[11] = {0, 1, 0, 1, 0, 0, 0, 1, 0, 1, 0};
+  int parents[11] = {-1, -1, 1, 1, 3, -1, 5, 6, -1, 8, 9};
+  void* p = ap_create_tree(11, types, nulls, parents);
+  std::vector<uint8_t> arena;
+  std::vector<uint64_t> offs{0};
+  auto rec = [&](int64_t id, bool imu_null, bool gps_null, int nread,
+                 int ninner) {
+    zz(arena, id);
+    zz(arena, imu_null ? 0 : 1);  // imu union branch
+    if (!imu_null) {
+      zz(arena, 42);              // ts
+      zz(arena, gps_null ? 0 : 1);
+      if (!gps_null) {
+        double lat = 1.5;
+        const uint8_t* b = (const uint8_t*)&lat;
+        arena.insert(arena.end(), b, b + 8);
+      }
+    }
+    if (nread) {
+      zz(arena, nread);
+      for (int i = 0; i < nread; i++) {
+        zz(arena, i % 2);          // k union branch: alternate null
+        if (i % 2) zz(arena, 7);
+      }
+    }
+    zz(arena, 0);                  // readings terminator
+    if (ninner) {
+      zz(arena, -ninner);          // negative block count + byte size
+      zz(arena, 1);                // (size not validated, items decoded)
+      for (int i = 0; i < ninner; i++) {
+        zz(arena, 1);              // inner union branch: present
+        zz(arena, 2);              // one element
+        zz(arena, (int64_t)i);
+        zz(arena, (int64_t)-i);
+        zz(arena, 0);              // inner terminator
+      }
+    }
+    zz(arena, 0);                  // m terminator
+    offs.push_back(arena.size());
+  };
+  rec(1, false, false, 2, 2);
+  rec(2, true, false, 0, 0);
+  rec(3, false, true, 3, 1);
+  {
+    std::vector<uint8_t> exact(arena);
+    assert(ap_parse(p, exact.data(), offs.data(), 3) == 0);
+    assert(ap_nrows(p) == 3);
+    const uint8_t* imup = ap_col_valid(p, 1);
+    assert(imup[0] == 1 && imup[1] == 0 && imup[2] == 1);
+    const uint8_t* gpsp = ap_col_valid(p, 3);
+    assert(gpsp[0] == 1 && gpsp[1] == 0 && gpsp[2] == 0);
+    assert(ap_col_f64(p, 4)[0] == 1.5);
+    const uint64_t* ro = ap_col_list_offsets(p, 5);
+    assert(ro[1] == 2 && ro[2] == 2 && ro[3] == 5);
+    const uint8_t* kp = ap_col_valid(p, 7);
+    assert(kp[0] == 0 && kp[1] == 1);  // alternating null ks
+    assert(ap_col_i64(p, 7)[1] == 7);
+    const uint64_t* mo = ap_col_list_offsets(p, 8);
+    assert(mo[1] == 2 && mo[3] == 3);  // 2 + 0 + 1 inner lists
+    const uint64_t* io = ap_col_list_offsets(p, 9);
+    assert(io[1] == 2 && ap_col_i64(p, 10)[0] == 0);
+    assert(ap_col_i64(p, 10)[1] == 0);  // -0 zigzag
+  }
+  // truncations at every byte boundary of the arena: rollback must keep
+  // every node subtree consistent (ASan catches stale sizes)
+  for (size_t cut = 0; cut < offs[1]; cut++) {
+    ap_clear(p);
+    std::vector<uint8_t> exact(arena.begin(), arena.begin() + cut);
+    uint64_t toffs[2] = {0, cut};
+    assert(ap_parse(p, exact.data(), toffs, 1) == -1);
+    assert(ap_nrows(p) == 0);
+  }
+  // array count bomb: tiny payload declaring 2^30 items must fail, not
+  // allocate
+  {
+    ap_clear(p);
+    std::vector<uint8_t> bomb;
+    zz(bomb, 9);       // id
+    zz(bomb, 0);       // imu null
+    zz(bomb, 1 << 30); // readings count
+    uint64_t boffs[2] = {0, bomb.size()};
+    std::vector<uint8_t> exact(bomb);
+    assert(ap_parse(p, exact.data(), boffs, 1) == -1);
+  }
+  ap_destroy(p);
+  // repeated-block bomb (review-found): array<empty record> elements
+  // consume ZERO wire bytes, so the per-block remaining-bytes cap admits
+  // 65536 items per ~3-byte block forever — the cumulative per-record
+  // element budget must stop it after the first block
+  {
+    int types2[2] = {6, 5};
+    int nulls2[2] = {0, 0};
+    int parents2[2] = {-1, 0};
+    void* p2 = ap_create_tree(2, types2, nulls2, parents2);
+    std::vector<uint8_t> bomb;
+    for (int b = 0; b < 200; b++) zz(bomb, 65536);
+    zz(bomb, 0);
+    uint64_t boffs[2] = {0, bomb.size()};
+    std::vector<uint8_t> exact(bomb);
+    assert(ap_parse(p2, exact.data(), boffs, 1) == -1);
+    // a small array of empty records stays legal
+    ap_clear(p2);
+    std::vector<uint8_t> ok;
+    zz(ok, 3);
+    zz(ok, 0);
+    uint64_t ooffs[2] = {0, ok.size()};
+    std::vector<uint8_t> exact2(ok);
+    assert(ap_parse(p2, exact2.data(), ooffs, 1) == 0);
+    assert(ap_col_list_offsets(p2, 0)[1] == 3);
+    ap_destroy(p2);
+  }
+  printf("avro tree ok\n");
 }
 
 static void test_codecs() {
@@ -441,7 +639,9 @@ int main(int argc, char** argv) {
   test_json();
   test_json_fast_layout();
   test_json_tree();
+  test_json_generic_lists();
   test_avro();
+  test_avro_tree();
   test_codecs();
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;

@@ -300,7 +300,15 @@ class SourceExec(ExecOperator):
             r.offset_restore(s)
 
     def metrics(self):
-        return dict(self._metrics)
+        m = dict(self._metrics)
+        # per-partition Python-decode fallback counts, aggregated: a
+        # schema shape that silently routes to the ~30x-slower Python
+        # decoder must be observable, not a quiet perf cliff.  Reading an
+        # int attribute across the prefetch worker threads is safe.
+        m["decode_fallback_rows"] = sum(
+            r.decode_fallback_rows() for r in (self._readers or [])
+        )
+        return m
 
     def _label(self):
         return f"SourceExec({self.source.name})"

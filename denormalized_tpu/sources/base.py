@@ -110,6 +110,15 @@ class PartitionReader:
     def offset_restore(self, snap: dict) -> None:
         pass
 
+    # -- optional decode-path observability ------------------------------
+    def decode_fallback_rows(self) -> int:
+        """Rows this reader decoded through a pure-Python fallback path
+        (native parser unavailable, or the schema has a shape the native
+        shredder declines).  Aggregated into ``SourceExec.metrics()`` so
+        a topic silently riding the ~30x-slower decode path is visible —
+        0 for readers with no payload decode stage (memory, CSV)."""
+        return 0
+
     # -- optional backlog report ----------------------------------------
     def caught_up(self) -> bool | None:
         """Does this reader KNOW whether more data is already waiting at

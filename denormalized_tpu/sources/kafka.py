@@ -815,6 +815,12 @@ class KafkaPartitionReader(PartitionReader):
         fetch yet, or reconnecting)."""
         return self._caught_up
 
+    def decode_fallback_rows(self) -> int:
+        # the decoder counts rows it pushed through the Python path (the
+        # zero-copy native arena parse never touches the decoder's
+        # push/flush, so native rows stay out of the count by design)
+        return int(getattr(self._decoder, "decode_fallback_rows", 0))
+
     def offset_snapshot(self) -> dict:
         # _snap_offset trails _offset while a split fetch drains: it
         # covers exactly the YIELDED slices, so a barrier between slices

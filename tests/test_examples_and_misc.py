@@ -335,3 +335,123 @@ def test_catchup_replay_example_legacy_mode_drops():
     # the demo's point: the reference-semantics replay silently loses
     # the slow partition's rows
     assert "late-dropped rows: 0" not in out, out[-500:]
+
+
+def test_kafka_rideshare_schema_decodes_natively_no_fallback():
+    """The kafka_rideshare nested schema (structs three levels deep) must
+    decode 100% natively: SourceExec's aggregated ``decode_fallback_rows``
+    stays 0 — the counter that makes a silent route to the ~30x-slower
+    Python decoder observable.  A dynamic-map schema (the one shape that
+    STILL falls back) shows a nonzero count through the same plumbing."""
+    from examples.kafka_rideshare import SAMPLE_EVENT
+
+    from denormalized_tpu.physical.simple_execs import SourceExec
+    from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+    from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    try:
+        broker.create_topic("rideshare-metrics", partitions=1)
+        n = 200
+        msgs = []
+        for i in range(n):
+            ev = json.loads(json.dumps(SAMPLE_EVENT))
+            ev["occurred_at_ms"] = 1_700_000_000_000 + i
+            ev["imu_measurement"]["gps"]["speed"] = float(i % 40)
+            msgs.append(json.dumps(ev).encode())
+        broker.produce_batched("rideshare-metrics", 0, msgs)
+
+        def consume(builder_topic: str, sample: dict | None,
+                    avro_decl: dict | None = None) -> dict:
+            b = KafkaTopicBuilder(broker.bootstrap).with_topic(builder_topic)
+            if avro_decl is not None:
+                b = b.with_avro_schema(avro_decl)
+            else:
+                b = b.infer_schema_from_json(json.dumps(sample))
+            src = b.with_timestamp_column("occurred_at_ms").build_reader()
+            exec_ = SourceExec(src)
+            gen = exec_.run()
+            deadline = time.time() + 20
+            while (
+                exec_.metrics()["rows_out"] < n and time.time() < deadline
+            ):
+                next(gen)
+            gen.close()
+            return exec_.metrics()
+
+        m = consume("rideshare-metrics", SAMPLE_EVENT)
+        assert m["rows_out"] >= n
+        assert m["decode_fallback_rows"] == 0, m
+
+        # the EQUIVALENT nested Avro schema decodes natively too
+        from denormalized_tpu.formats.avro_codec import (
+            encode_record,
+            parse_avro_schema,
+        )
+
+        avro_decl = {
+            "type": "record", "name": "Ride", "fields": [
+                {"name": "driver_id", "type": "string"},
+                {"name": "occurred_at_ms", "type": "long"},
+                {"name": "imu_measurement", "type": {
+                    "type": "record", "name": "Imu", "fields": [
+                        {"name": "timestamp_ms", "type": "long"},
+                        {"name": "gps", "type": {
+                            "type": "record", "name": "Gps", "fields": [
+                                {"name": "latitude", "type": "double"},
+                                {"name": "speed", "type": ["null", "double"]},
+                            ]}},
+                    ]}},
+            ],
+        }
+        avro_sch = parse_avro_schema(avro_decl)
+        broker.create_topic("rideshare-avro", partitions=1)
+        broker.produce_batched("rideshare-avro", 0, [
+            encode_record(avro_sch, {
+                "driver_id": f"d{i % 8}",
+                "occurred_at_ms": 1_700_000_000_000 + i,
+                "imu_measurement": {
+                    "timestamp_ms": i,
+                    "gps": {"latitude": 37.7, "speed": float(i % 40)},
+                },
+            })
+            for i in range(n)
+        ])
+        ma = consume("rideshare-avro", None, avro_decl=avro_decl)
+        assert ma["rows_out"] >= n
+        assert ma["decode_fallback_rows"] == 0, ma
+
+        # a list-of-struct schema — the shape that used to silently drop
+        # to the Python decoder — now also stays native end to end
+        broker.create_topic("rideshare-events", partitions=1)
+        broker.produce_batched("rideshare-events", 0, [
+            json.dumps({
+                "occurred_at_ms": 1_700_000_000_000 + i,
+                "evts": [{"kind": "ping", "v": float(i)},
+                         {"kind": "pong", "v": -1.5}],
+            }).encode()
+            for i in range(n)
+        ])
+        ml = consume(
+            "rideshare-events",
+            {"occurred_at_ms": 1, "evts": [{"kind": "x", "v": 0.5}]},
+        )
+        assert ml["rows_out"] >= n
+        assert ml["decode_fallback_rows"] == 0, ml
+
+        # inverse control: a dynamic-map struct (childless) is the one
+        # JSON shape the native shredder still declines — the SAME
+        # counter must light up, proving the plumbing measures reality
+        broker.create_topic("rideshare-dyn", partitions=1)
+        dyn_msgs = [
+            json.dumps(
+                {"occurred_at_ms": 1_700_000_000_000 + i, "meta": {"k": i}}
+            ).encode()
+            for i in range(n)
+        ]
+        broker.produce_batched("rideshare-dyn", 0, dyn_msgs)
+        m2 = consume("rideshare-dyn", {"occurred_at_ms": 1, "meta": {}})
+        assert m2["rows_out"] >= n
+        assert m2["decode_fallback_rows"] >= n, m2
+    finally:
+        broker.stop()

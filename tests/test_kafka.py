@@ -886,21 +886,21 @@ def test_fetch_splitting_bounded_batches_exact_offsets(broker):
 
 
 def test_fetch_splitting_non_native_decode_path(broker):
-    """Schemas the native parser declines to shred (here: a list of
-    structs) decode through the Python decoder, but the fetch still runs
-    through the native client — so max.batch.rows splitting and its exact
-    slice-boundary offsets apply on this path too.  (Plain nested structs
-    now decode natively via the shredded tree ABI, so they no longer
-    exercise this path.)"""
+    """Schemas the native parser declines to shred (here: a dynamic-map
+    struct with no declared children — the ONE remaining fallback shape
+    now that lists of structs/lists shred natively) decode through the
+    Python decoder, but the fetch still runs through the native client —
+    so max.batch.rows splitting and its exact slice-boundary offsets
+    apply on this path too."""
     broker.create_topic("splitnest", partitions=1)
     total = 600
     msgs = [
-        b'{"occurred_at_ms": %d, "evts": [{"speed": %d}]}'
-        % (1_700_000_000_000 + i, i)
+        b'{"occurred_at_ms": %d, "meta": {"k%d": %d}}'
+        % (1_700_000_000_000 + i, i, i)
         for i in range(total)
     ]
     broker.produce_batched("splitnest", 0, msgs)
-    sample = json.dumps({"occurred_at_ms": 1, "evts": [{"speed": 2}]})
+    sample = json.dumps({"occurred_at_ms": 1, "meta": {}})
     src = (
         KafkaTopicBuilder(broker.bootstrap)
         .with_topic("splitnest")
