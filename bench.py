@@ -7,7 +7,14 @@ tumbling ``count/min/max/avg`` by ``sensor_name`` (the driver-defined target;
 the reference publishes no numbers of its own).
 
 Other configs (BENCH_CONFIG env): sliding | highcard | join | checkpoint —
-the remaining BASELINE.md configs 2-5.
+the remaining BASELINE.md configs 2-5 — plus:
+
+- ``session``: the soak-shaped bursty feed (600ms burst / 400ms silence per
+  event-second) through a 300ms-gap session window, count/min/max/avg by
+  key — the vectorized host-side session operator, measured end to end.
+- ``session_scale``: key-cardinality sweep (1 / 1k / 10k / 100k keys) of
+  the session operator, NEW vs the kept pre-vectorization reference
+  implementation (SESSION_SCALE.json artifact).
 
 Prints ONE JSON line:
     {"metric": ..., "value": engine rows/s, "unit": "rows/s",
@@ -65,6 +72,11 @@ LAT_BATCH = int(os.environ.get("BENCH_LAT_BATCH", 8_192))
 WINDOW_MS = 1000
 EVENTS_PER_SEC = 1_000_000  # event-time generation rate AND latency-phase pace
 EVENT_T0 = 1_700_000_000_000
+# session config: gap + the tools/soak.py burst duty cycle (events squeezed
+# into each second's first 600ms; the 400ms silence > gap closes one
+# session per key per event-second)
+SESSION_GAP_MS = int(os.environ.get("BENCH_SESSION_GAP_MS", 300))
+SESSION_BURST_NUM, SESSION_BURST_DEN = 3, 5  # 600ms of every 1000
 
 
 def log(*a):
@@ -436,6 +448,25 @@ def gen_batches(
     return schema, batches
 
 
+def gen_session_batches(
+    num_keys=None, total_rows=None, batch_rows=None, seed=0
+):
+    """gen_batches with the soak session shape: each event-second's rows
+    squash into its first 600ms, leaving a 400ms silence > SESSION_GAP_MS —
+    one session per key per event-second, so sessions CLOSE continuously
+    during the run (the flat gen_batches feed never has a per-key gap at
+    bench cardinalities and would only flush at EOS)."""
+    schema, batches = gen_batches(
+        num_keys=num_keys, total_rows=total_rows, batch_rows=batch_rows,
+        seed=seed,
+    )
+    for b in batches:
+        ts = np.asarray(b.column("occurred_at_ms"), dtype=np.int64)
+        sec = (ts // 1000) * 1000
+        b.columns[0] = sec + ((ts - sec) * SESSION_BURST_NUM) // SESSION_BURST_DEN
+    return schema, batches
+
+
 DEVICE_STRATEGY = os.environ.get("BENCH_DEVICE_STRATEGY", "auto")
 EMISSION_COMPACTION = os.environ.get("BENCH_EMISSION_COMPACTION", "0") == "1"
 HOST_PIPELINE = os.environ.get("BENCH_HOST_PIPELINE", "0") == "1"
@@ -531,6 +562,17 @@ def build_pipeline(config, ctx, source, source2=None):
             ["sensor_name"],
             [F.sum(col("reading")).alias("sum"), F.avg(col("reading")).alias("avg")],
             WINDOW_MS,
+        )
+    if config == "session":
+        return ctx.from_source(source, name="bench_session").session_window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            SESSION_GAP_MS,
         )
     if config == "join":
         left = ctx.from_source(source, name="bench_t").window(
@@ -958,6 +1000,96 @@ def run_ingest_scale(batches) -> dict:
         # a 1-core host can only show partition-multiplex OVERHEAD (perfect
         # flat = 1/N efficiency); true thread scaling needs cores — record
         # the context so the numbers aren't misread as a GIL ceiling
+        "host_cores": os.cpu_count(),
+        "host_load_1m": round(os.getloadavg()[0], 2),
+    }
+
+
+def run_session_scale() -> dict:
+    """Key-cardinality sweep of the SESSION operator, new-vs-reference
+    (the PR's perf evidence): for each point (1 / 1k / 10k / 100k keys)
+    run the SAME bursty workload through (a) the vectorized
+    SessionWindowExec and (b) the kept pre-vectorization reference
+    (DENORMALIZED_SESSION_REFERENCE=1 — physical/session_reference.py),
+    both through the full production pipeline (MemorySource → SourceExec →
+    session window), and report rows/s each.  The reference runs a
+    bounded row prefix (BENCH_SESSION_REF_ROWS, default 262144): at
+    ~0.1M rows/s and 100k keys an un-bounded reference point alone would
+    take tens of minutes; rows/s is rate, the per-point workload shape is
+    identical.  Artifact: SESSION_SCALE.json; headline value/vs_baseline
+    are the 10k-key point (new rows/s and new/reference)."""
+    points = [
+        int(x)
+        for x in os.environ.get(
+            "BENCH_SESSION_SCALE_KEYS", "1,1000,10000,100000"
+        ).split(",")
+    ]
+    new_rows = TOTAL_ROWS if _ROWS_EXPLICIT else 2_000_000
+    ref_rows = int(os.environ.get("BENCH_SESSION_REF_ROWS", 262_144))
+    batch_rows = min(BATCH_ROWS, 131_072)
+
+    def one(batches, reference: bool) -> tuple[float, int]:
+        prev = os.environ.pop("DENORMALIZED_SESSION_REFERENCE", None)
+        if reference:
+            os.environ["DENORMALIZED_SESSION_REFERENCE"] = "1"
+        try:
+            ctx = _engine_ctx(batch_rows)
+            ds = build_pipeline("session", ctx, _mem_source(batches))
+            rows = sum(b.num_rows for b in batches)
+            out_rows = 0
+            t0 = time.perf_counter()
+            for b in ds.stream():
+                out_rows += b.num_rows
+            dt = time.perf_counter() - t0
+            return rows / dt, out_rows
+        finally:
+            os.environ.pop("DENORMALIZED_SESSION_REFERENCE", None)
+            if prev is not None:
+                os.environ["DENORMALIZED_SESSION_REFERENCE"] = prev
+
+    results: dict[str, dict] = {}
+    for keys in points:
+        _, batches = gen_session_batches(
+            num_keys=keys, total_rows=new_rows, batch_rows=batch_rows
+        )
+        n_ref = max(1, ref_rows // batch_rows)
+        new_rps, new_sessions = one(batches, reference=False)
+        ref_rps, ref_sessions = one(batches[:n_ref], reference=True)
+        results[str(keys)] = {
+            "new_rows_per_s": round(new_rps),
+            "reference_rows_per_s": round(ref_rps),
+            "speedup": round(new_rps / ref_rps, 2),
+            "new_rows": sum(b.num_rows for b in batches),
+            "reference_rows": sum(b.num_rows for b in batches[:n_ref]),
+            "new_sessions_emitted": new_sessions,
+            "reference_sessions_emitted": ref_sessions,
+        }
+        log(
+            f"session_scale[{keys} keys]: new {new_rps:,.0f} rows/s, "
+            f"reference {ref_rps:,.0f} rows/s "
+            f"({new_rps / ref_rps:.1f}x)"
+        )
+    # headline = the 10k-key point when the sweep includes it; otherwise
+    # the largest point actually run — and the metric NAME must say which
+    headline_keys = 10000 if "10000" in results else points[-1]
+    headline = results[str(headline_keys)]
+    lbl = (
+        f"{headline_keys // 1000}k"
+        if headline_keys >= 1000 and headline_keys % 1000 == 0
+        else str(headline_keys)
+    )
+    return {
+        "metric": (
+            f"rows_per_sec_{SESSION_GAP_MS}ms_gap_session_scale_{lbl}_keys"
+        ),
+        "value": headline["new_rows_per_s"],
+        "unit": "rows/s",
+        # for this config the ratio is new-vs-reference at the headline
+        # cardinality — the operator-rewrite speedup, not engine-vs-cpu
+        "vs_baseline": headline["speedup"],
+        "device": "host",
+        "gap_ms": SESSION_GAP_MS,
+        "points": results,
         "host_cores": os.cpu_count(),
         "host_load_1m": round(os.getloadavg()[0], 2),
     }
@@ -1520,7 +1652,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
     from denormalized_tpu.common.constants import WINDOW_END_COLUMN
 
     lat_keys = NUM_KEYS
-    _, batches = gen_batches(
+    _, batches = (gen_session_batches if config == "session" else gen_batches)(
         num_keys=lat_keys, total_rows=LAT_ROWS, batch_rows=LAT_BATCH, seed=7
     )
     batches2 = None
@@ -2073,6 +2205,63 @@ class _TorchAgg(_CpuAgg):
         return out
 
 
+def _session_cpu_baseline(batches) -> int:
+    """Streaming numpy sessionizer — the honest single-core baseline for
+    the session config: per batch, sort by (key-code, ts), reduceat the
+    gap-separated segments, merge into a dict of per-key open sessions,
+    close on watermark.  Same algorithmic shape as the engine operator but
+    with none of its generality (no nulls, no out-of-order bridges, no
+    UDAFs, no checkpointing)."""
+    gap = SESSION_GAP_MS
+    open_s: dict = {}  # (key) -> [start, last, cnt, mn, mx, sm]
+    emitted = 0
+    wm = None
+    for b in batches:
+        ts = np.asarray(b.columns[0], dtype=np.int64)
+        names = np.asarray(b.columns[1], dtype=object)
+        vals = np.asarray(b.columns[2])
+        _, codes = np.unique(names, return_inverse=True)
+        order = np.lexsort((ts, codes))
+        ts_s, cs, vs = ts[order], codes[order], vals[order]
+        brk = np.empty(len(ts), dtype=bool)
+        brk[0] = True
+        brk[1:] = (cs[1:] != cs[:-1]) | ((ts_s[1:] - ts_s[:-1]) > gap)
+        bounds = np.nonzero(brk)[0]
+        firsts = ts_s[bounds]
+        lasts = ts_s[np.append(bounds[1:], len(ts)) - 1]
+        cnts = np.diff(np.append(bounds, len(ts)))
+        mns = np.minimum.reduceat(vs, bounds)
+        mxs = np.maximum.reduceat(vs, bounds)
+        sms = np.add.reduceat(vs, bounds)
+        seg_names = names[order][bounds]
+        for i in range(len(bounds)):
+            k = seg_names[i]
+            s = open_s.get(k)
+            if s is not None and firsts[i] - s[1] <= gap:
+                s[1] = int(lasts[i])
+                s[2] += int(cnts[i])
+                s[3] = min(s[3], mns[i])
+                s[4] = max(s[4], mxs[i])
+                s[5] += sms[i]
+            else:
+                if s is not None:
+                    emitted += 1  # avg finalize
+                    _ = s[5] / s[2]
+                open_s[k] = [
+                    int(firsts[i]), int(lasts[i]), int(cnts[i]),
+                    mns[i], mxs[i], sms[i],
+                ]
+        bmin = int(ts.min())
+        if wm is None or bmin > wm:
+            wm = bmin
+        for k in list(open_s):
+            if open_s[k][1] + gap <= wm:
+                s = open_s.pop(k)
+                _ = s[5] / s[2]
+                emitted += 1
+    return emitted + len(open_s)
+
+
 def _baseline_once(agg_cls, batches, kind, batches2=None):
     rows = sum(b.num_rows for b in batches)
     t0 = time.perf_counter()
@@ -2089,6 +2278,12 @@ def _baseline_once(agg_cls, batches, kind, batches2=None):
                 avg = e[3] / e[2]
                 _keep = avg > 45.0  # post-agg filter
         emitted = agg.emitted
+    elif kind == "session":
+        if agg_cls is not _CpuAgg:
+            # torch's scatter primitives don't express data-dependent
+            # interval merging; only the numpy baseline exists
+            raise ValueError("no torch baseline for session")
+        emitted = _session_cpu_baseline(batches)
     elif kind == "join":
         rows += sum(b.num_rows for b in batches2)
         left = agg_cls(WINDOW_MS)
@@ -2228,12 +2423,18 @@ def _roofline(rps, info, probe) -> dict:
 def run_config(device: str) -> dict:
     """Run the currently-configured bench config end to end (throughput +
     latency + CPU baseline) and return the one-line JSON dict."""
-    global NUM_KEYS, BATCH_ROWS, TOTAL_ROWS
+    global NUM_KEYS, BATCH_ROWS, TOTAL_ROWS, LAT_ROWS
     config = CONFIG
     if config == "decode_scale":
         out = run_decode_scale()
         log(f"engine[decode_scale]: worst-shape native {out['value']:,} "
             f"rows/s, min native/python {out['min_native_vs_python']}x")
+        return out
+    if config == "session_scale":
+        out = run_session_scale()
+        log(f"engine[session_scale]: headline {out['metric']} = "
+            f"{out['value']:,} rows/s, "
+            f"{out['vs_baseline']}x over the reference operator")
         return out
     if config == "ingest_scale":
         if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
@@ -2275,8 +2476,18 @@ def run_config(device: str) -> dict:
             # which dominate at 100K-key cardinality; capped so reduced-row
             # quick cells still produce >=4 batches
             BATCH_ROWS = min(524_288, max(8_192, TOTAL_ROWS // 4))
+    if config == "session":
+        # the session operator is pure-host: its sweet spot is fewer rows
+        # than the device configs, and it needs NO device at all
+        if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
+            TOTAL_ROWS = 4_000_000
+        if "BENCH_BATCH" not in os.environ:
+            BATCH_ROWS = min(BATCH_ROWS, max(8_192, TOTAL_ROWS // 8))
+        if "BENCH_LAT_ROWS" not in os.environ:
+            LAT_ROWS = min(LAT_ROWS, 30_000_000)  # 30s paced at 1M ev/s
     log(f"generating {TOTAL_ROWS:,} rows ...")
-    _, batches = gen_batches()
+    gen = gen_session_batches if config == "session" else gen_batches
+    _, batches = gen()
     batches2 = None
     if config == "join":
         _, batches2 = gen_batches(seed=1)
@@ -2287,6 +2498,10 @@ def run_config(device: str) -> dict:
         "sliding": "rows_per_sec_1s_200ms_sliding_with_filter",
         "join": "rows_per_sec_windowed_stream_join",
         "checkpoint": "rows_per_sec_1s_tumbling_with_checkpointing",
+        "session": (
+            f"rows_per_sec_{SESSION_GAP_MS}ms_gap_session_"
+            "count_min_max_avg_by_key"
+        ),
     }[config]
 
     ckpt_dir = None
@@ -2358,11 +2573,12 @@ def main():
         return
     if CONFIG not in (
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
-        "ingest_scale", "decode_scale",
+        "ingest_scale", "decode_scale", "session", "session_scale",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
-    if CONFIG in ("decode_scale",):
-        # pure host-side decoder benchmark: no device, no TPU relay wait
+    if CONFIG in ("decode_scale", "session", "session_scale"):
+        # pure host-side benchmarks (decoder / session operator): no
+        # device, no TPU relay wait
         device = "host"
         force_cpu()
     else:

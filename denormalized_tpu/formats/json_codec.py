@@ -293,22 +293,42 @@ def rows_to_batch(objs: list[dict], schema: Schema) -> RecordBatch:
 
 
 class JsonRowEncoder:
-    """RecordBatch → per-row JSON byte payloads (utils/row_encoder.rs)."""
+    """RecordBatch → per-row JSON byte payloads (utils/row_encoder.rs).
+
+    Column-major preparation: each column converts to a plain-Python value
+    list ONCE (``tolist`` is one C call; NaN→None and mask→None patch in
+    bulk), then rows assemble by zipping the prepared lists — the per-row
+    work is exactly one dict build + ``json.dumps``, with no per-row column
+    lookups, mask probes, or numpy-scalar unboxing.  Measurable on
+    high-fanout kafka sink emission."""
 
     def encode(self, batch: RecordBatch) -> list[bytes]:
         user = batch.select(batch.schema.without_internal().names)
         names = user.schema.names
-        out = []
-        for i in range(user.num_rows):
-            row = {}
-            for j, name in enumerate(names):
-                m = user.masks[j]
-                if m is not None and not m[i]:
-                    row[name] = None
-                    continue
-                row[name] = _jsonify(user.columns[j][i])
-            out.append(json.dumps(row).encode())
-        return out
+        pycols: list[list] = []
+        for j in range(len(names)):
+            c = user.columns[j]
+            kind = getattr(c.dtype, "kind", "O")
+            if c.dtype == object:
+                vals = [_jsonify(v) for v in c.tolist()]
+            elif kind == "f":
+                vals = c.tolist()
+                if np.isnan(c).any():
+                    vals = [None if v != v else v for v in vals]
+            else:
+                # int/bool tolist() already yields native Python scalars
+                vals = c.tolist()
+            m = user.masks[j]
+            if m is not None:
+                vals = [
+                    v if ok else None for v, ok in zip(vals, m.tolist())
+                ]
+            pycols.append(vals)
+        dumps = json.dumps
+        return [
+            dumps(dict(zip(names, row))).encode()
+            for row in zip(*pycols)
+        ] if pycols else [b"{}"] * user.num_rows
 
 
 def _jsonify(v):

@@ -453,7 +453,173 @@ class WindowFunctionExpr(Expr):
         ]
         return np.asarray(idx, np.int64), keys
 
+    def _partition_ids(self, batch: RecordBatch, n: int) -> np.ndarray:
+        """Dense partition ids via the group interner (the session/window
+        operators' keying trick): numeric key columns dedupe through
+        np.unique, string columns through the native PyObject interner —
+        no per-row tuple construction.  Columns holding non-string objects
+        fall back to the legacy Python path (the interner's ``str()``
+        normalization could merge keys raw tuples would keep distinct)."""
+        if not self.partition_by:
+            return np.zeros(n, dtype=np.int32)
+        from denormalized_tpu.ops.interner import GroupInterner
+
+        pcols = []
+        for e in self.partition_by:
+            v = np.atleast_1d(e.eval(batch))
+            if v.dtype.kind == "f" and np.isnan(v).any():
+                # comparator-path semantics: NaN != NaN, so every NaN key
+                # is its OWN partition — np.unique would merge them
+                raise _WindowFallback
+            if v.dtype.kind not in "ifbuM" and not all(
+                isinstance(x, str) or x is None for x in v.tolist()
+            ):
+                raise _WindowFallback
+            pcols.append(v)
+        return GroupInterner(len(pcols)).intern(pcols)
+
+    def _order_keys_vec(
+        self, batch: RecordBatch, n: int
+    ) -> tuple[list[np.ndarray], list[np.ndarray]]:
+        """Per order-by column: an int64 ascending-composite sort key
+        (null bucket ∘ direction-adjusted dense rank from sorted-unique)
+        and a tie id.  Tie semantics preserved from the comparator path:
+        None ties with None, float NaN never ties (each NaN is its own
+        rank group).  Non-comparable (mixed-type) columns raise
+        ``_WindowFallback``."""
+        keys: list[np.ndarray] = []
+        ties: list[np.ndarray] = []
+        for sx in self.order_by:
+            vals = np.atleast_1d(sx.expr.eval(batch))
+            kind = vals.dtype.kind
+            nan_rows = None
+            if kind in "iub":
+                null = np.zeros(n, dtype=bool)
+            elif kind == "f":
+                null = np.isnan(vals)
+                nan_rows = np.nonzero(null)[0]
+            elif kind == "M":
+                null = np.isnat(vals)
+            else:
+                lst = vals.tolist()
+                none_mask = np.fromiter(
+                    (v is None for v in lst), dtype=bool, count=n
+                )
+                nan_mask = np.fromiter(
+                    (isinstance(v, float) and v != v for v in lst),
+                    dtype=bool,
+                    count=n,
+                )
+                null = none_mask | nan_mask
+                nan_rows = np.nonzero(nan_mask)[0]
+            nn = ~null
+            try:
+                uniq, inv = np.unique(vals[nn], return_inverse=True)
+            except TypeError:
+                raise _WindowFallback from None
+            nv = len(uniq)
+            r = np.zeros(n, dtype=np.int64)
+            r[nn] = inv if sx.ascending else (nv - 1) - inv
+            # final null placement follows nulls_first regardless of
+            # direction (matching the comparator path's null_rank logic)
+            bucket = np.where(null, 0 if sx.nulls_first else 2, 1)
+            keys.append(bucket.astype(np.int64) * (nv + 1) + r)
+            tie = np.full(n, -1, dtype=np.int64)  # -1: the shared None tie
+            tie[nn] = inv
+            if nan_rows is not None and len(nan_rows):
+                tie[nan_rows] = -2 - nan_rows  # NaN: unique per row
+            ties.append(tie)
+        return keys, ties
+
     def eval(self, batch: RecordBatch) -> np.ndarray:
+        n = batch.num_rows
+        try:
+            pids = self._partition_ids(batch, n)
+            okeys, oties = self._order_keys_vec(batch, n)
+        except _WindowFallback:
+            return self._eval_python(batch)
+        # one stable lexsort: partition primary, order-by keys within —
+        # ties keep arrival order, exactly like the stable comparator sort
+        sidx = np.lexsort(tuple(reversed(okeys)) + (pids,))
+        ps = pids[sidx]
+        pstart = np.empty(n, dtype=bool)
+        pstart[:1] = True
+        pstart[1:] = ps[1:] != ps[:-1]
+        newk = pstart.copy()  # order-key change OR partition change
+        for t in oties:
+            tt = t[sidx]
+            newk[1:] |= tt[1:] != tt[:-1]
+        pb = np.nonzero(pstart)[0]
+        plens = np.diff(np.append(pb, n))
+        base = np.repeat(pb, plens)  # partition start per sorted position
+        karr = np.repeat(plens, plens)  # partition size per sorted position
+        j = np.arange(n) - base  # 0-based position within partition
+        w = self.wname
+        if w == "row_number":
+            res = j + 1
+        elif w == "rank":
+            res = (
+                np.maximum.accumulate(np.where(newk, np.arange(n), 0))
+                - base
+                + 1
+            )
+        elif w == "dense_rank":
+            c = np.cumsum(newk)
+            res = c - np.repeat(c[pb] - 1, plens)
+        elif w == "percent_rank":
+            rank = (
+                np.maximum.accumulate(np.where(newk, np.arange(n), 0))
+                - base
+                + 1
+            )
+            res = np.where(
+                karr > 1, (rank - 1) / np.maximum(karr - 1, 1), 0.0
+            )
+        elif w == "cume_dist":
+            tb = np.nonzero(newk)[0]
+            tlens = np.diff(np.append(tb, n))
+            tie_last = np.repeat(tb + tlens - 1, tlens)
+            res = (tie_last - base + 1) / karr
+        elif w == "ntile":
+            # SQL NTILE: the first (k mod n) buckets hold ceil(k/n) rows,
+            # the rest floor(k/n) — consecutive bucket ids even when
+            # rows < buckets
+            nb = int(self.params[0])
+            big = karr // nb + 1
+            r_big = karr % nb
+            small = np.maximum(karr // nb, 1)  # guarded: branch unused at k<nb
+            res = np.where(
+                j < r_big * big,
+                j // big + 1,
+                r_big + (j - r_big * big) // small + 1,
+            )
+        elif w in ("lead", "lag"):
+            offset, default = self.params
+            shift = offset if w == "lead" else -offset
+            vals = np.atleast_1d(self.args[0].eval(batch))
+            vs = vals[sidx]
+            src = j + shift
+            ok = (src >= 0) & (src < karr)
+            res = np.empty(n, dtype=object)
+            res[:] = default
+            res[ok] = vs[(np.arange(n) + shift)[ok]]
+        else:
+            raise PlanError(f"unknown window function {w!r}")
+        out = np.empty(n, dtype=object)
+        out[sidx] = res
+        # densify numeric results
+        try:
+            tight = np.asarray(out.tolist())
+            if tight.dtype.kind in "ifb":
+                return tight
+        except (ValueError, TypeError):
+            pass
+        return out
+
+    def _eval_python(self, batch: RecordBatch) -> np.ndarray:
+        """Comparator-based fallback for order/partition columns numpy
+        cannot sort (mixed non-comparable objects) — the pre-vectorization
+        implementation, kept verbatim."""
         n = batch.num_rows
         # partition ids
         if self.partition_by:
@@ -545,6 +711,10 @@ class WindowFunctionExpr(Expr):
 
     def __repr__(self):
         return self.name
+
+
+class _WindowFallback(Exception):
+    """Signal: this batch's keys need the comparator-based Python path."""
 
 
 class _SortKey:

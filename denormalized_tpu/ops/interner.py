@@ -87,6 +87,12 @@ def _load_native_lib():
         return None
 
 
+# canonical dict key for float NaN (nan != nan, so NaN itself can never be
+# found again in a dict); all NaNs intern to one id — the SQL
+# GROUP-BY-NULL convention, and what np.unique already does within a batch
+_NAN_KEY = ("__nan__",)
+
+
 class ColumnInterner:
     """value -> id for one column.
 
@@ -210,10 +216,16 @@ class ColumnInterner:
         to_id = self._to_id
         values = self._values
         for i, v in enumerate(uniq):
-            j = to_id.get(v)
+            # NaN needs a canonical dict key: np.unique collapses NaNs
+            # WITHIN a batch, but nan != nan so a plain dict lookup would
+            # mint a fresh id every batch — grouping would then depend on
+            # batch boundaries (review-found, pinned by
+            # test_nan_group_keys_form_one_session cross-batch case)
+            key = _NAN_KEY if isinstance(v, float) and v != v else v
+            j = to_id.get(key)
             if j is None:
                 j = len(values)
-                to_id[v] = j
+                to_id[key] = j
                 values.append(v)
             ids[i] = j
         return ids[inv]
@@ -252,9 +264,33 @@ class ColumnInterner:
             ids = self.intern_array(np.array(vals, dtype=object))
             assert ids.tolist() == list(range(len(vals))), "restore order"
         else:
-            # numeric (or no-native) columns live in the dict
+            # numeric (or no-native) columns live in the dict; NaN values
+            # re-key through the canonical NaN sentinel exactly like
+            # intern_array, or post-restore batches would re-mint NaN ids
             self._values = list(vals)
-            self._to_id = {v: i for i, v in enumerate(self._values)}
+            self._to_id = {
+                (_NAN_KEY if isinstance(v, float) and v != v else v): i
+                for i, v in enumerate(self._values)
+            }
+
+
+def _dedup_rows(per_col: list[np.ndarray]) -> tuple[list[tuple], np.ndarray]:
+    """Shared composite-key dedup: per-column id arrays → (unique row
+    tuples, inverse indices).  2 columns pack into one int64 for a 1-D
+    unique (much faster than np.unique(axis=0)'s void-view row sort);
+    single source of truth for GroupInterner AND RecyclingGroupInterner so
+    the packing can never diverge between them."""
+    if len(per_col) == 2:
+        packed = (per_col[0].astype(np.int64) << 32) | per_col[1].astype(
+            np.int64
+        )
+        uniq, inv = np.unique(packed, return_inverse=True)
+        rows = [(int(p >> 32), int(p & 0xFFFFFFFF)) for p in uniq.tolist()]
+    else:
+        stacked = np.stack(per_col, axis=1)
+        uniq_rows, inv = np.unique(stacked, axis=0, return_inverse=True)
+        rows = list(map(tuple, uniq_rows.tolist()))
+    return rows, inv
 
 
 class GroupInterner:
@@ -289,18 +325,7 @@ class GroupInterner:
             if n_now > n_known:
                 self._gid_rows.extend((i,) for i in range(n_known, n_now))
             return cids
-        if self.num_columns == 2:
-            # pack both int32 ids into one int64 → 1-D unique (much faster
-            # than np.unique(axis=0)'s void-view row sort)
-            packed = (per_col[0].astype(np.int64) << 32) | per_col[1].astype(
-                np.int64
-            )
-            uniq, inv = np.unique(packed, return_inverse=True)
-            rows = [(int(p >> 32), int(p & 0xFFFFFFFF)) for p in uniq.tolist()]
-        else:
-            stacked = np.stack(per_col, axis=1)
-            uniq_rows, inv = np.unique(stacked, axis=0, return_inverse=True)
-            rows = list(map(tuple, uniq_rows.tolist()))
+        rows, inv = _dedup_rows(per_col)
         gids_for_uniq = np.empty(len(rows), dtype=np.int32)
         for i, row in enumerate(rows):
             g = self._tuple_to_gid.get(row)
@@ -339,3 +364,100 @@ class GroupInterner:
         g._gid_rows = [tuple(r) for r in snap["rows"]]
         g._tuple_to_gid = {r: i for i, r in enumerate(g._gid_rows)}
         return g
+
+
+class RecyclingGroupInterner:
+    """Composite key -> dense group id WITH gid recycling.
+
+    Same ``intern``/``keys_of`` contract as :class:`GroupInterner`, plus
+    ``release(gids)``: a released gid goes onto a free list and is handed
+    to the next first-seen key, so the dense-id space stays proportional
+    to the number of LIVE keys rather than all keys ever seen.  Built for
+    the session operator, whose key population churns (a key with no open
+    session holds no state and its id can be reused); the window and join
+    interners keep gids forever because their ids index device buffers.
+
+    Two deliberate deviations from GroupInterner:
+
+    - no single-column ``cid == gid`` fast path — recycling breaks that
+      identity, so every shape goes through the packed-row dedup (still
+      O(uniques-per-batch) Python, the same bound as the multi-column
+      paths);
+    - per-COLUMN value ids (inside ColumnInterner) are never recycled:
+      they deduplicate values, and the composite-key cross product — the
+      thing that actually explodes at high key churn — is what the free
+      list caps.
+    """
+
+    def __init__(self, num_columns: int) -> None:
+        self.num_columns = num_columns
+        self._col_interners = [ColumnInterner() for _ in range(num_columns)]
+        self._row_to_gid: dict = {}
+        # per gid: tuple of per-column value ids, or None when freed
+        self._gid_rows: list[tuple | None] = []
+        self._free: list[int] = []
+
+    def __len__(self) -> int:
+        """Number of LIVE (unreleased) keys."""
+        return len(self._gid_rows) - len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Dense-id space size (live + free) — sizes gid-indexed arrays."""
+        return len(self._gid_rows)
+
+    def intern(self, key_columns: list[np.ndarray]) -> np.ndarray:
+        assert len(key_columns) == self.num_columns
+        per_col = [
+            it.intern_array(np.asarray(c))
+            for it, c in zip(self._col_interners, key_columns)
+        ]
+        if self.num_columns == 1:
+            # no cid==gid fast path here (recycling breaks the identity),
+            # but the dedup is still a single 1-D unique
+            uniq, inv = np.unique(per_col[0].astype(np.int64),
+                                  return_inverse=True)
+            rows = [(int(c),) for c in uniq.tolist()]
+        else:
+            rows, inv = _dedup_rows(per_col)
+        gids_for_uniq = np.empty(len(rows), dtype=np.int32)
+        row_to_gid = self._row_to_gid
+        gid_rows = self._gid_rows
+        free = self._free
+        for i, row in enumerate(rows):
+            g = row_to_gid.get(row)
+            if g is None:
+                if free:
+                    g = free.pop()
+                    gid_rows[g] = row
+                else:
+                    g = len(gid_rows)
+                    gid_rows.append(row)
+                row_to_gid[row] = g
+            gids_for_uniq[i] = g
+        return gids_for_uniq[inv]
+
+    def release(self, gids) -> None:
+        """Return gids to the free list (idempotent per gid).  The caller
+        guarantees no state remains keyed by a released gid."""
+        gid_rows = self._gid_rows
+        for g in np.asarray(gids).tolist():
+            row = gid_rows[g]
+            if row is None:
+                continue  # already free
+            del self._row_to_gid[row]
+            gid_rows[g] = None
+            self._free.append(g)
+
+    def keys_of(self, gids: np.ndarray) -> list[np.ndarray]:
+        """Reconstruct each key column's values for the given LIVE gids."""
+        rows = np.array(
+            [self._gid_rows[g] for g in np.asarray(gids).tolist()],
+            dtype=np.int64,
+        )
+        if len(rows) == 0:
+            rows = rows.reshape(0, self.num_columns)
+        return [
+            it.value_of(rows[:, c])
+            for c, it in enumerate(self._col_interners)
+        ]

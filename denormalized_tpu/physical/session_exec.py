@@ -1,4 +1,4 @@
-"""Session windows — per-key gap-separated windows.
+"""Session windows — per-key gap-separated windows, fully vectorized.
 
 The reference *declares* session windows (``StreamingWindowType::Session``,
 logical_plan/streaming_window.rs:69-74) but its operator hits ``todo!()`` at
@@ -7,16 +7,48 @@ implements them: a session for key k is a maximal run of events where
 consecutive timestamps are ≤ ``gap_ms`` apart; the window closes (and emits)
 when the watermark passes ``last_ts + gap_ms``.
 
-Sessions are data-dependent (no static window grid), so state lives host-side
-as per-key running aggregates — the correct tool for this shape: cardinality
-is per-open-session, updates are tiny merges of per-batch partials that numpy
-computes vectorized via sort+reduceat.  The dense fixed-grid hot path stays on
-TPU in StreamingWindowExec.
+Sessions are data-dependent (no static window grid), so state lives
+host-side — but "host-side" no longer means "Python objects".  The hot path
+is zero per-row Python for the built-in aggregates
+(count/sum/min/max/avg/stddev):
+
+1. group keys intern to dense gids through
+   :class:`~denormalized_tpu.ops.interner.RecyclingGroupInterner` (the same
+   native PyObject fast path the tumbling operator and the join use; closed
+   keys' gids recycle through a free list).  This also FIXES a correctness
+   bug of the pre-vectorization operator: its salted 64-bit ``hash(tuple)``
+   composite could collide and silently merge two distinct keys' segments —
+   dense interner ids cannot collide.
+2. per-batch segmenting is one lexsort by (gid, ts) + boundary scan, and ALL
+   segment partials (counts/sums/mins/maxs + masked Chan moment columns)
+   come out of single ``np.<ufunc>.reduceat`` passes — no Python loop over
+   segments, no per-segment objects.
+3. open sessions live in a :class:`~denormalized_tpu.ops.session_table
+   .SessionTable`: a StreamBox-HBM-style SoA slot store (flat numpy arrays
+   start/last/counts/sums/mins/maxs/means/m2s, per-gid chains like the
+   join's ``_SideState``, slot free list).  Merging a batch's boundary
+   segments into open sessions — including out-of-order bridges that fuse
+   several open sessions — is ONE combined interval-merge sweep: gather the
+   touched gids' open sessions, sort the union with the new segments by
+   (gid, start), find merged runs with a segmented running max
+   (``start − runmax(last) > gap`` starts a run), fold each run with
+   reduceat, scatter back.  Watermark close/emit is a vectorized scan of
+   the live slots.
+4. the late-row salvage path keeps its per-row arrival-order semantics but
+   only rows whose KEY has a candidate open interval walk it; every other
+   row stays on the vectorized path.
+
+UDAF/collection aggregates keep the accumulator-per-segment contract (user
+code is inherently per-segment Python); they ride the same segmenting and
+the same SoA store, with their accumulators in a slot-keyed side dict.
+
+The pre-vectorization operator is preserved verbatim as
+``physical/session_reference.py`` (``DENORMALIZED_SESSION_REFERENCE=1``
+selects it) and serves as the differential oracle.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
 from typing import Iterator
 
 import numpy as np
@@ -30,6 +62,8 @@ from denormalized_tpu.common.errors import PlanError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.logical.expr import AggregateExpr, Expr
+from denormalized_tpu.ops.interner import RecyclingGroupInterner
+from denormalized_tpu.ops.session_table import SessionTable
 from denormalized_tpu.physical.base import (
     EOS,
     EndOfStream,
@@ -40,28 +74,29 @@ from denormalized_tpu.physical.base import (
 )
 
 
-@dataclass
-class _Agg:
-    """Mergeable running aggregate for one session.  Variance uses
-    Welford/Chan moments (means/m2s) — numerically stable at any value
-    magnitude, merged exactly by ``segment_agg.chan_merge``."""
-
-    count: int = 0
-    counts: list[int] = field(default_factory=list)  # per value col
-    sums: list[float] = field(default_factory=list)
-    mins: list[float] = field(default_factory=list)
-    maxs: list[float] = field(default_factory=list)
-    means: list[float] = field(default_factory=list)
-    m2s: list[float] = field(default_factory=list)
-
-
-@dataclass
-class _Session:
-    start: int
-    last: int
-    agg: _Agg
-    # one Accumulator per UDAF/collection aggregate (None when none exist)
-    accs: list | None = None
+def _segmented_cummax(vals: np.ndarray, seg_start: np.ndarray) -> np.ndarray:
+    """Inclusive cumulative max of ``vals`` within segments whose first
+    elements are flagged by ``seg_start``.  Offset trick: key each value as
+    ``seg_id * stride + (v - min)`` so one ``np.maximum.accumulate`` can
+    never carry a maximum across a segment boundary (every later segment's
+    keys exceed every earlier segment's).  Falls back to a per-segment loop
+    in the (practically unreachable) case the keyed range would overflow
+    int64."""
+    n = len(vals)
+    if n == 0:
+        return vals.copy()
+    seg_id = np.cumsum(seg_start, dtype=np.int64) - 1
+    base = int(vals.min())
+    r = vals.astype(np.int64) - base
+    stride = int(r.max()) + 1
+    if int(seg_id[-1] + 1) * stride < 2**62:
+        off = seg_id * stride
+        return np.maximum.accumulate(off + r) - off + base
+    out = np.empty_like(vals)
+    bounds = np.nonzero(seg_start)[0]
+    for b0, b1 in zip(bounds, np.append(bounds[1:], n)):
+        out[b0:b1] = np.maximum.accumulate(vals[b0:b1])
+    return out
 
 
 class SessionWindowExec(ExecOperator):
@@ -119,14 +154,19 @@ class SessionWindowExec(ExecOperator):
         ]
         self.schema = Schema(fields)
 
-        # per key: open sessions sorted by start (usually exactly one)
-        self._sessions: dict[tuple, list[_Session]] = {}
+        self._interner = RecyclingGroupInterner(len(self.group_exprs))
+        self._table = SessionTable(len(self._value_exprs))
         self._watermark: int | None = None
         # True once a kind="partition" hint arrived: batch min-ts no
         # longer advances the watermark (replay-skew safety)
         self._src_watermarks = False
         self._ckpt: tuple | None = None
-        self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
+        self._metrics = {
+            "rows_in": 0,
+            "sessions_emitted": 0,
+            "late_rows": 0,
+            "salvage_rows_scanned": 0,
+        }
 
     @property
     def children(self):
@@ -147,76 +187,64 @@ class SessionWindowExec(ExecOperator):
             return None
         return [a.udaf.make() for a in self._udafs]
 
-    # ------------------------------------------------------------------
-    @staticmethod
-    def _merge_agg(a: _Agg, p: _Agg) -> None:
-        from denormalized_tpu.ops.segment_agg import chan_merge
+    # -- late-row salvage (the ONLY per-row path; scoped to keys with a
+    # -- candidate open interval) --------------------------------------
+    def _salvage_late(
+        self, ts: np.ndarray, gids: np.ndarray, late: np.ndarray
+    ) -> np.ndarray:
+        """Decide per-row, in ARRIVAL order, which late rows merge into a
+        still-open (or this-batch-created) session of their key — exactly
+        as row-at-a-time processing would (Flink event-time session
+        semantics: a late row within gap of an open session belongs to it;
+        only true closed singletons drop).  Returns the updated ``late``
+        mask.  Only rows whose key has at least one late row this batch
+        walk the loop; all other rows never leave the vectorized path."""
+        gap_ms = self.gap_ms
+        T = self._table
+        aff_gids = np.unique(gids[late])
+        # interval views of the affected keys' open sessions
+        views: dict[int, list[list[int]]] = {int(g): [] for g in aff_gids}
+        slots, owner = T.open_slots_of(aff_gids)
+        starts = T.start[slots]
+        lasts = T.last[slots]
+        for i, pos in enumerate(owner.tolist()):
+            views[int(aff_gids[pos])].append([int(starts[i]), int(lasts[i])])
+        aff_mask = np.zeros(self._interner.capacity, dtype=bool)
+        aff_mask[aff_gids] = True
+        rows = np.nonzero(aff_mask[gids])[0]
+        self._metrics["salvage_rows_scanned"] += len(rows)
+        late = late.copy()
+        for i in rows.tolist():
+            iv_list = views[int(gids[i])]
+            t = int(ts[i])
+            hit = [
+                iv
+                for iv in iv_list
+                if t - iv[1] <= gap_ms and iv[0] - t <= gap_ms
+            ]
+            if late[i]:
+                if not hit:
+                    continue  # true closed singleton: stays dropped
+                late[i] = False
+            merged = [
+                min([t] + [iv[0] for iv in hit]),
+                max([t] + [iv[1] for iv in hit]),
+            ]
+            views[int(gids[i])] = [
+                iv for iv in iv_list if iv not in hit
+            ] + [merged]
+        return late
 
-        a.count += p.count
-        for i in range(len(a.sums)):
-            _, a.means[i], a.m2s[i] = chan_merge(
-                a.counts[i], a.means[i], a.m2s[i],
-                p.counts[i], p.means[i], p.m2s[i],
-            )
-            a.counts[i] += p.counts[i]
-            a.sums[i] += p.sums[i]
-            a.mins[i] = min(a.mins[i], p.mins[i])
-            a.maxs[i] = max(a.maxs[i], p.maxs[i])
-
-    def _merge_rows(
-        self,
-        key: tuple,
-        ts_sorted: np.ndarray,
-        partial: _Agg,
-        partial_accs: list | None = None,
-    ):
-        """Merge one batch segment [first, last] into the per-key OPEN
-        session set.  Sessions stay open until the watermark passes
-        ``last + gap`` — closing on gap-at-arrival would mis-split
-        out-of-order data, so a segment may bridge (merge) several open
-        sessions (standard event-time session-merge)."""
-        first, last = int(ts_sorted[0]), int(ts_sorted[-1])
-        open_list = self._sessions.setdefault(key, [])
-        keep: list[_Session] = []
-        hits: list[_Session] = []
-        for s in open_list:
-            # within-gap overlap in either direction → merge
-            if first - s.last <= self.gap_ms and s.start - last <= self.gap_ms:
-                hits.append(s)
-            else:
-                keep.append(s)
-        if not hits:
-            keep.append(_Session(first, last, partial, partial_accs))
-        else:
-            # the OLDEST session is the merge base and the new partial folds
-            # in LAST: order-sensitive accumulators (first/last_value,
-            # array_agg) keep arrival order, and the per-batch merge copies
-            # only the new partial's state — not the session's accumulated
-            # state — so long sessions stay O(rows), not quadratic
-            hits.sort(key=lambda s: s.start)
-            base = hits[0]
-            for s in hits[1:]:
-                self._merge_agg(base.agg, s.agg)
-                if base.accs is not None:
-                    for acc, other in zip(base.accs, s.accs):
-                        acc.merge(other.state())
-            self._merge_agg(base.agg, partial)
-            if base.accs is not None and partial_accs is not None:
-                for acc, p in zip(base.accs, partial_accs):
-                    acc.merge(p.state())
-            base.start = min(base.start, first)
-            base.last = max(base.last, last, *(s.last for s in hits[1:]))
-            keep.append(base)
-        keep.sort(key=lambda s: s.start)
-        self._sessions[key] = keep
-
+    # -- vectorized batch path ------------------------------------------
     def _process_batch(self, batch: RecordBatch) -> Iterator[RecordBatch]:
         n = batch.num_rows
         if n == 0:
             return
         self._metrics["rows_in"] += n
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
-        key_cols = [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
+        key_cols = [g.eval(batch) for g in self.group_exprs]
+        gids = self._interner.intern(key_cols)
+        self._table.ensure_gids(self._interner.capacity)
         vals = (
             np.stack(
                 [np.asarray(e.eval(batch), dtype=np.float64) for e in self._value_exprs],
@@ -248,58 +276,18 @@ class SessionWindowExec(ExecOperator):
         # watermark and mis-drop later on-time rows
         raw_min = int(ts.min())
 
-        # late rows: a row with ts+gap <= watermark would close as a
-        # singleton — but if it lies within gap of a STILL-OPEN session for
-        # its key it belongs to that session (Flink event-time session
-        # semantics: the merged session closes later).  So salvage
-        # open-session-mergeable rows and drop only true closed singletons.
+        dropped_gids: np.ndarray | None = None
         if self._watermark is not None:
             late = ts + self.gap_ms <= self._watermark
             if late.any():
-                # decide per-row in ARRIVAL order against a live interval
-                # view that also tracks this batch's on-time rows for the
-                # affected keys: an earlier row (late or on-time) can extend
-                # a session into range of a later late row, exactly as
-                # row-at-a-time processing would.  Kept rows then flow
-                # through the normal segment/merge machinery, which
-                # reproduces the same merged aggregates.
-                gap_ms = self.gap_ms
-                late_keys = {
-                    tuple(kc[i] for kc in key_cols)
-                    for i in np.nonzero(late)[0]
-                }
-                views = {
-                    k: [[s.start, s.last] for s in self._sessions.get(k, ())]
-                    for k in late_keys
-                }
-                for i in range(n):
-                    key = tuple(kc[i] for kc in key_cols)
-                    iv_list = views.get(key)
-                    if iv_list is None:
-                        continue
-                    t = int(ts[i])
-                    hit = [
-                        iv
-                        for iv in iv_list
-                        if t - iv[1] <= gap_ms and iv[0] - t <= gap_ms
-                    ]
-                    if late[i]:
-                        if not hit:
-                            continue  # true closed singleton: stays dropped
-                        late[i] = False
-                    merged = [
-                        min([t] + [iv[0] for iv in hit]),
-                        max([t] + [iv[1] for iv in hit]),
-                    ]
-                    views[key] = [
-                        iv for iv in iv_list if iv not in hit
-                    ] + [merged]
+                late = self._salvage_late(ts, gids, late)
             n_late = int(late.sum())
             if n_late:
                 self._metrics["late_rows"] += n_late
+                dropped_gids = np.unique(gids[late])
                 keep = ~late
                 ts = ts[keep]
-                key_cols = [kc[keep] for kc in key_cols]
+                gids = gids[keep]
                 vals = vals[keep]
                 valid = valid[keep]
                 udaf_cols = [[c[keep] for c in cols] for cols in udaf_cols]
@@ -307,164 +295,322 @@ class SessionWindowExec(ExecOperator):
                     m[keep] if m is not None else None for m in udaf_masks
                 ]
                 n = len(ts)
-                if n == 0:
-                    return
 
-        # vectorized per-key segmenting: sort by (key, ts), then reduceat over
-        # key-run + intra-batch gap boundaries
-        composite = np.fromiter(
-            (hash(tuple(kc[i] for kc in key_cols)) for i in range(n)),
-            dtype=np.int64,
-            count=n,
-        )
-        order = np.lexsort((ts, composite))
-        ts_s = ts[order]
-        comp_s = composite[order]
-        vals_s = vals[order]
-        valid_s = valid[order]
-        key_rows = [kc[order] for kc in key_cols]
-        # boundaries: new key run or gap within same key
-        newkey = np.empty(n, dtype=bool)
-        newkey[0] = True
-        newkey[1:] = comp_s[1:] != comp_s[:-1]
-        gap = np.empty(n, dtype=bool)
-        gap[0] = True
-        gap[1:] = (ts_s[1:] - ts_s[:-1]) > self.gap_ms
-        bounds = np.nonzero(newkey | gap)[0]
-        ends = np.append(bounds[1:], n)
-        for b0, b1 in zip(bounds, ends):
-            key = tuple(kr[b0] for kr in key_rows)
-            seg_vals = vals_s[b0:b1]
-            seg_valid = valid_s[b0:b1]
+        if n:
+            # vectorized per-key segmenting: sort by (gid, ts), then one
+            # reduceat per aggregate primitive over key-run + intra-batch
+            # gap boundaries
+            order = np.lexsort((ts, gids))
+            ts_s = ts[order]
+            g_s = gids[order]
+            vals_s = vals[order]
+            valid_s = valid[order]
+            boundary = np.empty(n, dtype=bool)
+            boundary[0] = True
+            boundary[1:] = (g_s[1:] != g_s[:-1]) | (
+                (ts_s[1:] - ts_s[:-1]) > self.gap_ms
+            )
+            bounds = np.nonzero(boundary)[0]
+            lens = np.diff(np.append(bounds, n))
+            seg_gid = g_s[bounds].astype(np.int64)
+            seg_first = ts_s[bounds]
+            seg_last = ts_s[np.append(bounds[1:], n) - 1]
+            seg_rows = lens.astype(np.int64)
             # null-neutralize per aggregate kind (same semantics as the
             # device kernel: nulls excluded from count/sum/min/max)
-            seg_counts = seg_valid.sum(axis=0)
-            seg_sums = np.where(seg_valid, seg_vals, 0.0).sum(axis=0)
+            seg_counts = np.add.reduceat(
+                valid_s.astype(np.int64), bounds, axis=0
+            )
+            seg_sums = np.add.reduceat(
+                np.where(valid_s, vals_s, 0.0), bounds, axis=0
+            )
+            seg_mins = np.minimum.reduceat(
+                np.where(valid_s, vals_s, np.inf), bounds, axis=0
+            )
+            seg_maxs = np.maximum.reduceat(
+                np.where(valid_s, vals_s, -np.inf), bounds, axis=0
+            )
             with np.errstate(invalid="ignore", divide="ignore"):
                 seg_means = np.where(
-                    seg_counts > 0, seg_sums / np.maximum(seg_counts, 1), 0.0
+                    seg_counts > 0,
+                    seg_sums / np.maximum(seg_counts, 1),
+                    0.0,
                 )
-                seg_m2s = np.where(
-                    seg_valid, (seg_vals - seg_means) ** 2, 0.0
-                ).sum(axis=0)
-            partial = _Agg(
-                count=int(b1 - b0),
-                counts=[int(c) for c in seg_counts],
-                sums=[float(s) for s in seg_sums],
-                mins=[
-                    float(s)
-                    for s in np.where(seg_valid, seg_vals, np.inf).min(axis=0)
-                ],
-                maxs=[
-                    float(s)
-                    for s in np.where(seg_valid, seg_vals, -np.inf).max(axis=0)
-                ],
-                means=[float(m) for m in seg_means],
-                m2s=[float(m) for m in seg_m2s],
+            centered = vals_s - np.repeat(seg_means, lens, axis=0)
+            seg_m2s = np.add.reduceat(
+                np.where(valid_s, centered * centered, 0.0), bounds, axis=0
             )
-            partial_accs = self._make_accs()
-            if partial_accs is not None:
-                seg_rows = order[b0:b1]
-                for acc, cols, am in zip(partial_accs, udaf_cols, udaf_masks):
-                    chunk = [c[seg_rows] for c in cols]
-                    if am is not None:
-                        ok = am[seg_rows]
-                        chunk = [c[ok] for c in chunk]
-                    acc.update(*chunk)
-            self._merge_rows(key, ts_s[b0:b1], partial, partial_accs)
+            seg_accs = None
+            if self._udafs:
+                # accumulator-per-segment contract: user code runs once per
+                # (key, segment) — inherently Python, and only here
+                seg_accs = []
+                for b0, b1 in zip(bounds.tolist(), np.append(bounds[1:], n).tolist()):
+                    accs = self._make_accs()
+                    seg_idx = order[b0:b1]
+                    for acc, cols, am in zip(accs, udaf_cols, udaf_masks):
+                        chunk = [c[seg_idx] for c in cols]
+                        if am is not None:
+                            ok = am[seg_idx]
+                            chunk = [c[ok] for c in chunk]
+                        acc.update(*chunk)
+                    seg_accs.append(accs)
+            self._merge_segments(
+                seg_gid, seg_first, seg_last, seg_rows, seg_counts,
+                seg_sums, seg_mins, seg_maxs, seg_means, seg_m2s, seg_accs,
+            )
 
         # watermark advance + close expired sessions — skipped under
         # per-partition watermarks: the authoritative advance arrives as
         # a kind="partition" hint right after this batch
         if not self._src_watermarks:
             yield from self._advance_and_close(raw_min)
+        if dropped_gids is not None:
+            # a key whose only-ever rows were dropped-late holds no state:
+            # recycle its gid immediately instead of leaking it
+            idle = dropped_gids[self._table.head[dropped_gids] == -1]
+            if len(idle):
+                self._interner.release(idle)
 
+    def _merge_segments(
+        self,
+        seg_gid: np.ndarray,
+        seg_first: np.ndarray,
+        seg_last: np.ndarray,
+        seg_rows: np.ndarray,
+        seg_counts: np.ndarray,
+        seg_sums: np.ndarray,
+        seg_mins: np.ndarray,
+        seg_maxs: np.ndarray,
+        seg_means: np.ndarray,
+        seg_m2s: np.ndarray,
+        seg_accs: list | None,
+    ) -> None:
+        """One combined interval-merge sweep: union the touched gids' open
+        sessions with the batch segments, sort by (gid, start), split into
+        merged runs where ``start − running_max(last) > gap`` (sessions
+        stay open until the watermark passes ``last + gap`` — closing on
+        gap-at-arrival would mis-split out-of-order data, so a segment may
+        bridge several open sessions), fold every run with reduceat, and
+        scatter the merged sessions back into the SoA table."""
+        T = self._table
+        S = len(seg_gid)
+        touched = np.unique(seg_gid)
+        ex_slots, ex_owner = T.open_slots_of(touched)
+        E = len(ex_slots)
+        M = E + S
+        cg = np.concatenate([touched[ex_owner], seg_gid])
+        cstart = np.concatenate([T.start[ex_slots], seg_first])
+        clast = np.concatenate([T.last[ex_slots], seg_last])
+        cnew = np.zeros(M, dtype=bool)
+        cnew[E:] = True
+        # tie-break (cnew last): at equal start the EXISTING session sorts
+        # first — order-sensitive accumulator folds keep arrival order
+        order = np.lexsort((cnew, cstart, cg))
+        g2 = cg[order]
+        st2 = cstart[order]
+        la2 = clast[order]
+        newg = np.empty(M, dtype=bool)
+        newg[0] = True
+        newg[1:] = g2[1:] != g2[:-1]
+        runmax = _segmented_cummax(la2, newg)
+        boundary = newg.copy()
+        boundary[1:] |= (st2[1:] - runmax[:-1]) > self.gap_ms
+        rb = np.nonzero(boundary)[0]
+        runlens = np.diff(np.append(rb, M))
+        crow = np.concatenate([T.row_count[ex_slots], seg_rows])[order]
+        ccnt = np.concatenate([T.counts[ex_slots], seg_counts], axis=0)[order]
+        csum = np.concatenate([T.sums[ex_slots], seg_sums], axis=0)[order]
+        cmin = np.concatenate([T.mins[ex_slots], seg_mins], axis=0)[order]
+        cmax = np.concatenate([T.maxs[ex_slots], seg_maxs], axis=0)[order]
+        cmean = np.concatenate([T.means[ex_slots], seg_means], axis=0)[order]
+        cm2 = np.concatenate([T.m2s[ex_slots], seg_m2s], axis=0)[order]
+        out_gid = g2[rb]
+        out_start = st2[rb]
+        out_last = np.maximum.reduceat(la2, rb)
+        out_row = np.add.reduceat(crow, rb)
+        out_cnt = np.add.reduceat(ccnt, rb, axis=0)
+        out_sum = np.add.reduceat(csum, rb, axis=0)
+        out_min = np.minimum.reduceat(cmin, rb, axis=0)
+        out_max = np.maximum.reduceat(cmax, rb, axis=0)
+        # k-way Chan moment combine (exact algebra of chan_merge):
+        # M2 = Σ m2_i + Σ n_i (μ_i − μ)²  with  μ = Σ n_i μ_i / Σ n_i
+        cntf = ccnt.astype(np.float64)
+        wmean = np.add.reduceat(cntf * cmean, rb, axis=0)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out_mean = np.where(
+                out_cnt > 0, wmean / np.maximum(out_cnt, 1), 0.0
+            )
+        centered = cmean - np.repeat(out_mean, runlens, axis=0)
+        out_m2 = np.add.reduceat(cm2 + cntf * centered * centered, rb, axis=0)
+        single = runlens == 1
+        if single.any():
+            # identity folds must not re-round a stored moment pair
+            out_mean[single] = cmean[rb[single]]
+            out_m2[single] = cm2[rb[single]]
+        new_accs = None
+        if self._udafs:
+            # per-RUN accumulator fold (runs only; Python is unavoidable —
+            # accumulator state is opaque user code).  Order-sensitive
+            # accumulators (first/last_value, array_agg) must see EXACTLY
+            # the fold order of sequential processing, including the quirk
+            # that a mid-batch merge can lower a session's start and change
+            # which member is the next merge's base — so replay the
+            # reference algorithm per run: for each new segment in ts
+            # order, merge its within-gap hits base-oldest-first, then the
+            # segment's own partial last.
+            cref = np.concatenate(
+                [ex_slots, -np.arange(1, S + 1, dtype=np.int64)]
+            )[order]
+            cnew2 = cnew[order]
+            new_accs = []
+            for b0, b1 in zip(rb.tolist(), np.append(rb[1:], M).tolist()):
+                refs = cref[b0:b1]
+                news = cnew2[b0:b1]
+                # live mini-set of [start, last, accs] for this run;
+                # existing sessions seed it (they are pairwise >gap apart)
+                sess = [
+                    [int(st2[b0 + i]), int(la2[b0 + i]),
+                     T.accs.pop(int(refs[i]))]
+                    for i in range(b1 - b0)
+                    if not news[i]
+                ]
+                for i in range(b1 - b0):
+                    if not news[i]:
+                        continue
+                    first = int(st2[b0 + i])
+                    last = int(la2[b0 + i])
+                    part = seg_accs[-int(refs[i]) - 1]
+                    hits = [
+                        s for s in sess
+                        if first - s[1] <= self.gap_ms
+                        and s[0] - last <= self.gap_ms
+                    ]
+                    if not hits:
+                        sess.append([first, last, part])
+                        continue
+                    hits.sort(key=lambda s: s[0])
+                    base = hits[0]
+                    for s in hits[1:]:
+                        for acc, other in zip(base[2], s[2]):
+                            acc.merge(other.state())
+                    for acc, p in zip(base[2], part):
+                        acc.merge(p.state())
+                    base[0] = min(base[0], first)
+                    base[1] = max([last] + [s[1] for s in hits])
+                    sess = [s for s in sess if s not in hits[1:]]
+                # the run IS one merged session (transitive closure), so
+                # exactly one survivor remains; fold defensively if not
+                accs = sess[0][2]
+                for s in sess[1:]:  # pragma: no cover — unreachable
+                    for acc, other in zip(accs, s[2]):
+                        acc.merge(other.state())
+                new_accs.append(accs)
+        # scatter back: every touched gid's open set is rewritten wholesale
+        T.free(ex_slots)
+        T.head[touched] = -1
+        slots = T.alloc(len(rb))
+        T.start[slots] = out_start
+        T.last[slots] = out_last
+        T.row_count[slots] = out_row
+        T.counts[slots] = out_cnt
+        T.sums[slots] = out_sum
+        T.mins[slots] = out_min
+        T.maxs[slots] = out_max
+        T.means[slots] = out_mean
+        T.m2s[slots] = out_m2
+        T.gid[slots] = out_gid
+        T.live[slots] = True
+        T.chain(out_gid, slots)
+        if new_accs is not None:
+            for s, a in zip(slots.tolist(), new_accs):
+                T.accs[int(s)] = a
+
+    # -- close + emit ----------------------------------------------------
     def _advance_and_close(self, candidate_wm: int) -> Iterator[RecordBatch]:
         """Monotonic watermark advance, then emit every session whose gap
         has expired — shared by the per-batch path and idle-source
-        WatermarkHint handling."""
+        WatermarkHint handling.  One vectorized scan of the live slots."""
         if self._watermark is None or candidate_wm > self._watermark:
             self._watermark = candidate_wm
-        closed: list[tuple[tuple, _Session]] = []
-        for k in list(self._sessions):
-            still: list[_Session] = []
-            for s in self._sessions[k]:
-                if s.last + self.gap_ms <= self._watermark:
-                    closed.append((k, s))
-                else:
-                    still.append(s)
-            if still:
-                self._sessions[k] = still
-            else:
-                del self._sessions[k]
-        if closed:
-            yield self._emit(closed)
+        expired = self._table.expired_slots(self.gap_ms, self._watermark)
+        if len(expired) == 0:
+            return
+        order = np.lexsort(
+            (self._table.gid[expired], self._table.start[expired])
+        )
+        expired = expired[order]
+        out = self._emit_slots(expired)
+        freed = self._table.remove_slots(expired)
+        if len(freed):
+            # closed keys' dense ids go back to the interner free list
+            self._interner.release(freed)
+        yield out
 
-    def _emit(self, closed: list[tuple[tuple, _Session]]) -> RecordBatch:
-        self._metrics["sessions_emitted"] += len(closed)
-        m = len(closed)
-        cols: list[np.ndarray] = []
+    def _emit_slots(self, slots: np.ndarray) -> RecordBatch:
+        T = self._table
+        m = len(slots)
+        self._metrics["sessions_emitted"] += m
         in_schema = self.input_op.schema
+        key_vals = self._interner.keys_of(T.gid[slots])
+        cols: list[np.ndarray] = []
         for ci, g in enumerate(self.group_exprs):
             f = g.out_field(in_schema)
-            vals = np.array([k[ci] for k, _ in closed], dtype=object)
+            vals = np.asarray(key_vals[ci], dtype=object)
             if f.dtype.is_numeric:
                 vals = vals.astype(f.dtype.to_numpy())
             cols.append(vals)
         from denormalized_tpu.ops.segment_agg import VAR_KINDS, variance_from_m2
 
-        for ai, spec in enumerate(self._agg_specs):
-            kind, col_i = spec[0], spec[1]
-            if kind == "udaf":
-                vals_out = [s.accs[col_i].evaluate() for _, s in closed]
-                arr = np.empty(len(vals_out), dtype=object)
-                for vi, v in enumerate(vals_out):
-                    arr[vi] = v
-                f = self.aggr_exprs[ai].out_field(self.input_op.schema)
-                if f.dtype.is_numeric:
-                    arr = arr.astype(f.dtype.to_numpy())
-                cols.append(arr)
-            elif kind in VAR_KINDS:
-                cols.append(
-                    variance_from_m2(
-                        kind,
-                        np.array([s.agg.counts[col_i] for _, s in closed]),
-                        np.array([s.agg.m2s[col_i] for _, s in closed]),
+        with np.errstate(invalid="ignore", divide="ignore"):
+            for ai, spec in enumerate(self._agg_specs):
+                kind, col_i = spec[0], spec[1]
+                if kind == "udaf":
+                    vals_out = [
+                        T.accs[int(s)][col_i].evaluate() for s in slots.tolist()
+                    ]
+                    arr = np.empty(m, dtype=object)
+                    for vi, v in enumerate(vals_out):
+                        arr[vi] = v
+                    f = self.aggr_exprs[ai].out_field(in_schema)
+                    if f.dtype.is_numeric:
+                        arr = arr.astype(f.dtype.to_numpy())
+                    cols.append(arr)
+                elif kind in VAR_KINDS:
+                    cols.append(
+                        variance_from_m2(
+                            kind, T.counts[slots, col_i], T.m2s[slots, col_i]
+                        )
                     )
-                )
-            elif kind == "count":
-                cols.append(
-                    np.array(
-                        [
-                            s.agg.count if col_i is None else s.agg.counts[col_i]
-                            for _, s in closed
-                        ],
-                        dtype=np.int64,
+                elif kind == "count":
+                    cols.append(
+                        (
+                            T.row_count[slots]
+                            if col_i is None
+                            else T.counts[slots, col_i]
+                        ).astype(np.int64)
                     )
-                )
-            elif kind == "sum":
-                cols.append(np.array([s.agg.sums[col_i] for _, s in closed]))
-            elif kind == "avg":
-                cols.append(
-                    np.array(
-                        [
-                            s.agg.sums[col_i] / s.agg.counts[col_i]
-                            if s.agg.counts[col_i]
-                            else np.nan
-                            for _, s in closed
-                        ]
+                elif kind == "sum":
+                    cols.append(T.sums[slots, col_i].copy())
+                elif kind == "avg":
+                    c = T.counts[slots, col_i]
+                    cols.append(
+                        np.where(
+                            c > 0,
+                            T.sums[slots, col_i] / np.maximum(c, 1),
+                            np.nan,
+                        )
                     )
-                )
-            elif kind == "min":
-                v = np.array([s.agg.mins[col_i] for _, s in closed])
-                cols.append(np.where(np.isposinf(v), np.nan, v))
-            elif kind == "max":
-                v = np.array([s.agg.maxs[col_i] for _, s in closed])
-                cols.append(np.where(np.isneginf(v), np.nan, v))
-            else:
-                raise PlanError(f"session window does not support {kind}")
-        starts = np.array([s.start for _, s in closed], dtype=np.int64)
-        ends = np.array([s.last + self.gap_ms for _, s in closed], dtype=np.int64)
+                elif kind == "min":
+                    v = T.mins[slots, col_i]
+                    cols.append(np.where(np.isposinf(v), np.nan, v))
+                elif kind == "max":
+                    v = T.maxs[slots, col_i]
+                    cols.append(np.where(np.isneginf(v), np.nan, v))
+                else:
+                    raise PlanError(f"session window does not support {kind}")
+        starts = T.start[slots].astype(np.int64)
+        ends = (T.last[slots] + self.gap_ms).astype(np.int64)
         # cast agg outputs to declared dtypes
         out_cols = []
         for f, c in zip(self.schema.fields[: len(cols)], cols):
@@ -474,7 +620,8 @@ class SessionWindowExec(ExecOperator):
         out_cols += [starts, ends, starts.copy()]
         return RecordBatch(self.schema, out_cols)
 
-    # -- checkpointing (host dict state → JSON blob) ----------------------
+    # -- checkpointing (SoA store → the dict-era JSON blob, unchanged
+    # -- format: snapshots interoperate with the reference operator) ------
     def enable_checkpointing(self, node_id: str, coord, orch) -> None:
         from denormalized_tpu.state.checkpoint import get_json
 
@@ -483,49 +630,81 @@ class SessionWindowExec(ExecOperator):
         if snap is None:
             return
         self._watermark = snap["watermark"]
-        self._sessions = {}
-        for entry in snap["sessions"]:
+        self._restore_sessions(snap["sessions"])
+
+    def _restore_sessions(self, entries: list) -> None:
+        self._interner = RecyclingGroupInterner(len(self.group_exprs))
+        self._table = SessionTable(len(self._value_exprs))
+        if not entries:
+            return
+        key_cols = []
+        for c in range(len(self.group_exprs)):
+            lst = [e[0][c] for e in entries]
+            arr = np.asarray(lst)
+            if arr.dtype.kind not in "ifbM":
+                # strings (or mixed objects): rebuild from the ORIGINAL
+                # values — np.asarray may have stringified them
+                arr = np.empty(len(lst), dtype=object)
+                arr[:] = lst
+            key_cols.append(arr)
+        gids = self._interner.intern(key_cols)
+        T = self._table
+        T.ensure_gids(self._interner.capacity)
+        slots = T.alloc(len(entries))
+        V = len(self._value_exprs)
+        for i, entry in enumerate(entries):
+            slot = int(slots[i])
             key_list, start, last, agg = entry[:4]
             acc_states = entry[4] if len(entry) > 4 else None
+            T.start[slot] = start
+            T.last[slot] = last
+            T.row_count[slot] = agg["count"]
+            T.counts[slot] = agg["counts"]
+            T.sums[slot] = agg["sums"]
+            T.mins[slot] = agg["mins"]
+            T.maxs[slot] = agg["maxs"]
+            T.means[slot] = agg.get("means", [0.0] * V)
+            T.m2s[slot] = agg.get("m2s", [0.0] * V)
+            T.gid[slot] = gids[i]
+            T.live[slot] = True
             accs = self._make_accs()
-            if accs is not None and acc_states is not None:
-                for acc, st in zip(accs, acc_states):
-                    acc.merge(st)
-            s = _Session(
-                start,
-                last,
-                _Agg(
-                    count=agg["count"],
-                    counts=list(agg["counts"]),
-                    sums=list(agg["sums"]),
-                    mins=list(agg["mins"]),
-                    maxs=list(agg["maxs"]),
-                    means=list(agg.get("means", [0.0] * len(agg["sums"]))),
-                    m2s=list(agg.get("m2s", [0.0] * len(agg["sums"]))),
-                ),
-                accs,
-            )
-            self._sessions.setdefault(tuple(key_list), []).append(s)
+            if accs is not None:
+                if acc_states is not None:
+                    for acc, st in zip(accs, acc_states):
+                        acc.merge(st)
+                T.accs[slot] = accs
+        T.chain(gids.astype(np.int64), slots)
 
     def _snapshot(self, epoch: int) -> None:
         from denormalized_tpu.state.checkpoint import put_json
 
         coord, key = self._ckpt
-        sessions = [
-            [list(k), s.start, s.last,
-             {
-                 "count": s.agg.count,
-                 "counts": s.agg.counts,
-                 "sums": s.agg.sums,
-                 "mins": [float(m) for m in s.agg.mins],
-                 "maxs": [float(m) for m in s.agg.maxs],
-                 "means": [float(m) for m in s.agg.means],
-                 "m2s": [float(m) for m in s.agg.m2s],
-             },
-             [acc.state() for acc in s.accs] if s.accs is not None else None]
-            for k, lst in self._sessions.items()
-            for s in lst
-        ]
+        T = self._table
+        live = T.live_slots()
+        order = np.lexsort((T.gid[live], T.start[live]))
+        live = live[order]
+        key_cols = self._interner.keys_of(T.gid[live])
+        sessions = []
+        for i, s in enumerate(live.tolist()):
+            sessions.append(
+                [
+                    [key_cols[c][i] for c in range(len(key_cols))],
+                    int(T.start[s]),
+                    int(T.last[s]),
+                    {
+                        "count": int(T.row_count[s]),
+                        "counts": [int(x) for x in T.counts[s]],
+                        "sums": [float(x) for x in T.sums[s]],
+                        "mins": [float(x) for x in T.mins[s]],
+                        "maxs": [float(x) for x in T.maxs[s]],
+                        "means": [float(x) for x in T.means[s]],
+                        "m2s": [float(x) for x in T.m2s[s]],
+                    },
+                    [acc.state() for acc in T.accs[s]]
+                    if s in T.accs
+                    else None,
+                ]
+            )
         put_json(
             coord, key, epoch,
             {"epoch": epoch, "watermark": self._watermark, "sessions": sessions},
@@ -548,36 +727,26 @@ class SessionWindowExec(ExecOperator):
                 # out-of-order rows down to watermark - gap + 1, and such
                 # a row can START (or merge a session down to) exactly
                 # there, so that is the true output low bound
-                open_starts = [
-                    s.start
-                    for lst in self._sessions.values()
-                    for s in lst
-                ]
+                live = self._table.live_slots()
                 floor = (
                     self._watermark - self.gap_ms
                     if self._watermark is not None
                     else item.ts_ms
                 )
-                yield WatermarkHint(
-                    min(
-                        [item.ts_ms, floor]
-                        + [st - 1 for st in open_starts]
-                    ),
-                    kind=item.kind,
-                )
+                lows = [item.ts_ms, floor]
+                if len(live):
+                    lows.append(int(self._table.start[live].min()) - 1)
+                yield WatermarkHint(min(lows), kind=item.kind)
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
-                if self.emit_on_close and self._sessions:
-                    closed = [
-                        (k, s)
-                        for k, lst in self._sessions.items()
-                        for s in lst
-                    ]
-                    closed.sort(key=lambda e: e[1].start)
-                    self._sessions.clear()
-                    yield self._emit(closed)
+                live = self._table.live_slots()
+                if self.emit_on_close and len(live):
+                    order = np.lexsort(
+                        (self._table.gid[live], self._table.start[live])
+                    )
+                    yield self._emit_slots(live[order])
                 yield EOS
                 return

@@ -118,7 +118,18 @@ class Planner:
             if node.window_type is lp.WindowType.SESSION:
                 # sessions handle builtin AND accumulator (UDAF/collection)
                 # aggregates in one operator
-                from denormalized_tpu.physical.session_exec import SessionWindowExec
+                import os
+
+                if os.environ.get("DENORMALIZED_SESSION_REFERENCE") == "1":
+                    # escape hatch + differential-oracle path: the
+                    # pre-vectorization operator, kept verbatim
+                    from denormalized_tpu.physical.session_reference import (
+                        ReferenceSessionWindowExec as SessionWindowExec,
+                    )
+                else:
+                    from denormalized_tpu.physical.session_exec import (
+                        SessionWindowExec,
+                    )
 
                 return SessionWindowExec(
                     child,

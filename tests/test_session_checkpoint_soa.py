@@ -1,0 +1,246 @@
+"""Checkpoint round-trip for the SoA session store: snapshot mid-stream,
+restore in a fresh process-equivalent context, and require the union of
+pre-kill and post-restore emissions to be BYTE-IDENTICAL (exact float
+equality, not approx) to an uninterrupted run.
+
+Workload: the tools/soak.py session pipeline config (sensor keys,
+count/min/max/avg, 300ms gap, 600ms-burst/400ms-silence event time) at a
+higher rate — ~10x the soak smoke's rows per burst — so the snapshot lands
+mid-session with real open state: multiple keys, Chan moment columns, and
+an interner worth of gids to rebuild.
+"""
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.physical.base import EndOfStream, Marker
+from denormalized_tpu.physical.simple_execs import CollectSink
+from denormalized_tpu.runtime import executor
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.checkpoint import wire_checkpointing
+from denormalized_tpu.state.lsm import close_global_state_backend
+from denormalized_tpu.state.orchestrator import Orchestrator
+
+SESSION_GAP_MS = 300
+T0 = 1_700_000_000_000
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+
+
+def _burst_ts(ts):
+    """tools/soak.py burst_ts: squeeze each second's events into its first
+    600ms — the 400ms silence (> gap) closes one session per key/second."""
+    sec = (ts // 1000) * 1000
+    return sec + ((ts - sec) * 3) // 5
+
+
+def _batches(n_batches=14, rows=400, n_keys=7, seed=11):
+    rng = np.random.default_rng(seed)
+    out = []
+    ms_per_batch = 250
+    for b in range(n_batches):
+        base = T0 + b * ms_per_batch
+        ts = np.sort(_burst_ts(base + rng.integers(0, ms_per_batch, rows)))
+        ks = np.asarray(
+            [f"sensor_{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.normal(50.0, 10.0, rows)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _pipeline(ctx, batches):
+    return ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="soa_ckpt",
+    ).session_window(
+        ["k"],
+        [
+            F.count(col("v")).alias("count"),
+            F.min(col("v")).alias("min"),
+            F.max(col("v")).alias("max"),
+            F.avg(col("v")).alias("average"),
+            F.stddev(col("v")).alias("sd"),
+        ],
+        SESSION_GAP_MS,
+    )
+
+
+def _rows_of(batch):
+    out = {}
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        out[key] = (
+            int(batch.column("count")[i]),
+            float(batch.column("min")[i]),
+            float(batch.column("max")[i]),
+            float(batch.column("average")[i]),
+            float(batch.column("sd")[i]),
+        )
+    return out
+
+
+def test_soa_session_store_kill_restore_byte_identical(tmp_path):
+    batches = _batches()
+
+    golden = {}
+    for item in _pipeline(Context(), batches).stream():
+        golden.update(_rows_of(item))
+
+    def make_cfg(path):
+        return EngineConfig(
+            checkpoint=True, checkpoint_interval_s=9999, state_backend_path=path
+        )
+
+    state_dir = str(tmp_path / "state")
+    try:
+        # run A: process a few emissions, snapshot MID-SESSION, stop hard
+        ctx_a = Context(make_cfg(state_dir))
+        root_a = executor.build_physical(
+            lp.Sink(_pipeline(ctx_a, batches)._plan, CollectSink()), ctx_a
+        )
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emitted_a = {}
+        items_seen = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, RecordBatch):
+                emitted_a.update(_rows_of(item))
+            if items_seen == 2:
+                orch_a.trigger_now()
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                break
+            items_seen += 1
+        it.close()
+        close_global_state_backend()
+
+        # run B: restore from the snapshot, run to completion
+        ctx_b = Context(make_cfg(state_dir))
+        root_b = executor.build_physical(
+            lp.Sink(_pipeline(ctx_b, batches)._plan, CollectSink()), ctx_b
+        )
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        emitted_b = {}
+        for item in root_b.run():
+            if isinstance(item, RecordBatch):
+                emitted_b.update(_rows_of(item))
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        close_global_state_backend()
+
+    combined = dict(emitted_a)
+    combined.update(emitted_b)
+    assert set(combined) == set(golden), {
+        "extra": sorted(set(combined) - set(golden))[:4],
+        "missing": sorted(set(golden) - set(combined))[:4],
+    }
+    for key in golden:
+        # byte-identical: the snapshot stores exact f64 components (JSON
+        # repr round-trips doubles exactly), the merge order after restore
+        # matches the uninterrupted run, so every float must be EQUAL
+        assert combined[key] == golden[key], (key, combined[key], golden[key])
+
+
+def test_soa_snapshot_interoperates_with_reference(tmp_path, monkeypatch):
+    """The SoA store writes the SAME JSON snapshot schema the dict-era
+    operator wrote: a snapshot taken by the vectorized operator restores
+    into the reference operator (and vice versa) with identical emissions.
+    Pins the format so checkpoints survive engine upgrades in both
+    directions."""
+    batches = _batches(n_batches=14, rows=120, n_keys=4, seed=3)
+
+    golden = {}
+    for item in _pipeline(Context(), batches).stream():
+        golden.update(_rows_of(item))
+
+    def run_with(env_for_a, env_for_b, path):
+        def make_cfg():
+            return EngineConfig(
+                checkpoint=True,
+                checkpoint_interval_s=9999,
+                state_backend_path=path,
+            )
+
+        if env_for_a:
+            monkeypatch.setenv("DENORMALIZED_SESSION_REFERENCE", "1")
+        else:
+            monkeypatch.delenv("DENORMALIZED_SESSION_REFERENCE", raising=False)
+        ctx_a = Context(make_cfg())
+        root_a = executor.build_physical(
+            lp.Sink(_pipeline(ctx_a, batches)._plan, CollectSink()), ctx_a
+        )
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emitted = {}
+        items_seen = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, RecordBatch):
+                emitted.update(_rows_of(item))
+            if items_seen == 0:
+                orch_a.trigger_now()
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                break
+            items_seen += 1
+        it.close()
+        close_global_state_backend()
+
+        if env_for_b:
+            monkeypatch.setenv("DENORMALIZED_SESSION_REFERENCE", "1")
+        else:
+            monkeypatch.delenv("DENORMALIZED_SESSION_REFERENCE", raising=False)
+        ctx_b = Context(make_cfg())
+        root_b = executor.build_physical(
+            lp.Sink(_pipeline(ctx_b, batches)._plan, CollectSink()), ctx_b
+        )
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        for item in root_b.run():
+            if isinstance(item, RecordBatch):
+                emitted.update(_rows_of(item))
+            if isinstance(item, EndOfStream):
+                break
+        close_global_state_backend()
+        return emitted
+
+    def check(got):
+        # cross-OPERATOR resume cannot be bit-exact (the two engines fold
+        # floats in different orders); the format-compat bar is: same
+        # sessions, exact count/min/max/bounds, avg/sd to 1e-12 relative
+        assert set(got) == set(golden)
+        for k in golden:
+            gc, gmn, gmx, gav, gsd = got[k]
+            wc, wmn, wmx, wav, wsd = golden[k]
+            assert (gc, gmn, gmx) == (wc, wmn, wmx), k
+            assert abs(gav - wav) <= 1e-12 * max(1.0, abs(wav)), k
+            assert abs(gsd - wsd) <= 1e-9 * max(1.0, abs(wsd)), k
+
+    try:
+        # vectorized writes → reference restores
+        check(run_with(False, True, str(tmp_path / "s1")))
+        # reference writes → vectorized restores
+        check(run_with(True, False, str(tmp_path / "s2")))
+    finally:
+        close_global_state_backend()

@@ -229,3 +229,24 @@ def test_partitioned_session_replay_is_lossless(case):
         "extra": {k: v for k, v in got.items() if want.get(k) != v},
         "missing": {k: v for k, v in want.items() if got.get(k) != v},
     }
+
+
+# -- vectorized operator vs the kept reference implementation -------------
+
+
+@settings(max_examples=40, deadline=None)
+@given(session_case())
+def test_vectorized_matches_reference_operator(case):
+    """Property form of tests/test_session_vectorized.py: the vectorized
+    operator and the pre-vectorization reference must agree on every
+    emitted session — all builtin aggregate kinds, emission-cycle grouping
+    included — over arbitrary out-of-order multi-batch workloads."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_session_vectorized import assert_parity, kv
+
+    gap, raw = case
+    items = [kv(ts, ks, vs) for ts, ks, vs in raw]
+    assert_parity(items, gap_ms=gap)

@@ -1,0 +1,428 @@
+"""Differential suite: the vectorized SessionWindowExec vs the kept
+reference oracle (physical/session_reference.py — the pre-vectorization
+row/segment-at-a-time operator).
+
+Both operators are driven with IDENTICAL StreamItem sequences through a stub
+input operator, so the comparison pins the full operator contract: segment
+merging (including out-of-order bridges fusing several open sessions),
+late-row salvage into open sessions, watermark-driven close ordering
+(which sessions emit together per watermark advance), UDAF sessions, EOS
+flush, and gid-reuse-after-close.
+
+Parity bar: counts / interval bounds / min / max are EXACT; sum / avg /
+stddev compare at 1e-9 relative (the vectorized fold uses reduceat and the
+exact k-way Chan combine — same algebra as the oracle's sequential
+chan_merge, associativity-of-float rounding differs in the last ulps).
+"""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.physical.base import EOS, EndOfStream, RecordBatch as _RB
+from denormalized_tpu.physical.base import WatermarkHint
+from denormalized_tpu.physical.session_exec import SessionWindowExec
+from denormalized_tpu.physical.session_reference import (
+    ReferenceSessionWindowExec,
+)
+
+from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS, nullable=False),
+    ]
+)
+T0 = 1_700_000_000_000
+
+
+class _FeedOp:
+    """Stub input operator replaying a fixed StreamItem sequence."""
+
+    def __init__(self, items, schema=SCHEMA):
+        self._items = items
+        self.schema = schema
+
+    @property
+    def children(self):
+        return []
+
+    def run(self):
+        yield from self._items
+        yield EOS
+
+
+def kv(ts, ks, vs, vmask=None):
+    masks = [None, None, vmask if vmask is not None else None, None]
+    t = np.asarray(ts, np.int64)
+    return RecordBatch(
+        SCHEMA,
+        [t, np.asarray(ks, object), np.asarray(vs), t.copy()],
+        masks,
+    )
+
+
+BUILTIN_AGGS = [
+    F.count(col("v")).alias("cnt"),
+    F.sum(col("v")).alias("s"),
+    F.min(col("v")).alias("mn"),
+    F.max(col("v")).alias("mx"),
+    F.avg(col("v")).alias("av"),
+    F.stddev(col("v")).alias("sd"),
+]
+
+
+def drive(op_cls, items, aggs=None, gap_ms=500, **kw):
+    """Run one operator over the item sequence; returns
+    (emission_cycles, canonical) where emission_cycles is the list of
+    per-yield session-key sets (watermark close ordering) and canonical
+    maps (key, start) -> dict of output columns."""
+    op = op_cls(
+        _FeedOp(items), [col("k")], aggs or BUILTIN_AGGS, gap_ms, **kw
+    )
+    cycles = []
+    rows = {}
+    for item in op.run():
+        if not isinstance(item, _RB):
+            continue
+        names = item.schema.names
+        cycle = set()
+        for i in range(item.num_rows):
+            rec = {nm: item.column(nm)[i] for nm in names}
+            # (key, start) can legitimately repeat across cycles (a closed
+            # session's start re-attained by later data) — keep a LIST per
+            # key and compare multisets, disambiguated by window_end
+            key = (rec["k"], int(rec["window_start_time"]))
+            rows.setdefault(key, []).append(rec)
+            rows[key].sort(key=lambda r: int(r["window_end_time"]))
+            cycle.add(key)
+        cycles.append(cycle)
+    return cycles, rows
+
+
+def assert_parity(items, aggs=None, gap_ms=500, check_cycles=True):
+    got_cycles, got = drive(SessionWindowExec, items, aggs, gap_ms)
+    want_cycles, want = drive(ReferenceSessionWindowExec, items, aggs, gap_ms)
+    assert set(got) == set(want), {
+        "extra": sorted(set(got) - set(want))[:5],
+        "missing": sorted(set(want) - set(got))[:5],
+    }
+    for key in want:
+        assert len(got[key]) == len(want[key]), key
+        for g, w in zip(got[key], want[key]):
+            assert set(g) == set(w)
+            for nm in w:
+                gv, wv = g[nm], w[nm]
+                if isinstance(wv, (np.floating, float)):
+                    if wv != wv:  # NaN
+                        assert gv != gv, (key, nm, gv, wv)
+                    else:
+                        assert gv == pytest.approx(wv, rel=1e-9, abs=1e-9), (
+                            key, nm, gv, wv,
+                        )
+                else:
+                    assert gv == wv, (key, nm, gv, wv)
+    if check_cycles:
+        # watermark close ordering: the same sessions must close on the
+        # same emission cycle
+        assert [sorted(c) for c in got_cycles] == [
+            sorted(c) for c in want_cycles
+        ]
+
+
+def gen_items(seed, n_batches=6, keys=("a", "b", "c", "d"), with_hints=False,
+              nulls=False):
+    """Seeded random workload: bursty per-key traffic, out-of-order rows
+    (down to genuinely-late), occasional idle WatermarkHints."""
+    rng = np.random.default_rng(seed)
+    items = []
+    base = 0
+    for _ in range(n_batches):
+        n = int(rng.integers(1, 40))
+        base += int(rng.integers(0, 900))
+        offs = rng.integers(-1500, 900, n)  # reach back far enough to be late
+        ts = np.sort(np.maximum(0, base + offs) + T0)
+        ks = rng.choice(np.asarray(keys, object), n)
+        vs = rng.normal(50.0, 10.0, n)
+        vmask = None
+        if nulls:
+            vmask = rng.random(n) > 0.25
+        items.append(kv(ts, ks, vs, vmask))
+        if with_hints and rng.random() < 0.4:
+            items.append(WatermarkHint(T0 + base + int(rng.integers(0, 500))))
+    return items
+
+
+# -- 12 fixed differential seeds (multi-key merges + late-row salvage) ----
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_differential_builtin_aggregates(seed):
+    assert_parity(gen_items(seed))
+
+
+@pytest.mark.parametrize("seed", range(12, 18))
+def test_differential_with_null_values(seed):
+    assert_parity(gen_items(seed, nulls=True))
+
+
+@pytest.mark.parametrize("seed", range(18, 24))
+def test_differential_with_idle_hints(seed):
+    assert_parity(gen_items(seed, with_hints=True))
+
+
+@pytest.mark.parametrize("seed", range(24, 30))
+def test_differential_udaf_sessions(seed):
+    aggs = [
+        F.array_agg(col("v")).alias("arr"),
+        F.first_value(col("v")).alias("fv"),
+        F.last_value(col("v")).alias("lv"),
+        F.median(col("v")).alias("med"),
+        F.count(col("v")).alias("cnt"),
+    ]
+    items = gen_items(seed, keys=("a", "b"))
+    got_cycles, got = drive(SessionWindowExec, items, aggs)
+    want_cycles, want = drive(ReferenceSessionWindowExec, items, aggs)
+    assert set(got) == set(want)
+    for key in want:
+        assert len(got[key]) == len(want[key]), key
+        for g, w in zip(got[key], want[key]):
+            assert list(g["arr"]) == list(w["arr"]), key  # exact, incl. order
+            assert g["fv"] == w["fv"] and g["lv"] == w["lv"], key
+            assert g["med"] == w["med"], key
+            assert g["cnt"] == w["cnt"], key
+    assert [sorted(c) for c in got_cycles] == [sorted(c) for c in want_cycles]
+
+
+def test_differential_high_cardinality_segments():
+    """Many keys per batch → many segments; exercises the combined
+    interval-merge sweep's segmented cummax across hundreds of gids."""
+    rng = np.random.default_rng(99)
+    keys = [f"k{i}" for i in range(300)]
+    items = []
+    base = 0
+    for _ in range(4):
+        n = 600
+        base += 700
+        ts = np.sort(T0 + base + rng.integers(-800, 800, n))
+        ks = rng.choice(np.asarray(keys, object), n)
+        items.append(kv(ts, ks, rng.normal(0, 1, n)))
+    assert_parity(items, gap_ms=300)
+
+
+def test_differential_multi_open_session_bridges():
+    """Deliberate shape: per key, two far-apart open sessions, then a
+    bridging middle row merges them (the multi-open-chain path)."""
+    items = [
+        kv([T0 + 1000, T0 + 4000, T0 + 1100, T0 + 4100],
+           ["a", "a", "b", "b"], [1.0, 4.0, 1.0, 4.0]),
+        kv([T0 + 2500, T0 + 2600], ["a", "b"], [2.5, 2.6]),
+        kv([T0 + 20_000], ["z"], [0.0]),
+    ]
+    assert_parity(items, gap_ms=2000)
+
+
+def test_differential_late_salvage_chain():
+    """Late rows reaching the open session only through another salvaged
+    late row arriving earlier in the same batch (arrival-order contract
+    of the scoped slow path)."""
+    items = [
+        kv([T0 + 100_000], ["a"], [1.0]),
+        kv([T0 + 105_000], ["w"], [0.0]),
+        kv([T0 + 91_000, T0 + 82_000, T0 + 106_000], ["a", "a", "w"],
+           [5.0, 3.0, 0.0]),
+        kv([T0 + 125_000], ["w"], [0.0]),
+    ]
+    assert_parity(items, gap_ms=10_000)
+
+
+# -- gid recycling ---------------------------------------------------------
+
+
+def test_gid_reuse_after_close():
+    """A closed key's dense id is recycled to a NEW key, then the original
+    key returns: no state bleeds across the reuse, and the id space
+    actually shrinks (the recycling is real, not vestigial)."""
+    op = SessionWindowExec(
+        _FeedOp([]), [col("k")], BUILTIN_AGGS, 500
+    )
+    items = [
+        kv([T0 + 100, T0 + 200], ["a", "a"], [1.0, 2.0]),
+        # wm → T0+5000: a's session closes and its gid frees
+        kv([T0 + 5000], ["b"], [10.0]),
+        # c should REUSE a's freed gid; a returns and gets a fresh one
+        kv([T0 + 5100, T0 + 5200], ["c", "a"], [7.0, 3.0]),
+        kv([T0 + 50_000], ["w"], [0.0]),
+    ]
+    assert_parity(items)
+    # drive the new operator alone to inspect the interner
+    op = SessionWindowExec(_FeedOp(items), [col("k")], BUILTIN_AGGS, 500)
+    list(op.run())
+    # keys ever seen: a, b, c, a(again), w — but a's first gid was
+    # recycled, so capacity stays below the naive 5 ids
+    assert op._interner.capacity <= 4
+
+
+def test_recycling_interner_unit():
+    from denormalized_tpu.ops.interner import RecyclingGroupInterner
+
+    it = RecyclingGroupInterner(1)
+    g1 = it.intern([np.asarray(["a", "b", "a"], object)])
+    assert g1.tolist() == [0, 1, 0]
+    it.release(np.asarray([0]))
+    assert len(it) == 1
+    g2 = it.intern([np.asarray(["c", "b"], object)])
+    # "c" takes the freed id 0; "b" keeps its id
+    assert g2.tolist() == [0, 1]
+    assert [x.tolist() for x in it.keys_of(np.asarray([0, 1]))] == [["c", "b"]]
+    # releasing twice is a no-op
+    it.release(np.asarray([0, 0]))
+    g3 = it.intern([np.asarray(["a"], object)])
+    assert g3.tolist() == [0]
+
+
+def test_recycling_interner_multi_column():
+    from denormalized_tpu.ops.interner import RecyclingGroupInterner
+
+    it = RecyclingGroupInterner(2)
+    g = it.intern(
+        [np.asarray(["x", "y", "x"], object), np.asarray([1, 2, 1], np.int64)]
+    )
+    assert g.tolist() == [0, 1, 0]
+    it.release(np.asarray([1]))
+    g2 = it.intern(
+        [np.asarray(["y", "y"], object), np.asarray([3, 2], np.int64)]
+    )
+    # both keys are first-seen this batch (("y", 2) was released): one
+    # takes the freed id 1, the other a fresh id — the id space stays
+    # dense at 3 ids for 3 live keys
+    assert sorted(g2.tolist()) == [1, 2]
+    assert it.capacity == 3 and len(it) == 3
+    ka, kb = it.keys_of(np.asarray([g2[0], g2[1]]))
+    assert ka.tolist() == ["y", "y"] and kb.tolist() == [3, 2]
+
+
+# -- no-per-row-Python guard ----------------------------------------------
+
+
+def test_builtin_path_does_no_per_row_python():
+    """The built-in-aggregate path must not touch the per-row salvage loop
+    when nothing is late: a workload whose every row is on time (each
+    batch's min ts at or above the prior batch's) must record ZERO salvage
+    scans — pinning that the only per-row loop is unreachable on the
+    vectorized path."""
+    rng = np.random.default_rng(3)
+    items, base = [], 0
+    for _ in range(6):
+        n = int(rng.integers(10, 60))
+        ts = np.sort(T0 + base + rng.integers(0, 800, n))
+        base = int(ts.max()) - T0  # next batch min >= this batch's max
+        ks = rng.choice(np.asarray(["a", "b", "c"], object), n)
+        items.append(kv(ts, ks, rng.normal(0, 1, n)))
+    op = SessionWindowExec(_FeedOp(items), [col("k")], BUILTIN_AGGS, 500)
+    list(op.run())
+    m = op.metrics()
+    assert m["rows_in"] == sum(it.num_rows for it in items)
+    assert m["late_rows"] == 0
+    assert m["salvage_rows_scanned"] == 0
+
+
+def test_salvage_scope_is_late_keys_only():
+    """Rows of keys WITHOUT a late row this batch never enter the per-row
+    salvage loop."""
+    items = [
+        kv([T0 + 100], ["a"], [1.0]),
+        kv([T0 + 10_000], ["b"], [1.0]),  # wm → 10_000, a closes
+        # batch: one late 'a' row + many on-time 'c' rows; only the 'a'
+        # row (its key's rows) may be scanned
+        kv([T0 + 200] + [T0 + 10_500 + i for i in range(50)],
+           ["a"] + ["c"] * 50, [9.9] * 51),
+    ]
+    op = SessionWindowExec(_FeedOp(items), [col("k")], BUILTIN_AGGS, 1000)
+    list(op.run())
+    assert op.metrics()["salvage_rows_scanned"] == 1
+
+
+# -- key-identity semantics: sessions now match the tumbling operator -----
+
+
+def test_nan_group_keys_form_one_session():
+    """DELIBERATE divergence from the reference oracle: the old tuple-dict
+    keying kept every NaN float key distinct (NaN != NaN → one session per
+    NaN row); the interner's numeric path groups NaNs as ONE key
+    (np.unique equal_nan), which is what the tumbling window operator has
+    always done and what SQL GROUP BY does with NULL.  Pin the new,
+    consistent behavior."""
+    schema = Schema(
+        [
+            Field("ts", DataType.INT64, nullable=False),
+            Field("k", DataType.FLOAT64),
+            Field("v", DataType.FLOAT64),
+            Field(
+                CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS,
+                nullable=False,
+            ),
+        ]
+    )
+    def nan_batch(ts_list, keys):
+        ts = np.asarray(ts_list, np.int64)
+        return RecordBatch(
+            schema,
+            [ts, np.asarray(keys), np.ones(len(ts)), ts.copy()],
+        )
+
+    def run_counts(items):
+        op = SessionWindowExec(
+            _FeedOp(items, schema), [col("k")],
+            [F.count(col("v")).alias("c")], 100,
+        )
+        return sorted(
+            int(item.column("c")[i])
+            for item in op.run()
+            if isinstance(item, _RB)
+            for i in range(item.num_rows)
+        )
+
+    # one NaN session (count 2) + the 1.0 session — NOT three singletons
+    assert run_counts(
+        [nan_batch([T0, T0 + 10, T0 + 20], [np.nan, np.nan, 1.0])]
+    ) == [1, 2]
+    # CROSS-BATCH: NaN must intern to the SAME gid in every batch (nan !=
+    # nan defeats a plain dict lookup — review-found; grouping must not
+    # depend on batch boundaries)
+    assert run_counts(
+        [
+            nan_batch([T0], [np.nan]),
+            nan_batch([T0 + 50], [np.nan]),
+        ]
+    ) == [2]
+
+
+# -- hash-collision regression (the bug the interner path fixes) ----------
+
+
+def test_no_composite_hash_collisions():
+    """The reference keyed segments by salted 64-bit hash(tuple); two keys
+    colliding would silently merge.  The interner path is collision-free by
+    construction — simulate the failure shape by interning adversarial key
+    counts and checking distinctness end to end."""
+    keys = [f"key_{i}" for i in range(2000)]
+    rng = np.random.default_rng(5)
+    n = 4000
+    ks = rng.choice(np.asarray(keys, object), n)
+    ts = np.sort(T0 + rng.integers(0, 200, n))
+    items = [kv(ts, ks, np.ones(n)), kv([T0 + 100_000], ["w"], [0.0])]
+    _, rows = drive(SessionWindowExec, items, [F.count(col("v")).alias("c")],
+                    gap_ms=500)
+    per_key_counts = {k: int(r[0]["c"]) for (k, _s), r in rows.items()}
+    want = {}
+    for k in ks.tolist():
+        want[k] = want.get(k, 0) + 1
+    want["w"] = 1
+    assert per_key_counts == want
