@@ -242,6 +242,7 @@ class SourceExec(ExecOperator):
         self._readers: list | None = None
         self._yielded_offsets: list | None = None
         self._ckpt = None  # (CheckpointCoordinator, node_id)
+        self._pump = None  # live prefetch pump (supervisor metrics)
 
     def set_barrier_source(self, poll: Callable[[], int | None]) -> None:
         self._barrier_poll = poll
@@ -305,9 +306,27 @@ class SourceExec(ExecOperator):
         # schema shape that silently routes to the ~30x-slower Python
         # decoder must be observable, not a quiet perf cliff.  Reading an
         # int attribute across the prefetch worker threads is safe.
-        m["decode_fallback_rows"] = sum(
-            r.decode_fallback_rows() for r in (self._readers or [])
-        )
+        # read the pump's CURRENT readers when it exists: a supervised
+        # restart swaps the worker's reader, and the pre-crash list would
+        # silently freeze this count at the crash point.  Retired
+        # readers' counts are carried on the worker so a restart never
+        # RESETS the perf-cliff metric either.
+        if self._pump is not None:
+            m["decode_fallback_rows"] = sum(
+                w.decode_fallback_total() for w in self._pump.workers
+            )
+            # supervisor restart state: how many worker crashes this
+            # source absorbed (and where), so a flapping partition is
+            # visible even when every restart succeeded
+            rs = self._pump.restart_stats()
+            m["prefetch_restarts"] = rs["restarts"]
+            m["prefetch_restarted_partitions"] = rs["restarted_partitions"]
+            if rs["last_errors"]:
+                m["prefetch_last_errors"] = dict(rs["last_errors"])
+        else:
+            m["decode_fallback_rows"] = sum(
+                r.decode_fallback_rows() for r in (self._readers or [])
+            )
         return m
 
     def _label(self):
@@ -406,7 +425,15 @@ class SourceExec(ExecOperator):
         # after downstream fully processed the batch.
         from denormalized_tpu.runtime.prefetch import PrefetchPump
 
-        pump = PrefetchPump(readers, queue_budget=self._queue_size)
+        pump = PrefetchPump(
+            readers,
+            queue_budget=self._queue_size,
+            # per-partition rebuild hooks: with these the pump SUPERVISES
+            # worker crashes (restart + seek to the last enqueued offset)
+            # instead of failing the query on the first transient error
+            reader_factories=self.source.partition_factories(),
+        )
+        self._pump = pump
         finished = 0
         # idle-source watermark hints: live readers deliver EMPTY batches
         # on read timeouts even when the topic is quiet, so idleness is

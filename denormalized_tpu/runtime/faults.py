@@ -1,0 +1,316 @@
+"""Deterministic, seedable fault injection for the I/O boundaries.
+
+Every failure mode the engine claims to survive is only *proven* survivable
+when it can be produced on demand.  The SIGKILL soaks (tools/soak.py) cover
+whole-process death; this module covers everything BELOW that granularity —
+a broker connection flapping mid-epoch, one torn state write, a prefetch
+worker dying, a transient error inside ``commit`` — as first-class,
+reproducible events.
+
+A process-global :class:`FaultPlan` is threaded through named **injection
+sites** at the engine's I/O boundaries::
+
+    kafka.fetch         KafkaClient fetch           (raises SourceError)
+    kafka.produce       KafkaClient produce         (raises SourceError)
+    decode              decoder output, per rowful  (raises SourceError)
+                        batch, both decode paths
+    sink.write          KafkaSinkWriter.write       (raises SourceError)
+    lsm.put             LsmStore.put                (StateError / torn value)
+    lsm.get             LsmStore.get                (raises StateError)
+    lsm.flush           LsmStore.flush              (raises StateError)
+    checkpoint.commit   CheckpointCoordinator.commit(raises StateError)
+
+Each site calls :func:`inject` (optionally passing the key/payload being
+written).  With no plan armed ``inject`` is a single attribute check and an
+immediate return — sites sit at per-fetch / per-snapshot granularity, never
+per-row, so an unarmed plan costs nothing measurable (pinned by
+``tests/test_faults.py`` and the ingest_scale acceptance run).
+
+## Plan grammar
+
+A plan is JSON (or the equivalent dict through :func:`arm`)::
+
+    {"seed": 1234,
+     "rules": [
+       {"site": "kafka.fetch", "kind": "error", "prob": 0.02, "times": 6,
+        "message": "recv: injected broker flap"},
+       {"site": "kafka.fetch", "kind": "error", "after": 200, "times": 1,
+        "message": "injected worker crash"},
+       {"site": "lsm.put", "kind": "torn", "key_substr": "@", "times": 2},
+       {"site": "checkpoint.commit", "kind": "error", "times": 2},
+       {"site": "*", "kind": "latency", "ms": 5, "prob": 0.01}
+     ]}
+
+Rule fields:
+
+- ``site``: exact site name, a ``prefix.*`` glob, or ``*`` (all sites).
+- ``kind``: ``error`` (raise), ``latency`` (sleep ``ms`` milliseconds), or
+  ``torn`` (truncate the payload bytes at a seeded cut point — only
+  meaningful at sites that pass a payload, i.e. ``lsm.put``).
+- ``times``: fire at most N times (omitted/null = unlimited) — the
+  "repeat-N-then-heal" schedule.
+- ``after``: skip the first K *matching* calls before becoming eligible.
+- ``prob``: per-call firing probability (default 1.0), drawn from the
+  rule's own seeded RNG so the decision for matching call #k is a pure
+  function of ``(seed, rule index, k)`` — deterministic regardless of
+  which thread made the call.
+- ``key_substr``: only match calls whose ``key`` contains this substring
+  (e.g. ``"@"`` restricts ``lsm.put`` tearing to epoch-suffixed snapshot
+  blobs, never the commit record).
+- ``message``: error text.  The text *steers classification* downstream:
+  transport markers (``recv:`` ...) route a ``kafka.fetch`` error into the
+  reader's reconnect path, ``fetch error 1`` into the OFFSET_OUT_OF_RANGE
+  reset, anything else escapes the reader and exercises the prefetch
+  supervisor.  The default message carries no markers.
+- ``error``: ``"source"`` or ``"state"`` to override the error class the
+  site would pick by its name prefix.
+
+The first rule that fires wins the call (rules are evaluated in plan
+order); a rule that matches but does not fire still advances its ``after``
+counter.  Every decision is appended to the plan's event log
+(:meth:`FaultPlan.event_log`), which is what the chaos soak compares across
+two same-seed simulations to prove determinism.
+
+Arming: :func:`arm` (API) or the ``DENORMALIZED_FAULT_PLAN`` environment
+variable — either inline JSON or ``@/path/to/plan.json`` — read once at
+module import, which is how the soak's child processes receive the plan.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+from denormalized_tpu.common.errors import SourceError, StateError
+
+#: known sites, and the error class each raises by default
+SITES = {
+    "kafka.fetch": SourceError,
+    "kafka.produce": SourceError,
+    "decode": SourceError,
+    "sink.write": SourceError,
+    "lsm.put": StateError,
+    "lsm.get": StateError,
+    "lsm.flush": StateError,
+    "checkpoint.commit": StateError,
+}
+
+_KINDS = ("error", "latency", "torn")
+
+
+class FaultRule:
+    """One rule's match predicate + seeded decision state (thread-safe
+    under the owning plan's lock)."""
+
+    def __init__(self, spec: dict, index: int, seed: int):
+        self.index = index
+        self.name = spec.get("name")  # optional label, echoed in events
+        self.site = spec.get("site", "*")
+        # a typo'd site ("lsm.putt", "kafk.*") would arm fine, match
+        # nothing, and let a chaos run report green without ever
+        # injecting the fault — reject at arm time instead
+        if self.site != "*":
+            if self.site.endswith(".*"):
+                prefix = self.site[:-1]
+                known = any(s.startswith(prefix) for s in SITES)
+            else:
+                known = self.site in SITES
+            if not known:
+                raise ValueError(
+                    f"fault rule {index}: site {self.site!r} matches no "
+                    f"known site (expected '*' or one of {sorted(SITES)})"
+                )
+        self.kind = spec.get("kind", "error")
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"fault rule {index}: unknown kind {self.kind!r} "
+                f"(expected one of {_KINDS})"
+            )
+        times = spec.get("times")
+        self.times = None if times is None else int(times)
+        self.after = int(spec.get("after", 0))
+        self.prob = float(spec.get("prob", 1.0))
+        self.key_substr = spec.get("key_substr")
+        self.message = spec.get("message")
+        self.error = spec.get("error")
+        self.ms = float(spec.get("ms", 0.0))
+        # decision RNG: a pure function of (seed, rule index) — the k-th
+        # matching call's draw is identical across runs and across the
+        # thread interleavings that produced it
+        self._rng = random.Random(int(seed) * 1_000_003 + index)
+        self.hits = 0  # matching calls seen
+        self.fired = 0  # times this rule actually fired
+
+    def matches(self, site: str, key: str | None) -> bool:
+        if self.site != "*" and self.site != site:
+            if not (self.site.endswith(".*")
+                    and site.startswith(self.site[:-1])):
+                return False
+        if self.key_substr is not None:
+            if key is None or self.key_substr not in key:
+                return False
+        return True
+
+    def decide(self) -> bool:
+        """Advance this rule's deterministic counters for one matching
+        call; True when the rule fires."""
+        self.hits += 1
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.hits <= self.after:
+            return False
+        if self.prob < 1.0 and self._rng.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+    def error_class(self, site: str):
+        if self.error == "source":
+            return SourceError
+        if self.error == "state":
+            return StateError
+        cls = SITES.get(site)
+        if cls is not None:
+            return cls
+        head = site.split(".", 1)[0]
+        return StateError if head in ("lsm", "checkpoint", "state") \
+            else SourceError
+
+
+class FaultPlan:
+    """A seeded set of rules plus the log of everything they did."""
+
+    def __init__(self, spec: dict | str):
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        self.seed = int(spec.get("seed", 0))
+        self.rules = [
+            FaultRule(r, i, self.seed)
+            for i, r in enumerate(spec.get("rules", []))
+        ]
+        self.events: list[dict] = []
+        self._lock = threading.Lock()
+
+    # -- the one entry point every site goes through ---------------------
+    def on(self, site: str, key: str | None = None, payload=None):
+        """Apply the plan to one call at ``site``; returns the (possibly
+        torn) payload, raises the rule's error class, or sleeps."""
+        sleep_s = 0.0
+        raise_exc = None
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(site, key):
+                    continue
+                if rule.kind == "torn" and not payload:
+                    # nothing to tear at a payload-less call: leave the
+                    # rule's budget (times/after/RNG) untouched for a
+                    # call that carries bytes — consuming it here would
+                    # log a vacuous "fired" while the planned tear
+                    # silently never happens
+                    continue
+                if not rule.decide():
+                    continue
+                event = {
+                    "site": site,
+                    "rule": rule.index,
+                    "kind": rule.kind,
+                    "hit": rule.hits,
+                    "fire": rule.fired,
+                }
+                if rule.name:
+                    event["name"] = rule.name
+                if rule.kind == "latency":
+                    sleep_s = rule.ms / 1000.0
+                    event["ms"] = rule.ms
+                elif rule.kind == "torn":
+                    # payload is non-empty: payload-less calls were
+                    # filtered before decide()
+                    keep = rule._rng.randrange(0, len(payload))
+                    event["torn_to"] = keep
+                    event["torn_from"] = len(payload)
+                    if key is not None:
+                        event["key"] = key
+                    payload = payload[:keep]
+                else:  # error
+                    msg = rule.message or f"injected fault at {site}"
+                    event["message"] = msg
+                    raise_exc = rule.error_class(site)(msg)
+                self.events.append(event)
+                break  # first firing rule wins the call
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)
+        if raise_exc is not None:
+            raise raise_exc
+        return payload
+
+    def event_log(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self.events]
+
+    def fired_sites(self) -> dict[str, int]:
+        """Per-site count of fired injections (observability/asserts)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for e in self.events:
+                out[e["site"]] = out.get(e["site"], 0) + 1
+        return out
+
+
+# -- process-global plan --------------------------------------------------
+
+_PLAN: FaultPlan | None = None
+
+
+def arm(plan: FaultPlan | dict | str) -> FaultPlan:
+    """Install a process-global plan (replacing any previous one)."""
+    global _PLAN
+    if not isinstance(plan, FaultPlan):
+        plan = FaultPlan(plan)
+    _PLAN = plan
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def armed() -> bool:
+    return _PLAN is not None
+
+
+def inject(site: str, key: str | None = None, payload=None):
+    """Site hook: no-op (returns ``payload`` unchanged) unless a plan is
+    armed.  Sites sit at I/O-operation granularity — one call per fetch,
+    produce, state op, or commit — never per row."""
+    p = _PLAN
+    if p is None:
+        return payload
+    return p.on(site, key=key, payload=payload)
+
+
+# env arming at import: how child processes (soak, SIGKILL harnesses)
+# receive the plan without API plumbing
+_env_plan = os.environ.get("DENORMALIZED_FAULT_PLAN")
+if _env_plan:
+    try:
+        if _env_plan.startswith("@"):
+            with open(_env_plan[1:]) as _f:
+                _env_plan = _f.read()
+        arm(_env_plan)
+    except Exception as _e:
+        # this runs at engine import — a stale/malformed value must name
+        # its source, not surface as a bare JSONDecodeError deep inside
+        # an unrelated import chain
+        raise RuntimeError(
+            f"DENORMALIZED_FAULT_PLAN is set but unusable "
+            f"({_env_plan[:80]!r}): {_e}"
+        ) from _e
+del _env_plan

@@ -38,14 +38,74 @@ around to processing a partition's batches:
   through a large catch-up fetch looked idle to every consumer-side
   clock).  ``None`` (reader has no backlog knowledge) falls back to the
   wall-clock judgment.
+
+Supervision: a worker whose reader dies with a transient error
+(``SourceError``/``StateError``) does not kill the query.  The supervisor
+restarts it with exponential backoff + jitter, rebuilding the reader via
+the source's per-partition factory and seeking it to the snapshot of the
+LAST batch this worker successfully ENQUEUED — everything at or before
+that offset is already in the ready queue or consumed, everything after
+it was lost with the crash and gets re-read, so a restart can neither
+replay rows the consumer saw nor drop rows it never will (the same
+offset-snapshot contract checkpoint restore uses).  A bounded restart
+budget (per-worker and pump-global) escalates to a structured
+:class:`PrefetchRestartExhausted` carrying partition, attempt count, and
+last error; restart counts surface in ``SourceExec.metrics()`` and each
+restart emits a ``tracing.span`` event.
 """
 
 from __future__ import annotations
 
 import queue as queue_mod
+import random
 import threading
 import time
 from typing import Callable, Iterator
+
+from denormalized_tpu.common.errors import SourceError, StateError
+from denormalized_tpu.runtime.tracing import logger, span
+
+
+class PrefetchRestartExhausted(SourceError):
+    """A partition's worker failed past its restart budget: the structured
+    query failure the supervisor escalates to."""
+
+    def __init__(self, partition: int, attempts: int, last_error):
+        super().__init__(
+            f"partition {partition}: prefetch worker failed permanently "
+            f"after {attempts} restart(s): {last_error}"
+        )
+        self.partition = partition
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+class _RestartBudget:
+    """Shared cap on restarts across all of one pump's workers.  Tokens
+    are refunded when a worker's restart streak heals (sustained healthy
+    operation), so the budget bounds failure RATE, not lifetime count —
+    a long-lived stream with occasional healed hiccups must not converge
+    to guaranteed death."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._cap = n
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self._lock:
+            if self._n <= 0:
+                return False
+            self._n -= 1
+            return True
+
+    def refund(self, n: int) -> None:
+        with self._lock:
+            self._n = min(self._cap, self._n + n)
+
+    def remaining(self) -> int:
+        with self._lock:
+            return self._n
 
 
 class PrefetchWorker:
@@ -60,6 +120,12 @@ class PrefetchWorker:
         *,
         depth: int = 2,
         read_timeout_s: float = 0.1,
+        reader_factory: Callable[[], object] | None = None,
+        restart_budget: int = 5,
+        global_budget: _RestartBudget | None = None,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        heal_after_s: float = 60.0,
     ) -> None:
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
@@ -67,8 +133,39 @@ class PrefetchWorker:
         self.reader = reader
         self._q = out_q
         self._done = done
+        self._depth = depth
         self._slots = threading.Semaphore(depth)
         self._read_timeout_s = read_timeout_s
+        # -- supervision ---------------------------------------------------
+        self._reader_factory = reader_factory
+        self._restart_budget = restart_budget
+        self._global_budget = global_budget or _RestartBudget(restart_budget)
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._heal_after_s = heal_after_s
+        # jitter RNG seeded per partition: restart timing never depends on
+        # a shared global RNG another thread may be draining
+        self._jitter = random.Random(0x5EED ^ (idx * 7919))
+        #: lifetime restart count (observability) — budget decisions use
+        #: the CURRENT STREAK, which heals after heal_after_s of crash-
+        #: free operation (with the global tokens refunded): the budget
+        #: bounds systemic failure, not total uptime
+        self.restarts = 0
+        self._streak = 0
+        self._restart_wall = 0.0
+        self.last_error: str | None = None
+        self.backoff_total_s = 0.0
+        #: offset snapshot of the last batch successfully ENQUEUED — the
+        #: rebuild-on-restart seek point (everything <= it is in the queue
+        #: or consumed; everything past it died with the old reader)
+        self._last_snap: dict | None = None
+        #: decode-fallback rows accumulated by readers this worker has
+        #: RETIRED across restarts — the replacement reader's counter
+        #: starts at 0, and the perf-cliff metric must not reset with it.
+        #: Folded under _swap_lock so a metrics read can never observe
+        #: the count doubled or dropped mid-swap.
+        self.retired_decode_fallback_rows = 0
+        self._swap_lock = threading.Lock()
         # single-writer activity slots (worker writes enq_*, consumer
         # writes deq_) — see module docstring
         self.enq_rowful = 0
@@ -130,34 +227,163 @@ class PrefetchWorker:
                 return True
         return False
 
+    def _restartable(self, err: BaseException) -> bool:
+        """Transient engine errors restart; anything else (programming
+        errors, interpreter shutdown) surfaces to the consumer verbatim.
+        Without a factory there is nothing to rebuild from."""
+        return (
+            self._reader_factory is not None
+            and isinstance(err, (SourceError, StateError))
+        )
+
+    def _rebuild_reader(self) -> None:
+        new = self._reader_factory()
+        if self._last_snap is not None:
+            new.offset_restore(self._last_snap)
+        old = self.reader
+        with self._swap_lock:
+            # fold + swap atomically w.r.t. decode_fallback_total(): no
+            # ordering of the two writes alone is glitch-free (one gives
+            # a transient drop, the other a transient double count)
+            fallback = getattr(old, "decode_fallback_rows", None)
+            if callable(fallback):
+                try:
+                    self.retired_decode_fallback_rows += int(fallback())
+                except Exception:
+                    pass
+            self.reader = new
+        # caught_up stays False (set when the crash was detected) until
+        # the rebuilt reader's first fetch reports real backlog state
+        close = getattr(old, "close", None)
+        if callable(close):
+            # free the crashed reader's native client now, not at GC —
+            # a flapping partition would otherwise hold one dead broker
+            # connection per restart
+            try:
+                close()
+            except Exception:
+                pass
+
+    def decode_fallback_total(self) -> int:
+        """Current + retired decode-fallback rows, glitch-free across a
+        supervised reader swap."""
+        with self._swap_lock:
+            return (
+                self.reader.decode_fallback_rows()
+                + self.retired_decode_fallback_rows
+            )
+
     def _run(self) -> None:
+        err: BaseException | None = None
+        try:
+            while True:
+                if err is not None:
+                    if self._done.is_set():
+                        return  # shutting down: swallow, nobody is reading
+                    if not self._restartable(err):
+                        self._q.put(err)  # surfaced by the consumer
+                        return
+                    if (
+                        self._streak >= self._restart_budget
+                        or not self._global_budget.take()
+                    ):
+                        self._q.put(PrefetchRestartExhausted(
+                            self.idx, self.restarts, err
+                        ))
+                        return
+                    self.restarts += 1
+                    self._streak += 1
+                    self._restart_wall = time.monotonic()
+                    # jitter INSIDE the clamp: backoff_max_s is a hard cap
+                    # a caller can tune against watermark/idle timeouts
+                    delay = min(
+                        self._backoff_max_s,
+                        self._backoff_base_s * (2 ** (self._streak - 1))
+                        * (1.0 + 0.25 * self._jitter.random()),
+                    )
+                    self.backoff_total_s += delay
+                    logger.warning(
+                        "prefetch worker %d: %s — restart %d/%d in %.2fs "
+                        "(resume from %s)",
+                        self.idx, err, self._streak, self._restart_budget,
+                        delay, self._last_snap,
+                    )
+                    if self._done.wait(delay):
+                        return
+                    err = None
+                    try:
+                        with span(
+                            "prefetch.restart",
+                            partition=self.idx, attempt=self.restarts,
+                        ):
+                            self._rebuild_reader()
+                    except BaseException as e:
+                        # rebuild failed (e.g. broker still down): another
+                        # crash — loops back into the budgeted backoff
+                        err = e
+                        self.last_error = f"{type(e).__name__}: {e}"
+                        continue
+                try:
+                    self._run_reader()
+                    return  # clean EOS (or shutdown)
+                except BaseException as e:
+                    err = e
+                    self.last_error = f"{type(e).__name__}: {e}"
+                    # rows past _last_snap died with the reader and WILL
+                    # be re-read: the partition must read as known-backlog
+                    # (never idle-judgeable) for the whole backoff/rebuild
+                    # window, or the watermark advances over the lost rows
+                    # and the re-read arrives "late" — silent loss by the
+                    # very mechanism meant to prevent it
+                    self.caught_up = False
+        finally:
+            self.finished = True
+            self._q.put((self.idx, None, None))
+
+    def _run_reader(self) -> None:
         reader = self.reader
         probe = getattr(reader, "caught_up", None)
         if not callable(probe):
             probe = None
-        try:
-            while not self._done.is_set():
-                b = reader.read(timeout_s=self._read_timeout_s)
-                self.first_read_done = True
-                if b is None:
-                    break  # partition exhausted (or reader died cleanly)
-                if probe is not None:
-                    self.caught_up = probe()
-                if b.num_rows:
-                    # stamp BEFORE the (possibly blocking) slot acquire:
-                    # while waiting for the consumer the partition has
-                    # pending work and must read as active
-                    self.enq_wall = time.monotonic()
-                    self.enq_rowful += 1
-                snap = reader.offset_snapshot()
-                if not self._acquire_slot():
-                    return  # shutdown won
-                self._q.put((self.idx, snap, b))
-        except BaseException as e:  # surfaced by the consumer
-            self._q.put(e)
-        finally:
-            self.finished = True
-            self._q.put((self.idx, None, None))
+        if self._last_snap is None:
+            self._last_snap = reader.offset_snapshot()
+        while not self._done.is_set():
+            if self._streak and (
+                time.monotonic() - self._restart_wall >= self._heal_after_s
+            ):
+                # crash-free for the heal interval: the streak resets and
+                # its global tokens come back — the next independent
+                # hiccup gets a full budget instead of inheriting debt
+                # from hours-old healed failures
+                self._global_budget.refund(self._streak)
+                self._streak = 0
+            b = reader.read(timeout_s=self._read_timeout_s)
+            self.first_read_done = True
+            if b is None:
+                return  # partition exhausted (or reader died cleanly)
+            if probe is not None:
+                cu = probe()
+                if cu is not None or self.caught_up is not False:
+                    # a None probe result (no fetch yet / mid-reconnect)
+                    # must NOT release a crash-time known-backlog pin —
+                    # only REAL backlog knowledge may
+                    self.caught_up = cu
+            elif self.caught_up is False and b.num_rows:
+                # probe-less reader delivered rows again: the crash-time
+                # pin is served (the re-read reached the consumer path);
+                # fall back to wall-clock idleness judgment
+                self.caught_up = None
+            if b.num_rows:
+                # stamp BEFORE the (possibly blocking) slot acquire:
+                # while waiting for the consumer the partition has
+                # pending work and must read as active
+                self.enq_wall = time.monotonic()
+                self.enq_rowful += 1
+            snap = reader.offset_snapshot()
+            if not self._acquire_slot():
+                return  # shutdown won
+            self._q.put((self.idx, snap, b))
+            self._last_snap = snap
 
 
 class PrefetchPump:
@@ -170,6 +396,10 @@ class PrefetchPump:
         queue_budget: int = 64,
         depth: int | None = None,
         read_timeout_s: float = 0.1,
+        reader_factories: list | None = None,
+        restart_budget: int = 5,
+        global_restart_budget: int | None = None,
+        restart_heal_s: float = 60.0,
     ) -> None:
         if depth is None:
             # split the aggregate budget across partitions; never below a
@@ -178,10 +408,32 @@ class PrefetchPump:
             depth = max(2, min(16, queue_budget // max(1, len(readers))))
         self._q: queue_mod.Queue = queue_mod.Queue()
         self._done = threading.Event()
+        if global_restart_budget is None:
+            # generous enough for independent per-partition hiccups, small
+            # enough that a systemic failure (broker gone for good) cannot
+            # retry forever across N partitions
+            global_restart_budget = max(8, 2 * len(readers))
+        self._global_budget = _RestartBudget(global_restart_budget)
+        # None (the documented sentinel) disables supervision; an empty
+        # LIST from a buggy partition_factories() must hit the length
+        # guard below, not silently disable restarts for every partition
+        factories = (
+            [None] * len(readers) if reader_factories is None
+            else reader_factories
+        )
+        if len(factories) != len(readers):
+            raise ValueError(
+                f"{len(factories)} reader factories for "
+                f"{len(readers)} readers"
+            )
         self.workers = [
             PrefetchWorker(
                 i, r, self._q, self._done,
                 depth=depth, read_timeout_s=read_timeout_s,
+                reader_factory=factories[i],
+                restart_budget=restart_budget,
+                global_budget=self._global_budget,
+                heal_after_s=restart_heal_s,
             )
             for i, r in enumerate(readers)
         ]
@@ -192,8 +444,57 @@ class PrefetchPump:
             w.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float | None = 5.0) -> list[int]:
+        """Shut the pump down for real: signal done, release every
+        worker's buffer slots (a worker blocked in ``_acquire_slot`` wakes
+        immediately instead of on its next 0.1s poll), join each worker,
+        and drain the ready queue so buffered batches/exceptions don't
+        outlive the query.  Returns the indexes of stragglers — workers
+        still alive after the join timeout (wedged in a native call) —
+        after logging them."""
         self._done.set()
+        for w in self.workers:
+            # over-releasing is harmless: the done flag gates the loop
+            w._slots.release(w._depth)
+        deadline = (
+            None if join_timeout_s is None
+            else time.monotonic() + join_timeout_s
+        )
+        stragglers = []
+        for w in self.workers:
+            t = (
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            w.join(t)
+            if w._thread is not None and w._thread.is_alive():
+                stragglers.append(w.idx)
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        if stragglers:
+            logger.warning(
+                "prefetch stop: %d worker(s) still alive after %.1fs "
+                "join timeout: %s",
+                len(stragglers), join_timeout_s or 0.0, stragglers,
+            )
+        return stragglers
+
+    def restart_stats(self) -> dict:
+        """Supervisor observability, aggregated into SourceExec.metrics()."""
+        per = {w.idx: w.restarts for w in self.workers if w.restarts}
+        return {
+            "restarts": sum(per.values()),
+            "restarted_partitions": len(per),
+            "per_partition": per,
+            "last_errors": {
+                w.idx: w.last_error
+                for w in self.workers if w.last_error
+            },
+            "global_budget_remaining": self._global_budget.remaining(),
+        }
 
     def get(self):
         return self._q.get()

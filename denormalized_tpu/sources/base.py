@@ -141,6 +141,16 @@ class Source:
     def partitions(self) -> list[PartitionReader]:
         raise NotImplementedError
 
+    def partition_factories(self) -> "list | None":
+        """Optional per-partition reader factories for the prefetch
+        supervisor: element ``i`` is a zero-arg callable rebuilding
+        partition ``i``'s reader after its worker crashed (the supervisor
+        then seeks the fresh reader to the last enqueued offset snapshot
+        via ``offset_restore``).  ``None`` (default) disables supervised
+        restarts for this source — a worker crash surfaces as a query
+        error, the pre-supervisor behavior."""
+        return None
+
     @property
     def unbounded(self) -> bool:
         return True

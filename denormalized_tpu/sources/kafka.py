@@ -33,6 +33,7 @@ from denormalized_tpu.formats.json_codec import (
 )
 from denormalized_tpu.native.build import load
 from denormalized_tpu.physical.simple_execs import Sink
+from denormalized_tpu.runtime import faults
 from denormalized_tpu.runtime.tracing import logger
 from denormalized_tpu.sources.base import (
     PartitionReader,
@@ -250,6 +251,8 @@ class KafkaClient:
     def produce(self, topic: str, partition: int, payloads: list[bytes]):
         if not payloads:
             return
+        if faults.armed():  # unarmed path builds no key string
+            faults.inject("kafka.produce", key=f"{topic}:{partition}")
         data = b"".join(payloads)
         offs = np.zeros(len(payloads) + 1, dtype=np.uint64)
         offs[1:] = np.cumsum([len(p) for p in payloads], dtype=np.uint64)
@@ -285,6 +288,8 @@ class KafkaClient:
         return payloads, ts, int(lib.kc_next_offset(self._h))
 
     def _fetch_raw(self, topic, partition, offset, max_bytes, max_wait_ms) -> int:
+        if faults.armed():  # unarmed path builds no key string
+            faults.inject("kafka.fetch", key=f"{topic}:{partition}")
         n = self._libref.kc_fetch(
             self._handle(), topic.encode(), partition, offset, max_bytes, max_wait_ms
         )
@@ -585,13 +590,7 @@ class KafkaPartitionReader(PartitionReader):
             self._consecutive_failures = 0  # future reads retry again
             raise err
         self._caught_up = None  # broker unreachable: backlog unknown
-        old = self._client
-        self._client = None  # never reuse a possibly-freed handle
-        if old is not None:
-            try:
-                old.close()
-            except Exception:
-                pass
+        self.close()  # never reuse a possibly-freed handle
         try:
             self._client = KafkaClient(
                 self._src.builder.bootstrap_servers,
@@ -608,6 +607,13 @@ class KafkaPartitionReader(PartitionReader):
         configured timestamp_unit to epoch-ms) or the broker record
         timestamp, which the wire protocol defines as ms
         (kafka_stream_read.rs:222-266)."""
+        # decoder-output fault site: fires once per rowful decoded batch
+        # on BOTH decode paths.  A (default, non-transport) error here
+        # escapes the reader and exercises the prefetch supervisor; the
+        # advanced fetch cursor is safe because the supervisor reseeks the
+        # rebuilt reader to the last ENQUEUED snapshot.
+        if faults.armed():  # unarmed path builds no key string
+            faults.inject("decode", key=f"{self._topic}:{self._partition}")
         if self._ts_col is not None:
             from denormalized_tpu.sources.base import normalize_ts_to_ms
 
@@ -815,6 +821,18 @@ class KafkaPartitionReader(PartitionReader):
         fetch yet, or reconnecting)."""
         return self._caught_up
 
+    def close(self) -> None:
+        """Release the native client connection — the prefetch supervisor
+        calls this on the crashed reader it replaces, so restarts never
+        leak broker sockets/arena handles until interpreter exit."""
+        old = self._client
+        self._client = None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+
     def decode_fallback_rows(self) -> int:
         # the decoder counts rows it pushed through the Python path (the
         # zero-copy native arena parse never touches the decoder's
@@ -888,6 +906,15 @@ class KafkaSource(Source):
             KafkaPartitionReader(self, p) for p in range(self._npartitions)
         ]
 
+    def partition_factories(self) -> list:
+        """Per-partition rebuild hooks for the prefetch supervisor: a
+        fresh reader opens its own native client connection, then the
+        supervisor seeks it to the last enqueued offset snapshot."""
+        return [
+            (lambda p=p: KafkaPartitionReader(self, p))
+            for p in range(self._npartitions)
+        ]
+
     @property
     def unbounded(self) -> bool:
         return True
@@ -934,6 +961,7 @@ class KafkaSinkWriter(Sink):
         payloads = self._encoder.encode(batch)
         if not payloads:
             return
+        faults.inject("sink.write", key=self._topic)
         self._client.produce(self._topic, self._rr, payloads)
         self._rr = (self._rr + 1) % self._npartitions
 

@@ -20,6 +20,8 @@ import zlib
 from pathlib import Path
 
 from denormalized_tpu.common.errors import StateError
+from denormalized_tpu.runtime import faults
+from denormalized_tpu.runtime.tracing import logger
 
 _NATIVE_SRC = Path(__file__).resolve().parent.parent / "native" / "lsmkv.cpp"
 _BUILD_LOCK = threading.Lock()
@@ -29,6 +31,11 @@ _LIB_FAILED = False
 
 def _load_native():
     global _LIB, _LIB_FAILED
+    if os.environ.get("DENORMALIZED_LSM_PY"):
+        # force the pure-Python engine (chaos soak / tests: its replay
+        # accounting and torn-tail handling must be exercisable on boxes
+        # where the native build exists)
+        return None
     if _LIB is not None or _LIB_FAILED:
         return _LIB
     with _BUILD_LOCK:
@@ -114,9 +121,22 @@ class LsmStore:
             self._py = _PyLsm(self.path)
         self._closed = False
 
+    def _check_open(self) -> None:
+        """Every op checks this FIRST: a put/get/delete/flush on a closed
+        native store would hand ctypes a freed handle — a potential
+        segfault, not a Python error — so the guard must precede any
+        native call."""
+        if self._closed:
+            raise StateError("state backend closed")
+
     # -- API (mirrors SlateDBWrapper::{put,get,close}) -------------------
     def put(self, key: str | bytes, value: bytes) -> None:
+        self._check_open()
         k = key.encode() if isinstance(key, str) else key
+        if faults.armed():  # unarmed path builds no key string
+            value = faults.inject(
+                "lsm.put", key=k.decode("utf-8", "replace"), payload=value
+            )
         if self._lib:
             if self._lib.lsm_put(self._h, k, len(k), value, len(value)) != 0:
                 raise StateError("put failed")
@@ -124,7 +144,10 @@ class LsmStore:
             self._py.put(k, value)
 
     def get(self, key: str | bytes) -> bytes | None:
+        self._check_open()
         k = key.encode() if isinstance(key, str) else key
+        if faults.armed():  # unarmed path builds no key string
+            faults.inject("lsm.get", key=k.decode("utf-8", "replace"))
         if self._lib:
             out = ctypes.POINTER(ctypes.c_uint8)()
             n = self._lib.lsm_get(self._h, k, len(k), ctypes.byref(out))
@@ -137,6 +160,7 @@ class LsmStore:
         return self._py.get(k)
 
     def delete(self, key: str | bytes) -> None:
+        self._check_open()
         k = key.encode() if isinstance(key, str) else key
         if self._lib:
             self._lib.lsm_delete(self._h, k, len(k))
@@ -144,6 +168,7 @@ class LsmStore:
             self._py.delete(k)
 
     def keys(self) -> list[bytes]:
+        self._check_open()
         if self._lib:
             out = ctypes.POINTER(ctypes.c_uint8)()
             n = self._lib.lsm_keys(self._h, ctypes.byref(out))
@@ -155,17 +180,21 @@ class LsmStore:
         return self._py.keys()
 
     def __len__(self) -> int:
+        self._check_open()
         if self._lib:
             return int(self._lib.lsm_count(self._h))
         return len(self._py.index)
 
     def flush(self) -> None:
+        self._check_open()
+        faults.inject("lsm.flush")
         if self._lib:
             self._lib.lsm_flush(self._h)
         else:
             self._py.flush()
 
     def compact(self) -> None:
+        self._check_open()
         if self._lib:
             if self._lib.lsm_compact(self._h) != 0:
                 raise StateError("compact failed")
@@ -185,6 +214,15 @@ class LsmStore:
     def is_native(self) -> bool:
         return self._lib is not None
 
+    @property
+    def replay_truncated(self) -> int:
+        """How many torn segment tails startup replay dropped (0 on the
+        native engine, whose replay truncation happens inside lsmkv.cpp
+        and is not counted here).  A nonzero value after recovery is the
+        signal that a crash landed mid-append — expected after SIGKILL,
+        alarming after a clean shutdown."""
+        return self._py.replay_truncated if self._py is not None else 0
+
 
 class _PyLsm:
     """Pure-Python fallback speaking the exact same segment format."""
@@ -195,6 +233,10 @@ class _PyLsm:
         self.dir = Path(path)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.index: dict[bytes, tuple[int, int, int]] = {}
+        #: torn segment tails dropped by startup replay — recovery after a
+        #: crash mid-append is EXPECTED to bump this; a silent count was
+        #: the old behavior and hid real tears from every operator
+        self.replay_truncated = 0
         segs = sorted(
             int(p.name[4:12]) for p in self.dir.glob("seg-*.log")
         )
@@ -211,12 +253,16 @@ class _PyLsm:
         off = 0
         with open(self._seg(seg), "rb") as f:
             data = f.read()
+        torn_at = None
         while off + 13 <= len(data):
             crc, klen, vlen, tomb = self._HDR.unpack_from(data, off)
             end = off + 13 + klen + vlen
-            if end > len(data):
-                break
-            if zlib.crc32(data[off + 4 : end]) != crc:
+            if end > len(data) or zlib.crc32(data[off + 4 : end]) != crc:
+                # torn tail: every byte from here on is untrusted (records
+                # are not self-synchronizing, so resyncing past a bad CRC
+                # could resurrect stale garbage as live records) — keep
+                # the truncation semantics, but LOUDLY
+                torn_at = off
                 break
             key = data[off + 13 : off + 13 + klen]
             if tomb:
@@ -224,6 +270,16 @@ class _PyLsm:
             else:
                 self.index[key] = (seg, off + 13 + klen, vlen)
             off = end
+        if torn_at is None and off < len(data):
+            torn_at = off  # trailing partial header (< 13 bytes)
+        if torn_at is not None:
+            self.replay_truncated += 1
+            logger.warning(
+                "lsm %s: segment %d torn at offset %d — dropping %d "
+                "trailing byte(s) (crash mid-append; later records, if "
+                "any, are unrecoverable)",
+                self.dir, seg, torn_at, len(data) - torn_at,
+            )
 
     def _append(self, key: bytes, value: bytes, tomb: int):
         body = self._HDR.pack(0, len(key), len(value), tomb)[4:] + key + value

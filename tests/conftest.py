@@ -19,11 +19,59 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler
+import signal
+import sys
+
 import numpy as np
 import pytest
 
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
+
+# -- env-gated per-test watchdog ------------------------------------------
+# DENORMALIZED_TEST_TIMEOUT_S=<seconds> arms a SIGALRM per test that dumps
+# EVERY thread's stack via faulthandler before failing the test.  The
+# tier-1 runner once wedged inside test_idle_watermark and produced
+# nothing but an 870s timeout kill (CHANGES.md PR 1) — a wedge must
+# produce stacks, not silence.  Off by default: SIGALRM only exists on
+# the main thread and some environments (debuggers) own it.
+#
+# SIGALRM's Python-level handler only runs between bytecodes on the main
+# thread, so a main thread wedged INSIDE a blocking native call (stuck
+# ctypes lsm_*/kc_fetch) would defer it forever — exactly the wedge class
+# this exists for.  faulthandler.dump_traceback_later runs on a dedicated
+# C watchdog thread and needs no bytecode, so it backstops that case:
+# stacks dump and the process exits (a native wedge cannot be failed
+# test-by-test anyway).
+_TEST_TIMEOUT_S = float(os.environ.get("DENORMALIZED_TEST_TIMEOUT_S", 0) or 0)
+
+if _TEST_TIMEOUT_S > 0:
+
+    @pytest.fixture(autouse=True)
+    def _test_watchdog(request):
+        def _on_alarm(signum, frame):
+            sys.stderr.write(
+                f"\n=== watchdog: {request.node.nodeid} exceeded "
+                f"{_TEST_TIMEOUT_S}s — all thread stacks follow ===\n"
+            )
+            faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
+            raise TimeoutError(
+                f"test exceeded DENORMALIZED_TEST_TIMEOUT_S="
+                f"{_TEST_TIMEOUT_S}s (thread stacks dumped to stderr)"
+            )
+
+        prev = signal.signal(signal.SIGALRM, _on_alarm)
+        signal.setitimer(signal.ITIMER_REAL, _TEST_TIMEOUT_S)
+        faulthandler.dump_traceback_later(
+            _TEST_TIMEOUT_S + 10, exit=True, file=sys.stderr
+        )
+        try:
+            yield
+        finally:
+            faulthandler.cancel_dump_traceback_later()
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, prev)
 
 
 @pytest.fixture

@@ -1,0 +1,636 @@
+"""Checkpoint integrity + epoch fallback: snapshot headers catch torn and
+corrupt blobs, commit retains the previous epoch, and restore degrades to
+it — loudly — instead of bricking (or worse, silently loading garbage)."""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.constants import WINDOW_START_COLUMN
+from denormalized_tpu.common.errors import StateError
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.checkpoint import (
+    CheckpointCoordinator,
+    frame_snapshot,
+)
+from denormalized_tpu.state.lsm import LsmStore, close_global_state_backend
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_backend():
+    yield
+    close_global_state_backend()
+
+
+# -- unit level ------------------------------------------------------------
+
+
+def test_snapshot_blobs_framed_and_verified(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    coord.put_snapshot("offsets_0", 5, b'{"partitions": [1, 2]}')
+    raw = be.get("offsets_0@5")
+    assert raw.startswith(b"DNZ1") and raw != b'{"partitions": [1, 2]}'
+    coord.commit(5)
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.committed_epoch == 5
+    assert not coord2.restored_from_fallback
+    assert coord2.get_snapshot("offsets_0") == b'{"partitions": [1, 2]}'
+    be2.close()
+
+
+def test_legacy_headerless_checkpoint_still_restores(tmp_path):
+    """A checkpoint written by the pre-header code (raw blobs, no
+    manifest, no history) must restore unchanged."""
+    be = LsmStore(str(tmp_path / "kv"))
+    be.put("offsets_0@7", b'{"epoch": 7, "partitions": [{"i": 3}]}')
+    be.put("window_1@7", b"\x00binary-legacy-snapshot\xff")
+    be.put("committed_epoch", b"7")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be2)
+    assert coord.committed_epoch == 7
+    assert not coord.restored_from_fallback
+    assert coord.get_snapshot("offsets_0") == (
+        b'{"epoch": 7, "partitions": [{"i": 3}]}'
+    )
+    assert coord.get_snapshot("window_1") == b"\x00binary-legacy-snapshot\xff"
+    be2.close()
+
+
+def test_commit_retains_last_two_epochs(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2, 3):
+        coord.put_snapshot("k", epoch, f"blob{epoch}".encode())
+        coord.commit(epoch)
+    assert coord.committed_history == [2, 3]
+    assert be.get("k@1") is None and be.get("manifest@1") is None
+    assert be.get("k@2") is not None and be.get("k@3") is not None
+    be.close()
+
+
+def test_corrupt_committed_epoch_falls_back_to_previous(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, f"snap{epoch}".encode())
+        coord.commit(epoch)
+    # torn write at the committed epoch: header present, payload truncated
+    be.put("offsets_0@2", frame_snapshot(b"snap2")[:-2])
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.restored_from_fallback
+    assert coord2.committed_epoch == 1
+    assert coord2.restored_epoch == 1
+    assert coord2.get_snapshot("offsets_0") == b"snap1"
+    be2.close()
+
+
+def test_missing_snapshot_blob_falls_back(tmp_path):
+    """The manifest makes MISSING blobs detectable, not just corrupt
+    ones."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, b"a")
+        coord.put_snapshot("window_1", epoch, b"b")
+        coord.commit(epoch)
+    be.delete("window_1@2")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.restored_from_fallback and coord2.committed_epoch == 1
+    be2.close()
+
+
+def test_torn_commit_record_keeps_retention_depth(tmp_path):
+    """Review-found regression: repairing a torn commit record to the
+    newest INTACT epoch used to collapse history to depth 1, GC-ing the
+    older intact epoch — a second crash that corrupts the repaired-to
+    epoch then had nothing left to fall back to."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, f"snap{epoch}".encode())
+        coord.commit(epoch)
+    be.put("committed_epoch", b"2x-torn")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.committed_epoch == 2  # newest intact epoch, via history
+    assert coord2.committed_history == [1, 2]  # depth preserved
+    assert be2.get("offsets_0@1") is not None  # older epoch NOT GC'd
+    be2.close()
+    # second crash corrupts the repaired-to epoch before any new commit:
+    # recovery must still land on epoch 1
+    be3 = LsmStore(str(tmp_path / "kv"))
+    be3.put("offsets_0@2", frame_snapshot(b"snap2")[:-2])
+    be3.flush()
+    be3.close()
+    be4 = LsmStore(str(tmp_path / "kv"))
+    coord4 = CheckpointCoordinator(be4)
+    assert coord4.restored_from_fallback and coord4.committed_epoch == 1
+    assert coord4.get_snapshot("offsets_0") == b"snap1"
+    be4.close()
+
+
+def test_fallback_decision_survives_a_second_crash(tmp_path):
+    """Review-found bug: after a fallback restore GC'd the corrupt
+    committed epoch, the on-disk commit record still pointed at it — a
+    second crash before the next commit would then 'verify' the
+    now-empty epoch vacuously and restore empty state.  The fallback
+    decision must be durable."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, f"snap{epoch}".encode())
+        coord.commit(epoch)
+    be.put("offsets_0@2", frame_snapshot(b"snap2")[:-2])
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.restored_from_fallback and coord2.committed_epoch == 1
+    be2.close()  # crash again: NO new commit happened
+    be3 = LsmStore(str(tmp_path / "kv"))
+    coord3 = CheckpointCoordinator(be3)
+    assert coord3.committed_epoch == 1
+    assert coord3.get_snapshot("offsets_0") == b"snap1"  # state, not void
+    be3.close()
+
+
+def test_blob_torn_below_magic_size_detected(tmp_path):
+    """Review-found bug: a framed blob torn to < 4 bytes loses the magic
+    and used to pass as 'legacy headerless' — exactly the corruption the
+    header exists to catch."""
+    from denormalized_tpu.state.checkpoint import unframe_snapshot
+
+    for cut in (0, 1, 2, 3):
+        ok, _ = unframe_snapshot(frame_snapshot(b"payload")[:cut])
+        assert not ok, f"{cut}-byte torn blob passed as legacy"
+    # tiny LEGACY payloads that are not magic prefixes stay readable
+    ok, payload = unframe_snapshot(b"{}")
+    assert ok and payload == b"{}"
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, b"snap")
+        coord.commit(epoch)
+    be.put("offsets_0@2", b"DN")  # torn below the magic
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.restored_from_fallback and coord2.committed_epoch == 1
+    be2.close()
+
+
+def _two_epoch_store(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, b"ok")
+        coord.commit(epoch)
+    be.flush()
+    be.close()
+    return LsmStore(str(tmp_path / "kv"))
+
+
+def test_transient_read_error_during_verify_retries_and_keeps_epoch(
+    tmp_path
+):
+    """Verification reads retry transient StateError: one momentary
+    hiccup must NOT durably discard (fallback + GC) an intact newest
+    epoch."""
+    from denormalized_tpu.runtime import faults
+
+    be2 = _two_epoch_store(tmp_path)
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.get", "kind": "error", "key_substr": "offsets_0@2",
+         "times": 1},
+    ]})
+    try:
+        coord2 = CheckpointCoordinator(be2)
+    finally:
+        faults.disarm()
+    assert not coord2.restored_from_fallback
+    assert coord2.committed_epoch == 2
+    assert coord2.get_snapshot("offsets_0") == b"ok"
+    be2.close()
+
+
+def test_transient_commit_record_read_retries(tmp_path):
+    """Review-found gap: the construction-time reads of the commit
+    record/history bypassed the transient retry, so one hiccup aborted
+    recovery even with intact epochs on disk."""
+    from denormalized_tpu.runtime import faults
+
+    be2 = _two_epoch_store(tmp_path)
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.get", "kind": "error",
+         "key_substr": "committed_epoch", "times": 1},
+    ]})
+    try:
+        coord2 = CheckpointCoordinator(be2)
+    finally:
+        faults.disarm()
+    assert coord2.committed_epoch == 2
+    assert not coord2.restored_from_fallback
+    be2.close()
+
+
+def test_transient_read_error_during_operator_restore_retries(tmp_path):
+    """Review-found gap: get_snapshot used a bare backend.get, so one
+    transient hiccup during operator restore aborted recovery of an
+    epoch that construction had just verified intact."""
+    from denormalized_tpu.runtime import faults
+
+    be2 = _two_epoch_store(tmp_path)
+    coord2 = CheckpointCoordinator(be2)
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.get", "kind": "error", "key_substr": "offsets_0@2",
+         "times": 1},
+    ]})
+    try:
+        assert coord2.get_snapshot("offsets_0") == b"ok"
+    finally:
+        faults.disarm()
+    be2.close()
+
+
+def test_persistent_read_error_during_verify_falls_back(tmp_path):
+    """When retries are exhausted the epoch fails verification and
+    fallback proceeds — recovery is never aborted outright."""
+    from denormalized_tpu.runtime import faults
+
+    be2 = _two_epoch_store(tmp_path)
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.get", "kind": "error", "key_substr": "offsets_0@2"},
+    ]})
+    try:
+        coord2 = CheckpointCoordinator(be2)
+    finally:
+        faults.disarm()
+    assert coord2.restored_from_fallback and coord2.committed_epoch == 1
+    assert coord2.get_snapshot("offsets_0") == b"ok"
+    be2.close()
+
+
+def test_torn_commit_record_on_legacy_store_discovers_or_fails_loudly(
+    tmp_path
+):
+    """Review-found regression: a torn committed_epoch record on a
+    history-less (pre-history) store used to restore EMPTY state
+    silently.  Intact epoch snapshots must be discovered from the keys;
+    with nothing usable, construction fails loudly."""
+    be = LsmStore(str(tmp_path / "kv"))
+    be.put("offsets_0@9", b"legacy-snap")
+    be.put("committed_epoch", b"9x-torn")  # present but unparseable
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be2)
+    assert coord.committed_epoch == 9  # discovered from the key suffixes
+    assert coord.restored_from_fallback  # degraded restore is flagged
+    assert coord.get_snapshot("offsets_0") == b"legacy-snap"
+    be2.close()
+
+    be3 = LsmStore(str(tmp_path / "kv2"))
+    be3.put("committed_epoch", b"garbage")  # no snapshots at all
+    be3.flush()
+    be3.close()
+    be4 = LsmStore(str(tmp_path / "kv2"))
+    with pytest.raises(StateError, match="refusing to silently restore"):
+        CheckpointCoordinator(be4)
+    be4.close()
+
+
+def test_commit_gc_sweeps_prior_incarnation_epochs(tmp_path):
+    """Review-found leak: commit GC only knew THIS incarnation's writes,
+    so epochs restored from a previous process stayed on disk for the
+    process lifetime once they left the retention window."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("k", epoch, f"blob{epoch}".encode())
+        coord.commit(epoch)
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)  # inherits epochs {1, 2}
+    for epoch in (3, 4):
+        coord2.put_snapshot("k", epoch, f"blob{epoch}".encode())
+        coord2.commit(epoch)
+    for old in (1, 2):
+        assert be2.get(f"k@{old}") is None, f"epoch {old} leaked"
+        assert be2.get(f"manifest@{old}") is None
+    assert be2.get("k@3") is not None and be2.get("k@4") is not None
+    be2.close()
+
+
+def test_commit_does_not_gc_future_epoch_snapshots(tmp_path):
+    """Review-found corruption: snapshots for a LATER barrier can land
+    before the current marker fully aligns (join inputs are pumped by
+    threads — one side's source can inject barrier E+1 and persist its
+    offsets while E is still draining).  commit(E) must not classify
+    E+1 as stale: deleting its blobs leaves commit(E+1) with a partial
+    manifest that verifies vacuously and a restore without offsets."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("k", epoch, f"blob{epoch}".encode())
+        coord.commit(epoch)
+    # the faster side persists epoch-4 offsets before epoch 3 commits
+    coord.put_snapshot("offsets_0", 4, b"future-offsets")
+    coord.put_snapshot("k", 3, b"blob3")
+    coord.commit(3)
+    assert be.get("offsets_0@4") is not None, "future epoch GC'd"
+    coord.put_snapshot("k", 4, b"blob4")
+    coord.commit(4)
+    assert json.loads(be.get("manifest@4").decode()) == ["k", "offsets_0"]
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.committed_epoch == 4
+    assert not coord2.restored_from_fallback
+    assert coord2.get_snapshot("offsets_0") == b"future-offsets"
+    be2.close()
+
+
+def test_transient_error_in_post_commit_gc_does_not_fail_commit(tmp_path):
+    """Review-found abort: the post-commit GC reads/deletes sat outside
+    the commit retry, so a transient StateError AFTER the commit record
+    was durable propagated out of commit() and killed the query over
+    harmless cleanup.  GC is best-effort; leftovers wait for the next
+    startup sweep."""
+    from denormalized_tpu.runtime import faults
+
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("k", epoch, f"blob{epoch}".encode())
+        coord.commit(epoch)
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)  # inherits epochs {1, 2}
+    coord2.put_snapshot("k", 3, b"blob3")
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.get", "kind": "error", "key_substr": "manifest@1"},
+    ]})
+    try:
+        coord2.commit(3)  # must not raise: the record is already durable
+    finally:
+        faults.disarm()
+    assert coord2.committed_epoch == 3
+    assert coord2.committed_history == [2, 3]
+    be2.close()
+    be3 = LsmStore(str(tmp_path / "kv"))
+    coord3 = CheckpointCoordinator(be3)
+    assert coord3.committed_epoch == 3
+    assert be3.get("k@1") is None and be3.get("manifest@1") is None
+    be3.close()
+
+
+def test_discovery_prefers_manifested_then_oldest_legacy(tmp_path):
+    """Review-found hole: with a torn commit record, discovery must not
+    trust the NEWEST manifest-less epoch (it may be a half-written
+    barrier — a mixed cut).  Manifested epochs are provably complete
+    (newest first); pure-legacy stores fall back to the OLDEST epoch,
+    which under legacy GC-on-commit is the committed one."""
+    # pure legacy: epochs 5 (committed) and 6 (half-written) on disk
+    be = LsmStore(str(tmp_path / "kv"))
+    be.put("offsets_0@5", b"five")
+    be.put("offsets_0@6", b"six-partial")
+    be.put("committed_epoch", b"torn!")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be2)
+    assert coord.committed_epoch == 5  # oldest legacy, not the mixed cut
+    assert coord.get_snapshot("offsets_0") == b"five"
+    be2.close()
+
+    # with a manifest: epoch 6 is provably complete — prefer it
+    be3 = LsmStore(str(tmp_path / "kv2"))
+    be3.put("offsets_0@5", b"five")
+    be3.put("offsets_0@6", frame_snapshot(b"six"))
+    be3.put("manifest@6", json.dumps(["offsets_0"]).encode())
+    be3.put("committed_epoch", b"torn!")
+    be3.flush()
+    be3.close()
+    be4 = LsmStore(str(tmp_path / "kv2"))
+    coord2 = CheckpointCoordinator(be4)
+    assert coord2.committed_epoch == 6
+    assert coord2.get_snapshot("offsets_0") == b"six"
+    be4.close()
+
+
+def test_empty_manifest_epoch_fails_verification(tmp_path):
+    """Review-found asymmetry: a manifest listing ZERO keys verified
+    vacuously (the manifest-less path already rejects zero-snapshot
+    epochs) — selecting it would restore empty state while claiming an
+    intact restore."""
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    coord.put_snapshot("k", 1, b"real")
+    coord.commit(1)
+    be.put("manifest@2", b"[]")
+    be.put("committed_epoch", b"2")
+    be.put("committed_epoch_history", json.dumps([1, 2]).encode())
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.restored_from_fallback
+    assert coord2.committed_epoch == 1
+    assert coord2.get_snapshot("k") == b"real"
+    be2.close()
+
+
+def test_all_retained_epochs_corrupt_raises_loudly(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    for epoch in (1, 2):
+        coord.put_snapshot("offsets_0", epoch, b"payload")
+        coord.commit(epoch)
+    be.put("offsets_0@1", frame_snapshot(b"payload")[:-1])
+    be.put("offsets_0@2", b"DNZ1garbage")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    with pytest.raises(StateError, match="no intact checkpoint epoch"):
+        CheckpointCoordinator(be2)
+    be2.close()
+
+
+def test_startup_gc_sweeps_uncommitted_and_skipped_epochs(tmp_path):
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    coord.put_snapshot("k", 1, b"one")
+    coord.commit(1)
+    # a half-written barrier: epoch 2 snapshots exist, never committed
+    coord.put_snapshot("k", 2, b"two")
+    be.flush()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.committed_epoch == 1
+    assert be2.get("k@2") is None  # swept: unusable without a commit
+    assert coord2.get_snapshot("k") == b"one"
+    be2.close()
+
+
+# -- acceptance: corrupted blob on disk → fallback restore with emissions
+# byte-identical to an uncorrupted restore from that same epoch ------------
+
+
+def _pipeline(ctx, batches):
+    return ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+        name="fb_src",
+    ).window(
+        ["sensor_name"],
+        [
+            F.count(col("reading")).alias("cnt"),
+            F.sum(col("reading")).alias("s"),
+            F.min(col("reading")).alias("mn"),
+        ],
+        1000,
+    )
+
+
+def _make_cfg(path):
+    return EngineConfig(
+        checkpoint=path is not None,
+        checkpoint_interval_s=9999,
+        state_backend_path=path,
+        emit_lag_ms=0,
+    )
+
+
+def _emissions(state_dir, batches):
+    """Restore at ``state_dir``'s committed epoch, run to EOS, return
+    every emitted row as exact (bit-level for floats) tuples, plus the
+    coordinator."""
+    from denormalized_tpu.common.record_batch import RecordBatch as RB
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    ctx = Context(_make_cfg(state_dir))
+    root = executor.build_physical(
+        lp.Sink(_pipeline(ctx, batches)._plan, CollectSink()), ctx
+    )
+    orch = Orchestrator(interval_s=9999)
+    coord = wire_checkpointing(root, ctx, orch)
+    rows = []
+    for item in root.run():
+        if isinstance(item, RB):
+            for i in range(item.num_rows):
+                rows.append((
+                    int(item.column(WINDOW_START_COLUMN)[i]),
+                    str(item.column("sensor_name")[i]),
+                    int(item.column("cnt")[i]),
+                    float(item.column("s")[i]).hex(),
+                    float(item.column("mn")[i]).hex(),
+                ))
+    close_global_state_backend()
+    return rows, coord
+
+
+def test_fallback_restore_byte_identical_to_direct_previous_epoch(
+    tmp_path, make_batch
+):
+    """Crash with two committed epochs; corrupt one snapshot blob of the
+    LATEST.  The fallback restore (corrupt E2 → E1) must emit
+    byte-identically to a control restore pointed straight at E1 — the
+    fallback is exactly "restore from the previous epoch", nothing
+    more."""
+    from denormalized_tpu.common.record_batch import RecordBatch as RB
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    rng = np.random.default_rng(77)
+    t0 = 1_700_000_000_000
+    batches = []
+    for b in range(14):
+        n = 150
+        ts = np.sort(t0 + b * 400 + rng.integers(0, 400, n))
+        keys = np.array(
+            [f"s{i}" for i in rng.integers(0, 6, n)], dtype=object
+        )
+        batches.append(make_batch(ts, keys, rng.normal(50, 5, n)))
+
+    state = str(tmp_path / "state")
+    ctx_a = Context(_make_cfg(state))
+    root_a = executor.build_physical(
+        lp.Sink(_pipeline(ctx_a, batches)._plan, CollectSink()), ctx_a
+    )
+    orch_a = Orchestrator(interval_s=9999)
+    coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+    committed = []
+    items = 0
+    it = root_a.run()
+    for item in it:
+        if items in (1, 4):
+            orch_a.trigger_now()
+        if isinstance(item, Marker):
+            coord_a.commit(item.epoch)
+            committed.append(item.epoch)
+            if len(committed) == 2:
+                break  # crash with TWO committed epochs on disk
+        items += 1
+    it.close()
+    close_global_state_backend()
+    assert len(committed) == 2
+    e1, e2 = committed
+
+    # two copies of the crashed state: one with a corrupt blob at E2, one
+    # pointed directly at E1 (the uncorrupted restore-from-E1 control)
+    corrupt_dir = str(tmp_path / "corrupt")
+    control_dir = str(tmp_path / "control")
+    shutil.copytree(state, corrupt_dir)
+    shutil.copytree(state, control_dir)
+
+    be = LsmStore(corrupt_dir)
+    manifest = json.loads(be.get(f"manifest@{e2}").decode())
+    victim = sorted(manifest)[-1]  # deterministic pick of one blob
+    blob = be.get(f"{victim}@{e2}")
+    be.put(f"{victim}@{e2}", blob[: len(blob) // 2])  # torn on disk
+    be.flush()
+    be.close()
+
+    be = LsmStore(control_dir)
+    be.put("committed_epoch", str(e1).encode())
+    be.put("committed_epoch_history", json.dumps([e1]).encode())
+    be.flush()
+    be.close()
+
+    rows_fallback, coord_fb = _emissions(corrupt_dir, batches)
+    assert coord_fb.restored_from_fallback
+    assert coord_fb.restored_epoch == e1
+
+    rows_control, coord_ctl = _emissions(control_dir, batches)
+    assert not coord_ctl.restored_from_fallback
+    assert coord_ctl.restored_epoch == e1
+
+    assert rows_fallback == rows_control  # byte-identical emissions
+    assert len(rows_fallback) > 0

@@ -1,0 +1,366 @@
+"""Fault-injection framework: deterministic schedules, the fault sites at
+the I/O boundaries, and the self-healing paths they drive (kafka offset
+reset, commit retry, LSM guards)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.errors import SourceError, StateError
+from denormalized_tpu.runtime import faults
+from denormalized_tpu.state.lsm import LsmStore
+from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+SAMPLE = '{"ts": 1, "i": 1}'
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture
+def broker():
+    b = MockKafkaBroker().start()
+    try:
+        yield b
+    finally:
+        b.stop()
+
+
+def _drive(plan, n=200):
+    """Fixed synthetic call sequence → event log."""
+    for i in range(n):
+        try:
+            plan.on("kafka.fetch", key="t:0")
+        except SourceError:
+            pass
+        if i % 10 == 0:
+            plan.on("lsm.put", key=f"win@{i}", payload=b"v" * 32)
+    return plan.event_log()
+
+
+def _spec():
+    return {
+        "seed": 99,
+        "rules": [
+            {"site": "kafka.fetch", "kind": "error", "prob": 0.1,
+             "times": 5, "message": "recv: flap"},
+            {"site": "lsm.put", "kind": "torn", "key_substr": "@",
+             "prob": 0.5, "times": 3},
+        ],
+    }
+
+
+def test_same_seed_reproduces_same_injection_sequence():
+    log_a = _drive(faults.FaultPlan(_spec()))
+    log_b = _drive(faults.FaultPlan(_spec()))
+    assert log_a and log_a == log_b
+    # a different seed produces a different sequence (prob draws differ)
+    other = _spec()
+    other["seed"] = 100
+    assert _drive(faults.FaultPlan(other)) != log_a
+
+
+def test_rule_schedule_times_after_and_heal():
+    plan = faults.FaultPlan({"seed": 1, "rules": [
+        {"site": "kafka.fetch", "kind": "error", "after": 3, "times": 2},
+    ]})
+    outcomes = []
+    for _ in range(10):
+        try:
+            plan.on("kafka.fetch")
+            outcomes.append("ok")
+        except SourceError:
+            outcomes.append("err")
+    # skips the first 3, fires exactly twice, then heals forever
+    assert outcomes == ["ok"] * 3 + ["err"] * 2 + ["ok"] * 5
+
+
+def test_torn_payload_truncates_deterministically():
+    plan = faults.FaultPlan({"seed": 5, "rules": [
+        {"site": "lsm.put", "kind": "torn", "times": 1},
+    ]})
+    out = plan.on("lsm.put", key="k", payload=b"x" * 100)
+    assert len(out) < 100
+    plan2 = faults.FaultPlan({"seed": 5, "rules": [
+        {"site": "lsm.put", "kind": "torn", "times": 1},
+    ]})
+    assert plan2.on("lsm.put", key="k", payload=b"x" * 100) == out
+
+
+def test_torn_rule_keeps_budget_on_payloadless_call():
+    """Review-found hole: a torn rule matching a payload-less site used
+    to consume its `times` budget and log a vacuous 'fired' event — the
+    planned tear then silently never happened."""
+    plan = faults.FaultPlan({"seed": 5, "rules": [
+        {"site": "*", "kind": "torn", "times": 1},
+    ]})
+    assert plan.on("kafka.fetch") is None  # no payload: no fire
+    assert plan.on("lsm.flush", payload=b"") == b""
+    assert plan.event_log() == []
+    out = plan.on("lsm.put", key="win@3", payload=b"x" * 100)
+    assert len(out) < 100  # budget survived for the tear-able call
+    assert [e["site"] for e in plan.event_log()] == ["lsm.put"]
+
+
+def test_key_substr_restricts_match():
+    plan = faults.FaultPlan({"seed": 1, "rules": [
+        {"site": "lsm.put", "kind": "torn", "key_substr": "@"},
+    ]})
+    assert plan.on("lsm.put", key="committed_epoch", payload=b"5") == b"5"
+    assert plan.on("lsm.put", key="win@9", payload=b"abcdef") != b"abcdef"
+
+
+def test_unknown_exact_site_rejected():
+    """A typo'd exact site must fail at arm time, not arm a dead rule
+    that lets a chaos run report green without injecting anything."""
+    with pytest.raises(ValueError, match="matches no known site"):
+        faults.FaultPlan({"seed": 1, "rules": [{"site": "lsm.putt"}]})
+    with pytest.raises(ValueError, match="matches no known site"):
+        faults.FaultPlan({"seed": 1, "rules": [{"site": "kafk.*"}]})
+    # globs with a real prefix (and the match-all) stay valid
+    faults.FaultPlan({"seed": 1, "rules": [
+        {"site": "lsm.*"}, {"site": "*"},
+    ]})
+
+
+def test_unarmed_inject_is_identity():
+    assert faults.plan() is None
+    payload = b"payload"
+    assert faults.inject("lsm.put", key="k", payload=payload) is payload
+    assert faults.inject("kafka.fetch") is None
+
+
+def test_error_class_by_site_and_override():
+    plan = faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.put", "kind": "error", "times": 1},
+        {"site": "kafka.fetch", "kind": "error", "times": 1},
+        {"site": "kafka.produce", "kind": "error", "times": 1,
+         "error": "state"},
+    ]})
+    with pytest.raises(StateError):
+        faults.inject("lsm.put", key="k", payload=b"")
+    with pytest.raises(SourceError):
+        faults.inject("kafka.fetch")
+    with pytest.raises(StateError):
+        faults.inject("kafka.produce")
+    assert plan.fired_sites() == {
+        "lsm.put": 1, "kafka.fetch": 1, "kafka.produce": 1
+    }
+
+
+def test_env_arming(tmp_path, monkeypatch):
+    """Child processes receive the plan via DENORMALIZED_FAULT_PLAN —
+    inline JSON or @file."""
+    import subprocess
+    import sys
+
+    spec = json.dumps({"seed": 3, "rules": [
+        {"site": "lsm.put", "kind": "error", "times": 1},
+    ]})
+    code = (
+        "from denormalized_tpu.runtime import faults\n"
+        "assert faults.armed(), 'env plan not armed'\n"
+        "assert faults.plan().seed == 3\n"
+    )
+    env = {"PATH": "/usr/bin:/bin", "DENORMALIZED_FAULT_PLAN": spec,
+           "JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo"}
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    # @file spelling
+    p = tmp_path / "plan.json"
+    p.write_text(spec)
+    env["DENORMALIZED_FAULT_PLAN"] = f"@{p}"
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    # a malformed value must fail naming the env var, not as a bare
+    # JSONDecodeError deep inside an unrelated import chain
+    env["DENORMALIZED_FAULT_PLAN"] = "{bad json"
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True)
+    assert r.returncode != 0
+    assert "DENORMALIZED_FAULT_PLAN" in r.stderr
+
+
+# -- LSM satellites --------------------------------------------------------
+
+
+def test_lsm_use_after_close_raises_not_segfaults(tmp_path):
+    s = LsmStore(str(tmp_path / "kv"))
+    s.put("a", b"1")
+    s.close()
+    for op in (
+        lambda: s.put("b", b"2"),
+        lambda: s.get("a"),
+        lambda: s.delete("a"),
+        lambda: s.flush(),
+        lambda: s.keys(),
+        lambda: len(s),
+        lambda: s.compact(),
+    ):
+        with pytest.raises(StateError, match="closed"):
+            op()
+    s.close()  # second close stays a no-op
+
+
+def test_pylsm_replay_truncated_counter_and_warning(tmp_path, monkeypatch,
+                                                    caplog):
+    monkeypatch.setenv("DENORMALIZED_LSM_PY", "1")
+    s = LsmStore(str(tmp_path / "kv"))
+    assert not s.is_native
+    for i in range(5):
+        s.put(f"k{i}", bytes([i]) * 8)
+    s.flush()
+    s.close()
+    # torn tail: garbage appended after valid records
+    segs = sorted((tmp_path / "kv").glob("seg-*.log"))
+    with open(segs[-1], "ab") as f:
+        f.write(b"\xde\xad\xbe\xef torn tail garbage")
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="denormalized_tpu"):
+        s2 = LsmStore(str(tmp_path / "kv"))
+    assert s2.replay_truncated == 1
+    assert any(
+        "torn at offset" in r.getMessage() for r in caplog.records
+    )
+    # every valid record before the tear survives
+    for i in range(5):
+        assert s2.get(f"k{i}") == bytes([i]) * 8
+    s2.close()
+
+
+def test_lsm_fault_sites(tmp_path):
+    s = LsmStore(str(tmp_path / "kv"))
+    faults.arm({"seed": 1, "rules": [
+        {"site": "lsm.put", "kind": "error", "times": 1},
+        {"site": "lsm.get", "kind": "error", "times": 1},
+        {"site": "lsm.flush", "kind": "error", "times": 1},
+    ]})
+    with pytest.raises(StateError):
+        s.put("k", b"v")
+    with pytest.raises(StateError):
+        s.get("k")
+    with pytest.raises(StateError):
+        s.flush()
+    # healed: the store works again
+    s.put("k", b"v")
+    assert s.get("k") == b"v"
+    s.close()
+
+
+# -- kafka OFFSET_OUT_OF_RANGE reset path (previously untested) ------------
+
+
+def _reader(broker, topic, reset):
+    from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+
+    src = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic(topic)
+        .infer_schema_from_json(SAMPLE)
+        .with_timestamp_column("ts")
+        .with_option("auto.offset.reset", reset)
+        .build_reader()
+    )
+    return src.partitions()[0]
+
+
+def _rows(reader, want, deadline_s=10.0):
+    import time
+
+    seen = []
+    t0 = time.monotonic()
+    while len(seen) < want:
+        assert time.monotonic() - t0 < deadline_s, (len(seen), want)
+        b = reader.read(timeout_s=0.05)
+        if b is not None and b.num_rows:
+            seen.extend(int(v) for v in b.column("i"))
+    return seen
+
+
+def _produce(broker, topic, start, n):
+    broker.produce_batched(topic, 0, [
+        json.dumps({"ts": 1_700_000_000_000 + i, "i": i}).encode()
+        for i in range(start, start + n)
+    ], ts_ms=1_700_000_000_000)
+
+
+def test_offset_out_of_range_resets_to_earliest(broker, caplog):
+    import logging
+
+    broker.create_topic("oor_e", partitions=1)
+    _produce(broker, "oor_e", 0, 10)
+    r = _reader(broker, "oor_e", "earliest")
+    assert _rows(r, 10) == list(range(10))
+    faults.arm({"seed": 1, "rules": [
+        {"site": "kafka.fetch", "kind": "error", "times": 1,
+         "message": "fetch: fetch error 1 (injected OFFSET_OUT_OF_RANGE)"},
+    ]})
+    with caplog.at_level(logging.WARNING, logger="denormalized_tpu"):
+        b = r.read(timeout_s=0.05)  # absorbs the error, resets the cursor
+    assert b is not None and b.num_rows == 0
+    assert r._offset == 0
+    assert any("offset out of range" in r_.getMessage()
+               for r_ in caplog.records)
+    # at-least-once semantics of an earliest reset: the log replays
+    assert _rows(r, 10) == list(range(10))
+
+
+def test_offset_out_of_range_resets_to_latest(broker):
+    broker.create_topic("oor_l", partitions=1)
+    _produce(broker, "oor_l", 0, 10)
+    r = _reader(broker, "oor_l", "latest")
+    faults.arm({"seed": 1, "rules": [
+        {"site": "kafka.fetch", "kind": "error", "times": 1,
+         "message": "fetch: fetch error 1 (injected OFFSET_OUT_OF_RANGE)"},
+    ]})
+    b = r.read(timeout_s=0.05)
+    assert b is not None and b.num_rows == 0
+    assert r._offset == 10  # log-end offset: old records never replay
+    _produce(broker, "oor_l", 10, 5)
+    assert _rows(r, 5) == list(range(10, 15))
+
+
+# -- commit retry ----------------------------------------------------------
+
+
+def test_commit_retries_transient_state_error(tmp_path):
+    from denormalized_tpu.state.checkpoint import CheckpointCoordinator
+
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    coord.put_snapshot("offsets_0", 7, b'{"p": 1}')
+    faults.arm({"seed": 1, "rules": [
+        {"site": "checkpoint.commit", "kind": "error", "times": 1},
+    ]})
+    coord.commit(7)  # transient hiccup absorbed, not surfaced
+    assert coord.commit_retries == 1
+    assert coord.committed_epoch == 7
+    faults.disarm()
+    be.close()
+    be2 = LsmStore(str(tmp_path / "kv"))
+    coord2 = CheckpointCoordinator(be2)
+    assert coord2.committed_epoch == 7
+    assert coord2.get_snapshot("offsets_0") == b'{"p": 1}'
+    be2.close()
+
+
+def test_commit_gives_up_after_bounded_retries(tmp_path):
+    from denormalized_tpu.state.checkpoint import CheckpointCoordinator
+
+    be = LsmStore(str(tmp_path / "kv"))
+    coord = CheckpointCoordinator(be)
+    coord.put_snapshot("offsets_0", 7, b"x")
+    faults.arm({"seed": 1, "rules": [
+        {"site": "checkpoint.commit", "kind": "error"},  # unlimited
+    ]})
+    with pytest.raises(StateError):
+        coord.commit(7)
+    assert coord.commit_retries == 3
+    be.close()

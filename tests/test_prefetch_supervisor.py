@@ -1,0 +1,296 @@
+"""Prefetch supervisor: a worker crash mid-stream must heal — restart with
+backoff, rebuild the reader at the last ENQUEUED offset snapshot — without
+replaying rows the consumer already saw and without losing any; past the
+restart budget it must fail structurally, not hang."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.runtime import faults
+from denormalized_tpu.runtime.prefetch import (
+    PrefetchPump,
+    PrefetchRestartExhausted,
+)
+from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+T0 = 1_700_000_000_000
+SAMPLE = '{"ts": 1, "p": 1, "i": 1}'
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture
+def broker():
+    b = MockKafkaBroker().start()
+    try:
+        yield b
+    finally:
+        b.stop()
+
+
+def _source(broker, topic, **opts):
+    b = (
+        KafkaTopicBuilder(broker.bootstrap)
+        .with_topic(topic)
+        .infer_schema_from_json(SAMPLE)
+        .with_timestamp_column("ts")
+    )
+    for k, v in opts.items():
+        b = b.with_option(k, v)
+    return b.build_reader()
+
+
+def _fill(broker, topic, parts, rows_per_part, chunk=64):
+    broker.create_topic(topic, partitions=parts)
+    for p in range(parts):
+        for base in range(0, rows_per_part, chunk):
+            payloads = [
+                json.dumps({"ts": T0 + i * 3, "p": p, "i": i}).encode()
+                for i in range(base, min(base + chunk, rows_per_part))
+            ]
+            broker.produce_batched(topic, p, payloads, ts_ms=T0)
+
+
+def _drain_rows(pump, total_rows, deadline_s=30.0):
+    """→ {partition: [i...]} in consumption order."""
+    seen = {}
+    deadline = time.monotonic() + deadline_s
+    for idx, _snap, batch in pump.drain(
+        total_rows=total_rows, deadline=deadline
+    ):
+        part = int(batch.column("p")[0])
+        seen.setdefault(part, []).extend(int(v) for v in batch.column("i"))
+    return seen
+
+
+def test_worker_crash_recovers_no_lost_no_replayed_rows(broker):
+    """Injected crashes mid-stream (non-transport errors escape the
+    reader) recover via restart+reseek: each partition's row ids come out
+    exactly once, in order — the offset-snapshot restart contract."""
+    parts, rows = 2, 1500
+    _fill(broker, "sup", parts, rows)
+    src = _source(broker, "sup", **{"max.batch.rows": 128,
+                                    "fetch.coalesce.rows": 0})
+    faults.arm({"seed": 2, "rules": [
+        # first crash on the very first fetch anywhere (that partition
+        # cannot deliver a row without a successful restart), second a
+        # couple of fetches later — possibly mid-catch-up on the rebuilt
+        # reader
+        {"site": "kafka.fetch", "kind": "error", "times": 1,
+         "message": "injected worker crash A"},
+        {"site": "kafka.fetch", "kind": "error", "after": 2, "times": 1,
+         "message": "injected worker crash B"},
+    ]})
+    pump = PrefetchPump(
+        src.partitions(),
+        reader_factories=src.partition_factories(),
+        restart_budget=5,
+    ).start()
+    try:
+        seen = _drain_rows(pump, parts * rows)
+    finally:
+        stragglers = pump.stop(join_timeout_s=5.0)
+    assert stragglers == []
+    for p in range(parts):
+        assert seen[p] == list(range(rows)), (
+            f"partition {p}: dup or lost rows after supervised restart"
+        )
+    stats = pump.restart_stats()
+    # crash A's restart is guaranteed (its partition delivered nothing
+    # before the crash, and every row came out); crash B may land after
+    # the consumer already finished — racing the shutdown is fine, LOSING
+    # rows is not
+    assert 1 <= stats["restarts"] <= 2, stats
+    assert stats["restarted_partitions"] >= 1, stats
+    assert stats["last_errors"], stats
+
+
+def test_restart_budget_exhausted_escalates_structured_failure(broker):
+    """A permanently-failing partition surfaces PrefetchRestartExhausted
+    (partition + attempts + last error), not a hang and not a bare
+    reader exception."""
+    _fill(broker, "dead", 1, 200)
+    src = _source(broker, "dead")
+    faults.arm({"seed": 2, "rules": [
+        {"site": "kafka.fetch", "kind": "error",
+         "message": "injected permanent failure"},  # unlimited
+    ]})
+    pump = PrefetchPump(
+        src.partitions(),
+        reader_factories=src.partition_factories(),
+        restart_budget=2,
+    ).start()
+    try:
+        with pytest.raises(PrefetchRestartExhausted) as ei:
+            for _ in pump.drain(total_rows=200,
+                                deadline=time.monotonic() + 20):
+                pass
+        assert ei.value.partition == 0
+        assert ei.value.attempts == 2
+        assert "injected permanent failure" in str(ei.value.last_error)
+    finally:
+        pump.stop(join_timeout_s=5.0)
+
+
+def test_without_factories_crash_surfaces_verbatim(broker):
+    """No factories (sources that opt out) = the pre-supervisor contract:
+    the first worker exception reaches the consumer."""
+    _fill(broker, "nofac", 1, 100)
+    src = _source(broker, "nofac")
+    faults.arm({"seed": 2, "rules": [
+        {"site": "kafka.fetch", "kind": "error", "times": 1,
+         "message": "injected crash (unsupervised)"},
+    ]})
+    pump = PrefetchPump(src.partitions()).start()
+    try:
+        with pytest.raises(SourceError, match="unsupervised"):
+            for _ in pump.drain(total_rows=100,
+                                deadline=time.monotonic() + 20):
+                pass
+    finally:
+        pump.stop(join_timeout_s=5.0)
+
+
+def test_empty_factory_list_hits_length_guard(broker):
+    """Review-found hole: `reader_factories or ...` treated an empty
+    LIST like the None sentinel, silently disabling supervision for
+    every partition instead of raising the length-mismatch error."""
+    _fill(broker, "emptyfac", 1, 10)
+    src = _source(broker, "emptyfac")
+    with pytest.raises(ValueError, match="0 reader factories"):
+        PrefetchPump(src.partitions(), reader_factories=[])
+
+
+def test_restart_budget_heals_after_crash_free_interval(broker):
+    """Review-found design flaw: lifetime budgets guaranteed death for
+    any long-lived stream with occasional healed hiccups.  The streak
+    must reset (and global tokens refund) after a crash-free interval,
+    so two well-separated transient crashes survive a budget of 1."""
+    _fill(broker, "heal", 1, 400)
+    src = _source(broker, "heal")
+    faults.arm({"seed": 2, "rules": [
+        {"site": "kafka.fetch", "kind": "error", "times": 1,
+         "message": "injected hiccup one"},
+        # ~15 post-restart reads later (0.1s timeout each): well past the
+        # 0.3s heal interval below
+        {"site": "kafka.fetch", "kind": "error", "after": 15, "times": 1,
+         "message": "injected hiccup two"},
+    ]})
+    pump = PrefetchPump(
+        src.partitions(),
+        reader_factories=src.partition_factories(),
+        restart_budget=1,          # one restart per streak ONLY
+        global_restart_budget=1,   # and one global token
+        restart_heal_s=0.3,
+    ).start()
+    try:
+        seen = _drain_rows(pump, 400)
+        assert seen[0] == list(range(400))
+        deadline = time.monotonic() + 10
+        while pump.workers[0].restarts < 2:
+            assert time.monotonic() < deadline, pump.restart_stats()
+            time.sleep(0.05)
+        assert pump.workers[0].restarts == 2  # both hiccups healed
+    finally:
+        faults.disarm()
+        pump.stop(join_timeout_s=5.0)
+
+
+def test_restarting_partition_never_judged_idle(broker):
+    """Review-found bug: during backoff/rebuild a crashed partition used
+    to look idle (pending=False, stale first_read_done, caught_up=None),
+    so the watermark could advance over the rows the restart re-reads —
+    late-dropping them.  The crash must pin the partition as
+    known-backlog until the rebuilt reader's first fetch reports."""
+    _fill(broker, "idlepin", 1, 500)
+    src = _source(broker, "idlepin")
+    faults.arm({"seed": 2, "rules": [
+        # crash every fetch: the worker stays in backoff/rebuild loops
+        {"site": "kafka.fetch", "kind": "error",
+         "message": "injected permanent-ish failure"},
+    ]})
+    pump = PrefetchPump(
+        src.partitions(),
+        reader_factories=src.partition_factories(),
+        restart_budget=50,
+        global_restart_budget=50,
+    ).start()
+    try:
+        deadline = time.monotonic() + 5
+        saw_restart = False
+        while time.monotonic() < deadline:
+            w = pump.workers[0]
+            if w.restarts >= 1:
+                saw_restart = True
+                # in or between restarts: may_judge_idle must be False
+                # and the reader side must not be quiet
+                assert w.activity()[3] is False, w.activity()
+                assert not w.reader_quiet()
+                assert not pump.quiet()
+                if w.restarts >= 3:
+                    break
+            time.sleep(0.02)
+        assert saw_restart
+    finally:
+        faults.disarm()
+        pump.stop(join_timeout_s=5.0)
+
+
+def test_stop_joins_workers_and_drains_queue(broker):
+    """stop() must leave NO worker thread behind (live readers block-poll
+    an idle topic forever otherwise) and release queued batches."""
+    _fill(broker, "stopt", 2, 300)
+    src = _source(broker, "stopt")
+    before = {t.name for t in threading.enumerate()}
+    pump = PrefetchPump(src.partitions()).start()
+    # let workers enqueue up to their buffer depth, consumer never reads
+    time.sleep(0.5)
+    stragglers = pump.stop(join_timeout_s=5.0)
+    assert stragglers == []
+    after = {t.name for t in threading.enumerate()}
+    leaked = {n for n in after - before if n.startswith("prefetch-")}
+    assert not leaked, f"leaked worker threads: {leaked}"
+    assert pump._q.qsize() == 0  # drained: no batch refs outlive the query
+
+
+def test_supervisor_metrics_visible_in_source_exec(broker):
+    """SourceExec.metrics() must expose restart counts on the production
+    path (the acceptance-criteria observability hook)."""
+    from denormalized_tpu.physical.simple_execs import SourceExec
+    from denormalized_tpu.common.record_batch import RecordBatch
+
+    parts, rows = 2, 600
+    _fill(broker, "supm", parts, rows)
+    src = _source(broker, "supm", **{"max.batch.rows": 64,
+                                     "fetch.coalesce.rows": 0})
+    faults.arm({"seed": 2, "rules": [
+        # fires on the second fetch overall: a partition that still owes
+        # rows, so the restart always lands before the stream completes
+        {"site": "kafka.fetch", "kind": "error", "after": 1, "times": 1,
+         "message": "injected worker crash"},
+    ]})
+    exec_ = SourceExec(src, idle_timeout_ms=200)
+    n = 0
+    it = exec_.run()
+    deadline = time.monotonic() + 30
+    for item in it:
+        assert time.monotonic() < deadline, "stalled"
+        if isinstance(item, RecordBatch):
+            n += item.num_rows
+        if n >= parts * rows:
+            break
+    it.close()
+    m = exec_.metrics()
+    assert m["rows_out"] == parts * rows
+    assert m["prefetch_restarts"] == 1
+    assert m["prefetch_restarted_partitions"] == 1
+    assert m["prefetch_last_errors"], m

@@ -277,6 +277,106 @@ def kafka_prep_and_feed(args, total_batches, log):
 SESSION_GAP_MS = 300
 
 
+# -- chaos mode: the kafka exactly-once soak under an armed FaultPlan ----
+
+
+def chaos_plan(seed: int) -> dict:
+    """The chaos schedule layered over the kafka soak.  Counters reset
+    with each respawned child (the plan arms at import from the env), so
+    ``times`` caps are PER SEGMENT.  Rates are tuned to the soak's fetch
+    (~20/s across 2 partitions, much higher during post-kill catch-up)
+    and commit (~1 per SOAK_CKPT_S) cadences so every rule fires within
+    a kill interval."""
+    return {
+        "seed": seed,
+        "rules": [
+            # broker flap: transport-marker errors ride the reader's
+            # log-and-reconnect path, then heal
+            {"name": "fetch_flap", "site": "kafka.fetch", "kind": "error",
+             "message": "recv: injected broker flap", "prob": 0.01,
+             "times": 6},
+            # worker crash: a non-transport error escapes the reader and
+            # exercises the prefetch supervisor's restart-from-snapshot
+            {"name": "worker_crash", "site": "kafka.fetch", "kind": "error",
+             "message": "injected worker crash", "after": 250, "times": 1},
+            # torn state write: only epoch-suffixed snapshot blobs (the
+            # "@" restriction), caught by header verification at restore
+            # → epoch fallback.  ONE per segment: fallback depth is
+            # RETAINED_EPOCHS=2, so two tears landing in two consecutive
+            # retained epochs would (by design) be unrecoverable — the
+            # plan must stay inside the failure envelope it proves out
+            {"name": "torn_snapshot", "site": "lsm.put", "kind": "torn",
+             "key_substr": "@", "prob": 0.08, "times": 1},
+            # commit-time transient error: absorbed by the coordinator's
+            # bounded retry
+            {"name": "commit_hiccup", "site": "checkpoint.commit",
+             "kind": "error", "message": "injected commit hiccup",
+             "prob": 0.15, "times": 2},
+            # background jitter on state flushes
+            {"name": "flush_latency", "site": "lsm.flush",
+             "kind": "latency", "ms": 5, "prob": 0.05, "times": 20},
+        ],
+    }
+
+
+#: the four failure modes the chaos acceptance gate requires to fire
+CHAOS_REQUIRED_RULES = (
+    "fetch_flap", "worker_crash", "torn_snapshot", "commit_hiccup",
+)
+
+
+def chaos_sim_sequence(spec: dict) -> list[dict]:
+    """Drive a fresh plan through a fixed synthetic call sequence and
+    return its event log — run twice, identical logs prove the seed fully
+    determines the injection sequence."""
+    from denormalized_tpu.runtime.faults import FaultPlan
+
+    p = FaultPlan(dict(spec))
+    for i in range(1200):
+        try:
+            p.on("kafka.fetch", key="soak:0")
+        except Exception:
+            pass
+        if i % 20 == 0:
+            try:
+                p.on("lsm.put", key=f"window_1@{1000 + i}",
+                     payload=b"x" * 64)
+            except Exception:
+                pass
+        if i % 40 == 0:
+            try:
+                p.on("checkpoint.commit")
+            except Exception:
+                pass
+            try:
+                p.on("lsm.flush")
+            except Exception:
+                pass
+    return p.event_log()
+
+
+def read_chaos_events(paths) -> list[dict]:
+    """One 'chaos' event dict per segment file that wrote one."""
+    out = []
+    for path in paths:
+        last = None
+        try:
+            f = open(path)
+        except FileNotFoundError:
+            continue
+        with f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if o.get("event") == "chaos":
+                    last = {k: v for k, v in o.items() if k != "event"}
+        if last is not None:
+            out.append(last)
+    return out
+
+
 def burst_ts(ts: "np.ndarray") -> "np.ndarray":
     """Squeeze each second's events into its first 600ms: the 400ms
     event-time silence every second (> SESSION_GAP_MS) closes one session
@@ -563,9 +663,68 @@ def child_main() -> None:
     stop = False
     coord = None
     announced = False
+    last_chaos_write = 0.0
+    chaos_log_seen = 0
+
+    def write_chaos_event(out) -> None:
+        """Snapshot of self-healing/fault state, rewritten every few
+        seconds so a SIGKILLed segment still leaves its (nearly) final
+        fault log behind — the parent keeps the LAST one per segment."""
+        try:
+            from denormalized_tpu.runtime import faults as fault_mod
+            from denormalized_tpu.state.lsm import get_global_state_backend
+
+            chaos: dict = {}
+            if coord is not None:
+                chaos["commit_retries"] = coord.commit_retries
+                chaos["restored_from_fallback"] = bool(
+                    coord.restored_from_fallback
+                )
+            try:
+                chaos["replay_truncated"] = int(
+                    get_global_state_backend().replay_truncated
+                )
+            except Exception:
+                pass
+            try:
+                # restart counts must ride THIS snapshot (which survives
+                # SIGKILL) — the 'metrics' event only exists for segments
+                # that reach EOS, i.e. never the killed ones
+                from denormalized_tpu.runtime.tracing import collect_metrics
+
+                chaos["prefetch_restarts"] = sum(
+                    m.get("prefetch_restarts", 0)
+                    for m in collect_metrics(ctx._last_physical).values()
+                )
+            except Exception:
+                pass
+            p = fault_mod.plan()
+            if p is not None:
+                chaos["fault_log"] = p.event_log()
+            if chaos:
+                out.write(json.dumps({"event": "chaos", **chaos}) + "\n")
+        except Exception:
+            pass
+
     with open(out_path, "a", buffering=1) as out:
         out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
         for batch in it:
+            # snapshot chaos state on a 5s cadence AND immediately when
+            # the fault log grew — an injection in the last pre-SIGKILL
+            # seconds must not vanish from the segment's record (the
+            # acceptance gate counts required rules from these events)
+            mono = time.monotonic()
+            try:
+                from denormalized_tpu.runtime import faults as _fm
+
+                _p = _fm.plan()
+                log_len = len(_p.events) if _p is not None else 0
+            except Exception:
+                log_len = 0
+            if mono - last_chaos_write > 5.0 or log_len > chaos_log_seen:
+                last_chaos_write = mono
+                chaos_log_seen = log_len
+                write_chaos_event(out)
             if not announced:
                 # exactly-once output protocol: announce the recovery
                 # point (frozen at coordinator construction) BEFORE any
@@ -651,10 +810,12 @@ def child_main() -> None:
                 "event": "metrics",
                 **{k: sums[k] for k in (
                     "late_rows", "rows_out", "rows_in", "batches_out",
+                    "prefetch_restarts", "prefetch_restarted_partitions",
                 ) if k in sums},
             }) + "\n")
         except Exception:
             pass
+        write_chaos_event(out)
         out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
 
 
@@ -774,20 +935,32 @@ def main():
                     choices=("simple", "sliding", "join", "session",
                              "udaf", "kafka"),
                     default="simple")
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm the seeded FaultPlan (broker flaps, worker "
+                    "crashes, torn state writes, commit hiccups) on top "
+                    "of the kafka exactly-once soak; implies "
+                    "--pipeline kafka")
+    ap.add_argument("--chaos-seed", type=int, default=1234)
     ap.add_argument("--out", default=None, help="default derives from "
                     "--pipeline: SOAK.json / SOAK_SLIDING.json / "
                     "SOAK_JOIN.json / SOAK_SESSION.json / SOAK_UDAF.json "
-                    "(never cross-clobbers artifacts)")
+                    "/ SOAK_CHAOS.json (never cross-clobbers artifacts)")
     args = ap.parse_args()
+    if args.chaos:
+        if args.pipeline not in ("simple", "kafka"):
+            ap.error("--chaos runs on the kafka pipeline only")
+        args.pipeline = "kafka"
     if args.out is None:
-        args.out = str(REPO / {
-            "simple": "SOAK.json",
-            "join": "SOAK_JOIN.json",
-            "session": "SOAK_SESSION.json",
-            "udaf": "SOAK_UDAF.json",
-            "sliding": "SOAK_SLIDING.json",
-            "kafka": "SOAK_KAFKA.json",
-        }[args.pipeline])
+        args.out = str(REPO / (
+            "SOAK_CHAOS.json" if args.chaos else {
+                "simple": "SOAK.json",
+                "join": "SOAK_JOIN.json",
+                "session": "SOAK_SESSION.json",
+                "udaf": "SOAK_UDAF.json",
+                "sliding": "SOAK_SLIDING.json",
+                "kafka": "SOAK_KAFKA.json",
+            }[args.pipeline]
+        ))
     if args.child:
         child_main()
         return
@@ -810,6 +983,21 @@ def main():
         "SOAK_CKPT_DIR": ckpt_dir,
         "SOAK_PIPELINE": args.pipeline,
     })
+    chaos_spec = None
+    chaos_deterministic = None
+    if args.chaos:
+        chaos_spec = chaos_plan(args.chaos_seed)
+        # determinism proof: the same seed must reproduce the same
+        # injection sequence — two fresh plans driven through the same
+        # synthetic call sequence must log identical decisions
+        seq_a = chaos_sim_sequence(chaos_spec)
+        seq_b = chaos_sim_sequence(chaos_spec)
+        chaos_deterministic = bool(seq_a and seq_a == seq_b)
+        chaos_sim_count = len(seq_a)
+        env["DENORMALIZED_FAULT_PLAN"] = json.dumps(chaos_spec)
+        # pure-Python LSM engine: its replay accounting (replay_truncated)
+        # is part of what the chaos run asserts on
+        env["DENORMALIZED_LSM_PY"] = "1"
     if args.pipeline == "kafka":
         kafka_broker, _feed_th, kafka_last_close_ws = kafka_prep_and_feed(
             args, total_batches, lambda m: print(m, file=sys.stderr)
@@ -825,6 +1013,13 @@ def main():
         "kill_every_s": args.kill_every,
         "segments": [],
     }
+    if args.chaos:
+        report["chaos"] = {
+            "seed": args.chaos_seed,
+            "plan": chaos_spec,
+            "fault_plan_deterministic": chaos_deterministic,
+            "sim_injections": chaos_sim_count,
+        }
 
     def write(extra=None):
         report.update(extra or {})
@@ -981,6 +1176,39 @@ def main():
             # spurious: emitted keys the golden never produced (corrupted
             # ws/key after a restore would land here)
             spurious = [k for k in wins if k not in golden]
+        chaos_report = {}
+        if args.chaos:
+            chaos_events = read_chaos_events(seg_paths)
+            fired_rules: dict = {}
+            fired_sites: dict = {}
+            for ev in chaos_events:
+                for e in ev.get("fault_log", []):
+                    name = e.get("name", f"rule{e.get('rule')}")
+                    fired_rules[name] = fired_rules.get(name, 0) + 1
+                    fired_sites[e["site"]] = fired_sites.get(e["site"], 0) + 1
+            chaos_report = {
+                "segments_reporting": len(chaos_events),
+                "injections_fired": sum(fired_rules.values()),
+                "fired_rules": fired_rules,
+                "fired_sites": fired_sites,
+                "required_rules_fired": sorted(
+                    r for r in CHAOS_REQUIRED_RULES if r in fired_rules
+                ),
+                "commit_retries": sum(
+                    ev.get("commit_retries", 0) for ev in chaos_events
+                ),
+                "fallback_restores": sum(
+                    1 for ev in chaos_events
+                    if ev.get("restored_from_fallback")
+                ),
+                "replay_truncated": sum(
+                    ev.get("replay_truncated", 0) for ev in chaos_events
+                ),
+                "prefetch_restarts": sum(
+                    ev.get("prefetch_restarts", 0) for ev in chaos_events
+                ),
+            }
+            report["chaos"].update(chaos_report)
         write({
             "aborted": aborted,
             "eos_done_seen": done_seen,
@@ -999,6 +1227,15 @@ def main():
             "ok": (
                 not aborted and done_seen and not lost and not spurious
                 and not mismatched and len(wins) == len(golden) > 0
+                and (
+                    not args.chaos
+                    or (
+                        chaos_deterministic
+                        and len(chaos_report.get(
+                            "required_rules_fired", []
+                        )) == len(CHAOS_REQUIRED_RULES)
+                    )
+                )
             ),
         })
         print(json.dumps({
@@ -1008,6 +1245,9 @@ def main():
             "lost": len(lost),
             "dupes": dupes,
             "aborted": aborted,
+            **({"chaos_rules": chaos_report.get("fired_rules"),
+                "fallbacks": chaos_report.get("fallback_restores")}
+               if args.chaos else {}),
         }))
     finally:
         if proc is not None and proc.poll() is None:
