@@ -205,7 +205,7 @@ def _enable_compilation_cache(path: str | None) -> None:
         if "cpu" in plat:
             return
         _activate_compilation_cache(path)
-    except Exception:
+    except Exception:  # dnzlint: allow(broad-except) the compilation cache is a pure optimization — a jax-version quirk here must never take the engine down
         pass
 
 
@@ -222,7 +222,7 @@ def ensure_compilation_cache_for_backend() -> None:
 
         if jax.default_backend() != "cpu":
             _activate_compilation_cache(path)
-    except Exception:
+    except Exception:  # dnzlint: allow(broad-except) the compilation cache is a pure optimization — a jax-version quirk here must never take the engine down
         pass
 
 

@@ -39,6 +39,27 @@ class Decoder:
         raise NotImplementedError
 
 
+_warned_native: set[str] = set()
+
+
+def _warn_native_unavailable(fmt: str, err: BaseException) -> None:
+    """One warning per format per process when a native parser cannot be
+    used and the ~10-30x-slower Python decode silently takes over — the
+    exact downgrade that shipped unnoticed for five rounds (CHANGES.md
+    PR 1).  The fallback is still the right behavior (no-compiler boxes,
+    schema shapes the native tree doesn't cover); the silence was not."""
+    if fmt in _warned_native:
+        return
+    _warned_native.add(fmt)
+    from denormalized_tpu.runtime.tracing import logger
+
+    logger.warning(
+        "native %s parser unavailable (%s: %s) — decoding through the "
+        "pure-Python path; decode_fallback_rows will count the rows",
+        fmt, type(err).__name__, err,
+    )
+
+
 def make_decoder(encoding: StreamEncoding, schema: Schema, avro_schema=None):
     if encoding is StreamEncoding.JSON:
         from denormalized_tpu.formats.json_codec import JsonDecoder

@@ -154,7 +154,14 @@ def _pyassemble():
             ctypes.c_uint64,
         ]
         _pa_fn = fn
-    except Exception:
+    except Exception as e:  # dnzlint: allow(broad-except) the generated-comprehension reassembly is the designed fallback (no Python headers); logged so the downgrade is visible, gated by test_native_build_gate where headers exist
+        from denormalized_tpu.runtime.tracing import logger
+
+        logger.warning(
+            "pyassemble (C row assembler) unavailable (%s: %s) — nested "
+            "reassembly uses the generated-comprehension path",
+            type(e).__name__, e,
+        )
         _pa_fn = None
     return _pa_fn
 

@@ -41,7 +41,7 @@ import struct
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
-from denormalized_tpu.formats import Decoder
+from denormalized_tpu.formats import Decoder, _warn_native_unavailable
 from denormalized_tpu.formats.json_codec import rows_to_batch
 
 _PRIMITIVE = {
@@ -579,7 +579,8 @@ class AvroDecoder(Decoder):
                 )
 
                 self._native = NativeAvroParser(avro_schema, self.schema)
-            except Exception:
+            except Exception as e:  # dnzlint: allow(broad-except) pure-Python decode is the designed fallback (no compiler / unsupported schema shape); the downgrade is logged once and counted in decode_fallback_rows, and test_native_build_gate fails images where the build should work
+                _warn_native_unavailable("Avro", e)
                 self._native = None
 
     def push(self, payload: bytes) -> None:

@@ -31,7 +31,7 @@ import numpy as np
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
-from denormalized_tpu.formats import Decoder
+from denormalized_tpu.formats import Decoder, _warn_native_unavailable
 
 
 # -- schema inference ----------------------------------------------------
@@ -87,7 +87,8 @@ class JsonDecoder(Decoder):
                 from denormalized_tpu.formats.native_json import NativeJsonParser
 
                 self._native = NativeJsonParser(schema)
-            except Exception:
+            except Exception as e:  # dnzlint: allow(broad-except) pure-Python decode is the designed fallback (no compiler / unsupported schema shape); the downgrade is logged once and counted in decode_fallback_rows, and test_native_build_gate fails images where the build should work
+                _warn_native_unavailable("JSON", e)
                 self._native = None
 
     def push(self, payload: bytes) -> None:

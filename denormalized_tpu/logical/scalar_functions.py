@@ -495,7 +495,7 @@ def _eq_scalar(a, b):
         return False
     try:
         return bool(a == b)
-    except Exception:
+    except Exception:  # dnzlint: allow(broad-except) SQL comparison semantics: incomparable operand types compare unequal, they don't error the query
         return False
 
 

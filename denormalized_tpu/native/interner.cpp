@@ -74,8 +74,12 @@ struct Interner {
 
 }  // namespace
 
-extern "C" {
+namespace {
 
+// internal-linkage like everything else non-ABI here: the handle type
+// crosses the C ABI only as void*, and keeping it in the anonymous
+// namespace (its Interner field already is) avoids -Wsubobject-linkage
+// in the single-TU sanitizer build
 struct CInterner {
   Interner in;
   std::vector<uint64_t> offsets;  // arena offset per id
@@ -91,6 +95,10 @@ struct CInterner {
   uint64_t pcount = 0;
 #endif
 };
+
+}  // namespace
+
+extern "C" {
 
 void* intern_create() {
   CInterner* c = new CInterner();

@@ -598,7 +598,10 @@ bool parse_scalar_value(Parser* p, Node& nd, Cursor& c) {
 // list_offsets/valid row entry.  Shared by the general and fast paths —
 // a list is a single layout unit, reparsed generically every row (its
 // element count varies, so its bytes can't be layout tokens).
-bool parse_list_value(Parser* p, Node& nd, Cursor& c, std::string& sval) {
+bool parse_list_value(Parser* /*p: callers pass it for symmetry with the
+                                 other value parsers; lists need no
+                                 parser-wide scratch*/,
+                      Node& nd, Cursor& c, std::string& sval) {
   if (!c.eat('[')) return false;
   if (!c.peek(']')) {
     for (;;) {

@@ -26,6 +26,7 @@
 #include <cstdio>
 #include <cstring>
 #include <dlfcn.h>
+#include <mutex>
 #include <netdb.h>
 #include <string>
 #include <sys/socket.h>
@@ -85,10 +86,13 @@ struct TlsApi {
 };
 
 TlsApi* tls_api() {
+  // std::call_once, not a hand-rolled "tried" flag: per-partition reader
+  // threads connect concurrently, and two threads racing the dlopen/dlsym
+  // fill would publish half-written function pointers (the data race the
+  // TSan hammer in native_test.cpp pins)
   static TlsApi api;
-  static bool tried = false;
-  if (!tried) {
-    tried = true;
+  static std::once_flag once;
+  std::call_once(once, [] {
     // libssl declares libcrypto as a dependency, but ERR_* symbols live in
     // libcrypto — resolve each from its own handle
     void* ssl = dlopen("libssl.so.3", RTLD_NOW | RTLD_LOCAL);
@@ -136,7 +140,7 @@ TlsApi* tls_api() {
           (void (*)(unsigned long, char*, size_t))C("ERR_error_string_n");
       api.ok = all;
     }
-  }
+  });
   return api.ok ? &api : nullptr;
 }
 

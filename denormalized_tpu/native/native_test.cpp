@@ -7,11 +7,13 @@
 // interner (growth, duplicates, width changes), JSON parser (escapes,
 // nulls, duplicates, malformed rows).
 
+#include <atomic>
 #include <cassert>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 // single-TU build: include the component sources directly
@@ -428,7 +430,7 @@ static void test_avro() {
     ap_parse(p, exact.data(), toffs, 1);
   }
   for (size_t i = 0; i < offs[1]; i++)
-    for (uint8_t x : {0xFF, 0x80, 0x01}) {
+    for (uint8_t x : {uint8_t{0xFF}, uint8_t{0x80}, uint8_t{0x01}}) {
       ap_clear(p);
       std::vector<uint8_t> m(arena.begin(), arena.begin() + (long)offs[1]);
       m[i] ^= x;
@@ -611,7 +613,7 @@ static void test_codecs() {
     for (size_t n = 0; n <= v.size(); n++) fn(v.data(), n, o);
     std::vector<uint8_t> m;
     for (size_t i = 0; i < v.size(); i++)
-      for (uint8_t x : {0xFF, 0x80, 0x01, 0x00}) {
+      for (uint8_t x : {uint8_t{0xFF}, uint8_t{0x80}, uint8_t{0x01}, uint8_t{0x00}}) {
         m = v;
         m[i] ^= x;
         fn(m.data(), m.size(), o);
@@ -632,6 +634,260 @@ static void test_codecs() {
   printf("codecs ok\n");
 }
 
+// ---- threaded hammers ----------------------------------------------------
+// The engine calls these components from prefetch worker threads with the
+// GIL released — the sanitizer build that matters most here is
+// -fsanitize=thread (tests/test_native_sanitizers.py builds all of this
+// under TSan and under ASan/UBSan; the hammers also run in the plain
+// build as ordinary correctness tests).
+
+static void test_lsm_hammer(const char* dir) {
+  // one store, 4 threads of put/get/flush on overlapping key sets: the
+  // store's internal mutex is the contract (state/checkpoint snapshots
+  // and LSM maintenance can touch the global store from several threads)
+  std::string d = std::string(dir) + "-hammer";
+  void* s = lsm_open(d.c_str());
+  assert(s);
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([s, t] {
+      char k[32], v[64];
+      for (int i = 0; i < 3000; i++) {
+        int kl;
+        if (i % 3 == 0)  // cross-thread contended keys
+          kl = snprintf(k, sizeof k, "shared-%d", i % 50);
+        else  // per-thread keys (the common partition-isolated shape)
+          kl = snprintf(k, sizeof k, "h%d-%d", t, i % 250);
+        int vl = snprintf(v, sizeof v, "val-%d-%d-%d", t, i, i * 31);
+        assert(lsm_put(s, (const uint8_t*)k, (uint32_t)kl,
+                       (const uint8_t*)v, (uint32_t)vl) == 0);
+        if (i % 7 == 0) {
+          uint8_t* out = nullptr;
+          int64_t n = lsm_get(s, (const uint8_t*)k, (uint32_t)kl, &out);
+          assert(n > 0);  // nothing ever deletes these keys
+          lsm_free(out);
+        }
+        if (i % 500 == 499) lsm_flush(s);
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  // the final key population is deterministic even though values race
+  assert(lsm_count(s) == 50 + 4 * 250);
+  lsm_close(s);
+  s = lsm_open(d.c_str());  // recovery after concurrent writes
+  assert(lsm_count(s) == 50 + 4 * 250);
+  lsm_close(s);
+  printf("lsm hammer ok\n");
+}
+
+// -- loopback mini-broker: just enough Produce v3 / Fetch v4 to drive the
+// real client wire paths from concurrent threads without a Kafka --------
+static bool h_recv_all(int fd, uint8_t* d, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, d, n, 0);
+    if (r <= 0) return false;
+    d += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+static bool h_send_all(int fd, const uint8_t* d, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, d, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    d += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static void hammer_payloads(int nrec, std::string& data,
+                            std::vector<uint64_t>& offs) {
+  data.clear();
+  offs.assign(1, 0);
+  for (int i = 0; i < nrec; i++) {
+    char buf[32];
+    int n = snprintf(buf, sizeof buf, "hammer-%d", i);
+    data.append(buf, (size_t)n);
+    offs.push_back(data.size());
+  }
+}
+
+static void hammer_broker_conn(int fd, int nrec) {
+  std::string data;
+  std::vector<uint64_t> offs;
+  hammer_payloads(nrec, data, offs);
+  for (;;) {
+    uint8_t szb[4];
+    if (!h_recv_all(fd, szb, 4)) break;
+    uint32_t sz_n;  // memcpy, not a type-punned cast: szb is 1-aligned
+    memcpy(&sz_n, szb, 4);
+    uint32_t sz = ntohl(sz_n);
+    if (sz < 8 || sz > (1u << 24)) break;
+    std::vector<uint8_t> req(sz);
+    if (!h_recv_all(fd, req.data(), sz)) break;
+    uint16_t api_n;
+    memcpy(&api_n, req.data(), 2);
+    int16_t api = (int16_t)ntohs(api_n);
+    uint32_t corr_n;
+    memcpy(&corr_n, req.data() + 4, 4);
+    Writer body;
+    if (api == 0) {  // Produce v3: echo success for topic/partition 0
+      body.i32(1);
+      body.str("hammer");
+      body.i32(1);
+      body.i32(0);   // partition
+      body.i16(0);   // err
+      body.i64(0);   // base offset
+      body.i64(-1);  // log append time
+    } else {  // Fetch v4: one batch of nrec records from offset 0
+      body.i32(0);  // throttle
+      body.i32(1);
+      body.str("hammer");
+      body.i32(1);
+      body.i32(0);          // partition
+      body.i16(0);          // err
+      body.i64(nrec);       // high watermark
+      body.i64(nrec);       // last stable offset
+      body.i32(0);          // aborted txns
+      build_record_batch(body, (const uint8_t*)data.data(), offs.data(),
+                         nrec, 1700000000000LL);  // writes i32 len + blob
+    }
+    Writer resp;
+    resp.i32((int32_t)(body.buf.size() + 4));
+    resp.append(&corr_n, 4);  // echo correlation id verbatim
+    resp.append(body.buf.data(), body.buf.size());
+    if (!h_send_all(fd, resp.buf.data(), resp.buf.size())) break;
+  }
+  close(fd);
+}
+
+static void test_kafka_hammer() {
+  const int NREC = 5, ITERS = 40, NTHREADS = 4;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  assert(lfd >= 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  assert(bind(lfd, (sockaddr*)&addr, sizeof addr) == 0);
+  assert(listen(lfd, 8) == 0);
+  socklen_t alen = sizeof addr;
+  assert(getsockname(lfd, (sockaddr*)&addr, &alen) == 0);
+  int port = (int)ntohs(addr.sin_port);
+
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+  std::thread server([&] {
+    for (;;) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd < 0) return;  // listen fd closed: shutdown
+      std::lock_guard<std::mutex> g(conns_mu);
+      if (stop.load()) {
+        close(cfd);
+        return;
+      }
+      conns.emplace_back(hammer_broker_conn, cfd, NREC);
+    }
+  });
+
+  // concurrent init of the dlopen'd TLS surface (std::call_once path —
+  // the hand-rolled flag it replaced was a real data race)
+  std::atomic<void*> tls_seen{nullptr};
+  std::vector<std::thread> tls_threads;
+  for (int t = 0; t < NTHREADS; t++) {
+    tls_threads.emplace_back([&] {
+      void* p = (void*)tls_api();
+      void* prev = tls_seen.exchange(p);
+      assert(prev == nullptr || prev == p);  // one consistent answer
+    });
+  }
+  for (auto& th : tls_threads) th.join();
+
+  // 4 client objects (the engine's per-partition-reader ownership model)
+  // produce+fetch concurrently against the mini-broker: shared process
+  // state (crc table, codec statics, TLS api) must be race-free
+  std::string data;
+  std::vector<uint64_t> offs;
+  hammer_payloads(NREC, data, offs);
+  std::vector<std::thread> clients;
+  for (int t = 0; t < NTHREADS; t++) {
+    clients.emplace_back([&, t] {
+      char err[256];
+      void* h = kc_connect("127.0.0.1", port, err, sizeof err);
+      assert(h);
+      for (int k = 0; k < ITERS; k++) {
+        assert(kc_produce(h, "hammer", 0, (const uint8_t*)data.data(),
+                          offs.data(), NREC, 1700000000000LL) == 0);
+        int n = kc_fetch(h, "hammer", 0, 0, 1 << 20, 100);
+        assert(n == NREC);
+        uint64_t nb = 0;
+        const uint8_t* rb = kc_rec_bytes(h, &nb);
+        const uint64_t* ro = kc_rec_offsets(h);
+        assert(nb == data.size());
+        for (int i = 0; i < NREC; i++) {
+          assert(ro[i + 1] - ro[i] == offs[i + 1] - offs[i]);
+          assert(memcmp(rb + ro[i], data.data() + offs[i],
+                        (size_t)(offs[i + 1] - offs[i])) == 0);
+        }
+        assert(kc_next_offset(h) == NREC);
+        assert(kc_high_watermark(h) == NREC);
+      }
+      kc_close(h);
+      (void)t;
+    });
+  }
+  for (auto& th : clients) th.join();
+
+  stop.store(true);
+  // close(lfd) alone does NOT unblock a thread parked in accept() on
+  // Linux — wake it with a throwaway connection, which it will close
+  // and exit on (stop is set)
+  int wake = socket(AF_INET, SOCK_STREAM, 0);
+  if (wake >= 0) {
+    connect(wake, (sockaddr*)&addr, sizeof addr);
+    close(wake);
+  }
+  server.join();
+  close(lfd);
+  {
+    std::lock_guard<std::mutex> g(conns_mu);
+    for (auto& th : conns) th.join();
+  }
+  printf("kafka hammer ok\n");
+}
+
+static void test_interner_hammer() {
+  // one interner per thread (the engine's ownership model: interners are
+  // operator-local) — this still hammers the shared allocator under
+  // contention, where TSan would catch any accidental global state
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([t] {
+      void* h = intern_create();
+      const uint32_t w = 12;
+      const int N = 20000;
+      std::vector<uint8_t> buf((size_t)N * w, 0);
+      std::vector<int32_t> ids(N);
+      for (int i = 0; i < N; i++) {
+        char tmp[16];
+        int len = snprintf(tmp, sizeof tmp, "t%d-%d", t, i % 3000);
+        memcpy(buf.data() + (size_t)i * w, tmp, (size_t)len);
+      }
+      intern_many(h, buf.data(), N, w, ids.data());
+      assert(intern_count(h) == 3000);
+      intern_destroy(h);
+    });
+  }
+  for (auto& th : ts) th.join();
+  printf("interner hammer ok\n");
+}
+
 int main(int argc, char** argv) {
   const char* dir = argc > 1 ? argv[1] : "/tmp/native_test_lsm";
   test_lsm(dir);
@@ -643,6 +899,9 @@ int main(int argc, char** argv) {
   test_avro();
   test_avro_tree();
   test_codecs();
+  test_lsm_hammer(dir);
+  test_kafka_hammer();
+  test_interner_hammer();
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;
 }

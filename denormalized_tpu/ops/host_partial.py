@@ -59,7 +59,14 @@ def _native():
                 ctypes.c_void_p,  # mx f64
             ]
             _LIB = lib
-        except Exception:
+        except Exception as e:  # dnzlint: allow(broad-except) numpy partial-agg is the designed fallback on no-compiler boxes; logged so the downgrade is visible, gated by test_native_build_gate where g++ exists
+            from denormalized_tpu.runtime.tracing import logger
+
+            logger.warning(
+                "native partial_agg unavailable (%s: %s) — host partial "
+                "aggregation runs the numpy path",
+                type(e).__name__, e,
+            )
             _LIB = None
     return _LIB
 

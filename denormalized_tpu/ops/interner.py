@@ -41,7 +41,7 @@ def _load_native():
             lib._intern_pyobjects = pylib.intern_pyobjects
             lib._intern_py_release = pylib.intern_py_release
         return lib, lib._intern_pyobjects
-    except Exception:
+    except Exception:  # dnzlint: allow(broad-except) the PyObject fast path is optional (needs -DINTERN_HAVE_PYTHON + headers); the byte-key path below covers interning either way
         return lib, None
 
 
@@ -57,7 +57,7 @@ def _load_native_lib():
             lib = load(
                 "interner", [f"-I{inc}", "-DINTERN_HAVE_PYTHON"]
             )
-        except Exception:
+        except Exception:  # dnzlint: allow(broad-except) retried immediately as the plain (headerless) build — only THAT failure is terminal below
             # no Python headers: plain build without the PyObject path
             lib = load("interner")
         if not getattr(lib, "_in_configured", False):
@@ -83,7 +83,14 @@ def _load_native_lib():
             lib.intern_free.argtypes = [ctypes.c_void_p]
             lib._in_configured = True
         return lib
-    except Exception:
+    except Exception as e:  # dnzlint: allow(broad-except) dict-based interning is the designed fallback on no-compiler boxes; logged so the downgrade is visible, gated by test_native_build_gate where g++ exists
+        from denormalized_tpu.runtime.tracing import logger
+
+        logger.warning(
+            "native interner unavailable (%s: %s) — dict-based interning "
+            "takes over (slower at high key cardinality)",
+            type(e).__name__, e,
+        )
         return None
 
 

@@ -98,6 +98,24 @@ SITES = {
     "checkpoint.commit": StateError,
 }
 
+#: where each site's ``inject`` call lives (module relative to this
+#: package) and what the boundary is.  Machine-checked both ways by
+#: dnzlint (DNZ-F002): a site registered here with no inject call in its
+#: declared module — or renamed at the call site — fails the lint gate
+#: instead of arming vacuous chaos plans.  The fault-site table in
+#: ``docs/fault_tolerance.md`` is generated from this registry
+#: (``python -m tools.dnzlint --fault-site-table``).
+SITE_MODULES = {
+    "kafka.fetch": ("sources/kafka.py", "`KafkaClient` fetch (every wire fetch)"),
+    "kafka.produce": ("sources/kafka.py", "`KafkaClient.produce`"),
+    "decode": ("sources/kafka.py", "decoder output, once per rowful batch, both decode paths"),
+    "sink.write": ("sources/kafka.py", "`KafkaSinkWriter.write`"),
+    "lsm.put": ("state/lsm.py", "`LsmStore.put` (supports torn values)"),
+    "lsm.get": ("state/lsm.py", "`LsmStore.get`"),
+    "lsm.flush": ("state/lsm.py", "`LsmStore.flush`"),
+    "checkpoint.commit": ("state/checkpoint.py", "`CheckpointCoordinator.commit`"),
+}
+
 _KINDS = ("error", "latency", "torn")
 
 

@@ -249,7 +249,7 @@ class PrefetchWorker:
             if callable(fallback):
                 try:
                     self.retired_decode_fallback_rows += int(fallback())
-                except Exception:
+                except Exception:  # dnzlint: allow(broad-except) best-effort metrics fold off a CRASHED reader — its counter is worth carrying over, never worth failing the restart for
                     pass
             self.reader = new
         # caught_up stays False (set when the crash was detected) until
@@ -261,7 +261,7 @@ class PrefetchWorker:
             # connection per restart
             try:
                 close()
-            except Exception:
+            except Exception:  # dnzlint: allow(broad-except) best-effort release of a connection that already died — the crash error, not the close error, is the story
                 pass
 
     def decode_fallback_total(self) -> int:
@@ -317,7 +317,7 @@ class PrefetchWorker:
                             partition=self.idx, attempt=self.restarts,
                         ):
                             self._rebuild_reader()
-                    except BaseException as e:
+                    except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor re-dispatches: restartable errors re-enter the budgeted backoff, the rest surface via the queue on the next loop pass
                         # rebuild failed (e.g. broker still down): another
                         # crash — loops back into the budgeted backoff
                         err = e
@@ -326,7 +326,7 @@ class PrefetchWorker:
                 try:
                     self._run_reader()
                     return  # clean EOS (or shutdown)
-                except BaseException as e:
+                except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor loop classifies err: non-restartable errors are enqueued for the consumer to re-raise, restartable ones restart
                     err = e
                     self.last_error = f"{type(e).__name__}: {e}"
                     # rows past _last_snap died with the reader and WILL

@@ -223,7 +223,7 @@ class KafkaClient:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # dnzlint: allow(broad-except) destructors must never raise — close() can see half-torn ctypes state at interpreter teardown
             pass
 
     def _err(self) -> str:
@@ -830,7 +830,7 @@ class KafkaPartitionReader(PartitionReader):
         if old is not None:
             try:
                 old.close()
-            except Exception:
+            except Exception:  # dnzlint: allow(broad-except) best-effort release of a dead broker connection — the caller is replacing it precisely because it failed
                 pass
 
     def decode_fallback_rows(self) -> int:

@@ -14,7 +14,6 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 import zlib
 from pathlib import Path
@@ -41,26 +40,14 @@ def _load_native():
     with _BUILD_LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        so_path = _NATIVE_SRC.parent / "lsmkv.so"
         try:
-            if (
-                not so_path.exists()
-                or so_path.stat().st_mtime < _NATIVE_SRC.stat().st_mtime
-            ):
-                subprocess.run(
-                    [
-                        "g++",
-                        "-O2",
-                        "-shared",
-                        "-fPIC",
-                        "-std=c++17",
-                        str(_NATIVE_SRC),
-                        "-o",
-                        str(so_path),
-                    ],
-                    check=True,
-                    capture_output=True,
-                )
+            # one staleness rule for the artifact: build.compile hashes
+            # source + quoted includes + flags into a stamp, so a flag or
+            # header change rebuilds here too (the old mtime-only check
+            # ignored both and could serve a stale .so forever)
+            from denormalized_tpu.native import build
+
+            so_path = build.compile("lsmkv")
             lib = ctypes.CDLL(str(so_path))
             lib.lsm_open.restype = ctypes.c_void_p
             lib.lsm_open.argtypes = [ctypes.c_char_p]
@@ -99,7 +86,16 @@ def _load_native():
             lib.lsm_compact.argtypes = [ctypes.c_void_p]
             lib.lsm_close.argtypes = [ctypes.c_void_p]
             _LIB = lib
-        except Exception:
+        except Exception as e:  # dnzlint: allow(broad-except) no-compiler boxes fall back to _PyLsm by design; the failure is logged below and test_native_build_gate fails CI images where the build SHOULD work
+            # the silent version of this except is how the JSON parser
+            # shipped broken for five rounds (CHANGES.md PR 1) — the
+            # fallback stays, the silence does not (build.compile embeds
+            # the compiler's stderr in its RuntimeError)
+            logger.warning(
+                "native LSM build/load failed — falling back to the "
+                "pure-Python engine (slower, same format): %s",
+                str(e)[-600:],
+            )
             _LIB_FAILED = True
     return _LIB
 

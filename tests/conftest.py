@@ -26,8 +26,44 @@ import sys
 import numpy as np
 import pytest
 
+# -- lock-order witness (on for the whole tier-1 run) ---------------------
+# Install BEFORE any engine module imports: module-level engine locks
+# (native/build.py _LOCK, state/lsm.py _BUILD_LOCK, ...) are created at
+# import time and must be wrapped too.  The witness records the runtime
+# lock-acquisition order of every engine lock and the session FAILS if
+# two code paths ever disagreed about it (a deadlock waiting for the
+# right interleaving).  Opt out with DENORMALIZED_LOCK_WITNESS=0; see
+# denormalized_tpu/common/lockwitness.py and docs/static_analysis.md.
+_LOCK_WITNESS = os.environ.get("DENORMALIZED_LOCK_WITNESS", "1") != "0"
+if _LOCK_WITNESS:
+    from denormalized_tpu.common import lockwitness
+
+    lockwitness.install()
+
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
+
+
+if _LOCK_WITNESS:
+
+    def pytest_terminal_summary(terminalreporter, exitstatus, config):
+        viol = lockwitness.witness().violations()
+        if viol:
+            terminalreporter.section("lock-order witness")
+            for v in viol:
+                terminalreporter.write_line(v.render())
+        else:
+            terminalreporter.write_line(
+                f"lock-order witness: "
+                f"{len(lockwitness.witness().edges())} edge(s), "
+                f"0 violations"
+            )
+
+    def pytest_sessionfinish(session, exitstatus):
+        # a recorded inversion fails the run even if every test passed —
+        # that is the witness's whole contract
+        if exitstatus == 0 and lockwitness.witness().violations():
+            session.exitstatus = 1
 
 # -- env-gated per-test watchdog ------------------------------------------
 # DENORMALIZED_TEST_TIMEOUT_S=<seconds> arms a SIGALRM per test that dumps

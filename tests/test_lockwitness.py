@@ -1,0 +1,253 @@
+"""Lock-order witness: induced inversions must be REPORTED with both
+stacks, consistent orderings and reentrancy must stay silent, and the
+real supervised-restart machinery must run clean under the witness.
+
+(The witness itself is installed for the whole tier-1 run by conftest;
+these tests build deliberate violations inside ``lockwitness.scoped()``
+so the global record — asserted at session end — stays clean.)
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from denormalized_tpu.common import lockwitness
+from denormalized_tpu.common.lockwitness import WitnessedLock, Witness
+
+
+def _wlock(site: str, w: Witness) -> WitnessedLock:
+    return WitnessedLock(threading.Lock(), site, w)
+
+
+def _run_in_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+
+
+class TestInversionDetection:
+    def test_two_lock_inversion_reported_with_both_stacks(self):
+        """The deadlock regression: path 1 takes A then B, path 2 takes
+        B then A.  Sequenced so nothing actually deadlocks — the witness
+        must still flag it (the hang only needs the right interleaving)
+        and the report must carry BOTH acquisition stacks of BOTH
+        orders."""
+        with lockwitness.scoped() as w:
+            a = _wlock("state/lsm.py:1 (A)", w)
+            b = _wlock("runtime/prefetch.py:1 (B)", w)
+
+            def path_ab():
+                with a:
+                    with b:
+                        pass
+
+            def path_ba():
+                with b:
+                    with a:
+                        pass
+
+            _run_in_thread(path_ab, "t-ab")
+            _run_in_thread(path_ba, "t-ba")
+
+            viol = w.violations()
+            assert len(viol) == 1, viol
+            report = viol[0].render()
+            # both lock classes named
+            assert "state/lsm.py:1 (A)" in report
+            assert "runtime/prefetch.py:1 (B)" in report
+            # both threads' stacks present, pointing at the two paths
+            assert "t-ab" in report and "t-ba" in report
+            assert "path_ab" in report and "path_ba" in report
+            # ... and each side shows a held-stack AND an acquired-stack
+            assert report.count("acquired at") == 2
+            assert report.count("then took") == 2
+
+    def test_inversion_detected_across_instances_of_same_classes(self):
+        """Ordering is per lock CLASS (creation site), so an ABBA between
+        two different instance pairs is still an inversion."""
+        with lockwitness.scoped() as w:
+            a1 = _wlock("siteA", w)
+            a2 = _wlock("siteA", w)
+            b1 = _wlock("siteB", w)
+            b2 = _wlock("siteB", w)
+            with a1:
+                with b1:
+                    pass
+            with b2:
+                with a2:
+                    pass
+            assert len(w.violations()) == 1
+
+    def test_consistent_order_is_clean(self):
+        with lockwitness.scoped() as w:
+            a = _wlock("siteA", w)
+            b = _wlock("siteB", w)
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+            _run_in_thread(lambda: [a.acquire(), b.acquire(),
+                                    b.release(), a.release()], "t2")
+            assert w.violations() == []
+            assert ("siteA", "siteB") in w.edges()
+
+    def test_reentrant_same_class_not_flagged(self):
+        """RLock-style same-class nesting is reentrancy, not ordering."""
+        with lockwitness.scoped() as w:
+            r = WitnessedLock(threading.RLock(), "siteR", w)
+            with r:
+                with r:
+                    pass
+            assert w.violations() == []
+            assert w.edges() == {}
+
+    def test_failed_trylock_not_recorded_as_held(self):
+        with lockwitness.scoped() as w:
+            a = _wlock("siteA", w)
+            b = _wlock("siteB", w)
+            b._inner.acquire()  # someone else holds the real lock
+            with a:
+                assert b.acquire(blocking=False) is False
+            b._inner.release()
+            # the failed try-lock must not have minted an a->b edge
+            assert ("siteA", "siteB") not in w.edges()
+
+
+class TestFactoryScoping:
+    def test_install_wraps_only_engine_created_locks(self, monkeypatch):
+        """The factories wrap locks whose CREATOR is engine code; this
+        test impersonates one by pointing the package marker at tests/."""
+        was_installed = lockwitness._installed
+        if was_installed:
+            lockwitness.uninstall()
+        monkeypatch.setattr(
+            lockwitness, "_PKG_MARKER", os.sep + "tests" + os.sep
+        )
+        lockwitness.install()
+        try:
+            lk = threading.Lock()  # this file now counts as engine code
+            assert isinstance(lk, WitnessedLock)
+            assert "test_lockwitness.py" in lk._site
+        finally:
+            lockwitness.uninstall()
+            monkeypatch.undo()
+            if was_installed:
+                lockwitness.install()
+        assert not isinstance(threading.Lock(), WitnessedLock)
+        if was_installed:
+            lockwitness.install()
+
+    def test_witnessed_lock_supports_condition_over_rlock(self):
+        """Condition over a witnessed RLock: the proxy must forward
+        _is_owned/_release_save/_acquire_restore to the real RLock —
+        Condition's generic acquire(False) ownership probe mis-detects
+        on a REENTRANT lock (acquire succeeds reentrantly), so without
+        forwarding, cv.wait() raises 'cannot wait on un-acquired lock'
+        while the lock IS held."""
+        with lockwitness.scoped() as w:
+            rl = WitnessedLock(threading.RLock(), "siteCVR", w)
+            cv = threading.Condition(rl)
+            hits = []
+
+            def waiter():
+                with cv:
+                    while not hits:
+                        cv.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                assert rl._is_owned()
+                hits.append(1)
+                cv.notify_all()
+            t.join(10)
+            assert not t.is_alive()
+            assert w.violations() == []
+
+    def test_witnessed_lock_supports_condition(self):
+        """stdlib Condition over a witnessed plain Lock — wait/notify
+        still work through Condition's generic (non-RLock) fallback."""
+        with lockwitness.scoped() as w:
+            cv = threading.Condition(_wlock("siteCV", w))
+            hits = []
+
+            def waiter():
+                with cv:
+                    while not hits:
+                        cv.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                hits.append(1)
+                cv.notify_all()
+            t.join(10)
+            assert not t.is_alive()
+            assert w.violations() == []
+
+
+@pytest.mark.skipif(
+    os.environ.get("DENORMALIZED_LOCK_WITNESS", "1") == "0",
+    reason="witness disabled for this run",
+)
+class TestEngineUnderWitness:
+    def test_prefetch_supervisor_restart_stays_clean(self):
+        """A supervised worker crash + restart exercises the engine's
+        lock web (budget lock, swap lock, fault-plan lock, build locks)
+        — the global witness must record no inversion from it."""
+        from denormalized_tpu.runtime import faults
+        from denormalized_tpu.runtime.prefetch import PrefetchPump
+        from denormalized_tpu.sources.kafka import KafkaTopicBuilder
+        from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+        before = len(lockwitness.witness().violations())
+        broker = MockKafkaBroker().start()
+        try:
+            broker.create_topic("wit", partitions=2)
+            t0 = 1_700_000_000_000
+            for p in range(2):
+                broker.produce_batched(
+                    "wit", p,
+                    [json.dumps({"ts": t0 + i, "p": p, "i": i}).encode()
+                     for i in range(400)],
+                    ts_ms=t0,
+                )
+            src = (
+                KafkaTopicBuilder(broker.bootstrap)
+                .with_topic("wit")
+                .infer_schema_from_json('{"ts": 1, "p": 1, "i": 1}')
+                .with_timestamp_column("ts")
+                .with_option("max.batch.rows", 128)
+                .build_reader()
+            )
+            faults.arm({"seed": 7, "rules": [
+                {"site": "kafka.fetch", "kind": "error", "times": 1,
+                 "message": "injected worker crash (lockwitness)"},
+            ]})
+            pump = PrefetchPump(
+                src.partitions(),
+                reader_factories=src.partition_factories(),
+                restart_budget=3,
+            ).start()
+            try:
+                seen = 0
+                deadline = time.monotonic() + 30
+                for _idx, _snap, batch in pump.drain(
+                    total_rows=800, deadline=deadline
+                ):
+                    seen += batch.num_rows
+                assert seen == 800
+            finally:
+                pump.stop(join_timeout_s=5.0)
+                faults.disarm()
+        finally:
+            broker.stop()
+        assert len(lockwitness.witness().violations()) == before, [
+            v.render() for v in lockwitness.witness().violations()[before:]
+        ]

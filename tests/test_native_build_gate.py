@@ -42,7 +42,14 @@ _MODULES = {
     "pyassemble": [f"-I{_PY_INC}"],
 }
 
-_BASE_FLAGS = ["-O2", "-shared", "-fPIC", "-std=c++17"]
+# the production loader's warning surface, made FATAL here: the gate is
+# where warning-cleanliness is enforced (build.py keeps warnings
+# non-fatal so a future compiler's new diagnostics can't brick first-use
+# builds in production — the gate catches them in CI instead)
+from denormalized_tpu.native.build import WARN_FLAGS
+
+_BASE_FLAGS = ["-O2", "-shared", "-fPIC", "-std=c++17", *WARN_FLAGS,
+               "-Werror"]
 
 
 def test_all_native_sources_enumerated():

@@ -1,0 +1,209 @@
+"""dnzlint — project-specific static analysis for the threaded runtime.
+
+Generic linters know nothing about THIS engine's invariants: which
+attributes are locks, which calls block, which functions are the
+vectorized hot paths PRs 2-3 paid for, which string literals must name a
+registered fault-injection site.  dnzlint encodes those invariants as
+AST passes over ``denormalized_tpu/`` and runs as a tier-1 test gate
+(``tests/test_lint.py``), so a regression is a test failure with a
+file:line and a rule id — not a soak failure three PRs later.
+
+Passes (rule catalog in ``docs/static_analysis.md``):
+
+==========  ==================  =========================================
+rule id     slug                what it flags
+==========  ==================  =========================================
+DNZ-L001    lock-order-cycle    a cycle in the static lock-acquisition
+                                graph (two code paths that take the same
+                                locks in opposite orders)
+DNZ-L002    blocking-under-lock a blocking call (``time.sleep``, queue
+                                get/put, thread join/wait, subprocess,
+                                ctypes library load or native ``lib.*``
+                                call, ``faults.inject`` latency site)
+                                made while a lock is held
+DNZ-E001    broad-except        ``except Exception``/``BaseException``/
+                                bare ``except`` that neither re-raises
+                                nor converts to a DenormalizedError
+DNZ-F001    unknown-fault-site  ``faults.inject("x")`` where ``"x"`` is
+                                not a key of ``faults.SITES``
+DNZ-F002    missing-fault-site  a site registered in ``faults.SITES`` /
+                                ``SITE_MODULES`` with no ``inject`` call
+                                in its declared module
+DNZ-H001    hot-loop            a per-row construct (``for``/``while``,
+                                ``.tolist()``) inside a registered
+                                hot-path function
+DNZ-H002    hash-tuple          ``hash(...)`` inside a registered
+                                hot-path function (the pre-vectorization
+                                collision bug class, PARITY.md Round-6)
+==========  ==================  =========================================
+
+Suppression is explicit and reasoned, never blanket:
+
+- inline pragma on the flagged line (or the line above)::
+
+      except Exception:  # dnzlint: allow(broad-except) destructor must never raise
+
+- a ``baseline.toml`` entry keyed by ``(rule, file, symbol)`` — line
+  numbers shift, symbols don't — each carrying a ``reason``.  The gate
+  therefore enforces zero NEW findings while keeping every accepted one
+  auditable in one file.
+
+Run locally::
+
+    python -m tools.dnzlint denormalized_tpu
+
+The package is stdlib-only (ast + tomllib) so the gate can never be
+skipped for a missing dependency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from pathlib import Path
+
+if sys.version_info >= (3, 11):
+    import tomllib as _toml
+else:  # pragma: no cover — 3.10 image ships tomli via pip? no: parse manually
+    _toml = None
+
+#: rule id -> pragma slug (what goes inside ``allow(...)``)
+RULES = {
+    "DNZ-L001": "lock-order-cycle",
+    "DNZ-L002": "blocking-under-lock",
+    "DNZ-E001": "broad-except",
+    "DNZ-F001": "unknown-fault-site",
+    "DNZ-F002": "missing-fault-site",
+    "DNZ-H001": "hot-loop",
+    "DNZ-H002": "hash-tuple",
+}
+SLUG_TO_RULE = {v: k for k, v in RULES.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, printable as ``file:line [rule] symbol: message``.
+
+    ``symbol`` is the stable anchor (``Class.method``, ``function``, or a
+    pass-specific identity like a cycle's sorted node list) — it is what
+    baseline entries match on, so findings survive unrelated line churn.
+    """
+
+    rule: str
+    path: str  # relative to the scanned root's parent (repo-style)
+    line: int
+    symbol: str
+    message: str
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} [{self.rule}] {self.symbol}: {self.message}"
+
+
+def _parse_toml(path: Path) -> dict:
+    if _toml is not None:
+        with open(path, "rb") as f:
+            return _toml.load(f)
+    # minimal fallback for [[entry]] tables of string key/values (the only
+    # shapes dnzlint's own config files use) on pythons without tomllib
+    out: dict = {}
+    current: dict | None = None
+    for raw in path.read_text().splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            name = line[2:-2].strip()
+            current = {}
+            out.setdefault(name, []).append(current)
+        elif "=" in line and current is not None:
+            k, _, v = line.partition("=")
+            current[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def load_baseline(path: Path) -> dict[tuple[str, str, str], str]:
+    """``baseline.toml`` -> {(rule, file, symbol): reason}.  Every entry
+    MUST carry a non-empty reason — an unreasoned suppression is itself
+    an error (the whole point is auditability)."""
+    if not path.exists():
+        return {}
+    data = _parse_toml(path)
+    out: dict[tuple[str, str, str], str] = {}
+    for entry in data.get("suppress", []):
+        rule = entry.get("rule", "")
+        file = entry.get("file", "")
+        symbol = entry.get("symbol", "")
+        reason = (entry.get("reason") or "").strip()
+        if rule not in RULES:
+            raise ValueError(f"baseline: unknown rule {rule!r} for {file}")
+        if not reason:
+            raise ValueError(
+                f"baseline: entry ({rule}, {file}, {symbol}) has no reason "
+                f"— unreasoned suppressions defeat the audit trail"
+            )
+        out[(rule, file, symbol)] = reason
+    return out
+
+
+def iter_python_files(root: Path):
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        yield p
+
+
+def run_all(
+    root: Path,
+    *,
+    baseline_path: Path | None = None,
+    hotpaths_path: Path | None = None,
+) -> tuple[list[Finding], list[Finding], list[tuple]]:
+    """Run every pass over the package at ``root``.
+
+    Returns ``(new, suppressed, stale_baseline)``: findings not covered
+    by pragma or baseline, findings a baseline entry absorbed, and
+    baseline entries that matched nothing (candidates for deletion —
+    reported so the baseline can only shrink honestly).
+    """
+    from tools.dnzlint import excepts, faultsites, hotpath, locks
+    from tools.dnzlint.pragmas import PragmaIndex
+
+    root = Path(root)
+    here = Path(__file__).resolve().parent
+    if baseline_path is None:
+        baseline_path = here / "baseline.toml"
+    if hotpaths_path is None:
+        hotpaths_path = here / "hotpaths.toml"
+    baseline = load_baseline(baseline_path)
+
+    findings: list[Finding] = []
+    pragma_index = PragmaIndex()
+    for path in iter_python_files(root):
+        pragma_index.scan(path, rel_path(path, root))
+    findings += pragma_index.malformed  # reasonless/unknown-slug pragmas
+    findings += locks.run(root)
+    findings += excepts.run(root)
+    findings += faultsites.run(root)
+    findings += hotpath.run(root, hotpaths_path)
+
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    used_baseline: set[tuple[str, str, str]] = set()
+    for f in findings:
+        if pragma_index.allows(f):
+            suppressed.append(f)
+        elif f.key() in baseline:
+            suppressed.append(f)
+            used_baseline.add(f.key())
+        else:
+            new.append(f)
+    stale = [k for k in baseline if k not in used_baseline]
+    return new, suppressed, stale
+
+
+def rel_path(path: Path, root: Path) -> str:
+    """Repo-style path: ``<root.name>/sub/file.py``."""
+    return str(Path(root.name) / path.relative_to(root))

@@ -1,0 +1,122 @@
+"""CLI: ``python -m tools.dnzlint [path] [options]``.
+
+Exit codes: 0 = clean (after pragmas + baseline), 1 = new findings,
+2 = usage/config error.  ``--fault-site-table`` prints the generated
+markdown fault-site table (what ``docs/fault_tolerance.md`` embeds) and
+exits — used by ``tools/lint.sh`` and ``tests/test_lint.py`` to pin the
+docs against the verified site inventory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.dnzlint import load_baseline, run_all
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.dnzlint",
+        description="project-specific static analysis "
+                    "(rule catalog: docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "root", nargs="?", default="denormalized_tpu",
+        help="package directory to scan (default: denormalized_tpu)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline.toml path (default: tools/dnzlint/baseline.toml)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (show every finding)",
+    )
+    parser.add_argument(
+        "--hotpaths", default=None,
+        help="hotpaths.toml path (default: tools/dnzlint/hotpaths.toml)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also list findings absorbed by pragmas/baseline",
+    )
+    parser.add_argument(
+        "--fault-site-table", action="store_true",
+        help="print the generated fault-site markdown table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    root = Path(args.root)
+    if not root.is_dir():
+        print(f"dnzlint: {root} is not a directory", file=sys.stderr)
+        return 2
+
+    if args.fault_site_table:
+        from tools.dnzlint.faultsites import fault_site_table
+
+        print(fault_site_table(root))
+        return 0
+
+    here = Path(__file__).resolve().parent
+    baseline_path = (
+        Path(args.baseline) if args.baseline else here / "baseline.toml"
+    )
+    try:
+        if args.no_baseline:
+            new, suppressed, stale = run_all(
+                root,
+                baseline_path=Path("/nonexistent"),
+                hotpaths_path=Path(args.hotpaths) if args.hotpaths else None,
+            )
+        else:
+            new, suppressed, stale = run_all(
+                root,
+                baseline_path=baseline_path,
+                hotpaths_path=Path(args.hotpaths) if args.hotpaths else None,
+            )
+    except (ValueError, SyntaxError) as e:
+        print(f"dnzlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "suppressed": [vars(f) for f in suppressed],
+            "stale_baseline": [list(k) for k in stale],
+        }, indent=2))
+        return 1 if new else 0
+
+    for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    if args.show_suppressed:
+        for f in sorted(suppressed, key=lambda f: (f.path, f.line, f.rule)):
+            print(f"suppressed: {f.render()}")
+    if stale:
+        # stale entries don't fail the run (a fix may land before the
+        # baseline edit in the same PR) but they must be visible: a
+        # baseline should only ever shrink honestly
+        for rule, file, symbol in sorted(stale):
+            print(
+                f"stale baseline entry: ({rule}, {file}, {symbol}) "
+                f"matched no finding — delete it",
+                file=sys.stderr,
+            )
+    n_base = len(load_baseline(baseline_path)) if not args.no_baseline else 0
+    print(
+        f"dnzlint: {len(new)} new finding(s), "
+        f"{len(suppressed)} suppressed "
+        f"({n_base} baseline entrie(s), rest pragmas), "
+        f"{len(stale)} stale baseline entrie(s)",
+        file=sys.stderr,
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

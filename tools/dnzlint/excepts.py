@@ -1,0 +1,84 @@
+"""DNZ-E001 — error taxonomy: no silently-swallowed broad excepts.
+
+A handler for ``Exception``, ``BaseException``, or a bare ``except`` in
+engine code must do one of:
+
+- **re-raise** — any ``raise`` statement anywhere in the handler body
+  (bare re-raise, ``raise X(...) from e`` conversion to a
+  :class:`DenormalizedError` subclass, anything that keeps the failure
+  moving) satisfies the rule;
+- **carry a pragma** — ``# dnzlint: allow(broad-except) <reason>`` on
+  the ``except`` line, for the handful of places where swallowing is the
+  design (destructors, best-effort teardown of already-dead resources,
+  supervisor loops that re-dispatch the error as data).
+
+Everything else is the bug class PR 1 dug out of the decode path: a
+native component that silently never worked, a close() that hides the
+error that explains the next failure.  Narrow handlers
+(``except OSError``, ``except FormatError``) are out of scope — naming a
+type is already a decision.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.dnzlint import Finding, iter_python_files, rel_path
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _enclosing_symbol(stack: list[str]) -> str:
+    return ".".join(stack) if stack else "<module>"
+
+
+def run(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(root):
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+
+        def visit(node: ast.AST, stack: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    if isinstance(child, ast.ExceptHandler) and _is_broad(
+                        child
+                    ) and not _reraises(child):
+                        what = (
+                            "bare except" if child.type is None
+                            else f"except {ast.unparse(child.type)}"
+                        )
+                        findings.append(Finding(
+                            "DNZ-E001", rel, child.lineno,
+                            _enclosing_symbol(stack),
+                            f"{what} swallows the error (no raise in the "
+                            f"handler) — re-raise, convert to a "
+                            f"DenormalizedError, or annotate with "
+                            f"allow(broad-except) and a reason",
+                        ))
+                    visit(child, stack)
+
+        visit(tree, [])
+    return findings

@@ -1,0 +1,193 @@
+"""DNZ-F001/F002 — fault-site completeness.
+
+The fault framework (``runtime/faults.py``) validates plans against
+``SITES`` at ARM time, which catches a typo'd plan — but a typo'd or
+renamed **call site** (``faults.inject("lsm.putt")``) only surfaces when
+a chaos run quietly fails to inject anything.  These passes close the
+loop statically, in both directions:
+
+- **DNZ-F001**: every ``faults.inject("x", ...)`` literal must be a key
+  of ``SITES``.  A non-literal site name is also flagged: dynamic names
+  cannot be checked, and nothing in the engine needs one.
+- **DNZ-F002**: every site registered in ``SITES`` must have at least
+  one ``inject`` call — in the module ``SITE_MODULES`` declares for it,
+  when declared — so deleting or moving an instrumented boundary without
+  updating the registry fails the gate instead of arming vacuous plans.
+
+Both read ``SITES``/``SITE_MODULES`` from the scanned tree's own
+``runtime/faults.py`` **by AST**, never by import: the linter must work
+on broken fixture trees and must not trigger the engine's import-time
+``DENORMALIZED_FAULT_PLAN`` arming.
+
+The pass also exports the verified site inventory
+(:func:`site_inventory`, :func:`fault_site_table`) — the fault-site
+table in ``docs/fault_tolerance.md`` is generated from it, so docs and
+registry cannot drift (``tests/test_lint.py`` pins the equality).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.dnzlint import Finding, iter_python_files, rel_path
+
+FAULTS_REL = Path("runtime") / "faults.py"
+
+
+def _const_str(node: ast.AST) -> str | None:
+    return node.value if (
+        isinstance(node, ast.Constant) and isinstance(node.value, str)
+    ) else None
+
+
+def load_registry(root: Path) -> tuple[dict, dict, int]:
+    """Parse ``SITES`` and ``SITE_MODULES`` from the tree's faults.py.
+
+    Returns ``(sites, site_modules, sites_lineno)`` where ``sites`` maps
+    site -> default-error-class name and ``site_modules`` maps
+    site -> (module-relpath, description).  Missing file or missing
+    assignments return empty dicts (the F-passes then no-op: a tree
+    without a fault framework has nothing to check).
+    """
+    path = root / FAULTS_REL
+    if not path.exists():
+        return {}, {}, 0
+    tree = ast.parse(path.read_text(), filename=str(path))
+    sites: dict[str, str] = {}
+    site_modules: dict[str, tuple[str, str]] = {}
+    lineno = 0
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if target.id == "SITES" and isinstance(node.value, ast.Dict):
+            lineno = node.lineno
+            for k, v in zip(node.value.keys, node.value.values):
+                key = _const_str(k)
+                if key is None:
+                    continue
+                sites[key] = (
+                    v.id if isinstance(v, ast.Name) else ast.unparse(v)
+                )
+        elif target.id == "SITE_MODULES" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                key = _const_str(k)
+                if key is None or not isinstance(v, ast.Tuple):
+                    continue
+                parts = [_const_str(e) or "" for e in v.elts]
+                if len(parts) == 2:
+                    site_modules[key] = (parts[0], parts[1])
+    return sites, site_modules, lineno
+
+
+def _inject_calls(tree: ast.AST):
+    """Yield (node, site_literal_or_None) for every ``faults.inject(...)``
+    or bare ``inject(...)`` call (the latter only when the module imported
+    the name from the fault framework — approximated by call-name match,
+    which is unambiguous in this codebase)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        named_inject = (
+            isinstance(fn, ast.Attribute) and fn.attr == "inject"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "faults"
+        ) or (isinstance(fn, ast.Name) and fn.id == "inject")
+        if not named_inject:
+            continue
+        site = _const_str(node.args[0]) if node.args else None
+        yield node, site
+
+
+def site_inventory(root: Path) -> dict[str, dict]:
+    """{site: {error, module, where, calls: [(rel, line), ...]}} — the
+    ground truth the docs table and DNZ-F002 both consume."""
+    sites, site_modules, _ = load_registry(root)
+    inv = {
+        s: {
+            "error": err,
+            "module": site_modules.get(s, ("", ""))[0],
+            "where": site_modules.get(s, ("", ""))[1],
+            "calls": [],
+        }
+        for s, err in sites.items()
+    }
+    for path in iter_python_files(root):
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node, site in _inject_calls(tree):
+            if site in inv:
+                inv[site]["calls"].append((rel, node.lineno))
+    return inv
+
+
+def fault_site_table(root: Path) -> str:
+    """The markdown fault-site table for ``docs/fault_tolerance.md``,
+    generated from the verified inventory (module column included so a
+    moved boundary is a visible docs diff, not silent drift)."""
+    inv = site_inventory(root)
+    lines = [
+        "| site | where | module | default error |",
+        "|---|---|---|---|",
+    ]
+    for site, meta in inv.items():
+        mod = f"`{root.name}/{meta['module']}`" if meta["module"] else "—"
+        lines.append(
+            f"| `{site}` | {meta['where']} | {mod} | `{meta['error']}` |"
+        )
+    return "\n".join(lines)
+
+
+def run(root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    sites, site_modules, sites_lineno = load_registry(root)
+    faults_rel = rel_path(root / FAULTS_REL, root) if sites else ""
+    seen: dict[str, list[str]] = {s: [] for s in sites}
+
+    for path in iter_python_files(root):
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        in_framework = path == root / FAULTS_REL
+        for node, site in _inject_calls(tree):
+            if in_framework:
+                continue  # the framework's own definition of inject()
+            if site is None:
+                findings.append(Finding(
+                    "DNZ-F001", rel, node.lineno, "<dynamic>",
+                    "faults.inject with a non-literal site name — sites "
+                    "must be checkable string literals",
+                ))
+                continue
+            if sites and site not in sites:
+                findings.append(Finding(
+                    "DNZ-F001", rel, node.lineno, site,
+                    f"faults.inject({site!r}) names no key of "
+                    f"faults.SITES — the plan validator can never match "
+                    f"it, so a chaos run would report green without "
+                    f"injecting",
+                ))
+                continue
+            if site in seen:
+                seen[site].append(rel)
+
+    pkg_prefix = root.name + "/"
+    for site, calls in seen.items():
+        declared = site_modules.get(site, ("", ""))[0]
+        if not calls:
+            findings.append(Finding(
+                "DNZ-F002", faults_rel, sites_lineno, site,
+                f"site {site!r} is registered in faults.SITES but no "
+                f"module contains a faults.inject({site!r}) call — a "
+                f"renamed or deleted boundary left the registry stale",
+            ))
+        elif declared and (pkg_prefix + declared) not in calls:
+            findings.append(Finding(
+                "DNZ-F002", faults_rel, sites_lineno, site,
+                f"site {site!r} is declared to live in {declared!r} "
+                f"(faults.SITE_MODULES) but its inject calls are in "
+                f"{sorted(set(calls))} — update the registry",
+            ))
+    return findings

@@ -1,0 +1,139 @@
+"""DNZ-H001/H002 — hot-path purity.
+
+PRs 2-3 bought the engine's throughput by removing per-row Python from
+the session/window/join/decode kernels (SESSION_SCALE.json: 14x at 10k
+keys).  Nothing structural stops a future edit from re-introducing a
+``for row in ...`` or a ``hash(tuple(key))`` into one of those functions
+— the tests would still pass, just 10-30x slower.  This pass pins the
+property: functions registered in ``hotpaths.toml`` must contain
+
+- **no ``for``/``while`` statements** (the registered kernels are the
+  fully-vectorized ones; per-column comprehensions remain legal — the
+  cliff is per-row *statements*, and every registered function is
+  loop-free today, so any new loop is a deliberate, pragma-documented
+  decision);
+- **no ``.tolist()`` calls** (the canonical start of a per-row walk);
+- **no ``hash(...)`` calls** (DNZ-H002 — the salted ``hash(tuple)``
+  composite key was a *correctness* bug, not just slow: colliding keys
+  silently merged two sessions, PARITY.md Round-6).
+
+A function that legitimately needs a bounded loop (e.g. a per-aggregate
+sweep over a fixed component list) takes an inline
+``# dnzlint: allow(hot-loop) <reason>`` on the loop line — visible at
+the loop, reviewed with the code.
+
+Registering a function that the tree does not define is itself a finding
+(DNZ-H001 on the config): a renamed kernel must update the registry, or
+the pin silently evaporates.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.dnzlint import Finding, _parse_toml
+
+
+def load_hotpaths(path: Path) -> list[dict]:
+    """``hotpaths.toml`` ``[[hotpath]]`` entries: {file, qualname}."""
+    if not path.exists():
+        return []
+    data = _parse_toml(path)
+    out = []
+    for entry in data.get("hotpath", []):
+        if entry.get("file") and entry.get("qualname"):
+            out.append({
+                "file": entry["file"],
+                "qualname": entry["qualname"],
+            })
+    return out
+
+
+def _find_function(tree: ast.AST, qualname: str):
+    """Resolve ``Class.method`` / ``func`` / ``outer.inner`` to its node."""
+    parts = qualname.split(".")
+    node: ast.AST = tree
+    for part in parts:
+        found = None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and child.name == part:
+                found = child
+                break
+        if found is None:
+            return None
+        node = found
+    return node if isinstance(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ) else None
+
+
+def run(root: Path, hotpaths_path: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    entries = load_hotpaths(hotpaths_path)
+    by_file: dict[str, list[str]] = {}
+    for e in entries:
+        by_file.setdefault(e["file"], []).append(e["qualname"])
+
+    pkg = root.name
+    for file_rel, qualnames in sorted(by_file.items()):
+        # config paths are repo-style (``denormalized_tpu/...``)
+        inner = file_rel[len(pkg) + 1:] if file_rel.startswith(pkg + "/") \
+            else file_rel
+        path = root / inner
+        if not path.exists():
+            for qn in qualnames:
+                findings.append(Finding(
+                    "DNZ-H001", file_rel, 1, qn,
+                    f"hotpaths.toml registers {qn} but {file_rel} does not "
+                    f"exist — update the registry for the moved/renamed "
+                    f"kernel",
+                ))
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for qn in sorted(qualnames):
+            fn = _find_function(tree, qn)
+            if fn is None:
+                findings.append(Finding(
+                    "DNZ-H001", file_rel, 1, qn,
+                    f"hotpaths.toml registers {qn} but it is not defined "
+                    f"in {file_rel} — update the registry for the "
+                    f"moved/renamed kernel",
+                ))
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    kind = "while" if isinstance(node, ast.While) else "for"
+                    findings.append(Finding(
+                        "DNZ-H001", file_rel, node.lineno, qn,
+                        f"`{kind}` loop inside registered hot-path "
+                        f"function {qn} — this kernel is pinned "
+                        f"loop-free (vectorize, or allow(hot-loop) with "
+                        f"a reason)",
+                    ))
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "tolist"
+                ):
+                    findings.append(Finding(
+                        "DNZ-H001", file_rel, node.lineno, qn,
+                        f".tolist() inside registered hot-path function "
+                        f"{qn} — per-row materialization on a pinned "
+                        f"vectorized kernel",
+                    ))
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "hash"
+                ):
+                    findings.append(Finding(
+                        "DNZ-H002", file_rel, node.lineno, qn,
+                        f"hash(...) inside registered hot-path function "
+                        f"{qn} — composite-key hashing collides and "
+                        f"silently merges keys (PARITY.md Round-6); "
+                        f"intern to dense ids instead",
+                    ))
+    return findings

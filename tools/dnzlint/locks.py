@@ -1,0 +1,598 @@
+"""DNZ-L001/L002 — lock discipline for the threaded runtime.
+
+The engine's concurrency is a set of small, ad-hoc ``threading.Lock``s
+(prefetch swap/budget locks, the native build locks, the channel
+registry, the fault plan's event lock, the orchestrator epoch lock).
+None of them is documented as part of a global order — so nothing stops
+a future edit from taking two of them in opposite orders on two paths,
+and nothing flags an I/O call that turns a millisecond critical section
+into a seconds-long convoy.  This pass builds the static story:
+
+1. **Lock inventory** — every ``threading.Lock/RLock/Condition`` bound
+   to a module global or a ``self.<attr>`` becomes a node, identified
+   structurally (``module.py:NAME`` or ``Class.attr``) so all instances
+   of a class share one node, like a lock *class* in a runtime witness.
+2. **Region extraction** — every ``with <lock>:`` in every function,
+   tracking the held set through nesting.
+3. **Call graph** — calls made while holding a lock are resolved (same
+   class methods, ``self.<attr>``-typed objects via constructor
+   assignments, package-internal imports) and each callee's *effective*
+   acquisitions (transitive, computed to fixpoint) become edges
+   ``held -> acquired``.
+4. **DNZ-L001** — a cycle among those edges (including a plain-Lock
+   self-edge, which is a self-deadlock: ``Lock`` is not reentrant).
+5. **DNZ-L002** — a blocking call inside a held region: ``time.sleep``,
+   queue ``get``/``put``, ``join``/``wait``/``acquire``/``result``,
+   socket ops, ``subprocess.*``, ``ctypes.CDLL/PyDLL`` loads, calls on
+   native library handles (``self._lib.*`` — these drop the GIL and can
+   block in foreign code), and ``faults.inject`` (a latency rule sleeps
+   at the site).
+
+Static resolution is deliberately conservative: an edge is only drawn
+when the callee resolves unambiguously, so the pass under-reports rather
+than crying wolf.  The runtime companion
+(``denormalized_tpu/common/lockwitness.py``) covers the dynamic
+remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from tools.dnzlint import Finding, iter_python_files, rel_path
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_BLOCKING_ATTRS = {
+    "join", "wait", "acquire", "result", "recv", "send", "sendall",
+    "accept", "connect", "select",
+}
+_QUEUE_RECV = {"get", "put"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+_NATIVE_HANDLES = {"_lib", "lib", "_libref", "pylib", "_LIB"}
+
+
+def _lock_ctor_kind(call: ast.AST) -> str | None:
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading" and fn.attr in _LOCK_CTORS:
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_CTORS:
+        return fn.id
+    return None
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One callable (module function or method) and what it does."""
+
+    uid: str  # "rel:qualname"
+    rel: str
+    acquires: list  # [(lock, lineno)] — with-statements in this unit
+    calls: list  # [(callee_ref, lineno, held_tuple)]
+    blocking: list  # [(desc, lineno, held_tuple)] — under a held lock
+    blocking_all: list  # [(desc, lineno)] — every blocking-ish call
+    nest_edges: list  # [(held_lock, acquired_lock, lineno)]
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """First pass over one module: lock definitions, classes, attr types,
+    import aliases."""
+
+    def __init__(self, rel: str, pkg: str):
+        self.rel = rel
+        self.pkg = pkg
+        self.module_locks: dict[str, str] = {}  # NAME -> kind
+        self.class_locks: dict[tuple[str, str], str] = {}  # (Cls, attr) -> kind
+        self.classes: dict[str, set[str]] = {}  # Cls -> method names
+        self.attr_types: dict[tuple[str, str], str] = {}  # (Cls, attr) -> Cls2
+        self.aliases: dict[str, tuple[str, str]] = {}  # name -> (kind, target)
+        self.lock_def_lines: dict[str, int] = {}
+
+    def scan(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    name = node.targets[0].id
+                    self.module_locks[name] = kind
+                    self.lock_def_lines[f"{self.rel}:{name}"] = node.lineno
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._scan_import(node)
+            elif isinstance(node, ast.ClassDef):
+                methods = set()
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        methods.add(item.name)
+                        self._scan_method_assigns(node.name, item)
+                self.classes[node.name] = methods
+
+    def _scan_import(self, node) -> None:
+        prefix = self.pkg + "."
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(prefix):
+                    self.aliases[a.asname or a.name.split(".")[-1]] = (
+                        "module", a.name[len(prefix):].replace(".", "/") + ".py"
+                    )
+        else:  # ImportFrom
+            mod = node.module or ""
+            if mod == self.pkg or mod.startswith(prefix):
+                sub = "" if mod == self.pkg else mod[len(prefix):]
+                for a in node.names:
+                    # could be a submodule or an object in the module —
+                    # record both candidates; resolution tries module
+                    # first, then object
+                    self.aliases[a.asname or a.name] = (
+                        "from", f"{sub.replace('.', '/')}|{a.name}"
+                    )
+
+    def _scan_method_assigns(self, cls: str, fn) -> None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                self.class_locks[(cls, t.attr)] = kind
+                self.lock_def_lines[f"{cls}.{t.attr}"] = node.lineno
+            elif isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name):
+                # self.X = SomeClass(...) — remember for obj-typed calls
+                self.attr_types[(cls, t.attr)] = node.value.func.id
+
+
+class _Analysis:
+    """Package-wide lock/call analysis over all scanned modules."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self.pkg = root.name
+        self.scans: dict[str, _ModuleScan] = {}
+        self.trees: dict[str, ast.Module] = {}
+        self.units: dict[str, _Unit] = {}
+        self.lock_kinds: dict[str, str] = {}
+        self.lock_def_lines: dict[str, int] = {}
+        # global class name -> (rel, methods) — class names are unique in
+        # this package; on a clash the first (sorted) module wins and
+        # cross-module resolution just gets more conservative
+        self.global_classes: dict[str, tuple[str, set[str]]] = {}
+        self.global_attr_types: dict[tuple[str, str], str] = {}
+
+    # -- collection ------------------------------------------------------
+    def collect(self) -> None:
+        for path in iter_python_files(self.root):
+            rel = rel_path(path, self.root)
+            tree = ast.parse(path.read_text(), filename=str(path))
+            scan = _ModuleScan(rel, self.pkg)
+            scan.scan(tree)
+            self.scans[rel] = scan
+            self.trees[rel] = tree
+            for name, kind in scan.module_locks.items():
+                self.lock_kinds[f"{rel}:{name}"] = kind
+            for (cls, attr), kind in scan.class_locks.items():
+                self.lock_kinds[f"{cls}.{attr}"] = kind
+            self.lock_def_lines.update(scan.lock_def_lines)
+            for cls, methods in scan.classes.items():
+                self.global_classes.setdefault(cls, (rel, methods))
+            self.global_attr_types.update(scan.attr_types)
+        for rel, tree in sorted(self.trees.items()):
+            self._walk_module(rel, tree)
+
+    def _walk_module(self, rel: str, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_unit(rel, None, node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._walk_unit(
+                            rel, node.name, f"{node.name}.{item.name}", item
+                        )
+
+    def _resolve_lock(self, expr: ast.AST, rel: str,
+                      cls: str | None) -> str | None:
+        scan = self.scans[rel]
+        if isinstance(expr, ast.Name) and expr.id in scan.module_locks:
+            return f"{rel}:{expr.id}"
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None \
+                and (cls, expr.attr) in scan.class_locks:
+            return f"{cls}.{expr.attr}"
+        return None
+
+    def _walk_unit(self, rel: str, cls: str | None, qual: str, fn) -> None:
+        unit = _Unit(f"{rel}:{qual}", rel, [], [], [], [], [])
+        self.units[unit.uid] = unit
+
+        def walk(stmts, held: tuple[str, ...]) -> None:
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # nested def: a separate execution context — its body
+                    # runs at call time with an unknown held set; analyze
+                    # it as its own (conservatively lock-free-entry) unit
+                    self._walk_unit(rel, cls, f"{qual}.{node.name}", node)
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner = held
+                    for item in node.items:
+                        lock = self._resolve_lock(
+                            item.context_expr, rel, cls
+                        )
+                        if lock is not None:
+                            unit.acquires.append((lock, node.lineno))
+                            for h in inner:
+                                unit.nest_edges.append(
+                                    (h, lock, node.lineno)
+                                )
+                            inner = inner + (lock,)
+                        else:
+                            self._scan_exprs([item.context_expr], unit,
+                                             rel, cls, inner)
+                    walk(node.body, inner)
+                    continue
+                # every other statement: scan expressions for calls, then
+                # recurse into compound bodies with the same held set
+                self._scan_exprs(
+                    [node], unit, rel, cls, held, skip_bodies=True
+                )
+                if isinstance(node, ast.Match):
+                    # 3.10 match statements: case bodies are ordinary
+                    # held-region code, invisible to the generic
+                    # body/orelse recursion below
+                    for case in node.cases:
+                        walk(case.body, held)
+                    continue
+                for attr in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, attr, None)
+                    if sub:
+                        if attr == "handlers":
+                            for h in sub:
+                                walk(h.body, held)
+                        else:
+                            walk(sub, held)
+
+        walk(fn.body, ())
+
+    def _scan_exprs(self, nodes, unit: _Unit, rel: str, cls: str | None,
+                    held: tuple[str, ...], skip_bodies: bool = False) -> None:
+        """Find calls in expression position.  ``skip_bodies`` stops the
+        walk at compound-statement bodies (the caller recurses into those
+        itself, preserving the held set through nested withs)."""
+
+        def gen(node):
+            for child in ast.iter_child_nodes(node):
+                if skip_bodies and isinstance(child, (
+                    ast.With, ast.AsyncWith, ast.For, ast.AsyncFor,
+                    ast.While, ast.If, ast.Try, ast.Match,
+                    ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                    ast.ExceptHandler,
+                )):
+                    continue
+                yield child
+                yield from gen(child)
+
+        roots = []
+        for n in nodes:
+            if isinstance(n, (ast.For, ast.AsyncFor)):
+                roots.append(n.iter)
+            elif isinstance(n, ast.While):
+                roots.append(n.test)
+            elif isinstance(n, ast.If):
+                roots.append(n.test)
+            elif isinstance(n, ast.Match):
+                roots.append(n.subject)
+            elif isinstance(n, ast.Try):
+                continue
+            else:
+                roots.append(n)
+        for r in roots:
+            stack = [r] + list(gen(r))
+            for node in stack:
+                if isinstance(node, ast.Call):
+                    self._record_call(node, unit, rel, cls, held)
+
+    def _record_call(self, call: ast.Call, unit: _Unit, rel: str,
+                     cls: str | None, held: tuple[str, ...]) -> None:
+        fn = call.func
+        desc = self._blocking_desc(fn, rel, cls, held)
+        if desc:
+            unit.blocking_all.append((desc, call.lineno))
+            if held:
+                unit.blocking.append((desc, call.lineno, held))
+        callee = self._resolve_callee(fn, rel, cls)
+        if callee is not None:
+            unit.calls.append((callee, call.lineno, held))
+
+    def _blocking_desc(self, fn, rel, cls, held) -> str | None:
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id == "time" and fn.attr == "sleep":
+                    return "time.sleep"
+                if base.id == "subprocess" and fn.attr in _SUBPROCESS_FNS:
+                    return f"subprocess.{fn.attr}"
+                if base.id == "ctypes" and fn.attr in ("CDLL", "PyDLL"):
+                    return f"ctypes.{fn.attr} (native library load)"
+                if base.id == "faults" and fn.attr == "inject":
+                    return "faults.inject (latency rules sleep here)"
+                if base.id in _NATIVE_HANDLES:
+                    return f"native call {base.id}.{fn.attr} (drops the GIL)"
+            if isinstance(base, ast.Attribute) and base.attr in \
+                    _NATIVE_HANDLES:
+                return f"native call .{base.attr}.{fn.attr} (drops the GIL)"
+            recv = base.attr if isinstance(base, ast.Attribute) else (
+                base.id if isinstance(base, ast.Name) else ""
+            )
+            if fn.attr in _QUEUE_RECV and (
+                recv.rstrip("_") in ("q", "queue")
+                or recv.endswith(("_q", "_queue", "queue"))
+            ):
+                return f"queue {recv}.{fn.attr}"
+            if fn.attr in _BLOCKING_ATTRS:
+                if isinstance(base, ast.Constant):
+                    return None  # b"".join / ", ".join — string, not thread
+                # Condition idiom: cv.wait() while holding cv RELEASES the
+                # lock — not a convoy; only flag waits on OTHER objects
+                lock = self._resolve_lock(base, rel, cls)
+                if fn.attr == "wait" and lock is not None and lock in held:
+                    return None
+                return f".{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id == "inject":
+            return "faults.inject (latency rules sleep here)"
+        return None
+
+    def _resolve_callee(self, fn, rel: str, cls: str | None) -> str | None:
+        scan = self.scans[rel]
+        if isinstance(fn, ast.Name):
+            alias = scan.aliases.get(fn.id)
+            if alias and alias[0] == "from":
+                sub, name = alias[1].split("|")
+                target_rel = (f"{sub}/{name}.py" if sub else f"{name}.py")
+                pk = f"{self.pkg}/{target_rel}"
+                if pk in self.scans:
+                    return None  # bare module name used as value — ignore
+                owner_rel = f"{self.pkg}/{sub}.py" if sub else None
+                if owner_rel and owner_rel in self.scans:
+                    return self._unit_in(owner_rel, name)
+                # from a.b import obj with a/b a package dir module path
+                owner_rel2 = f"{self.pkg}/{sub}/__init__.py" if sub else None
+                if owner_rel2 and owner_rel2 in self.scans:
+                    return self._unit_in(owner_rel2, name)
+                return None
+            if fn.id in scan.classes or fn.id in self.global_classes:
+                owner = (rel if fn.id in scan.classes
+                         else self.global_classes[fn.id][0])
+                return f"{owner}:{fn.id}.__init__"
+            if self._defined_in(rel, fn.id):
+                return f"{rel}:{fn.id}"
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    if fn.attr in self.scans[rel].classes.get(cls, set()):
+                        return f"{rel}:{cls}.{fn.attr}"
+                    return None
+                alias = scan.aliases.get(base.id)
+                if alias and alias[0] == "module":
+                    owner = f"{self.pkg}/{alias[1]}"
+                    if owner in self.scans:
+                        return self._unit_in(owner, fn.attr)
+                if alias and alias[0] == "from":
+                    # from pkg import submodule; submodule.func()
+                    sub, name = alias[1].split("|")
+                    owner = (f"{self.pkg}/{sub}/{name}.py" if sub
+                             else f"{self.pkg}/{name}.py")
+                    if owner in self.scans:
+                        return self._unit_in(owner, fn.attr)
+                return None
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and cls is not None:
+                # self.X.method() with self.X = SomeClass(...)
+                target_cls = self.global_attr_types.get((cls, base.attr))
+                if target_cls and target_cls in self.global_classes:
+                    owner, methods = self.global_classes[target_cls]
+                    if fn.attr in methods:
+                        return f"{owner}:{target_cls}.{fn.attr}"
+        return None
+
+    def _unit_in(self, owner_rel: str, name: str) -> str | None:
+        if self._defined_in(owner_rel, name):
+            return f"{owner_rel}:{name}"
+        if name in self.scans[owner_rel].classes:
+            return f"{owner_rel}:{name}.__init__"
+        return None
+
+    def _defined_in(self, rel: str, name: str) -> bool:
+        tree = self.trees.get(rel)
+        if tree is None:
+            return False
+        return any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == name
+            for n in tree.body
+        )
+
+    # -- effective acquisitions + edges ----------------------------------
+    def effective(self) -> dict[str, set[str]]:
+        eff = {
+            uid: {lock for lock, _ in u.acquires}
+            for uid, u in self.units.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for uid, u in self.units.items():
+                for callee, _, _ in u.calls:
+                    extra = eff.get(callee, set()) - eff[uid]
+                    if extra:
+                        eff[uid] |= extra
+                        changed = True
+        return eff
+
+    def edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """{(from_lock, to_lock): (rel, line, how)} — deduped with one
+        representative location each."""
+        eff = self.effective()
+        out: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for uid in sorted(self.units):
+            u = self.units[uid]
+            for held, acquired, line in u.nest_edges:
+                out.setdefault(
+                    (held, acquired),
+                    (u.rel, line, f"nested with in {uid.split(':')[1]}"),
+                )
+            for callee, line, held in u.calls:
+                if not held:
+                    continue
+                for lock in sorted(eff.get(callee, ())):
+                    for h in held:
+                        # h == lock is kept: a callee re-acquiring a held
+                        # plain Lock is a self-deadlock (self-edge)
+                        out.setdefault(
+                            (h, lock),
+                            (u.rel, line,
+                             f"{uid.split(':')[1]} calls "
+                             f"{callee.split(':')[1]}"),
+                        )
+        return out
+
+
+def _cycles(edges: dict) -> list[list[str]]:
+    """Strongly connected components of size > 1, plus real self-loops,
+    via Tarjan (iterative)."""
+    graph: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, 0)]
+        while work:
+            v, pi = work.pop()
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            recurse = False
+            succs = sorted(graph[v])
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work.append((v, i + 1))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(sorted(scc))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return [s for s in sccs if len(s) > 1 or (s[0], s[0]) in edges]
+
+
+def run(root: Path) -> list[Finding]:
+    analysis = _Analysis(root)
+    analysis.collect()
+    findings: list[Finding] = []
+
+    edges = analysis.edges()
+    # a self-edge on an RLock/Condition is reentrant and fine; drop it
+    for (a, b) in [k for k in edges if k[0] == k[1]]:
+        if analysis.lock_kinds.get(a) in ("RLock", "Condition"):
+            del edges[(a, b)]
+
+    for cycle in _cycles(edges):
+        cyc_edges = sorted(
+            (k, v) for k, v in edges.items()
+            if k[0] in cycle and k[1] in cycle
+        )
+        detail = "; ".join(
+            f"{a} -> {b} at {rel}:{line} ({how})"
+            for (a, b), (rel, line, how) in cyc_edges
+        )
+        rel0, line0, _ = cyc_edges[0][1]
+        findings.append(Finding(
+            "DNZ-L001", rel0, line0, "cycle:" + "<->".join(cycle),
+            f"lock acquisition cycle among {cycle}: {detail} — two "
+            f"threads taking these in opposite orders deadlock",
+        ))
+
+    # effective blocking behavior per unit, to fixpoint — a blocking
+    # call moved into a helper is still a blocking call when the caller
+    # holds the lock across the helper
+    eff_blk: dict[str, dict[str, str]] = {
+        uid: {desc: uid for desc, _ in u.blocking_all}
+        for uid, u in analysis.units.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for uid, u in analysis.units.items():
+            for callee, _, _ in u.calls:
+                for desc, origin in eff_blk.get(callee, {}).items():
+                    if desc not in eff_blk[uid]:
+                        eff_blk[uid][desc] = origin
+                        changed = True
+
+    for uid in sorted(analysis.units):
+        u = analysis.units[uid]
+        for desc, line, held in u.blocking:
+            findings.append(Finding(
+                "DNZ-L002", u.rel, line, uid.split(":")[1],
+                f"{desc} while holding {list(held)} — a blocking call "
+                f"inside a critical section convoys every thread that "
+                f"needs the lock",
+            ))
+        for callee, line, held in u.calls:
+            if not held:
+                continue
+            for desc, origin in sorted(eff_blk.get(callee, {}).items()):
+                origin_q = origin.split(":")[1]
+                callee_q = callee.split(":")[1]
+                via = (
+                    f"inside {origin_q}" if origin_q == callee_q
+                    else f"inside {origin_q}, reached via {callee_q}"
+                )
+                findings.append(Finding(
+                    "DNZ-L002", u.rel, line, uid.split(":")[1],
+                    f"{desc} ({via}) while holding {list(held)} "
+                    f"— a blocking call inside a critical section convoys "
+                    f"every thread that needs the lock",
+                ))
+    return findings

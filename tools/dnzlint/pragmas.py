@@ -1,0 +1,68 @@
+"""Inline suppression pragmas.
+
+Syntax (comment on the flagged line, or on the line directly above when
+the construct spans the line — e.g. a decorator-less ``def`` or a long
+``with``)::
+
+    # dnzlint: allow(<slug>) <reason>
+
+The slug is the rule's short name (``broad-except``, ``hot-loop``, ...;
+see :data:`tools.dnzlint.RULES`) and the reason is REQUIRED: a pragma
+with no reason does not suppress, it is reported as the original finding
+(an unexplained mute is exactly the "silently swallowed" pattern the
+linter exists to kill).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tools.dnzlint import RULES, SLUG_TO_RULE, Finding
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dnzlint:\s*allow\(([a-z0-9-]+)\)\s*(.*)$"
+)
+
+
+class PragmaIndex:
+    """All pragmas of a scanned tree: {(rel_path, line) -> (rule, reason)}."""
+
+    def __init__(self) -> None:
+        self._by_line: dict[tuple[str, int], tuple[str, str]] = {}
+        self.malformed: list[Finding] = []
+
+    def scan(self, path: Path, rel: str) -> None:
+        for lineno, text in enumerate(path.read_text().splitlines(), 1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            slug, reason = m.group(1), m.group(2).strip()
+            rule = SLUG_TO_RULE.get(slug)
+            if rule is None:
+                self.malformed.append(Finding(
+                    "DNZ-E001", rel, lineno, f"pragma:{slug}",
+                    f"pragma names unknown rule slug {slug!r} "
+                    f"(known: {sorted(SLUG_TO_RULE)})",
+                ))
+                continue
+            if not reason:
+                self.malformed.append(Finding(
+                    rule, rel, lineno, f"pragma:{slug}",
+                    f"allow({slug}) pragma carries no reason — reasonless "
+                    f"suppressions do not suppress",
+                ))
+                continue
+            self._by_line[(rel, lineno)] = (rule, reason)
+
+    def allows(self, finding: Finding) -> bool:
+        """A pragma covers a finding when it names the finding's rule and
+        sits on the finding's line or the line directly above it."""
+        for line in (finding.line, finding.line - 1):
+            hit = self._by_line.get((finding.path, line))
+            if hit is not None and hit[0] == finding.rule:
+                return True
+        return False
+
+
+assert set(SLUG_TO_RULE.values()) == set(RULES)
