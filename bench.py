@@ -1504,8 +1504,10 @@ def _kafka_e2e_latency(parts, sustainable: float) -> dict:
 # -- throughput phase ----------------------------------------------------
 
 
-def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dict]:
-    ctx = _ctx_for(config, ckpt_dir=ckpt_dir)
+def run_throughput(
+    config, batches, batches2, ckpt_dir=None, **over
+) -> tuple[float, dict]:
+    ctx = _ctx_for(config, ckpt_dir=ckpt_dir, **over)
     ds = build_pipeline(
         config, ctx, _mem_source(batches), _mem_source(batches2) if batches2 else None
     )
@@ -1539,6 +1541,39 @@ def run_throughput(config, batches, batches2, ckpt_dir=None) -> tuple[float, dic
     except Exception as e:  # metrics must never sink the bench
         log(f"metrics collection failed: {e}")
     return rows / dt, info
+
+
+def run_obs_overhead(config, batches, batches2=None) -> dict:
+    """Overhead guard for default-level metrics (docs/observability.md):
+    the same throughput pipeline with the obs registry enabled vs
+    disabled, interleaved best-of-2 each so drift hits both sides.  The
+    enabled run must stay within noise of the disabled one — the
+    registry's whole design brief (pre-bound handles, one attribute add
+    per batch) is that observability is not a tax on the 49.3M rows/s
+    r5 baseline."""
+    from denormalized_tpu import obs as _obs
+
+    best = {True: 0.0, False: 0.0}
+    for _rep in range(2):
+        for enabled in (True, False):
+            # fresh registry per run: instrument maps never accumulate
+            # across reps, and the disabled runs bind true nulls
+            prev = _obs.use_registry(_obs.MetricsRegistry(enabled=enabled))
+            try:
+                rps, _ = run_throughput(
+                    config, batches, batches2, metrics_enabled=enabled
+                )
+            finally:
+                _obs.use_registry(prev)
+            best[enabled] = max(best[enabled], rps)
+    ratio = best[True] / best[False] if best[False] else None
+    return {
+        "obs_overhead_rps_enabled": round(best[True]),
+        "obs_overhead_rps_disabled": round(best[False]),
+        "obs_overhead_ratio": round(ratio, 4) if ratio else None,
+        # 5% is this box's run-to-run noise band on the simple config
+        "obs_overhead_within_noise": bool(ratio and ratio >= 0.95),
+    }
 
 
 # -- latency phase (paced feed) ------------------------------------------
@@ -1693,15 +1728,36 @@ def run_latency(config, ckpt_dir=None) -> dict:
     # emit_on_close=False: the end-of-stream flush emits windows the
     # watermark never closed — those are not latency observations
     clock = _FeedClock()
-    ctx = _ctx_for(
-        config, batch_bucket=LAT_BATCH, ckpt_dir=ckpt_dir, emit_on_close=False
+    # obs telemetry: the paced phase streams JSONL registry snapshots and
+    # the report cross-checks the obs-derived e2e percentiles against the
+    # directly-measured ones below.  A FRESH registry isolates this
+    # phase's histograms from the warmup/throughput phases' samples
+    # (operators bind at construction, so the paced pipeline's handles
+    # land in the new registry).
+    from denormalized_tpu import obs as _obs
+
+    obs_jsonl_path = os.path.join(
+        tempfile.mkdtemp(prefix="bench_obs_"), "obs.jsonl"
     )
-    ds = build_pipeline(
-        config,
-        ctx,
-        _paced_source(batches, clock),
-        _paced_source(batches2, clock) if batches2 else None,
-    )
+    prev_registry = _obs.use_registry(_obs.MetricsRegistry(enabled=True))
+    try:
+        ctx = _ctx_for(
+            config, batch_bucket=LAT_BATCH, ckpt_dir=ckpt_dir,
+            emit_on_close=False,
+            metrics_jsonl_path=obs_jsonl_path, metrics_jsonl_interval_s=0.5,
+        )
+        ds = build_pipeline(
+            config,
+            ctx,
+            _paced_source(batches, clock),
+            _paced_source(batches2, clock) if batches2 else None,
+        )
+    except BaseException:
+        # the swapped-in registry must not outlive a failed setup — the
+        # streaming loop's own finally below restores it on every later
+        # path
+        _obs.use_registry(prev_registry)
+        raise
     # Tail-attribution rig (r03 shipped an unexplained 1374ms p99 against
     # an 8.9ms p50; this box has ONE core, so any concurrent work — or a
     # gen-2 cyclic GC over the feed's tens of millions of interned-string
@@ -1795,6 +1851,7 @@ def run_latency(config, ckpt_dir=None) -> dict:
         # join so a gap ending at stream end still lands in the summary
         hb_thread.join(timeout=0.1)
         gc_fence.remove()
+        _obs.use_registry(prev_registry)
         jax.config.update("jax_log_compiles", prior_log_compiles)
         for logger_name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
             logging.getLogger(logger_name).removeHandler(compile_handler)
@@ -1819,7 +1876,54 @@ def run_latency(config, ckpt_dir=None) -> dict:
     if hb_gaps:
         out["hb_gap_max_ms"] = round(max(g for g, _ in hb_gaps), 1)
         out["hb_gap_count"] = len(hb_gaps)
+    out.update(_obs_latency_summary(obs_jsonl_path, clock))
     return out
+
+
+def _obs_latency_summary(obs_jsonl_path, clock) -> dict:
+    """Consume the paced phase's JSONL telemetry stream and cross-report
+    the ANCHOR-EXACT statistics: max end-to-end latency, max watermark
+    lag, and the sample count.  The engine's lag metrics are event-time-
+    relative (wall − event time), and bench replays from the fixed
+    EVENT_T0 — a ~2-year offset that parks every sample in the
+    histogram's overflow bucket, so bucket-interpolated percentiles are
+    NOT derivable here (the soak gets real percentiles by re-anchoring
+    its feed to wall-now; bench keeps its superior directly-measured
+    p50/p95/p99 above).  Min/max are tracked exactly per histogram, so
+    subtracting the known anchor yields exact values."""
+    from denormalized_tpu.obs import jsonl as obs_jsonl
+
+    try:
+        snaps = obs_jsonl.read_stream(obs_jsonl_path)
+        if not snaps or clock.t0 is None:
+            return {}
+        # perf_counter → epoch mapping taken NOW: anchor offset is the
+        # constant the raw event-lag metrics carry on this paced feed
+        anchor_epoch_ms = (
+            time.time() - (time.perf_counter() - clock.t0)
+        ) * 1000.0
+        off = anchor_epoch_ms - EVENT_T0
+        last = snaps[-1]["metrics"]
+        emit = obs_jsonl.merge_histogram([
+            v for k, v in last.items()
+            if k.startswith("dnz_emit_event_lag_ms") and isinstance(v, dict)
+        ])
+        out: dict = {}
+        if emit:
+            out["obs_max_e2e_ms"] = round(emit["max"] - off, 2)
+            out["obs_min_e2e_ms"] = round(emit["min"] - off, 2)
+            out["obs_e2e_samples"] = emit["count"]
+        wm = obs_jsonl.merge_histogram([
+            v for k, v in last.items()
+            if k.startswith("dnz_watermark_lag_hist_ms")
+            and isinstance(v, dict)
+        ])
+        if wm:
+            out["obs_max_watermark_lag_ms"] = round(wm["max"] - off, 2)
+        return out
+    except Exception as e:  # telemetry is reporting — never sink the bench
+        log(f"obs latency summary failed: {e}")
+        return {}
 
 
 # -- checkpoint kill/recovery phase (BASELINE.json config 5) --------------
@@ -2531,6 +2635,12 @@ def run_config(device: str) -> dict:
             kill_rec = run_kill_recovery()
             log(f"kill_recovery[{config}]: {kill_rec}")
         cpu_rps = run_cpu_baseline(batches, config, batches2)
+        obs_guard = {}
+        if config == "simple":
+            # metrics-overhead gate rides the headline config (the one
+            # the r5 49.3M rows/s baseline pins)
+            obs_guard = run_obs_overhead(config, batches, batches2)
+            log(f"obs_overhead[{config}]: {obs_guard}")
         probe = {}
         roof = {}
         if device == "tpu":
@@ -2559,6 +2669,7 @@ def run_config(device: str) -> dict:
             **roof,
             **lat,
             **kill_rec,
+            **obs_guard,
         }
         if DEVICE_FALLBACK:
             result["device_fallback"] = DEVICE_FALLBACK

@@ -133,6 +133,27 @@ class EngineConfig:
     # Falls back per-operator when an aggregate isn't finalizable on
     # device (variance family) or the state layout doesn't support it.
     device_finalize: bool = True
+    # -- observability (denormalized_tpu/obs, docs/observability.md) ----
+    # default-level metrics: typed registry instruments across every
+    # layer (per-operator batch time + rows, watermark/emit lag, kafka
+    # consumer lag, prefetch depth/restarts, checkpoint/LSM timings).
+    # False binds every handle to a shared no-op null — the hot paths
+    # then do literally nothing (pinned by tests/test_obs.py)
+    metrics_enabled: bool = True
+    # opt-in Prometheus text-exposition endpoint on a stdlib HTTP server
+    # (127.0.0.1); 0 = ephemeral port (read it back from
+    # ctx._last_exporters.prometheus.port), None = off
+    prometheus_port: int | None = None
+    # periodic JSONL registry snapshots (soak/bench telemetry stream);
+    # None = off
+    metrics_jsonl_path: str | None = None
+    metrics_jsonl_interval_s: float = 1.0
+    # Chrome trace-event JSON (Perfetto-loadable) dumped at stream end
+    # from the ring-buffered span recorder; None = off.  trace_events
+    # sizes the ring (newest events win; 0 = default 65536)
+    trace_path: str | None = None
+    trace_events: int = 0
+
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a
     # remote-compile TPU backend costs seconds per program on FIRST run;
@@ -233,6 +254,13 @@ class Context:
         self.config = config or EngineConfig()
         self._tables: dict[str, Source] = {}
         self._orchestrator = None
+        # metrics_enabled is applied by the EXECUTOR right before the
+        # physical operators are built (runtime/executor.py), so the
+        # executing context's config decides — merely CONSTRUCTING a
+        # second Context with a different setting cannot flip an earlier
+        # context's telemetry.  (The flag itself stays process-global:
+        # concurrently EXECUTING queries with different settings are not
+        # supported — see build_physical.)
         _enable_compilation_cache(self.config.compilation_cache_dir)
 
     def __repr__(self) -> str:

@@ -1,0 +1,161 @@
+"""denormalized_tpu.obs — engine-wide observability.
+
+The metrics half of the reference's ``BaselineMetrics``/``tracing``
+story, built production-grade: typed instruments (Counter, Gauge,
+Histogram with exponential buckets) declared once in
+:mod:`~denormalized_tpu.obs.catalog`, bound to pre-resolved handles at
+operator construction, exported three ways —
+
+- a Prometheus text-exposition endpoint on a stdlib HTTP server
+  (``EngineConfig(prometheus_port=...)``, opt-in);
+- periodic JSONL snapshots for soaks and benches
+  (``EngineConfig(metrics_jsonl_path=...)``);
+- a ring-buffered span recorder dumping Chrome trace-event JSON
+  loadable in Perfetto (``EngineConfig(trace_path=...)``).
+
+Hot-path contract: a bound handle's ``add``/``observe`` is one
+attribute update (plus a ~20-element bisect for histograms); with
+metrics disabled the handle is a falsy shared null object whose methods
+are no-ops and allocate nothing.  Instruments are single-writer by
+construction (one handle per operator/worker); export readers tolerate
+mid-increment reads.
+
+Use module-level binders everywhere in the engine (the dnzlint DNZ-M001
+pass statically checks the name literals against the catalog)::
+
+    from denormalized_tpu import obs
+    self._rows_in = obs.counter("dnz_op_rows_in_total", op="window")
+    ...
+    self._rows_in.add(batch.num_rows)
+"""
+
+from __future__ import annotations
+
+from denormalized_tpu.obs import spans as spans
+from denormalized_tpu.obs.catalog import INSTRUMENTS
+from denormalized_tpu.obs.registry import (
+    MetricsRegistry,
+    NULL,
+    series_name,
+)
+from denormalized_tpu.obs.spans import (
+    SpanRecorder,
+    disable_span_recording,
+    enable_span_recording,
+)
+
+__all__ = [
+    "INSTRUMENTS", "MetricsRegistry", "NULL", "SpanRecorder",
+    "counter", "gauge", "gauge_fn", "histogram", "enabled",
+    "set_enabled", "registry", "use_registry", "series_name",
+    "enable_span_recording", "disable_span_recording", "spans",
+    "start_exporters",
+]
+
+_REGISTRY = MetricsRegistry(enabled=True)
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def use_registry(reg: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process registry (tests, bench isolation); returns the
+    previous one so callers can restore it."""
+    global _REGISTRY
+    prev, _REGISTRY = _REGISTRY, reg
+    return prev
+
+
+def set_enabled(on: bool) -> None:
+    """Flip metrics for instruments bound FROM NOW ON (binding decides
+    null vs live once, so the hot path never re-checks).  Contexts apply
+    ``EngineConfig.metrics_enabled`` before any operator is built."""
+    _REGISTRY.enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def counter(name: str, **labels):
+    return _REGISTRY.counter(name, **labels)
+
+
+def gauge(name: str, **labels):
+    return _REGISTRY.gauge(name, **labels)
+
+
+def histogram(name: str, **labels):
+    return _REGISTRY.histogram(name, **labels)
+
+
+def gauge_fn(name: str, fn, **labels):
+    return _REGISTRY.gauge_fn(name, fn, **labels)
+
+
+# -- per-execution exporters (started by the executor, opt-in) ------------
+
+
+class Exporters:
+    """Running exporters of one query execution; ``stop()`` is
+    idempotent and flushes/dumps everything."""
+
+    def __init__(self, prometheus=None, jsonl=None, trace_path=None,
+                 installed_recorder=False):
+        self.prometheus = prometheus
+        self.jsonl = jsonl
+        self._trace_path = trace_path
+        self._installed_recorder = installed_recorder
+        self._stopped = False
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        if self.jsonl is not None:
+            self.jsonl.stop()
+        if self.prometheus is not None:
+            self.prometheus.stop()
+        if self._trace_path is not None:
+            rec = spans.recorder()
+            if rec is not None:
+                rec.dump(self._trace_path)
+        if self._installed_recorder:
+            # uninstall what WE installed: later queries must not keep
+            # paying per-span record cost (or leak this run's events
+            # into their traces); a user-installed recorder is left alone
+            disable_span_recording()
+
+
+def start_exporters(config) -> Exporters | None:
+    """Start whatever the config opted into; None when nothing is.
+    Read with getattr so a caller-supplied config object predating these
+    knobs (tests building bare namespaces) never breaks execution."""
+    port = getattr(config, "prometheus_port", None)
+    jsonl_path = getattr(config, "metrics_jsonl_path", None)
+    trace_path = getattr(config, "trace_path", None)
+    trace_events = getattr(config, "trace_events", 0)
+    if port is None and jsonl_path is None and trace_path is None:
+        return None
+    server = None
+    if port is not None:
+        from denormalized_tpu.obs.prometheus import PrometheusServer
+
+        server = PrometheusServer(_REGISTRY, port=port).start()
+    snap = None
+    if jsonl_path is not None:
+        from denormalized_tpu.obs.jsonl import JsonlSnapshotter
+
+        snap = JsonlSnapshotter(
+            jsonl_path, _REGISTRY,
+            interval_s=getattr(config, "metrics_jsonl_interval_s", 1.0),
+        ).start()
+    installed = False
+    if trace_path is not None and spans.recorder() is None:
+        enable_span_recording(int(trace_events) or 65536)
+        installed = True
+    return Exporters(
+        prometheus=server, jsonl=snap, trace_path=trace_path,
+        installed_recorder=installed,
+    )

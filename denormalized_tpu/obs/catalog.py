@@ -1,0 +1,172 @@
+"""The instrument catalog — every metric the engine emits, declared once.
+
+This is the observability analog of ``faults.SITES``: a single registry
+of instrument names with help strings and bucket layouts, machine-checked
+both ways by dnzlint (DNZ-M001) — an ``obs.counter("dnz_typo_total")``
+call anywhere in the engine fails the lint gate (the name keys nothing
+here), and a declared instrument nobody binds fails it too (a renamed
+call site must not leave the catalog advertising a metric that never
+reports).  ``docs/observability.md`` embeds the table generated from
+this dict (``python -m tools.dnzlint --metric-catalog``), so the doc
+cannot drift from the declarations.
+
+Naming convention (lint-enforced):
+
+- every name matches ``^dnz_[a-z][a-z0-9_]*$``;
+- counters end in ``_total`` (Prometheus counter convention);
+- histograms end in a unit suffix: ``_ms``, ``_s``, ``_bytes`` or
+  ``_rows``;
+- every entry carries a non-trivial help string.
+
+Entries are ``name: (kind, help[, buckets])`` where ``kind`` is
+``"counter"`` / ``"gauge"`` / ``"histogram"`` and ``buckets`` (histograms
+only) is an exponential layout ``{"start": s, "factor": f, "count": n}``
+producing bounds ``s, s*f, s*f^2, ...`` plus the implicit +Inf bucket.
+"""
+
+from __future__ import annotations
+
+# exponential bucket layouts (see exp_bounds): latencies from 50µs to
+# ~7min, sizes from 256B to ~4GB, row counts from 1 to ~1B — wide enough
+# that a soak never saturates the top bucket and percentile estimates
+# stay meaningful
+MS_BUCKETS = {"start": 0.05, "factor": 2.0, "count": 23}
+BYTES_BUCKETS = {"start": 256.0, "factor": 4.0, "count": 12}
+ROWS_BUCKETS = {"start": 1.0, "factor": 4.0, "count": 15}
+
+INSTRUMENTS: dict[str, tuple] = {
+    # -- per-operator (physical/*) -------------------------------------
+    "dnz_op_rows_in_total": (
+        "counter",
+        "rows entering a physical operator, labeled op=<operator>",
+    ),
+    "dnz_op_rows_out_total": (
+        "counter",
+        "rows leaving a physical operator (source/join/sink emission)",
+    ),
+    "dnz_op_batch_ms": (
+        "histogram",
+        "wall time one operator spent processing one input batch "
+        "(eval + device dispatch + emission assembly; excludes time "
+        "spent suspended in downstream operators)",
+        MS_BUCKETS,
+    ),
+    "dnz_windows_emitted_total": (
+        "counter",
+        "windows/sessions emitted by a stateful operator",
+    ),
+    "dnz_late_rows_total": (
+        "counter",
+        "rows dropped late (behind the watermark) by a stateful operator",
+    ),
+    # -- watermark / end-to-end latency (stamped at window emit) --------
+    "dnz_watermark_lag_ms": (
+        "gauge",
+        "wall clock minus the operator's event-time watermark at the "
+        "last trigger — how far event time trails real time (includes "
+        "the replay offset when replaying historical data)",
+    ),
+    "dnz_watermark_lag_hist_ms": (
+        "histogram",
+        "distribution of wall-minus-watermark samples taken at every "
+        "trigger (the max over a run is the peak watermark lag)",
+        MS_BUCKETS,
+    ),
+    "dnz_emit_event_lag_ms": (
+        "histogram",
+        "end-to-end event-time emission latency: wall clock minus "
+        "window end, observed once per emitted window (for a replayed "
+        "feed this includes the constant replay offset; consumers "
+        "subtract their feed anchor — see tools/soak.py)",
+        MS_BUCKETS,
+    ),
+    # -- ingest (runtime/prefetch.py, sources/kafka.py) -----------------
+    "dnz_prefetch_queue_depth": (
+        "gauge",
+        "rowful batches enqueued but not yet consumed for one "
+        "partition's prefetch buffer (backpressure: the bounded "
+        "per-partition double buffer is full when depth == depth limit)",
+    ),
+    "dnz_prefetch_restarts_total": (
+        "counter",
+        "supervised prefetch-worker restarts (crash + rebuild + reseek)",
+    ),
+    "dnz_kafka_consumer_lag_rows": (
+        "gauge",
+        "records between this reader's cursor and the partition high "
+        "watermark reported by the last fetch response (broker-side "
+        "backlog; 0 = caught up)",
+    ),
+    "dnz_decode_fallback_rows": (
+        "gauge",
+        "rows decoded through the ~30x-slower Python fallback path "
+        "instead of the native columnar parser (registry view of the "
+        "SourceExec.metrics() counter)",
+    ),
+    # -- state (state/lsm.py, state/checkpoint.py) ----------------------
+    "dnz_lsm_op_ms": (
+        "histogram",
+        "latency of one LSM state-backend operation, labeled "
+        "op=put|get|flush",
+        MS_BUCKETS,
+    ),
+    "dnz_checkpoint_commit_ms": (
+        "histogram",
+        "duration of a checkpoint commit (manifest + fsync + commit "
+        "record + fsync + GC)",
+        MS_BUCKETS,
+    ),
+    "dnz_checkpoint_snapshot_bytes": (
+        "histogram",
+        "size of one operator snapshot blob as persisted (framed)",
+        BYTES_BUCKETS,
+    ),
+    "dnz_checkpoint_committed_epoch": (
+        "gauge",
+        "the last durably committed checkpoint epoch",
+    ),
+    "dnz_checkpoint_commit_retries_total": (
+        "counter",
+        "transient StateErrors absorbed by the bounded commit retry "
+        "(registry view of CheckpointCoordinator.commit_retries)",
+    ),
+    "dnz_lsm_replay_truncated_total": (
+        "counter",
+        "torn segment tails dropped by LSM startup replay (registry "
+        "view of LsmStore.replay_truncated; pure-Python engine only)",
+    ),
+    # -- fault injection (runtime/faults.py) ----------------------------
+    "dnz_fault_injections_total": (
+        "counter",
+        "fault-plan rules fired, labeled site=<injection site> — the "
+        "chaos event stream's counter view (timeline derivable from "
+        "successive JSONL snapshots)",
+    ),
+}
+
+
+def exp_bounds(spec: dict) -> list[float]:
+    """Materialize an exponential bucket layout into ascending upper
+    bounds (the +Inf bucket is implicit)."""
+    start = float(spec["start"])
+    factor = float(spec["factor"])
+    count = int(spec["count"])
+    return [start * factor**i for i in range(count)]
+
+
+def declaration(name: str) -> tuple:
+    """(kind, help, bounds|None) for a declared instrument; raises
+    KeyError with the catalog pointer for unknown names — binding an
+    undeclared instrument is a programming error the lint also catches
+    statically (DNZ-M001)."""
+    try:
+        entry = INSTRUMENTS[name]
+    except KeyError:
+        raise KeyError(
+            f"instrument {name!r} is not declared in "
+            "denormalized_tpu/obs/catalog.py (DNZ-M001: every metric "
+            "name must be declared with a help string)"
+        ) from None
+    kind, help_str = entry[0], entry[1]
+    bounds = exp_bounds(entry[2]) if kind == "histogram" else None
+    return kind, help_str, bounds

@@ -1,0 +1,127 @@
+"""Prometheus text-exposition rendering + the opt-in scrape endpoint.
+
+Rendering follows the text exposition format 0.0.4: one ``# HELP`` /
+``# TYPE`` pair per metric family, histograms expanded to cumulative
+``_bucket{le=...}`` series plus ``_sum``/``_count``.  Every instrument
+declared in the catalog is rendered — declared-but-unbound families
+emit their HELP/TYPE header with no samples, so a scrape always shows
+the full registered surface (the acceptance contract: a scrape during a
+running query returns all registered instruments).
+
+The endpoint is a stdlib ``ThreadingHTTPServer`` on a daemon thread,
+opt-in via ``EngineConfig(prometheus_port=...)`` (0 = ephemeral port,
+read it back from ``PrometheusServer.port``).  No dependencies — the
+container has no prometheus_client, and the engine does not need one.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from denormalized_tpu.obs.catalog import INSTRUMENTS
+from denormalized_tpu.obs.registry import Histogram, MetricsRegistry
+
+
+def _escape_label(v: str) -> str:
+    return (
+        str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _labels_str(labels: tuple, extra: tuple = ()) -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels] + [
+        f'{k}="{_escape_label(v)}"' for k, v in extra
+    ]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render(registry: MetricsRegistry) -> str:
+    """The full text exposition for one registry."""
+    by_name: dict[str, list] = {name: [] for name in INSTRUMENTS}
+    for inst in registry.instruments():
+        by_name.setdefault(inst.name, []).append(inst)
+    lines: list[str] = []
+    for name, (kind, help_str, *_rest) in INSTRUMENTS.items():
+        lines.append(f"# HELP {name} {help_str}")
+        lines.append(f"# TYPE {name} {kind}")
+        for inst in by_name.get(name, []):
+            if isinstance(inst, Histogram):
+                acc = 0
+                for i, bound in enumerate(inst.bounds):
+                    acc += inst.counts[i]
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_str(inst.labels, (('le', _fmt(bound)),))}"
+                        f" {acc}"
+                    )
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_labels_str(inst.labels, (('le', '+Inf'),))}"
+                    f" {inst.count}"
+                )
+                lines.append(
+                    f"{name}_sum{_labels_str(inst.labels)} {_fmt(inst.sum)}"
+                )
+                lines.append(
+                    f"{name}_count{_labels_str(inst.labels)} {inst.count}"
+                )
+            else:
+                lines.append(
+                    f"{name}{_labels_str(inst.labels)} {_fmt(inst.value)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusServer:
+    """Scrape endpoint serving ``render(registry)`` at ``/metrics``
+    (and ``/`` for convenience) on a daemon thread."""
+
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self._registry = registry
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = render(server._registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", server.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes must not spam the engine's stderr
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name=f"obs-prometheus-{self.port}",
+        )
+
+    def start(self) -> "PrometheusServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

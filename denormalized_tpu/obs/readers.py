@@ -1,0 +1,149 @@
+"""Read-side helpers for the JSONL telemetry stream — dependency-free.
+
+This module imports NOTHING from the engine (stdlib only) so consumers
+that must stay jax-free — the soak PARENT, external report tooling —
+can load it standalone by file path::
+
+    spec = importlib.util.spec_from_file_location("obs_readers", path)
+
+In-process consumers import the same names via
+:mod:`denormalized_tpu.obs.jsonl`, which re-exports them; the histogram
+quantile estimator here is also the one the live registry uses
+(:mod:`~denormalized_tpu.obs.registry` imports it), so writer and
+reader can never disagree about interpolation.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def quantile_from_buckets(
+    bounds, counts, total, q, *, vmin=None, vmax=None
+) -> float | None:
+    """Interpolated q-quantile (0..1) from exponential bucket counts,
+    clamped by the exact observed min/max when known; None when empty."""
+    if not total:
+        return None
+    rank = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        lo = bounds[i - 1] if i > 0 else (
+            vmin if vmin is not None else 0.0
+        )
+        hi = bounds[i] if i < len(bounds) else (
+            vmax if vmax is not None else bounds[-1]
+        )
+        # tighten the interpolation edges by the exact observed range:
+        # when all mass lands in one bucket (e.g. a replay offset pushing
+        # everything past the top bound) this degrades gracefully to a
+        # linear min→max estimate instead of saturating at a bucket edge
+        if vmin is not None and vmin > lo:
+            lo = min(vmin, hi)
+        if vmax is not None and vmax < hi:
+            hi = max(vmax, lo)
+        if acc + c >= rank:
+            frac = (rank - acc) / c
+            est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+            if vmax is not None:
+                est = min(est, vmax)
+            if vmin is not None:
+                est = max(est, vmin)
+            return est
+        acc += c
+    return vmax
+
+
+def read_stream(path) -> list[dict]:
+    """All obs snapshots of one JSONL file, oldest first; torn tail
+    lines (SIGKILL mid-write) are skipped."""
+    out = []
+    try:
+        f = open(path)
+    except FileNotFoundError:
+        return out
+    with f:
+        for line in f:
+            try:
+                o = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if o.get("event") == "obs":
+                out.append(o)
+    return out
+
+
+def last_stats(snapshots: list[dict], series: str):
+    """The final value/stats of one series across a snapshot stream."""
+    for snap in reversed(snapshots):
+        v = snap.get("metrics", {}).get(series)
+        if v is not None:
+            return v
+    return None
+
+
+def merge_histogram(stats_list: list[dict]) -> dict | None:
+    """Merge several processes' final histogram stats (same bucket
+    layout) into one: counts/sums add, min/max combine, percentiles
+    re-derived over the merged buckets."""
+    stats_list = [s for s in stats_list if s and s.get("count")]
+    if not stats_list:
+        return None
+    bounds = stats_list[0]["bounds"]
+    counts = [0] * (len(bounds) + 1)
+    total, total_sum = 0, 0.0
+    vmin, vmax = None, None
+    for s in stats_list:
+        if s["bounds"] != bounds:
+            continue  # layout changed between runs: skip, never mis-merge
+        for i, c in enumerate(s["bucket_counts"]):
+            counts[i] += c
+        total += s["count"]
+        total_sum += s["sum"]
+        if s["min"] is not None and (vmin is None or s["min"] < vmin):
+            vmin = s["min"]
+        if s["max"] is not None and (vmax is None or s["max"] > vmax):
+            vmax = s["max"]
+    if not total:
+        return None
+    q = lambda p: quantile_from_buckets(  # noqa: E731
+        bounds, counts, total, p, vmin=vmin, vmax=vmax
+    )
+    return {
+        "count": total,
+        "sum": total_sum,
+        "min": vmin,
+        "max": vmax,
+        "p50": q(0.50),
+        "p95": q(0.95),
+        "p99": q(0.99),
+    }
+
+
+def counter_timeline(snapshots: list[dict], prefix: str) -> list[dict]:
+    """Per-interval increments of every counter series starting with
+    ``prefix``, as ``[{"t": <s>, "series": ..., "delta": n}, ...]`` —
+    how the soak report reconstructs the fault-event timeline from the
+    cumulative ``dnz_fault_injections_total{site=...}`` counters.
+
+    Call this per PROCESS stream: counters restart at zero with each
+    process, so a concatenated multi-segment stream must be split by
+    segment first (tools/soak.py does).  A decrease is still treated as
+    a reset (delta = new value) rather than dropped, so an unsplit
+    stream degrades to undercounting only when a restarted counter
+    overtakes its predecessor between snapshots."""
+    last: dict[str, float] = {}
+    out: list[dict] = []
+    for snap in snapshots:
+        t = snap.get("t")
+        for series, v in snap.get("metrics", {}).items():
+            if not series.startswith(prefix) or isinstance(v, dict):
+                continue
+            prev = last.get(series, 0)
+            delta = v if v < prev else v - prev
+            if delta > 0:
+                out.append({"t": t, "series": series, "delta": delta})
+            last[series] = v
+    return out

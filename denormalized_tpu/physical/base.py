@@ -24,6 +24,7 @@ from typing import Iterator, Union
 
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import Schema
+from denormalized_tpu.obs.registry import NULL as _OBS_NULL
 
 
 @dataclass(frozen=True)
@@ -90,6 +91,23 @@ class ExecOperator:
 
     #: output schema
     schema: Schema
+
+    #: registry handles (no-op defaults so an operator that never calls
+    #: bind_obs — test doubles subclassing ExecOperator directly — still
+    #: runs; real operators bind in their constructors)
+    _obs_rows_in = _OBS_NULL
+    _obs_batch_ms = _OBS_NULL
+
+    def bind_obs(self, op: str) -> None:
+        """Bind this operator's registry instruments (obs subsystem):
+        rows-in counter + per-batch processing-time histogram, labeled
+        ``op=<label>``.  Called once from each operator's constructor;
+        with metrics disabled the handles are shared no-op nulls, so
+        the hot path stays allocation-free."""
+        from denormalized_tpu import obs
+
+        self._obs_rows_in = obs.counter("dnz_op_rows_in_total", op=op)
+        self._obs_batch_ms = obs.histogram("dnz_op_batch_ms", op=op)
 
     def run(self) -> Iterator[StreamItem]:
         raise NotImplementedError

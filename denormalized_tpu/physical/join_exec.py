@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
+import time
 from collections import deque
 from typing import Iterator
 
@@ -268,6 +269,10 @@ class StreamingJoinExec(ExecOperator):
                     f"{rk}: {rf.dtype}"
                 )
         self._metrics = {"rows_out": 0, "evicted": 0}
+        from denormalized_tpu import obs
+
+        self.bind_obs("join")
+        self._obs_rows_out = obs.counter("dnz_op_rows_out_total", op="join")
         # re-keying threshold (tests lower it to force the path)
         self._reintern_min = 262_144
         # checkpointing (None = disabled): set by enable_checkpointing
@@ -768,6 +773,8 @@ class StreamingJoinExec(ExecOperator):
                 batch: RecordBatch = item
                 if batch.num_rows == 0:
                     continue
+                self._obs_rows_in.add(batch.num_rows)
+                t0_batch = time.perf_counter()
                 gids = self._gids_of(
                     batch, self.left_keys if is_left else self.right_keys
                 )
@@ -779,8 +786,12 @@ class StreamingJoinExec(ExecOperator):
                 out = self._probe(
                     batch, gids, other, is_left, probe_base, side
                 )
+                self._obs_batch_ms.observe(
+                    (time.perf_counter() - t0_batch) * 1e3
+                )
                 if out is not None:
                     self._metrics["rows_out"] += out.num_rows
+                    self._obs_rows_out.add(out.num_rows)
                     yield out
                 # watermark & eviction
                 ts = np.asarray(

@@ -49,6 +49,7 @@ selects it) and serves as the differential oracle.
 
 from __future__ import annotations
 
+import time
 from typing import Iterator
 
 import numpy as np
@@ -167,6 +168,20 @@ class SessionWindowExec(ExecOperator):
             "late_rows": 0,
             "salvage_rows_scanned": 0,
         }
+        from denormalized_tpu import obs
+
+        self.bind_obs("session")
+        self._obs_late = obs.counter("dnz_late_rows_total", op="session")
+        self._obs_windows = obs.counter(
+            "dnz_windows_emitted_total", op="session"
+        )
+        self._obs_emit_lag = obs.histogram(
+            "dnz_emit_event_lag_ms", op="session"
+        )
+        self._obs_wm_lag = obs.gauge("dnz_watermark_lag_ms", op="session")
+        self._obs_wm_lag_hist = obs.histogram(
+            "dnz_watermark_lag_hist_ms", op="session"
+        )
 
     @property
     def children(self):
@@ -241,6 +256,7 @@ class SessionWindowExec(ExecOperator):
         if n == 0:
             return
         self._metrics["rows_in"] += n
+        self._obs_rows_in.add(n)
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         key_cols = [g.eval(batch) for g in self.group_exprs]
         gids = self._interner.intern(key_cols)
@@ -284,6 +300,7 @@ class SessionWindowExec(ExecOperator):
             n_late = int(late.sum())
             if n_late:
                 self._metrics["late_rows"] += n_late
+                self._obs_late.add(n_late)
                 dropped_gids = np.unique(gids[late])
                 keep = ~late
                 ts = ts[keep]
@@ -533,6 +550,10 @@ class SessionWindowExec(ExecOperator):
         WatermarkHint handling.  One vectorized scan of the live slots."""
         if self._watermark is None or candidate_wm > self._watermark:
             self._watermark = candidate_wm
+        if self._obs_wm_lag:
+            lag = time.time() * 1000.0 - self._watermark
+            self._obs_wm_lag.set(lag)
+            self._obs_wm_lag_hist.observe(lag)
         expired = self._table.expired_slots(self.gap_ms, self._watermark)
         if len(expired) == 0:
             return
@@ -551,6 +572,15 @@ class SessionWindowExec(ExecOperator):
         T = self._table
         m = len(slots)
         self._metrics["sessions_emitted"] += m
+        self._obs_windows.add(m)
+        if self._obs_emit_lag:
+            # one sample per emission sweep, at the OLDEST session's end
+            # (start-of-last-row + gap) — the conservative bound on this
+            # sweep's event-time emission latency
+            self._obs_emit_lag.observe(
+                time.time() * 1000.0
+                - (float(T.last[slots].min()) + self.gap_ms)
+            )
         in_schema = self.input_op.schema
         key_vals = self._interner.keys_of(T.gid[slots])
         cols: list[np.ndarray] = []
@@ -713,7 +743,12 @@ class SessionWindowExec(ExecOperator):
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
-                yield from self._process_batch(item)
+                # materialized inside the timing bracket: the histogram
+                # measures this operator's work, not downstream's
+                t0 = time.perf_counter()
+                out = list(self._process_batch(item))
+                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":
                     self._src_watermarks = True

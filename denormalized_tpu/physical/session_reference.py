@@ -129,6 +129,13 @@ class ReferenceSessionWindowExec(ExecOperator):
         self._src_watermarks = False
         self._ckpt: tuple | None = None
         self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
+        from denormalized_tpu import obs
+
+        self.bind_obs("session_ref")
+        self._obs_late = obs.counter("dnz_late_rows_total", op="session_ref")
+        self._obs_windows = obs.counter(
+            "dnz_windows_emitted_total", op="session_ref"
+        )
 
     @property
     def children(self):
@@ -217,6 +224,7 @@ class ReferenceSessionWindowExec(ExecOperator):
         if n == 0:
             return
         self._metrics["rows_in"] += n
+        self._obs_rows_in.add(n)
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         key_cols = [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
         vals = (
@@ -299,6 +307,7 @@ class ReferenceSessionWindowExec(ExecOperator):
             n_late = int(late.sum())
             if n_late:
                 self._metrics["late_rows"] += n_late
+                self._obs_late.add(n_late)
                 keep = ~late
                 ts = ts[keep]
                 key_cols = [kc[keep] for kc in key_cols]
@@ -404,6 +413,7 @@ class ReferenceSessionWindowExec(ExecOperator):
 
     def _emit(self, closed: list[tuple[tuple, _Session]]) -> RecordBatch:
         self._metrics["sessions_emitted"] += len(closed)
+        self._obs_windows.add(len(closed))
         m = len(closed)
         cols: list[np.ndarray] = []
         in_schema = self.input_op.schema

@@ -30,6 +30,22 @@ from denormalized_tpu.physical.base import (
 from denormalized_tpu.sources.base import Source
 
 
+#: per-process ordinal per source NAME: two sources sharing a name (the
+#: bench join runs two default-named MemorySources) must not share metric
+#: series — the registry dedups by (name, labels), and a shared gauge /
+#: gauge_fn would oscillate between (or drop) the two owners.  The first
+#: claimant of a name keeps it bare; later ones get ``name#2``, ``#3``...
+#: (deterministic in plan-build order, so a restarted identical query
+#: maps to the same series within one process run).
+_SOURCE_SERIES_ORDINALS: dict[str, int] = {}
+
+
+def _source_series_label(name: str) -> str:
+    n = _SOURCE_SERIES_ORDINALS.get(name, 0) + 1
+    _SOURCE_SERIES_ORDINALS[name] = n
+    return name if n == 1 else f"{name}#{n}"
+
+
 class _IdleTracker:
     """Idle-source detection shared by both SourceExec drive loops: rows
     re-arm it; after ``timeout_ms`` without rows it yields ONE
@@ -243,6 +259,31 @@ class SourceExec(ExecOperator):
         self._yielded_offsets: list | None = None
         self._ckpt = None  # (CheckpointCoordinator, node_id)
         self._pump = None  # live prefetch pump (supervisor metrics)
+        import weakref
+
+        from denormalized_tpu import obs
+
+        # collision-free series label (see _source_series_label): two
+        # same-named sources in one plan get distinct series
+        self._obs_source_label = _source_series_label(str(source.name))
+        self._obs_rows_out = obs.counter(
+            "dnz_op_rows_out_total", op="source",
+            source=self._obs_source_label,
+        )
+        # registry view of the ad-hoc decode-fallback counter: the
+        # authoritative count stays on the readers/pump (see metrics()),
+        # the gauge reads it at export time.  Weakref, not self — the
+        # process-global registry must not pin a finished query's
+        # operator graph (pump, readers, buffers) in memory forever.
+        ref = weakref.ref(self)
+        obs.gauge_fn(
+            "dnz_decode_fallback_rows",
+            lambda: (
+                op.metrics().get("decode_fallback_rows", 0)
+                if (op := ref()) is not None else 0
+            ),
+            source=self._obs_source_label,
+        )
 
     def set_barrier_source(self, poll: Callable[[], int | None]) -> None:
         self._barrier_poll = poll
@@ -399,6 +440,7 @@ class SourceExec(ExecOperator):
                     if b.num_rows:
                         self._metrics["rows_out"] += b.num_rows
                         self._metrics["batches_out"] += 1
+                        self._obs_rows_out.add(b.num_rows)
                         if idle is not None:
                             idle.observe_rows(b)
                         yield b
@@ -432,6 +474,7 @@ class SourceExec(ExecOperator):
             # worker crashes (restart + seek to the last enqueued offset)
             # instead of failing the query on the first transient error
             reader_factories=self.source.partition_factories(),
+            source_name=self._obs_source_label,
         )
         self._pump = pump
         finished = 0
@@ -464,6 +507,7 @@ class SourceExec(ExecOperator):
                     continue
                 self._metrics["rows_out"] += batch.num_rows
                 self._metrics["batches_out"] += 1
+                self._obs_rows_out.add(batch.num_rows)
                 if idle is not None:
                     if batch.num_rows:
                         idle.observe_rows(batch)
@@ -491,6 +535,7 @@ class ProjectExec(ExecOperator):
         self.input_op = input_op
         self.exprs = exprs
         self.schema = schema
+        self.bind_obs("project")
 
     @property
     def children(self):
@@ -511,12 +556,16 @@ class ProjectExec(ExecOperator):
 
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
+                t0 = time.perf_counter()
+                self._obs_rows_in.add(item.num_rows)
                 cols = [e.eval(item) for e in self.exprs]
                 masks = [
                     item.mask(src) if (src := passthrough_name(e)) is not None else None
                     for e in self.exprs
                 ]
-                yield RecordBatch(self.schema, cols, masks)
+                out = RecordBatch(self.schema, cols, masks)
+                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                yield out
             else:
                 yield item
 
@@ -526,6 +575,7 @@ class FilterExec(ExecOperator):
         self.input_op = input_op
         self.predicate = predicate
         self.schema = input_op.schema
+        self.bind_obs("filter")
 
     @property
     def children(self):
@@ -537,11 +587,17 @@ class FilterExec(ExecOperator):
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
+                t0 = time.perf_counter()
+                self._obs_rows_in.add(item.num_rows)
                 keep = np.asarray(self.predicate.eval(item), dtype=bool)
-                if keep.all():
-                    yield item
-                elif keep.any():
-                    yield item.filter(keep)
+                out = (
+                    item if keep.all()
+                    else item.filter(keep) if keep.any()
+                    else None
+                )
+                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                if out is not None:
+                    yield out
             else:
                 yield item
 

@@ -13,6 +13,7 @@ planner routes a window with ANY udaf here.
 
 from __future__ import annotations
 
+import time
 from typing import Iterator
 
 import numpy as np
@@ -162,6 +163,20 @@ class UdafWindowExec(ExecOperator):
         # longer advances the watermark (replay-skew safety)
         self._src_watermarks = False
         self._metrics = {"rows_in": 0, "windows_emitted": 0, "late_rows": 0}
+        from denormalized_tpu import obs
+
+        self.bind_obs("udaf")
+        self._obs_late = obs.counter("dnz_late_rows_total", op="udaf")
+        self._obs_windows = obs.counter(
+            "dnz_windows_emitted_total", op="udaf"
+        )
+        self._obs_emit_lag = obs.histogram(
+            "dnz_emit_event_lag_ms", op="udaf"
+        )
+        self._obs_wm_lag = obs.gauge("dnz_watermark_lag_ms", op="udaf")
+        self._obs_wm_lag_hist = obs.histogram(
+            "dnz_watermark_lag_hist_ms", op="udaf"
+        )
 
     @property
     def children(self):
@@ -187,6 +202,7 @@ class UdafWindowExec(ExecOperator):
         if n == 0:
             return
         self._metrics["rows_in"] += n
+        self._obs_rows_in.add(n)
         S = self.slide_ms
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         units = ts // S
@@ -249,7 +265,10 @@ class UdafWindowExec(ExecOperator):
             )
             late = (win < self._first_open) & ((ts - win * S) < self.length_ms)
             if i == 0:
-                self._metrics["late_rows"] += int(late.sum())
+                n_late = int(late.sum())
+                self._metrics["late_rows"] += n_late
+                if n_late:
+                    self._obs_late.add(n_late)
             idx = np.nonzero(in_window)[0]
             if len(idx) == 0:
                 continue
@@ -295,6 +314,10 @@ class UdafWindowExec(ExecOperator):
     def _trigger(self) -> Iterator[RecordBatch]:
         if self._watermark is None or self._first_open is None:
             return
+        if self._obs_wm_lag:
+            lag = time.time() * 1000.0 - self._watermark
+            self._obs_wm_lag.set(lag)
+            self._obs_wm_lag_hist.observe(lag)
         while self._first_open * self.slide_ms + self.length_ms <= self._watermark:
             b = self._emit(self._first_open)
             self._first_open += 1
@@ -352,6 +375,11 @@ class UdafWindowExec(ExecOperator):
         if not frame:
             return None
         self._metrics["windows_emitted"] += 1
+        self._obs_windows.add(1)
+        if self._obs_emit_lag:
+            self._obs_emit_lag.observe(
+                time.time() * 1000.0 - (j * self.slide_ms + self.length_ms)
+            )
         m = len(frame)
         items = list(frame.items())
         cols: list[np.ndarray] = []
@@ -454,7 +482,12 @@ class UdafWindowExec(ExecOperator):
     def run(self) -> Iterator[StreamItem]:
         for item in self.input_op.run():
             if isinstance(item, RecordBatch):
-                yield from self._process_batch(item)
+                # materialized inside the timing bracket: the histogram
+                # measures this operator's work, not downstream's
+                t0 = time.perf_counter()
+                out = list(self._process_batch(item))
+                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":
                     self._src_watermarks = True

@@ -307,6 +307,22 @@ class StreamingWindowExec(ExecOperator):
             "grow_events": 0,
             "host_prep_s": 0.0,
         }
+        # registry instruments (obs subsystem), pre-bound so the per-
+        # batch path is attribute adds only
+        from denormalized_tpu import obs
+
+        self.bind_obs("window")
+        self._obs_late = obs.counter("dnz_late_rows_total", op="window")
+        self._obs_windows = obs.counter(
+            "dnz_windows_emitted_total", op="window"
+        )
+        self._obs_emit_lag = obs.histogram(
+            "dnz_emit_event_lag_ms", op="window"
+        )
+        self._obs_wm_lag = obs.gauge("dnz_watermark_lag_ms", op="window")
+        self._obs_wm_lag_hist = obs.histogram(
+            "dnz_watermark_lag_hist_ms", op="window"
+        )
 
     # ------------------------------------------------------------------
     @property
@@ -419,6 +435,7 @@ class StreamingWindowExec(ExecOperator):
             return
         self._metrics["rows_in"] += n
         self._metrics["batches_in"] += 1
+        self._obs_rows_in.add(n)
         S = self.slide_ms
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         units, rem64 = np.divmod(ts, S)  # one pass for quotient+remainder
@@ -472,6 +489,7 @@ class StreamingWindowExec(ExecOperator):
         late = int((win_rel64 < 0).sum())
         if late:
             self._metrics["late_rows"] += late
+            self._obs_late.add(late)
 
         # group ids — intern BEFORE the capacity check so G always covers
         # every id this batch scatters
@@ -583,6 +601,7 @@ class StreamingWindowExec(ExecOperator):
                 n_drop = int((~keep).sum())
                 if n_drop:
                     self._metrics["late_rows"] += n_drop - late
+                    self._obs_late.add(n_drop - late)
                 else:
                     keep = None
             else:
@@ -773,6 +792,13 @@ class StreamingWindowExec(ExecOperator):
         deferral: ingest uses it to freeze closable windows before a
         batch whose rows would otherwise leak late units into them."""
         yield from self._drain_pending()
+        if self._obs_wm_lag and self._watermark_ms is not None:
+            # watermark lag (wall − watermark): how far event time trails
+            # real time at this trigger.  Gauge = latest, histogram =
+            # distribution (its max is the run's peak lag).
+            lag = time.time() * 1000.0 - self._watermark_ms
+            self._obs_wm_lag.set(lag)
+            self._obs_wm_lag_hist.observe(lag)
         n_close = self._closable()
         if n_close == 0:
             if (
@@ -910,6 +936,13 @@ class StreamingWindowExec(ExecOperator):
         start = np.full(m, j * self.slide_ms, dtype=np.int64)
         end = np.full(m, j * self.slide_ms + self.length_ms, dtype=np.int64)
         cols += [start, end, start.copy()]
+        self._obs_windows.add(1)
+        if self._obs_emit_lag:
+            # end-to-end event-time emission latency, stamped at the one
+            # place every emission path funnels through
+            self._obs_emit_lag.observe(
+                time.time() * 1000.0 - (j * self.slide_ms + self.length_ms)
+            )
         return RecordBatch(self.schema, cols)
 
     def _build_emission_finals(
@@ -1051,10 +1084,19 @@ class StreamingWindowExec(ExecOperator):
                 # marker BEFORE producing output from post-marker input
                 # (alignment invariant, see _release_snapshot)
                 yield from self._release_snapshot()
+                # emissions are materialized INSIDE the timing bracket so
+                # the span and the batch-time histogram measure this
+                # operator's own work, not time spent suspended while
+                # downstream consumed the yielded windows
+                t0 = time.perf_counter()
                 with span(
                     "window.process_batch", op=self.name, rows=item.num_rows
                 ):
-                    yield from self._process_batch(item)
+                    out = list(self._process_batch(item))
+                self._obs_batch_ms.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+                yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":
                     # authoritative per-partition watermark: from now on

@@ -78,8 +78,17 @@ def _attach_checkpointing(root: ExecOperator, ctx, checkpoint=None):
 
 
 def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
+    from denormalized_tpu import obs
     from denormalized_tpu.logical.optimizer import optimize
 
+    # metrics enablement resolves from the EXECUTING context's config,
+    # immediately before operator construction (handles bind once — live
+    # or null — and the hot path never re-checks).  The flag is
+    # process-global: CONCURRENT queries with different metrics_enabled
+    # settings are not supported (the last build decides for instruments
+    # that bind later, e.g. a supervised reader rebuilt mid-stream) —
+    # run mixed-enablement workloads in separate processes.
+    obs.set_enabled(getattr(ctx.config, "metrics_enabled", True))
     plan = optimize(plan, getattr(ctx.config, "optimizer", True))
     return Planner(ctx.config).create_physical_plan(plan)
 
@@ -87,10 +96,16 @@ def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
 def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
     from denormalized_tpu.physical.base import Marker
 
+    from denormalized_tpu import obs
+
     root = build_physical(plan, ctx)
     ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
     orch, coord = _attach_checkpointing(root, ctx, checkpoint)
     ctx._last_coord = coord  # transactional sinks read committed_epoch
+    # opt-in exporters: Prometheus endpoint / JSONL snapshots / Perfetto
+    # trace dump, per EngineConfig (None when nothing opted in)
+    exporters = obs.start_exporters(ctx.config)
+    ctx._last_exporters = exporters
     flag = ShutdownFlag()
     restore = _install_signal_handlers(flag)
     try:
@@ -107,12 +122,15 @@ def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
         restore()
         if orch is not None:
             orch.stop()
+        if exporters is not None:
+            exporters.stop()
         from denormalized_tpu.runtime.tracing import log_metrics
 
         log_metrics(root)
 
 
 def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
+    from denormalized_tpu import obs
     from denormalized_tpu.physical.base import Marker
 
     root = build_physical(plan, ctx)
@@ -122,6 +140,8 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     # recovery reader discards the uncommitted suffix (the transactional
     # truncate-on-restore protocol); committed_epoch is their boundary
     ctx._last_coord = coord
+    exporters = obs.start_exporters(ctx.config)
+    ctx._last_exporters = exporters
     try:
         for item in root.run():
             if isinstance(item, RecordBatch):
@@ -133,3 +153,5 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     finally:
         if orch is not None:
             orch.stop()
+        if exporters is not None:
+            exporters.stop()

@@ -211,6 +211,9 @@ class FaultPlan:
         ]
         self.events: list[dict] = []
         self._lock = threading.Lock()
+        # per-site registry counters, bound lazily on first firing (a
+        # plan can be armed before the obs registry is configured)
+        self._obs_counters: dict[str, object] = {}
 
     # -- the one entry point every site goes through ---------------------
     def on(self, site: str, key: str | None = None, payload=None):
@@ -218,6 +221,7 @@ class FaultPlan:
         torn) payload, raises the rule's error class, or sleeps."""
         sleep_s = 0.0
         raise_exc = None
+        fired_event = None
         with self._lock:
             for rule in self.rules:
                 if not rule.matches(site, key):
@@ -257,12 +261,30 @@ class FaultPlan:
                     event["message"] = msg
                     raise_exc = rule.error_class(site)(msg)
                 self.events.append(event)
+                fired_event = event
                 break  # first firing rule wins the call
+        if fired_event is not None:
+            # outside the plan lock: fault events ride the SAME metric +
+            # span streams as everything else (counter per site for the
+            # Prometheus/JSONL timeline, an instant event in the trace)
+            self._record_obs(site, fired_event)
         if sleep_s > 0.0:
             time.sleep(sleep_s)
         if raise_exc is not None:
             raise raise_exc
         return payload
+
+    def _record_obs(self, site: str, event: dict) -> None:
+        from denormalized_tpu import obs
+
+        c = self._obs_counters.get(site)
+        if c is None:
+            c = obs.counter("dnz_fault_injections_total", site=site)
+            self._obs_counters[site] = c
+        c.add(1)
+        rec = obs.spans.recorder()
+        if rec is not None:
+            rec.instant(f"fault.{site}", dict(event))
 
     def event_log(self) -> list[dict]:
         with self._lock:

@@ -126,6 +126,7 @@ class PrefetchWorker:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         heal_after_s: float = 60.0,
+        source_name: str = "default",
     ) -> None:
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
@@ -175,6 +176,24 @@ class PrefetchWorker:
         self.caught_up: bool | None = None
         self.finished = False
         self._thread: threading.Thread | None = None
+        # registry instruments: queue depth (enq - deq rowful batches; at
+        # the depth limit the worker is backpressure-blocked in
+        # _acquire_slot) and the supervised-restart counter.  The gauge
+        # value is a single store, so the worker (enqueue) and consumer
+        # (dequeue) updating it without a lock can only be one batch
+        # stale, never torn.  Labels carry the SOURCE too: a join runs
+        # two pumps whose partition indexes collide, and sharing a
+        # series across them would break the single-writer contract.
+        from denormalized_tpu import obs
+
+        self._obs_depth = obs.gauge(
+            "dnz_prefetch_queue_depth",
+            source=source_name, partition=str(idx),
+        )
+        self._obs_restarts = obs.counter(
+            "dnz_prefetch_restarts_total",
+            source=source_name, partition=str(idx),
+        )
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -195,6 +214,7 @@ class PrefetchWorker:
         consume, not just the dequeue."""
         if rowful:
             self.deq_rowful += 1
+            self._obs_depth.set(self.enq_rowful - self.deq_rowful)
         self._slots.release()
 
     def activity(self) -> tuple[bool, float, bool, bool]:
@@ -292,6 +312,7 @@ class PrefetchWorker:
                         ))
                         return
                     self.restarts += 1
+                    self._obs_restarts.add(1)
                     self._streak += 1
                     self._restart_wall = time.monotonic()
                     # jitter INSIDE the clamp: backoff_max_s is a hard cap
@@ -379,6 +400,7 @@ class PrefetchWorker:
                 # pending work and must read as active
                 self.enq_wall = time.monotonic()
                 self.enq_rowful += 1
+                self._obs_depth.set(self.enq_rowful - self.deq_rowful)
             snap = reader.offset_snapshot()
             if not self._acquire_slot():
                 return  # shutdown won
@@ -400,6 +422,7 @@ class PrefetchPump:
         restart_budget: int = 5,
         global_restart_budget: int | None = None,
         restart_heal_s: float = 60.0,
+        source_name: str = "default",
     ) -> None:
         if depth is None:
             # split the aggregate budget across partitions; never below a
@@ -434,6 +457,7 @@ class PrefetchPump:
                 restart_budget=restart_budget,
                 global_budget=self._global_budget,
                 heal_after_s=restart_heal_s,
+                source_name=source_name,
             )
             for i, r in enumerate(readers)
         ]

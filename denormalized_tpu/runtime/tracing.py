@@ -42,18 +42,45 @@ def tracing_enabled() -> bool:
 @contextlib.contextmanager
 def span(name: str, **fields):
     """Span with enter/close events (tracing-subscriber
-    `with_span_events(ENTER|CLOSE)` analog)."""
-    if not _TRACING:
+    `with_span_events(ENTER|CLOSE)` analog).
+
+    Two recording surfaces, independently enabled:
+
+    - log lines when :func:`enable_tracing` is on — the close line
+      carries the entry fields AND the error status (a span that exits
+      via exception logs ``status=ExcType``, not a plain close that
+      reads like success);
+    - the structured ring recorder
+      (:func:`denormalized_tpu.obs.spans.enable_span_recording`), which
+      dumps Perfetto-loadable Chrome trace JSON for whole-pipeline
+      profiling.  Failed spans carry ``args.error`` there.
+    """
+    from denormalized_tpu.obs import spans as obs_spans
+
+    rec = obs_spans.recorder()
+    if not _TRACING and rec is None:
         yield
         return
     t0 = time.perf_counter()
-    logger.info("enter %s %s", name, fields or "")
+    if _TRACING:
+        logger.info("enter %s %s", name, fields or "")
+    err: str | None = None
     try:
         yield
+    except BaseException as e:
+        # record, never swallow: the span must report failure (the old
+        # code logged a plain `close` indistinguishable from success)
+        err = type(e).__name__
+        raise
     finally:
-        logger.info(
-            "close %s time.busy=%.3fms", name, (time.perf_counter() - t0) * 1e3
-        )
+        dur = time.perf_counter() - t0
+        if _TRACING:
+            logger.info(
+                "close %s time.busy=%.3fms status=%s %s",
+                name, dur * 1e3, err or "ok", fields or "",
+            )
+        if rec is not None:
+            rec.record(name, t0, dur, fields or None, error=err)
 
 
 def collect_metrics(root) -> dict[str, dict]:

@@ -546,6 +546,15 @@ class KafkaPartitionReader(PartitionReader):
             )
         self._pending_slices: list = []
         self._snap_offset = self._offset
+        # per-partition consumer lag vs the broker high watermark,
+        # refreshed on every fetch response (the reader's own catch-up
+        # signal, now a first-class time series)
+        from denormalized_tpu import obs
+
+        self._obs_lag = obs.gauge(
+            "dnz_kafka_consumer_lag_rows",
+            topic=self._topic, partition=str(partition),
+        )
         # backlog report from the last fetch response (None = unknown):
         # consumed by the prefetch engine's idleness judgment — a reader
         # that KNOWS the broker holds more records must never be judged
@@ -753,7 +762,9 @@ class KafkaPartitionReader(PartitionReader):
             )
             self._consecutive_failures = 0
             self._offset = next_off
-            self._caught_up = next_off >= self._client.high_watermark()
+            hw = self._client.high_watermark()
+            self._caught_up = next_off >= hw
+            self._obs_lag.set(max(0, hw - next_off))
             if n == 0:
                 return RecordBatch.empty(self._src.schema)
             rec_offs = None
@@ -788,7 +799,9 @@ class KafkaPartitionReader(PartitionReader):
         self._consecutive_failures = 0
         # commit before decode (see above)
         self._offset = next_off
-        self._caught_up = next_off >= self._client.high_watermark()
+        hw = self._client.high_watermark()
+        self._caught_up = next_off >= hw
+        self._obs_lag.set(max(0, hw - next_off))
         n_fetch = len(payloads)
         if not payloads:
             # live source: no data within the wait — empty batch, stay open

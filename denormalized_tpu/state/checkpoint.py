@@ -140,8 +140,18 @@ class CheckpointCoordinator:
     """Epoch-aware snapshot IO shared by all operators of one query."""
 
     def __init__(self, backend):
+        from denormalized_tpu import obs
+
         self.backend = backend
         self.commit_retries = 0
+        self._obs_commit_ms = obs.histogram("dnz_checkpoint_commit_ms")
+        self._obs_snap_bytes = obs.histogram(
+            "dnz_checkpoint_snapshot_bytes"
+        )
+        self._obs_epoch = obs.gauge("dnz_checkpoint_committed_epoch")
+        self._obs_retries = obs.counter(
+            "dnz_checkpoint_commit_retries_total"
+        )
         #: True when the committed epoch failed integrity verification and
         #: recovery degraded to an older retained epoch
         self.restored_from_fallback = False
@@ -409,7 +419,9 @@ class CheckpointCoordinator:
 
     # -- write side ------------------------------------------------------
     def put_snapshot(self, key: str, epoch: int, blob: bytes) -> None:
-        self.backend.put(f"{key}@{epoch}", frame_snapshot(blob))
+        framed = frame_snapshot(blob)
+        self._obs_snap_bytes.observe(len(framed))
+        self.backend.put(f"{key}@{epoch}", framed)
         self._epoch_keys.setdefault(epoch, []).append(key)
 
     def commit(self, epoch: int) -> None:
@@ -423,6 +435,7 @@ class CheckpointCoordinator:
         new_history = sorted(
             set(h for h in self.committed_history if h < epoch) | {epoch}
         )[-RETAINED_EPOCHS:]
+        t0_commit = time.perf_counter()
         last_err = None
         for attempt in range(1, _COMMIT_ATTEMPTS + 1):
             try:
@@ -439,6 +452,7 @@ class CheckpointCoordinator:
             except StateError as e:
                 last_err = e
                 self.commit_retries += 1
+                self._obs_retries.add(1)
                 logger.warning(
                     "checkpoint commit epoch %d: %s (attempt %d/%d)",
                     epoch, e, attempt, _COMMIT_ATTEMPTS,
@@ -447,6 +461,8 @@ class CheckpointCoordinator:
                     time.sleep(0.01 * attempt)
         if last_err is not None:
             raise last_err
+        self._obs_commit_ms.observe((time.perf_counter() - t0_commit) * 1e3)
+        self._obs_epoch.set(epoch)
         retained = set(new_history)
         self.committed_epoch = epoch
         self.committed_history = new_history

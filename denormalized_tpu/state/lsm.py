@@ -15,6 +15,7 @@ import ctypes
 import os
 import struct
 import threading
+import time
 import zlib
 from pathlib import Path
 
@@ -105,6 +106,13 @@ class LsmStore:
 
     def __init__(self, path: str):
         self.path = str(path)
+        # op-latency histograms (falsy no-ops when metrics are disabled,
+        # so the timing brackets below cost nothing then)
+        from denormalized_tpu import obs
+
+        self._obs_put_ms = obs.histogram("dnz_lsm_op_ms", op="put")
+        self._obs_get_ms = obs.histogram("dnz_lsm_op_ms", op="get")
+        self._obs_flush_ms = obs.histogram("dnz_lsm_op_ms", op="flush")
         lib = _load_native()
         if lib is not None:
             self._lib = lib
@@ -133,27 +141,35 @@ class LsmStore:
             value = faults.inject(
                 "lsm.put", key=k.decode("utf-8", "replace"), payload=value
             )
+        t0 = time.perf_counter() if self._obs_put_ms else 0.0
         if self._lib:
             if self._lib.lsm_put(self._h, k, len(k), value, len(value)) != 0:
                 raise StateError("put failed")
         else:
             self._py.put(k, value)
+        if self._obs_put_ms:
+            self._obs_put_ms.observe((time.perf_counter() - t0) * 1e3)
 
     def get(self, key: str | bytes) -> bytes | None:
         self._check_open()
         k = key.encode() if isinstance(key, str) else key
         if faults.armed():  # unarmed path builds no key string
             faults.inject("lsm.get", key=k.decode("utf-8", "replace"))
-        if self._lib:
-            out = ctypes.POINTER(ctypes.c_uint8)()
-            n = self._lib.lsm_get(self._h, k, len(k), ctypes.byref(out))
-            if n < 0:
-                return None
-            try:
-                return ctypes.string_at(out, n)
-            finally:
-                self._lib.lsm_free(out)
-        return self._py.get(k)
+        t0 = time.perf_counter() if self._obs_get_ms else 0.0
+        try:
+            if self._lib:
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                n = self._lib.lsm_get(self._h, k, len(k), ctypes.byref(out))
+                if n < 0:
+                    return None
+                try:
+                    return ctypes.string_at(out, n)
+                finally:
+                    self._lib.lsm_free(out)
+            return self._py.get(k)
+        finally:
+            if self._obs_get_ms:
+                self._obs_get_ms.observe((time.perf_counter() - t0) * 1e3)
 
     def delete(self, key: str | bytes) -> None:
         self._check_open()
@@ -184,10 +200,13 @@ class LsmStore:
     def flush(self) -> None:
         self._check_open()
         faults.inject("lsm.flush")
+        t0 = time.perf_counter() if self._obs_flush_ms else 0.0
         if self._lib:
             self._lib.lsm_flush(self._h)
         else:
             self._py.flush()
+        if self._obs_flush_ms:
+            self._obs_flush_ms.observe((time.perf_counter() - t0) * 1e3)
 
     def compact(self) -> None:
         self._check_open()
@@ -233,6 +252,11 @@ class _PyLsm:
         #: crash mid-append is EXPECTED to bump this; a silent count was
         #: the old behavior and hid real tears from every operator
         self.replay_truncated = 0
+        from denormalized_tpu import obs
+
+        self._obs_replay_trunc = obs.counter(
+            "dnz_lsm_replay_truncated_total"
+        )
         segs = sorted(
             int(p.name[4:12]) for p in self.dir.glob("seg-*.log")
         )
@@ -270,6 +294,7 @@ class _PyLsm:
             torn_at = off  # trailing partial header (< 13 bytes)
         if torn_at is not None:
             self.replay_truncated += 1
+            self._obs_replay_trunc.add(1)
             logger.warning(
                 "lsm %s: segment %d torn at offset %d — dropping %d "
                 "trailing byte(s) (crash mid-append; later records, if "

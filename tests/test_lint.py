@@ -21,6 +21,11 @@ if str(REPO) not in sys.path:
 
 from tools.dnzlint import Finding, load_baseline, run_all  # noqa: E402
 from tools.dnzlint.faultsites import fault_site_table, site_inventory  # noqa: E402
+from tools.dnzlint.metricsreg import (  # noqa: E402
+    load_catalog,
+    metric_catalog_table,
+    usage_inventory,
+)
 
 ENGINE = REPO / "denormalized_tpu"
 BASELINE = REPO / "tools" / "dnzlint" / "baseline.toml"
@@ -62,6 +67,32 @@ def test_fault_site_docs_table_cannot_drift():
         "docs/fault_tolerance.md fault-site table is stale — regenerate "
         "with: python -m tools.dnzlint --fault-site-table\n\n" + table
     )
+
+
+def test_metric_catalog_docs_table_cannot_drift():
+    """docs/observability.md embeds the table generated from the obs
+    catalog + verified binder sites (python -m tools.dnzlint
+    --metric-catalog); regenerate the docs block when instruments
+    change."""
+    table = metric_catalog_table(ENGINE)
+    docs = (REPO / "docs" / "observability.md").read_text()
+    assert table in docs, (
+        "docs/observability.md metric-catalog table is stale — "
+        "regenerate with: python -m tools.dnzlint --metric-catalog\n\n"
+        + table
+    )
+
+
+def test_metric_usage_inventory_is_complete():
+    catalog, _ = load_catalog(ENGINE)
+    uses = usage_inventory(ENGINE)
+    assert len(catalog) >= 15  # the engine-wide instrument surface
+    for name in catalog:
+        assert uses[name], f"instrument {name} has no binder call"
+    # the layers the tentpole wires: physical, runtime, sources, state
+    modules = {m for calls in uses.values() for m, _l in calls}
+    for layer in ("physical/", "runtime/", "sources/", "state/"):
+        assert any(layer in m for m in modules), layer
 
 
 def test_site_inventory_is_complete():
@@ -295,6 +326,62 @@ def test_unknown_and_missing_fault_sites_fire(tmp_path):
     assert any(f.symbol == "<dynamic>" for f in f001)
     # a.y is registered but never injected anywhere
     assert any(f.symbol == "a.y" for f in f002)
+
+
+def test_metric_registry_pass_fires(tmp_path):
+    """DNZ-M001 must fire in both directions plus the naming/kind
+    checks, like DNZ-F001/F002 for fault sites."""
+    root = _write_pkg(tmp_path, {
+        "obs/catalog.py": """\
+            INSTRUMENTS = {
+                "dnz_good_total": ("counter", "a perfectly fine counter"),
+                "dnz_unused_total": ("counter", "declared but never bound"),
+                "dnz_bad_suffix": ("counter", "counter without _total"),
+                "dnz_hist_nosuffix": ("histogram", "histogram sans unit"),
+                "dnz_helpless_total": ("counter", ""),
+                "badprefix_total": ("counter", "name without dnz_ prefix"),
+                "dnz_kind_mismatch_ms": ("histogram", "bound as counter"),
+            }
+            """,
+        "mod.py": """\
+            from denormalized_tpu import obs
+
+
+            def f(name):
+                obs.counter("dnz_good_total")
+                obs.counter("dnz_never_declared_total")
+                obs.counter(name)
+                obs.counter("dnz_kind_mismatch_ms")
+            """,
+    })
+    new, _, _ = run_all(root, baseline_path=tmp_path / "nb.toml",
+                        hotpaths_path=tmp_path / "nh.toml")
+    m = [f for f in new if f.rule == "DNZ-M001"]
+    symbols = {f.symbol for f in m}
+    # direction 1: undeclared / dynamic / kind-mismatched binder calls
+    assert "dnz_never_declared_total" in symbols
+    assert "<dynamic>" in symbols
+    assert any(
+        f.symbol == "dnz_kind_mismatch_ms" and "binds a counter" in f.message
+        for f in m
+    )
+    # direction 2: declared but never bound
+    assert any(
+        f.symbol == "dnz_unused_total" and "no engine module binds" in f.message
+        for f in m
+    )
+    # naming + help discipline
+    assert any(f.symbol == "dnz_bad_suffix" and "_total" in f.message
+               for f in m)
+    assert any(f.symbol == "dnz_hist_nosuffix" and "unit suffix" in f.message
+               for f in m)
+    assert any(f.symbol == "dnz_helpless_total" and "help" in f.message
+               for f in m)
+    assert any(f.symbol == "badprefix_total" for f in m)
+    # the clean instrument raises nothing
+    assert not any(
+        f.symbol == "dnz_good_total" for f in m
+    )
 
 
 def test_hotpath_loop_tolist_and_hash_fire(tmp_path):

@@ -1,0 +1,311 @@
+"""obs subsystem unit tests: registry semantics, the disabled no-op
+path (pinned allocation-free), Prometheus text exposition, JSONL
+snapshots + read-side merging, the span recorder, and the span()
+error-status fix."""
+
+import gc
+import json
+import sys
+import time
+
+import pytest
+
+from denormalized_tpu import obs
+from denormalized_tpu.obs.catalog import INSTRUMENTS, declaration, exp_bounds
+from denormalized_tpu.obs.jsonl import (
+    JsonlSnapshotter,
+    counter_timeline,
+    merge_histogram,
+    read_stream,
+)
+from denormalized_tpu.obs.prometheus import render
+from denormalized_tpu.obs.registry import NULL, MetricsRegistry
+from denormalized_tpu.obs.spans import SpanRecorder
+
+
+@pytest.fixture
+def registry():
+    """Fresh process registry per test, restored afterward."""
+    reg = MetricsRegistry(enabled=True)
+    prev = obs.use_registry(reg)
+    yield reg
+    obs.use_registry(prev)
+
+
+# -- instruments ----------------------------------------------------------
+
+
+def test_counter_gauge_semantics(registry):
+    c = registry.counter("dnz_op_rows_in_total", op="t")
+    c.add(3)
+    c.add()
+    assert c.value == 4
+    g = registry.gauge("dnz_watermark_lag_ms", op="t")
+    g.set(17.5)
+    assert g.value == 17.5
+    # same (name, labels) re-bind returns the SAME instrument
+    assert registry.counter("dnz_op_rows_in_total", op="t") is c
+    # different labels are different series
+    assert registry.counter("dnz_op_rows_in_total", op="u") is not c
+
+
+def test_histogram_buckets_and_quantiles(registry):
+    h = registry.histogram("dnz_op_batch_ms", op="t")
+    for v in (0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 100.0):
+        h.observe(v)
+    assert h.count == 7
+    assert h.vmax == 100.0
+    assert h.vmin == 0.1
+    assert sum(h.counts) == 7
+    # quantiles are bucket-interpolated but clamped by exact min/max
+    assert h.quantile(0.0) >= 0.1
+    assert h.quantile(1.0) == 100.0
+    p50 = h.quantile(0.5)
+    assert 0.2 <= p50 <= 1.6
+    # exponential layout: bounds strictly increasing, geometric
+    b = exp_bounds({"start": 0.05, "factor": 2.0, "count": 5})
+    assert b == [0.05, 0.1, 0.2, 0.4, 0.8]
+
+
+def test_bind_validates_against_catalog(registry):
+    with pytest.raises(KeyError, match="DNZ-M001"):
+        registry.counter("dnz_not_declared_total")
+    with pytest.raises(TypeError, match="declared as a histogram"):
+        registry.counter("dnz_op_batch_ms")
+
+
+def test_gauge_fn_rebind_replaces_callback(registry):
+    g = registry.gauge_fn("dnz_decode_fallback_rows", lambda: 5, source="s")
+    assert g.value == 5.0
+    g2 = registry.gauge_fn(
+        "dnz_decode_fallback_rows", lambda: 9, source="s"
+    )
+    assert g2 is g
+    assert g.value == 9.0
+    # a failing callback degrades to 0, never raises at export time
+    registry.gauge_fn(
+        "dnz_decode_fallback_rows", lambda: 1 / 0, source="s"
+    )
+    assert g.value == 0.0
+
+
+def test_catalog_declarations_are_wellformed():
+    for name, entry in INSTRUMENTS.items():
+        kind, help_str, bounds = declaration(name)
+        assert kind in ("counter", "gauge", "histogram"), name
+        assert len(help_str) >= 8, name
+        if kind == "histogram":
+            assert bounds == sorted(bounds) and len(bounds) >= 8, name
+
+
+# -- the disabled path ----------------------------------------------------
+
+
+def test_disabled_registry_hands_out_falsy_nulls():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("dnz_op_rows_in_total", op="x")
+    h = reg.histogram("dnz_op_batch_ms", op="x")
+    g = reg.gauge("dnz_watermark_lag_ms", op="x")
+    assert c is NULL and h is NULL and g is NULL
+    assert not c  # falsy: call sites skip their perf_counter brackets
+    c.add(5)
+    h.observe(1.0)
+    g.set(2.0)
+    assert c.value == 0 and h.quantile(0.5) is None
+    assert reg.instruments() == []
+
+
+def test_disabled_instrument_call_allocates_nothing():
+    """The tentpole's no-op contract: a disabled-path add/observe/set
+    allocates zero objects (measured via the allocator's live block
+    count over many calls — any per-call allocation would show up
+    thousands of times)."""
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("dnz_op_rows_in_total", op="x")
+    h = reg.histogram("dnz_op_batch_ms", op="x")
+    for _ in range(10):  # warm any lazy interpreter state
+        c.add(1)
+        h.observe(2.0)
+    gc.collect()
+    before = sys.getallocatedblocks()
+    for _ in range(5000):
+        c.add(1)
+        h.observe(2.0)
+    after = sys.getallocatedblocks()
+    assert after - before <= 2, f"disabled path allocated {after - before}"
+
+
+# -- prometheus exposition ------------------------------------------------
+
+
+def _parse_exposition(text: str):
+    """Minimal exposition-format validator: returns ({name: type},
+    [(series, value)]) and asserts line grammar."""
+    types = {}
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+            continue
+        assert not line.startswith("#")
+        series, _, value = line.rpartition(" ")
+        float(value)  # must parse
+        samples.append((series, value))
+    return types, samples
+
+
+def test_prometheus_render_is_valid_and_complete(registry):
+    registry.counter("dnz_op_rows_in_total", op="w").add(12)
+    h = registry.histogram("dnz_op_batch_ms", op="w")
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    registry.gauge("dnz_kafka_consumer_lag_rows",
+                   topic="t", partition="0").set(42)
+    text = render(registry)
+    types, samples = _parse_exposition(text)
+    # EVERY declared instrument renders its family header, bound or not
+    for name, (kind, _help, *_r) in INSTRUMENTS.items():
+        assert types.get(name) == kind, name
+    sdict = dict(samples)
+    assert sdict['dnz_op_rows_in_total{op="w"}'] == "12"
+    assert (
+        sdict['dnz_kafka_consumer_lag_rows{partition="0",topic="t"}'] == "42"
+    )
+    # histogram expansion: cumulative buckets + +Inf + sum/count
+    assert sdict['dnz_op_batch_ms_bucket{op="w",le="+Inf"}'] == "3"
+    assert sdict['dnz_op_batch_ms_count{op="w"}'] == "3"
+    assert float(sdict['dnz_op_batch_ms_sum{op="w"}']) == pytest.approx(55.5)
+    infs = [
+        v for s, v in samples
+        if s.startswith("dnz_op_batch_ms_bucket") and 'le="+Inf"' not in s
+    ]
+    assert [int(v) for v in infs] == sorted(int(v) for v in infs)
+
+
+def test_prometheus_label_escaping(registry):
+    g = registry.gauge("dnz_watermark_lag_ms", op='we"ird\nname')
+    g.set(1)
+    text = render(registry)
+    assert 'op="we\\"ird\\nname"' in text
+
+
+# -- jsonl snapshots ------------------------------------------------------
+
+
+def test_jsonl_snapshotter_and_merge(registry, tmp_path):
+    h = registry.histogram("dnz_emit_event_lag_ms", op="window")
+    for v in (1.0, 2.0, 4.0, 80.0):
+        h.observe(v)
+    registry.counter("dnz_fault_injections_total", site="kafka.fetch").add(3)
+    path = tmp_path / "obs.jsonl"
+    snap = JsonlSnapshotter(str(path), registry, interval_s=0.05).start()
+    time.sleep(0.2)
+    registry.counter("dnz_fault_injections_total", site="kafka.fetch").add(2)
+    time.sleep(0.1)
+    snap.stop()
+    snaps = read_stream(path)
+    assert len(snaps) >= 2
+    last = snaps[-1]["metrics"]
+    stats = last['dnz_emit_event_lag_ms{op="window"}']
+    assert stats["count"] == 4 and stats["max"] == 80.0
+    assert stats["p99"] <= 80.0
+    # merging two processes' stats doubles counts, keeps max, and
+    # re-derives quantiles over the union
+    merged = merge_histogram([stats, stats])
+    assert merged["count"] == 8 and merged["max"] == 80.0
+    # fault timeline from cumulative counters
+    tl = counter_timeline(snaps, "dnz_fault_injections_total")
+    assert sum(e["delta"] for e in tl) == 5
+    assert all(e["series"].endswith('site="kafka.fetch"}') for e in tl)
+
+
+# -- span recorder + tracing integration ----------------------------------
+
+
+def test_span_recorder_ring_and_chrome_trace():
+    rec = SpanRecorder(capacity=4)
+    for i in range(6):
+        rec.record(f"s{i}", time.perf_counter(), 0.001, {"i": i})
+    events = rec.events()
+    assert len(events) == 4  # newest capacity events win
+    assert [e[2] for e in events] == ["s2", "s3", "s4", "s5"]
+    trace = rec.to_chrome_trace()
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] in ("X", "i")
+        assert ev["ts"] >= 0 and "name" in ev and "tid" in ev
+    json.dumps(trace)  # must be serializable as-is
+
+
+def test_span_records_error_status(tmp_path):
+    """The satellite fix: a span that exits via exception must record
+    failure (recorder args.error + log status), with its entry fields."""
+    from denormalized_tpu.obs import spans as obs_spans
+    from denormalized_tpu.runtime import tracing
+
+    rec = obs_spans.enable_span_recording(16)
+    try:
+        with pytest.raises(ValueError):
+            with tracing.span("unit.test_span", partition=3):
+                raise ValueError("boom")
+        with tracing.span("unit.ok_span", partition=4):
+            pass
+    finally:
+        obs_spans.disable_span_recording()
+    by_name = {e[2]: e for e in rec.events()}
+    failed = by_name["unit.test_span"]
+    assert failed[6]["error"] == "ValueError"
+    assert failed[6]["partition"] == 3  # entry fields ride the close
+    assert "error" not in (by_name["unit.ok_span"][6] or {})
+    # chrome trace marks the failed span
+    evs = {e["name"]: e for e in rec.to_chrome_trace()["traceEvents"]}
+    assert evs["unit.test_span"]["args"]["error"] == "ValueError"
+
+
+def test_span_error_status_in_log_line(caplog):
+    import logging
+
+    from denormalized_tpu.runtime import tracing
+
+    tracing.enable_tracing()
+    try:
+        with caplog.at_level(logging.INFO, logger="denormalized_tpu"):
+            with pytest.raises(RuntimeError):
+                with tracing.span("unit.log_span", part=1):
+                    raise RuntimeError("x")
+        closes = [r.getMessage() for r in caplog.records
+                  if r.getMessage().startswith("close unit.log_span")]
+        assert closes and "status=RuntimeError" in closes[0]
+        assert "part" in closes[0]  # entry fields on the close line
+    finally:
+        tracing._TRACING = False
+
+
+def test_fault_injections_land_on_registry_and_trace(registry):
+    from denormalized_tpu.obs import spans as obs_spans
+    from denormalized_tpu.runtime import faults
+
+    rec = obs_spans.enable_span_recording(64)
+    try:
+        faults.arm({"seed": 7, "rules": [
+            {"site": "lsm.get", "kind": "error", "times": 2},
+        ]})
+        for _ in range(3):
+            try:
+                faults.inject("lsm.get", key="k")
+            except Exception:
+                pass
+    finally:
+        faults.disarm()
+        obs_spans.disable_span_recording()
+    c = registry.counter("dnz_fault_injections_total", site="lsm.get")
+    assert c.value == 2
+    names = [e[2] for e in rec.events()]
+    assert names.count("fault.lsm.get") == 2

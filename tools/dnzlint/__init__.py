@@ -35,6 +35,12 @@ DNZ-H001    hot-loop            a per-row construct (``for``/``while``,
 DNZ-H002    hash-tuple          ``hash(...)`` inside a registered
                                 hot-path function (the pre-vectorization
                                 collision bug class, PARITY.md Round-6)
+DNZ-M001    metric-registry     an ``obs.counter/gauge/histogram`` call
+                                whose name literal keys nothing in
+                                ``obs/catalog.py`` (or mismatches its
+                                declared kind), a declared instrument no
+                                module binds, or a catalog entry
+                                violating the naming convention
 ==========  ==================  =========================================
 
 Suppression is explicit and reasoned, never blanket:
@@ -76,6 +82,7 @@ RULES = {
     "DNZ-F002": "missing-fault-site",
     "DNZ-H001": "hot-loop",
     "DNZ-H002": "hash-tuple",
+    "DNZ-M001": "metric-registry",
 }
 SLUG_TO_RULE = {v: k for k, v in RULES.items()}
 
@@ -168,7 +175,7 @@ def run_all(
     baseline entries that matched nothing (candidates for deletion —
     reported so the baseline can only shrink honestly).
     """
-    from tools.dnzlint import excepts, faultsites, hotpath, locks
+    from tools.dnzlint import excepts, faultsites, hotpath, locks, metricsreg
     from tools.dnzlint.pragmas import PragmaIndex
 
     root = Path(root)
@@ -187,6 +194,7 @@ def run_all(
     findings += locks.run(root)
     findings += excepts.run(root)
     findings += faultsites.run(root)
+    findings += metricsreg.run(root)
     findings += hotpath.run(root, hotpaths_path)
 
     new: list[Finding] = []

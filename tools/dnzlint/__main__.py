@@ -50,6 +50,11 @@ def main(argv: list[str] | None = None) -> int:
         "--fault-site-table", action="store_true",
         help="print the generated fault-site markdown table and exit",
     )
+    parser.add_argument(
+        "--metric-catalog", action="store_true",
+        help="print the generated metric-catalog markdown table "
+             "(docs/observability.md embeds it) and exit",
+    )
     args = parser.parse_args(argv)
 
     root = Path(args.root)
@@ -61,6 +66,12 @@ def main(argv: list[str] | None = None) -> int:
         from tools.dnzlint.faultsites import fault_site_table
 
         print(fault_site_table(root))
+        return 0
+
+    if args.metric_catalog:
+        from tools.dnzlint.metricsreg import metric_catalog_table
+
+        print(metric_catalog_table(root))
         return 0
 
     here = Path(__file__).resolve().parent

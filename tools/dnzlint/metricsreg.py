@@ -1,0 +1,227 @@
+"""DNZ-M001 — metric-registry completeness + naming discipline.
+
+The obs subsystem validates instrument names against the catalog at BIND
+time, which catches a typo'd binder — but only on the code path that
+binds it, and a *declared* instrument whose call site was renamed away
+just silently stops reporting.  Like DNZ-F001/F002 for fault sites, this
+pass closes the loop statically, in both directions:
+
+- every ``obs.counter("x", ...)`` / ``obs.gauge`` / ``obs.histogram`` /
+  ``obs.gauge_fn`` call must name a catalog key as a string literal, and
+  its binder kind must match the declared kind (``gauge_fn`` binds a
+  declared gauge);
+- every catalog entry must have at least one binder call somewhere in
+  the engine — a declaration nobody binds is a metric the docs advertise
+  that never reports;
+- catalog entries themselves follow the naming convention
+  (``^dnz_[a-z][a-z0-9_]*$``; counters end ``_total``; histograms end in
+  a unit suffix ``_ms``/``_s``/``_bytes``/``_rows``) and carry a real
+  help string.
+
+The catalog is read from the scanned tree's own ``obs/catalog.py`` **by
+AST**, never by import — same contract as the fault-site pass.  The pass
+also exports :func:`metric_catalog_table`, the generated markdown table
+``docs/observability.md`` embeds (``python -m tools.dnzlint
+--metric-catalog``), so the doc cannot drift from the declarations
+(pinned by ``tests/test_lint.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tools.dnzlint import Finding, iter_python_files, rel_path
+
+CATALOG_REL = Path("obs") / "catalog.py"
+
+#: binder attribute -> catalog kind it must bind
+BINDERS = {
+    "counter": "counter",
+    "gauge": "gauge",
+    "gauge_fn": "gauge",
+    "histogram": "histogram",
+}
+
+_NAME_RE = re.compile(r"^dnz_[a-z][a-z0-9_]*$")
+_HIST_SUFFIXES = ("_ms", "_s", "_bytes", "_rows")
+
+
+def _const_str(node: ast.AST) -> str | None:
+    return node.value if (
+        isinstance(node, ast.Constant) and isinstance(node.value, str)
+    ) else None
+
+
+def load_catalog(root: Path) -> tuple[dict[str, tuple[str, str]], int]:
+    """Parse ``INSTRUMENTS`` from the tree's obs/catalog.py.
+
+    Returns ``({name: (kind, help)}, lineno)``; a tree without an obs
+    package returns empty (the pass then no-ops).
+    """
+    path = root / CATALOG_REL
+    if not path.exists():
+        return {}, 0
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: dict[str, tuple[str, str]] = {}
+    lineno = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AnnAssign) and not isinstance(
+            node, ast.Assign
+        ):
+            continue
+        targets = (
+            [node.target] if isinstance(node, ast.AnnAssign)
+            else node.targets
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id == "INSTRUMENTS"
+            for t in targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        lineno = node.lineno
+        for k, v in zip(node.value.keys, node.value.values):
+            name = _const_str(k)
+            if name is None or not isinstance(v, ast.Tuple) or not v.elts:
+                continue
+            kind = _const_str(v.elts[0]) or ""
+            help_str = (
+                _const_str(v.elts[1]) if len(v.elts) > 1 else None
+            ) or ""
+            out[name] = (kind, help_str)
+    return out, lineno
+
+
+def _binder_calls(tree: ast.AST):
+    """Yield ``(node, binder_attr, name_literal_or_None)`` for every
+    ``obs.<binder>("name", ...)`` call (the engine's idiom is always a
+    module-qualified call on a name bound to the obs package)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in BINDERS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "obs"
+        ):
+            continue
+        yield node, fn.attr, _const_str(node.args[0]) if node.args else None
+
+
+def usage_inventory(root: Path) -> dict[str, list[tuple[str, int]]]:
+    """{instrument: [(module, line), ...]} across the tree (obs package
+    internals excluded — the subsystem binds through dynamic names by
+    design; the engine's call sites are what the catalog pins)."""
+    catalog, _ = load_catalog(root)
+    uses: dict[str, list[tuple[str, int]]] = {n: [] for n in catalog}
+    for path in iter_python_files(root):
+        if (root / "obs") in path.parents:
+            continue
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node, _attr, name in _binder_calls(tree):
+            if name in uses:
+                uses[name].append((rel, node.lineno))
+    return uses
+
+
+def metric_catalog_table(root: Path) -> str:
+    """The markdown metric-catalog table for ``docs/observability.md``,
+    generated from the declarations + verified call sites (so a moved
+    instrumentation point is a visible docs diff, not silent drift)."""
+    catalog, _ = load_catalog(root)
+    uses = usage_inventory(root)
+    lines = [
+        "| instrument | kind | help | instrumented in |",
+        "|---|---|---|---|",
+    ]
+    for name, (kind, help_str) in catalog.items():
+        mods = sorted({m for m, _l in uses.get(name, [])})
+        where = ", ".join(f"`{m}`" for m in mods) or "—"
+        lines.append(f"| `{name}` | {kind} | {help_str} | {where} |")
+    return "\n".join(lines)
+
+
+def _check_declaration(
+    name: str, kind: str, help_str: str, cat_rel: str, lineno: int
+) -> list[Finding]:
+    findings = []
+
+    def bad(msg: str) -> None:
+        findings.append(Finding("DNZ-M001", cat_rel, lineno, name, msg))
+
+    if kind not in ("counter", "gauge", "histogram"):
+        bad(f"unknown instrument kind {kind!r}")
+    if not _NAME_RE.match(name):
+        bad("instrument name must match ^dnz_[a-z][a-z0-9_]*$")
+    elif kind == "counter" and not name.endswith("_total"):
+        bad("counter names must end in _total")
+    elif kind == "histogram" and not name.endswith(_HIST_SUFFIXES):
+        bad(
+            "histogram names must end in a unit suffix "
+            f"({'/'.join(_HIST_SUFFIXES)})"
+        )
+    elif kind == "gauge" and name.endswith("_total"):
+        bad("_total names a counter; gauges must not use it")
+    if len(help_str.strip()) < 8:
+        bad("instrument help string is missing or trivially short")
+    return findings
+
+
+def run(root: Path) -> list[Finding]:
+    catalog, cat_lineno = load_catalog(root)
+    if not catalog:
+        return []  # no obs package in this tree: nothing to check
+    cat_rel = rel_path(root / CATALOG_REL, root)
+    findings: list[Finding] = []
+    for name, (kind, help_str) in catalog.items():
+        findings += _check_declaration(
+            name, kind, help_str, cat_rel, cat_lineno
+        )
+
+    used: dict[str, int] = {n: 0 for n in catalog}
+    for path in iter_python_files(root):
+        if (root / "obs") in path.parents:
+            continue  # the subsystem itself binds dynamically by design
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node, attr, name in _binder_calls(tree):
+            if name is None:
+                findings.append(Finding(
+                    "DNZ-M001", rel, node.lineno, "<dynamic>",
+                    f"obs.{attr} with a non-literal instrument name — "
+                    "names must be checkable string literals",
+                ))
+                continue
+            if name not in catalog:
+                findings.append(Finding(
+                    "DNZ-M001", rel, node.lineno, name,
+                    f"obs.{attr}({name!r}) names no entry of "
+                    "obs/catalog.py INSTRUMENTS — binding would raise at "
+                    "runtime; declare the instrument with a help string",
+                ))
+                continue
+            want = BINDERS[attr]
+            if catalog[name][0] != want:
+                findings.append(Finding(
+                    "DNZ-M001", rel, node.lineno, name,
+                    f"obs.{attr}({name!r}) binds a {want} but the "
+                    f"catalog declares a {catalog[name][0]}",
+                ))
+                continue
+            used[name] += 1
+
+    for name, count in used.items():
+        if count == 0:
+            findings.append(Finding(
+                "DNZ-M001", cat_rel, cat_lineno, name,
+                f"instrument {name!r} is declared in the catalog but no "
+                "engine module binds it — a renamed or deleted "
+                "instrumentation point left the catalog stale",
+            ))
+    return findings

@@ -62,7 +62,15 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-T0 = 1_700_000_000_000
+# Event-time origin.  Everything in the soak — batch generation, golden
+# folds, window keys — is T0-relative, so its absolute value is free to
+# move; the parent anchors it near wall-now (main()) so the engine's
+# event-time lag metrics (wall − event time) land inside their histogram
+# buckets and the telemetry percentiles are real, then hands the value
+# to every child via SOAK_T0 (parent and children MUST agree — window
+# keys are absolute).  Standalone/child invocations inherit or fall
+# back to the legacy fixed origin.
+T0 = int(os.environ.get("SOAK_T0", "0")) or 1_700_000_000_000
 N_KEYS = 10
 WINDOW_MS = 1000
 
@@ -224,21 +232,55 @@ def kafka_prep_and_feed(args, total_batches, log):
     """Start the parent-owned broker (the durable log that SURVIVES child
     kills — the restored child seeks back to its checkpointed offsets),
     pre-encode every chunk (the paced feed loop must only append staged
-    slices), and return (broker, feed_thread, last_close_ws).  Rows
-    interleave across KAFKA_PARTS partitions per batch so both
-    partitions' event-time ranges stay aligned (per-partition watermarks
-    advance together)."""
+    slices), and return (broker, feed_thread, last_close_ws,
+    feed_anchor).  Rows interleave across KAFKA_PARTS partitions per
+    batch so both partitions' event-time ranges stay aligned
+    (per-partition watermarks advance together).
+
+    The feed is scheduled against the ABSOLUTE event-time origin: one
+    calibration batch estimates the full staging wall, T0 is re-anchored
+    just past the estimated staging end (rounded to a window boundary),
+    and each batch is appended when the wall clock reaches its event
+    time — so event time ≈ wall time with near-zero offset, which is
+    what lets the engine's event-time lag histograms (bucketed
+    exponentially) resolve real latency percentiles instead of one huge
+    constant.  ``feed_anchor["epoch"]`` carries the wall second T0 maps
+    to; the telemetry report subtracts ``feed_epoch_ms − T0`` (≈0 here)
+    to convert raw event-time lag into end-to-end latency."""
+    global T0
     import threading
 
     from denormalized_tpu.testing.mock_kafka import MockKafkaBroker
+
+    broker = MockKafkaBroker().start()
+    broker.create_topic("soak", partitions=KAFKA_PARTS)
+    # calibration: stage one throwaway batch, scale to the run, pad 30%
+    # + 2s (an UNDERestimate only means the first batches burst as
+    # catch-up; the offset still collapses once the feed reaches its
+    # schedule)
+    t_cal = time.monotonic()
+    cal_ts, cal_keys, cal_vals = batch_arrays(
+        0, args.batch_rows, args.pace, seed=SEED_LEFT
+    )
+    cal_rows = encode_json_rows(cal_ts, cal_keys, cal_vals)
+    for p in range(KAFKA_PARTS):
+        rp = cal_rows[p::KAFKA_PARTS]
+        MockKafkaBroker.stage_batched(
+            rp, ts_ms=int(cal_ts[0]), records_per_batch=len(rp),
+            base_offset=0,
+        )
+    est_s = (time.monotonic() - t_cal) * total_batches * 1.3 + 2.0
+    if "SOAK_T0" not in os.environ:  # an explicit pin wins (determinism)
+        T0 = (
+            int((time.time() + est_s) * 1000) // WINDOW_MS
+        ) * WINDOW_MS
+    log(f"kafka soak: staging est {est_s:.0f}s — event origin T0={T0}")
 
     span_ms = int(total_batches * args.batch_rows * 1000.0 / args.pace)
     # two full windows of slack before the stream end: the child exits on
     # seeing this window, closed by the NATURAL watermark (events beyond
     # its end), no idle-hint dependence at the boundary
     last_close_ws = ((T0 + span_ms) // WINDOW_MS - 2) * WINDOW_MS
-    broker = MockKafkaBroker().start()
-    broker.create_topic("soak", partitions=KAFKA_PARTS)
     staged = [[] for _ in range(KAFKA_PARTS)]
     base = [0] * KAFKA_PARTS
     t_prep = time.monotonic()
@@ -259,11 +301,16 @@ def kafka_prep_and_feed(args, total_batches, log):
     log(f"kafka soak: staged all {total_batches} chunks in "
         f"{time.monotonic() - t_prep:.0f}s; feed starts now")
 
+    feed_anchor: dict = {"epoch": T0 / 1000.0}
+
     def feed():
-        t0 = time.monotonic()
+        # absolute event-time schedule (see docstring): batch i's rows
+        # end at event T0 + (i+1)*batch_span, so they are appended at
+        # that WALL instant — event time tracks wall time directly
+        t0_wall = T0 / 1000.0
         for i in range(total_batches):
-            due = t0 + (i + 1) * args.batch_rows / args.pace
-            delay = due - time.monotonic()
+            due = t0_wall + (i + 1) * args.batch_rows / args.pace
+            delay = due - time.time()
             if delay > 0:
                 time.sleep(delay)
             for p in range(KAFKA_PARTS):
@@ -271,7 +318,7 @@ def kafka_prep_and_feed(args, total_batches, log):
 
     th = threading.Thread(target=feed, daemon=True)
     th.start()
-    return broker, th, last_close_ws
+    return broker, th, last_close_ws, feed_anchor
 
 
 SESSION_GAP_MS = 300
@@ -545,6 +592,13 @@ def child_main() -> None:
         source_idle_timeout_ms=int(
             os.environ.get("SOAK_IDLE_MS", 1000)
         ) or None,
+        # per-segment JSONL telemetry stream (obs registry snapshots):
+        # the parent merges segments' histograms into the report's
+        # p50/p95/p99 e2e latency + max watermark lag + fault timeline.
+        # Line-buffered writer — a SIGKILL still leaves the last
+        # completed snapshot behind.
+        metrics_jsonl_path=os.environ.get("SOAK_OBS_OUT"),
+        metrics_jsonl_interval_s=1.0,
     )
     ctx = Context(cfg)
     last_close_ws = (
@@ -913,6 +967,91 @@ def read_emissions(paths):
     return wins, dupes, done, metrics, clipped
 
 
+def _obs_readers():
+    """Load the obs read-side helpers WITHOUT importing the engine
+    package (the soak parent never imports jax; the module is stdlib-only
+    by contract — see denormalized_tpu/obs/readers.py)."""
+    import importlib.util
+
+    path = REPO / "denormalized_tpu" / "obs" / "readers.py"
+    spec = importlib.util.spec_from_file_location("_soak_obs_readers", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
+    """The report's time-series section, derived entirely from the
+    segments' JSONL telemetry streams: p50/p95/p99 end-to-end latency
+    and max watermark lag (histograms merged across killed segments),
+    plus the fault-event timeline (per-site injection deltas).
+
+    The engine's lag metrics are event-time-relative (wall − event
+    time), so a paced feed replaying from T0 carries a constant offset
+    ``anchor_epoch_ms − T0``; when the feed anchor is known (kafka
+    pipeline) the report also emits anchored values = true end-to-end
+    latency."""
+    R = _obs_readers()
+
+    def final_hists(snaps, prefix):
+        # matched by PREFIX, not one hardcoded op label: the session and
+        # udaf pipelines emit their lag series under op="session"/"udaf"
+        last: dict = {}
+        for snap in reversed(snaps):
+            m = snap.get("metrics", {})
+            if any(k.startswith(prefix) for k in m):
+                last = m
+                break
+        return [
+            v for k, v in last.items()
+            if k.startswith(prefix) and isinstance(v, dict)
+        ]
+
+    finals_emit, finals_wm = [], []
+    timeline: list = []
+    n_snaps = 0
+    segs_reporting = 0
+    for path in obs_paths:
+        snaps = R.read_stream(path)
+        if not snaps:
+            continue
+        segs_reporting += 1
+        n_snaps += len(snaps)
+        finals_emit += final_hists(snaps, "dnz_emit_event_lag_ms")
+        finals_wm += final_hists(snaps, "dnz_watermark_lag_hist_ms")
+        # timeline per SEGMENT: each killed child restarts its counters
+        # from zero, so the delta baseline must reset with it
+        timeline += R.counter_timeline(snaps, "dnz_fault_injections_total")
+    timeline.sort(key=lambda e: e["t"] or 0)
+    emit = R.merge_histogram(finals_emit)
+    wm = R.merge_histogram(finals_wm)
+    tele: dict = {
+        "segments_reporting": segs_reporting,
+        "snapshots": n_snaps,
+        "fault_timeline": timeline,
+    }
+    if emit:
+        tele["e2e_event_lag_ms"] = {
+            k: round(emit[k], 2) for k in ("p50", "p95", "p99", "max")
+            if emit.get(k) is not None
+        }
+        tele["e2e_event_lag_ms"]["samples"] = emit["count"]
+    if wm:
+        tele["max_watermark_lag_ms"] = round(wm["max"], 2)
+    if anchor_epoch_ms is not None:
+        off = anchor_epoch_ms - T0
+        tele["feed_anchor_offset_ms"] = round(off, 1)
+        if emit:
+            tele["e2e_latency_ms"] = {
+                k: round(emit[k] - off, 2)
+                for k in ("p50", "p95", "p99", "max")
+                if emit.get(k) is not None
+            }
+        if wm:
+            tele["max_watermark_lag_anchored_ms"] = round(wm["max"] - off, 2)
+    return tele
+
+
 def rss_kb(pid: int) -> int | None:
     try:
         with open(f"/proc/{pid}/status") as f:
@@ -925,6 +1064,7 @@ def rss_kb(pid: int) -> int | None:
 
 
 def main():
+    global T0
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--minutes", type=float, default=12.0)
@@ -972,8 +1112,14 @@ def main():
     work = tempfile.mkdtemp(prefix="soak_")
     ckpt_dir = os.path.join(work, "ckpt")
     os.makedirs(ckpt_dir)
+    if "SOAK_T0" not in os.environ:
+        # anchor event time near wall-now, rounded to a window boundary
+        # (see the T0 comment above; the kafka feed re-anchors once its
+        # staging estimate is known)
+        T0 = int(time.time()) * 1000 // WINDOW_MS * WINDOW_MS
     kafka_broker = None
     kafka_last_close_ws = None
+    kafka_feed_anchor: dict = {}
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -999,11 +1145,17 @@ def main():
         # is part of what the chaos run asserts on
         env["DENORMALIZED_LSM_PY"] = "1"
     if args.pipeline == "kafka":
-        kafka_broker, _feed_th, kafka_last_close_ws = kafka_prep_and_feed(
-            args, total_batches, lambda m: print(m, file=sys.stderr)
+        kafka_broker, _feed_th, kafka_last_close_ws, kafka_feed_anchor = (
+            kafka_prep_and_feed(
+                args, total_batches, lambda m: print(m, file=sys.stderr)
+            )
         )
         env["SOAK_BOOTSTRAP"] = kafka_broker.bootstrap
         env["SOAK_LAST_CLOSE_WS"] = str(kafka_last_close_ws)
+    # AFTER the kafka branch: the feed's staging calibration re-anchors
+    # T0, and every child must see the final value (window keys are
+    # absolute — parent golden and child emissions must agree)
+    env["SOAK_T0"] = str(T0)
 
     report = {
         "pipeline": args.pipeline,
@@ -1033,6 +1185,7 @@ def main():
     }.get(args.pipeline, golden_update)  # udaf golden == tumbling fold
     golden_i = 0
     seg_paths = []
+    obs_paths = []
     seg = 0
     kills_issued = 0
     t_start = time.monotonic()
@@ -1045,8 +1198,11 @@ def main():
             seg += 1
             out_path = os.path.join(work, f"emit_{seg}.jsonl")
             seg_paths.append(out_path)
+            obs_path = os.path.join(work, f"obs_{seg}.jsonl")
+            obs_paths.append(obs_path)
             seg_env = dict(env)
             seg_env["SOAK_OUT"] = out_path
+            seg_env["SOAK_OBS_OUT"] = obs_path
             t_spawn = time.monotonic()
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -1209,8 +1365,19 @@ def main():
                 ),
             }
             report["chaos"].update(chaos_report)
+        try:
+            telemetry = derive_telemetry(
+                obs_paths,
+                anchor_epoch_ms=(
+                    kafka_feed_anchor["epoch"] * 1000.0
+                    if kafka_feed_anchor.get("epoch") else None
+                ),
+            )
+        except Exception as e:  # dnzlint: allow(broad-except) telemetry derivation is reporting, not verification — a malformed snapshot stream must not turn a green soak red
+            telemetry = {"error": str(e)}
         write({
             "aborted": aborted,
+            "telemetry": telemetry,
             "eos_done_seen": done_seen,
             "kills": kills_issued,
             "recovery_first_emit_s": recovery_times,
