@@ -153,6 +153,24 @@ class EngineConfig:
     # sizes the ring (newest events win; 0 = default 65536)
     trace_path: str | None = None
     trace_events: int = 0
+    # -- pipeline doctor (obs/doctor, docs/observability.md §doctor) ----
+    # live query introspection: every execution registers its physical
+    # plan (node-id keyed) with per-operator busy/queue-wait stats and
+    # ranked bottleneck attribution, served at /queries[/<id>/plan] on
+    # the Prometheus HTTP server and via df.explain_analyze().  Costs a
+    # few plain attribute adds per batch; False opts a query out.
+    doctor_enabled: bool = True
+    # sampled record lineage: tag every Nth row per partition at ingest
+    # with (source, partition, offset, event time) and follow it through
+    # operator handoffs into window emission — "why is this window late"
+    # becomes GET /queries/<id>/lineage.  None (default) = off; when on,
+    # adds an O(rows) timestamp min/max per batch per operator.
+    lineage_sample_every: int | None = None
+    lineage_max_samples: int = 256
+    # on-demand sampling profiler (sys._current_frames folded stacks for
+    # flamegraphs): started per query via the HTTP surface or
+    # QueryHandle.start_profiler(); this sets only the sample rate
+    profiler_hz: float = 100.0
 
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a
@@ -254,13 +272,12 @@ class Context:
         self.config = config or EngineConfig()
         self._tables: dict[str, Source] = {}
         self._orchestrator = None
-        # metrics_enabled is applied by the EXECUTOR right before the
-        # physical operators are built (runtime/executor.py), so the
-        # executing context's config decides — merely CONSTRUCTING a
-        # second Context with a different setting cannot flip an earlier
-        # context's telemetry.  (The flag itself stays process-global:
-        # concurrently EXECUTING queries with different settings are not
-        # supported — see build_physical.)
+        # metrics_enabled is resolved by the EXECUTOR per execution
+        # (runtime/executor.py _resolve_registry): each query binds its
+        # operators against its own resolved registry — live handles or
+        # shared nulls — so concurrently EXECUTING queries with
+        # different settings no longer fight over a process-global flag
+        # (the PR-6 documented limitation, since fixed).
         _enable_compilation_cache(self.config.compilation_cache_dir)
 
     def __repr__(self) -> str:

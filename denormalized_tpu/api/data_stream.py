@@ -322,6 +322,31 @@ class DataStream:
         print(self._ctx._last_physical.display(with_metrics=True))
         return self
 
+    def explain_analyze(self, print_output: bool = True) -> str:
+        """Execute against a discard sink and return the pipeline
+        doctor's annotated plan: every node with live rows/s, batch-time
+        share of wall, upstream queue-wait, prefetch queue depth and
+        watermark lag, plus the ranked bottleneck attribution — the
+        slowest stage is NAMED under a documented rule
+        (obs/doctor/attribution.py), not left for the reader to infer.
+
+        Like ``explain(analyze=True)`` this needs a bounded source and
+        runs with checkpointing forced off (an introspection run must
+        not commit epochs under the real pipeline's node-id keys).  The
+        same report is available LIVE for any running query at
+        ``GET /queries/<id>/plan`` on the Prometheus HTTP server."""
+        from denormalized_tpu.physical.simple_execs import CallbackSink
+
+        self._execute(CallbackSink(lambda _b: None), checkpoint=False)
+        handle = getattr(self._ctx, "_last_doctor", None)
+        if handle is not None:
+            text = handle.render()
+        else:  # doctor_enabled=False: fall back to the metrics dump
+            text = self._ctx._last_physical.display(with_metrics=True)
+        if print_output:
+            print(text)
+        return text
+
     # -- execution -------------------------------------------------------
     def _execute(self, sink, checkpoint=None) -> None:
         from denormalized_tpu.runtime.executor import execute_plan

@@ -31,6 +31,9 @@ pass statically checks the name literals against the catalog)::
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 from denormalized_tpu.obs import spans as spans
 from denormalized_tpu.obs.catalog import INSTRUMENTS
 from denormalized_tpu.obs.registry import (
@@ -48,15 +51,65 @@ __all__ = [
     "INSTRUMENTS", "MetricsRegistry", "NULL", "SpanRecorder",
     "counter", "gauge", "gauge_fn", "histogram", "enabled",
     "set_enabled", "registry", "use_registry", "series_name",
+    "current_registry", "disabled_registry", "bound_registry",
     "enable_span_recording", "disable_span_recording", "spans",
     "start_exporters",
 ]
 
 _REGISTRY = MetricsRegistry(enabled=True)
 
+#: shared always-disabled registry: the per-query binding target for
+#: executions with ``metrics_enabled=False`` (every bind returns NULL)
+_DISABLED = MetricsRegistry(enabled=False)
+
+# per-thread registry-binding stack (see bound_registry): executors push
+# the registry a query resolved so every instrument bound while building
+# and driving THAT query lands there — two concurrent queries with
+# different metrics_enabled settings no longer fight over one global flag
+_TLS = threading.local()
+
 
 def registry() -> MetricsRegistry:
+    """The process-default registry (what binds outside any query)."""
     return _REGISTRY
+
+
+def current_registry() -> MetricsRegistry:
+    """The registry module-level binders resolve against RIGHT NOW: the
+    innermost :func:`bound_registry` on this thread, else the process
+    default."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else _REGISTRY
+
+
+def disabled_registry() -> MetricsRegistry:
+    """The shared always-disabled registry (hands out falsy NULLs)."""
+    return _DISABLED
+
+
+@contextlib.contextmanager
+def bound_registry(reg: MetricsRegistry):
+    """Route this thread's module-level binders to ``reg`` for the
+    duration.  Used by the executor to scope registry binding per query
+    execution; long-lived components that bind instruments from their
+    OWN threads (prefetch workers) capture ``current_registry()`` at
+    construction and re-enter it on their thread, so a supervised
+    rebuild mid-stream still binds to its query's registry.
+
+    Exits remove THIS context's entry even when interleaved generators
+    unwind out of order (a paused ``stream()`` holding an entry must not
+    be popped by a sibling's exit)."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(reg)
+    try:
+        yield reg
+    finally:
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is reg:
+                del stack[i]
+                break
 
 
 def use_registry(reg: MetricsRegistry) -> MetricsRegistry:
@@ -68,30 +121,32 @@ def use_registry(reg: MetricsRegistry) -> MetricsRegistry:
 
 
 def set_enabled(on: bool) -> None:
-    """Flip metrics for instruments bound FROM NOW ON (binding decides
-    null vs live once, so the hot path never re-checks).  Contexts apply
-    ``EngineConfig.metrics_enabled`` before any operator is built."""
+    """Flip metrics for instruments bound FROM NOW ON against the
+    process-default registry (binding decides null vs live once, so the
+    hot path never re-checks).  Per-query enablement is scoped by the
+    executor via :func:`bound_registry` — this flag only governs binds
+    outside any execution."""
     _REGISTRY.enabled = bool(on)
 
 
 def enabled() -> bool:
-    return _REGISTRY.enabled
+    return current_registry().enabled
 
 
 def counter(name: str, **labels):
-    return _REGISTRY.counter(name, **labels)
+    return current_registry().counter(name, **labels)
 
 
 def gauge(name: str, **labels):
-    return _REGISTRY.gauge(name, **labels)
+    return current_registry().gauge(name, **labels)
 
 
 def histogram(name: str, **labels):
-    return _REGISTRY.histogram(name, **labels)
+    return current_registry().histogram(name, **labels)
 
 
 def gauge_fn(name: str, fn, **labels):
-    return _REGISTRY.gauge_fn(name, fn, **labels)
+    return current_registry().gauge_fn(name, fn, **labels)
 
 
 # -- per-execution exporters (started by the executor, opt-in) ------------
@@ -128,27 +183,31 @@ class Exporters:
             disable_span_recording()
 
 
-def start_exporters(config) -> Exporters | None:
+def start_exporters(config, registry=None) -> Exporters | None:
     """Start whatever the config opted into; None when nothing is.
     Read with getattr so a caller-supplied config object predating these
-    knobs (tests building bare namespaces) never breaks execution."""
+    knobs (tests building bare namespaces) never breaks execution.
+    ``registry`` scopes the exporters to one query's resolved registry
+    (the executor passes it); default is the current binding."""
     port = getattr(config, "prometheus_port", None)
     jsonl_path = getattr(config, "metrics_jsonl_path", None)
     trace_path = getattr(config, "trace_path", None)
     trace_events = getattr(config, "trace_events", 0)
     if port is None and jsonl_path is None and trace_path is None:
         return None
+    if registry is None:
+        registry = current_registry()
     server = None
     if port is not None:
         from denormalized_tpu.obs.prometheus import PrometheusServer
 
-        server = PrometheusServer(_REGISTRY, port=port).start()
+        server = PrometheusServer(registry, port=port).start()
     snap = None
     if jsonl_path is not None:
         from denormalized_tpu.obs.jsonl import JsonlSnapshotter
 
         snap = JsonlSnapshotter(
-            jsonl_path, _REGISTRY,
+            jsonl_path, registry,
             interval_s=getattr(config, "metrics_jsonl_interval_s", 1.0),
         ).start()
     installed = False

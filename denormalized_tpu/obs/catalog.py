@@ -135,6 +135,21 @@ INSTRUMENTS: dict[str, tuple] = {
         "torn segment tails dropped by LSM startup replay (registry "
         "view of LsmStore.replay_truncated; pure-Python engine only)",
     ),
+    # -- pipeline doctor (obs/doctor, docs/observability.md) ------------
+    "dnz_op_input_wait_ms": (
+        "histogram",
+        "time an operator spent suspended waiting for its upstream to "
+        "yield the next stream item — the doctor's queue-wait signal "
+        "(high wait + low busy = this stage is starved by upstream)",
+        MS_BUCKETS,
+    ),
+    "dnz_prefetch_queue_dwell_ms": (
+        "histogram",
+        "time a rowful batch sat in the prefetch ready queue between "
+        "worker enqueue and consumer dequeue (handoff dwell: sustained "
+        "growth means the consumer thread is the bottleneck, not ingest)",
+        MS_BUCKETS,
+    ),
     # -- fault injection (runtime/faults.py) ----------------------------
     "dnz_fault_injections_total": (
         "counter",

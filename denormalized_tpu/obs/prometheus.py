@@ -84,7 +84,14 @@ def render(registry: MetricsRegistry) -> str:
 
 class PrometheusServer:
     """Scrape endpoint serving ``render(registry)`` at ``/metrics``
-    (and ``/`` for convenience) on a daemon thread."""
+    (and ``/`` for convenience) on a daemon thread — plus the pipeline
+    doctor's introspection surface (``/healthz``, ``/queries``,
+    ``/queries/<id>/plan|lineage|profile`` — see obs/doctor/http.py).
+
+    Resilience contract (pinned by the concurrent-teardown test): a
+    scrape racing operator/exporter teardown never gets a 5xx or a
+    hung socket — the doctor router is total, and the exposition
+    renderer reads single-writer instruments without locks."""
 
     CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -94,16 +101,39 @@ class PrometheusServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?")[0] not in ("/", "/metrics"):
+            def _respond(self, status, ctype, body):
+                try:
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    pass  # client went away mid-write: their problem
+
+            def _handle(self, method):
+                from denormalized_tpu.obs.doctor import http as doctor_http
+
+                if self.path.split("?")[0] in ("/", "/metrics"):
+                    if method != "GET":
+                        self.send_error(405)
+                        return
+                    self._respond(
+                        200, server.CONTENT_TYPE,
+                        render(server._registry).encode(),
+                    )
+                    return
+                routed = doctor_http.route(self.path, method)
+                if routed is None:
                     self.send_error(404)
                     return
-                body = render(server._registry).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", server.CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(*routed)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                self._handle("GET")
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                self._handle("POST")
 
             def log_message(self, fmt, *args):
                 pass  # scrapes must not spam the engine's stderr

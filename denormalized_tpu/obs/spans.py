@@ -59,6 +59,21 @@ class SpanRecorder:
             threading.get_ident(), args or None,
         )
 
+    def flow(self, name: str, flow_id: int, phase: str,
+             args: dict | None = None) -> None:
+        """One flow event: ``phase`` is ``"s"`` (start), ``"t"`` (step)
+        or ``"f"`` (finish).  Events sharing ``(name, flow_id)`` render
+        as connected arrows in Perfetto — how sampled record lineage
+        (obs/doctor/lineage.py) draws ingest → operator → emission
+        chains on the same stream as the engine's spans."""
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        idx = next(self._next)
+        self._slots[idx % self.capacity] = (
+            idx, phase, name, time.perf_counter(), float(flow_id),
+            threading.get_ident(), args or None,
+        )
+
     # -- read side -------------------------------------------------------
     def events(self) -> list[tuple]:
         """Retained events, oldest first (slots carry their sequence
@@ -86,6 +101,12 @@ class SpanRecorder:
                 ev["dur"] = round(dur * 1e6, 1)
             if ph == "i":
                 ev["s"] = "t"  # thread-scoped instant
+            if ph in ("s", "t", "f"):
+                # flow events reuse the dur slot as the flow id; "e"
+                # binds the finish arrow to the enclosing slice's end
+                ev["id"] = int(dur)
+                if ph == "f":
+                    ev["bp"] = "e"
             if args:
                 ev["args"] = args
             if args and "error" in args:

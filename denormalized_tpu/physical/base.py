@@ -19,6 +19,7 @@ the generator plumbing between them moves only batch references.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterator, Union
 
@@ -97,17 +98,81 @@ class ExecOperator:
     #: runs; real operators bind in their constructors)
     _obs_rows_in = _OBS_NULL
     _obs_batch_ms = _OBS_NULL
+    _obs_input_wait = _OBS_NULL
+
+    #: doctor per-node stats (obs/doctor): plain single-writer attribute
+    #: adds — one float/int add per batch or item, independent of the
+    #: registry so attribution works even with metrics disabled.  Class
+    #: defaults keep un-doctored operator instances (test doubles, direct
+    #: build_physical callers) inert.
+    _dr_busy_ms = 0.0
+    _dr_batches = 0
+    _dr_rows_in = 0
+    _dr_input_wait_s = 0.0
+    _dr_node_id: str | None = None
+    _dr_lineage = None  # obs.doctor.lineage.LineageTracker when sampling
 
     def bind_obs(self, op: str) -> None:
         """Bind this operator's registry instruments (obs subsystem):
-        rows-in counter + per-batch processing-time histogram, labeled
-        ``op=<label>``.  Called once from each operator's constructor;
-        with metrics disabled the handles are shared no-op nulls, so
-        the hot path stays allocation-free."""
+        rows-in counter, per-batch processing-time histogram, and the
+        doctor's upstream-wait histogram, labeled ``op=<label>``.
+        Called once from each operator's constructor; with metrics
+        disabled the handles are shared no-op nulls, so the hot path
+        stays allocation-free."""
         from denormalized_tpu import obs
 
         self._obs_rows_in = obs.counter("dnz_op_rows_in_total", op=op)
         self._obs_batch_ms = obs.histogram("dnz_op_batch_ms", op=op)
+        self._obs_input_wait = obs.histogram(
+            "dnz_op_input_wait_ms", op=op
+        )
+
+    # -- doctor handoff instrumentation (obs/doctor, DNZ-M002) -----------
+    def _note_batch(self, t0: float, rows: int) -> None:
+        """Close a batch-processing bracket opened at ``perf_counter()``
+        ``t0``: feeds both the registry histogram and the doctor's
+        per-node busy accounting.  Emissions must be materialized before
+        calling (time suspended in downstream operators is never this
+        operator's busy time — the PR-6 bracket contract)."""
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._obs_batch_ms.observe(dt_ms)
+        self._dr_busy_ms += dt_ms
+        self._dr_batches += 1
+        self._dr_rows_in += rows
+
+    def _note_input_wait(self, dt_s: float) -> None:
+        """Record one upstream-handoff wait (time this operator spent
+        suspended before the next stream item arrived).  Multi-input
+        operators (the join's merged queue) call this directly; single-
+        input operators get it via :meth:`_doctor_input`."""
+        self._dr_input_wait_s += dt_s
+        if self._obs_input_wait:
+            self._obs_input_wait.observe(dt_s * 1e3)
+
+    def _doctor_input(self, input_op: "ExecOperator | None" = None
+                      ) -> Iterator[StreamItem]:
+        """Iterate the upstream operator with the doctor's handoff
+        instrumentation: every pull is timed (queue-wait attribution)
+        and, when record lineage is sampling, rowful batches covering a
+        sampled record register a hop at this node.  Every operator that
+        overrides the batch-processing path must consume its input
+        through this (or :meth:`_note_input_wait`) — lint-enforced by
+        DNZ-M002."""
+        it = (input_op if input_op is not None else self.input_op).run()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self._note_input_wait(time.perf_counter() - t0)
+            if (
+                self._dr_lineage is not None
+                and isinstance(item, RecordBatch)
+                and item.num_rows
+            ):
+                self._dr_lineage.hop(self._dr_node_id, item)
+            yield item
 
     def run(self) -> Iterator[StreamItem]:
         raise NotImplementedError

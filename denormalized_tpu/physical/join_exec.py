@@ -682,7 +682,14 @@ class StreamingJoinExec(ExecOperator):
                 if pending and not (blocked[0] or blocked[1]):
                     side_id, item = pending.popleft()
                 else:
+                    # the merged queue is this operator's upstream
+                    # handoff: time blocked here is queue-wait for the
+                    # doctor's attribution (both sides produce on their
+                    # own pump threads, so this only waits when BOTH
+                    # sides are slower than the join)
+                    t0_wait = time.perf_counter()
                     side_id, item = q.get()
+                    self._note_input_wait(time.perf_counter() - t0_wait)
                     if blocked[side_id] and not isinstance(
                         item, BaseException
                     ):
@@ -774,6 +781,10 @@ class StreamingJoinExec(ExecOperator):
                 if batch.num_rows == 0:
                     continue
                 self._obs_rows_in.add(batch.num_rows)
+                if self._dr_lineage is not None:
+                    # record-lineage hop (the generic _doctor_input hook
+                    # can't see through the join's merged queue)
+                    self._dr_lineage.hop(self._dr_node_id, batch)
                 t0_batch = time.perf_counter()
                 gids = self._gids_of(
                     batch, self.left_keys if is_left else self.right_keys
@@ -786,9 +797,7 @@ class StreamingJoinExec(ExecOperator):
                 out = self._probe(
                     batch, gids, other, is_left, probe_base, side
                 )
-                self._obs_batch_ms.observe(
-                    (time.perf_counter() - t0_batch) * 1e3
-                )
+                self._note_batch(t0_batch, batch.num_rows)
                 if out is not None:
                     self._metrics["rows_out"] += out.num_rows
                     self._obs_rows_out.add(out.num_rows)

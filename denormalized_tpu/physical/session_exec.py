@@ -581,6 +581,14 @@ class SessionWindowExec(ExecOperator):
                 time.time() * 1000.0
                 - (float(T.last[slots].min()) + self.gap_ms)
             )
+        if self._dr_lineage is not None:
+            # lineage close: a sampled row belongs to the session whose
+            # [start, last + gap) interval contains its event time
+            self._dr_lineage.emitted(
+                self._dr_node_id,
+                np.asarray(T.start[slots], dtype=np.int64),
+                np.asarray(T.last[slots], dtype=np.int64) + self.gap_ms,
+            )
         in_schema = self.input_op.schema
         key_vals = self._interner.keys_of(T.gid[slots])
         cols: list[np.ndarray] = []
@@ -741,13 +749,13 @@ class SessionWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
                 # materialized inside the timing bracket: the histogram
                 # measures this operator's work, not downstream's
                 t0 = time.perf_counter()
                 out = list(self._process_batch(item))
-                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._note_batch(t0, item.num_rows)
                 yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":

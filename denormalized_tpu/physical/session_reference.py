@@ -18,6 +18,7 @@ segments of two distinct keys; the interner's dense ids cannot.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -550,9 +551,14 @@ class ReferenceSessionWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
-                yield from self._process_batch(item)
+                # materialized inside the timing bracket (the doctor's
+                # busy/handoff contract, same as the vectorized operator)
+                t0 = time.perf_counter()
+                out = list(self._process_batch(item))
+                self._note_batch(t0, item.num_rows)
+                yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":
                     self._src_watermarks = True

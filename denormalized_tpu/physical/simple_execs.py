@@ -263,6 +263,11 @@ class SourceExec(ExecOperator):
 
         from denormalized_tpu import obs
 
+        # the registry this operator was BUILT under: run-time binds
+        # (pump workers, reconstructed kafka readers) must land in the
+        # same query-scoped registry regardless of which thread drives
+        # the generator or when a supervised rebuild happens
+        self._obs_reg = obs.current_registry()
         # collision-free series label (see _source_series_label): two
         # same-named sources in one plan get distinct series
         self._obs_source_label = _source_series_label(str(source.name))
@@ -401,7 +406,12 @@ class SourceExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        readers = self.source.partitions()
+        from denormalized_tpu import obs
+
+        # reader construction binds instruments (kafka consumer-lag
+        # gauges): scope the binds to this operator's captured registry
+        with obs.bound_registry(self._obs_reg):
+            readers = self.source.partitions()
         self._readers = readers
         self._restore_offsets(readers)
         self._yielded_offsets = [r.offset_snapshot() for r in readers]
@@ -443,6 +453,13 @@ class SourceExec(ExecOperator):
                         self._obs_rows_out.add(b.num_rows)
                         if idle is not None:
                             idle.observe_rows(b)
+                        if self._dr_lineage is not None:
+                            # sampled record lineage: tag rows with the
+                            # reader's own post-batch offset snapshot
+                            self._dr_lineage.ingest(
+                                self._obs_source_label, i,
+                                r.offset_snapshot(), b,
+                            )
                         yield b
                         self._yielded_offsets[i] = r.offset_snapshot()
                         if pwm is not None and (h := pwm.observe(i, b)):
@@ -467,15 +484,17 @@ class SourceExec(ExecOperator):
         # after downstream fully processed the batch.
         from denormalized_tpu.runtime.prefetch import PrefetchPump
 
-        pump = PrefetchPump(
-            readers,
-            queue_budget=self._queue_size,
-            # per-partition rebuild hooks: with these the pump SUPERVISES
-            # worker crashes (restart + seek to the last enqueued offset)
-            # instead of failing the query on the first transient error
-            reader_factories=self.source.partition_factories(),
-            source_name=self._obs_source_label,
-        )
+        with obs.bound_registry(self._obs_reg):
+            pump = PrefetchPump(
+                readers,
+                queue_budget=self._queue_size,
+                # per-partition rebuild hooks: with these the pump
+                # SUPERVISES worker crashes (restart + seek to the last
+                # enqueued offset) instead of failing the query on the
+                # first transient error
+                reader_factories=self.source.partition_factories(),
+                source_name=self._obs_source_label,
+            )
         self._pump = pump
         finished = 0
         # idle-source watermark hints: live readers deliver EMPTY batches
@@ -495,7 +514,10 @@ class SourceExec(ExecOperator):
         pump.start()
         try:
             while finished < len(readers):
-                item = pump.get()
+                # liveness-checked get: a worker that died without its
+                # sentinel surfaces as a structured error instead of
+                # wedging the stream in an untimed queue wait
+                item = pump.get_live()
                 if isinstance(item, BaseException):
                     raise item
                 idx, snap, batch = item
@@ -513,6 +535,10 @@ class SourceExec(ExecOperator):
                         idle.observe_rows(batch)
                     elif h := idle.maybe_hint():
                         yield h
+                if self._dr_lineage is not None and batch.num_rows:
+                    self._dr_lineage.ingest(
+                        self._obs_source_label, idx, snap, batch
+                    )
                 yield batch
                 self._yielded_offsets[idx] = snap
                 pump.consumed(idx, bool(batch.num_rows))
@@ -554,7 +580,7 @@ class ProjectExec(ExecOperator):
                 e = e.inner
             return e.name if isinstance(e, Column) else None
 
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
                 t0 = time.perf_counter()
                 self._obs_rows_in.add(item.num_rows)
@@ -564,7 +590,7 @@ class ProjectExec(ExecOperator):
                     for e in self.exprs
                 ]
                 out = RecordBatch(self.schema, cols, masks)
-                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._note_batch(t0, item.num_rows)
                 yield out
             else:
                 yield item
@@ -585,7 +611,7 @@ class FilterExec(ExecOperator):
         return f"FilterExec({self.predicate!r})"
 
     def run(self) -> Iterator[StreamItem]:
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
                 t0 = time.perf_counter()
                 self._obs_rows_in.add(item.num_rows)
@@ -595,7 +621,7 @@ class FilterExec(ExecOperator):
                     else item.filter(keep) if keep.any()
                     else None
                 )
-                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._note_batch(t0, item.num_rows)
                 if out is not None:
                     yield out
             else:
@@ -611,6 +637,7 @@ class SinkExec(ExecOperator):
         self.input_op = input_op
         self.sink = sink
         self.schema = input_op.schema
+        self.bind_obs("sink")
 
     @property
     def children(self):
@@ -620,9 +647,15 @@ class SinkExec(ExecOperator):
         return f"SinkExec({type(self.sink).__name__})"
 
     def run(self) -> Iterator[StreamItem]:
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
+                # sink.write is this operator's busy time: a slow sink
+                # (blocking Kafka produce, fsync-heavy file sink) must
+                # show up as the bottleneck it is, not as upstream wait
+                t0 = time.perf_counter()
+                self._obs_rows_in.add(item.num_rows)
                 self.sink.write(item)
+                self._note_batch(t0, item.num_rows)
             elif isinstance(item, EndOfStream):
                 self.sink.close()
             yield item

@@ -380,6 +380,12 @@ class UdafWindowExec(ExecOperator):
             self._obs_emit_lag.observe(
                 time.time() * 1000.0 - (j * self.slide_ms + self.length_ms)
             )
+        if self._dr_lineage is not None:
+            self._dr_lineage.emitted(
+                self._dr_node_id,
+                j * self.slide_ms,
+                j * self.slide_ms + self.length_ms,
+            )
         m = len(frame)
         items = list(frame.items())
         cols: list[np.ndarray] = []
@@ -480,13 +486,13 @@ class UdafWindowExec(ExecOperator):
         )
 
     def run(self) -> Iterator[StreamItem]:
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
                 # materialized inside the timing bracket: the histogram
                 # measures this operator's work, not downstream's
                 t0 = time.perf_counter()
                 out = list(self._process_batch(item))
-                self._obs_batch_ms.observe((time.perf_counter() - t0) * 1e3)
+                self._note_batch(t0, item.num_rows)
                 yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":

@@ -943,6 +943,14 @@ class StreamingWindowExec(ExecOperator):
             self._obs_emit_lag.observe(
                 time.time() * 1000.0 - (j * self.slide_ms + self.length_ms)
             )
+        if self._dr_lineage is not None:
+            # sampled record lineage: close every chain whose tagged row
+            # fell inside this window (same funnel point as emit lag)
+            self._dr_lineage.emitted(
+                self._dr_node_id,
+                j * self.slide_ms,
+                j * self.slide_ms + self.length_ms,
+            )
         return RecordBatch(self.schema, cols)
 
     def _build_emission_finals(
@@ -1078,7 +1086,7 @@ class StreamingWindowExec(ExecOperator):
     def _run_inner(self) -> Iterator[StreamItem]:
         from denormalized_tpu.runtime.tracing import span
 
-        for item in self.input_op.run():
+        for item in self._doctor_input():
             if isinstance(item, RecordBatch):
                 # materialize any in-flight snapshot and release its
                 # marker BEFORE producing output from post-marker input
@@ -1093,9 +1101,7 @@ class StreamingWindowExec(ExecOperator):
                     "window.process_batch", op=self.name, rows=item.num_rows
                 ):
                     out = list(self._process_batch(item))
-                self._obs_batch_ms.observe(
-                    (time.perf_counter() - t0) * 1e3
-                )
+                self._note_batch(t0, item.num_rows)
                 yield from out
             elif isinstance(item, WatermarkHint):
                 if item.kind == "partition":

@@ -77,73 +77,129 @@ def _attach_checkpointing(root: ExecOperator, ctx, checkpoint=None):
     return orch, coord
 
 
+def _resolve_registry(ctx):
+    """The metrics registry THIS execution binds against: the thread's
+    current registry when the config enables metrics, the shared
+    always-disabled registry otherwise.  Resolution is per query, so two
+    concurrent executions with different ``metrics_enabled`` settings in
+    one process no longer fight over a global flag (the PR-6 documented
+    limitation) — each query's operators bind live handles or nulls
+    according to ITS OWN config."""
+    from denormalized_tpu import obs
+
+    if getattr(ctx.config, "metrics_enabled", True):
+        return obs.current_registry()
+    return obs.disabled_registry()
+
+
 def build_physical(plan: lp.LogicalPlan, ctx) -> ExecOperator:
     from denormalized_tpu import obs
     from denormalized_tpu.logical.optimizer import optimize
 
     # metrics enablement resolves from the EXECUTING context's config,
     # immediately before operator construction (handles bind once — live
-    # or null — and the hot path never re-checks).  The flag is
-    # process-global: CONCURRENT queries with different metrics_enabled
-    # settings are not supported (the last build decides for instruments
-    # that bind later, e.g. a supervised reader rebuilt mid-stream) —
-    # run mixed-enablement workloads in separate processes.
-    obs.set_enabled(getattr(ctx.config, "metrics_enabled", True))
+    # or null — and the hot path never re-checks): construction runs
+    # under the query-resolved registry binding, so a concurrent build
+    # with a different setting binds into ITS registry, not ours
     plan = optimize(plan, getattr(ctx.config, "optimizer", True))
-    return Planner(ctx.config).create_physical_plan(plan)
+    with obs.bound_registry(_resolve_registry(ctx)):
+        return Planner(ctx.config).create_physical_plan(plan)
 
 
 def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
     from denormalized_tpu.physical.base import Marker
 
     from denormalized_tpu import obs
+    from denormalized_tpu.obs import doctor
 
-    root = build_physical(plan, ctx)
-    ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
-    orch, coord = _attach_checkpointing(root, ctx, checkpoint)
-    ctx._last_coord = coord  # transactional sinks read committed_epoch
-    # opt-in exporters: Prometheus endpoint / JSONL snapshots / Perfetto
-    # trace dump, per EngineConfig (None when nothing opted in)
-    exporters = obs.start_exporters(ctx.config)
-    ctx._last_exporters = exporters
-    flag = ShutdownFlag()
-    restore = _install_signal_handlers(flag)
-    try:
-        for item in root.run():
-            if isinstance(item, Marker) and coord is not None:
-                # marker drained at the root: every operator snapshotted
-                # this epoch → make it the durable recovery point
-                coord.commit(item.epoch)
-            if flag.is_set():
-                break
-            if isinstance(item, EndOfStream):
-                break
-    finally:
-        restore()
-        if orch is not None:
-            orch.stop()
-        if exporters is not None:
-            exporters.stop()
-        from denormalized_tpu.runtime.tracing import log_metrics
+    reg = _resolve_registry(ctx)
+    with obs.bound_registry(reg):
+        root = build_physical(plan, ctx)
+        ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
+        # EVERYTHING that starts a per-query service runs inside the
+        # try: a failure while wiring the next service (bad lineage
+        # config, port clash) must still tear down the ones already
+        # started — not leak a bound HTTP port and live threads
+        orch = coord = exporters = handle = None
+        restore = lambda: None  # noqa: E731
+        flag = ShutdownFlag()
+        try:
+            orch, coord = _attach_checkpointing(root, ctx, checkpoint)
+            ctx._last_coord = coord  # transactional sinks read committed_epoch
+            # opt-in exporters: Prometheus endpoint / JSONL snapshots /
+            # Perfetto trace dump, per EngineConfig (None when nothing
+            # opted in), scoped to THIS query's resolved registry
+            exporters = obs.start_exporters(ctx.config, registry=reg)
+            ctx._last_exporters = exporters
+            # pipeline doctor: register the plan for live introspection
+            # (/queries/<id>/plan, bottleneck attribution, record lineage)
+            handle = doctor.register_query(
+                root, config=ctx.config, registry=reg
+            )
+            ctx._last_doctor = handle
+            restore = _install_signal_handlers(flag)
+            for item in root.run():
+                if isinstance(item, Marker) and coord is not None:
+                    # marker drained at the root: every operator
+                    # snapshotted this epoch → make it the durable
+                    # recovery point
+                    coord.commit(item.epoch)
+                if flag.is_set():
+                    break
+                if isinstance(item, EndOfStream):
+                    break
+        finally:
+            restore()
+            if orch is not None:
+                orch.stop()
+            if handle is not None:
+                # freeze the final snapshot (and drop the operator-tree
+                # reference) BEFORE exporters stop, so the last JSONL
+                # snapshot / trace dump and the doctor agree on end state
+                handle.finish()
+            if exporters is not None:
+                exporters.stop()
+            from denormalized_tpu.runtime.tracing import log_metrics
 
-        log_metrics(root)
+            log_metrics(root)
 
 
 def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     from denormalized_tpu import obs
+    from denormalized_tpu.obs import doctor
     from denormalized_tpu.physical.base import Marker
 
-    root = build_physical(plan, ctx)
-    ctx._last_physical = root  # post-run metrics access (DataStream.metrics)
-    orch, coord = _attach_checkpointing(root, ctx)
-    # exactly-once sinks tag output with the in-flight epoch and a
-    # recovery reader discards the uncommitted suffix (the transactional
-    # truncate-on-restore protocol); committed_epoch is their boundary
-    ctx._last_coord = coord
-    exporters = obs.start_exporters(ctx.config)
-    ctx._last_exporters = exporters
+    reg = _resolve_registry(ctx)
+    orch = coord = exporters = handle = it = None
     try:
-        for item in root.run():
+        with obs.bound_registry(reg):
+            root = build_physical(plan, ctx)
+            ctx._last_physical = root  # post-run metrics (DataStream.metrics)
+            orch, coord = _attach_checkpointing(root, ctx)
+            # exactly-once sinks tag output with the in-flight epoch and
+            # a recovery reader discards the uncommitted suffix (the
+            # transactional truncate-on-restore protocol);
+            # committed_epoch is their boundary
+            ctx._last_coord = coord
+            exporters = obs.start_exporters(ctx.config, registry=reg)
+            ctx._last_exporters = exporters
+            handle = doctor.register_query(
+                root, config=ctx.config, registry=reg
+            )
+            ctx._last_doctor = handle
+        # drive loop: re-enter the binding around each RESUMPTION, never
+        # across a yield — a paused stream must not leave its registry
+        # on the consumer thread's binding stack (a sibling query built
+        # between pulls would bind into the wrong registry).  Binds from
+        # worker threads ride the captures in SourceExec /
+        # PrefetchWorker instead.
+        it = root.run()
+        while True:
+            with obs.bound_registry(reg):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
             if isinstance(item, RecordBatch):
                 yield item
             elif isinstance(item, Marker) and coord is not None:
@@ -151,7 +207,18 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
             elif isinstance(item, EndOfStream):
                 break
     finally:
-        if orch is not None:
-            orch.stop()
-        if exporters is not None:
-            exporters.stop()
+        with obs.bound_registry(reg):
+            # close the operator chain FIRST (deterministically runs the
+            # operators' own finally blocks — pump shutdown, worker
+            # joins — instead of waiting for GC), then tear down the
+            # per-query services; all slots default to None so a setup
+            # failure (bad lineage config, port clash) still stops
+            # whatever had already started
+            if it is not None:
+                it.close()
+            if orch is not None:
+                orch.stop()
+            if handle is not None:
+                handle.finish()
+            if exporters is not None:
+                exporters.stop()

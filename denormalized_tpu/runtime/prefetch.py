@@ -186,12 +186,24 @@ class PrefetchWorker:
         # series across them would break the single-writer contract.
         from denormalized_tpu import obs
 
+        # captured binding: instruments bound FROM THE WORKER THREAD
+        # (a supervised rebuild constructing a fresh kafka reader binds
+        # its consumer-lag gauge there) must land in the same query-
+        # scoped registry this pump was built under
+        self._obs_reg = obs.current_registry()
         self._obs_depth = obs.gauge(
             "dnz_prefetch_queue_depth",
             source=source_name, partition=str(idx),
         )
         self._obs_restarts = obs.counter(
             "dnz_prefetch_restarts_total",
+            source=source_name, partition=str(idx),
+        )
+        # handoff dwell: observed by the CONSUMER at dequeue (see
+        # PrefetchPump._strip) from the enqueue stamp riding each item —
+        # the doctor's "is the consumer thread the bottleneck" signal
+        self._obs_dwell = obs.histogram(
+            "dnz_prefetch_queue_dwell_ms",
             source=source_name, partition=str(idx),
         )
 
@@ -294,72 +306,83 @@ class PrefetchWorker:
             )
 
     def _run(self) -> None:
-        err: BaseException | None = None
+        # the end-of-stream sentinel is the consumer's ONLY liveness
+        # signal from this worker: it must be guaranteed by the
+        # outermost frame, so nothing that runs before the supervised
+        # loop (the registry re-entry below, a failed import) can kill
+        # the thread sentinel-less and wedge the consumer in get()
         try:
-            while True:
-                if err is not None:
-                    if self._done.is_set():
-                        return  # shutting down: swallow, nobody is reading
-                    if not self._restartable(err):
-                        self._q.put(err)  # surfaced by the consumer
-                        return
-                    if (
-                        self._streak >= self._restart_budget
-                        or not self._global_budget.take()
-                    ):
-                        self._q.put(PrefetchRestartExhausted(
-                            self.idx, self.restarts, err
-                        ))
-                        return
-                    self.restarts += 1
-                    self._obs_restarts.add(1)
-                    self._streak += 1
-                    self._restart_wall = time.monotonic()
-                    # jitter INSIDE the clamp: backoff_max_s is a hard cap
-                    # a caller can tune against watermark/idle timeouts
-                    delay = min(
-                        self._backoff_max_s,
-                        self._backoff_base_s * (2 ** (self._streak - 1))
-                        * (1.0 + 0.25 * self._jitter.random()),
-                    )
-                    self.backoff_total_s += delay
-                    logger.warning(
-                        "prefetch worker %d: %s — restart %d/%d in %.2fs "
-                        "(resume from %s)",
-                        self.idx, err, self._streak, self._restart_budget,
-                        delay, self._last_snap,
-                    )
-                    if self._done.wait(delay):
-                        return
-                    err = None
-                    try:
-                        with span(
-                            "prefetch.restart",
-                            partition=self.idx, attempt=self.restarts,
-                        ):
-                            self._rebuild_reader()
-                    except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor re-dispatches: restartable errors re-enter the budgeted backoff, the rest surface via the queue on the next loop pass
-                        # rebuild failed (e.g. broker still down): another
-                        # crash — loops back into the budgeted backoff
-                        err = e
-                        self.last_error = f"{type(e).__name__}: {e}"
-                        continue
-                try:
-                    self._run_reader()
-                    return  # clean EOS (or shutdown)
-                except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor loop classifies err: non-restartable errors are enqueued for the consumer to re-raise, restartable ones restart
-                    err = e
-                    self.last_error = f"{type(e).__name__}: {e}"
-                    # rows past _last_snap died with the reader and WILL
-                    # be re-read: the partition must read as known-backlog
-                    # (never idle-judgeable) for the whole backoff/rebuild
-                    # window, or the watermark advances over the lost rows
-                    # and the re-read arrives "late" — silent loss by the
-                    # very mechanism meant to prevent it
-                    self.caught_up = False
+            from denormalized_tpu import obs
+
+            with obs.bound_registry(self._obs_reg):
+                self._run_supervised()
         finally:
             self.finished = True
-            self._q.put((self.idx, None, None))
+            self._q.put((self.idx, None, None, 0.0))
+
+    def _run_supervised(self) -> None:
+        err: BaseException | None = None
+        while True:
+            if err is not None:
+                if self._done.is_set():
+                    return  # shutting down: swallow, nobody is reading
+                if not self._restartable(err):
+                    self._q.put(err)  # surfaced by the consumer
+                    return
+                if (
+                    self._streak >= self._restart_budget
+                    or not self._global_budget.take()
+                ):
+                    self._q.put(PrefetchRestartExhausted(
+                        self.idx, self.restarts, err
+                    ))
+                    return
+                self.restarts += 1
+                self._obs_restarts.add(1)
+                self._streak += 1
+                self._restart_wall = time.monotonic()
+                # jitter INSIDE the clamp: backoff_max_s is a hard cap
+                # a caller can tune against watermark/idle timeouts
+                delay = min(
+                    self._backoff_max_s,
+                    self._backoff_base_s * (2 ** (self._streak - 1))
+                    * (1.0 + 0.25 * self._jitter.random()),
+                )
+                self.backoff_total_s += delay
+                logger.warning(
+                    "prefetch worker %d: %s — restart %d/%d in %.2fs "
+                    "(resume from %s)",
+                    self.idx, err, self._streak, self._restart_budget,
+                    delay, self._last_snap,
+                )
+                if self._done.wait(delay):
+                    return
+                err = None
+                try:
+                    with span(
+                        "prefetch.restart",
+                        partition=self.idx, attempt=self.restarts,
+                    ):
+                        self._rebuild_reader()
+                except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor re-dispatches: restartable errors re-enter the budgeted backoff, the rest surface via the queue on the next loop pass
+                    # rebuild failed (e.g. broker still down): another
+                    # crash — loops back into the budgeted backoff
+                    err = e
+                    self.last_error = f"{type(e).__name__}: {e}"
+                    continue
+            try:
+                self._run_reader()
+                return  # clean EOS (or shutdown)
+            except BaseException as e:  # dnzlint: allow(broad-except) not swallowed — the supervisor loop classifies err: non-restartable errors are enqueued for the consumer to re-raise, restartable ones restart
+                err = e
+                self.last_error = f"{type(e).__name__}: {e}"
+                # rows past _last_snap died with the reader and WILL
+                # be re-read: the partition must read as known-backlog
+                # (never idle-judgeable) for the whole backoff/rebuild
+                # window, or the watermark advances over the lost rows
+                # and the re-read arrives "late" — silent loss by the
+                # very mechanism meant to prevent it
+                self.caught_up = False
 
     def _run_reader(self) -> None:
         reader = self.reader
@@ -404,7 +427,9 @@ class PrefetchWorker:
             snap = reader.offset_snapshot()
             if not self._acquire_slot():
                 return  # shutdown won
-            self._q.put((self.idx, snap, b))
+            # the enqueue stamp rides the item: the consumer observes
+            # queue dwell (enqueue → dequeue) at _strip time
+            self._q.put((self.idx, snap, b, time.perf_counter()))
             self._last_snap = snap
 
 
@@ -520,8 +545,58 @@ class PrefetchPump:
             "global_budget_remaining": self._global_budget.remaining(),
         }
 
+    def _strip(self, item):
+        """Normalize a queue item for consumers: observe the handoff
+        dwell (enqueue stamp → now) for rowful batches and strip the
+        stamp, so every caller keeps seeing ``(idx, snap, batch)``.
+        Exceptions and legacy 3-tuples (tests enqueue them directly)
+        pass through untouched."""
+        if isinstance(item, tuple) and len(item) == 4:
+            idx, snap, b, t_enq = item
+            if b is not None and b.num_rows and t_enq:
+                w = self.workers[idx]
+                if w._obs_dwell:
+                    w._obs_dwell.observe(
+                        (time.perf_counter() - t_enq) * 1e3
+                    )
+            return idx, snap, b
+        return item
+
     def get(self):
-        return self._q.get()
+        return self._strip(self._q.get())
+
+    def get_live(self, timeout_s: float = 30.0):
+        """Blocking get with a liveness backstop.  A live worker
+        guarantees an item at least every read-timeout (even a quiet
+        topic enqueues empty heartbeats), so a queue starved past
+        ``timeout_s`` while some worker thread has DIED without its
+        end-of-stream sentinel can never heal — raise a structured
+        SourceError naming the partitions instead of blocking the
+        consumer forever.  Workers that are alive but slow (a 30s
+        native-recv stall against a sick broker) just log and keep
+        waiting."""
+        while True:
+            try:
+                return self._strip(self._q.get(timeout=timeout_s))
+            except queue_mod.Empty:
+                dead = [
+                    w.idx for w in self.workers
+                    if not w.finished
+                    and w._thread is not None
+                    and not w._thread.is_alive()
+                ]
+                if dead:
+                    raise SourceError(
+                        f"prefetch worker(s) {dead} died without an "
+                        f"end-of-stream sentinel (ready queue starved "
+                        f"for {timeout_s:.0f}s)"
+                    ) from None
+                logger.warning(
+                    "prefetch ready queue starved for %.0fs — still "
+                    "waiting on live worker(s) for partition(s) %s",
+                    timeout_s,
+                    [w.idx for w in self.workers if not w.finished],
+                )
 
     def consumed(self, idx: int, rowful: bool) -> None:
         self.workers[idx].consumed(rowful)
@@ -561,7 +636,7 @@ class PrefetchPump:
                             f"prefetch drain stalled at {seen} rows"
                         )
                     try:
-                        item = self._q.get(timeout=1.0)
+                        item = self._strip(self._q.get(timeout=1.0))
                         break
                     except queue_mod.Empty:
                         continue

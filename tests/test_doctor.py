@@ -1,0 +1,476 @@
+"""Pipeline doctor: plan registry, bottleneck attribution, sampled
+record lineage, the HTTP introspection surface, and the sampling
+profiler (obs/doctor/, docs/observability.md §"Operating the doctor").
+
+The two acceptance tests the ISSUE names live here: a deliberately
+throttled operator must be NAMED as the top suspect by node id, and a
+sampled record must be traceable ingest offset → emission through the
+query API.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col, obs
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.schema import DataType
+from denormalized_tpu.obs.doctor import attribution, get_query
+from denormalized_tpu.obs.registry import MetricsRegistry
+from denormalized_tpu.sources.memory import MemorySource
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = obs.use_registry(reg)
+    yield reg
+    obs.use_registry(prev)
+
+
+T0 = 1_700_000_000_000
+
+
+def _batches(make_batch, n_batches=8, rows=200, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, size=rows))
+        names = rng.choice([f"sensor_{i}" for i in range(5)], size=rows)
+        vals = rng.normal(50.0, 10.0, size=rows)
+        out.append(make_batch(ts, names, vals))
+    return out
+
+
+def _mem(batches):
+    return MemorySource.from_batches(
+        batches, timestamp_column="occurred_at_ms"
+    )
+
+
+def _window_ds(ctx, batches):
+    return ctx.from_source(_mem(batches)).window(
+        [col("sensor_name")],
+        [F.count(col("reading")).alias("count")],
+        1000,
+    )
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+# -- acceptance: throttled operator is NAMED --------------------------------
+
+
+def test_throttled_operator_named_top_suspect(make_batch, registry):
+    """A deliberately slow stage (a UDF sleeping per batch inside a
+    projection) must come out as the doctor's #1 ranked suspect, by its
+    exact node id — the attribution rule names the stage, the reader
+    never infers it."""
+
+    def throttle(vals):
+        # 60ms per batch x 16 batches ≈ 1s: decisively above everything
+        # else in the plan, including the window's first-batch compile
+        time.sleep(0.06)
+        return vals
+
+    slow = F.udf(throttle, DataType.FLOAT64, "throttle")
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    ds = (
+        ctx.from_source(_mem(_batches(make_batch, n_batches=16)))
+        .with_column("reading", slow(col("reading")))
+        .window(
+            [col("sensor_name")],
+            [F.count(col("reading")).alias("count")],
+            1000,
+        )
+    )
+    ds.collect()
+    handle = ctx._last_doctor
+    assert handle is not None and not handle.running
+    snap = handle.snapshot()
+    suspects = snap["attribution"]["suspects"]
+    top = suspects[0]
+    assert "ProjectExec" in top["node_id"], suspects
+    assert snap["attribution"]["bottleneck"] == top["node_id"]
+    # the throttle is 60ms x 16 batches ≈ 1s of measured busy time
+    assert top["busy_ms"] >= 700.0
+    assert top["share_of_wall"] > 0.3
+    # the rule ships with the ranking, verbatim
+    assert "wall time" in snap["attribution"]["rule"]
+
+
+def test_attribution_rank_residual_to_uninstrumented_child():
+    """Unit contract of the documented rule: a consumer's input wait
+    minus its child's measured time is attributed to the child (a
+    source's un-bracketed fetch/decode)."""
+    nodes = [
+        {"node_id": "0_Sink", "label": "sink", "children": ["1_Win"],
+         "busy_ms": 5.0, "input_wait_ms": 100.0},
+        {"node_id": "1_Win", "label": "win", "children": ["2_Src"],
+         "busy_ms": 40.0, "input_wait_ms": 55.0},
+        {"node_id": "2_Src", "label": "src", "children": [],
+         "busy_ms": 0.0, "input_wait_ms": 0.0},
+    ]
+    ranked = attribution.rank(nodes, wall_ms=110.0)
+    by_id = {r["node_id"]: r for r in ranked}
+    # sink's 100ms wait is fully explained by win (40 + 55) + residual 5
+    assert by_id["1_Win"]["attributed_wait_ms"] == pytest.approx(5.0)
+    # win's 55ms wait is unexplained by src (0 measured) → all attributed
+    assert by_id["2_Src"]["attributed_wait_ms"] == pytest.approx(55.0)
+    assert by_id["2_Src"]["basis"] == "attributed"
+    # ranking: src 55 > win 45 > sink 5
+    assert [r["node_id"] for r in ranked] == ["2_Src", "1_Win", "0_Sink"]
+
+
+def test_explain_analyze_names_bottleneck(make_batch, registry, capsys):
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    text = _window_ds(ctx, _batches(make_batch)).explain_analyze()
+    assert "bottleneck:" in text
+    assert "StreamingWindowExec" in text
+    assert "rule:" in text
+    # per-node annotations are live numbers, not placeholders
+    assert "rows/s=" in text and "busy=" in text
+    assert text in capsys.readouterr().out
+
+
+# -- acceptance: sampled record lineage end to end --------------------------
+
+
+def test_lineage_chain_ingest_to_emission(make_batch, registry):
+    """A sampled record's chain must run ingest offset → operator hops
+    → window emission, with the emission window containing the record's
+    event time."""
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, lineage_sample_every=100,
+    ))
+    _window_ds(ctx, _batches(make_batch)).collect()
+    handle = ctx._last_doctor
+    assert handle.lineage is not None
+    chains = handle.lineage.chains()
+    assert len(chains) >= 8  # 1600 rows / 100
+    completed = [c for c in chains if c["emissions"]]
+    assert completed, "no lineage chain reached emission"
+    for c in completed:
+        # the source label may carry the per-process ordinal suffix
+        # (_source_series_label): earlier queries claimed "memory"
+        assert c["source"].startswith("memory")
+        assert c["offset"].get("pos") is not None  # reader offset snapshot
+        e = c["emissions"][0]
+        assert (
+            e["window_start_ms"] <= c["event_time_ms"] < e["window_end_ms"]
+        )
+        assert "StreamingWindowExec" in e["node_id"]
+        # at least one pre-aggregation hop was recorded
+        assert any(
+            "StreamingWindowExec" in h["node_id"] for h in c["hops"]
+        )
+    # the "why is this window late" lookup: filter by window start
+    ws = completed[0]["emissions"][0]["window_start_ms"]
+    filtered = handle.lineage.chains(window_start_ms=ws)
+    assert filtered
+    assert all(
+        any(e["window_start_ms"] == ws for e in c["emissions"])
+        for c in filtered
+    )
+
+
+def test_lineage_session_window_chain(make_batch, registry):
+    """Session emissions report per-slot [start, last+gap) interval
+    ARRAYS — the multi-window emitted() path — and chains still close by
+    event-time containment."""
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, lineage_sample_every=150,
+    ))
+    ds = ctx.from_source(_mem(_batches(make_batch))).session_window(
+        [col("sensor_name")],
+        [F.count(col("reading")).alias("count")],
+        300,
+    )
+    ds.collect()
+    chains = ctx._last_doctor.lineage.chains()
+    completed = [c for c in chains if c["emissions"]]
+    assert completed, "no session lineage chain reached emission"
+    for c in completed:
+        e = c["emissions"][0]
+        assert "SessionWindowExec" in e["node_id"]
+        assert (
+            e["window_start_ms"] <= c["event_time_ms"] < e["window_end_ms"]
+        )
+
+
+def test_lineage_flow_events_on_span_stream(make_batch, registry, tmp_path):
+    """Lineage lands as flow-connected (s/t/f) events on the PR-6 trace
+    stream, sharing ids so Perfetto draws the chain."""
+    trace_path = tmp_path / "trace.json"
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256,
+        lineage_sample_every=200,
+        trace_path=str(trace_path),
+    ))
+    try:
+        _window_ds(ctx, _batches(make_batch)).collect()
+    finally:
+        from denormalized_tpu.obs import spans as obs_spans
+
+        obs_spans.disable_span_recording()
+    trace = json.loads(trace_path.read_text())
+    flows = [e for e in trace["traceEvents"] if e.get("ph") in "stf"]
+    assert flows, "no lineage flow events in the trace"
+    by_id = {}
+    for e in flows:
+        assert e["name"] == "lineage" and "id" in e
+        by_id.setdefault(e["id"], set()).add(e["ph"])
+    # at least one chain is fully connected: start, step(s), finish
+    assert any({"s", "t", "f"} <= phases for phases in by_id.values())
+
+
+# -- the HTTP surface -------------------------------------------------------
+
+
+def test_queries_plan_and_lineage_endpoints_live(make_batch, registry):
+    """Mid-stream, the doctor endpoints serve the live plan (annotated
+    nodes + attribution) and the lineage chains for a running query."""
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, prometheus_port=0,
+        lineage_sample_every=100,
+    ))
+    ds = _window_ds(ctx, _batches(make_batch, n_batches=12))
+    it = ds.stream()
+    try:
+        next(it)  # at least one emission: windows have closed mid-run
+        port = ctx._last_exporters.prometheus.port
+        base = f"http://127.0.0.1:{port}"
+
+        status, ctype, body = _get(f"{base}/healthz")
+        assert status == 200 and ctype.startswith("application/json")
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert health["queries_running"] >= 1
+
+        status, _, body = _get(f"{base}/queries")
+        queries = json.loads(body)["queries"]
+        running = [q for q in queries if q["state"] == "running"]
+        assert running
+        qid = running[0]["query_id"]
+
+        status, _, body = _get(f"{base}/queries/{qid}/plan")
+        assert status == 200
+        plan = json.loads(body)
+        assert plan["state"] == "running"
+        node_ids = {n["node_id"] for n in plan["nodes"]}
+        assert any("StreamingWindowExec" in n for n in node_ids)
+        assert any("SourceExec" in n for n in node_ids)
+        assert plan["attribution"]["bottleneck"] in node_ids
+        for n in plan["nodes"]:
+            assert {"busy_ms", "input_wait_ms", "rows_per_s"} <= set(n)
+
+        status, _, body = _get(f"{base}/queries/{qid}/lineage")
+        assert status == 200
+        lineage = json.loads(body)
+        assert lineage["sample_every"] == 100
+        assert lineage["sampled_total"] >= 1
+
+        # unknown query id → 404 with the known ids listed
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/queries/nope/plan")
+        assert ei.value.code == 404
+    finally:
+        for _ in it:
+            pass
+    # after the stream ends the query is still introspectable in-process
+    # via the retained finished ring (the HTTP server is down by design)
+    handle = ctx._last_doctor
+    assert get_query(handle.query_id) is handle
+    assert handle.snapshot()["state"] == "finished"
+
+
+def test_profiler_start_stop_over_http(make_batch, registry):
+    ctx = Context(EngineConfig(min_batch_bucket=256, prometheus_port=0))
+    ds = _window_ds(ctx, _batches(make_batch, n_batches=30, rows=2000))
+    it = ds.stream()
+    try:
+        next(it)
+        port = ctx._last_exporters.prometheus.port
+        base = f"http://127.0.0.1:{port}"
+        qid = json.loads(_get(f"{base}/queries")[2])["queries"][0][
+            "query_id"
+        ]
+        status, _, body = _get(
+            f"{base}/queries/{qid}/profile/start?hz=200"
+        )
+        assert status == 200 and json.loads(body)["profiling"] is True
+        # drive the pipeline while the sampler runs
+        for _ in range(8):
+            next(it, None)
+        time.sleep(0.05)
+        status, _, body = _get(f"{base}/queries/{qid}/profile/stop")
+        stopped = json.loads(body)
+        assert stopped["profiling"] is False
+        assert stopped["samples"] >= 1
+        status, ctype, body = _get(f"{base}/queries/{qid}/profile")
+        assert status == 200 and ctype.startswith("text/plain")
+        folded = body.decode()
+        # folded-stack grammar: "frame;frame;... count" per line
+        for line in folded.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and int(count) >= 1
+    finally:
+        for _ in it:
+            pass
+
+
+def test_profiler_folded_stacks_capture_running_code(registry):
+    from denormalized_tpu.obs.doctor.profiler import SamplingProfiler
+
+    stop = threading.Event()
+
+    def busy_beaver():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=busy_beaver, name="beaver", daemon=True)
+    t.start()
+    prof = SamplingProfiler(hz=400).start()
+    try:
+        time.sleep(0.25)
+    finally:
+        n = prof.stop()
+        stop.set()
+        t.join(timeout=2)
+    assert n >= 20
+    folded = prof.folded()
+    assert "busy_beaver" in folded
+    assert any(line.startswith("beaver;") for line in folded.splitlines())
+
+
+# -- teardown resilience (rides the lock witness) ---------------------------
+
+
+def test_concurrent_scrapes_during_teardown_never_500(make_batch, registry):
+    """Satellite acceptance: scrapes against /metrics, /healthz,
+    /queries and /queries/<id>/plan racing operator + exporter teardown
+    must never see a 5xx and never deadlock.  Connection errors once the
+    server is down are the expected end state."""
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, prometheus_port=0,
+        lineage_sample_every=100,
+    ))
+    ds = _window_ds(ctx, _batches(make_batch, n_batches=20))
+    it = ds.stream()
+    next(it)
+    port = ctx._last_exporters.prometheus.port
+    base = f"http://127.0.0.1:{port}"
+    qid = json.loads(_get(f"{base}/queries")[2])["queries"][0]["query_id"]
+    paths = ["/metrics", "/healthz", "/queries", f"/queries/{qid}/plan",
+             f"/queries/{qid}/lineage"]
+    bad: list = []
+    server_down = threading.Event()
+
+    def hammer(path):
+        while not server_down.is_set():
+            try:
+                status, _, _ = _get(base + path, timeout=5)
+                if status >= 500:
+                    bad.append((path, status))
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:
+                    bad.append((path, e.code))
+            except (urllib.error.URLError, ConnectionError, OSError):
+                # server stopped (teardown finished): expected terminal
+                server_down.set()
+
+    threads = [
+        threading.Thread(target=hammer, args=(p,), daemon=True)
+        for p in paths for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    # drain to completion → operators tear down, exporters stop, the
+    # doctor freezes its final snapshot — all while the hammers run
+    for _ in it:
+        pass
+    server_down.wait(timeout=30)
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), "scrape thread hung"
+    assert bad == [], f"5xx during teardown: {bad}"
+
+
+def test_setup_failure_tears_down_started_exporters(make_batch, registry):
+    """A failure while wiring per-query services (an invalid lineage
+    config raising in register_query) must stop the exporters that
+    already started — not leak a bound HTTP port and live threads."""
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, prometheus_port=0,
+        lineage_sample_every=-1,  # rejected by LineageTracker
+    ))
+    with pytest.raises(ValueError, match="lineage_sample_every"):
+        _window_ds(ctx, _batches(make_batch)).collect()
+    server = ctx._last_exporters.prometheus
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(f"http://127.0.0.1:{server.port}/healthz", timeout=2)
+    # same teardown contract on the stream path
+    ctx2 = Context(EngineConfig(
+        min_batch_bucket=256, prometheus_port=0, lineage_sample_every=-1,
+    ))
+    with pytest.raises(ValueError, match="lineage_sample_every"):
+        next(_window_ds(ctx2, _batches(make_batch)).stream())
+    server2 = ctx2._last_exporters.prometheus
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        _get(f"http://127.0.0.1:{server2.port}/healthz", timeout=2)
+
+
+def test_doctor_disabled_opt_out(make_batch, registry):
+    ctx = Context(EngineConfig(min_batch_bucket=256, doctor_enabled=False))
+    ds = _window_ds(ctx, _batches(make_batch))
+    out = ds.collect()
+    assert out.num_rows > 0
+    assert ctx._last_doctor is None
+    # explain_analyze still works, via the metrics-dump fallback
+    text = _window_ds(ctx, _batches(make_batch)).explain_analyze(
+        print_output=False
+    )
+    assert "StreamingWindowExec" in text
+
+
+def test_profiler_start_after_finish_refuses(make_batch, registry):
+    """A /profile/start racing query end must not leak a sampler: on a
+    finished handle, start_profiler refuses (None) and the HTTP route
+    404s instead of starting a thread nothing will ever stop."""
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    _window_ds(ctx, _batches(make_batch)).collect()
+    handle = ctx._last_doctor
+    assert not handle.running
+    assert handle.start_profiler() is None
+    assert handle.profiler is None
+    from denormalized_tpu.obs.doctor import http as doctor_http
+
+    status, _, body = doctor_http.route(
+        f"/queries/{handle.query_id}/profile/start"
+    )
+    assert status == 404
+    assert b"finished" in body
+
+
+def test_finished_handle_drops_operator_tree(make_batch, registry):
+    """The retained finished ring must not pin operator graphs (window
+    state, prefetch buffers) — finish() freezes a plain-dict snapshot
+    and drops the tree reference."""
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    _window_ds(ctx, _batches(make_batch)).collect()
+    handle = ctx._last_doctor
+    assert handle.root is None
+    snap = handle.snapshot()
+    assert snap["state"] == "finished"
+    assert snap["attribution"]["suspects"]
+    # render works from the frozen snapshot
+    assert "bottleneck:" in handle.render()

@@ -384,6 +384,85 @@ def test_metric_registry_pass_fires(tmp_path):
     )
 
 
+def test_handoff_instrument_pass_fires(tmp_path):
+    """DNZ-M002 must fire in both directions: an operator overriding the
+    batch-processing path without the doctor's handoff hooks, a new
+    operator missing from operators.toml, and a stale registration."""
+    root = _write_pkg(tmp_path, {
+        "physical/ops.py": """\
+            class GoodOp:
+                def __init__(self, input_op):
+                    self.input_op = input_op
+                    self.bind_obs("good")
+
+                def run(self):
+                    for item in self._doctor_input():
+                        t0 = 0.0
+                        self._note_batch(t0, item.num_rows)
+                        yield item
+
+
+            class BadOp:
+                def __init__(self, input_op):
+                    self.input_op = input_op
+
+                def run(self):
+                    for item in self.input_op.run():
+                        yield item
+
+
+            class UnregisteredOp:
+                def __init__(self, input_op):
+                    self.input_op = input_op
+                    self.bind_obs("unreg")
+
+                def run(self):
+                    for item in self._doctor_input():
+                        self._note_batch(0.0, item.num_rows)
+                        yield item
+
+
+            class LeafOp:
+                # no upstream input: exempt by shape (SourceExec analog)
+                def run(self):
+                    yield None
+            """,
+    })
+    ops_toml = tmp_path / "ops.toml"
+    ops_toml.write_text(textwrap.dedent("""\
+        [[operator]]
+        class = "GoodOp"
+        file = "badpkg/physical/ops.py"
+
+        [[operator]]
+        class = "BadOp"
+        file = "badpkg/physical/ops.py"
+
+        [[operator]]
+        class = "GoneOp"
+        file = "badpkg/physical/gone.py"
+        """))
+    new, _, _ = run_all(root, baseline_path=tmp_path / "nb.toml",
+                        hotpaths_path=tmp_path / "nh.toml",
+                        operators_path=ops_toml)
+    m2 = [f for f in new if f.rule == "DNZ-M002"]
+    msgs = {f.symbol: [g.message for g in m2 if g.symbol == f.symbol]
+            for f in m2}
+    # BadOp: all three hooks missing (registered, so no registry finding)
+    assert "BadOp" in msgs
+    joined = " | ".join(msgs["BadOp"])
+    assert "bind_obs" in joined
+    assert "_doctor_input" in joined
+    assert "_note_batch" in joined
+    # a complete-but-unregistered operator fires the registry direction
+    assert any("not registered" in m for m in msgs.get("UnregisteredOp", []))
+    # a stale registration fires the reverse direction
+    assert any("stale" in m for m in msgs.get("GoneOp", []))
+    # the clean registered operator and the input-less leaf stay silent
+    assert "GoodOp" not in msgs
+    assert "LeafOp" not in msgs
+
+
 def test_hotpath_loop_tolist_and_hash_fire(tmp_path):
     root = _write_pkg(tmp_path, {"hot.py": """\
         def kernel(rows):

@@ -1,0 +1,186 @@
+"""Per-query registry binding (the PR-6 documented limitation, fixed):
+exporter lifecycle and metrics enablement are scoped to each execution,
+so two concurrent queries with different ``metrics_enabled`` settings in
+one process no longer fight over a process-global flag."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col, obs
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.obs.registry import NULL, MetricsRegistry
+from denormalized_tpu.sources.memory import MemorySource
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = obs.use_registry(reg)
+    yield reg
+    obs.use_registry(prev)
+
+
+T0 = 1_700_000_000_000
+
+
+def _batches(make_batch, n_batches=8, rows=200, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, size=rows))
+        names = rng.choice([f"sensor_{i}" for i in range(5)], size=rows)
+        vals = rng.normal(50.0, 10.0, size=rows)
+        out.append(make_batch(ts, names, vals))
+    return out
+
+
+def _run_query(make_batch, enabled, rows=200, n_batches=8, seed=0):
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256, metrics_enabled=enabled,
+    ))
+    src = MemorySource.from_batches(
+        _batches(make_batch, n_batches=n_batches, rows=rows, seed=seed),
+        timestamp_column="occurred_at_ms",
+    )
+    ds = ctx.from_source(src).window(
+        [col("sensor_name")],
+        [F.count(col("reading")).alias("count")],
+        1000,
+    )
+    ds.collect()
+    return ctx
+
+
+def _window_op(ctx):
+    from denormalized_tpu.physical.window_exec import StreamingWindowExec
+    from denormalized_tpu.state.checkpoint import walk
+
+    for op in walk(ctx._last_physical):
+        if isinstance(op, StreamingWindowExec):
+            return op
+    raise AssertionError("no window operator in the plan")
+
+
+def test_concurrent_queries_with_mixed_enablement_do_not_fight(
+    make_batch, registry
+):
+    """The regression the satellite demands: query A (metrics on) and
+    query B (metrics off) EXECUTING CONCURRENTLY in one process.  A's
+    operators must bind live instruments, B's must bind nulls, and the
+    shared registry must see exactly A's rows — regardless of
+    interleaving."""
+    results: dict = {}
+    barrier = threading.Barrier(2, timeout=30)
+
+    def run(key, enabled, seed):
+        barrier.wait()  # maximize overlap of the two builds + runs
+        results[key] = _run_query(
+            make_batch, enabled, n_batches=12, seed=seed
+        )
+
+    ta = threading.Thread(target=run, args=("a", True, 1))
+    tb = threading.Thread(target=run, args=("b", False, 2))
+    ta.start()
+    tb.start()
+    ta.join(timeout=60)
+    tb.join(timeout=60)
+    assert "a" in results and "b" in results
+
+    win_a = _window_op(results["a"])
+    win_b = _window_op(results["b"])
+    # A bound live handles; B bound the shared falsy null
+    assert win_a._obs_rows_in is not NULL
+    assert win_a._obs_rows_in.value == 12 * 200
+    assert win_b._obs_rows_in is NULL
+    assert win_b._obs_batch_ms is NULL
+    # the registry's series carry ONLY A's counts: B contributed nothing
+    c = registry.counter("dnz_op_rows_in_total", op="window")
+    assert c.value == 12 * 200
+    # both queries still produced correct output-side dict metrics
+    for key in ("a", "b"):
+        m = _window_op(results[key]).metrics()
+        assert m["rows_in"] == 12 * 200
+
+
+def test_disabled_query_binds_nothing_enabled_query_unaffected(
+    make_batch, registry
+):
+    """Sequential form of the same contract (deterministic ordering):
+    a disabled run leaves the registry untouched; a following enabled
+    run binds normally."""
+    _run_query(make_batch, enabled=False)
+    assert registry.instruments() == []
+    _run_query(make_batch, enabled=True)
+    c = registry.counter("dnz_op_rows_in_total", op="window")
+    assert c.value == 8 * 200
+
+
+def test_bound_registry_nesting_and_out_of_order_exit():
+    """The thread-local binding stack: nesting resolves innermost, and
+    an out-of-order exit (interleaved generators) removes the right
+    entry, not whatever is on top."""
+    default = obs.current_registry()
+    r1 = MetricsRegistry(enabled=True)
+    r2 = MetricsRegistry(enabled=True)
+    cm1 = obs.bound_registry(r1)
+    cm1.__enter__()
+    assert obs.current_registry() is r1
+    cm2 = obs.bound_registry(r2)
+    cm2.__enter__()
+    assert obs.current_registry() is r2
+    # r1's context exits FIRST (its generator finished while r2's is
+    # still live): r2 must stay the current binding
+    cm1.__exit__(None, None, None)
+    assert obs.current_registry() is r2
+    cm2.__exit__(None, None, None)
+    assert obs.current_registry() is default
+
+
+def test_worker_thread_binds_into_captured_registry(make_batch, registry):
+    """An instrument bound FROM another thread inside bound_registry's
+    capture (the prefetch-worker re-entry pattern) lands in the captured
+    registry, not the thread's default."""
+    captured = MetricsRegistry(enabled=True)
+    bound = {}
+
+    def worker(reg):
+        with obs.bound_registry(reg):
+            bound["c"] = obs.counter("dnz_op_rows_in_total", op="capture")
+
+    t = threading.Thread(target=worker, args=(captured,))
+    t.start()
+    t.join(timeout=10)
+    assert bound["c"] is captured.counter(
+        "dnz_op_rows_in_total", op="capture"
+    )
+    assert registry.instruments() == []
+
+
+def test_exporters_scope_to_query_registry(make_batch, registry, tmp_path):
+    """A query's JSONL exporter snapshots the registry THAT query
+    resolved — a disabled query with an exporter writes empty metric
+    snapshots instead of leaking whatever the process default holds."""
+    registry.counter("dnz_op_rows_in_total", op="preexisting").add(7)
+    jsonl = tmp_path / "obs.jsonl"
+    ctx = Context(EngineConfig(
+        min_batch_bucket=256,
+        metrics_enabled=False,
+        metrics_jsonl_path=str(jsonl),
+        metrics_jsonl_interval_s=0.05,
+    ))
+    src = MemorySource.from_batches(
+        _batches(make_batch), timestamp_column="occurred_at_ms"
+    )
+    ctx.from_source(src).window(
+        [col("sensor_name")], [F.count(col("reading")).alias("c")], 1000
+    ).collect()
+    from denormalized_tpu.obs.jsonl import read_stream
+
+    snaps = read_stream(jsonl)
+    assert snaps  # the exporter ran (final snapshot on clean stop)
+    assert all(s["metrics"] == {} for s in snaps), (
+        "disabled query's exporter leaked another registry's series"
+    )

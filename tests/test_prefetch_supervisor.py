@@ -294,3 +294,49 @@ def test_supervisor_metrics_visible_in_source_exec(broker):
     assert m["prefetch_restarts"] == 1
     assert m["prefetch_restarted_partitions"] == 1
     assert m["prefetch_last_errors"], m
+
+
+def test_get_live_raises_on_sentinelless_dead_worker():
+    """Liveness backstop (PR-7): a worker thread that died WITHOUT its
+    end-of-stream sentinel must surface as a structured SourceError from
+    the consumer's queue wait, never an unbounded block — every live
+    worker guarantees an item at least per read-timeout, so a starved
+    queue plus a dead sentinel-less thread can never heal."""
+    from denormalized_tpu.runtime.prefetch import PrefetchPump
+
+    pump = PrefetchPump([object()], queue_budget=4)
+    w = pump.workers[0]
+    # simulate the lost-sentinel failure: a thread object that ran and
+    # died without w.finished / the sentinel ever being set
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    w._thread = t
+    assert not w.finished
+    with pytest.raises(SourceError, match="without an end-of-stream"):
+        pump.get_live(timeout_s=0.2)
+
+
+def test_get_live_keeps_waiting_while_workers_alive():
+    """Alive-but-slow workers (a long native recv) must NOT trip the
+    backstop: get_live only raises for dead sentinel-less threads."""
+    from denormalized_tpu.runtime.prefetch import PrefetchPump
+
+    pump = PrefetchPump([object()], queue_budget=4)
+    w = pump.workers[0]
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, daemon=True)
+    t.start()
+    w._thread = t
+    try:
+        # starved queue + live worker: one timeout cycle logs and waits;
+        # an item arriving on the next cycle is returned normally
+        def feed():
+            time.sleep(0.35)
+            pump._q.put((0, {"pos": 1}, None, 0.0))
+
+        threading.Thread(target=feed, daemon=True).start()
+        idx, snap, b = pump.get_live(timeout_s=0.15)
+        assert idx == 0 and snap == {"pos": 1} and b is None
+    finally:
+        stop.set()

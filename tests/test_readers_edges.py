@@ -1,0 +1,140 @@
+"""obs/readers.py edge coverage (the satellite's two named untested
+edges): multi-process histogram merge over DISJOINT bucket layouts, and
+JSONL snapshot streams truncated mid-line by a crash during write."""
+
+import json
+
+from denormalized_tpu.obs.readers import (
+    last_stats,
+    merge_histogram,
+    quantile_from_buckets,
+    read_stream,
+)
+
+
+def _hist(bounds, values):
+    """Build the stats-dict shape the JSONL stream carries, from raw
+    observations — the writer-side layout readers must merge."""
+    counts = [0] * (len(bounds) + 1)
+    for v in values:
+        i = 0
+        while i < len(bounds) and v > bounds[i]:
+            i += 1
+        counts[i] += 1
+    return {
+        "count": len(values),
+        "sum": float(sum(values)),
+        "min": min(values),
+        "max": max(values),
+        "bounds": bounds,
+        "bucket_counts": counts,
+    }
+
+
+# -- disjoint bucket layouts ------------------------------------------------
+
+
+def test_merge_histogram_disjoint_layouts_never_mismerges():
+    """Two processes whose bucket layouts share NOTHING (a config change
+    between soak segments): the documented policy is first-layout-wins —
+    mismatched stats are skipped entirely, never added into the wrong
+    buckets, and the merged count reflects only what actually merged."""
+    a = _hist([1.0, 2.0, 4.0], [0.5, 1.5, 3.0, 3.5])
+    b = _hist([100.0, 200.0, 400.0], [150.0, 250.0])  # disjoint layout
+    merged = merge_histogram([a, b])
+    assert merged["count"] == a["count"]  # b skipped, not mis-merged
+    assert merged["sum"] == a["sum"]
+    assert merged["max"] == a["max"]  # 3.5, NOT b's 250
+    assert merged["p99"] <= a["max"]
+    # order decides the surviving layout: b first → only b merges
+    merged_rev = merge_histogram([b, a])
+    assert merged_rev["count"] == b["count"]
+    assert merged_rev["max"] == b["max"]
+
+
+def test_merge_histogram_partial_layout_overlap_is_still_all_or_nothing():
+    """A prefix-overlapping layout (same start, different count) is a
+    DIFFERENT layout: bucket i means different bounds, so the merge must
+    skip it rather than add counts positionally."""
+    a = _hist([1.0, 2.0, 4.0], [0.5, 1.5])
+    b = _hist([1.0, 2.0], [0.5, 1.5])
+    merged = merge_histogram([a, b])
+    assert merged["count"] == 2
+    # identical layouts DO merge
+    c = _hist([1.0, 2.0, 4.0], [3.0, 8.0])
+    merged2 = merge_histogram([a, c])
+    assert merged2["count"] == 4
+    assert merged2["max"] == 8.0
+    assert merged2["min"] == 0.5
+
+
+def test_merge_histogram_empty_and_none_stats():
+    assert merge_histogram([]) is None
+    assert merge_histogram([None, {"count": 0}]) is None
+
+
+def test_quantile_from_disjoint_single_bucket_mass():
+    """All mass in one bucket (e.g. a replay offset pushing everything
+    past the top bound) degrades to a min→max interpolation."""
+    bounds = [1.0, 2.0]
+    counts = [0, 0, 5]  # all in +Inf bucket
+    q = quantile_from_buckets(bounds, counts, 5, 0.5, vmin=10.0, vmax=20.0)
+    assert 10.0 <= q <= 20.0
+
+
+# -- torn JSONL streams -----------------------------------------------------
+
+
+def _snap_line(t, metrics):
+    return json.dumps({"event": "obs", "t": t, "metrics": metrics})
+
+
+def test_read_stream_skips_line_truncated_mid_write(tmp_path):
+    """A SIGKILL mid-write leaves a torn final line: the reader must
+    keep every complete snapshot and drop only the torn tail."""
+    p = tmp_path / "obs.jsonl"
+    full1 = _snap_line(1.0, {"dnz_op_rows_in_total{op=\"w\"}": 100})
+    full2 = _snap_line(2.0, {"dnz_op_rows_in_total{op=\"w\"}": 250})
+    torn = _snap_line(3.0, {"dnz_op_rows_in_total{op=\"w\"}": 999})
+    p.write_text(full1 + "\n" + full2 + "\n" + torn[: len(torn) // 2])
+    snaps = read_stream(p)
+    assert [s["t"] for s in snaps] == [1.0, 2.0]
+    # the torn line's value never surfaces
+    assert last_stats(snaps, 'dnz_op_rows_in_total{op="w"}') == 250
+
+
+def test_read_stream_torn_line_mid_file_then_recovery(tmp_path):
+    """Crash + restart appends AFTER a torn line (the soak's kill
+    segments share one file): the torn middle line is skipped, both
+    neighbors survive."""
+    p = tmp_path / "obs.jsonl"
+    lines = [
+        _snap_line(1.0, {"a": 1}),
+        _snap_line(2.0, {"a": 2})[:20],  # torn mid-write by the kill
+        _snap_line(3.0, {"a": 3}),      # restarted child's first snapshot
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    snaps = read_stream(p)
+    assert [s["t"] for s in snaps] == [1.0, 3.0]
+
+
+def test_read_stream_truncated_to_partial_json_prefix(tmp_path):
+    """The torn tail can be a VALID-JSON prefix of a line that parses to
+    a non-obs object (e.g. cut exactly after a nested close brace) —
+    anything that is not an obs event is filtered, not crashed on."""
+    p = tmp_path / "obs.jsonl"
+    p.write_text(
+        _snap_line(1.0, {"a": 1}) + "\n"
+        + '{"event": "obs", "t": 2.0'  # torn: unparseable
+        + "\n" + '{"t": 3.0}'          # parseable but not an obs event
+        + "\n"
+    )
+    snaps = read_stream(p)
+    assert [s["t"] for s in snaps] == [1.0]
+
+
+def test_read_stream_missing_and_empty_files(tmp_path):
+    assert read_stream(tmp_path / "never_written.jsonl") == []
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    assert read_stream(p) == []

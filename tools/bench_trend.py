@@ -1,0 +1,145 @@
+"""Reader for the committed perf-trajectory artifact BENCH_HISTORY.jsonl.
+
+``bench.py --record`` (or ``BENCH_RECORD=1``) appends one JSONL line per
+bench run — config, headline rows/s, git sha, host cores, device.  This
+tool renders the machine-readable trajectory as the table the ROADMAP
+narrative used to carry by hand::
+
+    python tools/bench_trend.py               # every config
+    python tools/bench_trend.py --config simple
+    python tools/bench_trend.py --json        # machine output
+
+Stdlib-only (it runs in the jax-free soak/driver environments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_history(path: Path) -> list[dict]:
+    """All history entries, file order (oldest first).  Torn tail lines
+    (crash mid-append) are skipped, same policy as obs/readers.py."""
+    out: list[dict] = []
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            o = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(o, dict) and "value" in o:
+            out.append(o)
+    return out
+
+
+def by_config(entries: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for e in entries:
+        out.setdefault(str(e.get("config", "?")), []).append(e)
+    return out
+
+
+def _label(e: dict) -> str:
+    return str(e.get("round") or e.get("git_sha") or "?")
+
+
+def trend_rows(entries: list[dict]) -> list[dict]:
+    """Per-entry rows with delta vs the previous point of the same
+    config (the number the ROADMAP narrative quotes)."""
+    rows = []
+    prev = None
+    for e in entries:
+        v = e.get("value") or 0
+        delta = None
+        if prev:
+            delta = round((v - prev) / prev * 100.0, 1)
+        rows.append({
+            "label": _label(e),
+            "value": v,
+            "delta_pct": delta,
+            "device": e.get("device"),
+            "git_sha": e.get("git_sha"),
+            "host_cores": e.get("host_cores"),
+            "vs_baseline": e.get("vs_baseline"),
+        })
+        prev = v
+    return rows
+
+
+def render(groups: dict[str, list[dict]]) -> str:
+    lines = []
+    for config, entries in sorted(groups.items()):
+        lines.append(f"== {config} ==")
+        lines.append(
+            f"{'point':>8}  {'rows/s':>14}  {'delta':>8}  "
+            f"{'device':>6}  {'sha':>9}  {'cores':>5}"
+        )
+        for r in trend_rows(entries):
+            delta = (
+                f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None
+                else "—"
+            )
+            lines.append(
+                f"{r['label']:>8}  {r['value']:>14,}  {delta:>8}  "
+                f"{str(r['device'] or '?'):>6}  "
+                f"{str(r['git_sha'] or '?'):>9}  "
+                f"{str(r['host_cores'] or '?'):>5}"
+            )
+        first, last = entries[0], entries[-1]
+        if first.get("value"):
+            lines.append(
+                f"trajectory: {first['value']:,} → {last['value']:,} "
+                f"rows/s ({last['value'] / first['value']:.2f}x over "
+                f"{len(entries)} recorded points)"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python tools/bench_trend.py",
+        description="render the BENCH_HISTORY.jsonl perf trajectory",
+    )
+    parser.add_argument(
+        "--path",
+        default=str(Path(__file__).resolve().parent.parent
+                    / "BENCH_HISTORY.jsonl"),
+    )
+    parser.add_argument("--config", default=None,
+                        help="restrict to one bench config")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the trend rows as JSON")
+    args = parser.parse_args(argv)
+
+    entries = load_history(Path(args.path))
+    if not entries:
+        print(f"no history at {args.path}", file=sys.stderr)
+        return 1
+    groups = by_config(entries)
+    if args.config:
+        if args.config not in groups:
+            print(
+                f"no entries for config {args.config!r} "
+                f"(have: {sorted(groups)})", file=sys.stderr,
+            )
+            return 1
+        groups = {args.config: groups[args.config]}
+    if args.json:
+        print(json.dumps(
+            {c: trend_rows(e) for c, e in groups.items()}, indent=2
+        ))
+    else:
+        print(render(groups))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

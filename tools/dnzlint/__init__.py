@@ -41,6 +41,14 @@ DNZ-M001    metric-registry     an ``obs.counter/gauge/histogram`` call
                                 declared kind), a declared instrument no
                                 module binds, or a catalog entry
                                 violating the naming convention
+DNZ-M002    handoff-instruments an operator class in ``physical/`` that
+                                overrides the batch-processing path
+                                without binding the doctor's handoff
+                                instruments both ways (``_doctor_input``
+                                / ``_note_input_wait`` upstream,
+                                ``_note_batch`` busy bracket), or an
+                                ``operators.toml`` registration drifting
+                                from the tree
 ==========  ==================  =========================================
 
 Suppression is explicit and reasoned, never blanket:
@@ -83,6 +91,7 @@ RULES = {
     "DNZ-H001": "hot-loop",
     "DNZ-H002": "hash-tuple",
     "DNZ-M001": "metric-registry",
+    "DNZ-M002": "handoff-instruments",
 }
 SLUG_TO_RULE = {v: k for k, v in RULES.items()}
 
@@ -167,6 +176,7 @@ def run_all(
     *,
     baseline_path: Path | None = None,
     hotpaths_path: Path | None = None,
+    operators_path: Path | None = None,
 ) -> tuple[list[Finding], list[Finding], list[tuple]]:
     """Run every pass over the package at ``root``.
 
@@ -175,7 +185,14 @@ def run_all(
     baseline entries that matched nothing (candidates for deletion —
     reported so the baseline can only shrink honestly).
     """
-    from tools.dnzlint import excepts, faultsites, hotpath, locks, metricsreg
+    from tools.dnzlint import (
+        excepts,
+        faultsites,
+        handoff,
+        hotpath,
+        locks,
+        metricsreg,
+    )
     from tools.dnzlint.pragmas import PragmaIndex
 
     root = Path(root)
@@ -195,6 +212,7 @@ def run_all(
     findings += excepts.run(root)
     findings += faultsites.run(root)
     findings += metricsreg.run(root)
+    findings += handoff.run(root, operators_path)
     findings += hotpath.run(root, hotpaths_path)
 
     new: list[Finding] = []

@@ -1,0 +1,175 @@
+"""DNZ-M002 — operator handoff-instrument completeness.
+
+The pipeline doctor attributes bottlenecks from two per-operator
+signals: measured batch-processing time (``_obs_batch_ms`` via
+``_note_batch``) and upstream queue-wait (``_doctor_input`` /
+``_note_input_wait``).  An operator that overrides the batch-processing
+path without binding BOTH directions is silently invisible to
+attribution — its time shows up as its consumer's unexplained wait and
+the doctor names the wrong suspect.  Like DNZ-M001 for the metric
+catalog, this pass closes the loop statically, in both directions:
+
+- every operator class in ``physical/`` that overrides the
+  batch-processing path (defines a real ``run`` and consumes an input —
+  references ``self.input_op`` or merges inputs via ``spawn_pump``)
+  must (a) call ``self.bind_obs(...)`` in its constructor, (b) consume
+  input through ``self._doctor_input(...)`` or time its own merge with
+  ``self._note_input_wait(...)``, and (c) close its busy bracket with
+  ``self._note_batch(...)`` (or observe ``self._obs_batch_ms``
+  directly);
+- every such class must be registered in ``operators.toml``, and every
+  registered class must still exist — a NEW operator cannot slip in
+  unregistered (and therefore unreviewed for attribution coverage), and
+  a renamed one cannot leave the registry stale.
+
+Leaf operators (``SourceExec``) are exempt by shape: they have no
+upstream handoff — their production time is attributed from their
+consumer's input wait (obs/doctor/attribution.py), and their queue
+signals come from the prefetch pump's own instruments.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.dnzlint import Finding, rel_path
+
+PHYSICAL_REL = Path("physical")
+
+
+def _class_src_flags(cls: ast.ClassDef) -> dict:
+    """What this operator class does, by AST: which doctor hooks it
+    calls and whether it consumes an upstream input."""
+    flags = {
+        "has_run": False,
+        "run_is_stub": False,
+        "consumes_input": False,
+        "binds_obs": False,
+        "input_wait": False,
+        "note_batch": False,
+    }
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == "run"
+        ):
+            flags["has_run"] = True
+            body = [
+                n for n in node.body
+                if not isinstance(n, ast.Expr)
+                or not isinstance(n.value, ast.Constant)
+            ]
+            flags["run_is_stub"] = (
+                len(body) == 1 and isinstance(body[0], ast.Raise)
+            )
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            if node.attr == "input_op":
+                flags["consumes_input"] = True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                    if fn.attr == "bind_obs":
+                        flags["binds_obs"] = True
+                    elif fn.attr in ("_doctor_input", "_note_input_wait"):
+                        flags["input_wait"] = True
+                    elif fn.attr == "_note_batch":
+                        flags["note_batch"] = True
+                # self._obs_batch_ms.observe(...)
+                if (
+                    fn.attr == "observe"
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "_obs_batch_ms"
+                    and isinstance(fn.value.value, ast.Name)
+                    and fn.value.value.id == "self"
+                ):
+                    flags["note_batch"] = True
+            elif isinstance(fn, ast.Name) and fn.id == "spawn_pump":
+                flags["consumes_input"] = True
+    return flags
+
+
+def discover(root: Path) -> dict[str, tuple[str, int, dict]]:
+    """{class name: (rel file, lineno, flags)} for every operator class
+    in ``physical/`` that overrides the batch-processing path."""
+    phys = root / PHYSICAL_REL
+    out: dict[str, tuple[str, int, dict]] = {}
+    if not phys.is_dir():
+        return out
+    for path in sorted(phys.glob("*.py")):
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            flags = _class_src_flags(node)
+            if not flags["has_run"] or flags["run_is_stub"]:
+                continue
+            if not flags["consumes_input"]:
+                continue  # leaf operator: no upstream handoff exists
+            out[node.name] = (rel, node.lineno, flags)
+    return out
+
+
+def load_operators(path: Path) -> dict[str, str]:
+    """operators.toml -> {class: file}."""
+    from tools.dnzlint import _parse_toml
+
+    if not path.exists():
+        return {}
+    data = _parse_toml(path)
+    return {
+        e["class"]: e.get("file", "")
+        for e in data.get("operator", [])
+        if e.get("class")
+    }
+
+
+def run(root: Path, operators_path: Path | None = None) -> list[Finding]:
+    discovered = discover(root)
+    if not discovered and operators_path is None:
+        return []  # tree without a physical/ package: nothing to check
+    if operators_path is None:
+        operators_path = Path(__file__).resolve().parent / "operators.toml"
+    registered = load_operators(operators_path)
+    findings: list[Finding] = []
+    for cls, (rel, lineno, flags) in discovered.items():
+        missing = []
+        if not flags["binds_obs"]:
+            missing.append("bind_obs(...) in the constructor")
+        if not flags["input_wait"]:
+            missing.append(
+                "input via self._doctor_input(...) (or "
+                "self._note_input_wait for a merged-queue operator)"
+            )
+        if not flags["note_batch"]:
+            missing.append(
+                "a busy bracket closed by self._note_batch(...) "
+                "(or self._obs_batch_ms.observe)"
+            )
+        for m in missing:
+            findings.append(Finding(
+                "DNZ-M002", rel, lineno, cls,
+                f"operator overrides the batch-processing path but lacks "
+                f"{m} — it would be invisible to the doctor's bottleneck "
+                f"attribution",
+            ))
+        if cls not in registered:
+            findings.append(Finding(
+                "DNZ-M002", rel, lineno, cls,
+                "operator class is not registered in "
+                "tools/dnzlint/operators.toml — register it so handoff-"
+                "instrument coverage is reviewed, not assumed",
+            ))
+    for cls, file in registered.items():
+        if cls not in discovered:
+            findings.append(Finding(
+                "DNZ-M002", file or str(operators_path), 0, cls,
+                f"operators.toml registers {cls!r} but no such "
+                "input-consuming operator class exists in physical/ — "
+                "stale registration (renamed or deleted operator)",
+            ))
+    return findings
