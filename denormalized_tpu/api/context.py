@@ -171,6 +171,13 @@ class EngineConfig:
     # flamegraphs): started per query via the HTTP surface or
     # QueryHandle.start_profiler(); this sets only the sample rate
     profiler_hz: float = 100.0
+    # -- state observatory (obs/statewatch.py, docs §state observatory) -
+    # soft budget for TOTAL live keyed state across a query's stateful
+    # operators: GET /queries/<id>/state projects time-to-budget from
+    # each operator's growth ring and raises state-budget-pressure
+    # verdicts as the projection closes in.  None = no budget (growth
+    # forecasts still reported, without a time-to-budget).
+    state_budget_bytes: int | None = None
 
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a

@@ -150,6 +150,50 @@ INSTRUMENTS: dict[str, tuple] = {
         "growth means the consumer thread is the bottleneck, not ingest)",
         MS_BUCKETS,
     ),
+    # -- state observatory (obs/statewatch.py, docs/observability.md) ---
+    "dnz_state_bytes": (
+        "gauge",
+        "live bytes of keyed state held by one stateful operator "
+        "(restore-invariant accounting: exact numpy storage for live "
+        "slots/rows plus documented per-object estimates for Python "
+        "accumulators and interned keys), labeled node=<plan node id>",
+    ),
+    "dnz_state_live_keys": (
+        "gauge",
+        "keys/groups currently holding live state in one stateful "
+        "operator, labeled node=<plan node id>",
+    ),
+    "dnz_state_slots": (
+        "gauge",
+        "slot-table shape of one stateful operator, labeled node= and "
+        "kind=capacity|live — occupancy vs allocated capacity (a low "
+        "ratio means the table grew for a churn spike and has not "
+        "shrunk back)",
+    ),
+    "dnz_state_oldest_event_lag_ms": (
+        "gauge",
+        "operator watermark minus the oldest retained event time — how "
+        "far back live state reaches; sustained growth beyond a few "
+        "window/gap/retention units is the retention-leak signal",
+    ),
+    "dnz_state_hot_key_share": (
+        "gauge",
+        "estimated state-mass share of one Space-Saving-tracked hot "
+        "key (labeled node=, key=, and side= for joins); only the "
+        "current top-K are refreshed, keys that fall out read 0",
+    ),
+    "dnz_state_skew_factor": (
+        "gauge",
+        "top-1 key share x live keys for one stateful operator: ~1 on "
+        "a uniform key distribution, >>1 when one key dominates (the "
+        "adaptive-join sub-partitioning trigger signal)",
+    ),
+    "dnz_checkpoint_last_snapshot_bytes": (
+        "gauge",
+        "size of the most recent snapshot blob persisted under one "
+        "state key (framed bytes), labeled key=<node-scoped state key> "
+        "— restore-size regressions are attributable to one operator",
+    ),
     # -- fault injection (runtime/faults.py) ----------------------------
     "dnz_fault_injections_total": (
         "counter",

@@ -10,6 +10,10 @@ to ``/metrics``:
 - ``GET /queries/<id>/plan`` — the full live plan snapshot: per-node
   rows/s, batch-time share, queue depth, watermark lag, plus the ranked
   bottleneck attribution;
+- ``GET /queries/<id>/state`` — the state observatory: per-stateful-node
+  exact accounting (live bytes/keys, slot occupancy, oldest-retained
+  lag), sketch-derived hot keys + skew factor, growth forecasts with
+  time-to-budget, and ranked health verdicts;
 - ``GET /queries/<id>/lineage[?window_start_ms=&source=]`` — sampled
   record lineage chains (ingest offset → operator hops → emission);
 - ``GET|POST /queries/<id>/profile/start[?hz=]`` / ``.../profile/stop``
@@ -86,6 +90,8 @@ def _route(path: str, method: str) -> tuple[int, str, bytes] | None:
     tail = parts[2:]
     if tail == ["plan"] or tail == []:
         return _json_resp(200, handle.snapshot())
+    if tail == ["state"]:
+        return _json_resp(200, handle.state_snapshot())
     if tail == ["lineage"]:
         if handle.lineage is None:
             return _json_resp(200, {

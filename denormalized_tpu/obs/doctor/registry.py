@@ -52,6 +52,7 @@ class QueryHandle:
         self._started_mono = time.monotonic()
         self._finished_mono: float | None = None
         self._final_snapshot: dict | None = None
+        self._final_state: dict | None = None
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -77,6 +78,12 @@ class QueryHandle:
         self._finished_mono = time.monotonic()
         self.stop_profiler()
         self._final_snapshot = self._snapshot_live()
+        from denormalized_tpu.obs.doctor import statedoc
+
+        try:
+            self._final_state = statedoc.state_snapshot(self)
+        except Exception:  # dnzlint: allow(broad-except) freezing the final /state view races operator teardown by design — a finished query without a state snapshot is degraded, not broken
+            self._final_state = None
         self.root = None
         self._node_ids = {}
         with _LOCK:
@@ -175,6 +182,32 @@ class QueryHandle:
             wm = getattr(op, "_watermark", None)
         if isinstance(wm, (int, float)):
             n["watermark_lag_ms"] = round(time.time() * 1000.0 - wm, 1)
+        # state observatory columns (stateful operators only)
+        try:
+            sinfo = op._cached_state_info()
+        except Exception:  # dnzlint: allow(broad-except) accounting races operator teardown (single-writer, lock-free) — degrade to no state columns, never 500 the plan endpoint
+            sinfo = None
+        if sinfo:
+            n["state_bytes"] = int(sinfo.get("state_bytes") or 0)
+            n["state_keys"] = int(sinfo.get("live_keys") or 0)
+            n["state_slots"] = [
+                int(sinfo.get("slot_live") or 0),
+                int(sinfo.get("slot_capacity") or 0),
+            ]
+            if sinfo.get("oldest_event_lag_ms") is not None:
+                n["state_oldest_lag_ms"] = sinfo["oldest_event_lag_ms"]
+            try:
+                from denormalized_tpu.obs.statewatch import side_live_keys
+
+                skews = [
+                    w.skew_factor(side_live_keys(sinfo, s))
+                    for s, w, _r in op._state_watch_views() if w
+                ]
+                skews = [s for s in skews if s is not None]
+                if skews:
+                    n["state_skew"] = max(skews)
+            except Exception:  # dnzlint: allow(broad-except) sketch reads race the operator thread like the accounting above — skew is an optional column
+                pass
         if metrics:
             n["metrics"] = {
                 k: v for k, v in metrics.items()
@@ -216,6 +249,15 @@ class QueryHandle:
             return self._final_snapshot
         return self._snapshot_live()
 
+    def state_snapshot(self) -> dict:
+        """The state observatory's /state payload (live, or the frozen
+        final view for a finished query)."""
+        if self._final_state is not None:
+            return self._final_state
+        from denormalized_tpu.obs.doctor import statedoc
+
+        return statedoc.state_snapshot(self)
+
     # -- rendering ---------------------------------------------------------
     def render(self) -> str:
         """The annotated plan tree + named bottleneck, from the current
@@ -240,6 +282,13 @@ class QueryHandle:
                 )
             if "watermark_lag_ms" in n:
                 ann.append(f"wm_lag={n['watermark_lag_ms']:.0f}ms")
+            if "state_bytes" in n:
+                ann.append(
+                    f"state={_fmt_bytes(n['state_bytes'])}/"
+                    f"{n['state_keys']}keys"
+                )
+                if n.get("state_skew") is not None and n["state_skew"] >= 2:
+                    ann.append(f"skew={n['state_skew']:.1f}")
             lines.append(
                 "  " * depth + f"{n['node_id']}  [{', '.join(ann)}]"
             )
@@ -265,6 +314,14 @@ class QueryHandle:
                 )
         lines.append(f"rule: {ATTRIBUTION_RULE}")
         return "\n".join(lines)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"  # pragma: no cover — loop always returns
 
 
 def _safe_label(op) -> str:
@@ -307,13 +364,32 @@ def register_query(root, config=None, registry=None) -> QueryHandle | None:
     )
     # stamp every operator once: node id for attribution/lineage keying,
     # tracker for the handoff/emission hooks (base defaults are None, so
-    # un-doctored trees — direct build_physical callers — stay inert)
-    stack = [root]
-    while stack:
-        op = stack.pop()
-        op._dr_node_id = node_ids.get(id(op))
-        op._dr_lineage = lineage
-        stack.extend(getattr(op, "children", ()))
+    # un-doctored trees — direct build_physical callers — stay inert).
+    # Stateful operators also bind their state-observatory gauges here —
+    # the node id IS the series label, and it only exists now.  Binds
+    # must land in the query's resolved registry even when a caller
+    # invokes register_query outside the executor's binding context.
+    import contextlib
+
+    from denormalized_tpu import obs as _obs
+
+    bind_ctx = (
+        _obs.bound_registry(registry) if registry is not None
+        else contextlib.nullcontext()
+    )
+    with bind_ctx:
+        stack = [root]
+        while stack:
+            op = stack.pop()
+            nid = node_ids.get(id(op))
+            op._dr_node_id = nid
+            op._dr_lineage = lineage
+            if nid is not None:
+                try:
+                    op.bind_state_obs(nid)
+                except Exception:  # dnzlint: allow(broad-except) a test double subclassing ExecOperator with a partial surface must not break query registration — its state gauges simply don't bind
+                    pass
+            stack.extend(getattr(op, "children", ()))
     with _LOCK:
         _RUNNING[handle.query_id] = handle
     return handle

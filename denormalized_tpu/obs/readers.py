@@ -122,6 +122,69 @@ def merge_histogram(stats_list: list[dict]) -> dict | None:
     }
 
 
+def linear_forecast(points, budget=None) -> dict | None:
+    """Least-squares growth fit over ``[(unix_t, value), ...]`` points —
+    the state observatory's time-to-budget projection (stdlib-only so
+    the jax-free soak parent can run the same fit over a JSONL snapshot
+    history that the live doctor runs over its in-memory ring).
+
+    Returns ``None`` below two distinct-time points; otherwise a dict of
+    ``slope_bytes_per_s``, ``current_bytes`` (last observed),
+    ``window_s`` (ring span), ``r2`` (fit quality, 0..1), ``samples``,
+    and — when ``budget`` is given — ``budget_bytes`` plus
+    ``time_to_budget_s``: 0 when already at/over budget, a finite
+    projection when growing, ``None`` when flat or shrinking (never
+    reaches it on trend)."""
+    pts = [(float(t), float(v)) for t, v in points]
+    n = len(pts)
+    if n < 2 or pts[-1][0] == pts[0][0]:
+        return None
+    t0 = pts[0][0]
+    xs = [t - t0 for t, _v in pts]
+    ys = [v for _t, v in pts]
+    sx = sum(xs)
+    sy = sum(ys)
+    sxx = sum(x * x for x in xs)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    denom = n * sxx - sx * sx
+    if denom == 0:
+        return None
+    slope = (n * sxy - sx * sy) / denom
+    intercept = (sy - slope * sx) / n
+    mean = sy / n
+    ss_tot = sum((y - mean) ** 2 for y in ys)
+    ss_res = sum((y - (intercept + slope * x)) ** 2 for x, y in zip(xs, ys))
+    r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+    out = {
+        "slope_bytes_per_s": round(slope, 3),
+        "current_bytes": ys[-1],
+        "window_s": round(xs[-1], 3),
+        "r2": round(r2, 4),
+        "samples": n,
+    }
+    if budget is not None:
+        out["budget_bytes"] = budget
+        if ys[-1] >= budget:
+            out["time_to_budget_s"] = 0.0
+        elif slope > 0:
+            out["time_to_budget_s"] = round((budget - ys[-1]) / slope, 1)
+        else:
+            out["time_to_budget_s"] = None
+    return out
+
+
+def gauge_series(snapshots: list[dict], series: str) -> list[tuple]:
+    """``[(t, value), ...]`` of one scalar gauge series across a JSONL
+    snapshot stream — the offline feed for :func:`linear_forecast`."""
+    out = []
+    for snap in snapshots:
+        v = snap.get("metrics", {}).get(series)
+        t = snap.get("t")
+        if t is not None and isinstance(v, (int, float)):
+            out.append((t, v))
+    return out
+
+
 def counter_timeline(snapshots: list[dict], prefix: str) -> list[dict]:
     """Per-interval increments of every counter series starting with
     ``prefix``, as ``[{"t": <s>, "series": ..., "delta": n}, ...]`` —

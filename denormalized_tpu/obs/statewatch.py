@@ -1,0 +1,518 @@
+"""State & skew observatory — the measurement layer under every
+stateful operator.
+
+The third operator question after "which stage is slow" (PR 7) and
+"what are my latencies" (PR 6) is "how big is my state, which keys are
+hot, and when do I OOM".  This module answers it with three parts:
+
+1. **Exact state accounting** — every stateful operator
+   (window/session/join/udaf, the interners' free lists, the LSM
+   backend) implements ``state_info()``: live bytes, live keys,
+   slot-table capacity vs occupancy, and oldest-retained-event-time.
+   Accounting is PULL-ONLY (computed when a snapshot/export asks), so
+   it costs the hot path nothing; the registry view binds in
+   ``ExecOperator.bind_state_obs`` via weakref'd gauge_fns — the same
+   no-graph-pinning rule ``dnz_decode_fallback_rows`` established.
+
+2. **Streaming key-distribution sketches** — a vectorized Space-Saving
+   heavy-hitter sketch (:class:`SpaceSaving`) and a HyperLogLog
+   cardinality estimator (:class:`Hll`), both fed DENSE GIDS in batch
+   right after intern time.  Updates are pure numpy (bucketed
+   ``np.unique`` + scatter adds; pinned loop-free in ``hotpaths.toml``)
+   so the 49M rows/s hot path pays microseconds per batch, not per-row
+   Python.  Accuracy bounds (documented in docs/observability.md):
+
+   - Space-Saving with K slots overestimates a key's count by at most
+     its reported ``err`` (the count of the slot it evicted); any key
+     with true share > 1/K is guaranteed tracked.  The batch variant
+     admits the ``min(K, new-keys)`` largest newcomers per batch and
+     folds the remainder into ``total`` only — same overestimate
+     guarantee, slightly looser tail recall than item-at-a-time.
+   - HLL with ``2**p`` registers has standard error
+     ``1.04 / sqrt(2**p)`` (p=12 → ~1.6%).
+   - Sketches are keyed by dense gid: for non-recycling interners
+     (window/join/udaf) a gid IS one key for the interner's lifetime;
+     a join/udaf re-intern resets the sketch (it re-warms from live
+     traffic).  The session interner RECYCLES closed keys' gids, so a
+     long-closed key's residual sketch mass can alias onto the key
+     that inherits its id — bounded by ``err`` and washed out by the
+     next refresh cycle; hot keys, by definition, keep their gid.
+   - Sketches do NOT ride checkpoints: after a restore they re-warm
+     from live traffic (a few seconds of feed at soak rates).  Exact
+     accounting is recomputed from restored state and therefore
+     matches the pre-kill values immediately (pinned by
+     tests/test_statewatch.py).
+
+3. **Growth forecasting** — each watch keeps a bounded ring of
+   (wall time, state bytes) samples, appended whenever an exporter or
+   the doctor's ``/state`` endpoint reads the state-bytes gauge.  A
+   least-squares fit over the ring projects time-to-budget against
+   ``EngineConfig(state_budget_bytes=...)``; the fit itself lives in
+   :func:`obs.readers.linear_forecast` (stdlib-only, so the jax-free
+   soak parent can run the same fit over a JSONL snapshot history).
+
+Health verdicts (``skewed-join-side``, ``unbounded-session-growth``,
+``retention-leak``) are ranked by the doctor from these signals — see
+:mod:`denormalized_tpu.obs.doctor.statedoc`.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+
+import numpy as np
+
+from denormalized_tpu.obs.readers import linear_forecast
+
+__all__ = [
+    "SpaceSaving", "Hll", "StateWatch", "NULL_WATCH", "arrays_nbytes",
+    "linear_forecast",
+]
+
+
+def arrays_nbytes(*arrays) -> int:
+    """Total nbytes of the given numpy arrays (None entries skipped)."""
+    return sum(int(a.nbytes) for a in arrays if a is not None)
+
+
+#: documented per-object estimates for state that lives in Python
+#: objects (accounting for them exactly would mean walking user object
+#: graphs on every export).  Being CONSTANTS makes the accounting
+#: restore-invariant: bytes derive only from live counts, so the
+#: pre-kill and post-restore numbers are identical by construction.
+KEY_EST_BYTES = 64  # one interned key: dict entry + row tuple + id
+ACC_EST_BYTES = 512  # one accumulator object (UDAF/builtin, amortized)
+OBJ_CELL_EST_BYTES = 56  # one object-dtype cell (string ref + header)
+
+
+def side_live_keys(info: dict, side) -> int:
+    """Live keys of ONE watch view: the side's own count for a join
+    ('left'/'right'), the node total otherwise.  Every skew-factor
+    consumer must use this — a per-side sketch's top-1 share multiplied
+    by the COMBINED both-sides key count would read ~2 on a perfectly
+    uniform join and flag it skewed."""
+    if side is not None:
+        return int(
+            info.get("sides", {}).get(side, {}).get("live_keys") or 0
+        )
+    return int(info.get("live_keys") or 0)
+
+
+def rb_nbytes(batch) -> int:
+    """Accounting bytes of one RecordBatch: exact nbytes for numeric
+    columns and masks, the documented per-cell estimate for object
+    (string) columns."""
+    import numpy as _np
+
+    total = 0
+    for name in batch.schema.names:
+        col = _np.asarray(batch.column(name))
+        if col.dtype == object:
+            total += len(col) * OBJ_CELL_EST_BYTES
+        else:
+            total += int(col.nbytes)
+        m = batch.mask(name)
+        if m is not None:
+            total += int(_np.asarray(m).nbytes)
+    return total
+
+
+#: rows per sketch update: batches beyond this update through a
+#: CONTIGUOUS block sample whose start rotates across updates, with
+#: counts rescaled to row units.  16k samples put the sampling error on
+#: a heavy hitter's share around +-1% — far below the Space-Saving slot
+#: guarantee — while capping the per-batch cost at ~0.1ms regardless of
+#: how large source coalescing makes a batch.
+SKETCH_ROW_CAP = 16_384
+
+
+def _aggregate_gids(g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(unique gids, per-gid counts) of one batch.  Dense gid spaces
+    (the normal case — interners hand out consecutive ids) take the
+    O(n + max_gid) bincount path instead of the O(n log n) sort that
+    ``np.unique`` costs; the sketch update must stay microseconds at
+    8k-row batches (the run_obs_overhead gate covers it)."""
+    mx = int(g.max())
+    if mx < 4 * len(g) + 1024:
+        bc = np.bincount(g)
+        u = np.nonzero(bc)[0]
+        return u, bc[u]
+    u, c = np.unique(g.astype(np.int64, copy=False), return_counts=True)
+    return u, c
+
+
+# -- Space-Saving heavy hitters ------------------------------------------
+
+
+class SpaceSaving:
+    """Vectorized Space-Saving (Metwally et al.) over dense int gids.
+
+    K slots of (key, count, err).  ``update`` aggregates the batch with
+    one ``np.unique`` and applies hits as a scatter-add; new keys
+    replace the lowest-count slots, inheriting the evicted count as
+    their error bound — ``count - err <= true count <= count`` for
+    every tracked key.  All numpy, no per-row Python (pinned by
+    DNZ-H001 via hotpaths.toml).
+    """
+
+    __slots__ = ("keys", "counts", "errs", "total")
+
+    def __init__(self, capacity: int = 64) -> None:
+        k = max(int(capacity), 8)
+        self.keys = np.full(k, -1, dtype=np.int64)
+        self.counts = np.zeros(k, dtype=np.int64)
+        self.errs = np.zeros(k, dtype=np.int64)
+        self.total = 0  # rows ever fed (the share denominator)
+
+    def update(self, gids: np.ndarray) -> None:
+        g = np.asarray(gids, dtype=np.int64)
+        if len(g) == 0:
+            return
+        self.update_aggregated(*_aggregate_gids(g), len(g))
+
+    def update_aggregated(
+        self, u: np.ndarray, c: np.ndarray, rows: int
+    ) -> None:
+        """Batch update from pre-aggregated (unique gids, counts) —
+        the shape :func:`_aggregate_gids` produces once per batch so the
+        HLL can share the same reduction."""
+        self.total += int(rows)
+        k = self.keys
+        order = np.argsort(k, kind="stable")
+        ks = k[order]
+        pos = np.minimum(np.searchsorted(ks, u), len(ks) - 1)
+        hit = ks[pos] == u
+        np.add.at(self.counts, order[pos[hit]], c[hit])
+        miss = ~hit
+        if miss.any():
+            mu = u[miss]
+            mc = c[miss]
+            # largest newcomers first when more new keys than slots
+            mo = np.argsort(-mc, kind="stable")
+            take = min(len(mu), len(k))
+            mu = mu[mo[:take]]
+            mc = mc[mo[:take]]
+            victims = np.argsort(self.counts, kind="stable")[:take]
+            base = self.counts[victims]
+            # admission guard: sequential Space-Saving only ever evicts
+            # the MINIMUM slot, whose count stays near the smallest base
+            # as it churns — so a newcomer may only take a victim whose
+            # count is within its own batch mass of that minimum.
+            # Without this, a batch with >= K new keys would pair its
+            # smallest newcomer against the LARGEST victim and evict a
+            # genuine heavy hitter (caught by the skew smoke test).
+            ok = base <= base[0] + mc
+            if not ok.all():
+                victims = victims[ok]
+                mu = mu[ok]
+                mc = mc[ok]
+                base = base[ok]
+            self.keys[victims] = mu
+            self.errs[victims] = base
+            self.counts[victims] = base + mc
+
+    def top(self, k: int = 8) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(gids, counts, errs) of the top-k tracked keys, count-desc."""
+        live = np.nonzero(self.keys >= 0)[0]
+        if len(live) == 0:
+            e = np.empty(0, dtype=np.int64)
+            return e, e.copy(), e.copy()
+        order = live[np.argsort(-self.counts[live], kind="stable")][:k]
+        return (
+            self.keys[order].copy(),
+            self.counts[order].copy(),
+            self.errs[order].copy(),
+        )
+
+    def reset(self) -> None:
+        """Drop all tracked keys (a re-intern invalidated the gid space);
+        the sketch re-warms from subsequent traffic."""
+        self.keys.fill(-1)
+        self.counts.fill(0)
+        self.errs.fill(0)
+        self.total = 0
+
+
+# -- HyperLogLog cardinality ---------------------------------------------
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer, vectorized (uint64 wraparound arithmetic)."""
+    z = x + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+class Hll:
+    """HyperLogLog over dense int gids; standard error 1.04/sqrt(2**p).
+
+    The register update is one vectorized hash + scatter-max.  The rank
+    (leading-zero count) of the low ``64-p`` bits comes from
+    ``floor(log2)`` on float64 — exact ONLY while ``64-p <= 52`` bits
+    fit the double mantissa, so p is restricted to >= 12 (a 56-bit word
+    at p=8 can round up across a power of two and bias a register low).
+    Default p=12: 4096 one-byte registers, ~1.6% standard error.
+    """
+
+    __slots__ = ("p", "m", "registers", "_wmask", "_alpha")
+
+    def __init__(self, p: int = 12) -> None:
+        if not 12 <= p <= 16:
+            raise ValueError(
+                "Hll precision p must be in [12, 16] (the float64 "
+                "log2 rank is only exact for 64-p <= 52 bits)"
+            )
+        self.p = p
+        self.m = 1 << p
+        self.registers = np.zeros(self.m, dtype=np.uint8)
+        self._wmask = np.uint64((1 << (64 - p)) - 1)
+        self._alpha = 0.7213 / (1.0 + 1.079 / self.m)
+
+    def update(self, gids: np.ndarray) -> None:
+        g = np.asarray(gids)
+        if len(g) == 0:
+            return
+        h = _mix64(g.astype(np.uint64))
+        idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
+        w = h & self._wmask
+        width = 64 - self.p
+        rho = np.full(len(h), width + 1, dtype=np.uint8)
+        nz = w > np.uint64(0)
+        rho[nz] = (
+            width - np.floor(np.log2(w[nz].astype(np.float64)))
+        ).astype(np.uint8)
+        np.maximum.at(self.registers, idx, rho)
+
+    def estimate(self) -> float:
+        regs = self.registers.astype(np.float64)
+        est = self._alpha * self.m * self.m / float(np.sum(np.exp2(-regs)))
+        zeros = int(np.count_nonzero(self.registers == 0))
+        if est <= 2.5 * self.m and zeros:
+            # small-range (linear counting) correction
+            return self.m * math.log(self.m / zeros)
+        return est
+
+    def reset(self) -> None:
+        self.registers.fill(0)
+
+
+# -- the per-operator watch ----------------------------------------------
+
+
+#: minimum seconds between two growth-ring samples (a Prometheus scrape
+#: and a JSONL snapshot racing each other must not double-enter a point)
+_SAMPLE_MIN_INTERVAL_S = 0.2
+
+#: growth-ring depth: at the 1 s JSONL cadence this is ~8.5 minutes of
+#: history — enough for a stable fit, bounded regardless of run length
+_SAMPLE_RING = 512
+
+
+class StateWatch:
+    """One stateful operator's (or one join side's) sketch + growth set.
+
+    Created unconditionally at operator construction; ``enabled``
+    resolves from the bound registry's enabledness so the metrics-off
+    path pays one attribute check per batch and nothing else (the exact
+    accounting is pull-only and works either way)."""
+
+    __slots__ = (
+        "label", "enabled", "sketch", "hll", "update_s", "update_batches",
+        "samples", "_last_sample_t", "_hot_bound", "_sample_phase",
+    )
+
+    def __init__(self, label: str, *, capacity: int = 64,
+                 enabled: bool = True) -> None:
+        self.label = label
+        self.enabled = bool(enabled)
+        self.sketch = SpaceSaving(capacity)
+        self.hll = Hll()
+        self.update_s = 0.0  # cumulative sketch-update cost (bench reports)
+        self.update_batches = 0
+        self.samples: deque = deque(maxlen=_SAMPLE_RING)
+        self._last_sample_t = 0.0
+        self._sample_phase = 0
+        # hot-key gauge handles by key label (stale ones are zeroed, not
+        # unbound — the registry has no eviction by design)
+        self._hot_bound: dict = {}
+
+    def __bool__(self) -> bool:
+        return True
+
+    # -- hot path --------------------------------------------------------
+    def update(self, gids: np.ndarray) -> None:
+        """Feed one batch's dense gids (call right after intern).  One
+        shared per-gid aggregation feeds both sketches: the Space-Saving
+        update works on (uniques, counts), and distinct-value sketches
+        only care about the uniques, so the HLL hashes those — not the
+        full batch.  Batches beyond SKETCH_ROW_CAP update through a
+        CONTIGUOUS block sample whose start rotates across updates
+        (counts scaled back to row units): contiguous keeps the memory
+        traffic at one block regardless of batch size, rotation keeps
+        the coverage uniform across the stream even when keys cluster
+        within a batch."""
+        n = len(gids)
+        if not self.enabled or n == 0:
+            return
+        t0 = time.perf_counter()
+        g = gids if isinstance(gids, np.ndarray) else np.asarray(gids)
+        sampled = False
+        if n > SKETCH_ROW_CAP:
+            sampled = True
+            # wrap the phase over the VALID start range [0, n - CAP], not
+            # back to 0: constant-size batches would otherwise alternate
+            # start 0 -> CAP -> 0 and never sample the tail rows past the
+            # last full block (a partition appended last by coalescing
+            # would be permanently invisible to the sketch)
+            start = self._sample_phase % (n - SKETCH_ROW_CAP + 1)
+            self._sample_phase = start + SKETCH_ROW_CAP
+            g = g[start:start + SKETCH_ROW_CAP]
+        u, c = _aggregate_gids(g)
+        if sampled:
+            # rescale by the TRUE sampling ratio (n / sample size), not
+            # an integer ceiling: a 17k-row batch samples 16384 rows at
+            # ratio ~1.04 — a ceil(17000/16384)=2 multiplier would
+            # double every share and falsely trip skew verdicts
+            c = np.rint(c * (n / len(g))).astype(np.int64)
+        self.sketch.update_aggregated(u, c, n)
+        self.hll.update(u)
+        self.update_s += time.perf_counter() - t0
+        self.update_batches += 1
+
+    def reset_sketches(self) -> None:
+        """A re-intern replaced the gid space: old gids no longer name
+        the same keys, so the sketches restart (documented re-warm)."""
+        self.sketch.reset()
+        self.hll.reset()
+
+    # -- growth ring -----------------------------------------------------
+    def record_sample(self, bytes_now: float, t: float | None = None) -> None:
+        """Append one (wall time, state bytes) growth point; rate-limited
+        so concurrent exporters don't double-sample.  Called from the
+        state-bytes gauge_fn (export-driven history) and from the
+        doctor's /state snapshots."""
+        now = time.time() if t is None else t
+        if now - self._last_sample_t < _SAMPLE_MIN_INTERVAL_S:
+            return
+        self._last_sample_t = now
+        self.samples.append((now, float(bytes_now)))
+
+    def forecast(self, budget_bytes: int | None = None) -> dict | None:
+        """Least-squares growth fit over the sample ring (None until two
+        samples exist)."""
+        return linear_forecast(list(self.samples), budget=budget_bytes)
+
+    # -- distribution summaries -----------------------------------------
+    def hot_keys(self, k: int = 8, resolve=None) -> list[dict]:
+        """Top-k tracked keys: ``[{key, rows, err_rows, share}]``, share
+        = tracked rows / total rows fed (the key's state-mass share for
+        row-proportional state).  ``resolve(gids) -> list[str]`` maps
+        dense gids to display keys; unresolvable gids (recycled/closed)
+        render as ``gid:<n>``."""
+        gids, counts, errs = self.sketch.top(k)
+        total = max(self.sketch.total, 1)
+        names = None
+        if resolve is not None and len(gids):
+            try:
+                names = resolve(gids)
+            except Exception:  # dnzlint: allow(broad-except) a hot gid may have been released/re-interned between sketch update and resolution — degrade to the numeric gid label, never take the state endpoint down
+                names = None
+        out = []
+        for i in range(len(gids)):
+            name = (
+                str(names[i]) if names is not None and names[i] is not None
+                else f"gid:{int(gids[i])}"
+            )
+            out.append({
+                "key": name,
+                "rows": int(counts[i]),
+                "err_rows": int(errs[i]),
+                "share": round(int(counts[i]) / total, 6),
+            })
+        return out
+
+    def skew_factor(self, live_keys: int) -> float | None:
+        """top-1 share x live keys: ~1 for a uniform distribution, >> 1
+        when one key dominates (the PanJoin hot-key trigger signal)."""
+        _gids, counts, _errs = self.sketch.top(1)
+        if len(counts) == 0 or self.sketch.total == 0 or live_keys <= 0:
+            return None
+        return round(
+            int(counts[0]) / self.sketch.total * live_keys, 3
+        )
+
+    def distinct_estimate(self) -> int:
+        return int(round(self.hll.estimate()))
+
+    def summary(self, live_keys: int = 0, resolve=None, k: int = 8) -> dict:
+        """The sketch block of one node's /state payload."""
+        return {
+            "hot_keys": self.hot_keys(k, resolve=resolve),
+            "skew_factor": self.skew_factor(live_keys),
+            "distinct_gids_estimate": self.distinct_estimate(),
+            "sketch_rows": self.sketch.total,
+            "sketch_update_ms_total": round(self.update_s * 1e3, 3),
+            "sketch_update_batches": self.update_batches,
+            "enabled": self.enabled,
+        }
+
+
+class _NullWatch:
+    """Falsy no-op watch (metrics-disabled path).  Exact accounting is
+    unaffected (it never routes through the watch); sketches and the
+    growth ring are simply off."""
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def update(self, gids) -> None:
+        pass
+
+    def reset_sketches(self) -> None:
+        pass
+
+    def record_sample(self, bytes_now, t=None) -> None:
+        pass
+
+    def forecast(self, budget_bytes=None):
+        return None
+
+    def hot_keys(self, k=8, resolve=None):
+        return []
+
+    def skew_factor(self, live_keys):
+        return None
+
+    def distinct_estimate(self) -> int:
+        return 0
+
+    def summary(self, live_keys=0, resolve=None, k=8) -> dict:
+        return {
+            "hot_keys": [], "skew_factor": None,
+            "distinct_gids_estimate": 0, "sketch_rows": 0,
+            "sketch_update_ms_total": 0.0, "sketch_update_batches": 0,
+            "enabled": False,
+        }
+
+    update_s = 0.0
+    update_batches = 0
+    samples: deque = deque()
+
+
+NULL_WATCH = _NullWatch()
+
+
+def make_watch(label: str, *, capacity: int = 64):
+    """A live :class:`StateWatch` when the currently bound registry has
+    metrics enabled, else the shared falsy null — the same
+    resolve-at-construction rule every obs handle follows."""
+    from denormalized_tpu import obs
+
+    if obs.enabled():
+        return StateWatch(label, capacity=capacity)
+    return NULL_WATCH

@@ -281,6 +281,53 @@ class ColumnInterner:
             }
 
 
+def format_key_tuple(vals) -> str:
+    """Canonical display string for one composite key — the ONE
+    formatting rule every hot-key label uses (engine interners and the
+    reference oracle's seq-id map must render identically or
+    differential hot-key comparisons break)."""
+    return (
+        str(vals[0]) if len(vals) == 1
+        else "(" + ", ".join(str(v) for v in vals) + ")"
+    )
+
+
+def display_keys(interner, gids) -> list:
+    """Best-effort display strings for dense gids, None for released or
+    out-of-range ids — the state observatory's hot-key resolution (a
+    heavy-hitter sketch can briefly hold a gid the recycling interner
+    already released; that key's state is gone, so rendering the raw
+    gid is the honest answer)."""
+    gl = np.asarray(gids, dtype=np.int64)
+    out: list = [None] * len(gl)
+    rows = interner._gid_rows
+    ok = [
+        i for i, g in enumerate(gl.tolist())
+        if 0 <= g < len(rows) and rows[g] is not None
+    ]
+    if not ok:
+        return out
+    cols = interner.keys_of(gl[ok])
+    for j, i in enumerate(ok):
+        out[i] = format_key_tuple([c[j] for c in cols])
+    return out
+
+
+def interner_accounting(interner) -> dict:
+    """Free-list / id-space accounting shared by both interner classes
+    (the state observatory's key-capacity view): live ids, total dense
+    id space, and the recycling free-list depth (0 for the
+    non-recycling :class:`GroupInterner`)."""
+    free = len(getattr(interner, "_free", ()))
+    return {
+        "live_keys": len(interner),
+        "key_capacity": getattr(
+            interner, "capacity", len(interner._gid_rows)
+        ),
+        "free_gids": free,
+    }
+
+
 def _dedup_rows(per_col: list[np.ndarray]) -> tuple[list[tuple], np.ndarray]:
     """Shared composite-key dedup: per-column id arrays → (unique row
     tuples, inverse indices).  2 columns pack into one int64 for a 1-D

@@ -199,6 +199,43 @@ class SessionTable:
         self.free(np.asarray(slots))
         return affected[self.head[affected] == -1]
 
+    # -- accounting (obs/statewatch.py) ----------------------------------
+    def per_slot_nbytes(self) -> int:
+        """Exact bytes one slot occupies across the parallel arrays —
+        the restore-invariant unit of the session operator's live-state
+        accounting (live bytes = live slots x this; allocated capacity
+        is reported separately, it may differ across a restore)."""
+        V = self.num_value_cols
+        return int(
+            self.start.itemsize
+            + self.last.itemsize
+            + self.row_count.itemsize
+            + self.gid.itemsize
+            + self.link.itemsize
+            + self.live.itemsize
+            + V
+            * (
+                self.counts.itemsize
+                + self.sums.itemsize
+                + self.mins.itemsize
+                + self.maxs.itemsize
+                + self.means.itemsize
+                + self.m2s.itemsize
+            )
+        )
+
+    def capacity_nbytes(self) -> int:
+        """Actually-allocated storage (all slots, live or free, plus the
+        per-gid head index)."""
+        return sum(
+            int(a.nbytes)
+            for a in (
+                self.start, self.last, self.row_count, self.counts,
+                self.sums, self.mins, self.maxs, self.means, self.m2s,
+                self.gid, self.link, self.live, self.head,
+            )
+        )
+
     # -- scans -----------------------------------------------------------
     def live_slots(self) -> np.ndarray:
         return np.nonzero(self.live[: self._hwm])[0]

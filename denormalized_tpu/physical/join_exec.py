@@ -270,8 +270,16 @@ class StreamingJoinExec(ExecOperator):
                 )
         self._metrics = {"rows_out": 0, "evicted": 0}
         from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
 
         self.bind_obs("join")
+        # state observatory: one heavy-hitter/cardinality sketch pair
+        # PER SIDE — "which side is skewed" is the verdict that matters
+        # for adaptive sub-partitioning, and the two sides share an
+        # interner so gids are comparable but their distributions aren't
+        self._sw = statewatch.make_watch("join")
+        self._sw_right = statewatch.make_watch("join")
+        self._sides = None  # run()'s live (_SideState, _SideState) pair
         self._obs_rows_out = obs.counter("dnz_op_rows_out_total", op="join")
         # re-keying threshold (tests lower it to force the path)
         self._reintern_min = 262_144
@@ -311,6 +319,70 @@ class StreamingJoinExec(ExecOperator):
     def _label(self):
         on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
         return f"StreamingJoinExec({self.kind.value} on {on})"
+
+    # -- state observatory (obs/statewatch.py) --------------------------
+    def _side_state_info(self, side: "_SideState") -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+
+        n = side.count
+        per_row = int(
+            side.link.itemsize + side.row_bi.itemsize
+            + side.row_ri.itemsize + side.row_gid.itemsize + 1  # matched
+        )
+        batch_bytes = sum(swm.rb_nbytes(b) for b in side.batches)
+        live_k = int(np.count_nonzero(side.head >= 0))
+        oldest = min(side.batch_max_ts) if side.batch_max_ts else None
+        return {
+            "rows": n,
+            "batches": len(side.batches),
+            "state_bytes": (
+                batch_bytes + n * per_row + live_k * swm.KEY_EST_BYTES
+            ),
+            "live_keys": live_k,
+            "oldest_event_ms": oldest,
+            "watermark_ms": side.watermark,
+        }
+
+    def state_info(self) -> dict:
+        sides = self._sides
+        if sides is None:
+            return {
+                "op": "join", "state_bytes": 0, "live_keys": 0,
+                "slot_capacity": 0, "slot_live": 0,
+                "retention_unit_ms": self.retention_ms,
+            }
+        L = self._side_state_info(sides[0])
+        R = self._side_state_info(sides[1])
+        wms = [s["watermark_ms"] for s in (L, R) if s["watermark_ms"] is not None]
+        olds = [s["oldest_event_ms"] for s in (L, R) if s["oldest_event_ms"] is not None]
+        info = {
+            "op": "join",
+            "state_bytes": L["state_bytes"] + R["state_bytes"],
+            "live_keys": L["live_keys"] + R["live_keys"],
+            "interner_keys_total": len(self._interner),
+            "slot_capacity": int(len(sides[0].link) + len(sides[1].link)),
+            "slot_live": L["rows"] + R["rows"],
+            "retention_unit_ms": self.retention_ms,
+            "sides": {"left": L, "right": R},
+        }
+        if wms and olds:
+            info["watermark_ms"] = min(wms)
+            info["oldest_event_ms"] = min(olds)
+            info["oldest_event_lag_ms"] = max(
+                0, int(min(wms)) - int(min(olds))
+            )
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+        from denormalized_tpu.ops.interner import display_keys
+
+        resolve = lambda g: display_keys(self._interner, g)  # noqa: E731
+        return [
+            ("left", self._sw, resolve),
+            ("right", self._sw_right, resolve),
+        ]
 
     # ------------------------------------------------------------------
     def _gids_of(self, batch: RecordBatch, names: list[str]) -> np.ndarray:
@@ -475,6 +547,10 @@ class StreamingJoinExec(ExecOperator):
         the retained batches and re-chains both sides — amortized O(rows
         retained)."""
         self._interner = GroupInterner(len(self.left_keys))
+        # the gid space just reset: old sketch entries name dead ids —
+        # restart and re-warm (documented in docs/observability.md)
+        self._sw.reset_sketches()
+        self._sw_right.reset_sketches()
         for side_id, side in enumerate(sides):
             names = self.left_keys if side_id == 0 else self.right_keys
             n = side.count
@@ -655,6 +731,7 @@ class StreamingJoinExec(ExecOperator):
         from denormalized_tpu.runtime.pump import spawn_pump
 
         sides = (_SideState(), _SideState())
+        self._sides = sides  # state observatory reads these pull-style
         if self._ckpt is not None:
             self._restore(sides)
         q: queue_mod.Queue = queue_mod.Queue(maxsize=8)
@@ -789,6 +866,7 @@ class StreamingJoinExec(ExecOperator):
                 gids = self._gids_of(
                     batch, self.left_keys if is_left else self.right_keys
                 )
+                (self._sw if is_left else self._sw_right).update(gids)
                 # insert BEFORE probing: the probe targets the OTHER side
                 # (no self-match risk) and the matched[] marks it writes for
                 # this batch's rows must not be cleared by a later insert

@@ -169,8 +169,12 @@ class SessionWindowExec(ExecOperator):
             "salvage_rows_scanned": 0,
         }
         from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
 
         self.bind_obs("session")
+        # state observatory: heavy-hitter/cardinality sketches fed dense
+        # gids per batch (falsy null when metrics are disabled)
+        self._sw = statewatch.make_watch("session")
         self._obs_late = obs.counter("dnz_late_rows_total", op="session")
         self._obs_windows = obs.counter(
             "dnz_windows_emitted_total", op="session"
@@ -195,6 +199,52 @@ class SessionWindowExec(ExecOperator):
             f"SessionWindowExec(gap={self.gap_ms}ms, "
             f"groups=[{', '.join(g.name for g in self.group_exprs)}])"
         )
+
+    # -- state observatory (obs/statewatch.py) --------------------------
+    def state_info(self) -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+        from denormalized_tpu.ops.interner import interner_accounting
+
+        T = self._table
+        live = T.live_slots()
+        n_live = int(len(live))
+        acc_objs = (
+            sum(len(v) for v in T.accs.values()) if T.accs else 0
+        )
+        keys = interner_accounting(self._interner)
+        wm = self._watermark
+        oldest = int(T.start[live].min()) if n_live else None
+        info = {
+            "op": "session",
+            # live accounting only (restore-invariant by construction):
+            # exact numpy storage per live slot + documented per-object
+            # estimates for interned keys and accumulator objects
+            "state_bytes": (
+                n_live * T.per_slot_nbytes()
+                + keys["live_keys"] * swm.KEY_EST_BYTES
+                + acc_objs * swm.ACC_EST_BYTES
+            ),
+            "capacity_bytes": T.capacity_nbytes(),
+            "slot_capacity": int(len(T.start)),
+            "slot_live": n_live,
+            "acc_objects": acc_objs,
+            "oldest_event_ms": oldest,
+            "watermark_ms": wm,
+            "retention_unit_ms": self.gap_ms,
+            **keys,
+        }
+        if wm is not None and oldest is not None:
+            info["oldest_event_lag_ms"] = max(0, int(wm) - oldest)
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+        from denormalized_tpu.ops.interner import display_keys
+
+        return [
+            (None, self._sw, lambda g: display_keys(self._interner, g))
+        ]
 
     # ------------------------------------------------------------------
     def _make_accs(self) -> list | None:
@@ -260,6 +310,7 @@ class SessionWindowExec(ExecOperator):
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         key_cols = [g.eval(batch) for g in self.group_exprs]
         gids = self._interner.intern(key_cols)
+        self._sw.update(gids)
         self._table.ensure_gids(self._interner.capacity)
         vals = (
             np.stack(
@@ -673,6 +724,11 @@ class SessionWindowExec(ExecOperator):
     def _restore_sessions(self, entries: list) -> None:
         self._interner = RecyclingGroupInterner(len(self.group_exprs))
         self._table = SessionTable(len(self._value_exprs))
+        # sketches do NOT ride the snapshot: the gid space is reassigned
+        # here, so they restart and re-warm from live traffic (accuracy
+        # note in docs/observability.md); exact accounting is recomputed
+        # from the restored table and matches pre-kill immediately
+        self._sw.reset_sketches()
         if not entries:
             return
         key_cols = []

@@ -131,8 +131,15 @@ class ReferenceSessionWindowExec(ExecOperator):
         self._ckpt: tuple | None = None
         self._metrics = {"rows_in": 0, "sessions_emitted": 0, "late_rows": 0}
         from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
 
         self.bind_obs("session_ref")
+        # state observatory: the oracle operator has no interner, so it
+        # assigns its own sequential key ids for the sketches (per-row
+        # Python is this operator's nature — it is the slow reference)
+        self._sw = statewatch.make_watch("session_ref")
+        self._sw_ids: dict = {}
+        self._sw_keys: list = []
         self._obs_late = obs.counter("dnz_late_rows_total", op="session_ref")
         self._obs_windows = obs.counter(
             "dnz_windows_emitted_total", op="session_ref"
@@ -150,6 +157,88 @@ class ReferenceSessionWindowExec(ExecOperator):
             f"SessionWindowExec(gap={self.gap_ms}ms, "
             f"groups=[{', '.join(g.name for g in self.group_exprs)}])"
         )
+
+    # -- state observatory (obs/statewatch.py) --------------------------
+    def _sw_intern_rows(self, key_cols, n: int) -> np.ndarray:
+        """Sequential key ids for the sketches (the oracle has no dense
+        interner; ids never recycle, so attribution is alias-free).
+        When keys-ever-seen dwarfs the live key population the map is
+        dropped and the sketches re-warm — the same bounded-memory
+        policy the join/udaf re-intern applies; without it a churning
+        differential soak would grow this display-only map forever."""
+        if len(self._sw_ids) > 4 * max(len(self._sessions), 1024):
+            self._sw.reset_sketches()
+            self._sw_ids = {}
+            self._sw_keys = []
+        ids = np.empty(n, dtype=np.int64)
+        d = self._sw_ids
+        keys_list = self._sw_keys
+        for i in range(n):
+            k = tuple(kc[i] for kc in key_cols)
+            j = d.get(k)
+            if j is None:
+                j = len(keys_list)
+                d[k] = j
+                keys_list.append(k)
+            ids[i] = j
+        return ids
+
+    def state_info(self) -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+
+        sessions = self._sessions
+        n_sessions = 0
+        acc_objs = 0
+        oldest = None
+        for lst in list(sessions.values()):
+            n_sessions += len(lst)
+            for s in lst:
+                if s.accs:
+                    acc_objs += len(s.accs)
+                if oldest is None or s.start < oldest:
+                    oldest = s.start
+        live_keys = len(sessions)
+        V = len(self._value_exprs)
+        # one _Session: interval + 6 per-column aggregate lists (the
+        # dict-era layout this operator preserves verbatim)
+        per_session = 96 + V * 6 * 8
+        wm = self._watermark
+        info = {
+            "op": "session_ref",
+            "state_bytes": (
+                n_sessions * per_session
+                + live_keys * swm.KEY_EST_BYTES
+                + acc_objs * swm.ACC_EST_BYTES
+            ),
+            "live_keys": live_keys,
+            "key_capacity": live_keys,
+            "free_gids": 0,
+            "slot_capacity": n_sessions,
+            "slot_live": n_sessions,
+            "acc_objects": acc_objs,
+            "oldest_event_ms": oldest,
+            "watermark_ms": wm,
+            "retention_unit_ms": self.gap_ms,
+        }
+        if wm is not None and oldest is not None:
+            info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+
+        def resolve(gids):
+            from denormalized_tpu.ops.interner import format_key_tuple
+
+            keys_list = self._sw_keys
+            return [
+                format_key_tuple(keys_list[g])
+                if 0 <= g < len(keys_list) else None
+                for g in np.asarray(gids).tolist()
+            ]
+
+        return [(None, self._sw, resolve)]
 
     # ------------------------------------------------------------------
     def _make_accs(self) -> list | None:
@@ -228,6 +317,8 @@ class ReferenceSessionWindowExec(ExecOperator):
         self._obs_rows_in.add(n)
         ts = np.asarray(batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64)
         key_cols = [np.asarray(g.eval(batch), dtype=object) for g in self.group_exprs]
+        if self._sw:
+            self._sw.update(self._sw_intern_rows(key_cols, n))
         vals = (
             np.stack(
                 [np.asarray(e.eval(batch), dtype=np.float64) for e in self._value_exprs],

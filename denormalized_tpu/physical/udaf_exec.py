@@ -164,8 +164,11 @@ class UdafWindowExec(ExecOperator):
         self._src_watermarks = False
         self._metrics = {"rows_in": 0, "windows_emitted": 0, "late_rows": 0}
         from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
 
         self.bind_obs("udaf")
+        # state observatory sketches, fed dense gids per batch
+        self._sw = statewatch.make_watch("udaf")
         self._obs_late = obs.counter("dnz_late_rows_total", op="udaf")
         self._obs_windows = obs.counter(
             "dnz_windows_emitted_total", op="udaf"
@@ -187,6 +190,61 @@ class UdafWindowExec(ExecOperator):
 
     def _label(self):
         return f"UdafWindowExec({self.window_type.value} {self.length_ms}ms)"
+
+    # -- state observatory (obs/statewatch.py) --------------------------
+    def state_info(self) -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+
+        frames = self._frames
+        groups_total = 0
+        live_gids: set[int] = set()
+        for f in list(frames.values()):
+            groups_total += len(f)
+            live_gids.update(f.keys())
+        n_aggs = len(self.aggr_exprs)
+        live_keys = len(live_gids)
+        acc_objs = groups_total * n_aggs
+        oldest = (
+            self._first_open * self.slide_ms
+            if self._first_open is not None and frames
+            else None
+        )
+        wm = self._watermark
+        info = {
+            "op": "udaf",
+            # frames hold opaque Python accumulators: counts are exact,
+            # bytes use the documented per-object estimates (restore-
+            # invariant — see docs/observability.md)
+            "state_bytes": (
+                acc_objs * swm.ACC_EST_BYTES
+                + live_keys * swm.KEY_EST_BYTES
+                + len(frames) * 64
+            ),
+            "live_keys": live_keys,
+            "slot_capacity": groups_total,
+            "slot_live": groups_total,
+            "open_windows": len(frames),
+            "acc_objects": acc_objs,
+            "retention_unit_ms": self.length_ms,
+            "oldest_event_ms": oldest,
+            "watermark_ms": wm,
+        }
+        if self._interner is not None:
+            info["interner_keys_total"] = len(self._interner)
+        if wm is not None and oldest is not None:
+            info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+        if self._interner is None:
+            return [(None, self._sw, None)]
+        from denormalized_tpu.ops.interner import display_keys
+
+        return [
+            (None, self._sw, lambda g: display_keys(self._interner, g))
+        ]
 
     def _make_accs(self) -> list:
         accs = []
@@ -238,6 +296,7 @@ class UdafWindowExec(ExecOperator):
             ).astype(np.int64)
         else:
             gids = np.zeros(n, dtype=np.int64)
+        self._sw.update(gids)
         from denormalized_tpu.logical.expr import column_validity
 
         def mask_of(e) -> np.ndarray | None:
@@ -346,6 +405,9 @@ class UdafWindowExec(ExecOperator):
             return
         from denormalized_tpu.ops.interner import GroupInterner
 
+        # the gid space is about to reset: sketch entries name dead ids
+        # after this — restart and re-warm (docs/observability.md)
+        self._sw.reset_sketches()
         old = self._interner
         new = GroupInterner(len(self.group_exprs))
         gids_sorted = sorted(live)

@@ -310,8 +310,11 @@ class StreamingWindowExec(ExecOperator):
         # registry instruments (obs subsystem), pre-bound so the per-
         # batch path is attribute adds only
         from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
 
         self.bind_obs("window")
+        # state observatory sketches, fed dense gids per batch
+        self._sw = statewatch.make_watch("window")
         self._obs_late = obs.counter("dnz_late_rows_total", op="window")
         self._obs_windows = obs.counter(
             "dnz_windows_emitted_total", op="window"
@@ -353,6 +356,66 @@ class StreamingWindowExec(ExecOperator):
             f"StreamingWindowExec({w}, groups=[{', '.join(g.name for g in self.group_exprs)}], "
             f"aggs=[{', '.join(a.name for a in self.aggr_exprs)}])"
         )
+
+    # -- state observatory (obs/statewatch.py) --------------------------
+    def state_info(self) -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+
+        spec = self._spec
+        try:
+            itemsize = int(np.dtype(spec.accum_dtype).itemsize)
+        except TypeError:
+            itemsize = 4
+        # the device ring is a DENSE allocation: its footprint IS the
+        # component-plane volume, independent of occupancy
+        device_bytes = (
+            len(spec.components)
+            * spec.window_slots
+            * spec.group_capacity
+            * itemsize
+        )
+        live_keys = (
+            len(self._interner) if self._interner is not None
+            else (1 if self._first_open is not None else 0)
+        )
+        open_windows = (
+            max(0, self._max_win_seen - self._first_open + 1)
+            if self._first_open is not None
+            else 0
+        )
+        oldest = (
+            self._first_open * self.slide_ms
+            if self._first_open is not None and open_windows
+            else None
+        )
+        wm = self._watermark_ms
+        info = {
+            "op": "window",
+            "state_bytes": device_bytes + live_keys * swm.KEY_EST_BYTES,
+            "device_state_bytes": device_bytes,
+            "live_keys": live_keys,
+            "slot_capacity": int(spec.group_capacity),
+            "slot_live": live_keys,
+            "open_windows": open_windows,
+            "window_slots": int(spec.window_slots),
+            "retention_unit_ms": self.length_ms,
+            "oldest_event_ms": oldest,
+            "watermark_ms": wm,
+        }
+        if wm is not None and oldest is not None:
+            info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+        if self._interner is None:
+            return [(None, self._sw, None)]
+        from denormalized_tpu.ops.interner import display_keys
+
+        return [
+            (None, self._sw, lambda g: display_keys(self._interner, g))
+        ]
 
     # -- capacity management --------------------------------------------
     def _grow(self, *, window_slots: int | None = None, group_capacity: int | None = None):
@@ -498,6 +561,7 @@ class StreamingWindowExec(ExecOperator):
             gid = self._interner.intern(key_cols)
         else:
             gid = np.zeros(n, dtype=np.int32)
+        self._sw.update(gid)
         self._ensure_capacity(int(win_rel64.max()))
 
         # value matrix + per-column validity: f64 only when the backend

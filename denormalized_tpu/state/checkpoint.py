@@ -419,8 +419,18 @@ class CheckpointCoordinator:
 
     # -- write side ------------------------------------------------------
     def put_snapshot(self, key: str, epoch: int, blob: bytes) -> None:
+        from denormalized_tpu import obs
+
         framed = frame_snapshot(blob)
         self._obs_snap_bytes.observe(len(framed))
+        # per-state-key last-snapshot size: the aggregate histogram says
+        # "restores got bigger", this gauge says WHICH operator's blob
+        # grew (keys embed the node id, e.g. session_3_SessionWindowExec).
+        # Bound lazily per key — binding is idempotent and runs at epoch
+        # cadence, on the operator thread that owns the series.
+        obs.gauge(
+            "dnz_checkpoint_last_snapshot_bytes", key=key
+        ).set(len(framed))
         self.backend.put(f"{key}@{epoch}", framed)
         self._epoch_keys.setdefault(epoch, []).append(key)
 

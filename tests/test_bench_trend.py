@@ -1,0 +1,91 @@
+"""bench_trend --gate: the perf trajectory as a CI gate, not just a log.
+
+Stdlib-only surface (tools/bench_trend.py runs in jax-free driver
+environments); these tests pin the gate semantics: regression beyond
+the threshold exits 2, improvement and single-record histories pass,
+cross-device records never compare against each other, and the
+committed BENCH_HISTORY.jsonl itself passes the wired lint.sh gate.
+"""
+
+import json
+from pathlib import Path
+
+from tools.bench_trend import by_config, gate, load_history, main
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _hist(tmp_path, entries):
+    p = tmp_path / "hist.jsonl"
+    p.write_text("\n".join(json.dumps(e) for e in entries) + "\n")
+    return p
+
+
+def _e(round_, value, device="cpu", config="simple"):
+    return {
+        "round": round_, "config": config, "value": value,
+        "device": device, "unit": "rows/s", "metric": "m",
+    }
+
+
+def test_gate_passes_on_improvement(tmp_path):
+    p = _hist(tmp_path, [_e("r1", 100), _e("r2", 150)])
+    assert main(["--path", str(p), "--gate", "--config", "simple"]) == 0
+
+
+def test_gate_fails_on_regression_beyond_threshold(tmp_path):
+    p = _hist(tmp_path, [_e("r1", 100), _e("r2", 80)])
+    rc = main([
+        "--path", str(p), "--gate", "--config", "simple",
+        "--max-regress-pct", "10",
+    ])
+    assert rc == 2
+
+
+def test_gate_tolerates_regression_within_threshold(tmp_path):
+    p = _hist(tmp_path, [_e("r1", 100), _e("r2", 95)])
+    rc = main([
+        "--path", str(p), "--gate", "--config", "simple",
+        "--max-regress-pct", "10",
+    ])
+    assert rc == 0
+
+
+def test_gate_single_record_passes(tmp_path):
+    p = _hist(tmp_path, [_e("r1", 100)])
+    assert main(["--path", str(p), "--gate", "--config", "simple"]) == 0
+
+
+def test_gate_never_compares_across_devices(tmp_path):
+    # a TPU point followed by a (much slower) CPU point is not a
+    # regression: the CPU point compares against the last CPU point
+    p = _hist(tmp_path, [
+        _e("r1", 90, device="cpu"),
+        _e("r2", 1000, device="tpu"),
+        _e("r3", 95, device="cpu"),
+    ])
+    assert main(["--path", str(p), "--gate", "--config", "simple"]) == 0
+
+
+def test_gate_unknown_config_errors(tmp_path):
+    p = _hist(tmp_path, [_e("r1", 100)])
+    assert main(["--path", str(p), "--gate", "--config", "nope"]) == 1
+    # --gate without --config is a usage error, not a silent pass
+    assert main(["--path", str(p), "--gate"]) == 1
+
+
+def test_gate_unit_contract():
+    rc, msg = gate([_e("r1", 100), _e("r2", 50)], 10.0, "simple")
+    assert rc == 2 and "REGRESSION" in msg
+    rc, msg = gate([], 10.0, "missing")
+    assert rc == 1
+
+
+def test_committed_history_passes_wired_gate():
+    """The exact invocation tools/lint.sh wires must pass on the
+    committed artifact — otherwise lint.sh would be red at HEAD."""
+    entries = load_history(REPO / "BENCH_HISTORY.jsonl")
+    assert entries, "committed BENCH_HISTORY.jsonl missing or empty"
+    groups = by_config(entries)
+    rc, msg = gate(groups["simple"], 25.0, "simple")
+    assert rc == 0, msg

@@ -463,6 +463,90 @@ def test_handoff_instrument_pass_fires(tmp_path):
     assert "LeafOp" not in msgs
 
 
+def test_keyed_state_pass_fires_both_directions(tmp_path):
+    """State-observatory drift pin (DNZ-M002 keyed-state extension):
+    a keyed_state=true registration without state_info()/make_watch
+    fires, and an operator that DEFINES state_info without the flag
+    fires the reverse direction; a compliant operator stays silent."""
+    root = _write_pkg(tmp_path, {
+        "physical/sops.py": """\
+            from denormalized_tpu.obs import statewatch
+
+
+            class KeyedGood:
+                def __init__(self, input_op):
+                    self.input_op = input_op
+                    self.bind_obs("kg")
+                    self._sw = statewatch.make_watch("kg")
+
+                def state_info(self):
+                    return {"state_bytes": 0}
+
+                def run(self):
+                    for item in self._doctor_input():
+                        self._note_batch(0.0, item.num_rows)
+                        yield item
+
+
+            class KeyedBare:
+                # registered keyed_state=true but binds NEITHER
+                # state-accounting instrument
+                def __init__(self, input_op):
+                    self.input_op = input_op
+                    self.bind_obs("kb")
+
+                def run(self):
+                    for item in self._doctor_input():
+                        self._note_batch(0.0, item.num_rows)
+                        yield item
+
+
+            class UnflaggedStateful:
+                # defines state_info but is NOT flagged keyed_state
+                def __init__(self, input_op):
+                    self.input_op = input_op
+                    self.bind_obs("uf")
+
+                def state_info(self):
+                    return {"state_bytes": 0}
+
+                def run(self):
+                    for item in self._doctor_input():
+                        self._note_batch(0.0, item.num_rows)
+                        yield item
+            """,
+    })
+    ops_toml = tmp_path / "sops.toml"
+    ops_toml.write_text(textwrap.dedent("""\
+        [[operator]]
+        class = "KeyedGood"
+        file = "badpkg/physical/sops.py"
+        keyed_state = true
+
+        [[operator]]
+        class = "KeyedBare"
+        file = "badpkg/physical/sops.py"
+        keyed_state = true
+
+        [[operator]]
+        class = "UnflaggedStateful"
+        file = "badpkg/physical/sops.py"
+        """))
+    new, _, _ = run_all(root, baseline_path=tmp_path / "nb.toml",
+                        hotpaths_path=tmp_path / "nh.toml",
+                        operators_path=ops_toml)
+    m2 = [f for f in new if f.rule == "DNZ-M002"]
+    msgs = {f.symbol: [g.message for g in m2 if g.symbol == f.symbol]
+            for f in m2}
+    bare = " | ".join(msgs.get("KeyedBare", []))
+    assert "state_info" in bare
+    assert "make_watch" in bare or "sketch watch" in bare
+    assert any(
+        "keyed_state" in m for m in msgs.get("UnflaggedStateful", [])
+    )
+    assert "KeyedGood" not in msgs
+
+
 def test_hotpath_loop_tolist_and_hash_fire(tmp_path):
     root = _write_pkg(tmp_path, {"hot.py": """\
         def kernel(rows):

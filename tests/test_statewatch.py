@@ -1,0 +1,887 @@
+"""State & skew observatory (obs/statewatch.py + obs/doctor/statedoc.py).
+
+Covers the ISSUE-8 acceptance surface:
+
+- sketch correctness: Space-Saving overestimate bounds, hot-key
+  survival under key churn, HLL accuracy, block-sampling scale-back;
+- exact state accounting identical before a kill and after restore for
+  BOTH session operators, the join, and the udaf operator (sketches
+  deliberately re-warm — the documented trade);
+- the integration acceptance: a deliberately skewed join feed yields a
+  ``skewed-join-side`` verdict at ``GET /queries/<id>/state`` naming
+  the correct node id and the hot key's state-mass share within sketch
+  error bounds, and a budgeted session workload produces a finite
+  time-to-budget forecast that tightens as snapshots accrue;
+- the registry/doctor surfaces: per-node dnz_state_* gauges, hot-key
+  share series, per-key checkpoint snapshot-size gauges,
+  explain_analyze state columns, and the soak telemetry derivation.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col, obs
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.api.udaf import Accumulator
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.obs import statewatch
+from denormalized_tpu.obs.doctor import statedoc
+from denormalized_tpu.obs.readers import gauge_series, linear_forecast
+from denormalized_tpu.obs.registry import MetricsRegistry
+from denormalized_tpu.obs.statewatch import Hll, SpaceSaving, StateWatch
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.lsm import close_global_state_backend
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry(enabled=True)
+    prev = obs.use_registry(reg)
+    yield reg
+    obs.use_registry(prev)
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_backend():
+    yield
+    close_global_state_backend()
+
+
+T0 = 1_700_000_000_000
+
+
+# -- sketches ---------------------------------------------------------------
+
+
+def test_space_saving_overestimate_bound():
+    """count - err <= true <= count for every tracked key (the classic
+    Space-Saving guarantee, preserved by the batch variant)."""
+    rng = np.random.default_rng(7)
+    true: dict[int, int] = {}
+    ss = SpaceSaving(32)
+    for _ in range(50):
+        batch = rng.zipf(1.5, size=500) % 200
+        for g in batch.tolist():
+            true[g] = true.get(g, 0) + 1
+        ss.update(batch.astype(np.int64))
+    gids, counts, errs = ss.top(32)
+    assert ss.total == 50 * 500
+    for g, c, e in zip(gids.tolist(), counts.tolist(), errs.tolist()):
+        t = true.get(g, 0)
+        assert t <= c, (g, t, c)
+        assert c - e <= t, (g, t, c, e)
+
+
+def test_space_saving_hot_key_survives_churn():
+    """A celebrity key must survive batches that bring more NEW keys
+    than the sketch has slots (the admission-guard regression)."""
+    for trial in range(4):
+        rng = np.random.default_rng(trial)
+        ss = SpaceSaving(64)
+        for b in range(40):
+            churn = rng.integers(b * 1000, b * 1000 + 900, 400)
+            g = np.concatenate([churn, np.full(600, 999_999)])
+            rng.shuffle(g)
+            ss.update(g)
+        gids, counts, _ = ss.top(1)
+        assert gids[0] == 999_999
+        share = counts[0] / ss.total
+        assert 0.55 <= share <= 0.65, share
+
+
+def test_space_saving_reset():
+    ss = SpaceSaving(16)
+    ss.update(np.arange(100))
+    ss.reset()
+    assert ss.total == 0
+    g, c, e = ss.top(5)
+    assert len(g) == 0
+
+
+def test_hll_accuracy():
+    h = Hll()
+    h.update(np.arange(100_000))
+    est = h.estimate()
+    assert abs(est - 100_000) / 100_000 < 0.05  # 1.04/sqrt(4096) ~ 1.6%
+    h2 = Hll()
+    h2.update(np.arange(40))
+    assert abs(h2.estimate() - 40) <= 3  # linear-counting regime
+    h2.reset()
+    assert h2.estimate() == 0 or h2.estimate() < 1
+
+
+def test_block_sampling_scales_counts_back_to_row_units():
+    """Batches beyond SKETCH_ROW_CAP sample a rotating contiguous block;
+    shares and totals must still be in true-row units."""
+    sw = StateWatch("t")
+    n = statewatch.SKETCH_ROW_CAP * 6
+    rng = np.random.default_rng(3)
+    g = rng.integers(0, 2, size=n).astype(np.int64)  # two keys, 50/50
+    sw.update(g)
+    assert sw.sketch.total == n
+    _gids, counts, _errs = sw.sketch.top(2)
+    assert counts.sum() == pytest.approx(n, rel=0.25)
+    for c in counts:
+        assert c / n == pytest.approx(0.5, abs=0.1)
+
+
+def test_block_sampling_just_over_cap_keeps_shares_bounded():
+    """Regression: a batch just over SKETCH_ROW_CAP must rescale by the
+    TRUE sampling ratio (~1.04), not an integer ceiling (2x) — the
+    ceiling inflated every share ~2x and could fabricate skew verdicts
+    (a single-key batch read share 1.93)."""
+    sw = StateWatch("t")
+    n = statewatch.SKETCH_ROW_CAP + 600
+    sw.update(np.zeros(n, dtype=np.int64))  # one key, 100% of rows
+    _g, counts, _e = sw.sketch.top(1)
+    share = counts[0] / sw.sketch.total
+    assert 0.95 <= share <= 1.05, share
+
+
+def test_block_sampling_rotation_covers_batch_tail():
+    """Regression: with constant-size batches the sample phase must wrap
+    over the valid start range, not reset to 0 — a key living only in
+    the tail rows past the last full block was permanently invisible."""
+    sw = StateWatch("t")
+    n = statewatch.SKETCH_ROW_CAP + 4000
+    g = np.zeros(n, dtype=np.int64)
+    g[-4000:] = 7  # the celebrity lives ONLY in the batch tail
+    for _ in range(20):
+        sw.update(g)
+    gids, counts, _ = sw.sketch.top(2)
+    assert 7 in gids.tolist(), gids
+    i = gids.tolist().index(7)
+    share = counts[i] / sw.sketch.total
+    assert share == pytest.approx(4000 / n, rel=0.5), share
+
+
+def test_query_level_budget_pressure_verdict():
+    """The budget bounds TOTAL state: four growers each ~4400s from the
+    budget alone, jointly 600s, must raise a QUERY-level
+    state-budget-pressure verdict (node_id None) while every per-node
+    check stays silent."""
+    now = time.time()
+
+    class _FakeOp:
+        def __init__(self, nid, cur, slope):
+            self.nid = nid
+            self._info = {
+                "op": "window", "state_bytes": cur, "live_keys": 1,
+            }
+            self._sw = StateWatch("f")
+            for k in range(4, 0, -1):
+                self._sw.record_sample(cur - slope * k, t=now - k)
+
+        def state_info(self):
+            return self._info
+
+        def _state_watch_views(self):
+            return []
+
+    ops = [_FakeOp(f"{i}_W", 10_000, 15.0) for i in range(4)]
+
+    class _H:
+        query_id = "qx"
+        running = True
+        # total 40k, joint slope 60 B/s -> joint tt = 600s (fires);
+        # per node: (76k - 10k) / 15 = 4400s (silent)
+        config = EngineConfig(state_budget_bytes=76_000)
+
+        def _walk(self):
+            return iter((op, op.nid, None) for op in ops)
+
+    snap = statedoc.state_snapshot(_H())
+    assert snap["forecast"]["slope_bytes_per_s"] == pytest.approx(
+        60.0, rel=0.05
+    )
+    pressure = [v for v in snap["verdicts"]
+                if v["kind"] == "state-budget-pressure"]
+    assert pressure and pressure[0]["node_id"] is None, snap["verdicts"]
+    assert pressure[0]["time_to_budget_s"] <= statedoc.BUDGET_PRESSURE_S
+    assert len(pressure) == 1  # no per-node verdict joined it
+
+
+def test_join_skew_gauge_uses_per_side_live_keys():
+    """Regression: the skew gauge fed a per-side sketch the COMBINED
+    both-sides key count, reading ~2 on a perfectly uniform join."""
+    info = {
+        "live_keys": 200,
+        "sides": {"left": {"live_keys": 100}, "right": {"live_keys": 100}},
+    }
+    assert statewatch.side_live_keys(info, "left") == 100
+    assert statewatch.side_live_keys(info, None) == 200
+    sw = StateWatch("t")
+    sw.update(np.arange(100).repeat(10))  # uniform: 100 keys x 10 rows
+    assert sw.skew_factor(
+        statewatch.side_live_keys(info, "left")
+    ) == pytest.approx(1.0, rel=0.05)
+
+
+def test_skew_factor_and_hot_keys():
+    sw = StateWatch("t")
+    g = np.concatenate([np.full(500, 3), np.arange(4, 54).repeat(10)])
+    sw.update(g)
+    hot = sw.hot_keys(3, resolve=lambda gids: [f"k{int(x)}" for x in gids])
+    assert hot[0]["key"] == "k3"
+    assert hot[0]["share"] == pytest.approx(0.5, abs=0.02)
+    sk = sw.skew_factor(live_keys=51)
+    assert sk == pytest.approx(25.5, rel=0.1)  # 0.5 share x 51 keys
+
+
+def test_null_watch_is_inert_and_falsy():
+    nw = statewatch.NULL_WATCH
+    assert not nw
+    nw.update(np.arange(10))
+    nw.record_sample(100)
+    assert nw.forecast(10) is None
+    assert nw.summary()["enabled"] is False
+
+
+def test_make_watch_follows_registry_enablement(registry):
+    assert isinstance(statewatch.make_watch("x"), StateWatch)
+    with obs.bound_registry(obs.disabled_registry()):
+        assert statewatch.make_watch("x") is statewatch.NULL_WATCH
+
+
+# -- growth forecasting -----------------------------------------------------
+
+
+def test_linear_forecast_contract():
+    # exact line: 100 B/s from 1000
+    pts = [(10.0 + i, 1000.0 + 100 * i) for i in range(5)]
+    fc = linear_forecast(pts, budget=11_400)
+    assert fc["slope_bytes_per_s"] == pytest.approx(100.0)
+    assert fc["r2"] == pytest.approx(1.0)
+    assert fc["time_to_budget_s"] == pytest.approx(100.0, rel=0.01)
+    # flat: never reaches the budget
+    flat = linear_forecast([(0, 5), (1, 5), (2, 5)], budget=100)
+    assert flat["slope_bytes_per_s"] == 0
+    assert flat["time_to_budget_s"] is None
+    # at/over budget: 0
+    over = linear_forecast([(0, 100), (1, 200)], budget=150)
+    assert over["time_to_budget_s"] == 0.0
+    # under two points: None
+    assert linear_forecast([(0, 1)]) is None
+    assert linear_forecast([]) is None
+
+
+def test_gauge_series_reader():
+    snaps = [
+        {"event": "obs", "t": 1.0, "metrics": {"dnz_state_bytes{node=\"x\"}": 10}},
+        {"event": "obs", "t": 2.0, "metrics": {"dnz_state_bytes{node=\"x\"}": 20}},
+        {"event": "obs", "t": 3.0, "metrics": {}},
+    ]
+    pts = gauge_series(snaps, 'dnz_state_bytes{node="x"}')
+    assert pts == [(1.0, 10), (2.0, 20)]
+    assert linear_forecast(pts)["slope_bytes_per_s"] == pytest.approx(10.0)
+
+
+# -- verdict rules (unit) ---------------------------------------------------
+
+
+def _join_node(share, live_keys, skew):
+    return {
+        "node_id": "2_StreamingJoinExec", "op": "join",
+        "sides": {"left": {"live_keys": live_keys}, "right": {"live_keys": 3}},
+        "sketches": {
+            "left": {
+                "hot_keys": [
+                    {"key": "celebrity", "rows": 100, "err_rows": 1,
+                     "share": share},
+                ],
+                "skew_factor": skew,
+            },
+        },
+    }
+
+
+def test_verdict_skewed_join_side_fires_and_names_side():
+    v = statedoc.verdicts([_join_node(0.5, 40, 20.0)])
+    assert v and v[0]["kind"] == "skewed-join-side"
+    assert v[0]["node_id"] == "2_StreamingJoinExec"
+    assert v[0]["side"] == "left"
+    assert v[0]["key"] == "celebrity"
+    # below either threshold: silent
+    assert not statedoc.verdicts([_join_node(0.1, 40, 20.0)])
+    assert not statedoc.verdicts([_join_node(0.5, 4, 2.0)])
+
+
+def test_verdict_retention_leak_and_ranking():
+    nodes = [
+        {"node_id": "1_S", "op": "session", "retention_unit_ms": 1000,
+         "oldest_event_lag_ms": 50_000},
+        _join_node(0.3, 40, 12.0),
+    ]
+    v = statedoc.verdicts(nodes)
+    kinds = [x["kind"] for x in v]
+    assert "retention-leak" in kinds and "skewed-join-side" in kinds
+    # ranked by severity desc
+    sevs = [x["severity"] for x in v]
+    assert sevs == sorted(sevs, reverse=True)
+    # lag below N units: silent
+    ok = {"node_id": "1_S", "op": "session", "retention_unit_ms": 1000,
+          "oldest_event_lag_ms": 2_000}
+    assert not statedoc.verdicts([ok])
+
+
+def test_verdict_growth_and_budget_pressure():
+    grow = {
+        "node_id": "1_S", "op": "session", "state_bytes": 1000,
+        "forecast": {"slope_bytes_per_s": 50.0, "r2": 0.9, "samples": 5,
+                     "window_s": 10.0},
+    }
+    v = statedoc.verdicts([grow], budget=2000)
+    kinds = {x["kind"] for x in v}
+    assert "unbounded-session-growth" in kinds
+    assert "state-budget-pressure" in kinds
+    tt = [x for x in v if x["kind"] == "state-budget-pressure"][0]
+    assert tt["time_to_budget_s"] == pytest.approx(20.0, rel=0.01)
+    # poor fit: no growth verdict
+    grow2 = dict(grow, forecast=dict(grow["forecast"], r2=0.1))
+    assert "unbounded-session-growth" not in {
+        x["kind"] for x in statedoc.verdicts([grow2])
+    }
+
+
+# -- accounting across checkpoint/restore (the satellite core) --------------
+
+
+_SENSOR_SCHEMA = Schema([
+    Field("occurred_at_ms", DataType.INT64, nullable=False),
+    Field("sensor_name", DataType.STRING, nullable=False),
+    Field("reading", DataType.FLOAT64),
+])
+
+
+def _sensor_batches(n_batches=12, rows=200, seed=21, keys=7):
+    """Bursty feed: batch b is a 300ms burst at T0 + b*1000 — the 700ms
+    silences exceed the 300ms session gap, so each burst's sessions
+    CLOSE when the next burst advances the watermark (emissions flow
+    mid-stream, giving the checkpoint barrier an injection point)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 300, rows))
+        names = np.array(
+            [f"s{i}" for i in rng.integers(0, keys, rows)], dtype=object
+        )
+        out.append(RecordBatch(
+            _SENSOR_SCHEMA, [ts, names, rng.normal(50, 5, rows)]
+        ))
+    return out
+
+
+def _cfg(path):
+    return EngineConfig(
+        checkpoint=path is not None,
+        checkpoint_interval_s=9999,
+        state_backend_path=path,
+        emit_lag_ms=0,
+    )
+
+
+def _find_op(root, cls_name):
+    from denormalized_tpu.state.checkpoint import walk
+
+    for op in walk(root):
+        if type(op).__name__ == cls_name:
+            return op
+    raise AssertionError(f"no {cls_name} in plan")
+
+
+def _run_to_marker(plan, ctx):
+    """Build + wire + drive until the first committed barrier, then
+    crash (generator close).  Returns the physical root, frozen."""
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    root = executor.build_physical(lp.Sink(plan, CollectSink()), ctx)
+    orch = Orchestrator(interval_s=9999)
+    coord = wire_checkpointing(root, ctx, orch)
+    items_seen = 0
+    it = root.run()
+    for item in it:
+        if items_seen == 1:
+            orch.trigger_now()
+        if isinstance(item, Marker):
+            coord.commit(item.epoch)
+            break
+        items_seen += 1
+    it.close()  # crash
+    close_global_state_backend()
+    return root
+
+
+def _wire_restore(plan, ctx):
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    root = executor.build_physical(lp.Sink(plan, CollectSink()), ctx)
+    coord = wire_checkpointing(root, ctx, Orchestrator(interval_s=9999))
+    assert coord.committed_epoch is not None
+    return root
+
+
+def _invariant(info, keys):
+    return {k: info.get(k) for k in keys}
+
+
+_SESSION_KEYS = (
+    "op", "state_bytes", "live_keys", "slot_live", "acc_objects",
+    "oldest_event_ms", "watermark_ms", "oldest_event_lag_ms",
+)
+
+
+def _session_restore_roundtrip(tmp_path, registry, op_cls):
+    state = str(tmp_path / "state")
+    batches = _sensor_batches()
+
+    def build(ctx):
+        return ctx.from_source(
+            MemorySource.from_batches(
+                batches, timestamp_column="occurred_at_ms"
+            ),
+            name="sw_src",
+        ).session_window(
+            ["sensor_name"],
+            [F.count(col("reading")).alias("cnt"),
+             F.avg(col("reading")).alias("a")],
+            300,
+        )._plan
+
+    ctx_a = Context(_cfg(state))
+    root_a = _run_to_marker(build(ctx_a), ctx_a)
+    op_a = _find_op(root_a, op_cls)
+    info_a = op_a.state_info()
+    assert info_a["live_keys"] > 0 and info_a["state_bytes"] > 0
+
+    ctx_b = Context(_cfg(state))
+    root_b = _wire_restore(build(ctx_b), ctx_b)
+    op_b = _find_op(root_b, op_cls)
+    info_b = op_b.state_info()
+    assert _invariant(info_a, _SESSION_KEYS) == _invariant(
+        info_b, _SESSION_KEYS
+    )
+    return op_a, op_b
+
+
+def test_session_accounting_survives_restore(tmp_path, registry):
+    op_a, op_b = _session_restore_roundtrip(
+        tmp_path, registry, "SessionWindowExec"
+    )
+    # sketches do NOT ride the snapshot: they re-warm (documented)
+    assert op_a._sw.sketch.total > 0
+    assert op_b._sw.sketch.total == 0
+
+
+def test_reference_session_accounting_survives_restore(
+    tmp_path, registry, monkeypatch
+):
+    monkeypatch.setenv("DENORMALIZED_SESSION_REFERENCE", "1")
+    _session_restore_roundtrip(
+        tmp_path, registry, "ReferenceSessionWindowExec"
+    )
+
+
+def test_join_accounting_survives_restore(tmp_path, registry):
+    from denormalized_tpu.physical import join_exec as JE
+
+    state = str(tmp_path / "state")
+    rng = np.random.default_rng(5)
+    lb, rb = [], []
+    # enough batches that the bounded sources cannot fully drain into
+    # the join's pumps before the barrier is triggered mid-stream
+    for b in range(24):
+        rows = 150
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, rows))
+        ks = np.array(
+            [f"k{i}" for i in rng.integers(0, 9, rows)], dtype=object
+        )
+        lb.append(RecordBatch(
+            Schema([Field("ts", DataType.INT64, nullable=False),
+                    Field("k", DataType.STRING, nullable=False),
+                    Field("v", DataType.FLOAT64)]),
+            [ts, ks, rng.normal(0, 1, rows)],
+        ))
+        rb.append(RecordBatch(
+            Schema([Field("rts", DataType.INT64, nullable=False),
+                    Field("rk", DataType.STRING, nullable=False),
+                    Field("rv", DataType.FLOAT64)]),
+            [ts.copy(), ks.copy(), rng.normal(0, 1, rows)],
+        ))
+
+    def build(ctx):
+        left = ctx.from_source(MemorySource.from_batches(
+            lb, timestamp_column="ts"), name="L")
+        right = ctx.from_source(MemorySource.from_batches(
+            rb, timestamp_column="rts"), name="R")
+        return left.join(right, "inner", ["k"], ["rk"])._plan
+
+    ctx_a = Context(_cfg(state))
+    root_a = _run_to_marker(build(ctx_a), ctx_a)
+    join_a = _find_op(root_a, "StreamingJoinExec")
+    info_a = join_a.state_info()
+    assert info_a["slot_live"] > 0 and info_a["state_bytes"] > 0
+
+    ctx_b = Context(_cfg(state))
+    root_b = _wire_restore(build(ctx_b), ctx_b)
+    join_b = _find_op(root_b, "StreamingJoinExec")
+    sides = (JE._SideState(), JE._SideState())
+    join_b._sides = sides
+    join_b._restore(sides)
+    info_b = join_b.state_info()
+
+    keys = ("op", "state_bytes", "live_keys", "slot_live")
+    assert _invariant(info_a, keys) == _invariant(info_b, keys)
+    for side in ("left", "right"):
+        sa, sb = info_a["sides"][side], info_b["sides"][side]
+        assert sa == sb, (side, sa, sb)
+
+
+def test_udaf_accounting_survives_restore(tmp_path, registry):
+    class Spread(Accumulator):
+        def __init__(self):
+            self.lo, self.hi = float("inf"), float("-inf")
+
+        def update(self, values):
+            if len(values):
+                self.lo = min(self.lo, float(values.min()))
+                self.hi = max(self.hi, float(values.max()))
+
+        def merge(self, states):
+            self.lo = min(self.lo, states[0])
+            self.hi = max(self.hi, states[1])
+
+        def state(self):
+            return [self.lo, self.hi]
+
+        def evaluate(self):
+            return self.hi - self.lo if self.hi >= self.lo else 0.0
+
+    spread = F.udaf(Spread, DataType.FLOAT64, "spread")
+    state = str(tmp_path / "state")
+    batches = _sensor_batches()
+
+    def build(ctx):
+        return ctx.from_source(
+            MemorySource.from_batches(
+                batches, timestamp_column="occurred_at_ms"
+            ),
+            name="u_src",
+        ).window(
+            ["sensor_name"], [spread(col("reading")).alias("sp")], 1000
+        )._plan
+
+    ctx_a = Context(_cfg(state))
+    root_a = _run_to_marker(build(ctx_a), ctx_a)
+    op_a = _find_op(root_a, "UdafWindowExec")
+    info_a = op_a.state_info()
+    assert info_a["acc_objects"] > 0
+
+    ctx_b = Context(_cfg(state))
+    root_b = _wire_restore(build(ctx_b), ctx_b)
+    op_b = _find_op(root_b, "UdafWindowExec")
+    info_b = op_b.state_info()
+    keys = ("op", "state_bytes", "live_keys", "slot_live", "open_windows",
+            "acc_objects", "oldest_event_ms", "watermark_ms")
+    assert _invariant(info_a, keys) == _invariant(info_b, keys)
+
+
+def test_checkpoint_last_snapshot_bytes_gauge(tmp_path, registry):
+    """Satellite 1: every persisted state key gets a labeled
+    last-snapshot-bytes gauge, so a restore-size regression names its
+    operator."""
+    state = str(tmp_path / "state")
+    batches = _sensor_batches()
+    ctx = Context(_cfg(state))
+    plan = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms"),
+        name="g_src",
+    ).session_window(
+        ["sensor_name"], [F.count(col("reading")).alias("c")], 300
+    )._plan
+    _run_to_marker(plan, ctx)
+    snap = registry.snapshot()
+    series = [
+        k for k in snap
+        if k.startswith("dnz_checkpoint_last_snapshot_bytes")
+    ]
+    assert any("session_" in s for s in series), series
+    assert any("offsets_" in s for s in series), series
+    for s in series:
+        assert snap[s] > 0
+
+
+# -- live surfaces ----------------------------------------------------------
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def test_skewed_join_yields_verdict_at_state_endpoint(registry):
+    """ISSUE-8 integration acceptance: a join feed where one celebrity
+    key holds >= 50% of the left side's rows produces a
+    ``skewed-join-side`` verdict at GET /queries/<id>/state naming the
+    join's node id, the left side, and the key's state-mass share
+    within sketch error bounds."""
+    rng = np.random.default_rng(11)
+    lschema = Schema([Field("ts", DataType.INT64, nullable=False),
+                      Field("k", DataType.STRING, nullable=False),
+                      Field("v", DataType.FLOAT64)])
+    rschema = Schema([Field("rts", DataType.INT64, nullable=False),
+                      Field("rk", DataType.STRING, nullable=False),
+                      Field("rv", DataType.FLOAT64)])
+    lb, rb = [], []
+    for b in range(8):
+        rows = 400
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, rows))
+        lk = np.array(
+            [f"u{i}" for i in rng.integers(0, 60, rows)], dtype=object
+        )
+        lk[: rows // 2] = "celebrity"  # >= 50% of the left side
+        rk = np.array(
+            [f"u{i}" for i in rng.integers(0, 60, rows)], dtype=object
+        )
+        lb.append(RecordBatch(lschema, [ts, lk, rng.normal(0, 1, rows)]))
+        rb.append(RecordBatch(
+            rschema, [ts.copy(), rk, rng.normal(0, 1, rows)]
+        ))
+
+    ctx = Context(EngineConfig(prometheus_port=0))
+    left = ctx.from_source(
+        MemorySource.from_batches(lb, timestamp_column="ts"), name="L"
+    )
+    right = ctx.from_source(
+        MemorySource.from_batches(rb, timestamp_column="rts"), name="R"
+    )
+    ds = left.join(right, "inner", ["k"], ["rk"])
+    it = ds.stream()
+    try:
+        for _ in range(4):
+            next(it, None)
+        port = ctx._last_exporters.prometheus.port
+        base = f"http://127.0.0.1:{port}"
+        qid = json.loads(_get(f"{base}/queries")[1])["queries"][0][
+            "query_id"
+        ]
+        status, body = _get(f"{base}/queries/{qid}/state")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["total_state_bytes"] > 0
+        node_ids = {n["node_id"] for n in payload["nodes"]}
+        sk = [v for v in payload["verdicts"]
+              if v["kind"] == "skewed-join-side"]
+        assert sk, payload["verdicts"]
+        v = sk[0]
+        assert "StreamingJoinExec" in v["node_id"]
+        assert v["node_id"] in node_ids
+        assert v["side"] == "left"
+        assert v["key"] == "celebrity"
+        # true share is 0.5; sketch overestimate bounded by err
+        assert 0.4 <= v["share"] <= 0.62, v
+        # the rule text ships with the payload
+        assert "skewed-join-side" in payload["rules"]
+    finally:
+        for _ in it:
+            pass
+
+
+def test_budgeted_session_forecast_tightens(registry, monkeypatch):
+    """ISSUE-8 integration acceptance, second half: a session workload
+    with a state budget produces a FINITE time-to-budget forecast that
+    tightens (more samples, shrinking projection) as snapshots accrue.
+
+    Driven at the operator level: an ever-growing key population (no
+    session ever closes) yields no emissions for a stream loop to pace
+    on, so the test feeds batches directly and polls the registered
+    query's /state view between feeds — exactly what a monitoring loop
+    scraping a long-running query does."""
+    from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+    from denormalized_tpu.obs import doctor
+    from denormalized_tpu.physical.base import ExecOperator
+    from denormalized_tpu.physical.session_exec import SessionWindowExec
+
+    monkeypatch.setattr(statewatch, "_SAMPLE_MIN_INTERVAL_S", 0.0)
+    in_schema = Schema([
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS,
+              nullable=False),
+        Field("sensor_name", DataType.STRING, nullable=False),
+        Field("reading", DataType.FLOAT64),
+    ])
+
+    class _Leaf(ExecOperator):
+        schema = in_schema
+
+        def run(self):
+            return iter(())
+
+    op = SessionWindowExec(
+        _Leaf(), [col("sensor_name")],
+        [F.count(col("reading")).alias("c")], 60_000,
+    )
+    handle = doctor.register_query(
+        op, config=EngineConfig(state_budget_bytes=30_000_000),
+        registry=registry,
+    )
+    try:
+        rng = np.random.default_rng(2)
+        samples_seen, tts = [], []
+        uid = 0
+        for b in range(10):
+            rows = 300
+            ts = np.sort(T0 + b * 400 + rng.integers(0, 400, rows))
+            names = np.array(
+                [f"u{uid + i}" for i in range(rows)], dtype=object
+            )
+            uid += rows
+            batch = RecordBatch(
+                in_schema, [ts, names, rng.normal(0, 1, rows)]
+            )
+            list(op._process_batch(batch))
+            time.sleep(0.05)
+            snap = handle.state_snapshot()
+            fc = snap.get("forecast")
+            if fc:
+                samples_seen.append(fc["samples"])
+                if fc.get("time_to_budget_s") is not None:
+                    tts.append(fc["time_to_budget_s"])
+        assert snap["budget_bytes"] == 30_000_000
+        node = [n for n in snap["nodes"] if n["op"] == "session"][0]
+        assert node["live_keys"] == uid  # nothing ever closed
+    finally:
+        handle.finish()
+    assert samples_seen and samples_seen[-1] > samples_seen[0]
+    assert samples_seen == sorted(samples_seen)  # accruing, never lost
+    assert tts, "no finite time-to-budget despite budget + growth"
+    assert all(t > 0 for t in tts)
+    assert tts[-1] <= tts[0] * 1.5  # projection tightens, not wanders
+
+
+def test_state_gauges_and_hot_key_series_bound_per_node(
+    make_batch, registry
+):
+    """The registry view: per-node dnz_state_* gauge_fns and the
+    1 Hz-refreshed hot-key share series appear under the plan node id
+    and read real values."""
+    rng = np.random.default_rng(0)
+    batches = []
+    for b in range(8):
+        rows = 200
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, rows))
+        names = rng.choice(
+            [f"s{i}" for i in range(5)], size=rows
+        ).astype(object)
+        names[: rows // 2] = "hot"
+        batches.append(make_batch(ts, names, rng.normal(50, 10, rows)))
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+    ).window(
+        [col("sensor_name")], [F.count(col("reading")).alias("c")], 1000
+    )
+    ds.collect()
+    snap = registry.snapshot()
+    win_bytes = [
+        k for k in snap
+        if k.startswith("dnz_state_bytes") and "WindowExec" in k
+    ]
+    assert win_bytes and snap[win_bytes[0]] > 0
+    assert any(k.startswith("dnz_state_live_keys") for k in snap)
+    assert any(
+        k.startswith("dnz_state_slots") and 'kind="capacity"' in k
+        for k in snap
+    )
+    hot = {
+        k: v for k, v in snap.items()
+        if k.startswith("dnz_state_hot_key_share") and 'key="hot"' in k
+    }
+    assert hot, [k for k in snap if k.startswith("dnz_state_hot")]
+    assert max(hot.values()) == pytest.approx(0.5, abs=0.1)
+    assert any(k.startswith("dnz_state_skew_factor") for k in snap)
+
+
+def test_explain_analyze_carries_state_columns(make_batch, registry, capsys):
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    rng = np.random.default_rng(0)
+    batches = []
+    for b in range(8):
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, 200))
+        names = rng.choice([f"s{i}" for i in range(5)], size=200)
+        batches.append(make_batch(ts, names, rng.normal(50, 10, 200)))
+    text = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+    ).window(
+        [col("sensor_name")], [F.count(col("reading")).alias("c")], 1000
+    ).explain_analyze()
+    assert "state=" in text
+    assert "keys" in text
+
+
+def test_state_snapshot_frozen_after_finish(make_batch, registry):
+    ctx = Context(EngineConfig(min_batch_bucket=256))
+    rng = np.random.default_rng(0)
+    batches = []
+    for b in range(6):
+        ts = np.sort(T0 + b * 400 + rng.integers(0, 400, 100))
+        names = rng.choice(["a", "b"], size=100)
+        batches.append(make_batch(ts, names, rng.normal(0, 1, 100)))
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="occurred_at_ms")
+    ).window([col("sensor_name")], [F.count(col("reading")).alias("c")], 1000)
+    ds.collect()
+    handle = ctx._last_doctor
+    assert not handle.running
+    snap = handle.state_snapshot()
+    assert snap["state"] == "finished"
+    assert snap["nodes"], snap
+    # frozen: identical object on re-read, survives root drop
+    assert handle.state_snapshot() is snap
+
+
+# -- soak telemetry derivation ---------------------------------------------
+
+
+def test_soak_telemetry_reports_peak_state_and_hot_keys(tmp_path):
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "_t_soak", Path(__file__).resolve().parent.parent / "tools" / "soak.py"
+    )
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+
+    p = tmp_path / "obs_seg0.jsonl"
+    lines = []
+    for i in range(4):
+        lines.append(json.dumps({
+            "event": "obs", "t": 100.0 + i,
+            "metrics": {
+                'dnz_state_bytes{node="3_SessionWindowExec"}': 1000 * (i + 1),
+                'dnz_state_bytes{node="state_backend"}': 500,
+                'dnz_state_hot_key_share{key="celebrity",node="3_SessionWindowExec"}': 0.5,
+                'dnz_state_hot_key_share{key="minor",node="3_SessionWindowExec"}': 0.01,
+            },
+        }))
+    p.write_text("\n".join(lines) + "\n")
+    tele = soak.derive_telemetry([str(p)])
+    assert tele["peak_state_bytes"] == 4500
+    hot = tele["state_hot_keys"][0]
+    assert hot["segment"] == 0
+    assert "celebrity" in hot["top_keys"][0]["series"]
+    assert hot["top_keys"][0]["share"] == pytest.approx(0.5)

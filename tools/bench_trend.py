@@ -9,6 +9,17 @@ narrative used to carry by hand::
     python tools/bench_trend.py --config simple
     python tools/bench_trend.py --json        # machine output
 
+``--gate`` turns the trajectory into a CI gate: exit 2 when the LATEST
+record of a config regresses more than ``--max-regress-pct`` (default
+10) against the PREVIOUS record on the gated metric — the perf
+trajectory stops being just a log.  A single-record history passes
+(nothing to compare yet); records from a DIFFERENT device are never
+compared against each other (a laptop run must not "regress" a TPU
+number)::
+
+    python tools/bench_trend.py --gate --config simple --max-regress-pct 15
+
+Wired into tools/lint.sh and pinned by tests/test_bench_trend.py.
 Stdlib-only (it runs in the jax-free soak/driver environments).
 """
 
@@ -73,6 +84,38 @@ def trend_rows(entries: list[dict]) -> list[dict]:
     return rows
 
 
+def gate(
+    entries: list[dict], max_regress_pct: float, config: str
+) -> tuple[int, str]:
+    """(exit_code, message) of the regression gate over ONE config's
+    history: 0 = pass, 2 = the latest record regressed more than
+    ``max_regress_pct`` vs the previous comparable (same-device) one."""
+    if not entries:
+        return 1, f"gate: no history entries for config {config!r}"
+    latest = entries[-1]
+    value = latest.get("value") or 0
+    device = latest.get("device")
+    prev = None
+    for e in reversed(entries[:-1]):
+        if e.get("device") == device and e.get("value"):
+            prev = e
+            break
+    if prev is None:
+        return 0, (
+            f"gate: {config}: single {device or '?'} record "
+            f"({_label(latest)}, {value:,}) — nothing to compare, pass"
+        )
+    drop_pct = (prev["value"] - value) / prev["value"] * 100.0
+    line = (
+        f"gate: {config}: {_label(prev)} {prev['value']:,} -> "
+        f"{_label(latest)} {value:,} rows/s "
+        f"({-drop_pct:+.1f}% , limit -{max_regress_pct:g}%)"
+    )
+    if drop_pct > max_regress_pct:
+        return 2, line + " — REGRESSION"
+    return 0, line + " — ok"
+
+
 def render(groups: dict[str, list[dict]]) -> str:
     lines = []
     for config, entries in sorted(groups.items()):
@@ -117,6 +160,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="restrict to one bench config")
     parser.add_argument("--json", action="store_true",
                         help="emit the trend rows as JSON")
+    parser.add_argument("--gate", action="store_true",
+                        help="CI mode: exit 2 when the latest record of "
+                        "--config (required) regresses more than "
+                        "--max-regress-pct vs the previous same-device "
+                        "record")
+    parser.add_argument("--max-regress-pct", type=float, default=10.0)
     args = parser.parse_args(argv)
 
     entries = load_history(Path(args.path))
@@ -124,6 +173,15 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no history at {args.path}", file=sys.stderr)
         return 1
     groups = by_config(entries)
+    if args.gate:
+        if not args.config:
+            print("--gate requires --config", file=sys.stderr)
+            return 1
+        rc, msg = gate(
+            groups.get(args.config, []), args.max_regress_pct, args.config
+        )
+        print(msg, file=sys.stderr if rc else sys.stdout)
+        return rc
     if args.config:
         if args.config not in groups:
             print(

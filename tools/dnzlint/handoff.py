@@ -20,7 +20,15 @@ catalog, this pass closes the loop statically, in both directions:
 - every such class must be registered in ``operators.toml``, and every
   registered class must still exist — a NEW operator cannot slip in
   unregistered (and therefore unreviewed for attribution coverage), and
-  a renamed one cannot leave the registry stale.
+  a renamed one cannot leave the registry stale;
+- **keyed-state coverage** (the state observatory's drift pin): every
+  operator registered with ``keyed_state = true`` must bind the
+  state-accounting instruments — a ``state_info()`` method AND a
+  sketch watch created via ``statewatch.make_watch(...)`` — and,
+  conversely, an operator that defines ``state_info`` must be flagged
+  ``keyed_state = true`` in the registry.  A future stateful operator
+  cannot silently be invisible to ``GET /queries/<id>/state``, memory
+  budgeting, or skew verdicts.
 
 Leaf operators (``SourceExec``) are exempt by shape: they have no
 upstream handoff — their production time is attributed from their
@@ -48,8 +56,14 @@ def _class_src_flags(cls: ast.ClassDef) -> dict:
         "binds_obs": False,
         "input_wait": False,
         "note_batch": False,
+        "has_state_info": False,
+        "makes_watch": False,
     }
     for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name == "state_info"
+        ):
+            flags["has_state_info"] = True
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
             node.name == "run"
         ):
@@ -89,6 +103,16 @@ def _class_src_flags(cls: ast.ClassDef) -> dict:
                     flags["note_batch"] = True
             elif isinstance(fn, ast.Name) and fn.id == "spawn_pump":
                 flags["consumes_input"] = True
+        # statewatch.make_watch(...) — the sketch-watch constructor
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "make_watch"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "statewatch"
+            ):
+                flags["makes_watch"] = True
     return flags
 
 
@@ -114,15 +138,20 @@ def discover(root: Path) -> dict[str, tuple[str, int, dict]]:
     return out
 
 
-def load_operators(path: Path) -> dict[str, str]:
-    """operators.toml -> {class: file}."""
+def load_operators(path: Path) -> dict[str, dict]:
+    """operators.toml -> {class: {"file": ..., "keyed_state": bool}}.
+    ``keyed_state`` normalizes across tomllib (bool) and the string
+    fallback parser."""
     from tools.dnzlint import _parse_toml
 
     if not path.exists():
         return {}
     data = _parse_toml(path)
     return {
-        e["class"]: e.get("file", "")
+        e["class"]: {
+            "file": e.get("file", ""),
+            "keyed_state": str(e.get("keyed_state", "")).lower() == "true",
+        }
         for e in data.get("operator", [])
         if e.get("class")
     }
@@ -164,10 +193,37 @@ def run(root: Path, operators_path: Path | None = None) -> list[Finding]:
                 "tools/dnzlint/operators.toml — register it so handoff-"
                 "instrument coverage is reviewed, not assumed",
             ))
-    for cls, file in registered.items():
+            continue
+        # keyed-state drift, both directions (state observatory pin)
+        entry = registered[cls]
+        if entry["keyed_state"]:
+            if not flags["has_state_info"]:
+                findings.append(Finding(
+                    "DNZ-M002", rel, lineno, cls,
+                    "operator is registered keyed_state=true but defines "
+                    "no state_info() — its memory/skew would be invisible "
+                    "to GET /queries/<id>/state and the budget forecast",
+                ))
+            if not flags["makes_watch"]:
+                findings.append(Finding(
+                    "DNZ-M002", rel, lineno, cls,
+                    "operator is registered keyed_state=true but never "
+                    "creates a sketch watch (statewatch.make_watch) — "
+                    "its key distribution would be invisible to hot-key "
+                    "and skew verdicts",
+                ))
+        elif flags["has_state_info"]:
+            findings.append(Finding(
+                "DNZ-M002", rel, lineno, cls,
+                "operator defines state_info() (it holds keyed state) "
+                "but operators.toml does not flag it keyed_state = true "
+                "— flag it so state-accounting coverage is reviewed, "
+                "not assumed",
+            ))
+    for cls, entry in registered.items():
         if cls not in discovered:
             findings.append(Finding(
-                "DNZ-M002", file or str(operators_path), 0, cls,
+                "DNZ-M002", entry["file"] or str(operators_path), 0, cls,
                 f"operators.toml registers {cls!r} but no such "
                 "input-consuming operator class exists in physical/ — "
                 "stale registration (renamed or deleted operator)",

@@ -15,6 +15,10 @@ fail=0
 echo "== dnzlint (rules: docs/static_analysis.md)"
 python -m tools.dnzlint denormalized_tpu || fail=1
 
+echo "== bench trend gate (BENCH_HISTORY.jsonl, latest vs previous)"
+python tools/bench_trend.py --gate --config simple --max-regress-pct 25 \
+    || fail=1
+
 echo "== fault-site docs drift"
 table="$(python -m tools.dnzlint --fault-site-table)"
 if ! python - "$table" <<'EOF'

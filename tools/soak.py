@@ -1011,7 +1011,9 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
     timeline: list = []
     n_snaps = 0
     segs_reporting = 0
-    for path in obs_paths:
+    peak_state = 0.0
+    state_hot: list = []
+    for seg_i, path in enumerate(obs_paths):
         snaps = R.read_stream(path)
         if not snaps:
             continue
@@ -1022,6 +1024,39 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
         # timeline per SEGMENT: each killed child restarts its counters
         # from zero, so the delta baseline must reset with it
         timeline += R.counter_timeline(snaps, "dnz_fault_injections_total")
+        # state observatory: peak total state bytes across the segment's
+        # snapshots, and the segment's final top-K hot keys (the
+        # dnz_state_hot_key_share gauges a stateful operator refreshes)
+        seg_peak = 0.0
+        for snap in snaps:
+            tot = sum(
+                v for k, v in snap.get("metrics", {}).items()
+                if k.startswith("dnz_state_bytes")
+                and isinstance(v, (int, float))
+            )
+            if tot > seg_peak:
+                seg_peak = tot
+        if seg_peak > peak_state:
+            peak_state = seg_peak
+        final_shares = {}
+        for snap in snaps:  # last snapshot carrying hot-key series wins
+            shares = {
+                k: v for k, v in snap.get("metrics", {}).items()
+                if k.startswith("dnz_state_hot_key_share") and v
+            }
+            if shares:
+                final_shares = shares
+        if final_shares:
+            top = sorted(
+                final_shares.items(), key=lambda kv: -kv[1]
+            )[:8]
+            state_hot.append({
+                "segment": seg_i,
+                "peak_state_bytes": round(seg_peak),
+                "top_keys": [
+                    {"series": k, "share": round(v, 4)} for k, v in top
+                ],
+            })
     timeline.sort(key=lambda e: e["t"] or 0)
     emit = R.merge_histogram(finals_emit)
     wm = R.merge_histogram(finals_wm)
@@ -1030,6 +1065,10 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
         "snapshots": n_snaps,
         "fault_timeline": timeline,
     }
+    if peak_state:
+        tele["peak_state_bytes"] = round(peak_state)
+    if state_hot:
+        tele["state_hot_keys"] = state_hot
     if emit:
         tele["e2e_event_lag_ms"] = {
             k: round(emit[k], 2) for k in ("p50", "p95", "p99", "max")
