@@ -1562,6 +1562,172 @@ def run_throughput(
     return rows / dt, info
 
 
+def gen_bigstate_batches(num_keys, batch_rows, wave_keys=None):
+    """The bigstate soak feed shape (tools/soak.py --pipeline bigstate):
+    phase A opens ``num_keys`` singleton sessions at 1ms spacing with a
+    gap equal to the whole span (ALL of them open simultaneously —
+    the larger-than-memory working set), then watermark waves close them
+    progressively.  Deterministic, int64 keys."""
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    schema = Schema([
+        Field("occurred_at_ms", DataType.INT64, nullable=False),
+        Field("sensor_id", DataType.INT64, nullable=False),
+        Field("reading", DataType.FLOAT64),
+    ])
+    t0 = 1_700_000_000_000
+    gap = num_keys  # DT = 1ms per key
+    wave = wave_keys or max(num_keys // 20, 1)
+    batches = []
+    for lo in range(0, num_keys, batch_rows):
+        kids = np.arange(lo, min(lo + batch_rows, num_keys), dtype=np.int64)
+        batches.append(RecordBatch(
+            schema, [t0 + kids, kids, (kids % 997) * 0.5 + 1.0]
+        ))
+    waves = -(-num_keys // wave)
+    for j in range(1, waves + 1):
+        base = num_keys + (j - 1) * 64
+        kids = np.arange(base, base + 64, dtype=np.int64)
+        ts = np.full(64, t0 + gap + j * wave, dtype=np.int64)
+        batches.append(RecordBatch(
+            schema, [ts, kids, (kids % 997) * 0.5 + 1.0]
+        ))
+    return schema, batches, gap
+
+
+def run_spill_scale() -> dict:
+    """Cold-tier sweep (docs/state_spill.md): for each live-key point
+    run the SAME all-keys-open session workload (a) unbudgeted and (b)
+    under a budget ~5x below the point's working set with the LSM cold
+    tier active — rows/s both ways, spill/reload volume, and
+    emission-count equality.  Plus the hot-path gate: a budget that is
+    CONFIGURED but never crossed must keep >= 0.95 of the unbudgeted
+    rate (the membership pre-probe is one attribute check + one scatter
+    when the cold set is empty) — interleaved best-of like
+    run_obs_overhead, reported as ``no_spill_ratio``."""
+    import shutil
+    import tempfile
+
+    from denormalized_tpu.ops.session_table import SessionTable
+    from denormalized_tpu.state.lsm import close_global_state_backend
+
+    points = [
+        int(x)
+        for x in os.environ.get(
+            "BENCH_SPILL_SCALE_KEYS", "100000,1000000"
+        ).split(",")
+    ]
+    batch_rows = min(BATCH_ROWS, 65_536)
+    per_slot = SessionTable(1).per_slot_nbytes()
+
+    def one(batches, gap, budget) -> tuple[float, int, dict]:
+        from denormalized_tpu import col
+        from denormalized_tpu.api import functions as F
+
+        work = tempfile.mkdtemp(prefix="bench_spill_")
+        try:
+            over = {}
+            if budget:
+                over = {
+                    "state_backend_path": os.path.join(work, "lsm"),
+                    "state_budget_bytes": budget,
+                }
+            ctx = _engine_ctx(batch_rows, **over)
+            ds = ctx.from_source(
+                _mem_source(batches), name="spill_bench"
+            ).session_window(
+                ["sensor_id"],
+                [
+                    F.count(col("reading")).alias("count"),
+                    F.min(col("reading")).alias("min"),
+                    F.max(col("reading")).alias("max"),
+                    F.avg(col("reading")).alias("average"),
+                ],
+                gap,
+            )
+            rows = sum(b.num_rows for b in batches)
+            sessions = 0
+            t0 = time.perf_counter()
+            for b in ds.stream():
+                sessions += b.num_rows
+            dt = time.perf_counter() - t0
+            spill = {}
+            op = ctx._last_physical
+            stack = [op]
+            while stack:
+                cur = stack.pop()
+                if type(cur).__name__ == "SessionWindowExec":
+                    spill = cur.state_info().get("spill") or {}
+                    break
+                stack.extend(cur.children)
+            return rows / dt, sessions, spill
+        finally:
+            close_global_state_backend()
+            shutil.rmtree(work, ignore_errors=True)
+
+    results: dict[str, dict] = {}
+    for keys in points:
+        _, batches, gap = gen_bigstate_batches(keys, batch_rows)
+        # working set = slot storage + key index; budget 5x under it
+        ws = keys * (per_slot + 64)
+        budget = max(ws // 5, 1_000_000)
+        plain_rps, plain_sessions, _ = one(batches, gap, 0)
+        bud_rps, bud_sessions, spill = one(batches, gap, budget)
+        results[str(keys)] = {
+            "working_set_bytes": ws,
+            "budget_bytes": budget,
+            "unbudgeted_rows_per_s": round(plain_rps),
+            "budgeted_rows_per_s": round(bud_rps),
+            "budgeted_over_unbudgeted": round(bud_rps / plain_rps, 3),
+            "sessions_equal": plain_sessions == bud_sessions,
+            "sessions": plain_sessions,
+            "spill_blocks": spill.get("spill_blocks_total", 0),
+            "reload_blocks": spill.get("reload_blocks_total", 0),
+            "spill_bytes": spill.get("spill_bytes_total", 0),
+        }
+        log(
+            f"spill_scale[{keys} keys]: unbudgeted {plain_rps:,.0f} "
+            f"rows/s, budgeted {bud_rps:,.0f} rows/s "
+            f"({bud_rps / plain_rps:.2f}x), "
+            f"{spill.get('spill_blocks_total', 0)} blocks spilled"
+        )
+
+    # no-spill hot-path gate: budget present but never crossed, at the
+    # smallest sweep point — interleaved best-of-3 to shed noise
+    gate_keys = points[0]
+    _, gate_batches, gate_gap = gen_bigstate_batches(gate_keys, batch_rows)
+    huge = 1 << 40
+    best_plain = best_cfgd = 0.0
+    for _ in range(3):
+        r, _s, _sp = one(gate_batches, gate_gap, 0)
+        best_plain = max(best_plain, r)
+        r, _s, sp = one(gate_batches, gate_gap, huge)
+        assert not sp.get("spill_blocks_total"), "gate run spilled"
+        best_cfgd = max(best_cfgd, r)
+    no_spill_ratio = round(best_cfgd / best_plain, 4)
+    log(
+        f"spill_scale[gate @ {gate_keys} keys]: configured-idle "
+        f"{best_cfgd:,.0f} vs plain {best_plain:,.0f} rows/s "
+        f"(ratio {no_spill_ratio})"
+    )
+
+    headline_keys = str(points[-1])
+    headline = results[headline_keys]
+    return {
+        "metric": f"rows_per_sec_spill_scale_{headline_keys}_keys_budgeted",
+        "value": headline["budgeted_rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": headline["budgeted_over_unbudgeted"],
+        "device": "host",
+        "points": results,
+        "no_spill_ratio": no_spill_ratio,
+        "no_spill_gate_pass": no_spill_ratio >= 0.95,
+        "host_cores": os.cpu_count(),
+        "host_load_1m": round(os.getloadavg()[0], 2),
+    }
+
+
 def run_obs_overhead(config, batches, batches2=None) -> dict:
     """Overhead guard for default-level metrics (docs/observability.md):
     the same throughput pipeline with the obs registry enabled vs
@@ -2597,6 +2763,14 @@ def run_config(device: str) -> dict:
             f"{out['value']:,} rows/s, "
             f"{out['vs_baseline']}x over the reference operator")
         return out
+    if config == "spill_scale":
+        out = run_spill_scale()
+        log(f"engine[spill_scale]: headline {out['metric']} = "
+            f"{out['value']:,} rows/s "
+            f"({out['vs_baseline']}x of unbudgeted), "
+            f"no-spill gate ratio {out['no_spill_ratio']} "
+            f"(pass={out['no_spill_gate_pass']})")
+        return out
     if config == "ingest_scale":
         if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
             TOTAL_ROWS = 4_000_000  # bounded by broker memory + encode time
@@ -2784,9 +2958,11 @@ def main():
     if CONFIG not in (
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
         "ingest_scale", "decode_scale", "session", "session_scale",
+        "spill_scale",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
-    if CONFIG in ("decode_scale", "session", "session_scale"):
+    if CONFIG in ("decode_scale", "session", "session_scale",
+                  "spill_scale"):
         # pure host-side benchmarks (decoder / session operator): no
         # device, no TPU relay wait
         device = "host"

@@ -178,6 +178,16 @@ class EngineConfig:
     # verdicts as the projection closes in.  None = no budget (growth
     # forecasts still reported, without a time-to-budget).
     state_budget_bytes: int | None = None
+    # tiered state (state/tiering.py, docs/state_spill.md): when a budget
+    # AND a state backend are both configured, stateful operators evict
+    # their coldest key/batch/window blocks to the LSM once accounted
+    # state crosses the budget, and reload them on touch — the query
+    # degrades to disk speed instead of OOMing.  'auto' (default) =
+    # active exactly when budget + state_backend_path are set; False
+    # disables (budget stays forecast-only, PR-8 semantics); True
+    # additionally REQUIRES a backend path (loud error instead of a
+    # silently forecast-only budget).
+    state_spill: bool | str = "auto"
 
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a

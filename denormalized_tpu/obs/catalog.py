@@ -194,6 +194,52 @@ INSTRUMENTS: dict[str, tuple] = {
         "state key (framed bytes), labeled key=<node-scoped state key> "
         "— restore-size regressions are attributable to one operator",
     ),
+    # -- tiered state / spill (state/tiering.py) ------------------------
+    "dnz_state_spilled_bytes": (
+        "gauge",
+        "bytes of one stateful operator's keyed state currently resident "
+        "in the cold LSM tier instead of RAM (payload bytes as stored), "
+        "labeled node=<plan node id>",
+    ),
+    "dnz_state_spilled_keys": (
+        "gauge",
+        "keys/groups (join: retained rows) whose state currently lives "
+        "in the cold LSM tier, labeled node=<plan node id>",
+    ),
+    "dnz_spill_op_ms": (
+        "histogram",
+        "latency of one cold-tier block operation, labeled "
+        "op=spill|reload (spill = serialize + LSM put of one evicted "
+        "block; reload = LSM get on touch, excluding re-merge)",
+        MS_BUCKETS,
+    ),
+    "dnz_spill_blocks_total": (
+        "counter",
+        "cold-tier blocks moved, labeled op=spill|reload — a reload "
+        "rate tracking the spill rate is the spill-thrashing signal",
+    ),
+    "dnz_spill_backpressure_total": (
+        "counter",
+        "escalations to end-of-line prefetch backpressure because "
+        "accounted state exceeded the hard ceiling with no evictable "
+        "cold state left",
+    ),
+    # -- sink (sources/kafka.py KafkaSinkWriter) ------------------------
+    "dnz_sink_retries_total": (
+        "counter",
+        "transient produce errors absorbed by the sink's bounded "
+        "exp-backoff retry (registry view of KafkaSinkWriter."
+        "sink_retries) — a rising rate means the output broker is "
+        "flapping even though segments still succeed",
+    ),
+    # -- source salvage (sources/kafka.py _salvage_decode) --------------
+    "dnz_source_salvaged_rows": (
+        "gauge",
+        "poison records skipped by per-record salvage decode (the fetch "
+        "kept its co-fetched good rows; these were undecodable and "
+        "dropped), labeled source= and partition= — invisible data loss "
+        "otherwise",
+    ),
     # -- fault injection (runtime/faults.py) ----------------------------
     "dnz_fault_injections_total": (
         "counter",

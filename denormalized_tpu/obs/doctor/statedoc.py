@@ -25,19 +25,29 @@ STATE_VERDICT_RULES = (
     "watermark by more than {leak} retention units (session gap / "
     "window length / join retention); "
     "state-budget-pressure: projected time-to-budget against "
-    "EngineConfig(state_budget_bytes) is under {pressure:.0f}s."
+    "EngineConfig(state_budget_bytes) is under {pressure:.0f}s; "
+    "spill-thrashing: the cold tier reloaded >= {thrash_ratio:.0%} of "
+    "the blocks it spilled within the rolling {thrash_window:.0f}s "
+    "window (>= {thrash_min} spills) — the working set does not fit the "
+    "budget and state is ping-ponging through the LSM."
 )
 
 SKEW_SHARE_MIN = 0.2
 SKEW_FACTOR_MIN = 4.0
 RETENTION_LEAK_UNITS = 3
 BUDGET_PRESSURE_S = 600.0
+THRASH_RATIO_MIN = 0.5
+THRASH_SPILLS_MIN = 4
 
 
 def rules_text() -> str:
+    from denormalized_tpu.state.tiering import THRASH_WINDOW_S
+
     return STATE_VERDICT_RULES.format(
         share=SKEW_SHARE_MIN, factor=SKEW_FACTOR_MIN,
         leak=RETENTION_LEAK_UNITS, pressure=BUDGET_PRESSURE_S,
+        thrash_ratio=THRASH_RATIO_MIN, thrash_window=THRASH_WINDOW_S,
+        thrash_min=THRASH_SPILLS_MIN,
     )
 
 
@@ -159,6 +169,27 @@ def verdicts(nodes: list[dict], budget=None) -> list[dict]:
                     "retained far past its close horizon"
                 ),
             })
+        sp = n.get("spill")
+        if sp:
+            rs = int(sp.get("recent_spill_blocks") or 0)
+            rr = int(sp.get("recent_reload_blocks") or 0)
+            if rs >= THRASH_SPILLS_MIN and rr >= THRASH_RATIO_MIN * rs:
+                out.append({
+                    "kind": "spill-thrashing",
+                    "node_id": nid,
+                    "severity": round(min(1.0, rr / max(rs, 1)), 4),
+                    "recent_spill_blocks": rs,
+                    "recent_reload_blocks": rr,
+                    "spilled_bytes": n.get("spilled_bytes") or 0,
+                    "detail": (
+                        f"cold tier reloaded {rr} of the {rs} blocks it "
+                        "spilled inside the rolling window — the hot "
+                        "working set exceeds state_budget_bytes, so "
+                        "state is ping-ponging through the LSM instead "
+                        "of settling; raise the budget or expect "
+                        "disk-speed throughput"
+                    ),
+                })
         fc = n.get("forecast")
         if (
             n.get("op") in ("session", "session_ref")

@@ -270,6 +270,15 @@ class ExecOperator:
             "dnz_state_oldest_event_lag_ms", field("oldest_event_lag_ms"),
             node=node_id,
         )
+        # cold tier (state/tiering.py): zero when no budget/backend is
+        # configured or nothing is spilled — the same state_info fields
+        # the /state endpoint and the spill-thrashing verdict read
+        obs.gauge_fn(
+            "dnz_state_spilled_bytes", field("spilled_bytes"), node=node_id
+        )
+        obs.gauge_fn(
+            "dnz_state_spilled_keys", field("spilled_keys"), node=node_id
+        )
 
         def skew():
             from denormalized_tpu.obs.statewatch import side_live_keys

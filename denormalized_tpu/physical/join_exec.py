@@ -231,6 +231,276 @@ class _SideState:
         return merged.take(inv)
 
 
+class _JoinTier:
+    """Cold tier of one streaming join: spills whole retained batches
+    (the row payload — the chained index arrays stay resident, they ARE
+    the probe structure) per side into the LSM, reloading a batch only
+    when a probe hit, an outer-join unmatched emission, or a checkpoint
+    actually needs its rows.  Cold rank: least-recently reloaded first,
+    oldest event time as the tiebreak — retention-horizon rows evicted
+    cold can die in the LSM without ever being read back.
+
+    The newest batch of each side is never spilled (it is the batch the
+    operator is processing)."""
+
+    __slots__ = (
+        "op", "node_id", "ctrl", "clock", "touch", "est", "blocks",
+        "spilled_bytes", "spilled_rows", "_next",
+    )
+
+    #: estimated row-array overhead per retained row (link/bi/ri/gid/
+    #: matched across the chained arrays)
+    ROW_OVERHEAD = 32
+
+    def __init__(self, op: "StreamingJoinExec", node_id: str, ctrl) -> None:
+        self.op = op
+        self.node_id = node_id
+        self.ctrl = ctrl
+        self.clock = 0
+        # per side, aligned with side.batches: touch stamp + cached
+        # accounting-bytes estimate; spilled-block map {bi: {...}}
+        self.touch: list[list[int]] = [[], []]
+        self.est: list[list[int]] = [[], []]
+        self.blocks: list[dict[int, dict]] = [{}, {}]
+        self.spilled_bytes = 0
+        self.spilled_rows = 0
+        self._next = 0
+        ctrl.register(node_id, op, self.resident_bytes)
+
+    def _side_idx(self, side) -> int:
+        return 0 if side is self.op._sides[0] else 1
+
+    def resident_bytes(self) -> int:
+        """Cheap per-batch budget input: cached per-batch estimates of
+        the RESIDENT batches plus the row-array overhead (O(#batches),
+        the same bound the eviction scan already pays per batch).
+
+        May be called from ANOTHER operator's thread (the controller
+        sums every adapter): snapshot the list references and bound the
+        index, so racing the join thread between batches.append and
+        est.append tears to a one-batch underestimate, never an
+        IndexError."""
+        sides = self.op._sides
+        if sides is None:
+            return 0
+        total = 0
+        for sid, side in enumerate(sides):
+            est = self.est[sid]
+            batches = side.batches
+            for bi in range(min(len(batches), len(est))):
+                if batches[bi] is not None:
+                    total += est[bi]
+            total += side.count * self.ROW_OVERHEAD
+        return total
+
+    @property
+    def any_spilled(self) -> bool:
+        return bool(self.blocks[0]) or bool(self.blocks[1])
+
+    def note_insert(self, side_id: int, batch: RecordBatch) -> None:
+        from denormalized_tpu.obs.statewatch import rb_nbytes
+
+        self.clock += 1
+        self.touch[side_id].append(self.clock)
+        self.est[side_id].append(rb_nbytes(batch))
+
+    # -- reload-on-touch --------------------------------------------------
+    def ensure_rows_resident(self, side, build_rows: np.ndarray) -> None:
+        """Reload every spilled batch the given build rows live in —
+        called right before ``gather`` materializes them."""
+        sid = self._side_idx(side)
+        if not self.blocks[sid] or len(build_rows) == 0:
+            return
+        for bi in np.unique(side.row_bi[build_rows]).tolist():
+            if int(bi) in self.blocks[sid]:
+                self._reload(sid, side, int(bi))
+        self._write_manifest()
+
+    def _reload(self, sid: int, side, bi: int) -> None:
+        meta = self.blocks[sid].pop(bi)
+        raw = self.ctrl.get_block(self.node_id, meta["id"])
+        from denormalized_tpu.state.tiering import rb_from_blob
+
+        schema = (self.op.left if sid == 0 else self.op.right).schema
+        rb, _extra = rb_from_blob(raw, schema)
+        side.batches[bi] = rb
+        self.clock += 1
+        self.touch[sid][bi] = self.clock
+        self.spilled_bytes -= meta["bytes"]
+        self.spilled_rows -= meta["rows"]
+        self.ctrl.note_reload(self.node_id, 1, len(raw))
+        self.ctrl.delete_block(self.node_id, meta["id"])
+        self.op._state_info_cache = None
+
+    # -- eviction interplay ----------------------------------------------
+    def evict_prepare(
+        self, side, is_left: bool, drop_bi: np.ndarray, um: np.ndarray | None
+    ) -> None:
+        """Before the eviction gather: reload dropped spilled batches
+        that still owe unmatched emissions; DELETE the rest unread (cold
+        rows dying at the horizon never come back from the LSM)."""
+        sid = self._side_idx(side)
+        if not self.blocks[sid]:
+            return
+        n = side.count
+        needed: set[int] = set()
+        if um is not None and um.any():
+            needed = set(np.unique(side.row_bi[:n][um]).tolist())
+        for bi in drop_bi.tolist():
+            if int(bi) not in self.blocks[sid]:
+                continue
+            if int(bi) in needed:
+                self._reload(sid, side, int(bi))
+            else:
+                meta = self.blocks[sid].pop(int(bi))
+                self.spilled_bytes -= meta["bytes"]
+                self.spilled_rows -= meta["rows"]
+                self.ctrl.delete_block(self.node_id, meta["id"])
+        self._write_manifest()
+
+    def evict_remap(self, side, drop_set: np.ndarray, remap_bi) -> None:
+        """After ``rebuild`` renumbered batch indices, renumber the
+        touch stamps and block map the same way."""
+        sid = self._side_idx(side)
+        self.touch[sid] = [
+            t for bi, t in enumerate(self.touch[sid]) if not drop_set[bi]
+        ]
+        self.est[sid] = [
+            e for bi, e in enumerate(self.est[sid]) if not drop_set[bi]
+        ]
+        if self.blocks[sid]:
+            self.blocks[sid] = {
+                int(remap_bi[bi]): meta
+                for bi, meta in self.blocks[sid].items()
+            }
+
+    # -- eviction ---------------------------------------------------------
+    def maybe_spill(self) -> None:
+        need = self.ctrl.over_budget()
+        if need <= 0:
+            self.ctrl.relax(self.node_id)
+            return
+        sides = self.op._sides
+        # (stamp, max_ts, sid, bi) of every resident, spillable batch —
+        # the NEWEST batch of each side stays resident
+        cands = []
+        for sid, side in enumerate(sides):
+            newest = len(side.batches) - 1
+            for bi, b in enumerate(side.batches):
+                if b is None or bi == newest or b.num_rows == 0:
+                    continue
+                cands.append(
+                    (self.touch[sid][bi], side.batch_max_ts[bi], sid, bi)
+                )
+        cands.sort()
+        freed = 0
+        spilled_any = False
+        from denormalized_tpu.common.errors import StateError
+
+        for _stamp, _mx, sid, bi in cands:
+            if freed >= need:
+                break
+            try:
+                self._spill(sid, sides[sid], bi)
+            except StateError as e:
+                # failed eviction put: the batch stays resident; degrade
+                # to backpressure below rather than kill the query
+                from denormalized_tpu.runtime.tracing import logger
+
+                logger.warning(
+                    "spill: join eviction put failed (%s) — batch stays "
+                    "resident", e,
+                )
+                break
+            freed += self.blocks[sid][bi]["est"]
+            spilled_any = True
+        if spilled_any:
+            self._write_manifest()
+            self.op._state_info_cache = None
+        self.ctrl.check_pressure(self.node_id)
+
+    def _spill(self, sid: int, side, bi: int) -> None:
+        from denormalized_tpu.obs.statewatch import rb_nbytes
+        from denormalized_tpu.state.tiering import rb_to_blob
+
+        batch = side.batches[bi]
+        blob = rb_to_blob(
+            batch, extra_meta={"max_ts": int(side.batch_max_ts[bi])}
+        )
+        block_id = f"s{sid}b{self._next}"
+        self._next += 1
+        nbytes = self.ctrl.put_block(self.node_id, block_id, blob)
+        self.blocks[sid][bi] = {
+            "id": block_id,
+            "bytes": nbytes,
+            "rows": batch.num_rows,
+            "est": rb_nbytes(batch),
+        }
+        side.batches[bi] = None
+        self.spilled_bytes += nbytes
+        self.spilled_rows += batch.num_rows
+        self.ctrl.note_spill(self.node_id, 1, nbytes)
+
+    def _write_manifest(self) -> None:
+        self.ctrl.write_manifest(
+            self.node_id,
+            [m["id"] for s in self.blocks for m in s.values()],
+        )
+
+    def info(self) -> dict:
+        return {
+            "spilled_bytes": self.spilled_bytes,
+            "spilled_keys": self.spilled_rows,
+            "spilled_blocks": len(self.blocks[0]) + len(self.blocks[1]),
+            "spill": self.ctrl.spill_stats(self.node_id),
+        }
+
+    # -- checkpoint integration -------------------------------------------
+    def snapshot_refs(self, coord, key: str, epoch: int) -> list[dict]:
+        refs = []
+        for sid in (0, 1):
+            for bi in sorted(self.blocks[sid]):
+                meta = self.blocks[sid][bi]
+                self.ctrl.copy_block_to_epoch(
+                    coord, key, epoch, self.node_id, meta["id"]
+                )
+                refs.append({
+                    "side": sid, "bi": bi, "id": meta["id"],
+                    "bytes": meta["bytes"], "rows": meta["rows"],
+                    "est": meta["est"],
+                })
+        return refs
+
+    def restore_block(self, coord, key: str, ref: dict) -> None:
+        """Epoch blob → spill namespace; tier map entry re-armed without
+        materializing the rows."""
+        raw = self.ctrl.restore_block_from_epoch(
+            coord, key, self.node_id, ref["id"]
+        )
+        sid, bi = int(ref["side"]), int(ref["bi"])
+        self.blocks[sid][bi] = {
+            "id": ref["id"], "bytes": len(raw),
+            "rows": int(ref["rows"]), "est": int(ref["est"]),
+        }
+        self.spilled_bytes += len(raw)
+        self.spilled_rows += int(ref["rows"])
+        seq = int(ref["id"].rsplit("b", 1)[1])
+        self._next = max(self._next, seq + 1)
+
+    def align_touch(self, sides) -> None:
+        """After a restore rebuilt the batch lists, re-seed the touch
+        stamps (everything equally cold; reload order then follows event
+        time) and the per-batch byte estimates."""
+        from denormalized_tpu.obs.statewatch import rb_nbytes
+
+        for sid, side in enumerate(sides):
+            self.touch[sid] = [0] * len(side.batches)
+            self.est[sid] = [
+                self.blocks[sid][bi]["est"] if b is None else rb_nbytes(b)
+                for bi, b in enumerate(side.batches)
+            ]
+
+
 class StreamingJoinExec(ExecOperator):
     def __init__(
         self,
@@ -285,6 +555,8 @@ class StreamingJoinExec(ExecOperator):
         self._reintern_min = 262_144
         # checkpointing (None = disabled): set by enable_checkpointing
         self._ckpt: tuple | None = None
+        # cold tier (state/tiering.py): set by enable_spill
+        self._tier: _JoinTier | None = None
         # ONE interner for the join: both sides' keys map to the same dense
         # ids (strings take the native PyObject fast path)
         self._interner = GroupInterner(len(left_keys))
@@ -320,6 +592,10 @@ class StreamingJoinExec(ExecOperator):
         on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
         return f"StreamingJoinExec({self.kind.value} on {on})"
 
+    # -- cold tier (state/tiering.py) -----------------------------------
+    def enable_spill(self, node_id: str, controller) -> None:
+        self._tier = _JoinTier(self, node_id, controller)
+
     # -- state observatory (obs/statewatch.py) --------------------------
     def _side_state_info(self, side: "_SideState") -> dict:
         from denormalized_tpu.obs import statewatch as swm
@@ -329,7 +605,11 @@ class StreamingJoinExec(ExecOperator):
             side.link.itemsize + side.row_bi.itemsize
             + side.row_ri.itemsize + side.row_gid.itemsize + 1  # matched
         )
-        batch_bytes = sum(swm.rb_nbytes(b) for b in side.batches)
+        # spilled batches sit as None placeholders: their rows cost the
+        # LSM, not RAM — resident accounting skips them
+        batch_bytes = sum(
+            swm.rb_nbytes(b) for b in side.batches if b is not None
+        )
         live_k = int(np.count_nonzero(side.head >= 0))
         oldest = min(side.batch_max_ts) if side.batch_max_ts else None
         return {
@@ -371,6 +651,8 @@ class StreamingJoinExec(ExecOperator):
             info["oldest_event_lag_ms"] = max(
                 0, int(min(wms)) - int(min(olds))
             )
+        if self._tier is not None:
+            info.update(self._tier.info())
         return info
 
     def _state_watch_views(self):
@@ -414,6 +696,10 @@ class StreamingJoinExec(ExecOperator):
                 np.ones(len(p_idx), dtype=bool), probe_is_left,
                 probe_base, probe_side, build,
             )
+        if self._tier is not None:
+            # membership pre-probe: any spilled batch a hit landed in
+            # reloads before gather (no spilled blocks = attribute check)
+            self._tier.ensure_rows_resident(build, b_rows)
         p_take = probe_batch.take(p_idx)
         b_take = build.gather(b_rows)
         probe_cols = {n: p_take.column(n) for n in p_take.schema.names}
@@ -472,6 +758,8 @@ class StreamingJoinExec(ExecOperator):
         if self.kind is JoinKind.LEFT_SEMI:
             newly = np.unique(bk[~pre])
             if len(newly):
+                if self._tier is not None:
+                    self._tier.ensure_rows_resident(build, newly)
                 return build.gather(newly)
         return None
 
@@ -490,8 +778,17 @@ class StreamingJoinExec(ExecOperator):
         n = side.count
         row_dropped = drop_set[side.row_bi[:n]]
         unmatched: list[RecordBatch] = []
+        um_rows = (
+            row_dropped & ~side.matched[:n]
+            if self._emits_unmatched(is_left)
+            else None
+        )
+        if self._tier is not None:
+            # dropped spilled batches owing unmatched emissions reload;
+            # the rest die in the LSM without ever being read back
+            self._tier.evict_prepare(side, is_left, drop_bi, um_rows)
         if self._emits_unmatched(is_left):
-            um = row_dropped & ~side.matched[:n]
+            um = um_rows
             for bi in drop_bi:
                 sel = um & (side.row_bi[:n] == bi)
                 if sel.any():
@@ -516,6 +813,8 @@ class StreamingJoinExec(ExecOperator):
             side.row_ri[:n][keep_rows].copy(),
             side.matched[:n][keep_rows].copy(),
         )
+        if self._tier is not None:
+            self._tier.evict_remap(side, drop_set, remap_bi)
         return unmatched
 
     def _evict_horizon(self, sides) -> "Iterator[RecordBatch]":
@@ -537,6 +836,12 @@ class StreamingJoinExec(ExecOperator):
         # so memory stays bounded by retention, not stream lifetime
         retained = sides[0].count + sides[1].count
         if len(self._interner) > max(self._reintern_min, 4 * retained):
+            if self._tier is not None and self._tier.any_spilled:
+                # re-interning reads every retained batch's key columns —
+                # reloading the whole cold tier for it would defeat the
+                # spill; defer until the cold set drains (eviction keeps
+                # the interner bounded by retention regardless)
+                return
             self._reintern(sides)
 
     def _reintern(self, sides) -> None:
@@ -615,17 +920,24 @@ class StreamingJoinExec(ExecOperator):
         from denormalized_tpu.state.serialization import pack_snapshot
 
         coord, key = self._ckpt
+        spilled = self._tier is not None and self._tier.any_spilled
         meta: dict = {"epoch": epoch, "sides": []}
         arrays: dict[str, np.ndarray] = {}
+        if spilled:
+            # v2 (cold tier active): spilled blocks are referenced from
+            # this snapshot and their payloads committed under the SAME
+            # epoch; per-row gids + the shared interner ride along so
+            # restore never materializes cold rows to re-intern them
+            meta["interner"] = self._interner.snapshot()
+            meta["spill"] = {
+                "blocks": self._tier.snapshot_refs(coord, key, epoch)
+            }
         for sid, (side, schema) in enumerate(
             zip(sides, (self.left.schema, self.right.schema))
         ):
             n = side.count
-            rows = (
-                RecordBatch.concat(side.batches)
-                if side.batches
-                else None
-            )
+            resident = [b for b in side.batches if b is not None]
+            rows = RecordBatch.concat(resident) if resident else None
             side_meta = {
                 "watermark": side.watermark,
                 "count": n,
@@ -633,7 +945,8 @@ class StreamingJoinExec(ExecOperator):
                 "masked": [],
             }
             if rows is not None:
-                assert rows.num_rows == n  # insert order == row-array order
+                # insert order == row-array order (v2: resident rows only)
+                assert spilled or rows.num_rows == n
                 for f in schema:
                     colv = np.asarray(rows.column(f.name))
                     if colv.dtype == object:
@@ -648,6 +961,7 @@ class StreamingJoinExec(ExecOperator):
                         arrays[f"s{sid}_mask_{f.name}"] = np.asarray(
                             mask, dtype=bool
                         )
+            if n and (rows is not None or spilled):
                 arrays[f"s{sid}_matched"] = side.matched[:n].copy()
                 # per-batch boundaries: restore must keep the original
                 # batch granularity or whole-batch max-ts eviction would
@@ -656,6 +970,8 @@ class StreamingJoinExec(ExecOperator):
                 arrays[f"s{sid}_batch_max_ts"] = np.asarray(
                     side.batch_max_ts, dtype=np.int64
                 )
+                if spilled:
+                    arrays[f"s{sid}_row_gid"] = side.row_gid[:n].copy()
             meta["sides"].append(side_meta)
         coord.put_snapshot(key, epoch, pack_snapshot(meta, arrays))
 
@@ -667,6 +983,18 @@ class StreamingJoinExec(ExecOperator):
         if blob is None:
             return
         meta, arrays = unpack_snapshot(blob)
+        if meta.get("spill") is not None:
+            self._restore_v2(coord, key, meta, arrays, sides)
+            return
+        self._restore_v1(meta, arrays, sides)
+        if self._tier is not None:
+            # a v1 (nothing-spilled-at-the-cut) snapshot restored into a
+            # budgeted run: the tier's per-batch touch/est lists must
+            # cover the rebuilt batch lists or the first budget check
+            # indexes past them
+            self._tier.align_touch(sides)
+
+    def _restore_v1(self, meta, arrays, sides) -> None:
         for sid, (side, schema, names) in enumerate(
             zip(
                 sides,
@@ -725,6 +1053,112 @@ class StreamingJoinExec(ExecOperator):
                 ris,
                 arrays[f"s{sid}_matched"].astype(bool),
             )
+
+    def _restore_v2(self, coord, key, meta, arrays, sides) -> None:
+        """Restore a cold-tier snapshot: the interner and per-row gids
+        come from the blob (no re-intern), resident batches rebuild from
+        the resident-row concat, and spilled batches re-arm as tier-map
+        placeholders — their payloads stream epoch → spill namespace one
+        at a time.  Without a tier (budget removed since the checkpoint)
+        spilled batches materialize resident instead."""
+        from denormalized_tpu.state.tiering import rb_from_blob
+
+        self._interner = GroupInterner.restore(meta["interner"])
+        refs = meta["spill"]["blocks"]
+        by_side: list[dict[int, dict]] = [{}, {}]
+        for ref in refs:
+            by_side[int(ref["side"])][int(ref["bi"])] = ref
+        for sid, (side, schema) in enumerate(
+            zip(sides, (self.left.schema, self.right.schema))
+        ):
+            side_meta = meta["sides"][sid]
+            side.watermark = side_meta["watermark"]
+            n = int(side_meta["count"])
+            if n == 0:
+                continue
+            bis = arrays[f"s{sid}_row_bi"].astype(np.int32)
+            batch_max_ts = [
+                int(x) for x in arrays[f"s{sid}_batch_max_ts"]
+            ]
+            gids = arrays[f"s{sid}_row_gid"].astype(np.int32)
+            # resident-row concat (absent when every batch spilled)
+            resident_rows = n - sum(
+                int(r["rows"]) for r in by_side[sid].values()
+            )
+            merged = None
+            if resident_rows > 0:
+                cols, masks = [], []
+                for f in schema:
+                    if f.name in side_meta["strings"]:
+                        vals = side_meta["strings"][f.name]
+                        arr = np.empty(len(vals), dtype=object)
+                        arr[:] = vals
+                        cols.append(arr)
+                    else:
+                        cols.append(arrays[f"s{sid}_col_{f.name}"])
+                    masks.append(
+                        arrays.get(f"s{sid}_mask_{f.name}")
+                        if f.name in side_meta["masked"]
+                        else None
+                    )
+                merged = RecordBatch(schema, cols, masks)
+            bounds = np.nonzero(
+                np.concatenate(([True], bis[1:] != bis[:-1]))
+            )[0]
+            ends = np.append(bounds[1:], n)
+            batches: list[RecordBatch | None] = []
+            cursor = 0
+            for new_bi, (b0, b1) in enumerate(zip(bounds, ends)):
+                orig_bi = int(bis[b0])
+                ref = by_side[sid].get(orig_bi)
+                if ref is not None:
+                    if self._tier is not None:
+                        self._tier.restore_block(
+                            coord, key, {**ref, "bi": new_bi}
+                        )
+                        batches.append(None)
+                    else:
+                        raw = coord.get_snapshot(
+                            f"{key}:spill:{ref['id']}"
+                        )
+                        if raw is None:
+                            from denormalized_tpu.common.errors import (
+                                StateError,
+                            )
+
+                            raise StateError(
+                                "checkpoint references spilled join "
+                                f"block {ref['id']!r} but the epoch "
+                                "holds no such snapshot"
+                            )
+                        rb, _extra = rb_from_blob(raw, schema)
+                        batches.append(rb)
+                else:
+                    ln = int(b1 - b0)
+                    batches.append(
+                        merged.take(
+                            np.arange(cursor, cursor + ln, dtype=np.int64)
+                        )
+                    )
+                    cursor += ln
+            ris = np.concatenate(
+                [np.arange(b1 - b0, dtype=np.int32)
+                 for b0, b1 in zip(bounds, ends)]
+            )
+            run_bi = np.cumsum(
+                np.concatenate(([True], bis[1:] != bis[:-1]))
+            ) - 1
+            side.rebuild(
+                batches,
+                [batch_max_ts[int(bis[b0])] for b0 in bounds],
+                gids,
+                run_bi.astype(np.int32),
+                ris,
+                arrays[f"s{sid}_matched"].astype(bool),
+            )
+        if self._tier is not None:
+            self._tier.align_touch(sides)
+            self._tier._write_manifest()
 
     # ------------------------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
@@ -872,6 +1306,8 @@ class StreamingJoinExec(ExecOperator):
                 # this batch's rows must not be cleared by a later insert
                 probe_base = side.count
                 side.insert(batch, gids)
+                if self._tier is not None:
+                    self._tier.note_insert(side_id, batch)
                 out = self._probe(
                     batch, gids, other, is_left, probe_base, side
                 )
@@ -889,6 +1325,8 @@ class StreamingJoinExec(ExecOperator):
                     if side.watermark is None or bmin > side.watermark:
                         side.watermark = bmin
                 yield from self._evict_horizon(sides)
+                if self._tier is not None:
+                    self._tier.maybe_spill()
             # EOS: flush unmatched for outer joins
             for s, l in ((sides[0], True), (sides[1], False)):
                 if self._emits_unmatched(l):

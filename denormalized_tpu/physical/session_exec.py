@@ -100,6 +100,318 @@ def _segmented_cummax(vals: np.ndarray, seg_start: np.ndarray) -> np.ndarray:
     return out
 
 
+class _SessionTier:
+    """Cold tier of one session operator: evicts the coldest gids' open
+    sessions (whole-gid granularity, blocks of up to
+    ``tiering.SPILL_BLOCK_SLOTS`` slots) out of the SoA table into the
+    LSM, reloads them when a batch touches their keys, the watermark
+    reaches their gap, a checkpoint snapshots, or the stream ends.
+
+    Invariant: a gid is either fully resident or fully spilled — touch
+    reloads BEFORE any merge, so the table never holds a partial view of
+    a spilled key.  Spilled gids keep their interner entries (the key →
+    gid mapping is the membership filter's index), and the operator's
+    release sites filter them out so a spilled gid can never be recycled
+    out from under its block (reload re-interns key VALUES, so even a
+    restore — which rebuilds the gid space — maps blocks back
+    correctly)."""
+
+    __slots__ = (
+        "op", "node_id", "ctrl", "cold", "any_spilled", "spilled_bytes",
+        "spilled_keys", "_block_of", "_blocks", "_next",
+    )
+
+    def __init__(self, op: "SessionWindowExec", node_id: str, ctrl) -> None:
+        from denormalized_tpu.state import tiering
+
+        self.op = op
+        self.node_id = node_id
+        self.ctrl = ctrl
+        self.cold = tiering.ColdTracker()
+        self.any_spilled = False
+        self.spilled_bytes = 0
+        self.spilled_keys = 0
+        self._block_of = np.full(1024, -1, dtype=np.int64)
+        self._blocks: dict[int, dict] = {}
+        self._next = 0
+        ctrl.register(node_id, op, self.resident_bytes)
+
+    def resident_bytes(self) -> int:
+        """O(1) resident estimate for the per-batch budget check (live
+        slot count x exact per-slot bytes + the documented per-object
+        estimates — the same formula as state_info, minus its live-slot
+        scans)."""
+        from denormalized_tpu.obs import statewatch as swm
+
+        op = self.op
+        T = op._table
+        return (
+            len(T) * T.per_slot_nbytes()
+            + len(T.accs) * swm.ACC_EST_BYTES
+            + len(op._interner) * swm.KEY_EST_BYTES
+        )
+
+    def _ensure_maps(self, n: int) -> None:
+        self.cold.ensure(n)
+        cap = len(self._block_of)
+        if n <= cap:
+            return
+        while cap < n:
+            cap *= 2
+        new = np.full(cap, -1, dtype=np.int64)
+        new[: len(self._block_of)] = self._block_of
+        self._block_of = new
+
+    # -- hot path: membership filter + touch stamp -----------------------
+    def touch(self, gids: np.ndarray) -> np.ndarray | None:
+        """Stamp the batch's gids hot and return the block ids any of
+        them live in (None when the cold set is empty — the common case
+        pays one attribute check and one scatter)."""
+        self._ensure_maps(self.op._interner.capacity)
+        self.cold.touch(gids)
+        if not self.any_spilled:
+            return None
+        b = self._block_of[gids]
+        hit = b[b >= 0]
+        if len(hit) == 0:
+            return None
+        return np.unique(hit)
+
+    def touch_and_reload(self, gids: np.ndarray) -> None:
+        hits = self.touch(gids)
+        if hits is not None:
+            for bid in hits.tolist():
+                self._reload_block(int(bid))
+            self._write_manifest()
+
+    # -- eviction ---------------------------------------------------------
+    def maybe_spill(self, protect_gids: np.ndarray) -> None:
+        from denormalized_tpu.state import tiering
+
+        need = self.ctrl.over_budget()
+        if need <= 0:
+            self.ctrl.relax(self.node_id)
+            return
+        op = self.op
+        T = op._table
+        live = T.live_slots()
+        spilled_any = False
+        if len(live):
+            per_slot = max(T.per_slot_nbytes(), 1)
+            self._ensure_maps(op._interner.capacity)
+            protect = np.zeros(len(self._block_of), dtype=bool)
+            protect[protect_gids] = True
+            live_gids = T.gid[live].astype(np.int64)
+            cand = live_gids[~protect[live_gids]]
+            if len(cand):
+                u, counts = np.unique(cand, return_counts=True)
+                order = np.argsort(
+                    self.cold.last_touch[u], kind="stable"
+                )
+                u = u[order]
+                counts = counts[order]
+                csum = np.cumsum(counts)
+                need_slots = -(-need // per_slot)
+                k = int(np.searchsorted(csum, need_slots)) + 1
+                k = min(k, len(u))
+                chosen, chosen_counts = u[:k], counts[:k]
+                # chunk the chosen gids into <= SPILL_BLOCK_SLOTS-slot
+                # blocks (slow path — spill cadence, never per row)
+                from denormalized_tpu.common.errors import StateError
+                from denormalized_tpu.runtime.tracing import logger
+
+                start = 0
+                acc = 0
+                for i in range(len(chosen)):
+                    acc += int(chosen_counts[i])
+                    if acc >= tiering.SPILL_BLOCK_SLOTS or i == len(chosen) - 1:
+                        try:
+                            self._spill_chunk(chosen[start : i + 1])
+                        except StateError as e:
+                            # a failed eviction put leaves the chunk
+                            # resident: degrade to backpressure below,
+                            # never kill the query over a spill write
+                            logger.warning(
+                                "spill: session eviction put failed "
+                                "(%s) — chunk stays resident", e,
+                            )
+                            break
+                        spilled_any = True
+                        start, acc = i + 1, 0
+                if spilled_any:
+                    self._write_manifest()
+                    op._state_info_cache = None
+        self.ctrl.check_pressure(self.node_id)
+
+    def _spill_chunk(self, gids_chunk: np.ndarray) -> None:
+        from denormalized_tpu.state.checkpoint import jsonable
+        from denormalized_tpu.state.serialization import pack_snapshot
+
+        op = self.op
+        T = op._table
+        slots, owner = T.open_slots_of(gids_chunk)
+        if len(slots) == 0:
+            return
+        fields = T.extract_slots(slots)
+        accs_meta = None
+        if op._udafs:
+            accs_meta = [
+                [acc.state() for acc in T.accs[int(s)]]
+                if int(s) in T.accs
+                else None
+                for s in slots.tolist()
+            ]
+        keys = op._interner.keys_of(gids_chunk)
+        meta = {
+            "keys": jsonable([list(c) for c in keys]),
+            "accs": jsonable(accs_meta),
+            "n": int(len(slots)),
+            "min_start": int(fields["start"].min()),
+            "min_last": int(fields["last"].min()),
+            "max_last": int(fields["last"].max()),
+        }
+        arrays = dict(fields)
+        arrays["owner"] = owner.astype(np.int32)
+        bid = self._next
+        self._next += 1
+        blob = pack_snapshot(meta, arrays)
+        nbytes = self.ctrl.put_block(self.node_id, f"b{bid}", blob)
+        T.remove_slots(slots)  # freed gids stay interned (spilled)
+        self._block_of[gids_chunk] = bid
+        self._blocks[bid] = {
+            "gids": gids_chunk.copy(),
+            "bytes": nbytes,
+            "min_start": meta["min_start"],
+            "min_last": meta["min_last"],
+            "max_last": meta["max_last"],
+        }
+        self.any_spilled = True
+        self.spilled_bytes += nbytes
+        self.spilled_keys += int(len(gids_chunk))
+        self.ctrl.note_spill(self.node_id, 1, nbytes)
+
+    # -- reload -----------------------------------------------------------
+    def _reload_block(self, bid: int) -> None:
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        meta = self._blocks.pop(bid)
+        op = self.op
+        raw = self.ctrl.get_block(self.node_id, f"b{bid}")
+        bmeta, arrays = unpack_snapshot(raw)
+        key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+        chunk_gids = op._interner.intern(key_cols).astype(np.int64)
+        self._ensure_maps(op._interner.capacity)
+        T = op._table
+        T.ensure_gids(op._interner.capacity)
+        slot_gids = chunk_gids[arrays["owner"]]
+        fields = {k: arrays[k] for k in T.SPILL_FIELDS}
+        slots = T.inject_slots(slot_gids, fields)
+        if bmeta.get("accs"):
+            for s, states in zip(slots.tolist(), bmeta["accs"]):
+                if states is None:
+                    continue
+                accs = op._make_accs()
+                for acc, st in zip(accs, states):
+                    acc.merge(st)
+                T.accs[int(s)] = accs
+        self._block_of[meta["gids"]] = -1
+        self._block_of[chunk_gids] = -1  # restore path: gids re-assigned
+        self.any_spilled = bool(self._blocks)
+        self.spilled_bytes -= meta["bytes"]
+        self.spilled_keys -= int(len(meta["gids"]))
+        self.ctrl.note_reload(self.node_id, 1, len(raw))
+        self.ctrl.delete_block(self.node_id, f"b{bid}")
+        op._state_info_cache = None
+
+    def reload_for_watermark(self, watermark: int) -> None:
+        """Blocks holding ANY gap-expired session reload so the close
+        sweep sees them — emission timing (and therefore output) stays
+        identical to the unbudgeted run."""
+        if not self.any_spilled:
+            return
+        gap = self.op.gap_ms
+        due = [
+            bid for bid, m in self._blocks.items()
+            if m["min_last"] + gap <= watermark
+        ]
+        for bid in due:
+            self._reload_block(bid)
+        if due:
+            self._write_manifest()
+
+    def reload_all(self) -> None:
+        for bid in list(self._blocks):
+            self._reload_block(bid)
+        self._write_manifest()
+
+    def _write_manifest(self) -> None:
+        self.ctrl.write_manifest(
+            self.node_id, [f"b{b}" for b in self._blocks]
+        )
+
+    # -- guards + accounting ---------------------------------------------
+    def filter_releasable(self, gids: np.ndarray) -> np.ndarray:
+        """Never recycle a gid whose sessions live in the cold tier."""
+        if not self.any_spilled or len(gids) == 0:
+            return gids
+        return gids[self._block_of[gids] < 0]
+
+    def min_start(self) -> int | None:
+        if not self._blocks:
+            return None
+        return min(m["min_start"] for m in self._blocks.values())
+
+    def info(self) -> dict:
+        return {
+            "spilled_bytes": self.spilled_bytes,
+            "spilled_keys": self.spilled_keys,
+            "spilled_blocks": len(self._blocks),
+            "spill": self.ctrl.spill_stats(self.node_id),
+        }
+
+    # -- checkpoint integration -------------------------------------------
+    def snapshot_refs(self, coord, key: str, epoch: int) -> list[int]:
+        bids = sorted(self._blocks)
+        for bid in bids:
+            self.ctrl.copy_block_to_epoch(
+                coord, key, epoch, self.node_id, f"b{bid}"
+            )
+        return bids
+
+    def restore_refs(self, coord, key: str, bids: list[int]) -> None:
+        """Rebuild the tier map from a committed epoch: each block's
+        payload streams back into the spill namespace (one at a time),
+        its keys re-intern into the fresh gid space, and the membership
+        maps re-arm — the cold tier is never materialized in RAM."""
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        op = self.op
+        for bid in bids:
+            raw = self.ctrl.restore_block_from_epoch(
+                coord, key, self.node_id, f"b{bid}"
+            )
+            bmeta, _arrays = unpack_snapshot(raw)
+            key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+            chunk_gids = op._interner.intern(key_cols).astype(np.int64)
+            self._ensure_maps(op._interner.capacity)
+            op._table.ensure_gids(op._interner.capacity)
+            self._block_of[chunk_gids] = bid
+            self._blocks[bid] = {
+                "gids": chunk_gids,
+                "bytes": len(raw),
+                "min_start": int(bmeta["min_start"]),
+                "min_last": int(bmeta["min_last"]),
+                "max_last": int(bmeta["max_last"]),
+            }
+            self.spilled_bytes += len(raw)
+            self.spilled_keys += int(len(chunk_gids))
+            self._next = max(self._next, bid + 1)
+        self.any_spilled = bool(self._blocks)
+        self._write_manifest()
+
+
 class SessionWindowExec(ExecOperator):
     def __init__(
         self,
@@ -162,6 +474,9 @@ class SessionWindowExec(ExecOperator):
         # longer advances the watermark (replay-skew safety)
         self._src_watermarks = False
         self._ckpt: tuple | None = None
+        # cold tier (state/tiering.py): installed by enable_spill when a
+        # state budget + backend are configured; None = all-resident
+        self._tier: _SessionTier | None = None
         self._metrics = {
             "rows_in": 0,
             "sessions_emitted": 0,
@@ -200,6 +515,10 @@ class SessionWindowExec(ExecOperator):
             f"groups=[{', '.join(g.name for g in self.group_exprs)}])"
         )
 
+    # -- cold tier (state/tiering.py) -----------------------------------
+    def enable_spill(self, node_id: str, controller) -> None:
+        self._tier = _SessionTier(self, node_id, controller)
+
     # -- state observatory (obs/statewatch.py) --------------------------
     def state_info(self) -> dict:
         from denormalized_tpu.obs import statewatch as swm
@@ -214,6 +533,10 @@ class SessionWindowExec(ExecOperator):
         keys = interner_accounting(self._interner)
         wm = self._watermark
         oldest = int(T.start[live].min()) if n_live else None
+        if self._tier is not None:
+            tmin = self._tier.min_start()
+            if tmin is not None:
+                oldest = tmin if oldest is None else min(oldest, tmin)
         info = {
             "op": "session",
             # live accounting only (restore-invariant by construction):
@@ -222,6 +545,14 @@ class SessionWindowExec(ExecOperator):
             "state_bytes": (
                 n_live * T.per_slot_nbytes()
                 + keys["live_keys"] * swm.KEY_EST_BYTES
+                + acc_objs * swm.ACC_EST_BYTES
+            ),
+            # the portion the cold tier can actually evict: slot storage
+            # + accumulators.  The interned-key index stays resident by
+            # design (it IS the spill membership filter) — the documented
+            # resident floor of a budgeted run (docs/state_spill.md)
+            "evictable_bytes": (
+                n_live * T.per_slot_nbytes()
                 + acc_objs * swm.ACC_EST_BYTES
             ),
             "capacity_bytes": T.capacity_nbytes(),
@@ -235,6 +566,8 @@ class SessionWindowExec(ExecOperator):
         }
         if wm is not None and oldest is not None:
             info["oldest_event_lag_ms"] = max(0, int(wm) - oldest)
+        if self._tier is not None:
+            info.update(self._tier.info())
         return info
 
     def _state_watch_views(self):
@@ -311,6 +644,11 @@ class SessionWindowExec(ExecOperator):
         key_cols = [g.eval(batch) for g in self.group_exprs]
         gids = self._interner.intern(key_cols)
         self._sw.update(gids)
+        if self._tier is not None:
+            # membership pre-probe + reload-on-touch: any spilled gid in
+            # this batch comes back resident BEFORE merging (costs one
+            # scatter + one gather when the cold set is empty)
+            self._tier.touch_and_reload(gids)
         self._table.ensure_gids(self._interner.capacity)
         vals = (
             np.stack(
@@ -437,8 +775,12 @@ class SessionWindowExec(ExecOperator):
             # a key whose only-ever rows were dropped-late holds no state:
             # recycle its gid immediately instead of leaking it
             idle = dropped_gids[self._table.head[dropped_gids] == -1]
+            if self._tier is not None:
+                idle = self._tier.filter_releasable(idle)
             if len(idle):
                 self._interner.release(idle)
+        if self._tier is not None:
+            self._tier.maybe_spill(gids)
 
     def _merge_segments(
         self,
@@ -605,6 +947,10 @@ class SessionWindowExec(ExecOperator):
             lag = time.time() * 1000.0 - self._watermark
             self._obs_wm_lag.set(lag)
             self._obs_wm_lag_hist.observe(lag)
+        if self._tier is not None:
+            # gap-expired cold blocks come back resident so this sweep
+            # closes them on the same watermark the all-resident run does
+            self._tier.reload_for_watermark(self._watermark)
         expired = self._table.expired_slots(self.gap_ms, self._watermark)
         if len(expired) == 0:
             return
@@ -614,6 +960,8 @@ class SessionWindowExec(ExecOperator):
         expired = expired[order]
         out = self._emit_slots(expired)
         freed = self._table.remove_slots(expired)
+        if self._tier is not None:
+            freed = self._tier.filter_releasable(freed)
         if len(freed):
             # closed keys' dense ids go back to the interner free list
             self._interner.release(freed)
@@ -720,6 +1068,45 @@ class SessionWindowExec(ExecOperator):
             return
         self._watermark = snap["watermark"]
         self._restore_sessions(snap["sessions"])
+        bids = snap.get("spill_blocks") or []
+        if bids:
+            if self._tier is not None:
+                # rebuild the tier map (blocks stream epoch → spill
+                # namespace one at a time; cold state stays cold)
+                self._tier.restore_refs(coord, self._ckpt[1], bids)
+            else:
+                # budget removed since the checkpoint: degrade gracefully
+                # by loading the cold tier back resident
+                self._restore_spilled_resident(coord, self._ckpt[1], bids)
+
+    def _restore_spilled_resident(self, coord, key: str, bids: list) -> None:
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.common.errors import StateError
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        T = self._table
+        for bid in bids:
+            raw = coord.get_snapshot(f"{key}:spill:b{bid}")
+            if raw is None:
+                raise StateError(
+                    f"checkpoint references spilled session block b{bid} "
+                    "but the epoch holds no such snapshot"
+                )
+            bmeta, arrays = unpack_snapshot(raw)
+            key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+            chunk_gids = self._interner.intern(key_cols).astype(np.int64)
+            T.ensure_gids(self._interner.capacity)
+            slot_gids = chunk_gids[arrays["owner"]]
+            fields = {k: arrays[k] for k in T.SPILL_FIELDS}
+            slots = T.inject_slots(slot_gids, fields)
+            if bmeta.get("accs"):
+                for s, states in zip(slots.tolist(), bmeta["accs"]):
+                    if states is None:
+                        continue
+                    accs = self._make_accs()
+                    for acc, st in zip(accs, states):
+                        acc.merge(st)
+                    T.accs[int(s)] = accs
 
     def _restore_sessions(self, entries: list) -> None:
         self._interner = RecyclingGroupInterner(len(self.group_exprs))
@@ -799,10 +1186,18 @@ class SessionWindowExec(ExecOperator):
                     else None,
                 ]
             )
-        put_json(
-            coord, key, epoch,
-            {"epoch": epoch, "watermark": self._watermark, "sessions": sessions},
-        )
+        snap = {
+            "epoch": epoch, "watermark": self._watermark,
+            "sessions": sessions,
+        }
+        if self._tier is not None and self._tier.any_spilled:
+            # spilled + resident state commit under ONE epoch: block
+            # payloads re-put (CRC-framed, manifest-listed) under
+            # epoch-suffixed keys, referenced here by id
+            snap["spill_blocks"] = self._tier.snapshot_refs(
+                coord, key, epoch
+            )
+        put_json(coord, key, epoch, snap)
 
     def run(self) -> Iterator[StreamItem]:
         for item in self._doctor_input():
@@ -835,12 +1230,22 @@ class SessionWindowExec(ExecOperator):
                 lows = [item.ts_ms, floor]
                 if len(live):
                     lows.append(int(self._table.start[live].min()) - 1)
+                if self._tier is not None:
+                    tmin = self._tier.min_start()
+                    if tmin is not None:
+                        # spilled sessions are still open sessions: the
+                        # forward promise must stay below their starts too
+                        lows.append(tmin - 1)
                 yield WatermarkHint(min(lows), kind=item.kind)
             elif isinstance(item, Marker):
                 if self._ckpt is not None:
                     self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
+                if self._tier is not None:
+                    # the final flush emits EVERY open session, cold ones
+                    # included
+                    self._tier.reload_all()
                 live = self._table.live_slots()
                 if self.emit_on_close and len(live):
                     order = np.lexsort(

@@ -361,6 +361,11 @@ class SourceExec(ExecOperator):
             m["decode_fallback_rows"] = sum(
                 w.decode_fallback_total() for w in self._pump.workers
             )
+            # poison records skipped by salvage decode (silent data
+            # loss, now operator-visible) — soak reports read this
+            m["salvaged_rows"] = sum(
+                w.salvaged_total() for w in self._pump.workers
+            )
             # supervisor restart state: how many worker crashes this
             # source absorbed (and where), so a flapping partition is
             # visible even when every restart succeeded
@@ -372,6 +377,10 @@ class SourceExec(ExecOperator):
         else:
             m["decode_fallback_rows"] = sum(
                 r.decode_fallback_rows() for r in (self._readers or [])
+            )
+            m["salvaged_rows"] = sum(
+                int(getattr(r, "salvaged_rows", 0) or 0)
+                for r in (self._readers or [])
             )
         return m
 

@@ -104,6 +104,330 @@ class _BuiltinAcc:
         self.max = max(self.max, s[3])
 
 
+class _Spilled:
+    """In-place marker for a frame group whose accumulators live in the
+    cold tier.  The dict ENTRY stays (so reload restores the group at
+    its original position and emission row order matches the
+    all-resident run); only the heavy accumulator objects leave RAM."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return "<spilled>"
+
+
+SPILLED = _Spilled()
+
+
+class _UdafTier:
+    """Cold tier of one UDAF window operator: evicts the coldest gids'
+    accumulator states (across every open window they appear in) to the
+    LSM, leaving order-preserving markers in the frames; reloads when a
+    batch touches the key or the window emits."""
+
+    __slots__ = (
+        "op", "node_id", "ctrl", "cold", "any_spilled", "spilled_bytes",
+        "spilled_groups", "_block_of", "_blocks", "_next",
+    )
+
+    def __init__(self, op: "UdafWindowExec", node_id: str, ctrl) -> None:
+        from denormalized_tpu.state import tiering
+
+        self.op = op
+        self.node_id = node_id
+        self.ctrl = ctrl
+        self.cold = tiering.ColdTracker()
+        self.any_spilled = False
+        self.spilled_bytes = 0
+        self.spilled_groups = 0  # (window, gid) entries in the cold tier
+        self._block_of = np.full(1024, -1, dtype=np.int64)
+        self._blocks: dict[int, dict] = {}
+        self._next = 0
+        ctrl.register(node_id, op, self.resident_bytes)
+
+    def resident_bytes(self) -> int:
+        from denormalized_tpu.obs import statewatch as swm
+
+        op = self.op
+        # list() copy: this may run on another operator's thread while
+        # the udaf thread inserts/pops frames (controller-summed)
+        groups = sum(len(f) for f in list(op._frames.values()))
+        resident = groups - self.spilled_groups
+        n_aggs = max(len(op.aggr_exprs), 1)
+        keys = len(op._interner) if op._interner is not None else 0
+        return (
+            resident * n_aggs * swm.ACC_EST_BYTES
+            + keys * swm.KEY_EST_BYTES
+            + len(op._frames) * 64
+        )
+
+    def _ensure_maps(self, n: int) -> None:
+        self.cold.ensure(n)
+        cap = len(self._block_of)
+        if n <= cap:
+            return
+        while cap < n:
+            cap *= 2
+        new = np.full(cap, -1, dtype=np.int64)
+        new[: len(self._block_of)] = self._block_of
+        self._block_of = new
+
+    def _capacity(self) -> int:
+        return len(self.op._interner) if self.op._interner is not None else 1
+
+    # -- hot path ---------------------------------------------------------
+    def touch_and_reload(self, gids: np.ndarray) -> None:
+        self._ensure_maps(self._capacity())
+        self.cold.touch(gids)
+        if not self.any_spilled:
+            return
+        b = self._block_of[gids]
+        hit = b[b >= 0]
+        if len(hit) == 0:
+            return
+        for bid in np.unique(hit).tolist():
+            self._reload_block(int(bid))
+        self._write_manifest()
+
+    def reload_gid(self, gid: int) -> None:
+        """Defensive lazy reload for a marker encountered outside the
+        batched touch path."""
+        bid = int(self._block_of[gid]) if gid < len(self._block_of) else -1
+        if bid >= 0:
+            self._reload_block(bid)
+            self._write_manifest()
+
+    def reload_for_window(self, j: int) -> None:
+        """Reload every block holding entries of window ``j`` before it
+        emits — emission content and row order match the all-resident
+        run exactly."""
+        if not self.any_spilled:
+            return
+        due = [
+            bid for bid, m in self._blocks.items() if j in m["windows"]
+        ]
+        for bid in due:
+            self._reload_block(bid)
+        if due:
+            self._write_manifest()
+
+    # -- eviction ---------------------------------------------------------
+    def maybe_spill(self, protect_gids: np.ndarray) -> None:
+        from denormalized_tpu.obs import statewatch as swm
+        from denormalized_tpu.state import tiering
+
+        need = self.ctrl.over_budget()
+        if need <= 0:
+            self.ctrl.relax(self.node_id)
+            return
+        op = self.op
+        # live resident groups per gid (slow path: spill cadence only)
+        per_gid: dict[int, int] = {}
+        for frame in op._frames.values():
+            for g, accs in frame.items():
+                if accs is not SPILLED:
+                    per_gid[g] = per_gid.get(g, 0) + 1
+        self._ensure_maps(self._capacity())
+        protect = np.zeros(len(self._block_of), dtype=bool)
+        protect[protect_gids] = True
+        cand = np.asarray(
+            [g for g in per_gid if not protect[g]], dtype=np.int64
+        )
+        spilled_any = False
+        if len(cand):
+            cand = self.cold.order_cold(cand)
+            n_aggs = max(len(op.aggr_exprs), 1)
+            per_entry = n_aggs * swm.ACC_EST_BYTES
+            counts = np.asarray([per_gid[int(g)] for g in cand])
+            csum = np.cumsum(counts) * per_entry
+            k = int(np.searchsorted(csum, need)) + 1
+            k = min(k, len(cand))
+            # chunk into blocks of <= SPILL_BLOCK_SLOTS entries
+            from denormalized_tpu.common.errors import StateError
+            from denormalized_tpu.runtime.tracing import logger
+
+            start = 0
+            acc = 0
+            for i in range(k):
+                acc += int(counts[i])
+                if acc >= tiering.SPILL_BLOCK_SLOTS or i == k - 1:
+                    try:
+                        self._spill_chunk(cand[start : i + 1])
+                    except StateError as e:
+                        # failed eviction put: accumulators stay
+                        # resident; degrade to backpressure, never kill
+                        # the query over a spill write
+                        logger.warning(
+                            "spill: udaf eviction put failed (%s) — "
+                            "chunk stays resident", e,
+                        )
+                        break
+                    spilled_any = True
+                    start, acc = i + 1, 0
+        if spilled_any:
+            self._write_manifest()
+            op._state_info_cache = None
+        self.ctrl.check_pressure(self.node_id)
+
+    def _spill_chunk(self, gids_chunk: np.ndarray) -> None:
+        from denormalized_tpu.state.checkpoint import jsonable
+        from denormalized_tpu.state.serialization import pack_snapshot
+
+        op = self.op
+        chunk_set = set(int(g) for g in gids_chunk)
+        entries: dict[str, list] = {}
+        to_mark: list[tuple[dict, int]] = []
+        windows: set[int] = set()
+        n_groups = 0
+        for j, frame in op._frames.items():
+            row = []
+            for g in frame:
+                if int(g) in chunk_set and frame[g] is not SPILLED:
+                    row.append(
+                        [int(g), [acc.state() for acc in frame[g]]]
+                    )
+                    to_mark.append((frame, int(g)))
+            if row:
+                entries[str(j)] = row
+                windows.add(int(j))
+                n_groups += len(row)
+        if n_groups == 0:
+            return
+        if op._interner is not None:
+            keys = op._interner.keys_of(
+                np.asarray(gids_chunk, dtype=np.int64)
+            )
+            keys_meta = jsonable([list(c) for c in keys])
+        else:
+            keys_meta = None
+        # entries reference gids by CHUNK POSITION so a restore (fresh
+        # gid space) maps them through the re-interned keys
+        pos = {int(g): i for i, g in enumerate(gids_chunk)}
+        for row in entries.values():
+            for e in row:
+                e[0] = pos[e[0]]
+        meta = {
+            "keys": keys_meta,
+            "entries": jsonable(entries),
+            "windows": sorted(windows),
+            "groups": n_groups,
+        }
+        bid = self._next
+        blob = pack_snapshot(meta, {})
+        # durable FIRST: the accumulators are only marker-replaced once
+        # their states are safely in the LSM
+        nbytes = self.ctrl.put_block(self.node_id, f"b{bid}", blob)
+        self._next += 1
+        for frame, g in to_mark:
+            frame[g] = SPILLED
+        self._block_of[gids_chunk] = bid
+        self._blocks[bid] = {
+            "gids": np.asarray(gids_chunk, dtype=np.int64).copy(),
+            "windows": windows,
+            "bytes": nbytes,
+            "groups": n_groups,
+        }
+        self.any_spilled = True
+        self.spilled_bytes += nbytes
+        self.spilled_groups += n_groups
+        self.ctrl.note_spill(self.node_id, 1, nbytes)
+
+    # -- reload -----------------------------------------------------------
+    def _reload_block(self, bid: int) -> None:
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        meta = self._blocks.pop(bid)
+        op = self.op
+        raw = self.ctrl.get_block(self.node_id, f"b{bid}")
+        bmeta, _arrays = unpack_snapshot(raw)
+        if bmeta["keys"] is not None and op._interner is not None:
+            key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+            chunk_gids = op._interner.intern(key_cols).astype(np.int64)
+        else:
+            chunk_gids = np.zeros(1, dtype=np.int64)
+        self._ensure_maps(self._capacity())
+        for j_str, row in bmeta["entries"].items():
+            frame = op._frames.setdefault(int(j_str), {})
+            for posi, states in row:
+                gid = int(chunk_gids[int(posi)])
+                accs = op._make_accs()
+                for acc, st in zip(accs, states):
+                    acc.merge(st)
+                # marker replaced IN PLACE: dict order (and therefore
+                # emission row order) is exactly the all-resident run's
+                frame[gid] = accs
+        self._block_of[meta["gids"]] = -1
+        self._block_of[chunk_gids] = -1  # restore path: fresh gid space
+        self.any_spilled = bool(self._blocks)
+        self.spilled_bytes -= meta["bytes"]
+        self.spilled_groups -= meta["groups"]
+        self.ctrl.note_reload(self.node_id, 1, len(raw))
+        self.ctrl.delete_block(self.node_id, f"b{bid}")
+        op._state_info_cache = None
+
+    def _write_manifest(self) -> None:
+        self.ctrl.write_manifest(
+            self.node_id, [f"b{b}" for b in self._blocks]
+        )
+
+    def info(self) -> dict:
+        return {
+            "spilled_bytes": self.spilled_bytes,
+            "spilled_keys": self.spilled_groups,
+            "spilled_blocks": len(self._blocks),
+            "spill": self.ctrl.spill_stats(self.node_id),
+        }
+
+    # -- checkpoint integration -------------------------------------------
+    def snapshot_refs(self, coord, key: str, epoch: int) -> list[int]:
+        bids = sorted(self._blocks)
+        for bid in bids:
+            self.ctrl.copy_block_to_epoch(
+                coord, key, epoch, self.node_id, f"b{bid}"
+            )
+        return bids
+
+    def restore_refs(self, coord, key: str, bids: list[int]) -> None:
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        op = self.op
+        for bid in bids:
+            raw = self.ctrl.restore_block_from_epoch(
+                coord, key, self.node_id, f"b{bid}"
+            )
+            bmeta, _arrays = unpack_snapshot(raw)
+            if bmeta["keys"] is not None and op._interner is not None:
+                key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+                chunk_gids = op._interner.intern(key_cols).astype(
+                    np.int64
+                )
+            else:
+                chunk_gids = np.zeros(1, dtype=np.int64)
+            self._ensure_maps(self._capacity())
+            windows: set[int] = set()
+            groups = 0
+            for j_str, row in bmeta["entries"].items():
+                frame = op._frames.setdefault(int(j_str), {})
+                windows.add(int(j_str))
+                for posi, _states in row:
+                    frame[int(chunk_gids[int(posi)])] = SPILLED
+                    groups += 1
+            self._block_of[chunk_gids] = bid
+            self._blocks[bid] = {
+                "gids": chunk_gids.copy(),
+                "windows": windows,
+                "bytes": len(raw),
+                "groups": groups,
+            }
+            self.spilled_bytes += len(raw)
+            self.spilled_groups += groups
+            self._next = max(self._next, bid + 1)
+        self.any_spilled = bool(self._blocks)
+        self._write_manifest()
+
+
 class UdafWindowExec(ExecOperator):
     def __init__(
         self,
@@ -156,6 +480,8 @@ class UdafWindowExec(ExecOperator):
         )
         self._frames: dict[int, dict[int, list]] = {}
         self._ckpt: tuple | None = None
+        # cold tier (state/tiering.py): set by enable_spill
+        self._tier: _UdafTier | None = None
         self._first_open: int | None = None
         self._max_win_seen = -1
         self._watermark: int | None = None
@@ -191,6 +517,10 @@ class UdafWindowExec(ExecOperator):
     def _label(self):
         return f"UdafWindowExec({self.window_type.value} {self.length_ms}ms)"
 
+    # -- cold tier (state/tiering.py) -----------------------------------
+    def enable_spill(self, node_id: str, controller) -> None:
+        self._tier = _UdafTier(self, node_id, controller)
+
     # -- state observatory (obs/statewatch.py) --------------------------
     def state_info(self) -> dict:
         from denormalized_tpu.obs import statewatch as swm
@@ -199,8 +529,12 @@ class UdafWindowExec(ExecOperator):
         groups_total = 0
         live_gids: set[int] = set()
         for f in list(frames.values()):
-            groups_total += len(f)
-            live_gids.update(f.keys())
+            # spilled markers keep their dict entries but their
+            # accumulators live in the LSM — resident accounting skips
+            # them (reported separately as spilled_keys/bytes)
+            resident = [g for g, a in f.items() if a is not SPILLED]
+            groups_total += len(resident)
+            live_gids.update(resident)
         n_aggs = len(self.aggr_exprs)
         live_keys = len(live_gids)
         acc_objs = groups_total * n_aggs
@@ -233,6 +567,8 @@ class UdafWindowExec(ExecOperator):
             info["interner_keys_total"] = len(self._interner)
         if wm is not None and oldest is not None:
             info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        if self._tier is not None:
+            info.update(self._tier.info())
         return info
 
     def _state_watch_views(self):
@@ -297,6 +633,10 @@ class UdafWindowExec(ExecOperator):
         else:
             gids = np.zeros(n, dtype=np.int64)
         self._sw.update(gids)
+        if self._tier is not None:
+            # membership pre-probe + reload-on-touch BEFORE the frame
+            # loop: touched markers come back resident
+            self._tier.touch_and_reload(gids)
         from denormalized_tpu.logical.expr import column_validity
 
         def mask_of(e) -> np.ndarray | None:
@@ -349,6 +689,11 @@ class UdafWindowExec(ExecOperator):
                 gid = int(gs[b0])
                 frame = self._frames.setdefault(j, {})
                 accs = frame.get(gid)
+                if accs is SPILLED:
+                    # defensive: touch-time reload covers every batch
+                    # gid; a marker here means the block map missed it
+                    self._tier.reload_gid(gid)
+                    accs = frame.get(gid)
                 if accs is None:
                     accs = self._make_accs()
                     frame[gid] = accs
@@ -369,6 +714,8 @@ class UdafWindowExec(ExecOperator):
             if self._watermark is None or bmin > self._watermark:
                 self._watermark = bmin
         yield from self._trigger()
+        if self._tier is not None:
+            self._tier.maybe_spill(gids)
 
     def _trigger(self) -> Iterator[RecordBatch]:
         if self._watermark is None or self._first_open is None:
@@ -393,6 +740,10 @@ class UdafWindowExec(ExecOperator):
         distinct-keys-ever-seen dwarfs them, so host memory follows open
         windows, not stream lifetime (same policy as the join)."""
         if self._interner is None:
+            return
+        if self._tier is not None and self._tier.any_spilled:
+            # re-keying would strand the blocks' gid maps; deferred
+            # until the cold set drains (emission drains it steadily)
             return
         # cheap threshold first: don't build the live set (O(open groups))
         # on every trigger just to no-op
@@ -433,6 +784,10 @@ class UdafWindowExec(ExecOperator):
         self._interner = new
 
     def _emit(self, j: int) -> RecordBatch | None:
+        if self._tier is not None:
+            # any block holding entries of this window reloads first —
+            # markers resolve in place, emission order is preserved
+            self._tier.reload_for_window(j)
         frame = self._frames.pop(j, None)
         if not frame:
             return None
@@ -494,9 +849,6 @@ class UdafWindowExec(ExecOperator):
         for j_str, groups in snap["frames"].items():
             frame: dict[int, list] = {}
             for key_list, states in groups:
-                accs = self._make_accs()
-                for acc, st in zip(accs, states):
-                    acc.merge(st)
                 if self._interner is not None:
                     gid = int(
                         self._interner.intern(
@@ -505,8 +857,54 @@ class UdafWindowExec(ExecOperator):
                     )
                 else:
                     gid = 0
+                if states is None:
+                    # spilled-at-the-cut group: seed the marker at its
+                    # recorded position (the tier restore / resident
+                    # degrade below overwrites it IN PLACE, so emission
+                    # row order matches the uninterrupted run)
+                    frame[gid] = SPILLED
+                    continue
+                accs = self._make_accs()
+                for acc, st in zip(accs, states):
+                    acc.merge(st)
                 frame[gid] = accs
             self._frames[int(j_str)] = frame
+        bids = snap.get("spill_blocks") or []
+        if bids:
+            if self._tier is not None:
+                self._tier.restore_refs(coord, self._ckpt[1], bids)
+            else:
+                self._restore_spilled_resident(coord, self._ckpt[1], bids)
+
+    def _restore_spilled_resident(self, coord, key: str, bids: list) -> None:
+        """Budget removed since the checkpoint: the cold tier's blocks
+        load back resident."""
+        from denormalized_tpu.common.errors import StateError
+        from denormalized_tpu.state import tiering
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        for bid in bids:
+            raw = coord.get_snapshot(f"{key}:spill:b{bid}")
+            if raw is None:
+                raise StateError(
+                    f"checkpoint references spilled UDAF block b{bid} "
+                    "but the epoch holds no such snapshot"
+                )
+            bmeta, _arrays = unpack_snapshot(raw)
+            if bmeta["keys"] is not None and self._interner is not None:
+                key_cols = tiering.key_columns_from_meta(bmeta["keys"])
+                chunk_gids = self._interner.intern(key_cols).astype(
+                    np.int64
+                )
+            else:
+                chunk_gids = np.zeros(1, dtype=np.int64)
+            for j_str, row in bmeta["entries"].items():
+                frame = self._frames.setdefault(int(j_str), {})
+                for posi, states in row:
+                    accs = self._make_accs()
+                    for acc, st in zip(accs, states):
+                        acc.merge(st)
+                    frame[int(chunk_gids[int(posi)])] = accs
 
     def _snapshot(self, epoch: int) -> None:
         # put_json's `jsonable` recursively converts numpy scalars/arrays in
@@ -520,6 +918,11 @@ class UdafWindowExec(ExecOperator):
         # per frame (one keys_of call), not per group.
         frames = {}
         for j, frame in self._frames.items():
+            # dict order IS emission row order, so the snapshot records
+            # every group IN POSITION: spilled markers persist as
+            # states=None placeholders (their accumulator states are
+            # committed under this SAME epoch as referenced blocks, and
+            # restore re-marks/overwrites them at the recorded position)
             gids = list(frame.keys())
             if self._interner is not None and gids:
                 key_arrays = self._interner.keys_of(
@@ -531,21 +934,25 @@ class UdafWindowExec(ExecOperator):
             else:
                 keys_per_gid = [[] for _ in gids]
             frames[str(j)] = [
-                [kv, [acc.state() for acc in frame[g]]]
+                [
+                    kv,
+                    None if frame[g] is SPILLED
+                    else [acc.state() for acc in frame[g]],
+                ]
                 for g, kv in zip(gids, keys_per_gid)
             ]
-        put_json(
-            coord,
-            key,
-            epoch,
-            {
-                "epoch": epoch,
-                "first_open": self._first_open,
-                "max_win_seen": self._max_win_seen,
-                "watermark": self._watermark,
-                "frames": frames,
-            },
-        )
+        snap = {
+            "epoch": epoch,
+            "first_open": self._first_open,
+            "max_win_seen": self._max_win_seen,
+            "watermark": self._watermark,
+            "frames": frames,
+        }
+        if self._tier is not None and self._tier.any_spilled:
+            snap["spill_blocks"] = self._tier.snapshot_refs(
+                coord, key, epoch
+            )
+        put_json(coord, key, epoch, snap)
 
     def run(self) -> Iterator[StreamItem]:
         for item in self._doctor_input():

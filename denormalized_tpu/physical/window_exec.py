@@ -104,6 +104,250 @@ def window_output_low_watermark(
     return min_future_start - 1
 
 
+class _WindowTier:
+    """Cold tier of one device-ring window operator: spills the OLDEST
+    contiguous prefix of open-but-not-closable window slots (the
+    window-frame spilling of the PAPERS.md spilling design — watermark-
+    deferred frames whose rows have stopped arriving) out of the device
+    ring into the LSM, then advances ``first_open`` past them so the
+    ring stops reserving capacity for the skew span.  A spilled window
+
+    - emits straight from its stored component planes when the
+      watermark closes it (same finalize as the ring path);
+    - reloads into the ring — lowering ``first_open`` back, exactly the
+      per-partition rebase machinery — when a late-ish batch lands rows
+      in it (touch), so drop semantics match the all-resident run;
+    - rides checkpoints as an epoch-referenced block like every tier.
+
+    Memory wins two ways: a spilled prefix stops ``_ensure_capacity``
+    growing W for event-time skew, and when the resident span shrinks
+    far enough the ring rebuilds at a smaller W (true allocation
+    shrink)."""
+
+    __slots__ = (
+        "op", "node_id", "ctrl", "any_spilled", "spilled_bytes",
+        "_blocks", "_next",
+    )
+
+    def __init__(self, op: "StreamingWindowExec", node_id: str, ctrl) -> None:
+        self.op = op
+        self.node_id = node_id
+        self.ctrl = ctrl
+        self.any_spilled = False
+        self.spilled_bytes = 0
+        self._blocks: dict[int, dict] = {}  # window index -> meta
+        self._next = 0
+        ctrl.register(node_id, op, self.resident_bytes)
+
+    def resident_bytes(self) -> int:
+        from denormalized_tpu.obs import statewatch as swm
+
+        op = self.op
+        spec = op._spec
+        try:
+            itemsize = int(np.dtype(spec.accum_dtype).itemsize)
+        except TypeError:
+            itemsize = 4
+        keys = len(op._interner) if op._interner is not None else 1
+        return (
+            len(spec.components)
+            * spec.window_slots
+            * spec.group_capacity
+            * itemsize
+            + keys * swm.KEY_EST_BYTES
+        )
+
+    # -- touch / reload ---------------------------------------------------
+    def touch_and_reload(self, lo_win: int, hi_win: int) -> None:
+        """Reload every spilled window the incoming batch's rows can
+        land in (windows [lo_win, hi_win]) BEFORE the operator computes
+        win_rel — otherwise those rows would read as late and drop."""
+        if not self.any_spilled:
+            return
+        due = sorted(j for j in self._blocks if lo_win <= j <= hi_win)
+        if not due:
+            return
+        # INVARIANT: every spilled window stays strictly below
+        # first_open.  Reloading lowers first_open to the lowest touched
+        # window, so every spilled window ABOVE it must come back too —
+        # left spilled, the ring's emission loop would reach its reset
+        # slot and emit nothing where the all-resident run emits a window
+        lo = due[0]
+        due = sorted(j for j in self._blocks if j >= lo)
+        self._reload(due)
+        self._write_manifest()
+
+    def _reload(self, js: list[int]) -> None:
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        op = self.op
+        op._flush()
+        new_first = min(js)
+        # ring capacity must cover [new_first, max_win_seen] BEFORE the
+        # base lowers (the _grow-before-rebase aliasing rule the
+        # per-partition watermark path documents)
+        op._ensure_capacity(op._max_win_seen - new_first)
+        op._first_open = new_first
+        # export may hand back read-only device views — copy to mutate
+        host = {
+            label: np.array(buf) for label, buf in op._backend.export().items()
+        }
+        W = op._spec.window_slots
+        for j in js:
+            meta = self._blocks.pop(j)
+            raw = self.ctrl.get_block(self.node_id, meta["id"])
+            _bmeta, arrays = unpack_snapshot(raw)
+            slot = j % W
+            for label, arr in arrays.items():
+                g = arr.shape[0]
+                host[label][slot, :g] = arr
+            self.spilled_bytes -= meta["bytes"]
+            self.ctrl.note_reload(self.node_id, 1, len(raw))
+            self.ctrl.delete_block(self.node_id, meta["id"])
+        op._backend.import_(host)
+        self.any_spilled = bool(self._blocks)
+        op._state_info_cache = None
+
+    # -- eviction ---------------------------------------------------------
+    def maybe_spill(self, hot_lo_win: int) -> None:
+        """Spill the prefix [first_open, min(hot_lo_win, …)) when over
+        budget — the windows old enough that the current batch no longer
+        feeds them.  Runs AFTER the trigger, so closable windows have
+        already emitted and the prefix is genuinely deferred-open."""
+        from denormalized_tpu.state.serialization import pack_snapshot
+
+        need = self.ctrl.over_budget()
+        if need <= 0:
+            self.ctrl.relax(self.node_id)
+            return
+        op = self.op
+        spec = op._spec
+        spilled_any = False
+        if op._first_open is not None:
+            try:
+                itemsize = int(np.dtype(spec.accum_dtype).itemsize)
+            except TypeError:
+                itemsize = 4
+            per_window = max(
+                len(spec.components) * spec.group_capacity * itemsize, 1
+            )
+            hi = min(int(hot_lo_win), op._max_win_seen + 1)
+            want = -(-need // per_window)
+            cut = min(op._first_open + want, hi)
+            if cut > op._first_open:
+                op._flush()
+                W = spec.window_slots
+                from denormalized_tpu.common.errors import StateError
+
+                for j in range(op._first_open, cut):
+                    rows = op._backend.read_slot(j % W)
+                    arrays = {
+                        label: np.asarray(arr)
+                        for label, arr in rows.items()
+                    }
+                    block_id = f"w{self._next}"
+                    blob = pack_snapshot({"window": int(j)}, arrays)
+                    try:
+                        # durable FIRST, reset after — a failed put must
+                        # leave the slot's data in the ring
+                        nbytes = self.ctrl.put_block(
+                            self.node_id, block_id, blob
+                        )
+                    except StateError as e:
+                        from denormalized_tpu.runtime.tracing import logger
+
+                        logger.warning(
+                            "spill: window eviction put failed (%s) — "
+                            "window %d stays resident this pass", e, j,
+                        )
+                        break
+                    self._next += 1
+                    op._backend.reset_slot(j % W)
+                    self._blocks[j] = {"id": block_id, "bytes": nbytes}
+                    self.spilled_bytes += nbytes
+                    self.ctrl.note_spill(self.node_id, 1, nbytes)
+                    op._first_open = j + 1
+                    self.any_spilled = True
+                    spilled_any = True
+                if spilled_any:
+                    self._write_manifest()
+                    self._maybe_shrink()
+                    op._state_info_cache = None
+        self.ctrl.check_pressure(self.node_id)
+
+    def _maybe_shrink(self) -> None:
+        """Rebuild the ring at a smaller W once the resident span allows
+        it — the actual allocation shrink (spilling alone only frees the
+        slots logically)."""
+        op = self.op
+        span = max(op._max_win_seen - op._first_open + 2, 1)
+        new_w = max(_next_pow2(span), 16)
+        if new_w < op._spec.window_slots:
+            op._grow(window_slots=new_w)
+
+    # -- emission ---------------------------------------------------------
+    def due_windows(self, wm_floor: int) -> list[int]:
+        """Spilled windows the watermark has closed, ascending — they
+        emit from their stored planes before any ring emission of the
+        same trigger (preserving ascending-window output order)."""
+        if not self.any_spilled:
+            return []
+        return sorted(j for j in self._blocks if j < wm_floor)
+
+    def emit_rows(self, j: int) -> dict:
+        """Load + drop one due window's component planes."""
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        meta = self._blocks.pop(j)
+        raw = self.ctrl.get_block(self.node_id, meta["id"])
+        _bmeta, arrays = unpack_snapshot(raw)
+        self.spilled_bytes -= meta["bytes"]
+        self.any_spilled = bool(self._blocks)
+        self.ctrl.note_reload(self.node_id, 1, len(raw))
+        self.ctrl.delete_block(self.node_id, meta["id"])
+        self._write_manifest()
+        return arrays
+
+    def _write_manifest(self) -> None:
+        self.ctrl.write_manifest(
+            self.node_id, [m["id"] for m in self._blocks.values()]
+        )
+
+    def info(self) -> dict:
+        return {
+            "spilled_bytes": self.spilled_bytes,
+            "spilled_keys": 0,
+            "spilled_blocks": len(self._blocks),
+            "spilled_windows": sorted(self._blocks),
+            "spill": self.ctrl.spill_stats(self.node_id),
+        }
+
+    # -- checkpoint integration -------------------------------------------
+    def snapshot_refs(self, coord, key: str, epoch: int) -> dict:
+        refs = {}
+        for j in sorted(self._blocks):
+            meta = self._blocks[j]
+            self.ctrl.copy_block_to_epoch(
+                coord, key, epoch, self.node_id, meta["id"]
+            )
+            refs[str(j)] = meta["id"]
+        return refs
+
+    def restore_refs(self, coord, key: str, refs: dict) -> None:
+        for j_str, block_id in refs.items():
+            raw = self.ctrl.restore_block_from_epoch(
+                coord, key, self.node_id, block_id
+            )
+            self._blocks[int(j_str)] = {
+                "id": block_id, "bytes": len(raw),
+            }
+            self.spilled_bytes += len(raw)
+            seq = int(block_id[1:])
+            self._next = max(self._next, seq + 1)
+        self.any_spilled = bool(self._blocks)
+        self._write_manifest()
+
+
 class StreamingWindowExec(ExecOperator):
     def __init__(
         self,
@@ -255,6 +499,8 @@ class StreamingWindowExec(ExecOperator):
 
         # streaming state
         self._ckpt: tuple | None = None
+        # cold tier (state/tiering.py): set by enable_spill
+        self._tier: _WindowTier | None = None
         self._first_open: int | None = None  # lowest non-emitted slide index
         self._max_win_seen: int = -1
         self._watermark_ms: int | None = None
@@ -357,6 +603,10 @@ class StreamingWindowExec(ExecOperator):
             f"aggs=[{', '.join(a.name for a in self.aggr_exprs)}])"
         )
 
+    # -- cold tier (state/tiering.py) -----------------------------------
+    def enable_spill(self, node_id: str, controller) -> None:
+        self._tier = _WindowTier(self, node_id, controller)
+
     # -- state observatory (obs/statewatch.py) --------------------------
     def state_info(self) -> dict:
         from denormalized_tpu.obs import statewatch as swm
@@ -404,6 +654,8 @@ class StreamingWindowExec(ExecOperator):
         }
         if wm is not None and oldest is not None:
             info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        if self._tier is not None:
+            info.update(self._tier.info())
         return info
 
     def _state_watch_views(self):
@@ -546,6 +798,15 @@ class StreamingWindowExec(ExecOperator):
                 # skipped one aliases slots.
                 self._ensure_capacity(self._max_win_seen - new_first)
                 self._first_open = new_first
+        if self._tier is not None:
+            # reload-on-touch BEFORE win_rel is computed: a spilled
+            # window this batch's rows can land in comes back into the
+            # ring (first_open lowers with it), so nothing reads as late
+            # that the all-resident run would have accepted
+            self._tier.touch_and_reload(
+                int(units.min()) - self._spec.length_units + 1,
+                int(units.max()),
+            )
         first = self._first_open
         win_rel64 = units - first
         self._max_win_seen = max(self._max_win_seen, int(units.max()))
@@ -737,6 +998,13 @@ class StreamingWindowExec(ExecOperator):
             if self._watermark_ms is None or bmin > self._watermark_ms:
                 self._watermark_ms = bmin
         yield from self._trigger()
+        if self._tier is not None:
+            # after the trigger: closable windows have emitted, so the
+            # [first_open, this batch's lowest window) prefix is the
+            # watermark-deferred cold span
+            self._tier.maybe_spill(
+                int(units.min()) - self._spec.length_units + 1
+            )
 
     # -- host pipeline fence --------------------------------------------
     def _join_acc(self) -> None:
@@ -856,6 +1124,24 @@ class StreamingWindowExec(ExecOperator):
         deferral: ingest uses it to freeze closable windows before a
         batch whose rows would otherwise leak late units into them."""
         yield from self._drain_pending()
+        if (
+            self._tier is not None
+            and self._tier.any_spilled
+            and self._watermark_ms is not None
+            and self._first_open is not None
+        ):
+            # spilled windows the watermark closed emit straight from
+            # their stored planes — they are all below first_open, so
+            # ascending-window output order is preserved
+            wmf = int(
+                watermark_floor(
+                    self._watermark_ms, self.length_ms, self.slide_ms
+                )
+            )
+            for j in self._tier.due_windows(wmf):
+                b = self._finalize_rows(j, self._tier.emit_rows(j))
+                if b is not None:
+                    yield b
         if self._obs_wm_lag and self._watermark_ms is not None:
             # watermark lag (wall − watermark): how far event time trails
             # real time at this trigger.  Gauge = latest, histogram =
@@ -969,6 +1255,12 @@ class StreamingWindowExec(ExecOperator):
             active = np.ones(len(gids), dtype=bool)
             self._metrics["windows_emitted"] += 1
             return self._build_emission(j, gids, rows, active)
+        return self._finalize_rows(j, rows)
+
+    def _finalize_rows(self, j: int, rows: dict) -> RecordBatch | None:
+        """Finalize one window's component planes into an emission batch
+        — shared by the ring slot path and the cold tier's emit-from-
+        store path (identical output either way)."""
         counts = rows[sa.ROW_COUNT.label]
         ngroups = len(self._interner) if self._grouped else 1
         active = counts > 0
@@ -1066,6 +1358,13 @@ class StreamingWindowExec(ExecOperator):
             "var_shift": dict(self._var_shift),
             "any_nulls_seen": self._any_nulls_seen,
         }
+        if self._tier is not None and self._tier.any_spilled:
+            coord, key = self._ckpt
+            # spilled window planes commit under this SAME epoch; the
+            # ring export below holds only the resident windows
+            meta["spill_windows"] = self._tier.snapshot_refs(
+                coord, key, epoch
+            )
         self._pending_snapshot = (
             epoch, meta, self._backend, self._backend.export_start()
         )
@@ -1127,6 +1426,40 @@ class StreamingWindowExec(ExecOperator):
         self._var_shift = dict(meta.get("var_shift") or {})
         if self._grouped and meta["interner"] is not None:
             self._interner = GroupInterner.restore(meta["interner"])
+        refs = meta.get("spill_windows")
+        if refs:
+            coord, key = self._ckpt
+            if self._tier is not None:
+                self._tier.restore_refs(coord, key, refs)
+            else:
+                self._restore_spilled_resident(coord, key, refs)
+
+    def _restore_spilled_resident(self, coord, key: str, refs: dict) -> None:
+        """Budget removed since the checkpoint: spilled window planes
+        merge back into the ring (first_open lowers to cover them)."""
+        from denormalized_tpu.common.errors import StateError
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        js = sorted(int(k) for k in refs)
+        new_first = min(js + ([self._first_open] if self._first_open is not None else []))
+        self._ensure_capacity(self._max_win_seen - new_first)
+        self._first_open = new_first
+        host = {
+            label: np.array(buf)
+            for label, buf in self._backend.export().items()
+        }
+        W = self._spec.window_slots
+        for j in js:
+            raw = coord.get_snapshot(f"{key}:spill:{refs[str(j)]}")
+            if raw is None:
+                raise StateError(
+                    f"checkpoint references spilled window {j} but the "
+                    "epoch holds no such snapshot"
+                )
+            _bmeta, arrays = unpack_snapshot(raw)
+            for label, arr in arrays.items():
+                host[label][j % W, : arr.shape[0]] = arr
+        self._backend.import_(host)
 
     # -- stream loop -----------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
@@ -1231,6 +1564,17 @@ class StreamingWindowExec(ExecOperator):
                 yield from self._release_snapshot()
                 if self.emit_on_close and self._first_open is not None:
                     self._flush()
+                    if self._tier is not None and self._tier.any_spilled:
+                        # spilled windows all sit below first_open:
+                        # flushing them first keeps ascending order
+                        for j in self._tier.due_windows(
+                            self._max_win_seen + 1
+                        ):
+                            b = self._finalize_rows(
+                                j, self._tier.emit_rows(j)
+                            )
+                            if b is not None:
+                                yield b
                     for j in range(self._first_open, self._max_win_seen + 1):
                         b = self._emit_window(j)
                         if b is not None:

@@ -120,10 +120,16 @@ def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
         # try: a failure while wiring the next service (bad lineage
         # config, port clash) must still tear down the ones already
         # started — not leak a bound HTTP port and live threads
-        orch = coord = exporters = handle = None
+        orch = coord = exporters = handle = spill = None
         restore = lambda: None  # noqa: E731
         flag = ShutdownFlag()
         try:
+            # cold tier BEFORE checkpoint wiring: restore rebuilds each
+            # operator's tier map through the adapter installed here
+            from denormalized_tpu.state.tiering import attach_spill
+
+            spill = attach_spill(root, ctx)
+            ctx._last_spill = spill
             orch, coord = _attach_checkpointing(root, ctx, checkpoint)
             ctx._last_coord = coord  # transactional sinks read committed_epoch
             # opt-in exporters: Prometheus endpoint / JSONL snapshots /
@@ -152,6 +158,8 @@ def execute_plan(plan: lp.LogicalPlan, ctx, checkpoint=None) -> None:
             restore()
             if orch is not None:
                 orch.stop()
+            if spill is not None:
+                spill.close()
             if handle is not None:
                 # freeze the final snapshot (and drop the operator-tree
                 # reference) BEFORE exporters stop, so the last JSONL
@@ -170,11 +178,15 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
     from denormalized_tpu.physical.base import Marker
 
     reg = _resolve_registry(ctx)
-    orch = coord = exporters = handle = it = None
+    orch = coord = exporters = handle = it = spill = None
     try:
         with obs.bound_registry(reg):
             root = build_physical(plan, ctx)
             ctx._last_physical = root  # post-run metrics (DataStream.metrics)
+            from denormalized_tpu.state.tiering import attach_spill
+
+            spill = attach_spill(root, ctx)
+            ctx._last_spill = spill
             orch, coord = _attach_checkpointing(root, ctx)
             # exactly-once sinks tag output with the in-flight epoch and
             # a recovery reader discards the uncommitted suffix (the
@@ -218,6 +230,8 @@ def stream_plan(plan: lp.LogicalPlan, ctx) -> Iterator[RecordBatch]:
                 it.close()
             if orch is not None:
                 orch.stop()
+            if spill is not None:
+                spill.close()
             if handle is not None:
                 handle.finish()
             if exporters is not None:

@@ -19,6 +19,9 @@ sites** at the engine's I/O boundaries::
     lsm.get             LsmStore.get                (raises StateError)
     lsm.flush           LsmStore.flush              (raises StateError)
     checkpoint.commit   CheckpointCoordinator.commit(raises StateError)
+    lsm.spill_put       SpillController.put_block   (StateError / torn value)
+    lsm.spill_get       SpillController.get_block   (raises StateError)
+    spill.manifest      SpillController.write_manifest (StateError / torn)
 
 Each site calls :func:`inject` (optionally passing the key/payload being
 written).  With no plan armed ``inject`` is a single attribute check and an
@@ -96,6 +99,9 @@ SITES = {
     "lsm.get": StateError,
     "lsm.flush": StateError,
     "checkpoint.commit": StateError,
+    "lsm.spill_put": StateError,
+    "lsm.spill_get": StateError,
+    "spill.manifest": StateError,
 }
 
 #: where each site's ``inject`` call lives (module relative to this
@@ -114,6 +120,20 @@ SITE_MODULES = {
     "lsm.get": ("state/lsm.py", "`LsmStore.get`"),
     "lsm.flush": ("state/lsm.py", "`LsmStore.flush`"),
     "checkpoint.commit": ("state/checkpoint.py", "`CheckpointCoordinator.commit`"),
+    "lsm.spill_put": (
+        "state/tiering.py",
+        "`SpillController.put_block` — cold-state block eviction to the "
+        "LSM tier (supports torn values)",
+    ),
+    "lsm.spill_get": (
+        "state/tiering.py",
+        "`SpillController.get_block` — reload-on-touch of a spilled block",
+    ),
+    "spill.manifest": (
+        "state/tiering.py",
+        "`SpillController.write_manifest` — per-node live-block manifest "
+        "write (supports torn values)",
+    ),
 }
 
 _KINDS = ("error", "latency", "torn")

@@ -64,6 +64,10 @@ from typing import Callable, Iterator
 
 from denormalized_tpu.common.errors import SourceError, StateError
 from denormalized_tpu.runtime.tracing import logger, span
+from denormalized_tpu.state.tiering import (
+    backpressure_pause as _backpressure_pause,
+    pressure_engaged as _pressure_engaged,
+)
 
 
 class PrefetchRestartExhausted(SourceError):
@@ -166,6 +170,7 @@ class PrefetchWorker:
         #: Folded under _swap_lock so a metrics read can never observe
         #: the count doubled or dropped mid-swap.
         self.retired_decode_fallback_rows = 0
+        self.retired_salvaged_rows = 0
         self._swap_lock = threading.Lock()
         # single-writer activity slots (worker writes enq_*, consumer
         # writes deq_) — see module docstring
@@ -283,6 +288,11 @@ class PrefetchWorker:
                     self.retired_decode_fallback_rows += int(fallback())
                 except Exception:  # dnzlint: allow(broad-except) best-effort metrics fold off a CRASHED reader — its counter is worth carrying over, never worth failing the restart for
                     pass
+            # same carry for salvage-skipped rows: a restart must not
+            # RESET the silent-data-loss counter
+            self.retired_salvaged_rows += int(
+                getattr(old, "salvaged_rows", 0) or 0
+            )
             self.reader = new
         # caught_up stays False (set when the crash was detected) until
         # the rebuilt reader's first fetch reports real backlog state
@@ -303,6 +313,15 @@ class PrefetchWorker:
             return (
                 self.reader.decode_fallback_rows()
                 + self.retired_decode_fallback_rows
+            )
+
+    def salvaged_total(self) -> int:
+        """Current + retired salvage-skipped (undecodable, dropped)
+        rows, glitch-free across a supervised reader swap."""
+        with self._swap_lock:
+            return (
+                int(getattr(self.reader, "salvaged_rows", 0) or 0)
+                + self.retired_salvaged_rows
             )
 
     def _run(self) -> None:
@@ -401,6 +420,14 @@ class PrefetchWorker:
                 # from hours-old healed failures
                 self._global_budget.refund(self._streak)
                 self._streak = 0
+            if _pressure_engaged():
+                # end-of-line backpressure from the state tier: spill
+                # could not keep accounted state under the hard ceiling,
+                # so the PUMP slows down — one bounded pause per read (a
+                # throttle, never a halt: rows must keep trickling or the
+                # watermark stalls and the pressure can never clear).
+                # Broker-side backlog absorbs what we stop fetching.
+                _backpressure_pause()
             b = reader.read(timeout_s=self._read_timeout_s)
             self.first_read_done = True
             if b is None:

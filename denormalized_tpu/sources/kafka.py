@@ -555,6 +555,14 @@ class KafkaPartitionReader(PartitionReader):
             "dnz_kafka_consumer_lag_rows",
             topic=self._topic, partition=str(partition),
         )
+        #: poison records skipped by per-record salvage decode — data the
+        #: stream silently dropped to keep progressing; invisible to
+        #: operators before this counter existed
+        self.salvaged_rows = 0
+        self._obs_salvaged = obs.gauge(
+            "dnz_source_salvaged_rows",
+            source=self._topic, partition=str(partition),
+        )
         # backlog report from the last fetch response (None = unknown):
         # consumed by the prefetch engine's idleness judgment — a reader
         # that KNOWS the broker holds more records must never be judged
@@ -679,6 +687,8 @@ class KafkaPartitionReader(PartitionReader):
             if b.num_rows:
                 good.append(b)
                 keep.append(i)
+        self.salvaged_rows += n_bad
+        self._obs_salvaged.set(self.salvaged_rows)
         logger.warning(
             "kafka %s[%d]: skipped %d undecodable record(s) at offsets "
             "<%d: %s",
@@ -957,10 +967,24 @@ class KafkaSource(Source):
 
 class KafkaSinkWriter(Sink):
     """JSON row producer (KafkaSink::write_all, topic_writer.rs:102-127),
-    round-robin over partitions."""
+    round-robin over partitions.
+
+    Produce failures retry a bounded number of times with exponential
+    backoff + jitter (the ``commit_retries`` pattern from
+    state/checkpoint.py) before surfacing: the sink was the last I/O
+    boundary where ONE broker hiccup failed the whole segment while
+    every other boundary self-heals.  A retry after a produce whose
+    response was lost can duplicate records — the sink's existing
+    at-least-once contract, now merely more likely to be exercised."""
+
+    #: bounded transient-produce retries (attempt count, not extra tries)
+    _WRITE_ATTEMPTS = 4
+    _BACKOFF_BASE_S = 0.05
 
     def __init__(self, bootstrap_servers: str, topic: str,
                  security: dict | None = None):
+        from denormalized_tpu import obs
+
         self._client = KafkaClient(bootstrap_servers, security=security)
         self._topic = topic
         self._encoder = JsonRowEncoder()
@@ -969,13 +993,41 @@ class KafkaSinkWriter(Sink):
         except SourceError:
             self._npartitions = 1
         self._rr = 0
+        #: transient produce errors absorbed by the bounded retry
+        self.sink_retries = 0
+        self._obs_retries = obs.counter("dnz_sink_retries_total")
 
     def write(self, batch: RecordBatch) -> None:
+        import random
+
         payloads = self._encoder.encode(batch)
         if not payloads:
             return
-        faults.inject("sink.write", key=self._topic)
-        self._client.produce(self._topic, self._rr, payloads)
+        last: SourceError | None = None
+        for attempt in range(1, self._WRITE_ATTEMPTS + 1):
+            try:
+                faults.inject("sink.write", key=self._topic)
+                self._client.produce(self._topic, self._rr, payloads)
+                last = None
+                break
+            except SourceError as e:
+                last = e
+                self.sink_retries += 1
+                self._obs_retries.add(1)
+                logger.warning(
+                    "kafka sink %s: produce failed (%s) — attempt %d/%d",
+                    self._topic, e, attempt, self._WRITE_ATTEMPTS,
+                )
+                if attempt < self._WRITE_ATTEMPTS:
+                    # exp backoff + jitter so N writers recovering from
+                    # one broker flap don't re-stampede it in lockstep
+                    time.sleep(
+                        self._BACKOFF_BASE_S
+                        * (2 ** (attempt - 1))
+                        * (1.0 + random.random())
+                    )
+        if last is not None:
+            raise last
         self._rr = (self._rr + 1) % self._npartitions
 
     def close(self) -> None:

@@ -100,6 +100,7 @@ def test_site_inventory_is_complete():
     assert set(inv) == {
         "kafka.fetch", "kafka.produce", "decode", "sink.write",
         "lsm.put", "lsm.get", "lsm.flush", "checkpoint.commit",
+        "lsm.spill_put", "lsm.spill_get", "spill.manifest",
     }
     for site, meta in inv.items():
         assert meta["calls"], f"site {site} has no inject call"

@@ -60,7 +60,9 @@ def _by_class(metrics_by_node):
 #: compatibility-view section) — changing one is an API break for every
 #: consumer of collect_metrics (bench, soak, dashboards), so it must be
 #: a conscious diff here
-SOURCE_KEYS = {"rows_out", "batches_out", "decode_fallback_rows"}
+SOURCE_KEYS = {
+    "rows_out", "batches_out", "decode_fallback_rows", "salvaged_rows",
+}
 WINDOW_KEYS = {
     "rows_in", "batches_in", "late_rows", "windows_emitted",
     "device_steps", "partial_merges", "grow_events", "host_prep_s",

@@ -362,8 +362,43 @@ def chaos_plan(seed: int) -> dict:
             # background jitter on state flushes
             {"name": "flush_latency", "site": "lsm.flush",
              "kind": "latency", "ms": 5, "prob": 0.05, "times": 20},
+            # cold-tier spill write tear: only fires when the run is
+            # budgeted enough to spill (the kafka soak's window state is
+            # small, so this usually stays dormant here — the bigstate
+            # soak's own plan exercises the tier deterministically).
+            # Caught by copy_block_to_epoch's integrity check: the epoch
+            # refuses the torn block, the previous intact epoch stays
+            # the recovery point
+            {"name": "spill_put_torn", "site": "lsm.spill_put",
+             "kind": "torn", "prob": 0.05, "times": 1},
         ],
     }
+
+
+def bigstate_fault_plan(seed: int) -> dict:
+    """Spill-site chaos for the bigstate soak: transient reload flaps
+    (healed by get_block's bounded retry), one eviction-write failure
+    (degrades to keep-resident + backpressure, never kills the query),
+    and a torn manifest write (best-effort metadata, logged only)."""
+    return {
+        "seed": seed,
+        "rules": [
+            {"name": "spill_get_flap", "site": "lsm.spill_get",
+             "kind": "error", "message": "injected spill reload flap",
+             "after": 20, "times": 2},
+            {"name": "spill_put_fail", "site": "lsm.spill_put",
+             "kind": "error", "message": "injected spill write failure",
+             "after": 40, "times": 1},
+            {"name": "spill_manifest_torn", "site": "spill.manifest",
+             "kind": "torn", "after": 5, "times": 1},
+        ],
+    }
+
+
+#: spill-site rules the bigstate acceptance gate requires to fire
+BIGSTATE_REQUIRED_RULES = (
+    "spill_get_flap", "spill_put_fail", "spill_manifest_torn",
+)
 
 
 #: the four failure modes the chaos acceptance gate requires to fire
@@ -663,6 +698,103 @@ def child_main() -> None:
             ],
             WINDOW_MS,
         )
+    elif pipeline == "bigstate":
+        # larger-than-memory session state: phase A opens SOAK_BS_KEYS
+        # singleton sessions (gap = the whole phase-A event span, so all
+        # of them stay open simultaneously); phase B advances the
+        # watermark in waves of SOAK_BS_WAVE keys so sessions close
+        # progressively instead of one giant reload-everything sweep.
+        # Budgeted children (SOAK_BS_BUDGET > 0) run the cold tier +
+        # checkpointing and get SIGKILLed; the reference child runs the
+        # identical feed unbudgeted — emissions must match byte-for-byte.
+        bs_keys = int(os.environ["SOAK_BS_KEYS"])
+        bs_wave = int(os.environ["SOAK_BS_WAVE"])
+        bs_budget = int(os.environ.get("SOAK_BS_BUDGET", "0") or 0)
+        if bs_budget:
+            cfg.state_budget_bytes = bs_budget
+        else:
+            # reference (unbudgeted) child: same feed, no cold tier, no
+            # snapshots — the byte-identical oracle the budgeted run is
+            # compared against
+            cfg.checkpoint = False
+        bs_gap = bs_keys  # DT = 1ms per key
+        wave_rows = 64
+        a_batches = -(-bs_keys // batch_rows)
+        waves = -(-bs_keys // bs_wave)
+
+        bs_user = Schema([
+            Field("occurred_at_ms", DataType.INT64, nullable=False),
+            Field("sensor_id", DataType.INT64, nullable=False),
+            Field("reading", DataType.FLOAT64),
+        ])
+        bs_schema = canonicalize_schema(bs_user)
+
+        class BigstatePartition(PartitionReader):
+            """Index-deterministic feed (restore = fast-forward)."""
+
+            def __init__(self):
+                self._i = 0
+
+            def read(self, timeout_s=None):
+                i = self._i
+                if i >= a_batches + waves:
+                    return None
+                self._i += 1
+                if i < a_batches:
+                    lo = i * batch_rows
+                    kids = np.arange(
+                        lo, min(lo + batch_rows, bs_keys), dtype=np.int64
+                    )
+                    ts = T0 + kids  # DT = 1ms
+                else:
+                    j = i - a_batches + 1
+                    base = bs_keys + (j - 1) * wave_rows
+                    kids = np.arange(
+                        base, base + wave_rows, dtype=np.int64
+                    )
+                    ts = np.full(
+                        wave_rows, T0 + bs_gap + j * bs_wave,
+                        dtype=np.int64,
+                    )
+                vals = (kids % 997) * 0.5 + 1.0
+                b = RecordBatch(bs_user, [ts, kids, vals])
+                return attach_canonical_timestamp(
+                    b, "occurred_at_ms",
+                    fallback_ms=int(time.time() * 1000),
+                )
+
+            def offset_snapshot(self):
+                return {"i": self._i}
+
+            def offset_restore(self, snap):
+                self._i = int(snap["i"])
+
+        class BigstateSource(Source):
+            name = "bigstate"
+
+            @property
+            def schema(self):
+                return bs_schema
+
+            def partitions(self):
+                return [BigstatePartition()]
+
+            @property
+            def unbounded(self):
+                return False
+
+        ds = ctx.from_source(
+            BigstateSource(), name="bigstate"
+        ).session_window(
+            ["sensor_id"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.min(col("reading")).alias("min"),
+                F.max(col("reading")).alias("max"),
+                F.avg(col("reading")).alias("average"),
+            ],
+            bs_gap,
+        )
     elif pipeline == "session":
         ds = ctx.from_source(
             SoakSource(SEED_LEFT, "soak_s"), name="soak_s"
@@ -714,6 +846,51 @@ def child_main() -> None:
             SLIDE_MS if pipeline == "sliding" else None,
         )
     it = ds.stream()
+    if pipeline == "bigstate":
+        # the drive loop only wakes on EMITTED batches, and phase A
+        # emits nothing for minutes — a side sampler thread records the
+        # state accounting (working set, spill counters) on a wall
+        # cadence into its own file (no interleaving with the emission
+        # stream; state_info reads are single-writer-defensive by
+        # contract)
+        import threading as _threading
+
+        def _state_sampler():
+            with open(out_path + ".state", "a", buffering=1) as sf:
+                while True:
+                    time.sleep(1.0)
+                    try:
+                        root = getattr(ctx, "_last_physical", None)
+                        if root is None:
+                            continue
+                        info = None
+                        stack = [root]
+                        while stack:
+                            cur = stack.pop()
+                            if type(cur).__name__ == "SessionWindowExec":
+                                info = cur.state_info()
+                                break
+                            stack.extend(cur.children)
+                        if info:
+                            sf.write(json.dumps({
+                                "event": "state",
+                                "bytes": info.get("state_bytes"),
+                                "evictable": info.get("evictable_bytes"),
+                                "live_keys": info.get("live_keys"),
+                                "spilled_bytes": info.get(
+                                    "spilled_bytes", 0
+                                ),
+                                "spilled_keys": info.get(
+                                    "spilled_keys", 0
+                                ),
+                                "spill": info.get("spill"),
+                            }) + "\n")
+                    except Exception:
+                        pass
+
+        _threading.Thread(
+            target=_state_sampler, daemon=True, name="bs-state"
+        ).start()
     stop = False
     coord = None
     announced = False
@@ -757,6 +934,30 @@ def child_main() -> None:
                 chaos["fault_log"] = p.event_log()
             if chaos:
                 out.write(json.dumps({"event": "chaos", **chaos}) + "\n")
+            if pipeline == "bigstate":
+                # state accounting snapshot (survives SIGKILL like the
+                # chaos event): the parent derives the unbudgeted
+                # working set and the budgeted run's resident bound
+                # from these
+                op = ctx._last_physical
+                info = None
+                stack = [op]
+                while stack:
+                    cur = stack.pop()
+                    if type(cur).__name__ == "SessionWindowExec":
+                        info = cur.state_info()
+                        break
+                    stack.extend(cur.children)
+                if info is not None:
+                    out.write(json.dumps({
+                        "event": "state",
+                        "bytes": info.get("state_bytes"),
+                        "evictable": info.get("evictable_bytes"),
+                        "live_keys": info.get("live_keys"),
+                        "spilled_bytes": info.get("spilled_bytes", 0),
+                        "spilled_keys": info.get("spilled_keys", 0),
+                        "spill": info.get("spill"),
+                    }) + "\n")
         except Exception:
             pass
 
@@ -800,7 +1001,9 @@ def child_main() -> None:
                 continue
             now = time.time()
             ws = batch.column(WINDOW_START_COLUMN)
-            names = batch.column("sensor_name")
+            names = batch.column(
+                "sensor_id" if pipeline == "bigstate" else "sensor_name"
+            )
             for i in range(batch.num_rows):
                 if pipeline == "udaf":
                     rec = {
@@ -810,11 +1013,14 @@ def child_main() -> None:
                         "count": int(batch.column("count")[i]),
                         "spread": round(float(batch.column("spread")[i]), 4),
                     }
-                elif pipeline == "session":
+                elif pipeline in ("session", "bigstate"):
                     rec = {
                         "t": round(now, 3),
                         "ws": int(ws[i]),
-                        "key": str(names[i]),
+                        "key": (
+                            int(names[i]) if pipeline == "bigstate"
+                            else str(names[i])
+                        ),
                         "we": int(batch.column(WINDOW_END_COLUMN)[i]),
                         "count": int(batch.column("count")[i]),
                         "min": round(float(batch.column("min")[i]), 4),
@@ -865,6 +1071,7 @@ def child_main() -> None:
                 **{k: sums[k] for k in (
                     "late_rows", "rows_out", "rows_in", "batches_out",
                     "prefetch_restarts", "prefetch_restarted_partitions",
+                    "salvaged_rows",
                 ) if k in sums},
             }) + "\n")
         except Exception:
@@ -1012,6 +1219,8 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
     n_snaps = 0
     segs_reporting = 0
     peak_state = 0.0
+    peak_spilled = 0.0
+    salvaged = 0.0
     state_hot: list = []
     for seg_i, path in enumerate(obs_paths):
         snaps = R.read_stream(path)
@@ -1038,6 +1247,27 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
                 seg_peak = tot
         if seg_peak > peak_state:
             peak_state = seg_peak
+        # cold-tier + salvage gauges: the segment's FINAL values (both
+        # are monotone within a segment's life for salvage; spilled
+        # bytes peak tracked like state bytes)
+        seg_salvaged = 0.0
+        for snap in snaps:
+            m = snap.get("metrics", {})
+            sp = sum(
+                v for k, v in m.items()
+                if k.startswith("dnz_state_spilled_bytes")
+                and isinstance(v, (int, float))
+            )
+            if sp > peak_spilled:
+                peak_spilled = sp
+            sv = sum(
+                v for k, v in m.items()
+                if k.startswith("dnz_source_salvaged_rows")
+                and isinstance(v, (int, float))
+            )
+            if sv > seg_salvaged:
+                seg_salvaged = sv
+        salvaged += seg_salvaged
         final_shares = {}
         for snap in snaps:  # last snapshot carrying hot-key series wins
             shares = {
@@ -1067,6 +1297,11 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
     }
     if peak_state:
         tele["peak_state_bytes"] = round(peak_state)
+    if peak_spilled:
+        tele["peak_spilled_bytes"] = round(peak_spilled)
+    # poison records skipped by salvage decode, summed across segments —
+    # silent data loss surfaced into the soak report (0 on clean feeds)
+    tele["salvaged_rows"] = round(salvaged)
     if state_hot:
         tele["state_hot_keys"] = state_hot
     if emit:
@@ -1091,6 +1326,285 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
     return tele
 
 
+def read_state_events(paths) -> list[dict]:
+    """Every 'state' accounting event across the given files (bigstate
+    soak: emission segments + their .state sampler streams)."""
+    out = []
+    for path in paths:
+        try:
+            f = open(path)
+        except FileNotFoundError:
+            continue
+        with f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if o.get("event") == "state":
+                    o["_path"] = str(path)
+                    out.append(o)
+    return out
+
+
+def bigstate_main(args) -> None:
+    """Larger-than-memory acceptance drive (ROADMAP item 3): one
+    unbudgeted reference run over a deterministic feed of
+    ``--keys`` simultaneously-open sessions, then the SAME feed under a
+    state budget ~5x smaller with the cold tier + checkpointing active,
+    SIGKILLed mid-run and restored.  Gates: byte-identical emissions
+    across the two runs (and across the kill), resident state bounded by
+    the budget, a materially lower RSS ceiling, the spill machinery
+    demonstrably exercised, and the armed spill-site fault rules all
+    fired + healed."""
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="soak_bs_")
+    a_batches = -(-args.keys // args.batch_rows)
+    waves = -(-args.keys // args.wave_keys)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SOAK_BATCH_ROWS": str(args.batch_rows),
+        "SOAK_PACE": str(args.pace),
+        "SOAK_TOTAL_BATCHES": str(a_batches + waves),
+        "SOAK_PIPELINE": "bigstate",
+        "SOAK_BS_KEYS": str(args.keys),
+        "SOAK_BS_WAVE": str(args.wave_keys),
+        "SOAK_T0": str(T0),
+        "SOAK_CKPT_S": str(args.ckpt_s),
+    })
+    report: dict = {
+        "pipeline": "bigstate",
+        "keys": args.keys,
+        "wave_keys": args.wave_keys,
+        "batch_rows": args.batch_rows,
+        "kill_every_s": args.kill_every,
+        "phaseA_batches": a_batches,
+        "close_waves": waves,
+    }
+
+    def run_child(out_path, obs_path, ckpt_dir, budget, kill_every,
+                  max_kills):
+        seg_env = dict(env)
+        seg_env["SOAK_BS_BUDGET"] = str(budget)
+        seg_env["SOAK_CKPT_DIR"] = ckpt_dir
+        if budget and args.chaos_spill:
+            seg_env["DENORMALIZED_FAULT_PLAN"] = json.dumps(
+                bigstate_fault_plan(args.chaos_seed)
+            )
+        segs, rss, kills, crashes = [], [], 0, 0
+        done = False
+        seg = 0
+        while not done:
+            seg += 1
+            seg_out = f"{out_path}.{seg}"
+            segs.append(seg_out)
+            seg_env["SOAK_OUT"] = seg_out
+            seg_env["SOAK_OBS_OUT"] = f"{obs_path}.{seg}"
+            t_spawn = time.monotonic()
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                env=seg_env, stdout=sys.stderr, stderr=sys.stderr,
+            )
+            kill_at = t_spawn + kill_every
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        done = True
+                    else:
+                        crashes += 1
+                        if crashes > 5:
+                            raise RuntimeError(
+                                f"bigstate child crashed {crashes}x "
+                                f"(rc={rc})"
+                            )
+                    break
+                if (r := rss_kb(proc.pid)):
+                    rss.append(r)
+                if (
+                    kills < max_kills
+                    and time.monotonic() >= kill_at
+                ):
+                    os.kill(proc.pid, signal.SIGKILL)
+                    kills += 1
+                    proc.wait(10)
+                    break
+                time.sleep(0.5)
+        return segs, rss, kills, crashes
+
+    try:
+        # -- run 1: the unbudgeted oracle --------------------------------
+        ckpt_ref = os.path.join(work, "ckpt_ref")
+        os.makedirs(ckpt_ref)
+        t0 = time.monotonic()
+        ref_segs, ref_rss, _, _ = run_child(
+            os.path.join(work, "ref.jsonl"),
+            os.path.join(work, "ref_obs.jsonl"),
+            ckpt_ref, budget=0, kill_every=float("inf"), max_kills=0,
+        )
+        ref_wall = time.monotonic() - t0
+        wins_ref, ref_dupes, ref_done, _m, _c = read_emissions(ref_segs)
+        ref_states = read_state_events(
+            ref_segs + [p + ".state" for p in ref_segs]
+        )
+        working_set = max(
+            (s.get("bytes") or 0 for s in ref_states), default=0
+        )
+        budget = args.state_budget or max(working_set // 5, 1_000_000)
+        report.update({
+            "reference": {
+                "wall_s": round(ref_wall, 1),
+                "sessions": len(wins_ref),
+                "duplicate_emissions": ref_dupes,
+                "rss_kb_max": max(ref_rss) if ref_rss else None,
+                "working_set_bytes": working_set,
+            },
+            "budget_bytes": budget,
+            "budget_ratio": (
+                round(working_set / budget, 2) if budget else None
+            ),
+        })
+        # -- run 2: budgeted + kills -------------------------------------
+        ckpt_b = os.path.join(work, "ckpt_b")
+        os.makedirs(ckpt_b)
+        t0 = time.monotonic()
+        b_segs, b_rss, kills, crashes = run_child(
+            os.path.join(work, "bud.jsonl"),
+            os.path.join(work, "bud_obs.jsonl"),
+            ckpt_b, budget=budget, kill_every=args.kill_every,
+            max_kills=args.max_kills,
+        )
+        b_wall = time.monotonic() - t0
+        wins_b, dupes, done_seen, _m2, clipped = read_emissions(b_segs)
+        b_states = read_state_events(
+            b_segs + [p + ".state" for p in b_segs]
+        )
+        resident_max = max(
+            (s.get("bytes") or 0 for s in b_states), default=0
+        )
+        evictable_max = max(
+            (s.get("evictable") or 0 for s in b_states), default=0
+        )
+        # counters reset with each respawned incarnation: sum each
+        # segment's LAST spill snapshot for the run totals
+        last_per_seg: dict = {}
+        for s in b_states:
+            if s.get("spill"):
+                last_per_seg[s["_path"]] = s["spill"]
+        spill_final: dict = {}
+        for sp in last_per_seg.values():
+            for k, v in sp.items():
+                if isinstance(v, (int, float)):
+                    spill_final[k] = spill_final.get(k, 0) + v
+        chaos_events = read_chaos_events(b_segs)
+        fired: dict = {}
+        for ev in chaos_events:
+            for e in ev.get("fault_log", []):
+                name = e.get("name", f"rule{e.get('rule')}")
+                fired[name] = fired.get(name, 0) + 1
+        # -- drift: EVERY budgeted occurrence must equal the oracle's ----
+        lost, spurious, mismatched = [], [], 0
+        for k, occs in wins_ref.items():
+            want = occs[0][0]
+            got = wins_b.get(k)
+            if not got:
+                lost.append(k)
+                continue
+            for vals, _seg in got:
+                if vals != want:
+                    mismatched += 1
+        for k in wins_b:
+            if k not in wins_ref:
+                spurious.append(k)
+        expected_sessions = args.keys + waves * 64
+        spill_blocks = (
+            (spill_final or {}).get("spill_blocks_total", 0)
+        )
+        required_fired = (
+            sorted(r for r in BIGSTATE_REQUIRED_RULES if r in fired)
+            if args.chaos_spill else []
+        )
+        rss_ratio = (
+            round(max(b_rss) / max(ref_rss), 3)
+            if b_rss and ref_rss else None
+        )
+        # the RSS gate is relative to the WORKING SET, not a bare
+        # ratio: both runs keep the interner key index resident (the
+        # documented membership-filter floor, ~2.8GB at 10M int keys),
+        # so the budgeted run must shed at least 35% of the evictable
+        # working set from RAM — a gate that scales with the workload
+        # instead of hardcoding the index share
+        rss_saved_bytes = (
+            (max(ref_rss) - max(b_rss)) * 1024
+            if b_rss and ref_rss else None
+        )
+        rss_flat_ok = (
+            rss_saved_bytes is not None
+            and rss_saved_bytes >= 0.35 * working_set
+            and (rss_ratio is None or rss_ratio <= 0.9)
+        )
+        report.update({
+            "budgeted": {
+                "wall_s": round(b_wall, 1),
+                "segments": len(b_segs),
+                "kills": kills,
+                "crash_restarts": crashes,
+                "sessions": len(wins_b),
+                "duplicate_emissions": dupes,
+                "uncommitted_clipped": clipped,
+                "rss_kb_max": max(b_rss) if b_rss else None,
+                "resident_state_bytes_max": resident_max,
+                "evictable_state_bytes_max": evictable_max,
+                "spill": spill_final,
+            },
+            "chaos_spill": {
+                "armed": bool(args.chaos_spill),
+                "fired_rules": fired,
+                "required_rules_fired": required_fired,
+            },
+            "sessions_expected": expected_sessions,
+            "sessions_lost": len(lost),
+            "sessions_spurious": len(spurious),
+            "sessions_mismatched": mismatched,
+            "rss_budgeted_over_reference": rss_ratio,
+            "rss_saved_mb": (
+                round(rss_saved_bytes / 2**20) if rss_saved_bytes else None
+            ),
+            "ok": (
+                ref_done and done_seen
+                and len(wins_ref) == expected_sessions
+                and not lost and not spurious and not mismatched
+                and kills >= 1
+                and spill_blocks > 0
+                # EVICTABLE resident state stays bounded by the budget
+                # (25% slack covers estimate-vs-exact gap + the
+                # protected current batch); the interned-key index is
+                # the documented un-evictable resident floor, reported
+                # via resident_state_bytes_max (docs/state_spill.md)
+                and evictable_max <= budget * 1.25
+                and rss_flat_ok
+                and (
+                    not args.chaos_spill
+                    or len(required_fired) == len(BIGSTATE_REQUIRED_RULES)
+                )
+            ),
+        })
+        Path(args.out).write_text(json.dumps(report, indent=1))
+        print(json.dumps({
+            "ok": report["ok"],
+            "sessions": len(wins_b),
+            "kills": kills,
+            "spill_blocks": spill_blocks,
+            "rss_ratio": rss_ratio,
+            "budget_ratio": report.get("budget_ratio"),
+        }))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def rss_kb(pid: int) -> int | None:
     try:
         with open(f"/proc/{pid}/status") as f:
@@ -1112,8 +1626,24 @@ def main():
     ap.add_argument("--kill-every", type=float, default=90.0)
     ap.add_argument("--pipeline",
                     choices=("simple", "sliding", "join", "session",
-                             "udaf", "kafka"),
+                             "udaf", "kafka", "bigstate"),
                     default="simple")
+    ap.add_argument("--keys", type=int, default=10_000_000,
+                    help="bigstate: simultaneously-open sessions")
+    ap.add_argument("--wave-keys", type=int, default=100_000,
+                    help="bigstate: sessions closed per watermark wave")
+    ap.add_argument("--state-budget", type=int, default=0,
+                    help="bigstate: budget bytes (0 = working set / 5)")
+    ap.add_argument("--ckpt-s", type=float, default=20.0,
+                    help="bigstate: checkpoint interval")
+    ap.add_argument("--max-kills", type=int, default=2,
+                    help="bigstate: SIGKILLs issued mid-run")
+    ap.add_argument("--chaos-spill", action="store_true", default=True,
+                    help="bigstate: arm the spill-site fault plan "
+                    "(transient reload flap, eviction-write failure, "
+                    "torn manifest; default on)")
+    ap.add_argument("--no-chaos-spill", dest="chaos_spill",
+                    action="store_false")
     ap.add_argument("--chaos", action="store_true",
                     help="arm the seeded FaultPlan (broker flaps, worker "
                     "crashes, torn state writes, commit hiccups) on top "
@@ -1138,10 +1668,14 @@ def main():
                 "udaf": "SOAK_UDAF.json",
                 "sliding": "SOAK_SLIDING.json",
                 "kafka": "SOAK_KAFKA.json",
+                "bigstate": "SOAK_BIGSTATE.json",
             }[args.pipeline]
         ))
     if args.child:
         child_main()
+        return
+    if args.pipeline == "bigstate":
+        bigstate_main(args)
         return
 
     import shutil
