@@ -1,0 +1,572 @@
+"""Cluster coordinator: spawn workers, align barriers, commit epochs,
+supervise, rescale on restore.
+
+The coordinator is a small control plane — it never touches row data.
+Its one durable artifact is ``meta/commits.jsonl``: an epoch appears
+there only after EVERY worker acked it (offsets + keyed snapshots
+durable in each worker's own store), which makes the last line the
+cluster-consistent recovery point.  Worker-local commit records are
+proposals; restore pins every worker to the cluster-committed epoch
+(cluster/worker.py PinnedCheckpointCoordinator).
+
+Supervision reuses the restart-budget pattern of the prefetch
+supervisor one level up: any worker death, error report, or liveness
+stall kills the whole incarnation and respawns it from the last
+cluster-committed epoch, at most ``spec.max_restarts`` times.  Recovery
+is full-cluster by design — a single worker cannot restart alone
+because its exchange peers hold post-barrier rows from it (the aligned
+cut is cluster-wide).  Exactly-once OUTPUT across those restarts is the
+reader-side clip protocol (tools/soak.py read_emissions), applied per
+worker slot.
+
+On restore with a DIFFERENT ``n_workers`` the coordinator first runs
+cluster/rescale.py, which re-buckets every worker's checkpointed keyed
+and spilled state plus source offsets under the new hash map into a new
+store version, then starts the new workers pinned at the same epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from denormalized_tpu.common.errors import StateError
+from denormalized_tpu.cluster.spec import ClusterSpec
+
+
+def _fsync_append(path: str, line: str) -> None:
+    with open(path, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class _WorkerConn:
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.wlock = threading.Lock()
+
+    def send(self, obj: dict) -> bool:
+        try:
+            with self.wlock:
+                self.sock.sendall((json.dumps(obj) + "\n").encode())
+            return True
+        except OSError:
+            return False
+
+
+class Coordinator:
+    def __init__(
+        self,
+        spec: ClusterSpec,
+        *,
+        kill_after_commits: int | None = None,
+        kill_worker_after_s: float | None = None,
+        kill_worker_id: int = 0,
+    ) -> None:
+        self.spec = spec
+        self.kill_after_commits = kill_after_commits
+        self.kill_worker_after_s = kill_worker_after_s
+        self.kill_worker_id = kill_worker_id
+        self.workdir = spec.workdir
+        for d in ("sock", "out", "obs", "meta", "state"):
+            os.makedirs(os.path.join(self.workdir, d), exist_ok=True)
+        self._spec_path = os.path.join(self.workdir, "meta", "spec.json")
+        with open(self._spec_path, "w") as f:
+            f.write(spec.to_json())
+        self._manifest_path = os.path.join(
+            self.workdir, "meta", "manifest.json"
+        )
+        self._commits_path = os.path.join(
+            self.workdir, "meta", "commits.jsonl"
+        )
+        self._segments_path = os.path.join(
+            self.workdir, "meta", "segments.jsonl"
+        )
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._conns: dict[int, _WorkerConn] = {}
+        self._events: queue.Queue = queue.Queue()
+        self._listener: socket.socket | None = None
+        self.restarts = 0
+        self.crash_log: list[str] = []  # why each incarnation died
+        #: generation token: bumped before each spawn; control events
+        #: are tagged with the token current when their connection was
+        #: accepted, so a killed generation's buffered acks/eos can
+        #: never be attributed to the respawned workers (epoch numbers
+        #: REPEAT across incarnations — a stale ack for epoch E would
+        #: otherwise cluster-commit E without the new workers' state)
+        self._gen_token = 0
+        self.out_files: dict[int, list[str]] = {
+            i: [] for i in range(spec.n_workers)
+        }
+
+    # -- durable meta -----------------------------------------------------
+    def read_manifest(self) -> dict | None:
+        try:
+            with open(self._manifest_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def _write_manifest(self, manifest: dict) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+
+    def committed_epochs(self) -> list[dict]:
+        out = []
+        try:
+            f = open(self._commits_path)
+        except FileNotFoundError:
+            return out
+        with f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail from a killed coordinator
+        return out
+
+    def last_committed(self) -> int | None:
+        commits = self.committed_epochs()
+        return commits[-1]["epoch"] if commits else None
+
+    def segments(self) -> list[dict]:
+        """Durable incarnation history: one record per worker
+        generation, each naming its restore epoch and output files —
+        what the exactly-once reader (cluster/reader.py) clips across.
+        Survives coordinator restarts AND worker-count changes (output
+        slots re-map under rescale; epochs are cluster-global)."""
+        out = []
+        try:
+            f = open(self._segments_path)
+        except FileNotFoundError:
+            return out
+        with f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return out
+
+    def store_dir(self, version: int, worker: int) -> str:
+        return os.path.join(
+            self.workdir, "state", f"v{version}", f"worker_{worker}"
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def _checkpointing(self) -> bool:
+        return self.spec.checkpoint_interval_s is not None
+
+    def _start_control_server(self) -> None:
+        from denormalized_tpu.cluster.worker import ctrl_sock_path
+
+        path = ctrl_sock_path(self.workdir)
+        if os.path.exists(path):
+            os.unlink(path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(self.spec.n_workers * 2)
+        threading.Thread(
+            target=self._accept_loop, name="cluster-accept", daemon=True
+        ).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._conn_loop, args=(conn, self._gen_token),
+                name="cluster-conn", daemon=True,
+            ).start()
+
+    def _conn_loop(self, conn: socket.socket, token: int) -> None:
+        f = conn.makefile("r", encoding="utf-8")
+        wid = None
+        try:
+            hello = json.loads(f.readline())
+            if hello.get("ev") != "hello":
+                conn.close()
+                return
+            wid = int(hello["worker"])
+            self._conns[wid] = _WorkerConn(conn)
+            self._events.put(("hello", wid, hello, token))
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                self._events.put(("msg", wid, msg, token))
+        except (OSError, ValueError):
+            pass
+        finally:
+            if wid is not None:
+                self._events.put(("conn_lost", wid, {}, token))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _spawn_workers(
+        self, seq: int, store_version: int, restore_epoch: str
+    ) -> None:
+        # stale exchange sockets from a killed incarnation must not
+        # accept this incarnation's connects
+        sockdir = os.path.join(self.workdir, "sock")
+        for name in os.listdir(sockdir):
+            if name.startswith("exch_"):
+                os.unlink(os.path.join(sockdir, name))
+        # global generation number: unique across coordinator restarts
+        # (a resumed coordinator must never append into a previous
+        # incarnation's files, and the reader needs total order)
+        gen = len(self.segments())
+        spec_path = self._spec_path
+        if gen > 0 and self.spec.fault_plan and self.spec.fault_plan_once:
+            # respawned incarnations run fault-free (see ClusterSpec)
+            spec_path = os.path.join(
+                self.workdir, "meta", "spec_nofault.json"
+            )
+            if not os.path.exists(spec_path):
+                import dataclasses
+
+                clean = dataclasses.replace(self.spec, fault_plan=None)
+                with open(spec_path, "w") as f:
+                    f.write(clean.to_json())
+        outs = []
+        for i in range(self.spec.n_workers):
+            os.makedirs(
+                self.store_dir(store_version, i), exist_ok=True
+            )
+            outs.append(os.path.join(
+                self.workdir, "out", f"g{gen:04d}_w{i}.jsonl"
+            ))
+        _fsync_append(self._segments_path, json.dumps({
+            "gen": gen,
+            "n_workers": self.spec.n_workers,
+            "restored": (
+                None if restore_epoch in ("off", "none")
+                else int(restore_epoch)
+            ),
+            "files": outs,
+        }))
+        for i in range(self.spec.n_workers):
+            store = self.store_dir(store_version, i)
+            out = outs[i]
+            self.out_files[i].append(out)
+            env = dict(os.environ)
+            # workers are host-side engine processes; an unset platform
+            # must not auto-grab an accelerator per worker (the device
+            # half stays per-worker via EngineConfig mesh settings)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            self._procs[i] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "denormalized_tpu.cluster.worker",
+                    "--spec", spec_path,
+                    "--worker", str(i),
+                    "--store", store,
+                    "--restore-epoch", restore_epoch,
+                    "--seq", str(seq),
+                    "--out", out,
+                ],
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                )),
+                env=env,
+            )
+
+    def _kill_all(self) -> None:
+        for p in self._procs.values():
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        self._procs.clear()
+        self._conns.clear()
+
+    def _broadcast(self, obj: dict) -> None:
+        for wc in list(self._conns.values()):
+            wc.send(obj)
+
+    # -- main loop --------------------------------------------------------
+    def run(self) -> dict:
+        """Run the cluster to completion (or to the configured kill),
+        supervising restarts.  Returns the run summary."""
+        t_start = time.perf_counter()
+        self._start_control_server()
+        try:
+            return self._run_supervised(t_start)
+        finally:
+            self._kill_all()
+            if self._listener is not None:
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+
+    def _prepare_incarnation(self) -> tuple[int, str]:
+        """→ (store_version, restore_epoch_arg), rescaling if the
+        manifest's worker count differs from the spec's."""
+        if not self._checkpointing():
+            return 0, "off"
+        manifest = self.read_manifest()
+        committed = self.last_committed()
+        if manifest is None or committed is None:
+            return (manifest or {}).get("store_version", 0), "none"
+        if manifest["n_workers"] != self.spec.n_workers:
+            from denormalized_tpu.cluster.rescale import rescale_cluster
+
+            new_version = manifest["store_version"] + 1
+            rescale_cluster(
+                self, manifest, committed, self.spec.n_workers, new_version
+            )
+            manifest["n_workers"] = self.spec.n_workers
+            manifest["store_version"] = new_version
+            self._write_manifest(manifest)
+        return self.read_manifest()["store_version"], str(committed)
+
+    def _run_supervised(self, t_start: float) -> dict:
+        seq = 0
+        killed_workers = 0
+        exchange_faults = 0
+        while True:
+            store_version, restore_epoch = self._prepare_incarnation()
+            status, detail = self._run_incarnation(
+                seq, store_version, restore_epoch,
+                already_killed=killed_workers,
+            )
+            seq += 1
+            if status == "done":
+                commits = self.committed_epochs()
+                rows = detail.get("rows", {})
+                meta = detail.get("meta", {})
+                return {
+                    "status": "done",
+                    "rows_total": sum(rows.values()),
+                    "rows_per_worker": rows,
+                    "rows_in_total": sum(
+                        int(m.get("rows_in", 0)) for m in meta.values()
+                    ),
+                    "ingest_wall_s_max": max(
+                        [float(m.get("ingest_wall_s", 0.0))
+                         for m in meta.values()] or [0.0]
+                    ),
+                    "worker_wall_s_max": max(
+                        [float(m.get("worker_wall_s", 0.0))
+                         for m in meta.values()] or [0.0]
+                    ),
+                    "commits": [c["epoch"] for c in commits],
+                    "restarts": self.restarts,
+                    "killed_workers": detail.get("killed_workers", 0),
+                    "out_files": {
+                        str(k): v for k, v in self.out_files.items()
+                    },
+                    "segments": self.segments(),
+                    "crashes": list(self.crash_log),
+                    "wall_s": round(time.perf_counter() - t_start, 3),
+                }
+            if status == "killed":
+                return {
+                    "status": "killed",
+                    "commits": [
+                        c["epoch"] for c in self.committed_epochs()
+                    ],
+                    "restarts": self.restarts,
+                    "out_files": {
+                        str(k): v for k, v in self.out_files.items()
+                    },
+                    "segments": self.segments(),
+                    "wall_s": round(time.perf_counter() - t_start, 3),
+                }
+            # crash / wedge: full-cluster restart from the last commit
+            self.crash_log.append(str(detail.get("why")))
+            killed_workers += detail.get("killed_workers", 0)
+            self.restarts += 1
+            if self.restarts > self.spec.max_restarts:
+                raise StateError(
+                    f"cluster exceeded restart budget "
+                    f"({self.spec.max_restarts}): {detail.get('why')}"
+                )
+
+    def _run_incarnation(
+        self, seq: int, store_version: int, restore_epoch: str,
+        already_killed: int = 0,
+    ) -> tuple[str, dict]:
+        spec = self.spec
+        n = spec.n_workers
+        # new generation: bump the token FIRST (conn threads capture it
+        # at accept) and drop anything a killed generation left queued
+        self._gen_token += 1
+        while True:
+            try:
+                self._events.get_nowait()
+            except queue.Empty:
+                break
+        self._spawn_workers(seq, store_version, restore_epoch)
+        ready: dict[int, dict] = {}
+        eos_rows: dict[int, int] = {}
+        eos_meta: dict[int, dict] = {}
+        acked: set[int] = set()
+        inflight_epoch: int | None = None
+        next_barrier_at: float | None = None
+        committed = self.last_committed() or 0
+        kill_at = (
+            time.monotonic() + self.kill_worker_after_s
+            if self.kill_worker_after_s is not None and already_killed == 0
+            else None
+        )
+        killed_workers = 0
+        last_liveness = time.monotonic()
+
+        def fail(why: str) -> tuple[str, dict]:
+            self._kill_all()
+            return "crashed", {
+                "why": why, "killed_workers": killed_workers,
+            }
+
+        while True:
+            # worker process death?
+            for wid, p in list(self._procs.items()):
+                rc = p.poll()
+                if rc is not None and rc != 0:
+                    return fail(f"worker {wid} exited rc={rc}")
+                if rc == 0 and wid not in eos_rows:
+                    return fail(f"worker {wid} exited before EOS")
+            if kill_at is not None and time.monotonic() >= kill_at:
+                # chaos: SIGKILL one worker mid-stream
+                p = self._procs.get(self.kill_worker_id)
+                if p is not None and p.poll() is None:
+                    os.kill(p.pid, signal.SIGKILL)
+                    killed_workers += 1
+                kill_at = None
+                continue
+            if (
+                time.monotonic() - last_liveness
+                > spec.liveness_timeout_s
+            ):
+                return fail("liveness timeout (no worker progress)")
+            # barrier cadence: serial (commit e before issuing e+1)
+            if (
+                self._checkpointing()
+                and len(ready) == n
+                and inflight_epoch is None
+                and next_barrier_at is not None
+                and time.monotonic() >= next_barrier_at
+                and len(eos_rows) < n
+            ):
+                inflight_epoch = committed + 1
+                acked = set()
+                self._broadcast(
+                    {"cmd": "barrier", "epoch": inflight_epoch}
+                )
+            try:
+                kind, wid, msg, token = self._events.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if token != self._gen_token:
+                continue  # a dead generation's buffered event
+            last_liveness = time.monotonic()
+            if kind == "hello":
+                continue
+            if kind == "conn_lost":
+                # the process-death poll above decides whether this is a
+                # crash (nonzero exit) or a clean shutdown
+                continue
+            ev = msg.get("ev")
+            if ev == "ready":
+                ready[wid] = msg
+                if len(ready) == n:
+                    if self.read_manifest() is None:
+                        self._write_manifest({
+                            "n_workers": n,
+                            "store_version": store_version,
+                            "n_partitions": msg.get("n_partitions"),
+                            "state_keys": msg.get("state_keys"),
+                            "key_columns": msg.get("key_columns"),
+                            "key_dtypes": msg.get("key_dtypes"),
+                        })
+                    if self._checkpointing():
+                        next_barrier_at = (
+                            time.monotonic() + spec.checkpoint_interval_s
+                        )
+            elif ev == "ack":
+                if int(msg["epoch"]) == inflight_epoch:
+                    acked.add(wid)
+                    if len(acked) == n:
+                        committed = inflight_epoch
+                        _fsync_append(self._commits_path, json.dumps({
+                            "epoch": committed,
+                            "n_workers": n,
+                            "store_version": store_version,
+                            "t": round(time.time(), 3),
+                        }))
+                        inflight_epoch = None
+                        next_barrier_at = (
+                            time.monotonic() + spec.checkpoint_interval_s
+                        )
+                        if (
+                            self.kill_after_commits is not None
+                            and len(self.committed_epochs())
+                            >= self.kill_after_commits
+                        ):
+                            self._kill_all()
+                            return "killed", {}
+                        if len(eos_rows) == n:
+                            # every worker reached EOS while this epoch
+                            # was aligning — finish now that it committed
+                            self._broadcast({"cmd": "stop"})
+                            for p in self._procs.values():
+                                try:
+                                    p.wait(timeout=30)
+                                except subprocess.TimeoutExpired:
+                                    p.kill()
+                            return "done", {
+                                "rows": eos_rows,
+                                "meta": eos_meta,
+                                "killed_workers": (
+                                    killed_workers + already_killed
+                                ),
+                            }
+            elif ev == "eos":
+                eos_rows[wid] = int(msg.get("rows", 0))
+                eos_meta[wid] = msg
+                if len(eos_rows) == n and inflight_epoch is None:
+                    self._broadcast({"cmd": "stop"})
+                    deadline = time.monotonic() + 30
+                    for p in self._procs.values():
+                        try:
+                            p.wait(
+                                timeout=max(0.1, deadline - time.monotonic())
+                            )
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                    return "done", {
+                        "rows": eos_rows,
+                        "meta": eos_meta,
+                        "killed_workers": killed_workers + already_killed,
+                    }
+            elif ev == "error":
+                return fail(f"worker {wid}: {msg.get('msg')}")
+
+
+def run_cluster(spec: ClusterSpec, **kw) -> dict:
+    """Convenience wrapper: build a coordinator, run, return summary."""
+    return Coordinator(spec, **kw).run()

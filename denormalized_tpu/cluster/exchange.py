@@ -1,0 +1,362 @@
+"""Exchange plumbing: unix-domain sockets between worker processes.
+
+Topology: every worker hosts one **server** socket and dials one
+**client** connection to every other worker — worker w's keyed operator
+therefore has N inbound *edges*: N-1 sockets plus a zero-copy loopback
+from its own ingest half.  Frames (cluster/framing.py) flow sender →
+receiver only; there is no request/response.
+
+The receive side runs one thread per inbound connection, decoding frames
+into a bounded per-edge queue — the queue bound (plus the kernel socket
+buffer) IS the exchange's backpressure, exactly like the prefetch
+pump's per-partition double buffer.  The :class:`EdgeMerger` is the
+single consumer: it merges data across edges, merges **watermarks** as
+the min over per-edge watermarks (an edge's watermark advances via
+piggybacked data-frame watermarks and explicit wm frames), aligns
+**barriers** (an edge that delivered barrier E is not consumed again
+until every live edge delivered E — the aligned Chandy-Lamport cut,
+same invariant the join operator enforces per-epoch), and collapses to
+EOS when every edge reports it.
+
+Failure model is fail-stop: any integrity violation (torn frame, CRC
+mismatch, refused reconnect) raises ``SourceError`` out of the worker,
+and the coordinator restarts the cluster from the last cluster-committed
+epoch.  Fault sites ``exchange.connect`` / ``exchange.send`` /
+``exchange.recv`` (runtime/faults.py) make every one of those paths
+reproducible on demand; ``exchange.send`` supports ``torn`` rules — the
+truncated frame is genuinely written before the connection drops, so
+the RECEIVER exercises its tear detection, not just the sender its
+error path.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+
+from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.runtime import faults
+from denormalized_tpu.cluster import framing
+
+#: per-edge inbound queue bound (items, mostly data frames): with the
+#: socket buffer this bounds memory while a barrier-blocked edge waits
+EDGE_QUEUE_ITEMS = 16
+
+_CONNECT_TIMEOUT_S = 30.0
+
+
+class ExchangeClient:
+    """One outbound edge: this worker's ingest half → peer ``dst``."""
+
+    def __init__(self, src: int, dst: int, sock_path: str) -> None:
+        from denormalized_tpu import obs
+
+        self.src = src
+        self.dst = dst
+        self.sock_path = sock_path
+        self.edge = f"{src}->{dst}"
+        self._sock: socket.socket | None = None
+        self._obs_frames = obs.counter(
+            "dnz_exchange_frames_total", dir="send", edge=self.edge
+        )
+        self._obs_bytes = obs.counter(
+            "dnz_exchange_bytes_total", dir="send", edge=self.edge
+        )
+        self._obs_send_ms = obs.histogram(
+            "dnz_exchange_send_ms", edge=self.edge
+        )
+
+    def connect(self, deadline_s: float = _CONNECT_TIMEOUT_S) -> None:
+        """Dial the peer's server socket (which may not be listening yet
+        — workers start concurrently), then identify this edge with a
+        hello frame.  Retries cover startup races only; an injected
+        fault or the deadline fails the worker outright."""
+        faults.inject("exchange.connect", key=self.edge)
+        deadline = time.monotonic() + deadline_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                s.connect(self.sock_path)
+                self._sock = s
+                self.send(framing.encode_hello(self.src))
+                return
+            except OSError as e:
+                s.close()
+                self._sock = None
+                last = e
+                time.sleep(0.05)
+        raise SourceError(
+            f"exchange connect {self.edge} failed after {deadline_s}s: {last}"
+        )
+
+    def send(self, frame: bytes) -> None:
+        """Write one frame.  A ``torn`` fault rule truncates the bytes
+        actually written and then drops the connection, so the tear is
+        observed where real tears are: at the receiver."""
+        if self._sock is None:
+            raise SourceError(f"exchange edge {self.edge} not connected")
+        t0 = time.perf_counter()
+        payload = faults.inject("exchange.send", key=self.edge, payload=frame)
+        try:
+            self._sock.sendall(payload)
+        except OSError as e:
+            raise SourceError(
+                f"exchange send on {self.edge} failed: {e}"
+            ) from e
+        if len(payload) != len(frame):
+            # the torn prefix is on the wire; kill the connection so the
+            # receiver sees a mid-frame EOF/CRC failure, then fail this
+            # worker — exactly what a mid-send process death looks like
+            self.close()
+            raise SourceError(
+                f"exchange frame torn by fault injection on {self.edge} "
+                f"({len(payload)}/{len(frame)} bytes written)"
+            )
+        self._obs_frames.add(1)
+        self._obs_bytes.add(len(frame))
+        self._obs_send_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def close(self) -> None:
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class EdgeState:
+    """Receiver-side state of one inbound edge."""
+
+    __slots__ = ("edge_id", "queue", "wm", "aligned", "eos", "depth_gauge")
+
+    def __init__(self, edge_id: int, depth_gauge) -> None:
+        self.edge_id = edge_id
+        self.queue: queue.Queue = queue.Queue(maxsize=EDGE_QUEUE_ITEMS)
+        self.wm: int | None = None
+        self.aligned = False  # delivered the in-flight barrier epoch
+        self.eos = False
+        self.depth_gauge = depth_gauge
+
+
+class ExchangeServer:
+    """This worker's inbound half: accepts N-1 peer connections, runs
+    one decode thread per connection, and exposes the per-edge queues to
+    the :class:`EdgeMerger`."""
+
+    def __init__(
+        self, worker_id: int, n_workers: int, sock_path: str, schema
+    ) -> None:
+        from denormalized_tpu import obs
+
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.schema = schema
+        self.sock_path = sock_path
+        self.edges: dict[int, EdgeState] = {
+            w: EdgeState(
+                w,
+                obs.gauge(
+                    "dnz_exchange_edge_depth", edge=f"{w}->{worker_id}"
+                ),
+            )
+            for w in range(n_workers)
+        }
+        self._obs_frames = obs.counter(
+            "dnz_exchange_frames_total", dir="recv",
+            edge=f"*->{worker_id}",
+        )
+        self._obs_bytes = obs.counter(
+            "dnz_exchange_bytes_total", dir="recv",
+            edge=f"*->{worker_id}",
+        )
+        self.wake = threading.Event()
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(sock_path)
+        self._listener.listen(n_workers)
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"exch-accept-{worker_id}",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    # -- loopback (ingest half of THIS worker) ---------------------------
+    def local_put(self, item: tuple) -> None:
+        """Zero-copy enqueue from this worker's own ingest half — no
+        socket, no framing, no fault site (the in-process edge is not an
+        I/O boundary)."""
+        edge = self.edges[self.worker_id]
+        edge.queue.put(item)
+        edge.depth_gauge.set(edge.queue.qsize())
+        self.wake.set()
+
+    # -- socket side ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        expected = self.n_workers - 1
+        accepted = 0
+        while accepted < expected and not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed during shutdown
+            t = threading.Thread(
+                target=self._recv_loop, args=(conn,),
+                name=f"exch-recv-{self.worker_id}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+            accepted += 1
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        """Decode frames from one peer into its edge queue.  Any
+        integrity failure is delivered IN-BAND as an ("err", exc) item —
+        the merger re-raises on the consumer thread, the worker dies,
+        the coordinator recovers (fail-stop contract)."""
+        edge: EdgeState | None = None
+        try:
+            payload = framing.read_frame(conn)
+            if payload is None:
+                return  # peer connected and vanished before hello
+            kind = framing.decode_frame(payload, self.schema)
+            if kind[0] != "hello":
+                raise SourceError(
+                    f"exchange peer spoke {kind[0]!r} before hello"
+                )
+            edge = self.edges[kind[1]]
+            while not self._stop.is_set():
+                faults.inject(
+                    "exchange.recv",
+                    key=f"{edge.edge_id}->{self.worker_id}",
+                )
+                payload = framing.read_frame(conn)
+                if payload is None:
+                    # clean EOF without an eos frame: the peer died —
+                    # surface, never silently treat as end-of-partition
+                    raise SourceError(
+                        f"exchange edge {edge.edge_id}->{self.worker_id} "
+                        "closed without EOS"
+                    )
+                item = framing.decode_frame(payload, self.schema)
+                self._obs_frames.add(1)
+                self._obs_bytes.add(len(payload))
+                edge.queue.put(item)
+                edge.depth_gauge.set(edge.queue.qsize())
+                self.wake.set()
+                if item[0] == "eos":
+                    return
+        except SourceError as e:
+            if edge is not None:
+                edge.queue.put(("err", e))
+                self.wake.set()
+            # hello never arrived: no edge to poison — the merger will
+            # starve and the coordinator's liveness timeout recovers
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class EdgeMerger:
+    """Single consumer over all inbound edges: data interleaves freely,
+    watermarks merge as the min over live edges, barriers align, EOS
+    collapses when unanimous.  Yields engine stream items — see
+    :class:`~denormalized_tpu.cluster.runtime.ExchangeSourceExec` for
+    where they enter the keyed pipeline."""
+
+    def __init__(self, server: ExchangeServer) -> None:
+        self.server = server
+        self._merged_wm: int | None = None
+
+    def _merged_watermark(self) -> int | None:
+        """Min over non-EOS edges; an exhausted edge leaves the min
+        (same rule as finished partitions in _PartitionWatermarks)."""
+        live = [
+            e.wm for e in self.server.edges.values() if not e.eos
+        ]
+        if not live or any(w is None for w in live):
+            return None
+        return min(live)
+
+    def __iter__(self):
+        """→ ("data", batch) | ("wm", ts) | ("barrier", epoch) | EOS (by
+        StopIteration).  Runs on the keyed half's thread."""
+        edges = list(self.server.edges.values())
+        barrier_epoch: int | None = None
+        while True:
+            progressed = False
+            for e in edges:
+                if e.eos or e.aligned:
+                    continue
+                try:
+                    item = e.queue.get_nowait()
+                except queue.Empty:
+                    continue
+                e.depth_gauge.set(e.queue.qsize())
+                progressed = True
+                t = item[0]
+                if t == "err":
+                    raise item[1]
+                if t == "data":
+                    _, batch, wm = item
+                    if wm is not None and (e.wm is None or wm > e.wm):
+                        e.wm = wm
+                    yield ("data", batch)
+                    merged = self._merged_watermark()
+                    if merged is not None and (
+                        self._merged_wm is None or merged > self._merged_wm
+                    ):
+                        self._merged_wm = merged
+                        yield ("wm", merged)
+                elif t == "wm":
+                    if e.wm is None or item[1] > e.wm:
+                        e.wm = item[1]
+                    merged = self._merged_watermark()
+                    if merged is not None and (
+                        self._merged_wm is None or merged > self._merged_wm
+                    ):
+                        self._merged_wm = merged
+                        yield ("wm", merged)
+                elif t == "barrier":
+                    if barrier_epoch is not None and item[1] != barrier_epoch:
+                        raise SourceError(
+                            f"exchange barrier overlap: epoch {item[1]} "
+                            f"arrived while {barrier_epoch} is aligning "
+                            "(the coordinator issues barriers serially)"
+                        )
+                    barrier_epoch = item[1]
+                    e.aligned = True
+                elif t == "eos":
+                    e.eos = True
+                else:
+                    raise SourceError(f"unknown exchange item {t!r}")
+                # an EOS edge satisfies any in-flight barrier (its
+                # sender persisted final offsets coordinator-side)
+                if barrier_epoch is not None and all(
+                    x.aligned or x.eos for x in edges
+                ):
+                    for x in edges:
+                        x.aligned = False
+                    ep, barrier_epoch = barrier_epoch, None
+                    yield ("barrier", ep)
+                if all(x.eos for x in edges):
+                    return
+            if not progressed:
+                self.server.wake.wait(timeout=0.002)
+                self.server.wake.clear()

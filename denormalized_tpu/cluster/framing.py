@@ -1,0 +1,218 @@
+"""Exchange wire format: length-prefixed, CRC-framed column buffers.
+
+The cross-process sibling of the checkpoint blob format
+(state/serialization.py + state/checkpoint.py framing): every frame is
+
+::
+
+    [4B magic "DNZX"][u32 payload_len][u32 crc32(payload)][payload]
+    payload = [u32 header_len][header JSON utf-8][col buf 0][col buf 1]...
+
+No pickle — frames are decodable across processes and a torn or
+bit-flipped frame is DETECTED (magic/length/CRC mismatch raises
+``SourceError``) instead of being reassembled into garbage rows.  Data
+frames carry raw little-endian column buffers for numeric columns and a
+JSON value list for object (string) columns; every data frame also
+piggybacks the sender's current watermark so an edge that only ever
+receives another worker's keys still advances event time.
+
+Frame types (``"t"`` in the header): ``hello`` (edge identification),
+``data`` (column buffers + watermark), ``wm`` (watermark-only advance),
+``barrier`` (checkpoint epoch marker, in-band), ``eos`` (sender's
+partitions exhausted).
+
+``encode_data`` / ``decode_data`` are pinned hot paths
+(tools/dnzlint/hotpaths.toml): per-column comprehensions only, never
+per-row statements.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+from denormalized_tpu.common.errors import SourceError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import Schema
+
+MAGIC = b"DNZX"
+_HDR = struct.Struct("<4sII")  # magic, payload_len, payload_crc32
+
+#: refuse frames claiming more than this — a corrupt length prefix must
+#: not turn into a multi-GB allocation before the CRC check can run
+MAX_FRAME_BYTES = 1 << 30
+
+
+def _frame(payload: bytes) -> bytes:
+    return _HDR.pack(MAGIC, len(payload), zlib.crc32(payload)) + payload
+
+
+def _payload(header: dict, bufs: list[bytes]) -> bytes:
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    return b"".join([struct.pack("<I", len(hj)), hj] + bufs)
+
+
+def encode_hello(worker_id: int) -> bytes:
+    return _frame(_payload({"t": "hello", "from": int(worker_id)}, []))
+
+
+def encode_wm(ts_ms: int) -> bytes:
+    return _frame(_payload({"t": "wm", "wm": int(ts_ms)}, []))
+
+
+def encode_barrier(epoch: int) -> bytes:
+    return _frame(_payload({"t": "barrier", "epoch": int(epoch)}, []))
+
+
+def encode_eos() -> bytes:
+    return _frame(_payload({"t": "eos"}, []))
+
+
+def _col_buf(col: np.ndarray) -> bytes:
+    if col.dtype == object:
+        return json.dumps(col.tolist()).encode()  # dnzlint: allow(hot-loop) object (string) columns have no raw-buffer form; the JSON lane is the documented slow path for string keys
+    return np.ascontiguousarray(col).tobytes()
+
+
+def encode_data(batch: RecordBatch, wm_ms: int | None) -> bytes:
+    """One RecordBatch → one frame.  Column order is schema order (the
+    receiver rebuilds against its own copy of the same schema); masks
+    ride as optional bool buffers."""
+    bufs = [_col_buf(c) for c in batch.columns]
+    mask_bufs = [
+        np.ascontiguousarray(m).tobytes() if m is not None else b""
+        for m in batch.masks
+    ]
+    header = {
+        "t": "data",
+        "wm": int(wm_ms) if wm_ms is not None else None,
+        "rows": int(batch.num_rows),
+        "cols": [
+            {
+                "dtype": "obj" if c.dtype == object else c.dtype.str,
+                "nbytes": len(b),
+            }
+            for c, b in zip(batch.columns, bufs)
+        ],
+        "masks": [len(b) if m is not None else None
+                  for m, b in zip(batch.masks, mask_bufs)],
+    }
+    return _frame(_payload(header, bufs + [b for b in mask_bufs if b]))
+
+
+def decode_frame(payload: bytes, schema: Schema) -> tuple:
+    """Decode one verified payload → ``(type, ...)`` tuple:
+
+    - ``("hello", worker_id)``
+    - ``("data", RecordBatch, wm_ms_or_None)``
+    - ``("wm", ts_ms)``
+    - ``("barrier", epoch)``
+    - ``("eos",)``
+    """
+    if len(payload) < 4:
+        raise SourceError("exchange frame too short for header length")
+    (hlen,) = struct.unpack_from("<I", payload, 0)
+    if 4 + hlen > len(payload):
+        raise SourceError("exchange frame header overruns payload")
+    try:
+        header = json.loads(payload[4:4 + hlen].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise SourceError(f"exchange frame header undecodable: {e}") from e
+    t = header.get("t")
+    if t == "data":
+        return ("data",) + decode_data(header, payload, hlen, schema)
+    if t == "wm":
+        return ("wm", int(header["wm"]))
+    if t == "barrier":
+        return ("barrier", int(header["epoch"]))
+    if t == "eos":
+        return ("eos",)
+    if t == "hello":
+        return ("hello", int(header["from"]))
+    raise SourceError(f"unknown exchange frame type {t!r}")
+
+
+def _col_from(buf: bytes, spec: dict, rows: int) -> np.ndarray:
+    if spec["dtype"] == "obj":
+        vals = json.loads(buf.decode())
+        arr = np.empty(rows, dtype=object)
+        arr[:] = vals
+        return arr
+    return np.frombuffer(buf, dtype=np.dtype(spec["dtype"]))
+
+
+def decode_data(
+    header: dict, payload: bytes, hlen: int, schema: Schema
+) -> tuple[RecordBatch, int | None]:
+    """Data payload → (RecordBatch, piggybacked watermark).  Numeric
+    columns are zero-copy views over the frame buffer (read-only —
+    operators never mutate input columns)."""
+    rows = int(header["rows"])
+    specs = header["cols"]
+    if len(specs) != len(schema):
+        raise SourceError(
+            f"exchange data frame has {len(specs)} columns, schema "
+            f"expects {len(schema)}"
+        )
+    off = 4 + hlen
+    cols = []
+    for spec in specs:  # dnzlint: allow(hot-loop) bounded per-COLUMN sweep (schema width), never per-row; offsets are sequential so this cannot be a comprehension
+        n = int(spec["nbytes"])
+        cols.append(_col_from(payload[off:off + n], spec, rows))
+        off += n
+    masks = []
+    for mspec in header["masks"]:  # dnzlint: allow(hot-loop) same bounded per-column sweep for the optional validity masks
+        if mspec is None:
+            masks.append(None)
+        else:
+            masks.append(
+                np.frombuffer(payload[off:off + mspec], dtype=bool)
+            )
+            off += mspec
+    batch = RecordBatch(schema, cols, masks)
+    wm = header.get("wm")
+    return batch, int(wm) if wm is not None else None
+
+
+def read_exact(sock, n: int) -> bytes | None:
+    """Read exactly ``n`` bytes from a socket; None on clean EOF at a
+    frame boundary (0 bytes read), SourceError on EOF mid-frame (a torn
+    frame — the sender died or a fault rule cut it)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise SourceError(
+                f"exchange connection torn mid-frame ({got}/{n} bytes)"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock) -> bytes | None:
+    """Read + verify one frame from a socket → payload bytes, or None on
+    clean EOF.  Every integrity violation (bad magic, oversize length,
+    CRC mismatch, mid-frame EOF) raises ``SourceError`` — the worker
+    fails stop-the-world and the coordinator restarts the cluster from
+    the last committed epoch (docs/cluster.md#failure-matrix)."""
+    hdr = read_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    magic, plen, crc = _HDR.unpack(hdr)
+    if magic != MAGIC:
+        raise SourceError(f"exchange frame bad magic {magic!r}")
+    if plen > MAX_FRAME_BYTES:
+        raise SourceError(f"exchange frame length {plen} exceeds cap")
+    payload = read_exact(sock, plen)
+    if payload is None:
+        raise SourceError("exchange connection torn before payload")
+    if zlib.crc32(payload) != crc:
+        raise SourceError("exchange frame CRC mismatch (torn or corrupt)")
+    return payload

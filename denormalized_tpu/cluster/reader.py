@@ -1,0 +1,82 @@
+"""Read-side of the cluster's exactly-once output protocol — stdlib
+only (no engine imports), so soak parents and external tooling can load
+it standalone, same contract as obs/readers.py.
+
+The coordinator records one **segment** per worker generation in
+``meta/segments.jsonl`` (also returned as ``result["segments"]``): the
+generation's restore epoch plus every worker's output file.  Each row
+line carries ``ep`` — the in-flight CLUSTER epoch at write time.  A
+generation's rows tagged beyond the epoch its successor restored from
+are the uncommitted suffix that successor regenerates; the reader
+discards them (transactional truncate-on-restore, reader-side — the
+protocol tools/soak.py established in PR 1).
+
+Epochs are cluster-global, so clipping works across worker-count
+changes (rescale re-maps which WORKER re-emits a window, never which
+EPOCH covers it) — the reason the clip boundary is per generation, not
+per worker slot."""
+
+from __future__ import annotations
+
+import json
+
+
+def _read_file(path: str) -> tuple[list, bool]:
+    rows = []
+    done = False
+    try:
+        f = open(path)
+    except FileNotFoundError:
+        return rows, done
+    with f:
+        for line in f:
+            try:
+                o = json.loads(line)
+            except ValueError:
+                continue  # torn tail (SIGKILL mid-write)
+            ev = o.get("event")
+            if ev == "done":
+                done = True
+            elif ev is None:
+                rows.append(o)
+    return rows, done
+
+
+def read_cluster(segments: list) -> dict:
+    """All generations' outputs → ``{"rows": [...], "clipped": n,
+    "done_files": k, "generations": g}``.  ``segments`` is the
+    coordinator's ``result["segments"]`` (or the parsed
+    ``meta/segments.jsonl``), in generation order."""
+    gens = []  # (restored_epoch|None, rows, done_files)
+    for seg in segments:
+        rows: list = []
+        done_files = 0
+        for path in seg.get("files", []):
+            r, d = _read_file(path)
+            rows.extend(r)
+            done_files += int(d)
+        gens.append((seg.get("restored"), rows, done_files))
+    kept: list = []
+    clipped = 0
+    done_files = 0
+    for i, (_restored, rows, dn) in enumerate(gens):
+        done_files += dn
+        boundary = None  # None = final emitting generation: keep all
+        for j in range(i + 1, len(gens)):
+            if gens[j][1]:
+                boundary = gens[j][0]
+                break
+        for o in rows:
+            ep = o.get("ep")
+            if boundary is not None and ep is not None and ep > (
+                boundary or 0
+            ):
+                clipped += 1
+                continue
+            kept.append(o)
+    return {
+        "rows": kept,
+        "clipped": clipped,
+        "done_files": done_files,
+        "generations": len(gens),
+    }

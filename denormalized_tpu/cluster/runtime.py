@@ -1,0 +1,253 @@
+"""Worker-side runtime operators: partition subsetting, the exchange
+router (ingest half) and the exchange source (keyed half).
+
+The ingest half is the UNMODIFIED single-process pipeline — SourceExec
+(prefetch pump, supervised restarts, partition watermarks) plus any
+stateless operators — driven by :class:`ExchangeRouter`, which splits
+each batch by ``hash(key) % n_workers`` (cluster/hashing.py) and ships
+the shards: self-destined rows take the zero-copy loopback, peers get
+framed column buffers.  Watermarks piggyback on data frames and
+broadcast as explicit frames on advance, so an edge that carries no
+rows for a worker still advances its event time; barriers broadcast
+in-band on every edge after the data that precedes them.
+
+The keyed half consumes :class:`ExchangeSourceExec` — a leaf operator
+yielding merged batches, authoritative ("partition"-kind) watermark
+hints at the min over inbound edges, aligned checkpoint markers, and
+EOS when every edge finished.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+    WatermarkHint,
+    WM_ANNOUNCE,
+)
+from denormalized_tpu.sources.base import PartitionReader, Source
+from denormalized_tpu.cluster import framing
+from denormalized_tpu.cluster.hashing import bucket_rows, partitions_for
+
+
+class PartitionSubsetSource(Source):
+    """A view of ``inner`` restricted to this worker's static partition
+    subset (``partitions_for``): reader ``i`` of the subset is global
+    partition ``worker + i * n_workers`` — the one assignment rule the
+    offset rescaler inverts (cluster/rescale.py)."""
+
+    def __init__(self, inner: Source, worker: int, n_workers: int) -> None:
+        self._inner = inner
+        self.worker = worker
+        self.n_workers = n_workers
+        self.name = f"{inner.name}@w{worker}"
+        all_readers = inner.partitions()
+        self.n_partitions_total = len(all_readers)
+        self._pids = partitions_for(
+            worker, n_workers, self.n_partitions_total
+        )
+        self._readers = [all_readers[p] for p in self._pids]
+
+    @property
+    def schema(self):
+        return self._inner.schema
+
+    @property
+    def unbounded(self) -> bool:
+        return self._inner.unbounded
+
+    def partitions(self) -> list[PartitionReader]:
+        readers, self._readers = self._readers, None
+        if readers is None:
+            # a second scan of the same source object rebuilds fresh
+            # cursors (bounded replay sources support this) — ONE inner
+            # scan, then subset, never one scan per subset partition
+            all_readers = self._inner.partitions()
+            readers = [all_readers[p] for p in self._pids]
+        return readers
+
+    def partition_factories(self):
+        inner = self._inner.partition_factories()
+        if inner is None:
+            return None
+        return [inner[p] for p in self._pids]
+
+    def global_partition_ids(self) -> list[int]:
+        return list(self._pids)
+
+
+class ExchangeRouter:
+    """Drives the ingest half and routes its output into the exchange.
+
+    Single-threaded (the worker's ingest thread); owns the outbound
+    clients.  ``run()`` returns once the ingest pipeline reached EOS and
+    the EOS frames are on every edge."""
+
+    def __init__(
+        self,
+        ingest_root: ExecOperator,
+        key_columns: list[str],
+        worker_id: int,
+        n_workers: int,
+        clients: dict,
+        server,
+    ) -> None:
+        from denormalized_tpu import obs
+
+        self.root = ingest_root
+        self.key_columns = key_columns
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.clients = clients  # dst -> ExchangeClient (excludes self)
+        self.server = server  # loopback target
+        self.wm: int | None = None
+        self.source_done = False
+        self.rows_routed = 0
+        self.wall_s = 0.0
+        self._key_idx = [
+            ingest_root.schema.index_of(k) for k in key_columns
+        ]
+        self._obs_rows = obs.counter(
+            "dnz_op_rows_out_total", op="exchange_router",
+            source=f"w{worker_id}",
+        )
+
+    def _broadcast(self, frame_bytes: bytes, local_item: tuple) -> None:
+        self.server.local_put(local_item)
+        for dst in range(self.n_workers):
+            if dst == self.worker_id:
+                continue
+            self.clients[dst].send(frame_bytes)
+
+    def _route_batch(self, batch: RecordBatch) -> None:
+        if batch.num_rows == 0:
+            return
+        self._obs_rows.add(batch.num_rows)
+        self.rows_routed += batch.num_rows
+        if self.n_workers == 1:
+            # single worker: every key is ours — skip the hash entirely
+            self.server.local_put(("data", batch, self.wm))
+            return
+        buckets = bucket_rows(
+            [batch.columns[i] for i in self._key_idx], self.n_workers
+        )
+        for dst in range(self.n_workers):  # dnzlint: allow(hot-loop) bounded per-WORKER sweep; the split itself is a vectorized boolean mask per destination
+            mask = buckets == dst
+            if not mask.any():
+                continue
+            sub = batch if mask.all() else batch.filter(mask)
+            if dst == self.worker_id:
+                self.server.local_put(("data", sub, self.wm))
+            else:
+                self.clients[dst].send(framing.encode_data(sub, self.wm))
+
+    def run(self) -> None:
+        t_start = time.perf_counter()
+        try:
+            self._run_inner()
+        finally:
+            self.wall_s = time.perf_counter() - t_start
+
+    def _run_inner(self) -> None:
+        for item in self.root.run():
+            if isinstance(item, RecordBatch):
+                self._route_batch(item)
+            elif isinstance(item, WatermarkHint):
+                if item.is_announcement:
+                    continue  # the merger announces downstream itself
+                if self.wm is None or item.ts_ms > self.wm:
+                    self.wm = item.ts_ms
+                    self._broadcast(
+                        framing.encode_wm(self.wm), ("wm", self.wm)
+                    )
+            elif isinstance(item, Marker):
+                self._broadcast(
+                    framing.encode_barrier(item.epoch),
+                    ("barrier", item.epoch),
+                )
+            elif isinstance(item, EndOfStream):
+                break
+        self.source_done = True
+        self._broadcast(framing.encode_eos(), ("eos",))
+        for c in self.clients.values():
+            c.close()
+
+
+class ExchangeSourceExec(ExecOperator):
+    """Leaf operator of the keyed half: merged exchange stream in, engine
+    stream items out.  Watermark hints are authoritative per-edge-merged
+    minima (kind="partition"), so the keyed operator never advances from
+    raw batch timestamps — exchange interleaving across senders would
+    race a max-of-min watermark exactly like multi-partition replay
+    does."""
+
+    def __init__(self, schema, merger, worker_id: int) -> None:
+        from denormalized_tpu import obs
+
+        self.schema = schema
+        self.merger = merger
+        self.worker_id = worker_id
+        self._metrics = {"rows_out": 0, "batches_out": 0}
+        self.bind_obs("exchange_source")
+        self._obs_rows_out = obs.counter(
+            "dnz_op_rows_out_total", op="exchange_source",
+            source=f"w{worker_id}",
+        )
+
+    def metrics(self):
+        return dict(self._metrics)
+
+    def _label(self):
+        return f"ExchangeSourceExec(w{self.worker_id})"
+
+    def run(self) -> Iterator[StreamItem]:
+        yield WatermarkHint(WM_ANNOUNCE, kind="partition")
+        it = iter(self.merger)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            self._note_input_wait(time.perf_counter() - t0)
+            kind = item[0]
+            if kind == "data":
+                batch = item[1]
+                self._metrics["rows_out"] += batch.num_rows
+                self._metrics["batches_out"] += 1
+                self._obs_rows_out.add(batch.num_rows)
+                self._note_batch(t0, batch.num_rows)
+                yield batch
+            elif kind == "wm":
+                yield WatermarkHint(item[1], kind="partition")
+            elif kind == "barrier":
+                yield Marker(item[1])
+        yield EOS
+
+
+def replace_scan_source(
+    ingest_logical, worker: int, n_workers: int
+) -> PartitionSubsetSource:
+    """Swap the (possibly projection-pushed) Scan's source for this
+    worker's partition subset.  The plan objects are built fresh inside
+    each worker process, so in-place replacement is safe — nothing else
+    holds them."""
+    from denormalized_tpu.common.errors import PlanError
+    from denormalized_tpu.logical import plan as lp
+
+    node = ingest_logical
+    while not isinstance(node, lp.Scan):
+        kids = node.children
+        if len(kids) != 1:
+            raise PlanError("ingest half must be a unary chain to a Scan")
+        node = kids[0]
+    subset = PartitionSubsetSource(node.source, worker, n_workers)
+    node.source = subset
+    return subset

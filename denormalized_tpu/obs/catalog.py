@@ -247,6 +247,31 @@ INSTRUMENTS: dict[str, tuple] = {
         "chaos event stream's counter view (timeline derivable from "
         "successive JSONL snapshots)",
     ),
+    # -- cluster exchange (cluster/exchange.py) -------------------------
+    "dnz_exchange_frames_total": (
+        "counter",
+        "exchange frames moved, labeled dir=send|recv and edge=src->dst "
+        "(recv aggregates per receiving worker) — barrier and watermark "
+        "frames included, loopback excluded",
+    ),
+    "dnz_exchange_bytes_total": (
+        "counter",
+        "framed exchange bytes moved (wire size incl. header+CRC on "
+        "send, payload on recv), labeled like dnz_exchange_frames_total",
+    ),
+    "dnz_exchange_send_ms": (
+        "histogram",
+        "wall time one framed exchange send spent in sendall — rising "
+        "percentiles mean the peer's edge queue (backpressure) or the "
+        "socket buffer is the bottleneck, not this worker's ingest",
+        MS_BUCKETS,
+    ),
+    "dnz_exchange_edge_depth": (
+        "gauge",
+        "decoded frames queued on one inbound exchange edge awaiting "
+        "the keyed half (labeled edge=src->dst); pinned at the bound "
+        "while an edge is barrier-blocked during alignment",
+    ),
 }
 
 

@@ -210,3 +210,71 @@ def counter_timeline(snapshots: list[dict], prefix: str) -> list[dict]:
                 out.append({"t": t, "series": series, "delta": delta})
             last[series] = v
     return out
+
+
+def merge_final_snapshots(paths) -> dict:
+    """Merge N processes' JSONL telemetry streams into ONE registry
+    view: each file's FINAL value per series, combined across files —
+    counters and scalar gauges sum, histograms merge bucket-wise with
+    percentiles re-derived over the union (:func:`merge_histogram`).
+
+    This is the user-facing merger for the multi-process-mergeable
+    format the registry writes (one cluster worker per file)::
+
+        python -m denormalized_tpu.obs.readers merge out/obs/w*.jsonl
+
+    Returns ``{"files": n, "series": {name: value-or-stats}}``.  A
+    series that is a histogram in one file and a scalar in another is
+    skipped (layout drift between engine versions — never mis-merged).
+    """
+    finals: list[dict] = []
+    for p in paths:
+        snaps = read_stream(p)
+        if not snaps:
+            continue
+        series: dict = {}
+        for snap in snaps:  # last value per series wins (cumulative)
+            for name, v in snap.get("metrics", {}).items():
+                series[name] = v
+        finals.append(series)
+    names: dict[str, None] = {}
+    for s in finals:
+        for name in s:
+            names.setdefault(name)
+    merged: dict = {}
+    for name in names:
+        vals = [s[name] for s in finals if name in s]
+        hists = [v for v in vals if isinstance(v, dict)]
+        scalars = [v for v in vals if isinstance(v, (int, float))]
+        if hists and scalars:
+            continue  # mixed kinds across files: refuse to guess
+        if hists:
+            m = merge_histogram(hists)
+            if m is not None:
+                merged[name] = m
+        elif scalars:
+            total = sum(scalars)
+            merged[name] = round(total, 6) if isinstance(total, float) \
+                else total
+    return {"files": len(finals), "series": merged}
+
+
+def _merge_cli(argv) -> int:
+    import sys
+
+    if not argv or argv[0] != "merge" or len(argv) < 2:
+        sys.stderr.write(
+            "usage: python -m denormalized_tpu.obs.readers "
+            "merge <snap.jsonl> [<snap.jsonl> ...]\n"
+        )
+        return 2
+    out = merge_final_snapshots(argv[1:])
+    json.dump(out, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    import sys
+
+    sys.exit(_merge_cli(sys.argv[1:]))

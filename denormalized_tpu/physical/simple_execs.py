@@ -310,6 +310,33 @@ class SourceExec(ExecOperator):
 
         self._barrier_poll = poll
 
+    def enable_cluster_checkpointing(
+        self, node_id: str, coord, poll_epoch: Callable[[], int | None]
+    ) -> None:
+        """Cluster-mode wiring (cluster/worker.py): barriers come from
+        the coordinator's control channel instead of a local
+        Orchestrator — same in-band injection, same offset persistence,
+        but the epoch NUMBER is cluster-global so every worker's cut
+        shares one key suffix."""
+        self._ckpt = (coord, node_id)
+
+        def poll():
+            epoch = poll_epoch()
+            if epoch is not None:
+                self._persist_offsets(epoch)
+            return epoch
+
+        self._barrier_poll = poll
+
+    def persist_final_offsets(self, epoch: int) -> None:
+        """Persist the (final) yielded offsets for ``epoch`` OUTSIDE the
+        stream — cluster workers call this when a barrier lands after
+        this source already reached EOS, so the cluster cut still
+        records every partition at its end position instead of omitting
+        the finished worker (which would replay its whole subset on
+        restore)."""
+        self._persist_offsets(epoch)
+
     def _persist_offsets(self, epoch: int) -> None:
         from denormalized_tpu.state.checkpoint import put_json
 

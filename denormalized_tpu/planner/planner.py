@@ -32,6 +32,12 @@ class Planner:
         self.config = config
 
     def create_physical_plan(self, node: lp.LogicalPlan) -> ExecOperator:
+        # extension point: a logical node that knows how to build its own
+        # exec (the cluster runtime's ExchangeScan leaf) builds it here —
+        # the planner stays ignorant of subsystem-specific operators
+        hook = getattr(node, "create_exec", None)
+        if hook is not None:
+            return hook(self)
         if isinstance(node, lp.Scan):
             return SourceExec(
                 node.source,

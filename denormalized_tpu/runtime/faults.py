@@ -22,6 +22,9 @@ sites** at the engine's I/O boundaries::
     lsm.spill_put       SpillController.put_block   (StateError / torn value)
     lsm.spill_get       SpillController.get_block   (raises StateError)
     spill.manifest      SpillController.write_manifest (StateError / torn)
+    exchange.connect    ExchangeClient.connect      (raises SourceError)
+    exchange.send       ExchangeClient.send         (SourceError / torn frame)
+    exchange.recv       exchange server recv loop   (raises SourceError)
 
 Each site calls :func:`inject` (optionally passing the key/payload being
 written).  With no plan armed ``inject`` is a single attribute check and an
@@ -102,6 +105,9 @@ SITES = {
     "lsm.spill_put": StateError,
     "lsm.spill_get": StateError,
     "spill.manifest": StateError,
+    "exchange.connect": SourceError,
+    "exchange.send": SourceError,
+    "exchange.recv": SourceError,
 }
 
 #: where each site's ``inject`` call lives (module relative to this
@@ -133,6 +139,21 @@ SITE_MODULES = {
         "state/tiering.py",
         "`SpillController.write_manifest` — per-node live-block manifest "
         "write (supports torn values)",
+    ),
+    "exchange.connect": (
+        "cluster/exchange.py",
+        "`ExchangeClient.connect` — worker-to-worker exchange socket "
+        "establishment (cluster runtime)",
+    ),
+    "exchange.send": (
+        "cluster/exchange.py",
+        "`ExchangeClient.send` — one framed exchange message on the "
+        "wire (supports torn frames: the truncated frame is written, "
+        "the receiver's CRC/length check detects the tear)",
+    ),
+    "exchange.recv": (
+        "cluster/exchange.py",
+        "exchange server receive loop, once per inbound frame",
     ),
 }
 

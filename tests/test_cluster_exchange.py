@@ -1,0 +1,299 @@
+"""Unit tests for the exchange building blocks: framing integrity,
+stable hashing, plan splitting, and edge-merger semantics (watermark
+min-merge, barrier alignment, EOS collapse) — no worker processes."""
+
+import os
+import queue
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.common.errors import PlanError, SourceError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.cluster import framing, hashing
+from denormalized_tpu.cluster.exchange import EdgeMerger, EdgeState
+from denormalized_tpu.cluster.split import split_keyed
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.logical.optimizer import optimize
+from denormalized_tpu.sources.memory import MemorySource
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return RecordBatch.from_pydict({
+        "k": np.array([f"s{i % 3}" for i in range(n)], dtype=object),
+        "v": rng.normal(size=n),
+        "ts": np.arange(n, dtype=np.int64),
+    })
+
+
+# -- hashing ---------------------------------------------------------------
+
+def test_hash_rows_stable_and_key_consistent():
+    a = hashing.hash_rows([np.array([5, 6, 5], dtype=np.int64)])
+    assert a[0] == a[2] and a[0] != a[1]
+    # int32 and int64 spellings of the same key agree (canonical int64)
+    b = hashing.hash_rows([np.array([5, 6, 5], dtype=np.int32)])
+    assert (a == b).all()
+    # string keys: object-column lane, deterministic across calls
+    s1 = hashing.hash_rows([np.array(["x", "y"], dtype=object)])
+    s2 = hashing.hash_rows([np.array(["x", "y"], dtype=object)])
+    assert (s1 == s2).all() and s1[0] != s1[1]
+    # multi-column: order matters
+    two = hashing.hash_rows([
+        np.array([1, 2], dtype=np.int64),
+        np.array([2, 1], dtype=np.int64),
+    ])
+    assert two[0] != two[1]
+
+
+def test_bucket_rows_covers_all_buckets():
+    keys = np.arange(1000, dtype=np.int64)
+    b = hashing.bucket_rows([keys], 4)
+    assert set(np.unique(b)) == {0, 1, 2, 3}
+    # roughly uniform (hash quality smoke, not a distribution proof)
+    counts = np.bincount(b, minlength=4)
+    assert counts.min() > 150
+
+
+def test_partitions_for_disjoint_cover():
+    for n in (1, 2, 3, 4, 8):
+        seen = []
+        for w in range(n):
+            seen += hashing.partitions_for(w, n, 13)
+        assert sorted(seen) == list(range(13))
+
+
+# -- framing ---------------------------------------------------------------
+
+def _roundtrip(frame: bytes, schema):
+    # strip the 12-byte wire header; CRC integrity is read_frame's job
+    return framing.decode_frame(frame[12:], schema)
+
+
+def test_data_frame_roundtrip_with_masks():
+    b = _batch()
+    mask = np.array([True] * 7 + [False], dtype=bool)
+    b = RecordBatch(b.schema, b.columns, [None, mask, None])
+    kind, got, wm = _roundtrip(framing.encode_data(b, 777), b.schema)
+    assert kind == "data" and wm == 777
+    assert got.to_pydict() == b.to_pydict()
+    assert got.masks[1].tolist() == mask.tolist()
+    assert got.masks[0] is None
+
+
+def test_torn_frame_detected_at_receiver():
+    import socket as socketlib
+
+    b = _batch()
+    frame = framing.encode_data(b, None)
+    a, c = socketlib.socketpair()
+    try:
+        a.sendall(frame[: len(frame) - 3])  # torn mid-payload
+        a.close()
+        with pytest.raises(SourceError, match="torn"):
+            framing.read_frame(c)
+    finally:
+        c.close()
+
+
+def test_corrupt_crc_detected():
+    import socket as socketlib
+
+    frame = bytearray(framing.encode_barrier(5))
+    frame[-1] ^= 0xFF
+    a, c = socketlib.socketpair()
+    try:
+        a.sendall(bytes(frame))
+        a.close()
+        with pytest.raises(SourceError, match="CRC"):
+            framing.read_frame(c)
+    finally:
+        c.close()
+
+
+# -- plan split ------------------------------------------------------------
+
+def _plan(ds):
+    return optimize(lp.Sink(ds.logical_plan(), None), True)
+
+
+def _mem_ds(ctx):
+    b = _batch()
+    return ctx.from_source(
+        MemorySource.from_batches([b], timestamp_column="ts")
+    )
+
+
+def test_split_keyed_basic():
+    ctx = Context()
+    ds = _mem_ds(ctx).window(
+        [col("k")], [F.count(col("v")).alias("c")], 1000
+    )
+    sq = split_keyed(_plan(ds))
+    assert sq.key_columns == ["k"]
+    assert sq.exchange_schema.has("k")
+
+
+def test_split_rejects_stateless_and_computed_keys():
+    ctx = Context()
+    with pytest.raises(PlanError, match="keyed operator"):
+        split_keyed(_plan(_mem_ds(ctx).filter(col("v") > 0)))
+    ds = _mem_ds(ctx).window(
+        [col("v") + col("v")], [F.count(col("v")).alias("c")], 1000
+    )
+    with pytest.raises(PlanError, match="column group keys"):
+        split_keyed(_plan(ds))
+
+
+def test_split_rejects_joins():
+    ctx = Context()
+    left = _mem_ds(ctx)
+    right = (
+        ctx.from_source(
+            MemorySource.from_batches(
+                [_batch(seed=1)], timestamp_column="ts"
+            ),
+            name="right",
+        )
+        .with_column_renamed("v", "v2")
+        .with_column_renamed("ts", "ts2")
+    )
+    joined = left.join(right, "inner", ["k"], ["k"]).window(
+        [col("k")], [F.count(col("v")).alias("c")], 1000
+    )
+    with pytest.raises(PlanError, match="non-join"):
+        split_keyed(_plan(joined))
+
+
+# -- edge merger -----------------------------------------------------------
+
+class _FakeServer:
+    def __init__(self, n):
+        import threading
+
+        class _G:
+            def set(self, v):
+                pass
+
+        self.edges = {i: EdgeState(i, _G()) for i in range(n)}
+        self.wake = threading.Event()
+
+
+def _drain(merger, limit=100):
+    out = []
+    it = iter(merger)
+    for _ in range(limit):
+        try:
+            out.append(next(it))
+        except StopIteration:
+            break
+    return out
+
+
+def test_merger_watermark_is_min_over_edges():
+    srv = _FakeServer(2)
+    m = EdgeMerger(srv)
+    b = _batch()
+    srv.edges[0].queue.put(("data", b, 100))
+    srv.edges[1].queue.put(("wm", 50))
+    srv.edges[0].queue.put(("eos",))
+    srv.edges[1].queue.put(("eos",))
+    items = _drain(m)
+    wms = [i[1] for i in items if i[0] == "wm"]
+    assert wms == [50]  # min(100, 50); never the fast edge's 100
+
+
+def test_merger_aligns_barriers_and_blocks_edges():
+    srv = _FakeServer(2)
+    m = EdgeMerger(srv)
+    early, late = _batch(seed=1), _batch(seed=2)
+    # edge0: barrier first, then post-barrier data; edge1: data then barrier
+    srv.edges[0].queue.put(("barrier", 9))
+    srv.edges[0].queue.put(("data", early, None))
+    srv.edges[1].queue.put(("data", late, None))
+    srv.edges[1].queue.put(("barrier", 9))
+    srv.edges[0].queue.put(("eos",))
+    srv.edges[1].queue.put(("eos",))
+    items = _drain(m)
+    kinds = [i[0] for i in items]
+    barrier_at = kinds.index("barrier")
+    # edge0's post-barrier batch must come AFTER the aligned barrier
+    datas = [i for i, k in enumerate(kinds) if k == "data"]
+    pre = [i for i in datas if i < barrier_at]
+    post = [i for i in datas if i > barrier_at]
+    assert len(pre) == 1 and len(post) == 1
+    assert items[pre[0]][1] is late  # pre-barrier data from edge1
+    assert items[post[0]][1] is early
+
+
+def test_merger_eos_satisfies_barrier():
+    srv = _FakeServer(2)
+    m = EdgeMerger(srv)
+    srv.edges[0].queue.put(("barrier", 4))
+    srv.edges[0].queue.put(("eos",))
+    srv.edges[1].queue.put(("eos",))  # finished before the barrier
+    items = _drain(m)
+    assert ("barrier", 4) in items
+
+
+def test_merger_raises_in_band_errors():
+    srv = _FakeServer(1)
+    m = EdgeMerger(srv)
+    srv.edges[0].queue.put(("err", SourceError("boom")))
+    with pytest.raises(SourceError, match="boom"):
+        _drain(m)
+
+
+def test_edge_queue_is_bounded():
+    st = EdgeState(0, type("G", (), {"set": lambda self, v: None})())
+    assert st.queue.maxsize > 0
+    with pytest.raises(queue.Full):
+        for _ in range(st.queue.maxsize + 1):
+            st.queue.put_nowait(("wm", 1))
+
+
+# -- obs merge CLI ---------------------------------------------------------
+
+def test_obs_readers_merge_cli(tmp_path):
+    """``python -m denormalized_tpu.obs.readers merge`` combines N
+    workers' JSONL snapshot streams into one registry view: counters
+    sum, histograms merge bucket-wise with re-derived percentiles."""
+    import json as jsonlib
+    import subprocess
+    import sys as syslib
+
+    def snap(counter, hist_counts, t):
+        return jsonlib.dumps({
+            "event": "obs", "t": t,
+            "metrics": {
+                "dnz_op_rows_in_total{op=window}": counter,
+                "dnz_op_batch_ms{op=window}": {
+                    "count": sum(hist_counts), "sum": 10.0,
+                    "min": 0.5, "max": 4.0,
+                    "bounds": [1.0, 2.0, 4.0],
+                    "bucket_counts": hist_counts + [0],
+                },
+            },
+        })
+
+    a, b = tmp_path / "w0.jsonl", tmp_path / "w1.jsonl"
+    a.write_text(snap(100, [1, 2, 3], 1.0) + "\n"
+                 + snap(250, [2, 4, 6], 2.0) + "\n")
+    b.write_text(snap(50, [5, 0, 1], 1.5) + "\n")
+    proc = subprocess.run(
+        [syslib.executable, "-m", "denormalized_tpu.obs.readers",
+         "merge", str(a), str(b)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = jsonlib.loads(proc.stdout)
+    assert out["files"] == 2
+    assert out["series"]["dnz_op_rows_in_total{op=window}"] == 300
+    h = out["series"]["dnz_op_batch_ms{op=window}"]
+    assert h["count"] == 18  # final-per-file: 12 + 6
+    assert h["min"] == 0.5 and h["max"] == 4.0
+    assert h["p50"] is not None and h["p50"] <= h["p99"]

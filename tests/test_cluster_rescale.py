@@ -1,0 +1,191 @@
+"""Rescale-on-restore: checkpoint a 4-worker cluster mid-stream, SIGKILL
+it, restore at N=2 and N=8 — emissions must be byte-identical to the
+uninterrupted single-process oracle (accumulators move whole under the
+new hash map; nothing is re-aggregated).  The spilled variant runs a
+skewed feed under a tiny state budget so part of the keyed state sits
+in PR-9 spill blocks AT the cut, and re-buckets through the
+merge-resident path."""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+from denormalized_tpu.cluster import ClusterSpec, run_cluster
+from denormalized_tpu.cluster.reader import read_cluster
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, TESTS_DIR)
+
+import cluster_jobs  # noqa: E402
+
+
+def _spec(workdir, n, job_args) -> ClusterSpec:
+    return ClusterSpec(
+        workdir=str(workdir),
+        n_workers=n,
+        job="cluster_jobs:windowed_job",
+        job_args=job_args,
+        sys_path=[TESTS_DIR],
+        liveness_timeout_s=240.0,
+        max_restarts=0,
+        checkpoint_interval_s=0.3,
+    )
+
+
+def _canonical(rows):
+    return sorted(cluster_jobs.canonical_row(r) for r in rows)
+
+
+def _fork_workdir(src, dst):
+    shutil.copytree(src, dst, ignore=shutil.ignore_patterns("*.sock"))
+
+
+def _keyed_snapshot_meta(workdir, version, n_workers, epoch):
+    """Raw (non-mutating) read of each worker's keyed snapshot meta at
+    ``epoch`` — no CheckpointCoordinator, which would GC/rewrite."""
+    from denormalized_tpu.state.checkpoint import unframe_snapshot
+    from denormalized_tpu.state.lsm import LsmStore
+    from denormalized_tpu.state.serialization import unpack_snapshot
+
+    manifest = json.load(
+        open(os.path.join(workdir, "meta", "manifest.json"))
+    )
+    key = manifest["state_keys"]["keyed"]
+    metas = []
+    for w in range(n_workers):
+        store = LsmStore(
+            os.path.join(workdir, "state", f"v{version}", f"worker_{w}")
+        )
+        try:
+            raw = store.get(f"{key}@{epoch}")
+            if raw is None:
+                metas.append(None)
+                continue
+            ok, payload = unframe_snapshot(raw)
+            assert ok
+            meta, _arrays = unpack_snapshot(payload)
+            metas.append(meta)
+        finally:
+            store.close()
+    return metas
+
+
+def _run_rescale(tmp_path, job_args, new_counts, kill_after=1):
+    oracle = cluster_jobs.oracle_rows(job_args)
+    assert oracle
+    wd = str(tmp_path / "base")
+    phase1 = run_cluster(
+        _spec(wd, 4, job_args), kill_after_commits=kill_after
+    )
+    assert phase1["status"] == "killed"
+    assert phase1["commits"]
+    results = {}
+    for new_n in new_counts:
+        wd2 = str(tmp_path / f"n{new_n}")
+        _fork_workdir(wd, wd2)
+        p2 = run_cluster(_spec(wd2, new_n, job_args))
+        assert p2["status"] == "done"
+        got = read_cluster(p2["segments"])
+        rows = _canonical(got["rows"])
+        assert len(got["rows"]) == len(oracle), (
+            f"N=4->{new_n}: kept {len(got['rows'])} rows vs oracle "
+            f"{len(oracle)} (clipped {got['clipped']}) — lost or "
+            "duplicate emissions across the rescale"
+        )
+        assert rows == oracle, f"N=4->{new_n}: emissions diverge"
+        results[new_n] = (phase1, p2)
+    return phase1, results
+
+
+JOB_ARGS = {
+    "partitions": 4,
+    "batches": 10,
+    "rows": 48,
+    "keys": 11,
+    "batch_span_ms": 250,
+    "window_ms": 1000,
+    "pace_s": 0.2,
+}
+
+
+def test_rescale_down_and_up_byte_identical(tmp_path):
+    """N=4 checkpoint → restore at N=2 (merging worker state) and N=8
+    (splitting it), both byte-identical to the oracle."""
+    phase1, results = _run_rescale(tmp_path, JOB_ARGS, (2, 8))
+    # the cut landed mid-stream (otherwise this test degenerates to
+    # replaying output files): the restored runs re-emitted windows
+    for new_n, (_p1, p2) in results.items():
+        assert p2["rows_total"] > 0, (
+            f"N=4->{new_n} re-emitted nothing: the phase-1 kill landed "
+            "post-EOS; slow the pace so the cut is mid-stream"
+        )
+
+
+SPILL_ARGS = {
+    # 8 partitions over 4 workers → 2 readers per worker → the THREADED
+    # ingest path, whose barrier polls stay responsive while partition
+    # 0 sleeps (the bounded round-robin path would hold every barrier
+    # hostage to the pause, and the cut could never land mid-silence)
+    "partitions": 8,
+    "unbounded": True,
+    "batches": 8,
+    "rows": 48,
+    "keys": 11,
+    "batch_span_ms": 250,
+    "window_ms": 250,
+    "pace_s": 0.12,
+    # partition 0: event time 4x slower (its open windows pin
+    # first_open) AND a mid-stream pause — while it is silent, nothing
+    # touches/reloads the spilled prefix, so the barrier cut carries it
+    "skew_divisor": 4,
+    "p0_pause_after": 2,
+    "p0_pause_s": 2.0,
+    "engine": {
+        # tiny budget: the skew-deferred window prefix spills to the
+        # LSM tier, so the cut carries PR-9 spill-block refs
+        "state_budget_bytes": 4096,
+        # spilled windows finalize on host; keep the ring path on host
+        # finalize too so every emission (oracle included) shares one
+        # finalize dtype path — byte-identity needs ONE path, not two
+        "device_finalize": False,
+    },
+}
+
+
+def test_rescale_with_spilled_state(tmp_path):
+    """Part of the keyed state sits in spill blocks at the cut; rescale
+    merges it resident, re-buckets, and the restored run (tier map
+    rebuilt under its own budget) still matches the oracle exactly.
+
+    Whether the CUT carries spill refs is timing-dependent (a trailing
+    partition's batch rebases first_open to the watermark floor and
+    reloads the spilled prefix — by design), so the kill phase retries
+    a few times until a cut with spilled state is secured; the restore
+    comparison then runs against that cut."""
+    oracle = cluster_jobs.oracle_rows(SPILL_ARGS)
+    spilled_cut = None
+    for attempt in range(3):
+        wd = str(tmp_path / f"base{attempt}")
+        phase1 = run_cluster(
+            _spec(wd, 4, SPILL_ARGS), kill_after_commits=1
+        )
+        assert phase1["status"] == "killed" and phase1["commits"]
+        metas = _keyed_snapshot_meta(wd, 0, 4, phase1["commits"][-1])
+        if any(m is not None and m.get("spill_windows") for m in metas):
+            spilled_cut = wd
+            break
+    assert spilled_cut is not None, (
+        "no attempt produced a cut with spilled windows — the "
+        "spilled-rescale path was not exercised"
+    )
+    wd2 = str(tmp_path / "n2")
+    _fork_workdir(spilled_cut, wd2)
+    p2 = run_cluster(_spec(wd2, 2, SPILL_ARGS))
+    assert p2["status"] == "done"
+    got = read_cluster(p2["segments"])
+    rows = _canonical(got["rows"])
+    assert len(got["rows"]) == len(oracle)
+    assert rows == oracle, "spilled rescale: emissions diverge"

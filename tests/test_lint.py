@@ -101,6 +101,7 @@ def test_site_inventory_is_complete():
         "kafka.fetch", "kafka.produce", "decode", "sink.write",
         "lsm.put", "lsm.get", "lsm.flush", "checkpoint.commit",
         "lsm.spill_put", "lsm.spill_get", "spill.manifest",
+        "exchange.connect", "exchange.send", "exchange.recv",
     }
     for site, meta in inv.items():
         assert meta["calls"], f"site {site} has no inject call"

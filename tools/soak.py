@@ -1616,6 +1616,137 @@ def rss_kb(pid: int) -> int | None:
     return None
 
 
+def cluster_main(args) -> None:
+    """Multi-process cluster soak: N worker processes over the
+    hash-repartition exchange, aligned checkpoints, a SIGKILLed worker
+    mid-stream (coordinator-driven full restart from the last cluster
+    commit), and one injected exchange fault (torn frame on the wire,
+    detected by the receiver's CRC check) — output must be EXACTLY-ONCE
+    vs the uninterrupted single-process oracle: 0 lost, 0 spurious, 0
+    duplicate emissions.
+
+    Unlike the single-process soaks this parent imports the engine (the
+    oracle runs in-process); the workers are real spawned processes."""
+    import shutil
+    import tempfile
+    from collections import Counter
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from denormalized_tpu.cluster import ClusterSpec, run_cluster
+    from denormalized_tpu.cluster import benchjob
+    from denormalized_tpu.cluster.reader import read_cluster
+
+    n_workers = args.cluster_workers
+    partitions = args.cluster_partitions
+    # stream sized from --minutes at a paced, checkpoint-friendly rate
+    batches = max(20, int(args.minutes * 60 / 0.05 / 2))
+    job_args = {
+        "partitions": partitions,
+        "batches": batches,
+        "rows": min(args.batch_rows, 1024),
+        "keys": 97,
+        "batch_span_ms": 250,
+        "window_ms": 1000,
+        "pace_s": 0.05,
+    }
+    per_worker_wall = (partitions / n_workers) * batches * 0.05
+    t_start = time.time()
+    print(f"cluster soak: {n_workers} workers, {partitions} partitions, "
+          f"{batches} batches/partition (~{per_worker_wall:.0f}s of "
+          "stream per worker)", file=sys.stderr)
+    oracle = benchjob.oracle_rows(job_args, string_keys=True)
+    work = tempfile.mkdtemp(prefix="soak_cluster_")
+    # one torn exchange frame from worker 0, mid-stream: the receiver's
+    # CRC/length check detects it, both ends fail stop-the-world, the
+    # coordinator restarts the cluster from the last committed epoch
+    fault_plan = {
+        "seed": args.chaos_seed,
+        "rules": [{
+            "site": "exchange.send", "kind": "torn",
+            "key_substr": "0->", "after": 40, "times": 1,
+            "name": "torn-exchange-frame",
+        }],
+    }
+    spec = ClusterSpec(
+        workdir=work,
+        n_workers=n_workers,
+        job="denormalized_tpu.cluster.benchjob:soak_job",
+        job_args=job_args,
+        checkpoint_interval_s=1.0,
+        sink="jsonl",
+        max_restarts=4,
+        liveness_timeout_s=300.0,
+        metrics_jsonl=True,
+        fault_plan=fault_plan,
+    )
+    kill_at = min(args.kill_every, per_worker_wall * 0.4)
+    result = run_cluster(
+        spec,
+        kill_worker_after_s=kill_at,
+        kill_worker_id=n_workers - 1,
+    )
+    got = read_cluster(result["segments"])
+    rows = [benchjob.canonical_row(r) for r in got["rows"]]
+    counts = Counter(rows)
+    dupes = sum(c - 1 for c in counts.values() if c > 1)
+    want = Counter(oracle)
+    lost = sum((want - counts).values())
+    spurious = sum((counts - want).values()) - dupes
+    # fault evidence: the torn frame fired in generation 0 (its obs
+    # stream carries the dnz_fault_injections_total counter) and cost
+    # at least one restart beyond the SIGKILL's
+    merged = _obs_readers().merge_final_snapshots(
+        sorted(
+            os.path.join(work, "obs", f)
+            for f in os.listdir(os.path.join(work, "obs"))
+        )
+    ) if os.path.isdir(os.path.join(work, "obs")) else {"series": {}}
+    fault_fired = sum(
+        v for k, v in merged["series"].items()
+        if k.startswith("dnz_fault_injections_total")
+        and "exchange" in k and isinstance(v, (int, float))
+    )
+    # a tear can kill the worker before the next JSONL export cycle:
+    # the coordinator's crash log is the durable secondary evidence
+    torn_crashes = sum(
+        1 for why in result.get("crashes", [])
+        if "torn" in (why or "")
+    )
+    fault_fired = max(int(fault_fired), torn_crashes)
+    report = {
+        "pipeline": "cluster",
+        "workers": n_workers,
+        "partitions": partitions,
+        "total_rows": partitions * batches * job_args["rows"],
+        "oracle_windows": len(oracle),
+        "emitted_windows_kept": len(rows),
+        "clipped_uncommitted": got["clipped"],
+        "lost": lost,
+        "spurious": spurious,
+        "duplicate_emissions": dupes,
+        "sigkills": result.get("killed_workers", 0),
+        "exchange_faults_fired": int(fault_fired),
+        "restarts": result["restarts"],
+        "commits": result["commits"],
+        "status": result["status"],
+        "wall_s": round(time.time() - t_start, 1),
+        "host_cores": os.cpu_count(),
+        "pass": bool(
+            result["status"] == "done"
+            and lost == 0 and spurious == 0 and dupes == 0
+            and result.get("killed_workers", 0) >= 1
+            and fault_fired >= 1
+            and result["restarts"] >= 2
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    shutil.rmtree(work, ignore_errors=True)
+    if not report["pass"]:
+        sys.exit(1)
+
+
 def main():
     global T0
     ap = argparse.ArgumentParser()
@@ -1626,8 +1757,12 @@ def main():
     ap.add_argument("--kill-every", type=float, default=90.0)
     ap.add_argument("--pipeline",
                     choices=("simple", "sliding", "join", "session",
-                             "udaf", "kafka", "bigstate"),
+                             "udaf", "kafka", "bigstate", "cluster"),
                     default="simple")
+    ap.add_argument("--cluster-workers", type=int, default=3,
+                    help="cluster: engine worker processes")
+    ap.add_argument("--cluster-partitions", type=int, default=6,
+                    help="cluster: source partitions (static assignment)")
     ap.add_argument("--keys", type=int, default=10_000_000,
                     help="bigstate: simultaneously-open sessions")
     ap.add_argument("--wave-keys", type=int, default=100_000,
@@ -1669,6 +1804,7 @@ def main():
                 "sliding": "SOAK_SLIDING.json",
                 "kafka": "SOAK_KAFKA.json",
                 "bigstate": "SOAK_BIGSTATE.json",
+                "cluster": "SOAK_CLUSTER.json",
             }[args.pipeline]
         ))
     if args.child:
@@ -1676,6 +1812,9 @@ def main():
         return
     if args.pipeline == "bigstate":
         bigstate_main(args)
+        return
+    if args.pipeline == "cluster":
+        cluster_main(args)
         return
 
     import shutil
