@@ -1318,6 +1318,73 @@ def run_decode_scale() -> dict:
     }
 
 
+def run_exchange_codec() -> dict:
+    """Exchange wire-codec throughput on a string-keyed cluster batch:
+    the raw offsets+bytes lane (columnar StringColumn sub-frames) vs the
+    ``json.dumps(col.tolist())`` lane it replaces (ISSUE 12 acceptance:
+    raw ≥ 3× json).  Measures the full encode→decode round trip per
+    lane — exactly what every hash-repartitioned batch pays twice on a
+    string-keyed cluster workload."""
+    from denormalized_tpu.cluster import framing
+    from denormalized_tpu.common.columns import StringColumn
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    rows = int(os.environ.get("BENCH_EXCHANGE_ROWS", 65_536))
+    repeats = max(1, int(os.environ.get("BENCH_EXCHANGE_REPEATS", 5)))
+    rng = np.random.default_rng(11)
+    schema = Schema([
+        Field("user_id", DataType.STRING),
+        Field("occurred_at_ms", DataType.INT64),
+        Field("reading", DataType.FLOAT64),
+    ])
+    keys = [f"user-{int(i):07d}-日本" for i in rng.integers(0, 50_000, rows)]
+    obj = np.empty(rows, dtype=object)
+    obj[:] = keys
+    ts = np.arange(rows, dtype=np.int64) + 1_700_000_000_000
+    vals = rng.normal(50, 5, rows)
+    b_raw = RecordBatch(
+        schema, [StringColumn.from_objects(obj), ts, vals]
+    )
+    b_json = RecordBatch(schema, [obj, ts, vals])
+
+    def measure(batch) -> float:
+        # warmup (dict caches, allocator steady state)
+        framing.decode_frame(
+            framing.encode_data(batch, 1)[framing._HDR.size:], schema
+        )
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            frame = framing.encode_data(batch, 1)
+            t, got, _wm = framing.decode_frame(
+                frame[framing._HDR.size:], schema
+            )
+            assert t == "data" and got.num_rows == rows
+            best = max(best, rows / (time.perf_counter() - t0))
+        return best
+
+    raw = measure(b_raw)
+    os.environ["DENORMALIZED_EXCHANGE_JSON"] = "1"
+    try:
+        js = measure(b_json)
+    finally:
+        del os.environ["DENORMALIZED_EXCHANGE_JSON"]
+    return {
+        "metric": "exchange_string_codec_rows_per_sec",
+        "value": round(raw),
+        "unit": "rows/s",
+        "vs_baseline": round(raw / js, 2),
+        "device": "host",
+        "rows": rows,
+        "repeats": repeats,
+        "json_rows_per_s": round(js),
+        "raw_frame_bytes": len(framing.encode_data(b_raw, 1)),
+        "json_frame_bytes": len(framing.encode_data(b_json, 1)),
+        "host_cores": os.cpu_count(),
+    }
+
+
 def _kafka_e2e_latency(parts, sustainable: float) -> dict:
     """Paced producer thread into a fresh topic; latency = emit wall −
     wall(window close), sampled per emitted window close.  The pace is
@@ -2898,6 +2965,12 @@ def run_config(device: str) -> dict:
         log(f"engine[decode_scale]: worst-shape native {out['value']:,} "
             f"rows/s, min native/python {out['min_native_vs_python']}x")
         return out
+    if config == "exchange_codec":
+        out = run_exchange_codec()
+        log(f"engine[exchange_codec]: raw lane {out['value']:,} rows/s, "
+            f"{out['vs_baseline']}x the json lane "
+            f"({out['json_rows_per_s']:,} rows/s)")
+        return out
     if config == "session_scale":
         out = run_session_scale()
         log(f"engine[session_scale]: headline {out['metric']} = "
@@ -3106,11 +3179,11 @@ def main():
     if CONFIG not in (
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
         "ingest_scale", "decode_scale", "session", "session_scale",
-        "spill_scale", "cluster_scale",
+        "spill_scale", "cluster_scale", "exchange_codec",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     if CONFIG in ("decode_scale", "session", "session_scale",
-                  "spill_scale", "cluster_scale"):
+                  "spill_scale", "cluster_scale", "exchange_codec"):
         # pure host-side benchmarks (decoder / session operator): no
         # device, no TPU relay wait
         device = "host"

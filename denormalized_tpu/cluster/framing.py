@@ -34,6 +34,11 @@ import zlib
 
 import numpy as np
 
+from denormalized_tpu.common.columns import (
+    Column,
+    column_from_spec,
+    column_spec_and_buffers,
+)
 from denormalized_tpu.common.errors import SourceError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import Schema
@@ -71,34 +76,75 @@ def encode_eos() -> bytes:
     return _frame(_payload({"t": "eos"}, []))
 
 
+def _legacy_json_lane() -> bool:
+    """``DENORMALIZED_EXCHANGE_JSON=1`` forces string/nested columns onto
+    the legacy JSON value-list lane (kept for one PR as the raw lane's
+    differential oracle; both lanes decode everywhere)."""
+    import os
+
+    return os.environ.get("DENORMALIZED_EXCHANGE_JSON") == "1"
+
+
 def _col_buf(col: np.ndarray) -> bytes:
     if col.dtype == object:
-        return json.dumps(col.tolist()).encode()  # dnzlint: allow(hot-loop) object (string) columns have no raw-buffer form; the JSON lane is the documented slow path for string keys
+        return json.dumps(col.tolist()).encode()  # dnzlint: allow(hot-loop) plain OBJECT columns (python-decoded nested values, mixed objects) have no raw-buffer form; columnar StringColumn/NestedColumn ride the raw offsets+bytes sub-frames in _col_spec_bufs instead
     return np.ascontiguousarray(col).tobytes()
+
+
+def _col_spec_bufs(col) -> tuple[dict, list[bytes]]:
+    """(header spec, raw buffers) for one column.  Columnar string/nested
+    columns ship their buffers VERBATIM — offsets+bytes sub-frames, no
+    JSON, no per-row Python; ndarrays keep the historical single-buffer
+    lanes."""
+    if isinstance(col, Column) and not _legacy_json_lane():
+        spec, arrs = column_spec_and_buffers(col)
+        bufs = [np.ascontiguousarray(a).tobytes() for a in arrs]
+        return (
+            {"dtype": "col", "spec": spec, "nb": [len(b) for b in bufs],
+             "nbytes": sum(len(b) for b in bufs)},
+            bufs,
+        )
+    arr = np.asarray(col)
+    b = _col_buf(arr)
+    return (
+        {"dtype": "obj" if arr.dtype == object else arr.dtype.str,
+         "nbytes": len(b)},
+        [b],
+    )
 
 
 def encode_data(batch: RecordBatch, wm_ms: int | None) -> bytes:
     """One RecordBatch → one frame.  Column order is schema order (the
     receiver rebuilds against its own copy of the same schema); masks
     ride as optional bool buffers."""
-    bufs = [_col_buf(c) for c in batch.columns]
+    specs_bufs = [_col_spec_bufs(c) for c in batch.columns]
+    bufs = [b for _, bl in specs_bufs for b in bl]
+    # a columnar column already ships its validity inside its own
+    # sub-frames — re-shipping the identical batch mask would cost one
+    # redundant byte per row per null-bearing column (the decode side
+    # rebuilds the mask from the column's validity)
+    masks = [
+        None
+        if m is None or (
+            spec["dtype"] == "col"
+            and m is getattr(c, "validity", None)
+        )
+        else m
+        for (spec, _), c, m in zip(
+            specs_bufs, batch.columns, batch.masks
+        )
+    ]
     mask_bufs = [
         np.ascontiguousarray(m).tobytes() if m is not None else b""
-        for m in batch.masks
+        for m in masks
     ]
     header = {
         "t": "data",
         "wm": int(wm_ms) if wm_ms is not None else None,
         "rows": int(batch.num_rows),
-        "cols": [
-            {
-                "dtype": "obj" if c.dtype == object else c.dtype.str,
-                "nbytes": len(b),
-            }
-            for c, b in zip(batch.columns, bufs)
-        ],
+        "cols": [s for s, _ in specs_bufs],
         "masks": [len(b) if m is not None else None
-                  for m, b in zip(batch.masks, mask_bufs)],
+                  for m, b in zip(masks, mask_bufs)],
     }
     return _frame(_payload(header, bufs + [b for b in mask_bufs if b]))
 
@@ -144,12 +190,56 @@ def _col_from(buf: bytes, spec: dict, rows: int) -> np.ndarray:
     return np.frombuffer(buf, dtype=np.dtype(spec["dtype"]))
 
 
+#: buffer dtypes of the raw columnar lane, in column_spec_and_buffers'
+#: depth-first order — each spec kind contributes a fixed dtype sequence,
+#: reconstructed by _columnar_bufs below
+_SPEC_BUF_DTYPES = {
+    "str": lambda s: [np.int64, np.uint8] + ([np.bool_] if s["v"] else []),
+    "prim": lambda s: [
+        {"i64": np.int64, "f64": np.float64, "bool": np.uint8}[s["p"]]
+    ] + ([np.bool_] if s["v"] else []),
+}
+
+
+def _spec_buf_dtypes(spec: dict, out: list) -> None:
+    k = spec["k"]
+    fixed = _SPEC_BUF_DTYPES.get(k)
+    if fixed is not None:
+        out.extend(fixed(spec))
+        return
+    if spec["v"]:
+        out.append(np.bool_)
+    if k == "list":
+        out.append(np.int64)
+    for c in spec["ch"]:
+        _spec_buf_dtypes(c, out)
+
+
+def _columnar_col_from(spec: dict, payload: bytes, off: int):
+    """Rebuild one columnar column from its raw sub-frames (zero-copy
+    views over the frame buffer — read-only, like the numeric lane)."""
+    dts: list = []
+    _spec_buf_dtypes(spec["spec"], dts)
+    lens = spec["nb"]
+    if len(dts) != len(lens):
+        raise SourceError(
+            "exchange columnar spec/buffer count mismatch "
+            f"({len(dts)} vs {len(lens)})"
+        )
+    arrs = []
+    for dt, n in zip(dts, lens):  # dnzlint: allow(hot-loop) bounded per-BUFFER sweep (spec tree size), never per-row; offsets are sequential
+        arrs.append(np.frombuffer(payload[off:off + n], dtype=dt))
+        off += n
+    return column_from_spec(spec["spec"], iter(arrs)), off
+
+
 def decode_data(
     header: dict, payload: bytes, hlen: int, schema: Schema
 ) -> tuple[RecordBatch, int | None]:
     """Data payload → (RecordBatch, piggybacked watermark).  Numeric
     columns are zero-copy views over the frame buffer (read-only —
-    operators never mutate input columns)."""
+    operators never mutate input columns); columnar string/nested
+    columns rebuild as zero-copy views the same way."""
     rows = int(header["rows"])
     specs = header["cols"]
     if len(specs) != len(schema):
@@ -160,13 +250,20 @@ def decode_data(
     off = 4 + hlen
     cols = []
     for spec in specs:  # dnzlint: allow(hot-loop) bounded per-COLUMN sweep (schema width), never per-row; offsets are sequential so this cannot be a comprehension
+        if spec["dtype"] == "col":
+            col, off = _columnar_col_from(spec, payload, off)
+            cols.append(col)
+            continue
         n = int(spec["nbytes"])
         cols.append(_col_from(payload[off:off + n], spec, rows))
         off += n
     masks = []
-    for mspec in header["masks"]:  # dnzlint: allow(hot-loop) same bounded per-column sweep for the optional validity masks
+    for i, mspec in enumerate(header["masks"]):  # dnzlint: allow(hot-loop) same bounded per-column sweep for the optional validity masks
         if mspec is None:
-            masks.append(None)
+            # columnar columns carry validity in their own sub-frames;
+            # surface it as the batch mask (the sender elided the
+            # redundant copy)
+            masks.append(getattr(cols[i], "validity", None))
         else:
             masks.append(
                 np.frombuffer(payload[off:off + mspec], dtype=bool)

@@ -52,6 +52,26 @@ def _object_column_u64(col: np.ndarray) -> np.ndarray:
     return out
 
 
+def _string_column_u64(col) -> np.ndarray:
+    """crc32 lane for columnar strings: hashes each row's UTF-8 bytes
+    STRAIGHT off the offsets+bytes buffers — per-row crc32 like the
+    object lane (and bit-identical to it for the same logical values,
+    so rescale across lanes re-buckets identically: a valid UTF-8 str's
+    encoded bytes ARE its column bytes, and a null hashes b'None' just
+    like the object lane str()s None) — but with no Python str ever
+    materialized."""
+    out = np.empty(len(col), dtype=np.uint64)
+    mv = memoryview(np.ascontiguousarray(col.data))
+    offs = col.offsets.tolist()
+    valid = col.validity.tolist() if col.validity is not None else None
+    for i in range(len(col)):
+        if valid is not None and not valid[i]:
+            out[i] = zlib.crc32(b"None")
+        else:
+            out[i] = zlib.crc32(mv[offs[i]: offs[i + 1]])
+    return out
+
+
 def column_u64(col: np.ndarray) -> np.ndarray:
     """Canonical uint64 reinterpretation of one key column.
 
@@ -59,6 +79,14 @@ def column_u64(col: np.ndarray) -> np.ndarray:
     complement view); floats through float64 bit patterns with -0.0
     normalized to +0.0 so the two equal keys hash identically; object
     columns through the crc32 lane."""
+    from denormalized_tpu.common.columns import Column, StringColumn
+
+    if isinstance(col, StringColumn):
+        return _string_column_u64(col)
+    if isinstance(col, Column):
+        # nested key columns: materialize (grouping by a whole struct is
+        # a legacy corner, not a hot path)
+        col = col.as_object()
     a = np.asarray(col)
     if a.dtype == object:
         return _object_column_u64(a)
@@ -77,7 +105,7 @@ def hash_rows(key_columns: list) -> np.ndarray:
     The exchange router and the rescale re-bucketer both call this; the
     column list must be the operator's group-key columns in group-expr
     order (order matters — it is part of the hash)."""
-    h = np.zeros(len(np.asarray(key_columns[0])), dtype=np.uint64)
+    h = np.zeros(len(key_columns[0]), dtype=np.uint64)
     for col in key_columns:  # dnzlint: allow(hot-loop) bounded per-KEY-COLUMN sweep (group-expr arity, typically 1-3), each iteration fully vectorized over rows
         h = _mix64(h + _COMBINE + column_u64(col))
     return h

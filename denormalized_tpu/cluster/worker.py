@@ -263,7 +263,7 @@ class _EpochTaggedJsonlSink:
         ep = (coord.committed_epoch or 0) + 1 if coord else None
         user = batch.select(
             [n for n in self._names if batch.schema.has(n)]
-        )
+        ).materialized()
         names = user.schema.names
         py = self._py
         for i in range(user.num_rows):

@@ -17,6 +17,11 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
+from denormalized_tpu.common.columns import (
+    Column,
+    as_numpy,
+    concat_columns,
+)
 from denormalized_tpu.common.errors import SchemaError
 from denormalized_tpu.common.schema import DataType, Field, Schema
 
@@ -24,6 +29,8 @@ from denormalized_tpu.common.schema import DataType, Field, Schema
 @dataclass
 class RecordBatch:
     schema: Schema
+    # plain host ndarrays, or columnar Column instances (StringColumn /
+    # NestedColumn — see common/columns.py) for string & nested fields
     columns: list[np.ndarray]
     # validity masks, parallel to columns; None = all valid
     masks: list[np.ndarray | None]
@@ -40,7 +47,9 @@ class RecordBatch:
                 f"{len(columns)} columns for schema of {len(schema)} fields"
             )
         self.schema = schema
-        self.columns = [np.asarray(c) for c in columns]
+        self.columns = [
+            c if isinstance(c, Column) else np.asarray(c) for c in columns
+        ]
         n = self.columns[0].shape[0] if self.columns else 0
         for f, c in zip(schema, self.columns):
             if c.shape[0] != n:
@@ -85,9 +94,29 @@ class RecordBatch:
         return self.masks[self.schema.index_of(name)]
 
     def to_pydict(self) -> dict[str, list]:
-        return {
-            f.name: c.tolist() for f, c in zip(self.schema, self.columns)
-        }
+        """Python value lists per column, with validity APPLIED: a null
+        entry surfaces as ``None`` (matching ``to_pyarrow().to_pylist()``),
+        never as the storage fill value (0/False/'')."""
+        out: dict[str, list] = {}
+        for f, c, m in zip(self.schema, self.columns, self.masks):
+            vals = c.tolist()
+            if m is not None and not (valid := np.asarray(m, dtype=bool)).all():
+                vals = [
+                    v if ok else None for v, ok in zip(vals, valid.tolist())
+                ]
+            out[f.name] = vals
+        return out
+
+    def materialized(self) -> "RecordBatch":
+        """A batch whose columnar string/nested columns are replaced by
+        their object-array materialization — the user-facing boundary
+        (CallbackSink, UDF inputs).  A batch with no Column instances
+        returns itself."""
+        if not any(isinstance(c, Column) for c in self.columns):
+            return self
+        return RecordBatch(
+            self.schema, [as_numpy(c) for c in self.columns], self.masks
+        )
 
     # -- Arrow interop ---------------------------------------------------
     # The reference's Python callback path hands pyarrow batches to user
@@ -185,13 +214,14 @@ class RecordBatch:
             fields = list(self.schema.fields)
             fields[i] = field
             cols = list(self.columns)
-            cols[i] = np.asarray(col)
+            cols[i] = col if isinstance(col, Column) else np.asarray(col)
             masks = list(self.masks)
             masks[i] = mask
             return RecordBatch(Schema(fields), cols, masks)
         return RecordBatch(
             self.schema.append(field),
-            list(self.columns) + [np.asarray(col)],
+            list(self.columns)
+            + [col if isinstance(col, Column) else np.asarray(col)],
             list(self.masks) + [mask],
         )
 
@@ -218,11 +248,24 @@ class RecordBatch:
         )
 
     @staticmethod
-    def concat(batches: Sequence["RecordBatch"]) -> "RecordBatch":
-        batches = [b for b in batches if b.num_rows > 0] or list(batches[:1])
+    def concat(
+        batches: Sequence["RecordBatch"], schema: Schema | None = None
+    ) -> "RecordBatch":
+        batches = list(batches)
+        if not batches:
+            # an empty sequence has no schema to concat under — either the
+            # caller supplies one (→ a well-formed 0-row batch) or this is
+            # a clear error instead of an opaque IndexError
+            if schema is None:
+                raise SchemaError(
+                    "RecordBatch.concat of an empty sequence needs an "
+                    "explicit schema= argument"
+                )
+            return RecordBatch.empty(schema)
+        batches = [b for b in batches if b.num_rows > 0] or batches[:1]
         first = batches[0]
         cols = [
-            np.concatenate([b.columns[i] for b in batches])
+            concat_columns([b.columns[i] for b in batches])
             for i in range(len(first.schema))
         ]
         masks = []

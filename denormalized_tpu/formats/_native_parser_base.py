@@ -23,6 +23,13 @@ from dataclasses import dataclass, field as dc_field
 
 import numpy as np
 
+from denormalized_tpu.common.columns import (
+    NestedColumn,
+    PrimitiveColumn,
+    StringColumn,
+    _compile_fused_builder,  # fused builder shared with the lazy assembly
+    columnar_strings_enabled,
+)
 from denormalized_tpu.common.errors import FormatError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
@@ -110,6 +117,10 @@ _NATURAL_DTYPE = {
 
 _I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
 
+# natural storage dtype per nested-leaf kind on the columnar path (bool
+# stays u8 — pyassemble's type-2 reads bytes)
+_PRIM_NP = {"i64": np.int64, "f64": np.float64, "bool": np.uint8}
+
 
 def _clamp_nested_ints(vals, field: Field):
     """Saturate an int64 ndarray of nested-leaf values at the DECLARED
@@ -121,49 +132,13 @@ def _clamp_nested_ints(vals, field: Field):
     return vals
 
 
-_PA_SENTINEL = object()
-_pa_fn = _PA_SENTINEL  # resolved on first use; None = unavailable
-
-
 def _pyassemble():
-    """The C-level row assembler (native/pyassemble.cpp), or None when it
-    can't build here (no compiler / no Python headers — the generated-
-    comprehension fallback below then does the reassembly).  Loaded via
-    PyDLL: the assembler manipulates Python objects and must hold the
-    GIL."""
-    global _pa_fn
-    if _pa_fn is not _PA_SENTINEL:
-        return _pa_fn
-    try:
-        import sysconfig
+    """The C-level row assembler, shared with the lazy sink-boundary
+    materialization (see :func:`denormalized_tpu.common.columns._pyassemble`
+    — one loader, one fallback policy)."""
+    from denormalized_tpu.common import columns
 
-        from denormalized_tpu.native.build import load
-
-        inc = sysconfig.get_paths()["include"]
-        pylib = load("pyassemble", [f"-I{inc}"], pydll=True)
-        fn = pylib.pa_rows
-        fn.restype = ctypes.py_object
-        fn.argtypes = [
-            ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.c_uint64,
-        ]
-        _pa_fn = fn
-    except Exception as e:  # dnzlint: allow(broad-except) the generated-comprehension reassembly is the designed fallback (no Python headers); logged so the downgrade is visible, gated by test_native_build_gate where headers exist
-        from denormalized_tpu.runtime.tracing import logger
-
-        logger.warning(
-            "pyassemble (C row assembler) unavailable (%s: %s) — nested "
-            "reassembly uses the generated-comprehension path",
-            type(e).__name__, e,
-        )
-        _pa_fn = None
-    return _pa_fn
+    return columns._pyassemble()
 
 
 _PA_SCALAR_CODE = {"i64": 0, "f64": 1, "bool": 2, "str": 3}
@@ -188,27 +163,6 @@ class NodeDesc:
     fused_builders: dict | None = None
 
 
-def _compile_fused_builder(expr: str, nargs: int):
-    """Compile a row builder that assembles one struct column's python
-    rows in a SINGLE comprehension: ``expr`` is a nested dict LITERAL
-    over loop variables a0..aN (one per leaf/list value list, plus one
-    per non-all-present sub-struct presence list), so a whole struct
-    subtree materializes in one zip pass with no intermediate per-child
-    lists.  This per-row assembly is the dominant cost of nested decode
-    (the C++ shred runs ~4.5M rows/s; reassembly bounds the batch), and
-    the inline literal beats per-node dict(zip(...)) by ~3x.  Field
-    names are embedded via repr (arbitrary key strings are safe);
-    argument names are synthesized."""
-    args = ", ".join(f"A{i}" for i in range(nargs))
-    unpack = ", ".join(f"a{i}" for i in range(nargs))
-    # `for a0 in zip(A0)` would bind the 1-TUPLE, not the element
-    loop = (
-        f"for {unpack} in zip({args})" if nargs > 1 else "for a0 in A0"
-    )
-    src = f"def _b({args}):\n    return [{expr} {loop}]\n"
-    ns: dict = {}
-    exec(src, ns)  # noqa: S102 — schema-derived, keys repr-escaped
-    return ns["_b"]
 
 
 class ColumnarNativeParser:
@@ -249,10 +203,19 @@ class ColumnarNativeParser:
         if rc != 0:
             raise FormatError(self._fn("error")(self._h).decode())
         tree = getattr(self, "_tree", None)
+        columnar = columnar_strings_enabled()
         if tree is not None:
-            return self._extract_tree(tree, n)
+            return self._extract_tree(tree, n, columnar)
         cols, masks = [], []
         for ci, f in enumerate(self.schema):
+            if columnar and self._kinds[ci] == "str":
+                # zero-copy handoff: offsets+bytes snapshot into a
+                # StringColumn (one bulk memcpy off the parser arena),
+                # no per-row str materialization on the decode path
+                col = self._snapshot_string(ci, n)
+                cols.append(col)
+                masks.append(col.validity)
+                continue
             arr, valid = self._scalar_arrays(
                 ci, self._kinds[ci], n, f.dtype.to_numpy()
             )
@@ -337,11 +300,144 @@ class ColumnarNativeParser:
             arr[i] = raw[offs[i] : offs[i + 1]].decode(errors="replace")
         return arr
 
+    # -- columnar (zero-copy) snapshots ----------------------------------
+    # One bulk copy per buffer off the parser arena into column-owned
+    # ndarrays (the parser's buffers die at the next parse/clear); rows
+    # materialize lazily at the sink/UDF boundary via Column.as_object.
+
+    def _snapshot_valid(self, idx: int, count: int) -> np.ndarray | None:
+        """Copied bool validity for node ``idx``, or None when all-valid."""
+        if count == 0:
+            return None
+        valid = np.ctypeslib.as_array(
+            self._fn("col_valid")(self._h, idx), shape=(count,)
+        ).astype(bool)
+        return None if valid.all() else valid
+
+    def _snapshot_string(
+        self, idx: int, count: int, validity: np.ndarray | None = None,
+        own_valid: bool = True,
+    ) -> StringColumn:
+        """StringColumn snapshot of node ``idx``'s offsets+bytes vectors
+        (also used for packed str list ELEMENTS, whose validity comes
+        from the list node's evalid — pass it via ``validity``)."""
+        if count == 0:
+            return StringColumn(
+                np.zeros(1, dtype=np.int64), np.empty(0, dtype=np.uint8)
+            )
+        if own_valid:
+            validity = self._snapshot_valid(idx, count)
+        nb = ctypes.c_uint64()
+        bptr = self._fn("col_str_bytes")(self._h, idx, ctypes.byref(nb))
+        data = (
+            np.frombuffer(ctypes.string_at(bptr, nb.value), dtype=np.uint8)
+            if nb.value else np.empty(0, dtype=np.uint8)
+        )
+        offs = np.ctypeslib.as_array(
+            self._fn("col_str_offsets")(self._h, idx), shape=(count + 1,)
+        ).astype(np.int64)
+        return StringColumn(offs, data, validity)
+
+    def _snapshot_scalar(
+        self, idx: int, kind: str, count: int, field: Field | None,
+        validity: np.ndarray | None,
+    ):
+        """PrimitiveColumn/StringColumn snapshot of one scalar node at
+        the parser's natural width; declared-INT32 leaves saturate at
+        i32 bounds here (the one place the declared width is enforced,
+        same as the legacy extraction)."""
+        if kind == "str":
+            return self._snapshot_string(
+                idx, count, validity, own_valid=False
+            )
+        if count == 0:
+            return PrimitiveColumn(
+                kind, np.empty(0, dtype=_PRIM_NP[kind]), None
+            )
+        if kind == "i64":
+            view = np.ctypeslib.as_array(
+                self._fn("col_i64")(self._h, idx), shape=(count,)
+            )
+            if field is not None and field.dtype is DataType.INT32:
+                vals = np.clip(view, _I32_MIN, _I32_MAX)
+            else:
+                vals = view.copy()
+        elif kind == "f64":
+            vals = np.ctypeslib.as_array(
+                self._fn("col_f64")(self._h, idx), shape=(count,)
+            ).copy()
+        else:  # bool, stored u8 (pyassemble type-2 reads bytes)
+            vals = np.ctypeslib.as_array(
+                self._fn("col_bool")(self._h, idx), shape=(count,)
+            ).copy()
+        return PrimitiveColumn(kind, vals, validity)
+
+    def _snapshot_node(self, nd: "NodeDesc", count: int):
+        """Column snapshot of one shredded node subtree."""
+        validity = self._snapshot_valid(nd.idx, count)
+        if nd.kind == "struct":
+            children = [
+                self._snapshot_node(c, count) for c in nd.children
+            ]
+            return NestedColumn(
+                nd.field, "struct", count, children, validity
+            )
+        if nd.kind == "list":
+            offs = (
+                np.ctypeslib.as_array(
+                    self._fn("col_list_offsets")(self._h, nd.idx),
+                    shape=(count + 1,),
+                ).astype(np.int64)
+                if count else np.zeros(1, dtype=np.int64)
+            )
+            ne = (
+                int(self._fn("col_list_nelems")(self._h, nd.idx))
+                if count else 0
+            )
+            if nd.elem_kind is not None:
+                # packed scalar elements: values live in the list node's
+                # own vectors, element validity in evalid
+                evalid = None
+                if ne:
+                    ev = np.ctypeslib.as_array(
+                        self._fn("col_list_evalid")(self._h, nd.idx),
+                        shape=(ne,),
+                    ).astype(bool)
+                    evalid = None if ev.all() else ev
+                efield = (
+                    nd.field.children[0] if nd.field.children else None
+                )
+                elem = self._snapshot_scalar(
+                    nd.idx, nd.elem_kind, ne, efield, evalid
+                )
+            else:
+                elem = self._snapshot_node(nd.children[0], ne)
+            return NestedColumn(
+                nd.field, "list", count, [elem], validity, offs
+            )
+        return self._snapshot_scalar(
+            nd.idx, nd.kind, count, nd.field, validity
+        )
+
     # -- nested (shredded) extraction ------------------------------------
 
-    def _extract_tree(self, tree: list, n: int) -> RecordBatch:
+    def _extract_tree(
+        self, tree: list, n: int, columnar: bool = False
+    ) -> RecordBatch:
         cols, masks = [], []
         for nd in tree:
+            if columnar and nd.kind in ("struct", "list"):
+                col = self._snapshot_node(nd, n)
+                cols.append(col)
+                masks.append(col.validity)
+                continue
+            if columnar and nd.kind == "str":
+                col = self._snapshot_string(nd.idx, n)
+                cols.append(col)
+                masks.append(col.validity)
+                continue
+            # top-level scalar leaves stay plain ndarrays at the DECLARED
+            # dtype, exactly like the flat column path
             if nd.kind in ("struct", "list"):
                 vals, valid = self._node_pyvalues(nd, n)
                 arr = np.empty(n, dtype=object)

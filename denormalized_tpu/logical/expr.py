@@ -230,8 +230,10 @@ class BinaryExpr(Expr):
         return Field(self.name, _promote(lf.dtype, rf.dtype, self.op))
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
-        l = self.left.eval(batch)
-        r = self.right.eval(batch)
+        from denormalized_tpu.common.columns import as_numpy
+
+        l = as_numpy(self.left.eval(batch))
+        r = as_numpy(self.right.eval(batch))
         if self.op in _CMP and (
             getattr(l, "dtype", None) == object or getattr(r, "dtype", None) == object
         ):
@@ -318,13 +320,21 @@ class IsNullExpr(Expr):
         return Field(self.name, DataType.BOOL)
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
+        from denormalized_tpu.common.columns import Column as _ColData
+
         if isinstance(self.inner, Column):
             m = batch.mask(self.inner.name)
             null = (
                 np.zeros(batch.num_rows, dtype=bool) if m is None else ~m
             )
             v = batch.column(self.inner.name)
-            if v.dtype == object:
+            if isinstance(v, _ColData):
+                # columnar string/nested columns carry nulls as validity
+                # — read it directly, no row materialization
+                validity = getattr(v, "validity", None)
+                if validity is not None:
+                    null = null | ~validity
+            elif v.dtype == object:
                 # string/derived columns carry nulls as None VALUES (scalar
                 # functions propagate None without materializing a mask) —
                 # both representations are null
@@ -333,11 +343,18 @@ class IsNullExpr(Expr):
                 )
         else:
             v = self.inner.eval(batch)
-            null = (
-                np.array([x is None for x in v])
-                if v.dtype == object
-                else np.isnan(v) if v.dtype.kind == "f" else np.zeros(len(v), bool)
-            )
+            if isinstance(v, _ColData):
+                validity = getattr(v, "validity", None)
+                null = (
+                    ~validity if validity is not None
+                    else np.zeros(len(v), bool)
+                )
+            else:
+                null = (
+                    np.array([x is None for x in v])
+                    if v.dtype == object
+                    else np.isnan(v) if v.dtype.kind == "f" else np.zeros(len(v), bool)
+                )
         return ~null if self.negate else null
 
     def columns_referenced(self) -> set[str]:
@@ -762,7 +779,34 @@ class FieldAccessExpr(Expr):
         raise SchemaError(f"struct {f.name!r} has no field {self.field_name!r}")
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
-        structs = self.inner.eval(batch)  # object array of dicts
+        from denormalized_tpu.common.columns import (
+            NestedColumn,
+            PrimitiveColumn,
+            as_numpy,
+        )
+
+        structs = self.inner.eval(batch)
+        if (
+            isinstance(structs, NestedColumn)
+            and structs.kind == "struct"
+            and structs.validity is None
+        ):
+            # shredded access: the child column IS the answer — no row
+            # materialization.  (A null parent struct must surface None
+            # for every child, which only the row path models; the
+            # all-present case — the normal one — stays columnar.)
+            for f, child in zip(structs.field.children, structs.children):
+                if f.name == self.field_name:
+                    if isinstance(child, PrimitiveColumn):
+                        if child.validity is not None:
+                            return child.as_object()
+                        # densified exactly like the legacy tight path
+                        return (
+                            child.values.view(np.bool_)
+                            if child.kind == "bool" else child.values
+                        )
+                    return child
+        structs = as_numpy(structs)  # object array of dicts
         out = np.empty(len(structs), dtype=object)
         for i, s in enumerate(structs):
             out[i] = None if s is None else s.get(self.field_name)
@@ -796,9 +840,16 @@ class CastExpr(Expr):
         return Field(f.name, self.dtype, f.nullable)
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
+        from denormalized_tpu.common.columns import StringColumn, as_numpy
+
         v = self.inner.eval(batch)
         if self.dtype is DataType.STRING:
-            return np.array([str(x) for x in v], dtype=object)
+            if isinstance(v, StringColumn) and v.validity is None:
+                # already columnar strings with no nulls: identity cast
+                # (null slots legacy-cast to the string 'None', so they
+                # take the materializing path below)
+                return v
+            return np.array([str(x) for x in as_numpy(v)], dtype=object)
         return np.asarray(v).astype(self.dtype.to_numpy())
 
     def eval_jax(self, cols):
@@ -862,7 +913,13 @@ class ScalarFunctionExpr(Expr):
                 # count — a broadcast scalar would repeat one draw
                 out = fn.np_fn(batch.num_rows)
             else:
-                out = fn.np_fn(*[a.eval(batch) for a in self.args])
+                from denormalized_tpu.common.columns import as_numpy
+
+                # scalar functions are a user-facing value boundary:
+                # columnar string/nested args materialize (cached) here
+                out = fn.np_fn(
+                    *[as_numpy(a.eval(batch)) for a in self.args]
+                )
         if not isinstance(out, np.ndarray):
             out = np.asarray(out)
         if out.ndim == 0:  # zero-arg / scalar result → broadcast
@@ -1012,7 +1069,12 @@ class ScalarUDFExpr(Expr):
         return Field(self._name, self.dtype)
 
     def eval(self, batch: RecordBatch) -> np.ndarray:
-        return np.asarray(self.fn(*[a.eval(batch) for a in self.args]))
+        from denormalized_tpu.common.columns import as_numpy
+
+        # the UDF boundary: user code sees plain numpy columns
+        return np.asarray(
+            self.fn(*[as_numpy(a.eval(batch)) for a in self.args])
+        )
 
     def eval_jax(self, cols):
         return self.fn(*[a.eval_jax(cols) for a in self.args])

@@ -165,6 +165,29 @@ void intern_many(void* h, const uint8_t* data, uint64_t n, uint32_t w,
   }
 }
 
+// Intern n variable-length keys given as one contiguous UTF-8 buffer plus
+// u64 offsets (n+1 entries) — the Arrow string-column layout, so a
+// StringColumn interns straight off its own buffers with NO Python str
+// materialization.  valid may be NULL (all valid); invalid slots intern
+// the dedicated 0xFF NULL key (impossible in valid UTF-8 — same sentinel
+// as the PyObject path's None handling, so mixed-lane columns agree).
+// Trailing NULs strip like every other lane.
+void intern_offsets(void* h, const uint8_t* bytes, const uint64_t* offsets,
+                    const uint8_t* valid, uint64_t n, int32_t* out_ids) {
+  CInterner* c = static_cast<CInterner*>(h);
+  static const uint8_t kNullKey[1] = {0xFF};
+  for (uint64_t i = 0; i < n; i++) {
+    if (valid != nullptr && !valid[i]) {
+      out_ids[i] = intern_one(c, kNullKey, 1);
+      continue;
+    }
+    const uint8_t* key = bytes + offsets[i];
+    uint32_t len = (uint32_t)(offsets[i + 1] - offsets[i]);
+    while (len > 0 && key[len - 1] == 0) len--;
+    out_ids[i] = intern_one(c, key, len);
+  }
+}
+
 #ifdef INTERN_HAVE_PYTHON
 // Direct PyObject path: hash each numpy-object-array slot's string content
 // (CPython-cached UTF-8) with NO fixed-width conversion and NO new Python

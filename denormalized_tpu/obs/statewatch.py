@@ -106,13 +106,28 @@ def rb_nbytes(batch) -> int:
     (string) columns."""
     import numpy as _np
 
+    from denormalized_tpu.common.columns import Column as _ColData
+
     total = 0
     for name in batch.schema.names:
-        col = _np.asarray(batch.column(name))
-        if col.dtype == object:
-            total += len(col) * OBJ_CELL_EST_BYTES
-        else:
+        col = batch.column(name)
+        if isinstance(col, _ColData):
+            # columnar string/nested columns have EXACT buffer bytes —
+            # no estimate needed (and no accidental materialization:
+            # np.asarray here would build every Python row just to
+            # count them)
             total += int(col.nbytes)
+            if getattr(col, "_obj", None) is not None:
+                # a legacy touch materialized (and cached) Python rows:
+                # that parallel object array is real resident memory —
+                # charge it like the pre-columnar estimate did
+                total += len(col) * OBJ_CELL_EST_BYTES
+        else:
+            col = _np.asarray(col)
+            if col.dtype == object:
+                total += len(col) * OBJ_CELL_EST_BYTES
+            else:
+                total += int(col.nbytes)
         m = batch.mask(name)
         if m is not None:
             total += int(_np.asarray(m).nbytes)

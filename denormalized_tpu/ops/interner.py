@@ -80,6 +80,18 @@ def _load_native_lib():
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
             ]
+            # offsets+bytes lane (StringColumn) — probe for a stale .so
+            # without the symbol; srchash rebuilds make this moot, but a
+            # cheap guard beats an AttributeError mid-stream
+            if hasattr(lib, "intern_offsets"):
+                lib.intern_offsets.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_void_p,  # utf-8 byte buffer
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.c_void_p,  # validity (u8) or NULL
+                    ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_int32),
+                ]
             lib.intern_free.argtypes = [ctypes.c_void_p]
             lib._in_configured = True
         return lib
@@ -173,6 +185,21 @@ class ColumnInterner:
         native and fallback paths."""
         import ctypes
 
+        from denormalized_tpu.common.columns import StringColumn
+
+        if isinstance(arr, StringColumn):
+            # columnar lane: intern straight off offsets+bytes — no
+            # Python str is ever created for a key on this path.  Null
+            # slots intern the 0xFF NULL key, the same id the PyObject
+            # lane gives None, so a column mixing columnar and legacy
+            # batches groups identically.
+            fn = (
+                getattr(self._lib, "intern_offsets", None)
+                if self._h is not None else None
+            )
+            if fn is not None:
+                return self._intern_string_column(arr, fn)
+            arr = arr.as_object()  # no native lib: dict fallback below
         if arr.dtype.kind in "ifbM":
             # numeric key column: unique per batch, dict on uniques only
             uniq, inv = np.unique(arr, return_inverse=True)
@@ -236,6 +263,33 @@ class ColumnInterner:
                 values.append(v)
             ids[i] = j
         return ids[inv]
+
+    def _intern_string_column(self, col, fn) -> np.ndarray:
+        """offsets+bytes native intern (pinned hot path: one foreign call
+        per batch, no per-row Python)."""
+        import ctypes
+
+        n = len(col)
+        ids = np.empty(n, dtype=np.int32)
+        if n == 0:
+            return ids
+        offsets = np.ascontiguousarray(col.offsets, dtype=np.uint64)
+        data = np.ascontiguousarray(col.data)
+        validity = col.validity
+        vptr = (
+            0 if validity is None
+            else np.ascontiguousarray(validity).ctypes.data
+        )
+        fn(
+            self._h,
+            data.ctypes.data if data.size else 0,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            vptr,
+            n,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        self._native_active = True
+        return ids
 
     def value_of(self, ids: np.ndarray) -> np.ndarray:
         if self._native_active:
@@ -462,8 +516,10 @@ class RecyclingGroupInterner:
 
     def intern(self, key_columns: list[np.ndarray]) -> np.ndarray:
         assert len(key_columns) == self.num_columns
+        from denormalized_tpu.common.columns import as_key_column
+
         per_col = [
-            it.intern_array(np.asarray(c))
+            it.intern_array(as_key_column(c))
             for it, c in zip(self._col_interners, key_columns)
         ]
         if self.num_columns == 1:

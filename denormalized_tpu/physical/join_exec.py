@@ -947,20 +947,7 @@ class StreamingJoinExec(ExecOperator):
             if rows is not None:
                 # insert order == row-array order (v2: resident rows only)
                 assert spilled or rows.num_rows == n
-                for f in schema:
-                    colv = np.asarray(rows.column(f.name))
-                    if colv.dtype == object:
-                        side_meta["strings"][f.name] = [
-                            None if v is None else str(v) for v in colv
-                        ]
-                    else:
-                        arrays[f"s{sid}_col_{f.name}"] = colv
-                    mask = rows.mask(f.name)
-                    if mask is not None:
-                        side_meta["masked"].append(f.name)
-                        arrays[f"s{sid}_mask_{f.name}"] = np.asarray(
-                            mask, dtype=bool
-                        )
+                self._pack_side_cols(sid, rows, schema, side_meta, arrays)
             if n and (rows is not None or spilled):
                 arrays[f"s{sid}_matched"] = side.matched[:n].copy()
                 # per-batch boundaries: restore must keep the original
@@ -994,6 +981,67 @@ class StreamingJoinExec(ExecOperator):
             # indexes past them
             self._tier.align_touch(sides)
 
+    @staticmethod
+    def _pack_side_cols(sid, rows, schema, side_meta, arrays) -> None:
+        """Pack one side's retained-row columns into the snapshot:
+        columnar string/nested columns store their RAW buffers (the same
+        codec spill blocks use — no Python value round-trip), plain
+        object columns keep the legacy JSON ``strings`` lane."""
+        from denormalized_tpu.common.columns import Column, column_to_arrays
+
+        for f in schema:
+            col = rows.column(f.name)
+            if isinstance(col, Column):
+                side_meta.setdefault("columnar", {})[f.name] = (
+                    column_to_arrays(col, f"s{sid}_cc_{f.name}_", arrays)
+                )
+            else:
+                colv = np.asarray(col)
+                if colv.dtype == object:
+                    side_meta["strings"][f.name] = [
+                        None if v is None else str(v) for v in colv
+                    ]
+                else:
+                    arrays[f"s{sid}_col_{f.name}"] = colv
+            mask = rows.mask(f.name)
+            # columnar columns already pack their validity — skip the
+            # identical batch mask (unpack rebuilds it from the column)
+            if mask is not None and mask is not getattr(
+                col, "validity", None
+            ):
+                side_meta["masked"].append(f.name)
+                arrays[f"s{sid}_mask_{f.name}"] = np.asarray(
+                    mask, dtype=bool
+                )
+
+    @staticmethod
+    def _unpack_side_cols(sid, schema, side_meta, arrays) -> RecordBatch:
+        """Inverse of :meth:`_pack_side_cols` (legacy snapshots — no
+        ``columnar`` entry — load unchanged)."""
+        from denormalized_tpu.common.columns import column_from_arrays
+
+        colspecs = side_meta.get("columnar", {})
+        cols, masks = [], []
+        for f in schema:
+            if f.name in colspecs:
+                cols.append(
+                    column_from_arrays(
+                        colspecs[f.name], f"s{sid}_cc_{f.name}_", arrays
+                    )
+                )
+            elif f.name in side_meta["strings"]:
+                vals = side_meta["strings"][f.name]
+                arr = np.empty(len(vals), dtype=object)
+                arr[:] = vals
+                cols.append(arr)
+            else:
+                cols.append(arrays[f"s{sid}_col_{f.name}"])
+            if f.name in side_meta["masked"]:
+                masks.append(arrays.get(f"s{sid}_mask_{f.name}"))
+            else:
+                masks.append(getattr(cols[-1], "validity", None))
+        return RecordBatch(schema, cols, masks)
+
     def _restore_v1(self, meta, arrays, sides) -> None:
         for sid, (side, schema, names) in enumerate(
             zip(
@@ -1007,20 +1055,7 @@ class StreamingJoinExec(ExecOperator):
             n = int(side_meta["count"])
             if n == 0:
                 continue
-            cols, masks = [], []
-            for f in schema:
-                if f.name in side_meta["strings"]:
-                    cols.append(
-                        np.asarray(side_meta["strings"][f.name], dtype=object)
-                    )
-                else:
-                    cols.append(arrays[f"s{sid}_col_{f.name}"])
-                masks.append(
-                    arrays.get(f"s{sid}_mask_{f.name}")
-                    if f.name in side_meta["masked"]
-                    else None
-                )
-            merged = RecordBatch(schema, cols, masks)
+            merged = self._unpack_side_cols(sid, schema, side_meta, arrays)
             gids = self._gids_of(merged, names).astype(np.int32)
             # split back into the ORIGINAL batches (rows are stored in
             # (batch, row) insert order, so each bi is one contiguous run)
@@ -1087,21 +1122,9 @@ class StreamingJoinExec(ExecOperator):
             )
             merged = None
             if resident_rows > 0:
-                cols, masks = [], []
-                for f in schema:
-                    if f.name in side_meta["strings"]:
-                        vals = side_meta["strings"][f.name]
-                        arr = np.empty(len(vals), dtype=object)
-                        arr[:] = vals
-                        cols.append(arr)
-                    else:
-                        cols.append(arrays[f"s{sid}_col_{f.name}"])
-                    masks.append(
-                        arrays.get(f"s{sid}_mask_{f.name}")
-                        if f.name in side_meta["masked"]
-                        else None
-                    )
-                merged = RecordBatch(schema, cols, masks)
+                merged = self._unpack_side_cols(
+                    sid, schema, side_meta, arrays
+                )
             bounds = np.nonzero(
                 np.concatenate(([True], bis[1:] != bis[:-1]))
             )[0]

@@ -713,7 +713,10 @@ class PrintSink(Sink):
         self._file = file or sys.stdout
 
     def write(self, batch: RecordBatch) -> None:
-        user = batch.select(batch.schema.without_internal().names)
+        # sink = user-facing boundary: columnar columns materialize here
+        user = batch.select(
+            batch.schema.without_internal().names
+        ).materialized()
         import json
 
         names = user.schema.names
@@ -740,7 +743,12 @@ class CallbackSink(Sink):
         self._fn = fn
 
     def write(self, batch: RecordBatch) -> None:
-        self._fn(batch.select(batch.schema.without_internal().names))
+        # user callback = user-facing boundary: rows may materialize
+        self._fn(
+            batch.select(
+                batch.schema.without_internal().names
+            ).materialized()
+        )
 
 
 class CollectSink(Sink):

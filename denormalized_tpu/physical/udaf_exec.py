@@ -627,8 +627,10 @@ class UdafWindowExec(ExecOperator):
             # numeric/bool keys take the interner's exact-value path —
             # forcing object would str()-normalize them (False → 'True'
             # on emission re-cast)
+            from denormalized_tpu.common.columns import as_key_column
+
             gids = self._interner.intern(
-                [np.asarray(g.eval(batch)) for g in self.group_exprs]
+                [as_key_column(g.eval(batch)) for g in self.group_exprs]
             ).astype(np.int64)
         else:
             gids = np.zeros(n, dtype=np.int64)

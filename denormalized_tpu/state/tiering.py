@@ -185,46 +185,70 @@ class ColdTracker:
 
 
 def rb_to_blob(batch: RecordBatch, extra_meta: dict | None = None) -> bytes:
-    """Pack one RecordBatch (columns + masks; object columns ride the
-    JSON meta like the join snapshot's ``strings``) into a self-
-    describing blob."""
+    """Pack one RecordBatch into a self-describing blob.  Columnar
+    string/nested columns pack their RAW buffers (offsets+bytes — the
+    same codec the exchange frames use, so cold state shrinks and never
+    round-trips through Python values); plain object columns keep the
+    legacy JSON-meta ``strings`` lane."""
+    from denormalized_tpu.common.columns import Column, column_to_arrays
+
     meta: dict = {"strings": {}, "masked": [], "rows": batch.num_rows}
     if extra_meta:
         meta["extra"] = extra_meta
     arrays: dict[str, np.ndarray] = {}
+    colspecs: dict[str, dict] = {}
     for f in batch.schema:
-        col = np.asarray(batch.column(f.name))
-        if col.dtype == object:
+        col = batch.column(f.name)
+        if isinstance(col, Column):
+            colspecs[f.name] = column_to_arrays(
+                col, f"cc_{f.name}_", arrays
+            )
+        elif np.asarray(col).dtype == object:
             meta["strings"][f.name] = [
-                None if v is None else str(v) for v in col
+                None if v is None else str(v) for v in np.asarray(col)
             ]
         else:
-            arrays[f"col_{f.name}"] = col
+            arrays[f"col_{f.name}"] = np.asarray(col)
         m = batch.mask(f.name)
-        if m is not None:
+        # a columnar column's validity already rides its own buffers —
+        # don't store the identical batch mask twice
+        if m is not None and m is not getattr(col, "validity", None):
             meta["masked"].append(f.name)
             arrays[f"mask_{f.name}"] = np.asarray(m, dtype=bool)
+    if colspecs:
+        meta["columnar"] = colspecs
     return pack_snapshot(meta, arrays)
 
 
 def rb_from_blob(blob: bytes, schema) -> tuple[RecordBatch, dict | None]:
     """Inverse of :func:`rb_to_blob` (schema supplied by the owner —
-    spilled blocks never carry schemas)."""
+    spilled blocks never carry schemas).  Legacy blobs (no ``columnar``
+    meta) load unchanged."""
+    from denormalized_tpu.common.columns import column_from_arrays
+
     meta, arrays = unpack_snapshot(blob)
+    colspecs = meta.get("columnar", {})
     cols, masks = [], []
     for f in schema:
-        if f.name in meta["strings"]:
+        if f.name in colspecs:
+            cols.append(
+                column_from_arrays(
+                    colspecs[f.name], f"cc_{f.name}_", arrays
+                )
+            )
+        elif f.name in meta["strings"]:
             vals = meta["strings"][f.name]
             arr = np.empty(len(vals), dtype=object)
             arr[:] = vals
             cols.append(arr)
         else:
             cols.append(arrays[f"col_{f.name}"])
-        masks.append(
-            arrays.get(f"mask_{f.name}")
-            if f.name in meta["masked"]
-            else None
-        )
+        if f.name in meta["masked"]:
+            masks.append(arrays.get(f"mask_{f.name}"))
+        else:
+            # columnar columns surface their own validity as the mask
+            # (the pack side elided the redundant copy)
+            masks.append(getattr(cols[-1], "validity", None))
     return RecordBatch(schema, cols, masks), meta.get("extra")
 
 

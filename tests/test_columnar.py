@@ -1,0 +1,272 @@
+"""Columnar string & nested column unit coverage: representation ops,
+RecordBatch integration (concat/empty, to_pydict nulls), the
+offsets+bytes intern lane, and the shared spill/snapshot codec."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.common.columns import (
+    NestedColumn,
+    PrimitiveColumn,
+    StringColumn,
+    as_numpy,
+    column_from_spec,
+    column_spec_and_buffers,
+)
+from denormalized_tpu.common.errors import SchemaError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+
+F, S, D = Field, Schema, DataType
+
+
+def _sc(vals):
+    col = StringColumn.from_objects(np.array(vals, dtype=object))
+    assert col is not None
+    return col
+
+
+def _nested_struct():
+    f = F("st", D.STRUCT, children=(F("x", D.INT64), F("s", D.STRING)))
+    prim = PrimitiveColumn(
+        "i64", np.array([1, 2, 3, 4]), np.array([True, False, True, True])
+    )
+    ss = _sc(["a", "b", None, "d"])
+    return NestedColumn(
+        f, "struct", 4, [prim, ss],
+        validity=np.array([True, True, False, True]),
+    )
+
+
+# -- StringColumn ---------------------------------------------------------
+
+
+def test_string_column_roundtrip_and_ops():
+    vals = ["ab", "", "日本語", None, "x" * 300, "tail\x00"]
+    col = _sc(vals)
+    # from_objects normalization: values round-trip exactly (incl. the
+    # trailing-NUL string — byte storage has no fixed-width padding)
+    assert col.tolist() == vals
+    assert col[2] == "日本語" and col[3] is None
+    assert col.take(np.array([4, 3, 0])).tolist() == [vals[4], None, "ab"]
+    assert col[1:4].tolist() == vals[1:4]
+    assert col[np.array([True, False, True, False, False, True])].tolist() \
+        == ["ab", "日本語", "tail\x00"]
+    cc = StringColumn.concat([col, col.slice(0, 2)])
+    assert cc.tolist() == vals + vals[:2]
+    # exact accounting, no estimate
+    assert col.nbytes == col.offsets.nbytes + col.data.nbytes \
+        + col.validity.nbytes
+    # numpy interop: __array__ materializes the cached object array
+    assert np.asarray(col).dtype == object
+    assert np.asarray(col).tolist() == vals
+
+
+def test_string_column_from_objects_declines_non_strings():
+    assert StringColumn.from_objects(
+        np.array([b"bytes", "s"], dtype=object)
+    ) is None
+    assert StringColumn.from_objects(
+        np.array([{"k": 1}], dtype=object)
+    ) is None
+
+
+def test_nested_column_ops():
+    st = _nested_struct()
+    want = [{"x": 1, "s": "a"}, {"x": None, "s": "b"}, None,
+            {"x": 4, "s": "d"}]
+    assert st.tolist() == want
+    assert st.take(np.array([3, 0])).tolist() == [want[3], want[0]]
+    lf = F("lst", D.LIST, children=(st.field,))
+    lc = NestedColumn(
+        lf, "list", 3, [st],
+        validity=np.array([True, False, True]),
+        offsets=np.array([0, 2, 2, 4]),
+    )
+    assert lc.tolist() == [want[:2], None, want[2:]]
+    assert lc.take(np.array([2, 0])).tolist() == [want[2:], want[:2]]
+    cc = NestedColumn.concat([lc, lc.take(np.array([0]))])
+    assert cc.tolist() == [want[:2], None, want[2:], want[:2]]
+
+
+def test_column_spec_buffer_codec_roundtrip():
+    st = _nested_struct()
+    lf = F("lst", D.LIST, children=(st.field,))
+    lc = NestedColumn(
+        lf, "list", 3, [st], validity=None, offsets=np.array([0, 1, 2, 4])
+    )
+    for col in (_sc(["q", None, ""]), st, lc):
+        spec, bufs = column_spec_and_buffers(col)
+        back = column_from_spec(spec, iter(bufs))
+        assert back.tolist() == col.tolist()
+
+
+# -- RecordBatch integration ----------------------------------------------
+
+
+def test_concat_empty_sequence_raises_schema_error():
+    with pytest.raises(SchemaError, match="empty sequence"):
+        RecordBatch.concat([])
+
+
+def test_concat_empty_sequence_with_schema():
+    sch = S([F("a", D.INT64), F("s", D.STRING)])
+    b = RecordBatch.concat([], schema=sch)
+    assert b.num_rows == 0 and b.schema == sch
+
+
+def test_concat_mixed_column_representations():
+    sch = S([F("s", D.STRING)])
+    b_col = RecordBatch(sch, [_sc(["a", None])])
+    legacy = np.empty(2, dtype=object)
+    legacy[:] = ["c", "d"]
+    b_obj = RecordBatch(sch, [legacy])
+    got = RecordBatch.concat([b_col, b_obj])
+    assert got.to_pydict() == {"s": ["a", None, "c", "d"]}
+    # homogeneous columnar chunks stay columnar
+    got2 = RecordBatch.concat([b_col, b_col])
+    assert isinstance(got2.columns[0], StringColumn)
+    assert got2.to_pydict() == {"s": ["a", None, "a", None]}
+
+
+def test_to_pydict_applies_validity_masks():
+    sch = S([F("a", D.INT64), F("f", D.FLOAT64), F("s", D.STRING),
+             F("t", D.BOOL)])
+    masks = [
+        np.array([True, False, True]),
+        np.array([False, True, True]),
+        np.array([True, True, False]),
+        np.array([False, False, True]),
+    ]
+    svals = np.empty(3, dtype=object)
+    svals[:] = ["x", "y", ""]
+    b = RecordBatch(
+        sch,
+        [np.array([1, 0, 3]), np.array([0.0, 2.5, 3.5]), svals,
+         np.array([False, False, True])],
+        masks,
+    )
+    d = b.to_pydict()
+    assert d == {
+        "a": [1, None, 3],
+        "f": [None, 2.5, 3.5],
+        "s": ["x", "y", None],
+        "t": [None, None, True],
+    }
+    # pinned identical to the pyarrow lane
+    pa = pytest.importorskip("pyarrow")  # noqa: F841
+    rows = b.to_pyarrow().to_pylist()
+    by_col = {n: [r[n] for r in rows] for n in sch.names}
+    assert by_col == d
+
+
+def test_batch_transforms_keep_columnar_columns():
+    sch = S([F("s", D.STRING), F("v", D.INT64)])
+    col = _sc(["a", "b", None, "d", "e"])
+    b = RecordBatch(sch, [col, np.arange(5)], [col.validity, None])
+    f = b.filter(np.array([True, False, True, True, False]))
+    assert isinstance(f.columns[0], StringColumn)
+    assert f.to_pydict() == {"s": ["a", None, "d"], "v": [0, 2, 3]}
+    t = b.take(np.array([4, 2]))
+    assert t.to_pydict() == {"s": ["e", None], "v": [4, 2]}
+    s = b.slice(1, 3)
+    assert s.to_pydict() == {"s": ["b", None, "d"], "v": [1, 2, 3]}
+    m = b.materialized()
+    assert m.columns[0].dtype == object and not isinstance(
+        m.columns[0], StringColumn
+    )
+    assert m.to_pydict() == b.to_pydict()
+
+
+# -- interner offsets lane ------------------------------------------------
+
+
+def test_interner_offsets_lane_matches_object_lane():
+    from denormalized_tpu.ops.interner import ColumnInterner
+
+    vals = ["a", "b", "a", None, "c", "", "b", "日本"]
+    ci = ColumnInterner()
+    ids_col = ci.intern_array(_sc(vals))
+    # a SECOND interner fed the same keys as objects assigns the same ids
+    ci2 = ColumnInterner()
+    ids_obj = ci2.intern_array(np.array(vals, dtype=object))
+    np.testing.assert_array_equal(ids_col, ids_obj)
+    # and MIXING lanes in one interner resolves to the same ids
+    ids_mixed = ci.intern_array(np.array(vals, dtype=object))
+    np.testing.assert_array_equal(ids_col, ids_mixed)
+    assert ci.value_of(np.asarray(ids_col)).tolist() == [
+        v if v is None else v for v in vals
+    ]
+
+
+def test_group_interner_takes_string_columns():
+    from denormalized_tpu.ops.interner import (
+        GroupInterner,
+        RecyclingGroupInterner,
+    )
+
+    col = _sc(["k1", "k2", "k1", None])
+    for interner in (GroupInterner(1), RecyclingGroupInterner(1)):
+        gids = interner.intern([col])
+        assert gids[0] == gids[2] and gids[0] != gids[1]
+        keys = interner.keys_of(np.asarray([gids[0], gids[3]]))[0]
+        assert keys.tolist() == ["k1", None]
+
+
+# -- shared spill/snapshot codec ------------------------------------------
+
+
+def test_spill_blob_roundtrips_columnar_columns():
+    from denormalized_tpu.state.tiering import rb_from_blob, rb_to_blob
+
+    sch = S([F("s", D.STRING), F("v", D.INT64),
+             F("st", D.STRUCT, children=(F("x", D.INT64),))])
+    col = _sc(["a", None, "日本"])
+    st = NestedColumn(
+        sch.field("st"), "struct", 3,
+        [PrimitiveColumn("i64", np.arange(3),
+                         np.array([True, False, True]))],
+        validity=np.array([True, True, False]),
+    )
+    b = RecordBatch(sch, [col, np.arange(3), st],
+                    [col.validity, None, st.validity])
+    blob = rb_to_blob(b, {"tag": 7})
+    back, extra = rb_from_blob(blob, sch)
+    assert extra == {"tag": 7}
+    assert isinstance(back.columns[0], StringColumn)
+    assert isinstance(back.columns[2], NestedColumn)
+    assert back.to_pydict() == b.to_pydict()
+    # at scale the raw lane is SMALLER than the legacy JSON-strings lane
+    # (fixed spec overhead amortizes; per-value JSON quoting does not)
+    big_vals = [f"key-{i % 50}-日本" for i in range(400)]
+    big = RecordBatch(S([F("s", D.STRING)]), [_sc(big_vals)])
+    raw_blob = rb_to_blob(big)
+    legacy_blob = rb_to_blob(big.materialized())
+    assert len(raw_blob) < len(legacy_blob)
+
+
+def test_rb_nbytes_exact_for_columnar_columns():
+    from denormalized_tpu.obs.statewatch import rb_nbytes
+
+    sch = S([F("s", D.STRING)])
+    col = _sc(["abc", "de", None])
+    b = RecordBatch(sch, [col], [col.validity])
+    # exact column buffers + the batch-level mask
+    want = col.nbytes + np.asarray(col.validity, dtype=bool).nbytes
+    assert rb_nbytes(b) == want
+    # and no materialization happened as a side effect of accounting
+    assert col._obj is None
+    # once a legacy touch materializes (and caches) rows, the parallel
+    # object array is charged like the pre-columnar per-cell estimate
+    from denormalized_tpu.obs.statewatch import OBJ_CELL_EST_BYTES
+
+    col.as_object()
+    assert rb_nbytes(b) == want + len(col) * OBJ_CELL_EST_BYTES
+
+
+def test_as_numpy_passthrough():
+    arr = np.arange(3)
+    assert as_numpy(arr) is arr
+    col = _sc(["a"])
+    out = as_numpy(col)
+    assert out.dtype == object and out.tolist() == ["a"]

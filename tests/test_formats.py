@@ -799,6 +799,7 @@ def test_nested_reassembly_python_fallback_matches_c():
     headers) must stay bit-identical to the C row assembler it falls
     back from — otherwise only the C path keeps its differential
     coverage."""
+    import denormalized_tpu.common.columns as C
     import denormalized_tpu.formats._native_parser_base as B
 
     if B._pyassemble() is None:
@@ -808,15 +809,17 @@ def test_nested_reassembly_python_fallback_matches_c():
     for r in rows:
         a.push(r)
     ba = a.flush()
-    orig = B._pa_fn
+    orig = C._pa_fn
     try:
-        B._pa_fn = None  # force the generated-comprehension fallback
+        C._pa_fn = None  # force the generated-comprehension fallback
         b = JsonDecoder(NESTED, use_native=True)
         for r in rows:
             b.push(r)
-        bb = b.flush()
+        # materialize INSIDE the patched region: on the columnar path
+        # reassembly is lazy, so the fallback only runs if rows build now
+        bb = b.flush().materialized()
     finally:
-        B._pa_fn = orig
+        C._pa_fn = orig
     for name in NESTED.names:
         ca, cb = ba.column(name), bb.column(name)
         if ca.dtype == object:
