@@ -1795,6 +1795,302 @@ def run_spill_scale() -> dict:
     }
 
 
+def run_multi_query() -> dict:
+    """BENCH_CONFIG=multi_query — the multi-query engine's acceptance
+    artifact (MULTI_QUERY_SCALE.json): Q concurrent shareable sliding-
+    window queries over ONE feed, shared slice plan vs Q independent
+    pipelines, swept at Q = 1/10/100.
+
+    Per sweep point: the shared plan runs ONE ingest + slice store with
+    Q fold-and-emit subscribers (runtime/multi_query.py); the
+    independent baseline runs Q full pipelines through the production
+    StreamingWindowExec path.  Aggregate throughput = Q * feed_rows /
+    wall.  The artifact also records (a) per-query emissions at Q=10
+    compared byte-identically against independent slice-oracle
+    pipelines pinned to the group's gcd slice, (b) a kill/restore
+    segment asserting byte-identity THROUGH a checkpoint restore, and
+    (c) the single-query sliding fast-path A/B (slice fold vs k-way
+    ring scatter) — the no-sharing satellite."""
+    from denormalized_tpu.physical.simple_execs import CallbackSink
+    from denormalized_tpu.runtime.multi_query import run_queries
+
+    col, F = _F()
+    rows = int(os.environ.get("BENCH_MQ_ROWS", 150_000))
+    batch_rows = min(int(os.environ.get("BENCH_MQ_BATCH", 16_384)), rows)
+    sweep = [
+        int(q)
+        for q in os.environ.get("BENCH_MQ_QUERIES", "1,10,100").split(",")
+    ]
+    n_keys = int(os.environ.get("BENCH_MQ_KEYS", 64))
+    _schema, batches = gen_batches(
+        num_keys=n_keys, total_rows=rows, batch_rows=batch_rows
+    )
+    feed_rows = sum(b.num_rows for b in batches)
+    # window specs cycled across queries — all multiples of a 1s slice
+    spec_cycle = [
+        (5_000, 1_000), (10_000, 1_000), (30_000, 5_000), (10_000, 2_000),
+        (60_000, 10_000), (15_000, 3_000), (20_000, 4_000), (8_000, 2_000),
+    ]
+    aggs = [
+        F.count(col("reading")).alias("c"),
+        F.sum(col("reading")).alias("s"),
+        F.avg(col("reading")).alias("av"),
+    ]
+
+    def make_queries(ctx, q, sinks):
+        base = ctx.from_source(_mem_source(batches), name="mq_feed")
+        return [
+            (
+                base.window(
+                    ["sensor_name"], aggs,
+                    spec_cycle[i % len(spec_cycle)][0],
+                    spec_cycle[i % len(spec_cycle)][1],
+                ),
+                sinks[i],
+            )
+            for i in range(q)
+        ]
+
+    def counting_sink(counter):
+        def sink(b):
+            counter[0] += b.num_rows
+
+        return sink
+
+    # warmup: compile every distinct window spec's programs (both the
+    # ring operator and the slice path) on a tiny feed, so the timed
+    # sweep measures steady-state on BOTH sides, not first-compile
+    warm = batches[: max(2, len(batches) // 16)]
+    for L, S in spec_cycle:
+        ctx_w = _engine_ctx()
+        ctx_w.from_source(
+            _mem_source(warm), name="mq_feed"
+        ).window(["sensor_name"], aggs, L, S)._execute(
+            CallbackSink(lambda _b: None)
+        )
+    ctx_w = _engine_ctx()
+    sink_null = lambda _b: None  # noqa: E731
+    # ONE base DataStream: sharing keys on Scan source IDENTITY, so a
+    # per-query from_source here would warm 8 independent fallbacks and
+    # leave the shared slice path cold (the SKILL.md gotcha)
+    base_w = ctx_w.from_source(_mem_source(warm), name="mq_feed")
+    rep_w = run_queries(
+        ctx_w,
+        [
+            (base_w.window(["sensor_name"], aggs, L, S), sink_null)
+            for L, S in spec_cycle
+        ],
+    )
+    assert rep_w["shared_queries"] == len(spec_cycle), rep_w
+
+    points = []
+    for q in sweep:
+        # shared plan: one pass
+        ctx = _engine_ctx()
+        counters = [[0] for _ in range(q)]
+        queries = make_queries(ctx, q, [counting_sink(c) for c in counters])
+        t0 = time.perf_counter()
+        rep = run_queries(ctx, queries)
+        shared_s = time.perf_counter() - t0
+        assert rep["shared_queries"] == q or q == 1, rep
+        # independent baseline: q full production pipelines
+        t0 = time.perf_counter()
+        for i in range(q):
+            ctx_i = _engine_ctx()
+            c = [0]
+            L, S = spec_cycle[i % len(spec_cycle)]
+            ctx_i.from_source(_mem_source(batches), name="mq_feed").window(
+                ["sensor_name"], aggs, L, S
+            )._execute(CallbackSink(counting_sink(c)))
+        independent_s = time.perf_counter() - t0
+        points.append(
+            {
+                "queries": q,
+                "shared_s": round(shared_s, 3),
+                "independent_s": round(independent_s, 3),
+                "shared_agg_rows_per_s": round(q * feed_rows / shared_s),
+                "independent_agg_rows_per_s": round(
+                    q * feed_rows / independent_s
+                ),
+                "speedup": round(independent_s / shared_s, 3),
+                "emitted_windows": sum(c[0] for c in counters),
+            }
+        )
+        log(
+            f"multi_query q={q}: shared {shared_s:.2f}s vs independent "
+            f"{independent_s:.2f}s → {points[-1]['speedup']}x"
+        )
+
+    # -- single-query sliding fast path A/B (the no-sharing satellite) --
+    def one_query(cfg_over):
+        ctx = _engine_ctx(**cfg_over)
+        c = [0]
+        ctx.from_source(_mem_source(batches), name="mq_feed").window(
+            ["sensor_name"], aggs, 5_000, 1_000
+        )._execute(CallbackSink(counting_sink(c)))
+        return c[0]
+
+    t0 = time.perf_counter()
+    ring_windows = one_query({})
+    ring_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    slice_windows_n = one_query({"slice_windows": True})
+    slice_s = time.perf_counter() - t0
+    assert ring_windows == slice_windows_n
+
+    # -- byte-identity: shared vs independent slice oracles at Q=10 -----
+    def rows_of(b, acc):
+        ks = b.column("sensor_name")
+        ws = b.column("window_start_time")
+        we = b.column("window_end_time")
+        cs, ss, avs = b.column("c"), b.column("s"), b.column("av")
+        for i in range(b.num_rows):
+            acc[(ks[i], int(ws[i]), int(we[i]))] = (
+                float(cs[i]), float(ss[i]), float(avs[i])
+            )
+
+    # fixed at 10 regardless of the sweep: a BENCH_MQ_QUERIES=1 smoke
+    # has no shared group to compare, and the check is cheap
+    q_check = 10
+    ctx = _engine_ctx()
+    outs = [dict() for _ in range(q_check)]
+    sinks = [(lambda acc: (lambda b: rows_of(b, acc)))(o) for o in outs]
+    rep = run_queries(ctx, make_queries(ctx, q_check, sinks))
+    unit = next(g["unit_ms"] for g in rep["groups"] if g["shared"])
+    identical = True
+    for i in range(q_check):
+        L, S = spec_cycle[i % len(spec_cycle)]
+        ctx_i = _engine_ctx(slice_windows=True, slice_unit_ms=unit)
+        ind = {}
+        ctx_i.from_source(_mem_source(batches), name="mq_feed").window(
+            ["sensor_name"], aggs, L, S
+        )._execute(CallbackSink((lambda acc: (lambda b: rows_of(b, acc)))(ind)))
+        if outs[i] != ind:
+            identical = False
+            log(f"multi_query: query {i} emissions DIVERGED")
+    log(f"multi_query: byte-identity at q={q_check}: {identical}")
+
+    # -- kill/restore byte-identity through a checkpoint ----------------
+    kill_identical = _mq_kill_restore(
+        make_queries, rows_of, spec_cycle, q=3
+    )
+    log(f"multi_query: kill/restore byte-identity: {kill_identical}")
+
+    best = points[-1]
+    gate_pass = best["speedup"] >= 5.0 and identical and kill_identical
+    return {
+        "metric": (
+            f"multi_query_{best['queries']}q_shared_aggregate_rows_per_s"
+        ),
+        "value": best["shared_agg_rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": best["speedup"],
+        "device": "host",
+        "feed_rows": feed_rows,
+        "num_keys": n_keys,
+        "points": points,
+        "single_query_slice_ab": {
+            "ring_s": round(ring_s, 3),
+            "slice_s": round(slice_s, 3),
+            "slice_vs_ring": round(ring_s / slice_s, 3),
+            "windows": ring_windows,
+        },
+        "emissions_identical_vs_independent": identical,
+        "emissions_identical_through_kill_restore": kill_identical,
+        "scaling_gate": {
+            "bar": 5.0,
+            "measured": best["speedup"],
+            "pass": gate_pass,
+        },
+        "host_cores": os.cpu_count(),
+    }
+
+
+def _mq_kill_restore(make_queries, rows_of, spec_cycle, q=3) -> bool:
+    """Shared-group kill/restore segment of the multi_query bench: run
+    with checkpointing, hard-stop mid-epoch after one committed cut,
+    restore, and compare per-query emissions byte-identically against
+    independent uninterrupted slice oracles."""
+    import shutil
+
+    from denormalized_tpu.physical.base import EndOfStream, Marker
+    from denormalized_tpu.physical.simple_execs import CallbackSink
+    from denormalized_tpu.physical.slice_exec import SubscriberBatch
+    from denormalized_tpu.planner.sharing import detect_sharing
+    from denormalized_tpu.runtime.multi_query import build_shared_root
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.lsm import close_global_state_backend
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    state_dir = tempfile.mkdtemp(prefix="mq_bench_ckpt_")
+
+    def shared_root(ctx):
+        queries = make_queries(ctx, q, [None] * q)
+        groups = detect_sharing([ds._plan for ds, _s in queries])
+        (grp,) = [g for g in groups if g.shared]
+        return build_shared_root(ctx, grp)
+
+    got = [dict() for _ in range(q)]
+    try:
+        cfg = dict(
+            checkpoint=True, checkpoint_interval_s=9999,
+            state_backend_path=state_dir,
+        )
+        ctx_a = _engine_ctx(**cfg)
+        root_a = shared_root(ctx_a)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emissions = committed = post = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, SubscriberBatch):
+                rows_of(item.batch, got[item.tag])
+                emissions += 1
+                if committed:
+                    post += 1
+                    if post >= 9:
+                        break
+            if emissions == 8 and not committed:
+                orch_a.trigger_now()
+                emissions += 1
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                committed = 1
+        it.close()
+        close_global_state_backend()
+
+        ctx_b = _engine_ctx(**cfg)
+        root_b = shared_root(ctx_b)
+        orch_b = Orchestrator(interval_s=9999)
+        wire_checkpointing(root_b, ctx_b, orch_b)
+        for item in root_b.run():
+            if isinstance(item, SubscriberBatch):
+                rows_of(item.batch, got[item.tag])
+            if isinstance(item, EndOfStream):
+                break
+        close_global_state_backend()
+
+        # independent uninterrupted slice oracles, pinned to the shared
+        # group's slice unit (the byte-identity precondition)
+        unit = root_b.unit_ms
+        for i in range(q):
+            ctx_i = _engine_ctx(slice_windows=True, slice_unit_ms=unit)
+            ds = make_queries(ctx_i, q, [None] * q)[i][0]
+            ind: dict = {}
+            ds._execute(
+                CallbackSink(
+                    (lambda acc: (lambda b: rows_of(b, acc)))(ind)
+                ),
+                checkpoint=False,
+            )
+            if got[i] != ind:
+                return False
+        return True
+    finally:
+        close_global_state_backend()
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+
 def run_obs_overhead(config, batches, batches2=None) -> dict:
     """Overhead guard for default-level metrics (docs/observability.md):
     the same throughput pipeline with the obs registry enabled vs
@@ -2965,6 +3261,15 @@ def run_config(device: str) -> dict:
         log(f"engine[decode_scale]: worst-shape native {out['value']:,} "
             f"rows/s, min native/python {out['min_native_vs_python']}x")
         return out
+    if config == "multi_query":
+        out = run_multi_query()
+        log(
+            f"engine[multi_query]: {out['value']:,} rows/s aggregate at "
+            f"{out['points'][-1]['queries']} shared queries, "
+            f"{out['vs_baseline']}x independent; gate "
+            f"pass={out['scaling_gate']['pass']}"
+        )
+        return out
     if config == "exchange_codec":
         out = run_exchange_codec()
         log(f"engine[exchange_codec]: raw lane {out['value']:,} rows/s, "
@@ -3179,11 +3484,12 @@ def main():
     if CONFIG not in (
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
         "ingest_scale", "decode_scale", "session", "session_scale",
-        "spill_scale", "cluster_scale", "exchange_codec",
+        "spill_scale", "cluster_scale", "exchange_codec", "multi_query",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     if CONFIG in ("decode_scale", "session", "session_scale",
-                  "spill_scale", "cluster_scale", "exchange_codec"):
+                  "spill_scale", "cluster_scale", "exchange_codec",
+                  "multi_query"):
         # pure host-side benchmarks (decoder / session operator): no
         # device, no TPU relay wait
         device = "host"

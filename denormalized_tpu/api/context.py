@@ -189,6 +189,33 @@ class EngineConfig:
     # silently forecast-only budget).
     state_spill: bool | str = "auto"
 
+    # -- multi-query engine (docs/multi_query.md) -----------------------
+    # slice-folding window path: tumbling/sliding windows with builtin
+    # (foldable) aggregates run on SliceWindowExec — per-(group,
+    # slide-unit) partials accumulated once per batch, windows folded
+    # from slice partials instead of scattering each row into every
+    # overlapping window.  This is the kernel the multi-query sharing
+    # runtime (runtime/multi_query.py) always uses; setting True here
+    # additionally applies it to SINGLE queries planned through the
+    # normal executor (the sliding-window fast path; A/B'd in
+    # BENCH_HISTORY.jsonl under config=multi_query).  Default False: the
+    # device ring operator stays the single-query default pending a
+    # real-chip A/B — slice folds are host-side f64, so emitted floats
+    # can differ from the f32 device ring in the last ulp.
+    slice_windows: bool = False
+    # explicit slice width for the slice path (must divide the window's
+    # length AND slide; None = their gcd).  The fold grouping is part of
+    # a query's numeric contract — f64 sums round per fold tree — so an
+    # independent oracle comparing byte-identically against a shared
+    # group pins the group's gcd unit here (tests/bench do).
+    slice_unit_ms: int | None = None
+    # pin the slice store's lexsort accumulation lane (add-only
+    # component sets otherwise take the faster bincount lane, which
+    # associates long-segment adds differently).  A shared group whose
+    # aggregate UNION carries min/max always sorts, so an add-only
+    # member's byte-identity oracle sets this True to match.
+    slice_sort_lane: bool = False
+
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a
     # remote-compile TPU backend costs seconds per program on FIRST run;

@@ -224,6 +224,36 @@ INSTRUMENTS: dict[str, tuple] = {
         "accounted state exceeded the hard ceiling with no evictable "
         "cold state left",
     ),
+    # -- multi-query slice store (physical/slice_exec.py) ---------------
+    "dnz_slice_rows_total": (
+        "counter",
+        "rows folded into shared slice partials by a SliceWindowExec — "
+        "each row is aggregated ONCE here regardless of how many "
+        "overlapping windows or subscriber queries later fold it",
+    ),
+    "dnz_slice_units": (
+        "gauge",
+        "live slice units (slide-unit partial rows) resident in one "
+        "shared slice store — bounded by the longest subscriber window "
+        "plus watermark lag over the gcd slice width",
+    ),
+    "dnz_slice_subscribers": (
+        "gauge",
+        "window specs (concurrent queries) folding their windows from "
+        "one shared slice store — 1 on the single-query fast path",
+    ),
+    "dnz_slice_folds_total": (
+        "counter",
+        "window folds served from slice partials (one per closable "
+        "window per subscriber, including folds that found no active "
+        "groups and emitted nothing)",
+    ),
+    "dnz_slice_fold_ms": (
+        "histogram",
+        "latency of one window fold: combining L/gcd slice partials + "
+        "finalize + emission assembly for one subscriber's window",
+        MS_BUCKETS,
+    ),
     # -- sink (sources/kafka.py KafkaSinkWriter) ------------------------
     "dnz_sink_retries_total": (
         "counter",

@@ -34,10 +34,11 @@ from denormalized_tpu.obs.doctor.registry import (  # noqa: F401
     get_query,
     queries,
     register_query,
+    register_shared,
     running_count,
 )
 
 __all__ = [
     "ATTRIBUTION_RULE", "QueryHandle", "get_query", "queries",
-    "rank", "register_query", "running_count",
+    "rank", "register_query", "register_shared", "running_count",
 ]

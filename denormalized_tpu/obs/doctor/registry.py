@@ -36,13 +36,21 @@ class QueryHandle:
     """Introspection handle of one query execution."""
 
     def __init__(self, query_id: str, root, node_ids: dict[int, str],
-                 config=None, registry=None, lineage=None):
+                 config=None, registry=None, lineage=None, shared=None):
         self.query_id = query_id
         self.root = root
         self._node_ids = node_ids  # id(op) -> node_id
         self.config = config
         self.registry = registry
         self.lineage = lineage
+        # multi-query sharing (runtime/multi_query.py): when this query
+        # is one of N subscribers folding from a shared operator tree,
+        # ``shared`` carries {"group_size", "member", "weight", "label",
+        # "group"} and every shared node's busy time / input wait /
+        # state bytes are reported SCALED by weight (1/N) so per-query
+        # cost stays truthful — the attribution rule documented in
+        # docs/multi_query.md.  None = exclusive tree (the normal path).
+        self.shared = shared
         self.profiler = None
         # serializes profiler start/stop: the HTTP surface is a
         # ThreadingHTTPServer, so two concurrent /profile/start requests
@@ -213,6 +221,23 @@ class QueryHandle:
                 k: v for k, v in metrics.items()
                 if isinstance(v, (int, float))
             }
+        if self.shared is not None:
+            # shared-operator attribution: this tree serves group_size
+            # queries at once, so THIS query's truthful cost share of
+            # every node is weight (= 1/group_size) of the measured
+            # totals — busy, wait, and state split evenly across
+            # subscribers (the documented approximation, same spirit as
+            # the attribution rule's even residual split)
+            w = float(self.shared.get("weight", 1.0))
+            for k in ("busy_ms", "busy_frac", "input_wait_ms",
+                      "input_wait_frac"):
+                n[k] = round(n[k] * w, 4)
+            if "state_bytes" in n:
+                n["state_bytes"] = int(n["state_bytes"] * w)
+            n["shared"] = {
+                "subscribers": self.shared.get("group_size"),
+                "fraction": round(w, 6),
+            }
         return n
 
     def _snapshot_live(self) -> dict:
@@ -242,6 +267,8 @@ class QueryHandle:
         }
         if self.lineage is not None:
             snap["lineage_samples"] = self.lineage.sampled_total
+        if self.shared is not None:
+            snap["shared"] = dict(self.shared)
         return snap
 
     def snapshot(self) -> dict:
@@ -362,13 +389,22 @@ def register_query(root, config=None, registry=None) -> QueryHandle | None:
         f"q{next(_IDS)}", root, node_ids,
         config=config, registry=registry, lineage=lineage,
     )
-    # stamp every operator once: node id for attribution/lineage keying,
-    # tracker for the handoff/emission hooks (base defaults are None, so
-    # un-doctored trees — direct build_physical callers — stay inert).
-    # Stateful operators also bind their state-observatory gauges here —
-    # the node id IS the series label, and it only exists now.  Binds
-    # must land in the query's resolved registry even when a caller
-    # invokes register_query outside the executor's binding context.
+    _stamp_and_bind(root, node_ids, registry, lineage)
+    with _LOCK:
+        _RUNNING[handle.query_id] = handle
+    return handle
+
+
+def _stamp_and_bind(root, node_ids, registry, lineage=None) -> None:
+    """Stamp every operator once: node id for attribution/lineage
+    keying, tracker for the handoff/emission hooks (base defaults are
+    None, so un-doctored trees — direct build_physical callers — stay
+    inert).  Stateful operators also bind their state-observatory
+    gauges here — the node id IS the series label, and it only exists
+    now.  Binds must land in the query's resolved registry even when
+    the caller sits outside the executor's binding context.  Shared by
+    register_query and register_shared so the binding rules cannot
+    diverge between single- and multi-query registration."""
     import contextlib
 
     from denormalized_tpu import obs as _obs
@@ -390,9 +426,44 @@ def register_query(root, config=None, registry=None) -> QueryHandle | None:
                 except Exception:  # dnzlint: allow(broad-except) a test double subclassing ExecOperator with a partial surface must not break query registration — its state gauges simply don't bind
                     pass
             stack.extend(getattr(op, "children", ()))
+
+
+def register_shared(
+    root, count: int, config=None, registry=None, labels=None
+) -> list["QueryHandle"]:
+    """File ``count`` subscriber queries over ONE shared operator tree
+    (the multi-query runtime's registration): each gets its own query
+    id and a ``shared`` descriptor with weight ``1/count``, so
+    ``/queries/<id>/plan`` and ``/queries/<id>/state`` report that
+    query's truthful cost share of the shared nodes.  The tree is
+    stamped and its state gauges bound ONCE (under the first handle) —
+    the registry must not bind duplicate gauge series per subscriber.
+    Returns [] when the doctor is disabled."""
+    if config is not None and not getattr(config, "doctor_enabled", True):
+        return []
+    from denormalized_tpu.state.checkpoint import assign_node_ids
+
+    node_ids = assign_node_ids(root)
+    qids = [f"q{next(_IDS)}" for _ in range(count)]
+    handles = []
+    for i, qid in enumerate(qids):
+        handles.append(
+            QueryHandle(
+                qid, root, node_ids, config=config, registry=registry,
+                shared={
+                    "group_size": count,
+                    "member": i,
+                    "weight": 1.0 / count,
+                    "label": labels[i] if labels else None,
+                    "group": qids,
+                },
+            )
+        )
+    _stamp_and_bind(root, node_ids, registry)
     with _LOCK:
-        _RUNNING[handle.query_id] = handle
-    return handle
+        for h in handles:
+            _RUNNING[h.query_id] = h
+    return handles
 
 
 def get_query(query_id: str) -> QueryHandle | None:

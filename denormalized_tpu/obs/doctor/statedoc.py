@@ -253,6 +253,7 @@ def verdicts(nodes: list[dict], budget=None) -> list[dict]:
 def state_snapshot(handle) -> dict:
     """The full /state payload of one query."""
     nodes = []
+    shared = getattr(handle, "shared", None)
     for op, nid, _parent in handle._walk():
         ns = node_state(op, nid)
         if ns is not None:
@@ -282,11 +283,31 @@ def state_snapshot(handle) -> dict:
             ),
         })
         ranked.sort(key=lambda v: -v["severity"])
+    if shared is not None:
+        # shared-operator attribution (docs/multi_query.md): each node's
+        # DISPLAYED state bytes are this query's 1/N share, with the raw
+        # number kept under "state_bytes_shared_total".  The scaling
+        # happens strictly AFTER the budget math above — verdicts,
+        # forecasts, and time-to-budget consume RAW bytes, because the
+        # budget bounds live memory, which does not shrink by being
+        # shared (scaled inputs would silence budget pressure by a
+        # factor of N exactly in the high-fan-in case)
+        w = float(shared.get("weight", 1.0))
+        for ns in nodes:
+            raw = int(ns.get("state_bytes") or 0)
+            ns["state_bytes_shared_total"] = raw
+            ns["state_bytes"] = int(raw * w)
+            ns["shared"] = {
+                "subscribers": shared.get("group_size"),
+                "fraction": round(w, 6),
+            }
     return {
         "query_id": handle.query_id,
         "state": "running" if handle.running else "finished",
         "t": time.time(),
         "budget_bytes": budget,
+        # raw total: the budget/verdict basis (per-node dicts carry the
+        # per-query share when this handle is a shared subscriber)
         "total_state_bytes": total,
         "nodes": nodes,
         "forecast": qf,

@@ -1,0 +1,667 @@
+"""Slice-folding window operator — one ingest, N concurrent window specs.
+
+``SliceWindowExec`` is the execution half of the multi-query engine
+(docs/multi_query.md): it accumulates per-(group, slide-unit) partials
+ONCE per input batch into a shared :class:`SliceStore` and lets every
+subscribed window spec — tumbling, sliding, and any number of
+concurrently registered queries over the same source+filter+keys — fold
+its windows from those partials.  A sliding window composes ``L/g``
+slice partials by exact addition (the constant-pivot Chan combine; see
+ops/slice_store.py) instead of re-aggregating raw rows per overlap, and
+``N`` shareable queries pay ONE ingest+decode+aggregate pass instead of
+``N``.
+
+Two modes:
+
+- **single-subscriber** (the planner's ``EngineConfig(slice_windows=
+  True)`` fast path): a drop-in for :class:`StreamingWindowExec` on
+  foldable aggregates — emissions flow as plain RecordBatches;
+- **tagged** (the multi-query runtime): emissions are wrapped in
+  :class:`SubscriberBatch` carrying the subscriber index, and the
+  shared drive loop (runtime/multi_query.py) routes each to its query's
+  sink.
+
+Checkpointing takes ONE snapshot per epoch: the slice store's partials,
+the shared interner, the watermark, and every subscriber's emission
+cursor — restore resumes each query exactly where its own emissions
+stopped (per-query cursors, one store).  Semantics (late drop against
+the per-subscriber open floor, per-partition watermark rebase, idle
+hints, EOS flush) mirror StreamingWindowExec so a query moved between
+the operators sees the same windows.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import numpy as np
+
+from denormalized_tpu.common.constants import (
+    CANONICAL_TIMESTAMP_COLUMN,
+    WINDOW_END_COLUMN,
+    WINDOW_START_COLUMN,
+)
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical.expr import VAR_KINDS, AggregateExpr, Expr
+from denormalized_tpu.ops import segment_agg as sa
+from denormalized_tpu.ops.interner import GroupInterner
+from denormalized_tpu.ops.slice_store import SliceStore
+from denormalized_tpu.physical.base import (
+    EOS,
+    EndOfStream,
+    ExecOperator,
+    Marker,
+    StreamItem,
+    WatermarkHint,
+)
+from denormalized_tpu.physical.window_exec import (
+    watermark_floor,
+    window_output_low_watermark,
+)
+
+#: aggregate kinds whose windows fold exactly from slice partials
+FOLDABLE_KINDS = frozenset(
+    ("count", "sum", "min", "max", "avg") + tuple(VAR_KINDS)
+)
+
+
+@dataclass
+class SliceSubscriber:
+    """One window spec folding from the shared slice store."""
+
+    aggr_exprs: list
+    length_ms: int
+    slide_ms: int
+    tag: int = 0
+    label: str | None = None
+    # filled by the operator: per-subscriber agg specs over the SHARED
+    # value-column space, and the output schema
+    agg_specs: list = field(default_factory=list)
+    schema: Schema | None = None
+
+
+class SubscriberBatch:
+    """A tagged emission in multi-subscriber (shared) mode: ``tag`` is
+    the subscriber index, ``batch`` the per-query emission."""
+
+    __slots__ = ("tag", "batch")
+
+    def __init__(self, tag: int, batch: RecordBatch) -> None:
+        self.tag = tag
+        self.batch = batch
+
+
+class SliceWindowExec(ExecOperator):
+    def __init__(
+        self,
+        input_op: ExecOperator,
+        group_exprs: list[Expr],
+        subscribers: list[SliceSubscriber],
+        *,
+        emit_on_close: bool = True,
+        tagged: bool = False,
+        unit_ms: int | None = None,
+        sort_lane: bool = False,
+        name: str = "slice_window",
+    ) -> None:
+        if not subscribers:
+            raise PlanError("SliceWindowExec needs at least one subscriber")
+        self.input_op = input_op
+        self.group_exprs = list(group_exprs)
+        self._subs = list(subscribers)
+        self.emit_on_close = emit_on_close
+        self._tagged = tagged
+        self.name = name
+
+        in_schema = input_op.schema
+        # shared deduped value-column space across ALL subscribers (the
+        # StreamingWindowExec dedup, widened to N aggregate lists)
+        self._value_exprs: list[Expr] = []
+        self._value_transforms: list[str | None] = []
+        self._var_shift: dict[str, float] = {}
+        keys: dict = {}
+
+        def col_idx(e: Expr, transform: str | None) -> int:
+            k = (transform, repr(e))
+            if k not in keys:
+                keys[k] = len(self._value_exprs)
+                self._value_exprs.append(e)
+                self._value_transforms.append(transform)
+            return keys[k]
+
+        unit = 0
+        for sub in self._subs:
+            sub.slide_ms = int(sub.slide_ms) if sub.slide_ms else int(
+                sub.length_ms
+            )
+            sub.length_ms = int(sub.length_ms)
+            if sub.length_ms <= 0 or sub.slide_ms <= 0:
+                raise PlanError(
+                    "window length and slide must be positive for the "
+                    f"slice path (got L={sub.length_ms} S={sub.slide_ms})"
+                )
+            unit = math.gcd(unit, math.gcd(sub.length_ms, sub.slide_ms))
+            specs: list[tuple] = []
+            for a in sub.aggr_exprs:
+                if not isinstance(a, AggregateExpr):
+                    raise PlanError(f"{a!r} is not an aggregate expression")
+                if a.kind not in FOLDABLE_KINDS:
+                    raise PlanError(
+                        f"aggregate kind {a.kind!r} does not fold from "
+                        "slice partials (UDAFs run in UdafWindowExec)"
+                    )
+                if a.arg is None:
+                    specs.append((a.kind, None))
+                elif a.kind in sa.VAR_KINDS:
+                    specs.append(
+                        (
+                            a.kind,
+                            col_idx(a.arg, "shift"),
+                            col_idx(a.arg, "shift_sq"),
+                        )
+                    )
+                else:
+                    specs.append((a.kind, col_idx(a.arg, None)))
+            sub.agg_specs = specs
+            fields = [g.out_field(in_schema) for g in self.group_exprs]
+            fields += [a.out_field(in_schema) for a in sub.aggr_exprs]
+            fields += [
+                Field(
+                    WINDOW_START_COLUMN, DataType.TIMESTAMP_MS, nullable=False
+                ),
+                Field(
+                    WINDOW_END_COLUMN, DataType.TIMESTAMP_MS, nullable=False
+                ),
+                Field(
+                    CANONICAL_TIMESTAMP_COLUMN,
+                    DataType.TIMESTAMP_MS,
+                    nullable=False,
+                ),
+            ]
+            sub.schema = Schema(fields)
+        if unit_ms is not None:
+            # explicit slice-width pin: the fold grouping is part of a
+            # query's numeric contract (f64 sums round per fold tree),
+            # so an independent oracle comparing against a shared run
+            # pins the shared group's unit here.  Any divisor of the
+            # natural gcd is valid — slices still tile every window.
+            if unit_ms <= 0 or unit % int(unit_ms):
+                raise PlanError(
+                    f"slice_unit_ms={unit_ms} must divide every "
+                    f"subscriber's window length and slide (gcd {unit}ms)"
+                )
+            unit = int(unit_ms)
+        self.unit_ms = unit
+        all_specs = [s for sub in self._subs for s in sub.agg_specs]
+        self._components = tuple(sa.components_for(all_specs))
+        self._store = SliceStore(
+            self._components, self.unit_ms, force_sort_lane=sort_lane
+        )
+
+        self._grouped = len(self.group_exprs) > 0
+        self._interner = (
+            GroupInterner(len(self.group_exprs)) if self._grouped else None
+        )
+        # single-subscriber mode exposes that subscriber's schema (the
+        # planner drop-in contract); tagged mode has no single schema —
+        # downstream is the multi-query drive loop, not an operator
+        self.schema = self._subs[0].schema
+
+        # streaming state
+        self._ckpt: tuple | None = None
+        self._next_win: list[int | None] = [None] * len(self._subs)
+        self._watermark_ms: int | None = None
+        self._src_watermarks = False
+        self._max_ts: int | None = None
+        self._metrics = {
+            "rows_in": 0,
+            "batches_in": 0,
+            "late_rows": 0,
+            "windows_emitted": 0,
+            "slice_folds": 0,
+            "slices_live": 0,
+            "slices_pruned": 0,
+            "subscribers": len(self._subs),
+        }
+
+        from denormalized_tpu import obs
+        from denormalized_tpu.obs import statewatch
+
+        self.bind_obs("slice_window")
+        self._sw = statewatch.make_watch("slice_window")
+        self._obs_late = obs.counter("dnz_late_rows_total", op="slice_window")
+        self._obs_windows = obs.counter(
+            "dnz_windows_emitted_total", op="slice_window"
+        )
+        self._obs_emit_lag = obs.histogram(
+            "dnz_emit_event_lag_ms", op="slice_window"
+        )
+        self._obs_wm_lag = obs.gauge("dnz_watermark_lag_ms", op="slice_window")
+        self._obs_wm_lag_hist = obs.histogram(
+            "dnz_watermark_lag_hist_ms", op="slice_window"
+        )
+        self._obs_slice_rows = obs.counter("dnz_slice_rows_total")
+        self._obs_slice_units = obs.gauge("dnz_slice_units")
+        self._obs_slice_subs = obs.gauge("dnz_slice_subscribers")
+        self._obs_folds = obs.counter("dnz_slice_folds_total")
+        self._obs_fold_ms = obs.histogram("dnz_slice_fold_ms")
+        self._obs_slice_subs.set(len(self._subs))
+
+    # ------------------------------------------------------------------
+    @property
+    def children(self):
+        return [self.input_op]
+
+    def metrics(self):
+        m = dict(self._metrics)
+        m["slices_live"] = len(self._store)
+        return m
+
+    def _label(self):
+        specs = ", ".join(
+            f"{s.length_ms}ms/{s.slide_ms}ms" for s in self._subs[:4]
+        )
+        if len(self._subs) > 4:
+            specs += f", … ({len(self._subs)} total)"
+        return (
+            f"SliceWindowExec(unit={self.unit_ms}ms, windows=[{specs}], "
+            f"groups=[{', '.join(g.name for g in self.group_exprs)}])"
+        )
+
+    # -- state observatory (obs/statewatch.py) ---------------------------
+    def state_info(self) -> dict:
+        from denormalized_tpu.obs import statewatch as swm
+
+        live_keys = len(self._interner) if self._interner is not None else (
+            1 if self._max_ts is not None else 0
+        )
+        store_bytes = self._store.nbytes()
+        units = self._store.live_units()
+        oldest = units[0] * self.unit_ms if units else None
+        wm = self._watermark_ms
+        info = {
+            "op": "slice_window",
+            "state_bytes": store_bytes + live_keys * swm.KEY_EST_BYTES,
+            "slice_store_bytes": store_bytes,
+            "live_keys": live_keys,
+            "slot_capacity": int(self._store.capacity),
+            "slot_live": live_keys,
+            "slices_live": len(self._store),
+            "subscribers": len(self._subs),
+            "retention_unit_ms": max(s.length_ms for s in self._subs),
+            "oldest_event_ms": oldest,
+            "watermark_ms": wm,
+        }
+        if wm is not None and oldest is not None:
+            info["oldest_event_lag_ms"] = max(0, int(wm) - int(oldest))
+        return info
+
+    def _state_watch_views(self):
+        if not self._sw:
+            return []
+        if self._interner is None:
+            return [(None, self._sw, None)]
+        from denormalized_tpu.ops.interner import display_keys
+
+        return [
+            (None, self._sw, lambda g: display_keys(self._interner, g))
+        ]
+
+    # -- cursor / retention arithmetic -----------------------------------
+    def _anchor(self, q: int, ts_min: int) -> int:
+        """First window of subscriber ``q`` overlapping ``ts_min``."""
+        sub = self._subs[q]
+        return (ts_min - sub.length_ms) // sub.slide_ms + 1
+
+    def _wm_floor(self, q: int) -> int | None:
+        if self._watermark_ms is None:
+            return None
+        sub = self._subs[q]
+        return int(
+            watermark_floor(self._watermark_ms, sub.length_ms, sub.slide_ms)
+        )
+
+    def _floor_unit(self) -> int | None:
+        """Lowest slice unit any subscriber's open (or rebased-open)
+        window may still fold — rows below it are late for EVERY
+        subscriber and slices below it are prunable.  Under per-
+        partition watermarks a slower partition may rebase a cursor
+        back down to the watermark floor, so the floor accounts for
+        that exactly like StreamingWindowExec's rebase rule."""
+        lows = []
+        for q, sub in enumerate(self._subs):
+            nw = self._next_win[q]
+            if nw is None:
+                return None
+            low_j = nw
+            if self._src_watermarks:
+                f = self._wm_floor(q)
+                if f is not None:
+                    low_j = min(low_j, f)
+            lows.append(low_j * sub.slide_ms // self.unit_ms)
+        return min(lows)
+
+    # -- per-batch processing --------------------------------------------
+    def _eval_values(
+        self, batch: RecordBatch, n: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        from denormalized_tpu.logical.expr import column_validity
+
+        V = max(len(self._value_exprs), 1)
+        values64 = np.zeros((n, V), dtype=np.float64)
+        colvalid = np.ones((n, V), dtype=bool)
+        for j, e in enumerate(self._value_exprs):
+            raw = np.asarray(e.eval(batch), dtype=np.float64)
+            m = column_validity(e, batch)
+            if m is not None:
+                colvalid[:, j] = m
+            tr = self._value_transforms[j]
+            if tr is not None:
+                # variance pivot shift: identical rule to
+                # StreamingWindowExec — the first finite valid value ever
+                # seen for this expression pins K, so shared and
+                # independent runs over the same feed shift identically
+                key = repr(e)
+                K = self._var_shift.get(key)
+                if K is None:
+                    valid_vals = raw[colvalid[:, j]] if m is not None else raw
+                    finite = valid_vals[np.isfinite(valid_vals)]
+                    if len(finite):
+                        K = float(finite[0])
+                        self._var_shift[key] = K
+                    else:
+                        K = 0.0
+                raw = raw - K
+                if tr == "shift_sq":
+                    raw = raw * raw
+            values64[:, j] = raw
+        return values64, colvalid
+
+    def _process_batch(self, batch: RecordBatch) -> Iterator:
+        n = batch.num_rows
+        if n == 0:
+            return
+        self._metrics["rows_in"] += n
+        self._metrics["batches_in"] += 1
+        self._obs_rows_in.add(n)
+        ts = np.asarray(
+            batch.column(CANONICAL_TIMESTAMP_COLUMN), dtype=np.int64
+        )
+        units = ts // self.unit_ms
+        ts_min = int(ts.min())
+        ts_max = int(ts.max())
+        self._max_ts = ts_max if self._max_ts is None else max(
+            self._max_ts, ts_max
+        )
+        for q in range(len(self._subs)):
+            if self._next_win[q] is None:
+                self._next_win[q] = self._anchor(q, ts_min)
+            elif self._src_watermarks:
+                # per-partition watermarks: a slower partition's earlier
+                # windows stay legitimate until the min-driven watermark
+                # closes them — rebase the cursor down to the watermark
+                # floor (never below it: those windows genuinely emitted)
+                anchor = self._anchor(q, ts_min)
+                if anchor < self._next_win[q]:
+                    f = self._wm_floor(q)
+                    new = anchor if f is None else max(anchor, f)
+                    if new < self._next_win[q]:
+                        self._next_win[q] = new
+        # group ids for every row (keys intern regardless of lateness,
+        # matching StreamingWindowExec)
+        if self._grouped:
+            key_cols = [g.eval(batch) for g in self.group_exprs]
+            gid = self._interner.intern(key_cols)
+            ngroups = len(self._interner)
+        else:
+            gid = np.zeros(n, dtype=np.int32)
+            ngroups = 1
+        self._sw.update(gid)
+        values64, colvalid = self._eval_values(batch, n)
+
+        floor = self._floor_unit()
+        if floor is not None:
+            keep = units >= floor
+            n_late = int((~keep).sum())
+            if n_late:
+                self._metrics["late_rows"] += n_late
+                self._obs_late.add(n_late)
+                units = units[keep]
+                gid = gid[keep]
+                values64 = values64[keep]
+                colvalid = colvalid[keep]
+        if len(units):
+            self._store.accumulate(units, gid, values64, colvalid, ngroups)
+            self._obs_slice_rows.add(len(units))
+
+        if not self._src_watermarks:
+            if self._watermark_ms is None or ts_min > self._watermark_ms:
+                self._watermark_ms = ts_min
+        yield from self._trigger()
+
+    # -- emission --------------------------------------------------------
+    def _trigger(self) -> Iterator:
+        if self._obs_wm_lag and self._watermark_ms is not None:
+            lag = time.time() * 1000.0 - self._watermark_ms
+            self._obs_wm_lag.set(lag)
+            self._obs_wm_lag_hist.observe(lag)
+        if self._watermark_ms is None:
+            return
+        for q, sub in enumerate(self._subs):
+            nw = self._next_win[q]
+            if nw is None:
+                continue
+            wm_win = self._wm_floor(q)
+            while nw < wm_win:
+                b = self._emit_window(q, nw)
+                nw += 1
+                if b is not None:
+                    yield b
+            self._next_win[q] = nw
+        floor = self._floor_unit()
+        if floor is not None:
+            self._metrics["slices_pruned"] += self._store.prune(floor)
+        # gauge AFTER the prune: the exported number is the retained
+        # slice count the catalog text promises, not the pre-prune peak
+        self._obs_slice_units.set(len(self._store))
+
+    def _emit_window(self, q: int, j: int):
+        sub = self._subs[q]
+        t0 = time.perf_counter()
+        u0 = j * sub.slide_ms // self.unit_ms
+        u1 = (j * sub.slide_ms + sub.length_ms) // self.unit_ms
+        rows = self._store.fold(u0, u1)
+        self._metrics["slice_folds"] += 1
+        self._obs_folds.add(1)
+        if rows is None:
+            return None
+        ngroups = len(self._interner) if self._grouped else 1
+        counts = rows[sa.ROW_COUNT.label]
+        active = counts > 0
+        active[ngroups:] = False
+        if not active.any():
+            return None
+        gids = np.nonzero(active)[0].astype(np.int32)
+        finals = sa.finalize(sub.agg_specs, rows, active)
+        batch = self._assemble_emission(sub, j, gids, finals)
+        self._obs_fold_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._metrics["windows_emitted"] += 1
+        if self._tagged:
+            return SubscriberBatch(sub.tag, batch)
+        return batch
+
+    def _assemble_emission(
+        self, sub: SliceSubscriber, j: int, gids: np.ndarray, finals: list
+    ) -> RecordBatch:
+        in_schema = self.input_op.schema
+        cols: list[np.ndarray] = []
+        if self._grouped:
+            key_vals = self._interner.keys_of(gids)
+            for g, kv in zip(self.group_exprs, key_vals):
+                f = g.out_field(in_schema)
+                if f.dtype.is_numeric:
+                    kv = np.asarray(kv.tolist(), dtype=f.dtype.to_numpy())
+                cols.append(kv)
+        for a, arr in zip(sub.aggr_exprs, finals):
+            f = a.out_field(in_schema)
+            cols.append(np.asarray(arr).astype(f.dtype.to_numpy()))
+        m = len(gids)
+        start = np.full(m, j * sub.slide_ms, dtype=np.int64)
+        end = np.full(
+            m, j * sub.slide_ms + sub.length_ms, dtype=np.int64
+        )
+        cols += [start, end, start.copy()]
+        self._obs_windows.add(1)
+        if self._obs_emit_lag:
+            self._obs_emit_lag.observe(
+                time.time() * 1000.0 - (j * sub.slide_ms + sub.length_ms)
+            )
+        if self._dr_lineage is not None:
+            self._dr_lineage.emitted(
+                self._dr_node_id,
+                j * sub.slide_ms,
+                j * sub.slide_ms + sub.length_ms,
+            )
+        return RecordBatch(sub.schema, cols)
+
+    def _output_low_watermark(self, hint_ts: int) -> int:
+        lows = []
+        for q, sub in enumerate(self._subs):
+            lows.append(
+                window_output_low_watermark(
+                    self._next_win[q],
+                    sub.slide_ms,
+                    sub.length_ms,
+                    hint_ts,
+                    wm_ms=self._watermark_ms if self._src_watermarks else None,
+                )
+            )
+        return min(lows)
+
+    # -- checkpointing ----------------------------------------------------
+    def enable_checkpointing(self, node_id: str, coord, orch) -> None:
+        self._ckpt = (coord, f"slice_{node_id}")
+        self._restore()
+
+    def _snapshot(self, epoch: int) -> None:
+        from denormalized_tpu.state.serialization import pack_snapshot
+
+        coord, key = self._ckpt
+        ngroups = len(self._interner) if self._grouped else 1
+        meta = {
+            "epoch": epoch,
+            "unit_ms": self.unit_ms,
+            "next_win": list(self._next_win),
+            "watermark_ms": self._watermark_ms,
+            "src_watermarks": self._src_watermarks,
+            "max_ts": self._max_ts,
+            "var_shift": dict(self._var_shift),
+            "ngroups": ngroups,
+            "interner": self._interner.snapshot() if self._grouped else None,
+        }
+        coord.put_snapshot(
+            key, epoch,
+            pack_snapshot(meta, self._store.snapshot_arrays(ngroups)),
+        )
+
+    def _restore(self) -> None:
+        from denormalized_tpu.state.serialization import unpack_snapshot
+
+        coord, key = self._ckpt
+        blob = coord.get_snapshot(key)
+        if blob is None:
+            return
+        meta, arrays = unpack_snapshot(blob)
+        if int(meta["unit_ms"]) != self.unit_ms:
+            from denormalized_tpu.common.errors import StateError
+
+            raise StateError(
+                f"slice snapshot unit {meta['unit_ms']}ms does not match "
+                f"the plan's {self.unit_ms}ms — the subscriber set changed "
+                "incompatibly since the checkpoint"
+            )
+        self._next_win = [
+            None if v is None else int(v) for v in meta["next_win"]
+        ]
+        if len(self._next_win) != len(self._subs):
+            from denormalized_tpu.common.errors import StateError
+
+            raise StateError(
+                f"slice snapshot carries {len(self._next_win)} emission "
+                f"cursors but the plan subscribes {len(self._subs)} queries"
+            )
+        self._watermark_ms = meta["watermark_ms"]
+        self._src_watermarks = bool(meta.get("src_watermarks"))
+        self._max_ts = meta["max_ts"]
+        self._var_shift = dict(meta.get("var_shift") or {})
+        if self._grouped and meta["interner"] is not None:
+            self._interner = GroupInterner.restore(meta["interner"])
+        self._store.restore_arrays(arrays, int(meta.get("ngroups") or 1))
+
+    # -- stream loop -----------------------------------------------------
+    def run(self) -> Iterator[StreamItem]:
+        from denormalized_tpu.runtime.tracing import span
+
+        for item in self._doctor_input():
+            if isinstance(item, RecordBatch):
+                t0 = time.perf_counter()
+                with span(
+                    "slice_window.process_batch",
+                    op=self.name,
+                    rows=item.num_rows,
+                ):
+                    out = list(self._process_batch(item))
+                self._note_batch(t0, item.num_rows)
+                yield from out
+            elif isinstance(item, WatermarkHint):
+                if item.kind == "partition":
+                    self._src_watermarks = True
+                    if item.is_announcement:
+                        yield item
+                        continue
+                    if (
+                        self._watermark_ms is None
+                        or item.ts_ms > self._watermark_ms
+                    ):
+                        self._watermark_ms = item.ts_ms
+                        yield from self._trigger()
+                    yield WatermarkHint(
+                        min(
+                            item.ts_ms,
+                            self._output_low_watermark(item.ts_ms),
+                        ),
+                        kind="partition",
+                    )
+                    continue
+                if (
+                    self._watermark_ms is None
+                    or item.ts_ms > self._watermark_ms
+                ):
+                    self._watermark_ms = item.ts_ms
+                    yield from self._trigger()
+                yield WatermarkHint(
+                    min(item.ts_ms, self._output_low_watermark(item.ts_ms))
+                )
+            elif isinstance(item, Marker):
+                if self._ckpt is not None:
+                    self._snapshot(item.epoch)
+                yield item
+            elif isinstance(item, EndOfStream):
+                if self.emit_on_close and self._max_ts is not None:
+                    for q, sub in enumerate(self._subs):
+                        nw = self._next_win[q]
+                        if nw is None:
+                            continue
+                        while nw * sub.slide_ms <= self._max_ts:
+                            b = self._emit_window(q, nw)
+                            nw += 1
+                            if b is not None:
+                                yield b
+                        self._next_win[q] = nw
+                yield EOS
+                return

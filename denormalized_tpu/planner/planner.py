@@ -156,6 +156,37 @@ class Planner:
                     node.slide_ms,
                     emit_on_close=kwargs.get("emit_on_close", True),
                 )
+            if (
+                self.config is not None
+                and getattr(self.config, "slice_windows", False)
+                and not self.config.mesh_devices
+            ):
+                # slice-fold fast path (docs/multi_query.md): every
+                # builtin aggregate folds from slice partials, so a
+                # sliding window pays O(1) per row + O(L/slide) per
+                # emitted window instead of the k-way scatter fan-out.
+                # Host kernel — a device mesh keeps the ring operator.
+                from denormalized_tpu.physical.slice_exec import (
+                    SliceSubscriber,
+                    SliceWindowExec,
+                )
+
+                return SliceWindowExec(
+                    child,
+                    node.group_exprs,
+                    [
+                        SliceSubscriber(
+                            node.aggr_exprs,
+                            node.length_ms,
+                            node.slide_ms or node.length_ms,
+                        )
+                    ],
+                    emit_on_close=kwargs.get("emit_on_close", True),
+                    unit_ms=getattr(self.config, "slice_unit_ms", None),
+                    sort_lane=getattr(
+                        self.config, "slice_sort_lane", False
+                    ),
+                )
             return StreamingWindowExec(
                 child,
                 node.group_exprs,

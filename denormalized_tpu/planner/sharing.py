@@ -1,0 +1,147 @@
+"""Cross-query sharing pass: which concurrently registered window
+queries can fold from ONE shared slice store.
+
+The Factor-Windows rewrite rules (PAPERS.md), applied conservatively:
+a set of queries shares one ingest + slice store iff
+
+1. they read the SAME upstream subtree — same source object, same
+   filter predicates, same projections (structural signature, source
+   compared by identity: two scans of one registered Source are one
+   feed, two different Source objects are two feeds even if their
+   contents agree);
+2. they group by the SAME key expressions (the slice store is keyed by
+   the shared interner's dense gids);
+3. every aggregate folds from slice partials (builtin count / sum /
+   min / max / avg / variance family — UDAFs hold opaque per-window
+   accumulator state and cannot fold);
+4. the common slice width ``g = gcd over members of (length, slide)``
+   keeps every member's fold fan-in ``length/g`` under a cost bound —
+   the cost-based half of the rewrite: two queries at 60s/7ms and
+   60s/1000ms would share a 1ms slice and pay a 60000-way fold per
+   window, slower than running them independently.
+
+Queries that fail any rule fall back to independent plans (the
+negative-path contract tests pin this).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+
+from denormalized_tpu.logical import plan as lp
+from denormalized_tpu.physical.slice_exec import FOLDABLE_KINDS
+
+#: cost guard: maximum slice partials one window fold may combine.
+#: Past this, the fold itself dominates and independent plans win.
+MAX_SLICES_PER_WINDOW = 4096
+
+
+_OPAQUE = itertools.count()
+
+
+def input_signature(node: lp.LogicalPlan) -> str:
+    """Structural signature of a window's upstream subtree.  Scans key
+    on SOURCE IDENTITY; filters/projections on expression reprs; any
+    other shape (joins, nested windows) is opaque — NEVER shared, so
+    the opaque token is unique per call (two windows over the same
+    join node must not silently share an unreviewed pipeline; sharing
+    joins' windowed inputs is ROADMAP item-2 residue)."""
+    if isinstance(node, lp.Scan):
+        return f"scan#{id(node.source)}"
+    if isinstance(node, lp.Filter):
+        return f"filter[{node.predicate!r}]({input_signature(node.input)})"
+    if isinstance(node, lp.Project):
+        exprs = ",".join(repr(e) for e in node.exprs)
+        return f"project[{exprs}]({input_signature(node.input)})"
+    return f"opaque#{next(_OPAQUE)}"
+
+
+def classify(plan: lp.LogicalPlan):
+    """→ ``(share_key, window_node)`` when ``plan`` is a shareable
+    window query, else ``(None, reason)``."""
+    if not isinstance(plan, lp.StreamingWindow):
+        return None, f"top node is {type(plan).__name__}, not a window"
+    if plan.window_type is lp.WindowType.SESSION:
+        return None, "session windows hold per-key gap state (no slices)"
+    bad = [a.kind for a in plan.aggr_exprs if a.kind not in FOLDABLE_KINDS]
+    if bad:
+        return None, f"aggregate kind(s) {bad} do not fold from slices"
+    group_sig = tuple(repr(g) for g in plan.group_exprs)
+    return (input_signature(plan.input), group_sig), plan
+
+
+@dataclass
+class ShareGroup:
+    """One planning decision: either a shared slice plan over
+    ``members`` (≥ 2 queries, ``shared=True``) or an independent
+    fallback (singleton, or a documented rejection ``reason``)."""
+
+    members: list[int]
+    shared: bool
+    windows: list = field(default_factory=list)
+    input_plan: lp.LogicalPlan | None = None
+    unit_ms: int | None = None
+    reason: str | None = None
+
+
+def detect_sharing(
+    plans: list[lp.LogicalPlan],
+    max_slices_per_window: int = MAX_SLICES_PER_WINDOW,
+) -> list[ShareGroup]:
+    """Partition query plans into shared groups + independent
+    fallbacks.  Order inside a group follows registration order, and
+    every input index appears in exactly one group."""
+    buckets: dict = {}
+    singles: list[ShareGroup] = []
+    for i, plan in enumerate(plans):
+        key, node_or_reason = classify(plan)
+        if key is None:
+            singles.append(
+                ShareGroup([i], shared=False, reason=node_or_reason)
+            )
+            continue
+        buckets.setdefault(key, []).append((i, node_or_reason))
+    groups: list[ShareGroup] = []
+    for key, members in buckets.items():
+        if len(members) == 1:
+            i, _w = members[0]
+            groups.append(
+                ShareGroup([i], shared=False, reason="no co-registered "
+                           "query shares this source+filter+keys")
+            )
+            continue
+        g = 0
+        for _i, w in members:
+            slide = int(w.slide_ms) if w.slide_ms else int(w.length_ms)
+            g = math.gcd(g, math.gcd(int(w.length_ms), slide))
+        worst = max(int(w.length_ms) // g for _i, w in members)
+        if worst > max_slices_per_window:
+            # cost-based rejection: the gcd slice is so fine that folds
+            # dominate — run the members independently
+            for i, _w in members:
+                groups.append(
+                    ShareGroup(
+                        [i], shared=False,
+                        reason=(
+                            f"gcd slice {g}ms gives a {worst}-way fold "
+                            f"(> {max_slices_per_window}) — independent "
+                            "plans are cheaper"
+                        ),
+                    )
+                )
+            continue
+        groups.append(
+            ShareGroup(
+                [i for i, _w in members],
+                shared=True,
+                windows=[w for _i, w in members],
+                input_plan=members[0][1].input,
+                unit_ms=g,
+            )
+        )
+    # deterministic output order: by first member index
+    out = groups + singles
+    out.sort(key=lambda grp: grp.members[0])
+    return out

@@ -1,0 +1,181 @@
+"""Multi-query runtime: N concurrently registered queries, one ingest.
+
+Production traffic is many concurrent windowed queries over the same
+topics (per-user dashboards, alerting rules) — not one pipeline.  This
+runtime takes a batch of registered queries, runs the sharing pass
+(planner/sharing.py), and executes each share group through ONE
+physical pipeline: one SourceExec (one fetch+decode pass), one shared
+interner, one :class:`SliceWindowExec` with a
+:class:`~denormalized_tpu.physical.slice_exec.SliceSubscriber` per
+query — emissions fan out to per-query sinks by subscriber tag.
+Unshareable queries (UDAFs, sessions, different filters, cost-rejected
+slide sets) fall back to the normal single-query executor, unchanged.
+
+Checkpointing rides the existing epoch-consistent protocol: the shared
+group takes ONE snapshot per epoch (slice partials + interner + every
+subscriber's emission cursor) under the same in-band marker alignment
+and coordinator commit the single-query executor uses; restore resumes
+every subscriber exactly at its own cursor.
+
+The pipeline doctor files one :class:`QueryHandle` per subscriber
+query (``doctor.register_shared``): shared nodes report busy time and
+state bytes SCALED by 1/N per handle, so ``/queries/<id>/plan`` and
+``/queries/<id>/state`` stay truthful per query instead of charging
+the whole shared operator to whichever query registered first.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.physical.base import EndOfStream, ExecOperator, Marker
+from denormalized_tpu.physical.slice_exec import (
+    SliceSubscriber,
+    SliceWindowExec,
+    SubscriberBatch,
+)
+from denormalized_tpu.planner.sharing import ShareGroup, detect_sharing
+
+
+def build_shared_root(
+    ctx, group: ShareGroup, labels: list[str] | None = None
+) -> ExecOperator:
+    """Build the shared physical pipeline for one share group: the
+    common input subtree planned once, topped by a tagged
+    SliceWindowExec with one subscriber per member query.  Must run
+    under the query's bound obs registry (the caller's job — see
+    run_queries)."""
+    from denormalized_tpu.planner.planner import Planner
+
+    child = Planner(ctx.config).create_physical_plan(group.input_plan)
+    subs = [
+        SliceSubscriber(
+            w.aggr_exprs,
+            w.length_ms,
+            w.slide_ms or w.length_ms,
+            tag=k,
+            label=labels[k] if labels else None,
+        )
+        for k, w in enumerate(group.windows)
+    ]
+    return SliceWindowExec(
+        child,
+        group.windows[0].group_exprs,
+        subs,
+        tagged=True,
+        emit_on_close=getattr(ctx.config, "emit_on_close", True),
+        unit_ms=getattr(ctx.config, "slice_unit_ms", None),
+        sort_lane=getattr(ctx.config, "slice_sort_lane", False),
+    )
+
+
+def drive_shared(
+    root: ExecOperator,
+    sinks: list[Callable[[RecordBatch], None]],
+    coord=None,
+) -> None:
+    """Pump one shared pipeline to completion, routing each tagged
+    emission to its subscriber's sink and committing drained epochs —
+    the share-group analog of the executor's drive loop."""
+    for item in root.run():
+        if isinstance(item, SubscriberBatch):
+            sinks[item.tag](item.batch)
+        elif isinstance(item, Marker) and coord is not None:
+            coord.commit(item.epoch)
+        elif isinstance(item, EndOfStream):
+            break
+
+
+def run_queries(
+    ctx,
+    queries,
+    *,
+    sharing: bool = True,
+    checkpoint: bool | None = None,
+) -> dict:
+    """Execute a batch of concurrently registered queries.
+
+    ``queries`` is a list of ``(DataStream, sink_fn)`` pairs; each
+    sink_fn receives that query's emitted RecordBatches in order.
+    Returns a planning/execution report::
+
+        {"queries": N,
+         "groups": [{"members": [...], "shared": bool,
+                     "unit_ms": g | None, "reason": str | None,
+                     "query_ids": [doctor ids] | None}, ...],
+         "shared_queries": n, "independent_queries": m}
+
+    With ``sharing=False`` every query runs through the normal
+    single-query executor (the A/B baseline).
+
+    Execution contract: groups run SEQUENTIALLY in first-member order,
+    each drained to EndOfStream before the next starts — so this entry
+    point serves bounded (replay/batch) feeds.  With an unbounded
+    source, the first group never ends and later groups never run:
+    drive each group on its own thread/process instead (one
+    build_shared_root + drive_shared per group), the same rule as any
+    two concurrent queries today."""
+    from denormalized_tpu import obs
+    from denormalized_tpu.obs import doctor
+    from denormalized_tpu.physical.simple_execs import CallbackSink
+    from denormalized_tpu.runtime import executor
+
+    plans = [ds._plan for ds, _sink in queries]
+    if sharing:
+        groups = detect_sharing(plans)
+    else:
+        groups = [
+            ShareGroup([i], shared=False, reason="sharing disabled")
+            for i in range(len(queries))
+        ]
+    report = {
+        "queries": len(queries),
+        "groups": [],
+        "shared_queries": 0,
+        "independent_queries": 0,
+    }
+    for group in groups:
+        entry = {
+            "members": list(group.members),
+            "shared": group.shared,
+            "unit_ms": group.unit_ms,
+            "reason": group.reason,
+            "query_ids": None,
+        }
+        if not group.shared:
+            report["independent_queries"] += len(group.members)
+            for i in group.members:
+                ds, sink = queries[i]
+                ds._execute(CallbackSink(sink), checkpoint=checkpoint)
+            report["groups"].append(entry)
+            continue
+        report["shared_queries"] += len(group.members)
+        sinks = [queries[i][1] for i in group.members]
+        labels = [f"member{i}" for i in group.members]
+        reg = executor._resolve_registry(ctx)
+        orch = coord = exporters = None
+        handles: list = []
+        with obs.bound_registry(reg):
+            root = build_shared_root(ctx, group, labels)
+            try:
+                orch, coord = executor._attach_checkpointing(
+                    root, ctx, checkpoint
+                )
+                ctx._last_coord = coord
+                exporters = obs.start_exporters(ctx.config, registry=reg)
+                handles = doctor.register_shared(
+                    root, len(group.members),
+                    config=ctx.config, registry=reg, labels=labels,
+                )
+                entry["query_ids"] = [h.query_id for h in handles]
+                drive_shared(root, sinks, coord)
+            finally:
+                if orch is not None:
+                    orch.stop()
+                for h in handles:
+                    h.finish()
+                if exporters is not None:
+                    exporters.stop()
+        report["groups"].append(entry)
+    return report

@@ -1,0 +1,476 @@
+"""Multi-query engine: shared slice store + correlated-window sharing.
+
+Covers the sharing planner pass (positive grouping, every documented
+fallback), byte-identical per-query emissions shared-vs-independent,
+the single-query sliding fast path differential against the production
+ring operator, and the doctor's shared-cost attribution split."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.planner.sharing import detect_sharing
+from denormalized_tpu.runtime.multi_query import run_queries
+from denormalized_tpu.sources.memory import MemorySource
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+
+
+def _batches(seed=3, n_batches=20, rows=400, n_keys=6, null_frac=0.0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 1000, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.normal(10.0, 3.0, rows)
+        if null_frac:
+            vs = vs.astype(object)
+            vs[rng.random(rows) < null_frac] = None
+            vs = np.asarray(vs, object)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+AGGS = [
+    F.count(col("v")).alias("c"),
+    F.sum(col("v")).alias("s"),
+    F.min(col("v")).alias("mn"),
+    F.max(col("v")).alias("mx"),
+    F.avg(col("v")).alias("av"),
+    F.stddev(col("v")).alias("sd"),
+]
+AGG_COLS = ("c", "s", "mn", "mx", "av", "sd")
+
+
+def _rows_of(batch, acc, cols=AGG_COLS):
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i] if "k" in batch.schema.names else None,
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        acc[key] = tuple(float(batch.column(c)[i]) for c in cols)
+
+
+def _run_single(batches, L, S, cfg=None, aggs=AGGS, cols=AGG_COLS):
+    ctx = Context(cfg or EngineConfig())
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    ).window(["k"], aggs, L, S)
+    out = {}
+    for b in ds.stream():
+        _rows_of(b, out, cols)
+    return out
+
+
+def _assert_rows_close(a, b, rel=1e-5):
+    assert set(a) == set(b), {
+        "missing": sorted(set(a) - set(b))[:4],
+        "extra": sorted(set(b) - set(a))[:4],
+    }
+    for k in a:
+        for x, y in zip(a[k], b[k]):
+            if np.isnan(x) and np.isnan(y):
+                continue
+            assert x == pytest.approx(y, rel=rel, abs=1e-9), (k, a[k], b[k])
+
+
+# -- single-query fast path (the tentpole's kernel, no sharing) ----------
+
+
+def test_sliding_fast_path_matches_ring_operator():
+    batches = _batches()
+    ring = _run_single(batches, 3000, 1000)
+    sliced = _run_single(
+        batches, 3000, 1000, EngineConfig(slice_windows=True)
+    )
+    # counts are exact; floats differ only by f32-ring vs f64-fold
+    _assert_rows_close(ring, sliced)
+    for k in ring:
+        assert ring[k][0] == sliced[k][0]  # count
+
+
+def test_tumbling_fast_path_matches_ring_operator():
+    batches = _batches(seed=11)
+    ring = _run_single(batches, 2000, None)
+    sliced = _run_single(
+        batches, 2000, None, EngineConfig(slice_windows=True)
+    )
+    _assert_rows_close(ring, sliced)
+
+
+def test_fast_path_with_nulls_matches_ring_operator():
+    batches = _batches(seed=5, null_frac=0.2)
+    ring = _run_single(batches, 3000, 1000)
+    sliced = _run_single(
+        batches, 3000, 1000, EngineConfig(slice_windows=True)
+    )
+    _assert_rows_close(ring, sliced)
+
+
+def test_fast_path_is_deterministic_bit_exact():
+    batches = _batches(seed=13)
+    cfg = EngineConfig(slice_windows=True)
+    a = _run_single(batches, 3000, 1000, cfg)
+    b = _run_single(batches, 3000, 1000, EngineConfig(slice_windows=True))
+    assert a == b  # exact float equality, the slice-path contract
+
+
+# -- sharing detection (planner/sharing.py) ------------------------------
+
+
+def _ctx_and_base(batches):
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    return ctx, base
+
+
+def test_detect_groups_same_source_filter_keys():
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    flt = base.filter(col("v") > 0)
+    plans = [
+        flt.window(["k"], AGGS, 3000, 1000)._plan,
+        flt.window(["k"], AGGS, 5000, 1000)._plan,
+        flt.window(["k"], AGGS, 2000, 2000)._plan,
+    ]
+    groups = detect_sharing(plans)
+    assert len(groups) == 1
+    assert groups[0].shared and groups[0].members == [0, 1, 2]
+    assert groups[0].unit_ms == 1000
+
+
+def test_different_filter_does_not_share():
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    plans = [
+        base.filter(col("v") > 0).window(["k"], AGGS, 3000, 1000)._plan,
+        base.filter(col("v") > 1).window(["k"], AGGS, 3000, 1000)._plan,
+    ]
+    groups = detect_sharing(plans)
+    assert all(not g.shared for g in groups)
+    assert len(groups) == 2
+
+
+def test_different_group_keys_do_not_share():
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    plans = [
+        base.window(["k"], AGGS, 3000, 1000)._plan,
+        base.window([], AGGS, 3000, 1000)._plan,
+    ]
+    assert all(not g.shared for g in detect_sharing(plans))
+
+
+def test_udaf_and_session_fall_back():
+    class Last:
+        def __init__(self):
+            self.v = None
+
+        def update(self, values):
+            if len(values):
+                self.v = float(values[-1])
+
+        def merge(self, states):
+            pass
+
+        def state(self):
+            return [self.v]
+
+        def evaluate(self):
+            return self.v
+
+    last = F.udaf(Last, DataType.FLOAT64, "last")
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    plans = [
+        base.window(["k"], AGGS, 3000, 1000)._plan,
+        base.window(["k"], [last(col("v")).alias("l")], 3000, 1000)._plan,
+        base.session_window(["k"], AGGS[:1], 500)._plan,
+    ]
+    groups = detect_sharing(plans)
+    by_member = {g.members[0]: g for g in groups}
+    assert not by_member[1].shared and "udaf" in by_member[1].reason
+    assert not by_member[2].shared and "session" in by_member[2].reason
+
+
+def test_windows_over_same_join_never_share():
+    """Opaque input subtrees (joins, nested windows) must NEVER share —
+    even two windows over the SAME join node object get distinct
+    opaque tokens (sharing joins' inputs is explicitly deferred)."""
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    other = _ctx.from_source(
+        MemorySource.from_batches(
+            _batches(seed=4), timestamp_column="ts"
+        ),
+        name="feed2",
+    ).with_column_renamed("v", "v2").with_column_renamed("ts", "ts2")
+    joined = base.join(other, "inner", ["k"], ["k"])
+    plans = [
+        joined.window(["k"], AGGS[:2], 3000, 1000)._plan,
+        joined.window(["k"], AGGS[:2], 5000, 1000)._plan,
+    ]
+    groups = detect_sharing(plans)
+    assert all(not g.shared for g in groups)
+    assert len(groups) == 2
+
+
+def test_mixed_aggregate_group_oracle_pins_sort_lane():
+    """A shared group whose aggregate UNION carries extrema always
+    takes the lexsort lane; an add-only member's independent oracle
+    must pin slice_sort_lane=True (plus the gcd unit) to compare
+    byte-identically."""
+    batches = _batches(seed=31)
+    sum_aggs = [
+        F.count(col("v")).alias("c"),
+        F.sum(col("v")).alias("s"),
+        F.avg(col("v")).alias("av"),
+    ]
+    min_aggs = sum_aggs + [F.min(col("v")).alias("mn")]
+    cols = ("c", "s", "av")
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    out_sum, out_min = {}, {}
+    report = run_queries(ctx, [
+        (base.window(["k"], sum_aggs, 3000, 1000),
+         lambda b: _rows_of(b, out_sum, cols)),
+        (base.window(["k"], min_aggs, 5000, 1000),
+         lambda b: _rows_of(b, out_min, ("c", "s", "av", "mn"))),
+    ])
+    assert report["shared_queries"] == 2
+    # the add-only member's oracle: same gcd unit AND the sort lane
+    ind = _run_single(
+        batches, 3000, 1000,
+        EngineConfig(
+            slice_windows=True, slice_unit_ms=1000, slice_sort_lane=True
+        ),
+        aggs=sum_aggs, cols=cols,
+    )
+    assert out_sum == ind  # EXACT
+
+
+def test_cost_guard_rejects_pathological_gcd():
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    plans = [
+        base.window(["k"], AGGS, 60_000, 7)._plan,
+        base.window(["k"], AGGS, 60_000, 1000)._plan,
+    ]
+    groups = detect_sharing(plans)
+    assert all(not g.shared for g in groups)
+    assert any("fold" in (g.reason or "") for g in groups)
+
+
+# -- shared execution ----------------------------------------------------
+
+SPECS = [(3000, 1000), (5000, 1000), (2000, 2000)]
+
+
+def _run_shared(batches, specs=SPECS, aggs=AGGS, cfg=None, group=("k",)):
+    ctx = Context(cfg or EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    outs = [dict() for _ in specs]
+
+    def sink_for(acc):
+        return lambda b: _rows_of(b, acc)
+
+    queries = [
+        (base.window(list(group), aggs, L, S), sink_for(outs[i]))
+        for i, (L, S) in enumerate(specs)
+    ]
+    report = run_queries(ctx, queries)
+    return report, outs
+
+
+def test_shared_emissions_byte_identical_to_independent():
+    batches = _batches(seed=21)
+    report, outs = _run_shared(batches)
+    assert report["shared_queries"] == 3
+    for i, (L, S) in enumerate(SPECS):
+        # oracle pinned to the shared group's gcd slice (1000ms) so the
+        # fold trees match — byte-identity's precondition
+        ind = _run_single(
+            batches, L, S,
+            EngineConfig(slice_windows=True, slice_unit_ms=1000),
+        )
+        assert outs[i] == ind  # EXACT equality, every float
+
+
+def test_shared_emissions_match_ring_oracle():
+    batches = _batches(seed=22)
+    _report, outs = _run_shared(batches)
+    for i, (L, S) in enumerate(SPECS):
+        ring = _run_single(batches, L, S)
+        _assert_rows_close(ring, outs[i])
+
+
+def test_sharing_off_baseline_matches():
+    batches = _batches(seed=23)
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    outs = [dict() for _ in SPECS]
+    queries = [
+        (
+            base.window(["k"], AGGS, L, S),
+            (lambda acc: (lambda b: _rows_of(b, acc)))(outs[i]),
+        )
+        for i, (L, S) in enumerate(SPECS)
+    ]
+    report = run_queries(ctx, queries, sharing=False)
+    assert report["independent_queries"] == 3
+    _report2, shared = _run_shared(batches)
+    for i in range(len(SPECS)):
+        _assert_rows_close(outs[i], shared[i])
+
+
+def test_mixed_batch_runs_shareable_and_fallback():
+    batches = _batches(seed=24)
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    shared_a, shared_b, sess = {}, {}, {}
+    queries = [
+        (base.window(["k"], AGGS, 3000, 1000),
+         lambda b: _rows_of(b, shared_a)),
+        (base.window(["k"], AGGS, 2000, 2000),
+         lambda b: _rows_of(b, shared_b)),
+        (base.session_window(
+            ["k"], [F.count(col("v")).alias("c")], 400
+        ), lambda b: sess.update({b.num_rows: True})),
+    ]
+    report = run_queries(ctx, queries)
+    assert report["shared_queries"] == 2
+    assert report["independent_queries"] == 1
+    assert shared_a and shared_b and sess
+    ind = _run_single(batches, 3000, 1000, EngineConfig(slice_windows=True))
+    assert shared_a == ind
+
+
+def test_ungrouped_queries_share():
+    batches = _batches(seed=25)
+    aggs = [F.count(col("v")).alias("c"), F.avg(col("v")).alias("av")]
+    cols = ("c", "av")
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    outs = [dict(), dict()]
+    queries = [
+        (base.window([], aggs, 3000, 1000),
+         lambda b: _rows_of(b, outs[0], cols)),
+        (base.window([], aggs, 2000, 1000),
+         lambda b: _rows_of(b, outs[1], cols)),
+    ]
+    report = run_queries(ctx, queries)
+    assert report["shared_queries"] == 2
+    ind = _run_single(
+        batches, 3000, 1000, EngineConfig(slice_windows=True),
+        aggs=aggs, cols=cols,
+    )
+    # ungrouped single-query path runs the same operator ungrouped
+    ctx2 = Context(EngineConfig(slice_windows=True))
+    ds = ctx2.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    ).window([], aggs, 3000, 1000)
+    ind = {}
+    for b in ds.stream():
+        _rows_of(b, ind, cols)
+    assert outs[0] == ind
+
+
+# -- doctor: shared-cost attribution -------------------------------------
+
+
+def test_shared_attribution_splits_busy_and_state():
+    from denormalized_tpu.obs import doctor
+
+    batches = _batches(seed=26)
+    report, _outs = _run_shared(batches)
+    qids = report["groups"][0]["query_ids"]
+    assert len(qids) == 3
+    handles = [doctor.get_query(q) for q in qids]
+    snaps = [h.snapshot() for h in handles]
+    for snap in snaps:
+        assert snap["shared"]["group_size"] == 3
+        node = next(
+            n for n in snap["nodes"] if "SliceWindowExec" in n["node_id"]
+        )
+        assert node["shared"]["fraction"] == pytest.approx(1 / 3, rel=1e-6)
+    # the three scaled busy numbers sum back to the one measured total
+    busies = [
+        next(
+            n for n in s["nodes"] if "SliceWindowExec" in n["node_id"]
+        )["busy_ms"]
+        for s in snaps
+    ]
+    assert busies[0] == pytest.approx(busies[1], rel=1e-6)
+    # /state splits the slice store's bytes the same way
+    st = handles[0].state_snapshot()
+    node = next(n for n in st["nodes"] if n.get("op") == "slice_window")
+    assert node["shared"]["subscribers"] == 3
+    assert node["state_bytes"] * 3 == pytest.approx(
+        node["state_bytes_shared_total"], abs=3
+    )
+    # budget/verdict basis stays RAW: the query-level total is the sum
+    # of unscaled node bytes (live memory does not shrink by being
+    # shared), only the per-node display carries the 1/N share
+    assert st["total_state_bytes"] >= node["state_bytes_shared_total"]
+    assert st["total_state_bytes"] > node["state_bytes"]
+
+
+def test_slice_metrics_and_state_info():
+    batches = _batches(seed=27)
+    ctx = Context(EngineConfig(slice_windows=True))
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    ).window(["k"], AGGS, 3000, 1000)
+    n = 0
+    for _b in ds.stream():
+        n += 1
+    assert n
+    root = ctx._last_physical
+    from denormalized_tpu.physical.slice_exec import SliceWindowExec
+    from denormalized_tpu.state.checkpoint import walk
+
+    op = next(o for o in walk(root) if isinstance(o, SliceWindowExec))
+    m = op.metrics()
+    assert m["rows_in"] == sum(b.num_rows for b in batches)
+    assert m["windows_emitted"] > 0
+    assert m["slice_folds"] >= m["windows_emitted"]
+    assert m["subscribers"] == 1
+    info = op.state_info()
+    assert info["op"] == "slice_window"
+    assert info["live_keys"] == 6
+    assert info["state_bytes"] > 0

@@ -1,0 +1,244 @@
+"""Slice-store checkpoint/restore: one epoch snapshot, per-query
+emission cursors.
+
+The acceptance scenario: a shared pipeline serving 3 subscriber
+queries at different fold cadences is killed MID-EPOCH (progress past
+the last committed cut is lost), restored, and driven to completion —
+the union of pre-kill and post-restore emissions must be
+BYTE-IDENTICAL per query to 3 independent, uninterrupted pipelines.
+Plus the negative pin: an unshareable query in the batch falls back to
+an independent plan and still completes."""
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.physical.base import EndOfStream, Marker
+from denormalized_tpu.physical.slice_exec import SubscriberBatch
+from denormalized_tpu.planner.sharing import detect_sharing
+from denormalized_tpu.runtime.multi_query import build_shared_root, run_queries
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.checkpoint import wire_checkpointing
+from denormalized_tpu.state.lsm import close_global_state_backend
+from denormalized_tpu.state.orchestrator import Orchestrator
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+
+AGGS = [
+    F.count(col("v")).alias("c"),
+    F.sum(col("v")).alias("s"),
+    F.min(col("v")).alias("mn"),
+    F.max(col("v")).alias("mx"),
+    F.avg(col("v")).alias("av"),
+    F.stddev(col("v")).alias("sd"),
+]
+AGG_COLS = ("c", "s", "mn", "mx", "av", "sd")
+#: three different fold cadences over one gcd slice (500ms)
+SPECS = [(3000, 1000), (4000, 2000), (1000, 500)]
+
+
+def _batches(seed=5, n_batches=24, rows=300, n_keys=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 500 + rng.integers(0, 500, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.normal(10.0, 3.0, rows)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _rows_of(batch, acc):
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        acc[key] = tuple(float(batch.column(c)[i]) for c in AGG_COLS)
+
+
+def _shared_root(ctx, batches):
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    plans = [base.window(["k"], AGGS, L, S)._plan for (L, S) in SPECS]
+    groups = detect_sharing(plans)
+    assert len(groups) == 1 and groups[0].shared
+    return build_shared_root(ctx, groups[0])
+
+
+def test_shared_kill_restore_byte_identical_to_independent(tmp_path):
+    batches = _batches()
+
+    # 3 independent, uninterrupted oracle pipelines — same slice kernel,
+    # pinned to the SHARED group's gcd slice (500ms): the fold grouping
+    # is part of the numeric contract, and byte-identity is only defined
+    # against an oracle folding the same slices (docs/multi_query.md)
+    oracles = []
+    for L, S in SPECS:
+        ctx = Context(EngineConfig(slice_windows=True, slice_unit_ms=500))
+        ds = ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"),
+            name="feed",
+        ).window(["k"], AGGS, L, S)
+        out = {}
+        for b in ds.stream():
+            _rows_of(b, out)
+        oracles.append(out)
+    assert all(len(o) for o in oracles)
+
+    state_dir = str(tmp_path / "state")
+
+    def make_cfg():
+        return EngineConfig(
+            checkpoint=True,
+            checkpoint_interval_s=9999,
+            state_backend_path=state_dir,
+        )
+
+    got = [dict() for _ in SPECS]
+    try:
+        # run A: commit ONE epoch, keep emitting past it (mid-epoch
+        # progress the kill loses), then stop hard
+        ctx_a = Context(make_cfg())
+        root_a = _shared_root(ctx_a, batches)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emissions = 0
+        committed = False
+        post_commit = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, SubscriberBatch):
+                _rows_of(item.batch, got[item.tag])
+                emissions += 1
+                if committed:
+                    post_commit += 1
+                    if post_commit >= 9:
+                        break  # hard kill mid-epoch: progress uncommitted
+            if emissions == 8 and not committed:
+                orch_a.trigger_now()
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                committed = True
+        it.close()
+        assert committed and post_commit >= 9
+        close_global_state_backend()
+
+        # run B: restore from the committed cut, drive to completion —
+        # windows emitted between the cut and the kill re-emit with
+        # byte-identical values (the dict union dedupes them)
+        ctx_b = Context(make_cfg())
+        root_b = _shared_root(ctx_b, batches)
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        for item in root_b.run():
+            if isinstance(item, SubscriberBatch):
+                _rows_of(item.batch, got[item.tag])
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        close_global_state_backend()
+
+    for q in range(len(SPECS)):
+        assert set(got[q]) == set(oracles[q]), {
+            "query": q,
+            "missing": sorted(set(oracles[q]) - set(got[q]))[:4],
+            "extra": sorted(set(got[q]) - set(oracles[q]))[:4],
+        }
+        for k in oracles[q]:
+            # byte-identical: exact float equality, not approx — the
+            # snapshot stores the exact f64 slice partials and the fold
+            # order after restore matches the uninterrupted run
+            assert got[q][k] == oracles[q][k], (q, k)
+
+
+def test_snapshot_carries_per_query_cursors(tmp_path):
+    """One snapshot, N emission cursors: after a restore each
+    subscriber resumes at ITS OWN next window, not a shared one."""
+    batches = _batches(seed=9, n_batches=16)
+    state_dir = str(tmp_path / "state")
+
+    def make_cfg():
+        return EngineConfig(
+            checkpoint=True,
+            checkpoint_interval_s=9999,
+            state_backend_path=state_dir,
+        )
+
+    try:
+        ctx_a = Context(make_cfg())
+        root_a = _shared_root(ctx_a, batches)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emissions = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, SubscriberBatch):
+                emissions += 1
+            if emissions == 10:
+                orch_a.trigger_now()
+                emissions += 1
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                break
+        cursors_a = list(root_a._next_win)
+        it.close()
+        close_global_state_backend()
+
+        ctx_b = Context(make_cfg())
+        root_b = _shared_root(ctx_b, batches)
+        orch_b = Orchestrator(interval_s=9999)
+        wire_checkpointing(root_b, ctx_b, orch_b)
+        assert root_b._next_win == cursors_a
+        # three cadences → three DIFFERENT cursor positions in ms
+        starts = [
+            nw * SPECS[q][1] for q, nw in enumerate(root_b._next_win)
+        ]
+        assert len(set(starts)) > 1
+    finally:
+        close_global_state_backend()
+
+
+def test_unshareable_query_negative_falls_back(tmp_path):
+    """The planner-fallback pin: a session query co-registered with two
+    shareable window queries runs independently (the report says so)
+    and every query still completes."""
+    batches = _batches(seed=12, n_batches=12)
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    a, b, c = {}, {}, []
+    queries = [
+        (base.window(["k"], AGGS, 3000, 1000), lambda x: _rows_of(x, a)),
+        (base.window(["k"], AGGS, 2000, 1000), lambda x: _rows_of(x, b)),
+        (
+            base.session_window(["k"], [F.count(col("v")).alias("c")], 400),
+            lambda x: c.append(x.num_rows),
+        ),
+    ]
+    report = run_queries(ctx, queries)
+    shared_groups = [g for g in report["groups"] if g["shared"]]
+    fallback = [g for g in report["groups"] if not g["shared"]]
+    assert len(shared_groups) == 1
+    assert shared_groups[0]["members"] == [0, 1]
+    assert len(fallback) == 1 and fallback[0]["members"] == [2]
+    assert "session" in fallback[0]["reason"]
+    assert a and b and sum(c) > 0

@@ -1,0 +1,287 @@
+"""SliceStore kernel unit tests: per-(gid, slide-unit) partials from
+reduceat accumulation must agree with brute-force per-cell aggregation,
+window folds must agree with direct aggregation over the folded range,
+and the snapshot round-trip must be bit-exact (the property the
+multi-query engine's byte-identical emission guarantees ride on)."""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.ops.segment_agg import AggComponent, components_for
+from denormalized_tpu.ops.slice_store import (
+    SliceStore,
+    fold_slices,
+    slice_segment_bounds,
+)
+
+COMPONENTS = tuple(
+    components_for([("count", 0), ("sum", 0), ("min", 0), ("max", 0)])
+)
+
+
+def _brute_cells(units, gids, vals, valid):
+    cells = {}
+    for u, g, v, ok in zip(
+        units.tolist(), gids.tolist(), vals.tolist(), valid.tolist()
+    ):
+        c = cells.setdefault(
+            (u, g),
+            {"rows": 0, "n": 0, "s": 0.0, "mn": np.inf, "mx": -np.inf},
+        )
+        c["rows"] += 1
+        if ok:
+            c["n"] += 1
+            c["s"] += v
+            c["mn"] = min(c["mn"], v)
+            c["mx"] = max(c["mx"], v)
+    return cells
+
+
+def _feed(seed=0, n=5000, n_units=7, n_gids=23, null_frac=0.1):
+    rng = np.random.default_rng(seed)
+    units = rng.integers(0, n_units, n).astype(np.int64)
+    gids = rng.integers(0, n_gids, n).astype(np.int32)
+    vals = rng.normal(100.0, 30.0, n)
+    valid = rng.random(n) >= null_frac
+    return units, gids, vals, valid
+
+
+def _accumulate(store, units, gids, vals, valid, ngroups, chunks=4):
+    edges = np.linspace(0, len(units), chunks + 1).astype(int)
+    for a, b in zip(edges[:-1], edges[1:]):
+        store.accumulate(
+            units[a:b],
+            gids[a:b],
+            vals[a:b].reshape(-1, 1),
+            valid[a:b].reshape(-1, 1),
+            ngroups,
+        )
+
+
+def test_segment_bounds_partition_batch_exactly():
+    units, gids, _v, _ok = _feed(seed=3, n=1000)
+    order, starts, seg_u, seg_g = slice_segment_bounds(units, gids, 32)
+    # every row lands in exactly one segment, and segment cells are unique
+    total = 0
+    ends = np.append(starts[1:], len(units))
+    seen = set()
+    for i in range(len(starts)):
+        lo, hi = int(starts[i]), int(ends[i])
+        total += hi - lo
+        cell = (int(seg_u[i]), int(seg_g[i]))
+        assert cell not in seen
+        seen.add(cell)
+        assert (units[order[lo:hi]] == cell[0]).all()
+        assert (gids[order[lo:hi]] == cell[1]).all()
+    assert total == len(units)
+
+
+def test_segment_bounds_negative_units():
+    units = np.array([-3, -3, -1, 0, 2], dtype=np.int64)
+    gids = np.array([1, 2, 1, 0, 1], dtype=np.int32)
+    _order, _starts, seg_u, seg_g = slice_segment_bounds(units, gids, 16)
+    assert seg_u.tolist() == [-3, -3, -1, 0, 2]
+    assert seg_g.tolist() == [1, 2, 1, 0, 1]
+
+
+def test_accumulate_matches_brute_force_with_nulls():
+    units, gids, vals, valid = _feed()
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    _accumulate(store, units, gids, vals, valid, ngroups=23)
+    cells = _brute_cells(units, gids, vals, valid)
+    for (u, g), c in cells.items():
+        slot = store._units[u]
+        assert slot["count_star"][g] == c["rows"]
+        assert slot["count_0"][g] == c["n"]
+        assert slot["sum_0"][g] == pytest.approx(c["s"], rel=1e-12)
+        if c["n"]:
+            assert slot["min_0"][g] == c["mn"]
+            assert slot["max_0"][g] == c["mx"]
+        else:
+            assert np.isposinf(slot["min_0"][g])
+            assert np.isneginf(slot["max_0"][g])
+
+
+def test_fold_matches_direct_aggregation_over_range():
+    units, gids, vals, valid = _feed(seed=9)
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    _accumulate(store, units, gids, vals, valid, ngroups=23)
+    rows = store.fold(2, 6)  # units [2, 6)
+    sel = (units >= 2) & (units < 6)
+    cells = _brute_cells(
+        units[sel], np.zeros(sel.sum(), np.int32) + gids[sel], vals[sel],
+        valid[sel],
+    )
+    per_g = {}
+    for (_u, g), c in cells.items():
+        t = per_g.setdefault(
+            g, {"rows": 0, "n": 0, "s": 0.0, "mn": np.inf, "mx": -np.inf}
+        )
+        t["rows"] += c["rows"]
+        t["n"] += c["n"]
+        t["s"] += c["s"]
+        t["mn"] = min(t["mn"], c["mn"])
+        t["mx"] = max(t["mx"], c["mx"])
+    for g, t in per_g.items():
+        assert rows["count_star"][g] == t["rows"]
+        assert rows["count_0"][g] == t["n"]
+        assert rows["sum_0"][g] == pytest.approx(t["s"], rel=1e-12)
+        if t["n"]:
+            assert rows["min_0"][g] == t["mn"]
+            assert rows["max_0"][g] == t["mx"]
+
+
+def test_fold_empty_range_returns_none():
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    units, gids, vals, valid = _feed(n=100, n_units=3)
+    _accumulate(store, units, gids, vals, valid, ngroups=23, chunks=1)
+    assert store.fold(50, 60) is None
+
+
+def test_fold_single_unit_copies():
+    """A one-unit fold must hand back a COPY — emission finalize mutates
+    nothing, but a caller holding the rows across a later accumulate
+    must not see them change underneath."""
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    u = np.zeros(4, np.int64)
+    g = np.zeros(4, np.int32)
+    v = np.ones((4, 1))
+    ok = np.ones((4, 1), bool)
+    store.accumulate(u, g, v, ok, 1)
+    rows = store.fold(0, 1)
+    store.accumulate(u, g, v, ok, 1)
+    assert rows["count_star"][0] == 4
+    assert store.fold(0, 1)["count_star"][0] == 8
+
+
+def test_capacity_growth_preserves_partials():
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    units, gids, vals, valid = _feed(seed=1, n=500, n_gids=10)
+    _accumulate(store, units, gids, vals, valid, ngroups=10, chunks=1)
+    before = store.fold(0, 7)
+    cap0 = store.capacity
+    # a second batch with 10x the gid space forces growth
+    units2, gids2, vals2, valid2 = _feed(seed=2, n=500, n_gids=300)
+    _accumulate(store, units2, gids2, vals2, valid2, ngroups=300, chunks=1)
+    assert store.capacity > cap0
+    after = store.fold(0, 7)
+    # the original gids' contributions survived the growth
+    cells1 = _brute_cells(units, gids, vals, valid)
+    cells2 = _brute_cells(units2, gids2, vals2, valid2)
+    for g in range(10):
+        rows = sum(c["rows"] for (u, gg), c in cells1.items() if gg == g)
+        rows += sum(c["rows"] for (u, gg), c in cells2.items() if gg == g)
+        assert after["count_star"][g] == rows
+    assert before["count_star"][:10].sum() == sum(
+        c["rows"] for c in cells1.values()
+    )
+
+
+def test_prune_drops_only_below_floor():
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    units, gids, vals, valid = _feed(n=200, n_units=10)
+    _accumulate(store, units, gids, vals, valid, ngroups=23, chunks=1)
+    assert store.prune(4) == 4
+    assert store.live_units() == [4, 5, 6, 7, 8, 9]
+    assert store.fold(0, 4) is None
+
+
+def test_snapshot_restore_bit_exact():
+    store = SliceStore(COMPONENTS, unit_ms=1000)
+    units, gids, vals, valid = _feed(seed=7)
+    _accumulate(store, units, gids, vals, valid, ngroups=23)
+    arrays = store.snapshot_arrays(23)
+    other = SliceStore(COMPONENTS, unit_ms=1000)
+    other.restore_arrays(
+        {k: v.copy() for k, v in arrays.items()}, 23
+    )
+    assert other.live_units() == store.live_units()
+    a = store.fold(0, 7)
+    b = other.fold(0, 7)
+    for label in a:
+        np.testing.assert_array_equal(a[label][:23], b[label][:23])
+    # continued accumulation after restore stays bit-identical
+    u2, g2, v2, ok2 = _feed(seed=8, n=1000)
+    _accumulate(store, u2, g2, v2, ok2, ngroups=23, chunks=1)
+    _accumulate(other, u2, g2, v2, ok2, ngroups=23, chunks=1)
+    a = store.fold(0, 7)
+    b = other.fold(0, 7)
+    for label in a:
+        np.testing.assert_array_equal(a[label][:23], b[label][:23])
+
+
+def test_dense_and_sort_lanes_agree():
+    """Add-only component sets take the bincount lane; forcing the sort
+    lane over the same rows must agree to float64 rounding (the lanes
+    may associate long-segment adds differently — lane CHOICE is a pure
+    function of components + batch shape, so identical runs always take
+    identical lanes; cross-lane identity is not part of the contract)."""
+    comps = tuple(components_for([("count", 0), ("sum", 0), ("avg", 0)]))
+    units, gids, vals, valid = _feed(seed=17, n=4000)
+    dense = SliceStore(comps, unit_ms=1000)
+    assert dense._add_only
+    _accumulate(dense, units, gids, vals, valid, ngroups=23)
+    sortl = SliceStore(comps, unit_ms=1000)
+    sortl._add_only = False
+    _accumulate(sortl, units, gids, vals, valid, ngroups=23)
+    assert dense.live_units() == sortl.live_units()
+    for u in dense.live_units():
+        for comp in comps:
+            a = dense._units[u][comp.label]
+            b = sortl._units[u][comp.label]
+            if comp.kind == "count":
+                np.testing.assert_array_equal(a, b)
+            else:
+                np.testing.assert_allclose(a, b, rtol=1e-12)
+
+
+def test_dense_lane_guard_falls_back_on_sparse_span():
+    """A batch whose unit span dwarfs its rows must not allocate a
+    span*cap bincount — the sort lane takes it instead, with identical
+    results."""
+    comps = tuple(components_for([("count", 0), ("sum", 0)]))
+    store = SliceStore(comps, unit_ms=1000)
+    units = np.array([0, 10_000_000], dtype=np.int64)
+    gids = np.zeros(2, np.int32)
+    store.accumulate(
+        units, gids, np.ones((2, 1)), np.ones((2, 1), bool), 1
+    )
+    assert store.live_units() == [0, 10_000_000]
+    assert store._units[0]["sum_0"][0] == 1.0
+
+
+def test_fold_slices_deterministic():
+    rng = np.random.default_rng(0)
+    stack = rng.normal(0, 1, (9, 64))
+    assert (
+        fold_slices("sum", stack) == fold_slices("sum", stack.copy())
+    ).all()
+    assert (
+        fold_slices("min", stack) == np.minimum.reduce(stack, axis=0)
+    ).all()
+
+
+def test_variance_components_fold_additively():
+    """The variance family rides shifted-moment components: folding
+    per-slice (count, Σ(x−K), Σ(x−K)²) by addition is the exact
+    constant-pivot Chan combine, so a fold over two slices must equal
+    accumulating all rows into one slice."""
+    comps = tuple(components_for([("var", 0, 1)]))
+    rng = np.random.default_rng(4)
+    x = rng.normal(1e6, 1.0, 2000)  # large magnitude: pivot matters
+    K = x[0]
+    shifted = np.stack([x - K, (x - K) ** 2], axis=1)
+    ok = np.ones((2000, 2), bool)
+    g = np.zeros(2000, np.int32)
+    split = SliceStore(comps, unit_ms=1000)
+    split.accumulate(
+        np.repeat(np.array([0, 1], np.int64), 1000), g, shifted, ok, 1
+    )
+    one = SliceStore(comps, unit_ms=1000)
+    one.accumulate(np.zeros(2000, np.int64), g, shifted, ok, 1)
+    a = split.fold(0, 2)
+    b = one.fold(0, 1)
+    for label in a:
+        np.testing.assert_allclose(
+            a[label][:1], b[label][:1], rtol=1e-12
+        )
