@@ -12,6 +12,10 @@ the remaining BASELINE.md configs 2-5 — plus:
 - ``session``: the soak-shaped bursty feed (600ms burst / 400ms silence per
   event-second) through a 300ms-gap session window, count/min/max/avg by
   key — the vectorized host-side session operator, measured end to end.
+- ``join_skew``: the skew-adaptive join A/B (docs/joins.md) — a zipf(1.2)
+  fact side band-joined against a thin-celebrity probe side, adaptive
+  (closed-loop hot-key sub-partitioning) vs static chain walk, plus a
+  uniform-feed no-cold-path-tax cell.
 - ``session_scale``: key-cardinality sweep (1 / 1k / 10k / 100k keys) of
   the session operator, NEW vs the kept pre-vectorization reference
   implementation (SESSION_SCALE.json artifact).
@@ -1795,6 +1799,194 @@ def run_spill_scale() -> dict:
     }
 
 
+def run_join_skew() -> dict:
+    """BENCH_CONFIG=join_skew — the skew-adaptive join acceptance A/B
+    (ISSUE 15, docs/joins.md).  Two cells, interleaved best-of runs:
+
+    - **skew**: a zipf(1.2) fact side (rejection-sampled onto a 10k key
+      space — top key ~21% of rows) band-joined against a
+      mostly-uniform probe side with a thin celebrity presence, 1M
+      rows total.  Adaptive (closed-loop hot-key sub-partitioning) vs
+      static (``join_adaptive=False``, pure chain walk) — gate:
+      adaptive ≥ 3× static.  The static chain walk pays one numpy
+      iteration per retained celebrity duplicate per probe; the
+      adaptive probe pays one multi-arange over the dense hot blocks.
+    - **uniform**: the same pipeline on uniform keys both sides —
+      adaptation never triggers, so the cell measures the closed
+      loop's standing cost (sampled sketch + policy tick).  Gate:
+      ≥ 0.95 (no cold-path tax).
+
+    Emission equality between the two modes is pinned by
+    tests/test_join_adaptive.py (byte-identical order contract); the
+    bench cross-checks output row counts.
+    """
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+
+    batch = min(BATCH_ROWS, 8_192)
+    # acceptance cell: 1M rows total (500k/side) unless BENCH_ROWS set
+    total = TOTAL_ROWS if _ROWS_EXPLICIT else 1_000_000
+    rows_side = max(total, 2) // 2
+    keyspace = 10_000
+    # retention exceeds the replay's event-time span: the cell measures
+    # pure probe mechanics (chain walk vs sub-partition gather), not
+    # whole-side eviction rebuilds, which are identical in both modes
+    # and would only compress the ratio with shared cost
+    retention = int(os.environ.get("BENCH_JOIN_SKEW_RETENTION", 600_000))
+    dim_density = 0.0004
+
+    sch_l = Schema([
+        Field("ts", DataType.TIMESTAMP_MS, nullable=False),
+        Field("k", DataType.INT64, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ])
+    sch_r = Schema([
+        Field("ts2", DataType.TIMESTAMP_MS, nullable=False),
+        Field("k2", DataType.INT64, nullable=False),
+        Field("w", DataType.FLOAT64),
+    ])
+
+    def zipf_keys(rng, n):
+        # rejection-sampled zipf(1.2) over the key space (clipping
+        # would dump the unbounded tail's mass onto one pseudo-key)
+        out = np.empty(n, dtype=np.int64)
+        filled = 0
+        while filled < n:
+            draw = rng.zipf(1.2, n - filled)
+            draw = draw[draw <= keyspace]
+            out[filled:filled + len(draw)] = draw
+            filled += len(draw)
+        return out
+
+    def feed(seed, shape):
+        rng = np.random.default_rng(seed)
+        t = 1_700_000_000_000
+        out = []
+        for start in range(0, rows_side, batch):
+            n = min(batch, rows_side - start)
+            ts = t + np.arange(n, dtype=np.int64)
+            t += n
+            if shape == "zipf":
+                ks = zipf_keys(rng, n)
+            elif shape == "dim":
+                cel = rng.random(n) < dim_density
+                ks = np.where(cel, 1, rng.integers(2, keyspace + 1, n))
+            else:
+                ks = rng.integers(1, keyspace + 1, n)
+            out.append((ts, ks.astype(np.int64), rng.random(n)))
+        return out
+
+    def one(adaptive, lshape, rshape) -> tuple[float, int, dict]:
+        ctx = _engine_ctx(
+            batch,
+            join_adaptive=adaptive,
+            join_adapt_interval_s=0.25,
+            join_retention_ms=retention,
+        )
+        L = [RecordBatch(sch_l, list(b)) for b in feed(1, lshape)]
+        R = [RecordBatch(sch_r, list(b)) for b in feed(2, rshape)]
+        left = ctx.from_source(
+            _mem_source_named(L, "ts"), name="skew_l"
+        )
+        right = ctx.from_source(
+            _mem_source_named(R, "ts2"), name="skew_r"
+        )
+        ds = left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", -50, 50)
+        )
+        rows_out = 0
+        t0 = time.perf_counter()
+        for b in ds.stream():
+            rows_out += b.num_rows
+        dt = time.perf_counter() - t0
+        info = {}
+        stack = [ctx._last_physical]
+        while stack:
+            cur = stack.pop()
+            if type(cur).__name__ == "StreamingJoinExec":
+                info = cur.state_info()
+                break
+            stack.extend(cur.children)
+        return 2 * rows_side / dt, rows_out, info
+
+    def best_of(n, adaptive, lshape, rshape):
+        rps, out, info = 0.0, None, {}
+        for _ in range(n):
+            r, o, i = one(adaptive, lshape, rshape)
+            if r > rps:
+                rps, out, info = r, o, i
+        return rps, out, info
+
+    # skew cell (interleaved A/B)
+    sk_a = sk_s = 0.0
+    sk_a_out = sk_s_out = None
+    sk_info: dict = {}
+    for _ in range(2):
+        r, o, i = one(True, "zipf", "dim")
+        if r > sk_a:
+            sk_a, sk_a_out, sk_info = r, o, i
+        r, o, _i = one(False, "zipf", "dim")
+        if r > sk_s:
+            sk_s, sk_s_out = r, o
+    skew_ratio = round(sk_a / sk_s, 3)
+    adapts = (sk_info.get("adaptations") or {}).get("total", 0)
+    log(
+        f"join_skew[skew]: adaptive {sk_a:,.0f} rows/s "
+        f"(hot_keys={sk_info.get('hot_keys')}, adaptations={adapts}) vs "
+        f"static {sk_s:,.0f} rows/s — {skew_ratio}x "
+        f"(out {sk_a_out}/{sk_s_out})"
+    )
+    assert sk_a_out == sk_s_out, "adaptive/static emitted row counts differ"
+    assert adapts > 0, "the policy never adapted on the zipf feed"
+
+    # uniform (cold-path) cell
+    un_a = un_s = 0.0
+    un_a_out = un_s_out = None
+    for _ in range(3):
+        r, o, _i = one(True, "uni", "uni")
+        if r > un_a:
+            un_a, un_a_out = r, o
+        r, o, _i = one(False, "uni", "uni")
+        if r > un_s:
+            un_s, un_s_out = r, o
+    uniform_ratio = round(un_a / un_s, 4)
+    log(
+        f"join_skew[uniform]: adaptive {un_a:,.0f} vs static "
+        f"{un_s:,.0f} rows/s — ratio {uniform_ratio} (out "
+        f"{un_a_out}/{un_s_out})"
+    )
+    assert un_a_out == un_s_out
+
+    return {
+        "metric": "rows_per_sec_join_skew_zipf12_adaptive",
+        "value": round(sk_a),
+        "unit": "rows/s",
+        "vs_baseline": skew_ratio,
+        "device": "host",
+        "rows_total": 2 * rows_side,
+        "retention_ms": retention,
+        "static_rows_per_s": round(sk_s),
+        "adaptive_over_static": skew_ratio,
+        "skew_gate_pass": skew_ratio >= 3.0,
+        "hot_keys": sk_info.get("hot_keys"),
+        "hot_bytes": sk_info.get("hot_bytes"),
+        "adaptations": adapts,
+        "rows_out": sk_a_out,
+        "uniform_adaptive_rows_per_s": round(un_a),
+        "uniform_static_rows_per_s": round(un_s),
+        "uniform_ratio": uniform_ratio,
+        "uniform_gate_pass": uniform_ratio >= 0.95,
+        "host_cores": os.cpu_count(),
+        "host_load_1m": round(os.getloadavg()[0], 2),
+    }
+
+
+def _mem_source_named(batches, ts_col):
+    from denormalized_tpu.sources.memory import MemorySource
+
+    return MemorySource.from_batches(batches, timestamp_column=ts_col)
+
+
 def run_multi_query() -> dict:
     """BENCH_CONFIG=multi_query — the multi-query engine's acceptance
     artifact (MULTI_QUERY_SCALE.json): Q concurrent shareable sliding-
@@ -3290,6 +3482,13 @@ def run_config(device: str) -> dict:
             f"no-spill gate ratio {out['no_spill_ratio']} "
             f"(pass={out['no_spill_gate_pass']})")
         return out
+    if config == "join_skew":
+        out = run_join_skew()
+        log(f"engine[join_skew]: adaptive {out['value']:,} rows/s = "
+            f"{out['adaptive_over_static']}x static "
+            f"(gate pass={out['skew_gate_pass']}), uniform ratio "
+            f"{out['uniform_ratio']} (pass={out['uniform_gate_pass']})")
+        return out
     if config == "ingest_scale":
         if "BENCH_ROWS" not in os.environ and not _ROWS_EXPLICIT:
             TOTAL_ROWS = 4_000_000  # bounded by broker memory + encode time
@@ -3485,11 +3684,12 @@ def main():
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
         "ingest_scale", "decode_scale", "session", "session_scale",
         "spill_scale", "cluster_scale", "exchange_codec", "multi_query",
+        "join_skew",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     if CONFIG in ("decode_scale", "session", "session_scale",
                   "spill_scale", "cluster_scale", "exchange_codec",
-                  "multi_query"):
+                  "multi_query", "join_skew"):
         # pure host-side benchmarks (decoder / session operator): no
         # device, no TPU relay wait
         device = "host"

@@ -53,6 +53,13 @@ class EngineConfig:
     # streaming joins: rows older than the join watermark by more than this
     # are evicted (and emitted unmatched for outer joins)
     join_retention_ms: int = 300_000
+    # closed-loop skew adaptation (obs/doctor/actions.py): when a key's
+    # sketched share crosses the skewed-join-side verdict thresholds, the
+    # policy migrates it into a dense hot sub-partition (and folds it
+    # back on decay).  Emissions are byte-identical either way — this is
+    # a performance layout, not a semantics switch (docs/joins.md).
+    join_adaptive: bool = True
+    join_adapt_interval_s: float = 1.0
     min_batch_bucket: int = 256
     min_group_capacity: int = 128
     min_window_slots: int = 16

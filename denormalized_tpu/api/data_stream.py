@@ -167,7 +167,18 @@ class DataStream:
         left_cols: Sequence[str] = (),
         right_cols: Sequence[str] = (),
         filter: Expr | None = None,
+        band: "lp.JoinBand | tuple | None" = None,
     ) -> "DataStream":
+        """Stream-stream join on equi keys, optionally banded.
+
+        ``band`` adds an interval/range predicate alongside the equi
+        keys: ``(left_expr, right_expr, lower_ms, upper_ms)`` (column
+        names accepted for the exprs) matches a pair iff ``left -
+        right`` lands in ``[lower_ms, upper_ms]`` inclusive, ``None``
+        bounds open.  Band expressions evaluate on their OWN side, so
+        a band over event time works even though the right side's
+        timestamp never appears in the output — the enrichment /
+        temporal-correlation join (``ts BETWEEN a AND b``)."""
         jt = self._JOIN_TYPE_ALIASES.get(
             join_type.lower().replace(" ", ""), join_type.lower()
         )
@@ -179,6 +190,15 @@ class DataStream:
                 list(right_cols),
                 list(left_cols),
                 filter,
+                band=None if band is None else self._flip_band(band),
+            )
+        if band is not None and not isinstance(band, lp.JoinBand):
+            le, re_, lo, hi = band
+            band = lp.JoinBand(
+                col(le) if isinstance(le, str) else le,
+                col(re_) if isinstance(re_, str) else re_,
+                lo,
+                hi,
             )
         return self._wrap(
             lp.Join(
@@ -188,7 +208,27 @@ class DataStream:
                 list(left_cols),
                 list(right_cols),
                 filter,
+                band,
             )
+        )
+
+    @staticmethod
+    def _flip_band(band) -> "lp.JoinBand":
+        """Mirror a band across a left/right input swap: ``l - r ∈ [a,
+        b]`` becomes ``r - l ∈ [-b, -a]``."""
+        if not isinstance(band, lp.JoinBand):
+            le, re_, lo, hi = band
+            band = lp.JoinBand(
+                col(le) if isinstance(le, str) else le,
+                col(re_) if isinstance(re_, str) else re_,
+                lo,
+                hi,
+            )
+        return lp.JoinBand(
+            band.right_expr,
+            band.left_expr,
+            None if band.upper_ms is None else -band.upper_ms,
+            None if band.lower_ms is None else -band.lower_ms,
         )
 
     def join_on(
@@ -199,12 +239,18 @@ class DataStream:
         ``expr_l == expr_r`` conjuncts where each side references exactly
         one input become equi-keys: non-column sides are computed into
         hidden key columns on their input, the hash join runs on those,
-        and the hidden columns are dropped from the output.  Any other
-        conjunct (non-equi op, or an equality whose sides mix both
-        inputs) becomes a residual filter evaluated on matched pairs —
-        the same lowering DataFusion applies to the reference's
-        ``join_on``."""
-        from denormalized_tpu.logical.expr import BinaryExpr
+        and the hidden columns are dropped from the output.  Inclusive
+        inequality conjuncts comparing a pure-left expression against a
+        pure-right expression (± a literal) — the ``l.ts >= r.ts - a``
+        / ``l.ts <= r.ts + b`` BETWEEN shape — lower to ONE banded
+        predicate evaluated per side before pair materialization
+        (lp.JoinBand), which is also the only way to bound against the
+        right side's canonical timestamp (it never reaches the pair
+        schema).  Any other conjunct (strict inequality, non-equi op,
+        or an expression mixing both inputs) becomes a residual filter
+        evaluated on matched pairs — the same lowering DataFusion
+        applies to the reference's ``join_on``."""
+        from denormalized_tpu.logical.expr import BinaryExpr, Literal
 
         left_names = set(self.schema().names)
         right_names = set(right.schema().names)
@@ -219,11 +265,53 @@ class DataStream:
                 return "r"
             return None  # ambiguous or mixed — not a separable equi side
 
+        def shifted(e: Expr) -> tuple[Expr, float, str | None]:
+            """Decompose ``e`` as ``base + const`` with ``base`` purely
+            one-sided: peels one additive numeric literal off a
+            BinaryExpr (the ``r.ts + 5000`` shape)."""
+            if isinstance(e, BinaryExpr) and e.op in ("+", "-"):
+                if isinstance(e.right, Literal) and isinstance(
+                    e.right.value, (int, float)
+                ):
+                    c = float(e.right.value)
+                    return e.left, c if e.op == "+" else -c, side_of(e.left)
+                if e.op == "+" and isinstance(e.left, Literal) and isinstance(
+                    e.left.value, (int, float)
+                ):
+                    return e.right, float(e.left.value), side_of(e.right)
+            return e, 0.0, side_of(e)
+
+        def band_constraint(e: Expr):
+            """``(l_expr, r_expr, lower, upper)`` for one inclusive
+            inequality conjunct over opposite sides, else None.  Strict
+            ops stay residual: the band contract is inclusive and the
+            operands may be floats, so ``<`` cannot be rewritten."""
+            if not isinstance(e, BinaryExpr) or e.op not in ("<=", ">="):
+                return None
+            a, ca, sa_ = shifted(e.left)
+            b, cb, sb_ = shifted(e.right)
+            if {sa_, sb_} != {"l", "r"}:
+                return None
+            # normalize to  left_expr - right_expr  (op)  const
+            if sa_ == "l":
+                le_, re2, const = a, b, cb - ca
+                op = e.op
+            else:
+                le_, re2, const = b, a, ca - cb
+                op = "<=" if e.op == ">=" else ">="
+            if op == "<=":
+                return (le_, re2, None, const)
+            return (le_, re2, const, None)
+
         lds, rds = self, right
         lcols: list[str] = []
         rcols: list[str] = []
         hidden: list[str] = []
         residual: Expr | None = None
+        band_key = None
+        band_exprs = None
+        band_lo: float | None = None
+        band_hi: float | None = None
         for i, e in enumerate(on_exprs):
             sides = None
             if isinstance(e, BinaryExpr) and e.op == "==":
@@ -242,6 +330,24 @@ class DataStream:
                 elif sl == "r" and sr is None and not e.left.columns_referenced():
                     sides = (e.right, e.left)
             if sides is None:
+                bc = band_constraint(e)
+                if bc is not None:
+                    le_, re2, lo, hi = bc
+                    key = (repr(le_), repr(re2))
+                    if band_key is None or key == band_key:
+                        band_key = key
+                        band_exprs = (le_, re2)
+                        if lo is not None:
+                            band_lo = (
+                                lo if band_lo is None else max(band_lo, lo)
+                            )
+                        if hi is not None:
+                            band_hi = (
+                                hi if band_hi is None else min(band_hi, hi)
+                            )
+                        continue
+                    # the exec carries ONE band; a second distinct
+                    # expression pair stays a residual pair filter
                 residual = e if residual is None else (residual & e)
                 continue
             le, re_ = sides
@@ -265,7 +371,14 @@ class DataStream:
                 "(expr_over_left == expr_over_right) — a pure theta join "
                 "over unbounded streams has no hash key to bound state"
             )
-        out = lds.join(rds, join_type, lcols, rcols, filter=residual)
+        band = None
+        if band_exprs is not None:
+            band = lp.JoinBand(
+                band_exprs[0], band_exprs[1], band_lo, band_hi
+            )
+        out = lds.join(
+            rds, join_type, lcols, rcols, filter=residual, band=band
+        )
         return out.drop_columns(*hidden) if hidden else out
 
     # -- introspection ---------------------------------------------------

@@ -81,6 +81,7 @@ def map_children(
             node.left_keys,
             node.right_keys,
             node.filter,
+            node.band,
         )
     return node
 
@@ -191,6 +192,14 @@ class ProjectionPruning:
                 if node.filter is not None:
                     for n in node.filter.columns_referenced():
                         (lneed if n in lnames else rneed).add(n)
+                if node.band is not None:
+                    # band expressions evaluate against their own
+                    # side's input — pruning must keep those columns
+                    # even though they may never reach the output
+                    for n in node.band.left_expr.columns_referenced():
+                        lneed.add(n)
+                    for n in node.band.right_expr.columns_referenced():
+                        rneed.add(n)
             return lp.Join(
                 self._walk(node.left, lneed),
                 self._walk(node.right, rneed),
@@ -198,6 +207,7 @@ class ProjectionPruning:
                 node.left_keys,
                 node.right_keys,
                 node.filter,
+                node.band,
             )
         if isinstance(node, lp.Scan):
             if required is None:

@@ -172,6 +172,35 @@ class JoinKind(enum.Enum):
     LEFT_ANTI = "left_anti"
 
 
+@dataclass(frozen=True)
+class JoinBand:
+    """Banded (interval/range) join predicate riding alongside the equi
+    keys: a pair matches iff ``left_expr - right_expr`` lands in
+    ``[lower_ms, upper_ms]`` (inclusive; ``None`` = unbounded on that
+    side).  ``lower_ms > upper_ms`` is a legal EMPTY band (matches
+    nothing) — the degenerate case the hypothesis differential pins.
+    Each expression is evaluated against its OWN input's schema, so a
+    band can reference the right side's canonical timestamp even though
+    that column never appears in the join output — the
+    enrichment/temporal-correlation shape (``ts BETWEEN a AND b``) the
+    residual pair filter cannot express.  Rows only match while
+    co-retained: a band reaching beyond ``join_retention_ms`` is
+    clipped by eviction (docs/joins.md)."""
+
+    left_expr: Expr
+    right_expr: Expr
+    lower_ms: int | float | None
+    upper_ms: int | float | None
+
+    def _label(self) -> str:
+        lo = "-inf" if self.lower_ms is None else self.lower_ms
+        hi = "+inf" if self.upper_ms is None else self.upper_ms
+        return (
+            f"{self.left_expr.name} - {self.right_expr.name} in "
+            f"[{lo}, {hi}]"
+        )
+
+
 @dataclass
 class Join(LogicalPlan):
     """Stream-stream equi-join.  The reference lowers joins to DataFusion's
@@ -184,6 +213,7 @@ class Join(LogicalPlan):
     left_keys: list[str]
     right_keys: list[str]
     filter: Expr | None = None
+    band: JoinBand | None = None
     schema: Schema = None  # type: ignore[assignment]
 
     def __post_init__(self):
@@ -231,6 +261,8 @@ class Join(LogicalPlan):
 
     def _label(self):
         on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
+        if self.band is not None:
+            on += f", band {self.band._label()}"
         return f"Join({self.kind.value} on {on})"
 
 

@@ -224,7 +224,24 @@ INSTRUMENTS: dict[str, tuple] = {
         "accounted state exceeded the hard ceiling with no evictable "
         "cold state left",
     ),
+    # -- closed-loop skew adaptation (obs/doctor/actions.py) ------------
+    "dnz_join_adaptations_total": (
+        "counter",
+        "hot-key sub-partition layout changes applied by the join's "
+        "closed-loop policy, labeled action=adapt|fold and "
+        "side=left|right — the first doctor verdict that acts instead "
+        "of reporting (each change also lands as a Perfetto instant "
+        "event)",
+    ),
     # -- multi-query slice store (physical/slice_exec.py) ---------------
+    "dnz_mq_emit_lag_ms": (
+        "gauge",
+        "per-subscriber end-to-end emission lag of a shared slice "
+        "pipeline: wall clock minus window end at that query's last "
+        "emitted window, labeled query=<subscriber label> — attributes "
+        "shared-pipeline lag to the individual query (the aggregate "
+        "dnz_emit_event_lag_ms histogram sums over subscribers)",
+    ),
     "dnz_slice_rows_total": (
         "counter",
         "rows folded into shared slice partials by a SliceWindowExec — "

@@ -23,6 +23,26 @@ both sides' watermarks), after which it is evicted — and, for outer joins,
 emitted unmatched at eviction/EOS.  Both children are pumped by threads so a
 slow side cannot stall the other (the reference relies on tokio task
 scheduling for the same property).
+
+Two extensions ride the same probe (docs/joins.md):
+
+- **Hot-key sub-partitioning** (PanJoin-style skew adaptation): a
+  celebrity key's chain walk costs one numpy iteration per retained
+  duplicate — O(chain) serialization.  When the closed-loop policy
+  (obs/doctor/actions.py) names a key hot from the intern-time
+  Space-Saving sketch, :meth:`_SideState.adapt` migrates that key's
+  rows out of the hash chains into a dense contiguous block
+  (:class:`_HotStore`, SoA like SessionTable), and probes against it
+  become one mask + one contiguous multi-arange gather.  Cold keys keep
+  the chain path untouched; ``fold`` re-chains a decayed key.  Pair
+  ORDER is part of the operator contract — probe-major, newest-first
+  per probe row — and both layouts produce it exactly, so an adapted
+  run's emissions are byte-identical to the unadapted oracle.
+- **Band (interval) predicates**: ``left_expr - right_expr ∈ [lower,
+  upper]`` evaluated per side at insert into a cached per-row value
+  array, then applied to the equi pairs as one vectorized filter
+  BEFORE any row gather — the enrichment/temporal-correlation shape
+  (``ts BETWEEN a AND b``) costs index arithmetic, not materialization.
 """
 
 from __future__ import annotations
@@ -44,12 +64,250 @@ from denormalized_tpu.logical.plan import JoinKind
 from denormalized_tpu.ops.interner import GroupInterner
 from denormalized_tpu.physical.base import (
     EOS,
+    WM_ANNOUNCE,
     EndOfStream,
     ExecOperator,
     Marker,
     StreamItem,
     WatermarkHint,
 )
+
+
+class _HotStore:
+    """Dense hot-key sub-partitions for one join side.
+
+    One pooled int64 row-id buffer holds every hot key's block as a
+    contiguous run with slack (CSR-with-slack, SoA like SessionTable's
+    slot table): per slot ``(gid, start, len, cap)``, plus a gid→slot
+    ``lookup`` array sized like the side's ``head``.  Appends write
+    in-place into the slack; a full block relocates to the pool tail
+    with doubled capacity (amortized O(1) per appended row).  Block
+    rows are ALWAYS ascending global row ids — migration selects rows
+    in insert order and appends only ever add newer rows — which is
+    what lets a snapshot carry one representative row per block and
+    restore rebuild the exact layout.
+    """
+
+    __slots__ = (
+        "pool", "used", "slot_gid", "slot_start", "slot_len", "slot_cap",
+        "nslots", "lookup",
+    )
+
+    def __init__(self) -> None:
+        # zeros, not empty: cross-thread accounting reads (state_info's
+        # hot attribution) may race a relocation and observe slack —
+        # zero is a VALID row id that degrades to stale numbers, where
+        # uninitialized garbage would index out of bounds
+        self.pool = np.zeros(1024, dtype=np.int64)
+        self.used = 0
+        self.slot_gid = np.full(8, -1, dtype=np.int64)
+        self.slot_start = np.zeros(8, dtype=np.int64)
+        self.slot_len = np.zeros(8, dtype=np.int64)
+        self.slot_cap = np.zeros(8, dtype=np.int64)
+        self.nslots = 0
+        self.lookup = np.full(1024, -1, dtype=np.int64)  # gid -> slot
+
+    # -- bookkeeping -----------------------------------------------------
+    def ensure_gids(self, max_gid: int) -> None:
+        cap = len(self.lookup)
+        if max_gid < cap:
+            return
+        while cap <= max_gid:
+            cap *= 2
+        new = np.full(cap, -1, dtype=np.int64)
+        new[: len(self.lookup)] = self.lookup
+        self.lookup = new
+
+    def contains(self, gid: int) -> bool:
+        return 0 <= gid < len(self.lookup) and self.lookup[gid] >= 0
+
+    def gids(self) -> np.ndarray:
+        return self.slot_gid[: self.nslots].copy()
+
+    def rows_total(self) -> int:
+        return int(self.slot_len[: self.nslots].sum())
+
+    def rows_all(self) -> np.ndarray:
+        """Every hot row id (per-slot order, slots concatenated)."""
+        if self.nslots == 0:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate([
+            self.pool[self.slot_start[s]: self.slot_start[s]
+                      + self.slot_len[s]]
+            for s in range(self.nslots)
+        ])
+
+    def reps(self) -> list[int]:
+        """One representative row id (the block's OLDEST row) per
+        non-empty block — with the ascending-row-id invariant, a block
+        is fully reconstructible from the gid its representative
+        carries, so this is all a snapshot needs to persist."""
+        return [
+            int(self.pool[self.slot_start[s]])
+            for s in range(self.nslots)
+            if self.slot_len[s] > 0
+        ]
+
+    def clear(self) -> None:
+        if self.nslots:
+            self.lookup[self.slot_gid[: self.nslots]] = -1
+        self.slot_gid[: self.nslots] = -1
+        self.slot_len[: self.nslots] = 0
+        self.nslots = 0
+        self.used = 0
+
+    # -- growth ----------------------------------------------------------
+    def _compact(self) -> None:
+        """Repack every block contiguous at the head of a fresh pool
+        (reclaims relocation holes and removed blocks' slack).  Blocks
+        are not position-ordered — relocations move them to the tail —
+        so repacking copies into a new buffer, never in place."""
+        need = int(
+            np.maximum(64, 2 * self.slot_len[: self.nslots]).sum()
+        )
+        if need > len(self.pool):
+            return  # not enough room even compacted — caller grows
+        new_pool = np.zeros(len(self.pool), dtype=np.int64)
+        new_start = self.slot_start.copy()
+        new_used = 0
+        for s in range(self.nslots):
+            ln = int(self.slot_len[s])
+            cap = max(64, 2 * ln)
+            new_pool[new_used: new_used + ln] = self.pool[
+                self.slot_start[s]: self.slot_start[s] + ln
+            ]
+            new_start[s] = new_used
+            self.slot_cap[s] = cap
+            new_used += cap
+        # publish whole arrays (never mutate the live ones in place):
+        # a racing accounting read sees either layout, or a brief
+        # new-pool/old-starts mix whose row ids are stale-but-bounded
+        self.pool = new_pool
+        self.slot_start = new_start
+        self.used = new_used
+
+    def _ensure_pool(self, extra: int) -> None:
+        if self.used + extra <= len(self.pool):
+            return
+        live = self.rows_total()
+        if live + 2 * extra + 64 * max(self.nslots, 1) <= len(self.pool) // 2:
+            self._compact()
+            if self.used + extra <= len(self.pool):
+                return
+        cap = len(self.pool)
+        while self.used + extra > cap:
+            cap *= 2
+        new = np.zeros(cap, dtype=np.int64)
+        new[: self.used] = self.pool[: self.used]
+        self.pool = new
+
+    def _ensure_slots(self) -> None:
+        if self.nslots < len(self.slot_gid):
+            return
+        cap = 2 * len(self.slot_gid)
+        for name in ("slot_gid", "slot_start", "slot_len", "slot_cap"):
+            old = getattr(self, name)
+            new = np.full(cap, -1, dtype=np.int64) if name == "slot_gid" \
+                else np.zeros(cap, dtype=np.int64)
+            new[: self.nslots] = old[: self.nslots]
+            setattr(self, name, new)
+
+    # -- mutation --------------------------------------------------------
+    def adopt(self, gid: int, rows: np.ndarray) -> None:
+        """Open a block for ``gid`` with the given (ascending) rows."""
+        n = len(rows)
+        cap = max(64, 2 * n)
+        self._ensure_pool(cap)
+        self._ensure_slots()
+        s = self.nslots
+        start = self.used
+        self.pool[start: start + n] = rows
+        self.slot_gid[s] = gid
+        self.slot_start[s] = start
+        self.slot_len[s] = n
+        self.slot_cap[s] = cap
+        self.used += cap
+        self.nslots += 1
+        self.ensure_gids(gid)
+        self.lookup[gid] = s
+
+    def append(self, slot: int, rows: np.ndarray) -> None:
+        """Append (ascending, newer-than-existing) rows to a block,
+        relocating it to the tail with doubled capacity when full."""
+        n = len(rows)
+        ln = int(self.slot_len[slot])
+        if ln + n > self.slot_cap[slot]:
+            cap = max(64, 2 * (ln + n))
+            self._ensure_pool(cap)
+            old = self.pool[
+                self.slot_start[slot]: self.slot_start[slot] + ln
+            ].copy()
+            start = self.used
+            self.pool[start: start + ln] = old
+            self.slot_start[slot] = start
+            self.slot_cap[slot] = cap
+            self.used += cap
+        start = int(self.slot_start[slot])
+        self.pool[start + ln: start + ln + n] = rows
+        self.slot_len[slot] = ln + n
+
+    def remove(self, gid: int) -> np.ndarray:
+        """Close a block and return its rows (ascending); the pool hole
+        is reclaimed by the next compaction."""
+        s = int(self.lookup[gid])
+        rows = self.pool[
+            self.slot_start[s]: self.slot_start[s] + self.slot_len[s]
+        ].copy()
+        self.lookup[gid] = -1
+        last = self.nslots - 1
+        if s != last:
+            for name in ("slot_gid", "slot_start", "slot_len", "slot_cap"):
+                getattr(self, name)[s] = getattr(self, name)[last]
+            self.lookup[self.slot_gid[s]] = s
+        self.slot_gid[last] = -1
+        self.slot_len[last] = 0
+        self.nslots = last
+        return rows
+
+    # -- probe kernels (pinned loop-free in hotpaths.toml) ---------------
+    def slot_of(self, gids: np.ndarray) -> np.ndarray:
+        """Per-probe-row hot slot index (-1 = cold), bounds-safe for
+        gids past the lookup's current capacity."""
+        lk = self.lookup
+        safe = np.minimum(gids.astype(np.int64), len(lk) - 1)
+        return np.where(gids < len(lk), lk[safe], -1)
+
+    def probe_pairs(
+        self, slots: np.ndarray, p_idx: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """All (probe_row, build_row) pairs for hot probe rows: one
+        multi-arange over the contiguous blocks — probe-major, newest
+        build row first per probe row (the chain walk's per-key order),
+        so hot and cold pairs interleave into one deterministic
+        contract."""
+        lens = self.slot_len[slots]
+        nz = lens > 0
+        if not nz.all():
+            slots = slots[nz]
+            p_idx = p_idx[nz]
+            lens = lens[nz]
+        total = int(lens.sum())
+        if total == 0:
+            e = np.empty(0, dtype=np.int64)
+            return e, e.copy()
+        pp = np.repeat(p_idx, lens)
+        ends = np.cumsum(lens)
+        k = np.arange(total, dtype=np.int64) - np.repeat(ends - lens, lens)
+        bstart = np.repeat(self.slot_start[slots], lens)
+        blen = np.repeat(lens, lens)
+        bb = self.pool[bstart + (blen - 1 - k)]
+        return pp, bb
+
+    def nbytes(self) -> int:
+        """Live accounting bytes: hot row ids only (pool slack and the
+        gid lookup are capacity, deliberately excluded so the number is
+        restore-invariant like all state_info fields)."""
+        return self.rows_total() * int(self.pool.itemsize)
 
 
 class _SideState:
@@ -64,13 +322,15 @@ class _SideState:
         "row_ri",
         "row_gid",
         "matched",
+        "row_band",
+        "hot",
         "count",
         "watermark",
         "src_watermarks",
         "done",
     )
 
-    def __init__(self) -> None:
+    def __init__(self, with_band: bool = False) -> None:
         self.batches: list[RecordBatch] = []  # retained row storage
         self.batch_max_ts: list[int] = []  # cached per-batch max event time
         self.head = np.full(1024, -1, dtype=np.int64)  # gid -> newest row
@@ -79,6 +339,10 @@ class _SideState:
         self.row_ri = np.empty(1024, dtype=np.int32)
         self.row_gid = np.empty(1024, dtype=np.int32)
         self.matched = np.zeros(1024, dtype=bool)
+        # cached band-expression value per row (interval joins); NaN =
+        # null band value, which matches nothing
+        self.row_band = np.empty(1024, dtype=np.float64) if with_band else None
+        self.hot = _HotStore()
         self.count = 0
         self.watermark: int | None = None
         # True once this side's source sent a kind="partition" hint:
@@ -93,7 +357,10 @@ class _SideState:
             return
         while cap < need:
             cap *= 2
-        for name in ("link", "row_bi", "row_ri", "row_gid"):
+        names = ["link", "row_bi", "row_ri", "row_gid"]
+        if self.row_band is not None:
+            names.append("row_band")
+        for name in names:
             old = getattr(self, name)
             new = np.empty(cap, dtype=old.dtype)
             new[: self.count] = old[: self.count]
@@ -135,8 +402,15 @@ class _SideState:
         last[:-1] = first[1:]
         self.head[gs[last]] = rs[last]
 
-    def insert(self, batch: RecordBatch, gids: np.ndarray) -> None:
-        """Append a batch and chain its rows — no per-row Python."""
+    def insert(
+        self,
+        batch: RecordBatch,
+        gids: np.ndarray,
+        band_vals: np.ndarray | None = None,
+    ) -> None:
+        """Append a batch and chain its rows — no per-row Python.  Rows
+        whose key holds a hot sub-partition append to that block instead
+        of the chains."""
         n = len(gids)
         self._ensure_rows(n)
         self.ensure_gids(int(gids.max()) if n else 0)
@@ -156,8 +430,32 @@ class _SideState:
         self.row_ri[base : base + n] = np.arange(n, dtype=np.int32)
         self.row_gid[base : base + n] = gids
         self.matched[base : base + n] = False
+        if self.row_band is not None:
+            self.row_band[base : base + n] = band_vals
         self.count += n
-        self._chain(gids, np.arange(base, base + n, dtype=np.int64))
+        rows = np.arange(base, base + n, dtype=np.int64)
+        if self.hot.nslots:
+            slots = self.hot.slot_of(gids)
+            hm = slots >= 0
+            if hm.any():
+                self._append_hot(slots[hm], rows[hm])
+                rows = rows[~hm]
+                gids = gids[~hm]
+        self._chain(gids, rows)
+
+    def _append_hot(self, slots: np.ndarray, rows: np.ndarray) -> None:
+        """Route a batch's hot rows into their blocks: one segmented
+        pass grouping by slot (iterates DISTINCT hot keys present in
+        the batch — a handful — never rows)."""
+        order = np.argsort(slots, kind="stable")
+        ss = slots[order]
+        rr = rows[order]
+        bounds = np.nonzero(
+            np.concatenate(([True], ss[1:] != ss[:-1]))
+        )[0]
+        ends = np.append(bounds[1:], len(ss))
+        for b0, b1 in zip(bounds.tolist(), ends.tolist()):
+            self.hot.append(int(ss[b0]), rr[b0:b1])
 
     def rebuild(
         self,
@@ -167,11 +465,15 @@ class _SideState:
         bis: np.ndarray,
         ris: np.ndarray,
         matched: np.ndarray,
+        band: np.ndarray | None = None,
     ) -> None:
-        """Replace all chained state with the given rows (insert order)."""
+        """Replace all chained state with the given rows (insert order).
+        Hot sub-partitions are cleared — callers that keep keys hot
+        re-adopt them via :meth:`rehot` right after."""
         self.batches = batches
         self.batch_max_ts = batch_max_ts
         self.head.fill(-1)
+        self.hot.clear()
         self.count = 0
         m = len(gids)
         self._ensure_rows(m)
@@ -181,12 +483,83 @@ class _SideState:
         self.row_ri[:m] = ris
         self.row_gid[:m] = gids
         self.matched[:m] = matched
+        if self.row_band is not None:
+            self.row_band[:m] = band
         self.count = m
         self._chain(gids, np.arange(m, dtype=np.int64))
 
+    # -- hot-key sub-partitioning ---------------------------------------
+    def adapt(self, gid: int) -> bool:
+        """Migrate one key's rows out of the hash chains into a dense
+        hot block.  The chain is unlinked wholesale (``head[gid] = -1``
+        — stale ``link`` entries are unreachable and harmless); block
+        rows are the key's rows in insert order (ascending row ids)."""
+        gid = int(gid)
+        if self.hot.contains(gid):
+            return False
+        rows = np.nonzero(
+            self.row_gid[: self.count] == gid
+        )[0].astype(np.int64)
+        self.hot.adopt(gid, rows)
+        if gid < len(self.head):
+            self.head[gid] = -1
+        return True
+
+    def fold(self, gid: int) -> None:
+        """De-adapt: fold a decayed hot block back into the chains."""
+        gid = int(gid)
+        rows = self.hot.remove(gid)
+        if len(rows):
+            self._chain(
+                np.full(len(rows), gid, dtype=np.int64), rows
+            )
+
+    def rehot(self, hot_gids) -> None:
+        """Re-adopt hot keys after a :meth:`rebuild` renumbered rows
+        (eviction, re-intern, restore): each key's block is exactly its
+        rows in insert order.  ONE membership-mask + grouping pass over
+        ``row_gid`` covers every hot key — eviction already pays one
+        O(rows) rebuild, so re-adoption must not multiply that by the
+        hot-key count."""
+        self.hot.clear()
+        gids_arr = np.unique(np.asarray(list(hot_gids), dtype=np.int64))
+        if len(gids_arr) == 0:
+            return
+        rg = self.row_gid[: self.count].astype(np.int64, copy=False)
+        mark = np.zeros(int(gids_arr.max()) + 1, dtype=bool)
+        mark[gids_arr] = True
+        safe = np.minimum(rg, len(mark) - 1)
+        rows = np.nonzero((rg < len(mark)) & mark[safe])[0].astype(np.int64)
+        # stable grouping keeps each key's rows ascending (insert order)
+        order = np.argsort(rg[rows], kind="stable")
+        rs = rows[order]
+        gs = rg[rows][order]
+        bounds = np.nonzero(
+            np.concatenate(([True], gs[1:] != gs[:-1]))
+        )[0] if len(rs) else np.empty(0, dtype=np.int64)
+        ends = np.append(bounds[1:], len(rs))
+        seen = set()
+        for b0, b1 in zip(bounds.tolist(), ends.tolist()):
+            g = int(gs[b0])
+            seen.add(g)
+            self.hot.adopt(g, rs[b0:b1])
+        for g in gids_arr.tolist():
+            if g not in seen:
+                # a hot key whose rows all evicted keeps its (empty)
+                # block — it stays hot until the policy folds it
+                self.hot.adopt(int(g), np.empty(0, dtype=np.int64))
+        for g in gids_arr.tolist():
+            if g < len(self.head):
+                self.head[g] = -1
+
     def probe(self, gids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """All (probe_row, build_row) pairs for the batch: walk every key
-        chain simultaneously, one hop per iteration."""
+        """All (probe_row, build_row) pairs for the batch, PROBE-MAJOR:
+        ordered by probe row, newest build row first within one probe
+        row.  Cold keys walk every chain simultaneously (one hop per
+        numpy iteration = one per duplicate of the longest chain); hot
+        keys expand their contiguous blocks in one multi-arange.  Both
+        layouts produce the identical order, so adapting a key never
+        changes emissions."""
         n = len(gids)
         safe = np.minimum(gids.astype(np.int64), len(self.head) - 1)
         cur = np.where(gids < len(self.head), self.head[safe], -1)
@@ -202,10 +575,66 @@ class _SideState:
             outs_p.append(p)
             outs_b.append(cur)
             cur = self.link[cur]
-        if not outs_p:
-            e = np.empty(0, dtype=np.int64)
-            return e, e
-        return np.concatenate(outs_p), np.concatenate(outs_b)
+        if outs_p:
+            cp = np.concatenate(outs_p)
+            cb = np.concatenate(outs_b)
+            if len(outs_p) > 1:
+                # the walk yields hop-major; re-order to probe-major
+                # (hop h IS the newest-first rank within a probe row,
+                # so (p, hop) is the contract order).  No sort needed:
+                # hop blocks are nested prefixes of the probe set, so a
+                # pair's destination is start[p] + hop — one bincount +
+                # cumsum + scatter, O(pairs).  Single-hop batches —
+                # every unique-key workload — skip even that.
+                counts = np.bincount(cp, minlength=n)
+                start = np.cumsum(counts) - counts
+                hop_of = np.repeat(
+                    np.arange(len(outs_p), dtype=np.int64),
+                    [len(o) for o in outs_p],
+                )
+                dest = start[cp] + hop_of
+                op_ = np.empty_like(cp)
+                ob_ = np.empty_like(cb)
+                op_[dest] = cp
+                ob_[dest] = cb
+                cp, cb = op_, ob_
+        else:
+            cp = np.empty(0, dtype=np.int64)
+            cb = cp.copy()
+        if not self.hot.nslots:
+            return cp, cb
+        slots = self.hot.slot_of(gids)
+        hm = slots >= 0
+        if not hm.any():
+            return cp, cb
+        hp, hb = self.hot.probe_pairs(
+            slots[hm], np.nonzero(hm)[0].astype(np.int64)
+        )
+        if len(cp) == 0:
+            return hp, hb
+        if len(hp) == 0:
+            return cp, cb
+        return self.merge_pairs(cp, cb, hp, hb)
+
+    @staticmethod
+    def merge_pairs(
+        cp: np.ndarray, cb: np.ndarray, hp: np.ndarray, hb: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Merge two probe-major pair streams over DISJOINT probe rows
+        (a probe row's key is either hot or cold, never both) into one
+        probe-major stream — searchsorted offsets + two scatters, no
+        sort over the combined pair count."""
+        off_c = np.searchsorted(hp, cp)
+        off_h = np.searchsorted(cp, hp)
+        out_p = np.empty(len(cp) + len(hp), dtype=np.int64)
+        out_b = np.empty(len(cp) + len(hp), dtype=np.int64)
+        ic = np.arange(len(cp), dtype=np.int64) + off_c
+        ih = np.arange(len(hp), dtype=np.int64) + off_h
+        out_p[ic] = cp
+        out_b[ic] = cb
+        out_p[ih] = hp
+        out_b[ih] = hb
+        return out_p, out_b
 
     def gather(self, build_rows: np.ndarray) -> RecordBatch:
         """Materialize build-side rows (columns and masks) in order."""
@@ -382,17 +811,36 @@ class _JoinTier:
             return
         sides = self.op._sides
         # (stamp, max_ts, sid, bi) of every resident, spillable batch —
-        # the NEWEST batch of each side stays resident
+        # the NEWEST batch of each side stays resident, and any batch
+        # holding hot sub-partition rows is DEPRIORITIZED: a hot block
+        # is probed every batch by definition, so spilling its storage
+        # would guarantee a reload-per-batch thrash loop.  Hot batches
+        # remain a LAST RESORT (appended after every cold candidate)
+        # rather than excluded outright — a celebrity key present in
+        # every batch must not make the state budget unenforceable and
+        # escalate to permanent backpressure; if the hot tail does get
+        # spilled, the spill-thrashing verdict reports the ping-pong.
         cands = []
+        hot_cands = []
         for sid, side in enumerate(sides):
             newest = len(side.batches) - 1
+            hot_bis: set[int] = set()
+            if side.hot.nslots:
+                ra = side.hot.rows_all()
+                if len(ra):
+                    hot_bis = set(
+                        np.unique(side.row_bi[ra]).tolist()
+                    )
             for bi, b in enumerate(side.batches):
                 if b is None or bi == newest or b.num_rows == 0:
                     continue
-                cands.append(
+                target = hot_cands if bi in hot_bis else cands
+                target.append(
                     (self.touch[sid][bi], side.batch_max_ts[bi], sid, bi)
                 )
         cands.sort()
+        hot_cands.sort()
+        cands += hot_cands
         freed = 0
         spilled_any = False
         from denormalized_tpu.common.errors import StateError
@@ -513,6 +961,9 @@ class StreamingJoinExec(ExecOperator):
         schema: Schema,
         *,
         retention_ms: int = 300_000,
+        band=None,
+        adaptive: bool = True,
+        adapt_interval_s: float = 1.0,
     ) -> None:
         if len(left_keys) != len(right_keys) or not left_keys:
             raise PlanError("join requires equal non-empty key lists")
@@ -524,6 +975,26 @@ class StreamingJoinExec(ExecOperator):
         self.filter_expr = filter_expr
         self.schema = schema
         self.retention_ms = retention_ms
+        # band (interval) predicate: left_expr - right_expr must land in
+        # [lower_ms, upper_ms] for a pair to join (logical.plan.JoinBand)
+        self.band = band
+        if band is not None:
+            if band.lower_ms is None and band.upper_ms is None:
+                raise PlanError(
+                    "join band needs at least one bound (both lower_ms "
+                    "and upper_ms are None)"
+                )
+            for e, side_schema, label in (
+                (band.left_expr, left.schema, "left"),
+                (band.right_expr, right.schema, "right"),
+            ):
+                missing = e.columns_referenced() - set(side_schema.names)
+                if missing:
+                    raise PlanError(
+                        f"join band {label} expression references "
+                        f"{sorted(missing)} not present on the {label} "
+                        "input"
+                    )
         # equi-key dtype compatibility: the shared interner assigns ids per
         # column PATH (numeric dict vs native string), so joining a STRING
         # key against a numeric key would silently collide unrelated ids
@@ -550,7 +1021,41 @@ class StreamingJoinExec(ExecOperator):
         self._sw = statewatch.make_watch("join")
         self._sw_right = statewatch.make_watch("join")
         self._sides = None  # run()'s live (_SideState, _SideState) pair
+        # closed-loop skew adaptation (obs/doctor/actions.py): the policy
+        # runs on the join's own thread between batches.  It needs live
+        # sketches — with metrics disabled make_watch hands out the null
+        # watch, so the adaptive path owns real ones instead (their
+        # update is the same microseconds-per-batch the obs overhead
+        # gate already covers).
+        self._policy = None
+        # policy-owned sketches sample every 4th batch: with metrics off
+        # the pre-adaptive operator fed no sketch at all, and the policy
+        # decides at second granularity — a 1/4 row sample keeps shares
+        # unbiased while cutting the only cold-path cost adaptation adds
+        self._sw_sample = 0
+        self._sw_batches = [0, 0]
+        if adaptive:
+            from denormalized_tpu.obs.doctor.actions import (
+                JoinAdaptationPolicy,
+            )
+
+            self._policy = JoinAdaptationPolicy(
+                interval_s=adapt_interval_s
+            )
+            if not self._sw:
+                self._sw = statewatch.StateWatch("join")
+                self._sw_right = statewatch.StateWatch("join")
+                self._sw_sample = 4
         self._obs_rows_out = obs.counter("dnz_op_rows_out_total", op="join")
+        # adaptation counters pre-bound per (action, side) so the policy
+        # event path allocates nothing (obs handle convention)
+        self._obs_adapt = {
+            (a, s): obs.counter(
+                "dnz_join_adaptations_total", action=a, side=s
+            )
+            for a in ("adapt", "fold")
+            for s in ("left", "right")
+        }
         # re-keying threshold (tests lower it to force the path)
         self._reintern_min = 262_144
         # checkpointing (None = disabled): set by enable_checkpointing
@@ -586,7 +1091,13 @@ class StreamingJoinExec(ExecOperator):
         return [self.left, self.right]
 
     def metrics(self):
-        return dict(self._metrics)
+        m = dict(self._metrics)
+        sides = self._sides
+        if sides is not None:
+            m["hot_keys"] = sum(int(s.hot.nslots) for s in sides)
+        if self._policy is not None:
+            m["adaptations"] = self._policy.adaptations_total
+        return m
 
     def _label(self):
         on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
@@ -605,20 +1116,53 @@ class StreamingJoinExec(ExecOperator):
             side.link.itemsize + side.row_bi.itemsize
             + side.row_ri.itemsize + side.row_gid.itemsize + 1  # matched
         )
+        if side.row_band is not None:
+            per_row += int(side.row_band.itemsize)
         # spilled batches sit as None placeholders: their rows cost the
         # LSM, not RAM — resident accounting skips them
         batch_bytes = sum(
             swm.rb_nbytes(b) for b in side.batches if b is not None
         )
-        live_k = int(np.count_nonzero(side.head >= 0))
+        # hot sub-partitions: counted SEPARATELY (hot_bytes) so the
+        # spill controller's coldest-first ordering can see — and never
+        # evict — an actively-probed hot block.  Restore-invariant:
+        # live hot row ids + each hot row's proportional share of its
+        # batch's bytes (batch membership survives restore exactly).
+        hot_keys = int(side.hot.nslots)
+        hot_rows = side.hot.rows_total()
+        hot_bytes = side.hot.nbytes() + hot_rows * per_row
+        if hot_rows:
+            try:
+                # cross-thread read racing an adaptation/relocation on
+                # the join thread: row ids may be stale — clip them and
+                # degrade to approximate batch attribution, never raise
+                ra = np.clip(side.hot.rows_all(), 0, max(n - 1, 0))
+                cnt = np.bincount(
+                    side.row_bi[ra], minlength=len(side.batches)
+                )
+                for bi in np.nonzero(cnt)[0]:
+                    if bi >= len(side.batches):
+                        break
+                    b = side.batches[int(bi)]
+                    if b is not None and b.num_rows:
+                        hot_bytes += int(
+                            swm.rb_nbytes(b) * (int(cnt[bi]) / b.num_rows)
+                        )
+            except Exception:  # dnzlint: allow(broad-except) accounting reads race the join thread by design (single-writer, lock-free) — a torn hot layout degrades to the index-bytes floor, never raises into /state or a gauge export
+                pass
+        live_k = int(np.count_nonzero(side.head >= 0)) + hot_keys
         oldest = min(side.batch_max_ts) if side.batch_max_ts else None
         return {
             "rows": n,
             "batches": len(side.batches),
             "state_bytes": (
                 batch_bytes + n * per_row + live_k * swm.KEY_EST_BYTES
+                + side.hot.nbytes()
             ),
             "live_keys": live_k,
+            "hot_keys": hot_keys,
+            "hot_rows": hot_rows,
+            "hot_bytes": hot_bytes,
             "oldest_event_ms": oldest,
             "watermark_ms": side.watermark,
         }
@@ -639,12 +1183,19 @@ class StreamingJoinExec(ExecOperator):
             "op": "join",
             "state_bytes": L["state_bytes"] + R["state_bytes"],
             "live_keys": L["live_keys"] + R["live_keys"],
+            "hot_bytes": L["hot_bytes"] + R["hot_bytes"],
+            "hot_keys": L["hot_keys"] + R["hot_keys"],
             "interner_keys_total": len(self._interner),
             "slot_capacity": int(len(sides[0].link) + len(sides[1].link)),
             "slot_live": L["rows"] + R["rows"],
             "retention_unit_ms": self.retention_ms,
             "sides": {"left": L, "right": R},
         }
+        if self._policy is not None:
+            info["adaptations"] = {
+                "total": self._policy.adaptations_total,
+                "recent": list(self._policy.events)[-8:],
+            }
         if wms and olds:
             info["watermark_ms"] = min(wms)
             info["oldest_event_ms"] = min(olds)
@@ -670,6 +1221,43 @@ class StreamingJoinExec(ExecOperator):
     def _gids_of(self, batch: RecordBatch, names: list[str]) -> np.ndarray:
         return self._interner.intern([batch.column(n) for n in names])
 
+    def _band_vals(self, batch: RecordBatch, is_left: bool) -> np.ndarray:
+        """One side's band-expression values for a batch, as float64
+        with NaN where the expression reads a null (NaN compares False
+        against both bounds, so null band values match nothing)."""
+        from denormalized_tpu.common.columns import as_numpy
+        from denormalized_tpu.logical.expr import column_validity
+
+        e = self.band.left_expr if is_left else self.band.right_expr
+        v = np.asarray(as_numpy(e.eval(batch)), dtype=np.float64)
+        m = column_validity(e, batch)
+        if m is not None and not m.all():
+            v = v.copy()
+            v[~np.asarray(m, dtype=bool)] = np.nan
+        return v
+
+    def _band_keep(
+        self,
+        probe_band: np.ndarray,
+        p_idx: np.ndarray,
+        build: _SideState,
+        b_rows: np.ndarray,
+        probe_is_left: bool,
+    ) -> np.ndarray:
+        """Vectorized band filter over equi-probe pairs — pure index
+        arithmetic on the cached per-row band values, BEFORE any row
+        gather materializes candidates."""
+        pv = probe_band[p_idx]
+        bv = build.row_band[b_rows]
+        diff = pv - bv if probe_is_left else bv - pv
+        lo = self.band.lower_ms
+        hi = self.band.upper_ms
+        if lo is not None and hi is not None:
+            return (diff >= lo) & (diff <= hi)
+        if lo is not None:
+            return diff >= lo
+        return diff <= hi
+
     def _probe(
         self,
         probe_batch: RecordBatch,
@@ -678,16 +1266,26 @@ class StreamingJoinExec(ExecOperator):
         probe_is_left: bool,
         probe_base: int,
         probe_side: _SideState,
+        probe_band: np.ndarray | None = None,
     ) -> RecordBatch | None:
         """Join a new batch against the opposite side's table.  Rows are
-        marked 'matched' (for outer-join bookkeeping) only AFTER the join
-        filter accepts the pair — an equi-hit rejected by the filter must
-        still surface as unmatched in an outer join.  ``probe_base`` is the
-        probe side's row count BEFORE this batch inserts (its rows' global
-        ids)."""
+        marked 'matched' (for outer-join bookkeeping) only AFTER the band
+        and the join filter accept the pair — an equi-hit rejected by
+        either must still surface as unmatched in an outer join.
+        ``probe_base`` is the probe side's row count BEFORE this batch
+        inserts (its rows' global ids)."""
         p_idx, b_rows = build.probe(probe_gids)
         if len(p_idx) == 0:
             return None
+        if self.band is not None:
+            kb = self._band_keep(
+                probe_band, p_idx, build, b_rows, probe_is_left
+            )
+            if not kb.all():
+                p_idx = p_idx[kb]
+                b_rows = b_rows[kb]
+            if len(p_idx) == 0:
+                return None
         if self._existence and self.filter_expr is None:
             # no pair materializes downstream and no filter reads one:
             # the index arrays alone decide existence
@@ -801,6 +1399,7 @@ class StreamingJoinExec(ExecOperator):
 
         keep_rows = ~row_dropped
         remap_bi = np.cumsum(~drop_set) - 1  # old bi -> new bi
+        hot_gids = side.hot.gids() if side.hot.nslots else None
         side.rebuild(
             [b for bi, b in enumerate(side.batches) if not drop_set[bi]],
             [
@@ -812,7 +1411,15 @@ class StreamingJoinExec(ExecOperator):
             remap_bi[side.row_bi[:n][keep_rows]].astype(np.int32),
             side.row_ri[:n][keep_rows].copy(),
             side.matched[:n][keep_rows].copy(),
+            band=(
+                side.row_band[:n][keep_rows].copy()
+                if side.row_band is not None else None
+            ),
         )
+        if hot_gids is not None:
+            # eviction renumbered rows but not gids: re-adopt each hot
+            # key's (possibly now empty) block so it stays hot
+            side.rehot(hot_gids)
         if self._tier is not None:
             self._tier.evict_remap(side, drop_set, remap_bi)
         return unmatched
@@ -859,6 +1466,12 @@ class StreamingJoinExec(ExecOperator):
         for side_id, side in enumerate(sides):
             names = self.left_keys if side_id == 0 else self.right_keys
             n = side.count
+            # hot blocks survive a re-intern via representative rows:
+            # row ids are stable here (same batches, same order), only
+            # gid VALUES change — a rep row's new gid names its key.
+            # Empty blocks have no rep and lose hot status (the policy
+            # re-adapts them if they warm again).
+            hot_reps = side.hot.reps() if side.hot.nslots else None
             if side.batches:
                 gids = np.concatenate(
                     [self._gids_of(b, names) for b in side.batches]
@@ -875,7 +1488,13 @@ class StreamingJoinExec(ExecOperator):
                 side.row_bi[:n].copy(),
                 side.row_ri[:n].copy(),
                 side.matched[:n].copy(),
+                band=(
+                    side.row_band[:n].copy()
+                    if side.row_band is not None else None
+                ),
             )
+            if hot_reps:
+                side.rehot(np.unique(gids[np.asarray(hot_reps)]))
 
     def _emits_unmatched(self, is_left: bool) -> bool:
         if self.kind is JoinKind.FULL:
@@ -959,6 +1578,16 @@ class StreamingJoinExec(ExecOperator):
                 )
                 if spilled:
                     arrays[f"s{sid}_row_gid"] = side.row_gid[:n].copy()
+                if side.row_band is not None:
+                    arrays[f"s{sid}_band"] = side.row_band[:n].copy()
+            if side.hot.nslots:
+                # hot sub-partitions ride the snapshot as one
+                # representative row index per non-empty block: with the
+                # ascending-row-id invariant the whole block rebuilds
+                # from the rep's gid after restore (epoch-consistent —
+                # this runs at the aligned marker on the join thread,
+                # never racing an adaptation)
+                side_meta["hot_reps"] = side.hot.reps()
             meta["sides"].append(side_meta)
         coord.put_snapshot(key, epoch, pack_snapshot(meta, arrays))
 
@@ -1080,6 +1709,16 @@ class StreamingJoinExec(ExecOperator):
             new_bi = np.cumsum(
                 np.concatenate(([True], bis[1:] != bis[:-1]))
             ) - 1
+            band = None
+            if self.band is not None:
+                band = arrays.get(f"s{sid}_band")
+                if band is None:
+                    # snapshot predates the band predicate (plan gained
+                    # one since the cut): re-derive from the resident
+                    # rows — expression eval is deterministic
+                    band = np.concatenate(
+                        [self._band_vals(b, sid == 0) for b in batches]
+                    ) if batches else np.empty(0, dtype=np.float64)
             side.rebuild(
                 batches,
                 [batch_max_ts[int(bis[b0])] for b0 in bounds],
@@ -1087,7 +1726,13 @@ class StreamingJoinExec(ExecOperator):
                 new_bi.astype(np.int32),
                 ris,
                 arrays[f"s{sid}_matched"].astype(bool),
+                band=band,
             )
+            reps = side_meta.get("hot_reps") or []
+            if reps:
+                side.rehot(
+                    np.unique(gids[np.asarray(reps, dtype=np.int64)])
+                )
 
     def _restore_v2(self, coord, key, meta, arrays, sides) -> None:
         """Restore a cold-tier snapshot: the interner and per-row gids
@@ -1171,6 +1816,18 @@ class StreamingJoinExec(ExecOperator):
             run_bi = np.cumsum(
                 np.concatenate(([True], bis[1:] != bis[:-1]))
             ) - 1
+            band = None
+            if self.band is not None:
+                band = arrays.get(f"s{sid}_band")
+                if band is None:
+                    from denormalized_tpu.common.errors import StateError
+
+                    raise StateError(
+                        "banded join restoring a cold-tier snapshot "
+                        "without band values — the snapshot predates "
+                        "the band predicate and spilled rows cannot be "
+                        "re-evaluated"
+                    )
             side.rebuild(
                 batches,
                 [batch_max_ts[int(bis[b0])] for b0 in bounds],
@@ -1178,7 +1835,13 @@ class StreamingJoinExec(ExecOperator):
                 run_bi.astype(np.int32),
                 ris,
                 arrays[f"s{sid}_matched"].astype(bool),
+                band=band,
             )
+            reps = side_meta.get("hot_reps") or []
+            if reps:
+                side.rehot(
+                    np.unique(gids[np.asarray(reps, dtype=np.int64)])
+                )
         if self._tier is not None:
             self._tier.align_touch(sides)
             self._tier._write_manifest()
@@ -1187,7 +1850,8 @@ class StreamingJoinExec(ExecOperator):
     def run(self) -> Iterator[StreamItem]:
         from denormalized_tpu.runtime.pump import spawn_pump
 
-        sides = (_SideState(), _SideState())
+        with_band = self.band is not None
+        sides = (_SideState(with_band), _SideState(with_band))
         self._sides = sides  # state observatory reads these pull-style
         if self._ckpt is not None:
             self._restore(sides)
@@ -1211,6 +1875,16 @@ class StreamingJoinExec(ExecOperator):
         # only ever engages when markers flow, i.e. with checkpointing on.
         blocked = [False, False]
         pending: deque[tuple[int, StreamItem]] = deque()
+        # downstream event-time contract: joined rows can be as old as
+        # the eviction horizon (a retained row matches a fresh probe),
+        # so a downstream window advancing on raw batch mins would
+        # late-drop legitimate pairs.  When the sources themselves hint
+        # (partition mode) the hint-forwarding branch below covers it;
+        # for batch-min-driven sides the join ANNOUNCES hint mode before
+        # its first output and emits the joint low watermark
+        # (min(watermarks) − retention) whenever it advances.
+        wm_announced = False
+        wm_emitted: int | None = None
         try:
             while not (sides[0].done and sides[1].done):
                 if pending and not (blocked[0] or blocked[1]):
@@ -1323,19 +1997,34 @@ class StreamingJoinExec(ExecOperator):
                 gids = self._gids_of(
                     batch, self.left_keys if is_left else self.right_keys
                 )
-                (self._sw if is_left else self._sw_right).update(gids)
+                nb = self._sw_batches[side_id]
+                self._sw_batches[side_id] = nb + 1
+                if not self._sw_sample or nb % self._sw_sample == 0:
+                    (self._sw if is_left else self._sw_right).update(gids)
+                band_vals = (
+                    self._band_vals(batch, is_left)
+                    if self.band is not None else None
+                )
                 # insert BEFORE probing: the probe targets the OTHER side
                 # (no self-match risk) and the matched[] marks it writes for
                 # this batch's rows must not be cleared by a later insert
                 probe_base = side.count
-                side.insert(batch, gids)
+                side.insert(batch, gids, band_vals)
                 if self._tier is not None:
                     self._tier.note_insert(side_id, batch)
                 out = self._probe(
-                    batch, gids, other, is_left, probe_base, side
+                    batch, gids, other, is_left, probe_base, side,
+                    band_vals,
                 )
                 self._note_batch(t0_batch, batch.num_rows)
                 if out is not None:
+                    if not wm_announced:
+                        # switch downstream to hint-driven watermarks
+                        # BEFORE any joined rows: from here on the join's
+                        # own clamped hints are the only advance, so old
+                        # (still co-retained) pairs can never late-drop
+                        wm_announced = True
+                        yield WatermarkHint(WM_ANNOUNCE, kind="partition")
                     self._metrics["rows_out"] += out.num_rows
                     self._obs_rows_out.add(out.num_rows)
                     yield out
@@ -1348,8 +2037,25 @@ class StreamingJoinExec(ExecOperator):
                     if side.watermark is None or bmin > side.watermark:
                         side.watermark = bmin
                 yield from self._evict_horizon(sides)
+                if (
+                    wm_announced
+                    and sides[0].watermark is not None
+                    and sides[1].watermark is not None
+                ):
+                    low = (
+                        min(sides[0].watermark, sides[1].watermark)
+                        - self.retention_ms
+                    )
+                    if wm_emitted is None or low > wm_emitted:
+                        wm_emitted = low
+                        yield WatermarkHint(low, kind="partition")
                 if self._tier is not None:
                     self._tier.maybe_spill()
+                if self._policy is not None:
+                    # closed loop: the adaptation policy runs on the
+                    # join's own thread between batches (layout
+                    # mutations never race the probe) at its own cadence
+                    self._policy.maybe_tick(self, sides)
             # EOS: flush unmatched for outer joins
             for s, l in ((sides[0], True), (sides[1], False)):
                 if self._emits_unmatched(l):

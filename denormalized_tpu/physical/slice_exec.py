@@ -251,6 +251,16 @@ class SliceWindowExec(ExecOperator):
         self._obs_folds = obs.counter("dnz_slice_folds_total")
         self._obs_fold_ms = obs.histogram("dnz_slice_fold_ms")
         self._obs_slice_subs.set(len(self._subs))
+        # per-subscriber emit lag: the aggregate histogram above sums
+        # over subscribers, so a slow query hiding inside a shared
+        # pipeline was unattributable — one gauge per query fixes that
+        self._obs_mq_emit_lag = [
+            obs.gauge(
+                "dnz_mq_emit_lag_ms",
+                query=sub.label if sub.label is not None else f"q{q}",
+            )
+            for q, sub in enumerate(self._subs)
+        ]
 
     # ------------------------------------------------------------------
     @property
@@ -489,6 +499,10 @@ class SliceWindowExec(ExecOperator):
         gids = np.nonzero(active)[0].astype(np.int32)
         finals = sa.finalize(sub.agg_specs, rows, active)
         batch = self._assemble_emission(sub, j, gids, finals)
+        if self._obs_mq_emit_lag[q]:
+            self._obs_mq_emit_lag[q].set(
+                time.time() * 1000.0 - (j * sub.slide_ms + sub.length_ms)
+            )
         self._obs_fold_ms.observe((time.perf_counter() - t0) * 1e3)
         self._metrics["windows_emitted"] += 1
         if self._tagged:

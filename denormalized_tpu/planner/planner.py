@@ -204,6 +204,10 @@ class Planner:
             jkw = {}
             if self.config is not None:
                 jkw["retention_ms"] = self.config.join_retention_ms
+                jkw["adaptive"] = bool(self.config.join_adaptive)
+                jkw["adapt_interval_s"] = (
+                    self.config.join_adapt_interval_s
+                )
             return StreamingJoinExec(
                 left,
                 right,
@@ -212,6 +216,7 @@ class Planner:
                 node.right_keys,
                 node.filter,
                 node.schema,
+                band=node.band,
                 **jkw,
             )
         if isinstance(node, lp.Sink):

@@ -1,0 +1,514 @@
+"""Interval/range (banded) join predicates (ISSUE 15).
+
+Semantics under test (docs/joins.md): a pair joins iff the equi keys
+match AND ``left_expr - right_expr`` lands in ``[lower_ms, upper_ms]``
+inclusive (None = open bound), evaluated per side BEFORE pair
+materialization; null band values match nothing; ``lower > upper`` is a
+legal empty band; matches only exist while both rows are co-retained
+(the retention clip).  The differential oracle is a brute-force
+nested-loop join — including a deterministic-drive case at the
+band == retention edge and late (out-of-order) rows on both sides.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from denormalized_tpu.api.context import Context, EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.logical.expr import col
+from denormalized_tpu.sources.memory import MemorySource
+
+T0 = 1_700_000_000_000
+
+L_SCHEMA = Schema([
+    Field("ts", DataType.TIMESTAMP_MS, nullable=False),
+    Field("k", DataType.STRING, nullable=False),
+    Field("lv", DataType.INT64),
+])
+R_SCHEMA = Schema([
+    Field("ts2", DataType.TIMESTAMP_MS, nullable=False),
+    Field("k2", DataType.STRING, nullable=False),
+    Field("rv", DataType.INT64),
+])
+
+
+def _ctx(**kw):
+    kw.setdefault("join_retention_ms", 10**9)
+    return Context(EngineConfig(
+        join_adaptive=True, join_adapt_interval_s=0.0, **kw
+    ))
+
+
+def _streams(ctx, L, R):
+    left = ctx.from_source(
+        MemorySource.from_batches(L, timestamp_column="ts"), name="il"
+    )
+    right = ctx.from_source(
+        MemorySource.from_batches(R, timestamp_column="ts2"), name="ir"
+    )
+    return left, right
+
+
+def _mk(schema, rows, masks=None):
+    cols = list(zip(*rows)) if rows else [[], [], []]
+    arrs = [
+        np.asarray(cols[0], dtype=np.int64),
+        np.asarray(cols[1], dtype=object),
+        np.asarray(cols[2], dtype=np.int64),
+    ]
+    return RecordBatch(schema, arrs, masks)
+
+
+def _got(res):
+    return sorted(zip(
+        np.asarray(res.column("ts")).tolist(),
+        [str(x) for x in np.asarray(res.column("k"), dtype=object)],
+        np.asarray(res.column("lv")).tolist(),
+        np.asarray(res.column("ts2")).tolist(),
+        np.asarray(res.column("rv")).tolist(),
+    ))
+
+
+def _nested_loop(L_rows, R_rows, lo, hi, l_band=None, r_band=None):
+    """Brute-force oracle: all key-equal pairs whose band difference
+    lands inclusively in [lo, hi]; None band value matches nothing."""
+    out = []
+    for (lts, lk, lv) in L_rows:
+        for (rts, rk, rv) in R_rows:
+            if lk != rk:
+                continue
+            bl = lts if l_band is None else l_band((lts, lk, lv))
+            br = rts if r_band is None else r_band((rts, rk, rv))
+            if bl is None or br is None:
+                continue
+            d = bl - br
+            if lo is not None and d < lo:
+                continue
+            if hi is not None and d > hi:
+                continue
+            out.append((lts, lk, lv, rts, rv))
+    return sorted(out)
+
+
+def test_band_inclusive_bounds_and_one_sided():
+    L = [[(T0 + 0, "a", 1), (T0 + 10, "a", 2), (T0 + 20, "b", 3)]]
+    R = [[(T0 + 5, "a", 10), (T0 + 10, "a", 20), (T0 + 25, "b", 30)]]
+    Lr = [r for b in L for r in b]
+    Rr = [r for b in R for r in b]
+    for lo, hi in [(-5, 5), (0, 0), (None, 0), (0, None), (-100, 100)]:
+        ctx = _ctx()
+        left, right = _streams(
+            ctx, [_mk(L_SCHEMA, b) for b in L], [_mk(R_SCHEMA, b) for b in R]
+        )
+        res = left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", lo, hi)
+        ).collect()
+        assert _got(res) == _nested_loop(Lr, Rr, lo, hi), (lo, hi)
+
+
+def test_empty_band_matches_nothing():
+    L = [[(T0, "a", 1)]]
+    R = [[(T0, "a", 2)]]
+    ctx = _ctx()
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, b) for b in L], [_mk(R_SCHEMA, b) for b in R]
+    )
+    res = left.join(
+        right, "inner", ["k"], ["k2"], band=("ts", "ts2", 10, -10)
+    ).collect()
+    assert res.num_rows == 0
+
+
+def test_band_needs_a_bound():
+    from denormalized_tpu.common.errors import PlanError
+
+    ctx = _ctx()
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, [(T0, "a", 1)])],
+        [_mk(R_SCHEMA, [(T0, "a", 2)])],
+    )
+    with pytest.raises(PlanError, match="at least one bound"):
+        left.join(
+            right, "inner", ["k"], ["k2"],
+            band=("ts", "ts2", None, None),
+        ).collect()
+
+
+def test_null_band_values_never_match():
+    """Null band-column cells (validity mask) match nothing, on either
+    side and under one-sided bounds."""
+    L_rows = [(T0, "a", 1), (T0 + 1, "a", 2)]
+    R_rows = [(T0, "a", 10), (T0 + 1, "a", 20)]
+    lmask = [None, None, np.array([True, False])]   # lv null in row 1
+    rmask = [None, None, np.array([False, True])]   # rv null in row 0
+    for lo, hi in [(-10**6, 10**6), (None, 10**6)]:
+        ctx = _ctx()
+        left, right = _streams(
+            ctx,
+            [_mk(L_SCHEMA, L_rows, lmask)],
+            [_mk(R_SCHEMA, R_rows, rmask)],
+        )
+        res = left.join(
+            right, "inner", ["k"], ["k2"],
+            band=(col("lv"), col("rv"), lo, hi),
+        ).collect()
+        want = _nested_loop(
+            L_rows, R_rows, lo, hi,
+            l_band=lambda r: r[2] if r[2] != 2 else None,
+            r_band=lambda r: r[2] if r[2] != 10 else None,
+        )
+        assert _got(res) == want
+
+
+def test_join_on_lowers_between_to_band():
+    """``l.ts >= r.ts - a  AND  l.ts <= r.ts + b`` conjuncts in join_on
+    lower to ONE JoinBand (visible in the plan) and produce exactly the
+    explicit band API's result."""
+    rng = np.random.default_rng(3)
+    L = [[(T0 + int(t), f"k{rng.integers(4)}", int(v))
+          for t, v in zip(rng.integers(0, 500, 40), range(40))]]
+    R = [[(T0 + int(t), f"k{rng.integers(4)}", int(v))
+          for t, v in zip(rng.integers(0, 500, 40), range(40))]]
+
+    ctx = _ctx()
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, b) for b in L], [_mk(R_SCHEMA, b) for b in R]
+    )
+    ds = left.join_on(right, "inner", [
+        col("k") == col("k2"),
+        col("ts") >= col("ts2") - 50,
+        col("ts") <= col("ts2") + 30,
+    ])
+    band = ds._plan.band
+    assert band is not None
+    assert band.lower_ms == -50 and band.upper_ms == 30
+    assert ds.optimized_plan().band is not None  # survives the optimizer
+    got = _got(ds.collect())
+
+    ctx2 = _ctx()
+    left2, right2 = _streams(
+        ctx2, [_mk(L_SCHEMA, b) for b in L], [_mk(R_SCHEMA, b) for b in R]
+    )
+    want = _got(left2.join(
+        right2, "inner", ["k"], ["k2"], band=("ts", "ts2", -50, 30)
+    ).collect())
+    assert got == want
+    Lr = [r for b in L for r in b]
+    Rr = [r for b in R for r in b]
+    assert got == _nested_loop(Lr, Rr, -50, 30)
+
+
+def test_band_differential_seeded_nested_loop():
+    """Seeded random feeds with LATE (out-of-order) rows on both sides:
+    with retention effectively infinite, the operator must equal the
+    pure nested-loop oracle for every band shape."""
+    rng = np.random.default_rng(11)
+    cases = [(-40, 40), (0, 120), (None, 0), (-7, None), (60, 10)]
+    for seed, (lo, hi) in enumerate(cases):
+        r = np.random.default_rng(seed)
+
+        def feed(sd):
+            rr = np.random.default_rng(sd)
+            batches = []
+            for b in range(5):
+                n = 60
+                # deliberately unsorted within AND across batches: both
+                # sides late relative to each other
+                ts = T0 + rr.integers(0, 2_000, n)
+                ks = np.array(
+                    [f"k{i}" for i in rr.integers(0, 6, n)], dtype=object
+                )
+                vs = rr.integers(0, 1000, n)
+                batches.append([
+                    (int(t), str(k), int(v))
+                    for t, k, v in zip(ts, ks, vs)
+                ])
+            return batches
+
+        Lb, Rb = feed(seed * 2 + 1), feed(seed * 2 + 2)
+        ctx = _ctx()
+        left, right = _streams(
+            ctx,
+            [_mk(L_SCHEMA, b) for b in Lb],
+            [_mk(R_SCHEMA, b) for b in Rb],
+        )
+        res = left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", lo, hi)
+        ).collect()
+        Lr = [x for b in Lb for x in b]
+        Rr = [x for b in Rb for x in b]
+        assert _got(res) == _nested_loop(Lr, Rr, lo, hi), (seed, lo, hi)
+
+
+def _sequential_pump(monkeypatch):
+    """Deterministic drive: pump threads enqueue strictly in spawn
+    order (all of the left source, then all of the right), so eviction
+    timing — and therefore retention-edge matches — is reproducible."""
+    import threading
+
+    from denormalized_tpu.runtime import pump as pump_mod
+
+    real_put = pump_mod.checked_put
+    threads: list[threading.Thread] = []
+
+    def fake_spawn(q, done, items, sentinel, wrap=lambda x: x):
+        idx = len(threads)
+
+        def run():
+            if idx:
+                threads[idx - 1].join()
+            try:
+                for item in items():
+                    if not real_put(q, done, wrap(item)):
+                        return
+            finally:
+                real_put(q, done, sentinel)
+
+        th = threading.Thread(target=run, daemon=True)
+        threads.append(th)
+        th.start()
+        return th
+
+    monkeypatch.setattr(pump_mod, "spawn_pump", fake_spawn)
+
+
+def test_band_at_retention_edge_deterministic(monkeypatch):
+    """band width == retention: matches at exactly the retention
+    horizon are clipped by whole-batch eviction.  Under the sequential
+    drive the eviction schedule is reproducible, so the oracle models
+    it exactly: when a right batch probes, the horizon is
+    min(final-left-watermark, right-watermark-so-far) - retention and
+    left batches wholly below it are gone."""
+    _sequential_pump(monkeypatch)
+    retention = 400
+    rng = np.random.default_rng(5)
+
+    def feed(sd, nb=6, n=50):
+        rr = np.random.default_rng(sd)
+        t = T0
+        out = []
+        for _ in range(nb):
+            ts = np.sort(t + rr.integers(0, 200, n))
+            t += 200
+            ks = np.array(
+                [f"k{i}" for i in rr.integers(0, 4, n)], dtype=object
+            )
+            out.append([
+                (int(a), str(k), int(v))
+                for a, k, v in zip(ts, ks, rr.integers(0, 100, n))
+            ])
+        return out
+
+    Lb, Rb = feed(1), feed(2)
+    ctx = _ctx(join_retention_ms=retention, partition_watermarks=False)
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, b) for b in Lb], [_mk(R_SCHEMA, b) for b in Rb]
+    )
+    res = left.join(
+        right, "inner", ["k"], ["k2"],
+        band=("ts", "ts2", -retention, retention),
+    ).collect()
+
+    # oracle: left fully ingested first (no eviction: right watermark is
+    # unset), then each right batch probes retained left batches before
+    # its own eviction sweep
+    wmL = max(min(r[0] for r in b) for b in Lb)
+    retained = [(b, max(r[0] for r in b)) for b in Lb]
+    wmR = None
+    want = []
+    for rb in Rb:
+        for (rts, rk, rv) in rb:
+            for lb, _mx in retained:
+                for (lts, lk, lv) in lb:
+                    d = lts - rts
+                    if lk == rk and -retention <= d <= retention:
+                        want.append((lts, lk, lv, rts, rv))
+        bmin = min(r[0] for r in rb)
+        wmR = bmin if wmR is None or bmin > wmR else wmR
+        horizon = min(wmL, wmR) - retention
+        retained = [(lb, mx) for lb, mx in retained if mx >= horizon]
+    assert _got(res) == sorted(want)
+    assert len(want) > 50
+
+
+def test_outer_join_band_rejected_pairs_emit_unmatched():
+    """LEFT join: an equi-hit rejected by the band must still surface
+    as an unmatched (null-padded) left row at EOS."""
+    L = [[(T0, "a", 1), (T0 + 500, "a", 2)]]
+    R = [[(T0 + 2, "a", 10)]]
+    ctx = _ctx()
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, b) for b in L], [_mk(R_SCHEMA, b) for b in R]
+    )
+    res = left.join(
+        right, "left", ["k"], ["k2"], band=("ts", "ts2", -10, 10)
+    ).collect()
+    rows = {}
+    for i in range(res.num_rows):
+        lv = int(res.column("lv")[i])
+        rv_mask = res.mask("rv")
+        matched = bool(rv_mask[i]) if rv_mask is not None else True
+        rows[lv] = matched
+    # row lv=1 in band -> matched pair; lv=2 out of band -> unmatched
+    assert rows == {1: True, 2: False}
+
+
+def test_banded_join_kill_restore_byte_identical(tmp_path):
+    """Band values ride the snapshot: a restored banded join continues
+    exactly (no re-derivation drift, spilled-row-safe layout)."""
+    from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.physical.base import Marker
+    from denormalized_tpu.physical.simple_execs import CollectSink
+    from denormalized_tpu.runtime import executor
+    from denormalized_tpu.state.checkpoint import wire_checkpointing
+    from denormalized_tpu.state.lsm import close_global_state_backend
+    from denormalized_tpu.state.orchestrator import Orchestrator
+
+    rng = np.random.default_rng(9)
+
+    def feed(sd, nb=24, n=80):
+        rr = np.random.default_rng(sd)
+        t = T0
+        out = []
+        for _ in range(nb):
+            ts = np.sort(t + rr.integers(0, 300, n))
+            t += 300
+            ks = np.array(
+                [f"k{i}" for i in rr.integers(0, 5, n)], dtype=object
+            )
+            out.append([
+                (int(a), str(k), int(v))
+                for a, k, v in zip(ts, ks, rr.integers(0, 100, n))
+            ])
+        return out
+
+    Lb, Rb = feed(1), feed(2)
+
+    def mk(path):
+        ctx = Context(EngineConfig(
+            checkpoint=path is not None,
+            checkpoint_interval_s=9999,
+            state_backend_path=path,
+            join_adaptive=True,
+            join_adapt_interval_s=0.0,
+        ))
+        left, right = _streams(
+            ctx,
+            [_mk(L_SCHEMA, b) for b in Lb],
+            [_mk(R_SCHEMA, b) for b in Rb],
+        )
+        return ctx, left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", -50, 50)
+        )
+
+    _ctx_g, ds_g = mk(None)
+    golden = set(_got(ds_g.collect()))
+
+    state_dir = str(tmp_path / "state")
+    ctx_a, ds_a = mk(state_dir)
+    sink_a = CollectSink()
+    root_a = executor.build_physical(lp.Sink(ds_a._plan, sink_a), ctx_a)
+    orch = Orchestrator(interval_s=9999)
+    coord = wire_checkpointing(root_a, ctx_a, orch)
+    it = root_a.run()
+    seen = 0
+    committed = False
+    for item in it:
+        if isinstance(item, RecordBatch):
+            seen += 1
+        if seen == 1:
+            orch.trigger_now()
+            seen += 1
+        if isinstance(item, Marker):
+            coord.commit(item.epoch)
+            committed = True
+            break
+    assert committed, "sources drained before the checkpoint trigger"
+    it.close()
+    close_global_state_backend()
+    emitted_a = [
+        r for b in sink_a.batches for r in _got(b)
+    ]
+
+    ctx_b, ds_b = mk(state_dir)
+    sink_b = CollectSink()
+    root_b = executor.build_physical(lp.Sink(ds_b._plan, sink_b), ctx_b)
+    orch_b = Orchestrator(interval_s=9999)
+    coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+    assert coord_b.committed_epoch is not None
+    join_b = root_b.input_op
+    for _ in root_b.run():
+        pass
+    # band values restored from the snapshot arrays (not re-derived)
+    assert all(
+        s.row_band is not None for s in join_b._sides
+    )
+    emitted_b = [r for b in sink_b.batches for r in _got(b)]
+    combined = set(emitted_a) | set(emitted_b)
+    assert combined == golden
+    close_global_state_backend()
+
+
+# -- hypothesis property (clean skip when the dep is absent) --------------
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - env without hypothesis
+    HAVE_HYPOTHESIS = False
+
+
+if HAVE_HYPOTHESIS:
+
+    @st.composite
+    def _band_case(draw):
+        nkeys = draw(st.integers(1, 5))
+        span = draw(st.integers(1, 1500))
+
+        def rows(n):
+            return [
+                (
+                    T0 + draw(st.integers(0, span)),
+                    f"k{draw(st.integers(0, nkeys - 1))}",
+                    draw(st.integers(0, 50)),
+                )
+                for _ in range(n)
+            ]
+
+        L = [rows(draw(st.integers(0, 25))) for _ in range(draw(st.integers(1, 3)))]
+        R = [rows(draw(st.integers(0, 25))) for _ in range(draw(st.integers(1, 3)))]
+        lo = draw(st.one_of(st.none(), st.integers(-span, span)))
+        hi = draw(st.one_of(st.none(), st.integers(-span, span)))
+        if lo is None and hi is None:
+            hi = 0
+        return L, R, lo, hi
+
+    @settings(max_examples=25, deadline=None)
+    @given(_band_case())
+    def test_band_property_matches_nested_loop(case):
+        L, R, lo, hi = case
+        if not any(b for b in L) and not any(b for b in R):
+            return
+        ctx = _ctx()
+        left, right = _streams(
+            ctx,
+            [_mk(L_SCHEMA, b) for b in L],
+            [_mk(R_SCHEMA, b) for b in R],
+        )
+        res = left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", lo, hi)
+        ).collect()
+        Lr = [x for b in L for x in b]
+        Rr = [x for b in R for x in b]
+        assert _got(res) == _nested_loop(Lr, Rr, lo, hi)
+
+else:
+
+    @pytest.mark.skip(reason="hypothesis not installed in this environment")
+    def test_band_property_matches_nested_loop():
+        pass

@@ -474,3 +474,52 @@ def test_slice_metrics_and_state_info():
     assert info["op"] == "slice_window"
     assert info["live_keys"] == 6
     assert info["state_bytes"] > 0
+
+
+def test_per_subscriber_emit_lag_gauge():
+    """ROADMAP item-2e residue: each subscriber of a shared pipeline
+    gets its own dnz_mq_emit_lag_ms{query=} gauge, so shared-pipeline
+    lag is attributable per query."""
+    from denormalized_tpu import obs
+    from denormalized_tpu.obs.registry import MetricsRegistry
+    from denormalized_tpu.physical.slice_exec import (
+        SliceSubscriber,
+        SliceWindowExec,
+    )
+    from denormalized_tpu.runtime.multi_query import drive_shared
+    from denormalized_tpu.state.checkpoint import walk
+
+    reg = MetricsRegistry(enabled=True)
+    with obs.bound_registry(reg):
+        batches = _batches(seed=31)
+        ctx = Context(EngineConfig())
+        base = ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"),
+            name="feed",
+        )
+        outs: dict[int, int] = {}
+        from denormalized_tpu.planner.sharing import detect_sharing
+        from denormalized_tpu.runtime.multi_query import build_shared_root
+
+        q1 = base.window(["k"], AGGS, 2000, 1000)
+        q2 = base.window(["k"], AGGS, 3000, 1000)
+        groups = detect_sharing([q1._plan, q2._plan])
+        shared = [g for g in groups if g.shared]
+        assert len(shared) == 1 and len(shared[0].members) == 2
+        root = build_shared_root(
+            ctx, shared[0], labels=["alpha", "beta"]
+        )
+        drive_shared(root, [
+            lambda b: outs.__setitem__(0, outs.get(0, 0) + b.num_rows),
+            lambda b: outs.__setitem__(1, outs.get(1, 0) + b.num_rows),
+        ])
+        assert set(outs) == {0, 1}
+    snap = reg.snapshot()
+    lag_series = {
+        k: v for k, v in snap.items()
+        if k.startswith("dnz_mq_emit_lag_ms")
+    }
+    assert any('query="alpha"' in k for k in lag_series), lag_series
+    assert any('query="beta"' in k for k in lag_series), lag_series
+    # both queries emitted, so both gauges carry a real lag sample
+    assert all(v != 0 for v in lag_series.values())

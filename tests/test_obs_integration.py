@@ -72,7 +72,7 @@ SESSION_KEYS = {
     "rows_in", "sessions_emitted", "late_rows", "salvage_rows_scanned",
 }
 UDAF_KEYS = {"rows_in", "windows_emitted", "late_rows"}
-JOIN_KEYS = {"rows_out", "evicted"}
+JOIN_KEYS = {"rows_out", "evicted", "hot_keys", "adaptations"}
 
 
 def test_collect_metrics_window_pipeline_keys(make_batch, registry):

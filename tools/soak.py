@@ -16,9 +16,10 @@ should demonstrate it surviving repetition.
 Design:
 - The child process runs the chosen pipeline — ``simple`` (1s tumbling
   count/min/max/avg by key), ``sliding`` (1s/250ms, 4-way emission
-  fan-out), ``join`` (two independent streams windowed then
-  inner-joined on (key, window): join state rides the same checkpoint
-  barriers), ``session`` (300ms-gap session windows over a bursty
+  fan-out), ``join`` (a raw fact stream — skewed + late mid-run —
+  band-joined to a per-second dimension stream then windowed: the
+  closed-loop skew policy adapts the celebrity key live and SIGKILLs
+  land mid-adaptation, docs/joins.md), ``session`` (300ms-gap session windows over a bursty
   feed: exact session bounds verified — the operator the reference
   left ``todo!()``), or ``udaf`` (stateful Python accumulator on the
   host-frame path: state()/merge() snapshots) — over a DETERMINISTIC
@@ -178,22 +179,75 @@ def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
     _merge_tumbling(agg, uniq, cnts, mins, maxs, sums)
 
 
-def golden_update_join(agg: dict, i: int, batch_rows: int, pace: float):
-    """Fold batch i of BOTH streams into {(ws, key): [cnt_l, sum_l,
-    cnt_r, sum_r]} — the join emits (avg_t, avg_h) per (window, key)
-    present on both sides (at this pace every key is in every window).
-    Vectorized per group like golden_update."""
-    for off, seed in ((0, SEED_LEFT), (2, SEED_RIGHT)):
-        ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=seed)
-        ws = (ts // WINDOW_MS) * WINDOW_MS
-        uniq, cnts, [[sums]] = _group_reduce(
-            ws * N_KEYS + keys, [(vals, [np.add])]
-        )
-        for u, c, sm in zip(uniq.tolist(), cnts.tolist(), sums.tolist()):
-            w, k = divmod(u, N_KEYS)
-            a = agg.setdefault((w, f"sensor_{k}"), [0, 0.0, 0, 0.0])
-            a[off] += c
-            a[off + 1] += sm
+# -- skew-adaptive interval-join soak feed (ISSUE 15) --------------------
+# The join pipeline is a raw fact stream band-joined to a sparse
+# per-second dimension stream, then windowed: every fact row matches
+# EXACTLY the dim row of its key and event-second (band
+# fact.ts − dim.ts ∈ [0, WINDOW_MS−1]), so the golden is a pure
+# per-(window, key) fold of the fact feed plus the deterministic dim
+# value.  A mid-run slice of the feed is SKEWED (one celebrity key takes
+# JOIN_HOT_SHARE of the rows — long build chains, the closed-loop
+# policy's trigger) and periodically LATE (rows shifted back
+# JOIN_LATE_MS, still inside the join retention, and safe downstream
+# because the join forwards its watermark clamped by retention).
+JOIN_SKEW_START_FRAC = 0.30
+JOIN_SKEW_END_FRAC = 0.70
+JOIN_HOT_SHARE = 0.6
+JOIN_LATE_EVERY = 7
+JOIN_LATE_FRAC = 0.1
+JOIN_LATE_MS = 2500
+JOIN_BAND_MS = WINDOW_MS
+JOIN_RETENTION_MS = 6000
+
+
+def join_skew_slice(total_batches: int) -> tuple[int, int]:
+    return (
+        int(total_batches * JOIN_SKEW_START_FRAC),
+        int(total_batches * JOIN_SKEW_END_FRAC),
+    )
+
+
+def join_batch_arrays(
+    i: int, batch_rows: int, pace: float, total_batches: int
+):
+    """Fact-side batch i: ``batch_arrays`` plus the skewed + late
+    mid-run slice.  Deterministic in (i, total_batches) — parent golden
+    and child source share it."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+    lo, hi = join_skew_slice(total_batches)
+    if lo <= i < hi:
+        rng = np.random.default_rng(77_000_003 + i)
+        hot = rng.random(batch_rows) < JOIN_HOT_SHARE
+        keys = np.where(hot, 0, keys)
+        if (i - lo) % JOIN_LATE_EVERY == 0 and i > lo:
+            late = rng.random(batch_rows) < JOIN_LATE_FRAC
+            ts = np.where(late, ts - JOIN_LATE_MS, ts)
+    return ts, keys, vals
+
+
+def dim_value(k: int, second: int) -> float:
+    """The dimension stream's deterministic enrichment value for key k
+    during event-second ``second`` (T0-relative)."""
+    return round((second % 97) * 1.5 + k * 0.25, 4)
+
+
+def golden_update_join(
+    agg: dict, i: int, batch_rows: int, pace: float, total_batches: int
+):
+    """Fold fact batch i into {(ws, key): [cnt, sum]} — with the
+    exactly-one dim match per fact row, the joined window aggregate is
+    count(fact rows), avg(fact readings), and the (constant within the
+    window) dim value.  Vectorized per group like golden_update."""
+    ts, keys, vals = join_batch_arrays(i, batch_rows, pace, total_batches)
+    ws = (ts // WINDOW_MS) * WINDOW_MS
+    uniq, cnts, [[sums]] = _group_reduce(
+        ws * N_KEYS + keys, [(vals, [np.add])]
+    )
+    for u, c, sm in zip(uniq.tolist(), cnts.tolist(), sums.tolist()):
+        w, k = divmod(u, N_KEYS)
+        a = agg.setdefault((w, f"sensor_{k}"), [0, 0.0])
+        a[0] += c
+        a[1] += sm
 
 
 SLIDE_MS = 250  # 1000ms window / 250ms slide = 4-way emission fan-out
@@ -581,9 +635,14 @@ def child_main() -> None:
                         RecordBatch.empty(schema), "occurred_at_ms",
                         fallback_ms=int(time.time() * 1000),
                     )
-            ts, keys, vals = batch_arrays(
-                self._i, batch_rows, pace, seed=self._seed
-            )
+            if pipeline == "join":
+                ts, keys, vals = join_batch_arrays(
+                    self._i, batch_rows, pace, total_batches
+                )
+            else:
+                ts, keys, vals = batch_arrays(
+                    self._i, batch_rows, pace, seed=self._seed
+                )
             if pipeline == "session":
                 ts = burst_ts(ts)
             self._i += 1
@@ -809,29 +868,101 @@ def child_main() -> None:
             SESSION_GAP_MS,
         )
     elif pipeline == "join":
-        # the bench 'join' shape: two independent streams, windowed avg
-        # each, inner-joined on (key, window) — the join operator's state
-        # (both sides' retained windows + matched flags) rides the same
-        # checkpoint barriers the window state does
+        # skew-adaptive interval join (ISSUE 15, docs/joins.md): a raw
+        # fact stream — skewed + late mid-run (join_batch_arrays) —
+        # band-joined to a sparse per-second dimension stream on the
+        # sensor key (fact.ts − dim.ts ∈ [0, WINDOW_MS−1]: exactly the
+        # dim row of the fact row's event-second), then windowed.  The
+        # skew slice builds celebrity chains on the fact side, the
+        # closed-loop policy sub-partitions the hot key live (visible in
+        # the telemetry as dnz_join_adaptations_total), kills land while
+        # hot blocks are live, and the restored child rebuilds them from
+        # the snapshot's representative rows.
+        cfg.join_retention_ms = JOIN_RETENTION_MS
+        dim_user = Schema([
+            Field("dim_at_ms", DataType.INT64, nullable=False),
+            Field("dim_sensor", DataType.STRING, nullable=False),
+            Field("w", DataType.FLOAT64),
+        ])
+        dim_schema = canonicalize_schema(dim_user)
+        dim_seconds = -(-total_batches * batch_rows // int(pace)) + 1
+        t0_sec = T0 // 1000
+
+        class DimPartition(PartitionReader):
+            """One batch per event-second: N_KEYS enrichment rows at
+            the second's absolute boundary, value = dim_value(k, s).
+            Paced at one batch per wall second; restore fast-forwards
+            by batch index like SoakPartition."""
+
+            def __init__(self):
+                self._i = 0
+                self._anchor_wall = None
+                self._anchor_i = 0
+
+            def read(self, timeout_s=None):
+                if self._i >= dim_seconds:
+                    return None
+                now = time.monotonic()
+                if self._anchor_wall is None:
+                    self._anchor_wall = now
+                    self._anchor_i = self._i
+                due = self._anchor_wall + (self._i - self._anchor_i)
+                if now < due:
+                    time.sleep(min(due - now, timeout_s or (due - now)))
+                    if time.monotonic() < due:
+                        return attach_canonical_timestamp(
+                            RecordBatch.empty(dim_user), "dim_at_ms",
+                            fallback_ms=int(time.time() * 1000),
+                        )
+                s = self._i
+                self._i += 1
+                ts = np.full(
+                    N_KEYS, (t0_sec + s) * 1000, dtype=np.int64
+                )
+                vals = np.array(
+                    [dim_value(k, s) for k in range(N_KEYS)]
+                )
+                b = RecordBatch(dim_user, [ts, key_names.copy(), vals])
+                return attach_canonical_timestamp(
+                    b, "dim_at_ms", fallback_ms=int(time.time() * 1000)
+                )
+
+            def offset_snapshot(self):
+                return {"i": self._i}
+
+            def offset_restore(self, snap):
+                self._i = int(snap["i"])
+                self._anchor_wall = None
+
+        class DimSource(Source):
+            name = "soak_dim"
+
+            @property
+            def schema(self):
+                return dim_schema
+
+            def partitions(self):
+                return [DimPartition()]
+
+            @property
+            def unbounded(self):
+                return False
+
         left = ctx.from_source(
-            SoakSource(SEED_LEFT, "soak_t"), name="soak_t"
-        ).window(
-            ["sensor_name"], [F.avg(col("reading")).alias("avg_t")],
-            WINDOW_MS,
+            SoakSource(SEED_LEFT, "soak_fact"), name="soak_fact"
         )
-        right = (
-            ctx.from_source(SoakSource(SEED_RIGHT, "soak_h"), name="soak_h")
-            .window(
-                ["sensor_name"], [F.avg(col("reading")).alias("avg_h")],
-                WINDOW_MS,
-            )
-            .with_column_renamed("sensor_name", "hs")
-            .with_column_renamed("window_start_time", "hws")
-            .with_column_renamed("window_end_time", "hwe")
-        )
+        right = ctx.from_source(DimSource(), name="soak_dim")
         ds = left.join(
-            right, "inner",
-            ["sensor_name", "window_start_time"], ["hs", "hws"],
+            right, "inner", ["sensor_name"], ["dim_sensor"],
+            band=("occurred_at_ms", "dim_at_ms", 0, JOIN_BAND_MS - 1),
+        ).window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.avg(col("reading")).alias("avg_t"),
+                F.avg(col("w")).alias("avg_h"),
+            ],
+            WINDOW_MS,
         )
     else:
         ds = ctx.from_source(SoakSource(SEED_LEFT, "soak"), name="soak").window(
@@ -1032,6 +1163,7 @@ def child_main() -> None:
                         "t": round(now, 3),
                         "ws": int(ws[i]),
                         "key": str(names[i]),
+                        "count": int(batch.column("count")[i]),
                         "avg_t": round(float(batch.column("avg_t")[i]), 4),
                         "avg_h": round(float(batch.column("avg_h")[i]), 4),
                     }
@@ -1071,7 +1203,7 @@ def child_main() -> None:
                 **{k: sums[k] for k in (
                     "late_rows", "rows_out", "rows_in", "batches_out",
                     "prefetch_restarts", "prefetch_restarted_partitions",
-                    "salvaged_rows",
+                    "salvaged_rows", "hot_keys", "adaptations",
                 ) if k in sums},
             }) + "\n")
         except Exception:
@@ -1160,7 +1292,7 @@ def read_emissions(paths):
         if occ:
             dupes += 1
         if "avg_t" in o:  # join pipeline record
-            vals = (o["avg_t"], o["avg_h"])
+            vals = (o["count"], o["avg_t"], o["avg_h"])
         elif "we" in o:  # session record: bounds + aggregates
             vals = (o["count"], o["min"], o["max"],
                     o["avg"], o["ws"], o["we"])
@@ -1216,6 +1348,8 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
 
     finals_emit, finals_wm = [], []
     timeline: list = []
+    adapt_timeline: list = []
+    adapt_by_seg: list = []
     n_snaps = 0
     segs_reporting = 0
     peak_state = 0.0
@@ -1233,6 +1367,38 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
         # timeline per SEGMENT: each killed child restarts its counters
         # from zero, so the delta baseline must reset with it
         timeline += R.counter_timeline(snaps, "dnz_fault_injections_total")
+        # closed-loop adaptation events (dnz_join_adaptations_total,
+        # labeled action=adapt|fold + side): same per-segment delta
+        # derivation — a kill while the counter is ahead of its folds
+        # landed MID-ADAPTATION (hot sub-partitions live at the cut)
+        seg_adapt = R.counter_timeline(
+            snaps, "dnz_join_adaptations_total"
+        )
+        adapt_timeline += seg_adapt
+        final_counts: dict = {}
+        for snap in snaps:
+            vals = {
+                k: v for k, v in snap.get("metrics", {}).items()
+                if k.startswith("dnz_join_adaptations_total")
+                and isinstance(v, (int, float))
+            }
+            if vals:
+                final_counts = vals
+        if final_counts:
+            adapts = sum(
+                v for k, v in final_counts.items()
+                if 'action="adapt"' in k
+            )
+            folds = sum(
+                v for k, v in final_counts.items()
+                if 'action="fold"' in k
+            )
+            adapt_by_seg.append({
+                "segment": seg_i + 1,
+                "adapt": round(adapts),
+                "fold": round(folds),
+                "hot_blocks_live_at_end": round(adapts - folds) > 0,
+            })
         # state observatory: peak total state bytes across the segment's
         # snapshots, and the segment's final top-K hot keys (the
         # dnz_state_hot_key_share gauges a stateful operator refreshes)
@@ -1288,6 +1454,7 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
                 ],
             })
     timeline.sort(key=lambda e: e["t"] or 0)
+    adapt_timeline.sort(key=lambda e: e["t"] or 0)
     emit = R.merge_histogram(finals_emit)
     wm = R.merge_histogram(finals_wm)
     tele: dict = {
@@ -1295,6 +1462,12 @@ def derive_telemetry(obs_paths, anchor_epoch_ms=None) -> dict:
         "snapshots": n_snaps,
         "fault_timeline": timeline,
     }
+    if adapt_timeline or adapt_by_seg:
+        tele["adaptations"] = {
+            "events": adapt_timeline,
+            "by_segment": adapt_by_seg,
+            "total": sum(s["adapt"] + s["fold"] for s in adapt_by_seg),
+        }
     if peak_state:
         tele["peak_state_bytes"] = round(peak_state)
     if peak_spilled:
@@ -1891,7 +2064,9 @@ def main():
 
     golden: dict = {}
     _fold = {
-        "join": golden_update_join,
+        "join": lambda agg, i, br, pc: golden_update_join(
+            agg, i, br, pc, total_batches
+        ),
         "session": golden_update_session,
         "sliding": golden_update_sliding,
     }.get(args.pipeline, golden_update)  # udaf golden == tumbling fold
@@ -2001,11 +2176,6 @@ def main():
                 k: v for k, v in wins.items()
                 if k[0] <= kafka_last_close_ws
             }
-        if args.pipeline == "join" and not aborted:
-            # an inner join correctly emits nothing for a (window, key)
-            # present on only one stream — drop one-sided golden entries
-            # (also prevents a zero-division on the absent side's count)
-            golden = {k: g for k, g in golden.items() if g[0] and g[2]}
         if args.pipeline == "session" and not aborted:
             # golden keys are (burst second, key); emissions key on the
             # session START (min ts in the burst) — remap for comparison
@@ -2022,8 +2192,18 @@ def main():
                     lost.append(k)
                     continue
                 if args.pipeline == "join":
-                    cl, sl, cr, sr = g
-                    want = (round(sl / cl, 4), round(sr / cr, 4))
+                    cnt, sm = g
+                    # exactly-one dim match per fact row: count and avg
+                    # come from the fact fold, avg_h is the window's
+                    # (constant) deterministic dim value
+                    want = (
+                        cnt,
+                        round(sm / cnt, 4),
+                        dim_value(
+                            int(k[1].rsplit("_", 1)[1]),
+                            k[0] // 1000 - T0 // 1000,
+                        ),
+                    )
                 elif args.pipeline == "session":
                     cnt, mn, mx, sm, t0, t1 = g
                     want = (cnt, round(mn, 4), round(mx, 4),
