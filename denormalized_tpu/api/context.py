@@ -222,6 +222,14 @@ class EngineConfig:
     # aggregate UNION carries min/max always sorts, so an add-only
     # member's byte-identity oracle sets this True to match.
     slice_sort_lane: bool = False
+    # predicate-subsumption sharing in the multi-query runtime: a query
+    # whose filter is provably implied by another's (conjunct
+    # containment over equality/range/IN bounds — planner/predicates.py)
+    # joins that query's share group, ingesting once under the weakest
+    # member predicate with a vectorized residual re-filter per
+    # stronger member.  False restores exact-signature matching only
+    # (the pre-subsumption behavior; the bench's A/B control).
+    mq_subsumption: bool = True
 
     # persistent XLA compilation cache (jax_compilation_cache_dir): the
     # engine prewarms its program ladders at stream start, which on a

@@ -234,11 +234,29 @@ class BinaryExpr(Expr):
 
         l = as_numpy(self.left.eval(batch))
         r = as_numpy(self.right.eval(batch))
-        if self.op in _CMP and (
-            getattr(l, "dtype", None) == object or getattr(r, "dtype", None) == object
-        ):
-            # string comparison: numpy object arrays compare elementwise fine
-            return _BIN_NUMPY[self.op](l, r).astype(bool)
+        l_obj = getattr(l, "dtype", None) == object
+        r_obj = getattr(r, "dtype", None) == object
+        if self.op in _CMP and (l_obj or r_obj):
+            # object lanes carry strings and/or nullable cells.  Any
+            # comparison against a null (None) cell is FALSE — SQL
+            # three-valued logic collapsed to the filter's keep/drop
+            # decision, and the precondition the subsumption-sharing
+            # containment argument rests on (planner/predicates.py:
+            # constrained conjuncts must reject null rows on BOTH
+            # sides of an implication)
+            valid = None
+            for side, is_obj in ((l, l_obj), (r, r_obj)):
+                if not is_obj:
+                    continue
+                m = np.not_equal(side, None).astype(bool)
+                valid = m if valid is None else (valid & m)
+            if bool(valid.all()):
+                return _BIN_NUMPY[self.op](l, r).astype(bool)
+            lv = l[valid] if np.shape(l) == valid.shape else l
+            rv = r[valid] if np.shape(r) == valid.shape else r
+            out = np.zeros(valid.shape, dtype=bool)
+            out[valid] = _BIN_NUMPY[self.op](lv, rv).astype(bool)
+            return out
         return _BIN_NUMPY[self.op](l, r)
 
     def eval_jax(self, cols: dict[str, Any]):

@@ -271,6 +271,30 @@ INSTRUMENTS: dict[str, tuple] = {
         "finalize + emission assembly for one subscriber's window",
         MS_BUCKETS,
     ),
+    # -- query-dense serving: live registration + subsumption (ISSUE 16) -
+    "dnz_mq_subscribers_live": (
+        "gauge",
+        "subscriber queries currently attached to one shared slice "
+        "pipeline — moves on live attach/detach, unlike "
+        "dnz_slice_subscribers it counts the instantaneous registry "
+        "(after mid-stream joins and leaves), not the planning-time set",
+    ),
+    "dnz_mq_backfill_windows_total": (
+        "counter",
+        "windows served to a mid-stream joiner from the slice store's "
+        "RETAINED partials at attach time — each one is a window the "
+        "query got without replaying the stream, exact from the gcd "
+        "slices already covering it",
+    ),
+    "dnz_mq_refilter_ms": (
+        "histogram",
+        "per-batch cost of the residual re-filter masks in a shared "
+        "slice pipeline (predicate-subsumption sharing): evaluating "
+        "each stronger member's own predicate over the batch — or over "
+        "NEW interner keys only on the gid lane — before per-class "
+        "accumulation; observed only when a residual class exists",
+        MS_BUCKETS,
+    ),
     # -- sink (sources/kafka.py KafkaSinkWriter) ------------------------
     "dnz_sink_retries_total": (
         "counter",

@@ -104,6 +104,9 @@ def _route(path: str, method: str) -> tuple[int, str, bytes] | None:
         chains = handle.lineage.chains(
             window_start_ms=int(ws) if ws is not None else None,
             source=src,
+            # a shared pipeline's tracker serves every member query —
+            # filter the view to THIS handle's tagged emissions
+            query=handle.query_id if handle.shared is not None else None,
         )
         return _json_resp(200, {
             "sampled_total": handle.lineage.sampled_total,

@@ -145,11 +145,16 @@ class LineageTracker:
                     rec.flow("lineage", sid, "t", {"node_id": node_id})
 
     # -- emission (stateful operators) ------------------------------------
-    def emitted(self, node_id: str | None, start_ms, end_ms) -> None:
+    def emitted(
+        self, node_id: str | None, start_ms, end_ms, query: str | None = None
+    ) -> None:
         """One emitted window ``[start_ms, end_ms)`` (scalars or equal-
         length arrays for a multi-window sweep, e.g. a session close
         cycle).  Every live sample whose event time the window contains
-        gains an emission link — completing its ingest → emission chain."""
+        gains an emission link — completing its ingest → emission chain.
+        ``query`` tags the link with the subscriber query id when a
+        SHARED pipeline emits for one of its member queries, so one
+        tracker serves every member's ``/lineage`` view."""
         if not self._live_ids or node_id is None:
             return
         starts = np.atleast_1d(np.asarray(start_ms, dtype=np.int64))
@@ -166,13 +171,16 @@ class LineageTracker:
                 if s is None:
                     continue
                 w = int(win[0])
-                s["emissions"].append({
+                link = {
                     "node_id": node_id,
                     "window_start_ms": int(starts[w]),
                     "window_end_ms": int(ends[w]),
                     "wall": now,
                     "emit_lag_ms": round(now * 1000.0 - int(ends[w]), 3),
-                })
+                }
+                if query is not None:
+                    link["query"] = query
+                s["emissions"].append(link)
                 if rec is not None:
                     rec.flow("lineage", sid, "f", {
                         "node_id": node_id,
@@ -182,14 +190,34 @@ class LineageTracker:
 
     # -- read side ---------------------------------------------------------
     def chains(self, window_start_ms: int | None = None,
-               source: str | None = None) -> list[dict]:
+               source: str | None = None,
+               query: str | None = None) -> list[dict]:
         """Assembled chains, optionally filtered to samples that landed
         in the window starting at ``window_start_ms`` (the "why is this
-        window late" lookup) or to one source."""
+        window late" lookup), to one source, or — for a shared pipeline
+        whose tracker serves several member queries — to samples with an
+        emission tagged for ``query`` (untagged emission links, e.g.
+        from a non-shared downstream operator, stay in every member's
+        view)."""
         with self._lock:
             out = [dict(s) for s in self._samples.values()]
         if source is not None:
             out = [s for s in out if s["source"] == source]
+        if query is not None:
+            out = [
+                dict(
+                    s,
+                    emissions=[
+                        e for e in s["emissions"]
+                        if e.get("query") in (None, query)
+                    ],
+                )
+                for s in out
+                if any(
+                    e.get("query") in (None, query)
+                    for e in s["emissions"]
+                ) or not s["emissions"]
+            ]
         if window_start_ms is not None:
             out = [
                 s for s in out

@@ -224,11 +224,18 @@ class QueryHandle:
         if self.shared is not None:
             # shared-operator attribution: this tree serves group_size
             # queries at once, so THIS query's truthful cost share of
-            # every node is weight (= 1/group_size) of the measured
-            # totals — busy, wait, and state split evenly across
-            # subscribers (the documented approximation, same spirit as
-            # the attribution rule's even residual split)
+            # every node is its weight fraction of the measured totals.
+            # With a weight_fn (the slice operator's per-subscriber cost
+            # ledger) that fraction is MEASURED — a subsumption member
+            # paying an expensive residual re-filter shows its real
+            # share; without one, the even 1/group_size split applies.
             w = float(self.shared.get("weight", 1.0))
+            fn = self.shared.get("weight_fn")
+            if fn is not None:
+                try:
+                    w = float(fn())
+                except Exception:  # dnzlint: allow(broad-except) the ledger read races the operator thread like every accounting read above — fall back to the even split
+                    pass
             for k in ("busy_ms", "busy_frac", "input_wait_ms",
                       "input_wait_frac"):
                 n[k] = round(n[k] * w, 4)
@@ -268,7 +275,11 @@ class QueryHandle:
         if self.lineage is not None:
             snap["lineage_samples"] = self.lineage.sampled_total
         if self.shared is not None:
-            snap["shared"] = dict(self.shared)
+            # the weight_fn callable is snapshot machinery, not payload
+            # (the JSON route serializes this dict verbatim)
+            snap["shared"] = {
+                k: v for k, v in self.shared.items() if k != "weight_fn"
+            }
         return snap
 
     def snapshot(self) -> dict:
@@ -435,31 +446,65 @@ def register_shared(
     (the multi-query runtime's registration): each gets its own query
     id and a ``shared`` descriptor with weight ``1/count``, so
     ``/queries/<id>/plan`` and ``/queries/<id>/state`` report that
-    query's truthful cost share of the shared nodes.  The tree is
-    stamped and its state gauges bound ONCE (under the first handle) —
-    the registry must not bind duplicate gauge series per subscriber.
-    Returns [] when the doctor is disabled."""
+    query's truthful cost share of the shared nodes.  When the root
+    measures per-subscriber cost (``shared_fractions()`` — the slice
+    operator's ledger of re-filter + accumulate + fold time), each
+    descriptor also carries a ``weight_fn`` resolving the ACTUAL
+    fraction at snapshot time: under subsumption sharing a member with
+    an expensive residual predicate costs more than 1/N, and the even
+    split would lie.  The tree is stamped and its state gauges bound
+    ONCE (under the first handle) — the registry must not bind
+    duplicate gauge series per subscriber.  One shared LineageTracker
+    (when ``lineage_sample_every`` is set) serves every member: the
+    slice operator tags emissions with the member's query id via the
+    ``_dr_mq_qids`` stamp, and each handle's ``/lineage`` filters to
+    its own.  Returns [] when the doctor is disabled."""
     if config is not None and not getattr(config, "doctor_enabled", True):
         return []
     from denormalized_tpu.state.checkpoint import assign_node_ids
 
     node_ids = assign_node_ids(root)
     qids = [f"q{next(_IDS)}" for _ in range(count)]
+    lineage = None
+    every = getattr(config, "lineage_sample_every", None)
+    if every:
+        from denormalized_tpu.obs.doctor.lineage import LineageTracker
+
+        lineage = LineageTracker(
+            int(every),
+            max_samples=getattr(config, "lineage_max_samples", 256),
+        )
+    fractions = getattr(root, "shared_fractions", None)
+
+    def _weight_fn_for(tag: int):
+        if fractions is None:
+            return None
+
+        def weight() -> float:
+            return float(fractions().get(tag, 1.0 / count))
+
+        return weight
+
     handles = []
     for i, qid in enumerate(qids):
         handles.append(
             QueryHandle(
                 qid, root, node_ids, config=config, registry=registry,
+                lineage=lineage,
                 shared={
                     "group_size": count,
                     "member": i,
                     "weight": 1.0 / count,
+                    "weight_fn": _weight_fn_for(i),
                     "label": labels[i] if labels else None,
                     "group": qids,
                 },
             )
         )
-    _stamp_and_bind(root, node_ids, registry)
+    _stamp_and_bind(root, node_ids, registry, lineage)
+    # subscriber tag → query id, read by the slice operator's emission
+    # hook to tag lineage links per member query
+    root._dr_mq_qids = {i: qid for i, qid in enumerate(qids)}
     with _LOCK:
         for h in handles:
             _RUNNING[h.query_id] = h

@@ -293,6 +293,14 @@ def state_snapshot(handle) -> dict:
         # shared (scaled inputs would silence budget pressure by a
         # factor of N exactly in the high-fan-in case)
         w = float(shared.get("weight", 1.0))
+        fn = shared.get("weight_fn")
+        if fn is not None:
+            # measured per-subscriber fraction (the slice operator's
+            # cost ledger) — see registry.register_shared
+            try:
+                w = float(fn())
+            except Exception:  # dnzlint: allow(broad-except) ledger read races the operator thread — fall back to the even split
+                pass
         for ns in nodes:
             raw = int(ns.get("state_bytes") or 0)
             ns["state_bytes_shared_total"] = raw

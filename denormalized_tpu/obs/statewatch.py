@@ -160,6 +160,13 @@ def _aggregate_gids(g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 # -- Space-Saving heavy hitters ------------------------------------------
 
+#: decay horizon for the JOIN's windowed sketches: one decay step (×½)
+#: every quarter-million rows per side ⇒ a retired celebrity's share
+#: halves every ~256k rows regardless of run length, so the adaptation
+#: policy's fold condition (share below fold_share for hold_ticks) is
+#: reachable in bounded rows.  Other operators keep monotone sketches.
+JOIN_SKETCH_DECAY_ROWS = 1 << 18
+
 
 class SpaceSaving:
     """Vectorized Space-Saving (Metwally et al.) over dense int gids.
@@ -170,16 +177,44 @@ class SpaceSaving:
     their error bound — ``count - err <= true count <= count`` for
     every tracked key.  All numpy, no per-row Python (pinned by
     DNZ-H001 via hotpaths.toml).
+
+    With ``decay_every`` > 0 the sketch is WINDOWED: every
+    ``decay_every`` rows fed, counts, error bounds, and the total are
+    scaled by ``decay_factor`` — an exponential moving window with a
+    half-life of ``decay_every / (1 - decay_factor) * ln2`` rows at the
+    default factor ½.  Shares then track RECENT traffic: a retired
+    celebrity's share decays geometrically instead of only as
+    ``1/total`` growth, so the join adaptation policy's fold trigger
+    fires promptly instead of holding stale heavy hitters for the rest
+    of the run.  Default 0 (off) preserves the monotone sketch every
+    other consumer (skew verdicts, hot-key gauges) was tuned against;
+    the overestimate invariant ``count - err <= true(window)`` is
+    preserved under decay because both sides of the bound scale
+    together.
     """
 
-    __slots__ = ("keys", "counts", "errs", "total")
+    __slots__ = (
+        "keys", "counts", "errs", "total", "decay_every", "decay_factor",
+        "_since_decay",
+    )
 
-    def __init__(self, capacity: int = 64) -> None:
+    def __init__(
+        self,
+        capacity: int = 64,
+        *,
+        decay_every: int = 0,
+        decay_factor: float = 0.5,
+    ) -> None:
         k = max(int(capacity), 8)
         self.keys = np.full(k, -1, dtype=np.int64)
         self.counts = np.zeros(k, dtype=np.int64)
         self.errs = np.zeros(k, dtype=np.int64)
-        self.total = 0  # rows ever fed (the share denominator)
+        self.total = 0  # rows in the (possibly decayed) window
+        self.decay_every = max(int(decay_every), 0)
+        if not 0.0 < float(decay_factor) < 1.0:
+            raise ValueError("decay_factor must be in (0, 1)")
+        self.decay_factor = float(decay_factor)
+        self._since_decay = 0
 
     def update(self, gids: np.ndarray) -> None:
         g = np.asarray(gids, dtype=np.int64)
@@ -187,12 +222,27 @@ class SpaceSaving:
             return
         self.update_aggregated(*_aggregate_gids(g), len(g))
 
+    def decay(self) -> None:
+        """One decay step: scale counts, errors, and the total by
+        ``decay_factor``; slots decayed to zero free up for new keys
+        (their key stays until evicted — a zero-count slot is the first
+        victim the admission pass picks)."""
+        f = self.decay_factor
+        self.counts = (self.counts * f).astype(np.int64)
+        self.errs = (self.errs * f).astype(np.int64)
+        self.total = int(self.total * f)
+        self._since_decay = 0
+
     def update_aggregated(
         self, u: np.ndarray, c: np.ndarray, rows: int
     ) -> None:
         """Batch update from pre-aggregated (unique gids, counts) —
         the shape :func:`_aggregate_gids` produces once per batch so the
         HLL can share the same reduction."""
+        if self.decay_every:
+            self._since_decay += int(rows)
+            if self._since_decay >= self.decay_every:
+                self.decay()
         self.total += int(rows)
         k = self.keys
         order = np.argsort(k, kind="stable")
@@ -248,6 +298,7 @@ class SpaceSaving:
         self.counts.fill(0)
         self.errs.fill(0)
         self.total = 0
+        self._since_decay = 0
 
 
 # -- HyperLogLog cardinality ---------------------------------------------
@@ -340,10 +391,13 @@ class StateWatch:
     )
 
     def __init__(self, label: str, *, capacity: int = 64,
-                 enabled: bool = True) -> None:
+                 enabled: bool = True, decay_every: int = 0,
+                 decay_factor: float = 0.5) -> None:
         self.label = label
         self.enabled = bool(enabled)
-        self.sketch = SpaceSaving(capacity)
+        self.sketch = SpaceSaving(
+            capacity, decay_every=decay_every, decay_factor=decay_factor
+        )
         self.hll = Hll()
         self.update_s = 0.0  # cumulative sketch-update cost (bench reports)
         self.update_batches = 0
@@ -522,12 +576,19 @@ class _NullWatch:
 NULL_WATCH = _NullWatch()
 
 
-def make_watch(label: str, *, capacity: int = 64):
+def make_watch(label: str, *, capacity: int = 64, decay_every: int = 0,
+               decay_factor: float = 0.5):
     """A live :class:`StateWatch` when the currently bound registry has
     metrics enabled, else the shared falsy null — the same
-    resolve-at-construction rule every obs handle follows."""
+    resolve-at-construction rule every obs handle follows.
+    ``decay_every``/``decay_factor`` make the heavy-hitter sketch
+    windowed (see :class:`SpaceSaving`) — the join passes them so its
+    adaptation policy sees recent shares."""
     from denormalized_tpu import obs
 
     if obs.enabled():
-        return StateWatch(label, capacity=capacity)
+        return StateWatch(
+            label, capacity=capacity,
+            decay_every=decay_every, decay_factor=decay_factor,
+        )
     return NULL_WATCH

@@ -131,6 +131,13 @@ class SliceStore:
         return len(self._units)
 
     @property
+    def add_only(self) -> bool:
+        """True when this store may take the sort-free bincount lane —
+        callers precomputing a shared sort permutation must NOT hand it
+        to an add-only store (the dense lane's bits differ)."""
+        return self._add_only
+
+    @property
     def capacity(self) -> int:
         return self._cap
 
@@ -172,50 +179,76 @@ class SliceStore:
         values64: np.ndarray,
         colvalid: np.ndarray,
         ngroups: int,
+        *,
+        order: np.ndarray | None = None,
     ) -> int:
         """Fold one batch's rows into their slice partials.  ``units``
         are slide-unit indices (``ts // unit_ms``), ``gids`` dense group
         ids, ``values64`` the ``(n, V)`` f64 value matrix (variance
         columns already pivot-shifted by the caller — the same transform
         StreamingWindowExec applies), ``colvalid`` per-cell validity.
+
+        ``order``, when given, is a precomputed stable ``(unit, gid)``
+        sort permutation — the full batch's, or an order-preserving
+        masked subset of it (row indices into the batch arrays).  The
+        store then skips its own lexsort and folds exactly the rows
+        ``order`` names, in that order.  A stable subset of a stable
+        sort IS the subset's stable sort, so the per-segment row
+        sequences (and hence the reduceat bits) are identical to
+        sorting the subset directly — the shared pipeline exploits this
+        to pay ONE sort per batch across every residual filter class.
         Returns the number of distinct slice segments touched."""
-        n = len(units)
+        n = len(units) if order is None else len(order)
         if n == 0:
             return 0
         self._ensure_capacity(max(ngroups, 1))
         cap = self._cap
-        if self._add_only:
-            u_min = int(units.min())
-            span = int(units.max()) - u_min + 1
-            # dense-cell guard: a wildly out-of-order batch whose unit
-            # span dwarfs its row count falls back to the sort lane
-            if span * cap <= 4 * max(n, 1024):
-                return self._accumulate_dense(
-                    units, gids, values64, colvalid, u_min, span
-                )
-        order, starts, seg_u, seg_g = slice_segment_bounds(units, gids, cap)
+        if order is None:
+            if self._add_only:
+                u_min = int(units.min())
+                span = int(units.max()) - u_min + 1
+                # dense-cell guard: a wildly out-of-order batch whose
+                # unit span dwarfs its row count falls back to sorting
+                if span * cap <= 4 * max(n, 1024):
+                    return self._accumulate_dense(
+                        units, gids, values64, colvalid, u_min, span
+                    )
+            order, starts, seg_u, seg_g = slice_segment_bounds(
+                units, gids, cap
+            )
+        else:
+            ks = units[order].astype(np.int64) * np.int64(
+                cap
+            ) + gids[order].astype(np.int64)
+            edges = np.flatnonzero(ks[1:] != ks[:-1]) + 1
+            starts = np.concatenate((np.zeros(1, dtype=np.int64), edges))
+            seg_key = ks[starts]
+            seg_u = seg_key // cap
+            seg_g = seg_key % cap
         row_counts = np.diff(np.append(starts, n))
-        # per-component segment partials (one reduceat per component)
+        # per-component segment partials (one reduceat per component);
+        # gather-then-select equals select-then-gather elementwise, so
+        # both order paths produce the same bits
         seg_vals: dict[str, np.ndarray] = {}
         for comp in self.components:
             if comp.kind == "count" and comp.col is None:
                 seg_vals[comp.label] = row_counts.astype(_I64)
                 continue
             if comp.kind == "count":
-                v = colvalid[:, comp.col].astype(_I64)
-                seg_vals[comp.label] = np.add.reduceat(v[order], starts)
+                v = colvalid[order, comp.col].astype(_I64)
+                seg_vals[comp.label] = np.add.reduceat(v, starts)
                 continue
-            col = values64[:, comp.col]
-            ok = colvalid[:, comp.col]
+            col = values64[order, comp.col]
+            ok = colvalid[order, comp.col]
             if comp.kind == "sum":
                 v = np.where(ok, col, 0.0)
-                seg_vals[comp.label] = np.add.reduceat(v[order], starts)
+                seg_vals[comp.label] = np.add.reduceat(v, starts)
             elif comp.kind == "min":
                 v = np.where(ok, col, np.inf)
-                seg_vals[comp.label] = np.minimum.reduceat(v[order], starts)
+                seg_vals[comp.label] = np.minimum.reduceat(v, starts)
             elif comp.kind == "max":
                 v = np.where(ok, col, -np.inf)
-                seg_vals[comp.label] = np.maximum.reduceat(v[order], starts)
+                seg_vals[comp.label] = np.maximum.reduceat(v, starts)
             else:  # pragma: no cover — components_for never emits others
                 raise ValueError(comp.kind)
         # scatter segment partials into per-unit arrays: segments are

@@ -1018,8 +1018,18 @@ class StreamingJoinExec(ExecOperator):
         # PER SIDE — "which side is skewed" is the verdict that matters
         # for adaptive sub-partitioning, and the two sides share an
         # interner so gids are comparable but their distributions aren't
-        self._sw = statewatch.make_watch("join")
-        self._sw_right = statewatch.make_watch("join")
+        # windowed sketches (decay_every): the adaptation policy folds a
+        # hot-key sub-partition when the key's share decays — a monotone
+        # sketch only lets shares fall as 1/total, so a celebrity that
+        # retired early in a long run would stay "hot" forever; the
+        # exponential window makes shares track recent traffic and the
+        # fold trigger fire within a bounded row horizon
+        self._sw = statewatch.make_watch(
+            "join", decay_every=statewatch.JOIN_SKETCH_DECAY_ROWS
+        )
+        self._sw_right = statewatch.make_watch(
+            "join", decay_every=statewatch.JOIN_SKETCH_DECAY_ROWS
+        )
         self._sides = None  # run()'s live (_SideState, _SideState) pair
         # closed-loop skew adaptation (obs/doctor/actions.py): the policy
         # runs on the join's own thread between batches.  It needs live
@@ -1043,8 +1053,14 @@ class StreamingJoinExec(ExecOperator):
                 interval_s=adapt_interval_s
             )
             if not self._sw:
-                self._sw = statewatch.StateWatch("join")
-                self._sw_right = statewatch.StateWatch("join")
+                self._sw = statewatch.StateWatch(
+                    "join",
+                    decay_every=statewatch.JOIN_SKETCH_DECAY_ROWS,
+                )
+                self._sw_right = statewatch.StateWatch(
+                    "join",
+                    decay_every=statewatch.JOIN_SKETCH_DECAY_ROWS,
+                )
                 self._sw_sample = 4
         self._obs_rows_out = obs.counter("dnz_op_rows_out_total", op="join")
         # adaptation counters pre-bound per (action, side) so the policy

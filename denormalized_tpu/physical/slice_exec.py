@@ -47,7 +47,12 @@ from denormalized_tpu.common.constants import (
 from denormalized_tpu.common.errors import PlanError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
-from denormalized_tpu.logical.expr import VAR_KINDS, AggregateExpr, Expr
+from denormalized_tpu.logical.expr import (
+    VAR_KINDS,
+    AggregateExpr,
+    Column as _ColExpr,
+    Expr,
+)
 from denormalized_tpu.ops import segment_agg as sa
 from denormalized_tpu.ops.interner import GroupInterner
 from denormalized_tpu.ops.slice_store import SliceStore
@@ -79,6 +84,15 @@ class SliceSubscriber:
     slide_ms: int
     tag: int = 0
     label: str | None = None
+    #: residual predicate re-applied per row before this subscriber's
+    #: slice partials accumulate (subsumption sharing: the group
+    #: ingests under the WEAKEST member predicate; members with a
+    #: strictly stronger predicate re-filter here).  None = the
+    #: subscriber's predicate IS the base predicate — no re-filter.
+    filter_expr: Expr | None = None
+    #: full-predicate signature (checkpoint identity of this
+    #: subscriber's filter, planner/predicates.predicate_signature)
+    filter_sig: str = ""
     # filled by the operator: per-subscriber agg specs over the SHARED
     # value-column space, and the output schema
     agg_specs: list = field(default_factory=list)
@@ -94,6 +108,65 @@ class SubscriberBatch:
     def __init__(self, tag: int, batch: RecordBatch) -> None:
         self.tag = tag
         self.batch = batch
+
+
+def refilter_gid_mask(gid: np.ndarray, gid_pass: np.ndarray) -> np.ndarray:
+    """Per-row residual mask from per-gid pass bits: one gather over
+    dense interned gids.  The re-filter hot path for residual
+    predicates over the group-key columns — the predicate itself is
+    evaluated once per NEW gid (``_extend_gid_pass``), never per row."""
+    return gid_pass[gid]
+
+
+def shared_sort_order(units: np.ndarray, gid: np.ndarray) -> np.ndarray:
+    """ONE stable ``(unit, gid)`` sort permutation for a whole batch,
+    shared by every sort-lane filter class.  The key multiplier only
+    has to separate gids (any value > max gid yields the same ordering
+    relation), so the permutation is identical to the one each class's
+    store would compute with its own capacity — classes reuse it
+    instead of re-sorting."""
+    mult = np.int64(max(int(gid.max()) + 1, 1)) if len(gid) else np.int64(1)
+    key = units.astype(np.int64) * mult + gid.astype(np.int64)
+    return np.argsort(key, kind="stable")
+
+
+def masked_sorted_order(order: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Subset a stable sort permutation by a residual mask, preserving
+    sort order — the per-class re-filter between the shared sort and
+    that class's slice-store accumulate.  A stable subset of a stable
+    sort IS the subset's stable sort, so the re-filtered member's folds
+    stay byte-comparable to an independent oracle that sorts its
+    filtered rows directly."""
+    return order[mask[order]]
+
+
+class _FilterClass:
+    """One residual-predicate class inside a shared pipeline:
+    subscribers whose full predicate equals the group's base predicate
+    form class ``""`` (no re-filter, the shared ingest already applied
+    it); each strictly stronger predicate gets its own class that
+    re-filters the shared pass into its own slice partials.  Residual
+    classes force the store's lexsort lane so an independent oracle
+    (whose interner capacity differs) can match the fold lane by
+    pinning ``EngineConfig(slice_sort_lane=True)``."""
+
+    __slots__ = (
+        "sig", "pred", "gid_lane", "gid_pass", "store", "exact_from_unit",
+    )
+
+    def __init__(self, sig, pred, gid_lane, store) -> None:
+        self.sig = sig
+        self.pred = pred
+        self.gid_lane = gid_lane
+        self.gid_pass = np.zeros(0, dtype=bool)
+        self.store = store
+        # first slice unit this class's partials are complete from: None
+        # for classes present since the start of the stream, else the
+        # unit after the max event time ingested when a mid-stream
+        # attach opened the class.  EVERY member's first exact window
+        # clamps past it — the floor is a property of the class's
+        # partials, not of whichever joiner happened to create it
+        self.exact_from_unit: int | None = None
 
 
 class SliceWindowExec(ExecOperator):
@@ -120,70 +193,20 @@ class SliceWindowExec(ExecOperator):
 
         in_schema = input_op.schema
         # shared deduped value-column space across ALL subscribers (the
-        # StreamingWindowExec dedup, widened to N aggregate lists)
+        # StreamingWindowExec dedup, widened to N aggregate lists).
+        # ``_value_keys`` persists so live-attached subscribers can
+        # resolve their aggregates against the SAME column space.
         self._value_exprs: list[Expr] = []
         self._value_transforms: list[str | None] = []
         self._var_shift: dict[str, float] = {}
-        keys: dict = {}
-
-        def col_idx(e: Expr, transform: str | None) -> int:
-            k = (transform, repr(e))
-            if k not in keys:
-                keys[k] = len(self._value_exprs)
-                self._value_exprs.append(e)
-                self._value_transforms.append(transform)
-            return keys[k]
+        self._value_keys: dict = {}
 
         unit = 0
         for sub in self._subs:
-            sub.slide_ms = int(sub.slide_ms) if sub.slide_ms else int(
-                sub.length_ms
+            self._prepare_subscriber(sub, grow=True)
+            unit = math.gcd(
+                unit, math.gcd(sub.length_ms, sub.slide_ms)
             )
-            sub.length_ms = int(sub.length_ms)
-            if sub.length_ms <= 0 or sub.slide_ms <= 0:
-                raise PlanError(
-                    "window length and slide must be positive for the "
-                    f"slice path (got L={sub.length_ms} S={sub.slide_ms})"
-                )
-            unit = math.gcd(unit, math.gcd(sub.length_ms, sub.slide_ms))
-            specs: list[tuple] = []
-            for a in sub.aggr_exprs:
-                if not isinstance(a, AggregateExpr):
-                    raise PlanError(f"{a!r} is not an aggregate expression")
-                if a.kind not in FOLDABLE_KINDS:
-                    raise PlanError(
-                        f"aggregate kind {a.kind!r} does not fold from "
-                        "slice partials (UDAFs run in UdafWindowExec)"
-                    )
-                if a.arg is None:
-                    specs.append((a.kind, None))
-                elif a.kind in sa.VAR_KINDS:
-                    specs.append(
-                        (
-                            a.kind,
-                            col_idx(a.arg, "shift"),
-                            col_idx(a.arg, "shift_sq"),
-                        )
-                    )
-                else:
-                    specs.append((a.kind, col_idx(a.arg, None)))
-            sub.agg_specs = specs
-            fields = [g.out_field(in_schema) for g in self.group_exprs]
-            fields += [a.out_field(in_schema) for a in sub.aggr_exprs]
-            fields += [
-                Field(
-                    WINDOW_START_COLUMN, DataType.TIMESTAMP_MS, nullable=False
-                ),
-                Field(
-                    WINDOW_END_COLUMN, DataType.TIMESTAMP_MS, nullable=False
-                ),
-                Field(
-                    CANONICAL_TIMESTAMP_COLUMN,
-                    DataType.TIMESTAMP_MS,
-                    nullable=False,
-                ),
-            ]
-            sub.schema = Schema(fields)
         if unit_ms is not None:
             # explicit slice-width pin: the fold grouping is part of a
             # query's numeric contract (f64 sums round per fold tree),
@@ -199,14 +222,32 @@ class SliceWindowExec(ExecOperator):
         self.unit_ms = unit
         all_specs = [s for sub in self._subs for s in sub.agg_specs]
         self._components = tuple(sa.components_for(all_specs))
-        self._store = SliceStore(
-            self._components, self.unit_ms, force_sort_lane=sort_lane
-        )
+        self._force_sort_lane = bool(sort_lane)
 
         self._grouped = len(self.group_exprs) > 0
         self._interner = (
             GroupInterner(len(self.group_exprs)) if self._grouped else None
         )
+        # per-filter-class slice stores: one store per residual
+        # predicate class; subscribers map to their class object
+        self._classes: list[_FilterClass] = []
+        self._sub_class: list[_FilterClass] = [
+            self._class_for(sub) for sub in self._subs
+        ]
+        # live-registration state: pending attach/detach ops applied at
+        # batch boundaries on the operator thread, per-sub cost ledger
+        # for actual-fraction attribution, backfill-exactness tracking
+        import threading
+
+        self._ops_lock = threading.Lock()
+        self._pending_ops: list = []
+        self._sub_cost_ms: list[float] = [0.0] * len(self._subs)
+        self._first_exact: list[int | None] = [None] * len(self._subs)
+        self._first_ts: int | None = None
+        self._exact_floor_unit: int | None = None
+        self._orphans: dict[int, dict] = {}
+        self._orphan_class_arrays: dict[str, tuple] = {}
+        self._departed: set[int] = set()
         # single-subscriber mode exposes that subscriber's schema (the
         # planner drop-in contract); tagged mode has no single schema —
         # downstream is the multi-query drive loop, not an operator
@@ -261,6 +302,335 @@ class SliceWindowExec(ExecOperator):
             )
             for q, sub in enumerate(self._subs)
         ]
+        # query-dense serving instruments: live subscriber count (moves
+        # on attach/detach), windows served from retained slices at
+        # attach, and the per-batch residual re-filter cost
+        self._obs_mq_live = obs.gauge("dnz_mq_subscribers_live")
+        self._obs_mq_backfill = obs.counter("dnz_mq_backfill_windows_total")
+        self._obs_refilter_ms = obs.histogram("dnz_mq_refilter_ms")
+        self._obs_mq_live.set(len(self._subs))
+
+    # -- subscriber / filter-class plumbing ------------------------------
+    @property
+    def _store(self) -> SliceStore:
+        """The base filter class's store (legacy single-class view —
+        state accounting and tests address it directly)."""
+        return self._classes[0].store
+
+    def _prepare_subscriber(self, sub: SliceSubscriber, *, grow: bool) -> None:
+        """Normalize one subscriber's window spec and resolve its
+        aggregates against the shared value-column space.  With
+        ``grow=False`` (live attach) the value space is frozen: an
+        aggregate needing a column the group never ingested raises —
+        the caller falls back to an independent pipeline."""
+        in_schema = self.input_op.schema
+
+        def col_idx(e: Expr, transform: str | None) -> int:
+            k = (transform, repr(e))
+            if k not in self._value_keys:
+                if not grow:
+                    raise PlanError(
+                        f"subscriber aggregate over {e!r} needs a value "
+                        "column the shared group does not ingest — "
+                        "attach requires aggregates over the group's "
+                        "existing column space"
+                    )
+                self._value_keys[k] = len(self._value_exprs)
+                self._value_exprs.append(e)
+                self._value_transforms.append(transform)
+            return self._value_keys[k]
+
+        sub.slide_ms = int(sub.slide_ms) if sub.slide_ms else int(
+            sub.length_ms
+        )
+        sub.length_ms = int(sub.length_ms)
+        if sub.length_ms <= 0 or sub.slide_ms <= 0:
+            raise PlanError(
+                "window length and slide must be positive for the "
+                f"slice path (got L={sub.length_ms} S={sub.slide_ms})"
+            )
+        specs: list[tuple] = []
+        for a in sub.aggr_exprs:
+            if not isinstance(a, AggregateExpr):
+                raise PlanError(f"{a!r} is not an aggregate expression")
+            if a.kind not in FOLDABLE_KINDS:
+                raise PlanError(
+                    f"aggregate kind {a.kind!r} does not fold from "
+                    "slice partials (UDAFs run in UdafWindowExec)"
+                )
+            if a.arg is None:
+                specs.append((a.kind, None))
+            elif a.kind in sa.VAR_KINDS:
+                specs.append(
+                    (
+                        a.kind,
+                        col_idx(a.arg, "shift"),
+                        col_idx(a.arg, "shift_sq"),
+                    )
+                )
+            else:
+                specs.append((a.kind, col_idx(a.arg, None)))
+        sub.agg_specs = specs
+        fields = [g.out_field(in_schema) for g in self.group_exprs]
+        fields += [a.out_field(in_schema) for a in sub.aggr_exprs]
+        fields += [
+            Field(
+                WINDOW_START_COLUMN, DataType.TIMESTAMP_MS, nullable=False
+            ),
+            Field(
+                WINDOW_END_COLUMN, DataType.TIMESTAMP_MS, nullable=False
+            ),
+            Field(
+                CANONICAL_TIMESTAMP_COLUMN,
+                DataType.TIMESTAMP_MS,
+                nullable=False,
+            ),
+        ]
+        sub.schema = Schema(fields)
+
+    def _class_for(self, sub: SliceSubscriber) -> _FilterClass:
+        """Find or create the filter class for one subscriber's
+        residual predicate."""
+        sig = "" if sub.filter_expr is None else repr(sub.filter_expr)
+        for cls in self._classes:
+            if cls.sig == sig:
+                return cls
+        gid_lane = False
+        if sig and self._grouped:
+            key_names = {
+                g.name for g in self.group_exprs if isinstance(g, _ColExpr)
+            }
+            gid_lane = (
+                len(key_names) == len(self.group_exprs)
+                and sub.filter_expr.columns_referenced() <= key_names
+            )
+        store = SliceStore(
+            self._components,
+            self.unit_ms,
+            # residual classes always sort: their independent oracles
+            # run a DIFFERENT interner (own gid space/capacity), so the
+            # dense-lane guard could diverge — the lexsort lane's fold
+            # order is capacity-independent (oracle pins
+            # EngineConfig(slice_sort_lane=True) to match)
+            force_sort_lane=self._force_sort_lane or bool(sig),
+        )
+        cls = _FilterClass(sig, sub.filter_expr, gid_lane, store)
+        self._classes.append(cls)
+        return cls
+
+    def _extend_gid_pass(self, cls: _FilterClass, ngroups: int) -> None:
+        """Evaluate a gid-lane class's residual predicate over the
+        interner keys of gids not yet classified (new groups only —
+        O(new keys), never O(rows))."""
+        start = len(cls.gid_pass)
+        if ngroups <= start:
+            return
+        new = np.arange(start, ngroups, dtype=np.int64)
+        key_vals = self._interner.keys_of(new)
+        fields = [g.out_field(self.input_op.schema) for g in self.group_exprs]
+        kb = RecordBatch(Schema(fields), list(key_vals))
+        passed = np.asarray(cls.pred.eval(kb), dtype=bool)
+        cls.gid_pass = np.concatenate((cls.gid_pass, passed))
+
+    def shared_fractions(self) -> dict[int, float]:
+        """Measured per-subscriber share of this operator's work, keyed
+        by subscriber tag — the doctor's actual-fraction attribution
+        for shared pipelines (re-filter + per-class accumulate + fold
+        cost differs across subscribers, so 1/N would lie)."""
+        total = sum(self._sub_cost_ms)
+        n = max(len(self._subs), 1)
+        if total <= 0.0:
+            return {sub.tag: 1.0 / n for sub in self._subs}
+        return {
+            sub.tag: self._sub_cost_ms[q] / total
+            for q, sub in enumerate(self._subs)
+        }
+
+    # -- live registration (attach/detach at slice boundaries) -----------
+    def request_attach(self, sub: SliceSubscriber, when_ts: int | None = None):
+        """Queue a mid-stream subscription (any thread).  The operator
+        thread applies it at the next batch boundary — with ``when_ts``
+        set, at the first batch whose min event time reaches it, so a
+        replayed request lands at the same stream position after a
+        kill/restore (event time is deterministic; arrival time isn't)."""
+        with self._ops_lock:
+            self._pending_ops.append(("attach", sub, when_ts))
+
+    def request_detach(self, tag: int, when_ts: int | None = None):
+        """Queue a mid-stream unsubscription (any thread)."""
+        with self._ops_lock:
+            self._pending_ops.append(("detach", tag, when_ts))
+
+    def _drain_ops(self, upcoming_ts: int | None) -> Iterator:
+        """Apply pending attach/detach ops whose event-time threshold
+        the upcoming batch reaches (``None`` = end of stream: apply
+        everything).  Yields backfilled window emissions from attaches."""
+        with self._ops_lock:
+            if not self._pending_ops:
+                return
+            ready, rest = [], []
+            for op in self._pending_ops:
+                when = op[2]
+                if upcoming_ts is None or when is None or when <= upcoming_ts:
+                    ready.append(op)
+                else:
+                    rest.append(op)
+            self._pending_ops = rest
+        for kind, payload, _when in ready:
+            if kind == "attach":
+                for b in self.attach(payload):
+                    yield b
+            else:
+                self.detach(payload)
+
+    def attach(self, sub: SliceSubscriber, *, warm: bool = True) -> list:
+        """Attach a subscriber mid-stream and warm it from the slice
+        store's retained partials.  Returns the backfilled window
+        emissions (windows the gcd slices already cover exactly).
+
+        Exactness contract: the first exact window j* is the max of the
+        joiner's anchor at the stream's first event time and the ceiling
+        of the highest prune/late-drop floor ever applied — everything
+        from j* on folds from complete slices, so backfilled windows and
+        all later ones are byte-identical to an independent from-start
+        pipeline.  A joiner whose residual predicate opens a NEW filter
+        class has no retained partials to warm from, so its j* addition-
+        ally clamps past the max event time already ingested."""
+        from denormalized_tpu import obs
+
+        if sub.tag in self._departed:
+            # replay idempotence: this tag joined AND left before the
+            # restored checkpoint — re-applying its registration
+            # schedule must not re-attach it
+            return []
+        if any(s.tag == sub.tag for s in self._subs):
+            raise PlanError(f"subscriber tag {sub.tag} is already attached")
+        self._prepare_subscriber(sub, grow=False)
+        if sub.length_ms % self.unit_ms or sub.slide_ms % self.unit_ms:
+            raise PlanError(
+                f"window {sub.length_ms}ms/{sub.slide_ms}ms does not "
+                f"tile the shared group's {self.unit_ms}ms slices — "
+                "attach requires length and slide divisible by the unit"
+            )
+        needed = set(sa.components_for(sub.agg_specs))
+        if not needed <= set(self._components):
+            raise PlanError(
+                "subscriber aggregates need slice components "
+                f"{sorted(needed - set(self._components))} the shared "
+                "store does not maintain"
+            )
+        sig = "" if sub.filter_expr is None else repr(sub.filter_expr)
+        fresh = all(c.sig != sig for c in self._classes)
+        cls = self._class_for(sub)
+        if fresh:
+            stash = self._orphan_class_arrays.pop(cls.sig, None)
+            if stash is not None:
+                # a restored checkpoint carried this class's partials
+                # (its only owners were late joiners) — revive them
+                # along with the class's exactness floor (the original
+                # class may itself have opened mid-stream)
+                st_arrays, st_ngroups, st_efu = stash
+                cls.store.restore_arrays(st_arrays, st_ngroups)
+                cls.exact_from_unit = st_efu
+            elif self._max_ts is not None:
+                # genuinely new residual class mid-stream: its partials
+                # only cover data from here on — record the floor ON
+                # THE CLASS so later same-class joiners inherit it
+                cls.exact_from_unit = self._max_ts // self.unit_ms + 1
+        self._subs.append(sub)
+        q = len(self._subs) - 1
+        self._sub_class.append(cls)
+        self._sub_cost_ms.append(0.0)
+        self._next_win.append(None)
+        self._first_exact.append(None)
+        self._obs_mq_emit_lag.append(
+            obs.gauge(
+                "dnz_mq_emit_lag_ms",
+                query=sub.label if sub.label is not None else f"q{sub.tag}",
+            )
+        )
+        self._obs_mq_live.set(len(self._subs))
+        self._obs_slice_subs.set(len(self._subs))
+        emitted: list = []
+        rec = self._orphans.pop(sub.tag, None)
+        if rec is not None:
+            if (
+                rec["filter_sig"] != sub.filter_sig
+                or int(rec["length_ms"]) != sub.length_ms
+                or int(rec["slide_ms"]) != sub.slide_ms
+            ):
+                from denormalized_tpu.common.errors import StateError
+
+                raise StateError(
+                    f"re-attaching subscriber tag {sub.tag} does not "
+                    "match its checkpointed record (filter signature or "
+                    "window spec changed)"
+                )
+            # replayed registration after restore: adopt the cursor the
+            # checkpoint carried — no backfill, those windows emitted
+            nw = rec["next_win"]
+            self._next_win[q] = None if nw is None else int(nw)
+            fe = rec.get("first_exact")
+            self._first_exact[q] = None if fe is None else int(fe)
+        elif warm and self._first_ts is not None:
+            j_star = self._anchor(q, self._first_ts)
+            if self._exact_floor_unit is not None:
+                j_star = max(
+                    j_star,
+                    -(-(self._exact_floor_unit * self.unit_ms)
+                      // sub.slide_ms),
+                )
+            if cls.exact_from_unit is not None:
+                # the class opened mid-stream: no partials predate its
+                # creation, so exactness starts past everything the
+                # stream had ingested by then — for every member, not
+                # just the joiner that opened it
+                j_star = max(
+                    j_star,
+                    -(-(cls.exact_from_unit * self.unit_ms)
+                      // sub.slide_ms),
+                )
+            self._first_exact[q] = j_star
+            wm = self._wm_floor(q)
+            if wm is not None and wm > j_star:
+                for j in range(j_star, wm):
+                    b = self._emit_window(q, j)
+                    if b is not None:
+                        emitted.append(b)
+                self._obs_mq_backfill.add(wm - j_star)
+            self._next_win[q] = max(j_star, wm) if wm is not None else j_star
+        return emitted
+
+    def detach(self, tag: int) -> None:
+        """Detach a subscriber; drop its cursor, ledger, and any filter
+        class no survivor owns, then prune slices only it retained."""
+        matches = [q for q, s in enumerate(self._subs) if s.tag == tag]
+        if not matches:
+            if tag in self._departed:
+                return  # replayed detach of an already-departed tag
+            raise PlanError(f"no attached subscriber has tag {tag}")
+        if len(self._subs) == 1:
+            raise PlanError(
+                "cannot detach the last subscriber — stop the pipeline "
+                "instead"
+            )
+        q = matches[0]
+        self._departed.add(tag)
+        del self._subs[q]
+        del self._next_win[q]
+        del self._sub_class[q]
+        del self._sub_cost_ms[q]
+        del self._first_exact[q]
+        del self._obs_mq_emit_lag[q]
+        owned = {id(c) for c in self._sub_class}
+        self._classes = [c for c in self._classes if id(c) in owned]
+        floor = self._floor_unit()
+        if floor is not None:
+            self._metrics["slices_pruned"] += sum(
+                cls.store.prune(floor) for cls in self._classes
+            )
+        self._obs_mq_live.set(len(self._subs))
+        self._obs_slice_subs.set(len(self._subs))
 
     # ------------------------------------------------------------------
     @property
@@ -269,7 +639,9 @@ class SliceWindowExec(ExecOperator):
 
     def metrics(self):
         m = dict(self._metrics)
-        m["slices_live"] = len(self._store)
+        m["slices_live"] = max(len(c.store) for c in self._classes)
+        m["subscribers"] = len(self._subs)
+        m["filter_classes"] = len(self._classes)
         return m
 
     def _label(self):
@@ -290,7 +662,7 @@ class SliceWindowExec(ExecOperator):
         live_keys = len(self._interner) if self._interner is not None else (
             1 if self._max_ts is not None else 0
         )
-        store_bytes = self._store.nbytes()
+        store_bytes = sum(c.store.nbytes() for c in self._classes)
         units = self._store.live_units()
         oldest = units[0] * self.unit_ms if units else None
         wm = self._watermark_ms
@@ -301,8 +673,9 @@ class SliceWindowExec(ExecOperator):
             "live_keys": live_keys,
             "slot_capacity": int(self._store.capacity),
             "slot_live": live_keys,
-            "slices_live": len(self._store),
+            "slices_live": max(len(c.store) for c in self._classes),
             "subscribers": len(self._subs),
+            "filter_classes": len(self._classes),
             "retention_unit_ms": max(s.length_ms for s in self._subs),
             "oldest_event_ms": oldest,
             "watermark_ms": wm,
@@ -396,6 +769,7 @@ class SliceWindowExec(ExecOperator):
         n = batch.num_rows
         if n == 0:
             return
+        t_shared0 = time.perf_counter()
         self._metrics["rows_in"] += n
         self._metrics["batches_in"] += 1
         self._obs_rows_in.add(n)
@@ -405,6 +779,8 @@ class SliceWindowExec(ExecOperator):
         units = ts // self.unit_ms
         ts_min = int(ts.min())
         ts_max = int(ts.max())
+        if self._first_ts is None:
+            self._first_ts = ts_min
         self._max_ts = ts_max if self._max_ts is None else max(
             self._max_ts, ts_max
         )
@@ -434,8 +810,30 @@ class SliceWindowExec(ExecOperator):
         self._sw.update(gid)
         values64, colvalid = self._eval_values(batch, n)
 
+        # residual re-filter masks, one per filter class, computed over
+        # the FULL batch (row-lane predicates need batch alignment)
+        # before the late-drop subset below
+        t_ref0 = time.perf_counter()
+        masks: list[np.ndarray | None] = []
+        for cls in self._classes:
+            if cls.pred is None:
+                masks.append(None)
+            elif cls.gid_lane:
+                self._extend_gid_pass(cls, ngroups)
+                masks.append(refilter_gid_mask(gid, cls.gid_pass))
+            else:
+                masks.append(np.asarray(cls.pred.eval(batch), dtype=bool))
+        refilter_ms = (time.perf_counter() - t_ref0) * 1e3
+        if len(self._classes) > 1 or self._classes[0].pred is not None:
+            self._obs_refilter_ms.observe(refilter_ms)
+
         floor = self._floor_unit()
         if floor is not None:
+            if (
+                self._exact_floor_unit is None
+                or floor > self._exact_floor_unit
+            ):
+                self._exact_floor_unit = floor
             keep = units >= floor
             n_late = int((~keep).sum())
             if n_late:
@@ -445,9 +843,57 @@ class SliceWindowExec(ExecOperator):
                 gid = gid[keep]
                 values64 = values64[keep]
                 colvalid = colvalid[keep]
+                masks = [m if m is None else m[keep] for m in masks]
+        # shared ingest cost (intern + sketch + value eval + masks)
+        # splits evenly; per-class accumulate cost charges that class's
+        # subscribers — the ledger behind shared_fractions()
+        nsubs = max(len(self._subs), 1)
+        shared_ms = (time.perf_counter() - t_shared0) * 1e3 / nsubs
+        for q in range(len(self._subs)):
+            self._sub_cost_ms[q] += shared_ms
         if len(units):
-            self._store.accumulate(units, gid, values64, colvalid, ngroups)
-            self._obs_slice_rows.add(len(units))
+            # one stable (unit, gid) sort serves every sort-lane class:
+            # a residual mask applied in sorted order IS that class's
+            # own stable sort, so N filter classes pay one argsort
+            order_full: np.ndarray | None = None
+            for ci, cls in enumerate(self._classes):
+                t_cls0 = time.perf_counter()
+                m = masks[ci]
+                if m is None:
+                    if cls.store.add_only:
+                        # dense bincount lane — no sort to share
+                        cls.store.accumulate(
+                            units, gid, values64, colvalid, ngroups
+                        )
+                    else:
+                        if order_full is None:
+                            order_full = shared_sort_order(units, gid)
+                        cls.store.accumulate(
+                            units, gid, values64, colvalid, ngroups,
+                            order=order_full,
+                        )
+                    rows = len(units)
+                else:
+                    if not m.any():
+                        continue
+                    if order_full is None:
+                        order_full = shared_sort_order(units, gid)
+                    o_sub = masked_sorted_order(order_full, m)
+                    cls.store.accumulate(
+                        units, gid, values64, colvalid, ngroups,
+                        order=o_sub,
+                    )
+                    rows = len(o_sub)
+                if ci == 0:
+                    self._obs_slice_rows.add(rows)
+                cls_ms = (time.perf_counter() - t_cls0) * 1e3
+                owners = [
+                    q for q, c in enumerate(self._sub_class) if c is cls
+                ]
+                if owners:
+                    share = cls_ms / len(owners)
+                    for q in owners:
+                        self._sub_cost_ms[q] += share
 
         if not self._src_watermarks:
             if self._watermark_ms is None or ts_min > self._watermark_ms:
@@ -475,26 +921,37 @@ class SliceWindowExec(ExecOperator):
             self._next_win[q] = nw
         floor = self._floor_unit()
         if floor is not None:
-            self._metrics["slices_pruned"] += self._store.prune(floor)
+            if (
+                self._exact_floor_unit is None
+                or floor > self._exact_floor_unit
+            ):
+                self._exact_floor_unit = floor
+            self._metrics["slices_pruned"] += sum(
+                cls.store.prune(floor) for cls in self._classes
+            )
         # gauge AFTER the prune: the exported number is the retained
         # slice count the catalog text promises, not the pre-prune peak
-        self._obs_slice_units.set(len(self._store))
+        self._obs_slice_units.set(
+            max(len(cls.store) for cls in self._classes)
+        )
 
     def _emit_window(self, q: int, j: int):
         sub = self._subs[q]
         t0 = time.perf_counter()
         u0 = j * sub.slide_ms // self.unit_ms
         u1 = (j * sub.slide_ms + sub.length_ms) // self.unit_ms
-        rows = self._store.fold(u0, u1)
+        rows = self._sub_class[q].store.fold(u0, u1)
         self._metrics["slice_folds"] += 1
         self._obs_folds.add(1)
         if rows is None:
+            self._sub_cost_ms[q] += (time.perf_counter() - t0) * 1e3
             return None
         ngroups = len(self._interner) if self._grouped else 1
         counts = rows[sa.ROW_COUNT.label]
         active = counts > 0
         active[ngroups:] = False
         if not active.any():
+            self._sub_cost_ms[q] += (time.perf_counter() - t0) * 1e3
             return None
         gids = np.nonzero(active)[0].astype(np.int32)
         finals = sa.finalize(sub.agg_specs, rows, active)
@@ -503,7 +960,9 @@ class SliceWindowExec(ExecOperator):
             self._obs_mq_emit_lag[q].set(
                 time.time() * 1000.0 - (j * sub.slide_ms + sub.length_ms)
             )
-        self._obs_fold_ms.observe((time.perf_counter() - t0) * 1e3)
+        fold_ms = (time.perf_counter() - t0) * 1e3
+        self._sub_cost_ms[q] += fold_ms
+        self._obs_fold_ms.observe(fold_ms)
         self._metrics["windows_emitted"] += 1
         if self._tagged:
             return SubscriberBatch(sub.tag, batch)
@@ -536,10 +995,15 @@ class SliceWindowExec(ExecOperator):
                 time.time() * 1000.0 - (j * sub.slide_ms + sub.length_ms)
             )
         if self._dr_lineage is not None:
+            # shared pipelines tag the emission with the subscriber's
+            # doctor query id so GET /queries/<id>/lineage attributes
+            # the chain to the right member query
+            qids = getattr(self, "_dr_mq_qids", None)
             self._dr_lineage.emitted(
                 self._dr_node_id,
                 j * sub.slide_ms,
                 j * sub.slide_ms + sub.length_ms,
+                query=None if qids is None else qids.get(sub.tag),
             )
         return RecordBatch(sub.schema, cols)
 
@@ -577,13 +1041,41 @@ class SliceWindowExec(ExecOperator):
             "var_shift": dict(self._var_shift),
             "ngroups": ngroups,
             "interner": self._interner.snapshot() if self._grouped else None,
+            # live-registration payload: per-subscriber identity records
+            # (tag + filter signature + join cursor) and the per-class
+            # array layout — restore matches cursors by TAG, never by
+            # position, so a mid-stream joiner's kill/restore is exact
+            "first_ts": self._first_ts,
+            "exact_floor_unit": self._exact_floor_unit,
+            "departed": sorted(self._departed),
+            "classes": [cls.sig for cls in self._classes],
+            "class_exact_from": [
+                cls.exact_from_unit for cls in self._classes
+            ],
+            "subs": [
+                {
+                    "tag": sub.tag,
+                    "label": sub.label,
+                    "length_ms": sub.length_ms,
+                    "slide_ms": sub.slide_ms,
+                    "filter_sig": sub.filter_sig,
+                    "class_sig": self._sub_class[q].sig,
+                    "next_win": self._next_win[q],
+                    "first_exact": self._first_exact[q],
+                }
+                for q, sub in enumerate(self._subs)
+            ],
         }
-        coord.put_snapshot(
-            key, epoch,
-            pack_snapshot(meta, self._store.snapshot_arrays(ngroups)),
-        )
+        arrays: dict[str, np.ndarray] = {}
+        for ci, cls in enumerate(self._classes):
+            for k, arr in cls.store.snapshot_arrays(ngroups).items():
+                # class 0 keeps the legacy un-prefixed key space so
+                # pre-subsumption snapshots stay restorable
+                arrays[k if ci == 0 else f"c{ci}|{k}"] = arr
+        coord.put_snapshot(key, epoch, pack_snapshot(meta, arrays))
 
     def _restore(self) -> None:
+        from denormalized_tpu.common.errors import StateError
         from denormalized_tpu.state.serialization import unpack_snapshot
 
         coord, key = self._ckpt
@@ -592,30 +1084,96 @@ class SliceWindowExec(ExecOperator):
             return
         meta, arrays = unpack_snapshot(blob)
         if int(meta["unit_ms"]) != self.unit_ms:
-            from denormalized_tpu.common.errors import StateError
-
             raise StateError(
                 f"slice snapshot unit {meta['unit_ms']}ms does not match "
                 f"the plan's {self.unit_ms}ms — the subscriber set changed "
                 "incompatibly since the checkpoint"
             )
-        self._next_win = [
-            None if v is None else int(v) for v in meta["next_win"]
-        ]
-        if len(self._next_win) != len(self._subs):
-            from denormalized_tpu.common.errors import StateError
-
-            raise StateError(
-                f"slice snapshot carries {len(self._next_win)} emission "
-                f"cursors but the plan subscribes {len(self._subs)} queries"
-            )
         self._watermark_ms = meta["watermark_ms"]
         self._src_watermarks = bool(meta.get("src_watermarks"))
         self._max_ts = meta["max_ts"]
         self._var_shift = dict(meta.get("var_shift") or {})
+        self._first_ts = meta.get("first_ts")
+        efu = meta.get("exact_floor_unit")
+        self._exact_floor_unit = None if efu is None else int(efu)
+        self._departed = {int(t) for t in meta.get("departed") or ()}
         if self._grouped and meta["interner"] is not None:
             self._interner = GroupInterner.restore(meta["interner"])
-        self._store.restore_arrays(arrays, int(meta.get("ngroups") or 1))
+            # gid-lane pass bits re-derive lazily from the restored
+            # interner on the next batch
+            for cls in self._classes:
+                cls.gid_pass = np.zeros(0, dtype=bool)
+        ngroups = int(meta.get("ngroups") or 1)
+        recs = meta.get("subs")
+        if recs is None:
+            # legacy (pre-live-registration) snapshot: positional
+            # cursors, single filter class
+            self._next_win = [
+                None if v is None else int(v) for v in meta["next_win"]
+            ]
+            if len(self._next_win) != len(self._subs):
+                raise StateError(
+                    f"slice snapshot carries {len(self._next_win)} emission "
+                    f"cursors but the plan subscribes "
+                    f"{len(self._subs)} queries"
+                )
+            self._store.restore_arrays(arrays, ngroups)
+            return
+        by_tag = {int(r["tag"]): r for r in recs}
+        for q, sub in enumerate(self._subs):
+            rec = by_tag.pop(sub.tag, None)
+            if rec is None:
+                raise StateError(
+                    f"slice snapshot has no cursor for subscriber tag "
+                    f"{sub.tag} — subscribers present at restore must "
+                    "predate the checkpoint (late joiners attach AFTER "
+                    "restore and adopt their cursor then)"
+                )
+            if (
+                rec["filter_sig"] != sub.filter_sig
+                or int(rec["length_ms"]) != sub.length_ms
+                or int(rec["slide_ms"]) != sub.slide_ms
+            ):
+                raise StateError(
+                    f"subscriber tag {sub.tag} does not match its "
+                    "snapshot record (filter signature or window spec "
+                    "changed since the checkpoint)"
+                )
+            nw = rec["next_win"]
+            self._next_win[q] = None if nw is None else int(nw)
+            fe = rec.get("first_exact")
+            self._first_exact[q] = None if fe is None else int(fe)
+        # cursors of subscribers not in the current plan: retained for
+        # adoption when the (replayed) live registration re-attaches
+        self._orphans = by_tag
+        # split arrays back into per-class stores by snapshot class
+        # index, matching classes by residual signature
+        snap_sigs = [str(s) for s in meta.get("classes") or [""]]
+        snap_efu = meta.get("class_exact_from") or [None] * len(snap_sigs)
+        per_class: list[dict[str, np.ndarray]] = [
+            {} for _ in snap_sigs
+        ]
+        for k, arr in arrays.items():
+            if k.startswith("c") and "|" in k:
+                head, rest = k.split("|", 1)
+                if head[1:].isdigit() and "|" in rest:
+                    per_class[int(head[1:])][rest] = arr
+                    continue
+            per_class[0][k] = arr
+        live_sigs = {cls.sig: cls for cls in self._classes}
+        self._orphan_class_arrays = {}
+        for ci, sig in enumerate(snap_sigs):
+            efu = snap_efu[ci] if ci < len(snap_efu) else None
+            efu = None if efu is None else int(efu)
+            cls = live_sigs.get(sig)
+            if cls is not None:
+                cls.store.restore_arrays(per_class[ci], ngroups)
+                cls.exact_from_unit = efu
+            else:
+                # no live subscriber folds this class yet — stash the
+                # partials (and the class's exactness floor) for the
+                # re-attaching joiner to revive
+                self._orphan_class_arrays[sig] = (per_class[ci], ngroups, efu)
 
     # -- stream loop -----------------------------------------------------
     def run(self) -> Iterator[StreamItem]:
@@ -623,6 +1181,17 @@ class SliceWindowExec(ExecOperator):
 
         for item in self._doctor_input():
             if isinstance(item, RecordBatch):
+                if self._pending_ops and item.num_rows:
+                    # live attach/detach lands at batch boundaries; ops
+                    # carrying an event-time threshold fire exactly when
+                    # the stream reaches it (deterministic under replay)
+                    up = int(
+                        np.asarray(
+                            item.column(CANONICAL_TIMESTAMP_COLUMN),
+                            dtype=np.int64,
+                        ).min()
+                    )
+                    yield from self._drain_ops(up)
                 t0 = time.perf_counter()
                 with span(
                     "slice_window.process_batch",
@@ -666,6 +1235,7 @@ class SliceWindowExec(ExecOperator):
                     self._snapshot(item.epoch)
                 yield item
             elif isinstance(item, EndOfStream):
+                yield from self._drain_ops(None)
                 if self.emit_on_close and self._max_ts is not None:
                     for q, sub in enumerate(self._subs):
                         nw = self._next_win[q]

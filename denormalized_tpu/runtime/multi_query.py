@@ -26,8 +26,10 @@ the whole shared operator to whichever query registered first.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable
 
+from denormalized_tpu.common.errors import PlanError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.physical.base import EndOfStream, ExecOperator, Marker
 from denormalized_tpu.physical.slice_exec import (
@@ -35,15 +37,22 @@ from denormalized_tpu.physical.slice_exec import (
     SliceWindowExec,
     SubscriberBatch,
 )
-from denormalized_tpu.planner.sharing import ShareGroup, detect_sharing
+from denormalized_tpu.planner import predicates as pr
+from denormalized_tpu.planner.sharing import (
+    ShareGroup,
+    classify,
+    detect_sharing,
+)
 
 
 def build_shared_root(
     ctx, group: ShareGroup, labels: list[str] | None = None
 ) -> ExecOperator:
     """Build the shared physical pipeline for one share group: the
-    common input subtree planned once, topped by a tagged
-    SliceWindowExec with one subscriber per member query.  Must run
+    common input subtree planned once (the BASE member's — weakest —
+    filter included), topped by a tagged SliceWindowExec with one
+    subscriber per member query; members with a strictly stronger
+    predicate carry it as a residual the operator re-applies.  Must run
     under the query's bound obs registry (the caller's job — see
     run_queries)."""
     from denormalized_tpu.planner.planner import Planner
@@ -56,6 +65,12 @@ def build_shared_root(
             w.slide_ms or w.length_ms,
             tag=k,
             label=labels[k] if labels else None,
+            filter_expr=(
+                group.filters[k] if k < len(group.filters) else None
+            ),
+            filter_sig=(
+                group.filter_sigs[k] if k < len(group.filter_sigs) else ""
+            ),
         )
         for k, w in enumerate(group.windows)
     ]
@@ -85,6 +100,222 @@ def drive_shared(
             coord.commit(item.epoch)
         elif isinstance(item, EndOfStream):
             break
+
+
+class SharedPipeline:
+    """Live multi-query serving over ONE shared slice pipeline: a
+    thread-safe registry of subscriber queries that can join and leave
+    MID-STREAM, without restarting the shared operator or cold-starting
+    an independent pipeline per query.
+
+    Built from an initial batch of queries that must form one share
+    group (``detect_sharing``), it exposes:
+
+    - :meth:`register` — queue a new query; it attaches at a slice
+      boundary on the operator thread and WARMS from the slice store's
+      retained partials (windows the gcd slices already cover backfill
+      immediately, exact from the query's first exact window — see
+      docs/multi_query.md for the exactness contract);
+    - :meth:`deregister` — queue a leave; the cursor detaches at a
+      slice boundary and partials no survivor needs are pruned.
+
+    Both accept ``when_ts``, an event-time threshold: the op fires at
+    the first batch whose min timestamp reaches it.  Event-time
+    scheduling makes a registration schedule REPLAYABLE — after a
+    kill/restore, re-issuing the same requests lands every join/leave
+    at the same stream position, and subscribers present in the
+    restored checkpoint adopt their snapshotted cursor instead of
+    backfilling (tags are assigned sequentially and deterministically).
+
+    A registering query must share the pipeline's source+keys and carry
+    a filter the group's base predicate already admits (identical, or
+    implied under subsumption) — the live ingest cannot widen.
+    """
+
+    def __init__(
+        self,
+        ctx,
+        queries,
+        *,
+        labels: list[str] | None = None,
+        checkpoint: bool | None = None,
+    ) -> None:
+        from denormalized_tpu import obs
+        from denormalized_tpu.runtime import executor
+
+        if not queries:
+            raise PlanError("SharedPipeline needs at least one query")
+        self._ctx = ctx
+        self._checkpoint = checkpoint
+        plans = [ds._plan for ds, _sink in queries]
+        subsumption = getattr(ctx.config, "mq_subsumption", True)
+        groups = detect_sharing(plans, subsumption=subsumption)
+        shared = [g for g in groups if g.shared]
+        if len(queries) > 1 and (
+            len(shared) != 1 or len(shared[0].members) != len(queries)
+        ):
+            reasons = "; ".join(
+                g.reason or "?" for g in groups if not g.shared
+            )
+            raise PlanError(
+                "initial queries do not form one share group: " + reasons
+            )
+        group = shared[0] if shared else _singleton_group(plans[0])
+        self._group = group
+        key0, entry0 = classify(plans[group.members[0]])
+        self._key = key0
+        self._base_sig = (
+            group.base_sig if group.base_sig is not None
+            else entry0.filter_sig
+        )
+        base_entry = entry0
+        for i in group.members:
+            _k, e = classify(plans[i])
+            if e.filter_sig == self._base_sig:
+                base_entry = e
+                break
+        self._base_cons = base_entry.cons
+        self._lock = threading.Lock()
+        # tags for initial members are their member index; live joiners
+        # continue the sequence (deterministic across a replay)
+        self._sinks: dict[int, Callable] = {
+            k: queries[i][1] for k, i in enumerate(group.members)
+        }
+        self._next_tag = len(group.members)
+        self._labels = labels or [f"member{i}" for i in group.members]
+        self._reg = executor._resolve_registry(ctx)
+        with obs.bound_registry(self._reg):
+            self._root: SliceWindowExec = build_shared_root(
+                ctx, group, self._labels
+            )
+
+    @property
+    def root(self) -> SliceWindowExec:
+        return self._root
+
+    def register(
+        self,
+        ds,
+        sink: Callable[[RecordBatch], None],
+        *,
+        label: str | None = None,
+        when_ts: int | None = None,
+    ) -> int:
+        """Queue a live subscription (any thread); returns the tag its
+        emissions carry.  Validates shareability up front so a bad
+        query is rejected HERE, not on the operator thread mid-drive."""
+        key, entry = classify(ds._plan)
+        if key is None:
+            raise PlanError(f"query cannot join a shared pipeline: {entry}")
+        if key != self._key:
+            raise PlanError(
+                "query does not share the pipeline's source, projection "
+                "and group keys"
+            )
+        if entry.filter_sig != self._base_sig and not pr.implies(
+            entry.cons, self._base_cons
+        ):
+            raise PlanError(
+                "query filter is not implied by the shared pipeline's "
+                "base predicate — the live ingest cannot widen; run it "
+                "as an independent pipeline"
+            )
+        w = entry.window
+        length = int(w.length_ms)
+        slide = int(w.slide_ms) if w.slide_ms else length
+        unit = self._root.unit_ms
+        if length % unit or slide % unit:
+            raise PlanError(
+                f"window {length}ms/{slide}ms does not tile the shared "
+                f"group's {unit}ms slices"
+            )
+        with self._lock:
+            tag = self._next_tag
+            self._next_tag += 1
+            self._sinks[tag] = sink
+        sub = SliceSubscriber(
+            w.aggr_exprs,
+            length,
+            slide,
+            tag=tag,
+            label=label if label is not None else f"live{tag}",
+            filter_expr=(
+                None if entry.filter_sig == self._base_sig
+                else pr.conjoin(entry.preds)
+            ),
+            filter_sig=entry.filter_sig,
+        )
+        self._root.request_attach(sub, when_ts)
+        return tag
+
+    def deregister(self, tag: int, *, when_ts: int | None = None) -> None:
+        """Queue a live unsubscription (any thread)."""
+        self._root.request_detach(tag, when_ts)
+
+    def run(self) -> None:
+        """Drive the shared pipeline to EndOfStream on the calling
+        thread, routing tagged emissions (including attach-time
+        backfills) to each subscriber's sink."""
+        from denormalized_tpu import obs
+        from denormalized_tpu.obs import doctor
+        from denormalized_tpu.runtime import executor
+
+        ctx = self._ctx
+        orch = coord = exporters = None
+        handles: list = []
+        with obs.bound_registry(self._reg):
+            try:
+                orch, coord = executor._attach_checkpointing(
+                    self._root, ctx, self._checkpoint
+                )
+                ctx._last_coord = coord
+                exporters = obs.start_exporters(
+                    ctx.config, registry=self._reg
+                )
+                handles = doctor.register_shared(
+                    self._root, len(self._group.members),
+                    config=ctx.config, registry=self._reg,
+                    labels=self._labels,
+                )
+                for item in self._root.run():
+                    if isinstance(item, SubscriberBatch):
+                        sink = self._sinks.get(item.tag)
+                        if sink is not None:
+                            sink(item.batch)
+                    elif isinstance(item, Marker) and coord is not None:
+                        coord.commit(item.epoch)
+                    elif isinstance(item, EndOfStream):
+                        break
+            finally:
+                if orch is not None:
+                    orch.stop()
+                for h in handles:
+                    h.finish()
+                if exporters is not None:
+                    exporters.stop()
+
+
+def _singleton_group(plan) -> ShareGroup:
+    """A one-member ShareGroup for a SharedPipeline started with a
+    single query (it still runs the slice operator in tagged mode so
+    live joiners can attach)."""
+    key, entry = classify(plan)
+    if key is None:
+        raise PlanError(f"query cannot seed a shared pipeline: {entry}")
+    w = entry.window
+    slide = int(w.slide_ms) if w.slide_ms else int(w.length_ms)
+    import math
+
+    return ShareGroup(
+        [0],
+        shared=True,
+        windows=[w],
+        input_plan=w.input,
+        unit_ms=math.gcd(int(w.length_ms), slide),
+        filters=[None],
+        filter_sigs=[entry.filter_sig],
+        base_sig=entry.filter_sig,
+    )
 
 
 def run_queries(
@@ -123,7 +354,10 @@ def run_queries(
 
     plans = [ds._plan for ds, _sink in queries]
     if sharing:
-        groups = detect_sharing(plans)
+        groups = detect_sharing(
+            plans,
+            subsumption=getattr(ctx.config, "mq_subsumption", True),
+        )
     else:
         groups = [
             ShareGroup([i], shared=False, reason="sharing disabled")

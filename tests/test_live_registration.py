@@ -1,0 +1,384 @@
+"""Live query registration on the shared slice pipeline.
+
+The query-dense serving surface (docs/multi_query.md): queries join and
+leave a running :class:`SharedPipeline` MID-STREAM without restarting
+the shared operator.  Pins the acceptance contracts:
+
+- a mid-stream joiner WARMS from the slice store's retained partials:
+  windows from its first exact window ``j*`` on (including the
+  immediately backfilled ones) are byte-identical to an independent
+  from-start pipeline folding the same slices;
+- a joiner whose residual predicate opens a NEW filter class has no
+  retained partials, so its exactness starts past the max ingested
+  event time — and is byte-identical to its filtered oracle from there;
+- deregistration detaches one cursor and leaves every survivor's
+  emissions byte-identical to an undisturbed run;
+- unshareable registrations are rejected AT register() with PlanError,
+  not on the operator thread;
+- kill/restore of a pipeline with a mid-stream joiner AND an already
+  departed short-lived query: replaying the same event-time-scheduled
+  registration sequence yields a per-query emission union byte-identical
+  to an uninterrupted run (cursor adoption by tag, departed-tag
+  idempotence).
+"""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.errors import PlanError
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.physical.base import Marker
+from denormalized_tpu.physical.slice_exec import SubscriberBatch
+from denormalized_tpu.runtime.multi_query import SharedPipeline
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.checkpoint import wire_checkpointing
+from denormalized_tpu.state.lsm import close_global_state_backend
+from denormalized_tpu.state.orchestrator import Orchestrator
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+
+# no stddev here: a residual member's variance pivot is chosen from the
+# SHARED ingest's first batch, its independent oracle's from the
+# filtered first batch — numerically equal only to ~1e-12, not byte-
+# identical (the documented exclusion; sums/extrema/counts fold exactly)
+AGGS = [
+    F.count(col("v")).alias("c"),
+    F.sum(col("v")).alias("s"),
+    F.min(col("v")).alias("mn"),
+    F.max(col("v")).alias("mx"),
+    F.avg(col("v")).alias("av"),
+]
+AGG_COLS = ("c", "s", "mn", "mx", "av")
+
+
+def _batches(seed=31, n_batches=20, rows=300, n_keys=6):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 1000, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.normal(10.0, 3.0, rows)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _rows_of(batch, acc):
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        acc[key] = tuple(float(batch.column(c)[i]) for c in AGG_COLS)
+
+
+def _sink(acc):
+    return lambda b: _rows_of(b, acc)
+
+
+def _base(ctx, batches):
+    return ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+
+
+def _oracle(batches, L, S, *, flt=None, sort_lane=False):
+    """Independent from-start pipeline pinned to the shared group's
+    1000ms slice (and, for residual members, its lexsort fold lane)."""
+    ctx = Context(
+        EngineConfig(
+            slice_windows=True,
+            slice_unit_ms=1000,
+            slice_sort_lane=sort_lane,
+        )
+    )
+    ds = _base(ctx, batches)
+    if flt is not None:
+        ds = ds.filter(flt)
+    out = {}
+    for b in ds.window(["k"], AGGS, L, S).stream():
+        _rows_of(b, out)
+    return out
+
+
+def _first_exact_start(sp, tag):
+    """window-start ms of the joiner's first exact window."""
+    root = sp.root
+    for q, sub in enumerate(root._subs):
+        if sub.tag == tag:
+            fe = root._first_exact[q]
+            assert fe is not None
+            return fe * sub.slide_ms
+    raise AssertionError(f"tag {tag} not attached")
+
+
+# -- live attach ---------------------------------------------------------
+
+
+def test_live_attach_backfills_exact_windows():
+    """A same-filter joiner at T0+8s backfills retained-slice windows
+    immediately and every window from its first exact one is
+    byte-identical to a from-start oracle."""
+    batches = _batches(seed=31)
+    got0, got1 = {}, {}
+    ctx = Context(EngineConfig())
+    base = _base(ctx, batches)
+    sp = SharedPipeline(ctx, [(base.window(["k"], AGGS, 3000, 1000), _sink(got0))])
+    when = T0 + 8_000
+    tag = sp.register(
+        base.window(["k"], AGGS, 2000, 1000),
+        _sink(got1),
+        label="joiner",
+        when_ts=when,
+    )
+    assert tag == 1
+    sp.run()
+
+    j_start = _first_exact_start(sp, tag)
+    oracle1 = _oracle(batches, 2000, 1000)
+    expect1 = {k: v for k, v in oracle1.items() if k[1] >= j_start}
+    assert got1 == expect1  # EXACT equality, every float
+    # the warm-up actually reached back: some exact windows CLOSED
+    # before the join point (served from retained slices, not live feed)
+    assert any(k[2] <= when for k in got1)
+    # the seed query is byte-identical to its own from-start oracle
+    assert got0 == _oracle(batches, 3000, 1000)
+    assert sp.root.metrics()["subscribers"] == 2
+
+
+def test_live_attach_residual_filter_exact_from_attach():
+    """A joiner with a strictly stronger predicate opens a fresh filter
+    class: no retained partials to warm from, so exactness starts past
+    the already-ingested max event time — and from there it is
+    byte-identical to its independent filtered oracle (which pins the
+    lexsort fold lane, the residual class's store lane)."""
+    batches = _batches(seed=32)
+    got0, got1 = {}, {}
+    ctx = Context(EngineConfig())
+    base = _base(ctx, batches)
+    sp = SharedPipeline(ctx, [(base.window(["k"], AGGS, 3000, 1000), _sink(got0))])
+    when = T0 + 9_000
+    tag = sp.register(
+        base.filter(col("v") > 12.0).window(["k"], AGGS, 2000, 1000),
+        _sink(got1),
+        when_ts=when,
+    )
+    sp.run()
+
+    j_start = _first_exact_start(sp, tag)
+    # fresh class: nothing before the attach point can be exact
+    assert j_start >= when - 2000
+    oracle1 = _oracle(batches, 2000, 1000, flt=col("v") > 12.0, sort_lane=True)
+    expect1 = {k: v for k, v in oracle1.items() if k[1] >= j_start}
+    assert expect1  # the window after the clamp still has content
+    assert got1 == expect1
+    assert sp.root.metrics()["filter_classes"] == 2
+
+
+def test_live_detach_survivor_unaffected():
+    batches = _batches(seed=33)
+    got0, got1 = {}, {}
+    ctx = Context(EngineConfig())
+    base = _base(ctx, batches)
+    sp = SharedPipeline(
+        ctx,
+        [
+            (base.window(["k"], AGGS, 3000, 1000), _sink(got0)),
+            (base.window(["k"], AGGS, 2000, 1000), _sink(got1)),
+        ],
+    )
+    when = T0 + 10_000
+    sp.deregister(1, when_ts=when)
+    sp.run()
+
+    # survivor: byte-identical to an undisturbed from-start oracle
+    assert got0 == _oracle(batches, 3000, 1000)
+    # the departed query emitted ONLY up to the leave point
+    oracle1 = _oracle(batches, 2000, 1000)
+    assert got1
+    assert set(got1) < set(oracle1)
+    assert all(got1[k] == oracle1[k] for k in got1)
+    assert max(k[2] for k in got1) <= when + 2000
+    m = sp.root.metrics()
+    assert m["subscribers"] == 1
+
+
+def test_register_rejects_unshareable():
+    batches = _batches(seed=34, n_batches=4)
+    ctx = Context(EngineConfig())
+    base = _base(ctx, batches)
+    seed = base.filter(col("v") > 10.0).window(["k"], AGGS, 3000, 1000)
+    sp = SharedPipeline(ctx, [(seed, _sink({}))])
+    # different group keys
+    with pytest.raises(PlanError, match="source, projection and group"):
+        sp.register(base.window([], AGGS, 3000, 1000), _sink({}))
+    # WEAKER predicate: the shared (v > 10) ingest cannot widen
+    with pytest.raises(PlanError, match="cannot widen"):
+        sp.register(
+            base.filter(col("v") > 5.0).window(["k"], AGGS, 2000, 1000),
+            _sink({}),
+        )
+    # window that does not tile the group's gcd slice
+    with pytest.raises(PlanError, match="tile"):
+        sp.register(
+            base.filter(col("v") > 10.0).window(["k"], AGGS, 1500, 500),
+            _sink({}),
+        )
+    # a STRONGER implied predicate is accepted
+    tag = sp.register(
+        base.filter(col("v") > 15.0).window(["k"], AGGS, 2000, 1000),
+        _sink({}),
+    )
+    assert tag >= 1
+
+
+# -- kill/restore with a live registration schedule ----------------------
+
+
+def _drive_with_schedule(sp, outs, *, kill_after_committed=None, orch=None,
+                         coord=None):
+    """Pump sp.root, routing tagged emissions; with a kill budget set,
+    trigger ONE epoch once the late joiner (tag 2) starts emitting,
+    commit it, keep going for the budget, then stop hard."""
+    committed = False
+    post_commit = 0
+    it = sp.root.run()
+    for item in it:
+        if isinstance(item, SubscriberBatch):
+            acc = outs.get(item.tag)
+            if acc is not None:
+                _rows_of(item.batch, acc)
+            if kill_after_committed is None:
+                continue
+            if item.tag == 2 and not committed and orch is not None:
+                orch.trigger_now()
+            if committed:
+                post_commit += 1
+                if post_commit >= kill_after_committed:
+                    it.close()
+                    return True
+        elif isinstance(item, Marker) and coord is not None:
+            coord.commit(item.epoch)
+            committed = True
+    return committed
+
+
+def _schedule(sp, base, outs):
+    """The replayable registration schedule: a short-lived query that
+    joins at +4s and leaves at +9s, and a joiner at +11s that outlives
+    the run.  Event-time thresholds make the schedule land at the same
+    stream positions on every (re)play."""
+    t1 = sp.register(
+        base.window(["k"], AGGS, 2000, 2000),
+        _sink(outs.setdefault(1, {})),
+        when_ts=T0 + 4_000,
+    )
+    sp.deregister(t1, when_ts=T0 + 9_000)
+    t2 = sp.register(
+        base.filter(col("v") > 12.0).window(["k"], AGGS, 2000, 1000),
+        _sink(outs.setdefault(2, {})),
+        when_ts=T0 + 11_000,
+    )
+    assert (t1, t2) == (1, 2)
+
+
+def test_kill_restore_with_live_joins_byte_identical(tmp_path):
+    """The acceptance scenario: SIGKILL-equivalent mid-epoch stop of a
+    shared pipeline AFTER a live join and a completed join+leave, then
+    restore + replay of the same registration schedule.  Per query, the
+    union of pre-kill and post-restore emissions must be byte-identical
+    to an uninterrupted run — the joiner adopts its checkpointed cursor
+    by TAG (no spurious backfill), the departed tag replays as a no-op."""
+    batches = _batches(seed=35, n_batches=24)
+    state_dir = str(tmp_path / "state")
+
+    def make_cfg(**kw):
+        return EngineConfig(**kw)
+
+    # golden: the SAME schedule, uninterrupted, no checkpointing
+    golden: dict[int, dict] = {0: {}}
+    ctx_g = Context(make_cfg())
+    base_g = _base(ctx_g, batches)
+    sp_g = SharedPipeline(
+        ctx_g,
+        [(base_g.window(["k"], AGGS, 3000, 1000), _sink(golden[0]))],
+    )
+    _schedule(sp_g, base_g, golden)
+    _drive_with_schedule(sp_g, golden)
+    assert golden[1] and golden[2]
+
+    got: dict[int, dict] = {0: {}}
+    try:
+        # run A: commit one epoch after the late joiner attached, keep
+        # emitting past it, then stop hard (mid-epoch progress lost)
+        ctx_a = Context(
+            make_cfg(
+                checkpoint=True,
+                checkpoint_interval_s=9999,
+                state_backend_path=state_dir,
+            )
+        )
+        base_a = _base(ctx_a, batches)
+        sp_a = SharedPipeline(
+            ctx_a,
+            [(base_a.window(["k"], AGGS, 3000, 1000), _sink(got[0]))],
+        )
+        _schedule(sp_a, base_a, got)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(sp_a.root, ctx_a, orch_a)
+        killed = _drive_with_schedule(
+            sp_a, got, kill_after_committed=6, orch=orch_a, coord=coord_a
+        )
+        assert killed
+        # the snapshot recorded the joiner's cursor and the departure
+        close_global_state_backend()
+
+        # run B: restore, REPLAY the schedule, drive to completion
+        ctx_b = Context(
+            make_cfg(
+                checkpoint=True,
+                checkpoint_interval_s=9999,
+                state_backend_path=state_dir,
+            )
+        )
+        base_b = _base(ctx_b, batches)
+        sp_b = SharedPipeline(
+            ctx_b,
+            [(base_b.window(["k"], AGGS, 3000, 1000), _sink(got[0]))],
+        )
+        _schedule(sp_b, base_b, got)
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(sp_b.root, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        # the joiner's checkpointed cursor is retained for tag adoption
+        assert 2 in sp_b.root._orphans
+        assert 1 in sp_b.root._departed
+        _drive_with_schedule(sp_b, got)
+        # replayed join adopted the cursor — it is attached, no orphan
+        assert 2 in {s.tag for s in sp_b.root._subs}
+        assert not sp_b.root._orphans
+    finally:
+        close_global_state_backend()
+
+    for tag in (0, 1, 2):
+        assert set(got[tag]) == set(golden[tag]), {
+            "tag": tag,
+            "missing": sorted(set(golden[tag]) - set(got[tag]))[:4],
+            "extra": sorted(set(got[tag]) - set(golden[tag]))[:4],
+        }
+        for k in golden[tag]:
+            assert got[tag][k] == golden[tag][k], (tag, k)

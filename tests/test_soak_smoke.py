@@ -46,3 +46,35 @@ def test_soak_smoke(tmp_path, pipeline):
     # recovery after SIGKILL banks its first emission promptly
     for t in r["recovery_first_emit_s"]:
         assert t < 30, r
+
+
+def test_soak_smoke_query_dense(tmp_path):
+    """Live multi-query registry under one SIGKILL: 50 staggered
+    queries, every emission checked byte-identical to its independent
+    oracle, backfills exact, one pipeline build per segment."""
+    out = tmp_path / "soak.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "soak.py"),
+            "--pipeline", "query_dense",
+            "--minutes", "0.5", "--kill-every", "8",
+            "--pace", "40000", "--batch-rows", "2048",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    r = json.loads(out.read_text())
+    if r.get("aborted") and "relay active" in r["aborted"]:
+        pytest.skip("soak yielded to an active TPU relay")
+    assert r["aborted"] is None, r
+    assert r["eos_done_seen"], r
+    assert r["kills"] >= 1, r
+    qd = r["query_dense"]
+    assert qd["oracle_rc"] == 0, qd
+    assert qd["oracle_windows"] > 0, qd
+    assert qd["failures"] == 0, qd
+    assert qd["queries_silent"] == [], qd
+    assert qd["backfill_missing"] == [], qd
+    assert qd["backfilled_joiners"] >= 10, qd
+    assert qd["max_builds_per_segment"] == 1, qd

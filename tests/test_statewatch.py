@@ -95,6 +95,85 @@ def test_space_saving_hot_key_survives_churn():
         assert 0.55 <= share <= 0.65, share
 
 
+def test_space_saving_decay_halves_counts_and_total():
+    """One decay step scales counts, errs, and total by decay_factor —
+    the windowed-sketch contract (shares stay comparable because both
+    numerator and denominator scale together)."""
+    ss = SpaceSaving(16, decay_every=1000, decay_factor=0.5)
+    ss.update(np.full(600, 7, dtype=np.int64))
+    ss.update(np.full(300, 9, dtype=np.int64))
+    assert ss.total == 900  # below the horizon: no decay yet
+    gids, counts, _ = ss.top(2)
+    before = dict(zip(gids.tolist(), counts.tolist()))
+    assert before == {7: 600, 9: 300}
+    # crossing the horizon decays the WINDOW first, then adds the batch
+    ss.update(np.full(100, 7, dtype=np.int64))
+    assert ss.total == 450 + 100
+    gids, counts, _ = ss.top(2)
+    after = dict(zip(gids.tolist(), counts.tolist()))
+    assert after == {7: 300 + 100, 9: 150}
+
+
+def test_space_saving_decay_retires_stale_celebrity():
+    """A celebrity that stops appearing must lose its top share within
+    a bounded number of decay horizons — the monotone sketch keeps it
+    near-forever (share only falls as 1/total), the windowed one
+    halves it per horizon.  This is what lets the join adaptation
+    policy FOLD a retired hot key promptly."""
+    monotone = SpaceSaving(64)
+    windowed = SpaceSaving(64, decay_every=10_000, decay_factor=0.5)
+    rng = np.random.default_rng(3)
+    hot_phase = np.concatenate(
+        [np.full(700, 42), rng.integers(0, 50, 300)]
+    ).astype(np.int64)
+    for _ in range(10):
+        monotone.update(hot_phase)
+        windowed.update(hot_phase)
+    for ss in (monotone, windowed):
+        g, c, _ = ss.top(1)
+        assert g[0] == 42 and c[0] / ss.total > 0.6
+    cold_phase = rng.integers(100, 150, 1000).astype(np.int64)
+    for _ in range(30):
+        monotone.update(cold_phase)
+        windowed.update(cold_phase)
+
+    def share(ss, key):
+        gids, counts, _ = ss.top(64)
+        m = dict(zip(gids.tolist(), counts.tolist()))
+        return m.get(key, 0) / ss.total
+
+    # monotone: still >17% after 3x cold traffic (7000/40000)
+    assert share(monotone, 42) > 0.15
+    # windowed: decayed well below the fold threshold regime
+    assert share(windowed, 42) < 0.05
+
+
+def test_fold_trigger_fires_on_decayed_share():
+    """The policy's fold condition (share < fold_share for hold_ticks
+    consecutive ticks) must become reachable through sketch decay alone
+    — pin it directly against the windowed sketch's share sequence."""
+    from denormalized_tpu.obs.doctor.actions import JoinAdaptationPolicy
+
+    pol = JoinAdaptationPolicy()
+    ss = SpaceSaving(64, decay_every=2_000, decay_factor=0.5)
+    ss.update(np.full(10_000, 42, dtype=np.int64))  # all-hot warmup
+    ticks_below = 0
+    rng = np.random.default_rng(5)
+    for _ in range(40):
+        ss.update(rng.integers(100, 150, 1000).astype(np.int64))
+        gids, counts, _ = ss.top(64)
+        m = dict(zip(gids.tolist(), counts.tolist()))
+        if m.get(42, 0) / ss.total < pol.fold_share:
+            ticks_below += 1
+            if ticks_below >= pol.hold_ticks:
+                break
+        else:
+            ticks_below = 0
+    assert ticks_below >= pol.hold_ticks, (
+        "decayed share never stayed below fold_share long enough"
+    )
+
+
 def test_space_saving_reset():
     ss = SpaceSaving(16)
     ss.update(np.arange(100))

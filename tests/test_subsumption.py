@@ -1,0 +1,279 @@
+"""Predicate-subsumption edge cases (planner/predicates.py + sharing).
+
+The conservative implication checker decides which query joins a
+shared ingest with a residual re-filter — a FALSE positive here is a
+correctness bug (rows the joiner wants would be missing from the
+shared ingest), so every edge lives under test:
+
+- boundary-touching ranges and strictness (``v >= 5 ⇒ v > 4`` but
+  NOT ``v > 5``... and so on);
+- IN-lists vs equality vs intervals (finite sets nest into intervals);
+- NaN literals are opaque (``v > nan`` constrains nothing and must
+  never share structurally);
+- NaN/null DATA rows: a constrained conjunct rejects them on both
+  sides, so a shared run with residual re-filters stays differentially
+  identical to independent oracles even with nulls in the filter
+  column;
+- the negative pin: non-implied predicates never share.
+"""
+
+import numpy as np
+import pytest
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.planner import predicates as pr
+from denormalized_tpu.planner.sharing import detect_sharing
+from denormalized_tpu.runtime.multi_query import run_queries
+from denormalized_tpu.sources.memory import MemorySource
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+
+
+def _implies(p_expr, q_expr) -> bool:
+    return pr.implies(pr.analyze([p_expr]), pr.analyze([q_expr]))
+
+
+# -- interval boundaries -------------------------------------------------
+
+
+def test_range_strictness_boundaries():
+    v = col("v")
+    assert _implies(v > 5.0, v > 4.0)
+    assert _implies(v >= 5.0, v > 4.0)
+    assert _implies(v > 5.0, v >= 5.0)
+    assert _implies(v >= 5.0, v >= 5.0)
+    # the boundary row v == 5 satisfies >= 5 but not > 5
+    assert not _implies(v >= 5.0, v > 5.0)
+    assert not _implies(v > 4.0, v > 5.0)
+    assert not _implies(v > 4.0, v >= 5.0)
+    # upper bounds, mirrored
+    assert _implies(v < 4.0, v < 5.0)
+    assert _implies(v < 5.0, v <= 5.0)
+    assert not _implies(v <= 5.0, v < 5.0)
+    # two-sided nesting
+    both_tight = (v > 2.0) & (v < 3.0)
+    both_loose = (v > 1.0) & (v < 4.0)
+    assert _implies(both_tight, both_loose)
+    assert not _implies(both_loose, both_tight)
+    # conjunct ordering is irrelevant
+    assert _implies((v < 3.0) & (v > 2.0), both_loose)
+
+
+def test_equality_and_in_list_nesting():
+    k, v = col("k"), col("v")
+    assert _implies(k == "a", F.in_list(k, ["a", "b"]))
+    assert not _implies(F.in_list(k, ["a", "b"]), k == "a")
+    assert _implies(
+        F.in_list(k, ["a"]),
+        F.in_list(k, ["a", "b"]),
+    )
+    # a finite numeric set nests into a covering interval...
+    assert _implies(F.in_list(v, [2.0, 3.0]), v > 1.0)
+    assert _implies(v == 2.0, v >= 2.0)
+    # ...but not when one member leaks out (boundary: 1.0 fails > 1.0)
+    assert not _implies(F.in_list(v, [1.0, 2.0]), v > 1.0)
+    # an interval never implies a finite set
+    assert not _implies(v > 1.0, F.in_list(v, [2.0, 3.0]))
+
+
+def test_unconstrained_and_unrelated_columns():
+    k, v = col("k"), col("v")
+    # anything implies the empty predicate; the converse does not hold
+    assert pr.implies(pr.analyze([v > 0.0]), pr.analyze([]))
+    assert not pr.implies(pr.analyze([]), pr.analyze([v > 0.0]))
+    # a bound on one column says nothing about another
+    assert not _implies(v > 5.0, k == "a")
+    # extra constrained columns on the stronger side are fine
+    assert _implies((v > 5.0) & (k == "a"), v > 0.0)
+
+
+def test_nan_literal_is_opaque():
+    v = col("v")
+    nan = float("nan")
+    # v > nan is the empty predicate; treating it as an interval would
+    # "prove" it implies anything — it must stay opaque instead
+    cons = pr.analyze([v > nan])
+    assert "v" not in cons.intervals and cons.opaque
+    assert not _implies(v > nan, v > 0.0)
+    assert not _implies(v > 0.0, v > nan)
+    # identical opaque conjuncts still match by repr
+    assert _implies(v > nan, v > nan)
+    cons_in = pr.analyze([F.in_list(v, [nan, 1.0])])
+    assert "v" not in cons_in.sets and cons_in.opaque
+
+
+def test_opaque_conjuncts_match_by_repr_only():
+    k, v = col("k"), col("v")
+    disj = (v > 5.0) | (k == "a")
+    assert _implies(disj, disj)
+    assert not _implies(disj, (v > 5.0) | (k == "b"))
+    # opaque+constrained mix: P needs Q's opaque verbatim
+    assert _implies(pr.conjoin([disj, v > 5.0]), disj)
+    assert not _implies(v > 5.0, disj)  # would need OR reasoning
+
+
+# -- sharing-pass integration -------------------------------------------
+
+
+AGGS = [F.count(col("v")).alias("c"), F.sum(col("v")).alias("s")]
+
+
+def _plans(batches, filters, L=3000, S=1000):
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    out = []
+    for flt in filters:
+        ds = base if flt is None else base.filter(flt)
+        out.append(ds.window(["k"], AGGS, L, S)._plan)
+    return out
+
+
+def _batches(seed=41, n_batches=12, rows=300, null_frac=0.0, nan_frac=0.0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 1000, rows))
+        ks = np.asarray([f"s{i}" for i in rng.integers(0, 5, rows)], object)
+        vs = rng.normal(10.0, 4.0, rows)
+        if nan_frac:
+            vs[rng.random(rows) < nan_frac] = np.nan
+        if null_frac:
+            vs = vs.astype(object)
+            vs[rng.random(rows) < null_frac] = None
+            vs = np.asarray(vs, object)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def test_sharing_pass_boundary_negative_pin():
+    """v > 5 and v >= 5 share — but only by REBASING onto the weaker
+    >= 5 side (ingesting under > 5 would drop the boundary rows);
+    incomparable ranges never share."""
+    batches = _batches(n_batches=4)
+    v = col("v")
+    groups = detect_sharing(_plans(batches, [v > 5.0, v >= 5.0]))
+    shared = [g for g in groups if g.shared]
+    assert len(shared) == 1 and shared[0].members == [0, 1]
+    # the strict > 5 member re-filters; the >= 5 member IS the base
+    assert shared[0].filters[0] is not None
+    assert shared[0].filters[1] is None
+    # disjoint ranges: neither implies the other, no group
+    groups = detect_sharing(_plans(batches, [v > 5.0, v < 5.0]))
+    assert all(len(g.members) == 1 for g in groups)
+    groups = detect_sharing(_plans(batches, [v > 4.0, v >= 5.0]))
+    assert [g.members for g in groups if g.shared] == [[0, 1]]
+
+
+def test_sharing_pass_widens_base_to_weakest_member():
+    """Arrival order must not matter: when the weaker predicate shows
+    up AFTER a stronger one, the group re-bases onto it."""
+    batches = _batches(n_batches=4)
+    v = col("v")
+    groups = detect_sharing(_plans(batches, [v > 5.0, v > 1.0, v > 3.0]))
+    shared = [g for g in groups if g.shared]
+    assert len(shared) == 1 and shared[0].members == [0, 1, 2]
+    g = shared[0]
+    # base = the v > 1 member: its residual is None, the others re-filter
+    assert g.filters[1] is None
+    assert g.filters[0] is not None and g.filters[2] is not None
+
+
+@pytest.mark.parametrize("null_frac,nan_frac", [(0.0, 0.0), (0.15, 0.1)])
+def test_shared_residuals_differential_vs_oracles(null_frac, nan_frac):
+    """The end-to-end differential: a subsumption group with residual
+    re-filters emits byte-identically to per-query independent oracles
+    — including NaN and null rows in the filter column, which every
+    constrained predicate rejects on both sides."""
+    batches = _batches(
+        seed=43, n_batches=14, null_frac=null_frac, nan_frac=nan_frac
+    )
+    v, k = col("v"), col("k")
+    filters = [
+        v > 6.0,
+        (v > 8.0) & (v < 14.0),
+        F.in_list(k, ["s0", "s1"]) & (v > 9.0),
+    ]
+    # every member implies the weakest (v > 6) predicate — including
+    # the k-in-list member, whose extra key constraint only narrows —
+    # so all three ride one ingest with per-member residuals
+    plans = _plans(batches, filters)
+    groups = detect_sharing(plans)
+    shared = [g for g in groups if g.shared]
+    assert len(shared) == 1 and shared[0].members == [0, 1, 2]
+    assert shared[0].filters[0] is None  # v > 6 IS the base
+
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    outs = [dict() for _ in filters]
+
+    def rows_of(b, acc):
+        for i in range(b.num_rows):
+            key = (
+                b.column("k")[i],
+                int(b.column("window_start_time")[i]),
+            )
+            acc[key] = (
+                float(b.column("c")[i]),
+                float(b.column("s")[i]),
+            )
+
+    queries = [
+        (
+            base.filter(flt).window(["k"], AGGS, 3000, 1000),
+            (lambda acc: (lambda b: rows_of(b, acc)))(outs[i]),
+        )
+        for i, flt in enumerate(filters)
+    ]
+    report = run_queries(ctx, queries)
+    assert report["shared_queries"] == 3
+
+    for i, flt in enumerate(filters):
+        # oracle pins the shared group's slice unit AND, for RESIDUAL
+        # members only, the lexsort fold lane their class store forces
+        # (the base member folds through the default dense lane)
+        octx = Context(
+            EngineConfig(
+                slice_windows=True,
+                slice_unit_ms=1000,
+                slice_sort_lane=(i != 0),
+            )
+        )
+        ods = octx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"),
+            name="feed",
+        ).filter(flt).window(["k"], AGGS, 3000, 1000)
+        oracle = {}
+        for b in ods.stream():
+            rows_of(b, oracle)
+        assert outs[i] == oracle, f"query {i} diverged from its oracle"
+
+
+def test_subsumption_off_config_restores_exact_match_sharing():
+    batches = _batches(n_batches=4)
+    v = col("v")
+    plans = _plans(batches, [v > 0.0, v > 1.0])
+    assert [g.members for g in detect_sharing(plans) if g.shared] == [[0, 1]]
+    off = detect_sharing(plans, subsumption=False)
+    assert all(not g.shared for g in off)
+    # identical predicates still share with subsumption off
+    same = _plans(batches, [v > 1.0, v > 1.0])
+    assert [
+        g.members for g in detect_sharing(same, subsumption=False) if g.shared
+    ] == [[0, 1]]

@@ -132,6 +132,87 @@ SEED_LEFT = 11
 SEED_RIGHT = 23
 
 
+# -- query-dense live-registration soak (ISSUE 16) -----------------------
+# 50 concurrent windowed queries over one feed, all subsumption-shared
+# into ONE slice pipeline: a handful present from the start, the rest
+# joining LIVE at staggered event times (incl. mid-epoch), some leaving
+# mid-run.  Every when_ts below is event time, so re-issuing the whole
+# schedule verbatim after a SIGKILL/restore lands each join/leave at
+# the same stream position — the registration control plane is
+# replayable by construction.
+
+QD_QUERIES = 50
+QD_INITIAL = 6
+QD_UNIT_MS = 1000
+#: (length, slide) cycle — every spec tiles the 1000ms gcd unit
+QD_SPECS = [(3000, 1000), (2000, 1000), (4000, 2000), (2000, 2000),
+            (5000, 1000), (3000, 3000), (6000, 2000), (4000, 1000)]
+#: reading > thr filter cycle; index 0 (weakest) is the group's base
+#: predicate — every other threshold is implied by it (subsumption)
+QD_THRESHOLDS = [30.0, 38.0, 42.0, 46.0, 50.0, 52.0, 55.0, 35.0]
+
+
+def qd_schedule(total_batches: int, batch_rows: int, pace: float) -> list:
+    """The deterministic 50-query control plane: returns one dict per
+    query — {"qid", "L", "S", "thr"} plus "join" (event-time when_ts)
+    for the 44 live joiners and "leave" for the mid-run departures.
+    Pure function of the feed shape; parent, child, and the oracle
+    child all derive the identical schedule from SOAK_* env."""
+    span_ms = batch_rows * 1000.0 / pace
+    horizon = int(total_batches * span_ms)
+    queries = []
+    for q in range(QD_QUERIES):
+        length, slide = QD_SPECS[q % len(QD_SPECS)]
+        queries.append({
+            "qid": q, "L": length, "S": slide,
+            "thr": QD_THRESHOLDS[q % len(QD_THRESHOLDS)],
+        })
+    # joiners: staggered across the middle of the event-time horizon at
+    # off-second offsets (joins land mid-epoch relative to the wall-
+    # clock checkpoint cadence); the tail 12s stays join-free so every
+    # joiner still closes full windows before EOS
+    njoin = QD_QUERIES - QD_INITIAL
+    join_lo = 4000
+    join_hi = max(join_lo + 1000, horizon - 12000)
+    for j, q in enumerate(range(QD_INITIAL, QD_QUERIES)):
+        queries[q]["join"] = (
+            T0 + join_lo + (join_hi - join_lo) * j // max(njoin - 1, 1)
+        )
+    # leavers: every fifth joiner departs a third of the horizon after
+    # it joined (never in the EOS drain tail — departure must be a live
+    # detach, not the pipeline close)
+    for q in range(QD_INITIAL, QD_QUERIES):
+        if q % 5 == 2:
+            leave = min(
+                queries[q]["join"] + horizon // 3, T0 + horizon - 6000
+            )
+            if leave > queries[q]["join"] + queries[q]["L"] + 2000:
+                queries[q]["leave"] = leave
+    return queries
+
+
+def qd_class_continuous(specs: dict, qid: int) -> bool:
+    """True when ``qid``'s threshold class had some member alive from
+    before its join clear through the join instant — its filter class's
+    slice partials were retained, so the attach OWES a warm backfill
+    (first emitted window strictly before the join time).  First-of-
+    class joiners clamp forward instead (fresh-class rule) and owe
+    nothing."""
+    spec = specs[qid]
+    join = spec["join"]
+    for other in specs.values():
+        if other["qid"] == qid or other["thr"] != spec["thr"]:
+            continue
+        born = other.get("join")
+        if born is not None and born >= join:
+            continue
+        gone = other.get("leave")
+        if gone is not None and gone <= join:
+            continue
+        return True
+    return False
+
+
 def _group_reduce(comp, arrays):
     """Composite-key group reduction shared by the golden folds — ONE
     argsort/unique reused across every value array: ``arrays`` is a list
@@ -695,6 +776,173 @@ def child_main() -> None:
         metrics_jsonl_interval_s=1.0,
     )
     ctx = Context(cfg)
+
+    def qd_aggs():
+        # the foldable set MINUS variance: the shared store's variance
+        # pivot differs from an independent oracle's, so stddev is not
+        # byte-comparable across the two runs (docs/multi_query.md)
+        return [
+            F.count(col("reading")).alias("count"),
+            F.sum(col("reading")).alias("sum"),
+            F.min(col("reading")).alias("min"),
+            F.max(col("reading")).alias("max"),
+            F.avg(col("reading")).alias("average"),
+        ]
+
+    if pipeline == "query_dense":
+        # ISSUE 16 acceptance: 50 queries register/deregister LIVE on
+        # one shared slice pipeline (staggered event-time arrivals,
+        # incl. mid-epoch joins), SIGKILLed mid-run; every query's
+        # committed emissions must be byte-identical to an independent
+        # uninterrupted oracle from its first exact window.  The
+        # schedule is event-time keyed, so this child re-issues it
+        # VERBATIM every incarnation: subscribers the restored
+        # checkpoint carried adopt their snapshotted cursor (orphan
+        # adoption by tag), departed tags stay departed, future ops
+        # fire when stream time reaches them.
+        from denormalized_tpu.runtime.multi_query import SharedPipeline
+
+        sched = qd_schedule(total_batches, batch_rows, pace)
+        base = ctx.from_source(
+            SoakSource(SEED_LEFT, "soak_qd"), name="soak_qd"
+        )
+        aggs = qd_aggs()
+
+        def q_stream(spec):
+            return base.filter(col("reading") > spec["thr"]).window(
+                ["sensor_name"], aggs, spec["L"], spec["S"]
+            )
+
+        with open(out_path, "a", buffering=1) as out:
+            out.write(json.dumps({"event": "ready", "t": time.time()}) + "\n")
+            announced: list = []
+
+            def mk_sink(qid):
+                def sink(b):
+                    coord = getattr(ctx, "_last_coord", None)
+                    if not announced:
+                        # exactly-once output protocol: announce the
+                        # recovery point before any window line (the
+                        # parent clips the predecessor's uncommitted
+                        # suffix at this epoch)
+                        announced.append(True)
+                        out.write(json.dumps({
+                            "event": "restored",
+                            "epoch": (
+                                (coord.restored_epoch or 0)
+                                if coord is not None else None
+                            ),
+                        }) + "\n")
+                    ep = (
+                        (coord.committed_epoch or 0) + 1
+                        if coord is not None else None
+                    )
+                    ws = b.column(WINDOW_START_COLUMN)
+                    names = b.column("sensor_name")
+                    cols = [
+                        b.column(c)
+                        for c in ("count", "sum", "min", "max", "average")
+                    ]
+                    for i in range(b.num_rows):
+                        # full float repr — the parent compares these
+                        # for byte-identity, not tolerance
+                        rec = {
+                            "q": qid, "ws": int(ws[i]),
+                            "key": str(names[i]),
+                            "count": int(cols[0][i]),
+                            "sum": float(cols[1][i]),
+                            "min": float(cols[2][i]),
+                            "max": float(cols[3][i]),
+                            "avg": float(cols[4][i]),
+                        }
+                        if ep is not None:
+                            rec["ep"] = ep
+                        out.write(json.dumps(rec) + "\n")
+                return sink
+
+            initial = [s for s in sched if "join" not in s]
+            sp = SharedPipeline(
+                ctx,
+                [(q_stream(s), mk_sink(s["qid"])) for s in initial],
+                labels=[f"q{s['qid']}" for s in initial],
+            )
+            assert sp.root.unit_ms == QD_UNIT_MS, sp.root.unit_ms
+            # one build per process incarnation: live joins/leaves must
+            # NEVER rebuild the shared pipeline (the parent gates on
+            # at most one of these per segment)
+            out.write(json.dumps({"event": "build", "t": time.time()}) + "\n")
+            for s in sched:
+                if "join" not in s:
+                    continue
+                tag = sp.register(
+                    q_stream(s), mk_sink(s["qid"]),
+                    label=f"q{s['qid']}", when_ts=s["join"],
+                )
+                assert tag == s["qid"], (tag, s["qid"])
+            for s in sched:
+                if "leave" in s:
+                    sp.deregister(s["qid"], when_ts=s["leave"])
+            sp.run()
+            m = sp.root.metrics()
+            out.write(json.dumps({"event": "metrics", **{
+                k: v for k, v in m.items() if isinstance(v, (int, float))
+            }}) + "\n")
+            out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
+        return
+
+    if pipeline == "query_dense_oracle":
+        # per-query independent UNINTERRUPTED oracles over the same
+        # index-deterministic feed, replayed densely (no pacing): the
+        # byte-identity referent for the live shared run.  Slice mode
+        # pins to the shared group's gcd unit so fold order matches
+        # (the aggregates carry extrema, so both runs take the lexsort
+        # fold lane).
+        from denormalized_tpu.sources.memory import MemorySource
+
+        sched = qd_schedule(total_batches, batch_rows, pace)
+        feed = []
+        for i in range(total_batches):
+            ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+            feed.append(RecordBatch(schema, [ts, key_names[keys], vals]))
+        with open(out_path, "a", buffering=1) as out:
+            for spec in sched:
+                octx = Context(EngineConfig(
+                    min_batch_bucket=batch_rows,
+                    min_window_slots=32,
+                    slice_windows=True,
+                    slice_unit_ms=QD_UNIT_MS,
+                    emit_on_close=True,
+                ))
+                ds = octx.from_source(
+                    MemorySource.from_batches(
+                        feed, timestamp_column="occurred_at_ms"
+                    ),
+                    name="soak_qd",
+                ).filter(col("reading") > spec["thr"]).window(
+                    ["sensor_name"], qd_aggs(), spec["L"], spec["S"]
+                )
+                for b in ds.stream():
+                    if not b.schema.has(WINDOW_START_COLUMN):
+                        continue
+                    ws = b.column(WINDOW_START_COLUMN)
+                    names = b.column("sensor_name")
+                    cols = [
+                        b.column(c)
+                        for c in ("count", "sum", "min", "max", "average")
+                    ]
+                    for i in range(b.num_rows):
+                        out.write(json.dumps({
+                            "q": spec["qid"], "ws": int(ws[i]),
+                            "key": str(names[i]),
+                            "count": int(cols[0][i]),
+                            "sum": float(cols[1][i]),
+                            "min": float(cols[2][i]),
+                            "max": float(cols[3][i]),
+                            "avg": float(cols[4][i]),
+                        }) + "\n")
+            out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
+        return
+
     last_close_ws = (
         int(os.environ["SOAK_LAST_CLOSE_WS"])
         if pipeline == "kafka" else None
@@ -1287,6 +1535,16 @@ def read_emissions(paths):
     wins: dict = {}
     dupes = 0
     for seg_idx, o in kept:
+        if "q" in o:  # query-dense record: per-query key, full precision
+            k = (o["ws"], o["key"], o["q"])
+            occ = wins.setdefault(k, [])
+            if occ:
+                dupes += 1
+            occ.append((
+                (o["count"], o["sum"], o["min"], o["max"], o["avg"]),
+                seg_idx,
+            ))
+            continue
         k = (o["ws"], o["key"])
         occ = wins.setdefault(k, [])
         if occ:
@@ -1304,6 +1562,121 @@ def read_emissions(paths):
         # of the compared tuple
         occ.append((vals, seg_idx))
     return wins, dupes, done, metrics, clipped
+
+
+def qd_verify(args, env, work, wins, seg_paths, total_batches) -> dict:
+    """Query-dense acceptance: spawn the oracle child (50 independent
+    uninterrupted runs over the same feed), then hold every live
+    query's committed emissions to BYTE-identity with its oracle from
+    its first exact window — late joiners' backfilled windows
+    included, departed queries' prefixes included, duplicate committed
+    occurrences each checked.  Also counts pipeline builds per segment
+    (live joins/leaves must never rebuild the shared pipeline)."""
+    oracle_path = os.path.join(work, "qd_oracle.jsonl")
+    oenv = dict(env)
+    oenv["SOAK_PIPELINE"] = "query_dense_oracle"
+    oenv["SOAK_OUT"] = oracle_path
+    rc = subprocess.call(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=oenv, stdout=sys.stderr, stderr=sys.stderr,
+    )
+    oracle: dict = {}  # qid -> {(key, ws): vals}
+    if rc == 0:
+        with open(oracle_path) as f:
+            for line in f:
+                try:
+                    o = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "ws" not in o:
+                    continue
+                oracle.setdefault(o["q"], {})[(o["key"], o["ws"])] = (
+                    o["count"], o["sum"], o["min"], o["max"], o["avg"]
+                )
+
+    builds_per_seg = []
+    for p in seg_paths:
+        n = 0
+        try:
+            with open(p) as f:
+                for line in f:
+                    if '"event": "build"' in line:
+                        n += 1
+        except FileNotFoundError:
+            pass
+        builds_per_seg.append(n)
+
+    per_q: dict = {}  # qid -> {(key, ws): [vals, ...]}
+    for (ws, key, q), occs in wins.items():
+        per_q.setdefault(q, {}).setdefault((key, ws), []).extend(
+            v for v, _seg in occs
+        )
+
+    sched = qd_schedule(total_batches, args.batch_rows, args.pace)
+    specs = {s["qid"]: s for s in sched}
+    failures: list = []
+    silent: list = []
+    backfilled = 0
+    backfill_missing: list = []
+    for q, spec in specs.items():
+        got = per_q.get(q)
+        if not got:
+            silent.append(q)
+            continue
+        want_all = oracle.get(q, {})
+        min_ws = min(ws for (_k, ws) in got)
+        max_ws = max(ws for (_k, ws) in got)
+        leave = spec.get("leave")
+        if leave is None:
+            # survivor: exact through the EOS flush — every oracle
+            # window from the first emitted one onward, byte-identical
+            want = {kw: v for kw, v in want_all.items() if kw[1] >= min_ws}
+        else:
+            want = {
+                kw: v for kw, v in want_all.items()
+                if min_ws <= kw[1] <= max_ws
+            }
+            if max_ws > leave + spec["L"]:
+                failures.append(
+                    (q, "emitted past its leave", max_ws, leave)
+                )
+        incoherent = [
+            kw for kw, vs in got.items() if any(v != vs[0] for v in vs[1:])
+        ]
+        if incoherent:
+            failures.append(
+                (q, "inconsistent duplicate emissions", incoherent[:2], None)
+            )
+        flat = {kw: vs[0] for kw, vs in got.items()}
+        if flat != want:
+            failures.append((q, "diverged from oracle", {
+                "missing": sorted(set(want) - set(flat))[:2],
+                "extra": sorted(set(flat) - set(want))[:2],
+                "value_diff": [
+                    kw for kw in set(flat) & set(want)
+                    if flat[kw] != want[kw]
+                ][:2],
+            }, None))
+        join = spec.get("join")
+        if join is not None:
+            if min_ws < join:
+                backfilled += 1
+            elif qd_class_continuous(specs, q):
+                backfill_missing.append(q)
+    return {
+        "oracle_rc": rc,
+        "oracle_windows": sum(len(v) for v in oracle.values()),
+        "queries": len(specs),
+        "joined_live": sum(1 for s in sched if "join" in s),
+        "departed": sum(1 for s in sched if "leave" in s),
+        "pipeline_builds_per_segment": builds_per_seg,
+        "max_builds_per_segment": max(builds_per_seg, default=0),
+        "queries_silent": silent,
+        "backfilled_joiners": backfilled,
+        "backfill_missing": backfill_missing,
+        "failures": len(failures),
+        "failure_sample": failures[:3],
+    }
 
 
 def _obs_readers():
@@ -1930,7 +2303,8 @@ def main():
     ap.add_argument("--kill-every", type=float, default=90.0)
     ap.add_argument("--pipeline",
                     choices=("simple", "sliding", "join", "session",
-                             "udaf", "kafka", "bigstate", "cluster"),
+                             "udaf", "kafka", "bigstate", "cluster",
+                             "query_dense"),
                     default="simple")
     ap.add_argument("--cluster-workers", type=int, default=3,
                     help="cluster: engine worker processes")
@@ -1978,6 +2352,7 @@ def main():
                 "kafka": "SOAK_KAFKA.json",
                 "bigstate": "SOAK_BIGSTATE.json",
                 "cluster": "SOAK_CLUSTER.json",
+                "query_dense": "SOAK_QUERY_DENSE.json",
             }[args.pipeline]
         ))
     if args.child:
@@ -2069,6 +2444,10 @@ def main():
         ),
         "session": golden_update_session,
         "sliding": golden_update_sliding,
+        # query_dense verifies against per-query ORACLE RUNS (qd_verify)
+        # after the drive loop, not an incremental golden fold — the
+        # loop still advances golden_i to track feed exhaustion
+        "query_dense": lambda agg, i, br, pc: None,
     }.get(args.pipeline, golden_update)  # udaf golden == tumbling fold
     golden_i = 0
     seg_paths = []
@@ -2164,6 +2543,49 @@ def main():
         wins, dupes, done_seen, child_metrics, clipped = read_emissions(
             seg_paths
         )
+        if args.pipeline == "query_dense":
+            qd = (
+                None if aborted
+                else qd_verify(args, env, work, wins, seg_paths,
+                               total_batches)
+            )
+            try:
+                telemetry = derive_telemetry(obs_paths)
+            except Exception as e:  # dnzlint: allow(broad-except) telemetry derivation is reporting, not verification
+                telemetry = {"error": str(e)}
+            ok = bool(
+                not aborted and done_seen and kills_issued >= 2
+                and qd is not None
+                and qd["oracle_rc"] == 0 and qd["oracle_windows"] > 0
+                and qd["failures"] == 0 and not qd["queries_silent"]
+                and not qd["backfill_missing"]
+                and qd["backfilled_joiners"] >= 10
+                and qd["max_builds_per_segment"] == 1
+            )
+            write({
+                "aborted": aborted,
+                "telemetry": telemetry,
+                "eos_done_seen": done_seen,
+                "kills": kills_issued,
+                "recovery_first_emit_s": recovery_times,
+                "emitted_rows": sum(len(v) for v in wins.values()),
+                "duplicate_emissions": dupes,
+                "uncommitted_clipped": clipped,
+                "child_metrics": child_metrics,
+                "query_dense": qd,
+                "ok": ok,
+            })
+            print(json.dumps({
+                "ok": ok,
+                "kills": kills_issued,
+                "queries": qd and qd["queries"],
+                "joined_live": qd and qd["joined_live"],
+                "departed": qd and qd["departed"],
+                "backfilled": qd and qd["backfilled_joiners"],
+                "failures": qd and qd["failures"],
+                "aborted": aborted,
+            }))
+            return
         if args.pipeline == "kafka" and not aborted:
             # the unbounded source ends at last_close_ws by design: windows
             # past it may or may not close (idle-hint timing) before the
