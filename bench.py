@@ -2503,6 +2503,350 @@ def run_query_dense() -> dict:
     }
 
 
+def run_join_dense() -> dict:
+    """BENCH_CONFIG=join_dense — the shared-join multi-query acceptance
+    artifact (JOIN_DENSE.json, ISSUE 17): 25 concurrent windowed
+    queries over the SAME fact×dim interval join execute as ONE
+    StreamingJoinExec fanning into the shared slice pipeline, against
+    25 independent join+window production pipelines.
+
+    Cells:
+
+    - shared vs independent: 25 queries cycling 8 window specs x 8
+      nested ``reading`` thresholds over one band join — the join's
+      build/probe/gather runs ONCE instead of 25 times; gate >= 5x
+      the independent aggregate throughput;
+    - no-sharing control: 25 queries whose band predicates all DIFFER
+      (every join signature unique, nothing may group) — the sharing
+      planner must stay within 5% of ``sharing=False`` (>= 0.95x);
+    - spot byte-identity: 3 members (the base class + two residual
+      classes) compared exactly against independent join+window
+      pipelines.  The feed's readings are integer-valued, so count /
+      sum are exact and avg is the identical division regardless of
+      fold grouping — byte-identity holds against ANY correct
+      execution order, no fold-lane pinning needed;
+    - kill/restore + live registry: a short ``tools/soak.py
+      --pipeline join_dense`` segment SIGKILLs the shared-join child
+      mid-stream with mid-stream register + deregister on the
+      schedule; its verifier holds every committed emission
+      byte-identical to independent uninterrupted oracles.
+    """
+    import subprocess
+
+    from denormalized_tpu.common.record_batch import RecordBatch
+    from denormalized_tpu.common.schema import DataType, Field, Schema
+    from denormalized_tpu.physical.simple_execs import CallbackSink
+    from denormalized_tpu.runtime.multi_query import run_queries
+
+    col, F = _F()
+    rows = int(os.environ.get("BENCH_JD_ROWS", 150_000))
+    batch_rows = min(int(os.environ.get("BENCH_JD_BATCH", 16_384)), rows)
+    n_queries = int(os.environ.get("BENCH_JD_QUERIES", 25))
+    n_keys = int(os.environ.get("BENCH_JD_KEYS", 64))
+    band_ms = 1_000
+    rows_per_ms = 2  # 150k rows → 75s of event time
+    t0 = EVENT_T0
+
+    fact_schema = Schema([
+        Field("occurred_at_ms", DataType.INT64, nullable=False),
+        Field("sensor_name", DataType.STRING, nullable=False),
+        Field("reading", DataType.FLOAT64),
+    ])
+    dim_schema = Schema([
+        Field("dim_at_ms", DataType.INT64, nullable=False),
+        Field("dim_sensor", DataType.STRING, nullable=False),
+        Field("dim_w", DataType.FLOAT64),
+    ])
+    keys = np.array(
+        [f"sensor_{i}" for i in range(n_keys)], dtype=object
+    )
+    rng = np.random.default_rng(7)
+    fact_batches = []
+    for start in range(0, rows, batch_rows):
+        n = min(batch_rows, rows - start)
+        ts = t0 + np.arange(start, start + n, dtype=np.int64) // rows_per_ms
+        names = keys[rng.integers(0, n_keys, n)]
+        # integer-valued readings: every aggregate is fold-order exact
+        vals = np.round(rng.normal(50.0, 10.0, n))
+        fact_batches.append(RecordBatch(fact_schema, [ts, names, vals]))
+    span_s = -(-rows // rows_per_ms // 1_000)
+    # one dim row per (key, event-second): each fact row band-matches
+    # exactly one dim row (0 <= occurred_at_ms - dim_at_ms <= 999)
+    dim_batches = []
+    for sec0 in range(0, span_s, 8):
+        secs = np.arange(sec0, min(sec0 + 8, span_s), dtype=np.int64)
+        ts = np.repeat(t0 + secs * 1_000, n_keys)
+        names = np.tile(keys, len(secs))
+        dim_batches.append(RecordBatch(
+            dim_schema, [ts, names, rng.random(len(ts))]
+        ))
+    feed_rows = sum(b.num_rows for b in fact_batches)
+    dim_rows = sum(b.num_rows for b in dim_batches)
+
+    spec_cycle = [
+        (3_000, 1_000), (2_000, 1_000), (4_000, 2_000), (2_000, 2_000),
+        (3_000, 3_000), (4_000, 1_000), (5_000, 1_000), (6_000, 2_000),
+    ]
+    thresholds = [30.0, 38.0, 42.0, 46.0, 50.0, 52.0, 55.0, 35.0]
+    aggs = [
+        F.count(col("reading")).alias("c"),
+        F.sum(col("reading")).alias("s"),
+        F.avg(col("reading")).alias("av"),
+    ]
+
+    def jd_ctx(**over):
+        # both sides arrive in band-value order, so zero slack is exact
+        return _engine_ctx(
+            batch_rows, join_retention_ms=3_000, join_band_slack_ms=0,
+            **over,
+        )
+
+    def joined_base(ctx, facts, band_hi=band_ms - 1):
+        fact = ctx.from_source(
+            _mem_source_named(facts, "occurred_at_ms"), name="jd_fact"
+        )
+        dim = ctx.from_source(
+            _mem_source_named(dim_batches, "dim_at_ms"), name="jd_dim"
+        )
+        return fact.join(
+            dim, "inner", ["sensor_name"], ["dim_sensor"],
+            band=("occurred_at_ms", "dim_at_ms", 0, band_hi),
+        )
+
+    def shared_queries(ctx, sinks, facts):
+        # ONE joined DataStream: all members share the join subtrees,
+        # so detect_sharing folds them into a single join group
+        base = joined_base(ctx, facts)
+        out = []
+        for i in range(n_queries):
+            L, S = spec_cycle[i % len(spec_cycle)]
+            flt = col("reading") > thresholds[i % len(thresholds)]
+            out.append((base.filter(flt).window(
+                ["sensor_name"], aggs, L, S
+            ), sinks[i]))
+        return out
+
+    def counting_sink(counter):
+        def sink(b):
+            counter[0] += b.num_rows
+
+        return sink
+
+    # warmup: compile every distinct window spec behind the join once,
+    # plus the shared fan-out programs, so the timed cells measure
+    # steady state
+    warm = fact_batches[: max(2, len(fact_batches) // 16)]
+    for L, S in spec_cycle:
+        joined_base(jd_ctx(), warm).filter(
+            col("reading") > 30.0
+        ).window(["sensor_name"], aggs, L, S)._execute(
+            CallbackSink(lambda _b: None)
+        )
+    ctx_w = jd_ctx()
+    base_w = joined_base(ctx_w, warm)
+    rep_w = run_queries(
+        ctx_w,
+        [
+            (base_w.filter(col("reading") > thresholds[i % 8]).window(
+                ["sensor_name"], aggs, *spec_cycle[i % 8]
+            ), lambda _b: None)
+            for i in range(min(n_queries, 8))
+        ],
+    )
+    assert rep_w["shared_queries"] == min(n_queries, 8), rep_w
+
+    # -- shared vs independent cell --------------------------------------
+    ctx = jd_ctx()
+    counters = [[0] for _ in range(n_queries)]
+    t0_w = time.perf_counter()
+    rep = run_queries(ctx, shared_queries(
+        ctx, [counting_sink(c) for c in counters], fact_batches
+    ))
+    shared_s = time.perf_counter() - t0_w
+    assert rep["shared_queries"] == n_queries, rep
+    assert sum(1 for g in rep["groups"] if g["shared"]) == 1, rep
+    assert all(c[0] > 0 for c in counters)
+
+    t0_w = time.perf_counter()
+    for i in range(n_queries):
+        L, S = spec_cycle[i % len(spec_cycle)]
+        joined_base(jd_ctx(), fact_batches).filter(
+            col("reading") > thresholds[i % len(thresholds)]
+        ).window(["sensor_name"], aggs, L, S)._execute(
+            CallbackSink(counting_sink([0]))
+        )
+    independent_s = time.perf_counter() - t0_w
+    speedup = independent_s / shared_s
+    log(
+        f"join_dense shared q={n_queries}: shared {shared_s:.2f}s vs "
+        f"independent {independent_s:.2f}s → {speedup:.2f}x"
+    )
+
+    # -- no-sharing control ----------------------------------------------
+    # every query gets its OWN band width, so every join signature is
+    # unique and nothing may group; a quarter feed keeps the cell short
+    # (both sides run the identical 25 unshared pipelines, so the
+    # ratio is feed-size independent)
+    ctrl_facts = fact_batches[: max(2, len(fact_batches) // 4)]
+
+    def control_queries(ctx_c, sinks):
+        out = []
+        for i in range(n_queries):
+            L, S = spec_cycle[i % len(spec_cycle)]
+            base = joined_base(ctx_c, ctrl_facts, band_hi=band_ms - 1 - i)
+            out.append((base.filter(
+                col("reading") > thresholds[i % len(thresholds)]
+            ).window(["sensor_name"], aggs, L, S), sinks[i]))
+        return out
+
+    def run_control(sharing: bool) -> float:
+        ctx_c = jd_ctx()
+        t0_c = time.perf_counter()
+        rep_c = run_queries(
+            ctx_c, control_queries(ctx_c, [lambda _b: None] * n_queries),
+            sharing=sharing,
+        )
+        wall = time.perf_counter() - t0_c
+        # distinct join signatures: nothing may share either way
+        assert rep_c["shared_queries"] == 0, rep_c
+        return wall
+
+    run_control(True)  # warm both planner paths once
+    run_control(False)
+    control_on_s = min(run_control(True) for _ in range(3))
+    control_off_s = min(run_control(False) for _ in range(3))
+    control_ratio = control_off_s / control_on_s
+    log(
+        f"join_dense control: sharing-on {control_on_s:.2f}s vs "
+        f"off {control_off_s:.2f}s → {control_ratio:.3f}x"
+    )
+
+    # -- spot byte-identity: shared members vs independent pipelines ----
+    def rows_of(b, acc):
+        ks = b.column("sensor_name")
+        ws = b.column("window_start_time")
+        cs, ss, avs = b.column("c"), b.column("s"), b.column("av")
+        for i in range(b.num_rows):
+            acc[(ks[i], int(ws[i]))] = (
+                float(cs[i]), float(ss[i]), float(avs[i])
+            )
+
+    ctx = jd_ctx()
+    outs = [dict() for _ in range(8)]
+    sinks = [(lambda acc: (lambda b: rows_of(b, acc)))(o) for o in outs]
+    saved, n_queries_full = n_queries, n_queries
+    n_queries = 8
+    rep8 = run_queries(ctx, shared_queries(ctx, sinks, fact_batches))
+    n_queries = saved
+    assert rep8["shared_queries"] == 8, rep8
+    unit = next(g["unit_ms"] for g in rep8["groups"] if g["shared"])
+    identical = True
+    for i in (0, 3, 6):  # base member + two residual classes
+        L, S = spec_cycle[i % len(spec_cycle)]
+        ind: dict = {}
+        # pin the oracle to the slice engine: count/sum are exact on
+        # the integer feed either way, but the default operator
+        # finalizes avg in f32 while the shared path divides in f64
+        joined_base(
+            jd_ctx(slice_windows=True, slice_unit_ms=unit), fact_batches
+        ).filter(
+            col("reading") > thresholds[i % len(thresholds)]
+        ).window(["sensor_name"], aggs, L, S)._execute(
+            CallbackSink((lambda acc: (lambda b: rows_of(b, acc)))(ind))
+        )
+        if outs[i] != ind:
+            identical = False
+            log(f"join_dense: query {i} emissions DIVERGED")
+    log(f"join_dense: member byte-identity: {identical}")
+
+    # -- kill/restore + mid-stream register/deregister evidence ---------
+    # (BENCH_JD_SOAK=0 skips for reduced-row quick cells; the committed
+    # artifact always carries it)
+    soak: dict = {"skipped": True}
+    soak_pass = None
+    if os.environ.get("BENCH_JD_SOAK", "1") != "0":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with tempfile.TemporaryDirectory(prefix="bench_jd_") as td:
+            out_p = os.path.join(td, "soak.json")
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "tools", "soak.py"),
+                    "--pipeline", "join_dense",
+                    "--minutes", "0.35", "--kill-every", "8",
+                    "--pace", "40000", "--batch-rows", "2048",
+                    "--out", out_p,
+                ],
+                capture_output=True, text=True, timeout=240,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            r = json.load(open(out_p)) if os.path.exists(out_p) else {}
+        jd = r.get("join_dense") or {}
+        soak_pass = bool(
+            proc.returncode == 0
+            and r.get("aborted") is None
+            and r.get("kills", 0) >= 1
+            and jd.get("oracle_rc") == 0
+            and jd.get("oracle_windows", 0) > 0
+            and jd.get("failures") == 0
+            and jd.get("queries_silent") == []
+            and jd.get("backfill_missing") == []
+            and jd.get("joined_live", 0) >= 1
+            and jd.get("departed", 0) >= 1
+            and jd.get("max_builds_per_segment") == 1
+        )
+        soak = {
+            "kills": r.get("kills"),
+            "oracle_windows": jd.get("oracle_windows"),
+            "failures": jd.get("failures"),
+            "joined_live": jd.get("joined_live"),
+            "departed": jd.get("departed"),
+            "backfilled_joiners": jd.get("backfilled_joiners"),
+            "max_builds_per_segment": jd.get("max_builds_per_segment"),
+            "pass": soak_pass,
+        }
+        log(f"join_dense soak: {soak}")
+
+    gate_pass = (
+        speedup >= 5.0 and control_ratio >= 0.95 and identical
+        and soak_pass is not False
+    )
+    return {
+        "metric": (
+            f"join_dense_{n_queries_full}q_shared_join_aggregate_rows_per_s"
+        ),
+        "value": round(n_queries_full * feed_rows / shared_s),
+        "unit": "rows/s",
+        "vs_baseline": round(speedup, 3),
+        "device": "host",
+        "feed_rows": feed_rows,
+        "dim_rows": dim_rows,
+        "num_keys": n_keys,
+        "queries": n_queries_full,
+        "filter_classes": len(set(thresholds)),
+        "band_ms": band_ms,
+        "shared_s": round(shared_s, 3),
+        "independent_s": round(independent_s, 3),
+        "independent_agg_rows_per_s": round(
+            n_queries_full * feed_rows / independent_s
+        ),
+        "control_no_sharing": {
+            "sharing_on_s": round(control_on_s, 3),
+            "sharing_off_s": round(control_off_s, 3),
+            "ratio": round(control_ratio, 3),
+            "bar": 0.95,
+        },
+        "member_byte_identity": identical,
+        "soak": soak,
+        "scaling_gate": {
+            "bar": 5.0,
+            "measured": round(speedup, 3),
+            "pass": gate_pass,
+        },
+        "host_cores": os.cpu_count(),
+    }
+
+
 def run_obs_overhead(config, batches, batches2=None) -> dict:
     """Overhead guard for default-level metrics (docs/observability.md):
     the same throughput pipeline with the obs registry enabled vs
@@ -3692,6 +4036,17 @@ def run_config(device: str) -> dict:
             f"pass={out['scaling_gate']['pass']}"
         )
         return out
+    if config == "join_dense":
+        out = run_join_dense()
+        log(
+            f"engine[join_dense]: {out['value']:,} rows/s aggregate at "
+            f"{out['queries']} shared-join queries, "
+            f"{out['vs_baseline']}x independent; control ratio "
+            f"{out['control_no_sharing']['ratio']}; soak "
+            f"pass={out['soak'].get('pass')}; gate "
+            f"pass={out['scaling_gate']['pass']}"
+        )
+        return out
     if config == "exchange_codec":
         out = run_exchange_codec()
         log(f"engine[exchange_codec]: raw lane {out['value']:,} rows/s, "
@@ -3914,12 +4269,12 @@ def main():
         "simple", "sliding", "highcard", "join", "checkpoint", "kafka_e2e",
         "ingest_scale", "decode_scale", "session", "session_scale",
         "spill_scale", "cluster_scale", "exchange_codec", "multi_query",
-        "join_skew", "query_dense",
+        "join_skew", "query_dense", "join_dense",
     ):
         raise SystemExit(f"unknown BENCH_CONFIG {CONFIG!r}")
     if CONFIG in ("decode_scale", "session", "session_scale",
                   "spill_scale", "cluster_scale", "exchange_codec",
-                  "multi_query", "join_skew", "query_dense"):
+                  "multi_query", "join_skew", "query_dense", "join_dense"):
         # pure host-side benchmarks (decoder / session operator): no
         # device, no TPU relay wait
         device = "host"

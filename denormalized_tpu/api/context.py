@@ -53,6 +53,16 @@ class EngineConfig:
     # streaming joins: rows older than the join watermark by more than this
     # are evicted (and emitted unmatched for outer joins)
     join_retention_ms: int = 300_000
+    # band-aware eviction for interval joins (docs/joins.md): when set,
+    # a retained row is also evictable once its band value falls more
+    # than this slack below the horizon the other side's band watermark
+    # implies — rows a band strictly tighter than retention can never
+    # match stop occupying state.  The slack absorbs band-space
+    # lateness: 0 is exact for per-side in-order band values, and with
+    # event-time-like band expressions set it to your allowed lateness.
+    # None (default) disables band-aware eviction (retention-only, the
+    # pre-existing semantics: matches exist while co-retained).
+    join_band_slack_ms: int | None = None
     # closed-loop skew adaptation (obs/doctor/actions.py): when a key's
     # sketched share crosses the skewed-join-side verdict thresholds, the
     # policy migrates it into a dense hot sub-partition (and folds it

@@ -295,6 +295,24 @@ INSTRUMENTS: dict[str, tuple] = {
         "accumulation; observed only when a residual class exists",
         MS_BUCKETS,
     ),
+    # -- query-dense joins: shared StreamingJoinExec (ISSUE 17) ---------
+    "dnz_mq_join_stage_ms": (
+        "histogram",
+        "per-batch time one SHARED join spent in each stage, labeled "
+        "stage=build|probe|gather (build = intern+insert, probe = "
+        "equi/band index probe, gather = pair materialization+filter) "
+        "— observed only when the join feeds a shared slice pipeline "
+        "(enable_shared_attribution); feeds the doctor's measured-cost "
+        "attribution across subscriber queries",
+        MS_BUCKETS,
+    ),
+    "dnz_mq_join_fanout_rows_total": (
+        "counter",
+        "joined rows fanned out from one shared StreamingJoinExec into "
+        "its group's slice pipeline — rows every subscriber's residual "
+        "class then re-filters, vs dnz_op_rows_out_total{op=join} which "
+        "also counts unshared joins",
+    ),
     # -- sink (sources/kafka.py KafkaSinkWriter) ------------------------
     "dnz_sink_retries_total": (
         "counter",

@@ -73,6 +73,24 @@ from denormalized_tpu.physical.base import (
 )
 
 
+def band_evict_mask(
+    batch_max_ts: np.ndarray,
+    horizon: int,
+    batch_band_max: np.ndarray | None,
+    band_horizon: float | None,
+) -> np.ndarray:
+    """Whole-batch eviction verdicts from the cached per-batch maxima:
+    a batch drops when every retained row is older than the time
+    horizon OR — for interval joins — its band maximum sits so far
+    behind the other side's band watermark that no future row can land
+    in band.  ONE vectorized compare per eviction tick over the cached
+    maxima; retained row data is never rescanned here."""
+    drop = batch_max_ts < horizon
+    if band_horizon is not None and batch_band_max is not None:
+        drop = drop | (batch_band_max < band_horizon)
+    return drop
+
+
 class _HotStore:
     """Dense hot-key sub-partitions for one join side.
 
@@ -316,6 +334,8 @@ class _SideState:
     __slots__ = (
         "batches",
         "batch_max_ts",
+        "batch_band_max",
+        "band_wm",
         "head",
         "link",
         "row_bi",
@@ -333,6 +353,15 @@ class _SideState:
     def __init__(self, with_band: bool = False) -> None:
         self.batches: list[RecordBatch] = []  # retained row storage
         self.batch_max_ts: list[int] = []  # cached per-batch max event time
+        # band-aware eviction bookkeeping (interval joins): per-batch max
+        # FINITE band value (NaN matches nothing, so an all-NaN batch is
+        # -inf = immediately band-dead), and this side's band watermark —
+        # the max over batches of min finite band value, the band-space
+        # analog of the event-time watermark.  The OTHER side's rows
+        # whose band reach lies below band_wm - slack can never match a
+        # future row of this side (docs/joins.md, band-aware eviction).
+        self.batch_band_max: list[float] = []
+        self.band_wm: float | None = None
         self.head = np.full(1024, -1, dtype=np.int64)  # gid -> newest row
         self.link = np.empty(1024, dtype=np.int64)  # row -> older same-key row
         self.row_bi = np.empty(1024, dtype=np.int32)
@@ -432,6 +461,15 @@ class _SideState:
         self.matched[base : base + n] = False
         if self.row_band is not None:
             self.row_band[base : base + n] = band_vals
+            fin = band_vals[~np.isnan(band_vals)]
+            if len(fin):
+                self.batch_band_max.append(float(fin.max()))
+                bmin = float(fin.min())
+                self.band_wm = (
+                    bmin if self.band_wm is None else max(self.band_wm, bmin)
+                )
+            else:
+                self.batch_band_max.append(float("-inf"))
         self.count += n
         rows = np.arange(base, base + n, dtype=np.int64)
         if self.hot.nslots:
@@ -485,6 +523,23 @@ class _SideState:
         self.matched[:m] = matched
         if self.row_band is not None:
             self.row_band[:m] = band
+            # recompute per-batch band maxima from the retained rows:
+            # eviction is whole-batch, so every retained batch keeps all
+            # its rows and the recomputed maxima equal the originals.
+            # band_wm is a monotone high-water mark over ALL batches
+            # ever inserted and survives the rebuild untouched.
+            self.batch_band_max = []
+            if m and band is not None:
+                bounds = np.nonzero(
+                    np.concatenate(([True], bis[1:] != bis[:-1]))
+                )[0]
+                vals = np.asarray(band[:m], dtype=np.float64)
+                vals = np.where(np.isnan(vals), float("-inf"), vals)
+                self.batch_band_max = [
+                    float(x) for x in np.maximum.reduceat(vals, bounds)
+                ]
+        else:
+            self.batch_band_max = []
         self.count = m
         self._chain(gids, np.arange(m, dtype=np.int64))
 
@@ -962,6 +1017,7 @@ class StreamingJoinExec(ExecOperator):
         *,
         retention_ms: int = 300_000,
         band=None,
+        band_slack_ms: int | None = None,
         adaptive: bool = True,
         adapt_interval_s: float = 1.0,
     ) -> None:
@@ -978,6 +1034,13 @@ class StreamingJoinExec(ExecOperator):
         # band (interval) predicate: left_expr - right_expr must land in
         # [lower_ms, upper_ms] for a pair to join (logical.plan.JoinBand)
         self.band = band
+        # band-aware eviction slack (docs/joins.md): a retained row is
+        # band-dead once its band reach lies more than slack below the
+        # OTHER side's band watermark — slack absorbs band-space
+        # lateness the same way allowed-lateness absorbs event-time
+        # lateness.  None (the default) disables band-aware eviction
+        # entirely (retention-only, the pre-band behavior).
+        self._band_slack_ms = band_slack_ms
         if band is not None:
             if band.lower_ms is None and band.upper_ms is None:
                 raise PlanError(
@@ -1063,6 +1126,19 @@ class StreamingJoinExec(ExecOperator):
                 )
                 self._sw_sample = 4
         self._obs_rows_out = obs.counter("dnz_op_rows_out_total", op="join")
+        # shared-group cost attribution (runtime/multi_query.py): when a
+        # join feeds a shared slice pipeline, the doctor apportions the
+        # join's MEASURED build/probe/gather time across subscribers by
+        # kept-rows share instead of 1/N.  Off by default — the timers
+        # cost two perf_counter calls per batch, so the single-query
+        # path never pays them.
+        self._shared_attr = False
+        self._stage_ms = {"build": 0.0, "probe": 0.0, "gather": 0.0}
+        self._obs_mq_stage = {
+            s: obs.histogram("dnz_mq_join_stage_ms", stage=s)
+            for s in ("build", "probe", "gather")
+        }
+        self._obs_mq_fanout = obs.counter("dnz_mq_join_fanout_rows_total")
         # adaptation counters pre-bound per (action, side) so the policy
         # event path allocates nothing (obs handle convention)
         self._obs_adapt = {
@@ -1113,7 +1189,22 @@ class StreamingJoinExec(ExecOperator):
             m["hot_keys"] = sum(int(s.hot.nslots) for s in sides)
         if self._policy is not None:
             m["adaptations"] = self._policy.adaptations_total
+        if self._shared_attr:
+            m["shared_cost_ms"] = self.shared_cost_ms()
         return m
+
+    # -- shared-group cost attribution (runtime/multi_query.py) ---------
+    def enable_shared_attribution(self) -> None:
+        """Turn on the build/probe/gather stage timers so a shared
+        pipeline's doctor ledger can apportion the join's measured cost
+        across subscribers (slice_exec.shared_fractions)."""
+        self._shared_attr = True
+
+    def shared_cost_ms(self) -> float:
+        """Total measured join time (build + probe + gather, ms) since
+        start — the upstream cost the shared slice operator folds into
+        its per-subscriber attribution."""
+        return float(sum(self._stage_ms.values()))
 
     def _label(self):
         on = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
@@ -1310,6 +1401,7 @@ class StreamingJoinExec(ExecOperator):
                 np.ones(len(p_idx), dtype=bool), probe_is_left,
                 probe_base, probe_side, build,
             )
+        tg = time.perf_counter() if self._shared_attr else 0.0
         if self._tier is not None:
             # membership pre-probe: any spilled batch a hit landed in
             # reloads before gather (no spilled blocks = attribute check)
@@ -1335,15 +1427,20 @@ class StreamingJoinExec(ExecOperator):
         if self.filter_expr is not None:
             keep = np.asarray(self.filter_expr.eval(out), dtype=bool)
         if self._existence:
-            return self._existence_probe(
+            res = self._existence_probe(
                 probe_batch, p_idx, b_rows, keep, probe_is_left,
                 probe_base, probe_side, build,
             )
+            if self._shared_attr:
+                self._stage_ms["gather"] += (time.perf_counter() - tg) * 1e3
+            return res
         if not keep.all():
             out = out.filter(keep)
         # mark matched pairs that survived the filter (vectorized)
         probe_side.matched[probe_base + p_idx[keep]] = True
         build.matched[b_rows[keep]] = True
+        if self._shared_attr:
+            self._stage_ms["gather"] += (time.perf_counter() - tg) * 1e3
         return out if out.num_rows else None
 
     def _existence_probe(
@@ -1378,16 +1475,31 @@ class StreamingJoinExec(ExecOperator):
         return None
 
     # ------------------------------------------------------------------
-    def _evict(self, side: _SideState, is_left: bool, horizon: int):
-        """Drop batches wholly older than the horizon; emit unmatched rows
-        for outer joins; rebuild the chained arrays over retained rows.
-        Batch ages come from the cached per-batch max timestamps — no
-        rescans of retained data on the hot path."""
-        if not side.batches or min(side.batch_max_ts) >= horizon:
+    def _evict(
+        self,
+        side: _SideState,
+        is_left: bool,
+        horizon: int,
+        band_horizon: float | None = None,
+    ):
+        """Drop batches wholly older than the horizon — or, for interval
+        joins, wholly below the band horizon (every retained row's band
+        value so far behind the other side's band watermark that no
+        future row can land in band) — emit unmatched rows for outer
+        joins; rebuild the chained arrays over retained rows.  Batch
+        ages come from the cached per-batch max timestamps / band
+        maxima — no rescans of retained data on the hot path."""
+        if not side.batches:
             return []
-        drop_set = np.asarray(
-            [mx < horizon for mx in side.batch_max_ts], dtype=bool
+        drop_set = band_evict_mask(
+            np.asarray(side.batch_max_ts, dtype=np.int64),
+            horizon,
+            np.asarray(side.batch_band_max, dtype=np.float64)
+            if band_horizon is not None and side.batch_band_max else None,
+            band_horizon,
         )
+        if not drop_set.any():
+            return []
         drop_bi = np.nonzero(drop_set)[0]
         n = side.count
         row_dropped = drop_set[side.row_bi[:n]]
@@ -1449,8 +1561,26 @@ class StreamingJoinExec(ExecOperator):
         horizon = (
             min(sides[0].watermark, sides[1].watermark) - self.retention_ms
         )
-        for s, l in ((sides[0], True), (sides[1], False)):
-            for ub in self._evict(s, l, horizon):
+        # band-aware horizons (docs/joins.md): a pair joins iff
+        # left_band - right_band ∈ [lower_ms, upper_ms], so a LEFT row
+        # with band value L only ever matches right rows with
+        # R ≥ L - upper … R ≤ L - lower.  Future right rows carry band
+        # values ≥ right.band_wm - slack, so L is dead once
+        # L < right.band_wm + lower_ms - slack (needs lower_ms set —
+        # without it, arbitrarily large future R still lands in band);
+        # symmetrically a RIGHT row R is dead once
+        # R < left.band_wm - upper_ms - slack (needs upper_ms set).
+        band_h: list[float | None] = [None, None]
+        if self.band is not None and self._band_slack_ms is not None:
+            slack = self._band_slack_ms
+            if self.band.lower_ms is not None and sides[1].band_wm is not None:
+                band_h[0] = sides[1].band_wm + self.band.lower_ms - slack
+            if self.band.upper_ms is not None and sides[0].band_wm is not None:
+                band_h[1] = sides[0].band_wm - self.band.upper_ms - slack
+        for (s, l), bh in zip(
+            ((sides[0], True), (sides[1], False)), band_h
+        ):
+            for ub in self._evict(s, l, horizon, band_horizon=bh):
                 padded = self._null_padded(ub, l)
                 self._metrics["rows_out"] += padded.num_rows
                 yield padded
@@ -1579,6 +1709,11 @@ class StreamingJoinExec(ExecOperator):
                 "strings": {},
                 "masked": [],
             }
+            if side.band_wm is not None:
+                # band watermark rides the snapshot so band-aware
+                # eviction resumes exactly; batch band maxima rebuild
+                # from the persisted per-row band values
+                side_meta["band_wm"] = side.band_wm
             if rows is not None:
                 # insert order == row-array order (v2: resident rows only)
                 assert spilled or rows.num_rows == n
@@ -1697,6 +1832,9 @@ class StreamingJoinExec(ExecOperator):
         ):
             side_meta = meta["sides"][sid]
             side.watermark = side_meta["watermark"]
+            # legacy snapshot → band_wm stays None: band-aware eviction
+            # holds off until new batches re-establish the watermark
+            side.band_wm = side_meta.get("band_wm")
             n = int(side_meta["count"])
             if n == 0:
                 continue
@@ -1769,6 +1907,7 @@ class StreamingJoinExec(ExecOperator):
         ):
             side_meta = meta["sides"][sid]
             side.watermark = side_meta["watermark"]
+            side.band_wm = side_meta.get("band_wm")
             n = int(side_meta["count"])
             if n == 0:
                 continue
@@ -2025,13 +2164,33 @@ class StreamingJoinExec(ExecOperator):
                 # (no self-match risk) and the matched[] marks it writes for
                 # this batch's rows must not be cleared by a later insert
                 probe_base = side.count
+                attr = self._shared_attr
                 side.insert(batch, gids, band_vals)
+                if attr:
+                    tb = (time.perf_counter() - t0_batch) * 1e3
+                    self._stage_ms["build"] += tb
+                    self._obs_mq_stage["build"].observe(tb)
                 if self._tier is not None:
                     self._tier.note_insert(side_id, batch)
+                if attr:
+                    g0 = self._stage_ms["gather"]
+                    tp0 = time.perf_counter()
                 out = self._probe(
                     batch, gids, other, is_left, probe_base, side,
                     band_vals,
                 )
+                if attr:
+                    # _probe accumulated its gather sub-phase itself;
+                    # the remainder of the call is probe-index time
+                    gather_d = self._stage_ms["gather"] - g0
+                    tp = max(
+                        (time.perf_counter() - tp0) * 1e3 - gather_d, 0.0
+                    )
+                    self._stage_ms["probe"] += tp
+                    self._obs_mq_stage["probe"].observe(tp)
+                    self._obs_mq_stage["gather"].observe(gather_d)
+                    if out is not None:
+                        self._obs_mq_fanout.add(out.num_rows)
                 self._note_batch(t0_batch, batch.num_rows)
                 if out is not None:
                     if not wm_announced:

@@ -152,6 +152,7 @@ class _FilterClass:
 
     __slots__ = (
         "sig", "pred", "gid_lane", "gid_pass", "store", "exact_from_unit",
+        "rows_kept",
     )
 
     def __init__(self, sig, pred, gid_lane, store) -> None:
@@ -160,6 +161,11 @@ class _FilterClass:
         self.gid_lane = gid_lane
         self.gid_pass = np.zeros(0, dtype=bool)
         self.store = store
+        # rows this class accumulated (post re-filter): the demand side
+        # of upstream-cost attribution — a member whose residual keeps
+        # 90% of a shared join's output is charged 90% of the join's
+        # probe/build/gather time, not 1/N (shared_fractions)
+        self.rows_kept = 0
         # first slice unit this class's partials are complete from: None
         # for classes present since the start of the stream, else the
         # unit after the max event time ingested when a mid-stream
@@ -248,6 +254,20 @@ class SliceWindowExec(ExecOperator):
         self._orphans: dict[int, dict] = {}
         self._orphan_class_arrays: dict[str, tuple] = {}
         self._departed: set[int] = set()
+        # base re-derivation (weakest-member departure): a predicate
+        # every survivor's own filter implies, applied to arriving rows
+        # BEFORE intern/value-eval/sort — the upstream plan still runs
+        # the original (wider) base filter, but rows no survivor can
+        # reach stop paying the ingest path (set_ingest_pred)
+        self._ingest_pred: Expr | None = None
+        # measured upstream shared cost (ms) — a shared join's
+        # probe/build/gather ledger, apportioned across subscribers by
+        # their classes' kept-rows demand in shared_fractions()
+        self._upstream_cost_fn = None
+        # fired after a detach completes (tag already removed, unowned
+        # classes dropped, slices pruned) — the multi-query runtime
+        # re-derives the ingest base from survivors here
+        self.on_detach = None
         # single-subscriber mode exposes that subscriber's schema (the
         # planner drop-in contract); tagged mode has no single schema —
         # downstream is the multi-query drive loop, not an operator
@@ -261,6 +281,7 @@ class SliceWindowExec(ExecOperator):
         self._max_ts: int | None = None
         self._metrics = {
             "rows_in": 0,
+            "rows_ingested": 0,
             "batches_in": 0,
             "late_rows": 0,
             "windows_emitted": 0,
@@ -433,16 +454,45 @@ class SliceWindowExec(ExecOperator):
         cls.gid_pass = np.concatenate((cls.gid_pass, passed))
 
     def shared_fractions(self) -> dict[int, float]:
-        """Measured per-subscriber share of this operator's work, keyed
+        """Measured per-subscriber share of this pipeline's work, keyed
         by subscriber tag — the doctor's actual-fraction attribution
         for shared pipelines (re-filter + per-class accumulate + fold
-        cost differs across subscribers, so 1/N would lie)."""
+        cost differs across subscribers, so 1/N would lie).
+
+        When the shared input is itself a measured operator (a shared
+        ``StreamingJoinExec`` reporting probe/build/gather time via
+        ``_upstream_cost_fn``), that upstream cost is apportioned by
+        each subscriber's share of kept rows: a member whose residual
+        keeps 90% of the join output caused ~90% of the join's gather
+        fan-out, and is attributed accordingly."""
         total = sum(self._sub_cost_ms)
         n = max(len(self._subs), 1)
-        if total <= 0.0:
+        up = 0.0
+        if self._upstream_cost_fn is not None:
+            try:
+                up = float(self._upstream_cost_fn())
+            except Exception:  # dnzlint: allow(broad-except) doctor attribution is best-effort: a torn upstream metrics read mid-teardown degrades to measured-only shares, it never fails the pipeline
+                up = 0.0
+        if total <= 0.0 and up <= 0.0:
             return {sub.tag: 1.0 / n for sub in self._subs}
+        kept = [0.0] * len(self._subs)
+        if up > 0.0:
+            for cls in self._classes:
+                owners = [
+                    q for q, c in enumerate(self._sub_class) if c is cls
+                ]
+                if owners and cls.rows_kept:
+                    share = cls.rows_kept / len(owners)
+                    for q in owners:
+                        kept[q] = share
+            ktot = sum(kept)
+            if ktot > 0.0:
+                kept = [k / ktot for k in kept]
+            else:
+                kept = [1.0 / n] * len(self._subs)
+        denom = total + up
         return {
-            sub.tag: self._sub_cost_ms[q] / total
+            sub.tag: (self._sub_cost_ms[q] + up * kept[q]) / denom
             for q, sub in enumerate(self._subs)
         }
 
@@ -631,6 +681,20 @@ class SliceWindowExec(ExecOperator):
             )
         self._obs_mq_live.set(len(self._subs))
         self._obs_slice_subs.set(len(self._subs))
+        if self.on_detach is not None:
+            self.on_detach(tag)
+
+    def set_ingest_pred(self, pred: Expr | None) -> None:
+        """Narrow (or clear) the ingest predicate applied to arriving
+        rows before intern/value-eval/sort.  The caller (the
+        multi-query runtime's base re-derivation) guarantees every
+        surviving subscriber's full predicate implies ``pred``, so
+        dropped rows are rows NO survivor's class would keep — partials
+        stay byte-identical while rows only the departed base member
+        could reach stop paying the ingest path.  Takes effect at the
+        next batch; the re-derivation fires at a batch boundary (the
+        detach drain), so no in-flight batch is split."""
+        self._ingest_pred = pred
 
     # ------------------------------------------------------------------
     @property
@@ -791,13 +855,46 @@ class SliceWindowExec(ExecOperator):
                 # per-partition watermarks: a slower partition's earlier
                 # windows stay legitimate until the min-driven watermark
                 # closes them — rebase the cursor down to the watermark
-                # floor (never below it: those windows genuinely emitted)
+                # floor (never below it: those windows genuinely emitted),
+                # and never below the subscriber's exactness floor: a
+                # mid-stream joiner's windows before first_exact can
+                # never fold completely (its class has no partials
+                # there), and out-of-order upstream output — a shared
+                # join's probe emissions carry retained rows older than
+                # the frontier — would otherwise drag the cursor into
+                # that inexact range and emit truncated windows
                 anchor = self._anchor(q, ts_min)
                 if anchor < self._next_win[q]:
                     f = self._wm_floor(q)
                     new = anchor if f is None else max(anchor, f)
+                    fe = self._first_exact[q]
+                    if fe is not None:
+                        new = max(new, fe)
                     if new < self._next_win[q]:
                         self._next_win[q] = new
+        if self._ingest_pred is not None:
+            # re-derived (narrowed) base after the weakest member left:
+            # rows failing every survivor's predicate skip the ingest
+            # path entirely.  Watermark/cursor bookkeeping above already
+            # used the FULL batch's ts_min/ts_max, so trigger timing is
+            # unchanged — only the accumulated row set narrows, and
+            # those rows belonged to no survivor's class.
+            keep_in = np.asarray(self._ingest_pred.eval(batch), dtype=bool)
+            if not keep_in.all():
+                if not keep_in.any():
+                    if not self._src_watermarks:
+                        if (
+                            self._watermark_ms is None
+                            or ts_min > self._watermark_ms
+                        ):
+                            self._watermark_ms = ts_min
+                    yield from self._trigger()
+                    return
+                batch = batch.take(np.nonzero(keep_in)[0])
+                ts = ts[keep_in]
+                units = units[keep_in]
+                n = batch.num_rows
+        self._metrics["rows_ingested"] += n
         # group ids for every row (keys intern regardless of lateness,
         # matching StreamingWindowExec)
         if self._grouped:
@@ -886,6 +983,7 @@ class SliceWindowExec(ExecOperator):
                     rows = len(o_sub)
                 if ci == 0:
                     self._obs_slice_rows.add(rows)
+                cls.rows_kept += rows
                 cls_ms = (time.perf_counter() - t_cls0) * 1e3
                 owners = [
                     q for q, c in enumerate(self._sub_class) if c is cls

@@ -208,6 +208,7 @@ class Planner:
                 jkw["adapt_interval_s"] = (
                     self.config.join_adapt_interval_s
                 )
+                jkw["band_slack_ms"] = self.config.join_band_slack_ms
             return StreamingJoinExec(
                 left,
                 right,

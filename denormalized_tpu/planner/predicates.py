@@ -252,6 +252,21 @@ def implies(p: Constraints, q: Constraints) -> bool:
     return True
 
 
+def weakest(cands: list[Constraints]) -> int | None:
+    """Index of the member every OTHER member provably implies — the
+    subsumption-lattice bottom of the given set — or None when no
+    single member is weakest (incomparable survivors).  Used to
+    re-derive the shared ingest predicate after the base member of a
+    live group deregisters (runtime/multi_query.py): the survivors'
+    weakest predicate becomes the new ingest filter, and rows only the
+    departed base could reach stop being ingested.  First match wins
+    for determinism when several members tie."""
+    for i, c in enumerate(cands):
+        if all(implies(o, c) for j, o in enumerate(cands) if j != i):
+            return i
+    return None
+
+
 def predicate_signature(preds: list[Expr]) -> str:
     """Stable textual identity of a full (conjunctive) predicate list —
     the per-subscriber filter signature checkpoints carry."""

@@ -8,7 +8,10 @@ a set of queries shares one ingest + slice store iff
    source object, same projections (structural signature, source
    compared by identity: two scans of one registered Source are one
    feed, two different Source objects are two feeds even if their
-   contents agree) — and their filter predicates either match exactly
+   contents agree), and for stream-stream joins the same join
+   signature (kind, equi keys, band predicate, join filter, both side
+   subtrees — ONE ``StreamingJoinExec`` then feeds the whole group) —
+   and their filter predicates either match exactly
    or nest under predicate subsumption: a query whose filter provably
    IMPLIES another member's filter (planner/predicates.py) joins that
    member's group, which then ingests+interns once under the WEAKEST
@@ -54,11 +57,14 @@ _OPAQUE = itertools.count()
 
 def input_signature(node: lp.LogicalPlan) -> str:
     """Structural signature of a window's upstream subtree.  Scans key
-    on SOURCE IDENTITY; filters/projections on expression reprs; any
-    other shape (joins, nested windows) is opaque — NEVER shared, so
-    the opaque token is unique per call (two windows over the same
-    join node must not silently share an unreviewed pipeline; sharing
-    joins' windowed inputs is ROADMAP item-2 residue)."""
+    on SOURCE IDENTITY; filters/projections on expression reprs; joins
+    key on (kind, equi-key pairs, band, join filter) plus BOTH side
+    signatures recursively — two windows over structurally identical
+    joins of the same sources run ONE ``StreamingJoinExec`` whose
+    output fans into the shared slice store.  Any other shape (nested
+    windows, UDFs) is opaque — NEVER shared, so the opaque token is
+    unique per call (two windows over the same unreviewed subtree must
+    not silently share a pipeline)."""
     if isinstance(node, lp.Scan):
         return f"scan#{id(node.source)}"
     if isinstance(node, lp.Filter):
@@ -66,6 +72,23 @@ def input_signature(node: lp.LogicalPlan) -> str:
     if isinstance(node, lp.Project):
         exprs = ",".join(repr(e) for e in node.exprs)
         return f"project[{exprs}]({input_signature(node.input)})"
+    if isinstance(node, lp.Join):
+        keys = ",".join(
+            f"{l}={r}" for l, r in zip(node.left_keys, node.right_keys)
+        )
+        parts = [node.kind.value, keys]
+        if node.band is not None:
+            b = node.band
+            parts.append(
+                f"band[{b.left_expr!r};{b.right_expr!r};"
+                f"{b.lower_ms};{b.upper_ms}]"
+            )
+        if node.filter is not None:
+            parts.append(f"filter[{node.filter!r}]")
+        return (
+            f"join[{';'.join(parts)}]"
+            f"({input_signature(node.left)})({input_signature(node.right)})"
+        )
     return f"opaque#{next(_OPAQUE)}"
 
 

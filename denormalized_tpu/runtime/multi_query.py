@@ -45,6 +45,22 @@ from denormalized_tpu.planner.sharing import (
 )
 
 
+def _find_shared_join(op):
+    """First StreamingJoinExec under the shared root's child subtree
+    (None when the group windows a join-free input) — the operator
+    whose measured build/probe/gather cost the doctor attributes across
+    subscribers instead of 1/N."""
+    from denormalized_tpu.physical.join_exec import StreamingJoinExec
+
+    stack = [op]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, StreamingJoinExec):
+            return cur
+        stack.extend(cur.children)
+    return None
+
+
 def build_shared_root(
     ctx, group: ShareGroup, labels: list[str] | None = None
 ) -> ExecOperator:
@@ -74,7 +90,7 @@ def build_shared_root(
         )
         for k, w in enumerate(group.windows)
     ]
-    return SliceWindowExec(
+    root = SliceWindowExec(
         child,
         group.windows[0].group_exprs,
         subs,
@@ -83,6 +99,14 @@ def build_shared_root(
         unit_ms=getattr(ctx.config, "slice_unit_ms", None),
         sort_lane=getattr(ctx.config, "slice_sort_lane", False),
     )
+    join = _find_shared_join(child)
+    if join is not None:
+        # a shared join feeds this group: turn on its stage timers and
+        # hand the slice operator its measured cost so shared_fractions
+        # apportions join time by kept-rows share, not 1/N
+        join.enable_shared_attribution()
+        root._upstream_cost_fn = join.shared_cost_ms
+    return root
 
 
 def drive_shared(
@@ -176,6 +200,13 @@ class SharedPipeline:
                 break
         self._base_cons = base_entry.cons
         self._lock = threading.Lock()
+        # per-tag planning facts (preds, cons, filter_sig): the base
+        # re-derivation on deregister needs every live member's full
+        # predicate to find the survivors' weakest (ISSUE 17 sat. 1)
+        self._member_facts: dict[int, tuple] = {}
+        for k, i in enumerate(group.members):
+            _k2, e = classify(plans[i])
+            self._member_facts[k] = (e.preds, e.cons, e.filter_sig)
         # tags for initial members are their member index; live joiners
         # continue the sequence (deterministic across a replay)
         self._sinks: dict[int, Callable] = {
@@ -188,6 +219,7 @@ class SharedPipeline:
             self._root: SliceWindowExec = build_shared_root(
                 ctx, group, self._labels
             )
+        self._root.on_detach = self._on_detach
 
     @property
     def root(self) -> SliceWindowExec:
@@ -233,6 +265,9 @@ class SharedPipeline:
             tag = self._next_tag
             self._next_tag += 1
             self._sinks[tag] = sink
+            self._member_facts[tag] = (
+                entry.preds, entry.cons, entry.filter_sig
+            )
         sub = SliceSubscriber(
             w.aggr_exprs,
             length,
@@ -251,6 +286,39 @@ class SharedPipeline:
     def deregister(self, tag: int, *, when_ts: int | None = None) -> None:
         """Queue a live unsubscription (any thread)."""
         self._root.request_detach(tag, when_ts)
+
+    def _on_detach(self, tag: int) -> None:
+        """Operator-thread hook, fired inside the slice boundary that
+        detached ``tag``.  When the departed member held the group's
+        BASE (weakest) predicate, the shared ingest would otherwise
+        keep admitting rows only that member could reach, forever —
+        correct but wasteful.  Re-derive the base from the survivors:
+        their weakest member's predicate (``predicates.weakest``)
+        becomes the new ingest filter — every survivor's full predicate
+        implies it, so the residual re-filters stay exact — and the
+        registration gate tightens to the new base (the live ingest
+        still cannot widen).  Pairwise-incomparable survivors keep the
+        old, wider predicate: no single survivor predicate admits every
+        row the others need.  Replayed detaches of already-departed
+        tags are no-ops."""
+        with self._lock:
+            facts = self._member_facts.pop(tag, None)
+            if facts is None or facts[2] != self._base_sig:
+                return
+            if not self._member_facts:
+                return
+            tags = sorted(self._member_facts)
+            if any(
+                self._member_facts[t][2] == self._base_sig for t in tags
+            ):
+                return  # another live member still holds the base
+            idx = pr.weakest([self._member_facts[t][1] for t in tags])
+            if idx is None:
+                return
+            preds, cons, sig = self._member_facts[tags[idx]]
+            self._base_sig = sig
+            self._base_cons = cons
+            self._root.set_ingest_pred(pr.conjoin(preds))
 
     def run(self) -> None:
         """Drive the shared pipeline to EndOfStream on the calling

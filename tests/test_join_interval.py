@@ -452,6 +452,111 @@ def test_banded_join_kill_restore_byte_identical(tmp_path):
     close_global_state_backend()
 
 
+def _find_join(op):
+    from denormalized_tpu.physical.join_exec import StreamingJoinExec
+
+    stack = [op]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, StreamingJoinExec):
+            return cur
+        stack.extend(cur.children)
+    raise AssertionError("no StreamingJoinExec in plan")
+
+
+def test_band_eviction_bounds_state_matches_oracle(monkeypatch):
+    """Band-aware eviction pin (ISSUE 17 satellite): at band ≪ retention
+    the SAME in-order feed run with ``join_band_slack_ms=0`` vs ``None``
+    (off) produces identical output — equal to the nested-loop oracle —
+    while the band-evicting run retains a small fraction of the state
+    bytes the retention-only run holds at EOS."""
+    _sequential_pump(monkeypatch)
+    band = 300
+
+    def feed(sd, nb=30, n=24):
+        rr = np.random.default_rng(sd)
+        t = T0
+        out = []
+        for _ in range(nb):
+            ts = np.sort(t + rr.integers(0, 500, n))
+            t += 500
+            ks = np.array(
+                [f"k{i}" for i in rr.integers(0, 4, n)], dtype=object
+            )
+            out.append([
+                (int(a), str(k), int(v))
+                for a, k, v in zip(ts, ks, rr.integers(0, 100, n))
+            ])
+        return out
+
+    Lb, Rb = feed(21), feed(22)
+
+    def run(slack):
+        ctx = _ctx(join_band_slack_ms=slack, partition_watermarks=False)
+        left, right = _streams(
+            ctx,
+            [_mk(L_SCHEMA, b) for b in Lb],
+            [_mk(R_SCHEMA, b) for b in Rb],
+        )
+        res = left.join(
+            right, "inner", ["k"], ["k2"], band=("ts", "ts2", -band, band)
+        ).collect()
+        return _got(res), _find_join(ctx._last_physical)
+
+    got_evict, j_evict = run(0)
+    got_off, j_off = run(None)
+    Lr = [x for b in Lb for x in b]
+    Rr = [x for b in Rb for x in b]
+    want = _nested_loop(Lr, Rr, -band, band)
+    assert got_evict == want
+    assert got_off == want
+    # retention is effectively infinite: every evicted row is the band
+    # horizon's doing, and the off run must not evict at all
+    assert j_off._metrics["evicted"] == 0
+    assert j_evict._metrics["evicted"] > 0
+    b_evict = j_evict.state_info()["state_bytes"]
+    b_off = j_off.state_info()["state_bytes"]
+    assert b_off > 0 and b_evict < 0.3 * b_off, (b_evict, b_off)
+
+
+def test_band_eviction_slack_absorbs_late_rows():
+    """Late (bounded out-of-order) band values: with slack ≥ the feed's
+    lateness, band eviction loses no matches under ANY thread
+    interleaving — exact vs the nested-loop oracle — while still
+    evicting (band ≪ retention).  The final sweep runs with both sides'
+    final band watermarks, so the eviction count is deterministic."""
+    band, late = 150, 400
+
+    def feed(sd, nb=30, n=24):
+        rr = np.random.default_rng(sd)
+        out = []
+        for b in range(nb):
+            base = T0 + b * 500
+            ts = base + rr.integers(-late, 500, n)
+            ts[0] = base  # on-time anchor: batch min stays ≤ base
+            ks = np.array(
+                [f"k{i}" for i in rr.integers(0, 4, n)], dtype=object
+            )
+            out.append([
+                (int(a), str(k), int(v))
+                for a, k, v in zip(ts, ks, rr.integers(0, 100, n))
+            ])
+        return out
+
+    Lb, Rb = feed(31), feed(32)
+    ctx = _ctx(join_band_slack_ms=late)
+    left, right = _streams(
+        ctx, [_mk(L_SCHEMA, b) for b in Lb], [_mk(R_SCHEMA, b) for b in Rb]
+    )
+    res = left.join(
+        right, "inner", ["k"], ["k2"], band=("ts", "ts2", -band, band)
+    ).collect()
+    Lr = [x for b in Lb for x in b]
+    Rr = [x for b in Rb for x in b]
+    assert _got(res) == _nested_loop(Lr, Rr, -band, band)
+    assert _find_join(ctx._last_physical)._metrics["evicted"] > 0
+
+
 # -- hypothesis property (clean skip when the dep is absent) --------------
 
 try:

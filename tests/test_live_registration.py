@@ -217,6 +217,65 @@ def test_live_detach_survivor_unaffected():
     assert m["subscribers"] == 1
 
 
+def test_detach_of_base_member_narrows_shared_ingest():
+    """ISSUE 17 satellite: when the weakest-predicate (base) member
+    deregisters, the shared ingest predicate re-derives from the
+    survivors at the next slice boundary — rows only the departed base
+    needed stop being ingested, the base filter class's partials are
+    pruned — and the survivor stays byte-identical to its from-start
+    filtered oracle."""
+    batches = _batches(seed=36)
+
+    def run(deregister_base):
+        got0, got1 = {}, {}
+        ctx = Context(EngineConfig())
+        base = _base(ctx, batches)
+        sp = SharedPipeline(
+            ctx,
+            [
+                (
+                    base.filter(col("v") > 5.0)
+                    .window(["k"], AGGS, 3000, 1000),
+                    _sink(got0),
+                ),
+                (
+                    base.filter(col("v") > 12.0)
+                    .window(["k"], AGGS, 2000, 1000),
+                    _sink(got1),
+                ),
+            ],
+        )
+        if deregister_base:
+            sp.deregister(0, when_ts=T0 + 10_000)
+        sp.run()
+        return got0, got1, sp.root.metrics()
+
+    got0_c, got1_c, m_c = run(False)  # control: base member stays
+    got0, got1, m = run(True)         # base member leaves at +10s
+
+    # the shared subtree's planned FilterExec (v > 5, the base pred)
+    # feeds both runs identically; without narrowing every arriving row
+    # is ingested, with it the post-departure ingest drops v ∈ (5, 12]
+    assert m["rows_in"] == m_c["rows_in"] > 0
+    assert m_c["rows_ingested"] == m_c["rows_in"]
+    assert m["rows_ingested"] < m_c["rows_ingested"]
+    # the base filter class no survivor owns was pruned with its partials
+    assert m_c["filter_classes"] == 2
+    assert m["filter_classes"] == 1
+
+    # survivor: byte-identical to its from-start filtered oracle in both
+    # runs (narrowing never drops a row the survivor's class would keep)
+    oracle1 = _oracle(batches, 2000, 1000, flt=col("v") > 12.0, sort_lane=True)
+    assert got1 == oracle1
+    assert got1_c == oracle1
+    # the departed base emitted only up to the leave point, all exact
+    oracle0 = _oracle(batches, 3000, 1000, flt=col("v") > 5.0, sort_lane=True)
+    assert got0 and set(got0) < set(oracle0)
+    assert all(got0[k] == oracle0[k] for k in got0)
+    assert max(k[2] for k in got0) <= T0 + 10_000 + 3000
+    assert got0_c == oracle0
+
+
 def test_register_rejects_unshareable():
     batches = _batches(seed=34, n_batches=4)
     ctx = Context(EngineConfig())

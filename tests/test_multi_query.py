@@ -236,10 +236,11 @@ def test_udaf_and_session_fall_back():
     assert not by_member[2].shared and "session" in by_member[2].reason
 
 
-def test_windows_over_same_join_never_share():
-    """Opaque input subtrees (joins, nested windows) must NEVER share —
-    even two windows over the SAME join node object get distinct
-    opaque tokens (sharing joins' inputs is explicitly deferred)."""
+def test_windows_over_same_join_share_one_group():
+    """Join-bearing queries are first-class sharing citizens (ISSUE
+    17): two windows over structurally identical joins of the same two
+    sources form ONE share group — one StreamingJoinExec feeds both
+    queries' slice folds."""
     batches = _batches()
     _ctx, base = _ctx_and_base(batches)
     other = _ctx.from_source(
@@ -252,6 +253,29 @@ def test_windows_over_same_join_never_share():
     plans = [
         joined.window(["k"], AGGS[:2], 3000, 1000)._plan,
         joined.window(["k"], AGGS[:2], 5000, 1000)._plan,
+    ]
+    groups = detect_sharing(plans)
+    assert len(groups) == 1 and groups[0].shared
+    assert groups[0].unit_ms == 1000
+
+
+def test_windows_over_different_joins_never_share():
+    """Join sharing keys on the STRUCTURAL join signature: two windows
+    over joins that differ in kind (or keys, or band) must stay apart
+    even when both read the same two sources."""
+    batches = _batches()
+    _ctx, base = _ctx_and_base(batches)
+    other = _ctx.from_source(
+        MemorySource.from_batches(
+            _batches(seed=4), timestamp_column="ts"
+        ),
+        name="feed2",
+    ).with_column_renamed("v", "v2").with_column_renamed("ts", "ts2")
+    inner = base.join(other, "inner", ["k"], ["k"])
+    left = base.join(other, "left", ["k"], ["k"])
+    plans = [
+        inner.window(["k"], AGGS[:2], 3000, 1000)._plan,
+        left.window(["k"], AGGS[:2], 3000, 1000)._plan,
     ]
     groups = detect_sharing(plans)
     assert all(not g.shared for g in groups)

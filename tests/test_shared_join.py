@@ -1,0 +1,596 @@
+"""Query-dense joins: shared stream-join inputs across queries (ISSUE 17).
+
+Differential suite for join-bearing share groups: N concurrent windowed
+queries over the SAME join (both source identities, equi keys, band,
+join type — planner/sharing.py's join signature) run ONE
+StreamingJoinExec whose output fans into the shared slice pipeline, and
+every query's emissions must be byte-identical to an independent
+join+window pipeline of its own (the per-query oracle pins the group's
+slice unit and the residual classes' lexsort fold lane).
+
+Covered here: inner and left-outer groups, equi+band with late rows
+(band-aware eviction live under a shared group), skew adaptation
+ticking INSIDE a shared group, mid-stream register/deregister with
+backfill exactness, and a SIGKILL-equivalent mid-epoch stop + restore
+with orphan cursor adoption (the PR-14 pattern over a join-fed root).
+
+Determinism: the sequential pump drive (all of the left feed, then all
+of the right) makes join emission order — and therefore eviction and
+watermark schedules — reproducible; aggregate value columns are
+integer-valued so window folds are exact regardless of pair order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from denormalized_tpu import Context, col
+from denormalized_tpu.api import functions as F
+from denormalized_tpu.api.context import EngineConfig
+from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field, Schema
+from denormalized_tpu.physical.base import Marker
+from denormalized_tpu.physical.slice_exec import SubscriberBatch
+from denormalized_tpu.runtime.multi_query import (
+    SharedPipeline,
+    _find_shared_join,
+    run_queries,
+)
+from denormalized_tpu.sources.memory import MemorySource
+from denormalized_tpu.state.checkpoint import wire_checkpointing
+from denormalized_tpu.state.lsm import close_global_state_backend
+from denormalized_tpu.state.orchestrator import Orchestrator
+
+T0 = 1_700_000_000_000
+
+L_SCHEMA = Schema([
+    Field("ts", DataType.TIMESTAMP_MS, nullable=False),
+    Field("k", DataType.STRING, nullable=False),
+    Field("v", DataType.FLOAT64),
+])
+R_SCHEMA = Schema([
+    Field("ts2", DataType.TIMESTAMP_MS, nullable=False),
+    Field("k2", DataType.STRING, nullable=False),
+    Field("w", DataType.FLOAT64),
+])
+
+# integer-valued floats: sums/extrema/counts/avg fold EXACTLY in any
+# order, so shared-vs-oracle equality is byte-equality even where join
+# pair emission order differs (e.g. the adaptive-layout comparison)
+AGGS = [
+    F.count(col("v")).alias("c"),
+    F.sum(col("v")).alias("sv"),
+    F.min(col("v")).alias("mn"),
+    F.max(col("v")).alias("mx"),
+    F.avg(col("v")).alias("av"),
+    F.sum(col("w")).alias("sw"),
+]
+AGG_COLS = ("c", "sv", "mn", "mx", "av", "sw")
+
+
+def _feed(seed, nb, n, *, keys=4, epoch_keys=True, key_lo=0, jitter=0):
+    """One side's batches as row tuples.  ``epoch_keys`` scopes each key
+    to its 1s epoch (bounds equi-join pair counts without a band);
+    ``jitter`` > 0 makes rows up to that many ms LATE (out of order),
+    with an on-time anchor so each batch's min never exceeds its base."""
+    rr = np.random.default_rng(seed)
+    out = []
+    for b in range(nb):
+        base = T0 + b * 1000
+        ts = base + rr.integers(-jitter, 1000, n) if jitter else np.sort(
+            base + rr.integers(0, 1000, n)
+        )
+        if jitter:
+            ts[0] = base
+        vs = rr.integers(0, 100, n)
+        rows = []
+        for a, v in zip(ts, vs):
+            i = key_lo + int(rr.integers(0, keys))
+            key = f"k{i}e{int(a) // 1000}" if epoch_keys else f"k{i}"
+            rows.append((int(a), key, float(v)))
+        out.append(rows)
+    return out
+
+
+def _mk(schema, rows):
+    cols = list(zip(*rows)) if rows else [[], [], []]
+    return RecordBatch(schema, [
+        np.asarray(cols[0], dtype=np.int64),
+        np.asarray(cols[1], dtype=object),
+        np.asarray(cols[2], dtype=np.float64),
+    ])
+
+
+def _joined(ctx, Lb, Rb, *, join_type="inner", band=None):
+    left = ctx.from_source(
+        MemorySource.from_batches(
+            [_mk(L_SCHEMA, b) for b in Lb], timestamp_column="ts"
+        ),
+        name="jl",
+    )
+    right = ctx.from_source(
+        MemorySource.from_batches(
+            [_mk(R_SCHEMA, b) for b in Rb], timestamp_column="ts2"
+        ),
+        name="jr",
+    )
+    return left.join(right, join_type, ["k"], ["k2"], band=band)
+
+
+def _cfg(**kw):
+    kw.setdefault("join_retention_ms", 10**9)
+    kw.setdefault("join_adaptive", False)
+    kw.setdefault("partition_watermarks", False)
+    return EngineConfig(**kw)
+
+
+def _rows_of(batch, acc):
+    cols = {c: batch.column(c) for c in AGG_COLS}
+    masks = {c: batch.mask(c) for c in AGG_COLS}
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        acc[key] = tuple(
+            None if masks[c] is not None and not masks[c][i]
+            else float(cols[c][i])
+            for c in AGG_COLS
+        )
+
+
+def _sink(acc):
+    return lambda b: _rows_of(b, acc)
+
+
+def _oracle(Lb, Rb, L, S, *, unit, flt=None, join_type="inner", band=None,
+            **cfg_kw):
+    """Independent from-start join+window pipeline pinned to the shared
+    group's slice unit and the lexsort fold lane (every group here has a
+    residual member, which forces the lane for all classes)."""
+    ctx = Context(_cfg(
+        slice_windows=True, slice_unit_ms=unit, slice_sort_lane=True,
+        **cfg_kw,
+    ))
+    ds = _joined(ctx, Lb, Rb, join_type=join_type, band=band)
+    if flt is not None:
+        ds = ds.filter(flt)
+    out = {}
+    for b in ds.window(["k"], AGGS, L, S).stream():
+        _rows_of(b, out)
+    return out
+
+
+def _sequential_pump(monkeypatch):
+    """Deterministic drive: pump threads enqueue strictly in spawn order
+    (all of the left source, then all of the right), so join emission
+    order, eviction, and downstream watermarks are reproducible."""
+    import threading
+
+    from denormalized_tpu.runtime import pump as pump_mod
+
+    real_put = pump_mod.checked_put
+    threads: list[threading.Thread] = []
+
+    def fake_spawn(q, done, items, sentinel, wrap=lambda x: x):
+        idx = len(threads)
+
+        def run():
+            if idx:
+                threads[idx - 1].join()
+            try:
+                for item in items():
+                    if not real_put(q, done, wrap(item)):
+                        return
+            finally:
+                real_put(q, done, sentinel)
+
+        th = threading.Thread(target=run, daemon=True)
+        threads.append(th)
+        th.start()
+        return th
+
+    monkeypatch.setattr(pump_mod, "spawn_pump", fake_spawn)
+
+
+def _lockstep_pump(monkeypatch):
+    """Deterministic TWO-LIVE-SIDES drive: the two pumps of each join
+    alternate strictly batch-for-batch (left, right, left, …).  The
+    sequential drive can't host an epoch commit — a checkpointing join
+    drops markers once either side hits EndOfStream (no consistent
+    two-input cut exists past that point) and the left side is done
+    before the first joined row.  Lockstep keeps both sides live for the
+    whole feed, so mid-stream barriers align and commit."""
+    import threading
+
+    from denormalized_tpu.runtime import pump as pump_mod
+
+    real_put = pump_mod.checked_put
+    cv = threading.Condition()
+    spawned = [0]
+    turn: dict[int, int] = {}
+    live: dict[int, int] = {}
+
+    def fake_spawn(q, done, items, sentinel, wrap=lambda x: x):
+        with cv:
+            idx = spawned[0]
+            spawned[0] += 1
+            pair, side = idx // 2, idx % 2
+            turn.setdefault(pair, 0)
+            live[pair] = live.get(pair, 0) + 1
+
+        def run():
+            try:
+                for item in items():
+                    with cv:
+                        while live[pair] > 1 and turn[pair] % 2 != side:
+                            cv.wait(0.05)
+                    if not real_put(q, done, wrap(item)):
+                        return
+                    with cv:
+                        turn[pair] = side + 1
+                        cv.notify_all()
+            finally:
+                with cv:
+                    live[pair] -= 1
+                    cv.notify_all()
+                real_put(q, done, sentinel)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        return th
+
+    monkeypatch.setattr(pump_mod, "spawn_pump", fake_spawn)
+
+
+def _first_exact_start(sp, tag):
+    root = sp.root
+    for q, sub in enumerate(root._subs):
+        if sub.tag == tag:
+            fe = root._first_exact[q]
+            assert fe is not None
+            return fe * sub.slide_ms
+    raise AssertionError(f"tag {tag} not attached")
+
+
+# -- share-group differentials -------------------------------------------
+
+
+def test_shared_inner_join_group_matches_independent(monkeypatch):
+    """Three windowed queries over the same inner join — two plain
+    windows plus a residual filter over a JOIN-OUTPUT column (w comes
+    from the right side) — form ONE share group and each query's
+    emissions equal its independent join+window oracle exactly."""
+    _sequential_pump(monkeypatch)
+    Lb = _feed(1, 20, 80)
+    Rb = _feed(2, 20, 10)
+    ctx = Context(_cfg())
+    joined = _joined(ctx, Lb, Rb)
+    outs = [{}, {}, {}]
+    report = run_queries(ctx, [
+        (joined.window(["k"], AGGS, 3000, 1000), _sink(outs[0])),
+        (joined.window(["k"], AGGS, 5000, 1000), _sink(outs[1])),
+        (
+            joined.filter(col("w") > 50.0).window(["k"], AGGS, 2000, 1000),
+            _sink(outs[2]),
+        ),
+    ])
+    assert report["shared_queries"] == 3
+    assert report["independent_queries"] == 0
+    (g,) = report["groups"]
+    assert g["shared"] and g["members"] == [0, 1, 2] and g["unit_ms"] == 1000
+    specs = [(3000, 1000, None), (5000, 1000, None),
+             (2000, 1000, col("w") > 50.0)]
+    for out, (L, S, flt) in zip(outs, specs):
+        assert out, (L, S)
+        assert out == _oracle(Lb, Rb, L, S, unit=1000, flt=flt), (L, S)
+    # the residual member saw strictly fewer rows than the plain ones
+    assert len(outs[2]) < len(outs[0])
+
+
+def test_shared_left_outer_join_group_matches_independent(monkeypatch):
+    """LEFT join group: unmatched left rows (null right columns) surface
+    mid-stream via retention eviction and land in open windows — and a
+    residual over the nullable right-side column filters them out.  Per
+    query, byte-identical to the outer-join oracle, and distinct from an
+    inner join of the same feeds (the unmatched rows matter)."""
+    _sequential_pump(monkeypatch)
+    Lb = _feed(3, 20, 60, keys=4)
+    Rb = _feed(4, 20, 10, keys=2)  # keys k2*/k3* never match: unmatched
+    kw = {"join_retention_ms": 2500}
+    ctx = Context(_cfg(**kw))
+    joined = _joined(ctx, Lb, Rb, join_type="left")
+    outs = [{}, {}]
+    report = run_queries(ctx, [
+        (joined.window(["k"], AGGS, 5000, 1000), _sink(outs[0])),
+        (
+            joined.filter(col("w") > 50.0).window(["k"], AGGS, 4000, 2000),
+            _sink(outs[1]),
+        ),
+    ])
+    (g,) = report["groups"]
+    assert g["shared"] and g["members"] == [0, 1]
+    assert outs[0] == _oracle(
+        Lb, Rb, 5000, 1000, unit=1000, join_type="left", **kw
+    )
+    assert outs[1] == _oracle(
+        Lb, Rb, 4000, 2000, unit=1000, flt=col("w") > 50.0,
+        join_type="left", **kw
+    )
+    inner = _oracle(Lb, Rb, 5000, 1000, unit=1000, **kw)
+    assert outs[0] != inner  # unmatched left rows reached the windows
+
+
+def test_shared_band_join_group_late_rows_and_eviction(monkeypatch):
+    """Equi+band group over LATE (bounded out-of-order) feeds with
+    band-aware eviction live (slack = the feed's lateness): per-query
+    emissions equal the oracles while the shared join actually evicts
+    band-dead state (retention is effectively infinite)."""
+    _sequential_pump(monkeypatch)
+    late = 400
+    Lb = _feed(5, 20, 60, epoch_keys=False, jitter=late)
+    Rb = _feed(6, 20, 10, epoch_keys=False, jitter=late)
+    band = ("ts", "ts2", -300, 300)
+    kw = {"join_band_slack_ms": late}
+    ctx = Context(_cfg(**kw))
+    joined = _joined(ctx, Lb, Rb, band=band)
+    outs = [{}, {}]
+    sp = SharedPipeline(ctx, [
+        (joined.window(["k"], AGGS, 3000, 1000), _sink(outs[0])),
+        (
+            joined.filter(col("w") > 50.0).window(["k"], AGGS, 2000, 1000),
+            _sink(outs[1]),
+        ),
+    ])
+    sp.run()
+    join = _find_shared_join(sp.root)
+    assert join is not None
+    assert join._metrics["evicted"] > 0
+    assert outs[0] == _oracle(Lb, Rb, 3000, 1000, unit=1000, band=band, **kw)
+    assert outs[1] == _oracle(
+        Lb, Rb, 2000, 1000, unit=1000, flt=col("w") > 50.0, band=band, **kw
+    )
+
+
+def test_skew_adaptation_live_inside_shared_group(monkeypatch):
+    """Hot-key sub-partitioning adapts WHILE the join feeds a shared
+    group (policy ticks every batch), without changing any member's
+    emissions vs an adaptation-free oracle — and the measured
+    build/probe/gather attribution is live: the slice operator's
+    shared_fractions() apportions the join's cost by kept rows."""
+    _sequential_pump(monkeypatch)
+
+    def celeb(seed, nb, n):
+        # skewed like test_join_adaptive's feed: the policy needs
+        # ≥ ADAPT_MIN_ROWS (4096) on a side and a dominant top key
+        rg = np.random.default_rng(seed)
+        out = []
+        for b in range(nb):
+            base = T0 + b * 1000
+            ts = np.sort(base + rg.integers(0, 1000, n))
+            rows = []
+            for a, v in zip(ts, rg.integers(0, 100, n)):
+                hot = rg.random() < 0.25
+                key = "celebrity" if hot else f"k{int(rg.integers(0, 30))}"
+                rows.append((int(a), key, float(v)))
+            out.append(rows)
+        return out
+
+    Lb, Rb = celeb(8, 18, 300), celeb(9, 18, 40)
+    band = ("ts", "ts2", -400, 400)
+    kw = {"join_adaptive": True, "join_adapt_interval_s": 0.0}
+    ctx = Context(_cfg(**kw))
+    joined = _joined(ctx, Lb, Rb, band=band)
+    outs = [{}, {}]
+    sp = SharedPipeline(ctx, [
+        (joined.window(["k"], AGGS, 3000, 1000), _sink(outs[0])),
+        (
+            joined.filter(col("w") > 50.0).window(["k"], AGGS, 2000, 1000),
+            _sink(outs[1]),
+        ),
+    ])
+    sp.run()
+    join = _find_shared_join(sp.root)
+    assert join._policy is not None
+    assert join._policy.adaptations_total >= 1
+    # measured attribution (not 1/N): stage timers ran, and the slice
+    # op hands the doctor fractions that include the join's cost
+    assert join._shared_attr
+    assert join.shared_cost_ms() > 0.0
+    assert join.metrics()["shared_cost_ms"] == join.shared_cost_ms()
+    fr = sp.root.shared_fractions()
+    assert set(fr) == {0, 1}
+    assert abs(sum(fr.values()) - 1.0) < 1e-9
+    # byte-identical to adaptation-OFF oracles: layout mutations change
+    # pair order, never pair content (integer folds are order-exact)
+    assert outs[0] == _oracle(
+        Lb, Rb, 3000, 1000, unit=1000, band=band, join_adaptive=False
+    )
+    assert outs[1] == _oracle(
+        Lb, Rb, 2000, 1000, unit=1000, flt=col("w") > 50.0, band=band,
+        join_adaptive=False,
+    )
+
+
+# -- live registration over a join-fed shared pipeline -------------------
+
+
+def test_live_join_and_leave_on_shared_join_pipeline(monkeypatch):
+    """Mid-stream register/deregister with a JOIN feeding the shared
+    root: a joiner at +8s warms from retained join-output partials
+    (windows that closed before the join point backfill exactly), a
+    deregistration at +12s leaves the survivors byte-identical."""
+    _sequential_pump(monkeypatch)
+    Lb = _feed(10, 20, 80)
+    Rb = _feed(11, 20, 10)
+    # the join drives downstream watermarks with its RETENTION-CLAMPED
+    # low watermark (co-retained pairs can never late-drop), so a live
+    # schedule needs a realistic retention for windows to close
+    # mid-stream at all — the backfill-exactness-vs-retention contract
+    kw = {"join_retention_ms": 2000}
+    ctx = Context(_cfg(**kw))
+    joined = _joined(ctx, Lb, Rb)
+    got0, got1, got2 = {}, {}, {}
+    sp = SharedPipeline(ctx, [
+        (joined.window(["k"], AGGS, 3000, 1000), _sink(got0)),
+        (joined.window(["k"], AGGS, 2000, 2000), _sink(got1)),
+    ])
+    when = T0 + 8_000
+    tag = sp.register(
+        joined.window(["k"], AGGS, 2000, 1000), _sink(got2),
+        label="joiner", when_ts=when,
+    )
+    assert tag == 2
+    sp.deregister(1, when_ts=T0 + 12_000)
+    sp.run()
+
+    j_start = _first_exact_start(sp, tag)
+    oracle2 = _oracle(Lb, Rb, 2000, 1000, unit=1000, **kw)
+    expect2 = {k: v for k, v in oracle2.items() if k[1] >= j_start}
+    assert got2 == expect2
+    # the warm-up reached back: exact windows CLOSED before the join
+    # point were served from retained join-output slices, not live feed
+    assert any(k[2] <= when for k in got2)
+    assert got0 == _oracle(Lb, Rb, 3000, 1000, unit=1000, **kw)
+    oracle1 = _oracle(Lb, Rb, 2000, 2000, unit=1000, **kw)
+    assert got1 and set(got1) < set(oracle1)
+    assert all(got1[k] == oracle1[k] for k in got1)
+    assert sp.root.metrics()["subscribers"] == 2
+
+
+# -- kill/restore mid-epoch over a join-fed shared pipeline --------------
+
+
+def _drive_with_schedule(sp, outs, *, kill_after_committed=None, orch=None,
+                         coord=None):
+    committed = False
+    post_commit = 0
+    it = sp.root.run()
+    for item in it:
+        if isinstance(item, SubscriberBatch):
+            acc = outs.get(item.tag)
+            if acc is not None:
+                _rows_of(item.batch, acc)
+            if kill_after_committed is None:
+                continue
+            if item.tag == 2 and not committed and orch is not None:
+                orch.trigger_now()
+            if committed:
+                post_commit += 1
+                if post_commit >= kill_after_committed:
+                    it.close()
+                    return True
+        elif isinstance(item, Marker) and coord is not None:
+            coord.commit(item.epoch)
+            committed = True
+    return committed
+
+
+def _schedule(sp, joined, outs):
+    """Replayable event-time schedule over the join-fed pipeline: a
+    short-lived query joins at +4s and leaves at +9s; a joiner with a
+    residual over the right-side column joins at +11s and outlives the
+    run."""
+    t1 = sp.register(
+        joined.window(["k"], AGGS, 2000, 2000),
+        _sink(outs.setdefault(1, {})),
+        when_ts=T0 + 4_000,
+    )
+    sp.deregister(t1, when_ts=T0 + 9_000)
+    t2 = sp.register(
+        joined.filter(col("w") > 50.0).window(["k"], AGGS, 2000, 1000),
+        _sink(outs.setdefault(2, {})),
+        when_ts=T0 + 11_000,
+    )
+    assert (t1, t2) == (1, 2)
+
+
+def test_kill_restore_shared_join_group_byte_identical(
+    tmp_path, monkeypatch
+):
+    """The ISSUE 17 acceptance scenario in miniature: ONE epoch snapshot
+    covers the join's both sides AND the slice partials AND every
+    subscriber cursor under aligned markers.  A SIGKILL-equivalent stop
+    mid-epoch (after a live join and a completed join+leave), then
+    restore + replay of the same registration schedule, yields per-query
+    emission unions byte-identical to an uninterrupted run."""
+    _lockstep_pump(monkeypatch)
+    # 40 batches, not 24: the join pre-fetches both inputs through a
+    # bounded pump queue, so the sources run ~10 batches ahead of the
+    # join's processing point.  The barrier fires at tag 2's first
+    # emission (join at left batch ~15); the feed must outlast that
+    # point PLUS the prefetch depth or the sources hit EOS before they
+    # can poll the barrier and no consistent cut ever exists.
+    Lb = _feed(12, 40, 60)
+    Rb = _feed(13, 40, 10)
+    state_dir = str(tmp_path / "state")
+
+    def mk(path):
+        kw = {"join_retention_ms": 2000}
+        if path is not None:
+            kw.update(
+                checkpoint=True,
+                checkpoint_interval_s=9999,
+                state_backend_path=path,
+            )
+        ctx = Context(_cfg(**kw))
+        return ctx, _joined(ctx, Lb, Rb)
+
+    # golden: the same schedule, uninterrupted, no checkpointing
+    golden: dict[int, dict] = {0: {}}
+    ctx_g, joined_g = mk(None)
+    sp_g = SharedPipeline(
+        ctx_g, [(joined_g.window(["k"], AGGS, 3000, 1000), _sink(golden[0]))]
+    )
+    _schedule(sp_g, joined_g, golden)
+    _drive_with_schedule(sp_g, golden)
+    assert golden[1] and golden[2]
+
+    got: dict[int, dict] = {0: {}}
+    try:
+        ctx_a, joined_a = mk(state_dir)
+        sp_a = SharedPipeline(
+            ctx_a, [(joined_a.window(["k"], AGGS, 3000, 1000), _sink(got[0]))]
+        )
+        _schedule(sp_a, joined_a, got)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(sp_a.root, ctx_a, orch_a)
+        killed = _drive_with_schedule(
+            sp_a, got, kill_after_committed=6, orch=orch_a, coord=coord_a
+        )
+        assert killed
+        close_global_state_backend()
+
+        ctx_b, joined_b = mk(state_dir)
+        sp_b = SharedPipeline(
+            ctx_b, [(joined_b.window(["k"], AGGS, 3000, 1000), _sink(got[0]))]
+        )
+        _schedule(sp_b, joined_b, got)
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(sp_b.root, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        # cursor adoption + departed-tag idempotence, same as the
+        # join-free pipeline (PR-14 pattern)
+        assert 2 in sp_b.root._orphans
+        assert 1 in sp_b.root._departed
+        # the committed cut covers the join: a both-sides snapshot blob
+        # exists under the restored epoch (run() will rebuild from it)
+        join_b = _find_shared_join(sp_b.root)
+        assert join_b is not None and join_b._ckpt is not None
+        assert coord_b.get_snapshot(join_b._ckpt[1]) is not None
+        _drive_with_schedule(sp_b, got)
+        assert join_b._sides is not None
+        assert 2 in {s.tag for s in sp_b.root._subs}
+        assert not sp_b.root._orphans
+    finally:
+        close_global_state_backend()
+
+    for tag in (0, 1, 2):
+        assert set(got[tag]) == set(golden[tag]), {
+            "tag": tag,
+            "missing": sorted(set(golden[tag]) - set(got[tag]))[:4],
+            "extra": sorted(set(got[tag]) - set(golden[tag]))[:4],
+        }
+        for k in golden[tag]:
+            assert got[tag][k] == golden[tag][k], (tag, k)

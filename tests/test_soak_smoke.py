@@ -48,6 +48,39 @@ def test_soak_smoke(tmp_path, pipeline):
         assert t < 30, r
 
 
+def test_soak_smoke_join_dense(tmp_path):
+    """Shared-join multi-query registry under SIGKILL: 10 staggered
+    queries windowing over ONE fact×dim interval join, every emission
+    checked byte-identical to its independent join+window oracle,
+    warm backfills exact, one pipeline build per segment."""
+    out = tmp_path / "soak.json"
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "soak.py"),
+            "--pipeline", "join_dense",
+            "--minutes", "0.5", "--kill-every", "8",
+            "--pace", "40000", "--batch-rows", "2048",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    r = json.loads(out.read_text())
+    if r.get("aborted") and "relay active" in r["aborted"]:
+        pytest.skip("soak yielded to an active TPU relay")
+    assert r["aborted"] is None, r
+    assert r["eos_done_seen"], r
+    assert r["kills"] >= 1, r
+    jd = r["join_dense"]
+    assert jd["oracle_rc"] == 0, jd
+    assert jd["oracle_windows"] > 0, jd
+    assert jd["failures"] == 0, jd
+    assert jd["queries_silent"] == [], jd
+    assert jd["backfill_missing"] == [], jd
+    assert jd["backfilled_joiners"] >= 3, jd
+    assert jd["max_builds_per_segment"] == 1, jd
+
+
 def test_soak_smoke_query_dense(tmp_path):
     """Live multi-query registry under one SIGKILL: 50 staggered
     queries, every emission checked byte-identical to its independent

@@ -152,36 +152,39 @@ QD_SPECS = [(3000, 1000), (2000, 1000), (4000, 2000), (2000, 2000),
 QD_THRESHOLDS = [30.0, 38.0, 42.0, 46.0, 50.0, 52.0, 55.0, 35.0]
 
 
-def qd_schedule(total_batches: int, batch_rows: int, pace: float) -> list:
-    """The deterministic 50-query control plane: returns one dict per
-    query — {"qid", "L", "S", "thr"} plus "join" (event-time when_ts)
-    for the 44 live joiners and "leave" for the mid-run departures.
-    Pure function of the feed shape; parent, child, and the oracle
-    child all derive the identical schedule from SOAK_* env."""
+def _dense_schedule(total_batches: int, batch_rows: int, pace: float, *,
+                    n_queries: int, n_initial: int, specs: list,
+                    thresholds: list, tail_ms: int) -> list:
+    """Shared core of the dense control planes (query_dense and
+    join_dense): one dict per query — {"qid", "L", "S", "thr"} plus
+    "join" (event-time when_ts) for the live joiners and "leave" for
+    the mid-run departures.  Pure function of the feed shape; parent,
+    child, and the oracle child all derive the identical schedule from
+    SOAK_* env."""
     span_ms = batch_rows * 1000.0 / pace
     horizon = int(total_batches * span_ms)
     queries = []
-    for q in range(QD_QUERIES):
-        length, slide = QD_SPECS[q % len(QD_SPECS)]
+    for q in range(n_queries):
+        length, slide = specs[q % len(specs)]
         queries.append({
             "qid": q, "L": length, "S": slide,
-            "thr": QD_THRESHOLDS[q % len(QD_THRESHOLDS)],
+            "thr": thresholds[q % len(thresholds)],
         })
     # joiners: staggered across the middle of the event-time horizon at
     # off-second offsets (joins land mid-epoch relative to the wall-
-    # clock checkpoint cadence); the tail 12s stays join-free so every
+    # clock checkpoint cadence); the tail stays join-free so every
     # joiner still closes full windows before EOS
-    njoin = QD_QUERIES - QD_INITIAL
+    njoin = n_queries - n_initial
     join_lo = 4000
-    join_hi = max(join_lo + 1000, horizon - 12000)
-    for j, q in enumerate(range(QD_INITIAL, QD_QUERIES)):
+    join_hi = max(join_lo + 1000, horizon - tail_ms)
+    for j, q in enumerate(range(n_initial, n_queries)):
         queries[q]["join"] = (
             T0 + join_lo + (join_hi - join_lo) * j // max(njoin - 1, 1)
         )
     # leavers: every fifth joiner departs a third of the horizon after
     # it joined (never in the EOS drain tail — departure must be a live
     # detach, not the pipeline close)
-    for q in range(QD_INITIAL, QD_QUERIES):
+    for q in range(n_initial, n_queries):
         if q % 5 == 2:
             leave = min(
                 queries[q]["join"] + horizon // 3, T0 + horizon - 6000
@@ -189,6 +192,16 @@ def qd_schedule(total_batches: int, batch_rows: int, pace: float) -> list:
             if leave > queries[q]["join"] + queries[q]["L"] + 2000:
                 queries[q]["leave"] = leave
     return queries
+
+
+def qd_schedule(total_batches: int, batch_rows: int, pace: float) -> list:
+    """The deterministic 50-query control plane (6 initial, 44 live
+    joiners, every fifth joiner departing mid-run)."""
+    return _dense_schedule(
+        total_batches, batch_rows, pace, n_queries=QD_QUERIES,
+        n_initial=QD_INITIAL, specs=QD_SPECS, thresholds=QD_THRESHOLDS,
+        tail_ms=12000,
+    )
 
 
 def qd_class_continuous(specs: dict, qid: int) -> bool:
@@ -211,6 +224,53 @@ def qd_class_continuous(specs: dict, qid: int) -> bool:
             continue
         return True
     return False
+
+
+# -- join-dense shared-join soak (ISSUE 17) ------------------------------
+# The query-dense scenario one operator deeper: every query windows over
+# the SAME fact×dim interval join, so the whole group runs ONE
+# StreamingJoinExec whose output fans into the shared slice pipeline.
+# Staggered live joins/leaves and SIGKILL/restore ride the identical
+# event-time-replayable control plane; verification is byte-identity
+# against per-query independent join+window oracles (jd_verify reuses
+# qd_verify's comparison).  Readings are rounded to INTEGERS: the join's
+# output batch boundaries depend on pump interleaving (live pacing vs
+# the oracle's dense replay), so float sums would drift in the last ulp
+# across fold groupings — integer-valued float64 keeps every aggregate
+# (sum/avg included) exact and order-free (docs/multi_query.md).
+
+JD_QUERIES = 10
+JD_INITIAL = 3
+JD_UNIT_MS = 1000
+JD_SPECS = [(3000, 1000), (2000, 1000), (4000, 2000), (2000, 2000),
+            (3000, 3000), (4000, 1000)]
+JD_THRESHOLDS = [30.0, 40.0, 46.0, 52.0, 35.0, 55.0]
+#: join retention — small enough that the retention-clamped downstream
+#: watermark still closes windows promptly, large enough to absorb the
+#: pump-interleaving skew between the paced live run and the oracle's
+#: dense replay (both sides' batches stay co-retained)
+JD_RETENTION_MS = 3000
+
+
+def jd_batch_arrays(i: int, batch_rows: int, pace: float):
+    """Fact-side batch i for the join_dense feed: ``batch_arrays`` with
+    readings rounded to integers (see the block comment above)."""
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+    return ts, keys, np.round(vals)
+
+
+def jd_schedule(total_batches: int, batch_rows: int, pace: float) -> list:
+    """The join-dense control plane: 10 queries over one shared join (3
+    initial, 7 live joiners, one mid-run departure).  The join-free
+    tail is longer than query_dense's by the join retention — the
+    retention-clamped watermark lags the feed by JD_RETENTION_MS, and a
+    joiner attaching inside that lag would backfill against a floor the
+    EOS flush then overruns."""
+    return _dense_schedule(
+        total_batches, batch_rows, pace, n_queries=JD_QUERIES,
+        n_initial=JD_INITIAL, specs=JD_SPECS, thresholds=JD_THRESHOLDS,
+        tail_ms=12000 + JD_RETENTION_MS,
+    )
 
 
 def _group_reduce(comp, arrays):
@@ -720,6 +780,8 @@ def child_main() -> None:
                 ts, keys, vals = join_batch_arrays(
                     self._i, batch_rows, pace, total_batches
                 )
+            elif pipeline == "join_dense":
+                ts, keys, vals = jd_batch_arrays(self._i, batch_rows, pace)
             else:
                 ts, keys, vals = batch_arrays(
                     self._i, batch_rows, pace, seed=self._seed
@@ -789,7 +851,82 @@ def child_main() -> None:
             F.avg(col("reading")).alias("average"),
         ]
 
-    if pipeline == "query_dense":
+    dim_user = Schema([
+        Field("dim_at_ms", DataType.INT64, nullable=False),
+        Field("dim_sensor", DataType.STRING, nullable=False),
+        Field("w", DataType.FLOAT64),
+    ])
+    dim_schema = canonicalize_schema(dim_user)
+    dim_seconds = -(-total_batches * batch_rows // int(pace)) + 1
+    t0_sec = T0 // 1000
+
+    class DimPartition(PartitionReader):
+        """One batch per event-second: N_KEYS enrichment rows at the
+        second's absolute boundary, value = dim_value(k, s).  Paced at
+        one batch per wall second (``paced=False`` replays densely for
+        the oracle children); restore fast-forwards by batch index like
+        SoakPartition."""
+
+        def __init__(self, paced=True):
+            self._paced = paced
+            self._i = 0
+            self._anchor_wall = None
+            self._anchor_i = 0
+
+        def read(self, timeout_s=None):
+            if self._i >= dim_seconds:
+                return None
+            if self._paced:
+                now = time.monotonic()
+                if self._anchor_wall is None:
+                    self._anchor_wall = now
+                    self._anchor_i = self._i
+                due = self._anchor_wall + (self._i - self._anchor_i)
+                if now < due:
+                    time.sleep(min(due - now, timeout_s or (due - now)))
+                    if time.monotonic() < due:
+                        return attach_canonical_timestamp(
+                            RecordBatch.empty(dim_user), "dim_at_ms",
+                            fallback_ms=int(time.time() * 1000),
+                        )
+            s = self._i
+            self._i += 1
+            ts = np.full(
+                N_KEYS, (t0_sec + s) * 1000, dtype=np.int64
+            )
+            vals = np.array(
+                [dim_value(k, s) for k in range(N_KEYS)]
+            )
+            b = RecordBatch(dim_user, [ts, key_names.copy(), vals])
+            return attach_canonical_timestamp(
+                b, "dim_at_ms", fallback_ms=int(time.time() * 1000)
+            )
+
+        def offset_snapshot(self):
+            return {"i": self._i}
+
+        def offset_restore(self, snap):
+            self._i = int(snap["i"])
+            self._anchor_wall = None
+
+    class DimSource(Source):
+        name = "soak_dim"
+
+        def __init__(self, paced=True):
+            self._paced = paced
+
+        @property
+        def schema(self):
+            return dim_schema
+
+        def partitions(self):
+            return [DimPartition(self._paced)]
+
+        @property
+        def unbounded(self):
+            return False
+
+    if pipeline in ("query_dense", "join_dense"):
         # ISSUE 16 acceptance: 50 queries register/deregister LIVE on
         # one shared slice pipeline (staggered event-time arrivals,
         # incl. mid-epoch joins), SIGKILLed mid-run; every query's
@@ -800,12 +937,34 @@ def child_main() -> None:
         # checkpoint carried adopt their snapshotted cursor (orphan
         # adoption by tag), departed tags stay departed, future ops
         # fire when stream time reaches them.
+        # join_dense (ISSUE 17) is the same contract one operator
+        # deeper: every query windows over the SAME fact×dim interval
+        # join, so the group runs ONE StreamingJoinExec — its sides
+        # snapshot in the SAME epoch cut as the slice partials and the
+        # per-tag cursors.
         from denormalized_tpu.runtime.multi_query import SharedPipeline
 
-        sched = qd_schedule(total_batches, batch_rows, pace)
-        base = ctx.from_source(
-            SoakSource(SEED_LEFT, "soak_qd"), name="soak_qd"
-        )
+        if pipeline == "join_dense":
+            cfg.join_retention_ms = JD_RETENTION_MS
+            # both sides' band values are in-order (sorted fact batches,
+            # strictly increasing dim seconds), so zero slack is exact
+            cfg.join_band_slack_ms = 0
+            sched = jd_schedule(total_batches, batch_rows, pace)
+            unit_ms = JD_UNIT_MS
+            fact = ctx.from_source(
+                SoakSource(SEED_LEFT, "soak_fact"), name="soak_fact"
+            )
+            dim = ctx.from_source(DimSource(), name="soak_dim")
+            base = fact.join(
+                dim, "inner", ["sensor_name"], ["dim_sensor"],
+                band=("occurred_at_ms", "dim_at_ms", 0, JOIN_BAND_MS - 1),
+            )
+        else:
+            sched = qd_schedule(total_batches, batch_rows, pace)
+            unit_ms = QD_UNIT_MS
+            base = ctx.from_source(
+                SoakSource(SEED_LEFT, "soak_qd"), name="soak_qd"
+            )
         aggs = qd_aggs()
 
         def q_stream(spec):
@@ -866,7 +1025,7 @@ def child_main() -> None:
                 [(q_stream(s), mk_sink(s["qid"])) for s in initial],
                 labels=[f"q{s['qid']}" for s in initial],
             )
-            assert sp.root.unit_ms == QD_UNIT_MS, sp.root.unit_ms
+            assert sp.root.unit_ms == unit_ms, sp.root.unit_ms
             # one build per process incarnation: live joins/leaves must
             # NEVER rebuild the shared pipeline (the parent gates on
             # at most one of these per segment)
@@ -890,35 +1049,64 @@ def child_main() -> None:
             out.write(json.dumps({"event": "done", "t": time.time()}) + "\n")
         return
 
-    if pipeline == "query_dense_oracle":
+    if pipeline in ("query_dense_oracle", "join_dense_oracle"):
         # per-query independent UNINTERRUPTED oracles over the same
         # index-deterministic feed, replayed densely (no pacing): the
         # byte-identity referent for the live shared run.  Slice mode
         # pins to the shared group's gcd unit so fold order matches
         # (the aggregates carry extrema, so both runs take the lexsort
-        # fold lane).
+        # fold lane).  The join_dense oracle runs each query's OWN
+        # fact×dim join under the same retention/band-slack config —
+        # the joined row multiset is interleaving-free, so the shared
+        # run must reproduce it byte for byte.
         from denormalized_tpu.sources.memory import MemorySource
 
-        sched = qd_schedule(total_batches, batch_rows, pace)
+        joined_oracle = pipeline == "join_dense_oracle"
+        sched = (
+            jd_schedule(total_batches, batch_rows, pace) if joined_oracle
+            else qd_schedule(total_batches, batch_rows, pace)
+        )
         feed = []
         for i in range(total_batches):
-            ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+            if joined_oracle:
+                ts, keys, vals = jd_batch_arrays(i, batch_rows, pace)
+            else:
+                ts, keys, vals = batch_arrays(
+                    i, batch_rows, pace, seed=SEED_LEFT
+                )
             feed.append(RecordBatch(schema, [ts, key_names[keys], vals]))
         with open(out_path, "a", buffering=1) as out:
             for spec in sched:
-                octx = Context(EngineConfig(
+                ocfg = EngineConfig(
                     min_batch_bucket=batch_rows,
                     min_window_slots=32,
                     slice_windows=True,
-                    slice_unit_ms=QD_UNIT_MS,
+                    slice_unit_ms=JD_UNIT_MS if joined_oracle
+                    else QD_UNIT_MS,
                     emit_on_close=True,
-                ))
-                ds = octx.from_source(
+                )
+                if joined_oracle:
+                    ocfg.join_retention_ms = JD_RETENTION_MS
+                    ocfg.join_band_slack_ms = 0
+                octx = Context(ocfg)
+                src = octx.from_source(
                     MemorySource.from_batches(
                         feed, timestamp_column="occurred_at_ms"
                     ),
-                    name="soak_qd",
-                ).filter(col("reading") > spec["thr"]).window(
+                    name="soak_fact" if joined_oracle else "soak_qd",
+                )
+                if joined_oracle:
+                    src = src.join(
+                        octx.from_source(
+                            DimSource(paced=False), name="soak_dim"
+                        ),
+                        "inner", ["sensor_name"], ["dim_sensor"],
+                        band=(
+                            "occurred_at_ms", "dim_at_ms", 0,
+                            JOIN_BAND_MS - 1,
+                        ),
+                    )
+                ds = src.filter(col("reading") > spec["thr"]).window(
                     ["sensor_name"], qd_aggs(), spec["L"], spec["S"]
                 )
                 for b in ds.stream():
@@ -1127,75 +1315,12 @@ def child_main() -> None:
         # hot blocks are live, and the restored child rebuilds them from
         # the snapshot's representative rows.
         cfg.join_retention_ms = JOIN_RETENTION_MS
-        dim_user = Schema([
-            Field("dim_at_ms", DataType.INT64, nullable=False),
-            Field("dim_sensor", DataType.STRING, nullable=False),
-            Field("w", DataType.FLOAT64),
-        ])
-        dim_schema = canonicalize_schema(dim_user)
-        dim_seconds = -(-total_batches * batch_rows // int(pace)) + 1
-        t0_sec = T0 // 1000
-
-        class DimPartition(PartitionReader):
-            """One batch per event-second: N_KEYS enrichment rows at
-            the second's absolute boundary, value = dim_value(k, s).
-            Paced at one batch per wall second; restore fast-forwards
-            by batch index like SoakPartition."""
-
-            def __init__(self):
-                self._i = 0
-                self._anchor_wall = None
-                self._anchor_i = 0
-
-            def read(self, timeout_s=None):
-                if self._i >= dim_seconds:
-                    return None
-                now = time.monotonic()
-                if self._anchor_wall is None:
-                    self._anchor_wall = now
-                    self._anchor_i = self._i
-                due = self._anchor_wall + (self._i - self._anchor_i)
-                if now < due:
-                    time.sleep(min(due - now, timeout_s or (due - now)))
-                    if time.monotonic() < due:
-                        return attach_canonical_timestamp(
-                            RecordBatch.empty(dim_user), "dim_at_ms",
-                            fallback_ms=int(time.time() * 1000),
-                        )
-                s = self._i
-                self._i += 1
-                ts = np.full(
-                    N_KEYS, (t0_sec + s) * 1000, dtype=np.int64
-                )
-                vals = np.array(
-                    [dim_value(k, s) for k in range(N_KEYS)]
-                )
-                b = RecordBatch(dim_user, [ts, key_names.copy(), vals])
-                return attach_canonical_timestamp(
-                    b, "dim_at_ms", fallback_ms=int(time.time() * 1000)
-                )
-
-            def offset_snapshot(self):
-                return {"i": self._i}
-
-            def offset_restore(self, snap):
-                self._i = int(snap["i"])
-                self._anchor_wall = None
-
-        class DimSource(Source):
-            name = "soak_dim"
-
-            @property
-            def schema(self):
-                return dim_schema
-
-            def partitions(self):
-                return [DimPartition()]
-
-            @property
-            def unbounded(self):
-                return False
-
+        # band-aware eviction (ISSUE 17, docs/joins.md): the band is far
+        # tighter than retention, so band-dead batches release early.
+        # Slack = the feed's bounded lateness — late rows sit at most
+        # JOIN_LATE_MS below an on-time batch's band minimum, which is
+        # exactly the horizon the slack re-opens
+        cfg.join_band_slack_ms = JOIN_LATE_MS
         left = ctx.from_source(
             SoakSource(SEED_LEFT, "soak_fact"), name="soak_fact"
         )
@@ -1564,17 +1689,20 @@ def read_emissions(paths):
     return wins, dupes, done, metrics, clipped
 
 
-def qd_verify(args, env, work, wins, seg_paths, total_batches) -> dict:
-    """Query-dense acceptance: spawn the oracle child (50 independent
-    uninterrupted runs over the same feed), then hold every live
-    query's committed emissions to BYTE-identity with its oracle from
-    its first exact window — late joiners' backfilled windows
-    included, departed queries' prefixes included, duplicate committed
-    occurrences each checked.  Also counts pipeline builds per segment
-    (live joins/leaves must never rebuild the shared pipeline)."""
+def qd_verify(args, env, work, wins, seg_paths, total_batches, *,
+              sched_fn=qd_schedule,
+              oracle_pipeline="query_dense_oracle") -> dict:
+    """Dense-pipeline acceptance (query_dense and join_dense): spawn
+    the oracle child (independent uninterrupted runs over the same
+    feed), then hold every live query's committed emissions to
+    BYTE-identity with its oracle from its first exact window — late
+    joiners' backfilled windows included, departed queries' prefixes
+    included, duplicate committed occurrences each checked.  Also
+    counts pipeline builds per segment (live joins/leaves must never
+    rebuild the shared pipeline)."""
     oracle_path = os.path.join(work, "qd_oracle.jsonl")
     oenv = dict(env)
-    oenv["SOAK_PIPELINE"] = "query_dense_oracle"
+    oenv["SOAK_PIPELINE"] = oracle_pipeline
     oenv["SOAK_OUT"] = oracle_path
     rc = subprocess.call(
         [sys.executable, os.path.abspath(__file__), "--child"],
@@ -1612,7 +1740,7 @@ def qd_verify(args, env, work, wins, seg_paths, total_batches) -> dict:
             v for v, _seg in occs
         )
 
-    sched = qd_schedule(total_batches, args.batch_rows, args.pace)
+    sched = sched_fn(total_batches, args.batch_rows, args.pace)
     specs = {s["qid"]: s for s in sched}
     failures: list = []
     silent: list = []
@@ -2304,7 +2432,7 @@ def main():
     ap.add_argument("--pipeline",
                     choices=("simple", "sliding", "join", "session",
                              "udaf", "kafka", "bigstate", "cluster",
-                             "query_dense"),
+                             "query_dense", "join_dense"),
                     default="simple")
     ap.add_argument("--cluster-workers", type=int, default=3,
                     help="cluster: engine worker processes")
@@ -2353,6 +2481,7 @@ def main():
                 "bigstate": "SOAK_BIGSTATE.json",
                 "cluster": "SOAK_CLUSTER.json",
                 "query_dense": "SOAK_QUERY_DENSE.json",
+                "join_dense": "SOAK_JOIN_DENSE.json",
             }[args.pipeline]
         ))
     if args.child:
@@ -2444,10 +2573,12 @@ def main():
         ),
         "session": golden_update_session,
         "sliding": golden_update_sliding,
-        # query_dense verifies against per-query ORACLE RUNS (qd_verify)
-        # after the drive loop, not an incremental golden fold — the
-        # loop still advances golden_i to track feed exhaustion
+        # query_dense/join_dense verify against per-query ORACLE RUNS
+        # (qd_verify) after the drive loop, not an incremental golden
+        # fold — the loop still advances golden_i to track feed
+        # exhaustion
         "query_dense": lambda agg, i, br, pc: None,
+        "join_dense": lambda agg, i, br, pc: None,
     }.get(args.pipeline, golden_update)  # udaf golden == tumbling fold
     golden_i = 0
     seg_paths = []
@@ -2543,23 +2674,34 @@ def main():
         wins, dupes, done_seen, child_metrics, clipped = read_emissions(
             seg_paths
         )
-        if args.pipeline == "query_dense":
+        if args.pipeline in ("query_dense", "join_dense"):
+            dense_join = args.pipeline == "join_dense"
             qd = (
                 None if aborted
-                else qd_verify(args, env, work, wins, seg_paths,
-                               total_batches)
+                else qd_verify(
+                    args, env, work, wins, seg_paths, total_batches,
+                    sched_fn=jd_schedule if dense_join else qd_schedule,
+                    oracle_pipeline=(
+                        "join_dense_oracle" if dense_join
+                        else "query_dense_oracle"
+                    ),
+                )
             )
             try:
                 telemetry = derive_telemetry(obs_paths)
             except Exception as e:  # dnzlint: allow(broad-except) telemetry derivation is reporting, not verification
                 telemetry = {"error": str(e)}
+            # join_dense runs a 10-query plane (the join oracles replay
+            # the full feed per query), so its warm-backfill floor scales
+            # down with it
+            min_backfilled = 3 if dense_join else 10
             ok = bool(
                 not aborted and done_seen and kills_issued >= 2
                 and qd is not None
                 and qd["oracle_rc"] == 0 and qd["oracle_windows"] > 0
                 and qd["failures"] == 0 and not qd["queries_silent"]
                 and not qd["backfill_missing"]
-                and qd["backfilled_joiners"] >= 10
+                and qd["backfilled_joiners"] >= min_backfilled
                 and qd["max_builds_per_segment"] == 1
             )
             write({
@@ -2572,7 +2714,7 @@ def main():
                 "duplicate_emissions": dupes,
                 "uncommitted_clipped": clipped,
                 "child_metrics": child_metrics,
-                "query_dense": qd,
+                args.pipeline: qd,
                 "ok": ok,
             })
             print(json.dumps({
