@@ -12,12 +12,12 @@ UDAF uses, so kill/restore covers them for free.
 
 from __future__ import annotations
 
-import hashlib
 import math
 
 import numpy as np
 
 from denormalized_tpu.api.udaf import Accumulator
+from denormalized_tpu.ops import sketches as _skx
 
 
 def _jsonable_scalar(x):
@@ -47,12 +47,17 @@ class ArrayAggAccumulator(Accumulator):
     def state(self) -> list:
         return [list(self.values)]
 
+    def state_nbytes(self) -> int:
+        return 64 + 64 * len(self.values)
+
     def evaluate(self):
         return list(self.values)
 
 
 class MedianAccumulator(Accumulator):
-    """Exact median (DataFusion `median`); state is the value list."""
+    """Exact median (DataFusion `median`); state is the value list —
+    UNBOUNDED growth, reported exactly via :meth:`state_nbytes` so the
+    doctor's budget/growth verdicts (and spill pressure) see it."""
 
     def __init__(self):
         self.values: list[float] = []
@@ -65,6 +70,11 @@ class MedianAccumulator(Accumulator):
 
     def state(self) -> list:
         return [list(self.values)]
+
+    def state_nbytes(self) -> int:
+        # 8 bytes payload + ~24 bytes of boxed-float overhead per entry;
+        # derived from the element count, so restore-invariant
+        return 64 + 32 * len(self.values)
 
     def evaluate(self):
         return float(np.median(self.values)) if self.values else math.nan
@@ -131,6 +141,11 @@ class CountDistinctAccumulator(Accumulator):
     def state(self) -> list:
         return [list(self.seen)]
 
+    def state_nbytes(self) -> int:
+        # ~64 bytes per set entry (hash slot + boxed value); derived
+        # from the element count, so restore-invariant
+        return 64 + 64 * len(self.seen)
+
     def evaluate(self) -> int:
         return len(self.seen)
 
@@ -153,6 +168,9 @@ class PercentileContAccumulator(Accumulator):
 
     def state(self) -> list:
         return [list(self.values)]
+
+    def state_nbytes(self) -> int:
+        return 64 + 32 * len(self.values)
 
     def evaluate(self):
         if not self.values:
@@ -255,6 +273,9 @@ class StringAggAccumulator(Accumulator):
 
     def state(self) -> list:
         return [list(self.values)]
+
+    def state_nbytes(self) -> int:
+        return 64 + 64 * len(self.values)
 
     def evaluate(self):
         return self.delimiter.join(self.values) if self.values else None
@@ -411,6 +432,9 @@ class WeightedPercentileAccumulator(Accumulator):
     def state(self) -> list:
         return [list(self.values), list(self.weights)]
 
+    def state_nbytes(self) -> int:
+        return 64 + 64 * len(self.values)
+
     def evaluate(self):
         if not self.values:
             return math.nan
@@ -431,10 +455,15 @@ class WeightedPercentileAccumulator(Accumulator):
 class ApproxDistinctAccumulator(Accumulator):
     """HyperLogLog distinct-count sketch (DataFusion `approx_distinct`).
 
-    2^11 registers (~1.6% standard error), 64-bit stable hash
-    (blake2b — NOT Python's salted ``hash``, which would break
-    checkpoint/restore across processes).  State is the register list, so
-    merge is an elementwise max — the standard HLL union."""
+    Thin shim over the shared :mod:`denormalized_tpu.ops.sketches`
+    kernels — the UDAF fallback lane of the first-class
+    ``approx_distinct`` slice aggregate.  2^11 registers (~2.3%
+    standard error), 64-bit stable hash (blake2b — NOT Python's salted
+    ``hash``, which would break checkpoint/restore across processes);
+    this class keeps its historical LOW-bit register-index convention
+    (``h & (M-1)``), so checkpointed register state from earlier builds
+    restores bit-for-bit.  State is the register list; merge is an
+    elementwise max — the standard HLL union."""
 
     P = 11
     M = 1 << P
@@ -444,30 +473,36 @@ class ApproxDistinctAccumulator(Accumulator):
 
     @classmethod
     def _hash64(cls, v) -> int:
-        b = repr(v).encode() if not isinstance(v, (str, bytes)) else (
-            v.encode() if isinstance(v, str) else v
-        )
-        return int.from_bytes(
-            hashlib.blake2b(b, digest_size=8).digest(), "little"
-        )
+        return _skx.blake2b64(v)
 
     def update(self, col: np.ndarray) -> None:
-        regs = self.regs
-        P, M = self.P, self.M
-        for v in col.tolist():
-            h = self._hash64(v)
-            idx = h & (M - 1)
-            rest = h >> P
-            # rank: position of first set bit in the remaining 64-P bits
-            rank = (64 - P) - rest.bit_length() + 1 if rest else (64 - P) + 1
-            if rank > regs[idx]:
-                regs[idx] = rank
+        vals = col.tolist()
+        if not vals:
+            return
+        hs = np.fromiter(
+            (_skx.blake2b64(v) for v in vals),
+            dtype=np.uint64,
+            count=len(vals),
+        )
+        idx = (hs & np.uint64(self.M - 1)).astype(np.int64)
+        rest = hs >> np.uint64(self.P)
+        # rank: position of first set bit in the remaining 64-P bits;
+        # exact bit-length from the shared kernel (bit-identical to the
+        # old per-row int.bit_length loop)
+        width = np.uint64(64 - self.P)
+        rank = (
+            width + np.uint64(1) - _skx.u64_bit_length(rest)
+        ).astype(np.int8)
+        np.maximum.at(self.regs, idx, rank)
 
     def merge(self, state) -> None:
         self.regs = np.maximum(self.regs, np.asarray(state[0], dtype=np.int8))
 
     def state(self) -> list:
         return [self.regs.tolist()]
+
+    def state_nbytes(self) -> int:
+        return int(self.regs.nbytes)  # constant — the sketch's point
 
     def evaluate(self) -> int:
         m = float(self.M)
@@ -477,3 +512,39 @@ class ApproxDistinctAccumulator(Accumulator):
         if est <= 2.5 * m and zeros:
             est = m * math.log(m / zeros)  # linear counting, small range
         return int(round(est))
+
+
+class ApproxTopKAccumulator(Accumulator):
+    """Exact top-k heavy hitters for the ``approx_top_k`` UDAF fallback
+    lane: a value → count dict, evaluated as ``[value, count]`` pairs
+    count-descending (insertion order breaks ties, so the output is a
+    pure function of the feed).  Unbounded in distinct values — the
+    slice path's Space-Saving planes are the bounded-state lane; this
+    accumulator reports its real growth via :meth:`state_nbytes`."""
+
+    def __init__(self, k: int = 10):
+        if k < 1:
+            raise ValueError(f"approx_top_k needs k >= 1, got {k}")
+        self.k = int(k)
+        self.counts: dict = {}
+
+    def update(self, col: np.ndarray) -> None:
+        counts = self.counts
+        for v in col.tolist():
+            v = _jsonable_scalar(v)
+            counts[v] = counts.get(v, 0) + 1
+
+    def merge(self, state) -> None:
+        counts = self.counts
+        for v, c in state[0]:
+            counts[v] = counts.get(v, 0) + int(c)
+
+    def state(self) -> list:
+        return [[[v, c] for v, c in self.counts.items()]]
+
+    def state_nbytes(self) -> int:
+        return 64 + 80 * len(self.counts)
+
+    def evaluate(self) -> list:
+        items = sorted(self.counts.items(), key=lambda kv: -kv[1])
+        return [[v, int(c)] for v, c in items[: self.k]]

@@ -232,6 +232,14 @@ class EngineConfig:
     # aggregate UNION carries min/max always sorts, so an add-only
     # member's byte-identity oracle sets this True to match.
     slice_sort_lane: bool = False
+    # approximate aggregates (approx_distinct / approx_top_k /
+    # approx_percentile_cont / approx_median) as first-class sketch
+    # planes on the slice path — constant state per group regardless of
+    # value cardinality (ops/sketches.py).  Only takes effect with
+    # slice_windows=True; False lowers them to their exact accumulator
+    # UDAFs everywhere (the historical behavior, and the bench's A/B
+    # control for the approx_scale sweep).
+    approx_native: bool = True
     # predicate-subsumption sharing in the multi-query runtime: a query
     # whose filter is provably implied by another's (conjunct
     # containment over equality/range/IN bounds — planner/predicates.py)

@@ -37,7 +37,7 @@ __all__ = [  # noqa: F822 - scalar names are injected below
     "var_pop",
     "median", "approx_median", "array_agg", "first_value", "last_value",
     "nth_value", "string_agg",
-    "approx_distinct", "count_distinct", "percentile_cont",
+    "approx_distinct", "approx_top_k", "count_distinct", "percentile_cont",
     "approx_percentile_cont", "approx_percentile_cont_with_weight",
     "bit_and", "bit_or", "bit_xor", "bool_and", "bool_or",
     "corr", "covar", "covar_pop", "covar_samp",
@@ -135,11 +135,16 @@ def median(expr: Expr | str) -> AggregateExpr:
 
 
 def approx_median(expr: Expr | str) -> AggregateExpr:
-    """Exact median under the approx_median name (we can afford exact)."""
+    """Approximate median: a first-class mergeable quantile sketch on
+    the multi-query slice path (documented rank-error bound, O(1) state
+    per group — ops/sketches.py KllSpec); lowers to the exact
+    MedianAccumulator on every other path."""
+    from denormalized_tpu.api.udaf import UDAF
+
     b = _builtin_accs()
-    return _builtin_udaf(b.MedianAccumulator, DataType.FLOAT64, "approx_median")(
-        expr
-    )
+    e = _e(expr)
+    u = UDAF(b.MedianAccumulator, (e,), DataType.FLOAT64, "approx_median")
+    return AggregateExpr("approx_median", e, None, u)
 
 
 def first_value(expr: Expr | str) -> AggregateExpr:
@@ -155,11 +160,41 @@ def last_value(expr: Expr | str) -> AggregateExpr:
 
 
 def approx_distinct(expr: Expr | str) -> AggregateExpr:
-    """HyperLogLog distinct count (~1.6% error, mergeable sketch state)."""
+    """HyperLogLog distinct count (~1.6% error, mergeable sketch state).
+
+    First-class on the multi-query slice path: a vectorized (G, 4096)
+    int8 register plane per slice unit, shared across concurrent
+    queries, byte-identical through kill/restore (stable blake2b /
+    splitmix64 hashing).  Lowers to the accumulator-frame HLL shim on
+    every other path."""
+    from denormalized_tpu.api.udaf import UDAF
+
     b = _builtin_accs()
-    return _builtin_udaf(
-        b.ApproxDistinctAccumulator, DataType.INT64, "approx_distinct"
-    )(expr)
+    e = _e(expr)
+    u = UDAF(
+        b.ApproxDistinctAccumulator, (e,), DataType.INT64, "approx_distinct"
+    )
+    return AggregateExpr("approx_distinct", e, None, u)
+
+
+def approx_top_k(expr: Expr | str, k: int = 10) -> AggregateExpr:
+    """Top-k most frequent values as ``[value, count]`` pairs,
+    count-descending — Space-Saving planes on the multi-query slice
+    path (``count - err <= true <= count`` per reported value, O(k)
+    state per group); exact dict counting on the fallback path."""
+    from denormalized_tpu.api.udaf import UDAF
+
+    b = _builtin_accs()
+    e = _e(expr)
+    k = int(k)
+
+    class _Bound(b.ApproxTopKAccumulator):
+        def __init__(self):
+            super().__init__(k)
+
+    _Bound.__name__ = f"ApproxTopK[{k}]"
+    u = UDAF(_Bound, (e,), DataType.LIST, f"approx_top_k_{k}")
+    return AggregateExpr("approx_top_k", e, None, u, (k,))
 
 
 def count_distinct(expr: Expr | str) -> AggregateExpr:
@@ -186,8 +221,24 @@ def percentile_cont(expr: Expr | str, q: float) -> AggregateExpr:
 
 
 def approx_percentile_cont(expr: Expr | str, q: float) -> AggregateExpr:
-    """Alias of :func:`percentile_cont` (we can afford exact)."""
-    return percentile_cont(expr, q)
+    """Approximate continuous percentile: compactor quantile sketch on
+    the multi-query slice path (self-reported rank-error bound, O(1)
+    state per group); lowers to the exact interpolating
+    :func:`percentile_cont` accumulator on every other path."""
+    from denormalized_tpu.api.udaf import UDAF
+
+    b = _builtin_accs()
+
+    class _Bound(b.PercentileContAccumulator):
+        def __init__(self):
+            super().__init__(q)
+
+    _Bound.__name__ = f"PercentileCont[{q}]"
+    e = _e(expr)
+    u = UDAF(_Bound, (e,), DataType.FLOAT64, f"percentile_cont_{q}")
+    return AggregateExpr(
+        "approx_percentile_cont", e, None, u, (float(q),)
+    )
 
 
 def approx_percentile_cont_with_weight(

@@ -1118,29 +1118,52 @@ AGG_KINDS = (
 )
 VAR_KINDS = ("stddev", "stddev_pop", "var", "var_pop")
 
+#: sketch-backed approximate aggregates — first-class mergeable kinds
+#: that plan onto the slice store (ops/sketches.py planes) when the
+#: multi-query slice path is on, and lower to their exact/UDAF fallback
+#: accumulators otherwise (see planner._lower_sketch_aggs)
+SKETCH_AGG_KINDS = (
+    "approx_distinct", "approx_top_k",
+    "approx_percentile_cont", "approx_median",
+)
+
 
 @dataclass(frozen=True, eq=False)
 class AggregateExpr(Expr):
     """An aggregate call inside window(): count/sum/min/max/avg or a UDAF."""
 
-    kind: str  # one of AGG_KINDS or "udaf"
+    kind: str  # one of AGG_KINDS, SKETCH_AGG_KINDS, or "udaf"
     arg: Expr | None  # None for count(*)
     _alias: str | None = None
-    udaf: Any = None  # api.udaf.UDAF instance when kind == "udaf"
+    udaf: Any = None  # api.udaf.UDAF instance when kind == "udaf";
+    # for SKETCH_AGG_KINDS: the exact/UDAF fallback accumulator the
+    # planner lowers to off the slice path
+    params: tuple = ()  # sketch kind parameters (k, quantile q, ...)
 
     @property
     def name(self) -> str:
         if self._alias:
             return self._alias
         argname = self.arg.name if self.arg is not None else "*"
+        if self.kind == "approx_percentile_cont" and self.params:
+            return f"{self.kind}({argname}, {self.params[0]})"
+        if self.kind == "approx_top_k" and self.params:
+            return f"{self.kind}({argname}, {self.params[0]})"
         return f"{self.kind}({argname})"
 
     def alias(self, name: str) -> "AggregateExpr":
-        return AggregateExpr(self.kind, self.arg, name, self.udaf)
+        return AggregateExpr(self.kind, self.arg, name, self.udaf, self.params)
 
     def out_field(self, schema: Schema) -> Field:
         if self.kind == "count":
             return Field(self.name, DataType.INT64, nullable=False)
+        if self.kind == "approx_distinct":
+            return Field(self.name, DataType.INT64, nullable=False)
+        if self.kind == "approx_top_k":
+            # list of [value, count] pairs, count-descending
+            return Field(self.name, DataType.LIST)
+        if self.kind in ("approx_percentile_cont", "approx_median"):
+            return Field(self.name, DataType.FLOAT64)
         if self.kind == "avg" or self.kind in VAR_KINDS:
             return Field(self.name, DataType.FLOAT64)
         if self.kind == "udaf":

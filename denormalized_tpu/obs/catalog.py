@@ -271,6 +271,28 @@ INSTRUMENTS: dict[str, tuple] = {
         "finalize + emission assembly for one subscriber's window",
         MS_BUCKETS,
     ),
+    "dnz_sketch_rows_total": (
+        "counter",
+        "rows fed through slice-store sketch kernels (HLL / Space-"
+        "Saving / quantile compactor planes) by a SliceWindowExec — "
+        "counted once per batch over all filter classes, so a row a "
+        "residual class re-accumulates counts again (it ran the kernel "
+        "again)",
+    ),
+    "dnz_sketch_state_bytes": (
+        "gauge",
+        "exact bytes held by sketch planes across a SliceWindowExec's "
+        "live slices — constant in value cardinality by construction "
+        "(the contrast to unbounded exact distinct/median accumulator "
+        "growth the doctor's state verdicts flag)",
+    ),
+    "dnz_sketch_update_ms": (
+        "histogram",
+        "per-batch time inside sketch accumulate kernels (all planes, "
+        "all filter classes) — the marginal ingest cost of approximate "
+        "aggregates riding a shared slice pipeline",
+        MS_BUCKETS,
+    ),
     # -- query-dense serving: live registration + subsumption (ISSUE 16) -
     "dnz_mq_subscribers_live": (
         "gauge",

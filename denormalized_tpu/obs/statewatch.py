@@ -65,10 +65,16 @@ from collections import deque
 import numpy as np
 
 from denormalized_tpu.obs.readers import linear_forecast
+from denormalized_tpu.ops.sketches import (  # noqa: F401 - re-exports
+    Hll,
+    SpaceSaving,
+    _aggregate_gids,
+    _mix64,
+)
 
 __all__ = [
     "SpaceSaving", "Hll", "StateWatch", "NULL_WATCH", "arrays_nbytes",
-    "linear_forecast",
+    "acc_nbytes", "linear_forecast",
 ]
 
 
@@ -85,6 +91,20 @@ def arrays_nbytes(*arrays) -> int:
 KEY_EST_BYTES = 64  # one interned key: dict entry + row tuple + id
 ACC_EST_BYTES = 512  # one accumulator object (UDAF/builtin, amortized)
 OBJ_CELL_EST_BYTES = 56  # one object-dtype cell (string ref + header)
+
+
+def acc_nbytes(acc) -> int:
+    """Accounting bytes of one accumulator: its own ``state_nbytes()``
+    when it reports one (the unbounded exact accumulators — median,
+    count_distinct, percentile, array_agg — derive it from their
+    element counts, so it is restore-invariant AND actually grows),
+    else the constant :data:`ACC_EST_BYTES` estimate.  Without this the
+    doctor's unbounded-growth / budget-pressure verdicts were blind to
+    exactly the accumulators most likely to OOM."""
+    fn = getattr(acc, "state_nbytes", None)
+    if fn is None:
+        return ACC_EST_BYTES
+    return int(fn())
 
 
 def side_live_keys(info: dict, side) -> int:
@@ -143,22 +163,14 @@ def rb_nbytes(batch) -> int:
 SKETCH_ROW_CAP = 16_384
 
 
-def _aggregate_gids(g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """(unique gids, per-gid counts) of one batch.  Dense gid spaces
-    (the normal case — interners hand out consecutive ids) take the
-    O(n + max_gid) bincount path instead of the O(n log n) sort that
-    ``np.unique`` costs; the sketch update must stay microseconds at
-    8k-row batches (the run_obs_overhead gate covers it)."""
-    mx = int(g.max())
-    if mx < 4 * len(g) + 1024:
-        bc = np.bincount(g)
-        u = np.nonzero(bc)[0]
-        return u, bc[u]
-    u, c = np.unique(g.astype(np.int64, copy=False), return_counts=True)
-    return u, c
-
-
-# -- Space-Saving heavy hitters ------------------------------------------
+# -- sketches ------------------------------------------------------------
+# The SpaceSaving / Hll / _mix64 / _aggregate_gids kernels moved to
+# ops/sketches.py (ISSUE 18) — ONE implementation now serves the
+# intern-time observatory sketches here, the slice store's first-class
+# approx aggregates, and the UDAF fallback HLL shim.  They are
+# re-imported above so every existing consumer (join_exec's decayed
+# sketch, the doctor, tests) keeps its import path; decay semantics
+# stay a SpaceSaving constructor option, used only by the join.
 
 #: decay horizon for the JOIN's windowed sketches: one decay step (×½)
 #: every quarter-million rows per side ⇒ a retired celebrity's share
@@ -166,203 +178,6 @@ def _aggregate_gids(g: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 #: policy's fold condition (share below fold_share for hold_ticks) is
 #: reachable in bounded rows.  Other operators keep monotone sketches.
 JOIN_SKETCH_DECAY_ROWS = 1 << 18
-
-
-class SpaceSaving:
-    """Vectorized Space-Saving (Metwally et al.) over dense int gids.
-
-    K slots of (key, count, err).  ``update`` aggregates the batch with
-    one ``np.unique`` and applies hits as a scatter-add; new keys
-    replace the lowest-count slots, inheriting the evicted count as
-    their error bound — ``count - err <= true count <= count`` for
-    every tracked key.  All numpy, no per-row Python (pinned by
-    DNZ-H001 via hotpaths.toml).
-
-    With ``decay_every`` > 0 the sketch is WINDOWED: every
-    ``decay_every`` rows fed, counts, error bounds, and the total are
-    scaled by ``decay_factor`` — an exponential moving window with a
-    half-life of ``decay_every / (1 - decay_factor) * ln2`` rows at the
-    default factor ½.  Shares then track RECENT traffic: a retired
-    celebrity's share decays geometrically instead of only as
-    ``1/total`` growth, so the join adaptation policy's fold trigger
-    fires promptly instead of holding stale heavy hitters for the rest
-    of the run.  Default 0 (off) preserves the monotone sketch every
-    other consumer (skew verdicts, hot-key gauges) was tuned against;
-    the overestimate invariant ``count - err <= true(window)`` is
-    preserved under decay because both sides of the bound scale
-    together.
-    """
-
-    __slots__ = (
-        "keys", "counts", "errs", "total", "decay_every", "decay_factor",
-        "_since_decay",
-    )
-
-    def __init__(
-        self,
-        capacity: int = 64,
-        *,
-        decay_every: int = 0,
-        decay_factor: float = 0.5,
-    ) -> None:
-        k = max(int(capacity), 8)
-        self.keys = np.full(k, -1, dtype=np.int64)
-        self.counts = np.zeros(k, dtype=np.int64)
-        self.errs = np.zeros(k, dtype=np.int64)
-        self.total = 0  # rows in the (possibly decayed) window
-        self.decay_every = max(int(decay_every), 0)
-        if not 0.0 < float(decay_factor) < 1.0:
-            raise ValueError("decay_factor must be in (0, 1)")
-        self.decay_factor = float(decay_factor)
-        self._since_decay = 0
-
-    def update(self, gids: np.ndarray) -> None:
-        g = np.asarray(gids, dtype=np.int64)
-        if len(g) == 0:
-            return
-        self.update_aggregated(*_aggregate_gids(g), len(g))
-
-    def decay(self) -> None:
-        """One decay step: scale counts, errors, and the total by
-        ``decay_factor``; slots decayed to zero free up for new keys
-        (their key stays until evicted — a zero-count slot is the first
-        victim the admission pass picks)."""
-        f = self.decay_factor
-        self.counts = (self.counts * f).astype(np.int64)
-        self.errs = (self.errs * f).astype(np.int64)
-        self.total = int(self.total * f)
-        self._since_decay = 0
-
-    def update_aggregated(
-        self, u: np.ndarray, c: np.ndarray, rows: int
-    ) -> None:
-        """Batch update from pre-aggregated (unique gids, counts) —
-        the shape :func:`_aggregate_gids` produces once per batch so the
-        HLL can share the same reduction."""
-        if self.decay_every:
-            self._since_decay += int(rows)
-            if self._since_decay >= self.decay_every:
-                self.decay()
-        self.total += int(rows)
-        k = self.keys
-        order = np.argsort(k, kind="stable")
-        ks = k[order]
-        pos = np.minimum(np.searchsorted(ks, u), len(ks) - 1)
-        hit = ks[pos] == u
-        np.add.at(self.counts, order[pos[hit]], c[hit])
-        miss = ~hit
-        if miss.any():
-            mu = u[miss]
-            mc = c[miss]
-            # largest newcomers first when more new keys than slots
-            mo = np.argsort(-mc, kind="stable")
-            take = min(len(mu), len(k))
-            mu = mu[mo[:take]]
-            mc = mc[mo[:take]]
-            victims = np.argsort(self.counts, kind="stable")[:take]
-            base = self.counts[victims]
-            # admission guard: sequential Space-Saving only ever evicts
-            # the MINIMUM slot, whose count stays near the smallest base
-            # as it churns — so a newcomer may only take a victim whose
-            # count is within its own batch mass of that minimum.
-            # Without this, a batch with >= K new keys would pair its
-            # smallest newcomer against the LARGEST victim and evict a
-            # genuine heavy hitter (caught by the skew smoke test).
-            ok = base <= base[0] + mc
-            if not ok.all():
-                victims = victims[ok]
-                mu = mu[ok]
-                mc = mc[ok]
-                base = base[ok]
-            self.keys[victims] = mu
-            self.errs[victims] = base
-            self.counts[victims] = base + mc
-
-    def top(self, k: int = 8) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(gids, counts, errs) of the top-k tracked keys, count-desc."""
-        live = np.nonzero(self.keys >= 0)[0]
-        if len(live) == 0:
-            e = np.empty(0, dtype=np.int64)
-            return e, e.copy(), e.copy()
-        order = live[np.argsort(-self.counts[live], kind="stable")][:k]
-        return (
-            self.keys[order].copy(),
-            self.counts[order].copy(),
-            self.errs[order].copy(),
-        )
-
-    def reset(self) -> None:
-        """Drop all tracked keys (a re-intern invalidated the gid space);
-        the sketch re-warms from subsequent traffic."""
-        self.keys.fill(-1)
-        self.counts.fill(0)
-        self.errs.fill(0)
-        self.total = 0
-        self._since_decay = 0
-
-
-# -- HyperLogLog cardinality ---------------------------------------------
-
-
-def _mix64(x: np.ndarray) -> np.ndarray:
-    """splitmix64 finalizer, vectorized (uint64 wraparound arithmetic)."""
-    z = x + np.uint64(0x9E3779B97F4A7C15)
-    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    return z ^ (z >> np.uint64(31))
-
-
-class Hll:
-    """HyperLogLog over dense int gids; standard error 1.04/sqrt(2**p).
-
-    The register update is one vectorized hash + scatter-max.  The rank
-    (leading-zero count) of the low ``64-p`` bits comes from
-    ``floor(log2)`` on float64 — exact ONLY while ``64-p <= 52`` bits
-    fit the double mantissa, so p is restricted to >= 12 (a 56-bit word
-    at p=8 can round up across a power of two and bias a register low).
-    Default p=12: 4096 one-byte registers, ~1.6% standard error.
-    """
-
-    __slots__ = ("p", "m", "registers", "_wmask", "_alpha")
-
-    def __init__(self, p: int = 12) -> None:
-        if not 12 <= p <= 16:
-            raise ValueError(
-                "Hll precision p must be in [12, 16] (the float64 "
-                "log2 rank is only exact for 64-p <= 52 bits)"
-            )
-        self.p = p
-        self.m = 1 << p
-        self.registers = np.zeros(self.m, dtype=np.uint8)
-        self._wmask = np.uint64((1 << (64 - p)) - 1)
-        self._alpha = 0.7213 / (1.0 + 1.079 / self.m)
-
-    def update(self, gids: np.ndarray) -> None:
-        g = np.asarray(gids)
-        if len(g) == 0:
-            return
-        h = _mix64(g.astype(np.uint64))
-        idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
-        w = h & self._wmask
-        width = 64 - self.p
-        rho = np.full(len(h), width + 1, dtype=np.uint8)
-        nz = w > np.uint64(0)
-        rho[nz] = (
-            width - np.floor(np.log2(w[nz].astype(np.float64)))
-        ).astype(np.uint8)
-        np.maximum.at(self.registers, idx, rho)
-
-    def estimate(self) -> float:
-        regs = self.registers.astype(np.float64)
-        est = self._alpha * self.m * self.m / float(np.sum(np.exp2(-regs)))
-        zeros = int(np.count_nonzero(self.registers == 0))
-        if est <= 2.5 * self.m and zeros:
-            # small-range (linear counting) correction
-            return self.m * math.log(self.m / zeros)
-        return est
-
-    def reset(self) -> None:
-        self.registers.fill(0)
 
 
 # -- the per-operator watch ----------------------------------------------

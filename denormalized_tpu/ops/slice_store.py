@@ -28,6 +28,8 @@ independent and kill/restore emission comparisons exact.
 
 from __future__ import annotations
 
+from time import perf_counter
+
 import numpy as np
 
 from denormalized_tpu.ops.segment_agg import AggComponent
@@ -104,17 +106,29 @@ class SliceStore:
     serves every window spec folding from it."""
 
     def __init__(
-        self, components, unit_ms: int, *, force_sort_lane: bool = False
+        self,
+        components,
+        unit_ms: int,
+        *,
+        force_sort_lane: bool = False,
+        sketches=(),
     ) -> None:
         if unit_ms <= 0:
             raise ValueError(f"slice unit must be positive, got {unit_ms}")
         self.components = tuple(components)
+        #: SketchSpec layouts riding this store's slice units — frozen at
+        #: construction so every unit (and every restore) carries the
+        #: same planes; see ops/sketches.py
+        self.sketches = tuple(sketches)
         self.unit_ms = int(unit_ms)
         # unit -> {component label -> (capacity,) array}
         self._units: dict[int, dict[str, np.ndarray]] = {}
         self._cap = 0
         self.rows_accumulated = 0
+        self.sketch_rows = 0
+        self.sketch_update_s = 0.0
         self._itemsize_total = 8 * len(self.components)
+        self._comp_labels = frozenset(c.label for c in self.components)
         # add-only component sets (counts + sums, no extrema) take the
         # sort-free bincount lane in accumulate(); min/max need ordered
         # segments, so their presence keeps the lexsort lane.
@@ -122,8 +136,12 @@ class SliceStore:
         # group whose component UNION carries extrema always sorts, so
         # an add-only member's independent byte-identity oracle must be
         # able to match that lane (EngineConfig(slice_sort_lane=True)).
-        self._add_only = not force_sort_lane and all(
-            c.kind in ("count", "sum") for c in self.components
+        # Sketch planes always sort: their per-cell update sequences
+        # must be a pure function of the (unit, gid) segment order.
+        self._add_only = (
+            not force_sort_lane
+            and not self.sketches
+            and all(c.kind in ("count", "sum") for c in self.components)
         )
 
     # -- accounting ------------------------------------------------------
@@ -142,7 +160,23 @@ class SliceStore:
         return self._cap
 
     def nbytes(self) -> int:
-        return len(self._units) * self._cap * self._itemsize_total
+        return (
+            len(self._units) * self._cap * self._itemsize_total
+            + self.sketch_nbytes()
+        )
+
+    def sketch_nbytes(self) -> int:
+        """Exact bytes held by sketch planes across live units — O(1) in
+        value cardinality by construction (the doctor reports this next
+        to the unbounded exact-accumulator growth it replaces)."""
+        if not self.sketches:
+            return 0
+        total = 0
+        for slot in self._units.values():
+            for label, arr in slot.items():
+                if label not in self._comp_labels:
+                    total += arr.nbytes
+        return total
 
     def live_units(self) -> list[int]:
         return sorted(self._units)
@@ -160,6 +194,16 @@ class SliceStore:
                 )
                 arr[: len(old)] = old
                 slot[comp.label] = arr
+            for spec in self.sketches:
+                for label in [k for k in slot if spec.owns(k)]:
+                    old = slot[label]
+                    arr = np.full(
+                        (new_cap,) + old.shape[1:],
+                        spec.fill_for(label),
+                        dtype=old.dtype,
+                    )
+                    arr[: old.shape[0]] = old
+                    slot[label] = arr
         self._cap = new_cap
 
     def _new_unit(self) -> dict[str, np.ndarray]:
@@ -169,6 +213,8 @@ class SliceStore:
             slot[comp.label] = np.full(
                 self._cap, _fill_value(comp), dtype=init.dtype
             )
+        for spec in self.sketches:
+            slot.update(spec.init_planes(self._cap))
         return slot
 
     # -- hot path: per-batch accumulation --------------------------------
@@ -181,6 +227,7 @@ class SliceStore:
         ngroups: int,
         *,
         order: np.ndarray | None = None,
+        aux: dict[int, np.ndarray] | None = None,
     ) -> int:
         """Fold one batch's rows into their slice partials.  ``units``
         are slide-unit indices (``ts // unit_ms``), ``gids`` dense group
@@ -197,6 +244,12 @@ class SliceStore:
         sequences (and hence the reduceat bits) are identical to
         sorting the subset directly — the shared pipeline exploits this
         to pay ONE sort per batch across every residual filter class.
+
+        ``aux`` carries per-row sketch source lanes keyed by value
+        column: uint64 stable hashes (HLL) or dense value-interner ids
+        (top-K), indexed by the same batch row positions as
+        ``values64``.  Required when the store carries a spec whose
+        ``uses`` is not ``"f64"``.
         Returns the number of distinct slice segments touched."""
         n = len(units) if order is None else len(order)
         if n == 0:
@@ -274,6 +327,26 @@ class SliceStore:
                     arr[g] = np.minimum(arr[g], seg)
                 else:
                     arr[g] = np.maximum(arr[g], seg)
+            if self.sketches:
+                # rows of this unit, in segment (gid-ascending) order —
+                # the per-cell sequences every sketch kernel requires
+                ts = perf_counter()
+                r0 = int(starts[lo])
+                r1 = int(starts[hi]) if hi < len(starts) else n
+                rows = order[r0:r1]
+                g_rows = gids[rows]
+                for spec in self.sketches:
+                    if spec.uses == "f64":
+                        col = values64[rows, spec.vcol]
+                    else:
+                        col = aux[spec.vcol][rows]
+                    spec.accumulate_unit(
+                        slot, cap, g_rows, col,
+                        colvalid[rows, spec.vcol],
+                    )
+                self.sketch_update_s += perf_counter() - ts
+        if self.sketches:
+            self.sketch_rows += n
         self.rows_accumulated += n
         return len(seg_u)
 
@@ -340,10 +413,15 @@ class SliceStore:
             slot = present[0]
             for comp in self.components:
                 out[comp.label] = slot[comp.label].copy()
-            return out
-        for comp in self.components:
-            stack = np.stack([slot[comp.label] for slot in present])
-            out[comp.label] = fold_slices(comp.kind, stack)
+        else:
+            for comp in self.components:
+                stack = np.stack([slot[comp.label] for slot in present])
+                out[comp.label] = fold_slices(comp.kind, stack)
+        # sketch planes merge across units in ascending unit order — a
+        # pure function of the feed, so shared / independent / restored
+        # runs fold identical bits
+        for spec in self.sketches:
+            out.update(spec.fold(present, self._cap))
         return out
 
     # -- retention -------------------------------------------------------
@@ -366,6 +444,12 @@ class SliceStore:
         for u, slot in self._units.items():
             for comp in self.components:
                 out[f"u{u}|{comp.label}"] = slot[comp.label][:ngroups]
+            if self.sketches:
+                # sketch planes (incl. dynamically allocated quantile
+                # levels) trim to the live gid prefix on axis 0
+                for label, arr in slot.items():
+                    if label not in self._comp_labels:
+                        out[f"u{u}|{label}"] = arr[:ngroups]
         return out
 
     def restore_arrays(
@@ -384,4 +468,11 @@ class SliceStore:
             if slot is None:
                 slot = self._new_unit()
                 self._units[u] = slot
+            if label not in slot:
+                # dynamically allocated sketch plane (quantile level):
+                # ask the owning spec for a fresh full-capacity array
+                for spec in self.sketches:
+                    if spec.owns(label):
+                        slot[label] = spec.alloc_label(label, self._cap)
+                        break
             slot[label][: len(arr)] = arr

@@ -48,6 +48,7 @@ from denormalized_tpu.common.errors import PlanError
 from denormalized_tpu.common.record_batch import RecordBatch
 from denormalized_tpu.common.schema import DataType, Field, Schema
 from denormalized_tpu.logical.expr import (
+    SKETCH_AGG_KINDS,
     VAR_KINDS,
     AggregateExpr,
     Column as _ColExpr,
@@ -69,9 +70,14 @@ from denormalized_tpu.physical.window_exec import (
     window_output_low_watermark,
 )
 
-#: aggregate kinds whose windows fold exactly from slice partials
+#: aggregate kinds whose windows fold exactly from slice partials —
+#: the sketch kinds fold within their documented error bounds via
+#: mergeable sketch planes (ops/sketches.py), sharing like any other
+#: foldable aggregate (subsumption groups, shared joins, live attach)
 FOLDABLE_KINDS = frozenset(
-    ("count", "sum", "min", "max", "avg") + tuple(VAR_KINDS)
+    ("count", "sum", "min", "max", "avg")
+    + tuple(VAR_KINDS)
+    + tuple(SKETCH_AGG_KINDS)
 )
 
 
@@ -97,6 +103,9 @@ class SliceSubscriber:
     # value-column space, and the output schema
     agg_specs: list = field(default_factory=list)
     schema: Schema | None = None
+    #: any agg spec is a ("sketch", …) entry — the emit path splits
+    #: finalization between scalar components and sketch planes
+    has_sketch: bool = False
 
 
 class SubscriberBatch:
@@ -206,6 +215,14 @@ class SliceWindowExec(ExecOperator):
         self._value_transforms: list[str | None] = []
         self._var_shift: dict[str, float] = {}
         self._value_keys: dict = {}
+        # sketch specs deduped across subscribers by (kind, value col,
+        # params): two queries asking approx_distinct(v) share ONE HLL
+        # plane, like any other deduped component.  Insertion order
+        # assigns sids, so shared and restored runs label planes alike.
+        self._sketch_specs: dict[tuple, object] = {}
+        # dense value-id interner for approx_top_k lanes (lazy — only
+        # pipelines carrying a top-k sketch pay for it)
+        self._vid_interner: GroupInterner | None = None
 
         unit = 0
         for sub in self._subs:
@@ -330,6 +347,14 @@ class SliceWindowExec(ExecOperator):
         self._obs_mq_backfill = obs.counter("dnz_mq_backfill_windows_total")
         self._obs_refilter_ms = obs.histogram("dnz_mq_refilter_ms")
         self._obs_mq_live.set(len(self._subs))
+        # sketch-plane instruments (rows through sketch kernels, exact
+        # plane bytes, per-batch kernel time) — per-batch deltas of the
+        # stores' own counters, summed over filter classes
+        self._obs_sketch_rows = obs.counter("dnz_sketch_rows_total")
+        self._obs_sketch_bytes = obs.gauge("dnz_sketch_state_bytes")
+        self._obs_sketch_ms = obs.histogram("dnz_sketch_update_ms")
+        self._sketch_rows_seen = 0
+        self._sketch_upd_seen = 0.0
 
     # -- subscriber / filter-class plumbing ------------------------------
     @property
@@ -381,6 +406,8 @@ class SliceWindowExec(ExecOperator):
                 )
             if a.arg is None:
                 specs.append((a.kind, None))
+            elif a.kind in SKETCH_AGG_KINDS:
+                specs.append(self._sketch_spec_for(a, col_idx, grow))
             elif a.kind in sa.VAR_KINDS:
                 specs.append(
                     (
@@ -392,6 +419,7 @@ class SliceWindowExec(ExecOperator):
             else:
                 specs.append((a.kind, col_idx(a.arg, None)))
         sub.agg_specs = specs
+        sub.has_sketch = any(s[0] == "sketch" for s in specs)
         fields = [g.out_field(in_schema) for g in self.group_exprs]
         fields += [a.out_field(in_schema) for a in sub.aggr_exprs]
         fields += [
@@ -408,6 +436,49 @@ class SliceWindowExec(ExecOperator):
             ),
         ]
         sub.schema = Schema(fields)
+
+    def _sketch_spec_for(self, a: AggregateExpr, col_idx, grow: bool) -> tuple:
+        """Resolve one sketch aggregate to its (deduped) SketchSpec and
+        value lane.  Specs dedup by (family, value column, params) —
+        concurrent queries asking the same sketch over the same column
+        share one plane per slice cell.  With ``grow=False`` (live
+        attach) a spec the group never planned raises: sketch planes
+        exist per slice unit from the unit's creation, so a mid-stream
+        joiner can only ride planes already maintained."""
+        from denormalized_tpu.ops import sketches as skx
+
+        if a.kind == "approx_distinct":
+            vcol = col_idx(a.arg, "hash")
+            key = ("hll", vcol, ())
+            q = None
+        elif a.kind == "approx_top_k":
+            k = int(a.params[0]) if a.params else 10
+            vcol = col_idx(a.arg, "vid")
+            key = ("topk", vcol, (k,))
+            q = None
+        else:  # approx_percentile_cont / approx_median
+            q = float(a.params[0]) if a.params else 0.5
+            vcol = col_idx(a.arg, None)
+            key = ("kll", vcol, ())
+        spec = self._sketch_specs.get(key)
+        if spec is None:
+            if not grow:
+                raise PlanError(
+                    f"subscriber aggregate {a.kind}({a.arg!r}) needs a "
+                    "sketch plane the shared group does not maintain — "
+                    "attach requires sketches the group already plans"
+                )
+            sid = f"sk{len(self._sketch_specs)}"
+            if key[0] == "hll":
+                spec = skx.HllSpec(sid, vcol)
+            elif key[0] == "topk":
+                spec = skx.TopKSpec(sid, vcol, key[2][0])
+            else:
+                spec = skx.KllSpec(sid, vcol)
+            self._sketch_specs[key] = spec
+        if q is None:
+            return ("sketch", vcol, spec)
+        return ("sketch", vcol, spec, q)
 
     def _class_for(self, sub: SliceSubscriber) -> _FilterClass:
         """Find or create the filter class for one subscriber's
@@ -434,6 +505,7 @@ class SliceWindowExec(ExecOperator):
             # order is capacity-independent (oracle pins
             # EngineConfig(slice_sort_lane=True) to match)
             force_sort_lane=self._force_sort_lane or bool(sig),
+            sketches=tuple(self._sketch_specs.values()),
         )
         cls = _FilterClass(sig, sub.filter_expr, gid_lane, store)
         self._classes.append(cls)
@@ -727,13 +799,29 @@ class SliceWindowExec(ExecOperator):
             1 if self._max_ts is not None else 0
         )
         store_bytes = sum(c.store.nbytes() for c in self._classes)
+        # the approx_top_k value→vid interner is NOT a sketch plane: it
+        # grows with distinct VALUES (one dict entry + boxed key each),
+        # the one cardinality-linear structure on the sketch lane —
+        # account it like any other interned key so budget/growth
+        # verdicts see it (docs/approx_aggregates.md)
+        vid_keys = (
+            len(self._vid_interner) if self._vid_interner is not None else 0
+        )
         units = self._store.live_units()
         oldest = units[0] * self.unit_ms if units else None
         wm = self._watermark_ms
         info = {
             "op": "slice_window",
-            "state_bytes": store_bytes + live_keys * swm.KEY_EST_BYTES,
+            "state_bytes": store_bytes
+            + (live_keys + vid_keys) * swm.KEY_EST_BYTES,
+            "vid_interner_keys": vid_keys,
             "slice_store_bytes": store_bytes,
+            # exact sketch-plane bytes (already inside state_bytes via
+            # the stores' nbytes) — O(1) per gid in value cardinality,
+            # the doctor's contrast to unbounded exact accumulators
+            "sketch_bytes": sum(
+                c.store.sketch_nbytes() for c in self._classes
+            ),
             "live_keys": live_keys,
             "slot_capacity": int(self._store.capacity),
             "slot_live": live_keys,
@@ -796,18 +884,35 @@ class SliceWindowExec(ExecOperator):
     # -- per-batch processing --------------------------------------------
     def _eval_values(
         self, batch: RecordBatch, n: int
-    ) -> tuple[np.ndarray, np.ndarray]:
+    ) -> tuple[np.ndarray, np.ndarray, dict[int, np.ndarray]]:
         from denormalized_tpu.logical.expr import column_validity
 
         V = max(len(self._value_exprs), 1)
         values64 = np.zeros((n, V), dtype=np.float64)
         colvalid = np.ones((n, V), dtype=bool)
+        aux: dict[int, np.ndarray] = {}
         for j, e in enumerate(self._value_exprs):
+            tr = self._value_transforms[j]
+            if tr in ("hash", "vid"):
+                # sketch source lanes: never forced through float64 (a
+                # string column would not survive the cast, and an
+                # int64 beyond 2^53 would lose identity).  The f64
+                # matrix column stays 0 — no scalar component reads it.
+                m = column_validity(e, batch)
+                if m is not None:
+                    colvalid[:, j] = m
+                col = e.eval(batch)
+                if tr == "hash":
+                    from denormalized_tpu.ops.sketches import stable_hash64
+
+                    aux[j] = stable_hash64(col, m)
+                else:
+                    aux[j] = self._intern_vids(col, m, n)
+                continue
             raw = np.asarray(e.eval(batch), dtype=np.float64)
             m = column_validity(e, batch)
             if m is not None:
                 colvalid[:, j] = m
-            tr = self._value_transforms[j]
             if tr is not None:
                 # variance pivot shift: identical rule to
                 # StreamingWindowExec — the first finite valid value ever
@@ -827,7 +932,32 @@ class SliceWindowExec(ExecOperator):
                 if tr == "shift_sq":
                     raw = raw * raw
             values64[:, j] = raw
-        return values64, colvalid
+        return values64, colvalid, aux
+
+    def _intern_vids(
+        self, col, valid: np.ndarray | None, n: int
+    ) -> np.ndarray:
+        """Dense value ids for an approx_top_k lane: the exec-owned
+        single-column interner assigns ids in first-seen order over the
+        SHARED (base-predicate) row stream, so every subscriber's
+        summary speaks the same id space and ``keys_of`` recovers the
+        original values at emission.  Invalid rows get id 0 and are
+        masked out by ``colvalid`` before the sketch kernel runs."""
+        if self._vid_interner is None:
+            self._vid_interner = GroupInterner(1)
+        out = np.zeros(n, dtype=np.int64)
+        if valid is None:
+            out[:] = self._vid_interner.intern([col])
+        else:
+            idx = np.flatnonzero(valid)
+            if len(idx):
+                sub = (
+                    col.take(idx)
+                    if hasattr(col, "take")
+                    else np.asarray(col)[idx]
+                )
+                out[idx] = self._vid_interner.intern([sub])
+        return out
 
     def _process_batch(self, batch: RecordBatch) -> Iterator:
         n = batch.num_rows
@@ -905,7 +1035,7 @@ class SliceWindowExec(ExecOperator):
             gid = np.zeros(n, dtype=np.int32)
             ngroups = 1
         self._sw.update(gid)
-        values64, colvalid = self._eval_values(batch, n)
+        values64, colvalid, aux = self._eval_values(batch, n)
 
         # residual re-filter masks, one per filter class, computed over
         # the FULL batch (row-lane predicates need batch alignment)
@@ -940,6 +1070,7 @@ class SliceWindowExec(ExecOperator):
                 gid = gid[keep]
                 values64 = values64[keep]
                 colvalid = colvalid[keep]
+                aux = {j: a[keep] for j, a in aux.items()}
                 masks = [m if m is None else m[keep] for m in masks]
         # shared ingest cost (intern + sketch + value eval + masks)
         # splits evenly; per-class accumulate cost charges that class's
@@ -967,7 +1098,7 @@ class SliceWindowExec(ExecOperator):
                             order_full = shared_sort_order(units, gid)
                         cls.store.accumulate(
                             units, gid, values64, colvalid, ngroups,
-                            order=order_full,
+                            order=order_full, aux=aux,
                         )
                     rows = len(units)
                 else:
@@ -978,7 +1109,7 @@ class SliceWindowExec(ExecOperator):
                     o_sub = masked_sorted_order(order_full, m)
                     cls.store.accumulate(
                         units, gid, values64, colvalid, ngroups,
-                        order=o_sub,
+                        order=o_sub, aux=aux,
                     )
                     rows = len(o_sub)
                 if ci == 0:
@@ -992,6 +1123,18 @@ class SliceWindowExec(ExecOperator):
                     share = cls_ms / len(owners)
                     for q in owners:
                         self._sub_cost_ms[q] += share
+            if self._sketch_specs:
+                rows_t = sum(c.store.sketch_rows for c in self._classes)
+                upd_t = sum(c.store.sketch_update_s for c in self._classes)
+                self._obs_sketch_rows.add(rows_t - self._sketch_rows_seen)
+                self._obs_sketch_ms.observe(
+                    (upd_t - self._sketch_upd_seen) * 1e3
+                )
+                self._sketch_rows_seen = rows_t
+                self._sketch_upd_seen = upd_t
+                self._obs_sketch_bytes.set(
+                    sum(c.store.sketch_nbytes() for c in self._classes)
+                )
 
         if not self._src_watermarks:
             if self._watermark_ms is None or ts_min > self._watermark_ms:
@@ -1052,7 +1195,15 @@ class SliceWindowExec(ExecOperator):
             self._sub_cost_ms[q] += (time.perf_counter() - t0) * 1e3
             return None
         gids = np.nonzero(active)[0].astype(np.int32)
-        finals = sa.finalize(sub.agg_specs, rows, active)
+        if sub.has_sketch:
+            finals = [
+                self._finalize_sketch(s, rows, gids)
+                if s[0] == "sketch"
+                else sa.finalize([s], rows, active)[0]
+                for s in sub.agg_specs
+            ]
+        else:
+            finals = sa.finalize(sub.agg_specs, rows, active)
         batch = self._assemble_emission(sub, j, gids, finals)
         if self._obs_mq_emit_lag[q]:
             self._obs_mq_emit_lag[q].set(
@@ -1065,6 +1216,34 @@ class SliceWindowExec(ExecOperator):
         if self._tagged:
             return SubscriberBatch(sub.tag, batch)
         return batch
+
+    def _finalize_sketch(
+        self, spec_t: tuple, rows: dict, gids: np.ndarray
+    ) -> np.ndarray:
+        """Finalize one sketch aggregate's column for the active gids of
+        an emitted window from the folded sketch planes."""
+        spec = spec_t[2]
+        if spec.kind == "hll":
+            return spec.finalize(rows, gids)
+        if spec.kind == "kll":
+            return spec.finalize_quantile(rows, gids, spec_t[3])
+        # topk: per-gid [[value, count], …] rows, count-desc — value ids
+        # translate back through the exec's value interner
+        ka = rows[f"{spec.sid}|k"]
+        ca = rows[f"{spec.sid}|c"]
+        ea = rows[f"{spec.sid}|e"]
+        out = np.empty(len(gids), dtype=object)
+        for i, gi in enumerate(np.asarray(gids).tolist()):
+            vids, cnts, _errs = spec.cell_top(ka[gi], ca[gi], ea[gi])
+            if len(vids):
+                kv = self._vid_interner.keys_of(vids.astype(np.int64))[0]
+                vals = np.asarray(kv).tolist()
+            else:
+                vals = []
+            out[i] = [
+                [v, int(c)] for v, c in zip(vals, cnts.tolist())
+            ]
+        return out
 
     def _assemble_emission(
         self, sub: SliceSubscriber, j: int, gids: np.ndarray, finals: list
@@ -1080,7 +1259,12 @@ class SliceWindowExec(ExecOperator):
                 cols.append(kv)
         for a, arr in zip(sub.aggr_exprs, finals):
             f = a.out_field(in_schema)
-            cols.append(np.asarray(arr).astype(f.dtype.to_numpy()))
+            arr = np.asarray(arr)
+            if f.dtype.is_numeric:
+                # LIST outputs (approx_top_k) stay object arrays — same
+                # rule UdafWindowExec applies to non-numeric finals
+                arr = arr.astype(f.dtype.to_numpy())
+            cols.append(arr)
         m = len(gids)
         start = np.full(m, j * sub.slide_ms, dtype=np.int64)
         end = np.full(
@@ -1139,6 +1323,13 @@ class SliceWindowExec(ExecOperator):
             "var_shift": dict(self._var_shift),
             "ngroups": ngroups,
             "interner": self._interner.snapshot() if self._grouped else None,
+            # top-k value-id space: ids are first-seen-order dense, so
+            # the summaries in the planes are meaningless without it
+            "vid_interner": (
+                self._vid_interner.snapshot()
+                if self._vid_interner is not None
+                else None
+            ),
             # live-registration payload: per-subscriber identity records
             # (tag + filter signature + join cursor) and the per-class
             # array layout — restore matches cursors by TAG, never by
@@ -1191,6 +1382,9 @@ class SliceWindowExec(ExecOperator):
         self._src_watermarks = bool(meta.get("src_watermarks"))
         self._max_ts = meta["max_ts"]
         self._var_shift = dict(meta.get("var_shift") or {})
+        vsnap = meta.get("vid_interner")
+        if vsnap is not None:
+            self._vid_interner = GroupInterner.restore(vsnap)
         self._first_ts = meta.get("first_ts")
         efu = meta.get("exact_floor_unit")
         self._exact_floor_unit = None if efu is None else int(efu)

@@ -149,14 +149,32 @@ class _UdafTier:
         from denormalized_tpu.obs import statewatch as swm
 
         op = self.op
-        # list() copy: this may run on another operator's thread while
-        # the udaf thread inserts/pops frames (controller-summed)
-        groups = sum(len(f) for f in list(op._frames.values()))
-        resident = groups - self.spilled_groups
-        n_aggs = max(len(op.aggr_exprs), 1)
+        # real accumulator sizes (state_nbytes where implemented —
+        # unbounded collectors like exact median/count-distinct report
+        # their TRUE growth, so spill pressure tracks reality instead
+        # of a flat 512-byte estimate); list() copies because this may
+        # run on another operator's thread while the udaf thread
+        # inserts/pops frames (controller-summed)
+        acc_bytes = 0
+        try:
+            for f in list(op._frames.values()):
+                for accs in list(f.values()):
+                    if accs is SPILLED:
+                        continue
+                    for acc in accs:
+                        acc_bytes += swm.acc_nbytes(acc)
+        except RuntimeError:
+            # torn read mid-mutation: fall back to the flat estimate
+            # for this sample — the next controller tick re-reads
+            groups = sum(len(f) for f in list(op._frames.values()))
+            acc_bytes = (
+                (groups - self.spilled_groups)
+                * max(len(op.aggr_exprs), 1)
+                * swm.ACC_EST_BYTES
+            )
         keys = len(op._interner) if op._interner is not None else 0
         return (
-            resident * n_aggs * swm.ACC_EST_BYTES
+            acc_bytes
             + keys * swm.KEY_EST_BYTES
             + len(op._frames) * 64
         )
@@ -221,12 +239,18 @@ class _UdafTier:
             self.ctrl.relax(self.node_id)
             return
         op = self.op
-        # live resident groups per gid (slow path: spill cadence only)
+        # live resident groups + REAL bytes per gid (slow path: spill
+        # cadence only) — evicting by true size frees the budget in as
+        # few blocks as possible when accumulator growth is skewed
         per_gid: dict[int, int] = {}
+        per_gid_bytes: dict[int, int] = {}
         for frame in op._frames.values():
             for g, accs in frame.items():
                 if accs is not SPILLED:
                     per_gid[g] = per_gid.get(g, 0) + 1
+                    per_gid_bytes[g] = per_gid_bytes.get(g, 0) + sum(
+                        swm.acc_nbytes(a) for a in accs
+                    )
         self._ensure_maps(self._capacity())
         protect = np.zeros(len(self._block_of), dtype=bool)
         protect[protect_gids] = True
@@ -236,10 +260,10 @@ class _UdafTier:
         spilled_any = False
         if len(cand):
             cand = self.cold.order_cold(cand)
-            n_aggs = max(len(op.aggr_exprs), 1)
-            per_entry = n_aggs * swm.ACC_EST_BYTES
             counts = np.asarray([per_gid[int(g)] for g in cand])
-            csum = np.cumsum(counts) * per_entry
+            csum = np.cumsum(
+                np.asarray([per_gid_bytes[int(g)] for g in cand])
+            )
             k = int(np.searchsorted(csum, need)) + 1
             k = min(k, len(cand))
             # chunk into blocks of <= SPILL_BLOCK_SLOTS entries
@@ -527,14 +551,19 @@ class UdafWindowExec(ExecOperator):
 
         frames = self._frames
         groups_total = 0
+        acc_bytes = 0
         live_gids: set[int] = set()
         for f in list(frames.values()):
             # spilled markers keep their dict entries but their
             # accumulators live in the LSM — resident accounting skips
             # them (reported separately as spilled_keys/bytes)
-            resident = [g for g, a in f.items() if a is not SPILLED]
-            groups_total += len(resident)
-            live_gids.update(resident)
+            for g, accs in list(f.items()):
+                if accs is SPILLED:
+                    continue
+                groups_total += 1
+                live_gids.add(g)
+                for acc in accs:
+                    acc_bytes += swm.acc_nbytes(acc)
         n_aggs = len(self.aggr_exprs)
         live_keys = len(live_gids)
         acc_objs = groups_total * n_aggs
@@ -547,10 +576,15 @@ class UdafWindowExec(ExecOperator):
         info = {
             "op": "udaf",
             # frames hold opaque Python accumulators: counts are exact,
-            # bytes use the documented per-object estimates (restore-
-            # invariant — see docs/observability.md)
+            # bytes come from each accumulator's own state_nbytes()
+            # (restore-invariant, derived from element counts — see
+            # docs/observability.md); accumulators without one fall
+            # back to the documented flat estimate.  Unbounded exact
+            # collectors (median, count_distinct) therefore report
+            # REAL growth — the doctor's state verdicts and the spill
+            # controller's pressure act on it.
             "state_bytes": (
-                acc_objs * swm.ACC_EST_BYTES
+                acc_bytes
                 + live_keys * swm.KEY_EST_BYTES
                 + len(frames) * 64
             ),

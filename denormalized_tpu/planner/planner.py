@@ -31,6 +31,48 @@ class Planner:
         # config: api.context.EngineConfig
         self.config = config
 
+    def _route_approx(self, node) -> list:
+        """Route approximate aggregates: on the slice path they stay
+        first-class sketch kinds (constant-state mergeable planes,
+        ops/sketches.py); everywhere else — sessions, the device ring,
+        default config, plans mixing true UDAFs, or
+        ``approx_native=False`` — each lowers to the exact accumulator
+        UDAF it historically was, preserving every prior behavior."""
+        from denormalized_tpu.logical.expr import (
+            SKETCH_AGG_KINDS,
+            AggregateExpr,
+        )
+
+        aggs = node.aggr_exprs
+        if not any(a.kind in SKETCH_AGG_KINDS for a in aggs):
+            return aggs
+        native = (
+            node.window_type is not lp.WindowType.SESSION
+            and self.config is not None
+            and getattr(self.config, "slice_windows", False)
+            and getattr(self.config, "approx_native", True)
+            and not getattr(self.config, "mesh_devices", None)
+            and not any(a.kind == "udaf" for a in aggs)
+        )
+        if native:
+            return aggs
+        lowered = []
+        for a in aggs:
+            if a.kind in SKETCH_AGG_KINDS:
+                if a.udaf is None:
+                    raise PlanError(
+                        f"approximate aggregate {a.name!r} has no "
+                        "accumulator fallback and the plan cannot take "
+                        "the slice path (sketch aggregates need "
+                        "EngineConfig(slice_windows=True) here)"
+                    )
+                lowered.append(
+                    AggregateExpr("udaf", a.arg, a._alias, a.udaf)
+                )
+            else:
+                lowered.append(a)
+        return lowered
+
     def create_physical_plan(self, node: lp.LogicalPlan) -> ExecOperator:
         # extension point: a logical node that knows how to build its own
         # exec (the cluster runtime's ExchangeScan leaf) builds it here —
@@ -60,6 +102,7 @@ class Planner:
             return FilterExec(child, node.predicate)
         if isinstance(node, lp.StreamingWindow):
             child = self.create_physical_plan(node.input)
+            aggr_exprs = self._route_approx(node)
             kwargs = {}
             if self.config is not None:
                 mesh = None
@@ -140,17 +183,17 @@ class Planner:
                 return SessionWindowExec(
                     child,
                     node.group_exprs,
-                    node.aggr_exprs,
+                    aggr_exprs,
                     gap_ms=node.length_ms,
                     emit_on_close=kwargs.get("emit_on_close", True),
                 )
-            if any(a.kind == "udaf" for a in node.aggr_exprs):
+            if any(a.kind == "udaf" for a in aggr_exprs):
                 from denormalized_tpu.physical.udaf_exec import UdafWindowExec
 
                 return UdafWindowExec(
                     child,
                     node.group_exprs,
-                    node.aggr_exprs,
+                    aggr_exprs,
                     node.window_type,
                     node.length_ms,
                     node.slide_ms,
@@ -176,7 +219,7 @@ class Planner:
                     node.group_exprs,
                     [
                         SliceSubscriber(
-                            node.aggr_exprs,
+                            aggr_exprs,
                             node.length_ms,
                             node.slide_ms or node.length_ms,
                         )
@@ -190,7 +233,7 @@ class Planner:
             return StreamingWindowExec(
                 child,
                 node.group_exprs,
-                node.aggr_exprs,
+                aggr_exprs,
                 node.window_type,
                 node.length_ms,
                 node.slide_ms,

@@ -573,3 +573,136 @@ def test_per_subscriber_emit_lag_gauge():
     assert any('query="beta"' in k for k in lag_series), lag_series
     # both queries emitted, so both gauges carry a real lag sample
     assert all(v != 0 for v in lag_series.values())
+
+
+# -- approximate aggregates on the shared path (ISSUE 18) -----------------
+
+APPROX_AGGS = [
+    F.approx_distinct(col("v")).alias("nd"),
+    F.approx_median(col("v")).alias("med"),
+    F.approx_top_k(col("v"), 3).alias("top"),
+    F.sum(col("v")).alias("s"),
+]
+APPROX_COLS = ("nd", "med", "top", "s")
+
+
+def _approx_batches(seed=41, n_batches=14, rows=400, n_keys=4):
+    # integer-valued v so approx_top_k sees real repeats
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 1000, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.integers(0, 60, rows).astype(np.float64)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _rows_of_approx(batch, acc, cols=APPROX_COLS):
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        row = []
+        for c in cols:
+            v = batch.column(c)[i]
+            row.append(
+                tuple(tuple(p) for p in v)
+                if isinstance(v, list)
+                else float(v)
+            )
+        acc[key] = tuple(row)
+
+
+def _run_single_approx(batches, L, S, cfg, aggs=APPROX_AGGS,
+                       cols=APPROX_COLS, filter_expr=None):
+    ctx = Context(cfg)
+    ds = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    if filter_expr is not None:
+        ds = ds.filter(filter_expr)
+    ds = ds.window(["k"], aggs, L, S)
+    out = {}
+    for b in ds.stream():
+        _rows_of_approx(b, out, cols)
+    return out
+
+
+def test_approx_shared_byte_identical_to_independent():
+    """Mixed exact+approx member set, equal predicates: every member's
+    emissions (including approx_top_k — equal-predicate members share
+    the value-id interner's exact assignment order) byte-identical to
+    an independent run pinned to the group's gcd unit."""
+    batches = _approx_batches()
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    specs = [(3000, 1000), (5000, 1000), (2000, 2000)]
+    outs = [dict() for _ in specs]
+    queries = [
+        (
+            base.window(["k"], APPROX_AGGS, L, S),
+            (lambda acc: (lambda b: _rows_of_approx(b, acc)))(outs[i]),
+        )
+        for i, (L, S) in enumerate(specs)
+    ]
+    report = run_queries(ctx, queries)
+    assert report["shared_queries"] == 3
+    for i, (L, S) in enumerate(specs):
+        ind = _run_single_approx(
+            batches, L, S,
+            EngineConfig(slice_windows=True, slice_unit_ms=1000),
+        )
+        assert outs[i] == ind  # EXACT — sketch estimates, topk, sum
+
+
+def test_approx_residual_member_byte_identical():
+    """Subsumption sharing with approx members: the residual member
+    (v > 20, ingesting under the weaker v > 5 base) folds HLL / KLL
+    planes byte-identical to its own independent filtered run — the
+    hash and f64 lanes are interner-free.  approx_top_k is deliberately
+    absent: a residual member's value-id space is assigned over the
+    BASE row stream, so its summary is bound-respecting but not
+    byte-comparable to an independent oracle's own interner order (see
+    docs/approx_aggregates.md)."""
+    aggs = [
+        F.approx_distinct(col("v")).alias("nd"),
+        F.approx_median(col("v")).alias("med"),
+        F.sum(col("v")).alias("s"),
+    ]
+    cols = ("nd", "med", "s")
+    batches = _approx_batches(seed=43)
+    ctx = Context(EngineConfig())
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    out_weak, out_strong = {}, {}
+    report = run_queries(ctx, [
+        (base.filter(col("v") > 5).window(["k"], aggs, 3000, 1000),
+         lambda b: _rows_of_approx(b, out_weak, cols)),
+        (base.filter(col("v") > 20).window(["k"], aggs, 3000, 1000),
+         lambda b: _rows_of_approx(b, out_strong, cols)),
+    ])
+    assert report["shared_queries"] == 2
+    oracle_cfg = lambda: EngineConfig(  # noqa: E731
+        slice_windows=True, slice_unit_ms=1000, slice_sort_lane=True
+    )
+    ind_weak = _run_single_approx(
+        batches, 3000, 1000, oracle_cfg(), aggs=aggs, cols=cols,
+        filter_expr=col("v") > 5,
+    )
+    ind_strong = _run_single_approx(
+        batches, 3000, 1000, oracle_cfg(), aggs=aggs, cols=cols,
+        filter_expr=col("v") > 20,
+    )
+    assert out_weak == ind_weak  # EXACT
+    assert out_strong == ind_strong  # EXACT

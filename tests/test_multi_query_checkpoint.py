@@ -242,3 +242,139 @@ def test_unshareable_query_negative_falls_back(tmp_path):
     assert len(fallback) == 1 and fallback[0]["members"] == [2]
     assert "session" in fallback[0]["reason"]
     assert a and b and sum(c) > 0
+
+
+# -- approximate aggregates across kill/restore (ISSUE 18) ----------------
+
+APPROX_AGGS = [
+    F.approx_distinct(col("v")).alias("nd"),
+    F.approx_median(col("v")).alias("med"),
+    F.approx_top_k(col("v"), 3).alias("top"),
+    F.sum(col("v")).alias("s"),
+]
+APPROX_COLS = ("nd", "med", "top", "s")
+
+
+def _approx_batches(seed=7, n_batches=24, rows=300, n_keys=4):
+    # integer-valued v so approx_top_k sees real repeats
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 500 + rng.integers(0, 500, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.integers(0, 50, rows).astype(np.float64)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _rows_of_approx(batch, acc):
+    for i in range(batch.num_rows):
+        key = (
+            batch.column("k")[i],
+            int(batch.column("window_start_time")[i]),
+            int(batch.column("window_end_time")[i]),
+        )
+        row = []
+        for c in APPROX_COLS:
+            v = batch.column(c)[i]
+            row.append(
+                tuple(tuple(p) for p in v)
+                if isinstance(v, list)
+                else float(v)
+            )
+        acc[key] = tuple(row)
+
+
+def _approx_shared_root(ctx, batches):
+    base = ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+    plans = [
+        base.window(["k"], APPROX_AGGS, L, S)._plan for (L, S) in SPECS
+    ]
+    groups = detect_sharing(plans)
+    assert len(groups) == 1 and groups[0].shared
+    return build_shared_root(ctx, groups[0])
+
+
+def test_approx_kill_restore_byte_identical(tmp_path):
+    """Sketch planes across a mid-window kill: HLL registers, KLL
+    compactor levels (dynamically allocated labels), Space-Saving
+    planes AND the value-id interner all ride the epoch snapshot, so
+    the union of pre-kill and post-restore emissions is byte-identical
+    per query to uninterrupted oracles — sketch estimates included."""
+    batches = _approx_batches()
+
+    oracles = []
+    for L, S in SPECS:
+        ctx = Context(EngineConfig(slice_windows=True, slice_unit_ms=500))
+        ds = ctx.from_source(
+            MemorySource.from_batches(batches, timestamp_column="ts"),
+            name="feed",
+        ).window(["k"], APPROX_AGGS, L, S)
+        out = {}
+        for b in ds.stream():
+            _rows_of_approx(b, out)
+        oracles.append(out)
+    assert all(len(o) for o in oracles)
+
+    state_dir = str(tmp_path / "state")
+
+    def make_cfg():
+        return EngineConfig(
+            checkpoint=True,
+            checkpoint_interval_s=9999,
+            state_backend_path=state_dir,
+        )
+
+    got = [dict() for _ in SPECS]
+    try:
+        ctx_a = Context(make_cfg())
+        root_a = _approx_shared_root(ctx_a, batches)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emissions = 0
+        committed = False
+        post_commit = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, SubscriberBatch):
+                _rows_of_approx(item.batch, got[item.tag])
+                emissions += 1
+                if committed:
+                    post_commit += 1
+                    if post_commit >= 9:
+                        break  # hard kill: mid-epoch progress lost
+            if emissions == 8 and not committed:
+                orch_a.trigger_now()
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                committed = True
+        it.close()
+        assert committed and post_commit >= 9
+        close_global_state_backend()
+
+        ctx_b = Context(make_cfg())
+        root_b = _approx_shared_root(ctx_b, batches)
+        orch_b = Orchestrator(interval_s=9999)
+        coord_b = wire_checkpointing(root_b, ctx_b, orch_b)
+        assert coord_b.committed_epoch is not None
+        for item in root_b.run():
+            if isinstance(item, SubscriberBatch):
+                _rows_of_approx(item.batch, got[item.tag])
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        close_global_state_backend()
+
+    for q in range(len(SPECS)):
+        assert set(got[q]) == set(oracles[q]), {
+            "query": q,
+            "missing": sorted(set(oracles[q]) - set(got[q]))[:4],
+            "extra": sorted(set(got[q]) - set(oracles[q]))[:4],
+        }
+        for k in oracles[q]:
+            assert got[q][k] == oracles[q][k], (q, k)

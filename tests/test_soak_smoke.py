@@ -5,7 +5,9 @@ SOAK_JOIN.json / SOAK_SESSION.json); this keeps the harness itself
 CI-validated: a ~20s run with one mid-stream SIGKILL must lose zero
 windows, match the golden, and see EOS — for the simple windowed
 pipeline, the stream-join pipeline (join state is the hardest
-checkpoint-restore path), and session windows (exact bounds checked).
+checkpoint-restore path), session windows (exact bounds checked), and
+the sketch-native approx pipeline (HLL estimates held to exact integer
+equality against a golden folded with the engine's own kernels).
 """
 
 import json
@@ -19,7 +21,8 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.mark.parametrize(
-    "pipeline", ["simple", "sliding", "join", "session", "udaf", "kafka"]
+    "pipeline", ["simple", "sliding", "join", "session", "udaf", "kafka",
+                 "approx"]
 )
 def test_soak_smoke(tmp_path, pipeline):
     out = tmp_path / "soak.json"

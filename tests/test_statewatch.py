@@ -964,3 +964,78 @@ def test_soak_telemetry_reports_peak_state_and_hot_keys(tmp_path):
     assert hot["segment"] == 0
     assert "celebrity" in hot["top_keys"][0]["series"]
     assert hot["top_keys"][0]["share"] == pytest.approx(0.5)
+
+
+def test_budget_pressure_verdict_on_exact_median_workload(
+    registry, monkeypatch
+):
+    """Satellite acceptance (ISSUE 18): an exact-median workload over a
+    FIXED group population grows without bound in values, not keys —
+    the old flat per-accumulator estimate was constant there, blinding
+    the doctor.  Real ``state_nbytes`` accounting must (a) report
+    growing state_bytes while live_keys stays fixed and (b) raise a
+    ``state-budget-pressure`` verdict against the udaf node once the
+    ring forecast projects budget exhaustion."""
+    from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+    from denormalized_tpu.logical.plan import WindowType
+    from denormalized_tpu.obs import doctor
+    from denormalized_tpu.physical.base import ExecOperator
+    from denormalized_tpu.physical.udaf_exec import UdafWindowExec
+
+    monkeypatch.setattr(statewatch, "_SAMPLE_MIN_INTERVAL_S", 0.0)
+    in_schema = Schema([
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.TIMESTAMP_MS,
+              nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ])
+
+    class _Leaf(ExecOperator):
+        schema = in_schema
+
+        def run(self):
+            return iter(())
+
+    op = UdafWindowExec(
+        _Leaf(), [col("k")], [F.median(col("v")).alias("m")],
+        WindowType.TUMBLING, 3_600_000, None,
+    )
+    handle = doctor.register_query(
+        op, config=EngineConfig(state_budget_bytes=2_000_000),
+        registry=registry,
+    )
+    try:
+        rng = np.random.default_rng(7)
+        bytes_seen, rows_total = [], 0
+        snap = None
+        for b in range(8):
+            rows = 4000
+            ts = np.sort(T0 + b * 400 + rng.integers(0, 400, rows))
+            ks = np.asarray(
+                [f"g{i}" for i in rng.integers(0, 8, rows)], object
+            )
+            batch = RecordBatch(
+                in_schema, [ts, ks, rng.normal(0, 1, rows)]
+            )
+            list(op._process_batch(batch))
+            rows_total += rows
+            time.sleep(0.05)
+            snap = handle.state_snapshot()
+            node = [n for n in snap["nodes"] if n["op"] == "udaf"][0]
+            bytes_seen.append(node["state_bytes"])
+            assert node["live_keys"] == 8  # fixed groups throughout
+        # real accounting: bytes grow with the VALUE population (the
+        # flat estimate was constant at fixed groups x aggs)
+        assert bytes_seen[-1] > bytes_seen[0]
+        assert bytes_seen == sorted(bytes_seen)
+        assert bytes_seen[-1] >= 8 * rows_total  # >= raw f64 payload
+        kinds = [v["kind"] for v in snap["verdicts"]]
+        assert "state-budget-pressure" in kinds, snap["verdicts"]
+        v = next(
+            x for x in snap["verdicts"]
+            if x["kind"] == "state-budget-pressure"
+        )
+        assert "udaf" in v["node_id"].lower() or v["node_id"], v
+        assert v["time_to_budget_s"] >= 0.0
+    finally:
+        handle.finish()

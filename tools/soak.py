@@ -9,7 +9,7 @@ The reference has no analog (its de-facto soak is "run the docker example
 and watch", SURVEY §4); a framework claiming checkpoint/restore parity
 should demonstrate it surviving repetition.
 
-    python tools/soak.py [--pipeline simple|sliding|join|session|udaf]
+    python tools/soak.py [--pipeline simple|sliding|join|session|udaf|approx]
                          [--minutes 12] [--pace 200000] [--kill-every 90]
                          [--out SOAK.json]
 
@@ -21,8 +21,12 @@ Design:
   closed-loop skew policy adapts the celebrity key live and SIGKILLs
   land mid-adaptation, docs/joins.md), ``session`` (300ms-gap session windows over a bursty
   feed: exact session bounds verified — the operator the reference
-  left ``todo!()``), or ``udaf`` (stateful Python accumulator on the
-  host-frame path: state()/merge() snapshots) — over a DETERMINISTIC
+  left ``todo!()``), ``udaf`` (stateful Python accumulator on the
+  host-frame path: state()/merge() snapshots), or ``approx``
+  (sketch-native approx_distinct on the slice store: the parent's
+  golden replays the HLL kernels from ops/sketches.py and demands
+  EXACT integer equality on every committed estimate,
+  docs/approx_aggregates.md) — over a DETERMINISTIC
   paced source whose
   batches are a pure function of the batch index (seeded RNG per batch),
   with checkpointing every 2s to a shared LSM dir.  The source implements
@@ -318,6 +322,60 @@ def golden_update(agg: dict, i: int, batch_rows: int, pace: float):
         ws * N_KEYS + keys, [(vals, [np.minimum, np.maximum, np.add])]
     )
     _merge_tumbling(agg, uniq, cnts, mins, maxs, sums)
+
+
+_SK_MOD = None
+
+
+def _sk():
+    """ops/sketches.py loaded by FILE PATH, not package import — the
+    sketch kernels are pure numpy by contract, and the parent must stay
+    jax-free (module docstring).  Importing denormalized_tpu here would
+    drag the whole engine (and jax) into the measuring process."""
+    global _SK_MOD
+    if _SK_MOD is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "denormalized_tpu", "ops", "sketches.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_soak_sketches", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _SK_MOD = mod
+    return _SK_MOD
+
+
+def golden_update_approx(agg: dict, i: int, batch_rows: int, pace: float):
+    """Fold batch i into {(ws, key): [cnt, hll_plane]} with the SAME
+    kernels the engine runs (stable_hash64 → hll_accumulate on a
+    single-row int8 plane).  The HLL scatter-max is associative and
+    commutative, so the parent's one-shot fold equals the child's
+    slice-split, kill-interrupted, restored fold register for register
+    — which is why the verify gate can demand EXACT integer equality
+    on the estimates instead of an epsilon band."""
+    sk = _sk()
+    ts, keys, vals = batch_arrays(i, batch_rows, pace, seed=SEED_LEFT)
+    ws = (ts // WINDOW_MS) * WINDOW_MS
+    hashes = sk.stable_hash64(vals)
+    comp = ws * N_KEYS + keys
+    order = np.argsort(comp, kind="stable")
+    uniq, starts = np.unique(comp[order], return_index=True)
+    ends = np.append(starts[1:], len(comp))
+    ho = hashes[order]
+    for u, s, e in zip(uniq.tolist(), starts.tolist(), ends.tolist()):
+        w, k = divmod(u, N_KEYS)
+        a = agg.setdefault(
+            (w, f"sensor_{k}"),
+            [0, np.zeros((1, 1 << sk.HLL_P), dtype=np.int8)],
+        )
+        a[0] += e - s
+        sk.hll_accumulate(
+            a[1], np.zeros(e - s, dtype=np.int64), ho[s:e]
+        )
 
 
 # -- skew-adaptive interval-join soak feed (ISSUE 15) --------------------
@@ -1193,6 +1251,29 @@ def child_main() -> None:
             ],
             WINDOW_MS,
         )
+    elif pipeline == "approx":
+        # sketch-native approximate aggregates on the slice store
+        # (docs/approx_aggregates.md): approx_distinct rides an HLL
+        # register plane whose scatter-max fold is associative and
+        # commutative, so the plane — and its integer estimate — is
+        # independent of how the feed was split across checkpoint
+        # segments.  The parent's golden replays the SAME kernels
+        # (ops/sketches.py loaded by file path; pure numpy, keeps the
+        # parent jax-free) and holds every committed estimate to EXACT
+        # integer equality through repeated SIGKILLs — the sketch
+        # restore path is bit-faithful or this gate goes red.
+        cfg.slice_windows = True
+        cfg.slice_unit_ms = SLIDE_MS  # kills land mid-window, mid-slice
+        ds = ctx.from_source(
+            SoakSource(SEED_LEFT, "soak_ax"), name="soak_ax"
+        ).window(
+            ["sensor_name"],
+            [
+                F.count(col("reading")).alias("count"),
+                F.approx_distinct(col("reading")).alias("distinct"),
+            ],
+            WINDOW_MS,
+        )
     elif pipeline == "bigstate":
         # larger-than-memory session state: phase A opens SOAK_BS_KEYS
         # singleton sessions (gap = the whole phase-A event span, so all
@@ -1540,6 +1621,16 @@ def child_main() -> None:
                         "avg_t": round(float(batch.column("avg_t")[i]), 4),
                         "avg_h": round(float(batch.column("avg_h")[i]), 4),
                     }
+                elif pipeline == "approx":
+                    # the estimate is an INT — no rounding tolerance;
+                    # the golden recomputes it with the same kernels
+                    rec = {
+                        "t": round(now, 3),
+                        "ws": int(ws[i]),
+                        "key": str(names[i]),
+                        "count": int(batch.column("count")[i]),
+                        "distinct": int(batch.column("distinct")[i]),
+                    }
                 else:
                     rec = {
                         "t": round(now, 3),
@@ -1681,6 +1772,8 @@ def read_emissions(paths):
                     o["avg"], o["ws"], o["we"])
         elif "spread" in o:  # udaf record
             vals = (o["count"], o["spread"])
+        elif "distinct" in o:  # approx record: exact integer estimate
+            vals = (o["count"], o["distinct"])
         else:
             vals = (o["count"], o["min"], o["max"], o["avg"])
         # segment attribution rides along for diagnosis but stays OUT
@@ -2431,8 +2524,8 @@ def main():
     ap.add_argument("--kill-every", type=float, default=90.0)
     ap.add_argument("--pipeline",
                     choices=("simple", "sliding", "join", "session",
-                             "udaf", "kafka", "bigstate", "cluster",
-                             "query_dense", "join_dense"),
+                             "udaf", "approx", "kafka", "bigstate",
+                             "cluster", "query_dense", "join_dense"),
                     default="simple")
     ap.add_argument("--cluster-workers", type=int, default=3,
                     help="cluster: engine worker processes")
@@ -2463,7 +2556,8 @@ def main():
     ap.add_argument("--out", default=None, help="default derives from "
                     "--pipeline: SOAK.json / SOAK_SLIDING.json / "
                     "SOAK_JOIN.json / SOAK_SESSION.json / SOAK_UDAF.json "
-                    "/ SOAK_CHAOS.json (never cross-clobbers artifacts)")
+                    "/ SOAK_APPROX.json / SOAK_CHAOS.json (never "
+                    "cross-clobbers artifacts)")
     args = ap.parse_args()
     if args.chaos:
         if args.pipeline not in ("simple", "kafka"):
@@ -2476,6 +2570,7 @@ def main():
                 "join": "SOAK_JOIN.json",
                 "session": "SOAK_SESSION.json",
                 "udaf": "SOAK_UDAF.json",
+                "approx": "SOAK_APPROX.json",
                 "sliding": "SOAK_SLIDING.json",
                 "kafka": "SOAK_KAFKA.json",
                 "bigstate": "SOAK_BIGSTATE.json",
@@ -2573,6 +2668,7 @@ def main():
         ),
         "session": golden_update_session,
         "sliding": golden_update_sliding,
+        "approx": golden_update_approx,
         # query_dense/join_dense verify against per-query ORACLE RUNS
         # (qd_verify) after the drive loop, not an incremental golden
         # fold — the loop still advances golden_i to track feed
@@ -2775,6 +2871,13 @@ def main():
                 elif args.pipeline == "udaf":
                     cnt, mn, mx, _sm = g
                     want = (cnt, round(mx - mn, 4))
+                elif args.pipeline == "approx":
+                    # EXACT integer equality: the golden's plane was
+                    # folded with the engine's own kernels, and HLL
+                    # max-merge is split-invariant — any deviation is
+                    # a real sketch restore/fold bug, not "noise"
+                    cnt, plane = g
+                    want = (cnt, int(_sk().hll_estimate(plane)[0]))
                 else:
                     cnt, mn, mx, sm = g
                     want = (cnt, round(mn, 4), round(mx, 4),
