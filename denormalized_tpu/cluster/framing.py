@@ -16,10 +16,18 @@ JSON value list for object (string) columns; every data frame also
 piggybacks the sender's current watermark so an edge that only ever
 receives another worker's keys still advances event time.
 
-Frame types (``"t"`` in the header): ``hello`` (edge identification),
-``data`` (column buffers + watermark), ``wm`` (watermark-only advance),
-``barrier`` (checkpoint epoch marker, in-band), ``eos`` (sender's
-partitions exhausted).
+Frame types (``"t"`` in the header): ``hello`` (edge identification:
+worker id + sender generation + the sender's pinned restore epoch),
+``data`` (column buffers + watermark + optional source-partition id),
+``wm`` (watermark-only advance), ``barrier`` (checkpoint epoch marker,
+in-band), ``eos`` (sender's partitions exhausted), and ``resume`` — the
+ONE receiver→sender frame in the protocol, written by the exchange
+server right after every hello so a reconnecting sender learns where
+the edge stands (frames seen, last committed barrier, rows delivered
+per source partition since that barrier).  Sequence numbers are
+IMPLICIT: both ends count post-hello frames per sender generation, so
+the wire format needs no per-frame counter — a replayed frame keeps
+its original position by construction (docs/cluster.md#rejoin).
 
 ``encode_data`` / ``decode_data`` are pinned hot paths
 (tools/dnzlint/hotpaths.toml): per-column comprehensions only, never
@@ -60,16 +68,69 @@ def _payload(header: dict, bufs: list[bytes]) -> bytes:
     return b"".join([struct.pack("<I", len(hj)), hj] + bufs)
 
 
-def encode_hello(worker_id: int) -> bytes:
-    return _frame(_payload({"t": "hello", "from": int(worker_id)}, []))
+def encode_hello(
+    worker_id: int, gen: int = 0, restore_epoch: int = 0
+) -> bytes:
+    """Edge identification.  ``gen`` is the sender's incarnation number
+    (bumped by the coordinator at every spawn of that worker, full or
+    partial) — the receiver resets its per-edge frame count when it
+    sees a new generation.  ``restore_epoch`` is the cluster-committed
+    epoch the sender was pinned to at startup (0 = fresh): a reborn
+    sender's peers answer with how many rows per partition they already
+    received since that barrier, so the replayed stream is deduplicated
+    exactly (docs/cluster.md#rejoin)."""
+    return _frame(_payload(
+        {"t": "hello", "from": int(worker_id), "gen": int(gen),
+         "restore": int(restore_epoch)},
+        [],
+    ))
+
+
+def encode_resume(
+    gen_seen: int,
+    frames_seen: int,
+    epoch: int,
+    counts: dict[int, int],
+    counts_ok: bool = True,
+) -> bytes:
+    """Receiver → sender, written once after every hello.  ``gen_seen``
+    is the sender generation the receiver last heard from on this edge
+    (-1 = never — fresh receiver or fresh edge), ``frames_seen`` the
+    number of post-hello frames it fully processed from that
+    generation, ``epoch`` the last cluster-committed barrier it knows,
+    and ``counts`` the rows per source partition delivered on this edge
+    since that barrier (the reborn-sender dedup ledger).  ``counts_ok``
+    is False when the receiver could not attribute rows to partitions
+    (unstamped batches) — the sender must then escalate to the
+    full-cluster fallback rather than guess."""
+    return _frame(_payload(
+        {"t": "resume", "gen": int(gen_seen), "seen": int(frames_seen),
+         "epoch": int(epoch),
+         "counts": {str(k): int(v) for k, v in counts.items()},
+         "ok": bool(counts_ok)},
+        [],
+    ))
 
 
 def encode_wm(ts_ms: int) -> bytes:
     return _frame(_payload({"t": "wm", "wm": int(ts_ms)}, []))
 
 
-def encode_barrier(epoch: int) -> bytes:
-    return _frame(_payload({"t": "barrier", "epoch": int(epoch)}, []))
+def encode_barrier(
+    epoch: int, skips: dict[int, int] | None = None
+) -> bytes:
+    """Checkpoint epoch marker.  ``skips`` is the sender's residual
+    router-side skip per source partition at the moment the barrier
+    entered its stream: a reborn sender that is still draining its
+    dedup skip emits barriers at a stream position BEHIND the rows the
+    receiver already holds, so the receiver must subtract this residual
+    when snapshotting its delivered-rows ledger for the epoch —
+    otherwise a second rebirth anchored at this barrier under-skips and
+    duplicates rows (docs/cluster.md#rejoin)."""
+    hdr: dict = {"t": "barrier", "epoch": int(epoch)}
+    if skips:
+        hdr["skips"] = {str(k): int(v) for k, v in skips.items()}
+    return _frame(_payload(hdr, []))
 
 
 def encode_eos() -> bytes:
@@ -113,10 +174,16 @@ def _col_spec_bufs(col) -> tuple[dict, list[bytes]]:
     )
 
 
-def encode_data(batch: RecordBatch, wm_ms: int | None) -> bytes:
+def encode_data(
+    batch: RecordBatch, wm_ms: int | None, part: int | None = None
+) -> bytes:
     """One RecordBatch → one frame.  Column order is schema order (the
     receiver rebuilds against its own copy of the same schema); masks
-    ride as optional bool buffers."""
+    ride as optional bool buffers.  ``part`` is the GLOBAL source
+    partition the batch's rows came from (batches never mix
+    partitions upstream of the router) — receivers ledger rows per
+    (edge, partition) against it so a reborn sender can skip exactly
+    the prefix already delivered."""
     specs_bufs = [_col_spec_bufs(c) for c in batch.columns]
     bufs = [b for _, bl in specs_bufs for b in bl]
     # a columnar column already ships its validity inside its own
@@ -146,16 +213,19 @@ def encode_data(batch: RecordBatch, wm_ms: int | None) -> bytes:
         "masks": [len(b) if m is not None else None
                   for m, b in zip(masks, mask_bufs)],
     }
+    if part is not None:
+        header["part"] = int(part)
     return _frame(_payload(header, bufs + [b for b in mask_bufs if b]))
 
 
 def decode_frame(payload: bytes, schema: Schema) -> tuple:
     """Decode one verified payload → ``(type, ...)`` tuple:
 
-    - ``("hello", worker_id)``
-    - ``("data", RecordBatch, wm_ms_or_None)``
+    - ``("hello", worker_id, gen, restore_epoch)``
+    - ``("resume", gen_seen, frames_seen, epoch, counts, counts_ok)``
+    - ``("data", RecordBatch, wm_ms_or_None, part_or_None)``
     - ``("wm", ts_ms)``
-    - ``("barrier", epoch)``
+    - ``("barrier", epoch, residual_skips)``
     - ``("eos",)``
     """
     if len(payload) < 4:
@@ -169,15 +239,36 @@ def decode_frame(payload: bytes, schema: Schema) -> tuple:
         raise SourceError(f"exchange frame header undecodable: {e}") from e
     t = header.get("t")
     if t == "data":
-        return ("data",) + decode_data(header, payload, hlen, schema)
+        batch, wm = decode_data(header, payload, hlen, schema)
+        part = header.get("part")
+        return ("data", batch, wm, int(part) if part is not None else None)
     if t == "wm":
         return ("wm", int(header["wm"]))
     if t == "barrier":
-        return ("barrier", int(header["epoch"]))
+        return (
+            "barrier",
+            int(header["epoch"]),
+            {int(k): int(v)
+             for k, v in header.get("skips", {}).items()},
+        )
     if t == "eos":
         return ("eos",)
     if t == "hello":
-        return ("hello", int(header["from"]))
+        return (
+            "hello",
+            int(header["from"]),
+            int(header.get("gen", 0)),
+            int(header.get("restore", 0)),
+        )
+    if t == "resume":
+        return (
+            "resume",
+            int(header["gen"]),
+            int(header["seen"]),
+            int(header["epoch"]),
+            {int(k): int(v) for k, v in header.get("counts", {}).items()},
+            bool(header.get("ok", True)),
+        )
     raise SourceError(f"unknown exchange frame type {t!r}")
 
 
@@ -296,8 +387,11 @@ def read_exact(sock, n: int) -> bytes | None:
 def read_frame(sock) -> bytes | None:
     """Read + verify one frame from a socket → payload bytes, or None on
     clean EOF.  Every integrity violation (bad magic, oversize length,
-    CRC mismatch, mid-frame EOF) raises ``SourceError`` — the worker
-    fails stop-the-world and the coordinator restarts the cluster from
+    CRC mismatch, mid-frame EOF) raises ``SourceError`` — a torn frame
+    is dropped WHOLE, so the receiver's per-edge ledgers always cover
+    an exact prefix of the sender's stream.  Under partial recovery the
+    receiver marks the edge down and awaits reconnect; in fail-stop
+    mode the worker dies and the coordinator restarts the cluster from
     the last committed epoch (docs/cluster.md#failure-matrix)."""
     hdr = read_exact(sock, _HDR.size)
     if hdr is None:

@@ -22,7 +22,10 @@ from __future__ import annotations
 import time
 from typing import Iterator
 
+import numpy as np
+
 from denormalized_tpu.common.record_batch import RecordBatch
+from denormalized_tpu.common.schema import DataType, Field
 from denormalized_tpu.physical.base import (
     EOS,
     EndOfStream,
@@ -36,24 +39,77 @@ from denormalized_tpu.sources.base import PartitionReader, Source
 from denormalized_tpu.cluster import framing
 from denormalized_tpu.cluster.hashing import bucket_rows, partitions_for
 
+#: batch-constant provenance column stamped at the reader (every batch
+#: comes from exactly one partition cursor) and dropped by the router
+#: before framing/loopback — receivers ledger delivered rows per
+#: (edge, global partition) against it, which is what makes a reborn
+#: sender's replay exactly deduplicatable (cluster/exchange.py)
+PART_COL = "__dnz_part"
+
+
+class _StampedReader(PartitionReader):
+    """Delegating reader that appends the global-partition provenance
+    column to every batch.  Offsets, backlog and decode reporting pass
+    through untouched — the stamp is invisible to checkpointing."""
+
+    def __init__(self, inner: PartitionReader, global_pid: int) -> None:
+        self._inner = inner
+        self._pid = global_pid
+        self._field = Field(PART_COL, DataType.INT64, nullable=False)
+
+    def read(self, timeout_s: float | None = None):
+        batch = self._inner.read(timeout_s)
+        if batch is None:
+            return None
+        return batch.with_column(
+            self._field,
+            np.full(batch.num_rows, self._pid, dtype=np.int64),
+        )
+
+    def offset_snapshot(self) -> dict:
+        return self._inner.offset_snapshot()
+
+    def offset_restore(self, snap: dict) -> None:
+        self._inner.offset_restore(snap)
+
+    def decode_fallback_rows(self) -> int:
+        return self._inner.decode_fallback_rows()
+
+    def caught_up(self):
+        return self._inner.caught_up()
+
 
 class PartitionSubsetSource(Source):
     """A view of ``inner`` restricted to this worker's static partition
     subset (``partitions_for``): reader ``i`` of the subset is global
     partition ``worker + i * n_workers`` — the one assignment rule the
-    offset rescaler inverts (cluster/rescale.py)."""
+    offset rescaler inverts (cluster/rescale.py).
 
-    def __init__(self, inner: Source, worker: int, n_workers: int) -> None:
+    With ``stamp=True`` every reader batch carries ``PART_COL`` (the
+    global partition id) for the exchange's rejoin ledgers; the
+    declared ``schema`` stays the inner one — the stamp is batch-level
+    provenance, invisible to planning."""
+
+    def __init__(
+        self, inner: Source, worker: int, n_workers: int,
+        stamp: bool = False,
+    ) -> None:
         self._inner = inner
         self.worker = worker
         self.n_workers = n_workers
+        self.stamp = stamp
         self.name = f"{inner.name}@w{worker}"
         all_readers = inner.partitions()
         self.n_partitions_total = len(all_readers)
         self._pids = partitions_for(
             worker, n_workers, self.n_partitions_total
         )
-        self._readers = [all_readers[p] for p in self._pids]
+        self._readers = [
+            self._wrap(all_readers[p], p) for p in self._pids
+        ]
+
+    def _wrap(self, reader: PartitionReader, pid: int) -> PartitionReader:
+        return _StampedReader(reader, pid) if self.stamp else reader
 
     @property
     def schema(self):
@@ -70,14 +126,22 @@ class PartitionSubsetSource(Source):
             # cursors (bounded replay sources support this) — ONE inner
             # scan, then subset, never one scan per subset partition
             all_readers = self._inner.partitions()
-            readers = [all_readers[p] for p in self._pids]
+            readers = [
+                self._wrap(all_readers[p], p) for p in self._pids
+            ]
         return readers
 
     def partition_factories(self):
         inner = self._inner.partition_factories()
         if inner is None:
             return None
-        return [inner[p] for p in self._pids]
+
+        def _stamped_factory(factory, pid):
+            return lambda: self._wrap(factory(), pid)
+
+        return [
+            _stamped_factory(inner[p], p) for p in self._pids
+        ]
 
     def global_partition_ids(self) -> list[int]:
         return list(self._pids)
@@ -119,18 +183,28 @@ class ExchangeRouter:
             source=f"w{worker_id}",
         )
 
-    def _broadcast(self, frame_bytes: bytes, local_item: tuple) -> None:
+    def _broadcast(
+        self, frame_bytes: bytes, local_item: tuple,
+        kind: str, epoch: int | None = None,
+    ) -> None:
         self.server.local_put(local_item)
         for dst in range(self.n_workers):
             if dst == self.worker_id:
                 continue
-            self.clients[dst].send(frame_bytes)
+            self.clients[dst].send(frame_bytes, kind, epoch)
 
     def _route_batch(self, batch: RecordBatch) -> None:
         if batch.num_rows == 0:
             return
         self._obs_rows.add(batch.num_rows)
         self.rows_routed += batch.num_rows
+        pid = None
+        if batch.schema.has(PART_COL):
+            # batch-constant provenance stamp: record it for the rejoin
+            # ledgers, then drop it — it never crosses the wire and the
+            # keyed half's schema doesn't know it
+            pid = int(batch.column(PART_COL)[0])
+            batch = batch.drop([PART_COL])
         if self.n_workers == 1:
             # single worker: every key is ours — skip the hash entirely
             self.server.local_put(("data", batch, self.wm))
@@ -144,9 +218,23 @@ class ExchangeRouter:
                 continue
             sub = batch if mask.all() else batch.filter(mask)
             if dst == self.worker_id:
+                # the loopback never skips: a reborn worker's own state
+                # restored to the same epoch its ingest replays from
                 self.server.local_put(("data", sub, self.wm))
-            else:
-                self.clients[dst].send(framing.encode_data(sub, self.wm))
+                continue
+            client = self.clients[dst]
+            if pid is not None:
+                s = client.take_skip(pid, sub.num_rows)
+                if s:
+                    # the receiver already holds this prefix from my
+                    # previous incarnation — per-partition sequences
+                    # are deterministic, so dropping the first s rows
+                    # is exact, not heuristic
+                    sub = sub.slice(s, sub.num_rows - s)
+            if sub.num_rows:
+                client.send(
+                    framing.encode_data(sub, self.wm, part=pid), "data"
+                )
 
     def run(self) -> None:
         t_start = time.perf_counter()
@@ -165,17 +253,29 @@ class ExchangeRouter:
                 if self.wm is None or item.ts_ms > self.wm:
                     self.wm = item.ts_ms
                     self._broadcast(
-                        framing.encode_wm(self.wm), ("wm", self.wm)
+                        framing.encode_wm(self.wm), ("wm", self.wm), "wm"
                     )
             elif isinstance(item, Marker):
-                self._broadcast(
-                    framing.encode_barrier(item.epoch),
-                    ("barrier", item.epoch),
-                )
+                # barriers are per-edge frames, not one shared buffer:
+                # while this (reborn) worker's dedup skip is draining,
+                # each peer must learn its own residual so its ledger
+                # snapshot for this epoch anchors at the barrier's
+                # stream position, not at the delivered frontier
+                self.server.local_put(("barrier", item.epoch))
+                for dst in range(self.n_workers):
+                    if dst == self.worker_id:
+                        continue
+                    client = self.clients[dst]
+                    client.send(
+                        framing.encode_barrier(
+                            item.epoch, skips=client.skip_residual()
+                        ),
+                        "barrier", item.epoch,
+                    )
             elif isinstance(item, EndOfStream):
                 break
         self.source_done = True
-        self._broadcast(framing.encode_eos(), ("eos",))
+        self._broadcast(framing.encode_eos(), ("eos",), "eos")
         for c in self.clients.values():
             c.close()
 
@@ -233,21 +333,40 @@ class ExchangeSourceExec(ExecOperator):
 
 
 def replace_scan_source(
-    ingest_logical, worker: int, n_workers: int
+    ingest_logical, worker: int, n_workers: int, stamp: bool = False
 ) -> PartitionSubsetSource:
     """Swap the (possibly projection-pushed) Scan's source for this
     worker's partition subset.  The plan objects are built fresh inside
     each worker process, so in-place replacement is safe — nothing else
     holds them."""
     from denormalized_tpu.common.errors import PlanError
+    from denormalized_tpu.common.schema import Schema
     from denormalized_tpu.logical import plan as lp
+    from denormalized_tpu.logical.expr import Column
 
     node = ingest_logical
+    projects = []
     while not isinstance(node, lp.Scan):
         kids = node.children
         if len(kids) != 1:
             raise PlanError("ingest half must be a unary chain to a Scan")
+        if isinstance(node, lp.Project):
+            projects.append(node)
         node = kids[0]
-    subset = PartitionSubsetSource(node.source, worker, n_workers)
+    subset = PartitionSubsetSource(
+        node.source, worker, n_workers, stamp=stamp
+    )
     node.source = subset
+    if stamp:
+        # the provenance stamp must survive optimizer-pushed
+        # projections the same way the canonical timestamp column
+        # rides along implicitly (logical/plan.py Project.__init__):
+        # ProjectExec rebuilds batches to its expr list, so each
+        # Project in the chain passes PART_COL through by reference
+        # (Column.eval is name-based against the live batch)
+        field = Field(PART_COL, DataType.INT64, nullable=False)
+        for proj in projects:
+            if not proj.schema.has(PART_COL):
+                proj.exprs.append(Column(PART_COL))
+                proj.schema = Schema(list(proj.schema) + [field])
     return subset

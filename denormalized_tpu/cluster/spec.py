@@ -36,9 +36,36 @@ class ClusterSpec:
     # emission sink: "jsonl" (full epoch-tagged rows, the exactly-once
     # soak/test protocol) or "count" (rows counted, bench mode)
     sink: str = "jsonl"
-    # supervision: full-cluster restarts allowed before giving up (the
-    # prefetch supervisor's restart-budget pattern, one level up)
+    # supervision: full-cluster restarts allowed before giving up.
+    # Budgets bound failure RATE, not lifetime: every restart opens a
+    # per-scope streak, and a crash-free ``restart_heal_s`` interval
+    # refunds the streak's tokens (the prefetch supervisor's
+    # streak+refund pattern, one level up) — so a days-long stream with
+    # occasional healed deaths never converges to a guaranteed kill,
+    # while a crash-storm still exhausts the budget promptly.
     max_restarts: int = 3
+    # partial recovery: a dead worker (with checkpointing on and at
+    # least one cluster commit) is respawned ALONE, pinned to the last
+    # committed epoch, while surviving workers keep streaming; falls
+    # back to the full-cluster restart when ineligible or when the
+    # rejoin exceeds its budget (docs/cluster.md#failure-matrix)
+    partial_recovery: bool = True
+    # single-worker respawns tolerated per worker within one heal
+    # interval before that worker's failures escalate to the
+    # full-cluster path (which spends ``max_restarts`` tokens)
+    worker_max_restarts: int = 3
+    # crash-free seconds after which restart streaks heal and their
+    # tokens are refunded (per worker AND cluster-global)
+    restart_heal_s: float = 30.0
+    # seconds a respawned worker gets to finish the rejoin handshake
+    # (ready event with echoed partition subset) before the
+    # coordinator abandons partial recovery for the full restart
+    rejoin_timeout_s: float = 60.0
+    # sender-side replay buffer cap per edge (frames retained since the
+    # last cluster-committed barrier); overflow evicts oldest and
+    # forces the full-cluster fallback if a replay would have needed
+    # the evicted frames
+    replay_buffer_bytes: int = 64 << 20
     # seconds with no worker liveness signal before the run is declared
     # wedged (workers heartbeat on epoch acks and EOS)
     liveness_timeout_s: float = 120.0

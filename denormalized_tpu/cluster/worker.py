@@ -1,7 +1,16 @@
 """Cluster worker process entry point.
 
 ``python -m denormalized_tpu.cluster.worker --spec <file> --worker <i>
---store <dir> --restore-epoch <E|none> --seq <k> --out <file>``
+--store <dir> --restore-epoch <E|none> --seq <k> --out <file>
+[--gen <g>] [--abort-floor <E>]``
+
+``--gen`` is this worker's incarnation number (bumped by the
+coordinator at every spawn, full or partial) — it rides the exchange
+hello so peers distinguish a reconnecting sender from a reborn one.
+``--abort-floor`` is the highest epoch the coordinator ever aborted (or
+committed) before this incarnation: the merger drops stale barrier
+markers at or below it, which is what makes replayed frames from
+surviving peers safe to consume verbatim.
 
 One worker = one engine process running BOTH halves of the split query
 (cluster/split.py): an **ingest thread** drives the partition-subset
@@ -142,6 +151,7 @@ class WorkerRuntime:
         self.src_exec = None
         self.coord = None
         self.ctrl: _ControlClient | None = None
+        self.merger = None
         self.barrier_q: list[int] = []  # consumed by the source poll
         self.stop_event = threading.Event()
         self.rows_emitted = 0
@@ -182,6 +192,21 @@ class WorkerRuntime:
             keyed_done = self.keyed_done
         if keyed_done and self.coord is not None:
             self.commit_and_ack(epoch)
+
+    def on_abort(self, epoch: int) -> None:
+        """Control thread: the coordinator aborted in-flight epoch
+        ``epoch`` (a peer died before acking it; the number is never
+        reused).  Drop it from the pending barrier queue so the marker
+        never enters the stream here, and raise the merger's abort
+        floor so markers already in flight from peers unwind instead of
+        aligning."""
+        with self.lock:
+            if epoch in self.barrier_q:
+                self.barrier_q.remove(epoch)
+        if self.merger is not None:
+            self.merger.abort_to(epoch)
+        if self.coord is not None:
+            self.coord.note_aborted(epoch)
 
     def on_barrier_cmd(self, epoch: int) -> None:
         """Control thread: route one barrier command."""
@@ -362,19 +387,40 @@ def run_worker(args) -> int:
                 lp.Sink(ds.logical_plan(), None),
                 getattr(config, "optimizer", True),
             )
+        # partial recovery needs checkpointing (there is nothing to pin
+        # a lone respawn to without cluster commits) — reader batches
+        # are then provenance-stamped so peers can ledger deliveries
+        # per partition (cluster/runtime.py PART_COL)
+        partial = bool(spec.partial_recovery) and checkpointing
+        pin_epoch = (
+            0 if args.restore_epoch in ("none", "off")
+            else int(args.restore_epoch)
+        )
         sq = split_keyed(plan)
-        subset = replace_scan_source(sq.ingest_logical, wid, n)
+        subset = replace_scan_source(
+            sq.ingest_logical, wid, n, stamp=partial
+        )
 
         # -- exchange -----------------------------------------------------
         with obs.bound_registry(reg):
             server = ExchangeServer(
-                wid, n, sock_path(spec.workdir, wid), sq.exchange_schema
+                wid, n, sock_path(spec.workdir, wid), sq.exchange_schema,
+                partial=partial, last_commit=pin_epoch,
             )
             clients = {
-                dst: ExchangeClient(wid, dst, sock_path(spec.workdir, dst))
+                dst: ExchangeClient(
+                    wid, dst, sock_path(spec.workdir, dst),
+                    gen=args.gen, restore_epoch=pin_epoch,
+                    partial=partial,
+                    replay_buffer_bytes=spec.replay_buffer_bytes,
+                    reconnect_deadline_s=spec.rejoin_timeout_s,
+                )
                 for dst in range(n) if dst != wid
             }
         merger = EdgeMerger(server)
+        if args.abort_floor:
+            merger.abort_to(args.abort_floor)
+        rt.merger = merger
 
         # -- physical halves ---------------------------------------------
         sink = (
@@ -447,6 +493,15 @@ def run_worker(args) -> int:
                     except StateError as e:
                         ctrl.send({"ev": "error", "msg": str(e)})
                         os._exit(1)
+                elif cmd == "abort":
+                    rt.on_abort(int(msg["epoch"]))
+                elif cmd == "committed":
+                    # cluster commit: prune replay buffers (senders) and
+                    # stale barrier snapshots (receiver ledgers)
+                    ep = int(msg["epoch"])
+                    server.note_commit(ep)
+                    for c in clients.values():
+                        c.note_commit(ep)
                 elif cmd == "stop":
                     rt.stop_event.set()
                     return
@@ -479,11 +534,21 @@ def run_worker(args) -> int:
                 import numpy as _np
 
                 key_dtypes.append(_np.dtype(f_.dtype.to_numpy()).str)
+        if args.gen > 0 and partial:
+            # rejoin handshake fault site: an injected StateError here
+            # surfaces as a failed rejoin — the coordinator's
+            # rejoin_timeout_s / budget machinery must degrade to the
+            # full-cluster restart, never wedge
+            faults.inject("cluster.rejoin", key=f"w{wid}")
         ctrl.send({
             "ev": "ready",
             "restored_epoch": (
                 (coord.restored_epoch or 0) if coord is not None else None
             ),
+            "gen": args.gen,
+            # partition subset echo: the coordinator cross-checks the
+            # respawn landed on exactly the dead worker's partitions
+            "partitions": subset.global_partition_ids(),
             "n_partitions": subset.n_partitions_total,
             "state_keys": state_keys,
             "key_columns": sq.key_columns,
@@ -504,9 +569,13 @@ def run_worker(args) -> int:
                     router.run()
             except BaseException as e:  # dnzlint: allow(broad-except) supervisor boundary: the error is re-dispatched to the coordinator as data and the process exits nonzero — fail-stop, never silent
                 ingest_err.append(e)
-                ctrl.send({
-                    "ev": "error", "msg": f"ingest: {e!r}",
-                })
+                msg = {"ev": "error", "msg": f"ingest: {e!r}"}
+                if getattr(e, "cluster_fallback", False):
+                    # partial recovery provably cannot absorb this
+                    # (replay gap, reconnect budget, unstamped rows):
+                    # tell the coordinator to take the full restart
+                    msg["fallback"] = "cluster"
+                ctrl.send(msg)
                 os._exit(1)
             finally:
                 rt.on_ingest_done()
@@ -549,7 +618,10 @@ def run_worker(args) -> int:
 
         tb = traceback.format_exc(limit=8)
         try:
-            ctrl.send({"ev": "error", "msg": f"{e!r}\n{tb}"})
+            msg = {"ev": "error", "msg": f"{e!r}\n{tb}"}
+            if getattr(e, "cluster_fallback", False):
+                msg["fallback"] = "cluster"
+            ctrl.send(msg)
         except Exception:  # dnzlint: allow(broad-except) the control channel may be the thing that failed; the nonzero exit below still surfaces the crash to the coordinator
             pass
         raise
@@ -572,6 +644,16 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--seq", type=int, default=0)
     ap.add_argument("--out", required=True)
+    ap.add_argument(
+        "--gen", type=int, default=0,
+        help="incarnation number for the exchange hello (bumped by the "
+        "coordinator at every spawn of this worker)",
+    )
+    ap.add_argument(
+        "--abort-floor", type=int, default=0,
+        help="highest aborted-or-committed epoch before this "
+        "incarnation; barrier markers at or below it are dropped",
+    )
     args = ap.parse_args(argv)
     return run_worker(args)
 

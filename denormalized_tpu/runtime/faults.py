@@ -25,6 +25,12 @@ sites** at the engine's I/O boundaries::
     exchange.connect    ExchangeClient.connect      (raises SourceError)
     exchange.send       ExchangeClient.send         (SourceError / torn frame)
     exchange.recv       exchange server recv loop   (raises SourceError)
+    exchange.reconnect  ExchangeClient redial of a  (raises SourceError)
+                        down edge, per attempt
+    cluster.rejoin      respawned worker's rejoin   (raises StateError)
+                        handshake, before ready
+    cluster.replay      buffered-frame replay on a  (SourceError / torn frame)
+                        fresh exchange connection
 
 Each site calls :func:`inject` (optionally passing the key/payload being
 written).  With no plan armed ``inject`` is a single attribute check and an
@@ -108,6 +114,9 @@ SITES = {
     "exchange.connect": SourceError,
     "exchange.send": SourceError,
     "exchange.recv": SourceError,
+    "exchange.reconnect": SourceError,
+    "cluster.rejoin": StateError,
+    "cluster.replay": SourceError,
 }
 
 #: where each site's ``inject`` call lives (module relative to this
@@ -154,6 +163,22 @@ SITE_MODULES = {
     "exchange.recv": (
         "cluster/exchange.py",
         "exchange server receive loop, once per inbound frame",
+    ),
+    "exchange.reconnect": (
+        "cluster/exchange.py",
+        "`ExchangeClient` redial of a down edge during partial "
+        "recovery, once per backoff attempt",
+    ),
+    "cluster.rejoin": (
+        "cluster/worker.py",
+        "respawned worker's rejoin handshake (generation > 0), before "
+        "it reports ready to the coordinator",
+    ),
+    "cluster.replay": (
+        "cluster/exchange.py",
+        "replay of sender-buffered frames on a freshly resumed "
+        "exchange connection (supports torn frames: the receiver's "
+        "CRC check detects the tear and the edge redials)",
     ),
 }
 

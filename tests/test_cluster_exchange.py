@@ -77,8 +77,13 @@ def test_data_frame_roundtrip_with_masks():
     b = _batch()
     mask = np.array([True] * 7 + [False], dtype=bool)
     b = RecordBatch(b.schema, b.columns, [None, mask, None])
-    kind, got, wm = _roundtrip(framing.encode_data(b, 777), b.schema)
-    assert kind == "data" and wm == 777
+    kind, got, wm, part = _roundtrip(framing.encode_data(b, 777), b.schema)
+    assert kind == "data" and wm == 777 and part is None
+    # provenance-stamped frames round-trip the global partition id
+    _, _, _, p2 = _roundtrip(
+        framing.encode_data(b, 777, part=5), b.schema
+    )
+    assert p2 == 5
     assert got.to_pydict() == b.to_pydict()
     assert got.masks[1].tolist() == mask.tolist()
     assert got.masks[0] is None

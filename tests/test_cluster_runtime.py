@@ -90,8 +90,11 @@ def test_cluster_kill_restore_same_n_exactly_once(tmp_path, oracle):
 
 def test_worker_death_triggers_supervised_restart(tmp_path, oracle):
     args = dict(JOB_ARGS, pace_s=0.05)
+    # partial recovery off: this test pins the FULL-cluster restart
+    # path (tests/test_cluster_recovery.py covers the partial one)
     spec = _spec(
-        tmp_path, 2, args, checkpoint_interval_s=0.3, max_restarts=2
+        tmp_path, 2, args, checkpoint_interval_s=0.3, max_restarts=2,
+        partial_recovery=False,
     )
     result = run_cluster(spec, kill_worker_after_s=1.0, kill_worker_id=1)
     assert result["status"] == "done"

@@ -35,7 +35,7 @@ def _roundtrip(batch, schema):
 
     got = framing.read_frame(_Sock(frame))
     assert got == payload
-    t, decoded, wm = framing.decode_frame(payload, schema)
+    t, decoded, wm, _part = framing.decode_frame(payload, schema)
     assert t == "data" and wm == 777
     return decoded
 
@@ -97,7 +97,9 @@ def test_raw_lane_elides_duplicate_validity():
     frame_dup = framing.encode_data(detached, None)
     # ~1 byte per row saved (modulo a few header chars)
     assert len(frame_dup) - len(frame) >= len(vals) - 16
-    _t, got, _wm = framing.decode_frame(frame[framing._HDR.size:], SCHEMA)
+    _t, got, _wm, _part = framing.decode_frame(
+        frame[framing._HDR.size:], SCHEMA
+    )
     np.testing.assert_array_equal(
         np.asarray(got.mask("k"), dtype=bool), col.validity
     )

@@ -102,6 +102,7 @@ def test_site_inventory_is_complete():
         "lsm.put", "lsm.get", "lsm.flush", "checkpoint.commit",
         "lsm.spill_put", "lsm.spill_get", "spill.manifest",
         "exchange.connect", "exchange.send", "exchange.recv",
+        "exchange.reconnect", "cluster.rejoin", "cluster.replay",
     }
     for site, meta in inv.items():
         assert meta["calls"], f"site {site} has no inject call"

@@ -1031,11 +1031,16 @@ def test_budget_pressure_verdict_on_exact_median_workload(
         assert bytes_seen[-1] >= 8 * rows_total  # >= raw f64 payload
         kinds = [v["kind"] for v in snap["verdicts"]]
         assert "state-budget-pressure" in kinds, snap["verdicts"]
-        v = next(
-            x for x in snap["verdicts"]
-            if x["kind"] == "state-budget-pressure"
+        # one stateful node: the query-TOTAL projection (node_id None)
+        # and the per-node projection cover the same state, and they
+        # rank by measured severity — accept whichever fired, preferring
+        # the node-attributed one when both did
+        v = max(
+            (x for x in snap["verdicts"]
+             if x["kind"] == "state-budget-pressure"),
+            key=lambda x: x.get("node_id") is not None,
         )
-        assert "udaf" in v["node_id"].lower() or v["node_id"], v
+        assert v["node_id"] is None or "udaf" in v["node_id"].lower(), v
         assert v["time_to_budget_s"] >= 0.0
     finally:
         handle.finish()

@@ -2383,22 +2383,30 @@ def rss_kb(pid: int) -> int | None:
     return None
 
 
-def cluster_main(args) -> None:
-    """Multi-process cluster soak: N worker processes over the
-    hash-repartition exchange, aligned checkpoints, a SIGKILLed worker
-    mid-stream (coordinator-driven full restart from the last cluster
-    commit), and one injected exchange fault (torn frame on the wire,
-    detected by the receiver's CRC check) — output must be EXACTLY-ONCE
-    vs the uninterrupted single-process oracle: 0 lost, 0 spurious, 0
-    duplicate emissions.
+def _cluster_cell(args, partial: bool) -> dict:
+    """Run one cluster soak cell and return its report dict.
 
-    Unlike the single-process soaks this parent imports the engine (the
-    oracle runs in-process); the workers are real spawned processes."""
+    Both cells stream the same paced job over N worker processes with a
+    SIGKILLed worker mid-stream plus one injected torn exchange frame,
+    and hold the surviving output to EXACTLY-ONCE vs the uninterrupted
+    single-process oracle (0 lost / 0 spurious / 0 duplicates).  They
+    differ in the recovery contract under test:
+
+    - ``full_restart`` (partial=False): fail-stop fallback — any death
+      or tear restarts the WHOLE cluster from the last committed epoch
+      (gate: restarts >= 2, one per injected failure).
+    - ``partial`` (partial=True): single-worker recovery — the tear is
+      keyed to the killed worker's outbound edges, so both failures are
+      attributed to that one worker and only IT respawns; survivors
+      must never restart (``max_restarts=0`` turns any full restart
+      into a hard error), only the dead worker's slot may grow partial
+      segments, and the coordinator's recovery-duration histogram
+      (``dnz_cluster_recovery_ms``) lands in the report."""
     import shutil
     import tempfile
     from collections import Counter
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from denormalized_tpu import obs
     from denormalized_tpu.cluster import ClusterSpec, run_cluster
     from denormalized_tpu.cluster import benchjob
     from denormalized_tpu.cluster.reader import read_cluster
@@ -2418,19 +2426,29 @@ def cluster_main(args) -> None:
     }
     per_worker_wall = (partitions / n_workers) * batches * 0.05
     t_start = time.time()
-    print(f"cluster soak: {n_workers} workers, {partitions} partitions, "
-          f"{batches} batches/partition (~{per_worker_wall:.0f}s of "
-          "stream per worker)", file=sys.stderr)
+    mode = "partial" if partial else "full_restart"
+    print(f"cluster soak [{mode}]: {n_workers} workers, {partitions} "
+          f"partitions, {batches} batches/partition "
+          f"(~{per_worker_wall:.0f}s of stream per worker)",
+          file=sys.stderr)
     oracle = benchjob.oracle_rows(job_args, string_keys=True)
     work = tempfile.mkdtemp(prefix="soak_cluster_")
-    # one torn exchange frame from worker 0, mid-stream: the receiver's
-    # CRC/length check detects it, both ends fail stop-the-world, the
-    # coordinator restarts the cluster from the last committed epoch
+    victim = n_workers - 1
+    # one torn exchange frame mid-stream, detected by the receiver's
+    # CRC/length check.  full_restart tears worker 0's edge (both ends
+    # fail, coordinator restarts the cluster); partial tears the
+    # VICTIM's outbound edge so the failure is attributed to the same
+    # worker the SIGKILL targets — two partial recoveries of one
+    # worker, peers never stop
     fault_plan = {
         "seed": args.chaos_seed,
         "rules": [{
             "site": "exchange.send", "kind": "torn",
-            "key_substr": "0->", "after": 40, "times": 1,
+            "key_substr": f"{victim}->" if partial else "0->",
+            # partial recovery pins the respawn to the last CLUSTER
+            # commit, so the partial cell's tear waits until the first
+            # 1s-interval barrier has provably committed
+            "after": 150 if partial else 40, "times": 1,
             "name": "torn-exchange-frame",
         }],
     }
@@ -2441,16 +2459,19 @@ def cluster_main(args) -> None:
         job_args=job_args,
         checkpoint_interval_s=1.0,
         sink="jsonl",
-        max_restarts=4,
+        # partial: ANY full-cluster restart is a hard failure — the
+        # survivors-keep-streaming contract is the point of the cell
+        max_restarts=0 if partial else 4,
         liveness_timeout_s=300.0,
         metrics_jsonl=True,
         fault_plan=fault_plan,
+        partial_recovery=partial,
     )
     kill_at = min(args.kill_every, per_worker_wall * 0.4)
     result = run_cluster(
         spec,
         kill_worker_after_s=kill_at,
-        kill_worker_id=n_workers - 1,
+        kill_worker_id=victim,
     )
     got = read_cluster(result["segments"])
     rows = [benchjob.canonical_row(r) for r in got["rows"]]
@@ -2461,7 +2482,7 @@ def cluster_main(args) -> None:
     spurious = sum((counts - want).values()) - dupes
     # fault evidence: the torn frame fired in generation 0 (its obs
     # stream carries the dnz_fault_injections_total counter) and cost
-    # at least one restart beyond the SIGKILL's
+    # at least one restart/recovery beyond the SIGKILL's
     merged = _obs_readers().merge_final_snapshots(
         sorted(
             os.path.join(work, "obs", f)
@@ -2481,7 +2502,7 @@ def cluster_main(args) -> None:
     )
     fault_fired = max(int(fault_fired), torn_crashes)
     report = {
-        "pipeline": "cluster",
+        "mode": mode,
         "workers": n_workers,
         "partitions": partitions,
         "total_rows": partitions * batches * job_args["rows"],
@@ -2498,18 +2519,73 @@ def cluster_main(args) -> None:
         "status": result["status"],
         "wall_s": round(time.time() - t_start, 1),
         "host_cores": os.cpu_count(),
-        "pass": bool(
+    }
+    if partial:
+        partials = [s for s in result["segments"] if s.get("partial")]
+        # the coordinator runs in THIS process: its recovery-duration
+        # histogram is read straight off the live obs registry
+        hist = {
+            k: v for k, v in obs.registry().snapshot().items()
+            if k.startswith("dnz_cluster_recovery_ms")
+        }
+        report.update({
+            "worker_restarts": result["worker_restarts"],
+            "aborted_epochs": result["aborted_epochs"],
+            "recoveries": result["recoveries"],
+            "recovery_ms_histogram": hist,
+            "crashes": result.get("crashes", []),
+            "partial_segment_workers": sorted(
+                {s["worker"] for s in partials}
+            ),
+        })
+        report["pass"] = bool(
+            result["status"] == "done"
+            and lost == 0 and spurious == 0 and dupes == 0
+            and result.get("killed_workers", 0) >= 1
+            and fault_fired >= 1
+            # survivors never restarted; only the victim replayed
+            and result["restarts"] == 0
+            and result["worker_restarts"] >= 1
+            and partials
+            and all(s["worker"] == victim for s in partials)
+            and all(s["restored"] >= 1 for s in partials)
+            and any(r["worker"] == victim and r["ms"] > 0
+                    for r in result["recoveries"])
+        )
+    else:
+        report["pass"] = bool(
             result["status"] == "done"
             and lost == 0 and spurious == 0 and dupes == 0
             and result.get("killed_workers", 0) >= 1
             and fault_fired >= 1
             and result["restarts"] >= 2
-        ),
+        )
+    shutil.rmtree(work, ignore_errors=True)
+    return report
+
+
+def cluster_main(args) -> None:
+    """Multi-process cluster soak (see ``_cluster_cell``): the
+    full-restart fallback cell and the partial-recovery cell, one
+    report with both.  ``--partial`` runs only the partial cell (quick
+    iteration on the single-worker recovery path).
+
+    Unlike the single-process soaks this parent imports the engine (the
+    oracle runs in-process); the workers are real spawned processes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    modes = [True] if args.partial else [False, True]
+    cells = {}
+    for partial in modes:
+        cell = _cluster_cell(args, partial)
+        cells[cell["mode"]] = cell
+    report = {
+        "pipeline": "cluster",
+        "cells": cells,
+        "pass": all(c["pass"] for c in cells.values()),
     }
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))
-    shutil.rmtree(work, ignore_errors=True)
     if not report["pass"]:
         sys.exit(1)
 
@@ -2531,6 +2607,11 @@ def main():
                     help="cluster: engine worker processes")
     ap.add_argument("--cluster-partitions", type=int, default=6,
                     help="cluster: source partitions (static assignment)")
+    ap.add_argument("--partial", action="store_true",
+                    help="cluster: run ONLY the partial-recovery cell "
+                    "(single-worker replay while peers keep streaming); "
+                    "default runs the full-restart fallback cell AND "
+                    "the partial cell")
     ap.add_argument("--keys", type=int, default=10_000_000,
                     help="bigstate: simultaneously-open sessions")
     ap.add_argument("--wave-keys", type=int, default=100_000,
