@@ -200,8 +200,9 @@ class ExchangeClient:
             # receiver never fully processed
             with self._buf_lock:
                 needed = [e for e in self._buf if e[0] >= frames_seen]
+                replay_ok = self._replay_ok
             if needed and (
-                not self._replay_ok or needed[0][0] != frames_seen
+                not replay_ok or needed[0][0] != frames_seen
             ):
                 raise cluster_fallback_error(
                     f"exchange edge {self.edge} cannot tear-heal: replay "
@@ -225,7 +226,8 @@ class ExchangeClient:
         # what the pruned buffer holds
         with self._buf_lock:
             needed = list(self._buf)
-        if needed and not self._replay_ok:
+            replay_ok = self._replay_ok
+        if needed and not replay_ok:
             raise cluster_fallback_error(
                 f"exchange edge {self.edge} cannot replay to reborn "
                 "receiver: buffer was evicted past the committed barrier"

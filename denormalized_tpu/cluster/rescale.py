@@ -210,7 +210,10 @@ def _build_target_snapshot(
             if first is None or not (first <= j <= last):
                 continue
             slot = j % w_new
-            for label in labels:
+            # sorted: label order here IS the arrays-dict insertion
+            # order, which pack_snapshot serializes — set order would
+            # make the rebuilt snapshot bytes hash-seed-dependent
+            for label in sorted(labels):
                 row = planes.get(label)
                 if row is None:
                     continue

@@ -74,6 +74,7 @@ class LineageTracker:
         n = batch.num_rows
         self._seen[key] = prev + n
         first = (-prev) % self.sample_every
+        # dnzlint: allow(unguarded) racy fullness peek only skips work early; the insert loop re-checks max_samples under _lock before every admit
         if first >= n or len(self._samples) >= self.max_samples:
             return
         ts_col = np.asarray(
@@ -118,6 +119,7 @@ class LineageTracker:
         Matching is by event-time-range containment — exact before any
         aggregation, approximate after (emissions re-stamp event time),
         which is why emission matching is a separate explicit call."""
+        # dnzlint: allow(unguarded) racy liveness peek only skips the column decode; matching below re-reads _live_ids/_live_ts as a consistent pair under _lock
         if not self._live_ids or node_id is None:
             return
         if not batch.schema.has(CANONICAL_TIMESTAMP_COLUMN):
@@ -128,12 +130,16 @@ class LineageTracker:
         if not len(ts):
             return
         mn, mx = int(ts.min()), int(ts.max())
-        hit = (self._live_ts >= mn) & (self._live_ts <= mx)
-        if not hit.any():
-            return
         rec = obs_spans.recorder()
         now = time.time()
         with self._lock:
+            # _live_ts indices resolve through _live_ids — both rebuilt
+            # together under _lock, so the pair must be read under it
+            # too or a concurrent ingest leaves the indices pointing
+            # into a different generation of the id list
+            hit = (self._live_ts >= mn) & (self._live_ts <= mx)
+            if not hit.any():
+                return
             for i in np.nonzero(hit)[0]:
                 sid = self._live_ids[int(i)]
                 s = self._samples.get(sid)
@@ -155,6 +161,7 @@ class LineageTracker:
         ``query`` tags the link with the subscriber query id when a
         SHARED pipeline emits for one of its member queries, so one
         tracker serves every member's ``/lineage`` view."""
+        # dnzlint: allow(unguarded) racy liveness peek only skips work; the matching loop reads _live_ids/_live_ts under _lock
         if not self._live_ids or node_id is None:
             return
         starts = np.atleast_1d(np.asarray(start_ms, dtype=np.int64))

@@ -54,7 +54,11 @@ class SamplingProfiler:
         t = self._thread
         if t is not None:
             t.join(timeout=5.0)
-        return self.samples_taken
+        with self._lock:
+            # the join can time out with the sampler mid-flush; the
+            # counter is only coherent with the sample buffer under its
+            # lock
+            return self.samples_taken
 
     def _run(self) -> None:
         own = threading.get_ident()

@@ -118,6 +118,18 @@ class QueryHandle:
             self.profiler = SamplingProfiler(hz=hz).start()
             return self.profiler
 
+    def _profiler_snapshot(self) -> dict:
+        """Status dict for /queries/<id> — claim the reference under
+        the lock (same idiom as stop_profiler) so a snapshot racing
+        start/stop sees one coherent sampler, then read off the claimed
+        local."""
+        with self._profiler_lock:
+            prof = self.profiler
+        return {
+            "running": bool(prof and prof.running),
+            "samples": getattr(prof, "samples_taken", 0),
+        }
+
     def stop_profiler(self) -> int:
         # claim the reference under the lock, join OUTSIDE it (stop()
         # joins the sampler thread; blocking under a held lock is the
@@ -267,10 +279,7 @@ class QueryHandle:
                 "suspects": suspects,
                 "bottleneck": suspects[0]["node_id"] if suspects else None,
             },
-            "profiler": {
-                "running": bool(self.profiler and self.profiler.running),
-                "samples": getattr(self.profiler, "samples_taken", 0),
-            },
+            "profiler": self._profiler_snapshot(),
         }
         if self.lineage is not None:
             snap["lineage_samples"] = self.lineage.sampled_total

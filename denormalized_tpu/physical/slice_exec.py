@@ -1438,6 +1438,18 @@ class SliceWindowExec(ExecOperator):
         # cursors of subscribers not in the current plan: retained for
         # adoption when the (replayed) live registration re-attaches
         self._orphans = by_tag
+        if by_tag:
+            from denormalized_tpu.runtime.tracing import logger
+
+            logger.info(
+                "slice restore retained %d orphan cursor(s) awaiting "
+                "re-attachment: %s", len(by_tag),
+                ", ".join(
+                    f"tag {t} ({r.get('label') or 'unlabeled'}, "
+                    f"class {r.get('class_sig') or '?'})"
+                    for t, r in sorted(by_tag.items())
+                ),
+            )
         # split arrays back into per-class stores by snapshot class
         # index, matching classes by residual signature
         snap_sigs = [str(s) for s in meta.get("classes") or [""]]
@@ -1473,6 +1485,7 @@ class SliceWindowExec(ExecOperator):
 
         for item in self._doctor_input():
             if isinstance(item, RecordBatch):
+                # dnzlint: allow(unguarded) boundary fast-path peek: truthiness load is atomic and _drain_ops re-checks _pending_ops under _ops_lock; a stale miss just defers the op to the next batch boundary
                 if self._pending_ops and item.num_rows:
                     # live attach/detach lands at batch boundaries; ops
                     # carrying an event-time threshold fire exactly when

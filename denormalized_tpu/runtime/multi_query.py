@@ -244,14 +244,6 @@ class SharedPipeline:
                 "query does not share the pipeline's source, projection "
                 "and group keys"
             )
-        if entry.filter_sig != self._base_sig and not pr.implies(
-            entry.cons, self._base_cons
-        ):
-            raise PlanError(
-                "query filter is not implied by the shared pipeline's "
-                "base predicate — the live ingest cannot widen; run it "
-                "as an independent pipeline"
-            )
         w = entry.window
         length = int(w.length_ms)
         slide = int(w.slide_ms) if w.slide_ms else length
@@ -262,6 +254,20 @@ class SharedPipeline:
                 f"group's {unit}ms slices"
             )
         with self._lock:
+            # predicate gate and membership insert are one atomic step:
+            # _on_detach re-derives the base from the surviving members
+            # under this same lock, so checking against a base the
+            # detach hook is about to replace cannot admit a widening
+            # query (TOCTOU otherwise)
+            if entry.filter_sig != self._base_sig and not pr.implies(
+                entry.cons, self._base_cons
+            ):
+                raise PlanError(
+                    "query filter is not implied by the shared pipeline's "
+                    "base predicate — the live ingest cannot widen; run it "
+                    "as an independent pipeline"
+                )
+            base_sig = self._base_sig
             tag = self._next_tag
             self._next_tag += 1
             self._sinks[tag] = sink
@@ -275,7 +281,7 @@ class SharedPipeline:
             tag=tag,
             label=label if label is not None else f"live{tag}",
             filter_expr=(
-                None if entry.filter_sig == self._base_sig
+                None if entry.filter_sig == base_sig
                 else pr.conjoin(entry.preds)
             ),
             filter_sig=entry.filter_sig,
@@ -347,7 +353,8 @@ class SharedPipeline:
                 )
                 for item in self._root.run():
                     if isinstance(item, SubscriberBatch):
-                        sink = self._sinks.get(item.tag)
+                        with self._lock:
+                            sink = self._sinks.get(item.tag)
                         if sink is not None:
                             sink(item.batch)
                     elif isinstance(item, Marker) and coord is not None:

@@ -277,6 +277,7 @@ class PrefetchWorker:
         new = self._reader_factory()
         if self._last_snap is not None:
             new.offset_restore(self._last_snap)
+        # dnzlint: allow(unguarded) single-writer field: only the supervisor thread (this method's caller) ever rebinds self.reader; _swap_lock exists to keep the metric fold + swap glitch-free for concurrent *_total() readers
         old = self.reader
         with self._swap_lock:
             # fold + swap atomically w.r.t. decode_fallback_total(): no
@@ -404,6 +405,7 @@ class PrefetchWorker:
                 self.caught_up = False
 
     def _run_reader(self) -> None:
+        # dnzlint: allow(unguarded) single-writer field: the supervisor thread running this loop is the only writer of self.reader (rebound in _rebuild_reader between _run_reader calls, never during one)
         reader = self.reader
         probe = getattr(reader, "caught_up", None)
         if not callable(probe):

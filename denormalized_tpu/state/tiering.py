@@ -436,7 +436,7 @@ class SpillController:
             except StateError as e:
                 last = e
                 if attempt < _RELOAD_ATTEMPTS - 1:
-                    time.sleep(0.01 * (attempt + 1))
+                    time.sleep(0.01 * (attempt + 1))  # dnzlint: allow(replay-impure) reload-retry backoff — timing never feeds block bytes
         if last is not None:
             raise last
         if raw is None:

@@ -646,3 +646,391 @@ def test_baseline_requires_reasons(tmp_path):
         """))
     with pytest.raises(ValueError, match="no reason"):
         load_baseline(bl)
+
+
+# -- dnzlint v2: guarded-by / replay-purity / snapshot-symmetry ------------
+
+def _v2_paths(tmp_path, **overrides):
+    """Registry paths for fixture runs: nonexistent by default so the
+    real tree's registries never leak into a fixture package."""
+    none = tmp_path / "no-such-registry.toml"
+    kw = dict(
+        baseline_path=none, hotpaths_path=none, operators_path=none,
+        guards_path=none, replaypaths_path=none,
+    )
+    kw.update(overrides)
+    return kw
+
+
+def test_guard_inference_fires_both_directions(tmp_path):
+    """DNZ-G001: an attribute written under a lock anywhere in the class
+    is claimed by it — unguarded reads AND writes fire; a reasoned
+    pragma suppresses; a helper only ever called with the lock held is
+    clean (transitive held-set resolution); a guards.toml exemption
+    absorbs its attribute, and a stale exemption is itself a finding
+    (DNZ-G002)."""
+    root = _write_pkg(tmp_path, {"coord.py": """\
+        import threading
+
+
+        class Coordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+                self._peers = {}
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+                    self._peers["x"] = 1
+
+            def racy_read(self):
+                return self._count
+
+            def racy_write(self):
+                self._count = 0
+
+            def peeked(self):
+                return self._count  # dnzlint: allow(unguarded) monitoring peek, staleness tolerated by the dashboard
+
+            def exempt_peek(self):
+                return self._peers
+
+            def locked_caller(self):
+                with self._lock:
+                    return self._helper()
+
+            def _helper(self):
+                return self._count
+        """})
+    gt = tmp_path / "guards.toml"
+    gt.write_text(textwrap.dedent("""\
+        [[unguarded]]
+        class = "Coordinator"
+        attr = "_peers"
+        reason = "fixture: read-only dashboard tolerates stale membership"
+
+        [[unguarded]]
+        class = "Coordinator"
+        attr = "_gone"
+        reason = "fixture: stale entry must be reported"
+        """))
+    new, suppressed, _ = run_all(root, **_v2_paths(tmp_path, guards_path=gt))
+    g1 = [f for f in new if f.rule == "DNZ-G001"]
+    assert any(f.symbol == "Coordinator.racy_read"
+               and "read of self._count" in f.message for f in g1), \
+        [f.render() for f in new]
+    assert any(f.symbol == "Coordinator.racy_write"
+               and "write of self._count" in f.message for f in g1)
+    # the claim names the lock and the claiming write site
+    assert all("Coordinator._lock" in f.message for f in g1)
+    # transitive resolution: the helper is only entered lock-held
+    assert not any("_helper" in f.symbol or "locked_caller" in f.symbol
+                   for f in g1)
+    # guards.toml exemption absorbs _peers entirely
+    assert not any("_peers" in f.message for f in g1)
+    # reasoned pragma suppresses rather than fires
+    assert any(f.rule == "DNZ-G001" and f.symbol == "Coordinator.peeked"
+               for f in suppressed)
+    # reverse drift: the _gone exemption matches nothing
+    assert any(f.rule == "DNZ-G002" and f.symbol == "Coordinator._gone"
+               for f in new)
+
+
+def test_guard_registry_requires_reasons(tmp_path):
+    from tools.dnzlint.guards import load_guards
+
+    gt = tmp_path / "guards.toml"
+    gt.write_text(textwrap.dedent("""\
+        [[unguarded]]
+        class = "C"
+        attr = "_x"
+        reason = ""
+        """))
+    with pytest.raises(ValueError, match="reason"):
+        load_guards(gt)
+
+
+def test_replay_purity_fires_both_directions(tmp_path):
+    """DNZ-D001: an impurity fires transitively (attributed to the
+    reached helper, naming the registered root) and on the registered
+    kernel itself; a pure registered kernel is silent.  DNZ-D002 fires
+    both ways: a registered symbol the tree no longer defines, and a
+    snapshot-codec caller outside the registry closure."""
+    root = _write_pkg(tmp_path, {"enc.py": """\
+        import time
+
+
+        def encode(meta):
+            return _pack(meta)
+
+
+        def _pack(meta):
+            meta["at"] = time.time()
+            return repr(meta).encode()
+
+
+        def decode(blob):
+            seen = set(blob)
+            out = []
+            for b in seen:
+                out.append(b)
+            return out
+
+
+        def stray_codec(meta):
+            return pack_snapshot(meta, {})
+
+
+        def clean_kernel(rows):
+            return sorted(rows)
+        """})
+    rp = tmp_path / "paths.toml"
+    rp.write_text(textwrap.dedent("""\
+        [[path]]
+        file = "badpkg/enc.py"
+        qualname = "encode"
+        note = "fixture: frame encoder"
+
+        [[path]]
+        file = "badpkg/enc.py"
+        qualname = "decode"
+        note = "fixture: frame decoder"
+
+        [[path]]
+        file = "badpkg/enc.py"
+        qualname = "clean_kernel"
+        note = "fixture: pure kernel stays silent"
+
+        [[path]]
+        file = "badpkg/enc.py"
+        qualname = "vanished"
+        note = "fixture: registered symbol the tree no longer defines"
+        """))
+    new, _, _ = run_all(root, **_v2_paths(tmp_path, replaypaths_path=rp))
+    d1 = [f for f in new if f.rule == "DNZ-D001"]
+    d2 = [f for f in new if f.rule == "DNZ-D002"]
+    # transitive: the clock read is in the helper, attributed to it,
+    # naming the registered entry point it was reached from
+    assert any(f.symbol == "_pack" and "time.time" in f.message
+               and "reached from registered encode" in f.message
+               for f in d1), [f.render() for f in new]
+    # direct: unordered set iteration feeding the decoder's output
+    assert any(f.symbol == "decode" and "unordered set" in f.message
+               for f in d1)
+    assert not any(f.symbol in ("encode", "clean_kernel") for f in d1)
+    # registry drift, both directions
+    assert any("vanished" in f.symbol for f in d2)
+    assert any(f.symbol == "stray_codec"
+               and "pack_snapshot" in f.message for f in d2)
+
+
+def test_replaypaths_registry_requires_notes(tmp_path):
+    from tools.dnzlint.replay import load_paths
+
+    rp = tmp_path / "paths.toml"
+    rp.write_text(textwrap.dedent("""\
+        [[path]]
+        file = "x.py"
+        qualname = "f"
+        note = ""
+        """))
+    with pytest.raises(ValueError, match="note"):
+        load_paths(rp)
+
+
+def test_snapshot_symmetry_fires_both_directions(tmp_path):
+    """DNZ-S001: written-never-read, strict-read-never-written (tolerant
+    .get(k, default) reads are the sanctioned legacy idiom and stay
+    silent), and a version literal bumped on one side only.  DNZ-S002:
+    codec flows without a keyed_state registration, and a keyed_state
+    registration whose class lost its codec flow."""
+    root = _write_pkg(tmp_path, {"physical/snapop.py": """\
+        class WinOp:
+            def _snapshot(self, coord):
+                meta = {
+                    "version": 2,
+                    "rows": self._rows,
+                    "orphaned": self._orphaned,
+                }
+                coord.put_snapshot("w", pack_snapshot(meta, {}))
+
+            def _restore(self, coord):
+                meta, _ = unpack_snapshot(coord.get_snapshot("w"))
+                if meta["version"] != 1:
+                    return
+                self._rows = meta["rows"]
+                self._missing = meta["ghost"]
+                self._opt = meta.get("legacy", 0)
+
+
+        class CleanOp:
+            def _snapshot(self, coord):
+                coord.put_snapshot("c", pack_snapshot({"rows": self._rows}, {}))
+
+            def _restore(self, coord):
+                meta, _ = unpack_snapshot(coord.get_snapshot("c"))
+                self._rows = meta["rows"]
+
+
+        class UnregisteredSnap:
+            def _snapshot(self, coord):
+                coord.put_snapshot("u", pack_snapshot({"x": 1}, {}))
+
+
+        class StaleKeyed:
+            def run(self):
+                pass
+        """})
+    ops = tmp_path / "ops.toml"
+    ops.write_text(textwrap.dedent("""\
+        [[operator]]
+        class = "WinOp"
+        file = "badpkg/physical/snapop.py"
+        keyed_state = true
+
+        [[operator]]
+        class = "CleanOp"
+        file = "badpkg/physical/snapop.py"
+        keyed_state = true
+
+        [[operator]]
+        class = "UnregisteredSnap"
+        file = "badpkg/physical/snapop.py"
+
+        [[operator]]
+        class = "StaleKeyed"
+        file = "badpkg/physical/snapop.py"
+        keyed_state = true
+        """))
+    from tools.dnzlint import snapshots
+
+    findings = snapshots.run(root, ops)
+    s1 = [f for f in findings if f.rule == "DNZ-S001"]
+    s2 = [f for f in findings if f.rule == "DNZ-S002"]
+    assert any(f.symbol == "WinOp._snapshot" and "'orphaned'" in f.message
+               and "no restore path reads it" in f.message
+               for f in s1), [f.render() for f in findings]
+    assert any(f.symbol == "WinOp._restore" and "'ghost'" in f.message
+               and "KeyError" in f.message for f in s1)
+    assert any(f.symbol == "WinOp" and "version literals" in f.message
+               for f in s1)
+    # tolerant legacy read and the symmetric operator stay silent
+    assert not any("'legacy'" in f.message for f in s1)
+    assert not any("CleanOp" in f.symbol for f in s1 + s2)
+    # registry drift, both directions
+    assert any(f.symbol == "UnregisteredSnap"
+               and "keyed_state" in f.message for f in s2)
+    assert any(f.symbol == "StaleKeyed"
+               and "no snapshot codec flow" in f.message for f in s2)
+
+
+def test_replay_path_docs_table_cannot_drift():
+    """docs/static_analysis.md embeds the registry table generated from
+    replaypaths.toml (python -m tools.dnzlint --replay-path-table);
+    regenerate the docs block when the registry changes."""
+    from tools.dnzlint.replay import replay_path_table
+
+    table = replay_path_table()
+    docs = (REPO / "docs" / "static_analysis.md").read_text()
+    assert table in docs, (
+        "docs/static_analysis.md replay-path table is stale — regenerate "
+        "with: python -m tools.dnzlint --replay-path-table"
+    )
+
+
+def test_replaypaths_registry_covers_core_kernels():
+    """The determinism pin is only as good as its roots: the codec,
+    hashing, and operator snapshot surfaces must stay registered."""
+    from tools.dnzlint.replay import load_paths
+
+    entries = load_paths(REPO / "tools" / "dnzlint" / "replaypaths.toml")
+    by_file = {}
+    for e in entries:
+        by_file.setdefault(e["file"], set()).add(e["qualname"])
+    assert len(entries) >= 60
+    core = {
+        "denormalized_tpu/cluster/framing.py": {"encode_data", "decode_frame"},
+        "denormalized_tpu/cluster/hashing.py": {"hash_rows", "bucket_rows"},
+        "denormalized_tpu/cluster/rescale.py": {"rescale_cluster"},
+        "denormalized_tpu/state/serialization.py": {
+            "pack_snapshot", "unpack_snapshot",
+        },
+        "denormalized_tpu/state/checkpoint.py": {
+            "CheckpointCoordinator.put_snapshot",
+            "CheckpointCoordinator.get_snapshot",
+        },
+        "denormalized_tpu/ops/sketches.py": {"stable_hash64"},
+        "denormalized_tpu/ops/slice_store.py": {"fold_slices"},
+    }
+    for file, quals in core.items():
+        assert quals <= by_file.get(file, set()), (file, quals)
+
+
+def test_cli_json_report_carries_reason_and_wall_clock(tmp_path):
+    """--format=json / --report emit {rule, file, line, symbol, reason}
+    per finding plus wall_clock_s (tools/lint.sh budget-gates on it)."""
+    import json
+
+    report_path = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dnzlint", "denormalized_tpu",
+         "--format=json", "--report", str(report_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    on_disk = json.loads(report_path.read_text())
+    assert report == on_disk
+    assert report["counts"]["new"] == 0
+    assert report["counts"]["suppressed"] >= 10
+    # the lint.sh wall-clock budget, with headroom for slow CI boxes
+    assert 0 < report["wall_clock_s"] < 60
+    for f in report["suppressed"]:
+        assert set(f) == {"rule", "file", "line", "symbol", "reason"}
+        assert f["reason"]
+
+
+def test_exchange_redial_blocking_forms_fire_under_lock(tmp_path):
+    """DNZ-L002 blocking-list extension for the cluster exchange
+    surface: the module-level socket dial helpers, selector polls, and
+    the redial backoff sleep must all fire when reached under a held
+    engine lock — and the same redial loop run WITHOUT the lock held
+    stays silent."""
+    root = _write_pkg(tmp_path, {"redial.py": """\
+        import socket
+        import threading
+        import time
+
+
+        class Exchange:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sel = None
+                self._sock = None
+
+            def bad_redial(self):
+                with self._lock:
+                    s = socket.create_connection(("peer", 1))
+                    s.connect("/tmp/peer.sock")
+                    self._sel.select(0.5)
+                    time.sleep(0.2)
+
+            def good_redial(self):
+                s = socket.create_connection(("peer", 1))
+                s.connect("/tmp/peer.sock")
+                self._sel.select(0.5)
+                time.sleep(0.2)
+                with self._lock:
+                    self._sock = s
+        """})
+    new, _, _ = run_all(root, **_v2_paths(tmp_path))
+    l2 = [f for f in new if f.rule == "DNZ-L002"]
+    msgs = [f.message for f in l2 if f.symbol == "Exchange.bad_redial"]
+    joined = " | ".join(msgs)
+    assert "socket.create_connection" in joined, \
+        [f.render() for f in new]
+    assert ".connect" in joined
+    assert "select" in joined
+    assert "time.sleep" in joined
+    assert not any(f.symbol == "Exchange.good_redial" for f in l2)

@@ -1,0 +1,140 @@
+"""Seeded-bug end-to-end gates for the dnzlint v2 passes.
+
+Each test copies the REAL engine tree, plants exactly one bug of the
+class its pass exists to catch, and runs the FULL gate (``run_all``
+with the committed registries, baseline, and pragmas) — proving the
+pass catches its target class at tree scale AND that no suppression
+channel (pragma, baseline, guards.toml, replaypaths.toml) can mask a
+fresh instance.  The committed tree itself must stay clean, so the
+seeded finding is asserted to be the ONLY new one.
+
+These are the acceptance tests for the v2 tentpole: an unguarded
+coordinator counter (DNZ-G), a wall-clock read smuggled into the
+snapshot encoder (DNZ-D), and a snapshot field dropped from the
+restore path (DNZ-S).
+"""
+
+import shutil
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.dnzlint import run_all  # noqa: E402
+
+ENGINE = REPO / "denormalized_tpu"
+
+
+def _copy_engine(tmp_path: Path) -> Path:
+    """The copy keeps the package name — baseline and registry keys are
+    ``denormalized_tpu/...`` paths, so the full gate applies unchanged."""
+    dst = tmp_path / "denormalized_tpu"
+    shutil.copytree(
+        ENGINE, dst, ignore=shutil.ignore_patterns("__pycache__")
+    )
+    return dst
+
+
+def _seeded_new(root: Path) -> list:
+    new, _suppressed, stale = run_all(root)
+    assert stale == [], f"seed invalidated baseline entries: {stale}"
+    return new
+
+
+def _patch(path: Path, old: str, new: str) -> None:
+    """Anchored one-occurrence patch: drift in the anchored source line
+    fails here, loudly, instead of silently seeding nothing."""
+    text = path.read_text()
+    assert text.count(old) == 1, (
+        f"seed anchor {old!r} occurs {text.count(old)}x in {path.name} — "
+        f"update the seeded-bug test to the moved/renamed code"
+    )
+    path.write_text(text.replace(old, new))
+
+
+def test_seeded_unguarded_coordinator_counter_is_caught(tmp_path):
+    """DNZ-G e2e: a coordinator whose counter is written under its lock
+    on one path and bare on another — the exact shape of the races
+    fixed in the v2 triage (exchange replay flag, shared-pipeline
+    membership, doctor profiler counter)."""
+    root = _copy_engine(tmp_path)
+    (root / "runtime" / "seeded_coord.py").write_text(textwrap.dedent("""\
+        import threading
+
+
+        class SeededCoordinator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = 0
+
+            def start(self):
+                with self._lock:
+                    self._inflight += 1
+
+            def finish(self):
+                self._inflight -= 1
+        """))
+    new = _seeded_new(root)
+    assert [
+        (f.rule, f.symbol) for f in new
+    ] == [("DNZ-G001", "SeededCoordinator.finish")], \
+        [f.render() for f in new]
+    (f,) = new
+    assert "write of self._inflight" in f.message
+    assert "SeededCoordinator._lock" in f.message
+
+
+def test_seeded_clock_read_in_snapshot_encoder_is_caught(tmp_path):
+    """DNZ-D e2e: ``time.time()`` smuggled into ``pack_snapshot`` — the
+    codec every operator snapshot funnels through, registered directly
+    in replaypaths.toml, so the impurity scan hits it as a root."""
+    root = _copy_engine(tmp_path)
+    ser = root / "state" / "serialization.py"
+    _patch(ser, "import struct", "import struct\nimport time")
+    _patch(
+        ser,
+        "    entries = []",
+        "    meta = dict(meta, packed_at=time.time())\n    entries = []",
+    )
+    new = _seeded_new(root)
+    assert [(f.rule, f.symbol) for f in new] == \
+        [("DNZ-D001", "pack_snapshot")], [f.render() for f in new]
+    (f,) = new
+    assert "time.time" in f.message
+    assert f.path == "denormalized_tpu/state/serialization.py"
+
+
+def test_seeded_snapshot_restore_asymmetry_is_caught(tmp_path):
+    """DNZ-S e2e, both drift directions on the real window operator: a
+    payload field the restore never reads (state silently dropped), and
+    a restore read renamed away from what any snapshot writes (KeyError
+    on every real snapshot)."""
+    root = _copy_engine(tmp_path)
+    we = root / "physical" / "window_exec.py"
+    # direction 1: write a field no restore path reads
+    _patch(
+        we,
+        '"max_win_seen": self._max_win_seen,',
+        '"max_win_seen": self._max_win_seen,\n            "resume_salt": 0,',
+    )
+    # direction 2: strict-read a key no snapshot path writes
+    _patch(
+        we,
+        'self._first_open = meta["first_open"]',
+        'self._first_open = meta["first_open_v2"]',
+    )
+    new = _seeded_new(root)
+    got = sorted((f.rule, f.symbol) for f in new)
+    assert got == [
+        ("DNZ-S001", "StreamingWindowExec._restore"),
+        ("DNZ-S001", "StreamingWindowExec._snapshot"),
+    ], [f.render() for f in new]
+    by_symbol = {f.symbol: f.message for f in new}
+    assert "'resume_salt'" in by_symbol["StreamingWindowExec._snapshot"]
+    assert "no restore path reads it" \
+        in by_symbol["StreamingWindowExec._snapshot"]
+    assert "'first_open_v2'" in by_symbol["StreamingWindowExec._restore"]
+    assert "KeyError" in by_symbol["StreamingWindowExec._restore"]

@@ -1,0 +1,355 @@
+"""Regression pins for the races and determinism bugs the dnzlint v2
+triage surfaced and fixed (DNZ-G guarded-by inference, DNZ-D replay
+purity, DNZ-S snapshot symmetry).
+
+Two layers of pinning:
+
+- **behavioral**: the fixed invariant exercised directly — atomic
+  shared-pipeline registration, hash-seed-invariant rescale snapshot
+  bytes, coherent doctor profiler accounting, orphan-cursor logging on
+  a narrowed restore, lineage hop/ingest under contention;
+- **static**: the fixed sites must stay clean WITHOUT suppression — a
+  reverted fix would need a fresh pragma or baseline entry to pass the
+  gate, and this test pins that none exists at those sites, so the
+  revert cannot ride in silently either way.
+"""
+
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from denormalized_tpu import Context, col  # noqa: E402
+from denormalized_tpu.api import functions as F  # noqa: E402
+from denormalized_tpu.api.context import EngineConfig  # noqa: E402
+from denormalized_tpu.common.record_batch import RecordBatch  # noqa: E402
+from denormalized_tpu.common.schema import DataType, Field, Schema  # noqa: E402
+from denormalized_tpu.physical.base import EndOfStream, Marker  # noqa: E402
+from denormalized_tpu.physical.slice_exec import SubscriberBatch  # noqa: E402
+from denormalized_tpu.planner.sharing import detect_sharing  # noqa: E402
+from denormalized_tpu.runtime.multi_query import (  # noqa: E402
+    SharedPipeline,
+    build_shared_root,
+)
+from denormalized_tpu.sources.memory import MemorySource  # noqa: E402
+from denormalized_tpu.state.checkpoint import wire_checkpointing  # noqa: E402
+from denormalized_tpu.state.lsm import close_global_state_backend  # noqa: E402
+from denormalized_tpu.state.orchestrator import Orchestrator  # noqa: E402
+
+SCHEMA = Schema(
+    [
+        Field("ts", DataType.INT64, nullable=False),
+        Field("k", DataType.STRING, nullable=False),
+        Field("v", DataType.FLOAT64),
+    ]
+)
+T0 = 1_700_000_000_000
+AGGS = [
+    F.count(col("v")).alias("c"),
+    F.sum(col("v")).alias("s"),
+]
+
+
+def _batches(seed=7, n_batches=14, rows=200, n_keys=4):
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        ts = np.sort(T0 + b * 1000 + rng.integers(0, 1000, rows))
+        ks = np.asarray(
+            [f"s{i}" for i in rng.integers(0, n_keys, rows)], object
+        )
+        vs = rng.normal(10.0, 3.0, rows)
+        out.append(RecordBatch(SCHEMA, [ts, ks, vs]))
+    return out
+
+
+def _base(ctx, batches):
+    return ctx.from_source(
+        MemorySource.from_batches(batches, timestamp_column="ts"),
+        name="feed",
+    )
+
+
+# -- DNZ-G fixes ----------------------------------------------------------
+
+def test_concurrent_register_allocates_atomic_membership():
+    """multi_query.register: tag allocation, sink installation, and the
+    member-facts insert are one atomic step under the pipeline lock —
+    racing registrations must neither duplicate a tag nor leave a tag
+    whose sink/facts entries are missing (the torn state the unlocked
+    version could publish to run())."""
+    batches = _batches()
+    ctx = Context(EngineConfig())
+    base = _base(ctx, batches)
+    got = [dict() for _ in range(9)]
+
+    def sink(acc):
+        return lambda b: acc.setdefault("rows", []).append(b.num_rows)
+
+    sp = SharedPipeline(
+        ctx, [(base.window(["k"], AGGS, 3000, 1000), sink(got[0]))]
+    )
+    barrier = threading.Barrier(8)
+    tags: list[int] = []
+    errs: list[Exception] = []
+
+    def reg(i):
+        try:
+            barrier.wait(timeout=30)
+            tags.append(sp.register(
+                base.window(["k"], AGGS, 2000, 1000),
+                sink(got[i]),
+                when_ts=T0 + 4000,
+            ))
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=reg, args=(i,)) for i in range(1, 9)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert sorted(tags) == list(range(1, 9))
+    # membership is complete for every allocated tag — no torn publish
+    assert set(sp._sinks) == set(range(9))
+    assert set(sp._member_facts) >= set(range(1, 9))
+    sp.run()
+    for i, acc in enumerate(got):
+        assert acc.get("rows"), f"subscriber {i} never received a batch"
+
+
+def test_lineage_hop_ingest_contention_smoke():
+    """lineage.hop resolves the hit mask against _live_ids under the
+    same lock that rebuilds the pair — hammering hop against concurrent
+    ingests must neither raise nor record a hop for an unknown id."""
+    from denormalized_tpu.common.constants import CANONICAL_TIMESTAMP_COLUMN
+    from denormalized_tpu.obs.doctor.lineage import LineageTracker
+
+    lschema = Schema([
+        Field(CANONICAL_TIMESTAMP_COLUMN, DataType.INT64, nullable=False),
+    ])
+
+    def batch(lo, n=32):
+        return RecordBatch(
+            lschema, [np.arange(lo, lo + n, dtype=np.int64)]
+        )
+
+    lt = LineageTracker(sample_every=1, max_samples=10_000)
+    errs: list[Exception] = []
+    stop = threading.Event()
+
+    def ingester():
+        lo = 0
+        try:
+            while not stop.is_set():
+                lt.ingest("src", 0, {}, batch(lo))
+                lo += 32
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    t = threading.Thread(target=ingester)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            lt.hop("node-1", batch(0, 4096))
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errs, errs
+    with lt._lock:
+        known = set(lt._samples)
+        hopped = {sid for sid, _node in lt._hopped}
+    assert hopped and hopped <= known
+
+
+def test_profiler_stop_reports_coherent_sample_count():
+    """SamplingProfiler.stop returns the sample count read under the
+    sampler lock; the registry's status snapshot claims the profiler
+    reference the same way — both must agree after a start/stop cycle."""
+    from denormalized_tpu.obs.doctor.registry import QueryHandle
+
+    qh = QueryHandle("q-prof", root=None, node_ids={})
+    prof = qh.start_profiler(hz=500.0)
+    assert prof is not None and prof.running
+    assert qh._profiler_snapshot()["running"] is True
+    time.sleep(0.05)
+    n = qh.stop_profiler()
+    assert isinstance(n, int) and n >= 0
+    snap = qh._profiler_snapshot()
+    assert snap["running"] is False
+    assert snap["samples"] == n == prof.samples_taken
+    # stop is idempotent and stable
+    assert qh.stop_profiler() == n
+
+
+# -- DNZ-D fix: rescale snapshot bytes are hash-seed invariant ------------
+
+_RESCALE_SCRIPT = textwrap.dedent("""\
+    import sys
+
+    import numpy as np
+
+    sys.path.insert(0, {repo!r})
+    from denormalized_tpu.cluster import rescale
+    from denormalized_tpu.state.serialization import pack_snapshot
+
+    labels = [f"agg{{i}}_plane" for i in range(8)]
+    kts = [("a",), ("b",), ("c",)]
+    meta = {{
+        "window_slots": 4,
+        "first_open": 0,
+        "max_win_seen": 2,
+        "watermark_ms": 1000,
+        "interner": rescale._interner_snapshot_from_tuples(kts),
+    }}
+    arrays = {{
+        lab: np.arange(12, dtype=np.float64).reshape(4, 3) * (i + 1)
+        for i, lab in enumerate(labels)
+    }}
+    c = rescale._WindowContribution(meta, arrays, {{}})
+    m, a = rescale._build_target_snapshot(
+        [(c, np.arange(3))], epoch=7
+    )
+    sys.stdout.buffer.write(pack_snapshot(m, a))
+""")
+
+
+def test_rescale_target_snapshot_bytes_hash_seed_invariant():
+    """The rebuilt window snapshot serializes its accumulator planes in
+    sorted label order — identical logical state must produce identical
+    bytes under different PYTHONHASHSEEDs (set iteration order), or a
+    rescaled cluster's replay verification breaks across processes."""
+    script = _RESCALE_SCRIPT.format(repo=str(REPO))
+    blobs = []
+    for seed in ("1", "2"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, cwd=REPO, env=env, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()
+        blobs.append(proc.stdout)
+    assert blobs[0][:4] == b"DTCK"
+    assert blobs[0] == blobs[1], (
+        "rescaled snapshot bytes depend on the interpreter hash seed"
+    )
+
+
+# -- DNZ-S fix: narrowed restore logs its orphan cursors ------------------
+
+def test_slice_restore_logs_orphan_cursors(tmp_path, caplog):
+    """Snapshot a 3-subscriber shared pipeline, restore it with only 2
+    registered: the unmatched per-query cursor is retained and LOGGED
+    (label + class) instead of being silently dropped — the read path
+    the DNZ-S pass found missing for the 'label'/'class_sig' payload
+    fields."""
+    # all three share a 1000ms gcd slice, and so do the surviving first
+    # two — dropping the LAST query keeps the survivors' tags aligned
+    # with their snapshot records and the slice unit unchanged (a
+    # changed unit or a respec'd surviving tag is a hard error, not an
+    # orphan)
+    specs = [(3000, 1000), (4000, 2000), (2000, 1000)]
+    batches = _batches(seed=11, n_batches=20, rows=250)
+    state_dir = str(tmp_path / "state")
+
+    def make_cfg():
+        return EngineConfig(
+            checkpoint=True,
+            checkpoint_interval_s=9999,
+            state_backend_path=state_dir,
+        )
+
+    def shared_root(ctx, use_specs):
+        base = _base(ctx, batches)
+        plans = [
+            base.window(["k"], AGGS, L, S)._plan for (L, S) in use_specs
+        ]
+        groups = detect_sharing(plans)
+        assert len(groups) == 1 and groups[0].shared
+        return build_shared_root(ctx, groups[0])
+
+    try:
+        ctx_a = Context(make_cfg())
+        root_a = shared_root(ctx_a, specs)
+        orch_a = Orchestrator(interval_s=9999)
+        coord_a = wire_checkpointing(root_a, ctx_a, orch_a)
+        emissions = 0
+        it = root_a.run()
+        for item in it:
+            if isinstance(item, SubscriberBatch):
+                emissions += 1
+            if emissions == 6:
+                orch_a.trigger_now()
+                emissions += 1
+            if isinstance(item, Marker):
+                coord_a.commit(item.epoch)
+                break
+        it.close()
+        close_global_state_backend()
+
+        ctx_b = Context(make_cfg())
+        root_b = shared_root(ctx_b, specs[:2])
+        orch_b = Orchestrator(interval_s=9999)
+        with caplog.at_level(logging.INFO, logger="denormalized_tpu"):
+            wire_checkpointing(root_b, ctx_b, orch_b)
+        orphan_logs = [
+            r.getMessage() for r in caplog.records
+            if "orphan cursor" in r.getMessage()
+        ]
+        assert orphan_logs, "narrowed restore logged no orphan cursors"
+        assert any("tag 2" in m for m in orphan_logs), orphan_logs
+        assert root_b._orphans, "orphan cursor not retained for re-attach"
+        # the survivors still restore and the pipeline completes
+        for item in root_b.run():
+            if isinstance(item, EndOfStream):
+                break
+    finally:
+        close_global_state_backend()
+
+
+# -- static pin: the fixes stay fixed, not suppressed ---------------------
+
+def test_fixed_race_sites_stay_clean_without_suppression():
+    """Every site fixed during the v2 triage must produce NO finding at
+    all — new or suppressed.  A reverted fix fires the gate; a revert
+    smuggled in behind a fresh pragma or baseline entry flips the site
+    into the suppressed list and fails here instead."""
+    from tools.dnzlint import run_all
+
+    new, suppressed, _ = run_all(REPO / "denormalized_tpu")
+    assert new == [], [f.render() for f in new]
+    fixed = [
+        ("DNZ-G001", "cluster/exchange.py", "_apply_resume"),
+        ("DNZ-G001", "runtime/multi_query.py", "SharedPipeline.register"),
+        ("DNZ-G001", "runtime/multi_query.py", "SharedPipeline.run"),
+        ("DNZ-G001", "obs/doctor/profiler.py", "SamplingProfiler.stop"),
+        ("DNZ-G001", "obs/doctor/registry.py", "_snapshot_live"),
+        ("DNZ-G001", "obs/doctor/registry.py", "_profiler_snapshot"),
+        ("DNZ-D001", "cluster/rescale.py", "_build_target_snapshot"),
+        ("DNZ-S001", "physical/slice_exec.py", "_snapshot"),
+        ("DNZ-S001", "physical/slice_exec.py", "_restore"),
+    ]
+    for rule, path_suffix, symbol_part in fixed:
+        hits = [
+            f for f in suppressed
+            if f.rule == rule and f.path.endswith(path_suffix)
+            and symbol_part in f.symbol
+        ]
+        assert not hits, (
+            f"fixed site ({rule}, {path_suffix}, {symbol_part}) is now "
+            f"suppressed: " + "; ".join(f.render() for f in hits)
+        )

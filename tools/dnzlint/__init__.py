@@ -49,6 +49,36 @@ DNZ-M002    handoff-instruments an operator class in ``physical/`` that
                                 ``_note_batch`` busy bracket), or an
                                 ``operators.toml`` registration drifting
                                 from the tree
+DNZ-G001    unguarded           a read/write of a ``self._x`` attribute
+                                that some lock *claims* (it is written
+                                under that lock elsewhere in the class)
+                                made outside any claiming-lock region,
+                                held sets resolved through same-class
+                                helpers (static guarded-by inference)
+DNZ-G002    guard-registry      a ``guards.toml`` exemption whose
+                                ``(class, attr)`` no lock claims any
+                                more — stale registry entry
+DNZ-D001    replay-impure       a nondeterminism source (``time.*``,
+                                ``random``, ``uuid``, ``os.urandom``,
+                                salted ``hash()``, ``id()``, unordered
+                                ``set`` iteration) reachable from a
+                                ``replaypaths.toml``-registered
+                                replay-critical kernel, transitively to
+                                fixpoint through package-internal calls
+DNZ-D002    replay-registry     a registered replay path whose symbol no
+                                longer exists, or a snapshot-codec entry
+                                point (``pack_snapshot``/``put_json``
+                                caller) not covered by the registry
+DNZ-S001    snapshot-asym       a snapshot payload field written by a
+                                keyed operator's snapshot path but never
+                                read by its restore path, or read in
+                                restore but never written — without a
+                                legacy-layout ``.get(k, default)``
+DNZ-S002    snapshot-registry   a ``physical/`` class with snapshot
+                                codec flows not registered
+                                ``keyed_state`` in ``operators.toml``,
+                                or a ``keyed_state`` registration whose
+                                class has no snapshot flow
 ==========  ==================  =========================================
 
 Suppression is explicit and reasoned, never blanket:
@@ -92,6 +122,12 @@ RULES = {
     "DNZ-H002": "hash-tuple",
     "DNZ-M001": "metric-registry",
     "DNZ-M002": "handoff-instruments",
+    "DNZ-G001": "unguarded",
+    "DNZ-G002": "guard-registry",
+    "DNZ-D001": "replay-impure",
+    "DNZ-D002": "replay-registry",
+    "DNZ-S001": "snapshot-asym",
+    "DNZ-S002": "snapshot-registry",
 }
 SLUG_TO_RULE = {v: k for k, v in RULES.items()}
 
@@ -177,6 +213,8 @@ def run_all(
     baseline_path: Path | None = None,
     hotpaths_path: Path | None = None,
     operators_path: Path | None = None,
+    guards_path: Path | None = None,
+    replaypaths_path: Path | None = None,
 ) -> tuple[list[Finding], list[Finding], list[tuple]]:
     """Run every pass over the package at ``root``.
 
@@ -188,10 +226,13 @@ def run_all(
     from tools.dnzlint import (
         excepts,
         faultsites,
+        guards,
         handoff,
         hotpath,
         locks,
         metricsreg,
+        replay,
+        snapshots,
     )
     from tools.dnzlint.pragmas import PragmaIndex
 
@@ -214,6 +255,9 @@ def run_all(
     findings += metricsreg.run(root)
     findings += handoff.run(root, operators_path)
     findings += hotpath.run(root, hotpaths_path)
+    findings += guards.run(root, guards_path)
+    findings += replay.run(root, replaypaths_path)
+    findings += snapshots.run(root, operators_path)
 
     new: list[Finding] = []
     suppressed: list[Finding] = []

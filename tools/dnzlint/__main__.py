@@ -4,7 +4,13 @@ Exit codes: 0 = clean (after pragmas + baseline), 1 = new findings,
 2 = usage/config error.  ``--fault-site-table`` prints the generated
 markdown fault-site table (what ``docs/fault_tolerance.md`` embeds) and
 exits — used by ``tools/lint.sh`` and ``tests/test_lint.py`` to pin the
-docs against the verified site inventory.
+docs against the verified site inventory; ``--replay-path-table`` does
+the same for the replay-path registry in ``docs/static_analysis.md``.
+
+``--format=json`` (and ``--report FILE``, which writes the same JSON
+alongside the text output) emits machine-readable findings: each is
+``{rule, file, line, symbol, reason}`` where ``reason`` is the finding
+message, plus ``wall_clock_s`` so CI can watch the lint budget.
 """
 
 from __future__ import annotations
@@ -12,9 +18,39 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
 from tools.dnzlint import load_baseline, run_all
+
+
+def _finding_obj(f) -> dict:
+    return {
+        "rule": f.rule,
+        "file": f.path,
+        "line": f.line,
+        "symbol": f.symbol,
+        "reason": f.message,
+    }
+
+
+def _report(new, suppressed, stale, n_base, wall_s, root) -> dict:
+    return {
+        "root": str(root),
+        "wall_clock_s": round(wall_s, 3),
+        "counts": {
+            "new": len(new),
+            "suppressed": len(suppressed),
+            "baseline_entries": n_base,
+            "stale_baseline": len(stale),
+        },
+        "new": [_finding_obj(f) for f in
+                sorted(new, key=lambda f: (f.path, f.line, f.rule))],
+        "suppressed": [_finding_obj(f) for f in
+                       sorted(suppressed,
+                              key=lambda f: (f.path, f.line, f.rule))],
+        "stale_baseline": [list(k) for k in sorted(stale)],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -55,6 +91,16 @@ def main(argv: list[str] | None = None) -> int:
         help="print the generated metric-catalog markdown table "
              "(docs/observability.md embeds it) and exit",
     )
+    parser.add_argument(
+        "--replay-path-table", action="store_true",
+        help="print the generated replay-path registry markdown table "
+             "(docs/static_analysis.md embeds it) and exit",
+    )
+    parser.add_argument(
+        "--report", default=None, metavar="FILE",
+        help="also write the JSON report to FILE (lint.sh writes "
+             "LINT_REPORT.json this way)",
+    )
     args = parser.parse_args(argv)
 
     root = Path(args.root)
@@ -74,10 +120,17 @@ def main(argv: list[str] | None = None) -> int:
         print(metric_catalog_table(root))
         return 0
 
+    if args.replay_path_table:
+        from tools.dnzlint.replay import replay_path_table
+
+        print(replay_path_table())
+        return 0
+
     here = Path(__file__).resolve().parent
     baseline_path = (
         Path(args.baseline) if args.baseline else here / "baseline.toml"
     )
+    t0 = time.perf_counter()
     try:
         if args.no_baseline:
             new, suppressed, stale = run_all(
@@ -94,13 +147,15 @@ def main(argv: list[str] | None = None) -> int:
     except (ValueError, SyntaxError) as e:
         print(f"dnzlint: {e}", file=sys.stderr)
         return 2
+    wall_s = time.perf_counter() - t0
+
+    n_base = len(load_baseline(baseline_path)) if not args.no_baseline else 0
+    report = _report(new, suppressed, stale, n_base, wall_s, root)
+    if args.report:
+        Path(args.report).write_text(json.dumps(report, indent=2) + "\n")
 
     if args.format == "json":
-        print(json.dumps({
-            "new": [vars(f) for f in new],
-            "suppressed": [vars(f) for f in suppressed],
-            "stale_baseline": [list(k) for k in stale],
-        }, indent=2))
+        print(json.dumps(report, indent=2))
         return 1 if new else 0
 
     for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
@@ -118,12 +173,12 @@ def main(argv: list[str] | None = None) -> int:
                 f"matched no finding — delete it",
                 file=sys.stderr,
             )
-    n_base = len(load_baseline(baseline_path)) if not args.no_baseline else 0
     print(
         f"dnzlint: {len(new)} new finding(s), "
         f"{len(suppressed)} suppressed "
         f"({n_base} baseline entrie(s), rest pragmas), "
-        f"{len(stale)} stale baseline entrie(s)",
+        f"{len(stale)} stale baseline entrie(s) "
+        f"[{wall_s:.1f}s]",
         file=sys.stderr,
     )
     return 1 if new else 0

@@ -1,0 +1,362 @@
+"""DNZ-G001/G002 — guarded-by inference (static lockset discipline).
+
+The lock witness and TSan only see interleavings that actually happen in
+a run; a race on a coordinator counter or a live-registry map can sit
+unexercised through every soak.  This pass infers the *guarded-by*
+relation Eraser computes dynamically, from the AST:
+
+1. **Claim inference** — inside every class that owns a lock attribute,
+   an attribute written under a held lock in ANY method (``__init__``
+   excluded — the object is not yet shared during construction) is
+   *claimed* by that lock.
+2. **Violation** — any read or write of a claimed attribute outside a
+   region holding one of its claiming locks (again outside
+   ``__init__``) is DNZ-G001.  Held sets propagate through same-class
+   helper calls exactly like ``locks.py``: a private helper reached
+   only from call sites that hold the lock inherits the intersection of
+   its callers' held sets, so moving the mutation into ``_apply()``
+   does not launder the race.  A private method whose bound reference
+   escapes (``x.on_detach = self._cb`` — callback registration) is
+   treated as externally callable and inherits nothing.
+3. **Escape hatches** — a reasoned ``# dnzlint: allow(unguarded)
+   <reason>`` pragma on the access, or a ``guards.toml``
+   ``[[unguarded]]`` entry for documented single-writer /
+   pre-thread-start fields.  A ``guards.toml`` entry whose
+   ``(class, attr)`` is no longer a lock-claimed attribute is itself a
+   finding (DNZ-G002) — the registry can only shrink honestly, same
+   rule as the baseline.
+
+Scope: the ISSUE-20 surfaces (thread-spawning classes, doctor/HTTP
+route owners, ``operators.toml`` operators) all satisfy the actual
+trigger — owning a lock — vacuously: claims can only arise from ``with
+self._lock:`` regions, so a lock-free class can never fire.  Analyzing
+every lock-owning class therefore covers the listed surfaces and any
+future one automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from tools.dnzlint import Finding, _parse_toml, iter_python_files, rel_path
+from tools.dnzlint.locks import _ModuleScan
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    kind: str  # "read" | "write"
+    line: int
+    held: tuple[str, ...]
+    method: str
+
+
+@dataclasses.dataclass
+class _MethodInfo:
+    name: str
+    accesses: list  # [_Access]
+    calls: list  # [(callee_method, held_tuple)]
+
+
+def load_guards(path: Path) -> list[dict]:
+    """``guards.toml`` ``[[unguarded]]`` entries: {class, attr, reason}.
+    Reasons are mandatory — an unreasoned exemption defeats the audit
+    trail, same contract as baseline.toml."""
+    if not path.exists():
+        return []
+    data = _parse_toml(path)
+    out = []
+    for entry in data.get("unguarded", []):
+        if not (entry.get("class") and entry.get("attr")):
+            continue
+        if not (entry.get("reason") or "").strip():
+            raise ValueError(
+                f"guards.toml: entry ({entry.get('class')}, "
+                f"{entry.get('attr')}) has no reason — unreasoned "
+                f"exemptions defeat the audit trail"
+            )
+        out.append({
+            "class": entry["class"],
+            "attr": entry["attr"],
+            "reason": entry["reason"].strip(),
+        })
+    return out
+
+
+class _ClassWalk:
+    """Held-set-aware walk of one class's methods, recording every
+    ``self.<attr>`` access, same-class call, and escaped method ref."""
+
+    def __init__(self, rel: str, cls: str, scan: _ModuleScan):
+        self.rel = rel
+        self.cls = cls
+        self.scan = scan
+        self.methods: dict[str, _MethodInfo] = {}
+        self.escaped: set[str] = set()  # methods whose ref escapes
+        self._call_funcs: set[int] = set()  # func nodes of self-calls
+
+    def _resolve_lock(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Name) \
+                and expr.id in self.scan.module_locks:
+            return f"{self.rel}:{expr.id}"
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" \
+                and (self.cls, expr.attr) in self.scan.class_locks:
+            return f"{self.cls}.{expr.attr}"
+        return None
+
+    def walk_method(self, fn) -> None:
+        info = _MethodInfo(fn.name, [], [])
+        self.methods[fn.name] = info
+
+        def note_exprs(nodes, held, skip_bodies=False):
+            def gen(node):
+                for child in ast.iter_child_nodes(node):
+                    if skip_bodies and isinstance(child, (
+                        ast.With, ast.AsyncWith, ast.For, ast.AsyncFor,
+                        ast.While, ast.If, ast.Try, ast.Match,
+                        ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef, ast.ExceptHandler,
+                    )):
+                        continue
+                    yield child
+                    yield from gen(child)
+
+            roots = []
+            for n in nodes:
+                if isinstance(n, (ast.For, ast.AsyncFor)):
+                    roots += [n.target, n.iter]
+                elif isinstance(n, ast.While):
+                    roots.append(n.test)
+                elif isinstance(n, ast.If):
+                    roots.append(n.test)
+                elif isinstance(n, ast.Match):
+                    roots.append(n.subject)
+                elif isinstance(n, ast.Try):
+                    continue
+                else:
+                    roots.append(n)
+            for r in roots:
+                for node in [r] + list(gen(r)):
+                    self._note_node(node, info, held)
+
+        def walk(stmts, held):
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # nested def: runs at an unknown time with an
+                    # unknown held set — analyze lock-free-entry
+                    walk(node.body, ())
+                    continue
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner = held
+                    for item in node.items:
+                        lock = self._resolve_lock(item.context_expr)
+                        if lock is not None:
+                            inner = inner + (lock,)
+                        else:
+                            note_exprs([item.context_expr], inner)
+                        if item.optional_vars is not None:
+                            note_exprs([item.optional_vars], inner)
+                    walk(node.body, inner)
+                    continue
+                note_exprs([node], held, skip_bodies=True)
+                if isinstance(node, ast.Match):
+                    for case in node.cases:
+                        walk(case.body, held)
+                    continue
+                for attr in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, attr, None)
+                    if sub:
+                        if attr == "handlers":
+                            for h in sub:
+                                walk(h.body, held)
+                        else:
+                            walk(sub, held)
+
+        walk(fn.body, ())
+
+    def _note_node(self, node, info: _MethodInfo, held) -> None:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            info.calls.append((node.func.attr, held))
+            # the `self.m` func node is the call, not an escaping
+            # bound-method reference — skip it when visited on its own
+            self._call_funcs.add(id(node.func))
+            return
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self":
+            # self._x[k] = v mutates the guarded container through the
+            # attribute — a write for claim purposes, not a bare read
+            if (self.cls, node.value.attr) not in self.scan.class_locks:
+                info.accesses.append(_Access(
+                    node.value.attr, "write", node.lineno, held,
+                    info.name,
+                ))
+            self._call_funcs.add(id(node.value))
+            return
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        if id(node) in self._call_funcs:
+            return
+        if (self.cls, node.attr) in self.scan.class_locks:
+            return  # the lock itself, not guarded data
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        else:
+            kind = "read"
+        info.accesses.append(
+            _Access(node.attr, kind, node.lineno, held, info.name)
+        )
+
+    def finish(self) -> None:
+        """Post-pass: a ``self._m`` read where ``_m`` is a method is a
+        bound-reference escape (callback registration), not guarded
+        data — drop the access and pin the method externally
+        callable."""
+        names = set(self.methods)
+        for info in self.methods.values():
+            kept = []
+            for a in info.accesses:
+                if a.attr in names:
+                    self.escaped.add(a.attr)
+                else:
+                    kept.append(a)
+            info.accesses = kept
+
+    def entry_held(self) -> dict[str, frozenset]:
+        """Locks guaranteed held at entry, per method: the intersection
+        over intra-class call sites of (caller's entry set + held at the
+        site).  Public methods, dunders, and escaped refs are externally
+        callable — entry set empty."""
+        all_locks = frozenset(
+            {f"{self.cls}.{a}" for (c, a) in self.scan.class_locks
+             if c == self.cls}
+            | {f"{self.rel}:{n}" for n in self.scan.module_locks}
+        )
+        sites: dict[str, list] = {m: [] for m in self.methods}
+        for info in self.methods.values():
+            for callee, held in info.calls:
+                if callee in sites:
+                    sites[callee].append((info.name, held))
+
+        def external(m: str) -> bool:
+            return (not m.startswith("_")) or m.startswith("__") \
+                or m in self.escaped or not sites[m]
+
+        entry = {
+            m: (frozenset() if external(m) else all_locks)
+            for m in self.methods
+        }
+        changed = True
+        while changed:
+            changed = False
+            for m in self.methods:
+                if external(m):
+                    continue
+                acc = all_locks
+                for caller, held in sites[m]:
+                    acc = acc & (entry[caller] | frozenset(held))
+                if acc != entry[m]:
+                    entry[m] = acc
+                    changed = True
+        return entry
+
+
+def _analyze_class(rel: str, cls_node: ast.ClassDef, scan: _ModuleScan,
+                   exempt: set[tuple[str, str]],
+                   claimed_out: set[tuple[str, str]]) -> list[Finding]:
+    cls = cls_node.name
+    cw = _ClassWalk(rel, cls, scan)
+    for item in cls_node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cw.walk_method(item)
+    cw.finish()
+    entry = cw.entry_held()
+
+    # claims: attr -> {lock: (method, line)} from locked writes outside
+    # __init__ (construction precedes sharing)
+    claims: dict[str, dict[str, tuple[str, int]]] = {}
+    for info in cw.methods.values():
+        if info.name == "__init__":
+            continue
+        for a in info.accesses:
+            if a.kind != "write":
+                continue
+            eff = frozenset(a.held) | entry[a.method]
+            for lock in eff:
+                claims.setdefault(a.attr, {}).setdefault(
+                    lock, (a.method, a.line)
+                )
+    findings: list[Finding] = []
+    for attr, locks in sorted(claims.items()):
+        claimed_out.add((cls, attr))
+        if (cls, attr) in exempt:
+            continue
+        for info in cw.methods.values():
+            if info.name == "__init__":
+                continue
+            for a in info.accesses:
+                if a.attr != attr:
+                    continue
+                eff = frozenset(a.held) | entry[a.method]
+                if eff & set(locks):
+                    continue
+                lock, (wm, wl) = sorted(locks.items())[0]
+                findings.append(Finding(
+                    "DNZ-G001", rel, a.line, f"{cls}.{a.method}",
+                    f"{a.kind} of self.{attr} without holding {lock} — "
+                    f"the attribute is claimed by that lock (written "
+                    f"under it in {cls}.{wm}:{wl}); hold the lock, or "
+                    f"document the single-writer contract via "
+                    f"allow(unguarded) / guards.toml",
+                ))
+    return findings
+
+
+def run(root: Path, guards_path: Path | None = None) -> list[Finding]:
+    here = Path(__file__).resolve().parent
+    if guards_path is None:
+        guards_path = here / "guards.toml"
+    entries = load_guards(guards_path)
+    exempt = {(e["class"], e["attr"]) for e in entries}
+
+    findings: list[Finding] = []
+    claimed: set[tuple[str, str]] = set()
+    pkg = root.name
+    for path in iter_python_files(root):
+        rel = rel_path(path, root)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        scan = _ModuleScan(rel, pkg)
+        scan.scan(tree)
+        has_locks = bool(scan.class_locks) or bool(scan.module_locks)
+        if not has_locks:
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                findings += _analyze_class(
+                    rel, node, scan, exempt, claimed
+                )
+
+    # registry drift: an exemption for an attribute no lock claims any
+    # more is stale — delete it so the registry only shrinks honestly
+    for e in entries:
+        if (e["class"], e["attr"]) not in claimed:
+            findings.append(Finding(
+                "DNZ-G002", "tools/dnzlint/guards.toml", 1,
+                f"{e['class']}.{e['attr']}",
+                f"guards.toml exempts {e['class']}.{e['attr']} but no "
+                f"lock claims that attribute in the tree — the field "
+                f"was fixed, renamed, or removed; delete the entry",
+            ))
+    return findings

@@ -23,10 +23,15 @@ into a seconds-long convoy.  This pass builds the static story:
    self-edge, which is a self-deadlock: ``Lock`` is not reentrant).
 5. **DNZ-L002** — a blocking call inside a held region: ``time.sleep``,
    queue ``get``/``put``, ``join``/``wait``/``acquire``/``result``,
-   socket ops, ``subprocess.*``, ``ctypes.CDLL/PyDLL`` loads, calls on
-   native library handles (``self._lib.*`` — these drop the GIL and can
-   block in foreign code), and ``faults.inject`` (a latency rule sleeps
-   at the site).
+   socket ops (``.connect``/``.accept``/``.recv``/``.sendall`` plus the
+   module-level ``socket.create_connection``/``socket.getaddrinfo``
+   dial helpers), ``selectors`` ``.select`` polls, ``subprocess.*``,
+   ``ctypes.CDLL/PyDLL`` loads, calls on native library handles
+   (``self._lib.*`` — these drop the GIL and can block in foreign
+   code), and ``faults.inject`` (a latency rule sleeps at the site).
+   The exchange redial loop (dial + hello + backoff sleep) is the
+   motivating surface: any of those calls reached while an engine lock
+   is held turns one slow peer into a stall for every sender.
 
 Static resolution is deliberately conservative: an edge is only drawn
 when the callee resolves unambiguously, so the pass under-reports rather
@@ -321,6 +326,10 @@ class _Analysis:
             if isinstance(base, ast.Name):
                 if base.id == "time" and fn.attr == "sleep":
                     return "time.sleep"
+                if base.id == "socket" and fn.attr in (
+                    "create_connection", "getaddrinfo"
+                ):
+                    return f"socket.{fn.attr}"
                 if base.id == "subprocess" and fn.attr in _SUBPROCESS_FNS:
                     return f"subprocess.{fn.attr}"
                 if base.id == "ctypes" and fn.attr in ("CDLL", "PyDLL"):
@@ -340,6 +349,14 @@ class _Analysis:
                 or recv.endswith(("_q", "_queue", "queue"))
             ):
                 return f"queue {recv}.{fn.attr}"
+            if fn.attr == "select" and (
+                recv.lstrip("_") in ("sel", "selector")
+                or recv.endswith(("_sel", "_selector", "selector"))
+            ):
+                # selectors.BaseSelector.select blocks up to its timeout;
+                # a redial loop polling under the engine lock convoys
+                # every sender behind one slow peer.
+                return f"selector {recv}.select"
             if fn.attr in _BLOCKING_ATTRS:
                 if isinstance(base, ast.Constant):
                     return None  # b"".join / ", ".join — string, not thread

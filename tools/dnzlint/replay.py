@@ -1,0 +1,378 @@
+"""DNZ-D001/D002 — replay-determinism purity.
+
+Every soak from SOAK_KAFKA through SOAK_CLUSTER asserts byte-identical
+replay/restore; a ``time.time()`` smuggled into a snapshot encoder or a
+set-ordered loop feeding a frame body breaks that contract *hours* into
+a differential soak.  The mergeable-summaries discipline says the same
+property is checkable at the AST: a replay-critical kernel must be a
+pure function of its inputs.
+
+``replaypaths.toml`` registers every replay-critical kernel and codec —
+snapshot encode/decode in the keyed operators, ``cluster/framing.py`` /
+``hashing.py`` / ``rescale.py``, ``ops/sketches.py``, the
+``ops/slice_store.py`` fold paths, the checkpoint manifest writers.
+This pass pins each registered symbol, **transitively to fixpoint
+through package-internal calls** (the call graph ``locks.py`` already
+resolves), free of:
+
+- ``time.*`` calls (wall or monotonic — both vary across replays),
+- ``random`` / ``np.random`` / ``secrets``,
+- ``uuid.*``, ``os.urandom``,
+- salted builtin ``hash()`` (PYTHONHASHSEED varies per process — use
+  ``ops.sketches.stable_hash64``) and ``id()`` (address-dependent),
+- iteration over an unordered ``set`` (``for x in {..}``, ``set(...)``,
+  a local assigned a set, or set algebra) — iterate ``sorted(...)`` or
+  a list-backed structure instead.  Plain ``dict`` iteration is NOT
+  flagged: insertion order is a language guarantee and e.g. the UDAF
+  frame codec deliberately uses "dict order IS emission row order".
+
+Both drift directions fire (same rule as hotpaths/fault sites):
+
+- DNZ-D002 on the config: a registered symbol the tree no longer
+  defines (renamed kernel ⇒ the pin silently evaporates), and
+- DNZ-D002 on the tree: a snapshot-codec entry point — any function
+  calling ``pack_snapshot`` / ``unpack_snapshot`` / ``put_snapshot`` /
+  ``get_snapshot`` / ``put_json`` / ``get_json`` — that the registry's
+  transitive closure does not cover (new codec dodging the pin).
+
+The closure deliberately does NOT descend into ``obs/`` (telemetry
+reads wall clocks by design and never feeds replayed bytes) or
+``faults.py`` (test-only injection machinery, gated off in production
+replays).  A registered kernel *directly* inside those trees would
+still be scanned.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.dnzlint import Finding, _parse_toml
+from tools.dnzlint.hotpath import _find_function
+from tools.dnzlint.locks import _Analysis
+
+#: call-closure boundary: reached units under these prefixes are not
+#: descended into (side channels that never feed replayed bytes)
+_CLOSURE_EXCLUDE = ("obs/", "faults.py")
+
+#: terminal callee names that make a function a snapshot-codec entry
+#: point (the reverse-drift trigger for DNZ-D002)
+_CODEC_NAMES = frozenset({
+    "pack_snapshot", "unpack_snapshot",
+    "put_snapshot", "get_snapshot",
+    "put_json", "get_json",
+})
+
+#: time.* members considered pure (no clock read)
+_TIME_PURE = frozenset({"strptime", "struct_time"})
+
+
+def load_paths(path: Path) -> list[dict]:
+    """``replaypaths.toml`` ``[[path]]`` entries: {file, qualname, note}.
+    ``note`` is mandatory — it becomes the docs registry table row, and
+    an unexplained pin defeats the audit trail."""
+    if not path.exists():
+        return []
+    data = _parse_toml(path)
+    out = []
+    for entry in data.get("path", []):
+        if not (entry.get("file") and entry.get("qualname")):
+            continue
+        if not (entry.get("note") or "").strip():
+            raise ValueError(
+                f"replaypaths.toml: entry ({entry.get('file')}, "
+                f"{entry.get('qualname')}) has no note — unexplained "
+                f"pins defeat the audit trail"
+            )
+        out.append({
+            "file": entry["file"],
+            "qualname": entry["qualname"],
+            "note": entry["note"].strip(),
+        })
+    return out
+
+
+def _excluded(rel_in_pkg: str) -> bool:
+    return rel_in_pkg.startswith(_CLOSURE_EXCLUDE[0]) \
+        or rel_in_pkg == _CLOSURE_EXCLUDE[1]
+
+
+class _ImpurityScan:
+    """One function body (nested defs included — they are lexically part
+    of the kernel) scanned for nondeterminism sources."""
+
+    def __init__(self, rel: str, qual: str, root_entry: str):
+        self.rel = rel
+        self.qual = qual
+        self.root_entry = root_entry
+        self.findings: list[Finding] = []
+
+    def _emit(self, line: int, what: str, why: str) -> None:
+        via = "" if self.root_entry == self.qual else \
+            f" (reached from registered {self.root_entry})"
+        self.findings.append(Finding(
+            "DNZ-D001", self.rel, line, self.qual,
+            f"{what} inside replay-critical path{via} — {why}",
+        ))
+
+    def scan(self, fn: ast.AST) -> list[Finding]:
+        set_locals = self._set_locals(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._check_iter(node.iter, node.lineno, set_locals)
+            elif isinstance(node, (ast.ListComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                # SetComp generators are deliberately exempt: building a
+                # set from unordered iteration is order-insensitive
+                for gen in node.generators:
+                    self._check_iter(gen.iter, node.lineno, set_locals)
+        return self.findings
+
+    # -- which locals hold sets ------------------------------------------
+    @staticmethod
+    def _is_set_expr(expr: ast.AST) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+                and expr.func.id in ("set", "frozenset"):
+            return True
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.BitAnd, ast.BitOr, ast.Sub, ast.BitXor)
+        ):
+            # set algebra — only meaningful when an operand is set-ish;
+            # treat as set only if either side syntactically is
+            return _ImpurityScan._is_set_expr(expr.left) \
+                or _ImpurityScan._is_set_expr(expr.right)
+        return False
+
+    @classmethod
+    def _set_locals(cls, fn: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and cls._is_set_expr(node.value):
+                out.add(node.targets[0].id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None \
+                    and cls._is_set_expr(node.value):
+                out.add(node.target.id)
+        return out
+
+    # -- nondeterministic calls ------------------------------------------
+    def _check_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "hash":
+                self._emit(
+                    node.lineno, "builtin hash()",
+                    "PYTHONHASHSEED salts str/bytes hashes per process; "
+                    "use ops.sketches.stable_hash64",
+                )
+            elif fn.id == "id":
+                self._emit(
+                    node.lineno, "id()",
+                    "object addresses differ across processes/replays",
+                )
+            return
+        if not isinstance(fn, ast.Attribute):
+            return
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "time" and fn.attr not in _TIME_PURE:
+                self._emit(
+                    node.lineno, f"time.{fn.attr}()",
+                    "clock reads differ across replays; thread event "
+                    "time / explicit parameters through instead",
+                )
+            elif base.id in ("random", "secrets"):
+                self._emit(
+                    node.lineno, f"{base.id}.{fn.attr}()",
+                    "nondeterministic entropy in a replay-critical path",
+                )
+            elif base.id == "uuid":
+                self._emit(
+                    node.lineno, f"uuid.{fn.attr}()",
+                    "fresh uuids differ per run; derive ids from "
+                    "deterministic inputs",
+                )
+            elif base.id == "os" and fn.attr == "urandom":
+                self._emit(
+                    node.lineno, "os.urandom()",
+                    "nondeterministic entropy in a replay-critical path",
+                )
+        elif isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id in ("np", "numpy") \
+                and base.attr == "random":
+            self._emit(
+                node.lineno, f"np.random.{fn.attr}()",
+                "nondeterministic entropy in a replay-critical path",
+            )
+
+    # -- unordered iteration ---------------------------------------------
+    def _check_iter(self, it: ast.AST, line: int, set_locals: set[str]) -> None:
+        set_ish = self._is_set_expr(it) or (
+            isinstance(it, ast.Name) and it.id in set_locals
+        )
+        if not set_ish and isinstance(it, ast.Call) \
+                and isinstance(it.func, ast.Name) \
+                and it.func.id in ("list", "tuple") and it.args:
+            # list(s)/tuple(s) preserves the set's arbitrary order —
+            # laundering, not fixing
+            a = it.args[0]
+            set_ish = self._is_set_expr(a) or (
+                isinstance(a, ast.Name) and a.id in set_locals
+            )
+        if set_ish:
+            self._emit(
+                line, "iteration over an unordered set",
+                "set order is hash-seed-dependent and feeds this "
+                "kernel's output; iterate sorted(...) or keep a "
+                "list-backed structure",
+            )
+
+
+def _closure(ana: _Analysis, roots: dict[str, str]) -> dict[str, str]:
+    """Transitive call closure from registered uids.  Returns
+    {uid: registered_root_qualname}; first (registration-order) root
+    wins for attribution.  Stops at the obs/faults boundary."""
+    pkg_prefix = ana.pkg + "/"
+
+    def rel_in_pkg(uid: str) -> str:
+        rel = uid.split(":", 1)[0]
+        return rel[len(pkg_prefix):] if rel.startswith(pkg_prefix) else rel
+
+    reached: dict[str, str] = {}
+    stack = list(roots.items())
+    while stack:
+        uid, root_q = stack.pop()
+        if uid in reached:
+            continue
+        reached[uid] = root_q
+        unit = ana.units.get(uid)
+        if unit is None:
+            continue
+        for callee, _line, _held in unit.calls:
+            if callee in reached or callee not in ana.units:
+                continue
+            if _excluded(rel_in_pkg(callee)):
+                continue
+            stack.append((callee, root_q))
+    return reached
+
+
+def _nested_uids(ana: _Analysis, uid: str) -> list[str]:
+    """A unit's lexically nested defs (``uid.inner...``) — scanned as
+    part of the kernel, and counted as covered for the reverse drift."""
+    prefix = uid + "."
+    return [u for u in ana.units if u.startswith(prefix)]
+
+
+def run(root: Path, replaypaths_path: Path | None = None) -> list[Finding]:
+    here = Path(__file__).resolve().parent
+    if replaypaths_path is None:
+        replaypaths_path = here / "replaypaths.toml"
+    entries = load_paths(replaypaths_path)
+
+    ana = _Analysis(root)
+    ana.collect()
+
+    findings: list[Finding] = []
+    roots: dict[str, str] = {}
+    for e in entries:
+        rel = e["file"]
+        uid = f"{rel}:{e['qualname']}"
+        if uid not in ana.units:
+            findings.append(Finding(
+                "DNZ-D002", "tools/dnzlint/replaypaths.toml", 1,
+                f"{rel}:{e['qualname']}",
+                f"replaypaths.toml registers {e['qualname']} but "
+                f"{rel} does not define it — update the registry for "
+                f"the moved/renamed kernel, or delete the entry",
+            ))
+            continue
+        roots.setdefault(uid, e["qualname"])
+
+    reached = _closure(ana, roots)
+    # nested defs of reached units are part of those kernels
+    covered = set(reached)
+    for uid in list(reached):
+        for nested in _nested_uids(ana, uid):
+            covered.add(nested)
+
+    # DNZ-D001: impurity scan over every unit in the closure (the scan
+    # walks nested defs itself, so nested uids need no separate scan)
+    for uid in sorted(reached):
+        rel, qual = uid.split(":", 1)
+        if "." in qual and any(
+            uid.startswith(other + ".") for other in reached if other != uid
+        ):
+            continue  # lexically inside an already-scanned unit
+        tree = ana.trees.get(rel)
+        if tree is None:
+            continue
+        fn = _find_function(tree, qual)
+        if fn is None:
+            continue
+        findings += _ImpurityScan(rel, qual, reached[uid]).scan(fn)
+
+    # DNZ-D002 reverse drift: snapshot-codec entry points outside the
+    # registry closure
+    pkg_prefix = ana.pkg + "/"
+    for uid, unit in sorted(ana.units.items()):
+        if uid in covered:
+            continue
+        rel = unit.rel
+        rel_in = rel[len(pkg_prefix):] if rel.startswith(pkg_prefix) else rel
+        if _excluded(rel_in):
+            continue
+        qual = uid.split(":", 1)[1]
+        tree = ana.trees.get(rel)
+        if tree is None:
+            continue
+        fn = _find_function(tree, qual)
+        if fn is None:
+            continue
+        hit = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if name in _CODEC_NAMES:
+                    hit = (name, node.lineno)
+                    break
+        if hit is not None:
+            findings.append(Finding(
+                "DNZ-D002", rel, hit[1], qual,
+                f"{qual} calls {hit[0]}() but is not covered by the "
+                f"replaypaths.toml transitive closure — a snapshot "
+                f"codec outside the determinism pin; register it (or "
+                f"the caller that owns the path)",
+            ))
+    return findings
+
+
+def replay_path_table(replaypaths_path: Path | None = None) -> str:
+    """The docs registry table (markdown), generated from
+    ``replaypaths.toml`` — drift between this and
+    ``docs/static_analysis.md`` is pinned by test, same pattern as the
+    fault-site table."""
+    here = Path(__file__).resolve().parent
+    if replaypaths_path is None:
+        replaypaths_path = here / "replaypaths.toml"
+    entries = load_paths(replaypaths_path)
+    lines = [
+        "| file | symbol | why it is replay-critical |",
+        "| --- | --- | --- |",
+    ]
+    for e in sorted(entries, key=lambda e: (e["file"], e["qualname"])):
+        lines.append(
+            f"| `{e['file']}` | `{e['qualname']}` | {e['note']} |"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,448 @@
+"""DNZ-S001/S002 — snapshot/restore symmetry.
+
+A field added to a keyed operator's snapshot payload but never read
+back, or read in restore but never written, is a silent state-loss bug
+that only a differential soak (hours in) or a version-skew restore
+(days later) surfaces.  This pass statically matches the key/field sets
+flowing into the snapshot payload against those read in the restore
+path, per ``keyed_state = true`` operator in ``operators.toml``.
+
+Method attribution works by codec seeding: a method whose body calls a
+write codec (``pack_snapshot`` / ``put_snapshot`` / ``put_json``) is on
+the *snapshot side*; a read codec (``unpack_snapshot`` /
+``get_snapshot`` / ``get_json``) caller is on the *restore side*.  Each
+side closes over private same-class / same-module helpers
+(``_pack_side_cols(side_meta)``-style extraction helpers carry payload
+keys too).  Within a side:
+
+- **written keys** — string-literal dict-display keys, ``x["k"] = v``
+  subscript stores, ``setdefault("k", ...)``;
+- **read keys** — ``x["k"]`` subscript loads (*strict* — restore dies
+  on a missing key) vs ``x.get("k", ...)`` / ``"k" in x`` (*tolerant*
+  — a legacy-layout default exists).
+
+DNZ-S001 fires on: a written key no restore path anywhere reads
+(dropped from restore), a *strict* read no snapshot path anywhere
+writes (phantom field — restore will KeyError on every real snapshot),
+and a version-literal key (``version`` / ``snapshot_version`` /
+``layout_version`` / ``fmt_version``) whose integer literals differ
+between the two sides (bumped on one side only).  Computed keys
+(f-strings, ``f"c{ci}|{k}"`` class-namespace slice layouts) are
+invisible to the matcher by construction — only literal drift is
+claimed.  Cross-codec keys (spill-block refs written by
+``state/tiering.py``, read by an operator, and vice versa) are resolved
+against package-wide auxiliary write/read sets rather than per-class
+ones, and ``epoch`` is allowlisted (written by every operator as
+provenance, deliberately read by none — restore trusts the manifest's
+epoch instead).
+
+DNZ-S002 is the registry drift rule: an ``operators.toml``-registered
+class with snapshot-codec flows but no ``keyed_state = true``, or a
+``keyed_state = true`` registration whose class has no snapshot flow
+left.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from tools.dnzlint import Finding, _parse_toml
+
+_WRITE_CODECS = frozenset({
+    "pack_snapshot", "put_snapshot", "put_json",
+    # spill-block re-keying into the epoch namespace: its callers own
+    # the spill-ref payload dicts ("side"/"bi"/"id"/... in join,
+    # "keys"/"entries" in the tiers)
+    "copy_block_to_epoch",
+})
+_READ_CODECS = frozenset({
+    "unpack_snapshot", "get_snapshot", "get_json",
+    "restore_block_from_epoch",
+})
+_VERSION_KEYS = frozenset({
+    "version", "snapshot_version", "layout_version", "fmt_version",
+})
+#: provenance keys every operator writes and restore deliberately
+#: ignores (the manifest, not the payload, is the restore's authority)
+_ALLOW_UNREAD = frozenset({"epoch"})
+
+
+@dataclasses.dataclass
+class _SideKeys:
+    written: dict[str, int] = dataclasses.field(default_factory=dict)
+    strict_read: dict[str, int] = dataclasses.field(default_factory=dict)
+    tolerant_read: dict[str, int] = dataclasses.field(default_factory=dict)
+    version_lits: set[int] = dataclasses.field(default_factory=set)
+
+    def merge(self, other: "_SideKeys") -> None:
+        for k, v in other.written.items():
+            self.written.setdefault(k, v)
+        for k, v in other.strict_read.items():
+            self.strict_read.setdefault(k, v)
+        for k, v in other.tolerant_read.items():
+            self.tolerant_read.setdefault(k, v)
+        self.version_lits |= other.version_lits
+
+
+def _str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _collect_keys(fn: ast.AST) -> _SideKeys:
+    """All literal payload-key activity in one function body."""
+    out = _SideKeys()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                key = _str_const(k) if k is not None else None
+                if key is None:
+                    continue
+                out.written.setdefault(key, node.lineno)
+                if key in _VERSION_KEYS and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    out.version_lits.add(v.value)
+        elif isinstance(node, ast.Subscript):
+            key = _str_const(node.slice)
+            if key is None:
+                continue
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                out.written.setdefault(key, node.lineno)
+            else:
+                out.strict_read.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("get", "pop") and node.args:
+                key = _str_const(node.args[0])
+                if key is not None:
+                    out.tolerant_read.setdefault(key, node.lineno)
+            elif node.func.attr == "setdefault" and node.args:
+                key = _str_const(node.args[0])
+                if key is not None:
+                    out.written.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            key = _str_const(node.left)
+            if key is not None:
+                out.tolerant_read.setdefault(key, node.lineno)
+        elif isinstance(node, ast.Assign):
+            # version literal via store: snap["version"] = 2
+            t = node.targets[0] if len(node.targets) == 1 else None
+            if isinstance(t, ast.Subscript) \
+                    and _str_const(t.slice) in _VERSION_KEYS \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                out.version_lits.add(node.value.value)
+    # version literals compared on the read side: x["version"] == 2,
+    # x.get("version", 1) — the .get default IS a version literal
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            left, right = node.left, node.comparators[0]
+            for a, b in ((left, right), (right, left)):
+                if isinstance(a, ast.Subscript) \
+                        and _str_const(a.slice) in _VERSION_KEYS \
+                        and isinstance(b, ast.Constant) \
+                        and isinstance(b.value, int):
+                    out.version_lits.add(b.value)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "get" and len(node.args) >= 2 \
+                and _str_const(node.args[0]) in _VERSION_KEYS \
+                and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, int):
+            out.version_lits.add(node.args[1].value)
+    return out
+
+
+class _ModuleUnits:
+    """One module's classes/functions with intra-module call edges."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: dict[str, ast.AST] = {}  # module-level defs
+        self.classes: dict[str, dict[str, ast.AST]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        methods[item.name] = item
+                self.classes[node.name] = methods
+
+    def callees(self, cls: str | None, fn: ast.AST) -> list[tuple[str | None, str]]:
+        """(owner_class_or_None, name) intra-module call edges."""
+        out = []
+        methods = self.classes.get(cls, {}) if cls else {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name):
+                if f.value.id in ("self", "cls") and f.attr in methods:
+                    out.append((cls, f.attr))
+                elif f.value.id in self.classes \
+                        and f.attr in self.classes[f.value.id]:
+                    out.append((f.value.id, f.attr))
+            elif isinstance(f, ast.Name) and f.id in self.functions:
+                out.append((None, f.id))
+        return out
+
+    def _calls_codec(self, fn: ast.AST, codecs: frozenset) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None
+                )
+                if name in codecs:
+                    return True
+        return False
+
+    @staticmethod
+    def _self_attr_loads(fn: ast.AST) -> set[str]:
+        return {
+            n.attr for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name) and n.value.id == "self"
+            and isinstance(n.ctx, ast.Load)
+        }
+
+    @staticmethod
+    def _self_attr_stores(fn: ast.AST) -> set[str]:
+        return {
+            n.attr for n in ast.walk(fn)
+            if isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Name) and n.value.id == "self"
+            and isinstance(n.ctx, ast.Store)
+        }
+
+    @staticmethod
+    def _builds_str_dict(fn: ast.AST) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Dict) and any(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in n.keys
+            ):
+                return True
+        return False
+
+    def side_units(self, cls: str, codecs: frozenset, *,
+                   bridge_writes: bool = False) -> list[tuple[str, ast.AST]]:
+        """Closure of ``cls``'s codec-calling methods over private
+        intra-module helpers: [(qualname, fn_node)].
+
+        With ``bridge_writes``, the closure also follows the
+        deferred-payload idiom: a private method that *stores* an
+        instance attribute some side unit *loads* — and that itself
+        builds a string-keyed dict — joins the side
+        (``_snapshot`` builds the meta, stashes it in
+        ``self._pending_snapshot``, ``_release_snapshot`` persists it).
+        """
+        seeds = [
+            (cls, m) for m, fn in self.classes.get(cls, {}).items()
+            if self._calls_codec(fn, codecs)
+        ]
+        seen: set[tuple[str | None, str]] = set()
+        order: list[tuple[str | None, str]] = []
+
+        def expand(stack: list) -> None:
+            while stack:
+                ref = stack.pop()
+                if ref in seen:
+                    continue
+                seen.add(ref)
+                order.append(ref)
+                owner, name = ref
+                fn = self.classes[owner][name] if owner \
+                    else self.functions[name]
+                for callee in self.callees(owner, fn):
+                    c_owner, c_name = callee
+                    if callee in seen:
+                        continue
+                    # descend only into private helpers — public methods
+                    # are independent surfaces, not extraction helpers
+                    if not c_name.startswith("_") \
+                            or c_name.startswith("__"):
+                        continue
+                    stack.append(callee)
+
+        expand(list(seeds))
+        if bridge_writes:
+            changed = True
+            while changed:
+                changed = False
+                loaded: set[str] = set()
+                for owner, name in order:
+                    fn = self.classes[owner][name] if owner \
+                        else self.functions[name]
+                    loaded |= self._self_attr_loads(fn)
+                for m, fn in self.classes.get(cls, {}).items():
+                    if (cls, m) in seen or not m.startswith("_") \
+                            or m.startswith("__"):
+                        continue
+                    if self._self_attr_stores(fn) & loaded \
+                            and self._builds_str_dict(fn):
+                        expand([(cls, m)])
+                        changed = True
+        out = []
+        for owner, name in order:
+            fn = self.classes[owner][name] if owner else self.functions[name]
+            qual = f"{owner}.{name}" if owner else name
+            out.append((qual, fn))
+        return out
+
+    def has_codec_flow(self, cls: str) -> bool:
+        return any(
+            self._calls_codec(fn, _WRITE_CODECS | _READ_CODECS)
+            for fn in self.classes.get(cls, {}).values()
+        )
+
+
+def load_operators(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    data = _parse_toml(path)
+    out = []
+    for entry in data.get("operator", []):
+        if entry.get("class") and entry.get("file"):
+            keyed = entry.get("keyed_state", False)
+            out.append({
+                "class": entry["class"],
+                "file": entry["file"],
+                # the no-tomllib fallback parses values as strings
+                "keyed_state": keyed in (True, "true"),
+            })
+    return out
+
+
+def _side_keys(mod: _ModuleUnits, cls: str, codecs: frozenset,
+               *, bridge_writes: bool = False) -> tuple[_SideKeys, dict[str, str]]:
+    """Merged key sets for one side, plus key -> qualname attribution."""
+    keys = _SideKeys()
+    where: dict[str, str] = {}
+    for qual, fn in mod.side_units(cls, codecs,
+                                   bridge_writes=bridge_writes):
+        got = _collect_keys(fn)
+        for k in got.written:
+            where.setdefault(f"w:{k}", qual)
+        for k in got.strict_read:
+            where.setdefault(f"r:{k}", qual)
+        keys.merge(got)
+    return keys, where
+
+
+def run(root: Path, operators_path: Path | None = None) -> list[Finding]:
+    here = Path(__file__).resolve().parent
+    if operators_path is None:
+        operators_path = here / "operators.toml"
+    entries = load_operators(operators_path)
+
+    findings: list[Finding] = []
+    pkg = root.name
+    mods: dict[str, tuple[_ModuleUnits, str]] = {}
+
+    def module_for(file_rel: str) -> _ModuleUnits | None:
+        if file_rel in mods:
+            return mods[file_rel][0]
+        inner = file_rel[len(pkg) + 1:] if file_rel.startswith(pkg + "/") \
+            else file_rel
+        path = root / inner
+        if not path.exists():
+            return None
+        tree = ast.parse(path.read_text(), filename=str(path))
+        mod = _ModuleUnits(tree)
+        mods[file_rel] = (mod, inner)
+        return mod
+
+    # package-wide auxiliary write/read sets: every codec-flow unit in
+    # the tree contributes, so cross-codec keys (tiering spill refs,
+    # rescale's rebuilt meta) resolve without per-class special cases
+    aux_written: set[str] = set()
+    aux_read: set[str] = set()
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = f"{pkg}/{path.relative_to(root)}"
+        mod = module_for(rel)
+        if mod is None:
+            continue
+        units = list(mod.functions.items()) + [
+            (f"{c}.{m}", fn)
+            for c, ms in mod.classes.items() for m, fn in ms.items()
+        ]
+        for _qual, fn in units:
+            if mod._calls_codec(fn, _WRITE_CODECS):
+                got = _collect_keys(fn)
+                aux_written |= set(got.written)
+            if mod._calls_codec(fn, _READ_CODECS):
+                got = _collect_keys(fn)
+                aux_read |= set(got.strict_read) | set(got.tolerant_read)
+
+    for e in entries:
+        mod = module_for(e["file"])
+        if mod is None or e["class"] not in mod.classes:
+            continue  # handoff.py owns missing-class drift (DNZ-M002)
+        cls = e["class"]
+        flows = mod.has_codec_flow(cls)
+        if e["keyed_state"] and not flows:
+            findings.append(Finding(
+                "DNZ-S002", "tools/dnzlint/operators.toml", 1, cls,
+                f"operators.toml registers {cls} keyed_state=true but "
+                f"the class has no snapshot codec flow — stale "
+                f"registration (state handling moved or was removed)",
+            ))
+            continue
+        if not e["keyed_state"]:
+            if flows:
+                findings.append(Finding(
+                    "DNZ-S002", e["file"], 1, cls,
+                    f"{cls} calls snapshot codecs but operators.toml "
+                    f"does not register it keyed_state=true — its "
+                    f"snapshot/restore symmetry is unchecked",
+                ))
+            continue
+
+        snap, snap_where = _side_keys(
+            mod, cls, _WRITE_CODECS, bridge_writes=True
+        )
+        rest, rest_where = _side_keys(mod, cls, _READ_CODECS)
+        reads_everywhere = set(rest.strict_read) | set(rest.tolerant_read)
+
+        for key, line in sorted(snap.written.items()):
+            if key in reads_everywhere or key in aux_read \
+                    or key in _ALLOW_UNREAD:
+                continue
+            qual = snap_where.get(f"w:{key}", cls)
+            findings.append(Finding(
+                "DNZ-S001", e["file"], line, qual,
+                f"snapshot payload key {key!r} is written by {cls}'s "
+                f"snapshot path but no restore path reads it — state "
+                f"silently dropped on restore (or a dead field: stop "
+                f"writing it)",
+            ))
+        for key, line in sorted(rest.strict_read.items()):
+            if key in snap.written or key in aux_written:
+                continue
+            qual = rest_where.get(f"r:{key}", cls)
+            findings.append(Finding(
+                "DNZ-S001", e["file"], line, qual,
+                f"restore path reads snapshot key {key!r} strictly "
+                f"(no .get default) but no snapshot path writes it — "
+                f"restore will KeyError on every real snapshot; write "
+                f"the field or read it with a legacy default",
+            ))
+        if snap.version_lits and rest.version_lits \
+                and snap.version_lits != rest.version_lits:
+            findings.append(Finding(
+                "DNZ-S001", e["file"], 1, cls,
+                f"snapshot version literals {sorted(snap.version_lits)} "
+                f"!= restore-side literals {sorted(rest.version_lits)} "
+                f"— the version was bumped on one side only",
+            ))
+    return findings

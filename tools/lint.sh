@@ -13,7 +13,20 @@ cd "$(dirname "$0")/.."
 fail=0
 
 echo "== dnzlint (rules: docs/static_analysis.md)"
-python -m tools.dnzlint denormalized_tpu || fail=1
+python -m tools.dnzlint denormalized_tpu --report LINT_REPORT.json || fail=1
+
+# budget gate: the whole point of a tier-1 lint is that nobody skips it
+# for being slow — the JSON report carries wall_clock_s so CI sees drift
+if ! python - <<'EOF'
+import json, sys
+wall = json.load(open("LINT_REPORT.json"))["wall_clock_s"]
+print(f"dnzlint wall clock: {wall}s (budget 60s)")
+sys.exit(0 if wall < 60 else 1)
+EOF
+then
+    echo "dnzlint blew its 60s wall-clock budget — profile the passes"
+    fail=1
+fi
 
 echo "== bench trend gate (BENCH_HISTORY.jsonl, latest vs previous)"
 python tools/bench_trend.py --gate --config simple --max-regress-pct 25 \
@@ -30,6 +43,20 @@ EOF
 then
     echo "docs/fault_tolerance.md fault-site table is stale — paste the"
     echo "output of: python -m tools.dnzlint --fault-site-table"
+    fail=1
+fi
+
+echo "== replay-path docs drift"
+table="$(python -m tools.dnzlint --replay-path-table)"
+if ! python - "$table" <<'EOF'
+import sys
+table = sys.argv[1]
+docs = open("docs/static_analysis.md").read()
+sys.exit(0 if table in docs else 1)
+EOF
+then
+    echo "docs/static_analysis.md replay-path table is stale — paste the"
+    echo "output of: python -m tools.dnzlint --replay-path-table"
     fail=1
 fi
 
